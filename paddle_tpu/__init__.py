@@ -1,0 +1,72 @@
+"""paddle_tpu — a TPU-native deep-learning framework with PaddlePaddle's
+capability set, built on JAX/XLA/Pallas/pjit.
+
+Top-level namespace mirrors `import paddle` (/root/reference/python/paddle/
+__init__.py): tensor ops, nn, optimizer, amp, io, jit, distributed, vision.
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .framework import (
+    Tensor, Parameter, no_grad, enable_grad, is_grad_enabled, to_tensor,
+    set_device, get_device, seed, get_rng_state, set_rng_state,
+    get_default_dtype, set_default_dtype,
+)
+from .framework.dtype import (  # dtype aliases: paddle.float32 etc.
+    bool_ as bool,  # noqa: A001
+    uint8, int8, int16, int32, int64, float16, bfloat16, float32, float64,
+    complex64, complex128, float8_e4m3fn, float8_e5m2,
+)
+
+from .tensor import *  # noqa: F401,F403 — op namespace at top level, like paddle
+from . import tensor  # noqa: F401
+from . import linalg  # noqa: F401
+from . import nn  # noqa: F401
+from . import optimizer  # noqa: F401
+from . import amp  # noqa: F401
+from . import io  # noqa: F401
+from . import jit  # noqa: F401
+from . import autograd  # noqa: F401
+from . import vision  # noqa: F401
+from . import distributed  # noqa: F401
+from . import ops  # noqa: F401
+from . import utils  # noqa: F401
+from .framework.io import save, load  # noqa: F401
+
+from .jit import to_static  # noqa: F401
+from .autograd import grad  # noqa: F401
+
+# paddle.DataParallel-style alias
+from .distributed.parallel import DataParallel  # noqa: F401
+
+
+def device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def disable_static(place=None):
+    return None
+
+
+def enable_static():
+    raise NotImplementedError(
+        "paddle_tpu is eager+jit only; use paddle_tpu.jit.to_static for "
+        "compiled graphs (the XLA path replaces the static-graph executor).")
+
+
+def in_dynamic_mode() -> bool:
+    return True

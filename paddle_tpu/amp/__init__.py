@@ -1,0 +1,139 @@
+"""paddle_tpu.amp — bf16-first autocast + GradScaler.
+
+Parity: /root/reference/python/paddle/amp/ (auto_cast.py:703, decorate:787,
+amp_lists.py, grad_scaler.py:578). On TPU the native mixed-precision dtype
+is bfloat16 (MXU matmul dtype); float16 is accepted for API compat. The
+autocast hook installs into framework.core.apply — the same interception
+point as the reference's generated AMP code in each ad_func
+(/root/reference/paddle/fluid/eager/amp_auto_cast.h).
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, _set_amp_hook
+from .grad_scaler import GradScaler  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler",
+           "white_list", "black_list", "is_auto_cast_enabled"]
+
+# Per-op lists (subset of /root/reference/python/paddle/amp/amp_lists.py:17-100)
+WHITE_LIST = {
+    "matmul", "bmm", "mm", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "flash_attention", "sdpa", "addmm", "mv",
+}
+BLACK_LIST = {
+    "exp", "log", "log2", "log10", "log1p", "logsumexp", "mean", "sum",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "bce",
+    "bce_logits", "mse_loss", "l1_loss", "kl_div", "layer_norm",
+    "batch_norm", "bn_stats", "group_norm", "instance_norm", "rms_norm",
+    "norm", "cumsum", "pow", "square", "reciprocal", "rsqrt", "sqrt",
+    "sigmoid", "erf", "erfinv",
+}
+
+
+class _AmpState:
+    enabled = False
+    dtype = None          # np.dtype target (bfloat16/float16)
+    level = "O1"
+    custom_white = set()
+    custom_black = set()
+
+
+_state = _AmpState()
+
+
+def is_auto_cast_enabled() -> bool:
+    return _state.enabled
+
+
+def white_list():
+    return WHITE_LIST | _state.custom_white
+
+
+def black_list():
+    return (BLACK_LIST | _state.custom_black) - _state.custom_white
+
+
+def _amp_hook(op_name, tensors):
+    if not _state.enabled:
+        return tensors
+    tgt = _state.dtype
+    wl = op_name in WHITE_LIST or op_name in _state.custom_white
+    # explicit custom white-list entries override the built-in black list
+    bl = (op_name in BLACK_LIST or op_name in _state.custom_black) and \
+        op_name not in _state.custom_white
+    if _state.level == "O2":
+        cast_down = not bl
+    else:
+        cast_down = wl and not bl
+    out = []
+    if cast_down:
+        for t in tensors:
+            if t.dtype == np.float32:
+                out.append(t.astype(tgt))
+            else:
+                out.append(t)
+        return out
+    if bl:
+        for t in tensors:
+            if t.dtype == np.dtype(tgt):
+                out.append(t.astype(np.float32))
+            else:
+                out.append(t)
+        return out
+    return tensors
+
+
+_set_amp_hook(_amp_hook)
+
+
+@contextlib.contextmanager
+def auto_cast(enable: bool = True, custom_white_list=None,
+              custom_black_list=None, level: str = "O1",
+              dtype: str = "bfloat16", use_promote: bool = True):
+    """paddle.amp.auto_cast parity; dtype defaults to bfloat16 (TPU)."""
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white, _state.custom_black)
+    _state.enabled = enable
+    _state.dtype = dtypes.convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white = set(custom_white_list or ())
+    _state.custom_black = set(custom_black_list or ())
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white, _state.custom_black) = prev
+
+
+amp_guard = auto_cast
+
+
+def decorate(models, optimizers=None, level: str = "O2", dtype: str = "bfloat16",
+             master_weight=None, save_dtype=None):
+    """paddle.amp.decorate parity: O2 casts model params to the AMP dtype
+    (optimizers keep float32 master weights via multi_precision)."""
+    d = dtypes.convert_dtype(dtype)
+    single = not isinstance(models, (list, tuple))
+    model_list = [models] if single else list(models)
+    if level == "O2":
+        for m in model_list:
+            m.to(dtype=d)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+def is_float16_supported(device=None):
+    return True
+
+
+def is_bfloat16_supported(device=None):
+    return True

@@ -1,0 +1,153 @@
+"""paddle.autograd parity: functional grad, PyLayer custom-op autograd.
+
+The reference implements these in C++ (/root/reference/paddle/fluid/eager/
+backward.cc:439 `Grad`, pylayer op). Here both ride the same Python tape over
+jax.vjp closures.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (
+    Tensor, TapeNode, no_grad, is_grad_enabled, _run_backward,
+)
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad"]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    for t, g in zip(tensors, grad_tensors):
+        t.backward(g, retain_graph=retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None) -> List[Optional[Tensor]]:
+    """paddle.grad analog (reference: paddle/fluid/eager/backward.cc:439).
+    create_graph (higher-order) is not supported on the eager tape — use
+    jax.grad composition through paddle_tpu.jit for that."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: compose jax.grad via paddle_tpu.jit instead")
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    retain = True if retain_graph is None else retain_graph
+
+    # Collect into a side table: paddle.grad must not touch .grad of ANY
+    # leaf (inputs or otherwise).
+    saved_sg = [t.stop_gradient for t in inputs]
+    for t in inputs:
+        t.stop_gradient = False
+    collected = {}
+
+    def collector(t, g):
+        prev = collected.get(id(t))
+        collected[id(t)] = g if prev is None else prev + g
+
+    try:
+        if grad_outputs is None:
+            grad_outputs = [None] * len(outputs)
+        for o, go in zip(outputs, grad_outputs):
+            if o.size != 1 and go is None:
+                raise RuntimeError("grad_outputs required for non-scalar")
+            seed = (go._value if isinstance(go, Tensor) else go)
+            if seed is None:
+                seed = jnp.ones(tuple(o.shape), o._value.dtype)
+            from ..framework.core import _run_backward
+            _run_backward(o, seed, retain, accum_fn=collector)
+        results = []
+        for t in inputs:
+            g = collected.get(id(t))
+            if g is None and not allow_unused:
+                raise RuntimeError(
+                    f"input {t.name or t} unused in the graph "
+                    "(pass allow_unused=True to get None)")
+            results.append(None if g is None else Tensor(g))
+        return results
+    finally:
+        for t, old_sg in zip(inputs, saved_sg):
+            t.stop_gradient = old_sg
+
+
+class PyLayerContext:
+    """ctx object passed to PyLayer.forward/backward
+    (paddle.autograd.PyLayer parity)."""
+
+    def __init__(self):
+        self._saved = ()
+        self.not_inplace_tensors = ()
+
+    def save_for_backward(self, *tensors):
+        self._saved = tensors
+
+    @property
+    def saved_tensor(self):
+        return self._saved
+
+    def saved_tensors(self):
+        return self._saved
+
+
+class PyLayer:
+    """Custom autograd op: subclass with static forward(ctx, ...) and
+    backward(ctx, *grads). Mirrors paddle.autograd.PyLayer — the mechanism
+    behind the reference's TP comm prims
+    (/root/reference/python/paddle/distributed/fleet/layers/mpu/mp_ops.py:27).
+    """
+
+    @staticmethod
+    def forward(ctx, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_args = [a for a in args if isinstance(a, Tensor)]
+        need_grad = is_grad_enabled() and any(
+            not t.stop_gradient for t in tensor_args)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs_list = list(outputs) if multi else [outputs]
+        results = [o if isinstance(o, Tensor) else Tensor(o) for o in outs_list]
+
+        if need_grad:
+            def vjp_fn(cotangents):
+                cts = cotangents if isinstance(cotangents, tuple) else (cotangents,)
+                ct_tensors = [Tensor(c) for c in cts]
+                with no_grad():
+                    gs = cls.backward(ctx, *ct_tensors)
+                gs = gs if isinstance(gs, (tuple, list)) else (gs,)
+                out = []
+                gi = iter(gs)
+                for t in tensor_args:
+                    g = next(gi, None)
+                    out.append(None if g is None else
+                               (g._value if isinstance(g, Tensor) else jnp.asarray(g)))
+                return tuple(out)
+
+            node = TapeNode(
+                vjp_fn, tensor_args,
+                [jax.ShapeDtypeStruct(tuple(r.shape), r.dtype) for r in results],
+                cls.__name__)
+            for k, r in enumerate(results):
+                r._node = node
+                r._out_idx = k
+                r.stop_gradient = False
+
+        if multi:
+            return tuple(results)
+        return results[0]

@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — mesh-native distributed stack.
+
+Replaces the reference's ProcessGroup/NCCL world
+(/root/reference/paddle/fluid/distributed/collective/,
+/root/reference/python/paddle/distributed/) with jax.sharding: collectives
+inside jitted programs are GSPMD-inserted XLA ops riding ICI; the host-side
+layer (init, rank/world bookkeeping, launch) wraps jax.distributed.
+"""
+from . import parallel  # noqa: F401
+from .parallel import (  # noqa: F401
+    init_parallel_env, get_rank, get_world_size, DataParallel, ParallelEnv,
+)
+from .mesh import (  # noqa: F401
+    ProcessMesh, auto, get_mesh, set_mesh,
+)
+from .placement import (  # noqa: F401
+    Placement, Shard, Replicate, Partial,
+)
+from .api import (  # noqa: F401
+    shard_tensor, reshard, shard_layer, shard_optimizer, dtensor_from_fn,
+    unshard_dtensor,
+)
+from .collective import (  # noqa: F401
+    all_reduce, all_gather, all_to_all, broadcast, reduce, reduce_scatter,
+    scatter, gather, barrier, send, recv, isend, irecv, new_group,
+    ReduceOp, get_group, wait,
+)
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+
+__all__ = [
+    "init_parallel_env", "get_rank", "get_world_size", "DataParallel",
+    "ParallelEnv", "ProcessMesh", "Shard", "Replicate", "Partial",
+    "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+    "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
+    "reduce_scatter", "scatter", "gather", "barrier", "send", "recv",
+    "new_group", "ReduceOp", "fleet", "checkpoint",
+]

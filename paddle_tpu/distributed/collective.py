@@ -1,0 +1,286 @@
+"""Eager collective API (parity:
+/root/reference/python/paddle/distributed/communication/ — all_reduce,
+all_gather, all_to_all, broadcast, reduce_scatter, send/recv, Group).
+
+TPU-native semantics: in the single-controller model there is no per-rank
+process; a "rank" is a device on a 1-D group mesh. A collective operates on
+a rank-stacked tensor (leading dim = group size, sharded across the group
+axis) and runs the real XLA collective via shard_map — so tests exercise
+the same psum/all_gather/ppermute lowering that GSPMD emits inside jitted
+programs. In multi-process (multi-host) deployments the jitted path is the
+supported one; this eager facade is for debugging and test parity
+(SURVEY §5.8).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework.core import Tensor
+
+__all__ = ["ReduceOp", "Group", "new_group", "get_group", "all_reduce",
+           "all_gather", "all_to_all", "broadcast", "reduce",
+           "reduce_scatter", "scatter", "gather", "barrier", "send", "recv",
+           "isend", "irecv", "wait", "destroy_process_group"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A set of 'ranks' = devices on a 1-D mesh axis named 'rank'."""
+
+    _next_id = 0
+
+    def __init__(self, ranks: Optional[List[int]] = None):
+        devices = jax.devices()
+        if ranks is None:
+            ranks = list(range(len(devices)))
+        self.ranks = ranks
+        self.nranks = len(ranks)
+        self.devs = np.asarray([devices[r] for r in ranks])
+        self.mesh = Mesh(self.devs, ("rank",))
+        Group._next_id += 1
+        self.id = Group._next_id
+
+    @property
+    def world_size(self):
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank)
+
+    def process_group(self):
+        return self
+
+
+_default_group: Optional[Group] = None
+
+
+def _group(group) -> Group:
+    global _default_group
+    if group is not None:
+        return group
+    if _default_group is None:
+        _default_group = Group()
+    return _default_group
+
+
+def new_group(ranks=None, backend=None, timeout=None) -> Group:
+    return Group(ranks)
+
+
+def get_group(gid=None) -> Group:
+    return _group(None)
+
+
+def destroy_process_group(group=None):
+    global _default_group
+    _default_group = None
+
+
+def _stacked(x: Tensor, g: Group):
+    """Interpret x as rank-stacked [nranks, ...]; shard dim 0 over ranks."""
+    arr = x._value
+    if arr.shape[0] != g.nranks:
+        raise ValueError(
+            f"eager collective expects rank-stacked input [nranks={g.nranks}"
+            f", ...]; got shape {arr.shape}")
+    return jax.device_put(arr, NamedSharding(g.mesh, P("rank")))
+
+
+def _run(g: Group, fn, arr, out_spec=P("rank")):
+    f = shard_map(fn, mesh=g.mesh, in_specs=(P("rank"),),
+                  out_specs=out_spec, check_vma=False)
+    return jax.jit(f)(arr)
+
+
+_REDUCERS = {
+    ReduceOp.SUM: lambda x, ax: jax.lax.psum(x, ax),
+    ReduceOp.MAX: lambda x, ax: jax.lax.pmax(x, ax),
+    ReduceOp.MIN: lambda x, ax: jax.lax.pmin(x, ax),
+    ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
+    ReduceOp.PROD: lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)),
+}
+
+
+class _Task:
+    """Stream-ordered task handle parity (ProcessGroup::Task). JAX arrays
+    are async by construction; wait() blocks."""
+
+    def __init__(self, arrs):
+        self._arrs = arrs if isinstance(arrs, (list, tuple)) else [arrs]
+
+    def wait(self):
+        for a in self._arrs:
+            if hasattr(a, "block_until_ready"):
+                a.block_until_ready()
+
+    def synchronize(self):
+        self.wait()
+
+    def is_completed(self):
+        return True
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    tensor._value.block_until_ready()
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None,
+               sync_op=True) -> _Task:
+    g = _group(group)
+    arr = _stacked(tensor, g)
+    out = _run(g, lambda x: _REDUCERS[op](x, "rank"), arr)
+    tensor._replace(out)
+    return _Task(out)
+
+
+def all_gather(tensor_list: List, tensor: Tensor, group=None,
+               sync_op=True) -> _Task:
+    """tensor: rank-stacked [nranks, ...]; result: each rank sees all —
+    tensor_list receives the nranks slices (identical on every rank)."""
+    g = _group(group)
+    arr = _stacked(tensor, g)
+    # per-shard [1,...] → all_gather(tiled) [nranks,...], replicated output
+    out = _run(g, lambda x: jax.lax.all_gather(x, "rank", axis=0, tiled=True),
+               arr, out_spec=P())
+    gathered = jax.device_get(out)
+    tensor_list.clear()
+    for i in range(g.nranks):
+        tensor_list.append(Tensor(jnp.asarray(gathered[i])))
+    return _Task(out)
+
+
+def all_to_all(out_tensor_list: List, in_tensor_list, group=None,
+               sync_op=True) -> _Task:
+    g = _group(group)
+    if isinstance(in_tensor_list, Tensor):
+        arr = _stacked(in_tensor_list, g)
+    else:
+        stacked = jnp.stack([t._value for t in in_tensor_list])
+        # [nranks_dst, ...] per rank; emulate with a [src, dst, ...] matrix
+        arr = stacked
+    if isinstance(in_tensor_list, (list, tuple)):
+        # full emulation: every rank r holds in_tensor_list (same on all) —
+        # in single-controller mode the caller provides the per-rank matrix
+        # as [src=me][dst]; transpose
+        raise NotImplementedError(
+            "eager all_to_all takes a rank-stacked Tensor "
+            "[nranks_src, nranks_dst, ...] in single-controller mode")
+    # arr: [src, dst, ...] sharded on src → output [dst, src, ...]
+    out = _run(g, lambda x: jax.lax.all_to_all(x, "rank", split_axis=1,
+                                               concat_axis=0, tiled=False),
+               arr)
+    out_tensor_list.clear()
+    out_tensor_list.append(Tensor(out))
+    return _Task(out)
+
+
+def broadcast(tensor: Tensor, src=0, group=None, sync_op=True) -> _Task:
+    g = _group(group)
+    arr = _stacked(tensor, g)
+    src_local = g.get_group_rank(src) if src in g.ranks else src
+
+    def f(x):
+        # select src rank's slice for everyone (pbroadcast via psum of mask)
+        idx = jax.lax.axis_index("rank")
+        contrib = jnp.where(idx == src_local, x, jnp.zeros_like(x))
+        return jax.lax.psum(contrib, "rank")
+
+    out = _run(g, f, arr)
+    tensor._replace(out)
+    return _Task(out)
+
+
+def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
+           sync_op=True) -> _Task:
+    g = _group(group)
+    arr = _stacked(tensor, g)
+    dst_local = g.get_group_rank(dst) if dst in g.ranks else dst
+
+    def f(x):
+        total = _REDUCERS[op](x, "rank")
+        idx = jax.lax.axis_index("rank")
+        return jnp.where(idx == dst_local, total, x)
+
+    out = _run(g, f, arr)
+    tensor._replace(out)
+    return _Task(out)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
+                   sync_op=True) -> _Task:
+    """in: rank-stacked [nranks, nranks*chunk, ...]; out per rank: its
+    reduced chunk. Result written to `tensor` as [nranks, chunk, ...]."""
+    g = _group(group)
+    if isinstance(tensor_list, Tensor):
+        arr = _stacked(tensor_list, g)
+    else:
+        arr = _stacked(tensor_list[0], g) if len(tensor_list) == 1 else \
+            jnp.stack([t._value for t in tensor_list])
+
+    def f(x):
+        return jax.lax.psum_scatter(x, "rank", scatter_dimension=1,
+                                    tiled=False)
+
+    out = _run(g, f, arr)
+    tensor._replace(out)
+    return _Task(out)
+
+
+def scatter(tensor: Tensor, tensor_list=None, src=0, group=None,
+            sync_op=True) -> _Task:
+    g = _group(group)
+    stacked = jnp.stack([t._value for t in tensor_list]) \
+        if tensor_list else tensor._value
+    # every rank gets slice r
+    tensor._replace(jax.device_put(
+        stacked, NamedSharding(g.mesh, P("rank"))))
+    return _Task(tensor._value)
+
+
+def gather(tensor: Tensor, gather_list=None, dst=0, group=None,
+           sync_op=True) -> _Task:
+    g = _group(group)
+    arr = _stacked(tensor, g)
+    gathered = jax.device_get(arr)
+    if gather_list is not None:
+        gather_list.clear()
+        for i in range(g.nranks):
+            gather_list.append(Tensor(jnp.asarray(gathered[i])))
+    return _Task(arr)
+
+
+def barrier(group=None):
+    g = _group(group)
+    x = jnp.zeros((g.nranks,), jnp.int32)
+    arr = jax.device_put(x, NamedSharding(g.mesh, P("rank")))
+    out = _run(g, lambda v: jax.lax.psum(v, "rank"), arr)
+    out.block_until_ready()
+
+
+def send(tensor: Tensor, dst=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv: use ppermute inside jitted programs "
+        "(paddle_tpu.distributed.fleet pipeline) — single-controller "
+        "eager p2p has no meaning")
+
+
+def recv(tensor: Tensor, src=0, group=None, sync_op=True):
+    raise NotImplementedError(
+        "eager p2p send/recv: use ppermute inside jitted programs")
+
+
+isend = send
+irecv = recv

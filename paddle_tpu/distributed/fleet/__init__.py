@@ -1,0 +1,86 @@
+"""paddle_tpu.distributed.fleet — hybrid-parallel entry (parity:
+/root/reference/python/paddle/distributed/fleet/fleet.py:167 fleet.init,
+base/distributed_strategy.py:1808 hybrid_configs).
+
+TPU-native: fleet.init builds a HybridCommunicateGroup = a named device
+mesh; distributed_model / distributed_optimizer are sharding-recipe
+appliers, not wrapper runtimes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from .topology import CommunicateTopology, HybridCommunicateGroup
+from .strategy import DistributedStrategy
+from . import mpu  # noqa: F401
+from .mpu import (  # noqa: F401
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelCrossEntropy,
+)
+from .recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["init", "fleet", "DistributedStrategy", "HybridCommunicateGroup",
+           "get_hybrid_communicate_group", "distributed_model",
+           "distributed_optimizer", "recompute", "ColumnParallelLinear",
+           "RowParallelLinear", "VocabParallelEmbedding",
+           "ParallelCrossEntropy"]
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = False,
+         strategy: Optional[DistributedStrategy] = None, log_level="INFO"):
+    """fleet.init parity: reads strategy.hybrid_configs and builds the mesh."""
+    global _hcg, _strategy
+    from .. import parallel
+    parallel.init_parallel_env()
+    _strategy = strategy or DistributedStrategy()
+    hc = _strategy.hybrid_configs
+    _hcg = HybridCommunicateGroup(
+        dp_degree=hc.get("dp_degree", 1),
+        mp_degree=hc.get("mp_degree", 1),
+        pp_degree=hc.get("pp_degree", 1),
+        sharding_degree=hc.get("sharding_degree", 1),
+        sep_degree=hc.get("sep_degree", 1),
+    )
+    return _hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def distributed_model(model):
+    """Apply the sharding recipe implied by the strategy (parity:
+    /root/reference/python/paddle/distributed/fleet/model.py:32). On TPU
+    this annotates parameter shardings; TP layers already carry theirs."""
+    if _hcg is None:
+        return model
+    from .sharding_recipes import apply_hybrid_shardings
+    return apply_hybrid_shardings(model, _hcg, _strategy)
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    from ..api import shard_optimizer
+    return shard_optimizer(optimizer)
+
+
+class _FleetNamespace:
+    init = staticmethod(init)
+    distributed_model = staticmethod(distributed_model)
+    distributed_optimizer = staticmethod(distributed_optimizer)
+    get_hybrid_communicate_group = staticmethod(get_hybrid_communicate_group)
+
+    @property
+    def worker_num(self):
+        import jax
+        return jax.process_count()
+
+    @property
+    def worker_index(self):
+        import jax
+        return jax.process_index()
+
+
+fleet = _FleetNamespace()

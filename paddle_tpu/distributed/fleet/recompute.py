@@ -1,0 +1,92 @@
+"""Activation recompute (parity:
+/root/reference/python/paddle/distributed/fleet/recompute/recompute.py:108).
+
+TPU-native: jax.checkpoint IS the recompute engine — the reference's
+RecomputeFunction PyLayer (save inputs, re-run forward in backward, RNG
+state juggling via mp RNG tracker) collapses into one rematerialization
+annotation that XLA schedules optimally. RNG correctness under remat is
+handled by jax.checkpoint's deterministic key threading (our dropout draws
+from fold_in counters, which replay identically).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ...framework.core import Tensor, apply, no_grad
+from ...jit import _SwapGuard, _unwrap_tree
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, use_reentrant: bool = True, **kwargs):
+    """Run function(*args) with activation rematerialization in backward."""
+    preserve = kwargs.pop("preserve_rng_state", True)
+    layer_params = []
+    if hasattr(function, "parameters"):
+        layer_params = [p for p in function.parameters()]
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
+    n_p = len(layer_params)
+
+    treedef_holder = {}
+
+    def pure(*arrs):
+        p_arrs = arrs[:n_p]
+        i_arrs = arrs[n_p:]
+        full_args = list(args)
+        for pos, a in zip(tensor_pos, i_arrs):
+            full_args[pos] = Tensor(a)
+        with _SwapGuard(layer_params, list(p_arrs)):
+            with no_grad():
+                out = function(*full_args, **kwargs)
+        flat, treedef = jax.tree_util.tree_flatten(_unwrap_tree(out))
+        treedef_holder["treedef"] = treedef
+        return tuple(flat) if len(flat) > 1 else flat[0]
+
+    ckpt = jax.checkpoint(pure)
+    result = apply("recompute", ckpt, *layer_params, *tensor_args)
+    flat = list(result) if isinstance(result, tuple) else [result]
+    return jax.tree_util.tree_unflatten(treedef_holder["treedef"], flat)
+
+
+def recompute_sequential(ctx, functions, *args, **kwargs):
+    """paddle recompute_sequential parity: chunked recompute over a
+    Sequential container."""
+    segments = ctx.get("segments", 1) if isinstance(ctx, dict) else 1
+    layers = list(functions)
+    n = len(layers)
+    per = max(1, n // segments)
+    out = args
+    i = 0
+    while i < n:
+        chunk = layers[i:i + per]
+
+        def run_chunk(*xs, _chunk=tuple(chunk)):
+            y = xs if len(xs) > 1 else xs[0]
+            for l in _chunk:
+                y = l(y) if not isinstance(y, tuple) else l(*y)
+            return y
+
+        class _ChunkFn:
+            def __init__(self, chunk):
+                self.chunk = chunk
+
+            def parameters(self):
+                ps = []
+                for l in self.chunk:
+                    ps.extend(l.parameters())
+                return ps
+
+            def __call__(self, *xs):
+                y = xs if len(xs) > 1 else xs[0]
+                for l in self.chunk:
+                    y = l(y) if not isinstance(y, tuple) else l(*y)
+                return y
+
+        out = recompute(_ChunkFn(chunk), *(out if isinstance(out, tuple)
+                                           else (out,)), **kwargs)
+        out = out if isinstance(out, tuple) else (out,)
+        i += per
+    return out[0] if len(out) == 1 else out

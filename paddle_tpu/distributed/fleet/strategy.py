@@ -1,0 +1,75 @@
+"""DistributedStrategy (parity:
+/root/reference/python/paddle/distributed/fleet/base/distributed_strategy.py
+:1808 hybrid_configs — the protobuf-backed config becomes a plain typed
+dict with the same keys)."""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+__all__ = ["DistributedStrategy"]
+
+_DEFAULT_HYBRID = {
+    "dp_degree": 1,
+    "mp_degree": 1,
+    "pp_degree": 1,
+    "sharding_degree": 1,
+    "sep_degree": 1,
+    "order": ["dp", "pp", "sharding", "sep", "mp"],
+}
+
+_DEFAULT_AMP = {
+    "init_loss_scaling": 32768.0,
+    "custom_white_list": [],
+    "custom_black_list": [],
+    "level": "O1",
+    "dtype": "bfloat16",
+    "use_pure_bf16": False,
+}
+
+_DEFAULT_SHARDING = {
+    "sharding_degree": 1,
+    "stage": 1,
+    "offload": False,
+}
+
+_DEFAULT_RECOMPUTE = {
+    "enable": False,
+    "checkpoints": [],
+}
+
+_DEFAULT_PIPELINE = {
+    "accumulate_steps": 1,
+    "micro_batch_size": 1,
+    "schedule_mode": "1F1B",
+}
+
+
+class DistributedStrategy:
+    def __init__(self):
+        self.hybrid_configs: Dict[str, Any] = dict(_DEFAULT_HYBRID)
+        self.amp = False
+        self.amp_configs: Dict[str, Any] = dict(_DEFAULT_AMP)
+        self.sharding = False
+        self.sharding_configs: Dict[str, Any] = dict(_DEFAULT_SHARDING)
+        self.recompute = False
+        self.recompute_configs: Dict[str, Any] = dict(_DEFAULT_RECOMPUTE)
+        self.pipeline = False
+        self.pipeline_configs: Dict[str, Any] = dict(_DEFAULT_PIPELINE)
+        self.gradient_merge = False
+        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.find_unused_parameters = False
+        self.fuse_grad_size_in_MB = 32
+
+    def __setattr__(self, name, value):
+        if name == "hybrid_configs" and isinstance(value, dict) and \
+                hasattr(self, "hybrid_configs"):
+            merged = dict(self.hybrid_configs)
+            merged.update(value)
+            object.__setattr__(self, name, merged)
+        else:
+            object.__setattr__(self, name, value)
+
+    def __repr__(self):
+        return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
+                f"amp={self.amp}, sharding={self.sharding}, "
+                f"recompute={self.recompute}, pipeline={self.pipeline})")

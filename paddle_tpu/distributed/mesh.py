@@ -1,0 +1,126 @@
+"""ProcessMesh (parity:
+/root/reference/python/paddle/distributed/auto_parallel/process_mesh.py:72,
+C++ /root/reference/paddle/phi/core/distributed/auto_parallel/process_mesh.h:34).
+
+A ProcessMesh is a named N-D grid of devices; it materializes as a
+jax.sharding.Mesh whose axis names carry the parallelism meaning
+(dp/fsdp/tp/pp/sep/ep). GSPMD inserts the collectives implied by
+NamedSharding placements over these axes — the reference's 132 SPMD rules
+(/root/reference/paddle/phi/infermeta/spmd_rules/rules.cc:38) collapse into
+XLA's propagation pass.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+
+__all__ = ["ProcessMesh", "get_mesh", "set_mesh", "auto"]
+
+
+class ProcessMesh:
+    def __init__(self, mesh, dim_names: Optional[Sequence[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh, dtype=np.int64)
+        if arr.ndim == 0:
+            arr = arr.reshape(1)
+        self._mesh_arr = arr
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError("dim_names must match mesh ndim")
+        self._dim_names = list(dim_names)
+        self._jax_mesh = None
+
+    # -- paddle API ----------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._mesh_arr.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._mesh_arr.ndim
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def mesh(self):
+        return self._mesh_arr
+
+    @property
+    def process_ids(self) -> List[int]:
+        return self._mesh_arr.reshape(-1).tolist()
+
+    @property
+    def size(self) -> int:
+        return int(self._mesh_arr.size)
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._mesh_arr.shape[self._dim_names.index(dim_name)]
+
+    def get_mesh_with_dim(self, dim_name: str, index=None):
+        """Sub-mesh slicing along a named dim (paddle parity)."""
+        axis = self._dim_names.index(dim_name)
+        moved = np.moveaxis(self._mesh_arr, axis, 0)
+        names = [dim_name] + [n for n in self._dim_names if n != dim_name]
+        if index is None:
+            return ProcessMesh(moved, names)
+        return ProcessMesh(moved[index], names[1:])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and np.array_equal(self._mesh_arr, other._mesh_arr)
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((self._mesh_arr.tobytes(), tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self.shape}, dim_names="
+                f"{self._dim_names})")
+
+    # -- jax materialization -------------------------------------------------
+    def to_jax_mesh(self) -> jax.sharding.Mesh:
+        if self._jax_mesh is None:
+            devices = np.asarray(jax.devices())
+            ids = self._mesh_arr.reshape(-1)
+            if ids.max() >= len(devices):
+                raise RuntimeError(
+                    f"mesh references device {ids.max()} but only "
+                    f"{len(devices)} JAX devices exist")
+            dev_grid = devices[ids].reshape(self._mesh_arr.shape)
+            self._jax_mesh = jax.sharding.Mesh(dev_grid,
+                                               tuple(self._dim_names))
+        return self._jax_mesh
+
+    def named_sharding(self, *spec) -> jax.sharding.NamedSharding:
+        return jax.sharding.NamedSharding(
+            self.to_jax_mesh(), jax.sharding.PartitionSpec(*spec))
+
+
+def create_mesh(shape: Sequence[int], dim_names: Sequence[str]) -> ProcessMesh:
+    n = int(np.prod(shape))
+    return ProcessMesh(np.arange(n).reshape(tuple(shape)), dim_names)
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+class _AutoNamespace:
+    """paddle.distributed.auto namespace stub for API parity."""
+    ProcessMesh = ProcessMesh
+
+
+auto = _AutoNamespace()

@@ -1,0 +1,141 @@
+"""Parallel env + DataParallel (parity:
+/root/reference/python/paddle/distributed/parallel.py — init_parallel_env:
+943, DataParallel:202).
+
+TPU-native: single-controller JAX. A "rank" in the reference's
+process-per-GPU world maps to a device here; multi-host runs use
+jax.distributed (coordinator = the TCPStore analog) and keep the same API.
+DataParallel needs no gradient reducer (the reference's EagerReducer,
+/root/reference/paddle/fluid/distributed/collective/reducer.h:88): with
+params replicated and the batch sharded over the 'dp' axis, XLA inserts the
+gradient all-reduce during the backward build — bucketing/fusion included.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+
+from ..framework.core import Tensor
+from .mesh import ProcessMesh
+from .placement import Replicate, Shard
+
+__all__ = ["init_parallel_env", "get_rank", "get_world_size", "ParallelEnv",
+           "DataParallel"]
+
+_initialized = False
+
+
+def init_parallel_env():
+    """Bootstraps multi-host JAX if coordinator env vars are present
+    (PADDLE_MASTER / MASTER_ADDR / JAX coordination vars); no-op otherwise.
+    The reference's TCPStore rendezvous
+    (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121) is
+    jax.distributed's coordination service."""
+    global _initialized
+    if _initialized:
+        return
+    coord = os.environ.get("PADDLE_MASTER") or os.environ.get("MASTER_ADDR")
+    nprocs = int(os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    pid = int(os.environ.get("PADDLE_TRAINER_ID", "0"))
+    if coord and nprocs > 1:
+        port = os.environ.get("MASTER_PORT", "8476")
+        addr = coord if ":" in coord else f"{coord}:{port}"
+        jax.distributed.initialize(coordinator_address=addr,
+                                   num_processes=nprocs, process_id=pid)
+    _initialized = True
+
+
+def get_rank(group=None) -> int:
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    # device-granular world (see module docstring): total chips
+    return jax.device_count()
+
+
+class ParallelEnv:
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return 0
+
+    @property
+    def nranks(self):
+        return get_world_size()
+
+    @property
+    def device_id(self):
+        return 0
+
+
+class DataParallel:
+    """paddle.DataParallel parity. Wraps a Layer: parameters are replicated
+    over a 1-D dp mesh, inputs get sharded on the batch dim. Both eager
+    (computation-follows-sharding) and jitted paths then run data-parallel
+    with XLA-inserted gradient all-reduce."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        object.__setattr__(self, "_layers", layers)
+        n = jax.device_count()
+        mesh = ProcessMesh(np.arange(n), ["dp"])
+        object.__setattr__(self, "_mesh", mesh)
+        if n > 1:
+            from .api import shard_tensor
+            for _, p in layers.named_parameters():
+                sharded = jax.device_put(
+                    p._value, mesh.named_sharding(None))
+                p._replace(sharded)
+            for _, b in layers.named_buffers():
+                if b is not None:
+                    b._replace(jax.device_put(
+                        b._value, mesh.named_sharding(None)))
+
+    def __call__(self, *inputs, **kwargs):
+        n = jax.device_count()
+        if n > 1:
+            sharded_inputs = []
+            for x in inputs:
+                if isinstance(x, Tensor) and x.shape and x.shape[0] % n == 0:
+                    arr = jax.device_put(
+                        x._value, self._mesh.named_sharding("dp"))
+                    t = Tensor(arr, x.stop_gradient, x.name)
+                    sharded_inputs.append(t)
+                else:
+                    sharded_inputs.append(x)
+            inputs = tuple(sharded_inputs)
+        return self._layers(*inputs, **kwargs)
+
+    def __getattr__(self, name):
+        return getattr(self._layers, name)
+
+    def __setattr__(self, name, value):
+        setattr(self._layers, name, value)
+
+    # common passthroughs made explicit for clarity
+    def forward(self, *a, **kw):
+        return self.__call__(*a, **kw)
+
+    def state_dict(self, *a, **kw):
+        return self._layers.state_dict(*a, **kw)
+
+    def set_state_dict(self, *a, **kw):
+        return self._layers.set_state_dict(*a, **kw)
+
+    def scale_loss(self, loss):
+        return loss
+
+    def apply_collective_grads(self):
+        pass

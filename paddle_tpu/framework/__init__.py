@@ -1,0 +1,17 @@
+from . import dtype
+from .core import (
+    Tensor, Parameter, apply, apply_nodiff, no_grad, enable_grad,
+    is_grad_enabled, to_tensor, set_device, get_device, seed,
+    get_rng_state, set_rng_state, default_generator, Generator, with_rng_key,
+)
+from .dtype import (
+    convert_dtype, get_default_dtype, set_default_dtype,
+)
+
+__all__ = [
+    "Tensor", "Parameter", "apply", "apply_nodiff", "no_grad", "enable_grad",
+    "is_grad_enabled", "to_tensor", "set_device", "get_device", "seed",
+    "get_rng_state", "set_rng_state", "default_generator", "Generator",
+    "with_rng_key", "convert_dtype", "get_default_dtype", "set_default_dtype",
+    "dtype",
+]

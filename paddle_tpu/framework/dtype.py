@@ -1,0 +1,97 @@
+"""Dtype registry and promotion helpers.
+
+TPU-native analog of the reference's dtype plumbing
+(/root/reference/paddle/phi/common/data_type.h): instead of a C++ enum +
+promotion tables, we alias JAX/NumPy dtypes under Paddle-style names and lean
+on jnp's promotion (which matches XLA semantics). bfloat16 is first-class —
+it is the TPU MXU's native matmul dtype.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical dtype objects (numpy dtype instances; jnp.bfloat16 is ml_dtypes).
+bool_ = jnp.bool_
+uint8 = jnp.uint8
+int8 = jnp.int8
+int16 = jnp.int16
+int32 = jnp.int32
+int64 = jnp.int64
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float32 = jnp.float32
+float64 = jnp.float64
+complex64 = jnp.complex64
+complex128 = jnp.complex128
+float8_e4m3fn = jnp.float8_e4m3fn
+float8_e5m2 = jnp.float8_e5m2
+
+_STR2DTYPE = {
+    "bool": bool_,
+    "uint8": uint8,
+    "int8": int8,
+    "int16": int16,
+    "int32": int32,
+    "int64": int64,
+    "float16": float16,
+    "bfloat16": bfloat16,
+    "float32": float32,
+    "float64": float64,
+    "complex64": complex64,
+    "complex128": complex128,
+    "float8_e4m3fn": float8_e4m3fn,
+    "float8_e5m2": float8_e5m2,
+    # Paddle-style aliases
+    "fp16": float16,
+    "bf16": bfloat16,
+    "fp32": float32,
+    "fp64": float64,
+}
+
+_FLOATING = {float16, bfloat16, float32, float64, float8_e4m3fn, float8_e5m2}
+
+
+def convert_dtype(dtype):
+    """Normalize a dtype-like (str, np.dtype, jnp dtype) to a numpy dtype."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        try:
+            return np.dtype(_STR2DTYPE[dtype])
+        except KeyError:
+            raise ValueError(f"Unknown dtype string: {dtype!r}")
+    return np.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name
+
+
+def is_floating_point(dtype) -> bool:
+    d = np.dtype(dtype)
+    return any(d == np.dtype(f) for f in _FLOATING)
+
+
+def is_integer(dtype) -> bool:
+    return np.dtype(dtype).kind in ("i", "u")
+
+
+def is_complex(dtype) -> bool:
+    return np.dtype(dtype).kind == "c"
+
+
+# Default dtype management (paddle.get_default_dtype / set_default_dtype).
+_default_dtype = np.dtype(np.float32)
+
+
+def set_default_dtype(dtype):
+    global _default_dtype
+    d = convert_dtype(dtype)
+    if not is_floating_point(d):
+        raise TypeError(f"Default dtype must be floating point, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

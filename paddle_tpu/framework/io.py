@@ -1,0 +1,75 @@
+"""paddle.save / paddle.load parity
+(/root/reference/python/paddle/framework/io.py:721,960): pickle-based
+state_dict persistence. Tensors serialize as numpy arrays."""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import Tensor, Parameter
+
+__all__ = ["save", "load"]
+
+_PROTOCOL = 4
+
+
+class _TensorPickle:
+    def __init__(self, array: np.ndarray, is_param: bool, name: str,
+                 stop_gradient: bool, dtype_name: str):
+        self.array = array
+        self.is_param = is_param
+        self.name = name
+        self.stop_gradient = stop_gradient
+        self.dtype_name = dtype_name
+
+
+def _pack(obj: Any) -> Any:
+    if isinstance(obj, Tensor):
+        arr = np.asarray(jax.device_get(obj._value))
+        return _TensorPickle(arr, isinstance(obj, Parameter), obj.name,
+                             obj.stop_gradient, str(obj._value.dtype))
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_pack(v) for v in obj)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy: bool = False) -> Any:
+    if isinstance(obj, _TensorPickle):
+        if return_numpy:
+            return obj.array
+        arr = jnp.asarray(obj.array)
+        if obj.dtype_name == "bfloat16":
+            arr = arr.astype(jnp.bfloat16)
+        if obj.is_param:
+            p = Parameter(arr, trainable=not obj.stop_gradient, name=obj.name)
+            return p
+        t = Tensor(arr, stop_gradient=obj.stop_gradient, name=obj.name)
+        return t
+    if isinstance(obj, dict):
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        t = type(obj)
+        return t(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = _PROTOCOL, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        obj = pickle.load(f)
+    return _unpack(obj, return_numpy=return_numpy)

@@ -1,0 +1,7 @@
+"""paddle.linalg namespace parity."""
+from .tensor.linalg import (  # noqa: F401
+    cholesky, inv, pinv, det, slogdet, svd, qr, eigh, eigvalsh, solve,
+    triangular_solve, lstsq, matrix_power, matrix_rank, cond, lu,
+    householder_product, cov, corrcoef, norm, matmul, multi_dot,
+    matrix_transpose,
+)

@@ -1,0 +1,194 @@
+"""Activation functionals (parity:
+/root/reference/python/paddle/nn/functional/activation.py). All map to VPU
+elementwise ops; XLA fuses them into surrounding matmuls."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+
+__all__ = [
+    "relu", "relu6", "relu_", "elu", "selu", "celu", "gelu", "silu", "swish",
+    "sigmoid", "hardsigmoid", "hardswish", "hardtanh", "tanh", "tanhshrink",
+    "softshrink", "hardshrink", "leaky_relu", "prelu", "rrelu", "mish",
+    "softplus", "softsign", "softmax", "log_softmax", "log_sigmoid", "glu",
+    "maxout", "thresholded_relu", "gumbel_softmax",
+]
+
+
+def relu(x, name=None):
+    return apply("relu", jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def relu6(x, name=None):
+    return apply("relu6", jax.nn.relu6, x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply("elu", lambda a: jax.nn.elu(a, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply("selu", lambda a: scale * jnp.where(a > 0, a, alpha * jnp.expm1(a)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply("celu", lambda a: jax.nn.celu(a, alpha), x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+
+
+def silu(x, name=None):
+    return apply("silu", jax.nn.silu, x)
+
+
+swish = silu
+
+
+def sigmoid(x, name=None):
+    return apply("sigmoid", jax.nn.sigmoid, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply("hardsigmoid", lambda a: jnp.clip(slope * a + offset, 0.0, 1.0), x)
+
+
+def hardswish(x, name=None):
+    return apply("hardswish", lambda a: a * jnp.clip(a + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return apply("hardtanh", lambda a: jnp.clip(a, min, max), x)
+
+
+def tanh(x, name=None):
+    return apply("tanh", jnp.tanh, x)
+
+
+def tanhshrink(x, name=None):
+    return apply("tanhshrink", lambda a: a - jnp.tanh(a), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply("softshrink",
+                 lambda a: jnp.where(a > threshold, a - threshold,
+                                     jnp.where(a < -threshold, a + threshold,
+                                               jnp.zeros_like(a))), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply("hardshrink",
+                 lambda a: jnp.where(jnp.abs(a) > threshold, a,
+                                     jnp.zeros_like(a)), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            w_b = w.reshape(())
+        else:
+            ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+            shape = [1] * a.ndim
+            shape[ch_axis] = -1
+            w_b = w.reshape(shape)
+        return jnp.where(a > 0, a, w_b * a)
+    return apply("prelu", f, x, weight)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
+    from ...framework.core import default_generator
+    if training:
+        key = default_generator.next_key()
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+            return jnp.where(a >= 0, a, slope * a)
+        return apply("rrelu", f, x)
+    mid = (lower + upper) / 2.0
+    return leaky_relu(x, mid)
+
+
+def mish(x, name=None):
+    return apply("mish", lambda a: a * jnp.tanh(jax.nn.softplus(a)), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply("softplus",
+                 lambda a: jnp.where(beta * a > threshold, a,
+                                     jax.nn.softplus(beta * a) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply("softsign", jax.nn.soft_sign, x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework import dtype as dtypes
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.softmax(a, axis=axis)
+    return apply("softmax", f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework import dtype as dtypes
+            a = a.astype(dtypes.convert_dtype(dtype))
+        return jax.nn.log_softmax(a, axis=axis)
+    return apply("log_softmax", f, x)
+
+
+def log_sigmoid(x, name=None):
+    return apply("log_sigmoid", jax.nn.log_sigmoid, x)
+
+
+def glu(x, axis=-1, name=None):
+    def f(a):
+        a1, a2 = jnp.split(a, 2, axis=axis)
+        return a1 * jax.nn.sigmoid(a2)
+    return apply("glu", f, x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return apply("maxout", f, x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return apply("thresholded_relu",
+                 lambda a: jnp.where(a > threshold, a, jnp.asarray(value, a.dtype)), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework.core import default_generator
+    key = default_generator.next_key()
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
+            y = y_hard + jax.lax.stop_gradient(y) - y + y - jax.lax.stop_gradient(y)
+            y = y_hard - jax.lax.stop_gradient(y) + y if False else y_hard + y - jax.lax.stop_gradient(y)
+        return y
+    return apply("gumbel_softmax", f, x)

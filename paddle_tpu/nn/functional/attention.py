@@ -1,0 +1,57 @@
+"""Attention functionals (parity:
+/root/reference/python/paddle/nn/functional/flash_attention.py:146,441).
+Layout matches paddle: [batch, seq, num_heads, head_dim]."""
+from __future__ import annotations
+
+from ...framework.core import Tensor, apply
+from ...ops import flash_attention as _fa
+
+__all__ = ["scaled_dot_product_attention", "flash_attention",
+           "flash_attn_unpadded", "sdp_kernel"]
+
+
+def flash_attention(query, key, value, dropout=0.0, causal=False,
+                    return_softmax=False, fixed_seed_offset=None, rng_name="",
+                    training=True, name=None):
+    out = apply("flash_attention",
+                lambda q, k, v: _fa(q, k, v, causal=causal, dropout=dropout),
+                query, key, value)
+    if return_softmax:
+        return out, None
+    return out, None  # paddle returns (out, softmax) tuple
+
+
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False,
+                                 training=True, name=None):
+    if attn_mask is not None:
+        return apply("sdpa",
+                     lambda q, k, v, m: _fa(q, k, v, attn_mask=m,
+                                            causal=is_causal),
+                     query, key, value, attn_mask)
+    return apply("sdpa",
+                 lambda q, k, v: _fa(q, k, v, causal=is_causal),
+                 query, key, value)
+
+
+def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
+                        max_seqlen_q, max_seqlen_k, scale, dropout=0.0,
+                        causal=False, return_softmax=False,
+                        fixed_seed_offset=None, rng_name="", training=True,
+                        name=None):
+    raise NotImplementedError(
+        "varlen flash attention: planned (segment-ids Pallas kernel)")
+
+
+class sdp_kernel:
+    """Context manager API-compat shim (paddle.nn.functional.sdp_kernel)."""
+
+    def __init__(self, enable_flash=True, enable_math=True,
+                 enable_mem_efficient=True):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False

@@ -1,0 +1,206 @@
+"""Common functionals: linear, dropout, embedding, one_hot, interpolate …
+(parity: /root/reference/python/paddle/nn/functional/common.py,
+input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply, apply_nodiff, default_generator
+from ...framework import dtype as dtypes
+
+__all__ = [
+    "linear", "dropout", "dropout2d", "dropout3d", "alpha_dropout",
+    "embedding", "one_hot", "interpolate", "upsample", "unfold", "fold",
+    "cosine_similarity", "pixel_shuffle", "pixel_unshuffle",
+    "channel_shuffle", "class_center_sample", "pad",
+]
+
+from .loss import cosine_similarity  # shared
+from ...tensor.manipulation import pad  # shared
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W (+ b). Paddle weight layout: [in, out]."""
+    if bias is not None:
+        return apply("linear", lambda a, w, b: jnp.matmul(a, w.astype(a.dtype)) + b.astype(a.dtype),
+                     x, weight, bias)
+    return apply("linear", lambda a, w: jnp.matmul(a, w.astype(a.dtype)), x, weight)
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        if mode == "downscale_in_infer" and not training:
+            return apply("dropout_scale", lambda a: a * (1 - p), x)
+        return x
+    key = default_generator.next_key()
+
+    def f(a):
+        if axis is None:
+            shape = a.shape
+        else:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
+        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a)).astype(a.dtype)
+        return jnp.where(keep, a, jnp.zeros_like(a))
+
+    return apply("dropout", f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=list(ax), training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = default_generator.next_key()
+
+    def f(a):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
+        coef_b = -coef_a * alpha_p * p
+        return coef_a * jnp.where(keep, a, jnp.full_like(a, alpha_p)) + coef_b
+
+    return apply("alpha_dropout", f, x)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Row gather on the MXU-friendly layout [vocab, dim]; padding_idx rows
+    receive zero gradient (via stop_gradient on that row)."""
+    def f(idx, w):
+        if padding_idx is not None:
+            pi = padding_idx if padding_idx >= 0 else w.shape[0] + padding_idx
+            frozen_row = jax.lax.stop_gradient(w[pi])
+            w = w.at[pi].set(frozen_row)
+        return jnp.take(w, idx, axis=0)
+    return apply("embedding", f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_nodiff("one_hot",
+                        lambda i: jax.nn.one_hot(i, num_classes, dtype=jnp.float32), x)
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(a):
+        channel_last = data_format in ("NHWC", "NDHWC", "NLC")
+        nd = a.ndim - 2
+        if channel_last:
+            spatial = a.shape[1:-1]
+        else:
+            spatial = a.shape[2:]
+        if size is not None:
+            tgt = tuple(int(s) for s in (size if isinstance(size, (list, tuple)) else [size]))
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) else [scale_factor] * nd
+            tgt = tuple(int(round(s * f_)) for s, f_ in zip(spatial, sf))
+        if channel_last:
+            out_shape = (a.shape[0],) + tgt + (a.shape[-1],)
+        else:
+            out_shape = a.shape[:2] + tgt
+        method = {"nearest": "nearest", "bilinear": "linear",
+                  "trilinear": "linear", "linear": "linear",
+                  "bicubic": "cubic", "area": "linear"}[mode]
+        return jax.image.resize(a, out_shape, method=method).astype(a.dtype)
+    return apply("interpolate", f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    """im2col: [N,C,H,W] -> [N, C*kh*kw, L]."""
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings[:2]
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+
+    def f(a):
+        n, c, h, w = a.shape
+        a = jnp.pad(a, [(0, 0), (0, 0), (ph, ph), (pw, pw)])
+        oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        patches = []
+        for i in range(kh):
+            for j in range(kw):
+                sl = a[:, :, i * dh:i * dh + oh * sh:sh, j * dw:j * dw + ow * sw:sw]
+                patches.append(sl)
+        out = jnp.stack(patches, axis=2)  # [N, C, kh*kw, oh, ow]
+        return out.reshape(n, c * kh * kw, oh * ow)
+    return apply("unfold", f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    oh, ow = output_sizes if isinstance(output_sizes, (list, tuple)) else (output_sizes,) * 2
+    kh, kw = (kernel_sizes, kernel_sizes) if isinstance(kernel_sizes, int) else kernel_sizes
+    sh, sw = (strides, strides) if isinstance(strides, int) else strides
+    ph, pw = (paddings, paddings) if isinstance(paddings, int) else paddings[:2]
+    dh, dw = (dilations, dilations) if isinstance(dilations, int) else dilations
+
+    def f(a):
+        n, ckk, l = a.shape
+        c = ckk // (kh * kw)
+        nh = (oh + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+        nw = (ow + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+        a = a.reshape(n, c, kh, kw, nh, nw)
+        out = jnp.zeros((n, c, oh + 2 * ph, ow + 2 * pw), a.dtype)
+        for i in range(kh):
+            for j in range(kw):
+                out = out.at[:, :, i * dh:i * dh + nh * sh:sh,
+                             j * dw:j * dw + nw * sw:sw].add(a[:, :, i, j])
+        return out[:, :, ph:ph + oh, pw:pw + ow]
+    return apply("fold", f, x)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c // (r * r), r, r, h, w)
+        a = jnp.transpose(a, (0, 1, 4, 2, 5, 3))
+        return a.reshape(n, c // (r * r), h * r, w * r)
+    return apply("pixel_shuffle", f, x)
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, c, h // r, r, w // r, r)
+        a = jnp.transpose(a, (0, 1, 3, 5, 2, 4))
+        return a.reshape(n, c * r * r, h // r, w // r)
+    return apply("pixel_unshuffle", f, x)
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        a = a.reshape(n, groups, c // groups, h, w)
+        a = jnp.swapaxes(a, 1, 2)
+        return a.reshape(n, c, h, w)
+    return apply("channel_shuffle", f, x)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    raise NotImplementedError("class_center_sample: PS-era API, descoped")

@@ -1,0 +1,158 @@
+"""Convolution functionals over lax.conv_general_dilated — the op XLA maps
+onto the MXU. Parity: /root/reference/python/paddle/nn/functional/conv.py.
+Weight layout matches paddle: [out_c, in_c/groups, *kernel]."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _norm_tuple(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(e) for e in v)
+
+
+def _norm_padding(padding, n):
+    """Returns lax padding spec: 'SAME', 'VALID' or [(lo,hi)]*n."""
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # paddle full-form [[0,0],[0,0],[h0,h1],[w0,w1]] (NCHW)
+        flat = [tuple(p) for p in padding]
+        if len(flat) == n + 2:
+            return flat[2:]
+        return flat
+    raise ValueError(f"bad padding {padding}")
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n, channel_last):
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    pad = _norm_padding(padding, n)
+
+    spatial = "DHW"[3 - n:]
+    if channel_last:
+        lhs_spec = "N" + spatial + "C"
+    else:
+        lhs_spec = "NC" + spatial
+    rhs_spec = "OI" + spatial
+    out_spec = lhs_spec
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs_spec, rhs_spec, out_spec))
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w.astype(a.dtype), window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn, feature_group_count=groups,
+            preferred_element_type=None)
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[out.ndim - 1 if channel_last else 1] = -1
+            out = out + b[0].astype(out.dtype).reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return apply(f"conv{n}d", f, x, weight, bias)
+    return apply(f"conv{n}d", f, x, weight)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 channel_last=data_format == "NLC")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 channel_last=data_format == "NHWC")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 channel_last=data_format == "NDHWC")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
+                    groups, n, channel_last, output_size=None):
+    strides = _norm_tuple(stride, n)
+    dil = _norm_tuple(dilation, n)
+    opad = _norm_tuple(output_padding, n)
+    pad = _norm_padding(padding, n)
+
+    spatial = "DHW"[3 - n:]
+    lhs_spec = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # paddle transpose-conv weight layout: [in_c, out_c/groups, *k]
+    rhs_spec = "IO" + spatial
+    dn = jax.lax.conv_dimension_numbers((1,) * (n + 2), (1,) * (n + 2),
+                                        (lhs_spec, rhs_spec, lhs_spec))
+
+    def f(a, w, *b):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # convert forward-conv padding to transpose padding
+            padding_cfg = []
+            for i in range(n):
+                k = (w.shape[2 + i] - 1) * dil[i] + 1
+                lo = k - 1 - pad[i][0]
+                hi = k - 1 - pad[i][1] + opad[i]
+                padding_cfg.append((lo, hi))
+        if groups > 1:
+            raise NotImplementedError("grouped conv_transpose: use groups=1")
+        w_t = jnp.swapaxes(w, 0, 1)  # -> [out_c, in_c, *k]
+        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
+        out = jax.lax.conv_general_dilated(
+            a, w_t.astype(a.dtype), window_strides=(1,) * n,
+            padding=padding_cfg, lhs_dilation=strides, rhs_dilation=dil,
+            dimension_numbers=jax.lax.conv_dimension_numbers(
+                (1,) * (n + 2), (1,) * (n + 2),
+                (lhs_spec, "OI" + spatial, lhs_spec)))
+        if b:
+            bias_shape = [1] * out.ndim
+            bias_shape[out.ndim - 1 if channel_last else 1] = -1
+            out = out + b[0].astype(out.dtype).reshape(bias_shape)
+        return out
+
+    if bias is not None:
+        return apply(f"conv{n}d_transpose", f, x, weight, bias)
+    return apply(f"conv{n}d_transpose", f, x, weight)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format == "NLC",
+                           output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format == "NHWC",
+                           output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format == "NDHWC",
+                           output_size)

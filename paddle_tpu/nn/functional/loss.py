@@ -1,0 +1,271 @@
+"""Loss functionals (parity:
+/root/reference/python/paddle/nn/functional/loss.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_similarity",
+    "cosine_embedding_loss", "label_smooth", "square_error_cost",
+    "log_loss", "hinge_embedding_loss", "triplet_margin_loss",
+    "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss",
+]
+
+
+def _reduce(loss, reduction):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lbl, *w):
+        n_classes = logits.shape[axis]
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis)
+        else:
+            logp = jnp.log(jnp.maximum(logits.astype(jnp.float32), 1e-30))
+        if soft_label or (lbl.ndim == logits.ndim and lbl.shape == logits.shape):
+            soft = lbl.astype(logp.dtype)
+            if label_smoothing > 0:
+                soft = soft * (1 - label_smoothing) + label_smoothing / n_classes
+            loss = -jnp.sum(soft * logp, axis=axis)
+            valid = jnp.ones_like(loss, dtype=jnp.bool_)
+        else:
+            idx = lbl
+            if idx.ndim == logp.ndim:  # trailing 1 dim
+                idx = jnp.squeeze(idx, axis=axis)
+            valid = idx != ignore_index
+            safe_idx = jnp.where(valid, idx, 0)
+            picked = jnp.take_along_axis(
+                logp, jnp.expand_dims(safe_idx, axis), axis=axis)
+            picked = jnp.squeeze(picked, axis=axis)
+            if label_smoothing > 0:
+                smooth_loss = -jnp.mean(logp, axis=axis)
+                loss = -(1 - label_smoothing) * picked + label_smoothing * smooth_loss
+            else:
+                loss = -picked
+            if w:
+                cw = jnp.take(w[0].astype(logp.dtype), safe_idx)
+                loss = loss * cw
+            loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.maximum(jnp.sum(valid.astype(logp.dtype)), 1.0)
+            if w and not soft_label:
+                cw = jnp.take(w[0].astype(logp.dtype), jnp.where(valid, lbl if lbl.ndim == loss.ndim else jnp.squeeze(lbl, axis=axis), 0))
+                denom = jnp.maximum(jnp.sum(jnp.where(valid, cw, 0.0)), 1e-12)
+            return jnp.sum(loss) / denom
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("cross_entropy", f, *args)
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               ignore_index=-100, return_softmax=False,
+                               numeric_stable_mode=True):
+    loss = cross_entropy(logits, label, soft_label=soft_label, axis=axis,
+                         ignore_index=ignore_index, reduction="none")
+    from .activation import softmax as _softmax
+    if return_softmax:
+        return loss, _softmax(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply("mse_loss",
+                 lambda a, b: _reduce(jnp.square(a - b), reduction), input, label)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply("l1_loss",
+                 lambda a, b: _reduce(jnp.abs(a - b), reduction), input, label)
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lbl, *w):
+        valid = lbl != ignore_index
+        safe = jnp.where(valid, lbl, 0)
+        picked = jnp.take_along_axis(logp, jnp.expand_dims(safe, 1), axis=1)
+        loss = -jnp.squeeze(picked, 1)
+        if w:
+            cw = jnp.take(w[0], safe)
+            loss = loss * cw
+        loss = jnp.where(valid, loss, 0.0)
+        if reduction == "mean":
+            denom = jnp.sum(jnp.take(w[0], safe) * valid) if w else jnp.sum(valid)
+            return jnp.sum(loss) / jnp.maximum(denom, 1e-12)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("nll_loss", f, *args)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-12)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply("bce", f, *args)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *rest):
+        logp = jax.nn.log_sigmoid(z)
+        lognotp = jax.nn.log_sigmoid(-z)
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]; i += 1
+        if pos_weight is not None:
+            pw = rest[i]; i += 1
+        pos_term = y * logp * (pw if pw is not None else 1.0)
+        loss = -(pos_term + (1 - y) * lognotp)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply("bce_logits", f, *args)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d, delta * (d - 0.5 * delta))
+        return _reduce(loss, reduction)
+    return apply("smooth_l1", f, input, label)
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(logq, p):
+        if log_target:
+            loss = jnp.exp(p) * (p - logq)
+        else:
+            loss = p * (jnp.log(jnp.maximum(p, 1e-30)) - logq)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / logq.shape[0]
+        return _reduce(loss, reduction)
+    return apply("kl_div", f, input, label)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    def f(a, b, y):
+        loss = jnp.maximum(0.0, -y * (a - b) + margin)
+        return _reduce(loss, reduction)
+    return apply("margin_ranking", f, input, other, label)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply("cosine_similarity", f, x1, x2)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0, reduction="mean",
+                          name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply("cosine_embedding", f, input1, input2, label)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(y, *pd):
+        k = y.shape[-1]
+        if pd:
+            return (1 - epsilon) * y + epsilon * pd[0]
+        return (1 - epsilon) * y + epsilon / k
+    args = [label] + ([prior_dist] if prior_dist is not None else [])
+    return apply("label_smooth", f, *args)
+
+
+def square_error_cost(input, label):
+    return apply("square_error_cost", lambda a, b: jnp.square(a - b), input, label)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    def f(p, y):
+        return -y * jnp.log(p + epsilon) - (1 - y) * jnp.log(1 - p + epsilon)
+    return apply("log_loss", f, input, label)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply("hinge_embedding", f, input, label)
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean", name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     axis=-1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        loss = jnp.maximum(0.0, d_pos - d_neg + margin)
+        return _reduce(loss, reduction)
+    return apply("triplet_margin", f, input, positive, negative)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply("sigmoid_focal", f, *args)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    raise NotImplementedError(
+        "ctc_loss: planned via optax.ctc_loss integration")
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
+                     reduction="mean", name=None):
+    def f(a, y):
+        if log_input:
+            loss = jnp.exp(a) - y * a
+        else:
+            loss = a - y * jnp.log(a + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + 0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return apply("poisson_nll", f, input, label)

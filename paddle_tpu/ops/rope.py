@@ -1,0 +1,41 @@
+"""Rotary position embedding on raw arrays (reference:
+/root/reference/python/paddle/incubate/nn/functional/fused_rotary_position_embedding.py).
+Pure jnp: XLA fuses the mul/add chain into surrounding ops; layout is
+[batch, seq, heads, head_dim] (paddle convention)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _rotate_half(x):
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def rope_reference(x, cos, sin):
+    """x: [b, s, h, d]; cos/sin: broadcastable [1, s, 1, d]."""
+    return x * cos + _rotate_half(x) * sin
+
+
+def build_rope_cache(seq_len: int, head_dim: int, base: float = 10000.0,
+                     dtype=jnp.float32):
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(seq_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)  # [s, d/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)  # [s, d]
+    cos = jnp.cos(emb)[None, :, None, :].astype(dtype)
+    sin = jnp.sin(emb)[None, :, None, :].astype(dtype)
+    return cos, sin
+
+
+def apply_rotary_pos_emb(q, k, cos=None, sin=None, position_ids=None,
+                         base: float = 10000.0):
+    """Fused-RoPE API parity: q/k [b, s, h, d]; builds cache if absent."""
+    if cos is None:
+        cos, sin = build_rope_cache(q.shape[1], q.shape[-1], base, q.dtype)
+    if position_ids is not None:
+        cos = jnp.take(cos[0], position_ids, axis=0)[:, :, None, :] if cos.shape[0] == 1 else cos
+        sin = jnp.take(sin[0], position_ids, axis=0)[:, :, None, :] if sin.shape[0] == 1 else sin
+    q_out = rope_reference(q, cos.astype(q.dtype), sin.astype(q.dtype))
+    k_out = rope_reference(k, cos.astype(k.dtype), sin.astype(k.dtype))
+    return q_out, k_out

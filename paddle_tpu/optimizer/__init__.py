@@ -1,0 +1,9 @@
+"""paddle_tpu.optimizer — parity with paddle.optimizer."""
+from . import lr  # noqa: F401
+from .optimizer import (  # noqa: F401
+    Optimizer, SGD, Momentum, Adam, AdamW, Adamax, Adagrad, Adadelta,
+    RMSProp, Lamb,
+)
+from .clip import (  # noqa: F401
+    ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
+)

@@ -1,0 +1,64 @@
+"""Gradient clipping (parity: /root/reference/python/paddle/nn/clip.py).
+Operates on raw grad arrays so the same code runs in eager step() and in
+the jitted train step."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def apply(self, grads):
+        """grads: list of raw arrays (None allowed) → clipped list."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def apply(self, grads):
+        return [None if g is None else jnp.clip(g, self.min, self.max)
+                for g in grads]
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads):
+        out = []
+        for g in grads:
+            if g is None:
+                out.append(None)
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(g.astype(jnp.float32))))
+            scale = jnp.minimum(1.0, self.clip_norm / jnp.maximum(norm, 1e-12))
+            out.append((g.astype(jnp.float32) * scale).astype(g.dtype))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip. Under GSPMD the norm reduction is automatically a
+    cross-replica psum when grads are sharded — the distributed-aware
+    behavior of the reference's HybridParallelOptimizer
+    (/root/reference/python/paddle/distributed/fleet/meta_optimizers/dygraph_optimizer/hybrid_parallel_optimizer.py:254)
+    falls out for free."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def apply(self, grads):
+        sq = [jnp.sum(jnp.square(g.astype(jnp.float32)))
+              for g in grads if g is not None]
+        if not sq:
+            return grads
+        global_norm = jnp.sqrt(jnp.sum(jnp.stack(sq)))
+        scale = self.clip_norm / jnp.maximum(global_norm, self.clip_norm)
+        return [None if g is None else
+                (g.astype(jnp.float32) * scale).astype(g.dtype)
+                for g in grads]

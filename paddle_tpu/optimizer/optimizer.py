@@ -1,0 +1,549 @@
+"""Optimizer base + family (parity:
+/root/reference/python/paddle/optimizer/optimizer.py:103).
+
+Design: every optimizer is defined by a *functional core* —
+``init_state(params) -> state`` and ``update(params, grads, state, lr) ->
+(new_params, new_state)`` over raw jax arrays. The eager ``step()`` (paddle
+API) wraps the core over ``param.grad``; the jitted train-step path
+(paddle_tpu.jit.TrainStep) calls the same core inside jax.jit, so numerics
+are identical and there is exactly one implementation of each rule.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum", "Adam", "AdamW", "Adamax",
+           "Adagrad", "Adadelta", "RMSProp", "Lamb"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision: bool = True):
+        if parameters is None:
+            raise ValueError("parameters must be provided (list of Parameter)")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = 0.0 if weight_decay is None else weight_decay
+        self._multi_precision = multi_precision
+        self._state: Optional[Dict[str, Any]] = None
+        self._step_count = 0
+
+    # -- lr ------------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("set_lr not allowed with an LRScheduler")
+        self._learning_rate = float(value)
+
+    # -- functional core (subclasses implement _update_impl) ----------------
+    def init_state(self, params: List[jax.Array]) -> Dict[str, Any]:
+        return self._with_master(self._init_state_impl(params), params)
+
+    def _init_state_impl(self, params) -> Dict[str, Any]:
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(self, params: List[jax.Array], grads: List[Optional[jax.Array]],
+               state: Dict[str, Any], lr) -> tuple:
+        """Template: route low-precision params through their persistent
+        float32 master copies (kept in state['master'], like the reference
+        AMP-O2 optimizer's master weights), run the subclass rule in f32,
+        then cast results back to the storage dtype."""
+        masters = state.get("master")
+        if masters is None:
+            return self._update_impl(params, grads, state, lr)
+        eff = [masters[i] if masters[i] is not None else p
+               for i, p in enumerate(params)]
+        new_eff, new_state = self._update_impl(eff, grads, state, lr)
+        new_params, new_masters = [], []
+        for i, (p, ne) in enumerate(zip(params, new_eff)):
+            if ne is None:
+                new_params.append(None)
+                new_masters.append(masters[i])
+                continue
+            if masters[i] is not None:
+                new_masters.append(ne)
+                new_params.append(ne.astype(p.dtype))
+            else:
+                new_masters.append(None)
+                new_params.append(ne)
+        new_state["master"] = new_masters
+        return new_params, new_state
+
+    def _update_impl(self, params, grads, state, lr) -> tuple:
+        raise NotImplementedError
+
+    # -- shared helpers ------------------------------------------------------
+    def _needs_master(self, p) -> bool:
+        return self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16)
+
+    def _with_master(self, st: Dict[str, Any], params) -> Dict[str, Any]:
+        if any(self._needs_master(p) for p in params):
+            st["master"] = [p.astype(jnp.float32) if self._needs_master(p)
+                            else None for p in params]
+        return st
+
+    def _master(self, p):
+        """float32 view for state init / stateless rules (persistent master
+        copies live in state['master'], handled by the update template)."""
+        if self._multi_precision and p.dtype in (jnp.bfloat16, jnp.float16):
+            return p.astype(jnp.float32)
+        return p
+
+    def _apply_clip_and_decay(self, params, grads):
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(grads)
+        return grads
+
+    # -- eager API -----------------------------------------------------------
+    def step(self):
+        params = self._parameter_list
+        raw_params = [p._value for p in params]
+        raw_grads = [None if p.grad is None else p.grad._value for p in params]
+        if self._state is None:
+            self._state = self.init_state(raw_params)
+        lr = jnp.asarray(self.get_lr(), jnp.float32)
+        new_params, self._state = self.update(raw_params, raw_grads,
+                                              self._state, lr)
+        for p, np_ in zip(params, new_params):
+            if np_ is not None:
+                p._replace(np_)
+        self._step_count += 1
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    # -- state dict ----------------------------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        sd = {"step_count": self._step_count}
+        if self._state is not None:
+            sd["state"] = jax.tree_util.tree_map(np.asarray, self._state)
+        if isinstance(self._learning_rate, LRScheduler):
+            sd["LR_Scheduler"] = self._learning_rate.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict: Dict[str, Any]):
+        self._step_count = state_dict.get("step_count", 0)
+        if "state" in state_dict:
+            self._state = jax.tree_util.tree_map(jnp.asarray,
+                                                 state_dict["state"])
+        if "LR_Scheduler" in state_dict and isinstance(self._learning_rate,
+                                                       LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+
+
+def _wd_grad(p, g, wd):
+    """Couple L2 weight decay into the gradient (paddle regularizer style)."""
+    if wd and g is not None:
+        return g + wd * p.astype(g.dtype)
+    return g
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        new_params = []
+        for p, g in zip(params, grads):
+            if g is None:
+                new_params.append(None)
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            m = self._master(p)
+            m = m - lr.astype(m.dtype) * g.astype(m.dtype)
+            new_params.append(m.astype(p.dtype))
+        return new_params, {"step": state["step"] + 1}
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._momentum = momentum
+        self._nesterov = use_nesterov
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "velocity": [jnp.zeros_like(self._master(p)) for p in params]}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        mu = self._momentum
+        new_params, new_vel = [], []
+        for p, g, v in zip(params, grads, state["velocity"]):
+            if g is None:
+                new_params.append(None)
+                new_vel.append(v)
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            m = self._master(p)
+            g32 = g.astype(m.dtype)
+            v = mu * v + g32
+            if self._nesterov:
+                upd = g32 + mu * v
+            else:
+                upd = v
+            m = m - lr.astype(m.dtype) * upd
+            new_params.append(m.astype(p.dtype))
+            new_vel.append(v)
+        return new_params, {"step": state["step"] + 1, "velocity": new_vel}
+
+
+class Adam(Optimizer):
+    _decoupled_wd = False
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 name=None, amsgrad=False):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._amsgrad = amsgrad
+
+    def _init_state_impl(self, params):
+        st = {"step": jnp.zeros((), jnp.int32),
+              "m": [jnp.zeros_like(self._master(p)) for p in params],
+              "v": [jnp.zeros_like(self._master(p)) for p in params]}
+        if self._amsgrad:
+            st["vmax"] = [jnp.zeros_like(self._master(p)) for p in params]
+        return st
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = state["step"] + 1
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(b1, tf)
+        bc2 = 1.0 - jnp.power(b2, tf)
+        new_params, new_m, new_v = [], [], []
+        new_vmax = [] if self._amsgrad else None
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m_s, v_s = state["m"][i], state["v"][i]
+            if g is None:
+                new_params.append(None)
+                new_m.append(m_s)
+                new_v.append(v_s)
+                if self._amsgrad:
+                    new_vmax.append(state["vmax"][i])
+                continue
+            mp = self._master(p)
+            if not self._decoupled_wd:
+                g = _wd_grad(p, g, self._weight_decay)
+            g32 = g.astype(mp.dtype)
+            m_s = b1 * m_s + (1 - b1) * g32
+            v_s = b2 * v_s + (1 - b2) * jnp.square(g32)
+            m_hat = m_s / bc1
+            v_hat = v_s / bc2
+            if self._amsgrad:
+                vm = jnp.maximum(state["vmax"][i], v_hat)
+                new_vmax.append(vm)
+                denom = jnp.sqrt(vm) + eps
+            else:
+                denom = jnp.sqrt(v_hat) + eps
+            upd = m_hat / denom
+            if self._decoupled_wd and self._weight_decay:
+                mp = mp * (1.0 - lr.astype(mp.dtype) * self._weight_decay)
+            mp = mp - lr.astype(mp.dtype) * upd
+            new_params.append(mp.astype(p.dtype))
+            new_m.append(m_s)
+            new_v.append(v_s)
+        out_state = {"step": t, "m": new_m, "v": new_v}
+        if self._amsgrad:
+            out_state["vmax"] = new_vmax
+        return new_params, out_state
+
+
+class AdamW(Adam):
+    """Decoupled weight decay (paddle.optimizer.AdamW,
+    /root/reference/python/paddle/optimizer/adamw.py)."""
+    _decoupled_wd = True
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None,
+                 amsgrad=False):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         weight_decay, grad_clip, lazy_mode, multi_precision,
+                         name, amsgrad)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        # static per-param decay mask (True = apply decay), from param names
+        if apply_decay_param_fun is not None:
+            self._decay_mask = [bool(apply_decay_param_fun(p.name))
+                                for p in self._parameter_list]
+        else:
+            self._decay_mask = [True] * len(self._parameter_list)
+
+    def update(self, params, grads, state, lr):
+        saved_wd = self._weight_decay
+        if not all(self._decay_mask):
+            # per-param decay: run the shared Adam core param-by-param with
+            # wd toggled; cheap because lists are short-lived python
+            new_params, new_state = [], None
+            for i in range(len(params)):
+                self._weight_decay = saved_wd if self._decay_mask[i] else 0.0
+                sub_state = {k: (v if not isinstance(v, list) else [v[i]])
+                             for k, v in state.items()}
+                ps, st = super().update([params[i]], [grads[i]], sub_state, lr)
+                new_params.append(ps[0])
+                if new_state is None:
+                    new_state = {k: (v if not isinstance(v, list) else list(v))
+                                 for k, v in st.items()}
+                else:
+                    for k, v in st.items():
+                        if isinstance(v, list):
+                            new_state[k].append(v[0])
+                        else:
+                            new_state[k] = v
+            self._weight_decay = saved_wd
+            return new_params, new_state
+        return super().update(params, grads, state, lr)
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": [jnp.zeros_like(self._master(p)) for p in params],
+                "u": [jnp.zeros_like(self._master(p)) for p in params]}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = state["step"] + 1
+        bc1 = 1.0 - jnp.power(b1, t.astype(jnp.float32))
+        new_params, new_m, new_u = [], [], []
+        for p, g, m_s, u_s in zip(params, grads, state["m"], state["u"]):
+            if g is None:
+                new_params.append(None)
+                new_m.append(m_s)
+                new_u.append(u_s)
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            mp = self._master(p)
+            g32 = g.astype(mp.dtype)
+            m_s = b1 * m_s + (1 - b1) * g32
+            u_s = jnp.maximum(b2 * u_s, jnp.abs(g32))
+            mp = mp - lr.astype(mp.dtype) * m_s / (bc1 * (u_s + eps))
+            new_params.append(mp.astype(p.dtype))
+            new_m.append(m_s)
+            new_u.append(u_s)
+        return new_params, {"step": t, "m": new_m, "u": new_u}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 initial_accumulator_value=0.0, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "acc": [jnp.full_like(self._master(p), self._init_acc)
+                        for p in params]}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        new_params, new_acc = [], []
+        for p, g, a in zip(params, grads, state["acc"]):
+            if g is None:
+                new_params.append(None)
+                new_acc.append(a)
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            mp = self._master(p)
+            g32 = g.astype(mp.dtype)
+            a = a + jnp.square(g32)
+            mp = mp - lr.astype(mp.dtype) * g32 / (jnp.sqrt(a) + self._epsilon)
+            new_params.append(mp.astype(p.dtype))
+            new_acc.append(a)
+        return new_params, {"step": state["step"] + 1, "acc": new_acc}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "avg_sq_grad": [jnp.zeros_like(self._master(p)) for p in params],
+                "avg_sq_upd": [jnp.zeros_like(self._master(p)) for p in params]}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        rho, eps = self._rho, self._epsilon
+        new_params, new_g2, new_u2 = [], [], []
+        for p, g, g2, u2 in zip(params, grads, state["avg_sq_grad"],
+                                state["avg_sq_upd"]):
+            if g is None:
+                new_params.append(None)
+                new_g2.append(g2)
+                new_u2.append(u2)
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            mp = self._master(p)
+            g32 = g.astype(mp.dtype)
+            g2 = rho * g2 + (1 - rho) * jnp.square(g32)
+            upd = jnp.sqrt(u2 + eps) / jnp.sqrt(g2 + eps) * g32
+            u2 = rho * u2 + (1 - rho) * jnp.square(upd)
+            mp = mp - lr.astype(mp.dtype) * upd
+            new_params.append(mp.astype(p.dtype))
+            new_g2.append(g2)
+            new_u2.append(u2)
+        return new_params, {"step": state["step"] + 1,
+                            "avg_sq_grad": new_g2, "avg_sq_upd": new_u2}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, rho=0.95, epsilon=1e-6,
+                 momentum=0.0, centered=False, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None,
+                 multi_precision=True):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name, multi_precision)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _init_state_impl(self, params):
+        st = {"step": jnp.zeros((), jnp.int32),
+              "ms": [jnp.zeros_like(self._master(p)) for p in params],
+              "mom": [jnp.zeros_like(self._master(p)) for p in params]}
+        if self._centered:
+            st["mg"] = [jnp.zeros_like(self._master(p)) for p in params]
+        return st
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        rho, eps, mu = self._rho, self._epsilon, self._momentum
+        new_params, new_ms, new_mom = [], [], []
+        new_mg = [] if self._centered else None
+        for i, (p, g) in enumerate(zip(params, grads)):
+            ms, mom = state["ms"][i], state["mom"][i]
+            if g is None:
+                new_params.append(None)
+                new_ms.append(ms)
+                new_mom.append(mom)
+                if self._centered:
+                    new_mg.append(state["mg"][i])
+                continue
+            g = _wd_grad(p, g, self._weight_decay)
+            mp = self._master(p)
+            g32 = g.astype(mp.dtype)
+            ms = rho * ms + (1 - rho) * jnp.square(g32)
+            if self._centered:
+                mg = rho * state["mg"][i] + (1 - rho) * g32
+                new_mg.append(mg)
+                denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+            else:
+                denom = jnp.sqrt(ms + eps)
+            mom = mu * mom + lr.astype(mp.dtype) * g32 / denom
+            mp = mp - mom
+            new_params.append(mp.astype(p.dtype))
+            new_ms.append(ms)
+            new_mom.append(mom)
+        st = {"step": state["step"] + 1, "ms": new_ms, "mom": new_mom}
+        if self._centered:
+            st["mg"] = new_mg
+        return new_params, st
+
+
+class Lamb(Optimizer):
+    """Layer-wise adaptive moments (paddle.optimizer.Lamb,
+    /root/reference/python/paddle/optimizer/lamb.py)."""
+
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 name=None, multi_precision=True):
+        super().__init__(learning_rate, parameters, lamb_weight_decay,
+                         grad_clip, name, multi_precision)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _init_state_impl(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": [jnp.zeros_like(self._master(p)) for p in params],
+                "v": [jnp.zeros_like(self._master(p)) for p in params]}
+
+    def _update_impl(self, params, grads, state, lr):
+        grads = self._apply_clip_and_decay(params, grads)
+        b1, b2, eps = self._beta1, self._beta2, self._epsilon
+        t = state["step"] + 1
+        tf = t.astype(jnp.float32)
+        bc1 = 1.0 - jnp.power(b1, tf)
+        bc2 = 1.0 - jnp.power(b2, tf)
+        new_params, new_m, new_v = [], [], []
+        for i, (p, g) in enumerate(zip(params, grads)):
+            m_s, v_s = state["m"][i], state["v"][i]
+            if g is None:
+                new_params.append(None)
+                new_m.append(m_s)
+                new_v.append(v_s)
+                continue
+            mp = self._master(p)
+            g32 = g.astype(mp.dtype)
+            m_s = b1 * m_s + (1 - b1) * g32
+            v_s = b2 * v_s + (1 - b2) * jnp.square(g32)
+            r = (m_s / bc1) / (jnp.sqrt(v_s / bc2) + eps)
+            wd = self._weight_decay
+            if self._exclude_fn is not None and self._exclude_fn(
+                    self._parameter_list[i]):
+                wd = 0.0
+            r = r + wd * mp
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(mp)))
+            r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
+            trust = jnp.where((w_norm > 0) & (r_norm > 0),
+                              w_norm / r_norm, 1.0)
+            mp = mp - lr.astype(mp.dtype) * trust * r
+            new_params.append(mp.astype(p.dtype))
+            new_m.append(m_s)
+            new_v.append(v_s)
+        return new_params, {"step": t, "m": new_m, "v": new_v}

@@ -1,0 +1,166 @@
+"""paddle_tpu.tensor — op namespace + Tensor method/operator patching.
+
+Mirrors the reference's monkey-patch approach
+(/root/reference/python/paddle/base/dygraph/math_op_patch.py:60 and
+tensor_patch_methods.py:78): every public op is also installed as a Tensor
+method, and Python operators route through the autograd-aware dispatcher.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, Parameter, apply, apply_nodiff, to_tensor
+
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .random import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .stat import *  # noqa: F401,F403
+
+from . import creation, math, linalg, manipulation, random, logic, stat
+
+from .einsum import einsum  # noqa: F401  (overrides linalg.einsum alias)
+
+
+def is_floating_point(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_floating_point(x.dtype)
+
+
+def is_integer(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_integer(x.dtype)
+
+
+def is_complex(x):
+    from ..framework import dtype as dtypes
+    return dtypes.is_complex(x.dtype)
+
+
+def rank(input):
+    return Tensor(jnp.asarray(input.ndim))
+
+
+def shape(input):
+    return Tensor(jnp.asarray(input.shape, dtype=jnp.int32))
+
+
+def numel(x, name=None):
+    return stat.numel(x)
+
+
+# ---------------------------------------------------------------------------
+# Operator overloads
+# ---------------------------------------------------------------------------
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._value
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return [_unwrap_index(i) for i in idx]
+    return idx
+
+
+def _getitem(self, idx):
+    uidx = _unwrap_index(idx)
+    return apply("getitem", lambda a: a[uidx], self)
+
+
+def _setitem(self, idx, value):
+    uidx = _unwrap_index(idx)
+    if isinstance(value, Tensor):
+        out = apply("setitem", lambda a, v: a.at[uidx].set(v.astype(a.dtype)), self, value)
+    else:
+        out = apply("setitem", lambda a: a.at[uidx].set(value), self)
+    self._value = out._value
+    self._node = out._node
+    self._out_idx = out._out_idx
+    self.stop_gradient = out.stop_gradient
+
+
+_BINOPS = {
+    "__add__": math.add,
+    "__radd__": lambda x, y: math.add(x, y),
+    "__sub__": math.subtract,
+    "__rsub__": lambda x, y: apply("rsub", lambda a: y - a if not isinstance(y, Tensor) else None, x)
+        if not isinstance(y, Tensor) else math.subtract(y, x),
+    "__mul__": math.multiply,
+    "__rmul__": lambda x, y: math.multiply(x, y),
+    "__truediv__": math.divide,
+    "__rdiv__": None,
+    "__floordiv__": math.floor_divide,
+    "__mod__": math.mod,
+    "__pow__": math.pow,
+    "__matmul__": linalg.matmul,
+}
+
+
+def _install_operators():
+    T = Tensor
+    T.__add__ = lambda s, o: math.add(s, o)
+    T.__radd__ = lambda s, o: math.add(s, o)
+    T.__sub__ = lambda s, o: math.subtract(s, o)
+    T.__rsub__ = lambda s, o: apply("rsub", lambda a: jnp.subtract(o._value if isinstance(o, Tensor) else o, a), s)
+    T.__mul__ = lambda s, o: math.multiply(s, o)
+    T.__rmul__ = lambda s, o: math.multiply(s, o)
+    T.__truediv__ = lambda s, o: math.divide(s, o)
+    T.__rtruediv__ = lambda s, o: apply("rdiv", lambda a: jnp.divide(o._value if isinstance(o, Tensor) else o, a), s)
+    T.__floordiv__ = lambda s, o: math.floor_divide(s, o)
+    T.__rfloordiv__ = lambda s, o: apply_nodiff("rfloordiv", lambda a: jnp.floor_divide(o._value if isinstance(o, Tensor) else o, a), s)
+    T.__mod__ = lambda s, o: math.mod(s, o)
+    T.__pow__ = lambda s, o: math.pow(s, o)
+    T.__rpow__ = lambda s, o: apply("rpow", lambda a: jnp.power(o._value if isinstance(o, Tensor) else o, a), s)
+    T.__matmul__ = lambda s, o: linalg.matmul(s, o)
+    T.__rmatmul__ = lambda s, o: linalg.matmul(o, s) if isinstance(o, Tensor) else apply("rmatmul", lambda a: jnp.matmul(o, a), s)
+    T.__neg__ = lambda s: math.neg(s)
+    T.__abs__ = lambda s: math.abs(s)
+    T.__invert__ = lambda s: logic.logical_not(s)
+    T.__eq__ = lambda s, o: logic.equal(s, o)
+    T.__ne__ = lambda s, o: logic.not_equal(s, o)
+    T.__lt__ = lambda s, o: logic.less_than(s, o)
+    T.__le__ = lambda s, o: logic.less_equal(s, o)
+    T.__gt__ = lambda s, o: logic.greater_than(s, o)
+    T.__ge__ = lambda s, o: logic.greater_equal(s, o)
+    T.__and__ = lambda s, o: logic.logical_and(s, o) if s.dtype == np.bool_ else logic.bitwise_and(s, o)
+    T.__or__ = lambda s, o: logic.logical_or(s, o) if s.dtype == np.bool_ else logic.bitwise_or(s, o)
+    T.__xor__ = lambda s, o: logic.logical_xor(s, o) if s.dtype == np.bool_ else logic.bitwise_xor(s, o)
+    T.__getitem__ = _getitem
+    T.__setitem__ = _setitem
+
+
+_NO_PATCH = {"to_tensor", "is_tensor", "shape", "rand", "randn", "randint",
+             "randperm", "zeros", "ones", "full", "empty", "eye", "arange",
+             "linspace", "logspace", "meshgrid", "einsum", "tril_indices",
+             "triu_indices", "scatter_nd", "complex"}
+
+
+def _install_methods():
+    import inspect
+    mods = [creation, math, linalg, manipulation, random, logic, stat]
+    for mod in mods:
+        for name in getattr(mod, "__all__", []):
+            if name in _NO_PATCH:
+                continue
+            fn = getattr(mod, name)
+            if not callable(fn):
+                continue
+            if getattr(Tensor, name, None) is None or name in ("abs", "t"):
+                Tensor._register_method(name, fn)
+    # extra conveniences
+    Tensor._register_method("is_floating_point", is_floating_point)
+    Tensor._register_method("is_integer", is_integer)
+    Tensor._register_method("is_complex", is_complex)
+    Tensor._register_method("dim", lambda s: s.ndim)
+    Tensor._register_method("rank", lambda s: rank(s))
+    Tensor._register_method("numel", lambda s: stat.numel(s))
+    Tensor._register_method("mm", linalg.mm)
+    Tensor._register_method("dot", linalg.dot)
+
+
+_install_operators()
+_install_methods()

@@ -1,0 +1,157 @@
+"""Tensor creation ops (paddle.tensor.creation parity,
+/root/reference/python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, apply_nodiff, to_tensor
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "diag", "diagflat", "tril", "triu", "meshgrid", "assign", "clone",
+    "tril_indices", "triu_indices", "complex",
+]
+
+
+def _d(dtype, default=None):
+    if dtype is None:
+        return default if default is not None else dtypes.get_default_dtype()
+    return dtypes.convert_dtype(dtype)
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape(shape), _d(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape(shape), _d(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    if isinstance(fill_value, Tensor):
+        fill_value = fill_value._value
+    if dtype is None:
+        arr = jnp.full(_shape(shape), fill_value)
+        if arr.dtype == jnp.float64:
+            arr = arr.astype(dtypes.get_default_dtype())
+        return Tensor(arr)
+    return Tensor(jnp.full(_shape(shape), fill_value, _d(dtype)))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    return apply_nodiff("zeros_like", lambda a: jnp.zeros_like(a, dtype=_d(dtype, np.dtype(x.dtype))), x)
+
+
+def ones_like(x, dtype=None, name=None):
+    return apply_nodiff("ones_like", lambda a: jnp.ones_like(a, dtype=_d(dtype, np.dtype(x.dtype))), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    return apply_nodiff("full_like", lambda a: jnp.full_like(a, fill_value, dtype=_d(dtype, np.dtype(x.dtype))), x)
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    def _v(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    start, end, step = _v(start), _v(end), _v(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        if all(isinstance(v, (int, np.integer)) for v in (start, end, step)):
+            dtype = jnp.int64
+        else:
+            dtype = dtypes.get_default_dtype()
+    return Tensor(jnp.arange(start, end, step, dtype=dtypes.convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    def _v(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.linspace(_v(start), _v(stop), int(_v(num)),
+                               dtype=_d(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    def _v(v):
+        return v._value.item() if isinstance(v, Tensor) else v
+    return Tensor(jnp.logspace(_v(start), _v(stop), int(_v(num)), base=_v(base),
+                               dtype=_d(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_d(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            n = a.shape[0] + abs(offset)
+            base = jnp.full((n, n), padding_value, a.dtype)
+            return base + jnp.diag(a - jnp.zeros((), a.dtype), k=offset) - jnp.diag(jnp.full(a.shape, padding_value, a.dtype), k=offset)
+        return jnp.diag(a, k=offset)
+    return apply("diag", f, x)
+
+
+def diagflat(x, offset=0, name=None):
+    return apply("diagflat", lambda a: jnp.diagflat(a, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None):
+    return apply("tril", lambda a: jnp.tril(a, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None):
+    return apply("triu", lambda a: jnp.triu(a, k=diagonal), x)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.tril_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r[0], r[1]]).astype(dtypes.convert_dtype(dtype)))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r = jnp.triu_indices(row, k=offset, m=col)
+    return Tensor(jnp.stack([r[0], r[1]]).astype(dtypes.convert_dtype(dtype)))
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = apply("meshgrid", lambda *xs: tuple(jnp.meshgrid(*xs, indexing="ij")), *args)
+    return list(outs)
+
+
+def assign(x, output=None):
+    src = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    if output is None:
+        return Tensor(src)
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return apply("clone", lambda a: a + jnp.zeros((), a.dtype), x)
+
+
+def complex(real, imag, name=None):
+    return apply("complex", jax.lax.complex, real, imag)

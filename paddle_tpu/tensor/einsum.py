@@ -1,0 +1,15 @@
+"""paddle.einsum parity (/root/reference/python/paddle/tensor/einsum.py) —
+delegates to jnp.einsum, which XLA lowers to MXU-shaped dots."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import apply
+
+__all__ = ["einsum"]
+
+
+def einsum(equation, *operands):
+    if len(operands) == 1 and isinstance(operands[0], (list, tuple)):
+        operands = tuple(operands[0])
+    return apply("einsum", lambda *xs: jnp.einsum(equation, *xs), *operands)

@@ -1,0 +1,223 @@
+"""Linear algebra ops (paddle.tensor.linalg parity,
+/root/reference/python/paddle/tensor/linalg.py). matmul maps straight onto
+the MXU; keep operands batched and let XLA tile."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply, apply_nodiff
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "mv", "t", "transpose", "norm", "dist",
+    "cross", "einsum", "trace", "kron", "multi_dot", "matrix_transpose",
+    # linalg namespace
+    "cholesky", "inv", "pinv", "det", "slogdet", "svd", "qr", "eigh",
+    "eigvalsh", "solve", "triangular_solve", "lstsq", "matrix_power",
+    "matrix_rank", "cond", "lu", "householder_product", "cov", "corrcoef",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply("matmul", f, x, y)
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply("bmm", jnp.matmul, x, y)
+
+
+def dot(x, y, name=None):
+    return apply("dot", lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def mv(x, vec, name=None):
+    return apply("mv", jnp.matmul, x, vec)
+
+
+def t(input, name=None):
+    return apply("t", lambda a: a.T if a.ndim >= 2 else a, input)
+
+
+def transpose(x, perm, name=None):
+    return apply("transpose", lambda a: jnp.transpose(a, axes=perm), x)
+
+
+def matrix_transpose(x, name=None):
+    return apply("matrix_transpose", lambda a: jnp.swapaxes(a, -1, -2), x)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p is None or p == "fro":
+            if axis is None:
+                return jnp.sqrt(jnp.sum(jnp.square(a)))
+            return jnp.linalg.norm(a, ord=None, axis=_ax(axis), keepdims=keepdim)
+        if p == float("inf") or p == "inf":
+            return jnp.max(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if p == float("-inf") or p == "-inf":
+            return jnp.min(jnp.abs(a), axis=_ax(axis), keepdims=keepdim)
+        if axis is None:
+            flat = jnp.abs(a.reshape(-1))
+            return jnp.power(jnp.sum(jnp.power(flat, p)), 1.0 / p)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=_ax(axis), keepdims=keepdim), 1.0 / p)
+    return apply("norm", f, x)
+
+
+def _ax(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def dist(x, y, p=2, name=None):
+    return apply("dist", lambda a, b: _pnorm(a - b, p), x, y)
+
+
+def _pnorm(d, p):
+    d = jnp.abs(d).reshape(-1)
+    if p == 0:
+        return jnp.sum((d != 0).astype(d.dtype))
+    if p == float("inf"):
+        return jnp.max(d)
+    if p == float("-inf"):
+        return jnp.min(d)
+    return jnp.power(jnp.sum(jnp.power(d, p)), 1.0 / p)
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply("cross", f, x, y)
+
+
+def einsum(equation, *operands):
+    return apply("einsum", lambda *xs: jnp.einsum(equation, *xs), *operands)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("trace", lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def kron(x, y, name=None):
+    return apply("kron", jnp.kron, x, y)
+
+
+def multi_dot(x, name=None):
+    return apply("multi_dot", lambda *xs: jnp.linalg.multi_dot(xs), *x)
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        l = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(l, -1, -2) if upper else l
+    return apply("cholesky", f, x)
+
+
+def inv(x, name=None):
+    return apply("inv", jnp.linalg.inv, x)
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply("pinv", lambda a: jnp.linalg.pinv(a, rcond=rcond, hermitian=hermitian), x)
+
+
+def det(x, name=None):
+    return apply("det", jnp.linalg.det, x)
+
+
+def slogdet(x, name=None):
+    def f(a):
+        s, l = jnp.linalg.slogdet(a)
+        return jnp.stack([s, l])
+    return apply("slogdet", f, x)
+
+
+def svd(x, full_matrices=False, name=None):
+    return apply("svd", lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x)
+
+
+def qr(x, mode="reduced", name=None):
+    return apply("qr", lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x)
+
+
+def eigh(x, UPLO="L", name=None):
+    return apply("eigh", lambda a: tuple(jnp.linalg.eigh(a, UPLO=UPLO)), x)
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply("eigvalsh", lambda a: jnp.linalg.eigvalsh(a, UPLO=UPLO), x)
+
+
+def solve(x, y, name=None):
+    return apply("solve", jnp.linalg.solve, x, y)
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False, name=None):
+    def f(a, b):
+        return jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular)
+    return apply("triangular_solve", f, x, y)
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_nodiff("lstsq", f, x, y)
+
+
+def matrix_power(x, n, name=None):
+    return apply("matrix_power", lambda a: jnp.linalg.matrix_power(a, n), x)
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    return apply_nodiff("matrix_rank", lambda a: jnp.linalg.matrix_rank(a, tol=tol), x)
+
+
+def cond(x, p=None, name=None):
+    return apply("cond", lambda a: jnp.linalg.cond(a, p=p), x)
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32)
+    outs = apply_nodiff("lu", f, x)
+    if get_infos:
+        z = Tensor(jnp.zeros((), jnp.int32))
+        return outs[0], outs[1], z
+    return outs
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        q = jnp.eye(m, dtype=a.dtype)
+        for i in range(n):
+            v = jnp.concatenate([jnp.zeros((i,), a.dtype), jnp.ones((1,), a.dtype), a[i + 1:, i]])
+            q = q - t[i] * (q @ v[:, None]) @ v[None, :]
+        return q
+    return apply("householder_product", f, x, tau)
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    return apply("cov", lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0), x)
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)

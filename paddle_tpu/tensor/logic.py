@@ -1,0 +1,72 @@
+"""Comparison / logical ops (paddle.tensor.logic parity,
+/root/reference/python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply_nodiff
+
+__all__ = [
+    "equal", "not_equal", "less_than", "less_equal", "greater_than",
+    "greater_equal", "logical_and", "logical_or", "logical_not",
+    "logical_xor", "bitwise_and", "bitwise_or", "bitwise_not", "bitwise_xor",
+    "bitwise_left_shift", "bitwise_right_shift", "allclose", "isclose",
+    "equal_all", "is_empty", "is_tensor",
+]
+
+
+def _cmp(name, fn):
+    def op(x, y, name=None):
+        return apply_nodiff(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+equal = _cmp("equal", jnp.equal)
+not_equal = _cmp("not_equal", jnp.not_equal)
+less_than = _cmp("less_than", jnp.less)
+less_equal = _cmp("less_equal", jnp.less_equal)
+greater_than = _cmp("greater_than", jnp.greater)
+greater_equal = _cmp("greater_equal", jnp.greater_equal)
+logical_and = _cmp("logical_and", jnp.logical_and)
+logical_or = _cmp("logical_or", jnp.logical_or)
+logical_xor = _cmp("logical_xor", jnp.logical_xor)
+bitwise_and = _cmp("bitwise_and", jnp.bitwise_and)
+bitwise_or = _cmp("bitwise_or", jnp.bitwise_or)
+bitwise_xor = _cmp("bitwise_xor", jnp.bitwise_xor)
+bitwise_left_shift = _cmp("bitwise_left_shift", jnp.left_shift)
+bitwise_right_shift = _cmp("bitwise_right_shift", jnp.right_shift)
+
+
+def logical_not(x, out=None, name=None):
+    return apply_nodiff("logical_not", jnp.logical_not, x)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_nodiff("bitwise_not", jnp.bitwise_not, x)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nodiff("allclose",
+                        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                                  equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_nodiff("isclose",
+                        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                                 equal_nan=equal_nan), x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply_nodiff("equal_all",
+                        lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)

@@ -1,0 +1,653 @@
+"""Shape / layout manipulation ops (paddle.tensor.manipulation parity,
+/root/reference/python/paddle/tensor/manipulation.py). All static-shape,
+XLA-friendly: no data-dependent output shapes except the documented
+exceptions (nonzero/unique/masked_select) which are eager-only."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, apply_nodiff
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "transpose", "squeeze", "squeeze_",
+    "unsqueeze", "unsqueeze_", "concat", "stack", "split", "chunk", "unbind",
+    "tile", "expand", "expand_as", "broadcast_to", "broadcast_tensors",
+    "flip", "rot90", "roll", "gather", "gather_nd", "scatter", "scatter_nd",
+    "scatter_nd_add", "index_select", "index_add", "index_put", "take",
+    "take_along_axis", "put_along_axis", "masked_select", "masked_fill",
+    "masked_scatter", "where", "nonzero", "unique", "unique_consecutive",
+    "sort", "argsort", "argmax", "argmin", "topk", "searchsorted",
+    "bucketize", "kthvalue",
+    "mode", "median", "nanmedian", "quantile", "nanquantile",
+    "pad", "slice", "strided_slice", "crop", "repeat_interleave",
+    "as_strided", "view", "view_as", "unfold", "tensordot", "moveaxis",
+    "swapaxes", "atleast_1d", "atleast_2d", "atleast_3d", "unstack",
+    "tensor_split", "hsplit", "vsplit", "dsplit", "hstack", "vstack",
+    "dstack", "column_stack", "row_stack", "shard_index", "cdist",
+]
+
+from .linalg import transpose  # shared
+
+
+def _ints(v):
+    if isinstance(v, Tensor):
+        return tuple(int(x) for x in np.atleast_1d(np.asarray(v._value)))
+    if isinstance(v, (int, np.integer)):
+        return (int(v),)
+    out = []
+    for e in v:
+        if isinstance(e, Tensor):
+            out.append(int(np.asarray(e._value)))
+        else:
+            out.append(int(e))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    s = _ints(shape)
+    return apply("reshape", lambda a: jnp.reshape(a, s), x)
+
+
+def reshape_(x, shape, name=None):
+    out = reshape(x, shape)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    x.stop_gradient = out.stop_gradient
+    return x
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply("flatten", f, x)
+
+
+def squeeze(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            return jnp.squeeze(a)
+        axes = _ints(axis)
+        axes = tuple(ax % a.ndim for ax in axes)
+        axes = tuple(ax for ax in axes if a.shape[ax] == 1)
+        return jnp.squeeze(a, axis=axes) if axes else a
+    return apply("squeeze", f, x)
+
+
+def squeeze_(x, axis=None, name=None):
+    out = squeeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def unsqueeze(x, axis, name=None):
+    axes = _ints(axis)
+    def f(a):
+        out = a
+        for ax in sorted(axes):
+            out = jnp.expand_dims(out, ax)
+        return out
+    return apply("unsqueeze", f, x)
+
+
+def unsqueeze_(x, axis, name=None):
+    out = unsqueeze(x, axis)
+    x._value, x._node, x._out_idx = out._value, out._node, out._out_idx
+    return x
+
+
+def concat(x, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply("concat", lambda *xs: jnp.concatenate(xs, axis=ax), *x)
+
+
+def stack(x, axis=0, name=None):
+    return apply("stack", lambda *xs: jnp.stack(xs, axis=axis), *x)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    def f(a):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(a, num_or_sections, axis=ax))
+        secs = list(num_or_sections)
+        total = a.shape[ax]
+        known = builtins_sum(s for s in secs if s not in (-1,))
+        secs = [total - known if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(a, idx, axis=ax))
+    return list(apply("split", f, x))
+
+
+def builtins_sum(it):
+    tot = 0
+    for v in it:
+        tot += v
+    return tot
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(input, axis=0, name=None):
+    n = input.shape[axis]
+    def f(a):
+        return tuple(jnp.squeeze(s, axis=axis) for s in jnp.split(a, n, axis=axis))
+    return list(apply("unbind", f, input))
+
+
+unstack = unbind
+
+
+def tile(x, repeat_times, name=None):
+    reps = _ints(repeat_times)
+    return apply("tile", lambda a: jnp.tile(a, reps), x)
+
+
+def expand(x, shape, name=None):
+    s = _ints(shape)
+    def f(a):
+        tgt = list(s)
+        # -1 means keep original dim
+        off = len(tgt) - a.ndim
+        for i in range(len(tgt)):
+            if tgt[i] == -1:
+                tgt[i] = a.shape[i - off]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply("expand", f, x)
+
+
+def expand_as(x, y, name=None):
+    return apply("expand_as", lambda a, b: jnp.broadcast_to(a, b.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    s = _ints(shape)
+    return apply("broadcast_to", lambda a: jnp.broadcast_to(a, s), x)
+
+
+def broadcast_tensors(input, name=None):
+    return list(apply("broadcast_tensors", lambda *xs: tuple(jnp.broadcast_arrays(*xs)), *input))
+
+
+def flip(x, axis, name=None):
+    axes = _ints(axis)
+    return apply("flip", lambda a: jnp.flip(a, axis=axes), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply("rot90", lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _ints(shifts)
+    sh = sh[0] if len(sh) == 1 and not isinstance(shifts, (list, tuple)) else sh
+    ax = _ints(axis) if axis is not None else None
+    if isinstance(sh, tuple) and ax is not None and len(sh) == len(ax):
+        return apply("roll", lambda a: jnp.roll(a, sh, axis=ax), x)
+    return apply("roll", lambda a: jnp.roll(a, sh if not isinstance(sh, tuple) else sh[0],
+                                            axis=None if ax is None else ax[0]), x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(axis._value) if isinstance(axis, Tensor) else int(axis)
+    return apply("gather", lambda a, i: jnp.take(a, i.reshape(-1) if i.ndim > 1 else i, axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        k = idx.shape[-1]
+        flat_idx = tuple(idx[..., i] for i in range(k))
+        return a[flat_idx]
+    return apply("gather_nd", f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            # paddle overwrite semantics: later rows win; emulate with set
+            return a.at[i].set(u)
+        base = a.at[i].set(jnp.zeros_like(u))
+        return base.at[i].add(u)
+    return apply("scatter", f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    s = _ints(shape)
+    def f(i, u):
+        out = jnp.zeros(s, u.dtype)
+        k = i.shape[-1]
+        return out.at[tuple(i[..., d] for d in range(k))].add(u)
+    return apply("scatter_nd", f, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, i, u):
+        k = i.shape[-1]
+        return a.at[tuple(i[..., d] for d in range(k))].add(u)
+    return apply("scatter_nd_add", f, x, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        idx = [builtins_slice(None)] * a.ndim
+        idx[axis] = i
+        return a.at[tuple(idx)].add(v)
+    return apply("index_add", f, x, index, value)
+
+
+def builtins_slice(*a):
+    import builtins
+    return builtins.slice(*a)
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    def f(a, v, *idx):
+        if accumulate:
+            return a.at[tuple(idx)].add(v)
+        return a.at[tuple(idx)].set(v)
+    return apply("index_put", f, x, value, *indices)
+
+
+def take(x, index, mode="raise", name=None):
+    m = {"raise": "clip", "clip": "clip", "wrap": "wrap"}[mode]
+    return apply("take", lambda a, i: jnp.take(a.reshape(-1), i, mode=m), x, index)
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply("take_along_axis", lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if jnp.ndim(v) else jnp.full(i.shape, v, a.dtype)
+        if reduce == "assign":
+            return jnp.put_along_axis(a, i, v, axis=axis, inplace=False)
+        if reduce == "add":
+            idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)])
+                   for k, s in enumerate(i.shape)]
+            idx[axis] = i
+            return a.at[tuple(idx)].add(v)
+        if reduce in ("mul", "multiply"):
+            idx = [jnp.arange(s).reshape([-1 if d == k else 1 for d in range(i.ndim)])
+                   for k, s in enumerate(i.shape)]
+            idx[axis] = i
+            return a.at[tuple(idx)].multiply(v)
+        raise ValueError(reduce)
+    return apply("put_along_axis", f, arr, indices, values)
+
+
+def masked_select(x, mask, name=None):
+    # Data-dependent output shape: eager-only (documented XLA exception).
+    xv = np.asarray(x._value)
+    mv = np.asarray(mask._value)
+    return Tensor(jnp.asarray(xv[mv]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = value._value if isinstance(value, Tensor) else value
+    return apply("masked_fill", lambda a, m: jnp.where(m, jnp.asarray(v, a.dtype), a), x, mask)
+
+
+def masked_scatter(x, mask, value, name=None):
+    xv = np.asarray(x._value)
+    mv = np.asarray(mask._value)
+    vv = np.asarray(value._value).reshape(-1)
+    out = xv.copy()
+    out[mv] = vv[: int(mv.sum())]
+    return Tensor(jnp.asarray(out))
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply("where", lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    xv = np.asarray(x._value)
+    nz = np.nonzero(xv)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    xv = np.asarray(x._value)
+    res = np.unique(xv, return_index=return_index, return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not (return_index or return_inverse or return_counts):
+        return Tensor(jnp.asarray(res))
+    out = [Tensor(jnp.asarray(r)) for r in res]
+    return tuple(out)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False, axis=None,
+                       dtype="int64", name=None):
+    xv = np.asarray(x._value)
+    if axis is None:
+        xv = xv.reshape(-1)
+        change = np.concatenate([[True], xv[1:] != xv[:-1]])
+    else:
+        raise NotImplementedError("axis not supported yet")
+    vals = xv[change]
+    outs = [Tensor(jnp.asarray(vals))]
+    if return_inverse:
+        inv = np.cumsum(change) - 1
+        outs.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.nonzero(change)[0]
+        counts = np.diff(np.concatenate([idx, [len(xv)]]))
+        outs.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return outs[0] if len(outs) == 1 else tuple(outs)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            out = jnp.argmax(a.reshape(-1))
+            return (out.reshape((1,) * a.ndim) if keepdim else out).astype(d)
+        return jnp.argmax(a, axis=axis, keepdims=keepdim).astype(d)
+    return apply_nodiff("argmax", f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    d = dtypes.convert_dtype(dtype)
+    def f(a):
+        if axis is None:
+            out = jnp.argmin(a.reshape(-1))
+            return (out.reshape((1,) * a.ndim) if keepdim else out).astype(d)
+        return jnp.argmin(a, axis=axis, keepdims=keepdim).astype(d)
+    return apply_nodiff("argmin", f, x)
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        out = jnp.sort(a, axis=axis, stable=stable)
+        return jnp.flip(out, axis=axis) if descending else out
+    return apply("sort", f, x)
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        i = jnp.argsort(a, axis=axis, stable=stable)
+        return jnp.flip(i, axis=axis) if descending else i
+    return apply_nodiff("argsort", f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = int(k._value) if isinstance(k, Tensor) else int(k)
+    def f(a):
+        ax = axis % a.ndim
+        moved = jnp.moveaxis(a, ax, -1)
+        if largest:
+            v, i = jax.lax.top_k(moved, kk)
+        else:
+            v, i = jax.lax.top_k(-moved, kk)
+            v = -v
+        return jnp.moveaxis(v, -1, ax), jnp.moveaxis(i, -1, ax).astype(jnp.int64)
+    vals, idxs = apply("topk", f, x)
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    side = "right" if right else "left"
+    d = jnp.int32 if out_int32 else jnp.int64
+    def f(s, v):
+        if s.ndim == 1:
+            return jnp.searchsorted(s, v, side=side).astype(d)
+        flat_s = s.reshape(-1, s.shape[-1])
+        flat_v = v.reshape(-1, v.shape[-1])
+        out = jax.vmap(lambda ss, vv: jnp.searchsorted(ss, vv, side=side))(flat_s, flat_v)
+        return out.reshape(v.shape).astype(d)
+    return apply_nodiff("searchsorted", f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32=out_int32, right=right)
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        si = jnp.argsort(a, axis=axis)
+        i = jnp.take(si, k - 1, axis=axis)
+        v = jnp.take_along_axis(a, jnp.expand_dims(i, axis), axis=axis)
+        v = v if keepdim else jnp.squeeze(v, axis)
+        i = (jnp.expand_dims(i, axis) if keepdim else i).astype(jnp.int64)
+        return v, i
+    vals, idxs = apply("kthvalue", f, x)
+    idxs.stop_gradient = True
+    return vals, idxs
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    xv = np.asarray(x._value)
+    from scipy import stats  # available via numpy ecosystem
+    m = stats.mode(xv, axis=axis, keepdims=keepdim)
+    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply("median", lambda a: jnp.median(a, axis=axis, keepdims=keepdim), x)
+
+
+def nanmedian(x, axis=None, keepdim=False, mode="avg", name=None):
+    return apply("nanmedian", lambda a: jnp.nanmedian(a, axis=axis, keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply("quantile", lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis,
+                                                    keepdims=keepdim, method=interpolation), x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear", name=None):
+    return apply("nanquantile", lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=axis,
+                                                          keepdims=keepdim, method=interpolation), x)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    p = _ints(pad)
+    def f(a):
+        nd = a.ndim
+        if len(p) == 2 * nd:
+            # full pad spec: paddle order is (before_0, after_0, ...)? paddle uses
+            # flat [d0_l, d0_r, d1_l, d1_r, ...] over all dims
+            widths = [(p[2 * i], p[2 * i + 1]) for i in range(nd)]
+        else:
+            # partial spec applies to trailing spatial dims, reversed pairs like torch
+            k = len(p) // 2
+            widths = [(0, 0)] * nd
+            if data_format.startswith("N") and len(data_format) == nd + 0:
+                pass
+            # paddle semantics: pairs start from the LAST spatial dim
+            # (e.g. NCHW len-4 pad = [W_l, W_r, H_l, H_r])
+            if data_format in ("NCHW", "NCDHW", "NCL"):
+                dims = list(range(2, nd))
+            elif data_format in ("NHWC", "NDHWC", "NLC"):
+                dims = list(range(1, nd - 1))
+            else:
+                dims = list(range(nd - k, nd))
+            for j, d in enumerate(reversed(dims[-k:])):
+                widths[d] = (p[2 * j], p[2 * j + 1])
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        if jmode == "constant":
+            return jnp.pad(a, widths, mode=jmode, constant_values=value)
+        return jnp.pad(a, widths, mode=jmode)
+    return apply("pad", f, x)
+
+
+def slice(input, axes, starts, ends, name=None):
+    ax = _ints(axes)
+    st = _ints(starts)
+    en = _ints(ends)
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for d, s, e in zip(ax, st, en):
+            idx[d] = builtins_slice(s, e)
+        return a[tuple(idx)]
+    return apply("slice", f, input)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    ax, st, en, sr = _ints(axes), _ints(starts), _ints(ends), _ints(strides)
+    def f(a):
+        idx = [builtins_slice(None)] * a.ndim
+        for d, s, e, r in zip(ax, st, en, sr):
+            idx[d] = builtins_slice(s, e, r)
+        return a[tuple(idx)]
+    return apply("strided_slice", f, x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    s = _ints(shape)
+    o = _ints(offsets) if offsets is not None else (0,) * len(s)
+    def f(a):
+        idx = tuple(builtins_slice(oo, oo + (ss if ss != -1 else a.shape[d] - oo))
+                    for d, (oo, ss) in enumerate(zip(o, s)))
+        return a[idx]
+    return apply("crop", f, x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        return apply("repeat_interleave",
+                     lambda a, r: jnp.repeat(a, r, axis=axis,
+                                             total_repeat_length=int(np.asarray(repeats._value).sum())),
+                     x, repeats)
+    return apply("repeat_interleave", lambda a: jnp.repeat(a, repeats, axis=axis), x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    def f(a):
+        flat = a.reshape(-1)
+        idx = np.zeros(tuple(shape), dtype=np.int64) + offset
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = np.arange(s) * st
+            idx = idx + r.reshape([-1 if i == d else 1 for i in range(len(shape))])
+        return flat[jnp.asarray(idx)]
+    return apply("as_strided", f, x)
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    return apply("view_dtype", lambda a: a.view(dtypes.convert_dtype(shape_or_dtype)), x)
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def unfold(x, axis, size, step, name=None):
+    def f(a):
+        n = (a.shape[axis] - size) // step + 1
+        idx = jnp.arange(n)[:, None] * step + jnp.arange(size)[None, :]
+        moved = jnp.moveaxis(a, axis, 0)
+        out = moved[idx]  # (n, size, ...)
+        out = jnp.moveaxis(out, (0, 1), (axis, a.ndim))
+        return out
+    return apply("unfold", f, x)
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax._value).tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(_ints(a)) if isinstance(a, (list, tuple, Tensor)) else a for a in ax)
+    return apply("tensordot", lambda a, b: jnp.tensordot(a, b, axes=ax), x, y)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply("moveaxis", lambda a: jnp.moveaxis(a, source, destination), x)
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply("swapaxes", lambda a: jnp.swapaxes(a, axis0, axis1), x)
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply("atleast_1d", jnp.atleast_1d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply("atleast_2d", jnp.atleast_2d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply("atleast_3d", jnp.atleast_3d, x) for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    def f(a):
+        return tuple(jnp.array_split(a, num_or_indices, axis=axis)) \
+            if isinstance(num_or_indices, int) else \
+            tuple(jnp.split(a, list(num_or_indices), axis=axis))
+    return list(apply("tensor_split", f, x))
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hstack(x, name=None):
+    return apply("hstack", lambda *xs: jnp.hstack(xs), *x)
+
+
+def vstack(x, name=None):
+    return apply("vstack", lambda *xs: jnp.vstack(xs), *x)
+
+
+def dstack(x, name=None):
+    return apply("dstack", lambda *xs: jnp.dstack(xs), *x)
+
+
+def column_stack(x, name=None):
+    return apply("column_stack", lambda *xs: jnp.column_stack(xs), *x)
+
+
+row_stack = vstack
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    def f(i):
+        shard_size = (index_num + nshards - 1) // nshards
+        in_shard = (i // shard_size) == shard_id
+        return jnp.where(in_shard, i % shard_size, ignore_value)
+    return apply_nodiff("shard_index", f, input)
+
+
+def cdist(x, y, p=2.0, compute_mode="use_mm_for_euclid_dist_if_necessary", name=None):
+    def f(a, b):
+        diff = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(diff * diff, axis=-1) + 1e-30)
+        return jnp.power(jnp.sum(jnp.power(jnp.abs(diff), p), axis=-1), 1.0 / p)
+    return apply("cdist", f, x, y)

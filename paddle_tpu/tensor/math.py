@@ -1,0 +1,363 @@
+"""Elementwise + reduction math ops (paddle.tensor.math parity,
+/root/reference/python/paddle/tensor/math.py). Every op is a jnp/lax
+composition dispatched through the autograd tape; XLA fuses the rest."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, apply_nodiff
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "mod",
+    "remainder", "pow", "float_power", "maximum", "minimum", "fmax", "fmin",
+    "abs", "neg", "ceil", "floor", "round", "trunc", "frac", "exp", "expm1",
+    "log", "log2", "log10", "log1p", "sqrt", "rsqrt", "square", "sign",
+    "reciprocal", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv",
+    "lgamma", "digamma", "sigmoid", "logit", "clip", "lerp", "nan_to_num",
+    "sum", "nansum", "mean", "nanmean", "prod", "max", "min", "amax", "amin",
+    "logsumexp", "all", "any", "cumsum", "cumprod", "cummax", "cummin",
+    "isfinite", "isinf", "isnan", "count_nonzero", "addmm", "inner", "outer",
+    "heaviside", "rad2deg", "deg2rad", "gcd", "lcm", "diff", "angle",
+    "conj", "real", "imag", "trapezoid", "multiply_", "add_", "subtract_",
+    "scale", "stanh", "multiplex", "increment", "log_normalize",
+]
+
+
+def _ew(name, fn):
+    def op(x, y, name=None):
+        return apply(name, fn, x, y)
+    op.__name__ = name
+    return op
+
+
+add = _ew("add", jnp.add)
+subtract = _ew("subtract", jnp.subtract)
+multiply = _ew("multiply", jnp.multiply)
+divide = _ew("divide", jnp.divide)
+maximum = _ew("maximum", jnp.maximum)
+minimum = _ew("minimum", jnp.minimum)
+fmax = _ew("fmax", jnp.fmax)
+fmin = _ew("fmin", jnp.fmin)
+atan2 = _ew("atan2", jnp.arctan2)
+heaviside = _ew("heaviside", jnp.heaviside)
+
+
+def floor_divide(x, y, name=None):
+    return apply_nodiff("floor_divide", jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return apply("mod", jnp.mod, x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return apply("pow", jnp.power, x, y)
+
+
+def float_power(x, y, name=None):
+    return apply("float_power", lambda a, b: jnp.power(a.astype(jnp.float64) if False else a.astype(jnp.float32), b), x, y)
+
+
+def _uw(name, fn):
+    def op(x, name=None):
+        return apply(name, fn, x)
+    op.__name__ = name
+    return op
+
+
+abs = _uw("abs", jnp.abs)
+neg = _uw("neg", jnp.negative)
+exp = _uw("exp", jnp.exp)
+expm1 = _uw("expm1", jnp.expm1)
+log = _uw("log", jnp.log)
+log2 = _uw("log2", jnp.log2)
+log10 = _uw("log10", jnp.log10)
+log1p = _uw("log1p", jnp.log1p)
+sqrt = _uw("sqrt", jnp.sqrt)
+rsqrt = _uw("rsqrt", jax.lax.rsqrt)
+square = _uw("square", jnp.square)
+sin = _uw("sin", jnp.sin)
+cos = _uw("cos", jnp.cos)
+tan = _uw("tan", jnp.tan)
+asin = _uw("asin", jnp.arcsin)
+acos = _uw("acos", jnp.arccos)
+atan = _uw("atan", jnp.arctan)
+sinh = _uw("sinh", jnp.sinh)
+cosh = _uw("cosh", jnp.cosh)
+tanh = _uw("tanh", jnp.tanh)
+asinh = _uw("asinh", jnp.arcsinh)
+acosh = _uw("acosh", jnp.arccosh)
+atanh = _uw("atanh", jnp.arctanh)
+erf = _uw("erf", jax.scipy.special.erf)
+erfinv = _uw("erfinv", jax.scipy.special.erfinv)
+lgamma = _uw("lgamma", jax.scipy.special.gammaln)
+digamma = _uw("digamma", jax.scipy.special.digamma)
+sigmoid = _uw("sigmoid", jax.nn.sigmoid)
+reciprocal = _uw("reciprocal", jnp.reciprocal)
+rad2deg = _uw("rad2deg", jnp.rad2deg)
+deg2rad = _uw("deg2rad", jnp.deg2rad)
+angle = _uw("angle", jnp.angle)
+conj = _uw("conj", jnp.conjugate)
+real = _uw("real", jnp.real)
+imag = _uw("imag", jnp.imag)
+
+
+def sign(x, name=None):
+    return apply_nodiff("sign", jnp.sign, x)
+
+
+def ceil(x, name=None):
+    return apply("ceil", jnp.ceil, x)
+
+
+def floor(x, name=None):
+    return apply("floor", jnp.floor, x)
+
+
+def round(x, decimals=0, name=None):
+    return apply("round", lambda a: jnp.round(a, decimals), x)
+
+
+def trunc(x, name=None):
+    return apply("trunc", jnp.trunc, x)
+
+
+def frac(x, name=None):
+    return apply("frac", lambda a: a - jnp.trunc(a), x)
+
+
+def logit(x, eps=None, name=None):
+    def f(a):
+        if eps is not None:
+            a = jnp.clip(a, eps, 1.0 - eps)
+        return jnp.log(a / (1.0 - a))
+    return apply("logit", f, x)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return apply("stanh", lambda a: scale_b * jnp.tanh(scale_a * a), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = min._value if isinstance(min, Tensor) else min
+    hi = max._value if isinstance(max, Tensor) else max
+    return apply("clip", lambda a: jnp.clip(a, lo, hi), x)
+
+
+def lerp(x, y, weight, name=None):
+    return apply("lerp", lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply("nan_to_num",
+                 lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    def f(a, s):
+        if bias_after_scale:
+            return s * a + jnp.asarray(bias, a.dtype)
+        return s * (a + jnp.asarray(bias, a.dtype))
+    if isinstance(scale, Tensor):
+        return apply("scale", f, x, scale)
+    return apply("scale", lambda a: f(a, jnp.asarray(scale, a.dtype)), x)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply("increment", lambda a: a + jnp.asarray(value, a.dtype), x)
+    x._replace(out._value)
+    return x
+
+
+# -- reductions -------------------------------------------------------------
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._value)
+        return tuple(int(v) for v in np.atleast_1d(a))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    def f(a):
+        out = jnp.sum(a, axis=_axis(axis), keepdims=keepdim, dtype=d)
+        return out
+    return apply("sum", f, x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("nansum", lambda a: jnp.nansum(a, axis=_axis(axis), keepdims=keepdim, dtype=d), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply("nanmean", lambda a: jnp.nanmean(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("prod", lambda a: jnp.prod(a, axis=_axis(axis), keepdims=keepdim, dtype=d), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):
+    return apply("max", lambda a: jnp.max(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):
+    return apply("min", lambda a: jnp.min(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+amax = max
+amin = min
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply("logsumexp", lambda a: jax.scipy.special.logsumexp(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return apply_nodiff("all", lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return apply_nodiff("any", lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply_nodiff("count_nonzero", lambda a: jnp.count_nonzero(a, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            return jnp.cumsum(a, dtype=d)
+        return jnp.cumsum(a, axis=int(axis), dtype=d)
+    return apply("cumsum", f, x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    d = dtypes.convert_dtype(dtype) if dtype else None
+    return apply("cumprod", lambda a: jnp.cumprod(a, axis=dim, dtype=d), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        ax = -1 if axis is None else axis
+        if axis is None:
+            a = a.reshape(-1)
+        vals = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        return vals
+    vals = apply("cummax", f, x)
+    # indices: argmax of running max == current
+    def fi(a):
+        ax = 0 if axis is None else axis
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        n = a.shape[ax]
+        idx = jnp.arange(n).reshape([-1 if i == (ax % a.ndim) else 1 for i in range(a.ndim)])
+        vals_ = jax.lax.associative_scan(jnp.maximum, a, axis=ax)
+        is_new = a >= vals_
+        idx_b = jnp.where(is_new, idx, 0)
+        inds = jax.lax.associative_scan(jnp.maximum, idx_b, axis=ax)
+        return inds.astype(dtypes.convert_dtype(dtype))
+    inds = apply_nodiff("cummax_idx", fi, x)
+    return vals, inds
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    from . import math as _m
+    neg_vals, inds = cummax(_m.neg(x), axis=axis, dtype=dtype)
+    return _m.neg(neg_vals), inds
+
+
+def isfinite(x, name=None):
+    return apply_nodiff("isfinite", jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return apply_nodiff("isinf", jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return apply_nodiff("isnan", jnp.isnan, x)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return apply("addmm", lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def inner(x, y, name=None):
+    return apply("inner", jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply("outer", lambda a, b: jnp.outer(a, b), x, y)
+
+
+def gcd(x, y, name=None):
+    return apply_nodiff("gcd", jnp.gcd, x, y)
+
+
+def lcm(x, y, name=None):
+    return apply_nodiff("lcm", jnp.lcm, x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = prepend._value if isinstance(prepend, Tensor) else prepend
+    app = append._value if isinstance(append, Tensor) else append
+    return apply("diff", lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app), x)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return apply("trapezoid", lambda a, b: jnp.trapezoid(a, x=b, axis=axis), y, x)
+    return apply("trapezoid", lambda a: jnp.trapezoid(a, dx=1.0 if dx is None else dx, axis=axis), y)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *xs):
+        stacked = jnp.stack(xs, axis=0)
+        rows = jnp.arange(stacked.shape[1])
+        return stacked[idx.reshape(-1), rows]
+    return apply("multiplex", f, index, *inputs)
+
+
+def log_normalize(x, axis=-1):
+    return apply("log_normalize", lambda a: a - jax.scipy.special.logsumexp(a, axis=axis, keepdims=True), x)
+
+
+# -- in-place variants (mutate the Tensor object) ---------------------------
+
+def _inplace(fn):
+    def op(x, y, name=None):
+        out = fn(x, y)
+        x._value = out._value
+        x._node = out._node
+        x._out_idx = out._out_idx
+        x.stop_gradient = out.stop_gradient
+        return x
+    return op
+
+
+add_ = _inplace(add)
+subtract_ = _inplace(subtract)
+multiply_ = _inplace(multiply)

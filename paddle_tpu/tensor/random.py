@@ -1,0 +1,152 @@
+"""Random ops over the global Generator's threaded PRNG key stream
+(paddle.tensor.random parity, /root/reference/python/paddle/tensor/random.py).
+Inside jit.TrainStep these draw from a traced base key (see
+framework.core.with_rng_key), keeping compiled steps pure."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, default_generator, apply_nodiff
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "randperm", "uniform",
+    "uniform_", "normal", "normal_", "standard_normal", "bernoulli",
+    "multinomial", "poisson", "exponential_", "rand_like", "randn_like",
+    "binomial", "standard_gamma",
+]
+
+
+def _d(dtype):
+    return dtypes.convert_dtype(dtype) if dtype is not None else dtypes.get_default_dtype()
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._value))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    out = []
+    for s in shape:
+        out.append(int(np.asarray(s._value)) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def rand(shape, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _d(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _d(dtype)))
+
+
+standard_normal = randn
+
+
+def randint(low=0, high=None, shape=(1,), dtype="int64", name=None):
+    if high is None:
+        low, high = 0, low
+    key = default_generator.next_key()
+    return Tensor(jax.random.randint(key, _shape(shape), low, high,
+                                     dtypes.convert_dtype(dtype)))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    d = dtype if dtype is not None else x.dtype
+    return randint(low, high, tuple(x.shape), d)
+
+
+def randperm(n, dtype="int64", name=None):
+    key = default_generator.next_key()
+    return Tensor(jax.random.permutation(key, n).astype(dtypes.convert_dtype(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = jax.random.PRNGKey(seed) if seed else default_generator.next_key()
+    d = _d(dtype)
+    return Tensor(jax.random.uniform(key, _shape(shape), d,
+                                     jnp.asarray(min, d), jnp.asarray(max, d)))
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    out = uniform(tuple(x.shape), x.dtype, min, max, seed)
+    x._replace(out._value)
+    return x
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    key = default_generator.next_key()
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = mean._value if isinstance(mean, Tensor) else mean
+        s = std._value if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(jnp.shape(m), jnp.shape(s))
+        eps = jax.random.normal(key, shp, dtypes.get_default_dtype())
+        return Tensor(m + s * eps)
+    shp = _shape(shape) if shape is not None else ()
+    eps = jax.random.normal(key, shp, dtypes.get_default_dtype())
+    return Tensor(mean + std * eps)
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    key = default_generator.next_key()
+    eps = jax.random.normal(key, tuple(x.shape), np.dtype(x.dtype)
+                            if dtypes.is_floating_point(x.dtype) else jnp.float32)
+    x._replace((mean + std * eps).astype(np.dtype(x.dtype)))
+    return x
+
+
+def rand_like(x, dtype=None, name=None):
+    return rand(tuple(x.shape), dtype if dtype is not None else x.dtype)
+
+
+def randn_like(x, dtype=None, name=None):
+    return randn(tuple(x.shape), dtype if dtype is not None else x.dtype)
+
+
+def bernoulli(x, name=None):
+    key = default_generator.next_key()
+    return apply_nodiff("bernoulli",
+                        lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x)
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = default_generator.next_key()
+    def f(p):
+        logits = jnp.log(jnp.maximum(p, 1e-30))
+        if p.ndim == 1:
+            return jax.random.choice(key, p.shape[-1], (num_samples,),
+                                     replace=replacement, p=p / p.sum()).astype(jnp.int64)
+        ks = jax.random.split(key, p.shape[0])
+        return jax.vmap(lambda k_, pr: jax.random.choice(
+            k_, p.shape[-1], (num_samples,), replace=replacement,
+            p=pr / pr.sum()))(ks, p).astype(jnp.int64)
+    return apply_nodiff("multinomial", f, x)
+
+
+def poisson(x, name=None):
+    key = default_generator.next_key()
+    return apply_nodiff("poisson",
+                        lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), x)
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = default_generator.next_key()
+    u = jax.random.uniform(key, tuple(x.shape), np.dtype(x.dtype))
+    x._replace(-jnp.log(1.0 - u) / lam)
+    return x
+
+
+def binomial(count, prob, name=None):
+    key = default_generator.next_key()
+    def f(n, p):
+        return jax.random.binomial(key, n.astype(jnp.float32), p).astype(jnp.int64)
+    return apply_nodiff("binomial", f, count, prob)
+
+
+def standard_gamma(x, name=None):
+    key = default_generator.next_key()
+    return apply_nodiff("standard_gamma", lambda a: jax.random.gamma(key, a), x)

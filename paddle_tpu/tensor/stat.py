@@ -1,0 +1,59 @@
+"""Statistics ops (paddle.tensor.stat parity,
+/root/reference/python/paddle/tensor/stat.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import apply, apply_nodiff
+
+__all__ = ["mean", "std", "var", "numel", "histogram", "histogramdd", "bincount"]
+
+from .math import mean  # shared
+
+
+def _axis(axis):
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("std", lambda a: jnp.std(a, axis=_axis(axis),
+                                          ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply("var", lambda a: jnp.var(a, axis=_axis(axis),
+                                          ddof=1 if unbiased else 0,
+                                          keepdims=keepdim), x)
+
+
+def numel(x, name=None):
+    return apply_nodiff("numel", lambda a: jnp.asarray(a.size), x)
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False, name=None):
+    def f(a):
+        lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+        h, _ = jnp.histogram(a.reshape(-1), bins=bins, range=(lo, hi),
+                             density=density)
+        return h if density else h.astype(jnp.int64)
+    return apply_nodiff("histogram", f, input)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None, name=None):
+    def f(a):
+        h, edges = jnp.histogramdd(a, bins=bins, range=ranges, density=density)
+        return (h,) + tuple(edges)
+    outs = apply_nodiff("histogramdd", f, x)
+    return outs[0], list(outs[1:])
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    if weights is not None:
+        return apply_nodiff("bincount",
+                            lambda a, w: jnp.bincount(a, weights=w, minlength=minlength),
+                            x, weights)
+    return apply_nodiff("bincount",
+                        lambda a: jnp.bincount(a, minlength=minlength), x)

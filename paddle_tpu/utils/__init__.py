@@ -1,0 +1,2 @@
+"""paddle_tpu.utils — flags registry, misc helpers."""
+from .flags import get_flags, set_flags, define_flag  # noqa: F401

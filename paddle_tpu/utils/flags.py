@@ -1,0 +1,75 @@
+"""Typed flag registry with env-var overrides.
+
+TPU-native analog of the reference's gflags clone
+(/root/reference/paddle/utils/flags_native.h, PHI_DEFINE_EXPORTED_* macros
+in paddle/phi/core/flags.h:155): one python registry, values overridable by
+FLAGS_<name> environment variables, settable at runtime via set_flags.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Dict
+
+__all__ = ["define_flag", "get_flags", "set_flags", "FLAGS"]
+
+
+@dataclass
+class _Flag:
+    name: str
+    default: Any
+    help: str
+    type_: type
+    value: Any = None
+
+
+_registry: Dict[str, _Flag] = {}
+
+
+def _coerce(type_, raw):
+    if type_ is bool:
+        if isinstance(raw, str):
+            return raw.lower() in ("1", "true", "yes", "on")
+        return bool(raw)
+    return type_(raw)
+
+
+def define_flag(name: str, default: Any, help: str = ""):
+    t = type(default)
+    f = _Flag(name, default, help, t)
+    env = os.environ.get(f"FLAGS_{name}")
+    f.value = _coerce(t, env) if env is not None else default
+    _registry[name] = f
+    return f
+
+
+def get_flags(flags=None):
+    if flags is None:
+        return {k: v.value for k, v in _registry.items()}
+    if isinstance(flags, str):
+        flags = [flags]
+    return {k: _registry[k].value for k in flags}
+
+
+def set_flags(flags: Dict[str, Any]):
+    for k, v in flags.items():
+        if k not in _registry:
+            define_flag(k, v)
+        else:
+            _registry[k].value = _coerce(_registry[k].type_, v)
+
+
+class _FlagsProxy:
+    def __getattr__(self, name):
+        if name in _registry:
+            return _registry[name].value
+        raise AttributeError(name)
+
+
+FLAGS = _FlagsProxy()
+
+# Core flags (subset parity with paddle/phi/core/flags.cc)
+define_flag("check_nan_inf", False, "check outputs for nan/inf after each op")
+define_flag("benchmark", False, "benchmark mode: block_until_ready each op")
+define_flag("use_pallas_kernels", True,
+            "use handwritten Pallas TPU kernels where available")

@@ -1,0 +1,3 @@
+"""paddle_tpu.vision — models/transforms/datasets
+(parity: /root/reference/python/paddle/vision/)."""
+from . import models  # noqa: F401

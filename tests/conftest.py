@@ -1,0 +1,33 @@
+"""Test harness: force an 8-device CPU JAX backend.
+
+SURVEY.md §4 takeaway (c): all collective/parallel tests run on virtual CPU
+devices — real multi-device SPMD semantics without TPU hardware. In this
+environment a sitecustomize pre-registers a TPU plugin and pins
+JAX_PLATFORMS; we drop that factory and select an 8-device CPU backend
+before anything initializes a backend.
+"""
+import os
+
+import jax
+from jax._src import xla_bridge as _xb
+
+if not _xb.backends_are_initialized():
+    _xb._backend_factories.pop("axon", None)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 8)
+elif jax.default_backend() != "cpu":
+    raise RuntimeError(
+        "JAX backend initialized before conftest; run pytest with "
+        "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+    paddle.seed(1234)
+    np.random.seed(1234)
+    yield

@@ -1,0 +1,246 @@
+"""Layer tests (shape + numerics vs manual numpy where cheap)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+import paddle_tpu.nn.functional as F
+
+
+def t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+class TestLinear:
+    def test_forward(self):
+        lin = nn.Linear(4, 3)
+        x = paddle.randn([2, 4])
+        out = lin(x)
+        assert out.shape == [2, 3]
+        want = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+        assert np.allclose(out.numpy(), want, rtol=1e-5)
+
+    def test_no_bias(self):
+        lin = nn.Linear(4, 3, bias_attr=False)
+        assert lin.bias is None
+        assert lin(paddle.randn([2, 4])).shape == [2, 3]
+
+
+class TestConv:
+    def test_conv2d_shape(self):
+        conv = nn.Conv2D(3, 8, 3, stride=2, padding=1)
+        out = conv(paddle.randn([2, 3, 16, 16]))
+        assert out.shape == [2, 8, 8, 8]
+
+    def test_conv2d_numpy(self):
+        # 1x1 conv == matmul over channels
+        conv = nn.Conv2D(3, 5, 1, bias_attr=False)
+        x = paddle.randn([1, 3, 4, 4])
+        out = conv(x).numpy()
+        w = conv.weight.numpy().reshape(5, 3)
+        want = np.einsum("oc,nchw->nohw", w, x.numpy())
+        assert np.allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_groups_depthwise(self):
+        conv = nn.Conv2D(4, 4, 3, padding=1, groups=4)
+        assert conv(paddle.randn([1, 4, 8, 8])).shape == [1, 4, 8, 8]
+
+    def test_conv_transpose(self):
+        convt = nn.Conv2DTranspose(3, 6, 4, stride=2, padding=1)
+        out = convt(paddle.randn([1, 3, 8, 8]))
+        assert out.shape == [1, 6, 16, 16]
+
+
+class TestNorms:
+    def test_batchnorm_train_eval(self):
+        bn = nn.BatchNorm2D(4)
+        x = paddle.randn([8, 4, 5, 5])
+        out = bn(x)
+        nx = out.numpy()
+        assert abs(nx.mean()) < 1e-4
+        assert abs(nx.std() - 1.0) < 1e-2
+        # running stats updated
+        assert not np.allclose(bn._mean.numpy(), 0.0)
+        bn.eval()
+        out2 = bn(x)
+        assert out2.shape == [8, 4, 5, 5]
+
+    def test_layernorm(self):
+        ln = nn.LayerNorm(16)
+        x = paddle.randn([3, 16])
+        out = ln(x).numpy()
+        assert np.allclose(out.mean(-1), 0.0, atol=1e-5)
+        assert np.allclose(out.std(-1), 1.0, atol=1e-1)
+
+    def test_rmsnorm(self):
+        rn = nn.RMSNorm(8)
+        x = paddle.randn([2, 8])
+        out = rn(x).numpy()
+        a = x.numpy()
+        want = a / np.sqrt((a ** 2).mean(-1, keepdims=True) + 1e-6)
+        assert np.allclose(out, want, rtol=1e-4, atol=1e-5)
+
+    def test_groupnorm(self):
+        gn = nn.GroupNorm(2, 4)
+        assert gn(paddle.randn([2, 4, 3, 3])).shape == [2, 4, 3, 3]
+
+
+class TestActivationsAndPool:
+    def test_activations(self):
+        x = paddle.randn([4, 4])
+        a = x.numpy()
+        assert np.allclose(nn.ReLU()(x).numpy(), np.maximum(a, 0))
+        assert np.allclose(F.sigmoid(x).numpy(), 1 / (1 + np.exp(-a)), rtol=1e-5)
+        sm = F.softmax(x, axis=-1).numpy()
+        assert np.allclose(sm.sum(-1), 1.0, rtol=1e-5)
+
+    def test_pools(self):
+        x = paddle.randn([1, 2, 8, 8])
+        assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+        assert nn.AdaptiveAvgPool2D((1, 1))(x).shape == [1, 2, 1, 1]
+        # adaptive avg (1,1) == mean
+        assert np.allclose(
+            nn.AdaptiveAvgPool2D((1, 1))(x).numpy().reshape(1, 2),
+            x.numpy().mean((2, 3)), rtol=1e-5)
+
+    def test_maxpool_values(self):
+        a = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        out = nn.MaxPool2D(2, 2)(t(a)).numpy()
+        assert np.allclose(out.reshape(2, 2), [[5, 7], [13, 15]])
+
+
+class TestEmbeddingDropout:
+    def test_embedding(self):
+        emb = nn.Embedding(10, 4)
+        idx = t(np.array([[1, 2], [3, 4]], np.int64))
+        out = emb(idx)
+        assert out.shape == [2, 2, 4]
+        assert np.allclose(out.numpy()[0, 0], emb.weight.numpy()[1])
+
+    def test_dropout_train_eval(self):
+        d = nn.Dropout(0.5)
+        x = paddle.ones([1000])
+        out = d(x)
+        frac_zero = float((out == 0).astype("float32").mean())
+        assert 0.3 < frac_zero < 0.7
+        d.eval()
+        assert np.allclose(d(x).numpy(), x.numpy())
+
+
+class TestContainerStateDict:
+    def test_sequential_and_state_dict(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        x = paddle.randn([3, 4])
+        assert m(x).shape == [3, 2]
+        sd = m.state_dict()
+        assert "0.weight" in sd and "2.bias" in sd
+        m2 = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m2.set_state_dict(sd)
+        assert np.allclose(m2(x).numpy(), m(x).numpy())
+
+    def test_save_load(self, tmp_path):
+        m = nn.Linear(3, 3)
+        p = str(tmp_path / "model.pdparams")
+        paddle.save(m.state_dict(), p)
+        sd = paddle.load(p)
+        m2 = nn.Linear(3, 3)
+        m2.set_state_dict(sd)
+        assert np.allclose(m2.weight.numpy(), m.weight.numpy())
+
+    def test_named_parameters_layerlist(self):
+        ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+        names = [n for n, _ in ll.named_parameters()]
+        assert "0.weight" in names and "2.bias" in names
+        assert len(ll.parameters()) == 6
+
+
+class TestAttention:
+    def test_mha_shapes(self):
+        mha = nn.MultiHeadAttention(32, 4)
+        x = paddle.randn([2, 10, 32])
+        out = mha(x)
+        assert out.shape == [2, 10, 32]
+
+    def test_sdpa_matches_naive(self):
+        b, s, h, d = 2, 6, 2, 8
+        q = paddle.randn([b, s, h, d])
+        k = paddle.randn([b, s, h, d])
+        v = paddle.randn([b, s, h, d])
+        out = F.scaled_dot_product_attention(q, k, v)
+        qn, kn, vn = q.numpy(), k.numpy(), v.numpy()
+        logits = np.einsum("bqhd,bkhd->bhqk", qn, kn) / np.sqrt(d)
+        p = np.exp(logits - logits.max(-1, keepdims=True))
+        p = p / p.sum(-1, keepdims=True)
+        want = np.einsum("bhqk,bkhd->bqhd", p, vn)
+        assert np.allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_causal(self):
+        b, s, h, d = 1, 5, 1, 4
+        q = paddle.randn([b, s, h, d])
+        k = paddle.randn([b, s, h, d])
+        v = paddle.randn([b, s, h, d])
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        # position 0 attends only to itself
+        assert np.allclose(out.numpy()[0, 0, 0], v.numpy()[0, 0, 0], rtol=1e-4)
+
+
+class TestLosses:
+    def test_cross_entropy(self):
+        logits = np.random.randn(4, 6).astype(np.float32)
+        labels = np.array([0, 5, 2, 3], np.int64)
+        loss = F.cross_entropy(t(logits), t(labels))
+        lse = np.log(np.exp(logits).sum(-1))
+        want = (lse - logits[np.arange(4), labels]).mean()
+        assert np.allclose(float(loss), want, rtol=1e-5)
+
+    def test_ignore_index(self):
+        logits = np.random.randn(4, 6).astype(np.float32)
+        labels = np.array([0, -100, 2, -100], np.int64)
+        loss = F.cross_entropy(t(logits), t(labels), ignore_index=-100)
+        lse = np.log(np.exp(logits).sum(-1))
+        safe = np.where(labels == -100, 0, labels)
+        want = (lse - logits[np.arange(4), safe])[[0, 2]].mean()
+        assert np.allclose(float(loss), want, rtol=1e-5)
+
+    def test_mse_l1_bce(self):
+        a = np.random.rand(5).astype(np.float32)
+        b = np.random.rand(5).astype(np.float32)
+        assert np.allclose(float(F.mse_loss(t(a), t(b))),
+                           ((a - b) ** 2).mean(), rtol=1e-5)
+        assert np.allclose(float(F.l1_loss(t(a), t(b))),
+                           np.abs(a - b).mean(), rtol=1e-5)
+        p = np.clip(a, 0.01, 0.99)
+        y = (b > 0.5).astype(np.float32)
+        want = -(y * np.log(p) + (1 - y) * np.log(1 - p)).mean()
+        assert np.allclose(float(F.binary_cross_entropy(t(p), t(y))), want,
+                           rtol=1e-4)
+
+
+class TestReviewRegressions:
+    """Regression tests for issues found in code review."""
+
+    def test_pad_pairs_last_dim_first(self):
+        # NCHW len-4 pad = [W_l, W_r, H_l, H_r]
+        a = np.zeros((1, 1, 2, 3), np.float32)
+        out = F.pad(t(a), [1, 2, 3, 4])
+        assert out.shape == [1, 1, 2 + 3 + 4, 3 + 1 + 2]
+
+    def test_batchnorm_bias_only(self):
+        import paddle_tpu.nn.functional as F_
+        x = paddle.randn([4, 3, 2, 2])
+        rm = paddle.zeros([3])
+        rv = paddle.ones([3])
+        b = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        out = F_.batch_norm(x, rm, rv, weight=None, bias=b, training=False)
+        want = x.numpy() / np.sqrt(1 + 1e-5) + \
+            np.array([1, 2, 3], np.float32).reshape(1, 3, 1, 1)
+        assert np.allclose(out.numpy(), want, rtol=1e-4, atol=1e-5)
+
+    def test_topk_single_dispatch_grad(self):
+        x = paddle.to_tensor(np.array([[3.0, 1.0, 2.0]], np.float32),
+                             stop_gradient=False)
+        v, i = paddle.topk(x, 2)
+        v.sum().backward()
+        assert np.allclose(x.grad.numpy(), [[1.0, 0.0, 1.0]])
+        assert i.numpy().tolist() == [[0, 2]]

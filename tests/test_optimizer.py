@@ -1,0 +1,191 @@
+"""Optimizer + LR scheduler tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+
+def quad_problem():
+    """min ||w - w*||^2 via Parameter."""
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    w = paddle.framework.Parameter(
+        paddle.zeros([3])._value, name="w")
+    return w, target
+
+
+def run_steps(opt_cls, steps=150, lr=0.1, **kw):
+    w, target = quad_problem()
+    opt = opt_cls(learning_rate=lr, parameters=[w], **kw)
+    for _ in range(steps):
+        loss = ((w - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return w.numpy(), target
+
+
+class TestOptimizers:
+    def test_sgd(self):
+        w, tgt = run_steps(optimizer.SGD, lr=0.1)
+        assert np.allclose(w, tgt, atol=1e-3)
+
+    def test_momentum(self):
+        w, tgt = run_steps(optimizer.Momentum, lr=0.05)
+        assert np.allclose(w, tgt, atol=1e-2)
+
+    def test_adam(self):
+        w, tgt = run_steps(optimizer.Adam, steps=400, lr=0.1)
+        assert np.allclose(w, tgt, atol=1e-2)
+
+    def test_adamw_decay(self):
+        # with pure decay and no loss, weights shrink
+        w = paddle.framework.Parameter(paddle.ones([4])._value)
+        opt = optimizer.AdamW(learning_rate=0.1, parameters=[w],
+                              weight_decay=0.5)
+        for _ in range(10):
+            loss = (w * 0.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert (w.numpy() < 1.0).all()
+
+    def test_rmsprop_adagrad_lamb(self):
+        w, tgt = run_steps(optimizer.RMSProp, steps=400, lr=0.1)
+        assert np.allclose(w, tgt, atol=0.1), "RMSProp"
+        # Adagrad's 1/sqrt(sum g^2) decay needs a hotter lr to converge fast
+        w, tgt = run_steps(optimizer.Adagrad, steps=600, lr=1.0)
+        assert np.allclose(w, tgt, atol=0.1), "Adagrad"
+
+    def test_grad_clip_global_norm(self):
+        w = paddle.framework.Parameter(paddle.zeros([2])._value)
+        clip = nn.ClipGradByGlobalNorm(1.0) if hasattr(nn, "ClipGradByGlobalNorm") \
+            else optimizer.ClipGradByGlobalNorm(1.0)
+        opt = optimizer.SGD(learning_rate=1.0, parameters=[w], grad_clip=clip)
+        loss = (w * paddle.to_tensor([100.0, 0.0])).sum()
+        loss.backward()
+        opt.step()
+        # grad (100, 0) clipped to norm 1 → step of size 1
+        assert np.allclose(np.linalg.norm(w.numpy()), 1.0, atol=1e-4)
+
+    def test_state_dict_roundtrip(self):
+        w, tgt = quad_problem()
+        opt = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        loss = (w ** 2).sum()
+        loss.backward()
+        opt.step()
+        sd = opt.state_dict()
+        opt2 = optimizer.Adam(learning_rate=0.1, parameters=[w])
+        opt2.set_state_dict(sd)
+        assert opt2._step_count == opt._step_count
+
+
+class TestLRSchedulers:
+    def test_step_decay(self):
+        sched = optimizer.lr.StepDecay(learning_rate=1.0, step_size=2,
+                                       gamma=0.5)
+        lrs = []
+        for _ in range(6):
+            lrs.append(sched())
+            sched.step()
+        assert lrs[0] == 1.0 and lrs[2] == 0.5 and lrs[4] == 0.25
+
+    def test_cosine(self):
+        sched = optimizer.lr.CosineAnnealingDecay(learning_rate=1.0, T_max=10)
+        vals = []
+        for _ in range(11):
+            vals.append(sched())
+            sched.step()
+        assert vals[0] == pytest.approx(1.0)
+        assert vals[10] == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup(self):
+        sched = optimizer.lr.LinearWarmup(learning_rate=0.1, warmup_steps=5,
+                                          start_lr=0.0, end_lr=0.1)
+        v0 = sched()
+        for _ in range(6):
+            sched.step()
+        assert v0 < 0.1
+        assert sched() == pytest.approx(0.1)
+
+    def test_optimizer_uses_scheduler(self):
+        w, _ = quad_problem()
+        sched = optimizer.lr.StepDecay(learning_rate=0.5, step_size=1,
+                                       gamma=0.1)
+        opt = optimizer.SGD(learning_rate=sched, parameters=[w])
+        assert opt.get_lr() == 0.5
+        sched.step()
+        assert opt.get_lr() == pytest.approx(0.05)
+
+
+class TestAmp:
+    def test_autocast_matmul_bf16(self):
+        import jax.numpy as jnp
+        a = paddle.randn([4, 4])
+        b = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            c = paddle.matmul(a, b)
+        assert c._value.dtype == jnp.bfloat16
+        # black-listed op stays f32
+        with paddle.amp.auto_cast(dtype="bfloat16"):
+            s = paddle.nn.functional.softmax(a)
+        assert s._value.dtype == jnp.float32
+
+    def test_grad_scaler_api(self):
+        w = paddle.framework.Parameter(paddle.ones([2])._value)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=128.0)
+        loss = (w * w).sum()
+        scaled = scaler.scale(loss)
+        scaled.backward()
+        scaler.step(opt)
+        # after unscale, effective grad = 2*w → w = 1 - 0.2
+        assert np.allclose(w.numpy(), 0.8, atol=1e-5)
+
+
+class TestReviewRegressions:
+    def test_master_weights_bf16(self):
+        """bf16 params keep f32 masters: tiny updates must accumulate."""
+        import jax.numpy as jnp
+        w = paddle.framework.Parameter(
+            paddle.ones([4]).astype("bfloat16")._value)
+        opt = optimizer.SGD(learning_rate=1e-4, parameters=[w])
+        for _ in range(50):
+            loss = (w.astype("float32") * 1.0).sum()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        # 50 steps of 1e-4: master should be at 1 - 0.005; without masters
+        # bf16 rounding freezes the weight at 1.0
+        master = opt._state["master"][0]
+        assert master is not None
+        assert np.allclose(np.asarray(master), 1.0 - 0.005, atol=1e-6)
+
+    def test_grad_api_no_leak(self):
+        """paddle.grad must not pollute .grad of uninvolved parameters."""
+        m = nn.Linear(2, 2)
+        x = paddle.randn([1, 2])
+        x.stop_gradient = False
+        y = m(x).sum()
+        (gx,) = paddle.grad(y, x)
+        assert gx is not None
+        assert m.weight.grad is None and m.bias.grad is None
+
+    def test_scaler_explicit_unscale_then_step(self):
+        """unscale_ + step must not double-unscale."""
+        w = paddle.framework.Parameter(paddle.ones([2])._value)
+        opt = optimizer.SGD(learning_rate=0.1, parameters=[w])
+        scaler = paddle.amp.GradScaler(init_loss_scaling=1024.0)
+        loss = (w * w).sum()
+        scaler.scale(loss).backward()
+        scaler.unscale_(opt)
+        scaler.step(opt)
+        assert np.allclose(w.numpy(), 0.8, atol=1e-5)
+
+    def test_amp_custom_white_overrides_black(self):
+        import jax.numpy as jnp
+        a = paddle.randn([4, 4])
+        with paddle.amp.auto_cast(custom_white_list=["softmax"],
+                                  dtype="bfloat16"):
+            s = paddle.nn.functional.softmax(a.astype("bfloat16"))
+        assert s._value.dtype == jnp.bfloat16

@@ -1,0 +1,202 @@
+"""Op unit tests vs numpy (reference pattern: OpTest numpy comparison,
+/root/reference/test/legacy_test/op_test.py:2763 check_output)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def t(a, **kw):
+    return paddle.to_tensor(np.asarray(a), **kw)
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert np.allclose(paddle.zeros([2, 3]).numpy(), np.zeros((2, 3)))
+        assert np.allclose(paddle.ones([4]).numpy(), np.ones(4))
+        assert np.allclose(paddle.full([2, 2], 7.0).numpy(), np.full((2, 2), 7.0))
+
+    def test_arange_linspace(self):
+        assert np.allclose(paddle.arange(10).numpy(), np.arange(10))
+        assert np.allclose(paddle.arange(2, 10, 3).numpy(), np.arange(2, 10, 3))
+        assert np.allclose(paddle.linspace(0, 1, 5).numpy(),
+                           np.linspace(0, 1, 5))
+
+    def test_eye_tril_triu(self):
+        assert np.allclose(paddle.eye(3).numpy(), np.eye(3))
+        x = np.random.rand(4, 4).astype(np.float32)
+        assert np.allclose(paddle.tril(t(x)).numpy(), np.tril(x))
+        assert np.allclose(paddle.triu(t(x), 1).numpy(), np.triu(x, 1))
+
+    def test_like_ops(self):
+        x = t(np.random.rand(3, 2).astype(np.float32))
+        assert paddle.zeros_like(x).shape == [3, 2]
+        assert float(paddle.ones_like(x).sum()) == 6.0
+
+
+class TestMath:
+    def test_elementwise(self):
+        a = np.random.rand(3, 4).astype(np.float32) + 0.5
+        b = np.random.rand(3, 4).astype(np.float32) + 0.5
+        assert np.allclose((t(a) + t(b)).numpy(), a + b)
+        assert np.allclose((t(a) - t(b)).numpy(), a - b)
+        assert np.allclose((t(a) * t(b)).numpy(), a * b)
+        assert np.allclose((t(a) / t(b)).numpy(), a / b, rtol=1e-5)
+        assert np.allclose((t(a) ** 2).numpy(), a ** 2, rtol=1e-5)
+        assert np.allclose(paddle.maximum(t(a), t(b)).numpy(), np.maximum(a, b))
+
+    def test_unary(self):
+        a = np.random.rand(5).astype(np.float32) + 0.1
+        assert np.allclose(paddle.exp(t(a)).numpy(), np.exp(a), rtol=1e-5)
+        assert np.allclose(paddle.log(t(a)).numpy(), np.log(a), rtol=1e-5)
+        assert np.allclose(paddle.sqrt(t(a)).numpy(), np.sqrt(a), rtol=1e-5)
+        assert np.allclose(paddle.tanh(t(a)).numpy(), np.tanh(a), rtol=1e-5)
+        assert np.allclose(paddle.abs(t(-a)).numpy(), a)
+
+    def test_reductions(self):
+        a = np.random.rand(3, 4, 5).astype(np.float32)
+        assert np.allclose(paddle.sum(t(a)).numpy(), a.sum(), rtol=1e-5)
+        assert np.allclose(paddle.sum(t(a), axis=1).numpy(), a.sum(1), rtol=1e-5)
+        assert np.allclose(paddle.mean(t(a), axis=[0, 2]).numpy(),
+                           a.mean((0, 2)), rtol=1e-5)
+        assert np.allclose(paddle.max(t(a), axis=-1).numpy(), a.max(-1))
+        assert np.allclose(paddle.prod(t(a), axis=0).numpy(), a.prod(0), rtol=1e-4)
+        assert np.allclose(paddle.logsumexp(t(a)).numpy(),
+                           np.log(np.exp(a).sum()), rtol=1e-5)
+
+    def test_cumsum_clip(self):
+        a = np.random.randn(4, 5).astype(np.float32)
+        assert np.allclose(paddle.cumsum(t(a), axis=1).numpy(),
+                           np.cumsum(a, 1), rtol=1e-5)
+        assert np.allclose(paddle.clip(t(a), -0.5, 0.5).numpy(),
+                           np.clip(a, -0.5, 0.5))
+
+    def test_scalar_ops(self):
+        a = np.random.rand(3).astype(np.float32)
+        assert np.allclose((2.0 - t(a)).numpy(), 2.0 - a)
+        assert np.allclose((2.0 / (t(a) + 1)).numpy(), 2.0 / (a + 1), rtol=1e-5)
+
+
+class TestLinalg:
+    def test_matmul(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        b = np.random.rand(4, 5).astype(np.float32)
+        assert np.allclose(paddle.matmul(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+        assert np.allclose((t(a) @ t(b)).numpy(), a @ b, rtol=1e-5)
+        assert np.allclose(
+            paddle.matmul(t(a), t(b.T), transpose_y=True).numpy(), a @ b,
+            rtol=1e-5)
+
+    def test_batched(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        b = np.random.rand(2, 4, 5).astype(np.float32)
+        assert np.allclose(paddle.bmm(t(a), t(b)).numpy(), a @ b, rtol=1e-5)
+
+    def test_einsum_transpose(self):
+        a = np.random.rand(3, 4).astype(np.float32)
+        assert np.allclose(paddle.einsum("ij->ji", t(a)).numpy(), a.T)
+        assert np.allclose(paddle.transpose(t(a), [1, 0]).numpy(), a.T)
+        assert np.allclose(paddle.t(t(a)).numpy(), a.T)
+
+    def test_norm_solve(self):
+        a = np.random.rand(3, 3).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = np.random.rand(3, 2).astype(np.float32)
+        assert np.allclose(paddle.linalg.solve(t(a), t(b)).numpy(),
+                           np.linalg.solve(a, b), rtol=1e-4, atol=1e-5)
+        assert np.allclose(paddle.linalg.norm(t(b)).numpy(),
+                           np.linalg.norm(b), rtol=1e-5)
+
+
+class TestManipulation:
+    def test_reshape_flatten(self):
+        a = np.random.rand(2, 3, 4).astype(np.float32)
+        assert paddle.reshape(t(a), [6, 4]).shape == [6, 4]
+        assert paddle.flatten(t(a), 1).shape == [2, 12]
+        assert paddle.squeeze(t(a.reshape(2, 1, 3, 4)), 1).shape == [2, 3, 4]
+        assert paddle.unsqueeze(t(a), 0).shape == [1, 2, 3, 4]
+
+    def test_concat_split_stack(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        b = np.random.rand(2, 3).astype(np.float32)
+        c = paddle.concat([t(a), t(b)], axis=0)
+        assert np.allclose(c.numpy(), np.concatenate([a, b], 0))
+        s = paddle.split(c, 2, axis=0)
+        assert np.allclose(s[0].numpy(), a)
+        st = paddle.stack([t(a), t(b)], axis=0)
+        assert st.shape == [2, 2, 3]
+        parts = paddle.split(t(np.arange(10, dtype=np.float32)), [3, -1])
+        assert parts[0].shape == [3] and parts[1].shape == [7]
+
+    def test_gather_scatter(self):
+        a = np.random.rand(5, 3).astype(np.float32)
+        idx = np.array([0, 2, 4])
+        assert np.allclose(paddle.gather(t(a), t(idx)).numpy(), a[idx])
+        assert np.allclose(paddle.index_select(t(a), t(idx), 0).numpy(), a[idx])
+        upd = np.ones((3, 3), np.float32)
+        out = paddle.scatter(t(a), t(idx), t(upd))
+        want = a.copy()
+        want[idx] = 1.0
+        assert np.allclose(out.numpy(), want)
+
+    def test_where_masked(self):
+        a = np.random.randn(4, 4).astype(np.float32)
+        out = paddle.where(t(a > 0), t(a), t(np.zeros_like(a)))
+        assert np.allclose(out.numpy(), np.where(a > 0, a, 0))
+        mf = paddle.masked_fill(t(a), t(a < 0), 0.0)
+        assert np.allclose(mf.numpy(), np.where(a < 0, 0, a))
+
+    def test_sort_topk_argsort(self):
+        a = np.random.randn(3, 6).astype(np.float32)
+        assert np.allclose(paddle.sort(t(a), axis=-1).numpy(), np.sort(a, -1))
+        v, i = paddle.topk(t(a), 2, axis=-1)
+        want = np.sort(a, -1)[:, ::-1][:, :2]
+        assert np.allclose(v.numpy(), want)
+        assert np.allclose(paddle.argsort(t(a), -1).numpy(), np.argsort(a, -1))
+
+    def test_tile_expand_pad(self):
+        a = np.random.rand(2, 3).astype(np.float32)
+        assert np.allclose(paddle.tile(t(a), [2, 2]).numpy(), np.tile(a, (2, 2)))
+        assert paddle.expand(t(a.reshape(1, 2, 3)), [4, 2, 3]).shape == [4, 2, 3]
+        # NCHW len-4 pad = [W_l, W_r, H_l, H_r] (last spatial dim first)
+        p = paddle.nn.functional.pad(t(a.reshape(1, 1, 2, 3)), [1, 1, 2, 2])
+        assert p.shape == [1, 1, 2 + 4, 3 + 2]
+
+    def test_getitem_setitem(self):
+        a = np.random.rand(4, 5).astype(np.float32)
+        x = t(a)
+        assert np.allclose(x[1:3, 2].numpy(), a[1:3, 2])
+        x[0] = 9.0
+        assert np.allclose(x.numpy()[0], 9.0)
+
+
+class TestLogic:
+    def test_compare(self):
+        a = np.array([1.0, 2.0, 3.0], np.float32)
+        b = np.array([2.0, 2.0, 2.0], np.float32)
+        assert np.array_equal((t(a) < t(b)).numpy(), a < b)
+        assert np.array_equal((t(a) == t(b)).numpy(), a == b)
+        assert bool(paddle.allclose(t(a), t(a + 1e-9)))
+        assert bool(paddle.equal_all(t(a), t(a)))
+
+
+class TestRandom:
+    def test_shapes_and_determinism(self):
+        paddle.seed(7)
+        a = paddle.randn([3, 4])
+        paddle.seed(7)
+        b = paddle.randn([3, 4])
+        assert np.allclose(a.numpy(), b.numpy())
+        assert paddle.rand([2, 2]).shape == [2, 2]
+        r = paddle.randint(0, 10, [100])
+        assert r.numpy().min() >= 0 and r.numpy().max() < 10
+        p = paddle.randperm(10).numpy()
+        assert sorted(p.tolist()) == list(range(10))
+
+
+class TestStat:
+    def test_std_var_median(self):
+        a = np.random.rand(10, 5).astype(np.float32)
+        assert np.allclose(paddle.std(t(a)).numpy(), a.std(ddof=1), rtol=1e-4)
+        assert np.allclose(paddle.var(t(a), axis=0).numpy(), a.var(0, ddof=1),
+                           rtol=1e-4)
+        assert np.allclose(paddle.median(t(a)).numpy(), np.median(a), rtol=1e-5)

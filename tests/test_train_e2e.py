@@ -1,0 +1,135 @@
+"""End-to-end training slices (reference pattern: test/book golden-value
+convergence tests, /root/reference/test/book/test_recognize_digits.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+import paddle_tpu.nn.functional as F
+
+
+def make_blobs(n=256, d=8, classes=4, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(classes, d).astype(np.float32) * 3
+    X = np.concatenate([
+        centers[i] + rng.randn(n // classes, d).astype(np.float32)
+        for i in range(classes)])
+    y = np.concatenate([np.full(n // classes, i, np.int64)
+                        for i in range(classes)])
+    p = rng.permutation(n)
+    return X[p], y[p]
+
+
+class TestEagerTraining:
+    def test_mlp_converges(self):
+        X, y = make_blobs()
+        model = nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+        opt = optimizer.Adam(parameters=model.parameters(), learning_rate=0.01)
+        xb = paddle.to_tensor(X)
+        yb = paddle.to_tensor(y)
+        first = None
+        for i in range(60):
+            out = model(xb)
+            loss = F.cross_entropy(out, yb)
+            if first is None:
+                first = float(loss)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        acc = float((out.argmax(-1) == yb).astype("float32").mean())
+        assert float(loss) < first * 0.3
+        assert acc > 0.9
+
+    def test_dataloader_pipeline(self):
+        X, y = make_blobs(n=64)
+
+        class DS(paddle.io.Dataset):
+            def __getitem__(self, i):
+                return X[i], y[i]
+
+            def __len__(self):
+                return len(X)
+
+        loader = paddle.io.DataLoader(DS(), batch_size=16, shuffle=True,
+                                      num_workers=2)
+        seen = 0
+        for xb, yb in loader:
+            assert xb.shape == [16, 8]
+            assert yb.shape == [16]
+            seen += 1
+        assert seen == 4
+
+
+class TestCompiledTraining:
+    def test_trainstep_matches_eager(self):
+        X, y = make_blobs(n=64)
+        paddle.seed(5)
+        m1 = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        paddle.seed(5)
+        m2 = nn.Sequential(nn.Linear(8, 16), nn.Tanh(), nn.Linear(16, 4))
+        assert np.allclose(m1[0].weight.numpy(), m2[0].weight.numpy())
+
+        xb, yb = paddle.to_tensor(X), paddle.to_tensor(y)
+        o1 = optimizer.SGD(learning_rate=0.1, parameters=m1.parameters())
+        o2 = optimizer.SGD(learning_rate=0.1, parameters=m2.parameters())
+
+        # eager loop
+        losses_eager = []
+        for _ in range(5):
+            loss = F.cross_entropy(m1(xb), yb)
+            loss.backward()
+            o1.step()
+            o1.clear_grad()
+            losses_eager.append(float(loss))
+
+        # compiled loop
+        step = paddle.jit.TrainStep(m2, lambda out, lbl: F.cross_entropy(out, lbl), o2)
+        losses_jit = [float(step(xb, yb)) for _ in range(5)]
+        assert np.allclose(losses_eager, losses_jit, rtol=1e-4, atol=1e-5)
+        assert np.allclose(m1[0].weight.numpy(), m2[0].weight.numpy(),
+                           rtol=1e-4, atol=1e-5)
+
+    def test_to_static_forward_backward(self):
+        m = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        sm = paddle.jit.to_static(m)
+        x = paddle.randn([3, 4])
+        out_eager = m(x)
+        out_static = sm(x)
+        assert np.allclose(out_eager.numpy(), out_static.numpy(), rtol=1e-5)
+        # backward through compiled graph
+        loss = out_static.sum()
+        loss.backward()
+        assert m[0].weight.grad is not None
+
+    def test_batchnorm_buffers_update_under_jit(self):
+        m = nn.Sequential(nn.Conv2D(1, 2, 3, padding=1), nn.BatchNorm2D(2),
+                          nn.Flatten(), nn.Linear(2 * 4 * 4, 2))
+        opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+        step = paddle.jit.TrainStep(
+            m, lambda out, lbl: F.cross_entropy(out, lbl), opt)
+        x = paddle.randn([4, 1, 4, 4])
+        ybl = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+        bn = m[1]
+        before = bn._mean.numpy().copy()
+        step(x, ybl)
+        after = bn._mean.numpy()
+        assert not np.allclose(before, after)
+
+
+class TestResNetSlice:
+    def test_resnet18_train_step(self):
+        paddle.seed(0)
+        m = paddle.vision.models.resnet18(num_classes=4)
+        opt = optimizer.SGD(learning_rate=0.01, parameters=m.parameters())
+        x = paddle.randn([2, 3, 32, 32])
+        yb = paddle.to_tensor(np.array([0, 1], np.int64))
+        out = m(x)
+        assert out.shape == [2, 4]
+        loss = F.cross_entropy(out, yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        out2 = m(x)
+        loss2 = F.cross_entropy(out2, yb)
+        assert float(loss2) < float(loss) + 1.0  # sanity: finite + roughly sane
+        assert np.isfinite(float(loss2))
