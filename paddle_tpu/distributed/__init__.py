@@ -27,6 +27,7 @@ from .collective import (  # noqa: F401
 )
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from .ring_attention import ring_attention, ring_attention_local  # noqa: F401
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "DataParallel",

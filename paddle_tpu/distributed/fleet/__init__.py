@@ -18,12 +18,19 @@ from .mpu import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
+from .pipeline import LayerDesc, PipelineLayer, pipeline_apply  # noqa: F401
+from .sequence_parallel_utils import (  # noqa: F401
+    ScatterOp, GatherOp, ColumnSequenceParallelLinear,
+    RowSequenceParallelLinear,
+)
 
 __all__ = ["init", "fleet", "DistributedStrategy", "HybridCommunicateGroup",
            "get_hybrid_communicate_group", "distributed_model",
            "distributed_optimizer", "recompute", "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
-           "ParallelCrossEntropy"]
+           "ParallelCrossEntropy", "LayerDesc", "PipelineLayer",
+           "pipeline_apply", "ScatterOp", "GatherOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]
 
 _hcg: Optional[HybridCommunicateGroup] = None
 _strategy: Optional[DistributedStrategy] = None

@@ -1,0 +1,140 @@
+"""Pipeline parallelism as an in-program SPMD schedule.
+
+Replaces the reference's actor/schedule machinery — PipelineParallel 1F1B
+(/root/reference/python/paddle/distributed/fleet/meta_parallel/
+pipeline_parallel.py:440), P2P tensor protocol (pp_utils/
+p2p_communication.py), and the C++ FleetExecutor interceptor runtime
+(/root/reference/paddle/fluid/distributed/fleet_executor/) — with a single
+jitted collective program: every pp rank runs the same code, activations
+move between neighbor stages via ppermute (ICI neighbor links), and the
+backward schedule falls out of autodiff through the loop (reverse
+ppermute), so no send/recv protocol, no interceptors, no message bus.
+
+Design (homogeneous stages, the transformer case):
+- stage parameters are stacked on a leading [n_stages, ...] axis sharded
+  over 'pp' — each device holds exactly its stage's slice;
+- the microbatch loop runs n_micro + n_stages - 1 ticks; stage 0 feeds a
+  fresh microbatch each tick, the last stage emits a finished microbatch
+  each tick after the fill phase (GPipe schedule; per-tick work is one
+  microbatch per stage, so steady-state utilization matches 1F1B — the
+  1F1B advantage on GPUs is weight-memory timing, which XLA's liveness
+  analysis handles for us).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["LayerDesc", "PipelineLayer", "pipeline_apply"]
+
+
+class LayerDesc:
+    """Declarative layer description for pipeline segmentation (parity:
+    /root/reference/python/paddle/distributed/fleet/meta_parallel/
+    parallel_layers/pp_layers.py:56)."""
+
+    def __init__(self, layer_cls, *args, **kwargs):
+        self.layer_cls = layer_cls
+        self.args = args
+        self.kwargs = kwargs
+
+    def build_layer(self):
+        return self.layer_cls(*self.args, **self.kwargs)
+
+
+class PipelineLayer:
+    """Container that segments a LayerDesc list into pp stages (parity:
+    pp_layers.py:237 PipelineLayer). Builds all layers (single-controller:
+    every process holds the program; per-stage placement happens via the
+    stacked-parameter sharding in pipeline_apply)."""
+
+    def __init__(self, layers: List[LayerDesc], num_stages: int,
+                 loss_fn: Optional[Callable] = None, topology=None,
+                 seg_method: str = "uniform"):
+        self.descs = layers
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        n = len(layers)
+        per = n // num_stages
+        assert per * num_stages == n, \
+            f"{n} layers not divisible into {num_stages} stages"
+        self.stage_layers = [
+            [d.build_layer() for d in layers[i * per:(i + 1) * per]]
+            for i in range(num_stages)
+        ]
+
+    def parameters(self):
+        ps = []
+        for stage in self.stage_layers:
+            for l in stage:
+                ps.extend(l.parameters())
+        return ps
+
+
+def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
+                   mesh, axis: str = "pp", extra_spec=None):
+    """Run a GPipe schedule over the `axis` mesh dimension.
+
+    stage_fn(params_slice, x) -> y   (same signature for every stage)
+    stacked_params: pytree whose leaves have leading dim n_stages (sharded
+      over `axis` outside or resharded here)
+    x_microbatches: [n_micro, ...] microbatched input of stage 0
+    Returns [n_micro, ...] outputs of the last stage (valid on every rank
+    — they're psum-broadcast so the loss is computable anywhere).
+    """
+    jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
+    n_stages = jmesh.shape[axis]
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(axis), stacked_params)
+    x_spec = P()  # microbatches replicated into the loop; stage0 consumes
+
+    def body(params, xs):
+        # params: leaves [1, ...] (this stage's slice) → squeeze
+        p_local = jax.tree_util.tree_map(lambda a: a[0], params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        n_ticks = n_micro + n_stages - 1
+        perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
+
+        y0_shape = jax.eval_shape(lambda p, x: stage_fn(p, x), p_local,
+                                  xs[0])
+
+        def tick(t, carry):
+            prev_out, outputs = carry
+            # activation arriving from the previous stage
+            incoming = jax.lax.ppermute(prev_out, axis, perm_fwd)
+            my_in = jnp.where(
+                stage == 0,
+                xs[jnp.minimum(t, n_micro - 1)].astype(incoming.dtype),
+                incoming)
+            out = stage_fn(p_local, my_in)
+            # last stage stores finished microbatch m = t - (n_stages - 1)
+            m = t - (n_stages - 1)
+            outputs = jax.lax.cond(
+                m >= 0,
+                lambda o: o.at[jnp.maximum(m, 0)].set(
+                    jnp.where(stage == n_stages - 1, out,
+                              o[jnp.maximum(m, 0)])),
+                lambda o: o,
+                outputs)
+            return out, outputs
+
+        init_out = jnp.zeros(y0_shape.shape, y0_shape.dtype)
+        outputs0 = jnp.zeros((n_micro,) + tuple(y0_shape.shape),
+                             y0_shape.dtype)
+        _, outputs = jax.lax.fori_loop(0, n_ticks, tick,
+                                       (init_out, outputs0))
+        # broadcast finished outputs from the last stage to all pp ranks
+        mask = (stage == n_stages - 1).astype(outputs.dtype)
+        outputs = jax.lax.psum(outputs * mask, axis)
+        return outputs
+
+    f = shard_map(body, mesh=jmesh,
+                  in_specs=(param_specs, x_spec), out_specs=P(),
+                  check_vma=False)
+    return f(stacked_params, x_microbatches)

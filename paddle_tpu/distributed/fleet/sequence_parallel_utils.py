@@ -1,0 +1,115 @@
+"""Megatron-style sequence parallelism (parity:
+/root/reference/python/paddle/distributed/fleet/utils/
+sequence_parallel_utils.py:85-340 — ScatterOp/GatherOp/AllGatherOp/
+ReduceScatterOp PyLayers + Column/RowSequenceParallelLinear).
+
+TPU-native: the scatter/gather PyLayers become sharding transitions on the
+sequence dim; the all-gather before the column matmul and the
+reduce-scatter after the row matmul are GSPMD-inserted by constraining
+activations to [seq→mp-sharded] outside the pair and unsharded inside.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...framework.core import Tensor, apply
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer.layers import Layer
+from .mpu import _annotate_param, _constrain, _get_mesh
+
+__all__ = ["ScatterOp", "GatherOp", "ColumnSequenceParallelLinear",
+           "RowSequenceParallelLinear", "mark_as_sequence_parallel_parameter"]
+
+
+def _seq_spec(ndim, axis="mp", seq_dim=1):
+    spec = [None] * ndim
+    spec[seq_dim] = axis
+    return spec
+
+
+def ScatterOp(x, seq_dim=1):
+    """Split the sequence dim across mp ranks (reshard, not a PyLayer)."""
+    mesh = _get_mesh()
+    if mesh is None or mesh.get_dim_size("mp") <= 1:
+        return x
+    return _constrain(x, mesh, _seq_spec(x.ndim, "mp", seq_dim))
+
+
+def GatherOp(x, seq_dim=1):
+    """Re-replicate the sequence dim (all-gather under GSPMD)."""
+    mesh = _get_mesh()
+    if mesh is None or mesh.get_dim_size("mp") <= 1:
+        return x
+    return _constrain(x, mesh, [None] * x.ndim)
+
+
+AllGatherOp = GatherOp
+ReduceScatterOp = ScatterOp
+
+
+def mark_as_sequence_parallel_parameter(param):
+    # Parameter has a __dict__ (no __slots__ of its own); plain Tensors
+    # with strict slots can't carry the mark — that's a usage error
+    try:
+        param.sequence_parallel = True
+    except AttributeError:
+        raise TypeError(
+            "mark_as_sequence_parallel_parameter expects a Parameter")
+    return param
+
+
+class ColumnSequenceParallelLinear(Layer):
+    """Input arrives sequence-sharded; GSPMD all-gathers it for the
+    column-parallel matmul; output stays feature-sharded."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=False, name=None):
+        super().__init__()
+        self.mesh = _get_mesh()
+        self.gather_output = gather_output
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias else None
+        if self.mesh is not None and self.mesh.get_dim_size("mp") > 1:
+            _annotate_param(self.weight, self.mesh, 1, "mp")
+            if self.bias is not None:
+                _annotate_param(self.bias, self.mesh, 0, "mp")
+
+    def forward(self, x):
+        out = F.linear(x, self.weight, self.bias)
+        if self.mesh is not None and self.mesh.get_dim_size("mp") > 1:
+            spec = [None] * (out.ndim - 1) + ([None] if self.gather_output
+                                              else ["mp"])
+            out = _constrain(out, self.mesh, spec)
+        return out
+
+
+class RowSequenceParallelLinear(Layer):
+    """Input is feature-sharded; output is reduce-scattered onto the
+    sequence dim (one fused collective under GSPMD instead of
+    all-reduce + scatter)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, name=None):
+        super().__init__()
+        self.mesh = _get_mesh()
+        self.weight = self.create_parameter(
+            (in_features, out_features), attr=weight_attr,
+            default_initializer=I.XavierUniform())
+        self.bias = self.create_parameter((out_features,), is_bias=True) \
+            if has_bias else None
+        if self.mesh is not None and self.mesh.get_dim_size("mp") > 1:
+            _annotate_param(self.weight, self.mesh, 0, "mp")
+
+    def forward(self, x):
+        if self.mesh is None or self.mesh.get_dim_size("mp") <= 1:
+            return F.linear(x, self.weight, self.bias)
+        out = F.linear(x, self.weight, None)
+        # reduce-scatter onto the sequence dim
+        out = _constrain(out, self.mesh, _seq_spec(out.ndim, "mp", 1))
+        if self.bias is not None:
+            out = out + self.bias
+        return out

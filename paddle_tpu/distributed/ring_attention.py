@@ -1,0 +1,111 @@
+"""Ring attention: blockwise causal attention with the sequence sharded
+over a mesh axis, KV blocks rotated around the ring via ppermute.
+
+Fills the reference's long-context gap (SURVEY.md §5.7: Paddle has only
+Megatron-SP and an early segment-parallel mode — no ring attention). This
+is the TPU-native design: the ring rides ICI neighbor links, compute on
+the current KV block overlaps the DMA of the next one (XLA schedules the
+ppermute async), and the online-softmax merge makes the math exact.
+
+Used inside shard_map / jitted programs; also exposed as an eager Tensor
+op through paddle_tpu.nn.functional.ring_attention.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+_NEG_INF = -1e30
+
+__all__ = ["ring_attention_local", "ring_attention"]
+
+
+def _block_attend(q, k, v, scale, mask):
+    """One (q_chunk × kv_chunk) blockwise attention partial.
+
+    q [b, sq, h, d]; k/v [b, sk, h, d]; mask broadcastable [sq, sk] bool or
+    None. Returns partial (acc [b,h,sq,d] f32, m [b,h,sq], l [b,h,sq])."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
+    return acc, m, l
+
+
+def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
+                         scale: Optional[float] = None):
+    """Per-shard ring attention body (call inside shard_map).
+
+    q/k/v: the LOCAL sequence chunk [b, s_local, h, d]; the global sequence
+    is the concatenation over `axis_name` in axis-index order.
+    Returns the local output chunk [b, s_local, h, d].
+    """
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    kv_heads = k.shape[2]
+    if kv_heads != h:
+        k = jnp.repeat(k, h // kv_heads, axis=2)
+        v = jnp.repeat(v, h // kv_heads, axis=2)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    causal_mask = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]) \
+        if causal else None
+
+    def step(t, carry):
+        acc, m, l, k_cur, v_cur = carry
+        src = (my - t) % n  # which chunk of the global sequence we hold now
+
+        if causal:
+            # chunk relation selects ONE mask: src < my → all-visible;
+            # src == my → causal inside; src > my → fully masked
+            mask = jnp.where(src < my, jnp.ones_like(causal_mask),
+                             jnp.where(src == my, causal_mask,
+                                       jnp.zeros_like(causal_mask)))
+            a_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, mask)
+        else:
+            a_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, None)
+
+        m_new = jnp.maximum(m, m_blk)
+        # guard both corrections against exp(-inf - -inf)
+        c_old = jnp.exp(jnp.maximum(m - m_new, -1e30))
+        c_blk = jnp.exp(jnp.maximum(m_blk - m_new, -1e30))
+        acc = acc * c_old[..., None] + a_blk * c_blk[..., None]
+        l = l * c_old + l_blk * c_blk
+        m = m_new
+
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return acc, m, l, k_nxt, v_nxt
+
+    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
+    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((b, h, s), jnp.float32)
+    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = True,
+                   scale: Optional[float] = None):
+    """Whole-array entry: q/k/v [b, S_global, h, d] (sharded or not) →
+    output with the sequence dim sharded over `axis`."""
+    jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
+    spec = P(None, axis, None, None)
+    f = shard_map(
+        partial(ring_attention_local, axis_name=axis, causal=causal,
+                scale=scale),
+        mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return f(q, k, v)

@@ -460,6 +460,14 @@ class Tensor:
         if tuple(arr.shape) != tuple(self._value.shape):
             raise ValueError(
                 f"set_value shape mismatch: {arr.shape} vs {self._value.shape}")
+        # keep the destination's sharding (checkpoint load into DistTensor)
+        old_sharding = getattr(self._value, "sharding", None)
+        if old_sharding is not None and not self._is_traced() and \
+                not isinstance(arr, jax.core.Tracer):
+            try:
+                arr = jax.device_put(arr, old_sharding)
+            except Exception:
+                pass
         self._replace(arr)
 
     def copy_(self, other):

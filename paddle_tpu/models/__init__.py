@@ -1,0 +1,5 @@
+"""paddle_tpu.models — flagship model zoo (BASELINE.json configs)."""
+from .llama import (  # noqa: F401
+    LlamaConfig, LlamaForCausalLM, LlamaModel, llama_tiny, llama_small,
+    llama_3_8b,
+)

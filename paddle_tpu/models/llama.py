@@ -1,0 +1,278 @@
+"""Llama-family decoder-only transformer — the framework's flagship model.
+
+Capability parity target: PaddleNLP's Llama stack trained with Fleet 4D
+parallel (reference framework side: fleet hybrid topology
+/root/reference/python/paddle/distributed/fleet/base/topology.py:174, TP
+layers /root/reference/python/paddle/distributed/fleet/layers/mpu/
+mp_layers.py, fused rope/rms incubate ops).
+
+TPU-native design:
+- RMSNorm + rotary + GQA attention via ops.flash_attention (Pallas on TPU)
+- SwiGLU MLP
+- tensor parallel via Column/RowParallelLinear + VocabParallelEmbedding
+  when a fleet mesh with mp_degree > 1 is active
+- FSDP/dp are placement recipes applied by fleet.distributed_model
+- bf16 weights with f32 master copies in the optimizer (multi_precision)
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply
+from .. import nn
+from ..nn import functional as F
+from ..ops.rope import build_rope_cache, rope_reference
+
+__all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "llama_tiny",
+           "llama_small", "llama_3_8b"]
+
+
+@dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: int = 32
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    dtype: str = "float32"
+    use_recompute: bool = False
+    # parallelism knobs (consumed when a fleet mesh is active)
+    tensor_parallel: bool = False
+    sequence_parallel: bool = False
+
+
+def _mp_active() -> bool:
+    from ..distributed.fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+class LlamaMLP(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(dtype=cfg.dtype)
+        if cfg.tensor_parallel and _mp_active():
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            self.gate_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.up_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size, has_bias=False,
+                gather_output=False)
+            self.down_proj = RowParallelLinear(
+                cfg.intermediate_size, cfg.hidden_size, has_bias=False,
+                input_is_parallel=True)
+        else:
+            self.gate_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                       bias_attr=False)
+            self.up_proj = nn.Linear(cfg.hidden_size, cfg.intermediate_size,
+                                     bias_attr=False)
+            self.down_proj = nn.Linear(cfg.intermediate_size, cfg.hidden_size,
+                                       bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) * self.up_proj(x))
+
+
+class LlamaAttention(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.num_heads = cfg.num_attention_heads
+        self.num_kv_heads = cfg.num_key_value_heads
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.hidden_size = cfg.hidden_size
+        q_out = cfg.hidden_size
+        kv_out = self.num_kv_heads * self.head_dim
+        self._tp = cfg.tensor_parallel and _mp_active()
+        if self._tp:
+            # heads shard over mp: q/k/v stay feature-sharded
+            # (gather_output=False), attention runs on the local heads, and
+            # o_proj's row-parallel matmul reduces — matching the
+            # reference's mp_layers head partitioning.
+            from ..distributed.fleet import (ColumnParallelLinear,
+                                             RowParallelLinear)
+            from ..distributed.fleet.mpu import _get_mesh
+            mesh = _get_mesh()
+            mp = mesh.get_dim_size("mp")
+            if self.num_kv_heads % mp or self.num_heads % mp:
+                raise ValueError(
+                    f"num_heads {self.num_heads} / num_kv_heads "
+                    f"{self.num_kv_heads} must divide mp degree {mp}")
+            self.q_proj = ColumnParallelLinear(cfg.hidden_size, q_out,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.k_proj = ColumnParallelLinear(cfg.hidden_size, kv_out,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.v_proj = ColumnParallelLinear(cfg.hidden_size, kv_out,
+                                               has_bias=False,
+                                               gather_output=False)
+            self.o_proj = RowParallelLinear(q_out, cfg.hidden_size,
+                                            has_bias=False,
+                                            input_is_parallel=True)
+        else:
+            self.q_proj = nn.Linear(cfg.hidden_size, q_out, bias_attr=False)
+            self.k_proj = nn.Linear(cfg.hidden_size, kv_out, bias_attr=False)
+            self.v_proj = nn.Linear(cfg.hidden_size, kv_out, bias_attr=False)
+            self.o_proj = nn.Linear(q_out, cfg.hidden_size, bias_attr=False)
+        self.rope_theta = cfg.rope_theta
+
+    def forward(self, x, rope_cos=None, rope_sin=None):
+        b, s = x.shape[0], x.shape[1]
+        q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
+        k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        v = self.v_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
+        if self._tp:
+            # keep the head dim sharded over mp through the reshape
+            from ..distributed.fleet.mpu import _constrain, _get_mesh
+            mesh = _get_mesh()
+            head_spec = [None, None, "mp", None]
+            q = _constrain(q, mesh, head_spec)
+            k = _constrain(k, mesh, head_spec)
+            v = _constrain(v, mesh, head_spec)
+
+        # rotary embedding (fused-rope parity) applied inside one taped op
+        def rope_fn(qa, ka):
+            cos, sin = build_rope_cache(s, self.head_dim, self.rope_theta,
+                                        jnp.float32)
+            qo = rope_reference(qa, cos.astype(qa.dtype), sin.astype(qa.dtype))
+            ko = rope_reference(ka, cos.astype(ka.dtype), sin.astype(ka.dtype))
+            return qo, ko
+        q, k = apply("fused_rope", rope_fn, q, k)
+
+        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+        out = out.reshape([b, s, self.num_heads * self.head_dim])
+        if self._tp:
+            from ..distributed.fleet.mpu import _constrain, _get_mesh
+            out = _constrain(out, _get_mesh(), [None, None, "mp"])
+        return self.o_proj(out)
+
+
+class LlamaDecoderLayer(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.input_layernorm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                                          dtype=cfg.dtype)
+        self.self_attn = LlamaAttention(cfg)
+        self.post_attention_layernorm = nn.RMSNorm(cfg.hidden_size,
+                                                   cfg.rms_norm_eps,
+                                                   dtype=cfg.dtype)
+        self.mlp = LlamaMLP(cfg)
+        self.use_recompute = cfg.use_recompute
+
+    def _block(self, x):
+        h = x + self.self_attn(self.input_layernorm(x))
+        return h + self.mlp(self.post_attention_layernorm(h))
+
+    def forward(self, x):
+        if self.use_recompute:
+            from ..distributed.fleet import recompute
+            return recompute(_LayerFn(self), x)
+        return self._block(x)
+
+
+class _LayerFn:
+    """Adapter giving recompute() access to the layer's parameters."""
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def parameters(self):
+        return self.layer.parameters()
+
+    def __call__(self, x):
+        return self.layer._block(x)
+
+
+class LlamaModel(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        if cfg.tensor_parallel and _mp_active():
+            from ..distributed.fleet import VocabParallelEmbedding
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = nn.Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.layers = nn.LayerList(
+            [LlamaDecoderLayer(cfg) for _ in range(cfg.num_hidden_layers)])
+        self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
+                               dtype=cfg.dtype)
+
+    def forward(self, input_ids):
+        h = self.embed_tokens(input_ids)
+        if self.cfg.dtype != "float32":
+            h = h.astype(self.cfg.dtype)
+        for layer in self.layers:
+            h = layer(h)
+        return self.norm(h)
+
+
+class LlamaForCausalLM(nn.Layer):
+    def __init__(self, cfg: LlamaConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        self.model = LlamaModel(cfg)
+        if cfg.tie_word_embeddings:
+            self.lm_head = None
+        elif cfg.tensor_parallel and _mp_active():
+            from ..distributed.fleet import ColumnParallelLinear
+            self.lm_head = ColumnParallelLinear(
+                cfg.hidden_size, cfg.vocab_size, has_bias=False,
+                gather_output=True)
+        else:
+            self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
+                                     bias_attr=False)
+
+    def forward(self, input_ids):
+        h = self.model(input_ids)
+        if self.lm_head is None:
+            from ..tensor.linalg import matmul
+            logits = matmul(h, self.model.embed_tokens.weight,
+                            transpose_y=True)
+        else:
+            logits = self.lm_head(h)
+        return logits
+
+    def loss(self, logits, labels):
+        """Shifted causal-LM cross entropy."""
+        from ..tensor.manipulation import reshape
+        v = logits.shape[-1]
+        shift_logits = logits[:, :-1, :].reshape([-1, v])
+        shift_labels = labels[:, 1:].reshape([-1])
+        return F.cross_entropy(shift_logits, shift_labels)
+
+    def num_params(self) -> int:
+        return sum(p.size for p in self.parameters())
+
+
+def llama_tiny(**kw) -> LlamaConfig:
+    return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
+                       num_hidden_layers=2, num_attention_heads=4,
+                       num_key_value_heads=2, max_position_embeddings=256,
+                       **kw)
+
+
+def llama_small(**kw) -> LlamaConfig:
+    """~0.5B bench config sized for a single v5e chip."""
+    return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                       intermediate_size=5632, num_hidden_layers=8,
+                       num_attention_heads=16, num_key_value_heads=8,
+                       max_position_embeddings=2048, **kw)
+
+
+def llama_3_8b(**kw) -> LlamaConfig:
+    return LlamaConfig(vocab_size=128256, hidden_size=4096,
+                       intermediate_size=14336, num_hidden_layers=32,
+                       num_attention_heads=32, num_key_value_heads=8,
+                       max_position_embeddings=8192, rope_theta=500000.0,
+                       **kw)

@@ -55,31 +55,34 @@ def flash_attention_reference(q, k, v, attn_mask=None, causal=False,
     return _sdpa_core(q, k, v, attn_mask, causal, scale)
 
 
-def _use_pallas(q) -> bool:
-    try:
-        dev = q.devices() if hasattr(q, "devices") else None
-        if dev is None:
-            return False
-        return any(d.platform not in ("cpu",) for d in dev)
-    except Exception:
-        # traced: decide by default backend
-        return jax.default_backend() not in ("cpu",)
+def _pick_block(seq: int):
+    for blk in (512, 256, 128):
+        if seq % blk == 0:
+            return blk
+    return None
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, dropout=0.0,
                     scale=None, return_softmax=False):
     """Differentiable flash attention on raw arrays.
 
-    On TPU backends dispatches to the Pallas kernel (with custom VJP); on
-    CPU falls back to the jnp reference. Both paths produce identical
-    numerics up to f32 accumulation order.
+    On TPU backends dispatches to the Pallas kernel (custom VJP) when
+    shapes qualify (no mask, seq divisible by a block size, head_dim MXU
+    friendly); otherwise the jnp reference (XLA still fuses well). Both
+    paths match numerically up to f32 accumulation order.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    if jax.default_backend() != "cpu" and attn_mask is None and q.shape[1] >= 512:
-        try:
+    from ..utils.flags import FLAGS
+    use_pallas = (getattr(FLAGS, "use_pallas_kernels", True)
+                  and jax.default_backend() not in ("cpu", "gpu")
+                  and attn_mask is None and dropout == 0.0
+                  and q.shape[-1] in (64, 128, 256)
+                  and q.shape[1] >= 512 and k.shape[1] >= 512)
+    if use_pallas:
+        bq = _pick_block(q.shape[1])
+        bk = _pick_block(k.shape[1])
+        if bq is not None and bk is not None:
             from .pallas.flash_attention import flash_attention_pallas
-            return flash_attention_pallas(q, k, v, causal=causal, scale=scale)
-        except Exception:
-            pass
+            return flash_attention_pallas(q, k, v, causal, scale, bq, bk)
     return _sdpa_core(q, k, v, attn_mask, causal, scale)

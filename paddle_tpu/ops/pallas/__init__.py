@@ -1,0 +1,2 @@
+"""Pallas TPU kernels (the reference's CUDA fusion inventory, TPU-native:
+/root/reference/paddle/phi/kernels/fusion/ + third_party/flashattn)."""

@@ -1,0 +1,307 @@
+"""Flash attention, Pallas TPU implementation (fwd + bwd).
+
+Replaces the reference's third_party/flashattn CUDA kernels
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu). Blocked
+online-softmax over KV tiles; LSE saved for the backward; causal masking
+with early loop exit. GQA handled by head-index mapping in the forward and
+group-summed dk/dv in the backward.
+
+Layout contract (paddle convention at the API): q/k/v [batch, seq, heads,
+head_dim]; kernels internally run [batch, heads, seq, head_dim]. head_dim
+should be a multiple of 128 for MXU efficiency (64 works, half-utilized).
+
+VMEM budget: K and V are held whole per (batch, kv-head) — fine up to
+seq*dim*2B*2 ≈ 8MB (seq 16k @ d=128 bf16). Longer sequences belong to ring
+attention (paddle_tpu.distributed.ring_attention) which shards seq over
+the mesh.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _interpret() -> bool:
+    # interpreter mode on non-TPU backends (CPU tests / numerics oracle)
+    return jax.default_backend() != "tpu" and not _on_tpu()
+
+
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform not in ("cpu", "gpu")
+    except Exception:
+        return False
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+_NEG_INF = -1e30
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
+                block_k, seq_k):
+    # block shapes: q [1, 1, bq, d]; k/v [1, 1, seq_k, d]
+    q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
+    bq = q.shape[0]
+    qi = pl.program_id(2)
+    q_offset = qi * bq
+
+    num_kv = pl.cdiv(seq_k, block_k)
+    if causal:
+        # only blocks whose start <= last query row
+        num_kv_run = jax.lax.div(q_offset + bq - 1, block_k) + 1
+    else:
+        num_kv_run = num_kv
+
+    def body(kj, carry):
+        acc, m_prev, l_prev = carry
+        k_blk = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)              # [bq, bk]
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1)                          # [bq]
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new[:, None])                      # [bq, bk]
+        corr = jnp.exp(m_prev - m_new)                       # [bq]
+        l_new = l_prev * corr + jnp.sum(p, axis=-1)
+        acc = acc * corr[:, None] + jax.lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l_new
+
+    d = q.shape[-1]
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, num_kv_run, body, (acc0, m0, l0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0, 0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0, 0, :, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
+    """q [b,h,sq,d]; k/v [b,hk,sk,d] → out [b,h,sq,d], lse [b,h,sq]."""
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    grid = (b, h, pl.cdiv(sq, bq))
+
+    kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
+                               block_k=bk, seq_k=sk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d),
+                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse[..., 0]
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale, causal, block_k, seq_k):
+    q = q_ref[0, 0].astype(jnp.float32)                     # [bq, d]
+    do = do_ref[0, 0].astype(jnp.float32)
+    lse = lse_ref[0, 0, :, 0]                               # [bq]
+    delta = delta_ref[0, 0, :, 0]                           # [bq]
+    bq = q.shape[0]
+    qi = pl.program_id(2)
+    q_offset = qi * bq
+
+    num_kv = pl.cdiv(seq_k, block_k)
+    if causal:
+        num_kv_run = jax.lax.div(q_offset + bq - 1, block_k) + 1
+    else:
+        num_kv_run = num_kv
+
+    def body(kj, dq):
+        k_blk = k_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(kj * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                        # [bq, bk]
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale               # [bq, bk]
+        return dq + jax.lax.dot_general(ds, k_blk, (((1,), (0,)), ((), ())),
+                                        preferred_element_type=jnp.float32)
+
+    dq0 = jnp.zeros_like(q)
+    dq = jax.lax.fori_loop(0, num_kv_run, body, dq0)
+    dq_ref[0, 0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+    k_blk = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    bk = k_blk.shape[0]
+    kj = pl.program_id(2)
+    k_offset = kj * bk
+
+    num_q = pl.cdiv(seq_q, block_q)
+    if causal:
+        # first q block that can see this k block
+        first_q = jax.lax.div(k_offset, block_q)
+    else:
+        first_q = 0
+
+    def body(qi, carry):
+        dk, dv = carry
+        q = q_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        do = do_ref[0, 0, pl.ds(qi * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        delta = delta_ref[0, 0, pl.ds(qi * block_q, block_q), 0]
+        s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(rows >= cols, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, None])                        # [bq, bk]
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = k_blk.shape[-1]
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
+    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
+    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+
+def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k):
+    """All [b,h,s,d] (kv already expanded to h heads). Returns dq,dk,dv."""
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)[..., None]                      # [b,h,sq,1]
+    lse4 = lse[..., None]                                    # [b,h,sq,1]
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_k=bk, seq_k=sk),
+        grid=(b, h, pl.cdiv(sq, bq)),
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda bi, hi, qi: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=bq, seq_q=sq),
+        grid=(b, h, pl.cdiv(sk, bk)),
+        in_specs=[
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
+            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+        ],
+        interpret=_interpret(),
+    )(q, k, v, do, lse4, delta)
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_pallas(q, k, v, causal=False, scale=None,
+                           block_q=DEFAULT_BLOCK_Q, block_k=DEFAULT_BLOCK_K):
+    """q/k/v: [batch, seq, heads, head_dim] (kv heads may be fewer: GQA)."""
+    out, _ = _fa_fwd(q, k, v, causal, scale, block_q, block_k)
+    return out
+
+
+def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)   # [b,h,s,d]
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t, lse = _flash_fwd(qt, kt, vt, causal, scale, block_q, block_k)
+    out = jnp.swapaxes(out_t, 1, 2)
+    return out, (q, k, v, out, lse)
+
+
+def _fa_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    h = q.shape[2]
+    hk = k.shape[2]
+    group = h // hk
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    if group > 1:  # expand kv heads for the backward kernels
+        kt = jnp.repeat(kt, group, axis=1)
+        vt = jnp.repeat(vt, group, axis=1)
+    out_t = jnp.swapaxes(out, 1, 2)
+    do_t = jnp.swapaxes(g, 1, 2)
+    dq_t, dk_t, dv_t = _flash_bwd(qt, kt, vt, out_t, lse, do_t, causal,
+                                  scale, block_q, block_k)
+    if group > 1:  # sum grads over each kv-head's query group
+        b, _, sk, d = dk_t.shape
+        dk_t = dk_t.reshape(b, hk, group, sk, d).sum(axis=2)
+        dv_t = dv_t.reshape(b, hk, group, sk, d).sum(axis=2)
+    dq = jnp.swapaxes(dq_t, 1, 2).astype(q.dtype)
+    dk = jnp.swapaxes(dk_t, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv_t, 1, 2).astype(v.dtype)
+    return dq, dk, dv
+
+
+flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
