@@ -32,6 +32,9 @@ from . import vision  # noqa: F401
 from . import distributed  # noqa: F401
 from . import ops  # noqa: F401
 from . import utils  # noqa: F401
+from . import metric  # noqa: F401
+from . import hapi  # noqa: F401
+from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 
 from .jit import to_static  # noqa: F401

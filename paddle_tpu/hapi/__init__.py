@@ -1,0 +1,13 @@
+"""paddle_tpu.hapi — high-level Model API (reference
+/root/reference/python/paddle/hapi/)."""
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback, CallbackList, EarlyStopping, LRScheduler, ModelCheckpoint,
+    ProgBarLogger, VisualDL,
+)
+from .model import Model  # noqa: F401
+from .model_summary import summary  # noqa: F401
+
+__all__ = ["Model", "summary", "callbacks", "Callback", "CallbackList",
+           "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
+           "EarlyStopping", "VisualDL"]

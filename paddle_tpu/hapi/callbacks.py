@@ -1,0 +1,287 @@
+"""hapi callbacks (reference /root/reference/python/paddle/hapi/callbacks.py:
+Callback:140, CallbackList:36, ProgBarLogger:253, ModelCheckpoint:550,
+LRScheduler:636, EarlyStopping:719, VisualDL:883).
+
+Same hook protocol as the reference; bodies are host-side Python, so nothing
+here touches the jit path.
+"""
+from __future__ import annotations
+
+import numbers
+import os
+import warnings
+from typing import List, Optional
+
+import numpy as np
+
+from .progressbar import ProgressBar
+
+__all__ = [
+    "Callback", "CallbackList", "ProgBarLogger", "ModelCheckpoint",
+    "LRScheduler", "EarlyStopping", "VisualDL", "config_callbacks",
+]
+
+
+class Callback:
+    """Base class: no-op hooks for every train/eval/predict event."""
+
+    def __init__(self):
+        self.model = None
+        self.params = {}
+
+    def set_params(self, params):
+        self.params = params
+
+    def set_model(self, model):
+        self.model = model
+
+    def on_train_begin(self, logs=None): pass
+    def on_train_end(self, logs=None): pass
+    def on_eval_begin(self, logs=None): pass
+    def on_eval_end(self, logs=None): pass
+    def on_predict_begin(self, logs=None): pass
+    def on_predict_end(self, logs=None): pass
+    def on_epoch_begin(self, epoch, logs=None): pass
+    def on_epoch_end(self, epoch, logs=None): pass
+    def on_train_batch_begin(self, step, logs=None): pass
+    def on_train_batch_end(self, step, logs=None): pass
+    def on_eval_batch_begin(self, step, logs=None): pass
+    def on_eval_batch_end(self, step, logs=None): pass
+    def on_predict_batch_begin(self, step, logs=None): pass
+    def on_predict_batch_end(self, step, logs=None): pass
+
+
+class CallbackList:
+    def __init__(self, callbacks: Optional[List[Callback]] = None):
+        self.callbacks = list(callbacks or [])
+
+    def append(self, cb):
+        self.callbacks.append(cb)
+
+    def set_params(self, params):
+        for c in self.callbacks:
+            c.set_params(params)
+
+    def set_model(self, model):
+        for c in self.callbacks:
+            c.set_model(model)
+
+    def _call(self, name, *args):
+        for c in self.callbacks:
+            getattr(c, name)(*args)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a: self._call(name, *a)
+        raise AttributeError(name)
+
+
+class ProgBarLogger(Callback):
+    """Batch/epoch progress logging (reference callbacks.py:253)."""
+
+    def __init__(self, log_freq=1, verbose=2):
+        super().__init__()
+        self.log_freq = log_freq
+        self.verbose = verbose
+
+    def on_train_begin(self, logs=None):
+        self.epochs = self.params.get("epochs")
+        self.steps = self.params.get("steps")
+        self._train_metrics = self.params.get("metrics", [])
+
+    def on_epoch_begin(self, epoch, logs=None):
+        if self.verbose and self.epochs:
+            print(f"Epoch {epoch + 1}/{self.epochs}")
+        self.progbar = ProgressBar(num=self.steps, verbose=self.verbose)
+        self.progbar.start()
+
+    def _values(self, logs):
+        out = []
+        for k in self._train_metrics:
+            if k in (logs or {}):
+                v = logs[k]
+                if isinstance(v, (list, tuple, np.ndarray)):
+                    v = float(np.asarray(v).reshape(-1)[0])
+                out.append((k, v))
+        return out
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.verbose and (step + 1) % self.log_freq == 0:
+            self.progbar.update(step + 1, self._values(logs))
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.verbose:
+            self.progbar.update(self.steps or 0, self._values(logs))
+
+    def on_eval_begin(self, logs=None):
+        self._eval_steps = (logs or {}).get("steps")
+        self._eval_metrics = (logs or {}).get("metrics", [])
+        self.eval_progbar = ProgressBar(num=self._eval_steps,
+                                        verbose=self.verbose)
+        if self.verbose:
+            print("Eval begin...")
+
+    def on_eval_batch_end(self, step, logs=None):
+        if self.verbose and (step + 1) % self.log_freq == 0:
+            vals = [(k, logs[k]) for k in self._eval_metrics
+                    if k in (logs or {})]
+            self.eval_progbar.update(step + 1, vals)
+
+    def on_eval_end(self, logs=None):
+        if self.verbose:
+            vals = [(k, v) for k, v in (logs or {}).items()
+                    if isinstance(v, (numbers.Number, list))]
+            print("Eval samples done - " + str(vals))
+
+
+class ModelCheckpoint(Callback):
+    """Periodic save of model+optimizer state (reference callbacks.py:550)."""
+
+    def __init__(self, save_freq=1, save_dir=None):
+        super().__init__()
+        self.save_freq = save_freq
+        self.save_dir = save_dir
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.model is not None and self.save_dir and \
+                epoch % self.save_freq == 0:
+            path = os.path.join(self.save_dir, str(epoch))
+            self.model.save(path)
+
+    def on_train_end(self, logs=None):
+        if self.model is not None and self.save_dir:
+            self.model.save(os.path.join(self.save_dir, "final"))
+
+
+class LRScheduler(Callback):
+    """Steps the optimizer's LRScheduler (reference callbacks.py:636)."""
+
+    def __init__(self, by_step=True, by_epoch=False):
+        super().__init__()
+        if by_step and by_epoch:
+            raise ValueError("by_step and by_epoch are mutually exclusive")
+        self.by_step = by_step
+        self.by_epoch = by_epoch
+
+    def _sched(self):
+        opt = getattr(self.model, "_optimizer", None)
+        lr = getattr(opt, "_learning_rate", None)
+        return lr if hasattr(lr, "step") else None
+
+    def on_train_batch_end(self, step, logs=None):
+        if self.by_step:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+    def on_epoch_end(self, epoch, logs=None):
+        if self.by_epoch:
+            s = self._sched()
+            if s is not None:
+                s.step()
+
+
+class EarlyStopping(Callback):
+    """Stop when a monitored metric stops improving (reference
+    callbacks.py:719)."""
+
+    def __init__(self, monitor="loss", mode="auto", patience=0, verbose=1,
+                 min_delta=0, baseline=None, save_best_model=True):
+        super().__init__()
+        self.monitor = monitor
+        self.patience = patience
+        self.verbose = verbose
+        self.baseline = baseline
+        self.min_delta = abs(min_delta)
+        self.wait_epoch = 0
+        self.best_weights = None
+        self.stopped_epoch = 0
+        self.save_best_model = save_best_model
+        if mode not in ("auto", "min", "max"):
+            warnings.warn(f"mode {mode} unknown, fallback to auto")
+            mode = "auto"
+        if mode == "min" or (mode == "auto" and "acc" not in self.monitor):
+            self.monitor_op = np.less
+        else:
+            self.monitor_op = np.greater
+        self.min_delta *= 1 if self.monitor_op == np.greater else -1
+
+    def on_train_begin(self, logs=None):
+        self.wait_epoch = 0
+        if self.baseline is not None:
+            self.best_value = self.baseline
+        else:
+            self.best_value = np.inf if self.monitor_op == np.less else -np.inf
+
+    def on_epoch_end(self, epoch, logs=None):
+        self.stopped_epoch = epoch
+
+    def on_eval_end(self, logs=None):
+        if logs is None or self.monitor not in logs:
+            warnings.warn(f"Monitor of EarlyStopping should be loss or "
+                          f"metric name; {self.monitor} missing.")
+            return
+        current = logs[self.monitor]
+        if isinstance(current, (list, tuple, np.ndarray)):
+            current = float(np.asarray(current).reshape(-1)[0])
+        if self.monitor_op(current - self.min_delta, self.best_value):
+            self.best_value = current
+            self.wait_epoch = 0
+            if self.save_best_model and self.model is not None and \
+                    getattr(self.model, "_save_dir", None):
+                self.model.save(os.path.join(self.model._save_dir,
+                                             "best_model"))
+        else:
+            self.wait_epoch += 1
+        if self.wait_epoch > self.patience:
+            self.model.stop_training = True
+            if self.verbose:
+                print(f"Epoch {self.stopped_epoch + 1}: early stopping.")
+
+
+class VisualDL(Callback):
+    """Scalar logging (reference callbacks.py:883). The VisualDL package is
+    not bundled; falls back to an in-memory record usable in tests."""
+
+    def __init__(self, log_dir):
+        super().__init__()
+        self.log_dir = log_dir
+        self.epochs = None
+        self.steps = None
+        self.records = []  # (tag, step, value) fallback record
+
+    def _log(self, logs, mode, step):
+        for k, v in (logs or {}).items():
+            if isinstance(v, (list, tuple, np.ndarray)):
+                try:
+                    v = float(np.asarray(v).reshape(-1)[0])
+                except Exception:
+                    continue
+            if isinstance(v, numbers.Number):
+                self.records.append((f"{mode}/{k}", step, float(v)))
+
+    def on_train_batch_end(self, step, logs=None):
+        self._log(logs, "train", step)
+
+    def on_eval_end(self, logs=None):
+        self._log(logs, "eval", 0)
+
+
+def config_callbacks(callbacks=None, model=None, batch_size=None, epochs=None,
+                     steps=None, log_freq=2, verbose=2, save_freq=1,
+                     save_dir=None, metrics=None, mode="train"):
+    cbks = list(callbacks or [])
+    if not any(isinstance(c, ProgBarLogger) for c in cbks) and verbose:
+        cbks.append(ProgBarLogger(log_freq, verbose=verbose))
+    if not any(isinstance(c, LRScheduler) for c in cbks):
+        cbks.append(LRScheduler())
+    if not any(isinstance(c, ModelCheckpoint) for c in cbks):
+        cbks.append(ModelCheckpoint(save_freq, save_dir))
+    cbk_list = CallbackList(cbks)
+    cbk_list.set_model(model)
+    params = {
+        "batch_size": batch_size, "epochs": epochs, "steps": steps,
+        "verbose": verbose, "metrics": metrics or [],
+    }
+    cbk_list.set_params(params)
+    return cbk_list

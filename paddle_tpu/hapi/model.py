@@ -1,0 +1,324 @@
+"""hapi.Model — Keras-style train/eval/predict loop (reference
+/root/reference/python/paddle/hapi/model.py:1054 `Model`, fit:1735,
+evaluate:1924, predict:2026, train_batch:1245, save:1378, load:1456).
+
+TPU-native redesign: the reference dispatches to DynamicGraphAdapter /
+StaticGraphAdapter; here there is a single eager path, with an optional
+jit-compiled fused train step (paddle_tpu.jit.TrainStep — fwd+bwd+opt in one
+donated XLA program) enabled by ``prepare(..., jit=True)``.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import warnings
+from typing import Any, Callable, List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from ..framework.io import save as _fw_save, load as _fw_load
+from ..metric import Metric
+from ..nn.layer.layers import Layer
+from .callbacks import config_callbacks
+from .model_summary import summary as _summary
+
+__all__ = ["Model"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _as_tensors(batch):
+    out = []
+    for b in _to_list(batch):
+        out.append(b if isinstance(b, Tensor) else to_tensor(np.asarray(b)))
+    return out
+
+
+class Model:
+    """High-level model wrapping a ``Layer`` with train/eval/predict loops."""
+
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self._train_step = None  # jit-fused step when prepare(jit=True)
+        self._use_jit_step = False
+        self.stop_training = False
+        self._save_dir = None
+
+    # ------------------------------------------------------------- prepare
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None, jit: bool = False):
+        self._optimizer = optimizer
+        if loss is not None and not (isinstance(loss, Layer) or
+                                     callable(loss)):
+            raise TypeError("loss must be a Layer or a callable")
+        self._loss = loss
+        self._metrics = _to_list(metrics)
+        for m in self._metrics:
+            if not isinstance(m, Metric):
+                raise TypeError(f"metric {m} is not a paddle_tpu.metric.Metric")
+        self._use_jit_step = jit
+        self._amp_configs = amp_configs
+        return self
+
+    # ------------------------------------------------------- batch methods
+    def _compute_loss(self, outputs: List[Tensor], labels: List[Tensor]):
+        if self._loss is None:
+            raise RuntimeError("call prepare(loss=...) before training")
+        return self._loss(*(outputs + labels))
+
+    def train_batch(self, inputs, labels=None, update=True):
+        """One optimization step; returns ([loss], [metric values...])."""
+        if self._optimizer is None:
+            raise RuntimeError("call prepare(optimizer=...) before training")
+        self.network.train()
+        inputs = _as_tensors(inputs)
+        labels = _as_tensors(labels)
+
+        if self._use_jit_step:
+            if not update:
+                raise NotImplementedError(
+                    "gradient accumulation (update=False) is not supported "
+                    "with prepare(jit=True); use eager mode or fold "
+                    "accumulation into the batch size")
+            if self._train_step is None:
+                from ..jit import TrainStep
+                loss_fn = (lambda out, *lbs:
+                           self._loss(*( _to_list(out) + list(lbs))))
+                self._train_step = TrainStep(self.network, loss_fn,
+                                             self._optimizer)
+            loss = self._train_step(inputs[0] if len(inputs) == 1 else inputs,
+                                    labels[0] if len(labels) == 1 else labels)
+            # fused step returns only the loss; per-batch metric outputs are
+            # not materialized (matches reference AMP-O2 fast path behavior)
+            return [float(loss)], []
+
+        outputs = _to_list(self.network(*inputs))
+        loss = self._compute_loss(outputs, labels)
+        loss.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metric_vals = self._update_metrics(outputs, labels)
+        return [float(loss)], metric_vals
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _as_tensors(inputs)
+        labels = _as_tensors(labels)
+        from ..framework.core import no_grad
+        with no_grad():
+            outputs = _to_list(self.network(*inputs))
+            losses = []
+            if self._loss is not None and labels:
+                losses = [float(self._compute_loss(outputs, labels))]
+        metric_vals = self._update_metrics(outputs, labels)
+        return losses, metric_vals
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = _as_tensors(inputs)
+        from ..framework.core import no_grad
+        with no_grad():
+            outputs = _to_list(self.network(*inputs))
+        return [o.numpy() for o in outputs]
+
+    def _update_metrics(self, outputs, labels):
+        vals = []
+        for m in self._metrics:
+            stats = m.compute(*(outputs + labels))
+            r = m.update(*_to_list(stats))
+            vals.append(r)
+        return vals
+
+    # --------------------------------------------------------------- loops
+    def _make_loader(self, data, batch_size, shuffle, num_workers, drop_last):
+        from ..io import DataLoader, Dataset
+        if data is None or hasattr(data, "__iter__") and not isinstance(
+                data, Dataset):
+            return data  # already a loader/iterable
+        return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                          num_workers=num_workers, drop_last=drop_last)
+
+    @staticmethod
+    def _split_batch(batch):
+        batch = list(batch) if isinstance(batch, (list, tuple)) else [batch]
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-1], batch[-1:]
+
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        loader = self._make_loader(train_data, batch_size, shuffle,
+                                   num_workers, drop_last)
+        eval_loader = self._make_loader(eval_data, batch_size, False,
+                                        num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        self._save_dir = save_dir
+        metric_names = ["loss"] + [n for m in self._metrics
+                                   for n in _to_list(m.name())]
+        cbks = config_callbacks(
+            callbacks, model=self, epochs=epochs, steps=steps,
+            log_freq=log_freq, save_freq=save_freq, save_dir=save_dir,
+            verbose=verbose, metrics=metric_names)
+
+        self.stop_training = False
+        cbks.on_train_begin()
+        global_step = 0
+        logs = {}
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(loader):
+                cbks.on_train_batch_begin(step)
+                ins, lbs = self._split_batch(batch)
+                update = (step + 1) % accumulate_grad_batches == 0
+                losses, metrics = self.train_batch(ins, lbs, update=update)
+                logs = {"loss": losses[0], "step": step,
+                        "batch_size": (ins[0].shape[0] if ins else None)}
+                for m, v in zip(self._metrics, metrics):
+                    for n, vv in zip(_to_list(m.name()), _to_list(v)):
+                        logs[n] = vv
+                cbks.on_train_batch_end(step, logs)
+                global_step += 1
+                if num_iters is not None and global_step >= num_iters:
+                    self.stop_training = True
+                if self.stop_training:
+                    break
+            # epoch-end logs use accumulated metric values
+            for m in self._metrics:
+                for n, vv in zip(_to_list(m.name()),
+                                 _to_list(m.accumulate())):
+                    logs[n] = vv
+            cbks.on_epoch_end(epoch, logs)
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                self.evaluate(eval_loader, batch_size=batch_size,
+                              verbose=verbose, callbacks=cbks,
+                              _inner=True)
+            if self.stop_training:
+                break
+        cbks.on_train_end(logs)
+        return self
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None,
+                 _inner=False):
+        loader = self._make_loader(eval_data, batch_size, False,
+                                   num_workers, False)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        metric_names = ["loss"] + [n for m in self._metrics
+                                   for n in _to_list(m.name())]
+        cbks = callbacks if _inner else config_callbacks(
+            callbacks, model=self, steps=steps, log_freq=log_freq,
+            verbose=verbose, metrics=metric_names, mode="eval")
+        for m in self._metrics:
+            m.reset()
+        cbks.on_eval_begin({"steps": steps, "metrics": metric_names})
+        logs = {}
+        seen = 0
+        loss_sum, loss_cnt = 0.0, 0
+        for step, batch in enumerate(loader):
+            cbks.on_eval_batch_begin(step)
+            ins, lbs = self._split_batch(batch)
+            losses, metrics = self.eval_batch(ins, lbs)
+            if losses:
+                loss_sum += losses[0]
+                loss_cnt += 1
+                logs["loss"] = losses[0]
+            cbks.on_eval_batch_end(step, logs)
+            seen += ins[0].shape[0] if ins else 0
+            if num_samples is not None and seen >= num_samples:
+                break
+        result = {}
+        if loss_cnt:
+            result["loss"] = loss_sum / loss_cnt
+        for m in self._metrics:
+            for n, vv in zip(_to_list(m.name()), _to_list(m.accumulate())):
+                result[n] = vv
+        cbks.on_eval_end(result)
+        return result
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = self._make_loader(test_data, batch_size, False,
+                                   num_workers, False)
+        cbks = config_callbacks(callbacks, model=self, verbose=verbose,
+                                metrics=[], mode="predict")
+        cbks.on_predict_begin()
+        outputs: List[List[np.ndarray]] = []
+        for step, batch in enumerate(loader):
+            cbks.on_predict_batch_begin(step)
+            ins, _ = self._split_batch(batch) if isinstance(
+                batch, (list, tuple)) and len(batch) > 1 else (_to_list(batch), [])
+            outs = self.predict_batch(ins)
+            outputs.append(outs)
+            cbks.on_predict_batch_end(step, {"step": step})
+        # transpose: list-of-batches-of-outputs -> per-output list
+        n_out = len(outputs[0]) if outputs else 0
+        result = [[b[i] for b in outputs] for i in range(n_out)]
+        if stack_outputs:
+            result = [np.concatenate(r, axis=0) for r in result]
+        cbks.on_predict_end()
+        return result
+
+    # ----------------------------------------------------------- save/load
+    def save(self, path: str, training: bool = True):
+        """Save `path + '.pdparams'` (+ `.pdopt` when training=True) — same
+        file layout as the reference (model.py:1378)."""
+        dirname = os.path.dirname(path)
+        if dirname:
+            os.makedirs(dirname, exist_ok=True)
+        _fw_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            _fw_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        param_path = path + ".pdparams" if not path.endswith(".pdparams") \
+            else path
+        state = _fw_load(param_path)
+        if skip_mismatch:
+            own = self.network.state_dict()
+            state = {k: v for k, v in state.items()
+                     if k in own and list(np.shape(v)) == list(own[k].shape)}
+        self.network.set_state_dict(state)
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(opt_path):
+            self._optimizer.set_state_dict(_fw_load(opt_path))
+        return self
+
+    # --------------------------------------------------------------- misc
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters(*args, **kwargs)
+
+    def summary(self, input_size=None, dtype=None):
+        return _summary(self.network, input_size or self._infer_input_size(),
+                        dtypes=dtype)
+
+    def _infer_input_size(self):
+        if self._inputs is None:
+            raise ValueError("summary needs input_size (no inputs spec set)")
+        specs = _to_list(self._inputs)
+        return [tuple(s.shape) for s in specs]

@@ -1,0 +1,95 @@
+"""Layer summary (reference /root/reference/python/paddle/hapi/
+model_summary.py `summary`): walks the layer tree with forward hooks on a
+dry-run forward, prints a table, returns {'total_params', 'trainable_params'}.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple, Union
+
+import numpy as np
+
+from ..framework.core import Tensor, to_tensor
+from ..nn.layer.layers import Layer
+
+__all__ = ["summary"]
+
+
+def _prod(shape):
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def summary(net: Layer, input_size=None, dtypes=None, input=None):
+    """Print a per-layer table of output shapes and param counts.
+
+    ``input_size``: tuple, list of tuples, or omitted when ``input``
+    (example tensors) is given. Batch dim may be -1 (mapped to 1).
+    """
+    if input is None:
+        if input_size is None:
+            raise ValueError("summary needs input_size or input")
+        sizes = [input_size] if isinstance(input_size, tuple) else \
+            list(input_size)
+        dts = dtypes if isinstance(dtypes, (list, tuple)) else \
+            [dtypes] * len(sizes)
+        input = []
+        for sz, dt in zip(sizes, dts):
+            shape = [1 if d in (-1, None) else int(d) for d in sz]
+            arr = np.zeros(shape, dtype=np.dtype(dt or "float32"))
+            input.append(to_tensor(arr))
+    elif isinstance(input, Tensor):
+        input = [input]
+
+    records: List[dict] = []
+    hooks = []
+
+    def register(layer: Layer, name: str):
+        def hook(l, inputs, outputs):
+            out = outputs[0] if isinstance(outputs, (tuple, list)) else outputs
+            shape = list(out.shape) if isinstance(out, Tensor) else []
+            n_params = sum(_prod(p.shape) for p in
+                           l.parameters(include_sublayers=False))
+            trainable = sum(
+                _prod(p.shape) for p in l.parameters(include_sublayers=False)
+                if not getattr(p, "stop_gradient", False))
+            records.append({"name": f"{type(l).__name__}-{len(records) + 1}",
+                            "output_shape": shape, "params": n_params,
+                            "trainable": trainable})
+        hooks.append(layer.register_forward_post_hook(hook))
+
+    for name, sub in net.named_sublayers():
+        if not sub._sub_layers:  # leaf layers only, like the reference
+            register(sub, name)
+    if not records and not net._sub_layers:
+        register(net, "net")
+
+    was_training = net.training
+    net.eval()
+    try:
+        net(*input)
+    finally:
+        for h in hooks:
+            h.remove()
+        if was_training:
+            net.train()
+
+    total = sum(_prod(p.shape) for p in net.parameters())
+    trainable = sum(_prod(p.shape) for p in net.parameters()
+                    if not getattr(p, "stop_gradient", False))
+
+    header = f"{'Layer (type)':<28}{'Output Shape':<24}{'Param #':<12}"
+    line = "-" * len(header)
+    print(line)
+    print(header)
+    print("=" * len(header))
+    for r in records:
+        print(f"{r['name']:<28}{str(r['output_shape']):<24}"
+              f"{r['params']:<12,}")
+    print("=" * len(header))
+    print(f"Total params: {total:,}")
+    print(f"Trainable params: {trainable:,}")
+    print(f"Non-trainable params: {total - trainable:,}")
+    print(line)
+    return {"total_params": total, "trainable_params": trainable}

@@ -1,0 +1,46 @@
+"""Terminal progress bar for hapi (reference
+/root/reference/python/paddle/hapi/progressbar.py)."""
+from __future__ import annotations
+
+import sys
+import time
+
+
+class ProgressBar:
+    def __init__(self, num=None, width=30, verbose=1, file=sys.stdout):
+        self._num = num
+        self._width = width
+        self._verbose = verbose
+        self.file = file
+        self._seen = 0
+        self._start = time.time()
+        self._last_update = 0.0
+
+    def start(self):
+        self._start = time.time()
+
+    def update(self, current_num, values=None):
+        self._seen = current_num
+        if self._verbose == 0:
+            return
+        now = time.time()
+        vals = " - ".join(
+            f"{k}: {v:.4f}" if isinstance(v, float) else f"{k}: {v}"
+            for k, v in (values or []))
+        if self._num:
+            frac = min(current_num / self._num, 1.0)
+            filled = int(frac * self._width)
+            bar = "=" * filled + ("." * (self._width - filled))
+            msg = f"\rstep {current_num}/{self._num} [{bar}] {vals}"
+        else:
+            msg = f"\rstep {current_num} {vals}"
+        # verbose=1: live same-line bar; verbose=2: one line per call
+        if self._verbose == 1:
+            self.file.write(msg)
+            if self._num and current_num >= self._num:
+                elapsed = now - self._start
+                self.file.write(f" - {elapsed:.1f}s\n")
+        else:
+            self.file.write(msg.lstrip("\r") + "\n")
+        self.file.flush()
+        self._last_update = now

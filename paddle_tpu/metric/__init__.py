@@ -1,0 +1,219 @@
+"""paddle_tpu.metric — metric parity with the reference
+(/root/reference/python/paddle/metric/metrics.py: Metric base, Accuracy,
+Precision, Recall, Auc).
+
+TPU-native note: ``compute`` runs in traced/jitted code and stays purely
+functional (returns arrays); ``update`` runs on host with concrete numpy
+values and mutates Python accumulator state — the same split the reference
+draws between graph-side compute and host-side bookkeeping.
+"""
+from __future__ import annotations
+
+import abc
+from typing import List, Optional, Sequence, Union
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc", "accuracy"]
+
+
+def _to_np(x) -> np.ndarray:
+    if isinstance(x, Tensor):
+        return x.numpy()
+    return np.asarray(x)
+
+
+class Metric(abc.ABC):
+    """Base metric (reference python/paddle/metric/metrics.py:47)."""
+
+    def __init__(self):
+        pass
+
+    @abc.abstractmethod
+    def reset(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def update(self, *args):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def accumulate(self):
+        raise NotImplementedError
+
+    @abc.abstractmethod
+    def name(self):
+        raise NotImplementedError
+
+    def compute(self, *args):
+        """Optional graph-side pre-processing: maps (pred, label) to the
+        statistics ``update`` consumes. Default: identity pass-through."""
+        return args
+
+
+class Accuracy(Metric):
+    """Top-k accuracy (reference metrics.py:178)."""
+
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        super().__init__()
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = _to_np(pred)
+        label_np = _to_np(label)
+        # top-maxk indices along the last dim
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            if label_np.shape[-1] == 1:  # [N, 1] class indices
+                label_np = label_np[..., 0]
+            else:  # one-hot / soft label
+                label_np = np.argmax(label_np, axis=-1)
+        correct = (idx == label_np[..., None]).astype(np.float32)
+        return correct
+
+    def update(self, correct, *args):
+        correct = _to_np(correct)
+        accs = []
+        num = int(np.prod(correct.shape[:-1]))
+        for i, k in enumerate(self.topk):
+            c = float(correct[..., :k].sum())
+            accs.append(c / max(num, 1))
+            self.total[i] += c
+            self.count[i] += num
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c > 0 else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return self._name
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    """Binary precision (reference metrics.py:327)."""
+
+    def __init__(self, name="precision", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fp += int(np.sum(pred_pos & (labels != 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        ap = self.tp + self.fp
+        return self.tp / ap if ap else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Recall(Metric):
+    """Binary recall (reference metrics.py:425)."""
+
+    def __init__(self, name="recall", *args, **kwargs):
+        super().__init__()
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds).reshape(-1)
+        labels = _to_np(labels).reshape(-1)
+        pred_pos = preds > 0.5
+        self.tp += int(np.sum(pred_pos & (labels == 1)))
+        self.fn += int(np.sum(~pred_pos & (labels == 1)))
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        p = self.tp + self.fn
+        return self.tp / p if p else 0.0
+
+    def name(self):
+        return self._name
+
+
+class Auc(Metric):
+    """ROC-AUC via thresholded confusion buckets (reference metrics.py:523)."""
+
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc",
+                 *args, **kwargs):
+        super().__init__()
+        self._curve = curve
+        self._num_thresholds = num_thresholds
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        preds = _to_np(preds)
+        labels = _to_np(labels).reshape(-1)
+        if preds.ndim == 2:  # [N, 2] class probs -> positive-class prob
+            pos_prob = preds[:, -1]
+        else:
+            pos_prob = preds.reshape(-1)
+        bins = np.minimum(
+            (pos_prob * self._num_thresholds).astype(np.int64),
+            self._num_thresholds)
+        pos = labels.astype(bool)
+        n = self._num_thresholds + 1
+        self._stat_pos += np.bincount(bins[pos], minlength=n)
+        self._stat_neg += np.bincount(bins[~pos], minlength=n)
+
+    def reset(self):
+        self._stat_pos = np.zeros(self._num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self._num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self._num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2.0
+            tot_pos, tot_neg = new_pos, new_neg
+        denom = tot_pos * tot_neg
+        return float(auc / denom) if denom else 0.0
+
+    def name(self):
+        return self._name
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Functional top-k accuracy (reference python/paddle/metric/metrics.py:
+    800 ``paddle.metric.accuracy``). Jit-safe: pure jnp/lax."""
+    import jax
+    import jax.numpy as jnp
+    from ..framework.core import to_tensor
+
+    x = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    y = label._value if isinstance(label, Tensor) else jnp.asarray(label)
+    if y.ndim == x.ndim:
+        if y.shape[-1] == 1:  # [N, 1] class indices
+            y = y[..., 0]
+        else:  # one-hot / soft label
+            y = jnp.argmax(y, axis=-1)
+    _, idx = jax.lax.top_k(x, k)
+    correct_mask = (idx == y[..., None]).any(axis=-1)
+    return to_tensor(jnp.mean(correct_mask.astype(jnp.float32)))

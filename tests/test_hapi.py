@@ -1,0 +1,142 @@
+"""hapi Model + metric tests (reference pattern: test/legacy_test/test_model.py
+style fit/evaluate/predict round-trips on tiny data)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.metric import Accuracy, Auc, Precision, Recall
+
+
+def make_blobs(n=128, d=8, classes=4, seed=0):
+    # class centers fixed across seeds so train/val share a distribution
+    centers = np.random.RandomState(7).randn(classes, d).astype(np.float32) * 3
+    rng = np.random.RandomState(seed)
+    X = np.concatenate([
+        centers[i] + rng.randn(n // classes, d).astype(np.float32)
+        for i in range(classes)])
+    y = np.concatenate([np.full(n // classes, i, np.int64)
+                        for i in range(classes)])
+    p = rng.permutation(n)
+    return X[p], y[p]
+
+
+class BlobDS(paddle.io.Dataset):
+    def __init__(self, n=128, seed=0):
+        self.X, self.y = make_blobs(n=n, seed=seed)
+
+    def __getitem__(self, i):
+        return self.X[i], self.y[i]
+
+    def __len__(self):
+        return len(self.X)
+
+
+def mlp():
+    return nn.Sequential(nn.Linear(8, 32), nn.ReLU(), nn.Linear(32, 4))
+
+
+class TestMetrics:
+    def test_accuracy_topk(self):
+        m = Accuracy(topk=(1, 2))
+        pred = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        label = np.array([1, 1], np.int64)
+        m.update(m.compute(pred, label))
+        top1, top2 = m.accumulate()
+        assert top1 == pytest.approx(0.5)
+        assert top2 == pytest.approx(1.0)
+        assert m.name() == ["acc_top1", "acc_top2"]
+
+    def test_accuracy_column_label(self):
+        # [N, 1] labels are class indices, not one-hot (paddle convention)
+        m = Accuracy()
+        pred = np.array([[0.1, 0.9], [0.8, 0.2]], np.float32)
+        label = np.array([[1], [1]], np.int64)
+        m.update(m.compute(pred, label))
+        assert m.accumulate() == pytest.approx(0.5)
+
+    def test_precision_recall(self):
+        p, r = Precision(), Recall()
+        preds = np.array([0.9, 0.8, 0.2, 0.6])
+        labels = np.array([1, 0, 1, 1])
+        p.update(preds, labels)
+        r.update(preds, labels)
+        assert p.accumulate() == pytest.approx(2 / 3)
+        assert r.accumulate() == pytest.approx(2 / 3)
+
+    def test_auc_perfect(self):
+        auc = Auc()
+        preds = np.stack([1 - np.linspace(0, 1, 10),
+                          np.linspace(0, 1, 10)], axis=1)
+        labels = (np.linspace(0, 1, 10) > 0.5).astype(np.int64)
+        auc.update(preds, labels)
+        assert auc.accumulate() == pytest.approx(1.0)
+
+    def test_functional_accuracy(self):
+        pred = paddle.to_tensor(np.array([[0.1, 0.9], [0.8, 0.2]], np.float32))
+        label = paddle.to_tensor(np.array([1, 0], np.int64))
+        acc = paddle.metric.accuracy(pred, label, k=1)
+        assert float(acc) == pytest.approx(1.0)
+
+
+class TestModel:
+    def test_fit_evaluate_predict(self, tmp_path):
+        model = paddle.Model(mlp())
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=0.01)
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        train = BlobDS(n=128, seed=0)
+        val = BlobDS(n=64, seed=1)
+        model.fit(train, val, batch_size=32, epochs=3, verbose=0,
+                  save_dir=str(tmp_path / "ckpt"))
+        res = model.evaluate(val, batch_size=32, verbose=0)
+        assert res["acc"] > 0.8
+        preds = model.predict(val, batch_size=32, stack_outputs=True,
+                              verbose=0)
+        assert preds[0].shape == (64, 4)
+        # checkpoint files written
+        assert (tmp_path / "ckpt" / "final.pdparams").exists()
+
+    def test_save_load_roundtrip(self, tmp_path):
+        m1 = paddle.Model(mlp())
+        opt = paddle.optimizer.Adam(parameters=m1.parameters())
+        m1.prepare(opt, nn.CrossEntropyLoss())
+        path = str(tmp_path / "m")
+        m1.save(path)
+        m2 = paddle.Model(mlp())
+        m2.prepare(paddle.optimizer.Adam(parameters=m2.parameters()),
+                   nn.CrossEntropyLoss())
+        m2.load(path)
+        x = paddle.to_tensor(np.random.randn(4, 8).astype(np.float32))
+        np.testing.assert_allclose(m1.network(x).numpy(),
+                                   m2.network(x).numpy(), rtol=1e-6)
+
+    def test_early_stopping(self):
+        model = paddle.Model(mlp())
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=0.01)
+        model.prepare(opt, nn.CrossEntropyLoss(), Accuracy())
+        es = paddle.hapi.EarlyStopping(monitor="acc", mode="max", patience=0,
+                                       save_best_model=False, verbose=0)
+        model.fit(BlobDS(128), BlobDS(64, seed=1), batch_size=32, epochs=8,
+                  verbose=0, callbacks=[es], eval_freq=1)
+        assert model.stop_training  # converges fast -> stops early
+
+    def test_train_batch_jit(self):
+        model = paddle.Model(mlp())
+        opt = paddle.optimizer.Adam(parameters=model.parameters(),
+                                    learning_rate=0.01)
+        model.prepare(opt, nn.CrossEntropyLoss(), jit=True)
+        X, y = make_blobs(n=64)
+        first = None
+        for i in range(20):
+            losses, _ = model.train_batch([X[:32]], [y[:32]])
+            if first is None:
+                first = losses[0]
+        assert losses[0] < first
+
+    def test_summary(self, capsys):
+        info = paddle.summary(mlp(), (1, 8))
+        assert info["total_params"] == 8 * 32 + 32 + 32 * 4 + 4
+        out = capsys.readouterr().out
+        assert "Total params" in out
