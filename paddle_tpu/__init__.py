@@ -33,6 +33,9 @@ from . import distributed  # noqa: F401
 from . import ops  # noqa: F401
 from . import utils  # noqa: F401
 from . import metric  # noqa: F401
+from . import distribution  # noqa: F401
+from . import fft  # noqa: F401
+from . import signal  # noqa: F401
 from . import hapi  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401

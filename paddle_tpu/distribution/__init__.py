@@ -1,0 +1,62 @@
+"""paddle.distribution parity (reference:
+/root/reference/python/paddle/distribution/__init__.py).
+
+TPU-native: parameters live as jnp arrays, sampling draws threaded PRNG
+keys from the global Generator (traceable under jit via
+framework.core.with_rng_key), densities are pure jnp — everything fuses
+under XLA.
+"""
+from __future__ import annotations
+
+from . import transform  # noqa: F401
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .binomial import Binomial
+from .categorical import Categorical
+from .cauchy import Cauchy
+from .continuous_bernoulli import ContinuousBernoulli
+from .dirichlet import Dirichlet
+from .distribution import Distribution
+from .exponential import Exponential
+from .exponential_family import ExponentialFamily
+from .gamma import Gamma
+from .geometric import Geometric
+from .gumbel import Gumbel
+from .independent import Independent
+from .kl import kl_divergence, register_kl
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .multinomial import Multinomial
+from .multivariate_normal import MultivariateNormal
+from .normal import Normal
+from .poisson import Poisson
+from .transform import (  # noqa: F401
+    AbsTransform,
+    AffineTransform,
+    ChainTransform,
+    ExpTransform,
+    IndependentTransform,
+    PowerTransform,
+    ReshapeTransform,
+    SigmoidTransform,
+    SoftmaxTransform,
+    StackTransform,
+    StickBreakingTransform,
+    TanhTransform,
+    Transform,
+)
+from .transformed_distribution import TransformedDistribution
+from .uniform import Uniform
+
+__all__ = [
+    'Bernoulli', 'Beta', 'Binomial', 'Categorical', 'Cauchy',
+    'ContinuousBernoulli', 'Dirichlet', 'Distribution', 'Exponential',
+    'ExponentialFamily', 'Gamma', 'Geometric', 'Gumbel', 'Independent',
+    'Laplace', 'LogNormal', 'Multinomial', 'MultivariateNormal', 'Normal',
+    'Poisson', 'TransformedDistribution', 'Uniform',
+    'kl_divergence', 'register_kl',
+    'AbsTransform', 'AffineTransform', 'ChainTransform', 'ExpTransform',
+    'IndependentTransform', 'PowerTransform', 'ReshapeTransform',
+    'SigmoidTransform', 'SoftmaxTransform', 'StackTransform',
+    'StickBreakingTransform', 'TanhTransform', 'Transform',
+]

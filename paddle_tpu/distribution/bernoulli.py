@@ -1,0 +1,57 @@
+"""Bernoulli (parity:
+/root/reference/python/paddle/distribution/bernoulli.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+_EPS = 1e-7
+
+
+class Bernoulli(ExponentialFamily):
+    def __init__(self, probs, name=None):
+        self.probs_ = jnp.clip(_as_jnp(probs), _EPS, 1 - _EPS)
+        self.logits = jnp.log(self.probs_) - jnp.log1p(-self.probs_)
+        # paddle parity: .probs is the parameter tensor (instance attr
+        # shadows the base class's pmf-evaluation method)
+        self.probs = Tensor(self.probs_)
+        super().__init__(batch_shape=self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        return Tensor(jax.random.bernoulli(_next_key(), self.probs_, shp)
+                      .astype(self.probs_.dtype))
+
+    def rsample(self, shape=(), temperature=1.0):
+        """Reparameterized relaxed sample (Gumbel-softmax / concrete)."""
+        shp = _sample_shape(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shp, self.probs_.dtype,
+                               minval=_EPS, maxval=1 - _EPS)
+        logistic = jnp.log(u) - jnp.log1p(-u)
+        return Tensor(jax.nn.sigmoid((self.logits + logistic) / temperature))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        return Tensor(v * jnp.log(self.probs_)
+                      + (1 - v) * jnp.log1p(-self.probs_))
+
+    def entropy(self):
+        p = self.probs_
+        return Tensor(-(p * jnp.log(p) + (1 - p) * jnp.log1p(-p)))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        out = jnp.where(v < 0, 0.0, jnp.where(v < 1, 1 - self.probs_, 1.0))
+        return Tensor(out)

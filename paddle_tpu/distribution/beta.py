@@ -1,0 +1,48 @@
+"""Beta (parity: /root/reference/python/paddle/distribution/beta.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma
+
+from ..framework.core import Tensor
+from .dirichlet import Dirichlet
+from .distribution import _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Beta(ExponentialFamily):
+    def __init__(self, alpha, beta):
+        self.alpha = _as_jnp(alpha)
+        self.beta = _as_jnp(beta)
+        self.alpha, self.beta = jnp.broadcast_arrays(self.alpha, self.beta)
+        super().__init__(batch_shape=self.alpha.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    @property
+    def variance(self):
+        s = self.alpha + self.beta
+        return Tensor(self.alpha * self.beta / (s * s * (s + 1)))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        return Tensor(jax.random.beta(_next_key(), self.alpha, self.beta,
+                                      shp))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        return Tensor((self.alpha - 1) * jnp.log(v)
+                      + (self.beta - 1) * jnp.log1p(-v)
+                      - betaln(self.alpha, self.beta))
+
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        return Tensor(betaln(a, b) - (a - 1) * digamma(a)
+                      - (b - 1) * digamma(b)
+                      + (a + b - 2) * digamma(a + b))

@@ -1,0 +1,63 @@
+"""Binomial (parity:
+/root/reference/python/paddle/distribution/binomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.scipy.special import gammaln
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs):
+        self.total_count = _as_jnp(total_count)
+        self.probs_ = _as_jnp(probs)
+        self.total_count, self.probs_ = jnp.broadcast_arrays(
+            self.total_count, self.probs_)
+        self.probs = Tensor(self.probs_)  # parameter tensor, paddle parity
+        super().__init__(batch_shape=self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        n = jnp.broadcast_to(self.total_count, shp)
+        p = jnp.broadcast_to(self.probs_, shp)
+        out = jax.random.binomial(_next_key(), n, p, shape=shp)
+        return Tensor(out.astype(self.probs_.dtype))
+
+    def log_prob(self, value):
+        k = _as_jnp(value)
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        logc = gammaln(n + 1) - gammaln(k + 1) - gammaln(n - k + 1)
+        return Tensor(logc + k * jnp.log(p) + (n - k) * jnp.log1p(-p))
+
+    _ENTROPY_EXACT_MAX = 1024
+
+    def entropy(self):
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-7, 1 - 1e-7)
+        try:
+            nmax = int(np.max(np.asarray(n)))
+        except Exception:
+            nmax = None  # traced total_count: no static support bound
+        if nmax is not None and nmax <= self._ENTROPY_EXACT_MAX:
+            # exact by summation over support (static 1+max bound)
+            ks = jnp.arange(0, nmax + 1, dtype=self.probs_.dtype)
+            ks = ks[(...,) + (None,) * self.probs_.ndim]
+            logc = gammaln(n + 1) - gammaln(ks + 1) - gammaln(n - ks + 1)
+            logpmf = logc + ks * jnp.log(p) + (n - ks) * jnp.log1p(-p)
+            logpmf = jnp.where(ks <= n, logpmf, -jnp.inf)
+            pmf = jnp.exp(logpmf)
+            return Tensor(-jnp.sum(pmf * jnp.where(jnp.isfinite(logpmf),
+                                                   logpmf, 0.0), axis=0))
+        # large or traced n: de Moivre–Laplace (Gaussian) approximation
+        return Tensor(0.5 * jnp.log(2 * jnp.pi * jnp.e * n * p * (1 - p)))

@@ -1,0 +1,54 @@
+"""Categorical (parity:
+/root/reference/python/paddle/distribution/categorical.py).
+
+Paddle's Categorical takes unnormalized non-negative ``logits`` that are
+interpreted as relative weights (it normalizes by the sum, not softmax).
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Categorical(Distribution):
+    def __init__(self, logits, name=None):
+        self.logits = _as_jnp(logits)
+        self._p = self.logits / jnp.sum(self.logits, -1, keepdims=True)
+        super().__init__(batch_shape=self.logits.shape[:-1])
+
+    @property
+    def probs_param(self):
+        return Tensor(self._p)
+
+    def sample(self, shape=()):
+        shp = _sample_shape(shape)
+        logp = jnp.log(jnp.clip(self._p, 1e-38))
+        out = jax.random.categorical(
+            _next_key(), logp, axis=-1,
+            shape=shp + self.batch_shape)
+        return Tensor(out.astype(jnp.int64) if jax.config.jax_enable_x64
+                      else out)
+
+    def log_prob(self, value):
+        idx = _as_jnp(value, dtype=jnp.int32).astype(jnp.int32)
+        if self.batch_shape == ():
+            picked = self._p[idx]
+        else:
+            idx_b = jnp.broadcast_to(idx, self.batch_shape)
+            picked = jnp.take_along_axis(
+                self._p, idx_b[..., None], axis=-1)[..., 0]
+        return Tensor(jnp.log(jnp.clip(picked, 1e-38)))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(_as_jnp(self.log_prob(value))))
+
+    def entropy(self):
+        p = self._p
+        return Tensor(-jnp.sum(p * jnp.log(jnp.clip(p, 1e-38)), -1))
+
+    def kl_divergence(self, other: "Categorical"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)

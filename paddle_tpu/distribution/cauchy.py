@@ -1,0 +1,57 @@
+"""Cauchy (parity: /root/reference/python/paddle/distribution/cauchy.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        raise ValueError("Cauchy distribution has no mean")
+
+    @property
+    def variance(self):
+        raise ValueError("Cauchy distribution has no variance")
+
+    @property
+    def stddev(self):
+        raise ValueError("Cauchy distribution has no stddev")
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        c = jax.random.cauchy(_next_key(), shp, self.loc.dtype)
+        return Tensor(self.loc + self.scale * c)
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-math.log(math.pi) - jnp.log(self.scale)
+                      - jnp.log1p(z * z))
+
+    def entropy(self):
+        return Tensor(jnp.log(4 * math.pi * self.scale))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(jnp.arctan((v - self.loc) / self.scale) / math.pi
+                      + 0.5)
+
+    def icdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(self.loc + self.scale
+                      * jnp.tan(math.pi * (v - 0.5)))

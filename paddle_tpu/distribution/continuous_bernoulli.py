@@ -1,0 +1,89 @@
+"""ContinuousBernoulli (parity:
+/root/reference/python/paddle/distribution/continuous_bernoulli.py).
+
+pdf(x; λ) = C(λ) λ^x (1-λ)^(1-x) on [0, 1], with normalizer
+C(λ) = 2 atanh(1-2λ) / (1-2λ) for λ ≠ 0.5, = 2 for λ = 0.5.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501)):
+        self.probs_ = jnp.clip(_as_jnp(probs), 1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(batch_shape=self.probs_.shape)
+
+    def _outside_unstable(self):
+        return (self.probs_ < self._lims[0]) | (self.probs_ > self._lims[1])
+
+    def _cut_probs(self):
+        # pin near-0.5 λ to the stable region; Taylor used there instead
+        return jnp.where(self._outside_unstable(), self.probs_,
+                         jnp.full_like(self.probs_, self._lims[0]))
+
+    def _log_norm(self):
+        lam = self._cut_probs()
+        log_norm = jnp.log(jnp.abs(2 * jnp.arctanh(1 - 2 * lam))) \
+            - jnp.log(jnp.abs(1 - 2 * lam))
+        x = self.probs_ - 0.5
+        taylor = jnp.log(2.0) + (4.0 / 3.0 + 104.0 / 45.0 * x * x) * x * x
+        return jnp.where(self._outside_unstable(), log_norm, taylor)
+
+    @property
+    def mean(self):
+        lam = self._cut_probs()
+        m = lam / (2 * lam - 1) + 1 / (2 * jnp.arctanh(1 - 2 * lam))
+        x = self.probs_ - 0.5
+        taylor = 0.5 + (1.0 / 3.0 + 16.0 / 45.0 * x * x) * x
+        return Tensor(jnp.where(self._outside_unstable(), m, taylor))
+
+    @property
+    def variance(self):
+        lam = self._cut_probs()
+        t = jnp.arctanh(1 - 2 * lam)
+        v = lam * (lam - 1) / jnp.square(1 - 2 * lam) + 1 / (4 * t * t)
+        x = self.probs_ - 0.5
+        taylor = 1.0 / 12.0 + (1.0 / 15.0 - 128.0 / 945.0 * x * x) * x * x
+        return Tensor(jnp.where(self._outside_unstable(), v, taylor))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shp, self.probs_.dtype,
+                               minval=1e-6, maxval=1 - 1e-6)
+        return self.icdf(Tensor(u))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        lam = self.probs_
+        return Tensor(v * jnp.log(lam) + (1 - v) * jnp.log1p(-lam)
+                      + self._log_norm())
+
+    def entropy(self):
+        lam = self.probs_
+        m = _as_jnp(self.mean)
+        return Tensor(-(m * jnp.log(lam) + (1 - m) * jnp.log1p(-lam)
+                        + self._log_norm()))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        lam = self._cut_probs()
+        num = jnp.power(lam, v) * jnp.power(1 - lam, 1 - v) + lam - 1
+        c = num / (2 * lam - 1)
+        out = jnp.where(self._outside_unstable(), c, v)
+        return Tensor(jnp.clip(out, 0.0, 1.0))
+
+    def icdf(self, value):
+        u = _as_jnp(value)
+        lam = self._cut_probs()
+        x = (jnp.log1p(u * (2 * lam - 1) / (1 - lam))
+             / (jnp.log(lam) - jnp.log1p(-lam)))
+        return Tensor(jnp.where(self._outside_unstable(), x, u))

@@ -1,0 +1,51 @@
+"""Dirichlet (parity:
+/root/reference/python/paddle/distribution/dirichlet.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammaln
+
+from ..framework.core import Tensor
+from .distribution import _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Dirichlet(ExponentialFamily):
+    def __init__(self, concentration):
+        self.concentration = _as_jnp(concentration)
+        super().__init__(batch_shape=self.concentration.shape[:-1],
+                         event_shape=self.concentration.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration
+                      / jnp.sum(self.concentration, -1, keepdims=True))
+
+    @property
+    def variance(self):
+        a0 = jnp.sum(self.concentration, -1, keepdims=True)
+        m = self.concentration / a0
+        return Tensor(m * (1 - m) / (a0 + 1))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        return Tensor(jax.random.dirichlet(_next_key(), self.concentration,
+                                           shp))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        a = self.concentration
+        return Tensor(jnp.sum((a - 1) * jnp.log(v), -1)
+                      + gammaln(jnp.sum(a, -1)) - jnp.sum(gammaln(a), -1))
+
+    def entropy(self):
+        a = self.concentration
+        a0 = jnp.sum(a, -1)
+        k = a.shape[-1]
+        lnB = jnp.sum(gammaln(a), -1) - gammaln(a0)
+        return Tensor(lnB + (a0 - k) * digamma(a0)
+                      - jnp.sum((a - 1) * digamma(a), -1))

@@ -1,0 +1,101 @@
+"""Distribution base (parity:
+/root/reference/python/paddle/distribution/distribution.py).
+
+Samples are returned as framework Tensors with shape
+``sample_shape + batch_shape + event_shape``; log_prob/entropy are pure
+jnp computations so they trace/fuse under jit.
+"""
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, default_generator
+
+
+def _as_jnp(x, dtype=None):
+    """Coerce Tensor / python number / ndarray to a jnp array."""
+    if isinstance(x, Tensor):
+        v = x._value
+    else:
+        v = x
+    arr = jnp.asarray(v)
+    if dtype is not None:
+        arr = arr.astype(dtype)
+    elif not jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = arr.astype(jnp.float32)
+    return arr
+
+
+def _sample_shape(shape) -> Tuple[int, ...]:
+    if shape is None:
+        return ()
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _next_key():
+    return default_generator.next_key()
+
+
+class Distribution:
+    def __init__(self, batch_shape: Sequence[int] = (),
+                 event_shape: Sequence[int] = ()):
+        self._batch_shape = tuple(int(s) for s in batch_shape)
+        self._event_shape = tuple(int(s) for s in event_shape)
+
+    @property
+    def batch_shape(self) -> Tuple[int, ...]:
+        return self._batch_shape
+
+    @property
+    def event_shape(self) -> Tuple[int, ...]:
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(_as_jnp(self.variance)))
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_as_jnp(self.log_prob(value))))
+
+    # paddle's Bernoulli/Categorical expose probs() as pmf evaluation
+    def probs(self, value):
+        return self.prob(value)
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def cdf(self, value):
+        raise NotImplementedError
+
+    def icdf(self, value):
+        raise NotImplementedError
+
+    def kl_divergence(self, other: "Distribution"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)
+
+    def _extend_shape(self, sample_shape) -> Tuple[int, ...]:
+        return (_sample_shape(sample_shape) + self.batch_shape
+                + self.event_shape)

@@ -1,0 +1,47 @@
+"""Exponential (parity:
+/root/reference/python/paddle/distribution/exponential.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Exponential(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _as_jnp(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        e = jax.random.exponential(_next_key(), shp, self.rate.dtype)
+        return Tensor(e / self.rate)
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(-jnp.expm1(-self.rate * v))
+
+    def icdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(-jnp.log1p(-v) / self.rate)

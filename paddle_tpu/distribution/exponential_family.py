@@ -1,0 +1,19 @@
+"""ExponentialFamily base (parity:
+/root/reference/python/paddle/distribution/exponential_family.py).
+
+The reference computes entropy generically via the Bregman divergence of
+the log-normalizer; here subclasses provide closed-form entropy directly
+(cheaper under XLA), and this base exists for API/isinstance parity.
+"""
+from __future__ import annotations
+
+from .distribution import Distribution
+
+
+class ExponentialFamily(Distribution):
+    @property
+    def _natural_parameters(self):
+        raise NotImplementedError
+
+    def _log_normalizer(self, *natural_parameters):
+        raise NotImplementedError

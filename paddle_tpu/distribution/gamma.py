@@ -1,0 +1,49 @@
+"""Gamma (parity: /root/reference/python/paddle/distribution/gamma.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import digamma, gammainc, gammaln
+
+from ..framework.core import Tensor
+from .distribution import _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Gamma(ExponentialFamily):
+    def __init__(self, concentration, rate):
+        self.concentration = _as_jnp(concentration)
+        self.rate = _as_jnp(rate)
+        self.concentration, self.rate = jnp.broadcast_arrays(
+            self.concentration, self.rate)
+        super().__init__(batch_shape=self.concentration.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.concentration / jnp.square(self.rate))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        g = jax.random.gamma(_next_key(), self.concentration, shp)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        a, b = self.concentration, self.rate
+        return Tensor(a * jnp.log(b) + (a - 1) * jnp.log(v) - b * v
+                      - gammaln(a))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + gammaln(a) + (1 - a) * digamma(a))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(gammainc(self.concentration, self.rate * v))

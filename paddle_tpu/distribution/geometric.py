@@ -1,0 +1,62 @@
+"""Geometric (parity:
+/root/reference/python/paddle/distribution/geometric.py).
+
+Paddle convention: support k = 0, 1, 2, ... (number of failures before
+the first success); pmf(k) = (1-p)^k p.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+_EPS = 1e-7
+
+
+class Geometric(Distribution):
+    def __init__(self, probs):
+        self.probs_ = jnp.clip(_as_jnp(probs), _EPS, 1 - _EPS)
+        self.probs = Tensor(self.probs_)  # parameter tensor, paddle parity
+        super().__init__(batch_shape=self.probs_.shape)
+
+    @property
+    def mean(self):
+        return Tensor((1 - self.probs_) / self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor((1 - self.probs_) / jnp.square(self.probs_))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.sqrt(1 - self.probs_) / self.probs_)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shp, self.probs_.dtype,
+                               minval=_EPS, maxval=1.0)
+        return Tensor(jnp.floor(jnp.log(u) / jnp.log1p(-self.probs_)))
+
+    def log_prob(self, value):
+        k = _as_jnp(value)
+        return Tensor(k * jnp.log1p(-self.probs_) + jnp.log(self.probs_))
+
+    def pmf(self, k):
+        return Tensor(jnp.exp(_as_jnp(self.log_prob(k))))
+
+    def log_pmf(self, k):
+        return self.log_prob(k)
+
+    def entropy(self):
+        p = self.probs_
+        q = 1 - p
+        return Tensor(-(q * jnp.log(q) + p * jnp.log(p)) / p)
+
+    def cdf(self, k):
+        kk = _as_jnp(k)
+        return Tensor(1 - jnp.power(1 - self.probs_, kk + 1))

@@ -1,0 +1,52 @@
+"""Gumbel (parity: /root/reference/python/paddle/distribution/gumbel.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+_EULER = 0.57721566490153286060
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * _EULER)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(math.pi * self.scale) / 6)
+
+    @property
+    def stddev(self):
+        return Tensor(math.pi / math.sqrt(6.0) * self.scale)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        g = jax.random.gumbel(_next_key(), shp, self.loc.dtype)
+        return Tensor(self.loc + self.scale * g)
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.scale) + 1 + _EULER)
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(jnp.exp(-jnp.exp(-(v - self.loc) / self.scale)))

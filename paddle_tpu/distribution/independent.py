@@ -1,0 +1,46 @@
+"""Independent (parity:
+/root/reference/python/paddle/distribution/independent.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp
+
+
+class Independent(Distribution):
+    """Reinterprets the rightmost batch dims of ``base`` as event dims."""
+
+    def __init__(self, base: Distribution, reinterpreted_batch_rank: int):
+        if reinterpreted_batch_rank > len(base.batch_shape):
+            raise ValueError("reinterpreted_batch_rank exceeds batch rank")
+        self.base = base
+        self.reinterpreted_batch_rank = int(reinterpreted_batch_rank)
+        n = len(base.batch_shape) - self.reinterpreted_batch_rank
+        super().__init__(
+            batch_shape=base.batch_shape[:n],
+            event_shape=base.batch_shape[n:] + base.event_shape)
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def _sum_rightmost(self, x):
+        axes = tuple(range(-self.reinterpreted_batch_rank, 0))
+        return jnp.sum(x, axis=axes) if axes else x
+
+    def log_prob(self, value):
+        return Tensor(self._sum_rightmost(_as_jnp(self.base.log_prob(value))))
+
+    def entropy(self):
+        return Tensor(self._sum_rightmost(_as_jnp(self.base.entropy())))

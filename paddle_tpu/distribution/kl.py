@@ -1,0 +1,157 @@
+"""KL divergence registry (parity:
+/root/reference/python/paddle/distribution/kl.py — kl_divergence,
+register_kl with MRO-based dispatch)."""
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+from jax.scipy.special import betaln, digamma, gammaln
+
+from ..framework.core import Tensor
+from .bernoulli import Bernoulli
+from .beta import Beta
+from .categorical import Categorical
+from .dirichlet import Dirichlet
+from .distribution import Distribution, _as_jnp
+from .exponential import Exponential
+from .gamma import Gamma
+from .geometric import Geometric
+from .gumbel import Gumbel
+from .laplace import Laplace
+from .lognormal import LogNormal
+from .normal import Normal
+from .poisson import Poisson
+from .uniform import Uniform
+
+__all__ = ["kl_divergence", "register_kl"]
+
+_REGISTRY = {}
+
+
+def register_kl(cls_p, cls_q):
+    def decorator(fn):
+        _REGISTRY[(cls_p, cls_q)] = fn
+        return fn
+    return decorator
+
+
+def _dispatch(type_p, type_q):
+    matches = [(p, q) for (p, q) in _REGISTRY
+               if issubclass(type_p, p) and issubclass(type_q, q)]
+    if not matches:
+        raise NotImplementedError(
+            f"no KL registered for ({type_p.__name__}, {type_q.__name__})")
+
+    def specificity(pair):
+        # fewer registered classes are subclasses of a *specific* class,
+        # so minimize to prefer the most-derived match
+        p, q = pair
+        return (sum(issubclass(p2, p) for p2, _ in matches),
+                sum(issubclass(q2, q) for _, q2 in matches))
+    best = min(matches, key=specificity)
+    return _REGISTRY[best]
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    return _dispatch(type(p), type(q))(p, q)
+
+
+@register_kl(Normal, Normal)
+def _kl_normal_normal(p, q):
+    var_ratio = jnp.square(p.scale / q.scale)
+    t1 = jnp.square((p.loc - q.loc) / q.scale)
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform_uniform(p, q):
+    lo = p.low >= q.low
+    hi = p.high <= q.high
+    kl = jnp.log((q.high - q.low) / (p.high - p.low))
+    return Tensor(jnp.where(lo & hi, kl, jnp.inf))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli_bernoulli(p, q):
+    a, b = p.probs_, q.probs_
+    return Tensor(a * (jnp.log(a) - jnp.log(b))
+                  + (1 - a) * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical_categorical(p, q):
+    pp, qq = p._p, q._p
+    return Tensor(jnp.sum(pp * (jnp.log(jnp.clip(pp, 1e-38))
+                                - jnp.log(jnp.clip(qq, 1e-38))), -1))
+
+
+@register_kl(Beta, Beta)
+def _kl_beta_beta(p, q):
+    a1, b1, a2, b2 = p.alpha, p.beta, q.alpha, q.beta
+    return Tensor(betaln(a2, b2) - betaln(a1, b1)
+                  + (a1 - a2) * digamma(a1) + (b1 - b2) * digamma(b1)
+                  + (a2 - a1 + b2 - b1) * digamma(a1 + b1))
+
+
+@register_kl(Dirichlet, Dirichlet)
+def _kl_dirichlet_dirichlet(p, q):
+    a, b = p.concentration, q.concentration
+    a0 = jnp.sum(a, -1, keepdims=True)
+    return Tensor(gammaln(jnp.sum(a, -1)) - gammaln(jnp.sum(b, -1))
+                  - jnp.sum(gammaln(a) - gammaln(b), -1)
+                  + jnp.sum((a - b) * (digamma(a) - digamma(a0)), -1))
+
+
+@register_kl(Gamma, Gamma)
+def _kl_gamma_gamma(p, q):
+    a1, b1, a2, b2 = p.concentration, p.rate, q.concentration, q.rate
+    return Tensor((a1 - a2) * digamma(a1) - gammaln(a1) + gammaln(a2)
+                  + a2 * (jnp.log(b1) - jnp.log(b2))
+                  + a1 * (b2 / b1 - 1))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential_exponential(p, q):
+    r = q.rate / p.rate
+    return Tensor(r - jnp.log(r) - 1)
+
+
+@register_kl(Laplace, Laplace)
+def _kl_laplace_laplace(p, q):
+    scale_ratio = p.scale / q.scale
+    loc_diff = jnp.abs(p.loc - q.loc) / q.scale
+    return Tensor(-jnp.log(scale_ratio) - 1
+                  + scale_ratio * jnp.exp(-loc_diff / scale_ratio)
+                  + loc_diff)
+
+
+@register_kl(Poisson, Poisson)
+def _kl_poisson_poisson(p, q):
+    return Tensor(p.rate * (jnp.log(p.rate) - jnp.log(q.rate))
+                  - p.rate + q.rate)
+
+
+@register_kl(Geometric, Geometric)
+def _kl_geometric_geometric(p, q):
+    a, b = p.probs_, q.probs_
+    return Tensor((jnp.log(a) - jnp.log(b)) + (1 - a) / a
+                  * (jnp.log1p(-a) - jnp.log1p(-b)))
+
+
+@register_kl(LogNormal, LogNormal)
+def _kl_lognormal_lognormal(p, q):
+    return _kl_normal_normal(p._base, q._base)
+
+
+@register_kl(Gumbel, Gumbel)
+def _kl_gumbel_gumbel(p, q):
+    # KL(Gumbel(m1,b1) || Gumbel(m2,b2)); Euler–Mascheroni γ
+    g = 0.57721566490153286060
+    b1, b2, m1, m2 = p.scale, q.scale, p.loc, q.loc
+    return Tensor(jnp.log(b2) - jnp.log(b1)
+                  + g * (b1 / b2 - 1)
+                  + jnp.exp((m2 - m1) / b2
+                            + gammaln(1 + b1 / b2)
+                            - gammaln(jnp.ones_like(b1))) - 1
+                  + (m1 - m2) / b2)

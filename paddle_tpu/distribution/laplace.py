@@ -1,0 +1,60 @@
+"""Laplace (parity:
+/root/reference/python/paddle/distribution/laplace.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale):
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(2 * jnp.square(self.scale))
+
+    @property
+    def stddev(self):
+        return Tensor(math.sqrt(2.0) * self.scale)
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shp, self.loc.dtype,
+                               minval=-0.5 + 1e-7, maxval=0.5)
+        return Tensor(self.loc - self.scale * jnp.sign(u)
+                      * jnp.log1p(-2 * jnp.abs(u)))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale
+                      - jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(0.5 - 0.5 * jnp.sign(z) * jnp.expm1(-jnp.abs(z)))
+
+    def icdf(self, value):
+        v = _as_jnp(value)
+        t = v - 0.5
+        return Tensor(self.loc - self.scale * jnp.sign(t)
+                      * jnp.log1p(-2 * jnp.abs(t)))

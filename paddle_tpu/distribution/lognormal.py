@@ -1,0 +1,31 @@
+"""LogNormal (parity:
+/root/reference/python/paddle/distribution/lognormal.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import _as_jnp
+from .normal import Normal
+from .transform import ExpTransform
+from .transformed_distribution import TransformedDistribution
+
+
+class LogNormal(TransformedDistribution):
+    def __init__(self, loc, scale):
+        self._base = Normal(loc, scale)
+        self.loc = self._base.loc
+        self.scale = self._base.scale
+        super().__init__(self._base, [ExpTransform()])
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + jnp.square(self.scale) / 2))
+
+    @property
+    def variance(self):
+        s2 = jnp.square(self.scale)
+        return Tensor(jnp.expm1(s2) * jnp.exp(2 * self.loc + s2))
+
+    def entropy(self):
+        return Tensor(_as_jnp(self._base.entropy()) + self.loc)

@@ -1,0 +1,60 @@
+"""Multinomial (parity:
+/root/reference/python/paddle/distribution/multinomial.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count: int, probs):
+        self.total_count = int(total_count)
+        p = _as_jnp(probs)
+        self.probs_ = p / jnp.sum(p, -1, keepdims=True)
+        super().__init__(batch_shape=p.shape[:-1], event_shape=p.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs_)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs_ * (1 - self.probs_))
+
+    def sample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        logp = jnp.log(jnp.clip(self.probs_, 1e-38))
+        # draw total_count categoricals, histogram them (one-hot sum) —
+        # static shapes, MXU/VPU friendly, no host loop
+        draws = jax.random.categorical(
+            _next_key(), logp, axis=-1,
+            shape=(self.total_count,) + shp)
+        onehot = jax.nn.one_hot(draws, self.probs_.shape[-1],
+                                dtype=self.probs_.dtype)
+        return Tensor(jnp.sum(onehot, axis=0))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        logp = jnp.log(jnp.clip(self.probs_, 1e-38))
+        return Tensor(gammaln(jnp.asarray(self.total_count + 1.0))
+                      - jnp.sum(gammaln(v + 1), -1)
+                      + jnp.sum(v * logp, -1))
+
+    def entropy(self):
+        # exact: H = -lgamma(n+1) + Σ_i E[lgamma(X_i+1)] - n Σ p log p
+        # with X_i ~ Binomial(n, p_i); E[·] by summation over the static
+        # support 0..n (total_count is a python int)
+        n, p = self.total_count, jnp.clip(self.probs_, 1e-38)
+        ks = jnp.arange(0, n + 1, dtype=p.dtype)
+        ks = ks[(...,) + (None,) * p.ndim]
+        logc = (gammaln(jnp.asarray(n + 1.0)) - gammaln(ks + 1)
+                - gammaln(n - ks + 1))
+        log_binom_pmf = logc + ks * jnp.log(p) + (n - ks) * jnp.log1p(-p)
+        e_lgamma = jnp.sum(jnp.exp(log_binom_pmf) * gammaln(ks + 1), 0)
+        ent1 = -jnp.sum(p * jnp.log(p), -1)
+        return Tensor(-gammaln(jnp.asarray(n + 1.0))
+                      + jnp.sum(e_lgamma, -1) + n * ent1)

@@ -1,0 +1,87 @@
+"""MultivariateNormal (parity:
+/root/reference/python/paddle/distribution/multivariate_normal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None):
+        self.loc = _as_jnp(loc)
+        if scale_tril is not None:
+            self._scale_tril = _as_jnp(scale_tril)
+        elif covariance_matrix is not None:
+            self._scale_tril = jnp.linalg.cholesky(_as_jnp(covariance_matrix))
+        elif precision_matrix is not None:
+            prec = _as_jnp(precision_matrix)
+            self._scale_tril = jnp.linalg.cholesky(jnp.linalg.inv(prec))
+        else:
+            raise ValueError(
+                "one of covariance_matrix / precision_matrix / scale_tril "
+                "must be specified")
+        batch = jnp.broadcast_shapes(self.loc.shape[:-1],
+                                     self._scale_tril.shape[:-2])
+        super().__init__(batch_shape=batch,
+                         event_shape=self.loc.shape[-1:])
+
+    @property
+    def scale_tril(self):
+        return Tensor(self._scale_tril)
+
+    @property
+    def covariance_matrix(self):
+        L = self._scale_tril
+        return Tensor(L @ jnp.swapaxes(L, -1, -2))
+
+    @property
+    def precision_matrix(self):
+        return Tensor(jnp.linalg.inv(_as_jnp(self.covariance_matrix)))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc,
+                                       self.batch_shape + self.event_shape))
+
+    @property
+    def variance(self):
+        var = jnp.square(self._scale_tril).sum(-1)
+        return Tensor(jnp.broadcast_to(var,
+                                       self.batch_shape + self.event_shape))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape + self.event_shape
+        eps = jax.random.normal(_next_key(), shp, self.loc.dtype)
+        return Tensor(self.loc + jnp.einsum('...ij,...j->...i',
+                                            self._scale_tril, eps))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        diff = v - self.loc
+        L = jnp.broadcast_to(self._scale_tril,
+                             diff.shape[:-1] + self._scale_tril.shape[-2:])
+        # solve L y = diff  →  maha = |y|^2
+        y = jax.scipy.linalg.solve_triangular(
+            L, diff[..., None], lower=True)[..., 0]
+        maha = jnp.sum(jnp.square(y), -1)
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        k = self.event_shape[0]
+        return Tensor(-0.5 * (maha + k * math.log(2 * math.pi))
+                      - half_logdet)
+
+    def entropy(self):
+        half_logdet = jnp.sum(jnp.log(
+            jnp.diagonal(self._scale_tril, axis1=-2, axis2=-1)), -1)
+        k = self.event_shape[0]
+        out = 0.5 * k * (1 + math.log(2 * math.pi)) + half_logdet
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))

@@ -1,0 +1,64 @@
+"""Normal (parity: /root/reference/python/paddle/distribution/normal.py)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import erf, erfinv
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+_HALF_LOG_2PI = 0.5 * math.log(2.0 * math.pi)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _as_jnp(loc)
+        self.scale = _as_jnp(scale)
+        self.loc, self.scale = jnp.broadcast_arrays(self.loc, self.scale)
+        super().__init__(batch_shape=self.loc.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(self.scale))
+
+    @property
+    def stddev(self):
+        return Tensor(self.scale)
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        eps = jax.random.normal(_next_key(), shp, self.loc.dtype)
+        return Tensor(self.loc + eps * self.scale)
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        z = (v - self.loc) / self.scale
+        return Tensor(-0.5 * z * z - jnp.log(self.scale) - _HALF_LOG_2PI)
+
+    def entropy(self):
+        out = 0.5 + _HALF_LOG_2PI + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(out, self.batch_shape))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(0.5 * (1 + erf((v - self.loc)
+                                     / (self.scale * math.sqrt(2.0)))))
+
+    def icdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(self.loc + self.scale * math.sqrt(2.0)
+                      * erfinv(2 * v - 1))
+
+    def kl_divergence(self, other: "Normal"):
+        from .kl import kl_divergence
+        return kl_divergence(self, other)

@@ -1,0 +1,50 @@
+"""Poisson (parity:
+/root/reference/python/paddle/distribution/poisson.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.special import gammaln
+
+from ..framework.core import Tensor
+from .distribution import _as_jnp, _next_key, _sample_shape
+from .exponential_family import ExponentialFamily
+
+
+class Poisson(ExponentialFamily):
+    def __init__(self, rate):
+        self.rate = _as_jnp(rate)
+        super().__init__(batch_shape=self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        out = jax.random.poisson(_next_key(), self.rate, shp)
+        return Tensor(out.astype(self.rate.dtype))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        return Tensor(v * jnp.log(jnp.clip(self.rate, 1e-38)) - self.rate
+                      - gammaln(v + 1))
+
+    def entropy(self):
+        # series approximation (matches reference's truncated evaluation
+        # for moderate rate): H ≈ 0.5 log(2πeλ) - corrections
+        lam = self.rate
+        h = (0.5 * jnp.log(2 * jnp.pi * jnp.e * lam)
+             - 1 / (12 * lam) - 1 / (24 * lam ** 2) - 19 / (360 * lam ** 3))
+        # exact for small λ by summation over k
+        ks = jnp.arange(0, 32, dtype=lam.dtype)
+        logpmf = (ks[(...,) + (None,) * lam.ndim] * jnp.log(
+            jnp.clip(lam, 1e-38)) - lam - gammaln(
+            ks[(...,) + (None,) * lam.ndim] + 1))
+        pmf = jnp.exp(logpmf)
+        h_exact = -jnp.sum(pmf * logpmf, axis=0)
+        return Tensor(jnp.where(lam < 10.0, h_exact, h))

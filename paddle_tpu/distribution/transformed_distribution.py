@@ -1,0 +1,49 @@
+"""TransformedDistribution (parity:
+/root/reference/python/paddle/distribution/transformed_distribution.py)."""
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp
+from .transform import ChainTransform, Transform
+
+
+class TransformedDistribution(Distribution):
+    def __init__(self, base: Distribution, transforms: Sequence[Transform]):
+        self.base = base
+        self.transforms = list(transforms)
+        self._chain = ChainTransform(self.transforms)
+        shape = base.batch_shape + base.event_shape
+        out_shape = self._chain.forward_shape(shape)
+        ev_rank = max(self._chain._event_rank, len(base.event_shape))
+        n = len(out_shape) - ev_rank
+        super().__init__(batch_shape=out_shape[:n],
+                         event_shape=out_shape[n:])
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        return self._chain.forward(x)
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        return self._chain.forward(x)
+
+    def log_prob(self, value):
+        y = _as_jnp(value)
+        base_ev_rank = len(self.base.event_shape)
+        lp = 0.0
+        for t in reversed(self.transforms):
+            x = t._inverse(y)
+            ldj = t._forward_log_det_jacobian(x)
+            # an elementwise transform's ldj still carries the base's
+            # event dims — reduce them so lp is per batch element
+            reduce_rank = base_ev_rank - t._event_rank
+            if reduce_rank > 0 and hasattr(ldj, 'ndim') and ldj.ndim > 0:
+                ldj = jnp.sum(ldj, axis=tuple(range(-reduce_rank, 0)))
+            lp = lp - ldj
+            y = x
+        base_lp = _as_jnp(self.base.log_prob(Tensor(y)))
+        return Tensor(base_lp + lp)

@@ -1,0 +1,49 @@
+"""Uniform (parity: /root/reference/python/paddle/distribution/uniform.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from .distribution import Distribution, _as_jnp, _next_key, _sample_shape
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _as_jnp(low)
+        self.high = _as_jnp(high)
+        self.low, self.high = jnp.broadcast_arrays(self.low, self.high)
+        super().__init__(batch_shape=self.low.shape)
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor(jnp.square(self.high - self.low) / 12)
+
+    def sample(self, shape=(), seed=0):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        shp = _sample_shape(shape) + self.batch_shape
+        u = jax.random.uniform(_next_key(), shp, self.low.dtype)
+        return Tensor(self.low + u * (self.high - self.low))
+
+    def log_prob(self, value):
+        v = _as_jnp(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def cdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(jnp.clip((v - self.low) / (self.high - self.low), 0, 1))
+
+    def icdf(self, value):
+        v = _as_jnp(value)
+        return Tensor(self.low + v * (self.high - self.low))

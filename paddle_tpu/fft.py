@@ -1,0 +1,140 @@
+"""paddle.fft parity (reference: /root/reference/python/paddle/fft.py).
+
+Thin Tensor-aware wrappers over jnp.fft — XLA lowers these to the TPU
+FFT HLO directly; no custom kernels needed.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor, as_jnp as _v
+
+__all__ = [
+    "fft", "ifft", "fft2", "ifft2", "fftn", "ifftn",
+    "rfft", "irfft", "rfft2", "irfft2", "rfftn", "irfftn",
+    "hfft", "ihfft", "hfft2", "ihfft2", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+
+def _norm(norm):
+    # paddle uses "backward"/"forward"/"ortho" like numpy
+    return norm if norm is not None else "backward"
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.fft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.ifft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.fft2(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.ifft2(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.fftn(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.ifftn(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.rfft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.irfft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.rfft2(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(jnp.fft.irfft2(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.rfftn(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(jnp.fft.irfftn(_v(x), s=s, axes=axes, norm=_norm(norm)))
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.hfft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return Tensor(jnp.fft.ihfft(_v(x), n=n, axis=axis, norm=_norm(norm)))
+
+
+def _nd_via_1d(fn1d, x, s, axes, norm):
+    """Hermitian n-d FFT as a 1-d hermitian transform on the last axis
+    composed with plain (i)ffts on the rest. Axis order matters:
+    hfft takes complex input, so leading complex ffts run first; ihfft
+    takes REAL input, so it must run first (producing complex), with the
+    remaining axes handled by ifft afterwards."""
+    v = _v(x)
+    if axes is None:
+        axes = tuple(range(v.ndim)) if s is None else \
+            tuple(range(v.ndim - len(s), v.ndim))
+    if s is None:
+        s = [None] * len(axes)
+    if fn1d is jnp.fft.hfft:
+        for ax, n in zip(axes[:-1], s[:-1]):
+            v = jnp.fft.fft(v, n=n, axis=ax, norm=norm)
+        return fn1d(v, n=s[-1], axis=axes[-1], norm=norm)
+    v = fn1d(v, n=s[-1], axis=axes[-1], norm=norm)
+    for ax, n in zip(axes[:-1], s[:-1]):
+        v = jnp.fft.ifft(v, n=n, axis=ax, norm=norm)
+    return v
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(_nd_via_1d(jnp.fft.hfft, x, s, axes, _norm(norm)))
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return Tensor(_nd_via_1d(jnp.fft.ihfft, x, s, axes, _norm(norm)))
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(_nd_via_1d(jnp.fft.hfft, x, s, axes, _norm(norm)))
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    return Tensor(_nd_via_1d(jnp.fft.ihfft, x, s, axes, _norm(norm)))
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import dtype as dtypes
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(int(n), d=float(d))
+    if dtype is not None:
+        from .framework import dtype as dtypes
+        out = out.astype(dtypes.convert_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.fftshift(_v(x), axes=axes))
+
+
+def ifftshift(x, axes=None, name=None):
+    return Tensor(jnp.fft.ifftshift(_v(x), axes=axes))

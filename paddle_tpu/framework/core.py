@@ -529,6 +529,11 @@ def _unwrap(x):
     return x._value if isinstance(x, Tensor) else x
 
 
+def as_jnp(x):
+    """Coerce Tensor / ndarray / python scalar to a jnp array."""
+    return jnp.asarray(_unwrap(x))
+
+
 _amp_hook: Optional[Callable] = None  # installed by paddle_tpu.amp
 
 

@@ -1,0 +1,130 @@
+"""paddle.signal parity (reference:
+/root/reference/python/paddle/signal.py — frame, overlap_add, stft,
+istft). Framing is a gather/reshape — static shapes, XLA-fusable.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.core import Tensor, as_jnp as _v
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice x into overlapping frames along ``axis``.
+
+    Output places the frame dim: axis=-1 → (..., frame_length, n_frames);
+    axis=0 → (n_frames, frame_length, ...), matching the reference.
+    """
+    v = _v(x)
+    fl, hop = int(frame_length), int(hop_length)
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    n = v.shape[-1] if axis == -1 else v.shape[0]
+    if n < fl:
+        raise ValueError(
+            f"signal length {n} is shorter than frame_length {fl}")
+    n_frames = 1 + (n - fl) // hop
+    idx = (jnp.arange(fl)[:, None] + hop * jnp.arange(n_frames)[None, :])
+    if axis == -1:
+        out = jnp.take(v, idx, axis=-1)          # (..., fl, n_frames)
+    else:
+        out = jnp.take(v, idx.T, axis=0)          # (n_frames, fl, ...)
+    return Tensor(out)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Inverse of frame: sum overlapping frames back into a signal."""
+    v = _v(x)
+    hop = int(hop_length)
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    if axis == 0:
+        # (n_frames, frame_length, ...) → canonical (..., fl, n_frames)
+        v = jnp.moveaxis(jnp.moveaxis(v, 0, -1), 0, -2)
+    fl, n_frames = v.shape[-2], v.shape[-1]
+    out_len = fl + hop * (n_frames - 1)
+    idx = (jnp.arange(fl)[:, None] + hop * jnp.arange(n_frames)[None, :])
+    flat = v.reshape(v.shape[:-2] + (-1,))
+    out = jnp.zeros(v.shape[:-2] + (out_len,), v.dtype)
+    out = out.at[..., idx.reshape(-1)].add(flat)
+    if axis == 0:
+        out = jnp.moveaxis(out, -1, 0)
+    return Tensor(out)
+
+
+def _get_window(window, n_fft, dtype):
+    if window is None:
+        return jnp.ones((n_fft,), dtype)
+    w = _v(window)
+    return w.astype(dtype)
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode='reflect', normalized=False, onesided=True,
+         name=None):
+    v = _v(x)
+    squeeze = False
+    if v.ndim == 1:
+        v, squeeze = v[None], True
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    w = _get_window(window, win_length, v.dtype)
+    if win_length < n_fft:   # center-pad window to n_fft
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    if center:
+        v = jnp.pad(v, [(0, 0)] * (v.ndim - 1) + [(n_fft // 2, n_fft // 2)],
+                    mode=pad_mode)
+    frames = _v(frame(Tensor(v), n_fft, hop_length, axis=-1))
+    frames = frames * w[:, None]
+    frames = jnp.moveaxis(frames, -1, -2)        # (..., n_frames, n_fft)
+    if onesided:
+        spec = jnp.fft.rfft(frames, axis=-1)
+    else:
+        spec = jnp.fft.fft(frames, axis=-1)
+    spec = jnp.moveaxis(spec, -1, -2)            # (..., n_bins, n_frames)
+    if normalized:
+        spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+    if squeeze:
+        spec = spec[0]
+    return Tensor(spec)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    v = _v(x)
+    squeeze = False
+    if v.ndim == 2:
+        v, squeeze = v[None], True
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    rdt = jnp.finfo(jnp.result_type(v.real)).dtype
+    w = _get_window(window, win_length, rdt)
+    if win_length < n_fft:
+        lp = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lp, n_fft - win_length - lp))
+    spec = jnp.moveaxis(v, -1, -2)               # (..., n_frames, n_bins)
+    if normalized:
+        spec = spec * jnp.sqrt(jnp.asarray(n_fft, rdt))
+    if onesided:
+        frames = jnp.fft.irfft(spec, n=n_fft, axis=-1)
+    else:
+        frames = jnp.fft.ifft(spec, n=n_fft, axis=-1)
+        if not return_complex:
+            frames = frames.real
+    frames = frames * w                           # windowed synthesis
+    frames_t = jnp.moveaxis(frames, -1, -2)       # (..., n_fft, n_frames)
+    sig = _v(overlap_add(Tensor(frames_t), hop_length, axis=-1))
+    wsq = jnp.broadcast_to((w * w)[:, None], frames_t.shape[-2:])
+    norm = _v(overlap_add(Tensor(wsq), hop_length, axis=-1))
+    sig = sig / jnp.where(norm > 1e-11, norm, 1.0)
+    if center:
+        sig = sig[..., n_fft // 2: sig.shape[-1] - n_fft // 2]
+    if length is not None:
+        sig = sig[..., :length]
+    if squeeze:
+        sig = sig[0]
+    return Tensor(sig)

@@ -1,0 +1,349 @@
+"""Tests for paddle_tpu.distribution + fft + signal (reference test
+model: test/distribution/, numpy/scipy cross-check)."""
+import numpy as np
+import pytest
+import scipy.stats as st
+
+import paddle_tpu as paddle
+from paddle_tpu import distribution as D
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestNormal:
+    def test_log_prob_entropy_cdf(self):
+        d = D.Normal(1.5, 2.0)
+        ref = st.norm(1.5, 2.0)
+        xs = np.linspace(-3, 5, 7)
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(n(d.cdf(paddle.to_tensor(xs))),
+                                   ref.cdf(xs), rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(
+            n(d.icdf(paddle.to_tensor(np.array([0.1, 0.5, 0.9])))),
+            ref.ppf([0.1, 0.5, 0.9]), rtol=1e-4)
+
+    def test_sample_moments(self):
+        paddle.seed(0)
+        d = D.Normal(np.zeros(3), np.ones(3) * 2.0)
+        s = n(d.sample((20000,)))
+        assert s.shape == (20000, 3)
+        np.testing.assert_allclose(s.mean(0), 0.0, atol=0.1)
+        np.testing.assert_allclose(s.std(0), 2.0, atol=0.1)
+
+    def test_kl(self):
+        p, q = D.Normal(0.0, 1.0), D.Normal(1.0, 2.0)
+        expect = (np.log(2.0) + (1 + 1) / (2 * 4) - 0.5)
+        np.testing.assert_allclose(n(D.kl_divergence(p, q)), expect,
+                                   rtol=1e-5)
+
+
+class TestUniformCategoricalBernoulli:
+    def test_uniform(self):
+        d = D.Uniform(1.0, 3.0)
+        np.testing.assert_allclose(n(d.entropy()), np.log(2.0), rtol=1e-6)
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(2.0))),
+                                   -np.log(2.0), rtol=1e-6)
+        assert n(d.log_prob(paddle.to_tensor(5.0))) == -np.inf
+        paddle.seed(1)
+        s = n(d.sample((5000,)))
+        assert (s >= 1).all() and (s < 3).all()
+
+    def test_categorical(self):
+        w = np.array([1.0, 2.0, 3.0])
+        d = D.Categorical(w)
+        p = w / w.sum()
+        np.testing.assert_allclose(n(d.entropy()), -(p * np.log(p)).sum(),
+                                   rtol=1e-5)
+        np.testing.assert_allclose(
+            n(d.log_prob(paddle.to_tensor(np.array([0, 2])))),
+            np.log(p[[0, 2]]), rtol=1e-5)
+        paddle.seed(2)
+        s = n(d.sample((8000,)))
+        freq = np.bincount(s.astype(int), minlength=3) / 8000
+        np.testing.assert_allclose(freq, p, atol=0.03)
+
+    def test_bernoulli(self):
+        d = D.Bernoulli(0.3)
+        ref = st.bernoulli(0.3)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(n(d.mean), 0.3, rtol=1e-5)
+        np.testing.assert_allclose(
+            n(d.log_prob(paddle.to_tensor(np.array([0.0, 1.0])))),
+            ref.logpmf([0, 1]), rtol=1e-4)
+
+
+class TestGammaFamily:
+    def test_beta(self):
+        d = D.Beta(2.0, 3.0)
+        ref = st.beta(2.0, 3.0)
+        xs = np.array([0.1, 0.4, 0.8])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-4)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-4)
+        np.testing.assert_allclose(n(d.mean), ref.mean(), rtol=1e-6)
+
+    def test_gamma(self):
+        d = D.Gamma(3.0, 2.0)
+        ref = st.gamma(3.0, scale=0.5)
+        xs = np.array([0.5, 1.0, 2.5])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-4)
+        np.testing.assert_allclose(n(d.cdf(paddle.to_tensor(xs))),
+                                   ref.cdf(xs), rtol=1e-5)
+
+    def test_dirichlet(self):
+        a = np.array([1.0, 2.0, 3.0])
+        d = D.Dirichlet(a)
+        ref = st.dirichlet(a)
+        x = np.array([0.2, 0.3, 0.5])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(x))),
+                                   ref.logpdf(x), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-4)
+        paddle.seed(3)
+        s = n(d.sample((2000,)))
+        np.testing.assert_allclose(s.sum(-1), 1.0, atol=1e-5)
+        np.testing.assert_allclose(s.mean(0), a / a.sum(), atol=0.05)
+
+    def test_exponential(self):
+        d = D.Exponential(2.0)
+        ref = st.expon(scale=0.5)
+        xs = np.array([0.1, 1.0, 3.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+
+
+class TestHeavyTailsAndDiscrete:
+    def test_laplace(self):
+        d = D.Laplace(0.5, 2.0)
+        ref = st.laplace(0.5, 2.0)
+        xs = np.linspace(-4, 5, 7)
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.cdf(paddle.to_tensor(xs))),
+                                   ref.cdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+
+    def test_cauchy(self):
+        d = D.Cauchy(0.0, 1.0)
+        ref = st.cauchy()
+        xs = np.array([-2.0, 0.0, 2.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.cdf(paddle.to_tensor(xs))),
+                                   ref.cdf(xs), rtol=1e-5)
+
+    def test_gumbel(self):
+        d = D.Gumbel(1.0, 2.0)
+        ref = st.gumbel_r(1.0, 2.0)
+        xs = np.array([-1.0, 1.0, 4.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+        np.testing.assert_allclose(n(d.mean), ref.mean(), rtol=1e-5)
+
+    def test_poisson_geometric_binomial(self):
+        d = D.Poisson(4.0)
+        ref = st.poisson(4.0)
+        ks = np.array([0.0, 2.0, 7.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(ks))),
+                                   ref.logpmf(ks), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-3)
+
+        g = D.Geometric(0.25)
+        # paddle counts failures (support from 0); scipy from 1
+        gref = st.geom(0.25, loc=-1)
+        np.testing.assert_allclose(n(g.log_prob(paddle.to_tensor(ks))),
+                                   gref.logpmf(ks), rtol=1e-5)
+
+        b = D.Binomial(10, 0.3)
+        bref = st.binom(10, 0.3)
+        np.testing.assert_allclose(n(b.log_prob(paddle.to_tensor(ks))),
+                                   bref.logpmf(ks), rtol=1e-4)
+        np.testing.assert_allclose(n(b.entropy()), bref.entropy(),
+                                   rtol=1e-4)
+
+    def test_lognormal(self):
+        d = D.LogNormal(0.5, 0.8)
+        ref = st.lognorm(s=0.8, scale=np.exp(0.5))
+        xs = np.array([0.5, 1.0, 3.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.mean), ref.mean(), rtol=1e-5)
+        np.testing.assert_allclose(n(d.variance), ref.var(), rtol=1e-5)
+
+
+class TestMultivariateAndWrappers:
+    def test_mvn(self):
+        mu = np.array([1.0, -1.0])
+        cov = np.array([[2.0, 0.5], [0.5, 1.0]])
+        d = D.MultivariateNormal(mu, covariance_matrix=cov)
+        ref = st.multivariate_normal(mu, cov)
+        xs = np.array([[0.0, 0.0], [1.0, -1.0], [2.0, 1.0]])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(), rtol=1e-5)
+        paddle.seed(4)
+        s = n(d.sample((20000,)))
+        np.testing.assert_allclose(s.mean(0), mu, atol=0.06)
+        np.testing.assert_allclose(np.cov(s.T), cov, atol=0.1)
+
+    def test_multinomial(self):
+        p = np.array([0.2, 0.3, 0.5])
+        d = D.Multinomial(10, p)
+        ref = st.multinomial(10, p)
+        x = np.array([2.0, 3.0, 5.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(x))),
+                                   ref.logpmf(x), rtol=1e-5)
+        np.testing.assert_allclose(n(d.entropy()), ref.entropy(),
+                                   rtol=1e-4)
+        paddle.seed(5)
+        s = n(d.sample((500,)))
+        assert s.shape == (500, 3)
+        np.testing.assert_allclose(s.sum(-1), 10.0)
+
+    def test_independent(self):
+        base = D.Normal(np.zeros((4, 3)), np.ones((4, 3)))
+        d = D.Independent(base, 1)
+        assert d.batch_shape == (4,) and d.event_shape == (3,)
+        x = np.random.RandomState(0).randn(4, 3)
+        np.testing.assert_allclose(
+            n(d.log_prob(paddle.to_tensor(x))),
+            n(base.log_prob(paddle.to_tensor(x))).sum(-1), rtol=1e-6)
+
+    def test_transformed(self):
+        base = D.Normal(0.0, 1.0)
+        d = D.TransformedDistribution(base, [D.AffineTransform(1.0, 3.0)])
+        ref = st.norm(1.0, 3.0)
+        xs = np.array([-2.0, 1.0, 4.0])
+        np.testing.assert_allclose(n(d.log_prob(paddle.to_tensor(xs))),
+                                   ref.logpdf(xs), rtol=1e-5)
+
+    def test_transforms_roundtrip(self):
+        x = np.random.RandomState(1).randn(5)
+        for t in [D.ExpTransform(), D.TanhTransform(),
+                  D.SigmoidTransform(), D.AffineTransform(0.5, 2.0),
+                  D.PowerTransform(2.0)]:
+            inp = np.abs(x) + 0.5 if isinstance(t, D.PowerTransform) else x
+            y = t.forward(paddle.to_tensor(inp))
+            back = n(t.inverse(y))
+            np.testing.assert_allclose(back, inp, rtol=1e-4, atol=1e-5)
+
+    def test_stickbreaking(self):
+        t = D.StickBreakingTransform()
+        x = np.random.RandomState(2).randn(4)
+        y = n(t.forward(paddle.to_tensor(x)))
+        assert y.shape == (5,)
+        np.testing.assert_allclose(y.sum(), 1.0, rtol=1e-5)
+        np.testing.assert_allclose(n(t.inverse(paddle.to_tensor(y))), x,
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_kl_registry(self):
+        for p, q, sp in [
+            (D.Beta(2., 3.), D.Beta(3., 2.), None),
+            (D.Gamma(2., 1.), D.Gamma(3., 2.), None),
+            (D.Exponential(1.), D.Exponential(2.), None),
+            (D.Categorical(np.array([1., 1.])),
+             D.Categorical(np.array([1., 3.])), None),
+        ]:
+            kl = n(D.kl_divergence(p, q))
+            assert np.isfinite(kl).all() and (kl >= -1e-6).all()
+        # mc cross-check for beta
+        paddle.seed(6)
+        p, q = D.Beta(2., 3.), D.Beta(3., 2.)
+        s = p.sample((50000,))
+        mc = (n(p.log_prob(s)) - n(q.log_prob(s))).mean()
+        np.testing.assert_allclose(n(D.kl_divergence(p, q)), mc, atol=0.03)
+
+    def test_kl_dispatch_prefers_most_specific(self):
+        from paddle_tpu.distribution import kl as klmod
+        calls = []
+        key = (D.ExponentialFamily, D.ExponentialFamily)
+        klmod._REGISTRY[key] = lambda p, q: calls.append("generic")
+        try:
+            out = D.kl_divergence(D.Gamma(2., 1.), D.Gamma(3., 2.))
+            assert not calls, "generic fallback used over exact Gamma KL"
+            assert np.isfinite(n(out)).all()
+        finally:
+            del klmod._REGISTRY[key]
+
+    def test_probs_is_parameter_tensor(self):
+        # paddle parity: Bernoulli/Geometric/Binomial .probs is the
+        # parameter, not the base class's pmf-evaluation method
+        np.testing.assert_allclose(n(D.Bernoulli(0.3).probs), 0.3)
+        np.testing.assert_allclose(n(D.Geometric(0.25).probs), 0.25)
+        np.testing.assert_allclose(n(D.Binomial(5, 0.4).probs), 0.4)
+
+    def test_chain_ldj_mixed_event_rank(self):
+        c = D.ChainTransform([D.AffineTransform(0., 2.),
+                              D.StickBreakingTransform()])
+        x = np.random.RandomState(0).randn(4).astype(np.float32)
+        ldj = n(c.forward_log_det_jacobian(paddle.to_tensor(x)))
+        assert ldj.shape == ()  # scalar: elementwise ldj summed over event
+
+    def test_ihfft2(self):
+        from paddle_tpu import fft
+        x2 = np.random.RandomState(0).randn(4, 6).astype(np.float32)
+        ref = np.fft.ifft(np.fft.ihfft(x2, axis=-1), axis=0)
+        np.testing.assert_allclose(n(fft.ihfft2(paddle.to_tensor(x2))),
+                                   ref, rtol=1e-4, atol=1e-5)
+
+    def test_frame_too_short_raises(self):
+        from paddle_tpu import signal
+        with pytest.raises(ValueError):
+            signal.frame(paddle.to_tensor(np.zeros(3, np.float32)), 8, 2)
+
+
+class TestFFT:
+    def test_fft_roundtrip_and_numpy(self):
+        x = np.random.RandomState(0).randn(4, 16).astype(np.float32)
+        from paddle_tpu import fft
+        np.testing.assert_allclose(n(fft.fft(paddle.to_tensor(x))),
+                                   np.fft.fft(x), rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(n(fft.rfft(paddle.to_tensor(x))),
+                                   np.fft.rfft(x), rtol=1e-4, atol=1e-4)
+        y = fft.ifft(fft.fft(paddle.to_tensor(x)))
+        np.testing.assert_allclose(n(y).real, x, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(
+            n(fft.fftshift(fft.fftfreq(8))),
+            np.fft.fftshift(np.fft.fftfreq(8)), rtol=1e-6)
+        np.testing.assert_allclose(n(fft.fft2(paddle.to_tensor(x))),
+                                   np.fft.fft2(x), rtol=1e-3, atol=1e-3)
+
+    def test_hfft(self):
+        from paddle_tpu import fft
+        x = np.random.RandomState(1).randn(9).astype(np.float32) \
+            + 1j * np.random.RandomState(2).randn(9).astype(np.float32)
+        np.testing.assert_allclose(n(fft.hfft(paddle.to_tensor(x))),
+                                   np.fft.hfft(x), rtol=1e-3, atol=1e-3)
+
+
+class TestSignal:
+    def test_frame_overlap_add(self):
+        from paddle_tpu import signal
+        x = np.arange(16, dtype=np.float32)
+        f = n(signal.frame(paddle.to_tensor(x), 4, 2))
+        assert f.shape == (4, 7)
+        np.testing.assert_allclose(f[:, 0], x[:4])
+        np.testing.assert_allclose(f[:, 1], x[2:6])
+        # overlap_add of disjoint frames (hop == frame_length) restores
+        f2 = n(signal.frame(paddle.to_tensor(x), 4, 4))
+        back = n(signal.overlap_add(paddle.to_tensor(f2), 4))
+        np.testing.assert_allclose(back, x)
+
+    def test_stft_istft_roundtrip(self):
+        from paddle_tpu import signal
+        rng = np.random.RandomState(3)
+        x = rng.randn(2, 512).astype(np.float32)
+        win = np.hanning(128).astype(np.float32)
+        spec = signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                           window=paddle.to_tensor(win))
+        assert n(spec).shape == (2, 65, 512 // 32 + 1)
+        back = signal.istft(spec, n_fft=128, hop_length=32,
+                            window=paddle.to_tensor(win), length=512)
+        np.testing.assert_allclose(n(back), x, atol=1e-3)
