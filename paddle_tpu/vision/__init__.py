@@ -1,3 +1,5 @@
 """paddle_tpu.vision — models/transforms/datasets
 (parity: /root/reference/python/paddle/vision/)."""
 from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401

@@ -1,0 +1,78 @@
+"""Cifar10/100 (parity:
+/root/reference/python/paddle/vision/datasets/cifar.py).
+
+Reads the python-pickle batch format from a local tar.gz (or extracted
+directory). No network access.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["Cifar10", "Cifar100"]
+
+
+class Cifar10(Dataset):
+    _archive = "cifar-10-python.tar.gz"
+    _train_members = [f"data_batch_{i}" for i in range(1, 6)]
+    _test_members = ["test_batch"]
+    _label_key = b"labels"
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend="cv2"):
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        self.mode = mode
+        self.transform = transform
+        if data_file is None:
+            data_file = os.path.join(
+                os.environ.get("PADDLE_TPU_DATA_HOME",
+                               os.path.expanduser("~/.cache/paddle_tpu")),
+                self._archive)
+        if not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{data_file} not found; place the archive locally "
+                "(no download in this environment)")
+        members = self._train_members if mode == "train" \
+            else self._test_members
+        datas, labels = [], []
+        if os.path.isdir(data_file):
+            for m in members:
+                with open(os.path.join(data_file, m), "rb") as f:
+                    batch = pickle.load(f, encoding="bytes")
+                datas.append(batch[b"data"])
+                labels.extend(batch[self._label_key])
+        else:
+            with tarfile.open(data_file, "r:*") as tar:
+                for info in tar.getmembers():
+                    base = os.path.basename(info.name)
+                    if base in members:
+                        batch = pickle.load(tar.extractfile(info),
+                                            encoding="bytes")
+                        datas.append(batch[b"data"])
+                        labels.extend(batch[self._label_key])
+        data = np.concatenate(datas, 0)
+        self.images = data.reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1)
+        self.labels = np.asarray(labels, dtype=np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class Cifar100(Cifar10):
+    _archive = "cifar-100-python.tar.gz"
+    _train_members = ["train"]
+    _test_members = ["test"]
+    _label_key = b"fine_labels"

@@ -1,0 +1,94 @@
+"""DatasetFolder / ImageFolder (parity:
+/root/reference/python/paddle/vision/datasets/folder.py)."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder"]
+
+IMG_EXTENSIONS = ('.jpg', '.jpeg', '.png', '.ppm', '.bmp', '.pgm',
+                  '.tif', '.tiff', '.webp', '.npy')
+
+
+def default_loader(path):
+    if path.endswith(".npy"):
+        return np.load(path)
+    from PIL import Image
+    with open(path, "rb") as f:
+        return np.asarray(Image.open(f).convert("RGB"))
+
+
+def is_image_file(filename):
+    return filename.lower().endswith(IMG_EXTENSIONS)
+
+
+class DatasetFolder(Dataset):
+    """root/class_x/xxx.png layout → (image, class_index) samples."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        classes = sorted(d.name for d in os.scandir(root) if d.is_dir())
+        if not classes:
+            raise RuntimeError(f"no class folders found in {root}")
+        self.classes = classes
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for dirpath, _, filenames in sorted(os.walk(cdir)):
+                for fn in sorted(filenames):
+                    path = os.path.join(dirpath, fn)
+                    if valid(path):
+                        self.samples.append((path, self.class_to_idx[c]))
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat folder of images → (image,) samples (no labels)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.loader = loader or default_loader
+        self.transform = transform
+        extensions = extensions or IMG_EXTENSIONS
+        valid = is_valid_file or (
+            lambda p: p.lower().endswith(tuple(extensions)))
+        self.samples = []
+        for dirpath, _, filenames in sorted(os.walk(root)):
+            for fn in sorted(filenames):
+                path = os.path.join(dirpath, fn)
+                if valid(path):
+                    self.samples.append(path)
+        if not self.samples:
+            raise RuntimeError(f"no valid files found under {root}")
+
+    def __getitem__(self, idx):
+        img = self.loader(self.samples[idx])
+        if self.transform is not None:
+            img = self.transform(img)
+        return [img]
+
+    def __len__(self):
+        return len(self.samples)

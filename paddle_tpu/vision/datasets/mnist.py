@@ -1,0 +1,82 @@
+"""MNIST / FashionMNIST (parity:
+/root/reference/python/paddle/vision/datasets/mnist.py).
+
+Reads the standard idx-ubyte files (optionally gzipped). No network:
+``image_path``/``label_path`` must point at local files (the zero-egress
+TPU pods mount datasets read-only).
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST"]
+
+
+def _open(path):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rb")
+    return open(path, "rb")
+
+
+def _read_idx_images(path):
+    with _open(path) as f:
+        magic, num, rows, cols = struct.unpack(">IIII", f.read(16))
+        if magic != 2051:
+            raise ValueError(f"{path}: bad idx image magic {magic}")
+        data = np.frombuffer(f.read(num * rows * cols), dtype=np.uint8)
+    return data.reshape(num, rows, cols)
+
+
+def _read_idx_labels(path):
+    with _open(path) as f:
+        magic, num = struct.unpack(">II", f.read(8))
+        if magic != 2049:
+            raise ValueError(f"{path}: bad idx label magic {magic}")
+        return np.frombuffer(f.read(num), dtype=np.uint8)
+
+
+class MNIST(Dataset):
+    NAME = "mnist"
+
+    def __init__(self, image_path=None, label_path=None, mode="train",
+                 transform=None, download=False, backend="cv2"):
+        if mode not in ("train", "test"):
+            raise ValueError("mode must be 'train' or 'test'")
+        self.mode = mode
+        self.transform = transform
+        self.backend = backend
+        if image_path is None or label_path is None:
+            root = os.environ.get(
+                "PADDLE_TPU_DATA_HOME",
+                os.path.expanduser(f"~/.cache/paddle_tpu/{self.NAME}"))
+            stem = "train" if mode == "train" else "t10k"
+            image_path = image_path or os.path.join(
+                root, f"{stem}-images-idx3-ubyte.gz")
+            label_path = label_path or os.path.join(
+                root, f"{stem}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"{image_path} not found; place the idx files locally "
+                "(no download in this environment)")
+        self.images = _read_idx_images(image_path)
+        self.labels = _read_idx_labels(label_path)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        label = np.asarray([self.labels[idx]], dtype=np.int64)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return len(self.images)
+
+
+class FashionMNIST(MNIST):
+    NAME = "fashion-mnist"

@@ -1,0 +1,122 @@
+"""DenseNet (parity:
+/root/reference/python/paddle/vision/models/densenet.py)."""
+from __future__ import annotations
+
+from ...tensor.manipulation import concat
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, LayerList, Linear, MaxPool2D, ReLU,
+                   Sequential)
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+    264: (64, 32, [6, 12, 64, 48]),
+}
+
+
+class DenseLayer(Layer):
+    def __init__(self, in_c, growth_rate, bn_size, dropout=0.0):
+        super().__init__()
+        self.norm1 = BatchNorm2D(in_c)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(in_c, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout > 0 else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return concat([x, out], axis=1)
+
+
+class DenseBlock(Layer):
+    def __init__(self, num_layers, in_c, growth_rate, bn_size,
+                 dropout=0.0):
+        super().__init__()
+        self.layers = LayerList([
+            DenseLayer(in_c + i * growth_rate, growth_rate, bn_size,
+                       dropout)
+            for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class Transition(Sequential):
+    def __init__(self, in_c, out_c):
+        super().__init__(
+            BatchNorm2D(in_c), ReLU(),
+            Conv2D(in_c, out_c, 1, bias_attr=False),
+            AvgPool2D(2, 2))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0,
+                 num_classes=1000, with_pool=True):
+        super().__init__()
+        num_init, growth_rate, block_cfg = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv = Sequential(
+            Conv2D(3, num_init, 7, stride=2, padding=3, bias_attr=False),
+            BatchNorm2D(num_init), ReLU(), MaxPool2D(3, 2, padding=1))
+        blocks = []
+        ch = num_init
+        for i, n in enumerate(block_cfg):
+            blocks.append(DenseBlock(n, ch, growth_rate, bn_size, dropout))
+            ch = ch + n * growth_rate
+            if i != len(block_cfg) - 1:
+                blocks.append(Transition(ch, ch // 2))
+                ch = ch // 2
+        self.blocks = Sequential(*blocks)
+        self.norm = BatchNorm2D(ch)
+        self.relu = ReLU()
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.classifier = Linear(ch, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm(self.blocks(self.conv(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    return _densenet(201, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    return _densenet(264, **kwargs)

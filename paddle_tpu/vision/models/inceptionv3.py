@@ -1,0 +1,144 @@
+"""InceptionV3 (parity:
+/root/reference/python/paddle/vision/models/inceptionv3.py)."""
+from __future__ import annotations
+
+from ...tensor.manipulation import concat
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["InceptionV3", "inception_v3"]
+
+
+class ConvBN(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                   bias_attr=False),
+            BatchNorm2D(out_c), ReLU())
+
+
+class InceptionA(Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1x1 = ConvBN(in_c, 64, 1)
+        self.b5x5 = Sequential(ConvBN(in_c, 48, 1),
+                               ConvBN(48, 64, 5, padding=2))
+        self.b3x3dbl = Sequential(ConvBN(in_c, 64, 1),
+                                  ConvBN(64, 96, 3, padding=1),
+                                  ConvBN(96, 96, 3, padding=1))
+        self.bpool = Sequential(AvgPool2D(3, 1, padding=1),
+                                ConvBN(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return concat([self.b1x1(x), self.b5x5(x), self.b3x3dbl(x),
+                       self.bpool(x)], axis=1)
+
+
+class InceptionB(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3x3 = ConvBN(in_c, 384, 3, stride=2)
+        self.b3x3dbl = Sequential(ConvBN(in_c, 64, 1),
+                                  ConvBN(64, 96, 3, padding=1),
+                                  ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3x3(x), self.b3x3dbl(x), self.pool(x)],
+                      axis=1)
+
+
+class InceptionC(Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1x1 = ConvBN(in_c, 192, 1)
+        self.b7x7 = Sequential(
+            ConvBN(in_c, c7, 1),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7x7dbl = Sequential(
+            ConvBN(in_c, c7, 1),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+            ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+            ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bpool = Sequential(AvgPool2D(3, 1, padding=1),
+                                ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        return concat([self.b1x1(x), self.b7x7(x), self.b7x7dbl(x),
+                       self.bpool(x)], axis=1)
+
+
+class InceptionD(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3x3 = Sequential(ConvBN(in_c, 192, 1),
+                               ConvBN(192, 320, 3, stride=2))
+        self.b7x7x3 = Sequential(
+            ConvBN(in_c, 192, 1),
+            ConvBN(192, 192, (1, 7), padding=(0, 3)),
+            ConvBN(192, 192, (7, 1), padding=(3, 0)),
+            ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, 2)
+
+    def forward(self, x):
+        return concat([self.b3x3(x), self.b7x7x3(x), self.pool(x)],
+                      axis=1)
+
+
+class InceptionE(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1x1 = ConvBN(in_c, 320, 1)
+        self.b3x3_1 = ConvBN(in_c, 384, 1)
+        self.b3x3_2a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3_2b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3x3dbl_1 = Sequential(ConvBN(in_c, 448, 1),
+                                    ConvBN(448, 384, 3, padding=1))
+        self.b3x3dbl_2a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3x3dbl_2b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bpool = Sequential(AvgPool2D(3, 1, padding=1),
+                                ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        b3 = self.b3x3_1(x)
+        b3 = concat([self.b3x3_2a(b3), self.b3x3_2b(b3)], axis=1)
+        bd = self.b3x3dbl_1(x)
+        bd = concat([self.b3x3dbl_2a(bd), self.b3x3dbl_2b(bd)], axis=1)
+        return concat([self.b1x1(x), b3, bd, self.bpool(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1), MaxPool2D(3, 2),
+            ConvBN(64, 80, 1), ConvBN(80, 192, 3), MaxPool2D(3, 2))
+        self.blocks = Sequential(
+            InceptionA(192, 32), InceptionA(256, 64), InceptionA(288, 64),
+            InceptionB(288),
+            InceptionC(768, 128), InceptionC(768, 160),
+            InceptionC(768, 160), InceptionC(768, 192),
+            InceptionD(768),
+            InceptionE(1280), InceptionE(2048))
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.drop = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.drop(x.flatten(1)))
+        return x
+
+
+def inception_v3(pretrained=False, **kwargs):
+    return InceptionV3(**kwargs)

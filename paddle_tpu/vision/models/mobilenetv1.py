@@ -1,0 +1,75 @@
+"""MobileNetV1 (parity:
+/root/reference/python/paddle/vision/models/mobilenetv1.py).
+
+Depthwise convs map to XLA's grouped-convolution HLO; on TPU these lower
+to the MXU with feature-group count = channels.
+"""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   ReLU, Sequential)
+
+__all__ = ["MobileNetV1", "mobilenet_v1"]
+
+
+class ConvBNLayer(Layer):
+    def __init__(self, in_c, out_c, kernel, stride, padding, groups=1):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=padding, groups=groups, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class DepthwiseSeparable(Layer):
+    def __init__(self, in_c, out_c1, out_c2, num_groups, stride, scale):
+        super().__init__()
+        self.dw = ConvBNLayer(int(in_c * scale), int(out_c1 * scale), 3,
+                              stride, 1, groups=int(num_groups * scale))
+        self.pw = ConvBNLayer(int(out_c1 * scale), int(out_c2 * scale),
+                              1, 1, 0)
+
+    def forward(self, x):
+        return self.pw(self.dw(x))
+
+
+class MobileNetV1(Layer):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.scale = scale
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+
+        self.conv1 = ConvBNLayer(3, int(32 * scale), 3, 2, 1)
+        cfg = [  # in, out1, out2, groups, stride
+            (32, 32, 64, 32, 1), (64, 64, 128, 64, 2),
+            (128, 128, 128, 128, 1), (128, 128, 256, 128, 2),
+            (256, 256, 256, 256, 1), (256, 256, 512, 256, 2),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 512, 512, 1),
+            (512, 512, 512, 512, 1), (512, 512, 1024, 512, 2),
+            (1024, 1024, 1024, 1024, 1),
+        ]
+        self.blocks = Sequential(*[
+            DepthwiseSeparable(i, o1, o2, g, s, scale)
+            for (i, o1, o2, g, s) in cfg])
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D((1, 1))
+        if num_classes > 0:
+            self.fc = Linear(int(1024 * scale), num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.conv1(x))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    return MobileNetV1(scale=scale, **kwargs)

@@ -1,0 +1,123 @@
+"""ShuffleNetV2 (parity:
+/root/reference/python/paddle/vision/models/shufflenetv2.py)."""
+from __future__ import annotations
+
+from ...tensor.manipulation import concat, reshape, split, swapaxes
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential)
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+
+
+def channel_shuffle(x, groups):
+    # tape-recorded ops so gradients flow on the eager backward path
+    n, c, h, w = x.shape
+    x = reshape(x, [n, groups, c // groups, h, w])
+    return reshape(swapaxes(x, 1, 2), [n, c, h, w])
+
+
+class ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, act=True):
+        layers = [Conv2D(in_c, out_c, kernel, stride=stride,
+                         padding=kernel // 2, groups=groups,
+                         bias_attr=False),
+                  BatchNorm2D(out_c)]
+        if act:
+            layers.append(ReLU())
+        super().__init__(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                ConvBNReLU(branch_c, branch_c, 1),
+                ConvBNReLU(branch_c, branch_c, 3, stride, branch_c,
+                           act=False),
+                ConvBNReLU(branch_c, branch_c, 1))
+        else:
+            self.branch1 = Sequential(
+                ConvBNReLU(in_c, in_c, 3, stride, in_c, act=False),
+                ConvBNReLU(in_c, branch_c, 1))
+            self.branch2 = Sequential(
+                ConvBNReLU(in_c, branch_c, 1),
+                ConvBNReLU(branch_c, branch_c, 3, stride, branch_c,
+                           act=False),
+                ConvBNReLU(branch_c, branch_c, 1))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = split(x, 2, axis=1)
+            out = concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act='relu', num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        stage_repeats = [4, 8, 4]
+        out_channels = {
+            0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+            0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+            1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
+        }[scale]
+        self.conv1 = ConvBNReLU(3, out_channels[0], 3, 2)
+        self.maxpool = MaxPool2D(3, 2, padding=1)
+        in_c = out_channels[0]
+        stages = []
+        for i, repeats in enumerate(stage_repeats):
+            out_c = out_channels[i + 1]
+            blocks = [InvertedResidual(in_c, out_c, 2)]
+            for _ in range(repeats - 1):
+                blocks.append(InvertedResidual(out_c, out_c, 1))
+            stages.append(Sequential(*blocks))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = ConvBNReLU(in_c, out_channels[-1], 1)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(out_channels[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.maxpool(self.conv1(x))))
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=2.0, **kwargs)

@@ -1,0 +1,268 @@
+"""Functional image transforms (parity:
+/root/reference/python/paddle/vision/transforms/functional.py).
+
+Host-side preprocessing: operates on numpy arrays (HWC, uint8 or float)
+or PIL Images; returns numpy. Device work stays in the model — keeping
+the input pipeline off the TPU is the TPU-native layout (feed bf16/f32
+batches, let XLA own the chip).
+"""
+from __future__ import annotations
+
+import numbers
+from typing import Sequence
+
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = [
+    "to_tensor", "hflip", "vflip", "resize", "pad", "crop", "center_crop",
+    "adjust_brightness", "adjust_contrast", "adjust_saturation",
+    "adjust_hue", "normalize", "rotate", "to_grayscale", "erase",
+]
+
+
+def _to_np(img):
+    if isinstance(img, Tensor):
+        return np.asarray(img._value)
+    try:
+        from PIL import Image
+        if isinstance(img, Image.Image):
+            return np.asarray(img)
+    except ImportError:
+        pass
+    return np.asarray(img)
+
+
+def to_tensor(pic, data_format='CHW'):
+    """uint8 HWC image → float32 tensor in [0,1], CHW by default."""
+    arr = _to_np(pic)
+    if arr.ndim == 2:
+        arr = arr[:, :, None]
+    if arr.dtype == np.uint8:
+        arr = arr.astype(np.float32) / 255.0
+    else:
+        arr = arr.astype(np.float32)
+    if data_format == 'CHW':
+        arr = np.transpose(arr, (2, 0, 1))
+    return Tensor(arr)
+
+
+def hflip(img):
+    return np.ascontiguousarray(_to_np(img)[:, ::-1])
+
+
+def vflip(img):
+    return np.ascontiguousarray(_to_np(img)[::-1])
+
+
+def _interp_resize(arr, h, w):
+    """Bilinear resize via jax.image on host numpy (small images)."""
+    import jax.image
+    squeeze = arr.ndim == 2
+    if squeeze:
+        arr = arr[:, :, None]
+    src_dtype = arr.dtype
+    out = jax.image.resize(arr.astype(np.float32),
+                           (h, w, arr.shape[2]), method="bilinear")
+    out = np.asarray(out)
+    if src_dtype == np.uint8:
+        out = np.clip(np.round(out), 0, 255).astype(np.uint8)
+    return out[:, :, 0] if squeeze else out
+
+
+def resize(img, size, interpolation='bilinear'):
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    if isinstance(size, int):
+        if h <= w:
+            nh, nw = size, int(size * w / h)
+        else:
+            nh, nw = int(size * h / w), size
+    else:
+        nh, nw = size
+    return _interp_resize(arr, nh, nw)
+
+
+def pad(img, padding, fill=0, padding_mode='constant'):
+    arr = _to_np(img)
+    if isinstance(padding, numbers.Number):
+        pl = pt = pr = pb = int(padding)
+    elif len(padding) == 2:
+        pl, pt = padding
+        pr, pb = padding
+    else:
+        pl, pt, pr, pb = padding
+    pads = [(pt, pb), (pl, pr)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == 'constant':
+        return np.pad(arr, pads, mode='constant', constant_values=fill)
+    return np.pad(arr, pads, mode=padding_mode)
+
+
+def crop(img, top, left, height, width):
+    arr = _to_np(img)
+    return arr[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _to_np(img)
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    h, w = arr.shape[:2]
+    th, tw = output_size
+    top = int(round((h - th) / 2.0))
+    left = int(round((w - tw) / 2.0))
+    return crop(arr, top, left, th, tw)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _to_np(img)
+    dt = arr.dtype
+    out = arr.astype(np.float32) * brightness_factor
+    return np.clip(out, 0, 255 if dt == np.uint8 else 1.0).astype(dt)
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _to_np(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = f.mean(axis=-1, keepdims=True).mean() if f.ndim == 3 else f.mean()
+    out = gray + contrast_factor * (f - gray)
+    return np.clip(out, 0, 255 if dt == np.uint8 else 1.0).astype(dt)
+
+
+def adjust_saturation(img, saturation_factor):
+    arr = _to_np(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = f.mean(axis=-1, keepdims=True)
+    out = gray + saturation_factor * (f - gray)
+    return np.clip(out, 0, 255 if dt == np.uint8 else 1.0).astype(dt)
+
+
+def adjust_hue(img, hue_factor):
+    if not (-0.5 <= hue_factor <= 0.5):
+        raise ValueError("hue_factor must be in [-0.5, 0.5]")
+    arr = _to_np(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32) / (255.0 if dt == np.uint8 else 1.0)
+    r, g, b = f[..., 0], f[..., 1], f[..., 2]
+    maxc = f[..., :3].max(-1)
+    minc = f[..., :3].min(-1)
+    v = maxc
+    delta = maxc - minc
+    s = np.where(maxc > 0, delta / np.maximum(maxc, 1e-12), 0.0)
+    rc = np.where(delta > 0, (maxc - r) / np.maximum(delta, 1e-12), 0.0)
+    gc = np.where(delta > 0, (maxc - g) / np.maximum(delta, 1e-12), 0.0)
+    bc = np.where(delta > 0, (maxc - b) / np.maximum(delta, 1e-12), 0.0)
+    h = np.where(r == maxc, bc - gc,
+                 np.where(g == maxc, 2.0 + rc - bc, 4.0 + gc - rc))
+    h = (h / 6.0) % 1.0
+    h = (h + hue_factor) % 1.0
+    i = np.floor(h * 6.0)
+    fr = h * 6.0 - i
+    p = v * (1.0 - s)
+    q = v * (1.0 - s * fr)
+    t = v * (1.0 - s * (1.0 - fr))
+    i = i.astype(np.int32) % 6
+    conds = [i == k for k in range(6)]
+    r2 = np.select(conds, [v, q, p, p, t, v])
+    g2 = np.select(conds, [t, v, v, q, p, p])
+    b2 = np.select(conds, [p, p, t, v, v, q])
+    out = np.stack([r2, g2, b2], axis=-1)
+    if dt == np.uint8:
+        out = np.clip(np.round(out * 255.0), 0, 255).astype(np.uint8)
+    else:
+        out = out.astype(dt)
+    return out
+
+
+def normalize(img, mean, std, data_format='CHW', to_rgb=False):
+    arr = _to_np(img).astype(np.float32)
+    if to_rgb:  # input is BGR (cv2-style): flip the channel axis
+        arr = arr[::-1] if data_format == 'CHW' else arr[..., ::-1]
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == 'CHW':
+        mean = mean.reshape(-1, 1, 1)
+        std = std.reshape(-1, 1, 1)
+    return (arr - mean) / std
+
+
+def rotate(img, angle, interpolation='nearest', expand=False, center=None,
+           fill=0):
+    """Rotate by angle (degrees, counter-clockwise) about the center.
+
+    expand=True enlarges the canvas to hold the whole rotated image
+    (only valid with center=None, like the reference).
+    """
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    rad = np.deg2rad(angle)
+    cos, sin = np.cos(rad), np.sin(rad)
+    if expand:
+        oh = int(np.ceil(abs(h * cos) + abs(w * sin)))
+        ow = int(np.ceil(abs(w * cos) + abs(h * sin)))
+    else:
+        oh, ow = h, w
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    ocy, ocx = ((oh - 1) / 2.0, (ow - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    yy, xx = np.meshgrid(np.arange(oh), np.arange(ow), indexing='ij')
+    # inverse map: output pixel -> source pixel
+    xs = cos * (xx - ocx) + sin * (yy - ocy) + cx
+    ys = -sin * (xx - ocx) + cos * (yy - ocy) + cy
+    if interpolation == 'bilinear':
+        x0 = np.floor(xs).astype(np.int64)
+        y0 = np.floor(ys).astype(np.int64)
+        fx, fy = xs - x0, ys - y0
+        acc = 0.0
+        wsum = 0.0
+        for dy, wy in ((0, 1 - fy), (1, fy)):
+            for dx, wx in ((0, 1 - fx), (1, fx)):
+                xi = np.clip(x0 + dx, 0, w - 1)
+                yi = np.clip(y0 + dy, 0, h - 1)
+                inside = ((x0 + dx >= 0) & (x0 + dx < w)
+                          & (y0 + dy >= 0) & (y0 + dy < h))
+                wgt = (wy * wx) * inside
+                pix = arr[yi, xi].astype(np.float32)
+                if arr.ndim == 3:
+                    wgt = wgt[..., None]
+                acc = acc + wgt * pix
+                wsum = wsum + wgt
+        valid = wsum > 1e-8
+        out_f = np.where(valid, acc / np.maximum(wsum, 1e-8),
+                         np.float32(fill))
+        if arr.dtype == np.uint8:
+            return np.clip(np.round(out_f), 0, 255).astype(np.uint8)
+        return out_f.astype(arr.dtype)
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out_shape = (oh, ow) + arr.shape[2:]
+    out = np.full(out_shape, fill, dtype=arr.dtype)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _to_np(img)
+    dt = arr.dtype
+    f = arr.astype(np.float32)
+    gray = (0.299 * f[..., 0] + 0.587 * f[..., 1] + 0.114 * f[..., 2])
+    gray = gray[..., None]
+    if num_output_channels == 3:
+        gray = np.repeat(gray, 3, axis=-1)
+    if dt == np.uint8:
+        gray = np.clip(np.round(gray), 0, 255).astype(np.uint8)
+    return gray.astype(dt) if dt != np.uint8 else gray
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    arr = _to_np(img)
+    # PIL/jax-backed arrays are read-only; inplace only works on a
+    # writeable ndarray input
+    out = arr if (inplace and arr.flags.writeable) else arr.copy()
+    out[i:i + h, j:j + w] = v
+    return out

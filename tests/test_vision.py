@@ -1,0 +1,232 @@
+"""Vision zoo / transforms / datasets tests (reference model:
+test/legacy_test/test_vision_models.py, test_transforms.py)."""
+import gzip
+import os
+import pickle
+import struct
+import tarfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import datasets, models, transforms
+from paddle_tpu.vision.transforms import functional as TF
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+def _rand(shape):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(*shape).astype(np.float32) * 0.1)
+
+
+class TestModelZoo:
+    @pytest.mark.parametrize("ctor,size,classes", [
+        (models.LeNet, 28, 10),
+        (lambda num_classes: models.mobilenet_v2(
+            scale=0.25, num_classes=num_classes), 96, 7),
+        (lambda num_classes: models.mobilenet_v3_small(
+            scale=0.5, num_classes=num_classes), 96, 7),
+        (lambda num_classes: models.shufflenet_v2_x0_25(
+            num_classes=num_classes), 96, 7),
+        (lambda num_classes: models.squeezenet1_1(
+            num_classes=num_classes), 96, 7),
+    ])
+    def test_small_model_forward(self, ctor, size, classes):
+        model = ctor(num_classes=classes)
+        model.eval()
+        ch = 1 if isinstance(model, models.LeNet) else 3
+        out = model(_rand((2, ch, size, size)))
+        assert tuple(out.shape) == (2, classes)
+        assert np.isfinite(n(out)).all()
+
+    def test_mobilenet_v1(self):
+        m = models.mobilenet_v1(scale=0.25, num_classes=5)
+        m.eval()
+        out = m(_rand((1, 3, 96, 96)))
+        assert tuple(out.shape) == (1, 5)
+
+    def test_densenet(self):
+        m = models.densenet121(num_classes=6)
+        m.eval()
+        out = m(_rand((1, 3, 64, 64)))
+        assert tuple(out.shape) == (1, 6)
+        assert np.isfinite(n(out)).all()
+
+    def test_googlenet_eval_and_train_aux(self):
+        m = models.googlenet(num_classes=4)
+        m.eval()
+        out, aux1, aux2 = m(_rand((1, 3, 96, 96)))
+        assert tuple(out.shape) == (1, 4)
+        assert aux1 is None and aux2 is None
+        m.train()
+        out, aux1, aux2 = m(_rand((1, 3, 224, 224)))
+        assert tuple(aux1.shape) == (1, 4)
+        assert tuple(aux2.shape) == (1, 4)
+
+    def test_inception_v3(self):
+        m = models.inception_v3(num_classes=3)
+        m.eval()
+        out = m(_rand((1, 3, 299, 299)))
+        assert tuple(out.shape) == (1, 3)
+
+    def test_vgg_alexnet(self):
+        for m in [models.vgg11(num_classes=3), models.alexnet(num_classes=3)]:
+            m.eval()
+            out = m(_rand((1, 3, 224, 224)))
+            assert tuple(out.shape) == (1, 3)
+            assert np.isfinite(n(out)).all()
+
+    def test_vgg_nonstandard_size(self):
+        # adaptive pool before the classifier handles any input size
+        m = models.vgg11(num_classes=3)
+        m.eval()
+        out = m(_rand((1, 3, 256, 256)))
+        assert tuple(out.shape) == (1, 3)
+
+    def test_shufflenet_backward(self):
+        # channel_shuffle/split must stay on the autograd tape
+        m = models.shufflenet_v2_x0_25(num_classes=4)
+        m.train()
+        out = m(_rand((1, 3, 64, 64)))
+        loss = out.sum()
+        loss.backward()
+        grads = [p.grad for p in m.parameters()]
+        assert any(g is not None and np.abs(n(g)).sum() > 0
+                   for g in grads)
+
+
+class TestTransforms:
+    def setup_method(self):
+        rng = np.random.RandomState(0)
+        self.img = rng.randint(0, 255, (32, 48, 3), dtype=np.uint8)
+
+    def test_functional_basics(self):
+        assert TF.hflip(self.img)[0, 0].tolist() == \
+            self.img[0, -1].tolist()
+        assert TF.vflip(self.img)[0, 0].tolist() == \
+            self.img[-1, 0].tolist()
+        r = TF.resize(self.img, (16, 24))
+        assert r.shape == (16, 24, 3)
+        r2 = TF.resize(self.img, 16)  # short side
+        assert r2.shape == (16, 24, 3)
+        c = TF.center_crop(self.img, 16)
+        assert c.shape == (16, 16, 3)
+        p = TF.pad(self.img, 2)
+        assert p.shape == (36, 52, 3)
+        t = TF.to_tensor(self.img)
+        assert tuple(t.shape) == (3, 32, 48)
+        assert 0.0 <= float(n(t).min()) and float(n(t).max()) <= 1.0
+
+    def test_color_ops(self):
+        b = TF.adjust_brightness(self.img, 1.5)
+        assert b.dtype == np.uint8 and b.mean() >= self.img.mean()
+        TF.adjust_contrast(self.img, 0.5)
+        TF.adjust_saturation(self.img, 2.0)
+        h = TF.adjust_hue(self.img, 0.1)
+        assert h.shape == self.img.shape
+        # hue=0 is identity (within rounding)
+        h0 = TF.adjust_hue(self.img, 0.0)
+        assert np.abs(h0.astype(int) - self.img.astype(int)).max() <= 1
+
+    def test_normalize_matches_numpy(self):
+        t = TF.to_tensor(self.img)
+        out = TF.normalize(t, [0.5, 0.5, 0.5], [0.5, 0.5, 0.5])
+        ref = (n(t) - 0.5) / 0.5
+        np.testing.assert_allclose(out, ref, rtol=1e-6)
+
+    def test_compose_pipeline(self):
+        pipe = transforms.Compose([
+            transforms.Resize(40),
+            transforms.RandomCrop(32),
+            transforms.RandomHorizontalFlip(0.5),
+            transforms.ColorJitter(0.1, 0.1, 0.1, 0.1),
+            transforms.ToTensor(),
+            transforms.Normalize([0.5] * 3, [0.5] * 3),
+        ])
+        out = pipe(self.img)
+        assert out.shape == (3, 32, 32)
+
+    def test_rotate_and_grayscale(self):
+        rot = TF.rotate(self.img, 90)
+        assert rot.shape == self.img.shape
+        # expand grows the canvas; 90° of a 32x48 → 48x32
+        rexp = TF.rotate(self.img, 90, expand=True)
+        assert rexp.shape[:2] == (48, 32)
+        # bilinear at 0° is identity
+        rb = TF.rotate(self.img, 0, interpolation='bilinear')
+        np.testing.assert_array_equal(rb, self.img)
+        g = TF.to_grayscale(self.img)
+        assert g.shape == (32, 48, 1)
+        g3 = TF.to_grayscale(self.img, 3)
+        assert g3.shape == (32, 48, 3)
+
+    def test_random_erasing(self):
+        t = transforms.RandomErasing(prob=1.0, value=0)
+        out = t(self.img.copy())
+        assert (out == 0).any()
+
+
+class TestDatasets:
+    def _write_mnist(self, tmpdir, n_img=10):
+        rng = np.random.RandomState(0)
+        imgs = rng.randint(0, 255, (n_img, 28, 28), dtype=np.uint8)
+        labels = rng.randint(0, 10, (n_img,), dtype=np.uint8)
+        ip = os.path.join(tmpdir, "train-images-idx3-ubyte.gz")
+        lp = os.path.join(tmpdir, "train-labels-idx1-ubyte.gz")
+        with gzip.open(ip, "wb") as f:
+            f.write(struct.pack(">IIII", 2051, n_img, 28, 28))
+            f.write(imgs.tobytes())
+        with gzip.open(lp, "wb") as f:
+            f.write(struct.pack(">II", 2049, n_img))
+            f.write(labels.tobytes())
+        return ip, lp, imgs, labels
+
+    def test_mnist(self, tmp_path):
+        ip, lp, imgs, labels = self._write_mnist(str(tmp_path))
+        ds = datasets.MNIST(image_path=ip, label_path=lp, mode="train")
+        assert len(ds) == 10
+        img, label = ds[3]
+        np.testing.assert_array_equal(img, imgs[3])
+        assert label[0] == labels[3]
+        # with transform
+        ds2 = datasets.MNIST(image_path=ip, label_path=lp,
+                             transform=transforms.ToTensor())
+        img2, _ = ds2[0]
+        assert tuple(img2.shape) == (1, 28, 28)
+
+    def test_cifar10(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.randint(0, 255, (20, 3072), dtype=np.uint8)
+        labels = rng.randint(0, 10, (20,)).tolist()
+        batch = {b"data": data, b"labels": labels}
+        d = tmp_path / "cifar-10-batches-py"
+        d.mkdir()
+        for name in [f"data_batch_{i}" for i in range(1, 6)] + \
+                ["test_batch"]:
+            with open(d / name, "wb") as f:
+                pickle.dump(batch, f)
+        tar = tmp_path / "cifar-10-python.tar.gz"
+        with tarfile.open(tar, "w:gz") as t:
+            t.add(d, arcname="cifar-10-batches-py")
+        ds = datasets.Cifar10(data_file=str(tar), mode="test")
+        assert len(ds) == 20
+        img, label = ds[0]
+        assert img.shape == (32, 32, 3)
+
+    def test_folder(self, tmp_path):
+        for cls in ["cat", "dog"]:
+            (tmp_path / cls).mkdir()
+            for i in range(3):
+                np.save(tmp_path / cls / f"{i}.npy",
+                        np.zeros((8, 8, 3), np.uint8))
+        ds = datasets.DatasetFolder(str(tmp_path))
+        assert len(ds) == 6
+        assert ds.classes == ["cat", "dog"]
+        img, target = ds[0]
+        assert img.shape == (8, 8, 3) and target == 0
+        flat = datasets.ImageFolder(str(tmp_path))
+        assert len(flat) == 6
