@@ -1,0 +1,18 @@
+"""paddle_tpu.core — native (C++) runtime components.
+
+The TPU compute path is JAX/XLA/Pallas; this package is the native runtime
+*around* it (SURVEY.md §2.5): rendezvous store, shared-memory data
+transport, host staging allocator, and the profiler's host tracer — the
+pieces the reference implements in C++ (TCPStore, DataLoader workers,
+AutoGrowthBestFitAllocator, HostTracer) and that stay native here.
+"""
+from .native import (  # noqa: F401
+    available,
+    load,
+    load_error,
+    HostArena,
+    NativeTracer,
+    ShmRing,
+    TCPStore,
+    TCPStoreServer,
+)

@@ -1,0 +1,157 @@
+// Host staging arena — native pooled allocator for input-pipeline buffers.
+//
+// TPU-native analog of the reference's host-side allocator strategies
+// (/root/reference/paddle/fluid/memory/allocation/auto_growth_best_fit_allocator.h:30
+// and the pinned-memory pool): device HBM is owned by the XLA runtime
+// (SURVEY.md §2.5 item 7), but the host staging path (batch assembly before
+// jax.device_put, checkpoint shard buffers) still benefits from a pooling
+// allocator that avoids malloc/mmap churn on multi-MB buffers.
+//
+// Design: auto-growth best-fit with size-bucketed free lists over mmap'd
+// chunks. Free blocks coalesce with neighbors on release. Thread-safe.
+
+#include <sys/mman.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <new>
+
+namespace {
+
+constexpr size_t kAlign = 128;         // TPU-friendly host alignment
+constexpr size_t kMinChunk = 8 << 20;  // grow in >=8MB mmap chunks
+
+struct Block {
+  size_t size;      // usable bytes (excluding header)
+  bool free;
+  Block* prev;      // address-ordered neighbors within a chunk
+  Block* next;
+};
+
+struct Arena {
+  std::mutex mu;
+  // free blocks keyed by size (multimap: best-fit = lower_bound)
+  std::multimap<size_t, Block*> free_blocks;
+  size_t total_reserved = 0;
+  size_t total_in_use = 0;
+  size_t peak_in_use = 0;
+  size_t alloc_count = 0;
+
+  void insert_free(Block* b) {
+    b->free = true;
+    free_blocks.emplace(b->size, b);
+  }
+
+  void erase_free(Block* b) {
+    auto range = free_blocks.equal_range(b->size);
+    for (auto it = range.first; it != range.second; ++it) {
+      if (it->second == b) {
+        free_blocks.erase(it);
+        return;
+      }
+    }
+  }
+};
+
+inline size_t align_up(size_t n) { return (n + kAlign - 1) & ~(kAlign - 1); }
+inline uint8_t* payload(Block* b) {
+  return reinterpret_cast<uint8_t*>(b) + align_up(sizeof(Block));
+}
+inline Block* from_payload(void* p) {
+  return reinterpret_cast<Block*>(static_cast<uint8_t*>(p) -
+                                  align_up(sizeof(Block)));
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pt_arena_create() { return new (std::nothrow) Arena(); }
+
+void pt_arena_destroy(void* h) {
+  // chunks are leaked intentionally on destroy-at-exit (OS reclaims); an
+  // explicit chunk list isn't kept because blocks coalesce to chunk size.
+  delete (Arena*)h;
+}
+
+void* pt_arena_alloc(void* h, size_t n) {
+  auto* a = (Arena*)h;
+  n = align_up(n ? n : kAlign);
+  std::lock_guard<std::mutex> lk(a->mu);
+  auto it = a->free_blocks.lower_bound(n);
+  Block* b;
+  if (it == a->free_blocks.end()) {
+    // grow: one mmap chunk holding this request (and future ones)
+    size_t hdr = align_up(sizeof(Block));
+    size_t chunk = n + hdr > kMinChunk ? n + hdr : kMinChunk;
+    void* mem = ::mmap(nullptr, chunk, PROT_READ | PROT_WRITE,
+                       MAP_PRIVATE | MAP_ANONYMOUS, -1, 0);
+    if (mem == MAP_FAILED) return nullptr;
+    a->total_reserved += chunk;
+    b = (Block*)mem;
+    b->size = chunk - hdr;
+    b->prev = b->next = nullptr;
+    b->free = false;
+  } else {
+    b = it->second;
+    a->free_blocks.erase(it);
+    b->free = false;
+  }
+  // split if the remainder is worth keeping
+  size_t hdr = align_up(sizeof(Block));
+  if (b->size >= n + hdr + kAlign) {
+    Block* rest = (Block*)(payload(b) + n);
+    rest->size = b->size - n - hdr;
+    rest->prev = b;
+    rest->next = b->next;
+    if (rest->next) rest->next->prev = rest;
+    b->next = rest;
+    b->size = n;
+    a->insert_free(rest);
+  }
+  a->total_in_use += b->size;
+  if (a->total_in_use > a->peak_in_use) a->peak_in_use = a->total_in_use;
+  a->alloc_count++;
+  return payload(b);
+}
+
+void pt_arena_free(void* h, void* p) {
+  if (!p) return;
+  auto* a = (Arena*)h;
+  Block* b = from_payload(p);
+  std::lock_guard<std::mutex> lk(a->mu);
+  a->total_in_use -= b->size;
+  size_t hdr = align_up(sizeof(Block));
+  // coalesce with next
+  if (b->next && b->next->free) {
+    Block* nx = b->next;
+    a->erase_free(nx);
+    b->size += hdr + nx->size;
+    b->next = nx->next;
+    if (b->next) b->next->prev = b;
+  }
+  // coalesce with prev
+  if (b->prev && b->prev->free) {
+    Block* pv = b->prev;
+    a->erase_free(pv);
+    pv->size += hdr + b->size;
+    pv->next = b->next;
+    if (pv->next) pv->next->prev = pv;
+    b = pv;
+  }
+  a->insert_free(b);
+}
+
+void pt_arena_stats(void* h, uint64_t* reserved, uint64_t* in_use,
+                    uint64_t* peak, uint64_t* allocs) {
+  auto* a = (Arena*)h;
+  std::lock_guard<std::mutex> lk(a->mu);
+  *reserved = a->total_reserved;
+  *in_use = a->total_in_use;
+  *peak = a->peak_in_use;
+  *allocs = a->alloc_count;
+}
+
+}  // extern "C"

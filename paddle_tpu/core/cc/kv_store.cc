@@ -1,0 +1,391 @@
+// TCP key-value rendezvous store — the native bootstrap service.
+//
+// TPU-native re-imagination of the reference's TCPStore
+// (/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121 and
+// socket-level MasterDaemon in tcp_utils): rank 0 hosts a small TCP server
+// holding a byte-keyed map; every rank (including 0) connects as a client.
+// Used by paddle_tpu.distributed.launch for master rendezvous and by
+// init_parallel_env as the coordination KV (the jax.distributed service
+// covers in-program collectives; this covers host-side orchestration:
+// barriers, address exchange, elastic heartbeats).
+//
+// Exposed as a C ABI consumed from Python via ctypes (no pybind11 in the
+// image). All calls are blocking with millisecond timeouts.
+//
+// Wire protocol (little-endian):
+//   request : u8 op | u32 klen | key bytes | u32 vlen | value bytes
+//   response: i64 status/num  | u32 vlen | value bytes
+// ops: 1=SET 2=GET(blocking till key exists or timeout) 3=ADD(i64 delta,
+//      returns new value) 4=CHECK(returns 1/0) 5=DELETE 6=NUM_KEYS
+//      7=COMPARE_SET(old new)
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <poll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum Op : uint8_t {
+  kSet = 1,
+  kGet = 2,
+  kAdd = 3,
+  kCheck = 4,
+  kDelete = 5,
+  kNumKeys = 6,
+  kCompareSet = 7,
+};
+
+struct Daemon {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::vector<std::thread> workers;
+  std::atomic<bool> stop{false};
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::vector<uint8_t>> data;
+
+  ~Daemon() { Shutdown(); }
+
+  void Shutdown() {
+    bool expected = false;
+    if (!stop.compare_exchange_strong(expected, true)) return;
+    if (listen_fd >= 0) {
+      ::shutdown(listen_fd, SHUT_RDWR);
+      ::close(listen_fd);
+      listen_fd = -1;
+    }
+    cv.notify_all();
+    if (accept_thread.joinable()) accept_thread.join();
+    for (auto& w : workers)
+      if (w.joinable()) w.join();
+  }
+};
+
+bool ReadFull(int fd, void* buf, size_t n, int timeout_ms) {
+  auto* p = static_cast<uint8_t*>(buf);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  while (n > 0) {
+    struct pollfd pfd = {fd, POLLIN, 0};
+    int remain = timeout_ms <= 0
+                     ? -1
+                     : (int)std::chrono::duration_cast<std::chrono::milliseconds>(
+                           deadline - std::chrono::steady_clock::now())
+                           .count();
+    if (timeout_ms > 0 && remain <= 0) return false;
+    int pr = ::poll(&pfd, 1, remain);
+    if (pr <= 0) return false;
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= (size_t)r;
+  }
+  return true;
+}
+
+bool WriteFull(int fd, const void* buf, size_t n) {
+  auto* p = static_cast<const uint8_t*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= (size_t)w;
+  }
+  return true;
+}
+
+void ServeClient(Daemon* d, int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  while (!d->stop.load()) {
+    uint8_t op;
+    if (!ReadFull(fd, &op, 1, 0)) break;
+    uint32_t klen;
+    if (!ReadFull(fd, &klen, 4, 10000)) break;
+    std::string key(klen, '\0');
+    if (klen && !ReadFull(fd, key.data(), klen, 10000)) break;
+    uint32_t vlen;
+    if (!ReadFull(fd, &vlen, 4, 10000)) break;
+    std::vector<uint8_t> val(vlen);
+    if (vlen && !ReadFull(fd, val.data(), vlen, 10000)) break;
+
+    int64_t status = 0;
+    std::vector<uint8_t> out;
+    switch (op) {
+      case kSet: {
+        std::lock_guard<std::mutex> lk(d->mu);
+        d->data[key] = std::move(val);
+        d->cv.notify_all();
+        break;
+      }
+      case kGet: {
+        // value holds i64 timeout_ms (0 = wait forever)
+        int64_t tmo = 0;
+        if (val.size() >= 8) std::memcpy(&tmo, val.data(), 8);
+        std::unique_lock<std::mutex> lk(d->mu);
+        auto pred = [&] { return d->stop.load() || d->data.count(key); };
+        bool ok;
+        if (tmo > 0)
+          ok = d->cv.wait_for(lk, std::chrono::milliseconds(tmo), pred);
+        else {
+          d->cv.wait(lk, pred);
+          ok = true;
+        }
+        if (ok && d->data.count(key)) {
+          out = d->data[key];
+        } else {
+          status = -1;  // timeout
+        }
+        break;
+      }
+      case kAdd: {
+        int64_t delta = 0;
+        if (val.size() >= 8) std::memcpy(&delta, val.data(), 8);
+        std::lock_guard<std::mutex> lk(d->mu);
+        int64_t cur = 0;
+        auto it = d->data.find(key);
+        if (it != d->data.end() && it->second.size() == 8)
+          std::memcpy(&cur, it->second.data(), 8);
+        cur += delta;
+        std::vector<uint8_t> nv(8);
+        std::memcpy(nv.data(), &cur, 8);
+        d->data[key] = nv;
+        status = cur;
+        d->cv.notify_all();
+        break;
+      }
+      case kCheck: {
+        std::lock_guard<std::mutex> lk(d->mu);
+        status = d->data.count(key) ? 1 : 0;
+        break;
+      }
+      case kDelete: {
+        std::lock_guard<std::mutex> lk(d->mu);
+        status = d->data.erase(key);
+        d->cv.notify_all();
+        break;
+      }
+      case kNumKeys: {
+        std::lock_guard<std::mutex> lk(d->mu);
+        status = (int64_t)d->data.size();
+        break;
+      }
+      case kCompareSet: {
+        // val = u32 oldlen | old | new
+        uint32_t olen = 0;
+        if (val.size() >= 4) std::memcpy(&olen, val.data(), 4);
+        std::vector<uint8_t> oldv(val.begin() + 4, val.begin() + 4 + olen);
+        std::vector<uint8_t> newv(val.begin() + 4 + olen, val.end());
+        std::lock_guard<std::mutex> lk(d->mu);
+        auto it = d->data.find(key);
+        if ((it == d->data.end() && oldv.empty()) ||
+            (it != d->data.end() && it->second == oldv)) {
+          d->data[key] = newv;
+          status = 1;
+          out = newv;
+          d->cv.notify_all();
+        } else {
+          status = 0;
+          if (it != d->data.end()) out = it->second;
+        }
+        break;
+      }
+      default:
+        status = -2;
+    }
+    uint32_t olen = (uint32_t)out.size();
+    uint8_t hdr[12];
+    std::memcpy(hdr, &status, 8);
+    std::memcpy(hdr + 8, &olen, 4);
+    if (!WriteFull(fd, hdr, 12)) break;
+    if (olen && !WriteFull(fd, out.data(), olen)) break;
+  }
+  ::close(fd);
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one request in flight per client handle
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* pt_kv_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = INADDR_ANY;
+  addr.sin_port = htons((uint16_t)port);
+  if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      ::listen(fd, 128) != 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, (sockaddr*)&addr, &alen);
+  auto* d = new Daemon();
+  d->listen_fd = fd;
+  d->port = ntohs(addr.sin_port);
+  d->accept_thread = std::thread([d] {
+    while (!d->stop.load()) {
+      int cfd = ::accept(d->listen_fd, nullptr, nullptr);
+      if (cfd < 0) {
+        if (d->stop.load()) break;
+        continue;
+      }
+      d->workers.emplace_back(ServeClient, d, cfd);
+    }
+  });
+  return d;
+}
+
+int pt_kv_server_port(void* h) { return h ? ((Daemon*)h)->port : -1; }
+
+void pt_kv_server_stop(void* h) {
+  if (!h) return;
+  auto* d = (Daemon*)h;
+  d->Shutdown();
+  delete d;
+}
+
+// ---- client ----
+void* pt_kv_connect(const char* host, int port, int timeout_ms) {
+  struct addrinfo hints {};
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  struct addrinfo* res = nullptr;
+  char ports[16];
+  snprintf(ports, sizeof(ports), "%d", port);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms : 60000);
+  while (std::chrono::steady_clock::now() < deadline) {
+    if (getaddrinfo(host, ports, &hints, &res) == 0) {
+      int fd = ::socket(res->ai_family, res->ai_socktype, res->ai_protocol);
+      if (fd >= 0 &&
+          ::connect(fd, res->ai_addr, (socklen_t)res->ai_addrlen) == 0) {
+        freeaddrinfo(res);
+        int one = 1;
+        ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+        auto* c = new Client();
+        c->fd = fd;
+        return c;
+      }
+      if (fd >= 0) ::close(fd);
+      freeaddrinfo(res);
+      res = nullptr;
+    }
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  return nullptr;
+}
+
+void pt_kv_disconnect(void* h) {
+  if (!h) return;
+  auto* c = (Client*)h;
+  if (c->fd >= 0) ::close(c->fd);
+  delete c;
+}
+
+static int64_t Request(Client* c, uint8_t op, const char* key, uint32_t klen,
+                       const uint8_t* val, uint32_t vlen, uint8_t** out,
+                       uint32_t* out_len) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  std::vector<uint8_t> req(1 + 4 + klen + 4 + vlen);
+  req[0] = op;
+  std::memcpy(req.data() + 1, &klen, 4);
+  std::memcpy(req.data() + 5, key, klen);
+  std::memcpy(req.data() + 5 + klen, &vlen, 4);
+  if (vlen) std::memcpy(req.data() + 9 + klen, val, vlen);
+  if (!WriteFull(c->fd, req.data(), req.size())) return INT64_MIN;
+  uint8_t hdr[12];
+  if (!ReadFull(c->fd, hdr, 12, 0)) return INT64_MIN;
+  int64_t status;
+  uint32_t olen;
+  std::memcpy(&status, hdr, 8);
+  std::memcpy(&olen, hdr + 8, 4);
+  uint8_t* buf = nullptr;
+  if (olen) {
+    buf = (uint8_t*)malloc(olen);
+    if (!ReadFull(c->fd, buf, olen, 0)) {
+      free(buf);
+      return INT64_MIN;
+    }
+  }
+  if (out) {
+    *out = buf;
+    *out_len = olen;
+  } else {
+    free(buf);
+  }
+  return status;
+}
+
+int64_t pt_kv_set(void* h, const char* key, const uint8_t* val, uint32_t vlen) {
+  return Request((Client*)h, kSet, key, (uint32_t)strlen(key), val, vlen,
+                 nullptr, nullptr);
+}
+
+// returns status (0 ok, -1 timeout); *out malloc'd — caller frees via
+// pt_kv_free.
+int64_t pt_kv_get(void* h, const char* key, int64_t timeout_ms, uint8_t** out,
+                  uint32_t* out_len) {
+  return Request((Client*)h, kGet, key, (uint32_t)strlen(key),
+                 (const uint8_t*)&timeout_ms, 8, out, out_len);
+}
+
+int64_t pt_kv_add(void* h, const char* key, int64_t delta) {
+  return Request((Client*)h, kAdd, key, (uint32_t)strlen(key),
+                 (const uint8_t*)&delta, 8, nullptr, nullptr);
+}
+
+int64_t pt_kv_check(void* h, const char* key) {
+  return Request((Client*)h, kCheck, key, (uint32_t)strlen(key), nullptr, 0,
+                 nullptr, nullptr);
+}
+
+int64_t pt_kv_delete(void* h, const char* key) {
+  return Request((Client*)h, kDelete, key, (uint32_t)strlen(key), nullptr, 0,
+                 nullptr, nullptr);
+}
+
+int64_t pt_kv_num_keys(void* h) {
+  return Request((Client*)h, kNumKeys, "", 0, nullptr, 0, nullptr, nullptr);
+}
+
+int64_t pt_kv_compare_set(void* h, const char* key, const uint8_t* oldv,
+                          uint32_t oldlen, const uint8_t* newv,
+                          uint32_t newlen) {
+  std::vector<uint8_t> val(4 + oldlen + newlen);
+  std::memcpy(val.data(), &oldlen, 4);
+  if (oldlen) std::memcpy(val.data() + 4, oldv, oldlen);
+  if (newlen) std::memcpy(val.data() + 4 + oldlen, newv, newlen);
+  return Request((Client*)h, kCompareSet, key, (uint32_t)strlen(key),
+                 val.data(), (uint32_t)val.size(), nullptr, nullptr);
+}
+
+void pt_kv_free(uint8_t* p) { free(p); }
+
+}  // extern "C"

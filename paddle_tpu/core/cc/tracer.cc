@@ -1,0 +1,148 @@
+// Host event tracer — native low-overhead span recorder for the profiler.
+//
+// TPU-native analog of the reference's HostTracer
+// (/root/reference/paddle/fluid/platform/profiler/host_tracer.h and
+// RecordEvent spans in event_tracing.h): paddle_tpu.profiler.RecordEvent
+// calls land here as two clock reads + a lock-free ring write (~40ns),
+// instead of Python-side dict appends. The Python layer drains the buffer
+// and merges spans with the device trace (jax.profiler) into one Chrome
+// trace. Device-side tracing itself belongs to XLA/xprof (SURVEY.md §5.1).
+//
+// Name strings are interned once (pt_trace_intern) so the hot path records
+// only integer ids.
+
+#include <sys/syscall.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Event {
+  uint32_t name_id;
+  uint32_t tid;
+  uint64_t t_start_ns;
+  uint64_t t_end_ns;
+};
+
+struct Tracer {
+  std::vector<Event> ring;
+  std::atomic<uint64_t> cursor{0};  // total events written
+  std::atomic<bool> enabled{false};
+
+  std::mutex names_mu;
+  std::vector<std::string> names;
+
+  explicit Tracer(size_t capacity) : ring(capacity) {}
+};
+
+inline uint64_t now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (uint64_t)ts.tv_sec * 1000000000ull + (uint64_t)ts.tv_nsec;
+}
+
+inline uint32_t tid() { return (uint32_t)syscall(SYS_gettid); }
+
+}  // namespace
+
+extern "C" {
+
+void* pt_trace_create(uint64_t capacity) {
+  return new Tracer(capacity ? capacity : (1u << 20));
+}
+
+void pt_trace_destroy(void* h) { delete (Tracer*)h; }
+
+void pt_trace_enable(void* h, int on) {
+  ((Tracer*)h)->enabled.store(on != 0, std::memory_order_release);
+}
+
+int pt_trace_enabled(void* h) {
+  return ((Tracer*)h)->enabled.load(std::memory_order_acquire) ? 1 : 0;
+}
+
+uint32_t pt_trace_intern(void* h, const char* name) {
+  auto* t = (Tracer*)h;
+  std::lock_guard<std::mutex> lk(t->names_mu);
+  for (uint32_t i = 0; i < t->names.size(); ++i)
+    if (t->names[i] == name) return i;
+  t->names.emplace_back(name);
+  return (uint32_t)t->names.size() - 1;
+}
+
+uint64_t pt_trace_now_ns() { return now_ns(); }
+
+// Record a completed span.
+void pt_trace_span(void* h, uint32_t name_id, uint64_t t_start_ns,
+                   uint64_t t_end_ns) {
+  auto* t = (Tracer*)h;
+  if (!t->enabled.load(std::memory_order_acquire)) return;
+  uint64_t i = t->cursor.fetch_add(1, std::memory_order_acq_rel);
+  Event& e = t->ring[i % t->ring.size()];
+  e.name_id = name_id;
+  e.tid = tid();
+  e.t_start_ns = t_start_ns;
+  e.t_end_ns = t_end_ns;
+}
+
+// Begin/end convenience (end computes duration itself).
+uint64_t pt_trace_begin(void* h) { return now_ns(); }
+
+void pt_trace_end(void* h, uint32_t name_id, uint64_t t_start_ns) {
+  pt_trace_span(h, name_id, t_start_ns, now_ns());
+}
+
+uint64_t pt_trace_count(void* h) {
+  auto* t = (Tracer*)h;
+  uint64_t n = t->cursor.load(std::memory_order_acquire);
+  return n < t->ring.size() ? n : t->ring.size();
+}
+
+uint64_t pt_trace_dropped(void* h) {
+  auto* t = (Tracer*)h;
+  uint64_t n = t->cursor.load(std::memory_order_acquire);
+  return n > t->ring.size() ? n - t->ring.size() : 0;
+}
+
+// Drain events into caller-provided parallel arrays (capacity `cap`).
+// Returns number of events copied; resets the buffer.
+uint64_t pt_trace_drain(void* h, uint32_t* name_ids, uint32_t* tids,
+                        uint64_t* starts, uint64_t* ends, uint64_t cap) {
+  auto* t = (Tracer*)h;
+  uint64_t total = t->cursor.exchange(0, std::memory_order_acq_rel);
+  uint64_t n = total < t->ring.size() ? total : t->ring.size();
+  if (n > cap) n = cap;
+  // oldest-first when wrapped
+  uint64_t begin = total > t->ring.size() ? total - t->ring.size() : 0;
+  for (uint64_t k = 0; k < n; ++k) {
+    const Event& e = t->ring[(begin + k) % t->ring.size()];
+    name_ids[k] = e.name_id;
+    tids[k] = e.tid;
+    starts[k] = e.t_start_ns;
+    ends[k] = e.t_end_ns;
+  }
+  return n;
+}
+
+// Copy interned name `i` into buf (cap bytes incl. NUL). Returns full length.
+uint32_t pt_trace_name(void* h, uint32_t i, char* buf, uint32_t cap) {
+  auto* t = (Tracer*)h;
+  std::lock_guard<std::mutex> lk(t->names_mu);
+  if (i >= t->names.size()) return 0;
+  const std::string& s = t->names[i];
+  if (cap) {
+    uint32_t n = (uint32_t)s.size() < cap - 1 ? (uint32_t)s.size() : cap - 1;
+    std::memcpy(buf, s.data(), n);
+    buf[n] = '\0';
+  }
+  return (uint32_t)s.size();
+}
+
+}  // extern "C"

@@ -1,0 +1,487 @@
+"""ctypes bindings for the native runtime library (libpaddle_tpu_core.so).
+
+The C++ sources live in paddle_tpu/core/cc/ and are compiled on first import
+(g++ is part of the supported toolchain; no pybind11 — plain C ABI via
+ctypes, per the environment constraints). Every consumer treats the native
+layer as optional: ``available()`` gates it and pure-Python fallbacks exist
+(e.g. the DataLoader falls back to multiprocessing queues).
+
+Components bound here (reference analogs in each class docstring):
+- TCPStore / TCPStoreServer  — rendezvous KV (tcp_store.h:121)
+- ShmRing                    — DataLoader shared-memory batch transport
+- HostArena                  — pooled host staging allocator
+- NativeTracer               — low-overhead profiler span recorder
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SO_PATH = os.path.join(_HERE, "libpaddle_tpu_core.so")
+_SRC_DIR = os.path.join(_HERE, "cc")
+
+_lib = None
+_lib_err: Optional[str] = None
+_build_lock = threading.Lock()
+
+
+def _build() -> Optional[str]:
+    srcs = [os.path.join(_SRC_DIR, f) for f in
+            ("kv_store.cc", "shm_ring.cc", "host_arena.cc", "tracer.cc")]
+    if not all(os.path.exists(s) for s in srcs):
+        return "native sources missing"
+    # rebuild when any source is newer than the .so
+    if os.path.exists(_SO_PATH):
+        so_mtime = os.path.getmtime(_SO_PATH)
+        if all(os.path.getmtime(s) <= so_mtime for s in srcs):
+            return None
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-Wall", "-pthread",
+           "-shared", *srcs, "-lrt", "-o", _SO_PATH]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired) as e:  # no g++ / hang
+        return f"native build failed to run: {e}"
+    if proc.returncode != 0:
+        return f"native build failed:\n{proc.stderr[-2000:]}"
+    return None
+
+
+def _declare(lib):
+    c = ctypes
+    P, U8P = c.c_void_p, c.POINTER(c.c_uint8)
+    sigs = {
+        # kv store
+        "pt_kv_server_start": ([c.c_int], P),
+        "pt_kv_server_port": ([P], c.c_int),
+        "pt_kv_server_stop": ([P], None),
+        "pt_kv_connect": ([c.c_char_p, c.c_int, c.c_int], P),
+        "pt_kv_disconnect": ([P], None),
+        "pt_kv_set": ([P, c.c_char_p, U8P, c.c_uint32], c.c_int64),
+        "pt_kv_get": ([P, c.c_char_p, c.c_int64, c.POINTER(U8P),
+                       c.POINTER(c.c_uint32)], c.c_int64),
+        "pt_kv_add": ([P, c.c_char_p, c.c_int64], c.c_int64),
+        "pt_kv_check": ([P, c.c_char_p], c.c_int64),
+        "pt_kv_delete": ([P, c.c_char_p], c.c_int64),
+        "pt_kv_num_keys": ([P], c.c_int64),
+        "pt_kv_compare_set": ([P, c.c_char_p, U8P, c.c_uint32, U8P,
+                               c.c_uint32], c.c_int64),
+        "pt_kv_free": ([U8P], None),
+        # shm ring
+        "pt_ring_open": ([c.c_char_p, c.c_uint64, c.c_uint32, c.c_int], P),
+        "pt_ring_close": ([P], None),
+        "pt_ring_slot_bytes": ([P], c.c_uint64),
+        "pt_ring_n_slots": ([P], c.c_uint32),
+        "pt_ring_acquire_write": ([P, c.POINTER(c.c_uint64), c.c_int], U8P),
+        "pt_ring_commit_write": ([P, c.c_uint64, c.c_uint32, c.c_int64], None),
+        "pt_ring_acquire_read": ([P, c.POINTER(c.c_uint32),
+                                  c.POINTER(c.c_int64),
+                                  c.POINTER(c.c_uint64), c.c_int], U8P),
+        "pt_ring_release_read": ([P, c.c_uint64], None),
+        "pt_ring_producer_done": ([P], None),
+        "pt_ring_producers_done": ([P], c.c_uint32),
+        "pt_ring_set_progress": ([P, c.c_uint64], None),
+        "pt_ring_progress": ([P], c.c_uint64),
+        "pt_ring_pending": ([P], c.c_uint64),
+        # arena
+        "pt_arena_create": ([], P),
+        "pt_arena_destroy": ([P], None),
+        "pt_arena_alloc": ([P, c.c_size_t], P),
+        "pt_arena_free": ([P, P], None),
+        "pt_arena_stats": ([P] + [c.POINTER(c.c_uint64)] * 4, None),
+        # tracer
+        "pt_trace_create": ([c.c_uint64], P),
+        "pt_trace_destroy": ([P], None),
+        "pt_trace_enable": ([P, c.c_int], None),
+        "pt_trace_enabled": ([P], c.c_int),
+        "pt_trace_intern": ([P, c.c_char_p], c.c_uint32),
+        "pt_trace_now_ns": ([], c.c_uint64),
+        "pt_trace_span": ([P, c.c_uint32, c.c_uint64, c.c_uint64], None),
+        "pt_trace_end": ([P, c.c_uint32, c.c_uint64], None),
+        "pt_trace_count": ([P], c.c_uint64),
+        "pt_trace_dropped": ([P], c.c_uint64),
+        "pt_trace_drain": ([P, c.POINTER(c.c_uint32), c.POINTER(c.c_uint32),
+                            c.POINTER(c.c_uint64), c.POINTER(c.c_uint64),
+                            c.c_uint64], c.c_uint64),
+        "pt_trace_name": ([P, c.c_uint32, c.c_char_p, c.c_uint32], c.c_uint32),
+    }
+    for name, (argtypes, restype) in sigs.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+
+
+def load():
+    """Build (if needed) and load the native library. Returns the ctypes
+    CDLL or None if unavailable (consumers must fall back)."""
+    global _lib, _lib_err
+    if _lib is not None or _lib_err is not None:
+        return _lib
+    with _build_lock:
+        if _lib is not None or _lib_err is not None:
+            return _lib
+        err = _build()
+        if err is not None:
+            _lib_err = err
+            return None
+        try:
+            lib = ctypes.CDLL(_SO_PATH)
+            _declare(lib)
+        except OSError as e:
+            _lib_err = str(e)
+            return None
+        _lib = lib
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def load_error() -> Optional[str]:
+    load()
+    return _lib_err
+
+
+# ---------------------------------------------------------------------------
+# TCPStore
+# ---------------------------------------------------------------------------
+
+class TCPStoreServer:
+    """Rank-0 daemon of the rendezvous store (MasterDaemon analog,
+    /root/reference/paddle/phi/core/distributed/store/tcp_store.h)."""
+
+    def __init__(self, port: int = 0):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native store unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.pt_kv_server_start(port)
+        if not self._h:
+            raise RuntimeError(f"failed to start KV server on port {port}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.pt_kv_server_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.pt_kv_server_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class TCPStore:
+    """Client of the rendezvous store — paddle.distributed's Store API
+    (set/get/add/wait/delete_key, tcp_store.h:121) over the native C++
+    client. ``is_master=True`` also hosts the daemon in-process."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, timeout: float = 900.0,
+                 world_size: int = 1):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native store unavailable: {_lib_err}")
+        self._lib = lib
+        self._server = TCPStoreServer(port) if is_master else None
+        if self._server is not None:
+            port = self._server.port
+        self.host, self.port = host, port
+        self._timeout_ms = int(timeout * 1000)
+        self._h = lib.pt_kv_connect(host.encode(), port, self._timeout_ms)
+        if not self._h:
+            raise RuntimeError(f"cannot connect to KV store {host}:{port}")
+        self.world_size = world_size
+
+    def set(self, key: str, value) -> None:
+        data = value.encode() if isinstance(value, str) else bytes(value)
+        buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
+            else None
+        rc = self._lib.pt_kv_set(self._h, key.encode(), buf, len(data))
+        if rc == -(2 ** 63):
+            raise RuntimeError("KV store connection lost")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint32()
+        tmo = self._timeout_ms if timeout is None else int(timeout * 1000)
+        rc = self._lib.pt_kv_get(self._h, key.encode(), tmo,
+                                 ctypes.byref(out), ctypes.byref(out_len))
+        if rc == -1:
+            raise TimeoutError(f"KV get({key!r}) timed out after {tmo}ms")
+        if rc == -(2 ** 63):
+            raise RuntimeError("KV store connection lost")
+        if not out or out_len.value == 0:
+            return b""
+        data = ctypes.string_at(out, out_len.value)
+        self._lib.pt_kv_free(out)
+        return data
+
+    def add(self, key: str, amount: int = 1) -> int:
+        rc = self._lib.pt_kv_add(self._h, key.encode(), amount)
+        if rc == -(2 ** 63):
+            raise RuntimeError("KV store connection lost")
+        return int(rc)
+
+    def check(self, key: str) -> bool:
+        return self._lib.pt_kv_check(self._h, key.encode()) == 1
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        self.get(key, timeout=timeout)
+
+    def delete_key(self, key: str) -> bool:
+        return self._lib.pt_kv_delete(self._h, key.encode()) > 0
+
+    def num_keys(self) -> int:
+        return int(self._lib.pt_kv_num_keys(self._h))
+
+    def compare_set(self, key: str, old: bytes, new: bytes) -> bool:
+        ob = (ctypes.c_uint8 * len(old)).from_buffer_copy(old) if old else None
+        nb = (ctypes.c_uint8 * len(new)).from_buffer_copy(new) if new else None
+        return self._lib.pt_kv_compare_set(
+            self._h, key.encode(), ob, len(old), nb, len(new)) == 1
+
+    def barrier(self, name: str = "barrier", world_size: Optional[int] = None,
+                timeout: Optional[float] = None) -> None:
+        """All ranks arrive, then all proceed (two-phase counter)."""
+        n = world_size or self.world_size
+        arrived = self.add(f"__bar/{name}/in", 1)
+        if arrived == n:
+            self.set(f"__bar/{name}/go", b"1")
+        self.wait(f"__bar/{name}/go", timeout=timeout)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_kv_disconnect(self._h)
+            self._h = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# ShmRing
+# ---------------------------------------------------------------------------
+
+class ShmRing:
+    """Shared-memory batch ring (see cc/shm_ring.cc). Producer side writes
+    serialized batches; consumer memoryviews them zero-copy."""
+
+    def __init__(self, name: str, slot_bytes: int = 0, n_slots: int = 0,
+                 create: bool = False):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native ring unavailable: {_lib_err}")
+        self._lib = lib
+        self.name = name
+        self._h = lib.pt_ring_open(name.encode(), slot_bytes, n_slots,
+                                   1 if create else 0)
+        if not self._h:
+            raise RuntimeError(f"shm ring open failed: {name}")
+        self.slot_bytes = lib.pt_ring_slot_bytes(self._h)
+        self.n_slots = lib.pt_ring_n_slots(self._h)
+
+    def write(self, data: bytes, meta: int = 0, timeout_ms: int = 60000) -> bool:
+        if len(data) > self.slot_bytes:
+            raise ValueError(
+                f"batch of {len(data)}B exceeds slot capacity "
+                f"{self.slot_bytes}B; pass a larger shm_slot_bytes to "
+                f"DataLoader")
+        ticket = ctypes.c_uint64()
+        ptr = self._lib.pt_ring_acquire_write(self._h, ctypes.byref(ticket),
+                                              timeout_ms)
+        if not ptr:
+            return False
+        ctypes.memmove(ptr, data, len(data))
+        self._lib.pt_ring_commit_write(self._h, ticket.value, len(data), meta)
+        return True
+
+    def read(self, timeout_ms: int = 60000):
+        """Returns (payload: bytes, meta: int) or None on timeout. The copy
+        out of shared memory happens once here (np.frombuffer consumers use
+        read_view instead)."""
+        got = self.read_view(timeout_ms)
+        if got is None:
+            return None
+        view, meta, ticket = got
+        data = bytes(view)
+        self.release(ticket)
+        return data, meta
+
+    def read_view(self, timeout_ms: int = 60000):
+        """Zero-copy read: returns (memoryview, meta, ticket); caller MUST
+        call release(ticket) when done with the view."""
+        ln = ctypes.c_uint32()
+        meta = ctypes.c_int64()
+        ticket = ctypes.c_uint64()
+        ptr = self._lib.pt_ring_acquire_read(
+            self._h, ctypes.byref(ln), ctypes.byref(meta),
+            ctypes.byref(ticket), timeout_ms)
+        if not ptr:
+            return None
+        view = memoryview((ctypes.c_uint8 * ln.value).from_address(
+            ctypes.addressof(ptr.contents))).cast("B")
+        return view, meta.value, ticket.value
+
+    def release(self, ticket: int):
+        self._lib.pt_ring_release_read(self._h, ticket)
+
+    def set_progress(self, v: int):
+        self._lib.pt_ring_set_progress(self._h, v)
+
+    def progress(self) -> int:
+        return self._lib.pt_ring_progress(self._h)
+
+    def producer_done(self):
+        self._lib.pt_ring_producer_done(self._h)
+
+    def producers_done(self) -> int:
+        return self._lib.pt_ring_producers_done(self._h)
+
+    def pending(self) -> int:
+        return self._lib.pt_ring_pending(self._h)
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_ring_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# HostArena
+# ---------------------------------------------------------------------------
+
+class HostArena:
+    """Pooled host staging allocator (cc/host_arena.cc). alloc() returns a
+    numpy-wrappable address; see buffer()."""
+
+    def __init__(self):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native arena unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.pt_arena_create()
+
+    def alloc(self, nbytes: int) -> int:
+        p = self._lib.pt_arena_alloc(self._h, nbytes)
+        if not p:
+            raise MemoryError(f"host arena alloc of {nbytes}B failed")
+        return p
+
+    def free(self, addr: int):
+        self._lib.pt_arena_free(self._h, ctypes.c_void_p(addr))
+
+    def buffer(self, addr: int, nbytes: int) -> memoryview:
+        return memoryview(
+            (ctypes.c_uint8 * nbytes).from_address(addr)).cast("B")
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self._lib.pt_arena_stats(self._h, *[ctypes.byref(v) for v in vals])
+        return {"reserved": vals[0].value, "in_use": vals[1].value,
+                "peak": vals[2].value, "allocs": vals[3].value}
+
+    def destroy(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_arena_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# NativeTracer
+# ---------------------------------------------------------------------------
+
+class NativeTracer:
+    """Span recorder (cc/tracer.cc) behind paddle_tpu.profiler.RecordEvent."""
+
+    def __init__(self, capacity: int = 1 << 20):
+        lib = load()
+        if lib is None:
+            raise RuntimeError(f"native tracer unavailable: {_lib_err}")
+        self._lib = lib
+        self._h = lib.pt_trace_create(capacity)
+        self._name_ids: dict = {}
+
+    def enable(self, on: bool = True):
+        self._lib.pt_trace_enable(self._h, 1 if on else 0)
+
+    def intern(self, name: str) -> int:
+        nid = self._name_ids.get(name)
+        if nid is None:
+            nid = self._lib.pt_trace_intern(self._h, name.encode())
+            self._name_ids[name] = nid
+        return nid
+
+    def now_ns(self) -> int:
+        return self._lib.pt_trace_now_ns()
+
+    def span(self, name_id: int, t_start_ns: int, t_end_ns: int):
+        self._lib.pt_trace_span(self._h, name_id, t_start_ns, t_end_ns)
+
+    def end(self, name_id: int, t_start_ns: int):
+        self._lib.pt_trace_end(self._h, name_id, t_start_ns)
+
+    def drain(self):
+        """Returns list of (name, tid, t_start_ns, t_end_ns)."""
+        import numpy as np
+        cap = int(self._lib.pt_trace_count(self._h))
+        if cap == 0:
+            return []
+        ids = np.zeros(cap, np.uint32)
+        tids = np.zeros(cap, np.uint32)
+        starts = np.zeros(cap, np.uint64)
+        ends = np.zeros(cap, np.uint64)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        u64p = ctypes.POINTER(ctypes.c_uint64)
+        n = self._lib.pt_trace_drain(
+            self._h, ids.ctypes.data_as(u32p), tids.ctypes.data_as(u32p),
+            starts.ctypes.data_as(u64p), ends.ctypes.data_as(u64p), cap)
+        out = []
+        buf = ctypes.create_string_buffer(256)
+        name_cache: dict = {}
+        for k in range(int(n)):
+            nid = int(ids[k])
+            name = name_cache.get(nid)
+            if name is None:
+                self._lib.pt_trace_name(self._h, nid, buf, 256)
+                name = buf.value.decode(errors="replace")
+                name_cache[nid] = name
+            out.append((name, int(tids[k]), int(starts[k]), int(ends[k])))
+        return out
+
+    def destroy(self):
+        if getattr(self, "_h", None):
+            self._lib.pt_trace_destroy(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.destroy()
+        except Exception:
+            pass

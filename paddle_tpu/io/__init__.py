@@ -245,21 +245,11 @@ def get_worker_info():
 
 
 def default_collate_fn(batch: List[Any]):
-    sample = batch[0]
-    if isinstance(sample, Tensor):
-        return to_tensor(np.stack([np.asarray(s._value) for s in batch]))
-    if isinstance(sample, np.ndarray):
-        return to_tensor(np.stack(batch))
-    if isinstance(sample, (int, np.integer)):
-        return to_tensor(np.asarray(batch, dtype=np.int64))
-    if isinstance(sample, (float, np.floating)):
-        return to_tensor(np.asarray(batch, dtype=np.float32))
-    if isinstance(sample, (tuple, list)):
-        transposed = list(zip(*batch))
-        return type(sample)(default_collate_fn(list(s)) for s in transposed)
-    if isinstance(sample, dict):
-        return {k: default_collate_fn([d[k] for d in batch]) for k in sample}
-    return batch
+    """Stack samples into Tensor batches (parity:
+    /root/reference/python/paddle/io/dataloader/collate.py). The numpy
+    stacking (_collate_numpy) is shared with the shm worker path, which
+    must not create jax arrays in child processes."""
+    return _np_tree_to_tensor(_collate_numpy(batch))
 
 
 class DataLoader:
@@ -268,11 +258,17 @@ class DataLoader:
                  shuffle=False, drop_last=False, collate_fn=None,
                  num_workers=0, use_buffer_reader=True, prefetch_factor=2,
                  use_shared_memory=False, timeout=0, worker_init_fn=None,
-                 persistent_workers=False):
+                 persistent_workers=False, shm_slot_bytes=64 << 20):
         self.dataset = dataset
+        self._user_collate_fn = collate_fn
         self.collate_fn = collate_fn or default_collate_fn
         self.num_workers = num_workers
         self.prefetch_factor = prefetch_factor
+        self.use_shared_memory = use_shared_memory
+        self.shm_slot_bytes = shm_slot_bytes
+        self.worker_init_fn = worker_init_fn
+        self.timeout = timeout
+        self._epoch_count = 0
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if self._iterable_mode:
             self.batch_sampler = None
@@ -308,6 +304,12 @@ class DataLoader:
         if self.num_workers == 0:
             yield from self._iter_batches()
             return
+        if self.use_shared_memory:
+            from paddle_tpu.core import native
+            if native.available():
+                yield from self._iter_shm()
+                return
+            # fall through to the thread pipeline if native lib is missing
         # thread-prefetch pipeline
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor *
                                      max(self.num_workers, 1))
@@ -332,3 +334,68 @@ class DataLoader:
                     raise err_holder[0]
                 return
             yield item
+
+    def _iter_shm(self):
+        """Multiprocess workers over the native shared-memory ring
+        (paddle_tpu/io/shm_loader.py). Batches come back as numpy trees;
+        collate to Tensors happens here on the main process (jax array
+        creation must stay on the consumer side).
+
+        Note: like the reference (and torch), a non-sharding
+        IterableDataset is iterated once PER WORKER here — use
+        get_worker_info() in __iter__ to shard; num_workers=0 or the
+        thread pipeline iterate it exactly once."""
+        from .shm_loader import ShmBatchLoader
+
+        # user collate runs in the worker; Tensor leaves are converted to
+        # numpy for the shm crossing. Default collate builds numpy directly.
+        collate = self._user_collate_fn  # None → workers use _collate_numpy
+        # fresh augmentation randomness each epoch (reference draws a new
+        # base seed per iterator)
+        seed = (default_generator.initial_seed
+                + 1000003 * self._epoch_count) % (2 ** 31)
+        self._epoch_count += 1
+        timeout = self.timeout if self.timeout else None  # 0 = no timeout
+        if self._iterable_mode:
+            loader = ShmBatchLoader(
+                self.dataset, None, self.num_workers, collate,
+                worker_init_fn=self.worker_init_fn, seed=seed,
+                slot_bytes=self.shm_slot_bytes,
+                iterable_batch_size=self.batch_size,
+                drop_last=self.drop_last, timeout=timeout)
+        else:
+            batch_indices = list(self.batch_sampler)
+            loader = ShmBatchLoader(
+                self.dataset, batch_indices, self.num_workers,
+                collate, worker_init_fn=self.worker_init_fn, seed=seed,
+                slot_bytes=self.shm_slot_bytes, timeout=timeout)
+        for np_batch in loader:
+            yield _np_tree_to_tensor(np_batch)
+
+
+def _collate_numpy(batch):
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(s._value) for s in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, dtype=np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, dtype=np.float32)
+    if isinstance(sample, (tuple, list)):
+        transposed = list(zip(*batch))
+        return type(sample)(_collate_numpy(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: _collate_numpy([d[k] for d in batch]) for k in sample}
+    return batch
+
+
+def _np_tree_to_tensor(obj):
+    if isinstance(obj, np.ndarray):
+        return to_tensor(obj)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_np_tree_to_tensor(e) for e in obj)
+    if isinstance(obj, dict):
+        return {k: _np_tree_to_tensor(v) for k, v in obj.items()}
+    return obj

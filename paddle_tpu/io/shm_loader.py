@@ -1,0 +1,336 @@
+"""Multiprocess DataLoader workers over the native shared-memory ring.
+
+The reference's worker pool pickles samples through multiprocessing queues
+(/root/reference/python/paddle/io/dataloader/worker.py:273 _worker_loop,
+dataloader_iter.py:358 _DataLoaderIterMultiProcess). Here worker processes
+run dataset.__getitem__ + collate to numpy, then serialize each batch
+straight into a POSIX shared-memory ring (paddle_tpu/core/cc/shm_ring.cc);
+the main process reconstructs numpy arrays from the mapped pages with one
+copy into the jax staging path. Batch *order* is restored by batch index
+(the reference's out-of-order cache, dataloader_iter.py) — workers claim
+ring slots in completion order, the consumer reorders by meta.
+
+Batch wire format:
+    u64 header_len | pickle(header) | payload (arrays back-to-back, each
+    64B-aligned)
+header = list of ("arr", dtype_str, shape, offset) / ("obj", pickled) in
+flattened pytree order + the treedef spec.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import struct
+import time
+import traceback
+from typing import Any, List, Optional
+
+import numpy as np
+
+__all__ = ["ShmBatchLoader", "serialize_batch", "deserialize_batch"]
+
+_ALIGN = 64
+
+_META_ERROR = -2
+_META_STOP = -3
+
+
+def _flatten(obj, out):
+    """Flatten nested tuples/lists/dicts of arrays into a spec tree +
+    leaf list. Tensors are unwrapped to numpy by the caller."""
+    if isinstance(obj, np.ndarray):
+        out.append(obj)
+        return ("a", len(out) - 1)
+    if isinstance(obj, (list, tuple)):
+        spec = [_flatten(e, out) for e in obj]
+        return ("t" if isinstance(obj, tuple) else "l", spec)
+    if isinstance(obj, dict):
+        return ("d", {k: _flatten(v, out) for k, v in obj.items()})
+    return ("o", obj)
+
+
+def _unflatten(spec, leaves):
+    kind = spec[0]
+    if kind == "a":
+        return leaves[spec[1]]
+    if kind in ("t", "l"):
+        seq = [_unflatten(s, leaves) for s in spec[1]]
+        return tuple(seq) if kind == "t" else seq
+    if kind == "d":
+        return {k: _unflatten(v, leaves) for k, v in spec[1].items()}
+    return spec[1]
+
+
+def serialize_batch(batch) -> bytes:
+    leaves: List[np.ndarray] = []
+    spec = _flatten(batch, leaves)
+    metas = []
+    offset = 0
+    for arr in leaves:
+        offset = (offset + _ALIGN - 1) & ~(_ALIGN - 1)
+        metas.append((str(arr.dtype), arr.shape, offset))
+        offset += arr.nbytes
+    header = pickle.dumps((spec, metas), protocol=pickle.HIGHEST_PROTOCOL)
+    total = 8 + len(header)
+    pay_base = (total + _ALIGN - 1) & ~(_ALIGN - 1)
+    buf = bytearray(pay_base + offset)
+    buf[:8] = struct.pack("<Q", len(header))
+    buf[8:8 + len(header)] = header
+    for arr, (_, _, off) in zip(leaves, metas):
+        a = np.ascontiguousarray(arr)
+        buf[pay_base + off:pay_base + off + a.nbytes] = a.tobytes()
+    return bytes(buf)
+
+
+def deserialize_batch(view) -> Any:
+    (hlen,) = struct.unpack_from("<Q", view, 0)
+    spec, metas = pickle.loads(bytes(view[8:8 + hlen]))
+    pay_base = (8 + hlen + _ALIGN - 1) & ~(_ALIGN - 1)
+    leaves = []
+    for dtype_s, shape, off in metas:
+        dt = np.dtype(dtype_s)
+        n = int(np.prod(shape)) if shape else 1
+        arr = np.frombuffer(view, dtype=dt, count=n,
+                            offset=pay_base + off).reshape(shape)
+        leaves.append(arr.copy())  # one copy out of shared pages
+    return _unflatten(spec, leaves)
+
+
+def _to_numpy_tree(obj):
+    """Convert Tensors / jax arrays inside a collated batch to numpy so the
+    batch can cross the process boundary."""
+    from ..framework.core import Tensor
+    if isinstance(obj, Tensor):
+        return np.asarray(obj._value)
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_numpy_tree(e) for e in obj)
+    if isinstance(obj, dict):
+        return {k: _to_numpy_tree(v) for k, v in obj.items()}
+    if hasattr(obj, "dtype") and hasattr(obj, "shape") and \
+            not isinstance(obj, np.ndarray):
+        return np.asarray(obj)
+    return obj
+
+
+def _worker_main(ring_name: str, dataset, batch_indices: List[List[int]],
+                 worker_id: int, num_workers: int, collate_src,
+                 worker_init_fn, seed: int, iterable_batch_size: int,
+                 drop_last: bool):
+    """Entry point of one spawned worker (the reference's _worker_loop
+    analog). Map-style: processes batches worker_id::num_workers.
+    Iterable-style: iterates its shard (get_worker_info-based)."""
+    from paddle_tpu.core.native import ShmRing
+    from . import _collate_numpy, IterableDataset, _worker_info
+
+    # default collate builds numpy directly (jax arrays must not be
+    # created inside workers); a user collate_fn runs as-is and its Tensor
+    # leaves are converted by _to_numpy_tree below.
+    collate = collate_src if collate_src is not None else _collate_numpy
+    # reseed BOTH RNG families: vision transforms draw from `random`, and
+    # fork start would otherwise clone the parent's state into every
+    # worker every epoch
+    import random as _pyrandom
+    np.random.seed((seed + 7919 * worker_id) % (2 ** 31))
+    _pyrandom.seed(seed * 2654435761 + worker_id)
+
+    class _Info:
+        id = worker_id
+        num_workers_ = num_workers
+        dataset_ = dataset
+
+    info = _Info()
+    info.num_workers = num_workers
+    info.dataset = dataset
+    _worker_info.info = info
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+
+    ring = ShmRing(ring_name)
+    # Map-style only: don't run further ahead of the consumer's in-order
+    # emit point than this — bounds the consumer's reorder backlog when one
+    # worker is slow. (Iterable shards can be uneven, where a gap in the
+    # batch-index sequence would make this gate stall spuriously.)
+    window = None if isinstance(dataset, IterableDataset) else \
+        max(4 * num_workers, ring.n_slots, 8)
+
+    def put(batch, bidx):
+        payload = serialize_batch(_to_numpy_tree(collate(batch)))
+        waited = 0.0
+        while window is not None and bidx - ring.progress() > window:
+            time.sleep(0.002)
+            waited += 0.002
+            if waited > 600.0:
+                raise RuntimeError(
+                    f"worker {worker_id}: consumer made no progress for "
+                    f"600s before batch {bidx}; aborting")
+        # a full ring means the consumer stopped draining; failing loudly
+        # beats silently dropping the batch
+        if not ring.write(payload, meta=bidx, timeout_ms=600000):
+            raise RuntimeError(
+                f"worker {worker_id}: ring full for 600s writing batch "
+                f"{bidx}; consumer appears stalled")
+
+    try:
+        if isinstance(dataset, IterableDataset):
+            it = iter(dataset)
+            batch: list = []
+            bidx = worker_id  # interleave indices across workers
+            for sample in it:
+                batch.append(sample)
+                if len(batch) == iterable_batch_size:
+                    put(batch, bidx)
+                    bidx += num_workers
+                    batch = []
+            if batch and not drop_last:
+                put(batch, bidx)
+        else:
+            for bidx in range(worker_id, len(batch_indices), num_workers):
+                put([dataset[i] for i in batch_indices[bidx]], bidx)
+    except Exception:
+        err = traceback.format_exc().encode()
+        try:
+            ring.write(struct.pack("<Q", len(err)) + err, meta=_META_ERROR,
+                       timeout_ms=60000)
+        except Exception:
+            pass
+    finally:
+        ring.producer_done()
+        ring.close()
+
+
+class ShmBatchLoader:
+    """Consumer side: spawns workers, reads the ring, reorders batches."""
+
+    def __init__(self, dataset, batch_indices: Optional[List[List[int]]],
+                 num_workers: int, collate_fn, worker_init_fn=None,
+                 slot_bytes: int = 64 << 20, n_slots: Optional[int] = None,
+                 seed: int = 0, iterable_batch_size: int = 1,
+                 drop_last: bool = False,
+                 timeout: Optional[float] = None):
+        import multiprocessing as mp
+        from paddle_tpu.core.native import ShmRing
+
+        self.num_workers = num_workers
+        self._n_batches = len(batch_indices) if batch_indices is not None \
+            else None
+        # None/0 = no deadline (the reference's timeout=0 semantics);
+        # liveness of worker processes is still checked every second.
+        self._timeout_ms = int(timeout * 1000) if timeout else None
+        self._ring_name = f"/pt_dl_{os.getpid()}_{id(self) & 0xffffff:x}"
+        n_slots = n_slots or max(2 * num_workers, 4)
+        self._ring = ShmRing(self._ring_name, slot_bytes=slot_bytes,
+                             n_slots=n_slots, create=True)
+        method = os.environ.get("PADDLE_TPU_WORKER_START", "auto")
+        if method == "auto":
+            # fork is faster but deadlocks if XLA threads already exist
+            from jax._src import xla_bridge as _xb
+            method = "spawn" if _xb.backends_are_initialized() else "fork"
+        ctx = mp.get_context(method)
+        self._procs = [
+            ctx.Process(
+                target=_worker_main,
+                args=(self._ring_name, dataset, batch_indices, w, num_workers,
+                      collate_fn, worker_init_fn, seed, iterable_batch_size,
+                      drop_last),
+                daemon=True)
+            for w in range(num_workers)
+        ]
+        for p in self._procs:
+            p.start()
+
+    def __iter__(self):
+        pending: dict = {}
+        next_idx = 0
+        emitted = 0
+        self._waited_ms = 0
+        while True:
+            if self._n_batches is not None and emitted >= self._n_batches:
+                break
+            # serve from the reorder buffer first
+            if next_idx in pending:
+                batch = pending.pop(next_idx)
+                next_idx += 1
+                emitted += 1
+                self._ring.set_progress(next_idx)
+                yield batch
+                continue
+            if self._ring.producers_done() >= self.num_workers and \
+                    self._ring.pending() == 0:
+                # all workers finished; flush whatever remains in order
+                for k in sorted(pending):
+                    emitted += 1
+                    yield pending[k]
+                pending.clear()
+                if self._n_batches is not None and \
+                        emitted < self._n_batches:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader epoch ended short: {emitted}/"
+                        f"{self._n_batches} batches produced — a worker "
+                        f"likely died without reporting an error")
+                break
+            # read in short slices so dead workers are detected promptly
+            # (a spawn-crashed worker never reaches producer_done)
+            got = self._ring.read_view(timeout_ms=1000)
+            if got is None:
+                dead = [p for p in self._procs
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                if dead and self._ring.producers_done() < self.num_workers \
+                        and self._ring.pending() == 0:
+                    codes = [p.exitcode for p in dead]
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader shm worker(s) died with exit codes "
+                        f"{codes} before producing. If you use spawn start "
+                        f"(default once JAX is initialized), the main "
+                        f"script must be importable (guard entry code with "
+                        f"if __name__ == '__main__') and the dataset "
+                        f"picklable.")
+                self._waited_ms += 1000
+                if self._ring.producers_done() >= self.num_workers:
+                    continue  # re-check drain condition
+                if self._timeout_ms is not None and \
+                        self._waited_ms >= self._timeout_ms:
+                    self.shutdown()
+                    raise RuntimeError(
+                        f"DataLoader shm workers timed out after "
+                        f"{self._timeout_ms}ms (alive="
+                        f"{[p.is_alive() for p in self._procs]})")
+                continue
+            self._waited_ms = 0
+            view, meta, ticket = got
+            if meta == _META_ERROR:
+                (elen,) = struct.unpack_from("<Q", view, 0)
+                msg = bytes(view[8:8 + elen]).decode(errors="replace")
+                self._ring.release(ticket)
+                self.shutdown()
+                raise RuntimeError(f"DataLoader worker failed:\n{msg}")
+            # deserialize straight out of the mapped pages (single copy),
+            # then hand the slot back to the producers
+            batch = deserialize_batch(view)
+            self._ring.release(ticket)
+            if meta == next_idx:
+                next_idx += 1
+                emitted += 1
+                self._ring.set_progress(next_idx)
+                yield batch
+            else:
+                pending[meta] = batch
+        self.shutdown()
+
+    def shutdown(self):
+        for p in self._procs:
+            if p.is_alive():
+                p.terminate()
+        for p in self._procs:
+            p.join(timeout=5)
+        self._procs = []
+        if self._ring is not None:
+            self._ring.close()
+            self._ring = None
+
+    def __del__(self):
+        try:
+            self.shutdown()
+        except Exception:
+            pass

@@ -1,0 +1,12 @@
+"""Shared helpers for the vision model zoo."""
+from __future__ import annotations
+
+
+def make_divisible(v, divisor=8, min_value=None):
+    """Round channel counts to hardware-friendly multiples (the MobileNet
+    rule: never round down by more than 10%)."""
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v

@@ -2,8 +2,8 @@
 /root/reference/python/paddle/vision/models/alexnet.py)."""
 from __future__ import annotations
 
-from ...nn import (Conv2D, Dropout, Layer, Linear, MaxPool2D, ReLU,
-                   Sequential)
+from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential)
 
 __all__ = ["AlexNet", "alexnet"]
 
@@ -22,6 +22,8 @@ class AlexNet(Layer):
             Conv2D(256, 256, 3, padding=1), ReLU(),
             MaxPool2D(3, 2),
         )
+        # adaptive pool decouples the classifier from the input size
+        self.avgpool = AdaptiveAvgPool2D((6, 6))
         if num_classes > 0:
             self.classifier = Sequential(
                 Dropout(dropout), Linear(256 * 6 * 6, 4096), ReLU(),
@@ -31,6 +33,7 @@ class AlexNet(Layer):
 
     def forward(self, x):
         x = self.features(x)
+        x = self.avgpool(x)
         if self.num_classes > 0:
             x = x.flatten(1)
             x = self.classifier(x)

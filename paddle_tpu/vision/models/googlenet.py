@@ -38,7 +38,8 @@ class Inception(Layer):
 class InceptionAux(Layer):
     def __init__(self, in_c, num_classes):
         super().__init__()
-        self.pool = AvgPool2D(5, 3)
+        # adaptive pool keeps the aux head valid for any input size
+        self.pool = AdaptiveAvgPool2D((4, 4))
         self.conv = ConvLayer(in_c, 128, 1)
         self.fc1 = Linear(128 * 4 * 4, 1024)
         self.relu = ReLU()

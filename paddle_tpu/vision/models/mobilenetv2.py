@@ -4,16 +4,9 @@ from __future__ import annotations
 
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer,
                    Linear, ReLU6, Sequential)
+from ._utils import make_divisible
 
 __all__ = ["MobileNetV2", "mobilenet_v2"]
-
-
-def _make_divisible(v, divisor=8, min_value=None):
-    min_value = min_value or divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
 
 
 class ConvBNReLU(Sequential):
@@ -56,11 +49,11 @@ class MobileNetV2(Layer):
             (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
             (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1),
         ]
-        input_channel = _make_divisible(32 * scale)
-        self.last_channel = _make_divisible(1280 * max(1.0, scale))
+        input_channel = make_divisible(32 * scale)
+        self.last_channel = make_divisible(1280 * max(1.0, scale))
         features = [ConvBNReLU(3, input_channel, stride=2)]
         for t, c, n, s in cfg:
-            out_c = _make_divisible(c * scale)
+            out_c = make_divisible(c * scale)
             for i in range(n):
                 features.append(InvertedResidual(
                     input_channel, out_c, s if i == 0 else 1, t))

@@ -4,17 +4,10 @@ from __future__ import annotations
 
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
                    Hardsigmoid, Hardswish, Layer, Linear, ReLU, Sequential)
+from ._utils import make_divisible
 
 __all__ = ["MobileNetV3Small", "MobileNetV3Large",
            "mobilenet_v3_small", "mobilenet_v3_large"]
-
-
-def _make_divisible(v, divisor=8, min_value=None):
-    min_value = min_value or divisor
-    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
-    if new_v < 0.9 * v:
-        new_v += divisor
-    return new_v
 
 
 class SqueezeExcitation(Layer):
@@ -56,7 +49,7 @@ class InvertedResidual(Layer):
                                          groups=exp_c, activation=act))
         if use_se:
             layers.append(SqueezeExcitation(exp_c,
-                                            _make_divisible(exp_c // 4)))
+                                            make_divisible(exp_c // 4)))
         layers.append(ConvNormActivation(exp_c, out_c, 1, activation=None))
         self.block = Sequential(*layers)
 
@@ -72,17 +65,17 @@ class MobileNetV3(Layer):
         super().__init__()
         self.num_classes = num_classes
         self.with_pool = with_pool
-        first_c = _make_divisible(16 * scale)
+        first_c = make_divisible(16 * scale)
         layers = [ConvNormActivation(3, first_c, 3, 2,
                                      activation=Hardswish)]
         in_c = first_c
         for k, exp, out, se, hs, s in cfg:
-            exp_c = _make_divisible(exp * scale)
-            out_c = _make_divisible(out * scale)
+            exp_c = make_divisible(exp * scale)
+            out_c = make_divisible(out * scale)
             layers.append(InvertedResidual(in_c, exp_c, out_c, k, s, se,
                                            hs))
             in_c = out_c
-        last_conv = _make_divisible(6 * in_c)
+        last_conv = make_divisible(6 * in_c)
         layers.append(ConvNormActivation(in_c, last_conv, 1,
                                          activation=Hardswish))
         self.features = Sequential(*layers)

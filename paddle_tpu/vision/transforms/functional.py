@@ -56,15 +56,32 @@ def vflip(img):
     return np.ascontiguousarray(_to_np(img)[::-1])
 
 
-def _interp_resize(arr, h, w):
-    """Bilinear resize via jax.image on host numpy (small images)."""
+# paddle/cv2 names → jax.image methods
+_INTERP_METHODS = {
+    "nearest": "nearest",
+    "bilinear": "linear",
+    "linear": "linear",
+    "bicubic": "cubic",
+    "cubic": "cubic",
+    "lanczos3": "lanczos3",
+    "lanczos5": "lanczos5",
+}
+
+
+def _interp_resize(arr, h, w, interpolation="bilinear"):
+    """Resize via jax.image on host numpy (small images)."""
     import jax.image
+    method = _INTERP_METHODS.get(interpolation)
+    if method is None:
+        raise ValueError(
+            f"unsupported interpolation {interpolation!r}; one of "
+            f"{sorted(_INTERP_METHODS)}")
     squeeze = arr.ndim == 2
     if squeeze:
         arr = arr[:, :, None]
     src_dtype = arr.dtype
     out = jax.image.resize(arr.astype(np.float32),
-                           (h, w, arr.shape[2]), method="bilinear")
+                           (h, w, arr.shape[2]), method=method)
     out = np.asarray(out)
     if src_dtype == np.uint8:
         out = np.clip(np.round(out), 0, 255).astype(np.uint8)
@@ -81,7 +98,7 @@ def resize(img, size, interpolation='bilinear'):
             nh, nw = int(size * h / w), size
     else:
         nh, nw = size
-    return _interp_resize(arr, nh, nw)
+    return _interp_resize(arr, nh, nw, interpolation)
 
 
 def pad(img, padding, fill=0, padding_mode='constant'):

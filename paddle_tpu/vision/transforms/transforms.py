@@ -33,6 +33,8 @@ class BaseTransform:
                     out.append(self._apply_image(data))
                 else:
                     out.append(data)
+            # elements beyond the declared keys (labels etc.) pass through
+            out.extend(inputs[len(self.keys):])
             return tuple(out)
         return self._apply_image(inputs)
 

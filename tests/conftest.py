@@ -21,6 +21,10 @@ elif jax.default_backend() != "cpu":
         "JAX backend initialized before conftest; run pytest with "
         "PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu")
 
+# Persistent XLA compilation cache: repeated suite runs skip recompiles.
+jax.config.update("jax_compilation_cache_dir", "/tmp/paddle_tpu_xla_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

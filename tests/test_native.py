@@ -1,0 +1,140 @@
+"""Tests for the native (C++) runtime layer: KV store, shm ring, arena,
+tracer. Cross-process tests use the subprocess-launch pattern from the
+reference test strategy (SURVEY.md §4)."""
+import multiprocessing as mp
+import os
+
+import pytest
+
+from paddle_tpu.core import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason=f"native lib unavailable: {native.load_error()}")
+
+
+def test_kv_store_basic():
+    s = native.TCPStore(is_master=True, world_size=1)
+    s.set("alpha", b"1")
+    assert s.get("alpha") == b"1"
+    assert s.add("n", 3) == 3
+    assert s.add("n", -1) == 2
+    assert s.check("alpha") and not s.check("beta")
+    assert s.delete_key("alpha")
+    assert not s.check("alpha")
+    with pytest.raises(TimeoutError):
+        s.get("never", timeout=0.2)
+    assert s.compare_set("cas", b"", b"v1")
+    assert not s.compare_set("cas", b"wrong", b"v2")
+    assert s.get("cas") == b"v1"
+    s.close()
+
+
+def _kv_worker(port, rank, q):
+    from paddle_tpu.core import native as nat
+    c = nat.TCPStore("127.0.0.1", port, world_size=2)
+    c.set(f"rank{rank}", str(rank).encode())
+    other = c.get(f"rank{1 - rank}", timeout=20)
+    c.barrier("b", world_size=2, timeout=20)
+    q.put((rank, other))
+    c.close()
+
+
+def test_kv_store_cross_process():
+    server = native.TCPStoreServer(0)
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_kv_worker, args=(server.port, r, q))
+             for r in range(2)]
+    for p in procs:
+        p.start()
+    results = sorted(q.get(timeout=60) for _ in range(2))
+    for p in procs:
+        p.join(timeout=30)
+    assert results == [(0, b"1"), (1, b"0")]
+    server.stop()
+
+
+def _ring_producer(name, n):
+    from paddle_tpu.core import native as nat
+    r = nat.ShmRing(name)
+    for i in range(n):
+        r.write(bytes([i % 256]) * (i + 1), meta=i)
+    r.producer_done()
+    r.close()
+
+
+def test_shm_ring_cross_process_ordered():
+    name = f"/pt_ring_test_{os.getpid()}"
+    ring = native.ShmRing(name, slot_bytes=4096, n_slots=4, create=True)
+    ctx = mp.get_context("spawn")
+    n = 32
+    p = ctx.Process(target=_ring_producer, args=(name, n))
+    p.start()
+    got = []
+    for _ in range(n):
+        out = ring.read(timeout_ms=30000)
+        assert out is not None
+        data, meta = out
+        got.append((meta, len(data), data[:1]))
+    p.join(timeout=30)
+    assert ring.producers_done() == 1
+    for i, (meta, ln, b0) in enumerate(got):
+        assert meta == i and ln == i + 1 and b0 == bytes([i % 256])
+    ring.close()
+
+
+def test_shm_ring_zero_copy_view():
+    name = f"/pt_ring_view_{os.getpid()}"
+    ring = native.ShmRing(name, slot_bytes=1024, n_slots=2, create=True)
+    ring.write(b"xyz" * 10, meta=1)
+    view, meta, ticket = ring.read_view()
+    assert bytes(view[:3]) == b"xyz" and meta == 1
+    ring.release(ticket)
+    ring.close()
+
+
+def test_shm_ring_oversize_raises():
+    name = f"/pt_ring_big_{os.getpid()}"
+    ring = native.ShmRing(name, slot_bytes=16, n_slots=2, create=True)
+    with pytest.raises(ValueError):
+        ring.write(b"0" * 17)
+    ring.close()
+
+
+def test_host_arena_alloc_free_coalesce():
+    a = native.HostArena()
+    ptrs = [a.alloc(1000) for _ in range(10)]
+    st = a.stats()
+    assert st["allocs"] == 10 and st["in_use"] > 0
+    buf = a.buffer(ptrs[0], 1000)
+    buf[:4] = b"\x01\x02\x03\x04"
+    assert bytes(buf[:4]) == b"\x01\x02\x03\x04"
+    for p in ptrs:
+        a.free(p)
+    assert a.stats()["in_use"] == 0
+    # reuse after coalesce: a big alloc should fit in the freed chunk
+    big = a.alloc(4 << 20)
+    assert a.stats()["reserved"] == st["reserved"]  # no new mmap
+    a.free(big)
+    a.destroy()
+
+
+def test_native_tracer_spans():
+    t = native.NativeTracer(256)
+    t.enable(True)
+    nid = t.intern("fwd")
+    nid2 = t.intern("bwd")
+    assert t.intern("fwd") == nid
+    for _ in range(3):
+        t0 = t.now_ns()
+        t.end(nid, t0)
+    t.end(nid2, t.now_ns())
+    events = t.drain()
+    assert len(events) == 4
+    names = [e[0] for e in events]
+    assert names.count("fwd") == 3 and names.count("bwd") == 1
+    assert all(e[3] >= e[2] for e in events)
+    # drained: buffer resets
+    assert t.drain() == []
+    t.destroy()

@@ -50,33 +50,34 @@ class TestModelZoo:
         assert tuple(out.shape) == (1, 5)
 
     def test_densenet(self):
+        # smallest input the stem supports — keeps eager CPU time bounded
         m = models.densenet121(num_classes=6)
         m.eval()
-        out = m(_rand((1, 3, 64, 64)))
+        out = m(_rand((1, 3, 32, 32)))
         assert tuple(out.shape) == (1, 6)
         assert np.isfinite(n(out)).all()
 
     def test_googlenet_eval_and_train_aux(self):
         m = models.googlenet(num_classes=4)
         m.eval()
-        out, aux1, aux2 = m(_rand((1, 3, 96, 96)))
+        out, aux1, aux2 = m(_rand((1, 3, 64, 64)))
         assert tuple(out.shape) == (1, 4)
         assert aux1 is None and aux2 is None
         m.train()
-        out, aux1, aux2 = m(_rand((1, 3, 224, 224)))
+        out, aux1, aux2 = m(_rand((1, 3, 64, 64)))
         assert tuple(aux1.shape) == (1, 4)
         assert tuple(aux2.shape) == (1, 4)
 
     def test_inception_v3(self):
         m = models.inception_v3(num_classes=3)
         m.eval()
-        out = m(_rand((1, 3, 299, 299)))
+        out = m(_rand((1, 3, 128, 128)))
         assert tuple(out.shape) == (1, 3)
 
     def test_vgg_alexnet(self):
         for m in [models.vgg11(num_classes=3), models.alexnet(num_classes=3)]:
             m.eval()
-            out = m(_rand((1, 3, 224, 224)))
+            out = m(_rand((1, 3, 96, 96)))
             assert tuple(out.shape) == (1, 3)
             assert np.isfinite(n(out)).all()
 
@@ -84,7 +85,7 @@ class TestModelZoo:
         # adaptive pool before the classifier handles any input size
         m = models.vgg11(num_classes=3)
         m.eval()
-        out = m(_rand((1, 3, 256, 256)))
+        out = m(_rand((1, 3, 80, 80)))
         assert tuple(out.shape) == (1, 3)
 
     def test_shufflenet_backward(self):
