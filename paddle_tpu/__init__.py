@@ -37,6 +37,7 @@ from . import distribution  # noqa: F401
 from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import hapi  # noqa: F401
+from . import profiler  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 

@@ -1,0 +1,210 @@
+"""Distributed-config auto-tuner.
+
+Reference: AutoTuner (/root/reference/python/paddle/distributed/auto_tuner/
+tuner.py:21) with pruning rules (prune.py) and cost/memory models
+(cost_model.py, memory_cost_model.py). TPU-native version: candidates are
+mesh layouts (dp/fsdp/tp/pp degrees x micro-batch x remat) over a chip
+count; pruning enforces divisibility and the HBM budget from an analytical
+transformer memory model; ranking uses a roofline cost model (MXU flops +
+ICI collective bytes). The Recorder feeds measured step times back so
+search converges on real data (reference recorder.py).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+__all__ = ["TunableSpace", "ClusterSpec", "ModelSpec", "Candidate",
+           "AutoTuner", "Recorder"]
+
+
+@dataclass
+class ClusterSpec:
+    num_chips: int = 8
+    hbm_bytes: float = 95e9            # v5p: 95GB
+    peak_flops: float = 459e12         # bf16
+    ici_bw: float = 9e10               # bytes/s per link, one direction
+    mxu_efficiency: float = 0.55
+
+
+@dataclass
+class ModelSpec:
+    num_layers: int = 32
+    hidden: int = 4096
+    ffn_hidden: int = 14336
+    heads: int = 32
+    vocab: int = 128256
+    seq_len: int = 8192
+    global_batch: int = 64             # sequences
+    param_bytes: int = 2               # bf16
+    opt_state_bytes: int = 8           # adam f32 m+v
+
+    @property
+    def num_params(self) -> float:
+        layer = (4 * self.hidden * self.hidden
+                 + 3 * self.hidden * self.ffn_hidden)
+        return self.num_layers * layer + 2 * self.vocab * self.hidden
+
+
+@dataclass
+class Candidate:
+    dp: int = 1
+    fsdp: int = 1      # sharding degree (ZeRO-3 analog axis)
+    tp: int = 1
+    pp: int = 1
+    micro_batch: int = 1
+    use_recompute: bool = False
+    est_memory: float = 0.0
+    est_step_time: float = 0.0
+    measured_time: Optional[float] = None
+
+    def degrees(self):
+        return self.dp * self.fsdp * self.tp * self.pp
+
+    def to_dict(self) -> dict:
+        return dict(dp=self.dp, sharding=self.fsdp, mp=self.tp, pp=self.pp,
+                    micro_batch_size=self.micro_batch,
+                    use_recompute=self.use_recompute)
+
+    def key(self) -> str:
+        return json.dumps(self.to_dict(), sort_keys=True)
+
+
+@dataclass
+class TunableSpace:
+    dp_degree: Optional[List[int]] = None
+    sharding_degree: Optional[List[int]] = None
+    mp_degree: Optional[List[int]] = None
+    pp_degree: Optional[List[int]] = None
+    micro_batch_size: Optional[List[int]] = None
+    use_recompute: List[bool] = field(default_factory=lambda: [False, True])
+
+
+def _divisors(n: int) -> List[int]:
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+class AutoTuner:
+    def __init__(self, model: ModelSpec, cluster: ClusterSpec,
+                 space: Optional[TunableSpace] = None):
+        self.model = model
+        self.cluster = cluster
+        self.space = space or TunableSpace()
+        self.recorder = Recorder()
+
+    # -- candidate generation + pruning (prune.py analog) ------------------
+    def candidates(self) -> List[Candidate]:
+        n = self.cluster.num_chips
+        divs = _divisors(n)
+        sp = self.space
+        out = []
+        for dp, fsdp, tp, pp in itertools.product(
+                sp.dp_degree or divs, sp.sharding_degree or divs,
+                sp.mp_degree or divs, sp.pp_degree or divs):
+            if dp * fsdp * tp * pp != n:
+                continue
+            if self.model.num_layers % pp != 0:
+                continue
+            if self.model.heads % tp != 0 or self.model.vocab % tp != 0:
+                continue
+            data_rank = dp * fsdp
+            if self.model.global_batch % data_rank != 0:
+                continue
+            per_rank = self.model.global_batch // data_rank
+            for mb in (sp.micro_batch_size or _divisors(per_rank)):
+                if per_rank % mb != 0:
+                    continue
+                if pp > 1 and per_rank // mb < pp:
+                    continue  # not enough micro-batches to fill the pipe
+                for rc in sp.use_recompute:
+                    c = Candidate(dp, fsdp, tp, pp, mb, rc)
+                    c.est_memory = self.estimate_memory(c)
+                    if c.est_memory > self.cluster.hbm_bytes:
+                        continue
+                    c.est_step_time = self.estimate_step_time(c)
+                    out.append(c)
+        return out
+
+    # -- memory model (memory_cost_model.py analog) ------------------------
+    def estimate_memory(self, c: Candidate) -> float:
+        m = self.model
+        shard = c.tp * c.pp * c.fsdp
+        params = m.num_params * m.param_bytes / shard
+        grads = m.num_params * m.param_bytes / shard
+        opt = m.num_params * m.opt_state_bytes / (c.tp * c.pp * c.fsdp)
+        # activations per chip: micro_batch x seq x hidden x layers/pp
+        act_per_layer = (2 if c.use_recompute else 14)
+        acts = (c.micro_batch * m.seq_len * m.hidden // c.tp
+                * act_per_layer * (m.num_layers // c.pp) * m.param_bytes)
+        if c.pp > 1:
+            acts *= min(c.pp, 2)  # 1F1B in-flight micro-batches bound
+        return params + grads + opt + acts
+
+    # -- roofline step-time model (cost_model.py analog) -------------------
+    def estimate_step_time(self, c: Candidate) -> float:
+        m, cl = self.model, self.cluster
+        tokens = m.global_batch * m.seq_len
+        flops = 6 * m.num_params * tokens
+        if c.use_recompute:
+            flops *= 4 / 3
+        compute = flops / (cl.num_chips * cl.peak_flops * cl.mxu_efficiency)
+        # TP all-reduces: 4 per layer, 2*bytes/bw, on the tp subring
+        comm = 0.0
+        if c.tp > 1:
+            per_layer = (c.micro_batch * m.seq_len * m.hidden
+                         * m.param_bytes)
+            n_micro = max(1, m.global_batch
+                          // (c.dp * c.fsdp * c.micro_batch))
+            comm += (4 * m.num_layers * per_layer * 2 * (c.tp - 1) / c.tp
+                     / cl.ici_bw) * n_micro / max(1, c.pp)
+        if c.fsdp > 1:  # param all-gather + grad reduce-scatter
+            comm += 2 * (m.num_params * m.param_bytes / (c.tp * c.pp)
+                         * (c.fsdp - 1) / c.fsdp) / cl.ici_bw
+        if c.dp > 1:    # grad all-reduce
+            comm += 2 * (m.num_params * m.param_bytes / (c.tp * c.pp)
+                         * (c.dp - 1) / c.dp) / cl.ici_bw
+        if c.pp > 1:    # bubble
+            n_micro = max(1, m.global_batch
+                          // (c.dp * c.fsdp * c.micro_batch))
+            compute *= 1 + (c.pp - 1) / n_micro
+        return compute + comm
+
+    # -- search ------------------------------------------------------------
+    def tune(self, top_k: int = 5) -> List[Candidate]:
+        """Ranked candidates, best (lowest estimated step time) first;
+        measured results override estimates in the ordering."""
+        cands = self.candidates()
+
+        def score(c: Candidate):
+            rec = self.recorder.get(c)
+            return rec if rec is not None else c.est_step_time
+
+        return sorted(cands, key=score)[:top_k]
+
+    def best(self) -> Optional[Candidate]:
+        top = self.tune(top_k=1)
+        return top[0] if top else None
+
+
+class Recorder:
+    """Measured-result store (recorder.py analog)."""
+
+    def __init__(self):
+        self._data: Dict[str, float] = {}
+
+    def record(self, cand: Candidate, step_time: float):
+        cand.measured_time = step_time
+        self._data[cand.key()] = step_time
+
+    def get(self, cand: Candidate) -> Optional[float]:
+        return self._data.get(cand.key())
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(self._data, f, indent=2)
+
+    def load(self, path: str):
+        with open(path) as f:
+            self._data.update(json.load(f))

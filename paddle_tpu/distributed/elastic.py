@@ -1,0 +1,129 @@
+"""Elastic membership + fault tolerance over the native KV store.
+
+Reference: ElasticManager (/root/reference/python/paddle/distributed/
+fleet/elastic/manager.py:126) — etcd leases + heartbeats (:254-296),
+watching the node set, levels FAULT_TOLERANCE=1 / ELASTIC=2 (:42-44).
+Here the store is the C++ TCP KV (no TTL primitives), so liveness is
+timestamped heartbeats: a node is dead when its beat is older than
+2*heartbeat_interval. On membership change the manager reports a new
+world spec so the launcher can re-rendezvous (restart generation bump) —
+on TPU pods that means re-forming the jax.distributed world and resuming
+from the latest checkpoint.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional
+
+from ..core.native import TCPStore
+
+
+class ElasticLevel(Enum):
+    OFF = 0
+    FAULT_TOLERANCE = 1   # fixed world size, restart on failure
+    ELASTIC = 2           # world size may shrink/grow within [min, max]
+
+
+class ElasticStatus(Enum):
+    RUNNING = "running"
+    RESTART = "restart"
+    COMPLETED = "completed"
+    ERROR = "error"
+
+
+class ElasticManager:
+    def __init__(self, store: TCPStore, job_id: str, rank: int,
+                 min_nodes: int, max_nodes: int,
+                 level: ElasticLevel = ElasticLevel.FAULT_TOLERANCE,
+                 heartbeat_interval: float = 2.0):
+        self.store = store
+        self.job_id = job_id
+        self.rank = rank
+        self.min_nodes = min_nodes
+        self.max_nodes = max(max_nodes, min_nodes)
+        self.level = level
+        self.interval = heartbeat_interval
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._on_change: Optional[Callable[[List[int]], None]] = None
+        self._last_alive: List[int] = []
+
+    def _k(self, *parts) -> str:
+        return "/".join(("elastic", self.job_id) + parts)
+
+    # -- membership --------------------------------------------------------
+    def register(self):
+        self.store.set(self._k(f"node{self.rank}"),
+                       json.dumps({"t": time.time()}).encode())
+        self.store.add(self._k("registered"), 1)
+
+    def heartbeat(self):
+        self.store.set(self._k(f"node{self.rank}"),
+                       json.dumps({"t": time.time()}).encode())
+
+    def alive_nodes(self) -> List[int]:
+        """Ranks whose heartbeat is fresh."""
+        alive = []
+        horizon = 2.0 * self.interval
+        for r in range(self.max_nodes):
+            key = self._k(f"node{r}")
+            try:
+                if not self.store.check(key):
+                    continue
+                beat = json.loads(self.store.get(key, timeout=5))
+                if time.time() - beat["t"] <= horizon:
+                    alive.append(r)
+            except Exception:
+                continue
+        return alive
+
+    def healthy(self, alive: Optional[List[int]] = None) -> bool:
+        alive = self.alive_nodes() if alive is None else alive
+        return len(alive) >= self.min_nodes
+
+    # -- watch loop --------------------------------------------------------
+    def start(self, on_change: Optional[Callable[[List[int]], None]] = None):
+        """Start heartbeating + watching in a daemon thread. on_change is
+        called with the new alive set whenever membership changes."""
+        self._on_change = on_change
+        self.register()
+        self._last_alive = self.alive_nodes()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.heartbeat()
+                alive = self.alive_nodes()
+                if set(alive) != set(self._last_alive):
+                    # fire the callback BEFORE updating _last_alive so a
+                    # handler calling plan() still sees the transition
+                    if self._on_change is not None:
+                        self._on_change(alive)
+                    self._last_alive = alive
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- decisions ---------------------------------------------------------
+    def plan(self, alive: Optional[List[int]] = None) -> ElasticStatus:
+        """What should the job do given current membership?"""
+        alive = self.alive_nodes() if alive is None else alive
+        n = len(alive)
+        if n >= self.min_nodes and set(self._last_alive) == set(alive):
+            return ElasticStatus.RUNNING
+        if self.level == ElasticLevel.OFF:
+            return ElasticStatus.ERROR if n < self.min_nodes \
+                else ElasticStatus.RUNNING
+        if n < self.min_nodes:
+            # below quorum: fault-tolerance waits (RESTART when it
+            # recovers); elastic likewise cannot shrink below min
+            return ElasticStatus.ERROR
+        return ElasticStatus.RESTART

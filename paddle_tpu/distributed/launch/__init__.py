@@ -1,0 +1,18 @@
+"""paddle_tpu.distributed.launch — multi-host job launcher.
+
+TPU-native analog of `python -m paddle.distributed.launch`
+(/root/reference/python/paddle/distributed/launch/main.py:20): a Master
+rendezvous (the native TCP KV store instead of etcd/HTTP-KV), a Pod of
+Container processes per node (/root/reference/python/paddle/distributed/
+launch/job/pod.py, container.py), env injection (PADDLE_TRAINER_ID etc.),
+per-rank log files, and a watch loop with restart policy.
+
+The TPU twist: JAX is single-controller-per-host — one process per host
+drives all local chips, so nproc_per_node defaults to 1 (not
+chips-per-host). In-program collectives need no process groups; the
+launcher only bootstraps jax.distributed's coordinator and supervises.
+"""
+from .main import launch, main  # noqa: F401
+from .context import Context  # noqa: F401
+from .pod import Container, Pod  # noqa: F401
+from .master import Master  # noqa: F401

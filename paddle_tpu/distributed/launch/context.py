@@ -1,0 +1,83 @@
+"""Launch context: argument + environment parsing (the reference's
+launch/context/__init__.py Context analog, argument set from
+/root/reference/python/paddle/distributed/launch/main.py docopt table)."""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+
+def free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@dataclass
+class Context:
+    master: Optional[str] = None          # host:port of the rendezvous KV
+    nnodes: int = 1                       # number of hosts (or "N" / "N:M")
+    max_nodes: int = 0                    # elastic upper bound (0 = fixed)
+    nproc_per_node: int = 1               # controller processes per host
+    rank: int = -1                        # fixed node rank (-1 = assigned)
+    job_id: str = "default"
+    log_dir: str = "log"
+    log_level: str = "INFO"
+    devices: Optional[str] = None         # visible accelerator ids
+    training_script: str = ""
+    training_script_args: List[str] = field(default_factory=list)
+    max_restart: int = 3
+    elastic_level: int = 0                # 0 off, 1 fault-tolerant, 2 elastic
+    host: str = ""
+
+    @classmethod
+    def from_args(cls, argv: Optional[List[str]] = None) -> "Context":
+        p = argparse.ArgumentParser(
+            prog="python -m paddle_tpu.distributed.launch",
+            description="paddle_tpu multi-host launcher")
+        p.add_argument("--master", default=os.environ.get("PADDLE_MASTER"))
+        p.add_argument("--nnodes", default=os.environ.get("PADDLE_NNODES",
+                                                          "1"))
+        p.add_argument("--nproc_per_node", type=int,
+                       default=int(os.environ.get("PADDLE_NPROC_PER_NODE",
+                                                  "1")))
+        p.add_argument("--rank", type=int, default=-1)
+        p.add_argument("--job_id", default=os.environ.get("PADDLE_JOB_ID",
+                                                          "default"))
+        p.add_argument("--log_dir", default="log")
+        p.add_argument("--log_level", default="INFO")
+        p.add_argument("--devices", "--gpus", "--xpus", dest="devices",
+                       default=None)
+        p.add_argument("--max_restart", type=int, default=3)
+        p.add_argument("--elastic_level", type=int,
+                       default=int(os.environ.get("PADDLE_ELASTIC_LEVEL",
+                                                  "0")))
+        p.add_argument("training_script")
+        p.add_argument("training_script_args", nargs=argparse.REMAINDER)
+        a = p.parse_args(argv)
+
+        nnodes, max_nodes = cls._parse_nnodes(str(a.nnodes))
+        return cls(master=a.master, nnodes=nnodes, max_nodes=max_nodes,
+                   nproc_per_node=a.nproc_per_node, rank=a.rank,
+                   job_id=a.job_id, log_dir=a.log_dir,
+                   log_level=a.log_level, devices=a.devices,
+                   training_script=a.training_script,
+                   training_script_args=list(a.training_script_args),
+                   max_restart=a.max_restart,
+                   elastic_level=a.elastic_level,
+                   host=socket.gethostname())
+
+    @staticmethod
+    def _parse_nnodes(s: str):
+        """"2" → (2,2 fixed); "2:4" → elastic between 2 and 4."""
+        if ":" in s:
+            lo, hi = s.split(":")
+            return int(lo), int(hi)
+        return int(s), 0
+
+    @property
+    def is_elastic(self) -> bool:
+        return self.max_nodes > self.nnodes or self.elastic_level > 0

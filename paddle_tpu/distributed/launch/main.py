@@ -1,0 +1,128 @@
+"""Launcher controller: rendezvous → env injection → pod supervision.
+
+Collective-controller analog (/root/reference/python/paddle/distributed/
+launch/controllers/collective.py:37 build_pod + controller.py watch loop):
+on each node, sync peers through the Master KV, assign ranks, start the
+training processes with PADDLE_* env injected, then watch; on failure
+restart up to --max_restart times (rendezvous generation bumps so peers
+re-sync). SIGTERM/SIGINT tear the pod down.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import time
+from typing import List, Optional
+
+from .context import Context, free_port
+from .master import Master
+from .pod import Container, Pod
+
+
+def _build_pod(ctx: Context, node_rank: int, peers: List[str],
+               master_ep: Optional[str], generation: int) -> Pod:
+    pod = Pod()
+    nnodes = len(peers)
+    total = nnodes * ctx.nproc_per_node
+    for local in range(ctx.nproc_per_node):
+        rank = node_rank * ctx.nproc_per_node + local
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": str(total),
+            "PADDLE_LOCAL_RANK": str(local),
+            "PADDLE_NNODES": str(nnodes),
+            "PADDLE_NODE_RANK": str(node_rank),
+            "PADDLE_JOB_ID": ctx.job_id,
+            "PADDLE_RESTART_GENERATION": str(generation),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(peers),
+        }
+        if master_ep:
+            host, port = master_ep.rsplit(":", 1)
+            # trainers rendezvous one port above the launcher KV
+            env["PADDLE_MASTER"] = master_ep
+            env["MASTER_ADDR"] = host
+            env["MASTER_PORT"] = str(int(port) + 1)
+        if ctx.devices:
+            env["PADDLE_VISIBLE_DEVICES"] = ctx.devices
+        log = os.path.join(ctx.log_dir,
+                           f"workerlog.{node_rank}.{local}")
+        pod.add(Container([sys.executable, "-u", ctx.training_script,
+                           *ctx.training_script_args], env, log, rank))
+    return pod
+
+
+def launch(ctx: Context) -> int:
+    """Run the job to completion; returns exit code."""
+    single = ctx.nnodes <= 1 and ctx.master is None
+    master = Master(None if single else ctx.master, ctx.job_id,
+                    is_lead=(not single and ctx.rank in (-1, 0)))
+    # NOTE on is_lead with auto-assigned ranks: every candidate tries to
+    # bind the KV port; losers fall back to client-only (bind fails fast).
+    generation = 0
+    restarts = 0
+    code = 0
+    stop = {"flag": False}
+
+    def _sig(signum, frame):
+        stop["flag"] = True
+
+    old_term = signal.signal(signal.SIGTERM, _sig)
+    old_int = signal.signal(signal.SIGINT, _sig)
+    try:
+        while True:
+            my_ep = f"{ctx.host}:{free_port()}"
+            node_rank, peers = master.sync_peers(
+                my_ep, ctx.nnodes, ctx.rank, generation)
+            pod = _build_pod(ctx, node_rank, peers, ctx.master, generation)
+            pod.start()
+            master.heartbeat(node_rank, "running")
+            while True:
+                time.sleep(0.2)
+                if stop["flag"]:
+                    pod.terminate()
+                    master.set_status("stopped", generation)
+                    return 130
+                if master.get_status(generation) == "failed":
+                    pod.terminate()
+                    break  # another node failed → re-rendezvous (restart)
+                failed = pod.failed()
+                if failed:
+                    # must come before the finished() check: with
+                    # nproc_per_node=1 a crashed trainer is also "finished"
+                    for c in failed:
+                        sys.stderr.write(
+                            f"[launch] rank {c.rank} exited "
+                            f"{c.exit_code}; last log:\n"
+                            f"{c.tail_log()}\n")
+                    pod.terminate()
+                    # generation-scoped so peers reliably observe it (a
+                    # shared key cleared right away would race their poll)
+                    master.set_status("failed", generation)
+                    break
+                if pod.finished():
+                    break
+            master.heartbeat(node_rank, "done")
+            if pod.finished() and pod.success():
+                master.set_status("done", generation)
+                return 0
+            restarts += 1
+            if restarts > ctx.max_restart:
+                sys.stderr.write(
+                    f"[launch] giving up after {restarts - 1} restarts\n")
+                return 1
+            sys.stderr.write(
+                f"[launch] restarting (attempt {restarts}/"
+                f"{ctx.max_restart})\n")
+            generation += 1
+            pod.clear()
+    finally:
+        signal.signal(signal.SIGTERM, old_term)
+        signal.signal(signal.SIGINT, old_int)
+        master.close()
+    return code
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ctx = Context.from_args(argv)
+    return launch(ctx)

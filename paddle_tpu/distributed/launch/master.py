@@ -1,0 +1,120 @@
+"""Master rendezvous over the native KV store.
+
+The reference Master (/root/reference/python/paddle/distributed/launch/
+controllers/master.py) is an etcd client or a built-in HTTP KV; here the
+rank-0 node hosts the C++ TCP KV store (paddle_tpu/core/cc/kv_store.cc)
+and every node (including rank 0) joins through a client. Rendezvous
+protocol: each candidate registers its endpoint under a generation key,
+ranks are assigned by registration order (or honored if fixed), and all
+peers fetch the full endpoint list once the quorum is reached.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+from ...core.native import TCPStore, TCPStoreServer, available
+
+
+class Master:
+    def __init__(self, endpoint: Optional[str], job_id: str = "default",
+                 is_lead: bool = False, timeout: float = 300.0):
+        """endpoint: "host:port" of the KV store; None → single-node local
+        mode (no store at all). is_lead: host the store in-process."""
+        self.endpoint = endpoint
+        self.job_id = job_id
+        self.timeout = timeout
+        self._server: Optional[TCPStoreServer] = None
+        self._store: Optional[TCPStore] = None
+        if endpoint is None:
+            return
+        if not available():
+            raise RuntimeError("native KV store unavailable; cannot "
+                               "rendezvous a multi-node job")
+        host, port = endpoint.rsplit(":", 1)
+        if is_lead:
+            # with auto-assigned ranks every candidate offers to host; the
+            # first bind wins, the rest fall back to client-only
+            try:
+                self._server = TCPStoreServer(int(port))
+            except RuntimeError:
+                self._server = None
+        self._store = TCPStore(host, int(port), timeout=timeout)
+
+    @property
+    def store(self) -> Optional[TCPStore]:
+        return self._store
+
+    def _k(self, *parts) -> str:
+        return "/".join(("job", self.job_id) + parts)
+
+    def sync_peers(self, my_endpoint: str, nnodes: int, rank: int = -1,
+                   generation: int = 0) -> Tuple[int, List[str]]:
+        """Register and wait for the quorum. Returns (my_rank, all
+        endpoints ordered by rank). generation bumps on elastic restarts so
+        stale registrations don't collide."""
+        if self._store is None:
+            return 0, [my_endpoint]
+        g = str(generation)
+        if rank < 0:
+            rank = self._store.add(self._k(g, "seq"), 1) - 1
+        self._store.set(self._k(g, f"rank{rank}"), my_endpoint.encode())
+        arrived = self._store.add(self._k(g, "arrived"), 1)
+        if arrived == nnodes:
+            eps = [self._store.get(self._k(g, f"rank{r}"),
+                                   timeout=self.timeout).decode()
+                   for r in range(nnodes)]
+            self._store.set(self._k(g, "peers"), json.dumps(eps).encode())
+        peers = json.loads(self._store.get(self._k(g, "peers"),
+                                           timeout=self.timeout).decode())
+        return rank, peers
+
+    def heartbeat(self, rank: int, status: str = "running"):
+        if self._store is None:
+            return
+        self._store.set(self._k(f"beat{rank}"),
+                        json.dumps({"t": time.time(),
+                                    "status": status}).encode())
+
+    def peer_status(self, nnodes: int) -> List[Optional[dict]]:
+        if self._store is None:
+            return [None] * nnodes
+        out = []
+        for r in range(nnodes):
+            try:
+                if self._store.check(self._k(f"beat{r}")):
+                    out.append(json.loads(
+                        self._store.get(self._k(f"beat{r}"), timeout=5)))
+                else:
+                    out.append(None)
+            except Exception:
+                out.append(None)
+        return out
+
+    def set_status(self, status: str, generation: int = 0):
+        """Generation-scoped: each restart generation has its own status
+        key, so 'failed' sticks until every peer has seen it and moved to
+        the next generation (no clear-before-peers-poll race)."""
+        if self._store is not None:
+            self._store.set(self._k(f"status{generation}"), status.encode())
+
+    def get_status(self, generation: int = 0) -> str:
+        if self._store is None:
+            return ""
+        key = self._k(f"status{generation}")
+        try:
+            if self._store.check(key):
+                return self._store.get(key, timeout=5).decode()
+        except Exception:
+            pass
+        return ""
+
+    def close(self):
+        if self._store is not None:
+            self._store.close()
+            self._store = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None

@@ -1,0 +1,112 @@
+"""Pod/Container process management (reference:
+/root/reference/python/paddle/distributed/launch/job/pod.py, container.py —
+a Pod is this node's set of trainer processes; each Container wraps one
+subprocess with its env + log file)."""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+
+class Container:
+    def __init__(self, entrypoint: List[str], env: Dict[str, str],
+                 log_path: str, rank: int):
+        self.entrypoint = entrypoint
+        self.env = env
+        self.log_path = log_path
+        self.rank = rank
+        self.proc: Optional[subprocess.Popen] = None
+        self._log_file = None
+
+    def start(self):
+        os.makedirs(os.path.dirname(self.log_path) or ".", exist_ok=True)
+        self._log_file = open(self.log_path, "ab", buffering=0)
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        self.proc = subprocess.Popen(
+            self.entrypoint, env=full_env, stdout=self._log_file,
+            stderr=subprocess.STDOUT, start_new_session=True)
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+    @property
+    def exit_code(self) -> Optional[int]:
+        return None if self.proc is None else self.proc.poll()
+
+    def terminate(self, grace: float = 10.0):
+        if self.proc is None or self.proc.poll() is not None:
+            self._close_log()
+            return
+        try:
+            os.killpg(self.proc.pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+        deadline = time.time() + grace
+        while time.time() < deadline and self.proc.poll() is None:
+            time.sleep(0.1)
+        if self.proc.poll() is None:
+            try:
+                os.killpg(self.proc.pid, signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+            self.proc.wait()
+        self._close_log()
+
+    def _close_log(self):
+        if self._log_file is not None:
+            self._log_file.close()
+            self._log_file = None
+
+    def tail_log(self, n: int = 20) -> str:
+        try:
+            with open(self.log_path, "rb") as f:
+                return b"\n".join(
+                    f.read().splitlines()[-n:]).decode(errors="replace")
+        except OSError:
+            return ""
+
+
+class Pod:
+    """This node's trainer processes."""
+
+    def __init__(self):
+        self.containers: List[Container] = []
+
+    def add(self, c: Container):
+        self.containers.append(c)
+
+    def start(self):
+        for c in self.containers:
+            c.start()
+
+    @property
+    def alive(self) -> bool:
+        return any(c.alive for c in self.containers)
+
+    @property
+    def all_alive(self) -> bool:
+        return all(c.alive for c in self.containers)
+
+    def failed(self) -> List[Container]:
+        return [c for c in self.containers
+                if not c.alive and c.exit_code not in (0, None)]
+
+    def finished(self) -> bool:
+        return all(not c.alive for c in self.containers)
+
+    def success(self) -> bool:
+        return all(c.exit_code == 0 for c in self.containers)
+
+    def terminate(self):
+        for c in self.containers:
+            c.terminate()
+
+    def clear(self):
+        self.terminate()
+        self.containers = []

@@ -1,0 +1,102 @@
+"""paddle.distributed.spawn parity
+(/root/reference/python/paddle/distributed/spawn.py:450): run ``func`` in
+``nprocs`` freshly spawned processes with rank env injected, propagate the
+first failure, join all.
+
+On TPU the common case is nprocs=1 per host (single-controller JAX); the
+multi-process form exists for CPU-backend tests and host-parallel
+utilities — matching the reference's subprocess test strategy
+(SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+from typing import Optional, Tuple
+
+
+def _entry(func, rank: int, nprocs: int, args, q, env):
+    os.environ.update(env)
+    os.environ["PADDLE_TRAINER_ID"] = str(rank)
+    os.environ["PADDLE_TRAINERS_NUM"] = str(nprocs)
+    os.environ["PADDLE_LOCAL_RANK"] = str(rank)
+    try:
+        func(*args)
+        q.put((rank, None))
+    except BaseException:
+        q.put((rank, traceback.format_exc()))
+        raise SystemExit(1)
+
+
+class SpawnContext:
+    def __init__(self, procs, q):
+        self.processes = procs
+        self._q = q
+
+    def join(self, timeout: Optional[float] = None) -> bool:
+        """Wait for all; raise on the first reported failure. Also detects
+        children that die without reporting (segfault/OOM-kill), which
+        would otherwise hang q.get() forever."""
+        import queue as _queue
+        import time as _time
+        deadline = None if timeout is None else _time.time() + timeout
+        remaining = len(self.processes)
+        reports = 0
+        while remaining:
+            try:
+                rank, err = self._q.get(timeout=0.2)
+            except _queue.Empty:
+                dead = [p for p in self.processes
+                        if not p.is_alive() and p.exitcode not in (0, None)]
+                # more dead children than received reports → at least one
+                # died silently (a just-written report may still be in
+                # flight: give the queue one final chance)
+                if len(dead) > reports:
+                    try:
+                        rank, err = self._q.get(timeout=1.0)
+                    except _queue.Empty:
+                        for p in self.processes:
+                            if p.is_alive():
+                                p.terminate()
+                        raise RuntimeError(
+                            f"spawned process died without reporting "
+                            f"(exit codes {[p.exitcode for p in dead]}) — "
+                            f"likely killed (OOM/segfault)")
+                elif deadline is not None and _time.time() > deadline:
+                    raise TimeoutError("spawn join timed out")
+                else:
+                    continue
+            remaining -= 1
+            reports += 1
+            if err is not None:
+                for p in self.processes:
+                    if p.is_alive():
+                        p.terminate()
+                raise RuntimeError(
+                    f"spawned process rank {rank} failed:\n{err}")
+        for p in self.processes:
+            p.join()
+        return True
+
+
+def spawn(func, args: Tuple = (), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """Launch func(*args) in nprocs processes. options: env (dict of extra
+    env vars), start_method ('spawn'|'fork'|'forkserver')."""
+    env = dict(options.get("env") or {})
+    method = options.get("start_method", "spawn")
+    ctx = mp.get_context(method)
+    q = ctx.Queue()
+    procs = []
+    for rank in range(nprocs):
+        p = ctx.Process(target=_entry,
+                        args=(func, rank, nprocs, args, q, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    sctx = SpawnContext(procs, q)
+    if join:
+        sctx.join()
+        return None
+    return sctx

@@ -142,13 +142,23 @@ def current_jax_device() -> Optional[jax.Device]:
 class Generator:
     def __init__(self, seed_: int = 0):
         self._seed = int(seed_)
-        self._key = jax.random.PRNGKey(self._seed)
-        self._traced_key = None
-        self._traced_counter = 0
+        self._key_ = None  # lazy: importing the framework must not
+        self._traced_key = None  # initialize a JAX backend (launcher CLI,
+        self._traced_counter = 0  # fork-based dataloader workers)
+
+    @property
+    def _key(self):
+        if self._key_ is None:
+            self._key_ = jax.random.PRNGKey(self._seed)
+        return self._key_
+
+    @_key.setter
+    def _key(self, v):
+        self._key_ = v
 
     def manual_seed(self, seed_: int):
         self._seed = int(seed_)
-        self._key = jax.random.PRNGKey(self._seed)
+        self._key_ = jax.random.PRNGKey(self._seed)
         return self
 
     @property

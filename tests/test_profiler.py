@@ -1,0 +1,132 @@
+"""Profiler tests: scheduler windows, span capture, chrome export,
+summary, benchmark timer (reference model: test/legacy_test
+profiler tests + profiler/profiler.py behaviors)."""
+import json
+import os
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import profiler
+from paddle_tpu.profiler import (
+    Profiler, ProfilerState, ProfilerTarget, RecordEvent,
+    export_chrome_tracing, make_scheduler,
+)
+
+
+def test_make_scheduler_states():
+    sch = make_scheduler(closed=1, ready=1, record=2, repeat=1)
+    states = [sch(i) for i in range(6)]
+    assert states[0] == ProfilerState.CLOSED
+    assert states[1] == ProfilerState.READY
+    assert states[2] == ProfilerState.RECORD
+    assert states[3] == ProfilerState.RECORD_AND_RETURN
+    # repeat=1 → stays closed after one period
+    assert states[4] == ProfilerState.CLOSED
+    assert states[5] == ProfilerState.CLOSED
+
+
+def test_scheduler_skip_first_and_repeat():
+    sch = make_scheduler(closed=0, ready=0, record=1, skip_first=2)
+    assert sch(0) == ProfilerState.CLOSED
+    assert sch(1) == ProfilerState.CLOSED
+    assert sch(2) == ProfilerState.RECORD_AND_RETURN
+
+
+def test_profiler_records_spans_and_exports(tmp_path):
+    out = str(tmp_path / "trace")
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    on_trace_ready=export_chrome_tracing(out))
+    prof.start()
+    for _ in range(3):
+        with RecordEvent("train_step"):
+            with RecordEvent("forward"):
+                pass
+        prof.step()
+    prof.stop()
+    names = [e["name"] for e in prof.events]
+    assert names.count("train_step") == 3
+    assert names.count("forward") == 3
+    # durations sane
+    for e in prof.events:
+        if e.get("ph") == "X":
+            assert e["dur"] >= 0
+    # chrome export written by on_trace_ready, loads as json
+    files = os.listdir(out)
+    assert len(files) == 1
+    data = json.load(open(os.path.join(out, files[0])))
+    assert "traceEvents" in data and len(data["traceEvents"]) >= 6
+
+
+def test_profiler_windows_export_disjoint_events(tmp_path):
+    # each recorded window exports only its own spans (no duplication)
+    out = str(tmp_path / "trace")
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    scheduler=make_scheduler(closed=1, ready=0, record=1,
+                                             repeat=2),
+                    on_trace_ready=export_chrome_tracing(out))
+    prof.start()
+    for i in range(4):
+        with RecordEvent(f"s{i}"):
+            pass
+        prof.step()
+    prof.stop()
+    files = sorted(os.listdir(out))
+    assert len(files) == 2
+    ev0 = [e["name"] for e in
+           json.load(open(os.path.join(out, files[0])))["traceEvents"]
+           if e.get("ph") == "X"]
+    ev1 = [e["name"] for e in
+           json.load(open(os.path.join(out, files[1])))["traceEvents"]
+           if e.get("ph") == "X"]
+    assert set(ev0) & set(ev1) == set()
+    assert sorted(set(ev0) | set(ev1)) == ["s1", "s3"]
+
+
+def test_profiler_window_scheduler_only_records_window():
+    prof = Profiler(targets=[ProfilerTarget.CPU],
+                    scheduler=make_scheduler(closed=2, ready=0, record=1,
+                                             repeat=1))
+    prof.start()
+    for i in range(5):
+        with RecordEvent(f"step{i}"):
+            pass
+        prof.step()
+    prof.stop()
+    names = {e["name"] for e in prof.events if e.get("ph") == "X"}
+    assert "step2" in names
+    assert "step0" not in names and "step1" not in names
+    assert "step3" not in names
+
+
+def test_profiler_summary_table():
+    prof = Profiler(targets=[ProfilerTarget.CPU])
+    prof.start()
+    with RecordEvent("matmul"):
+        np.dot(np.ones((64, 64)), np.ones((64, 64)))
+    prof.step(num_samples=32)
+    prof.stop()
+    s = prof.summary()
+    assert "matmul" in s and "Calls" in s
+    assert "throughput" in s
+
+
+def test_benchmark_timer():
+    b = profiler.benchmark()
+    b.begin()
+    for _ in range(4):
+        b.step(num_samples=8)
+    out = b.end()
+    assert "steps: 4" in out
+    assert b.speed_average() > 0
+
+
+def test_profiler_context_manager_and_batch_range():
+    with Profiler(targets=[ProfilerTarget.CPU], scheduler=(1, 3)) as prof:
+        for i in range(4):
+            with RecordEvent("w"):
+                pass
+            prof.step()
+    names = [e for e in prof.events if e.get("ph") == "X"]
+    # recorded batches [1, 3) → 2 spans
+    assert len(names) == 2
