@@ -38,6 +38,7 @@ from . import fft  # noqa: F401
 from . import signal  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
+from . import static  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 
@@ -66,14 +67,16 @@ def is_compiled_with_tpu() -> bool:
 
 
 def disable_static(place=None):
+    from . import static as _static
+    _static.disable_static()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu is eager+jit only; use paddle_tpu.jit.to_static for "
-        "compiled graphs (the XLA path replaces the static-graph executor).")
+    from . import static as _static
+    _static.enable_static()
 
 
 def in_dynamic_mode() -> bool:
-    return True
+    from .static.program import in_static_mode
+    return not in_static_mode()

@@ -72,8 +72,14 @@ def launch(ctx: Context) -> int:
     try:
         while True:
             my_ep = f"{ctx.host}:{free_port()}"
-            node_rank, peers = master.sync_peers(
-                my_ep, ctx.nnodes, ctx.rank, generation)
+            try:
+                node_rank, peers = master.sync_peers(
+                    my_ep, ctx.nnodes, ctx.rank, generation)
+            except (TimeoutError, RuntimeError) as e:
+                sys.stderr.write(
+                    f"[launch] rendezvous failed at generation "
+                    f"{generation}: {e}\n")
+                return 1
             pod = _build_pod(ctx, node_rank, peers, ctx.master, generation)
             pod.start()
             master.heartbeat(node_rank, "running")
@@ -104,8 +110,22 @@ def launch(ctx: Context) -> int:
                     break
             master.heartbeat(node_rank, "done")
             if pod.finished() and pod.success():
+                # don't clobber a peer's failure report for this
+                # generation; a mixed done/failed world is a job failure
+                if master.get_status(generation) == "failed":
+                    sys.stderr.write(
+                        "[launch] local pod succeeded but a peer failed; "
+                        "exiting\n")
+                    return 1
                 master.set_status("done", generation)
                 return 0
+            # if peers already completed this generation, restarting alone
+            # can never re-form the quorum — give up with a clear message
+            if master.get_status(generation) == "done":
+                sys.stderr.write(
+                    "[launch] peers completed generation "
+                    f"{generation} but this pod failed; not restarting\n")
+                return 1
             restarts += 1
             if restarts > ctx.max_restart:
                 sys.stderr.write(
@@ -119,6 +139,7 @@ def launch(ctx: Context) -> int:
     finally:
         signal.signal(signal.SIGTERM, old_term)
         signal.signal(signal.SIGINT, old_int)
+        master.checkout(ctx.nnodes)
         master.close()
     return code
 

@@ -15,7 +15,20 @@ import os
 import time
 from typing import List, Optional, Tuple
 
+import socket as _socket
+
 from ...core.native import TCPStore, TCPStoreServer, available
+
+
+def _is_local_host(host: str) -> bool:
+    if host in ("127.0.0.1", "0.0.0.0", "localhost",
+                _socket.gethostname()):
+        return True
+    try:
+        return _socket.gethostbyname(host) in (
+            "127.0.0.1", _socket.gethostbyname(_socket.gethostname()))
+    except OSError:
+        return False
 
 
 class Master:
@@ -34,9 +47,11 @@ class Master:
             raise RuntimeError("native KV store unavailable; cannot "
                                "rendezvous a multi-node job")
         host, port = endpoint.rsplit(":", 1)
-        if is_lead:
-            # with auto-assigned ranks every candidate offers to host; the
-            # first bind wins, the rest fall back to client-only
+        if is_lead and _is_local_host(host):
+            # with auto-assigned ranks every LOCAL candidate offers to
+            # host; the first bind wins, the rest fall back to client-only.
+            # A non-local candidate binding its own port would "win" a
+            # store nobody connects to.
             try:
                 self._server = TCPStoreServer(int(port))
             except RuntimeError:
@@ -60,6 +75,11 @@ class Master:
         g = str(generation)
         if rank < 0:
             rank = self._store.add(self._k(g, "seq"), 1) - 1
+        if rank >= nnodes:
+            raise RuntimeError(
+                f"node joined as rank {rank} but the job is fixed at "
+                f"nnodes={nnodes}; elastic worlds must re-rendezvous with "
+                f"a larger quorum, not join an existing one")
         self._store.set(self._k(g, f"rank{rank}"), my_endpoint.encode())
         arrived = self._store.add(self._k(g, "arrived"), 1)
         if arrived == nnodes:
@@ -74,9 +94,12 @@ class Master:
     def heartbeat(self, rank: int, status: str = "running"):
         if self._store is None:
             return
-        self._store.set(self._k(f"beat{rank}"),
-                        json.dumps({"t": time.time(),
-                                    "status": status}).encode())
+        try:
+            self._store.set(self._k(f"beat{rank}"),
+                            json.dumps({"t": time.time(),
+                                        "status": status}).encode())
+        except RuntimeError:
+            pass  # advisory: the leader may already be gone at job end
 
     def peer_status(self, nnodes: int) -> List[Optional[dict]]:
         if self._store is None:
@@ -98,7 +121,11 @@ class Master:
         key, so 'failed' sticks until every peer has seen it and moved to
         the next generation (no clear-before-peers-poll race)."""
         if self._store is not None:
-            self._store.set(self._k(f"status{generation}"), status.encode())
+            try:
+                self._store.set(self._k(f"status{generation}"),
+                                status.encode())
+            except RuntimeError:
+                pass  # advisory at job end (leader may be gone)
 
     def get_status(self, generation: int = 0) -> str:
         if self._store is None:
@@ -110,6 +137,22 @@ class Master:
         except Exception:
             pass
         return ""
+
+    def checkout(self, nnodes: int, timeout: float = 20.0):
+        """Called on exit: count this node out; the store-hosting leader
+        lingers until all nodes checked out (or timeout) so peers' final
+        status/heartbeat writes don't hit a dead server."""
+        if self._store is None:
+            return
+        try:
+            n = self._store.add(self._k("exited"), 1)
+            if self._server is not None:
+                deadline = time.time() + timeout
+                while n < nnodes and time.time() < deadline:
+                    time.sleep(0.1)
+                    n = self._store.add(self._k("exited"), 0)
+        except RuntimeError:
+            pass
 
     def close(self):
         if self._store is not None:

@@ -42,33 +42,35 @@ class SpawnContext:
         import time as _time
         deadline = None if timeout is None else _time.time() + timeout
         remaining = len(self.processes)
-        reports = 0
+        reported_ranks: set = set()
         while remaining:
             try:
                 rank, err = self._q.get(timeout=0.2)
             except _queue.Empty:
-                dead = [p for p in self.processes
-                        if not p.is_alive() and p.exitcode not in (0, None)]
-                # more dead children than received reports → at least one
-                # died silently (a just-written report may still be in
-                # flight: give the queue one final chance)
-                if len(dead) > reports:
+                # a dead nonzero-exit child that never reported = silent
+                # death (reports carry the rank; processes[rank] is it)
+                silent = [r for r, p in enumerate(self.processes)
+                          if not p.is_alive() and p.exitcode not in (0, None)
+                          and r not in reported_ranks]
+                if silent:
+                    # a just-written report may be in flight: one grace get
                     try:
                         rank, err = self._q.get(timeout=1.0)
                     except _queue.Empty:
                         for p in self.processes:
                             if p.is_alive():
                                 p.terminate()
+                        codes = [self.processes[r].exitcode for r in silent]
                         raise RuntimeError(
-                            f"spawned process died without reporting "
-                            f"(exit codes {[p.exitcode for p in dead]}) — "
-                            f"likely killed (OOM/segfault)")
+                            f"spawned rank(s) {silent} died without "
+                            f"reporting (exit codes {codes}) — likely "
+                            f"killed (OOM/segfault)")
                 elif deadline is not None and _time.time() > deadline:
                     raise TimeoutError("spawn join timed out")
                 else:
                     continue
             remaining -= 1
-            reports += 1
+            reported_ranks.add(rank)
             if err is not None:
                 for p in self.processes:
                     if p.is_alive():

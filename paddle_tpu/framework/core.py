@@ -552,6 +552,19 @@ def _set_amp_hook(fn):
     _amp_hook = fn
 
 
+# Static-graph recorder hook (installed by paddle_tpu.static): when static
+# mode is on and any arg is symbolic, ops append graph nodes instead of
+# executing — the analog of OpDesc appending to the default main Program
+# (/root/reference/python/paddle/base/framework.py), except the "IR" is a
+# DAG of pure jax thunks and shape inference is jax.eval_shape.
+_static_handler: Optional[Callable] = None
+
+
+def _set_static_handler(fn):
+    global _static_handler
+    _static_handler = fn
+
+
 def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
     """Run ``fn`` over the unwrapped jax arrays of ``args``, recording a
     TapeNode when gradients are required. ``fn`` must be pure; non-Tensor
@@ -562,6 +575,10 @@ def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
     AMP autocast → (optional) grad-node creation → kernel invocation, except
     the 'kernel' is a jnp/lax composition compiled by XLA.
     """
+    if _static_handler is not None:
+        out = _static_handler(op_name, fn, args, kwargs)
+        if out is not NotImplemented:
+            return out
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
     tensors = [args[i] for i in tensor_pos]
 
@@ -609,6 +626,10 @@ def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
 
 def apply_nodiff(op_name: str, fn: Callable, *args, **kwargs):
     """Dispatch for non-differentiable ops (argmax, comparisons, ...)."""
+    if _static_handler is not None:
+        out = _static_handler(op_name, fn, args, kwargs)
+        if out is not NotImplemented:
+            return out
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
 
     full = list(args)

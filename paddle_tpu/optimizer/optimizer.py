@@ -131,6 +131,14 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        # static mode: attach the train spec to the loss's Program — the
+        # Executor compiles fwd+bwd+update as one donated-buffer XLA step
+        # (reference: minimize appends backward+optimizer OpDescs,
+        # python/paddle/optimizer/optimizer.py)
+        from ..static.program import Variable as _StaticVar
+        if isinstance(loss, _StaticVar):
+            loss.program._train_spec = {"loss": loss, "optimizer": self}
+            return [], []
         loss.backward()
         self.step()
         self.clear_grad()

@@ -225,9 +225,12 @@ class Profiler:
         prev = self.current_state
         self.step_num += 1
         self.current_state = self.scheduler(self.step_num)
-        if prev in (ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN) \
-                and self.current_state not in (ProfilerState.RECORD,
-                                               ProfilerState.RECORD_AND_RETURN):
+        # RECORD_AND_RETURN marks the window's last step: deliver even if
+        # the next window starts immediately (closed=0, ready=0)
+        if prev == ProfilerState.RECORD_AND_RETURN or (
+                prev == ProfilerState.RECORD
+                and self.current_state not in (
+                    ProfilerState.RECORD, ProfilerState.RECORD_AND_RETURN)):
             # window closed → deliver trace
             self._recording = False  # before _drain: tracer must disable
             self._drain()

@@ -1,0 +1,37 @@
+"""paddle_tpu.static — static-graph API (Program/Executor).
+
+Parity with paddle.static (/root/reference/python/paddle/static/,
+base/framework.py, base/executor.py), rebuilt TPU-first: the Program is a
+DAG of pure jax thunks (program.py), the Executor is whole-program
+jax.jit (executor.py), and save/load_inference_model round-trips through
+StableHLO via jax.export (io.py) — the serving artifact the reference
+gets from ProgramDesc protobufs + AnalysisPredictor.
+"""
+from .program import (  # noqa: F401
+    InputSpec, Program, Variable, data, default_main_program,
+    default_startup_program, disable_static, enable_static, in_static_mode,
+    program_guard,
+)
+from .executor import Executor, global_scope  # noqa: F401
+from .io import (  # noqa: F401
+    load_inference_model, save_inference_model,
+)
+from . import nn  # noqa: F401
+
+__all__ = [
+    "InputSpec", "Program", "Variable", "data", "default_main_program",
+    "default_startup_program", "enable_static", "disable_static",
+    "program_guard", "Executor", "global_scope", "save_inference_model",
+    "load_inference_model", "nn", "append_backward",
+]
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None):
+    """Marks the program for gradient computation (reference:
+    base/backward.py append_backward, which appends grad OpDescs). Here
+    gradients are produced by jax.grad over the whole program at compile
+    time, so this only validates and records intent; returns [] (the
+    param/grad pairs materialize inside the compiled step)."""
+    prog = loss.program
+    prog._needs_backward = True
+    return []

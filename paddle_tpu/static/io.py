@@ -1,0 +1,106 @@
+"""save/load_inference_model over jax.export (StableHLO).
+
+Reference: paddle.static.save_inference_model serializes ProgramDesc
+protobuf + persistables, consumed by AnalysisPredictor
+(/root/reference/python/paddle/static/io.py, paddle/fluid/inference/).
+TPU-native artifact: the traced program exported as serialized StableHLO
+(jax.export) — a stable, versioned, runtime-loadable form — plus a numpy
+archive of parameters. Loading rebuilds a callable without the Python
+model code, exactly the deployment contract the reference's inference
+engine provides.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from .executor import _evaluate
+from .program import Program, Variable
+
+__all__ = ["save_inference_model", "load_inference_model"]
+
+
+def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
+                         fetch_vars: Sequence[Variable], executor=None,
+                         program: Optional[Program] = None, **kwargs):
+    """Serialize the subgraph feed_vars → fetch_vars.
+
+    Writes <prefix>.pdmodel (pickled {stablehlo, in/out specs}) and
+    <prefix>.pdiparams (npz of captured parameters)."""
+    feed_vars = list(feed_vars)
+    fetch_vars = list(fetch_vars)
+    program = program or feed_vars[0].program
+
+    # concrete captures (params/buffers) become explicit inputs so the
+    # exported artifact is self-contained and the arrays swappable
+    captured: List[Tensor] = []
+    seen = set()
+    for node in program.nodes:
+        for a in node.args:
+            if isinstance(a, Tensor) and id(a) not in seen:
+                seen.add(id(a))
+                captured.append(a)
+
+    def fn(feed_arrays, param_arrays):
+        env = {id(v): a for v, a in zip(feed_vars, feed_arrays)}
+        env.update({id(t): a for t, a in zip(captured, param_arrays)})
+        return tuple(_evaluate(program, env, fetch_vars))
+
+    feed_avals = [jax.ShapeDtypeStruct(v.aval.shape, v.aval.dtype)
+                  for v in feed_vars]
+    param_avals = [jax.ShapeDtypeStruct(t._value.shape, t._value.dtype)
+                   for t in captured]
+    exported = jax.export.export(jax.jit(fn))(feed_avals, param_avals)
+    blob = exported.serialize()
+
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdmodel", "wb") as f:
+        pickle.dump({
+            "stablehlo": blob,
+            "feed_names": [v.name for v in feed_vars],
+            "feed_shapes": [tuple(v.aval.shape) for v in feed_vars],
+            "feed_dtypes": [str(v.aval.dtype) for v in feed_vars],
+            "fetch_names": [v.name for v in fetch_vars],
+        }, f)
+    np.savez(path_prefix + ".pdiparams",
+             **{f"p{i}": np.asarray(t._value)
+                for i, t in enumerate(captured)})
+    return path_prefix
+
+
+class _LoadedPredictor:
+    """Callable rebuilt from the serialized artifact."""
+
+    def __init__(self, path_prefix: str):
+        with open(path_prefix + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        self.feed_names: List[str] = meta["feed_names"]
+        self.fetch_names: List[str] = meta["fetch_names"]
+        self.feed_shapes = meta["feed_shapes"]
+        self.feed_dtypes = meta["feed_dtypes"]
+        self._exported = jax.export.deserialize(meta["stablehlo"])
+        z = np.load(path_prefix + ".pdiparams.npz")
+        self._params = [jnp.asarray(z[f"p{i}"]) for i in range(len(z.files))]
+
+    def run(self, feeds: Sequence) -> List[np.ndarray]:
+        feed_arrays = [jnp.asarray(x._value if isinstance(x, Tensor) else x)
+                       for x in feeds]
+        out = self._exported.call(feed_arrays, self._params)
+        return [np.asarray(o) for o in out]
+
+    def __call__(self, *feeds):
+        return self.run(list(feeds))
+
+
+def load_inference_model(path_prefix: str, executor=None, **kwargs):
+    """Returns (predictor, feed_names, fetch_names) — the reference
+    returns (program, feed_names, fetch_names); the predictor here plays
+    the program role (pass feeds positionally to .run)."""
+    pred = _LoadedPredictor(path_prefix)
+    return pred, pred.feed_names, pred.fetch_names

@@ -1,0 +1,105 @@
+"""paddle.static.nn parity (/root/reference/python/paddle/static/nn/):
+graph-building layer functions. Each creates concrete Parameters (eager)
+and records the compute symbolically through the shared functional ops —
+the same split the reference has between startup (param init) and main
+(compute) programs.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..framework.core import Parameter
+from ..framework import dtype as dtypes
+from .. import nn as _nn
+from ..nn import functional as F
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "layer_norm",
+           "dropout"]
+
+
+def _param(shape, dtype, initializer=None, name=None):
+    from ..nn.initializer import XavierNormal
+    init = initializer or XavierNormal()
+    d = dtypes.convert_dtype(dtype)
+    return Parameter(init(tuple(shape), d))
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation: Optional[str] = None, name=None):
+    in_dim = int(np.prod(x.shape[num_flatten_dims:]))
+    w = _param((in_dim, size), x.dtype)
+    b = _param((size,), x.dtype) if bias_attr is not False else None
+    h = x
+    if len(x.shape) > num_flatten_dims + 1:
+        h = h.reshape([*x.shape[:num_flatten_dims], in_dim])
+    out = h.matmul(w)
+    if b is not None:
+        out = out + b
+    if activation:
+        out = getattr(F, activation)(out)
+    return out
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act: Optional[str] = None, data_format="NCHW", name=None):
+    k = filter_size if isinstance(filter_size, (tuple, list)) \
+        else (filter_size, filter_size)
+    in_c = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    w = _param((num_filters, in_c // groups, *k), input.dtype)
+    b = _param((num_filters,), input.dtype) if bias_attr is not False \
+        else None
+    out = F.conv2d(input, w, b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def batch_norm(input, act=None, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               is_test=False, name=None):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    scale = _param((c,), input.dtype)
+    scale.set_value(np.ones(c, np.float32))
+    bias = _param((c,), input.dtype)
+    bias.set_value(np.zeros(c, np.float32))
+    mean = Tensor(jnp.zeros(c, dtypes.convert_dtype(input.dtype)))
+    var = Tensor(jnp.ones(c, dtypes.convert_dtype(input.dtype)))
+    out = F.batch_norm(input, mean, var, scale, bias, training=not is_test,
+                       momentum=momentum, epsilon=epsilon,
+                       data_format=data_layout)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def embedding(input, size: Sequence[int], is_sparse=False,
+              param_attr=None, dtype="float32", name=None):
+    w = _param(tuple(size), dtype)
+    return F.embedding(input, w)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = tuple(input.shape[begin_norm_axis:])
+    import jax.numpy as jnp
+    from ..framework.core import Tensor
+    w = Parameter(jnp.ones(shape, dtypes.convert_dtype(input.dtype))) \
+        if scale else None
+    b = Parameter(jnp.zeros(shape, dtypes.convert_dtype(input.dtype))) \
+        if shift else None
+    out = F.layer_norm(input, shape, w, b, epsilon)
+    if act:
+        out = getattr(F, act)(out)
+    return out
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, name=None):
+    return F.dropout(x, p=dropout_prob, training=not is_test)

@@ -1,0 +1,358 @@
+"""Static graph core: Program / Variable / recorder.
+
+TPU-native re-imagination of the reference's Program/Block/Variable IR
+(/root/reference/python/paddle/base/framework.py:5742 Program, :1467
+Variable, OpDesc protos): instead of a serialized op-desc IR interpreted
+by a C++ executor, a Program is a DAG of **pure jax thunks** — each
+recorded op holds the same jnp/lax composition the eager path runs.
+Shape/dtype propagation (the reference's InferMeta pass,
+/root/reference/paddle/phi/infermeta/) is ``jax.eval_shape`` over the
+thunk: every Variable carries a concrete ShapeDtypeStruct at build time.
+Execution (paddle_tpu/static/executor.py) traces the DAG once under
+jax.jit — XLA is the instruction scheduler, stream analyzer and GC that
+the reference implements by hand (SURVEY.md §2.5 items 8-9).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..framework import core as fcore
+from ..framework import dtype as dtypes
+from ..framework.core import Parameter, Tensor
+
+__all__ = [
+    "Program", "Variable", "program_guard", "default_main_program",
+    "default_startup_program", "in_static_mode", "enable_static",
+    "disable_static", "data", "InputSpec",
+]
+
+
+class Node:
+    """One recorded op: outputs = fn(*inputs) with non-Variable args
+    captured as constants."""
+
+    __slots__ = ("op_name", "fn", "args", "kwargs", "n_out", "out_vars")
+
+    def __init__(self, op_name, fn, args, kwargs):
+        self.op_name = op_name
+        self.fn = fn
+        self.args = args          # mix of Variable / Tensor / python consts
+        self.kwargs = kwargs
+        self.n_out = 0
+        self.out_vars: List["Variable"] = []
+
+
+class Variable:
+    """Symbolic tensor in a Program (reference Variable,
+    base/framework.py:1467): named, with a build-time aval. Duck-types the
+    Tensor surface that layers touch (shape/dtype/ndim/astype/common
+    operators), so `paddle.nn` layers build static graphs unchanged."""
+
+    def __init__(self, program: "Program", aval, name: str,
+                 node: Optional[Node] = None, out_idx: int = 0,
+                 stop_gradient: bool = True, is_feed: bool = False):
+        self.program = program
+        self.aval = aval          # jax.ShapeDtypeStruct
+        self.name = name
+        self.node = node
+        self.out_idx = out_idx
+        self.stop_gradient = stop_gradient
+        self.is_feed = is_feed
+        self.persistable = False
+
+    # -- Tensor-like surface ------------------------------------------------
+    @property
+    def shape(self):
+        return list(self.aval.shape)
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self.aval.dtype)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    def astype(self, dtype):
+        d = dtypes.convert_dtype(dtype)
+        return fcore.apply("cast", lambda x: x.astype(d), self)
+
+    def cast(self, dtype):
+        return self.astype(dtype)
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={dtypes.dtype_name(self.dtype)})")
+
+    def __getattr__(self, name):
+        # tensor methods (matmul, reshape, sum, ...) monkey-patched onto
+        # Tensor work on Variables too: they all route through fcore.apply
+        method = fcore._tensor_method_registry.get(name)
+        if method is not None:
+            return lambda *a, **k: method(self, *a, **k)
+        raise AttributeError(
+            f"'Variable' object has no attribute {name!r}")
+
+
+# arithmetic dunders: reuse whatever got patched onto Tensor
+def _alias_tensor_dunders():
+    for dunder in ("__add__", "__radd__", "__sub__", "__rsub__", "__mul__",
+                   "__rmul__", "__truediv__", "__rtruediv__", "__matmul__",
+                   "__neg__", "__pow__", "__rpow__", "__mod__", "__lt__",
+                   "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
+                   "__getitem__"):
+        fn = getattr(Tensor, dunder, None)
+        if fn is not None and not hasattr(Variable, dunder):
+            setattr(Variable, dunder, fn)
+
+
+class Program:
+    """An op DAG + its feed variables and referenced parameters."""
+
+    _counter = 0
+
+    def __init__(self):
+        Program._counter += 1
+        self.id = Program._counter
+        self.nodes: List[Node] = []
+        self.feeds: Dict[str, Variable] = {}
+        self.vars: Dict[str, Variable] = {}
+        self._name_counter = 0
+        self.version = 0           # bumped per appended node (cache key)
+        # optimizer state attached by minimize() (executor updates it)
+        self._train_spec = None
+        # id(node) → replacement fn (clone(for_test): dropout → identity)
+        self._node_overrides: Dict[int, Callable] = {}
+
+    # -- naming -------------------------------------------------------------
+    def _unique_name(self, prefix: str) -> str:
+        self._name_counter += 1
+        return f"{prefix}_{self._name_counter}"
+
+    # -- recording ----------------------------------------------------------
+    def add_feed(self, name: str, shape, dtype) -> Variable:
+        aval = jax.ShapeDtypeStruct(
+            tuple(d if d and d > 0 else 1 for d in shape),
+            dtypes.convert_dtype(dtype))
+        # dynamic dims (None/-1) are materialized per-run from the feed;
+        # the build-time aval uses 1 as placeholder
+        v = Variable(self, aval, name, stop_gradient=True, is_feed=True)
+        v._declared_shape = tuple(shape)
+        self.feeds[name] = v
+        self.vars[name] = v
+        return v
+
+    def record(self, op_name: str, fn: Callable, args: tuple,
+               kwargs: dict):
+        """Append a node; infer output avals via jax.eval_shape (InferMeta
+        analog). Returns Variable or tuple of Variables."""
+        node = Node(op_name, fn, args, kwargs)
+
+        sym_pos = [i for i, a in enumerate(args)
+                   if isinstance(a, Variable)]
+        avals = [args[i].aval for i in sym_pos]
+
+        def abstract(*sym_vals):
+            full = list(args)
+            for i, v in zip(sym_pos, sym_vals):
+                full[i] = v
+            full = [a._value if isinstance(a, Tensor) else a for a in full]
+            return fn(*full, **kwargs)
+
+        out_aval = jax.eval_shape(abstract, *avals)
+        multi = isinstance(out_aval, (tuple, list))
+        out_list = list(out_aval) if multi else [out_aval]
+        node.n_out = len(out_list)
+
+        any_grad = any(not args[i].stop_gradient for i in sym_pos) or any(
+            isinstance(a, Tensor) and not a.stop_gradient for a in args)
+        outs = []
+        for k, av in enumerate(out_list):
+            name = self._unique_name(op_name)
+            v = Variable(self, jax.ShapeDtypeStruct(av.shape, av.dtype),
+                         name, node, k, stop_gradient=not any_grad)
+            self.vars[name] = v
+            outs.append(v)
+        node.out_vars = outs
+        self.nodes.append(node)
+        self.version += 1
+        return tuple(outs) if multi else outs[0]
+
+    # -- introspection -------------------------------------------------------
+    def parameters(self) -> List[Parameter]:
+        """Concrete Parameters referenced by recorded nodes (the analog of
+        the startup program's persistables)."""
+        seen, out = set(), []
+        for node in self.nodes:
+            for a in node.args:
+                if isinstance(a, Parameter) and id(a) not in seen:
+                    seen.add(id(a))
+                    out.append(a)
+        return out
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def global_block(self):
+        return _BlockFacade(self)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        p = Program()
+        p.nodes = list(self.nodes)
+        p.feeds = dict(self.feeds)
+        p.vars = dict(self.vars)
+        p._name_counter = self._name_counter
+        p.version = self.version
+        p._node_overrides = dict(self._node_overrides)
+        if for_test:
+            # reference semantics: strip training-only behavior. Dropout
+            # thunks captured training=True at record time, so this clone
+            # overrides those nodes with identity (eval dropout in
+            # upscale_in_train mode IS identity) — via an override map, so
+            # the shared Node/Variable objects of the source program stay
+            # untouched. Train-mode batch_norm can't be rewritten post-hoc
+            # — build eval programs with is_test=True.
+            import warnings
+            for node in p.nodes:
+                if node.op_name == "dropout":
+                    p._node_overrides[id(node)] = \
+                        lambda x, *rest, **kw: x
+                elif node.op_name == "batch_norm":
+                    warnings.warn(
+                        "clone(for_test=True) cannot convert a recorded "
+                        "train-mode batch_norm to eval mode; build the "
+                        "eval program with is_test=True")
+            p.version += 1
+        return p
+
+    def __repr__(self):
+        ops = ", ".join(n.op_name for n in self.nodes[:8])
+        more = "..." if len(self.nodes) > 8 else ""
+        return (f"Program(id={self.id}, {len(self.nodes)} ops: "
+                f"[{ops}{more}], feeds={list(self.feeds)})")
+
+
+class _BlockFacade:
+    """Minimal Block view (reference Block, base/framework.py:3350):
+    enough for code that iterates block.ops / block.vars."""
+
+    def __init__(self, program: Program):
+        self.program = program
+
+    @property
+    def ops(self):
+        return self.program.nodes
+
+    @property
+    def vars(self):
+        return self.program.vars
+
+    def var(self, name):
+        return self.program.vars[name]
+
+
+# ---------------------------------------------------------------------------
+# mode + default programs
+# ---------------------------------------------------------------------------
+
+class _State(threading.local):
+    def __init__(self):
+        self.static = False
+        self.main: Optional[Program] = None
+        self.startup: Optional[Program] = None
+
+
+_state = _State()
+
+
+def in_static_mode() -> bool:
+    return _state.static
+
+
+def enable_static():
+    _alias_tensor_dunders()
+    _state.static = True
+    if _state.main is None:
+        _state.main = Program()
+        _state.startup = Program()
+    fcore._set_static_handler(_static_dispatch)
+
+
+def disable_static():
+    _state.static = False
+    fcore._set_static_handler(None)
+
+
+def default_main_program() -> Program:
+    if _state.main is None:
+        _state.main = Program()
+    return _state.main
+
+
+def default_startup_program() -> Program:
+    if _state.startup is None:
+        _state.startup = Program()
+    return _state.startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: Program,
+                  startup_program: Optional[Program] = None):
+    """Reference program_guard parity (base/framework.py:7867)."""
+    prev_main, prev_startup = _state.main, _state.startup
+    _state.main = main_program
+    _state.startup = startup_program or _state.startup
+    try:
+        yield
+    finally:
+        _state.main, _state.startup = prev_main, prev_startup
+
+
+def _static_dispatch(op_name: str, fn: Callable, args: tuple, kwargs: dict):
+    """Hook installed into framework.core.apply: record instead of execute
+    when static mode is on and symbolic values are involved."""
+    if not _state.static:
+        return NotImplemented
+    involves_sym = any(isinstance(a, Variable) for a in args)
+    if not involves_sym:
+        # concrete-only op (e.g. param init inside a layer): run eagerly
+        return NotImplemented
+    return default_main_program().record(op_name, fn, args, kwargs)
+
+
+# ---------------------------------------------------------------------------
+# data / InputSpec
+# ---------------------------------------------------------------------------
+
+def data(name: str, shape: Sequence[int], dtype="float32",
+         lod_level: int = 0) -> Variable:
+    """paddle.static.data parity (python/paddle/static/input.py)."""
+    return default_main_program().add_feed(name, shape, dtype)
+
+
+class InputSpec:
+    """Shape/dtype/name spec (python/paddle/static/input.py InputSpec)."""
+
+    def __init__(self, shape, dtype="float32", name=None,
+                 stop_gradient=True):
+        self.shape = tuple(shape)
+        self.dtype = dtypes.convert_dtype(dtype)
+        self.name = name
+        self.stop_gradient = stop_gradient
+
+    @classmethod
+    def from_tensor(cls, tensor, name=None):
+        return cls(tensor.shape, tensor.dtype, name or tensor.name)
+
+    def __repr__(self):
+        return (f"InputSpec(shape={self.shape}, dtype={self.dtype}, "
+                f"name={self.name})")

@@ -1,0 +1,125 @@
+"""Static-graph API tests (reference model: test/legacy_test static-mode
+tests + test_executor*, test_inference_model_io)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer, static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    # fresh default programs per test
+    from paddle_tpu.static import program as prog_mod
+    prog_mod._state.main = prog_mod.Program()
+    prog_mod._state.startup = prog_mod.Program()
+    yield
+    paddle.disable_static()
+
+
+def test_mode_toggle():
+    assert not paddle.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode()
+    paddle.enable_static()
+
+
+def test_data_and_infer_shapes():
+    x = static.data("x", [4, 8], "float32")
+    assert x.shape == [4, 8] and x.dtype == np.float32
+    y = x.matmul(paddle.ones([8, 3]))
+    assert isinstance(y, static.Variable)
+    assert y.shape == [4, 3]  # InferMeta via eval_shape
+    z = (y + 1.0).sum()
+    assert z.shape == []
+
+
+def test_executor_run_forward():
+    x = static.data("x", [2, 3], "float32")
+    y = x * 2.0 + 1.0
+    exe = static.Executor()
+    xin = np.arange(6, np.float32).reshape(2, 3) \
+        if False else np.arange(6).reshape(2, 3).astype(np.float32)
+    (out,) = exe.run(feed={"x": xin}, fetch_list=[y])
+    np.testing.assert_allclose(out, xin * 2 + 1, rtol=1e-6)
+
+
+def test_executor_cache_and_program_guard():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [2], "float32")
+        y = x + 10.0
+    exe = static.Executor()
+    (o1,) = exe.run(main, feed={"x": np.zeros(2, np.float32)},
+                    fetch_list=[y])
+    (o2,) = exe.run(main, feed={"x": np.ones(2, np.float32)},
+                    fetch_list=[y])
+    assert o1[0] == 10 and o2[0] == 11
+    assert len(exe._cache) == 1  # same shapes → one compile
+
+
+def test_static_layers_and_training_converges():
+    # linear regression via static graph + minimize
+    rng = np.random.RandomState(0)
+    w_true = rng.randn(4, 1).astype(np.float32)
+    x = static.data("x", [16, 4], "float32")
+    label = static.data("y", [16, 1], "float32")
+    lin = nn.Linear(4, 1)
+    pred = lin(x)
+    loss = ((pred - label) ** 2).mean()
+    opt = optimizer.SGD(learning_rate=0.1,
+                        parameters=lin.parameters())
+    opt.minimize(loss)
+    exe = static.Executor()
+    losses = []
+    for i in range(60):
+        xb = rng.randn(16, 4).astype(np.float32)
+        yb = xb @ w_true
+        (lv,) = exe.run(static.default_main_program(),
+                        feed={"x": xb, "y": yb}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.05 * losses[0]
+    np.testing.assert_allclose(
+        lin.weight.numpy().reshape(-1), w_true.reshape(-1), atol=0.15)
+
+
+def test_static_nn_fc_conv():
+    x = static.data("img", [2, 3, 8, 8], "float32")
+    h = static.nn.conv2d(x, num_filters=4, filter_size=3, padding=1,
+                        act="relu")
+    assert h.shape == [2, 4, 8, 8]
+    flat = h.reshape([2, -1])
+    out = static.nn.fc(flat, size=5)
+    assert out.shape == [2, 5]
+    exe = static.Executor()
+    (o,) = exe.run(feed={"img": np.random.RandomState(0).randn(
+        2, 3, 8, 8).astype(np.float32)}, fetch_list=[out])
+    assert o.shape == (2, 5) and np.isfinite(o).all()
+
+
+def test_save_load_inference_model(tmp_path):
+    x = static.data("x", [3, 6], "float32")
+    lin = nn.Linear(6, 2)
+    out = nn.functional.softmax(lin(x))
+    prefix = str(tmp_path / "model" / "infer")
+    static.save_inference_model(prefix, [x], [out])
+    assert os.path.exists(prefix + ".pdmodel")
+    assert os.path.exists(prefix + ".pdiparams.npz")
+
+    pred, feed_names, fetch_names = static.load_inference_model(prefix)
+    assert feed_names == ["x"]
+    xin = np.random.RandomState(1).randn(3, 6).astype(np.float32)
+    (got,) = pred.run([xin])
+    exe = static.Executor()
+    (want,) = exe.run(feed={"x": xin}, fetch_list=[out])
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_eager_unaffected_after_static_session():
+    paddle.disable_static()
+    t = paddle.ones([2, 2]) * 3
+    assert float(t.sum().numpy()) == 12.0
+    paddle.enable_static()
