@@ -39,6 +39,12 @@ from . import signal  # noqa: F401
 from . import hapi  # noqa: F401
 from . import profiler  # noqa: F401
 from . import static  # noqa: F401
+from . import incubate  # noqa: F401
+from . import sparse  # noqa: F401
+from . import geometric  # noqa: F401
+from . import audio  # noqa: F401
+from . import text  # noqa: F401
+from . import quantization  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 

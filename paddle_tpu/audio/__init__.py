@@ -1,0 +1,14 @@
+"""paddle_tpu.audio — audio feature extraction.
+
+Reference: /root/reference/python/paddle/audio/ (functional/: hz↔mel,
+fbank matrix, dct; features/: Spectrogram, MelSpectrogram,
+LogMelSpectrogram, MFCC layers; backends/ for file IO). Compute rides
+paddle_tpu.signal's STFT (XLA-compiled); file IO backends are gated on
+optional soundfile (the image ships none — load/save raise with
+instructions, info works for WAV via the stdlib wave module).
+"""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from . import backends  # noqa: F401
+
+__all__ = ["functional", "features", "backends"]

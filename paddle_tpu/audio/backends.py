@@ -1,0 +1,100 @@
+"""audio.backends — file IO (reference:
+/root/reference/python/paddle/audio/backends/: init_backend.py with
+wave_backend default, soundfile optional). The image ships no soundfile;
+WAV load/save/info work through the stdlib wave module (16-bit PCM),
+other formats need soundfile."""
+from __future__ import annotations
+
+import wave as _wave
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["list_available_backends", "get_current_backend", "set_backend",
+           "load", "save", "info", "AudioInfo"]
+
+_backend = "wave_backend"
+
+
+def list_available_backends() -> List[str]:
+    out = ["wave_backend"]
+    try:
+        import soundfile  # noqa: F401
+        out.append("soundfile")
+    except ImportError:
+        pass
+    return out
+
+
+def get_current_backend() -> str:
+    return _backend
+
+
+def set_backend(backend_name: str):
+    global _backend
+    if backend_name not in list_available_backends():
+        raise ValueError(
+            f"backend {backend_name!r} not available; "
+            f"have {list_available_backends()}")
+    _backend = backend_name
+
+
+class AudioInfo:
+    def __init__(self, sample_rate, num_samples, num_channels,
+                 bits_per_sample, encoding="PCM_S"):
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+
+def info(filepath: str) -> AudioInfo:
+    with _wave.open(filepath, "rb") as f:
+        return AudioInfo(f.getframerate(), f.getnframes(),
+                         f.getnchannels(), f.getsampwidth() * 8)
+
+
+def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True, channels_first: bool = True
+         ) -> Tuple[Tensor, int]:
+    """Returns (waveform [channels, samples] if channels_first, sr)."""
+    with _wave.open(filepath, "rb") as f:
+        sr = f.getframerate()
+        n = f.getnframes()
+        f.setpos(min(frame_offset, n))
+        count = n - frame_offset if num_frames < 0 else num_frames
+        raw = f.readframes(count)
+        width = f.getsampwidth()
+        ch = f.getnchannels()
+    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if normalize:
+        if width == 1:
+            data = (data.astype(np.float32) - 128) / 128.0
+        else:
+            data = data.astype(np.float32) / float(2 ** (8 * width - 1))
+    arr = data.T if channels_first else data
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(arr)), sr
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True, encoding: str = "PCM_S",
+         bits_per_sample: int = 16):
+    if bits_per_sample != 16:
+        raise NotImplementedError(
+            "wave_backend saves 16-bit PCM; install soundfile for others")
+    arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+    if channels_first:
+        arr = arr.T
+    if arr.dtype.kind == "f":
+        arr = np.clip(arr, -1.0, 1.0)
+        arr = (arr * 32767.0).astype(np.int16)
+    with _wave.open(filepath, "wb") as f:
+        f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

@@ -1,0 +1,147 @@
+"""audio.functional parity
+(/root/reference/python/paddle/audio/functional/functional.py:
+hz_to_mel, mel_to_hz, mel_frequencies, fft_frequencies,
+compute_fbank_matrix, power_to_db, create_dct; window functions in
+window.py)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["hz_to_mel", "mel_to_hz", "mel_frequencies", "fft_frequencies",
+           "compute_fbank_matrix", "power_to_db", "create_dct",
+           "get_window"]
+
+
+def hz_to_mel(freq, htk: bool = False):
+    scalar = not isinstance(freq, (Tensor, np.ndarray, jnp.ndarray, list))
+    f = np.asarray(freq._value if isinstance(freq, Tensor) else freq,
+                   dtype=np.float64)
+    if htk:
+        mel = 2595.0 * np.log10(1.0 + f / 700.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        mel = (f - f_min) / f_sp
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        mel = np.where(f >= min_log_hz,
+                       min_log_mel + np.log(np.maximum(f, 1e-10)
+                                            / min_log_hz) / logstep, mel)
+    return float(mel) if scalar else Tensor(jnp.asarray(mel, jnp.float32))
+
+
+def mel_to_hz(mel, htk: bool = False):
+    scalar = not isinstance(mel, (Tensor, np.ndarray, jnp.ndarray, list))
+    m = np.asarray(mel._value if isinstance(mel, Tensor) else mel,
+                   dtype=np.float64)
+    if htk:
+        hz = 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    else:
+        f_min, f_sp = 0.0, 200.0 / 3
+        hz = f_min + f_sp * m
+        min_log_hz = 1000.0
+        min_log_mel = (min_log_hz - f_min) / f_sp
+        logstep = math.log(6.4) / 27.0
+        hz = np.where(m >= min_log_mel,
+                      min_log_hz * np.exp(logstep * (m - min_log_mel)), hz)
+    return float(hz) if scalar else Tensor(jnp.asarray(hz, jnp.float32))
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    low = hz_to_mel(float(f_min), htk)
+    high = hz_to_mel(float(f_max), htk)
+    mels = np.linspace(low, high, n_mels)
+    return Tensor(jnp.asarray(
+        np.asarray([mel_to_hz(float(m), htk) for m in mels]),
+        jnp.float32))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return Tensor(jnp.linspace(0, sr / 2, 1 + n_fft // 2,
+                               dtype=jnp.float32))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max: Optional[float] = None,
+                         htk: bool = False, norm: Union[str, float] = "slaney",
+                         dtype="float32"):
+    """[n_mels, 1 + n_fft//2] triangular mel filterbank."""
+    f_max = f_max or sr / 2.0
+    fft_f = np.linspace(0, sr / 2, 1 + n_fft // 2)
+    mel_f = np.asarray(
+        [mel_to_hz(float(m), htk) for m in np.linspace(
+            hz_to_mel(float(f_min), htk), hz_to_mel(float(f_max), htk),
+            n_mels + 2)])
+    fdiff = np.diff(mel_f)
+    ramps = mel_f[:, None] - fft_f[None, :]
+    weights = np.zeros((n_mels, len(fft_f)))
+    for i in range(n_mels):
+        lower = -ramps[i] / fdiff[i]
+        upper = ramps[i + 2] / fdiff[i + 1]
+        weights[i] = np.maximum(0, np.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights *= enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        norms = np.linalg.norm(weights, ord=norm, axis=1, keepdims=True)
+        weights = weights / np.maximum(norms, 1e-10)
+    return Tensor(jnp.asarray(weights, jnp.float32))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: Optional[float] = 80.0):
+    x = spect._value if isinstance(spect, Tensor) else jnp.asarray(spect)
+    log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+    log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+    if top_db is not None:
+        log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+    return Tensor(log_spec)
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm: Optional[str] = "ortho",
+               dtype="float32"):
+    """[n_mels, n_mfcc] DCT-II matrix."""
+    n = np.arange(n_mels)
+    k = np.arange(n_mfcc)[None, :]
+    dct = np.cos(math.pi / n_mels * (n[:, None] + 0.5) * k)
+    if norm == "ortho":
+        dct[:, 0] *= 1.0 / math.sqrt(2)
+        dct *= math.sqrt(2.0 / n_mels)
+    else:
+        dct *= 2.0
+    return Tensor(jnp.asarray(dct, jnp.float32))
+
+
+def get_window(window: Union[str, tuple], win_length: int,
+               fftbins: bool = True, dtype="float32"):
+    """Window functions (reference audio/functional/window.py)."""
+    name = window if isinstance(window, str) else window[0]
+    M = win_length + (0 if fftbins else -1)
+    n = np.arange(win_length)
+    denom = max(M, 1)
+    if name == "hann":
+        w = 0.5 - 0.5 * np.cos(2 * math.pi * n / denom)
+    elif name == "hamming":
+        w = 0.54 - 0.46 * np.cos(2 * math.pi * n / denom)
+    elif name == "blackman":
+        w = (0.42 - 0.5 * np.cos(2 * math.pi * n / denom)
+             + 0.08 * np.cos(4 * math.pi * n / denom))
+    elif name in ("rect", "rectangular", "boxcar", "ones"):
+        w = np.ones(win_length)
+    elif name == "gaussian":
+        std = window[1] if isinstance(window, tuple) else 0.4
+        w = np.exp(-0.5 * ((n - (win_length - 1) / 2)
+                           / (std * (win_length - 1) / 2)) ** 2)
+    elif name == "triang":
+        w = 1 - np.abs((n - (win_length - 1) / 2) / (win_length / 2))
+    else:
+        raise ValueError(f"unsupported window {window!r}")
+    return Tensor(jnp.asarray(w, jnp.float32))

@@ -21,12 +21,21 @@ from ...core.native import TCPStore, TCPStoreServer, available
 
 
 def _is_local_host(host: str) -> bool:
+    """True iff this machine owns `host`'s address. The reliable test is
+    binding a socket to the resolved IP: binding a non-local address
+    fails with EADDRNOTAVAIL, regardless of /etc/hosts aliasing or
+    multi-NIC setups (hostname-comparison heuristics get both wrong)."""
     if host in ("127.0.0.1", "0.0.0.0", "localhost",
                 _socket.gethostname()):
         return True
     try:
-        return _socket.gethostbyname(host) in (
-            "127.0.0.1", _socket.gethostbyname(_socket.gethostname()))
+        ip = _socket.gethostbyname(host)
+    except OSError:
+        return False
+    try:
+        with _socket.socket(_socket.AF_INET, _socket.SOCK_STREAM) as s:
+            s.bind((ip, 0))  # ephemeral port: tests ownership only
+        return True
     except OSError:
         return False
 

@@ -1,0 +1,168 @@
+"""paddle_tpu.geometric — graph-learning message passing.
+
+Reference: /root/reference/python/paddle/geometric/ (segment ops in
+math.py, message passing send_u_recv/send_ue_recv/send_uv in
+message_passing/, sampling). TPU-native: every op is a jax segment_sum /
+gather composition — XLA lowers these to efficient sorted-scatter on
+TPU; all are differentiable through the tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+
+__all__ = [
+    "segment_sum", "segment_mean", "segment_max", "segment_min",
+    "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+    "sample_neighbors",
+]
+
+
+def _num_segments(segment_ids, count=None):
+    if count is not None:
+        return int(count)
+    ids = segment_ids._value if isinstance(segment_ids, Tensor) \
+        else segment_ids
+    return int(np.asarray(jax.device_get(ids)).max()) + 1 if ids.size \
+        else 0
+
+
+def segment_sum(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply("segment_sum",
+                 lambda d, s: jax.ops.segment_sum(d, s, num_segments=n),
+                 data, segment_ids)
+
+
+def segment_mean(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+
+    def f(d, s):
+        tot = jax.ops.segment_sum(d, s, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones((d.shape[0],), d.dtype), s,
+                                  num_segments=n)
+        return tot / jnp.maximum(cnt, 1)[(...,) + (None,) * (d.ndim - 1)]
+    return apply("segment_mean", f, data, segment_ids)
+
+
+def segment_max(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply("segment_max",
+                 lambda d, s: jax.ops.segment_max(d, s, num_segments=n),
+                 data, segment_ids)
+
+
+def segment_min(data, segment_ids, name=None):
+    n = _num_segments(segment_ids)
+    return apply("segment_min",
+                 lambda d, s: jax.ops.segment_min(d, s, num_segments=n),
+                 data, segment_ids)
+
+
+_POOLS = {"sum": segment_sum, "mean": segment_mean, "max": segment_max,
+          "min": segment_min}
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x[src] and segment-reduce onto dst (reference
+    message_passing/send_recv.py send_u_recv)."""
+    n = out_size or (x.shape[0] if hasattr(x, "shape") else None)
+    pool = reduce_op.lower()
+    if pool not in _POOLS:
+        raise ValueError(f"reduce_op must be one of {list(_POOLS)}")
+
+    seg = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def f(xa, si, di):
+        msgs = xa[si]
+        if pool == "mean":
+            tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), xa.dtype), di, num_segments=n)
+            return tot / jnp.maximum(cnt, 1)[
+                (...,) + (None,) * (msgs.ndim - 1)]
+        return seg[pool](msgs, di, num_segments=n)
+
+    return apply("send_u_recv", f, x, src_index, dst_index)
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Message = x[src] (op) edge_feature, then reduce onto dst."""
+    n = out_size or x.shape[0]
+    mop = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}[message_op.lower()]
+    pool = reduce_op.lower()
+    seg = {"sum": jax.ops.segment_sum, "max": jax.ops.segment_max,
+           "min": jax.ops.segment_min}
+
+    def f(xa, ya, si, di):
+        msgs = mop(xa[si], ya)
+        if pool == "mean":
+            tot = jax.ops.segment_sum(msgs, di, num_segments=n)
+            cnt = jax.ops.segment_sum(
+                jnp.ones((msgs.shape[0],), msgs.dtype), di, num_segments=n)
+            return tot / jnp.maximum(cnt, 1)[
+                (...,) + (None,) * (msgs.ndim - 1)]
+        return seg[pool](msgs, di, num_segments=n)
+
+    return apply("send_ue_recv", f, x, y, src_index, dst_index)
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add",
+            name=None):
+    """Per-edge message x[src] (op) y[dst] (no reduction)."""
+    mop = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+           "div": jnp.divide}[message_op.lower()]
+    return apply("send_uv",
+                 lambda xa, ya, si, di: mop(xa[si], ya[di]),
+                 x, y, src_index, dst_index)
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact node ids to a contiguous range (reference
+    sampling/neighbors.py reindex_graph)."""
+    xa = np.asarray(x._value if isinstance(x, Tensor) else x)
+    nb = np.asarray(neighbors._value if isinstance(neighbors, Tensor)
+                    else neighbors)
+    cnt = np.asarray(count._value if isinstance(count, Tensor) else count)
+    uniq = list(dict.fromkeys(xa.tolist()))
+    mapping = {v: i for i, v in enumerate(uniq)}
+    out_nodes = list(uniq)
+    reindexed = []
+    for v in nb.tolist():
+        if v not in mapping:
+            mapping[v] = len(out_nodes)
+            out_nodes.append(v)
+        reindexed.append(mapping[v])
+    return (Tensor(jnp.asarray(reindexed, jnp.int64)),
+            Tensor(jnp.asarray(out_nodes, xa.dtype)),
+            Tensor(jnp.asarray(cnt)))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
+                     eids=None, return_eids=False, perm_buffer=None,
+                     name=None):
+    """Uniform neighbor sampling on CSC (reference
+    sampling/neighbors.py). Host-side (data loading path, not jitted)."""
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor)
+                    else colptr)
+    nodes = np.asarray(input_nodes._value
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.default_rng()
+    out_n, out_count = [], []
+    for v in nodes.tolist():
+        nbrs = r[cp[v]:cp[v + 1]]
+        if 0 <= sample_size < len(nbrs):
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_n.extend(nbrs.tolist())
+        out_count.append(len(nbrs))
+    return (Tensor(jnp.asarray(out_n, jnp.int64)),
+            Tensor(jnp.asarray(out_count, jnp.int64)))

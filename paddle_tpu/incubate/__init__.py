@@ -1,0 +1,12 @@
+"""paddle_tpu.incubate — experimental surface
+(/root/reference/python/paddle/incubate/): fused transformer ops
+(delegating to the Pallas/XLA implementations in paddle_tpu.ops),
+functional autograd transforms (jvp/vjp/Jacobian/Hessian — thin, because
+jax IS the autograd engine), 2:4 structured sparsity (asp), and extra
+optimizers."""
+from . import nn  # noqa: F401
+from . import autograd  # noqa: F401
+from . import asp  # noqa: F401
+from . import optimizer  # noqa: F401
+
+__all__ = ["nn", "autograd", "asp", "optimizer"]

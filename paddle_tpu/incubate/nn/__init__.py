@@ -1,0 +1,9 @@
+"""incubate.nn — fused layers (reference:
+/root/reference/python/paddle/incubate/nn/layer/fused_transformer.py)."""
+from . import functional  # noqa: F401
+from .layer import (  # noqa: F401
+    FusedFeedForward, FusedMultiHeadAttention, FusedTransformerEncoderLayer,
+)
+
+__all__ = ["functional", "FusedMultiHeadAttention", "FusedFeedForward",
+           "FusedTransformerEncoderLayer"]

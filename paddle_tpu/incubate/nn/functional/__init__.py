@@ -1,0 +1,208 @@
+"""incubate.nn.functional — fused-op API parity
+(/root/reference/python/paddle/incubate/nn/functional/: fused_rms_norm,
+fused_layer_norm, fused_rotary_position_embedding, fused_bias_act,
+fused_linear, ...). On TPU the fusion itself is XLA's job (plus the
+Pallas flash-attention kernel in paddle_tpu/ops); these wrappers keep
+the reference's fused-op call signatures so incubate users can port
+unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ....framework.core import Tensor, apply  # type: ignore
+# package depth: paddle_tpu/incubate/nn/functional → framework is 3 up
+
+__all__ = [
+    "fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+    "fused_bias_act", "fused_linear", "fused_linear_activation",
+    "fused_dropout_add", "swiglu", "fused_multi_head_attention",
+    "fused_feedforward", "variable_length_memory_efficient_attention",
+]
+
+
+def fused_rms_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kwargs):
+    """fused_rms_norm parity (incubate/nn/functional/fused_rms_norm.py)."""
+    from ....ops.rms_norm import rms_norm  # array-level kernel
+
+    if norm_weight is not None:
+        out = apply("rms_norm",
+                    lambda xa, wa: rms_norm(xa, wa, epsilon,
+                                            axis=begin_norm_axis),
+                    x, norm_weight)
+    else:
+        out = apply("rms_norm",
+                    lambda xa: rms_norm(xa, None, epsilon,
+                                        axis=begin_norm_axis), x)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out
+
+
+def fused_layer_norm(x, norm_weight=None, norm_bias=None, epsilon=1e-5,
+                     begin_norm_axis=-1, **kwargs):
+    from ....nn import functional as F
+    shape = tuple(x.shape[begin_norm_axis:]) if begin_norm_axis != -1 \
+        else (x.shape[-1],)
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon)
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    **kwargs):
+    """Parity: incubate/nn/functional/fused_rotary_position_embedding.py —
+    returns (q, k, v) with rotary applied to q/k (v passes through)."""
+    from ....ops.rope import apply_rotary_pos_emb  # array-level kernel
+
+    def f(qa, ka, *rest):
+        it = iter(rest)
+        cos_a = next(it) if cos is not None else None
+        sin_a = next(it) if sin is not None else None
+        pos_a = next(it) if position_ids is not None else None
+        return apply_rotary_pos_emb(qa, ka, cos_a, sin_a, pos_a)
+
+    extra = tuple(a for a in (cos, sin, position_ids) if a is not None)
+    q2, k2 = apply("fused_rope", f, q, k if k is not None else q, *extra)
+    return q2, (k2 if k is not None else None), v
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kwargs):
+    from ....nn import functional as F
+    if bias is not None:
+        x = x + bias
+    act = {"gelu": F.gelu, "relu": F.relu, "silu": F.silu,
+           "swiglu": swiglu}.get(act_method)
+    if act is None:
+        raise ValueError(f"unsupported act_method {act_method!r}")
+    return act(x)
+
+
+def swiglu(x, y=None):
+    """SwiGLU: silu(x) * y; single-arg form splits the last dim."""
+    from ....nn import functional as F
+    if y is None:
+        def f(a):
+            a1, a2 = jnp.split(a, 2, axis=-1)
+            return jnp.multiply(a1 * (1 / (1 + jnp.exp(-a1))), a2)
+        return apply("swiglu", f, x)
+    return F.silu(x) * y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, **kwargs):
+    def f(xa, wa, *rest):
+        w = wa.T if transpose_weight else wa
+        out = xa @ w
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, weight) + ((bias,) if bias is not None else ())
+    return apply("fused_linear", f, *args)
+
+
+def fused_linear_activation(x, y, bias=None, trans_x=False, trans_y=False,
+                            activation="gelu"):
+    def f(xa, ya, *rest):
+        a = xa.T if trans_x else xa
+        b = ya.T if trans_y else ya
+        out = a @ b
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x, y) + ((bias,) if bias is not None else ())
+    out = apply("fused_linear_act", f, *args)
+    from ....nn import functional as F
+    return {"gelu": F.gelu, "relu": F.relu, "": lambda v: v,
+            None: lambda v: v}[activation](out)
+
+
+def fused_dropout_add(x, y, p=0.5, training=True, mode="upscale_in_train",
+                      **kwargs):
+    from ....nn import functional as F
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_multi_head_attention(x, qkv_weight, linear_weight,
+                               pre_layer_norm=False, pre_ln_scale=None,
+                               pre_ln_bias=None, ln_scale=None, ln_bias=None,
+                               pre_ln_epsilon=1e-5, qkv_bias=None,
+                               linear_bias=None, cache_kv=None,
+                               attn_mask=None, dropout_rate=0.0,
+                               attn_dropout_rate=0.0, ln_epsilon=1e-5,
+                               training=True, num_heads=None, **kwargs):
+    """Whole fused-MHA block parity (fused_transformer.py:
+    fused_multi_head_attention). qkv_weight: [3, H, D/H, D] layout like
+    the reference."""
+    from ....nn import functional as F
+    from ....nn.functional.attention import flash_attention
+
+    residual = x
+    if pre_layer_norm:
+        x = fused_layer_norm(x, pre_ln_scale, pre_ln_bias, pre_ln_epsilon)
+    three, h, hd, d = qkv_weight.shape
+    w = qkv_weight.reshape([3 * h * hd, d])
+
+    def qkv_f(xa, wa, *rest):
+        out = xa @ wa.T
+        if rest:
+            out = out + rest[0].reshape(-1)
+        return out
+    args = (x, w) + ((qkv_bias,) if qkv_bias is not None else ())
+    qkv = apply("fused_qkv", qkv_f, *args)
+    b, s = qkv.shape[0], qkv.shape[1]
+    qkv = qkv.reshape([b, s, 3, h, hd])
+    q, k, v = (qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+    if attn_mask is not None:
+        out = F.scaled_dot_product_attention(q, k, v, attn_mask=attn_mask)
+    else:
+        out, _ = flash_attention(
+            q, k, v, dropout=attn_dropout_rate if training else 0.0)
+    out = out.reshape([b, s, h * hd])
+    out = F.linear(out, linear_weight, linear_bias)
+    if dropout_rate:
+        out = F.dropout(out, p=dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln_scale, ln_bias, ln_epsilon)
+    return out
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, **kwargs):
+    """fused_feedforward parity (fused_transformer.py)."""
+    from ....nn import functional as F
+    residual = x
+    if pre_layer_norm:
+        x = fused_layer_norm(x, ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    if dropout1_rate:
+        h = F.dropout(h, p=dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    if dropout2_rate:
+        h = F.dropout(h, p=dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm:
+        out = fused_layer_norm(out, ln2_scale, ln2_bias, ln2_epsilon)
+    return out
+
+
+def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
+                                               kv_seq_lens=None, mask=None,
+                                               scale=None, causal=False):
+    """Varlen attention parity (reference binds a CUDA kernel;
+    here the Pallas/XLA flash path with a length mask)."""
+    from ....nn import functional as F
+    if mask is not None:
+        return F.scaled_dot_product_attention(query, key, value,
+                                              attn_mask=mask,
+                                              is_causal=causal)
+    from ....nn.functional.attention import flash_attention
+    out, _ = flash_attention(query, key, value, causal=causal)
+    return out

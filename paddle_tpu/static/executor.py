@@ -121,7 +121,14 @@ class Executor:
             a = a._value if isinstance(a, Tensor) else jnp.asarray(a)
             feed_arrays.append(a)
 
+        # train step only when the fetch actually wants the loss: fetching
+        # e.g. predictions alone is evaluation and must neither require
+        # the label feeds nor update parameters (the reference executor
+        # prunes to the fetch list the same way)
         spec = program._train_spec
+        if spec is not None and not any(v is spec["loss"]
+                                        for v in fetch_vars):
+            spec = None
         params = program.parameters()
         trainable = [p for p in params if not p.stop_gradient] \
             if spec is not None else []

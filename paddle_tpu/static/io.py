@@ -38,10 +38,23 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
     program = program or feed_vars[0].program
 
     # concrete captures (params/buffers) become explicit inputs so the
-    # exported artifact is self-contained and the arrays swappable
+    # exported artifact is self-contained and the arrays swappable. Only
+    # the fetch closure's nodes are walked — a shared default program may
+    # hold unrelated models whose weights must not leak into the artifact.
+    needed = set()
+    stack = list(fetch_vars)
+    while stack:
+        v = stack.pop()
+        if id(v) in needed:
+            continue
+        needed.add(id(v))
+        if v.node is not None:
+            stack.extend(a for a in v.node.args if isinstance(a, Variable))
     captured: List[Tensor] = []
     seen = set()
     for node in program.nodes:
+        if not any(id(v) in needed for v in node.out_vars):
+            continue
         for a in node.args:
             if isinstance(a, Tensor) and id(a) not in seen:
                 seen.add(id(a))

@@ -68,6 +68,13 @@ class Variable:
     # -- Tensor-like surface ------------------------------------------------
     @property
     def shape(self):
+        # feed vars report their declared shape, including -1 dynamic dims
+        # (the build-time aval holds placeholder 1 for those; execution
+        # re-traces with the real feed shapes, so only introspection of
+        # DERIVED vars sees the placeholder)
+        decl = getattr(self, "_declared_shape", None)
+        if decl is not None:
+            return [d if d is not None else -1 for d in decl]
         return list(self.aval.shape)
 
     @property
@@ -111,7 +118,9 @@ def _alias_tensor_dunders():
                    "__le__", "__gt__", "__ge__", "__eq__", "__ne__",
                    "__getitem__"):
         fn = getattr(Tensor, dunder, None)
-        if fn is not None and not hasattr(Variable, dunder):
+        # check Variable.__dict__, not hasattr: rich comparisons inherit
+        # object defaults, which would silently win and break x == y
+        if fn is not None and dunder not in Variable.__dict__:
             setattr(Variable, dunder, fn)
 
 

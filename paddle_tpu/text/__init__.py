@@ -1,0 +1,156 @@
+"""paddle_tpu.text — NLP utilities + datasets.
+
+Reference: /root/reference/python/paddle/text/ (datasets: Imdb, Imikolov,
+Movielens, UCIHousing, WMT14/16, Conll05; viterbi_decode op + ViterbiDecoder
+layer in /root/reference/python/paddle/text/viterbi_decode.py). Datasets
+read local files (zero-egress environment: no downloads — pass data_file
+explicitly, same escape hatch the reference offers).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor, apply, apply_nodiff
+from ..nn.layer.layers import Layer
+from ..io import Dataset
+
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+
+
+def viterbi_decode(potentials, transition_params, lengths=None,
+                   include_bos_eos_tag: bool = True, name=None):
+    """CRF Viterbi decode (parity:
+    /root/reference/python/paddle/text/viterbi_decode.py). potentials:
+    [batch, seq, num_tags]; returns (scores [batch], paths [batch, seq]).
+    lax.scan forward pass + reverse backtrace — TPU-friendly (no Python
+    loop over time)."""
+
+    def f(emis, trans, *rest):
+        lens = rest[0] if rest else None
+        b, s, n = emis.shape
+        if include_bos_eos_tag:
+            # reference semantics: first step adds trans from BOS (tag n-2),
+            # last valid step adds trans to EOS (tag n-1)
+            init = emis[:, 0] + trans[n - 2][None, :]
+        else:
+            init = emis[:, 0]
+
+        def step(carry, t):
+            alpha = carry  # [b, n]
+            # score[i→j] = alpha[i] + trans[i, j] + emis[t, j]
+            scores = alpha[:, :, None] + trans[None, :, :]
+            best_prev = jnp.argmax(scores, axis=1)          # [b, n]
+            alpha_t = jnp.max(scores, axis=1) + emis[:, t]
+            if lens is not None:
+                active = (t < lens)[:, None]
+                alpha_t = jnp.where(active, alpha_t, alpha)
+                best_prev = jnp.where(active, best_prev,
+                                      jnp.arange(n)[None, :])
+            return alpha_t, best_prev
+
+        ts = jnp.arange(1, s)
+        alpha, history = jax.lax.scan(step, init, ts)  # history [s-1, b, n]
+        if include_bos_eos_tag:
+            alpha = alpha + trans[:, n - 1][None, :]
+        scores = jnp.max(alpha, axis=1)
+        last_tag = jnp.argmax(alpha, axis=1)  # [b]
+
+        def back(carry, hist_t):
+            tag = carry
+            prev = jnp.take_along_axis(hist_t, tag[:, None],
+                                       axis=1)[:, 0]
+            return prev, tag
+
+        first_tag, path_rev = jax.lax.scan(back, last_tag, history[::-1])
+        # scan emits tags t=s-1..1; the final carry is the t=0 tag
+        paths = jnp.concatenate(
+            [first_tag[:, None], path_rev[::-1].T], axis=1)  # [b, s]
+        return scores, paths.astype(jnp.int64)
+
+    args = (potentials, transition_params) + \
+        ((lengths,) if lengths is not None else ())
+    return apply_nodiff("viterbi_decode", f, *args)
+
+
+class ViterbiDecoder(Layer):
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths=None):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)
+
+
+class UCIHousing(Dataset):
+    """UCI housing regression dataset from a local file (reference
+    text/datasets/uci_housing.py; 13 features + 1 target per row)."""
+
+    def __init__(self, data_file: Optional[str] = None, mode: str = "train",
+                 download: bool = False):
+        if data_file is None:
+            raise ValueError(
+                "data_file is required (no network in this environment); "
+                "pass the path to the housing data file")
+        raw = np.loadtxt(data_file, dtype=np.float32)
+        raw = raw.reshape(-1, 14)
+        # reference normalizes using feature-wise max/min/avg of train split
+        split = int(len(raw) * 0.8)
+        feats = raw[:, :13]
+        mx, mn, avg = feats.max(0), feats.min(0), feats.mean(0)
+        denom = np.where(mx - mn == 0, 1, mx - mn)
+        raw[:, :13] = (feats - avg) / denom
+        self.data = raw[:split] if mode == "train" else raw[split:]
+
+    def __getitem__(self, idx):
+        row = self.data[idx]
+        return row[:13], row[13:]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Imdb(Dataset):
+    """IMDB sentiment dataset from a local aclImdb tar or directory
+    (reference text/datasets/imdb.py). Builds a word index from the
+    data; items are (ids ndarray, label)."""
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 cutoff: int = 150, download: bool = False):
+        import os
+        import re
+        if data_dir is None:
+            raise ValueError(
+                "data_dir is required (no network in this environment)")
+        pat = re.compile(r"[A-Za-z']+")
+        texts, labels = [], []
+        for label, sub in ((0, "neg"), (1, "pos")):
+            d = os.path.join(data_dir, mode, sub)
+            for fn in sorted(os.listdir(d)):
+                with open(os.path.join(d, fn), errors="ignore") as f:
+                    texts.append(pat.findall(f.read().lower()))
+                labels.append(label)
+        freq: dict = {}
+        for t in texts:
+            for w in t:
+                freq[w] = freq.get(w, 0) + 1
+        words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])
+                 if c >= cutoff]
+        self.word_idx = {w: i for i, w in enumerate(words)}
+        self.word_idx["<unk>"] = len(words)
+        unk = self.word_idx["<unk>"]
+        self.docs = [np.asarray([self.word_idx.get(w, unk) for w in t],
+                                np.int64) for t in texts]
+        self.labels = np.asarray(labels, np.int64)
+
+    def __getitem__(self, idx):
+        return self.docs[idx], self.labels[idx]
+
+    def __len__(self):
+        return len(self.docs)

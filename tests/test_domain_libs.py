@@ -1,0 +1,214 @@
+"""Domain-lib tests: sparse, geometric, audio, text, quantization
+(reference models: test/legacy_test sparse/geometric tests, audio
+feature tests, quantization tests)."""
+import os
+import wave
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, nn, quantization, sparse, text
+
+
+def n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+class TestSparse:
+    def test_coo_roundtrip(self):
+        idx = [[0, 1, 2], [1, 2, 0]]
+        vals = [1.0, 2.0, 3.0]
+        s = sparse.sparse_coo_tensor(idx, vals, shape=[3, 3])
+        assert s.is_sparse_coo() and s.nnz == 3
+        dense = n(s.to_dense())
+        want = np.zeros((3, 3), np.float32)
+        want[0, 1], want[1, 2], want[2, 0] = 1, 2, 3
+        np.testing.assert_allclose(dense, want)
+        np.testing.assert_allclose(n(s.values()), vals)
+        assert n(s.indices()).shape == (2, 3)
+
+    def test_csr_roundtrip(self):
+        crows = [0, 2, 3, 5]
+        cols = [0, 2, 1, 0, 2]
+        vals = [1., 2., 3., 4., 5.]
+        s = sparse.sparse_csr_tensor(crows, cols, vals, [3, 3])
+        assert s.is_sparse_csr()
+        dense = n(s.to_dense())
+        want = np.array([[1, 0, 2], [0, 3, 0], [4, 0, 5]], np.float32)
+        np.testing.assert_allclose(dense, want)
+        back = sparse.sparse_coo_tensor([[0], [0]], [9.]).to_sparse_csr()
+        assert back.is_sparse_csr()
+
+    def test_sparse_arithmetic_and_matmul(self):
+        a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], [2, 2])
+        b = sparse.sparse_coo_tensor([[0, 1], [1, 1]], [3.0, 4.0], [2, 2])
+        np.testing.assert_allclose(
+            n(sparse.add(a, b).to_dense()),
+            [[1, 3], [0, 6]])
+        np.testing.assert_allclose(
+            n(sparse.subtract(a, b).to_dense()),
+            [[1, -3], [0, -2]])
+        np.testing.assert_allclose(
+            n(sparse.multiply(a, 2.0).to_dense()), [[2, 0], [0, 4]])
+        dense = paddle.to_tensor(np.eye(2, dtype=np.float32) * 5)
+        np.testing.assert_allclose(n(sparse.matmul(a, dense)),
+                                   [[5, 0], [0, 10]])
+        r = sparse.relu(sparse.sparse_coo_tensor(
+            [[0, 0], [0, 1]], [-1.0, 2.0], [1, 2]))
+        np.testing.assert_allclose(n(r.to_dense()), [[0, 2]])
+
+
+class TestGeometric:
+    def test_segment_ops(self):
+        data = paddle.to_tensor(
+            np.array([[1., 2.], [3., 4.], [5., 6.]], np.float32))
+        seg = paddle.to_tensor(np.array([0, 0, 1]))
+        np.testing.assert_allclose(n(geometric.segment_sum(data, seg)),
+                                   [[4, 6], [5, 6]])
+        np.testing.assert_allclose(n(geometric.segment_mean(data, seg)),
+                                   [[2, 3], [5, 6]])
+        np.testing.assert_allclose(n(geometric.segment_max(data, seg)),
+                                   [[3, 4], [5, 6]])
+        np.testing.assert_allclose(n(geometric.segment_min(data, seg)),
+                                   [[1, 2], [5, 6]])
+
+    def test_send_u_recv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.], [3.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1, 2, 0]))
+        dst = paddle.to_tensor(np.array([1, 2, 1, 0]))
+        out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+        # dst0 ← x[0]=1; dst1 ← x[0]+x[2]=4; dst2 ← x[1]=2
+        np.testing.assert_allclose(n(out), [[1], [4], [2]])
+
+    def test_send_ue_recv_and_uv(self):
+        x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+        e = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+        src = paddle.to_tensor(np.array([0, 1]))
+        dst = paddle.to_tensor(np.array([1, 0]))
+        out = geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+        np.testing.assert_allclose(n(out), [[22], [11]])
+        uv = geometric.send_uv(x, x, src, dst, "mul")
+        np.testing.assert_allclose(n(uv), [[2], [2]])
+
+    def test_segment_grad_flows(self):
+        data = paddle.to_tensor(
+            np.ones((4, 2), np.float32), stop_gradient=False)
+        seg = paddle.to_tensor(np.array([0, 1, 0, 1]))
+        geometric.segment_sum(data, seg).sum().backward()
+        np.testing.assert_allclose(n(data.grad), np.ones((4, 2)))
+
+
+class TestAudio:
+    def test_mel_scale_roundtrip(self):
+        for htk in (False, True):
+            hz = audio.functional.mel_to_hz(
+                audio.functional.hz_to_mel(440.0, htk), htk)
+            assert abs(hz - 440.0) < 1e-3
+
+    def test_fbank_matrix(self):
+        fb = n(audio.functional.compute_fbank_matrix(
+            sr=16000, n_fft=512, n_mels=40))
+        assert fb.shape == (40, 257)
+        assert (fb >= 0).all() and fb.sum() > 0
+
+    def test_spectrogram_and_mfcc_shapes(self):
+        sig = paddle.to_tensor(
+            np.sin(np.linspace(0, 100, 16000)).astype(np.float32)[None])
+        spec = audio.features.Spectrogram(n_fft=512, hop_length=256)(sig)
+        assert list(spec.shape)[-2] == 257  # freq bins
+        mfcc = audio.features.MFCC(sr=16000, n_mfcc=13, n_fft=512)(sig)
+        assert list(mfcc.shape)[-2] == 13
+        assert np.isfinite(n(mfcc)).all()
+
+    def test_wav_backend_roundtrip(self, tmp_path):
+        sr = 8000
+        wavf = str(tmp_path / "t.wav")
+        data = np.sin(np.linspace(0, 20, 800)).astype(np.float32)[None]
+        audio.backends.save(wavf, paddle.to_tensor(data), sr)
+        info = audio.backends.info(wavf)
+        assert info.sample_rate == sr and info.num_samples == 800
+        loaded, sr2 = audio.backends.load(wavf)
+        assert sr2 == sr
+        np.testing.assert_allclose(n(loaded), data, atol=1e-3)
+
+
+class TestText:
+    def test_viterbi_decode_simple(self):
+        # 2 tags + BOS/EOS = 4; strong diagonal transitions
+        np.random.seed(0)
+        emis = np.array([[[5., 0., 0., 0.],
+                          [0., 5., 0., 0.],
+                          [5., 0., 0., 0.]]], np.float32)
+        trans = np.zeros((4, 4), np.float32)
+        scores, path = text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans))
+        assert n(path).tolist() == [[0, 1, 0]]
+        assert float(n(scores)[0]) == pytest.approx(15.0)
+
+    def test_viterbi_transitions_break_ties(self):
+        emis = np.zeros((1, 3, 4), np.float32)
+        trans = np.full((4, 4), -1e3, np.float32)
+        trans[0, 1] = trans[1, 0] = 1.0  # force alternation
+        trans[2, :] = 0.0  # BOS row
+        trans[:, 3] = 0.0  # to EOS
+        _, path = text.viterbi_decode(
+            paddle.to_tensor(emis), paddle.to_tensor(trans),
+            include_bos_eos_tag=True)
+        p = n(path)[0].tolist()
+        assert p in ([0, 1, 0], [1, 0, 1])
+
+    def test_uci_housing_local(self, tmp_path):
+        rng = np.random.RandomState(0)
+        data = rng.rand(50, 14).astype(np.float32)
+        f = tmp_path / "housing.data"
+        np.savetxt(f, data)
+        train = text.UCIHousing(data_file=str(f), mode="train")
+        test = text.UCIHousing(data_file=str(f), mode="test")
+        assert len(train) == 40 and len(test) == 10
+        x, y = train[0]
+        assert x.shape == (13,) and y.shape == (1,)
+
+
+class TestQuantization:
+    def test_fake_quanter_grid(self):
+        q = quantization.FakeQuanterWithAbsMaxObserver()
+        q.train()
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        out = q(x)
+        # quantized to 8-bit grid of absmax=1
+        grid = np.round(n(out) * 127)
+        np.testing.assert_allclose(n(out), grid / 127, atol=1e-6)
+
+    def test_qat_quantize_and_train(self):
+        cfg = quantization.QuantConfig(
+            activation="FakeQuanterWithAbsMaxObserver",
+            weight="FakeQuanterWithAbsMaxObserver")
+        model = nn.Sequential(nn.Linear(8, 16), nn.ReLU(),
+                              nn.Linear(16, 2))
+        qmodel = quantization.QAT(cfg).quantize(model)
+        assert isinstance(qmodel[0], quantization.QuantedLinear)
+        assert isinstance(qmodel[2], quantization.QuantedLinear)
+        # original untouched
+        from paddle_tpu.nn import Linear
+        assert isinstance(model[0], Linear)
+        qmodel.train()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(4, 8).astype(np.float32))
+        out = qmodel(x)
+        assert out.shape == [4, 2]
+        out.sum().backward()  # STE grads flow
+        grads = [p.grad for p in qmodel.parameters()]
+        assert any(g is not None and np.abs(n(g)).sum() > 0 for g in grads)
+
+    def test_ptq_calibrate_convert(self):
+        cfg = quantization.QuantConfig(
+            activation="FakeQuanterWithAbsMaxObserver", weight=None)
+        model = nn.Sequential(nn.Linear(4, 4))
+        ptq = quantization.PTQ(cfg)
+        q = ptq.quantize(model)
+        for _ in range(3):
+            q(paddle.to_tensor(
+                np.random.RandomState(1).randn(2, 4).astype(np.float32)))
+        final = ptq.convert(q)
+        assert not final.training

@@ -1,0 +1,169 @@
+"""incubate tests: fused ops numerics vs unfused reference, functional
+autograd vs analytic derivatives, ASP mask invariants, LookAhead."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import incubate, nn, optimizer
+from paddle_tpu.incubate.autograd import Hessian, Jacobian, jvp, vjp
+import paddle_tpu.incubate.nn.functional as IF
+
+
+def n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+class TestFusedFunctional:
+    def setup_method(self):
+        rng = np.random.RandomState(0)
+        self.x = paddle.to_tensor(rng.randn(2, 6, 16).astype(np.float32))
+
+    def test_fused_rms_norm_matches_composed(self):
+        w = paddle.ones([16])
+        out = IF.fused_rms_norm(self.x, w)
+        xa = n(self.x)
+        ref = xa / np.sqrt((xa ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(n(out), ref, rtol=1e-5)
+
+    def test_fused_layer_norm(self):
+        out = IF.fused_layer_norm(self.x)
+        xa = n(self.x)
+        ref = (xa - xa.mean(-1, keepdims=True)) / np.sqrt(
+            xa.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(n(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_fused_rope_matches_ops(self):
+        from paddle_tpu.ops.rope import build_rope_cache
+        q = paddle.to_tensor(np.random.RandomState(1).randn(
+            2, 6, 4, 8).astype(np.float32))
+        k = paddle.to_tensor(np.random.RandomState(2).randn(
+            2, 6, 4, 8).astype(np.float32))
+        cos, sin = build_rope_cache(6, 8)
+        q2, k2, v2 = IF.fused_rotary_position_embedding(
+            q, k, None, sin=sin, cos=cos)
+        assert q2.shape == q.shape and k2.shape == k.shape and v2 is None
+        assert not np.allclose(n(q2), n(q))
+
+    def test_swiglu_and_bias_act(self):
+        x = paddle.to_tensor(np.random.RandomState(3).randn(
+            4, 8).astype(np.float32))
+        out = IF.swiglu(x)
+        xa = n(x)
+        a1, a2 = np.split(xa, 2, axis=-1)
+        ref = a1 / (1 + np.exp(-a1)) * a2
+        np.testing.assert_allclose(n(out), ref, rtol=1e-5)
+        b = paddle.zeros([16])
+        out2 = IF.fused_bias_act(self.x, b, act_method="relu")
+        np.testing.assert_allclose(n(out2), np.maximum(n(self.x), 0),
+                                   rtol=1e-6)
+
+    def test_fused_linear(self):
+        x = paddle.to_tensor(np.random.RandomState(4).randn(
+            3, 5).astype(np.float32))
+        w = paddle.to_tensor(np.random.RandomState(5).randn(
+            5, 2).astype(np.float32))
+        b = paddle.to_tensor(np.ones(2, np.float32))
+        out = IF.fused_linear(x, w, b)
+        np.testing.assert_allclose(n(out), n(x) @ n(w) + 1, rtol=1e-5)
+
+    def test_fused_mha_and_ffn_run_and_grad(self):
+        layer = incubate.nn.FusedTransformerEncoderLayer(
+            d_model=16, nhead=4, dim_feedforward=32, dropout_rate=0.0)
+        layer.train()
+        out = layer(self.x)
+        assert out.shape == [2, 6, 16]
+        loss = out.sum()
+        loss.backward()
+        grads = [p.grad for p in layer.parameters()]
+        assert any(g is not None and np.abs(n(g)).sum() > 0 for g in grads)
+
+
+class TestFunctionalAutograd:
+    def test_jvp_matches_analytic(self):
+        def f(x):
+            return (x ** 3).sum()
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        v = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        out, jv = jvp(f, x, v)
+        assert np.isclose(float(n(out)), 9.0)
+        assert np.isclose(float(n(jv)), 3.0)  # d/dx1 = 3*x1^2 = 3
+
+    def test_vjp_matches_analytic(self):
+        def f(x):
+            return (x ** 2).sum()
+        x = paddle.to_tensor(np.array([3.0, 4.0], np.float32))
+        out, g = vjp(f, x)
+        assert np.isclose(float(n(out)), 25.0)
+        np.testing.assert_allclose(n(g), [6.0, 8.0])
+
+    def test_jacobian(self):
+        def f(x):
+            return x * x
+        x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], np.float32))
+        J = Jacobian(f, x)
+        np.testing.assert_allclose(n(J[:]), np.diag([2.0, 4.0, 6.0]),
+                                   rtol=1e-6)
+
+    def test_hessian(self):
+        def f(x):
+            return (x ** 3).sum()
+        x = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+        H = Hessian(f, x)
+        np.testing.assert_allclose(n(H[:]), np.diag([6.0, 12.0]),
+                                   rtol=1e-6)
+
+
+class TestASP:
+    def test_mask_1d_two_four(self):
+        from paddle_tpu.incubate.asp import check_sparsity, create_mask
+        w = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+        mask = create_mask(paddle.to_tensor(w))
+        assert mask.shape == w.shape
+        assert check_sparsity(w * mask)
+        # exactly half survive
+        assert mask.sum() == w.size // 2
+        # largest-magnitude kept per group of 4
+        g = (np.abs(w).reshape(-1, 4)).argmax(1)
+        m = mask.reshape(-1, 4)
+        assert all(m[i, g[i]] for i in range(len(g)))
+
+    def test_prune_model_and_decorate(self):
+        from paddle_tpu.incubate import asp
+        model = nn.Sequential(nn.Linear(16, 8), nn.ReLU(),
+                              nn.Linear(8, 4))
+        pruned = asp.prune_model(model)
+        assert pruned  # at least the linear weights
+        for name, p in model.named_parameters():
+            if name in pruned:
+                assert asp.check_sparsity(n(p))
+        opt = asp.decorate(optimizer.SGD(
+            learning_rate=0.1, parameters=model.parameters()))
+        x = paddle.to_tensor(np.random.RandomState(1).randn(
+            4, 16).astype(np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        # masks survive the update
+        for name, p in model.named_parameters():
+            if name in pruned:
+                assert asp.check_sparsity(n(p))
+
+
+class TestLookAhead:
+    def test_lookahead_converges_and_syncs(self):
+        rng = np.random.RandomState(0)
+        lin = nn.Linear(4, 1)
+        inner = optimizer.SGD(learning_rate=0.05,
+                              parameters=lin.parameters())
+        opt = incubate.optimizer.LookAhead(inner, alpha=0.5, k=2)
+        w_true = rng.randn(4, 1).astype(np.float32)
+        losses = []
+        for i in range(40):
+            xb = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+            yb = paddle.to_tensor(n(xb) @ w_true)
+            loss = ((lin(xb) - yb) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(n(loss)))
+        assert losses[-1] < losses[0] * 0.2

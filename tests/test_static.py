@@ -41,8 +41,7 @@ def test_executor_run_forward():
     x = static.data("x", [2, 3], "float32")
     y = x * 2.0 + 1.0
     exe = static.Executor()
-    xin = np.arange(6, np.float32).reshape(2, 3) \
-        if False else np.arange(6).reshape(2, 3).astype(np.float32)
+    xin = np.arange(6).reshape(2, 3).astype(np.float32)
     (out,) = exe.run(feed={"x": xin}, fetch_list=[y])
     np.testing.assert_allclose(out, xin * 2 + 1, rtol=1e-6)
 
@@ -84,6 +83,40 @@ def test_static_layers_and_training_converges():
     assert losses[-1] < 0.05 * losses[0]
     np.testing.assert_allclose(
         lin.weight.numpy().reshape(-1), w_true.reshape(-1), atol=0.15)
+
+
+def test_eval_fetch_after_minimize_needs_no_label():
+    # fetching predictions (not the loss) after minimize must neither
+    # require label feeds nor update parameters
+    x = static.data("x", [4, 3], "float32")
+    label = static.data("y", [4, 1], "float32")
+    lin = nn.Linear(3, 1)
+    pred = lin(x)
+    loss = ((pred - label) ** 2).mean()
+    opt = optimizer.SGD(learning_rate=0.1, parameters=lin.parameters())
+    opt.minimize(loss)
+    exe = static.Executor()
+    w_before = lin.weight.numpy().copy()
+    (p,) = exe.run(feed={"x": np.ones((4, 3), np.float32)},
+                   fetch_list=[pred])
+    assert p.shape == (4, 1)
+    np.testing.assert_array_equal(lin.weight.numpy(), w_before)
+    # fetching the loss (with labels) trains
+    exe.run(feed={"x": np.ones((4, 3), np.float32),
+                  "y": np.zeros((4, 1), np.float32)}, fetch_list=[loss])
+    assert not np.array_equal(lin.weight.numpy(), w_before)
+
+
+def test_dynamic_batch_dim():
+    x = static.data("x", [-1, 4], "float32")
+    assert x.shape == [-1, 4]
+    y = (x * 3.0).sum(axis=1)
+    exe = static.Executor()
+    for b in (2, 5):
+        (out,) = exe.run(feed={"x": np.ones((b, 4), np.float32)},
+                         fetch_list=[y])
+        assert out.shape == (b,)
+        np.testing.assert_allclose(out, 12.0)
 
 
 def test_static_nn_fc_conv():
