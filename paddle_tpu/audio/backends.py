@@ -52,6 +52,11 @@ class AudioInfo:
 
 
 def info(filepath: str) -> AudioInfo:
+    if _backend == "soundfile":
+        import soundfile as sf
+        i = sf.info(filepath)
+        return AudioInfo(i.samplerate, i.frames, i.channels,
+                         16 if "16" in i.subtype else 32, i.subtype)
     with _wave.open(filepath, "rb") as f:
         return AudioInfo(f.getframerate(), f.getnframes(),
                          f.getnchannels(), f.getsampwidth() * 8)
@@ -61,6 +66,16 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
          normalize: bool = True, channels_first: bool = True
          ) -> Tuple[Tensor, int]:
     """Returns (waveform [channels, samples] if channels_first, sr)."""
+    import jax.numpy as jnp
+    if _backend == "soundfile":
+        import soundfile as sf
+        data, sr = sf.read(filepath, start=frame_offset,
+                           frames=num_frames, dtype="float32",
+                           always_2d=True)
+        if not normalize:
+            data = (data * (2 ** 15)).astype(np.int16)
+        arr = data.T if channels_first else data
+        return Tensor(jnp.asarray(arr)), sr
     with _wave.open(filepath, "rb") as f:
         sr = f.getframerate()
         n = f.getnframes()
@@ -84,6 +99,15 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
 def save(filepath: str, src, sample_rate: int,
          channels_first: bool = True, encoding: str = "PCM_S",
          bits_per_sample: int = 16):
+    if _backend == "soundfile":
+        import soundfile as sf
+        arr = np.asarray(src._value if isinstance(src, Tensor) else src)
+        if channels_first:
+            arr = arr.T
+        subtype = {16: "PCM_16", 24: "PCM_24", 32: "PCM_32"}.get(
+            bits_per_sample, "PCM_16")
+        sf.write(filepath, arr, sample_rate, subtype=subtype)
+        return
     if bits_per_sample != 16:
         raise NotImplementedError(
             "wave_backend saves 16-bit PCM; install soundfile for others")

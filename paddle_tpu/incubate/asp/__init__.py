@@ -37,9 +37,27 @@ def get_mask_1d(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
 
 
 def get_mask_2d_greedy(mat: np.ndarray, n: int = 2, m: int = 4) -> np.ndarray:
-    """Greedy 2D variant: apply 1D n:m along rows then refine columns
-    (reference get_mask_2d_greedy)."""
-    return get_mask_1d(mat, n, m)
+    """2D n:m over m x m blocks: greedily keep the largest-|w| entries
+    subject to <= n survivors per block-row AND per block-column
+    (reference utils.py get_mask_2d_greedy semantics). Requires both
+    dims divisible by m (callers pad)."""
+    h, w = mat.shape
+    assert h % m == 0 and w % m == 0, "pad to multiples of m first"
+    mask = np.zeros_like(mat, dtype=bool)
+    absw = np.abs(mat)
+    for bi in range(0, h, m):
+        for bj in range(0, w, m):
+            block = absw[bi:bi + m, bj:bj + m]
+            order = np.argsort(-block, axis=None)
+            rows_used = np.zeros(m, np.int64)
+            cols_used = np.zeros(m, np.int64)
+            for flat in order:
+                r, c = divmod(int(flat), m)
+                if rows_used[r] < n and cols_used[c] < n:
+                    mask[bi + r, bj + c] = True
+                    rows_used[r] += 1
+                    cols_used[c] += 1
+    return mask
 
 
 def create_mask(tensor, func_name: str = "get_mask_1d", n: int = 2,
@@ -48,14 +66,17 @@ def create_mask(tensor, func_name: str = "get_mask_1d", n: int = 2,
                      else tensor)
     shape = arr.shape
     flat = arr.reshape(shape[0], -1) if arr.ndim > 1 else arr.reshape(1, -1)
-    pad = (-flat.shape[1]) % m
-    if pad:
-        flat = np.pad(flat, ((0, 0), (0, pad)))
+    pad_c = (-flat.shape[1]) % m
+    pad_r = (-flat.shape[0]) % m if func_name == "get_mask_2d_greedy" else 0
+    if pad_c or pad_r:
+        flat = np.pad(flat, ((0, pad_r), (0, pad_c)))
     fn = {"get_mask_1d": get_mask_1d,
           "get_mask_2d_greedy": get_mask_2d_greedy}[func_name]
     mask = fn(flat, n, m)
-    if pad:
-        mask = mask[:, :-pad]
+    if pad_r:
+        mask = mask[:-pad_r]
+    if pad_c:
+        mask = mask[:, :-pad_c]
     return mask.reshape(shape)
 
 

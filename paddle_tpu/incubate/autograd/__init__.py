@@ -92,21 +92,23 @@ class Jacobian:
     def _materialize(self):
         if self._mat is not None:
             return self._mat
+        if self._is_batched:
+            # per-sample Jacobian via vmap (a plain jacrev over the batched
+            # fn would produce the [b, out, b, in] cross-batch Jacobian)
+            jac = jax.vmap(jax.jacrev(self._fn))(*self._arrays)
+            b = self._arrays[0].shape[0]
+            i = int(np.prod(self._arrays[0].shape[1:]))
+            self._mat = jnp.asarray(jac).reshape(b, -1, i)
+            return self._mat
         jac = jax.jacrev(self._fn, argnums=tuple(
             range(len(self._arrays))))(*self._arrays)
         if self._single_in:
             jac = jac[0] if isinstance(jac, tuple) else jac
         out_aval = jax.eval_shape(self._fn, *self._arrays)
-        if self._is_batched:
-            b = self._arrays[0].shape[0]
-            o = int(np.prod(out_aval.shape[1:]))
-            i = int(np.prod(self._arrays[0].shape[1:]))
-            self._mat = jnp.asarray(jac).reshape(b, o, i)
-        else:
-            o = int(np.prod(out_aval.shape))
-            self._mat = jnp.asarray(jac).reshape(
-                o, -1) if not isinstance(jac, tuple) else tuple(
-                jnp.asarray(j).reshape(o, -1) for j in jac)
+        o = int(np.prod(out_aval.shape))
+        self._mat = jnp.asarray(jac).reshape(
+            o, -1) if not isinstance(jac, tuple) else tuple(
+            jnp.asarray(j).reshape(o, -1) for j in jac)
         return self._mat
 
     @property
@@ -137,14 +139,14 @@ class Hessian:
 
     def _materialize(self):
         if self._mat is None:
-            h = jax.hessian(self._fn)(*self._arrays)
-            n = int(np.prod(self._arrays[0].shape))
             if self._is_batched:
+                h = jax.vmap(jax.hessian(self._fn))(*self._arrays)
                 b = self._arrays[0].shape[0]
                 k = int(np.prod(self._arrays[0].shape[1:]))
-                self._mat = jnp.asarray(h).reshape(b, k, k) \
-                    if False else jnp.asarray(h)
+                self._mat = jnp.asarray(h).reshape(b, k, k)
             else:
+                n = int(np.prod(self._arrays[0].shape))
+                h = jax.hessian(self._fn)(*self._arrays)
                 self._mat = jnp.asarray(h).reshape(n, n)
         return self._mat
 

@@ -3,3 +3,18 @@ from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_tiny, llama_small,
     llama_3_8b,
 )
+from .gpt import (  # noqa: F401
+    GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny, gpt_345m,
+    ernie_45_dense_3b,
+)
+from .moe_lm import (  # noqa: F401
+    MoEConfig, MoEForCausalLM, MoEModel, moe_tiny, deepseek_moe_16b_like,
+    qwen2_moe_a14b_like,
+)
+from .dit import (  # noqa: F401
+    DiT, DiTConfig, dit_tiny, dit_s_2, dit_xl_2,
+)
+from .bert import (  # noqa: F401
+    BertConfig, BertForMaskedLM, BertForSequenceClassification, BertModel,
+    bert_tiny, bert_base, bert_large,
+)

@@ -1,0 +1,167 @@
+"""BERT encoder family (masked-LM / classification).
+
+Capability parity target: the reference's encoder stacks (PaddleNLP BERT
+on the framework's nn.TransformerEncoder,
+/root/reference/python/paddle/nn/layer/transformer.py). Word+position+
+token-type embeddings with LayerNorm, post-LN encoder blocks, pooler,
+MLM and sequence-classification heads.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from ..framework.core import apply
+from .. import nn
+from ..nn import functional as F
+
+__all__ = ["BertConfig", "BertModel", "BertForMaskedLM",
+           "BertForSequenceClassification", "bert_tiny", "bert_base",
+           "bert_large"]
+
+
+@dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    pad_token_id: int = 0
+    dtype: str = "float32"
+
+
+class BertEmbeddings(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.word_embeddings = nn.Embedding(cfg.vocab_size,
+                                            cfg.hidden_size)
+        self.position_embeddings = nn.Embedding(
+            cfg.max_position_embeddings, cfg.hidden_size)
+        self.token_type_embeddings = nn.Embedding(cfg.type_vocab_size,
+                                                  cfg.hidden_size)
+        self.layer_norm = nn.LayerNorm(cfg.hidden_size,
+                                       epsilon=cfg.layer_norm_eps)
+        self.dropout = cfg.hidden_dropout_prob
+
+    def forward(self, input_ids, token_type_ids=None):
+        pos = apply("position_ids",
+                    lambda ids: jnp.broadcast_to(
+                        jnp.arange(ids.shape[1]), ids.shape), input_ids)
+        emb = self.word_embeddings(input_ids) + \
+            self.position_embeddings(pos)
+        if token_type_ids is not None:
+            emb = emb + self.token_type_embeddings(token_type_ids)
+        emb = self.layer_norm(emb)
+        if self.dropout:
+            emb = F.dropout(emb, p=self.dropout, training=self.training)
+        return emb
+
+
+class BertModel(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = nn.TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            normalize_before=False)
+        self.encoder = nn.TransformerEncoder(enc_layer,
+                                             cfg.num_hidden_layers)
+        self.pooler = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, attention_mask=None):
+        """Returns (sequence_output [B,S,H], pooled_output [B,H])."""
+        h = self.embeddings(input_ids, token_type_ids)
+        if attention_mask is not None:
+            # [B, S] of 1/0 → additive mask broadcastable to attention
+            mask = apply(
+                "attn_mask",
+                lambda m: (1.0 - m.astype(jnp.float32))[:, None, None, :]
+                * -1e9, attention_mask)
+        else:
+            mask = None
+        h = self.encoder(h, src_mask=mask)
+        pooled = F.tanh(self.pooler(h[:, 0]))
+        return h, pooled
+
+
+class BertForMaskedLM(nn.Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__(dtype=cfg.dtype)
+        self.cfg = cfg
+        self.bert = BertModel(cfg)
+        self.transform = nn.Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_ln = nn.LayerNorm(cfg.hidden_size,
+                                         epsilon=cfg.layer_norm_eps)
+        self.decoder_bias = None  # tied to word embeddings
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        seq, _ = self.bert(input_ids, token_type_ids, attention_mask)
+        h = self.transform_ln(F.gelu(self.transform(seq)))
+        from ..tensor.linalg import matmul
+        return matmul(h, self.bert.embeddings.word_embeddings.weight,
+                      transpose_y=True)
+
+    def loss(self, logits, labels, ignore_index: int = -100):
+        """MLM loss over positions where labels != ignore_index."""
+        v = logits.shape[-1]
+        flat_logits = logits.reshape([-1, v])
+        flat_labels = labels.reshape([-1])
+
+        def f(lg, lb):
+            valid = lb != ignore_index
+            lb_safe = jnp.where(valid, lb, 0)
+            logp = jax.nn.log_softmax(lg, axis=-1)
+            nll = -jnp.take_along_axis(logp, lb_safe[:, None],
+                                       axis=1)[:, 0]
+            return (nll * valid).sum() / jnp.maximum(valid.sum(), 1)
+        import jax
+        return apply("mlm_loss", f, flat_logits, flat_labels)
+
+
+class BertForSequenceClassification(nn.Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2):
+        super().__init__(dtype=cfg.dtype)
+        self.bert = BertModel(cfg)
+        self.dropout = cfg.hidden_dropout_prob
+        self.classifier = nn.Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, attention_mask)
+        if self.dropout:
+            pooled = F.dropout(pooled, p=self.dropout,
+                               training=self.training)
+        return self.classifier(pooled)
+
+    def loss(self, logits, labels):
+        return F.cross_entropy(logits, labels)
+
+
+def bert_tiny(**kw) -> BertConfig:
+    return BertConfig(vocab_size=512, hidden_size=128,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      intermediate_size=256,
+                      max_position_embeddings=128, **kw)
+
+
+def bert_base(**kw) -> BertConfig:
+    return BertConfig(**kw)
+
+
+def bert_large(**kw) -> BertConfig:
+    return BertConfig(hidden_size=1024, num_hidden_layers=24,
+                      num_attention_heads=16, intermediate_size=4096,
+                      **kw)

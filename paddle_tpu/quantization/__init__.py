@@ -131,21 +131,30 @@ class QuantConfig:
         return self.activation, self.weight
 
 
-class QuantedLinear(Layer):
-    """Linear with weight+activation fake-quant (QAT form of nn.Linear;
-    reference nn/quant/qat/linear.py)."""
+_DEFAULT = object()  # distinguishes "use default quanter" from
+# "None = leave this tensor unquantized" (QuantConfig semantics)
 
-    def __init__(self, linear, act_quanter=None, weight_quanter=None):
+
+class QuantedLinear(Layer):
+    """Linear with weight/activation fake-quant (QAT form of nn.Linear;
+    reference nn/quant/qat/linear.py). Pass None for either quanter to
+    leave that tensor unquantized."""
+
+    def __init__(self, linear, act_quanter=_DEFAULT,
+                 weight_quanter=_DEFAULT):
         super().__init__()
         self.linear = linear
-        self.act_quanter = act_quanter or FakeQuanterWithAbsMaxObserver()
-        self.weight_quanter = weight_quanter or \
-            FakeQuanterWithAbsMaxObserver()
+        self.act_quanter = FakeQuanterWithAbsMaxObserver() \
+            if act_quanter is _DEFAULT else act_quanter
+        self.weight_quanter = FakeQuanterWithAbsMaxObserver() \
+            if weight_quanter is _DEFAULT else weight_quanter
 
     def forward(self, x):
         from ..nn import functional as F
-        xq = self.act_quanter(x)
-        wq = self.weight_quanter(self.linear.weight)
+        xq = self.act_quanter(x) if self.act_quanter is not None else x
+        w = self.linear.weight
+        wq = self.weight_quanter(w) if self.weight_quanter is not None \
+            else w
         return F.linear(xq, wq, self.linear.bias)
 
 
@@ -171,8 +180,10 @@ class QAT:
                 make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
                     cfg, str) else (cfg() if isinstance(cfg, type)
                                     else cfg))
+                # None in the config means: do not quantize that tensor
                 setattr(layer, name, QuantedLinear(
-                    sub, make(a) if a else None, make(w) if w else None))
+                    sub, make(a) if a is not None else None,
+                    make(w) if w is not None else None))
             else:
                 self._convert(sub)
 

@@ -33,9 +33,9 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         lens = rest[0] if rest else None
         b, s, n = emis.shape
         if include_bos_eos_tag:
-            # reference semantics: first step adds trans from BOS (tag n-2),
-            # last valid step adds trans to EOS (tag n-1)
-            init = emis[:, 0] + trans[n - 2][None, :]
+            # reference convention: the LAST tag (n-1) is start/BOS, the
+            # second-to-last (n-2) is stop/EOS
+            init = emis[:, 0] + trans[n - 1][None, :]
         else:
             init = emis[:, 0]
 
@@ -55,7 +55,7 @@ def viterbi_decode(potentials, transition_params, lengths=None,
         ts = jnp.arange(1, s)
         alpha, history = jax.lax.scan(step, init, ts)  # history [s-1, b, n]
         if include_bos_eos_tag:
-            alpha = alpha + trans[:, n - 1][None, :]
+            alpha = alpha + trans[:, n - 2][None, :]
         scores = jnp.max(alpha, axis=1)
         last_tag = jnp.argmax(alpha, axis=1)  # [b]
 
@@ -129,15 +129,23 @@ class Imdb(Dataset):
             raise ValueError(
                 "data_dir is required (no network in this environment)")
         pat = re.compile(r"[A-Za-z']+")
-        texts, labels = [], []
-        for label, sub in ((0, "neg"), (1, "pos")):
-            d = os.path.join(data_dir, mode, sub)
-            for fn in sorted(os.listdir(d)):
-                with open(os.path.join(d, fn), errors="ignore") as f:
-                    texts.append(pat.findall(f.read().lower()))
-                labels.append(label)
+
+        def read_split(which):
+            ts, ls = [], []
+            for label, sub in ((0, "neg"), (1, "pos")):
+                d = os.path.join(data_dir, which, sub)
+                for fn in sorted(os.listdir(d)):
+                    with open(os.path.join(d, fn), errors="ignore") as f:
+                        ts.append(pat.findall(f.read().lower()))
+                    ls.append(label)
+            return ts, ls
+
+        texts, labels = read_split(mode)
+        # vocabulary ALWAYS comes from the train split (reference
+        # semantics) so train/test share word ids
+        vocab_texts = texts if mode == "train" else read_split("train")[0]
         freq: dict = {}
-        for t in texts:
+        for t in vocab_texts:
             for w in t:
                 freq[w] = freq.get(w, 0) + 1
         words = [w for w, c in sorted(freq.items(), key=lambda kv: -kv[1])

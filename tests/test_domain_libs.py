@@ -150,8 +150,8 @@ class TestText:
         emis = np.zeros((1, 3, 4), np.float32)
         trans = np.full((4, 4), -1e3, np.float32)
         trans[0, 1] = trans[1, 0] = 1.0  # force alternation
-        trans[2, :] = 0.0  # BOS row
-        trans[:, 3] = 0.0  # to EOS
+        trans[3, :] = 0.0  # BOS row (last tag is start)
+        trans[:, 2] = 0.0  # to EOS (second-to-last tag is stop)
         _, path = text.viterbi_decode(
             paddle.to_tensor(emis), paddle.to_tensor(trans),
             include_bos_eos_tag=True)

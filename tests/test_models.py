@@ -1,0 +1,178 @@
+"""Model-family tests: GPT/ERNIE, MoE-LM, DiT, BERT (tiny configs) —
+forward shapes, loss + grads, one training step improving loss."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.models import (
+    BertForMaskedLM, BertForSequenceClassification, DiT, GPTForCausalLM,
+    MoEForCausalLM, bert_tiny, dit_tiny, gpt_tiny, moe_tiny,
+)
+
+
+def n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+def _ids(rng, b, s, v):
+    return paddle.to_tensor(rng.randint(0, v, (b, s)).astype(np.int32))
+
+
+class TestGPT:
+    def test_forward_loss_step(self):
+        rng = np.random.RandomState(0)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        ids = _ids(rng, 2, 16, cfg.vocab_size)
+        logits = model(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+        step = paddle.jit.TrainStep(
+            model, lambda o, l: model.loss(o, l),
+            optimizer.AdamW(learning_rate=1e-3,
+                            parameters=model.parameters()))
+        l0 = float(n(step(ids, ids)))
+        for _ in range(5):
+            l1 = float(n(step(ids, ids)))
+        assert l1 < l0
+
+    def test_tied_embeddings(self):
+        cfg = gpt_tiny(tie_word_embeddings=True)
+        model = GPTForCausalLM(cfg)
+        assert model.lm_head is None
+        names = [name for name, _ in model.named_parameters()]
+        assert not any("lm_head" in nm for nm in names)
+
+
+class TestMoELM:
+    def test_forward_and_aux_loss(self):
+        rng = np.random.RandomState(0)
+        cfg = moe_tiny()
+        model = MoEForCausalLM(cfg)
+        ids = _ids(rng, 2, 16, cfg.vocab_size)
+        logits = model(ids)
+        assert logits.shape == [2, 16, cfg.vocab_size]
+        # layer 0 dense, layer 1 MoE (first_k_dense_replace=1)
+        from paddle_tpu.models.moe_lm import MoEBlock, _DenseMLP
+        assert isinstance(model.model.layers[0].mlp, _DenseMLP)
+        assert isinstance(model.model.layers[1].mlp, MoEBlock)
+        aux = model.model.aux_losses()
+        assert len(aux) == 1
+        loss = model.loss(logits, ids)
+        assert np.isfinite(float(n(loss)))
+
+    def test_trains(self):
+        rng = np.random.RandomState(0)
+        cfg = moe_tiny()
+        model = MoEForCausalLM(cfg)
+        ids = _ids(rng, 2, 16, cfg.vocab_size)
+        step = paddle.jit.TrainStep(
+            model, lambda o, l: model.loss(o, l),
+            optimizer.AdamW(learning_rate=1e-3,
+                            parameters=model.parameters()))
+        l0 = float(n(step(ids, ids)))
+        for _ in range(5):
+            l1 = float(n(step(ids, ids)))
+        assert l1 < l0
+
+    def test_activated_params_fewer_than_total(self):
+        model = MoEForCausalLM(moe_tiny())
+        assert model.num_activated_params() < model.num_params()
+
+
+class TestDiT:
+    def test_forward_shapes(self):
+        rng = np.random.RandomState(0)
+        cfg = dit_tiny()
+        model = DiT(cfg)
+        model.eval()
+        x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+        t = paddle.to_tensor(rng.randint(0, 1000, (2,)).astype(np.int32))
+        y = paddle.to_tensor(rng.randint(0, 10, (2,)).astype(np.int32))
+        out = model(x, t, y)
+        assert out.shape == [2, 8, 8, 8]  # learn_sigma doubles channels
+
+    def test_adaln_zero_init_identity_final(self):
+        # final linear zero-init → output is exactly zero at init
+        cfg = dit_tiny()
+        model = DiT(cfg)
+        model.eval()
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(1, 4, 8, 8).astype(np.float32))
+        t = paddle.to_tensor(np.array([5], np.int32))
+        y = paddle.to_tensor(np.array([1], np.int32))
+        out = model(x, t, y)
+        np.testing.assert_allclose(n(out), 0.0)
+
+    def test_denoising_step_trains(self):
+        rng = np.random.RandomState(0)
+        cfg = dit_tiny(learn_sigma=False)
+        model = DiT(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        loss_fn = nn.MSELoss()
+        x = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+        noise = paddle.to_tensor(rng.randn(2, 4, 8, 8).astype(np.float32))
+        t = paddle.to_tensor(rng.randint(0, 1000, (2,)).astype(np.int32))
+        y = paddle.to_tensor(rng.randint(0, 10, (2,)).astype(np.int32))
+        losses = []
+        for _ in range(6):
+            pred = model(x, t, y)
+            loss = loss_fn(pred, noise)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(n(loss)))
+        assert losses[-1] < losses[0]
+
+
+class TestBert:
+    def test_mlm_forward_and_masked_loss(self):
+        rng = np.random.RandomState(0)
+        cfg = bert_tiny()
+        model = BertForMaskedLM(cfg)
+        model.eval()
+        ids = _ids(rng, 2, 12, cfg.vocab_size)
+        logits = model(ids)
+        assert logits.shape == [2, 12, cfg.vocab_size]
+        labels = np.full((2, 12), -100, np.int64)
+        labels[:, 3] = 7
+        loss = model.loss(logits, paddle.to_tensor(labels))
+        assert np.isfinite(float(n(loss)))
+        # all-ignored labels → zero loss, no nan
+        all_ign = paddle.to_tensor(np.full((2, 12), -100, np.int64))
+        l2 = model.loss(logits, all_ign)
+        assert float(n(l2)) == 0.0
+
+    def test_attention_mask_changes_output(self):
+        rng = np.random.RandomState(0)
+        cfg = bert_tiny(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        model = BertForSequenceClassification(cfg, num_classes=3)
+        model.eval()
+        ids = _ids(rng, 1, 8, cfg.vocab_size)
+        full = np.ones((1, 8), np.float32)
+        half = full.copy()
+        half[:, 4:] = 0
+        o1 = model(ids, attention_mask=paddle.to_tensor(full))
+        o2 = model(ids, attention_mask=paddle.to_tensor(half))
+        assert o1.shape == [1, 3]
+        assert not np.allclose(n(o1), n(o2))
+
+    def test_classification_trains(self):
+        rng = np.random.RandomState(0)
+        cfg = bert_tiny(hidden_dropout_prob=0.0,
+                        attention_probs_dropout_prob=0.0)
+        model = BertForSequenceClassification(cfg, num_classes=2)
+        opt = optimizer.AdamW(learning_rate=1e-3,
+                              parameters=model.parameters())
+        ids = _ids(rng, 4, 12, cfg.vocab_size)
+        labels = paddle.to_tensor(np.array([0, 1, 0, 1], np.int64))
+        losses = []
+        for _ in range(6):
+            loss = model.loss(model(ids), labels)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(n(loss)))
+        assert losses[-1] < losses[0]
