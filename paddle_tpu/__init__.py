@@ -45,6 +45,7 @@ from . import geometric  # noqa: F401
 from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import quantization  # noqa: F401
+from . import inference  # noqa: F401
 from .hapi import Model, summary  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 

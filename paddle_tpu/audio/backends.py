@@ -55,8 +55,10 @@ def info(filepath: str) -> AudioInfo:
     if _backend == "soundfile":
         import soundfile as sf
         i = sf.info(filepath)
-        return AudioInfo(i.samplerate, i.frames, i.channels,
-                         16 if "16" in i.subtype else 32, i.subtype)
+        bits = {"PCM_U8": 8, "PCM_S8": 8, "PCM_16": 16, "PCM_24": 24,
+                "PCM_32": 32, "FLOAT": 32, "DOUBLE": 64}.get(i.subtype, 16)
+        return AudioInfo(i.samplerate, i.frames, i.channels, bits,
+                         i.subtype)
     with _wave.open(filepath, "rb") as f:
         return AudioInfo(f.getframerate(), f.getnframes(),
                          f.getnchannels(), f.getsampwidth() * 8)
@@ -69,11 +71,17 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
     import jax.numpy as jnp
     if _backend == "soundfile":
         import soundfile as sf
+        if normalize:
+            dtype = "float32"
+        else:
+            # native integer width per subtype (PCM_24 promotes to int32,
+            # matching soundfile's own convention)
+            subtype = sf.info(filepath).subtype
+            dtype = "int16" if subtype in ("PCM_16", "PCM_S8",
+                                           "PCM_U8") else "int32"
         data, sr = sf.read(filepath, start=frame_offset,
-                           frames=num_frames, dtype="float32",
+                           frames=num_frames, dtype=dtype,
                            always_2d=True)
-        if not normalize:
-            data = (data * (2 ** 15)).astype(np.int16)
         arr = data.T if channels_first else data
         return Tensor(jnp.asarray(arr)), sr
     with _wave.open(filepath, "rb") as f:

@@ -94,11 +94,17 @@ class Jacobian:
             return self._mat
         if self._is_batched:
             # per-sample Jacobian via vmap (a plain jacrev over the batched
-            # fn would produce the [b, out, b, in] cross-batch Jacobian)
-            jac = jax.vmap(jax.jacrev(self._fn))(*self._arrays)
+            # fn would produce the [b, out, b, in] cross-batch Jacobian);
+            # argnums covers every input like the unbatched path
+            argnums = tuple(range(len(self._arrays)))
+            jac = jax.vmap(jax.jacrev(self._fn, argnums=argnums))(
+                *self._arrays)
             b = self._arrays[0].shape[0]
-            i = int(np.prod(self._arrays[0].shape[1:]))
-            self._mat = jnp.asarray(jac).reshape(b, -1, i)
+            mats = tuple(
+                jnp.asarray(j).reshape(
+                    b, -1, int(np.prod(a.shape[1:])))
+                for j, a in zip(jac, self._arrays))
+            self._mat = mats[0] if self._single_in else mats
             return self._mat
         jac = jax.jacrev(self._fn, argnums=tuple(
             range(len(self._arrays))))(*self._arrays)
@@ -133,6 +139,10 @@ class Hessian:
 
     def __init__(self, func: Callable, xs, is_batched: bool = False):
         self._arrays = _unwrap_args(xs)
+        if len(self._arrays) > 1:
+            raise NotImplementedError(
+                "Hessian over multiple inputs: concatenate them into one "
+                "tensor (the reference's Hessian is single-input too)")
         self._fn = _wrap_fn(func)
         self._is_batched = is_batched
         self._mat = None

@@ -1,0 +1,145 @@
+"""paddle_tpu.inference — deployment predictor API.
+
+Reference: paddle.inference (/root/reference/python/paddle/inference/
+__init__.py binding AnalysisPredictor,
+/root/reference/paddle/fluid/inference/api/analysis_predictor.h): a
+Config names the serialized model artifact; create_predictor loads it
+and exposes named input/output handles. The TPU-native artifact is the
+StableHLO export written by paddle_tpu.static.save_inference_model (or
+paddle_tpu.jit.save) — XLA AOT plays the role of the reference's
+analysis passes + TensorRT engines.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
+           "PlaceType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType:
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class Config:
+    """Holds artifact paths + device options (reference
+    paddle.inference.Config)."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # accept either a path prefix or explicit .pdmodel path
+        if prog_file and prog_file.endswith(".pdmodel"):
+            prog_file = prog_file[:-len(".pdmodel")]
+        self.prefix = prog_file
+        self._device = "tpu"
+        self._device_id = 0
+        self._enable_memory_optim = True
+        self._precision = PrecisionType.Float32
+
+    def set_prog_file(self, path: str):
+        self.prefix = path[:-len(".pdmodel")] if path.endswith(".pdmodel") \
+            else path
+
+    def set_model(self, prog_file: str, params_file: str = ""):
+        self.set_prog_file(prog_file)
+
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=None):
+        self._device = "tpu"  # accelerator routing: gpu name → local chip
+        self._device_id = device_id
+
+    def enable_tpu(self, device_id: int = 0):
+        self._device = "tpu"
+        self._device_id = device_id
+
+    def disable_gpu(self):
+        self._device = "cpu"
+
+    def enable_memory_optim(self, x: bool = True):
+        self._enable_memory_optim = x
+
+    def switch_ir_optim(self, x: bool = True):
+        pass  # XLA always optimizes
+
+    def model_dir(self):
+        return self.prefix
+
+
+class _IOHandle:
+    """Named tensor handle (reference PaddleTensor/ZeroCopyTensor):
+    copy_from_cpu to feed, copy_to_cpu to fetch."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._value: Optional[np.ndarray] = None
+
+    def copy_from_cpu(self, arr):
+        self._value = np.asarray(arr)
+
+    def reshape(self, shape):
+        if self._value is not None:
+            self._value = self._value.reshape(shape)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"output {self.name!r} not produced yet; "
+                               f"call predictor.run() first")
+        return self._value
+
+    def shape(self):
+        return list(self._value.shape) if self._value is not None else None
+
+
+class Predictor:
+    def __init__(self, config: Config):
+        from ..static.io import _LoadedPredictor
+        if not config.prefix:
+            raise ValueError("Config has no model path")
+        self._loaded = _LoadedPredictor(config.prefix)
+        self._inputs = {n: _IOHandle(n) for n in self._loaded.feed_names}
+        self._outputs = {n: _IOHandle(n) for n in self._loaded.fetch_names}
+
+    def get_input_names(self) -> List[str]:
+        return list(self._inputs)
+
+    def get_output_names(self) -> List[str]:
+        return list(self._outputs)
+
+    def get_input_handle(self, name: str) -> _IOHandle:
+        return self._inputs[name]
+
+    def get_output_handle(self, name: str) -> _IOHandle:
+        return self._outputs[name]
+
+    def run(self, inputs: Optional[List[np.ndarray]] = None):
+        """Either positional (returns outputs) or handle-based."""
+        if inputs is not None:
+            feeds = [np.asarray(a) for a in inputs]
+        else:
+            missing = [n for n, h in self._inputs.items()
+                       if h._value is None]
+            if missing:
+                raise RuntimeError(
+                    f"inputs {missing} not set; use "
+                    f"get_input_handle(name).copy_from_cpu(arr)")
+            feeds = [self._inputs[n]._value
+                     for n in self._loaded.feed_names]
+        outs = self._loaded.run(feeds)
+        for n, o in zip(self._loaded.fetch_names, outs):
+            self._outputs[n]._value = o
+        return outs if inputs is not None else True
+
+
+def create_predictor(config: Config) -> Predictor:
+    return Predictor(config)

@@ -371,17 +371,106 @@ class TrainStep:
 
 
 # ---------------------------------------------------------------------------
-# jit.save / jit.load (AOT export parity — minimal: orbax/pickle of params +
-# re-trace on load; full StableHLO export in paddle_tpu.static)
+# jit.save / jit.load — AOT export parity
+# (reference: paddle.jit.save → TranslatedLayer,
+# /root/reference/python/paddle/jit/api.py + translated_layer.py). The
+# artifact is serialized StableHLO (jax.export) + params npz — loadable
+# without the Python model class, like the reference's program+params.
 # ---------------------------------------------------------------------------
 
 def save(layer, path, input_spec=None, **configs):
+    """Trace layer.forward over input_spec and write <path>.pdmodel
+    (StableHLO + metadata) and <path>.pdiparams.npz. Also writes
+    <path>.pdparams (state_dict) so paddle.load works on the same
+    prefix."""
+    import os
+    import pickle
+
     from ..framework.io import save as _save
-    _save({"state_dict": layer.state_dict() if hasattr(layer, "state_dict")
-           else {}, "class": type(layer).__name__}, path + ".pdparams")
+    from ..static.program import InputSpec
+
+    if input_spec is None:
+        raise ValueError(
+            "jit.save needs input_spec=[InputSpec(shape, dtype), ...] "
+            "to trace the forward (dynamic dims as 1)")
+    specs = [s if isinstance(s, InputSpec) else InputSpec(
+        s.shape, s.dtype) for s in input_spec]
+
+    params, buffers = _collect(layer)
+    p_arrays = [p._value for _, p in params]
+    b_arrays = [b._value for _, b in buffers]
+    was_training = getattr(layer, "training", False)
+    layer.eval()
+
+    def fn(in_arrays, param_arrays, buffer_arrays):
+        out, _ = functional_call(layer, param_arrays, buffer_arrays,
+                                 tuple(in_arrays))
+        flat, _ = jax.tree_util.tree_flatten(out)
+        return tuple(flat)
+
+    in_avals = [jax.ShapeDtypeStruct(
+        tuple(d if d and d > 0 else 1 for d in s.shape), s.dtype)
+        for s in specs]
+    p_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in p_arrays]
+    b_avals = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in b_arrays]
+    try:
+        exported = jax.export.export(jax.jit(fn))(in_avals, p_avals,
+                                                  b_avals)
+    finally:
+        if was_training:
+            layer.train()
+
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump({
+            "stablehlo": exported.serialize(),
+            "feed_names": [s.name or f"x{i}"
+                           for i, s in enumerate(specs)],
+            "feed_shapes": [tuple(a.shape) for a in in_avals],
+            "feed_dtypes": [str(a.dtype) for a in in_avals],
+            "fetch_names": [f"out{i}"
+                            for i in range(len(exported.out_avals))],
+            "kind": "jit.save",
+            "n_params": len(p_arrays),
+        }, f)
+    np.savez(path + ".pdiparams",
+             **{f"p{i}": np.asarray(a)
+                for i, a in enumerate(list(p_arrays) + list(b_arrays))})
+    _save({"state_dict": layer.state_dict()}, path + ".pdparams")
+    return path
 
 
-def load(path, **configs):
-    raise NotImplementedError(
-        "jit.load: use paddle_tpu.load + Layer.set_state_dict; "
-        "AOT StableHLO export planned in paddle_tpu.static")
+class TranslatedLayer:
+    """Callable rebuilt from a jit.save artifact (reference
+    TranslatedLayer, jit/translated_layer.py) — runs the compiled
+    StableHLO, no Python model code needed."""
+
+    def __init__(self, path: str):
+        import pickle
+        with open(path + ".pdmodel", "rb") as f:
+            meta = pickle.load(f)
+        self._exported = jax.export.deserialize(meta["stablehlo"])
+        z = np.load(path + ".pdiparams.npz")
+        stored = [jnp.asarray(z[f"p{i}"]) for i in range(len(z.files))]
+        n_p = meta["n_params"]
+        self._params = stored[:n_p]
+        self._buffers = stored[n_p:]
+        self.feed_names = meta["feed_names"]
+
+    def __call__(self, *args):
+        in_arrays = [a._value if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args]
+        out = self._exported.call(list(in_arrays), self._params,
+                                  self._buffers)
+        outs = [Tensor(o) for o in out]
+        return outs[0] if len(outs) == 1 else tuple(outs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("TranslatedLayer is inference-only")
+
+
+def load(path, **configs) -> TranslatedLayer:
+    return TranslatedLayer(path)

@@ -194,6 +194,11 @@ class DiT(nn.Layer):
         h = h.flatten(2).transpose([0, 2, 1])  # [B, N, hidden]
         h = h + self.pos_embed
         c = self.t_embedder(t) + self.y_embedder(y)
+        if self.cfg.dtype != "float32":
+            # pos_embed/embedders are f32 masters; narrow activations so
+            # the block stack actually runs at the configured precision
+            h = h.astype(self.cfg.dtype)
+            c = c.astype(self.cfg.dtype)
         for block in self.blocks:
             if self.cfg.use_recompute:
                 from ..distributed.fleet import recompute

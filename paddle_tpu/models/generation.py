@@ -1,0 +1,98 @@
+"""Autoregressive generation over static-shape KV caches.
+
+Reference analog: the serving decode loops built on
+block_multihead_attention + masked_multihead_attention
+(/root/reference/python/paddle/incubate/nn/functional/). TPU-native
+structure: two compiled programs — prefill (prompt chunk, fills the
+caches) and a single-token decode step (traced position into fixed
+[b, max_len] caches, donated so updates happen in-place in HBM). The
+Python loop only replays the compiled decode step: no per-step
+recompiles, no dynamic shapes.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..jit import functional_call
+
+__all__ = ["generate"]
+
+
+def _sample(logits, temperature, top_k, key):
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             max_length: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, eos_token_id: Optional[int] = None,
+             seed: int = 0):
+    """Returns a Tensor [batch, prompt_len + generated] of token ids
+    (prompt included). Greedy when temperature == 0."""
+    cfg = model.cfg
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = ids.astype(jnp.int32)
+    b, prompt_len = ids.shape
+    max_length = max_length or min(cfg.max_position_embeddings,
+                                   prompt_len + max_new_tokens)
+    n_new = min(max_new_tokens, max_length - prompt_len)
+    if n_new <= 0:
+        return Tensor(ids)
+
+    model.eval()
+    # same collection functional_call uses internally — ordering must match
+    from ..jit import _collect
+    params, buffers = _collect(model)
+    p_arrays = [p._value for _, p in params]
+    b_arrays = [bf._value for _, bf in buffers]
+    n_layers = cfg.num_hidden_layers
+    kv_heads = cfg.num_key_value_heads
+    head_dim = cfg.hidden_size // cfg.num_attention_heads
+    dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+
+    caches = [(jnp.zeros((b, max_length, kv_heads, head_dim), dtype),
+               jnp.zeros((b, max_length, kv_heads, head_dim), dtype))
+              for _ in range(n_layers)]
+
+    def step(pa, ba, chunk, caches_in, pos, key):
+        (logits, new_caches), _ = functional_call(
+            model, pa, ba, (chunk,),
+            kwargs={"caches": caches_in, "pos": pos})
+        next_tok = _sample(logits[:, -1, :], temperature, top_k, key)
+        return next_tok, new_caches
+
+    prefill_j = jax.jit(step)
+    decode_j = jax.jit(step, donate_argnums=(3,))
+
+    key = jax.random.PRNGKey(seed)
+    key, k0 = jax.random.split(key)
+    tok, caches = prefill_j(p_arrays, b_arrays, ids, caches,
+                            jnp.int32(0), k0)
+    out_tokens = [tok]
+    pos = prompt_len
+    finished = jnp.zeros((b,), bool)
+    if eos_token_id is not None:
+        finished = finished | (tok == eos_token_id)
+    for _ in range(n_new - 1):
+        if eos_token_id is not None and bool(finished.all()):
+            break
+        key, kd = jax.random.split(key)
+        tok, caches = decode_j(p_arrays, b_arrays, tok[:, None], caches,
+                               jnp.int32(pos), kd)
+        if eos_token_id is not None:
+            tok = jnp.where(finished, eos_token_id, tok)
+            finished = finished | (tok == eos_token_id)
+        out_tokens.append(tok)
+        pos += 1
+    gen = jnp.stack(out_tokens, axis=1)
+    return Tensor(jnp.concatenate([ids, gen], axis=1))

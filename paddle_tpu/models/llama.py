@@ -126,7 +126,14 @@ class LlamaAttention(nn.Layer):
             self.o_proj = nn.Linear(q_out, cfg.hidden_size, bias_attr=False)
         self.rope_theta = cfg.rope_theta
 
-    def forward(self, x, rope_cos=None, rope_sin=None):
+    def forward(self, x, rope_cos=None, rope_sin=None, past_kv=None,
+                pos=None):
+        """past_kv: optional (k_cache, v_cache) Tensors of fixed shape
+        [b, max_len, kv_heads, head_dim]; pos: scalar Tensor — number of
+        tokens already cached. With a cache, returns (out, new_kv) and
+        attends this chunk's queries over cache[:pos]+chunk (the decode
+        path; shapes stay static so ONE compiled program serves every
+        step)."""
         b, s = x.shape[0], x.shape[1]
         q = self.q_proj(x).reshape([b, s, self.num_heads, self.head_dim])
         k = self.k_proj(x).reshape([b, s, self.num_kv_heads, self.head_dim])
@@ -140,21 +147,60 @@ class LlamaAttention(nn.Layer):
             k = _constrain(k, mesh, head_spec)
             v = _constrain(v, mesh, head_spec)
 
-        # rotary embedding (fused-rope parity) applied inside one taped op
-        def rope_fn(qa, ka):
-            cos, sin = build_rope_cache(s, self.head_dim, self.rope_theta,
-                                        jnp.float32)
-            qo = rope_reference(qa, cos.astype(qa.dtype), sin.astype(qa.dtype))
-            ko = rope_reference(ka, cos.astype(ka.dtype), sin.astype(ka.dtype))
-            return qo, ko
-        q, k = apply("fused_rope", rope_fn, q, k)
+        # rotary embedding (fused-rope parity) applied inside one taped
+        # op; with a cache the table is sliced at the running offset
+        if past_kv is None:
+            def rope_fn(qa, ka):
+                cos, sin = build_rope_cache(s, self.head_dim,
+                                            self.rope_theta, jnp.float32)
+                qo = rope_reference(qa, cos.astype(qa.dtype),
+                                    sin.astype(qa.dtype))
+                ko = rope_reference(ka, cos.astype(ka.dtype),
+                                    sin.astype(ka.dtype))
+                return qo, ko
+            q, k = apply("fused_rope", rope_fn, q, k)
+            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            out = out.reshape([b, s, self.num_heads * self.head_dim])
+            if self._tp:
+                from ..distributed.fleet.mpu import _constrain, _get_mesh
+                out = _constrain(out, _get_mesh(), [None, None, "mp"])
+            return self.o_proj(out)
 
-        out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
-        out = out.reshape([b, s, self.num_heads * self.head_dim])
-        if self._tp:
-            from ..distributed.fleet.mpu import _constrain, _get_mesh
-            out = _constrain(out, _get_mesh(), [None, None, "mp"])
-        return self.o_proj(out)
+        past_k, past_v = past_kv
+        max_len = past_k.shape[1]
+
+        def cached_attn(qa, ka, va, pk, pv, p):
+            import jax
+            cos_f, sin_f = build_rope_cache(max_len, self.head_dim,
+                                            self.rope_theta, jnp.float32)
+            # cache layout [1, max_len, 1, d] → slice the seq axis
+            cos = jax.lax.dynamic_slice_in_dim(cos_f, p, s, axis=1)
+            sin = jax.lax.dynamic_slice_in_dim(sin_f, p, s, axis=1)
+            qa = rope_reference(qa, cos.astype(qa.dtype),
+                                sin.astype(qa.dtype))
+            ka = rope_reference(ka, cos.astype(ka.dtype),
+                                sin.astype(ka.dtype))
+            nk = jax.lax.dynamic_update_slice_in_dim(pk, ka, p, axis=1)
+            nv = jax.lax.dynamic_update_slice_in_dim(pv, va, p, axis=1)
+            # GQA attention of the s new queries over nk[:, :p+s]
+            group = self.num_heads // self.num_kv_heads
+            qg = qa.reshape(b, s, self.num_kv_heads, group, self.head_dim)
+            scores = jnp.einsum("bqkgd,bskd->bkgqs",
+                                qg.astype(jnp.float32),
+                                nk.astype(jnp.float32))
+            scores = scores / jnp.sqrt(float(self.head_dim))
+            kpos = jnp.arange(max_len)[None, None, None, None, :]
+            qpos = p + jnp.arange(s)[None, None, None, :, None]
+            scores = jnp.where(kpos <= qpos, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1)
+            og = jnp.einsum("bkgqs,bskd->bqkgd", probs,
+                            nv.astype(jnp.float32))
+            o = og.reshape(b, s, self.num_heads * self.head_dim)
+            return o.astype(qa.dtype), nk, nv
+
+        out, new_k, new_v = apply("cached_attention", cached_attn,
+                                  q, k, v, past_k, past_v, pos)
+        return self.o_proj(out), (new_k, new_v)
 
 
 class LlamaDecoderLayer(nn.Layer):
@@ -173,7 +219,12 @@ class LlamaDecoderLayer(nn.Layer):
         h = x + self.self_attn(self.input_layernorm(x))
         return h + self.mlp(self.post_attention_layernorm(h))
 
-    def forward(self, x):
+    def forward(self, x, past_kv=None, pos=None):
+        if past_kv is not None:
+            attn, new_kv = self.self_attn(self.input_layernorm(x),
+                                          past_kv=past_kv, pos=pos)
+            h = x + attn
+            return h + self.mlp(self.post_attention_layernorm(h)), new_kv
         if self.use_recompute:
             from ..distributed.fleet import recompute
             return recompute(_LayerFn(self), x)
@@ -208,10 +259,16 @@ class LlamaModel(nn.Layer):
         self.norm = nn.RMSNorm(cfg.hidden_size, cfg.rms_norm_eps,
                                dtype=cfg.dtype)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None, pos=None):
         h = self.embed_tokens(input_ids)
         if self.cfg.dtype != "float32":
             h = h.astype(self.cfg.dtype)
+        if caches is not None:
+            new_caches = []
+            for layer, kv in zip(self.layers, caches):
+                h, nkv = layer(h, past_kv=kv, pos=pos)
+                new_caches.append(nkv)
+            return self.norm(h), new_caches
         for layer in self.layers:
             h = layer(h)
         return self.norm(h)
@@ -233,14 +290,19 @@ class LlamaForCausalLM(nn.Layer):
             self.lm_head = nn.Linear(cfg.hidden_size, cfg.vocab_size,
                                      bias_attr=False)
 
-    def forward(self, input_ids):
-        h = self.model(input_ids)
+    def forward(self, input_ids, caches=None, pos=None):
+        if caches is not None:
+            h, new_caches = self.model(input_ids, caches=caches, pos=pos)
+        else:
+            h = self.model(input_ids)
         if self.lm_head is None:
             from ..tensor.linalg import matmul
             logits = matmul(h, self.model.embed_tokens.weight,
                             transpose_y=True)
         else:
             logits = self.lm_head(h)
+        if caches is not None:
+            return logits, new_caches
         return logits
 
     def loss(self, logits, labels):
@@ -253,6 +315,22 @@ class LlamaForCausalLM(nn.Layer):
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 max_length: Optional[int] = None,
+                 temperature: float = 0.0, top_k: int = 0,
+                 eos_token_id: Optional[int] = None, seed: int = 0):
+        """KV-cached autoregressive generation (the serving decode loop —
+        reference analog: the generation path over
+        block_multihead_attention). Prefill compiles once, the
+        single-token decode step compiles once (static cache shapes,
+        traced position), then every step is a fast replay.
+        """
+        from .generation import generate as _generate
+        return _generate(self, input_ids, max_new_tokens=max_new_tokens,
+                         max_length=max_length, temperature=temperature,
+                         top_k=top_k, eos_token_id=eos_token_id,
+                         seed=seed)
 
 
 def llama_tiny(**kw) -> LlamaConfig:

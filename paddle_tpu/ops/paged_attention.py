@@ -1,0 +1,142 @@
+"""Paged (block) KV-cache attention — the serving decode path.
+
+Reference: block_multi_head_attention
+(/root/reference/paddle/phi/kernels/fusion/gpu/block_multi_head_attention
+kernel + python/paddle/incubate/nn/functional/block_multihead_attention.py):
+the KV cache lives in fixed-size blocks; a per-sequence block table maps
+logical positions to physical blocks, so sequences grow without
+reallocation and memory fragments are reclaimed per-block (vLLM-style).
+
+TPU-native: the decode gather is expressed as one jnp.take over the
+block axis followed by a flash-style softmax over the gathered window —
+XLA lowers the gather efficiently and fuses the rest; everything is
+fixed-shape (max_blocks per sequence) so one compiled program serves all
+lengths, with masking by context length.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["PagedKVCache", "paged_attention_decode", "reshape_and_cache"]
+
+
+def reshape_and_cache(k, v, k_cache, v_cache, slot_mapping):
+    """Scatter this step's K/V ([batch, kv_heads, head_dim]) into the
+    block pool at flat slot ids (block_id * block_size + offset).
+    Returns updated caches. Cache layout: [num_blocks, block_size,
+    kv_heads, head_dim]."""
+    nb, bs, h, d = k_cache.shape
+    flat_k = k_cache.reshape(nb * bs, h, d)
+    flat_v = v_cache.reshape(nb * bs, h, d)
+    flat_k = flat_k.at[slot_mapping].set(k)
+    flat_v = flat_v.at[slot_mapping].set(v)
+    return flat_k.reshape(nb, bs, h, d), flat_v.reshape(nb, bs, h, d)
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables, context_lens,
+                           scale: Optional[float] = None):
+    """One-token decode attention over the paged cache.
+
+    q:            [batch, num_heads, head_dim]  (this step's query)
+    k_cache/v_cache: [num_blocks, block_size, kv_heads, head_dim]
+    block_tables: [batch, max_blocks] int32 physical block ids
+    context_lens: [batch] int32 — valid tokens per sequence (incl. this)
+    Returns [batch, num_heads, head_dim].
+    """
+    b, nh, d = q.shape
+    nb, bs, kvh, _ = k_cache.shape
+    max_blocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    group = nh // kvh  # GQA: queries per kv head
+
+    # gather each sequence's blocks: [b, max_blocks, bs, kvh, d]
+    k = jnp.take(k_cache, block_tables, axis=0)
+    v = jnp.take(v_cache, block_tables, axis=0)
+    k = k.reshape(b, max_blocks * bs, kvh, d)
+    v = v.reshape(b, max_blocks * bs, kvh, d)
+
+    qg = q.reshape(b, kvh, group, d)
+    # scores: [b, kvh, group, S]
+    scores = jnp.einsum("bkgd,bskd->bkgs", qg.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_blocks * bs)[None, None, None, :]
+    mask = pos < context_lens[:, None, None, None]
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bkgs,bskd->bkgd", probs, v.astype(jnp.float32))
+    return out.reshape(b, nh, d).astype(q.dtype)
+
+
+class PagedKVCache:
+    """Host-side block allocator + device block pool (the cache manager
+    half of the reference's block_multihead_attention serving path).
+
+    One instance per layer set: caches are stacked [num_layers, ...] so a
+    decode step updates all layers functionally.
+    """
+
+    def __init__(self, num_layers: int, num_blocks: int, block_size: int,
+                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+        self.num_layers = num_layers
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self.k = jnp.zeros((num_layers, num_blocks, block_size, kv_heads,
+                            head_dim), dtype)
+        self.v = jnp.zeros_like(self.k)
+        self._free = list(range(num_blocks - 1, -1, -1))
+        self._tables: dict = {}   # seq_id → [block ids]
+        self._lens: dict = {}     # seq_id → context length
+
+    # -- allocation ---------------------------------------------------------
+    def allocate(self, seq_id: int, num_tokens: int):
+        """Reserve blocks for a sequence of num_tokens (prefill)."""
+        needed = -(-num_tokens // self.block_size)
+        if len(self._free) < needed:
+            raise RuntimeError(
+                f"KV cache exhausted: need {needed} blocks, "
+                f"{len(self._free)} free")
+        self._tables[seq_id] = [self._free.pop() for _ in range(needed)]
+        self._lens[seq_id] = 0
+        return self._tables[seq_id]
+
+    def extend(self, seq_id: int):
+        """Ensure room for one more token; returns the flat slot id."""
+        pos = self._lens[seq_id]
+        blocks = self._tables[seq_id]
+        if pos >= len(blocks) * self.block_size:
+            if not self._free:
+                raise RuntimeError("KV cache exhausted on extend")
+            blocks.append(self._free.pop())
+        self._lens[seq_id] = pos + 1
+        block = blocks[pos // self.block_size]
+        return block * self.block_size + pos % self.block_size
+
+    def free(self, seq_id: int):
+        self._free.extend(reversed(self._tables.pop(seq_id, [])))
+        self._lens.pop(seq_id, None)
+
+    def context_len(self, seq_id: int) -> int:
+        return self._lens.get(seq_id, 0)
+
+    def block_table(self, seq_id: int, max_blocks: int) -> np.ndarray:
+        t = self._tables[seq_id]
+        out = np.zeros(max_blocks, np.int32)
+        out[:len(t)] = t
+        return out
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    # -- device updates -----------------------------------------------------
+    def write(self, layer: int, k, v, slot_mapping):
+        """Write one step's K/V for `layer` at the given flat slots."""
+        nk, nv = reshape_and_cache(k, v, self.k[layer], self.v[layer],
+                                   slot_mapping)
+        self.k = self.k.at[layer].set(nk)
+        self.v = self.v.at[layer].set(nv)

@@ -99,12 +99,26 @@ class _LoadedPredictor:
         self.feed_dtypes = meta["feed_dtypes"]
         self._exported = jax.export.deserialize(meta["stablehlo"])
         z = np.load(path_prefix + ".pdiparams.npz")
-        self._params = [jnp.asarray(z[f"p{i}"]) for i in range(len(z.files))]
+        stored = [jnp.asarray(z[f"p{i}"]) for i in range(len(z.files))]
+        # two artifact layouts share the extension: static.io exports
+        # fn(feeds, params); jit.save exports fn(feeds, params, buffers)
+        # with n_params marking the split
+        if meta.get("kind") == "jit.save":
+            n_p = meta["n_params"]
+            self._params = stored[:n_p]
+            self._buffers: Optional[List] = stored[n_p:]
+        else:
+            self._params = stored
+            self._buffers = None
 
     def run(self, feeds: Sequence) -> List[np.ndarray]:
         feed_arrays = [jnp.asarray(x._value if isinstance(x, Tensor) else x)
                        for x in feeds]
-        out = self._exported.call(feed_arrays, self._params)
+        if self._buffers is not None:
+            out = self._exported.call(feed_arrays, self._params,
+                                      self._buffers)
+        else:
+            out = self._exported.call(feed_arrays, self._params)
         return [np.asarray(o) for o in out]
 
     def __call__(self, *feeds):
