@@ -1,0 +1,196 @@
+"""amp.debugging — numerics sanitizer (nan/inf detection + op stats).
+
+Reference: /root/reference/python/paddle/amp/debugging.py
+(TensorCheckerConfig :157, enable_tensor_checker/disable_tensor_checker,
+check_numerics, collect_operator_stats :459) backed by the C++ per-op
+nan/inf scan (/root/reference/paddle/fluid/eager/nan_inf_utils.h,
+FLAGS_check_nan_inf). TPU-native: the checker hooks the same op
+dispatcher AMP uses — each checked op's outputs get a jnp isfinite
+reduction (fused by XLA; one scalar readback only when debug_mode
+demands a host-side raise).
+"""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["DebugMode", "TensorCheckerConfig", "enable_tensor_checker",
+           "disable_tensor_checker", "check_numerics",
+           "enable_operator_stats_collection",
+           "disable_operator_stats_collection", "collect_operator_stats"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    """Parity with reference TensorCheckerConfig (amp/debugging.py:157):
+    enable + debug_mode + op/dtype filters."""
+
+    def __init__(self, enable: bool = True,
+                 debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir: Optional[str] = None,
+                 checked_op_list: Optional[List[str]] = None,
+                 skipped_op_list: Optional[List[str]] = None,
+                 debug_step: Optional[tuple] = None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = set(checked_op_list or [])
+        self.skipped_op_list = set(skipped_op_list or [])
+        self.debug_step = debug_step
+        self._step = 0
+
+    def _should_check(self, op_name: str) -> bool:
+        if not self.enable:
+            return False
+        if self.debug_step is not None:
+            lo, hi = self.debug_step
+            if not (lo <= self._step < hi):
+                return False
+        if self.checked_op_list and op_name not in self.checked_op_list:
+            return False
+        if op_name in self.skipped_op_list:
+            return False
+        return True
+
+
+_checker: Optional[TensorCheckerConfig] = None
+_found: List[Dict] = []
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    """Install the per-op nan/inf hook (reference
+    enable_tensor_checker)."""
+    global _checker
+    _checker = config
+    from ..framework import core as fcore
+    fcore._set_check_hook(_check_outputs)
+
+
+def disable_tensor_checker():
+    global _checker
+    _checker = None
+    from ..framework import core as fcore
+    fcore._set_check_hook(None)
+
+
+def _check_outputs(op_name: str, arrays):
+    """Called by the dispatcher with each op's output arrays (eager
+    path). Returns nothing; raises or records per debug_mode."""
+    cfg = _checker
+    if cfg is None or not cfg._should_check(op_name):
+        return
+    for i, a in enumerate(arrays):
+        if not isinstance(a, jax.Array) or isinstance(a, jax.core.Tracer):
+            continue  # traced values are checked by the jitted variant
+        if not jnp.issubdtype(a.dtype, jnp.floating):
+            continue
+        finite = bool(jnp.isfinite(a).all())
+        if finite:
+            continue
+        arr = np.asarray(a)
+        info = {
+            "op": op_name, "output_index": i,
+            "num_nan": int(np.isnan(arr).sum()),
+            "num_inf": int(np.isinf(arr).sum()),
+            "shape": tuple(arr.shape), "dtype": str(arr.dtype),
+        }
+        _found.append(info)
+        if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(
+                f"nan/inf detected in output {i} of op {op_name!r}: "
+                f"{info['num_nan']} NaN, {info['num_inf']} Inf "
+                f"(shape {info['shape']}, dtype {info['dtype']})")
+
+
+def found_issues() -> List[Dict]:
+    """Recorded non-abort findings (CHECK_NAN_INF mode)."""
+    return list(_found)
+
+
+def check_numerics(tensor, op_type: str = "", var_name: str = "",
+                   debug_mode: DebugMode = DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """One-shot check (reference paddle.amp.debugging.check_numerics).
+    Returns (num_nan, num_inf, num_zero) Tensors like the reference."""
+    from ..framework.core import Tensor, apply_nodiff
+
+    def f(a):
+        af = a.astype(jnp.float32)
+        return (jnp.isnan(af).sum(), jnp.isinf(af).sum(),
+                (af == 0).sum())
+    nan_ct, inf_ct, zero_ct = apply_nodiff("check_numerics", f, tensor)
+    if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+        n_nan, n_inf = int(nan_ct.numpy()), int(inf_ct.numpy())
+        if n_nan or n_inf:
+            raise FloatingPointError(
+                f"check_numerics({op_type or 'tensor'} {var_name}): "
+                f"{n_nan} NaN, {n_inf} Inf")
+    return nan_ct, inf_ct, zero_ct
+
+
+# ---------------------------------------------------------------------------
+# operator stats collection (reference collect_operator_stats :459)
+# ---------------------------------------------------------------------------
+
+_op_stats: Optional[Dict[str, Dict[str, int]]] = None
+
+
+def enable_operator_stats_collection():
+    """Count op calls per dtype (reference op-stats table)."""
+    global _op_stats
+    _op_stats = {}
+    from ..framework import core as fcore
+    fcore._set_stats_hook(_record_stats)
+
+
+def disable_operator_stats_collection():
+    global _op_stats
+    from ..framework import core as fcore
+    fcore._set_stats_hook(None)
+    stats = _op_stats
+    _op_stats = None
+    if stats:
+        print(_format_stats(stats))
+    return stats
+
+
+def _record_stats(op_name: str, arrays):
+    if _op_stats is None:
+        return
+    row = _op_stats.setdefault(op_name, {})
+    for a in arrays:
+        d = str(getattr(a, "dtype", "other"))
+        row[d] = row.get(d, 0) + 1
+
+
+def _format_stats(stats) -> str:
+    dtypes = ["float32", "bfloat16", "float16", "other"]
+    header = f"{'Op':<28}" + "".join(f"{d:>12}" for d in dtypes)
+    lines = ["<------------- op list of amp running ------------->",
+             header, "-" * len(header)]
+    for op, row in sorted(stats.items()):
+        counts = []
+        for d in dtypes:
+            c = row.get(d, 0) if d != "other" else sum(
+                v for k, v in row.items() if k not in dtypes)
+            counts.append(c)
+        lines.append(f"{op:<28}" + "".join(f"{c:>12}" for c in counts))
+    return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()

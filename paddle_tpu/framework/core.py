@@ -565,6 +565,23 @@ def _set_static_handler(fn):
     _static_handler = fn
 
 
+# Numerics-checker + op-stats hooks (installed by paddle_tpu.amp.debugging
+# — the FLAGS_check_nan_inf / op-stats analog of the reference's
+# paddle/fluid/eager/nan_inf_utils.h). Both receive (op_name, out_arrays).
+_check_hook: Optional[Callable] = None
+_stats_hook: Optional[Callable] = None
+
+
+def _set_check_hook(fn):
+    global _check_hook
+    _check_hook = fn
+
+
+def _set_stats_hook(fn):
+    global _stats_hook
+    _stats_hook = fn
+
+
 def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
     """Run ``fn`` over the unwrapped jax arrays of ``args``, recording a
     TapeNode when gradients are required. ``fn`` must be pure; non-Tensor
@@ -604,6 +621,11 @@ def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
     multi = isinstance(outs, (tuple, list))
     outs_list = list(outs) if multi else [outs]
 
+    if _check_hook is not None:
+        _check_hook(op_name, outs_list)
+    if _stats_hook is not None:
+        _stats_hook(op_name, outs_list)
+
     result = [Tensor(o, stop_gradient=not need_grad) for o in outs_list]
 
     if need_grad:
@@ -638,6 +660,10 @@ def apply_nodiff(op_name: str, fn: Callable, *args, **kwargs):
     outs = fn(*full, **kwargs)
     multi = isinstance(outs, (tuple, list))
     outs_list = list(outs) if multi else [outs]
+    if _check_hook is not None:
+        _check_hook(op_name, outs_list)
+    if _stats_hook is not None:
+        _stats_hook(op_name, outs_list)
     result = [Tensor(o, stop_gradient=True) for o in outs_list]
     return tuple(result) if multi else result[0]
 

@@ -16,10 +16,10 @@ __all__ = [
 ]
 
 
-def _cmp(name, fn):
-    def op(x, y, name=None):
-        return apply_nodiff(name, fn, x, y)
-    op.__name__ = name
+def _cmp(op_name, fn):
+    def op(x, y, name=None):  # `name` = paddle output-name arg
+        return apply_nodiff(op_name, fn, x, y)
+    op.__name__ = op_name
     return op
 
 

@@ -27,10 +27,10 @@ __all__ = [
 ]
 
 
-def _ew(name, fn):
-    def op(x, y, name=None):
-        return apply(name, fn, x, y)
-    op.__name__ = name
+def _ew(op_name, fn):
+    def op(x, y, name=None):  # `name` is the paddle API's output-name
+        return apply(op_name, fn, x, y)  # arg — NOT the op identifier
+    op.__name__ = op_name
     return op
 
 
@@ -62,13 +62,18 @@ def pow(x, y, name=None):
 
 
 def float_power(x, y, name=None):
-    return apply("float_power", lambda a, b: jnp.power(a.astype(jnp.float64) if False else a.astype(jnp.float32), b), x, y)
+    # paddle promises float64 math; x64 must be enabled in jax or the
+    # cast silently narrows, so promote as far as the backend allows
+    def f(a, b):
+        target = jnp.float64 if jax.config.jax_enable_x64 else jnp.float32
+        return jnp.power(a.astype(target), b)
+    return apply("float_power", f, x, y)
 
 
-def _uw(name, fn):
-    def op(x, name=None):
-        return apply(name, fn, x)
-    op.__name__ = name
+def _uw(op_name, fn):
+    def op(x, name=None):  # `name` = paddle output-name arg
+        return apply(op_name, fn, x)
+    op.__name__ = op_name
     return op
 
 

@@ -1,0 +1,179 @@
+"""Custom C++ operator extension — XLA FFI custom calls.
+
+Reference: paddle.utils.cpp_extension + the C++ custom-op registry
+(/root/reference/paddle/fluid/framework/custom_operator.cc,
+paddle/phi/capi/ — PD_BUILD_OP macros compiled out-of-tree and loaded at
+runtime). TPU-native split (SURVEY.md §2.5 item 22):
+
+- **Host/C++ ops**: compiled against XLA's FFI headers
+  (jax.ffi.include_dir()) into a shared library; handlers register as
+  XLA custom-call targets on the host platform. This is the analog of
+  the reference's custom CPU kernels.
+- **Device (TPU) ops**: written as Pallas kernels in Python (see
+  paddle_tpu/ops/pallas) — the TPU has no user C++ path in any
+  framework; the reference's CUDA custom ops map to Pallas here.
+- **Pure-Python ops with custom gradients**: ``register_custom_op``
+  wraps forward/backward into a jax.custom_vjp dispatched through the
+  framework tape (the PD_BUILD_OP + grad-op analog without C++).
+
+Typical C++ handler (compiled by ``load``):
+
+    #include "xla/ffi/api/ffi.h"
+    namespace ffi = xla::ffi;
+    static ffi::Error AxpyImpl(float a, ffi::Buffer<ffi::F32> x,
+                               ffi::Buffer<ffi::F32> y,
+                               ffi::ResultBuffer<ffi::F32> out) { ... }
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(Axpy, AxpyImpl,
+        ffi::Ffi::Bind().Attr<float>("a").Arg<ffi::Buffer<ffi::F32>>()
+            .Arg<ffi::Buffer<ffi::F32>>().Ret<ffi::Buffer<ffi::F32>>());
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["load", "CustomOpModule", "register_custom_op", "get_build_dir"]
+
+_BUILD_DIR = os.environ.get(
+    "PADDLE_TPU_EXTENSION_DIR",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu_extensions"))
+_lock = threading.Lock()
+
+
+def get_build_dir() -> str:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    return _BUILD_DIR
+
+
+def _compile(name: str, sources: Sequence[str],
+             extra_cxx_flags: Sequence[str] = (),
+             extra_include_paths: Sequence[str] = (),
+             verbose: bool = False) -> str:
+    out = os.path.join(get_build_dir(), f"{name}.so")
+    if os.path.exists(out) and all(
+            os.path.getmtime(s) <= os.path.getmtime(out) for s in sources):
+        return out
+    cmd = ["g++", "-O2", "-std=c++17", "-fPIC", "-shared",
+           f"-I{jax.ffi.include_dir()}",
+           *[f"-I{p}" for p in extra_include_paths],
+           *extra_cxx_flags, *sources, "-o", out]
+    if verbose:
+        print("[cpp_extension]", " ".join(cmd))
+    proc = subprocess.run(cmd, capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise RuntimeError(
+            f"custom op build failed:\n{proc.stderr[-3000:]}")
+    return out
+
+
+class CustomOpModule:
+    """Loaded extension: each registered handler becomes a callable that
+    issues the XLA custom call (host platform)."""
+
+    def __init__(self, name: str, so_path: str):
+        self.name = name
+        self.so_path = so_path
+        self._lib = ctypes.CDLL(so_path)
+        self._registered: Dict[str, str] = {}
+
+    def register(self, target_name: str, symbol: Optional[str] = None,
+                 platform: str = "cpu") -> "CustomOpModule":
+        """Register the exported handler `symbol` (default: target_name)
+        as custom-call target `target_name`."""
+        sym = symbol or target_name
+        fn = getattr(self._lib, sym)
+        capsule = jax.ffi.pycapsule(fn)
+        jax.ffi.register_ffi_target(target_name, capsule,
+                                    platform=platform)
+        self._registered[target_name] = platform
+        return self
+
+    def call(self, target_name: str, out_shape, out_dtype, *args,
+             **attrs):
+        """Invoke the custom call. args: Tensors/arrays; attrs become FFI
+        attributes. Works under jit (it's a real XLA custom call)."""
+        from ..framework.core import Tensor, apply
+        out_type = jax.ShapeDtypeStruct(tuple(out_shape), out_dtype)
+
+        def f(*arrays):
+            call = jax.ffi.ffi_call(target_name, out_type)
+            return call(*arrays, **attrs)
+
+        return apply(f"custom_call:{target_name}", f, *args)
+
+    def make_op(self, target_name: str, out_shape_fn: Callable,
+                out_dtype_fn: Optional[Callable] = None, **fixed_attrs):
+        """Bind a python-callable op: shapes inferred per-call via
+        out_shape_fn(*input_shapes) (the InferMeta analog for custom
+        ops)."""
+        def op(*args, **attrs):
+            shapes = [tuple(a.shape) for a in args]
+            out_shape = out_shape_fn(*shapes)
+            dt = out_dtype_fn(*args) if out_dtype_fn else args[0].dtype
+            merged = dict(fixed_attrs)
+            merged.update(attrs)
+            return self.call(target_name, out_shape, dt, *args, **merged)
+        op.__name__ = target_name
+        return op
+
+
+def load(name: str, sources: Sequence[str],
+         extra_cxx_flags: Sequence[str] = (),
+         extra_include_paths: Sequence[str] = (),
+         verbose: bool = False) -> CustomOpModule:
+    """Compile + load a custom-op extension (reference
+    cpp_extension.load parity). Returns a CustomOpModule; call
+    .register(target) for each exported handler."""
+    with _lock:
+        so = _compile(name, list(sources), extra_cxx_flags,
+                      extra_include_paths, verbose)
+    return CustomOpModule(name, so)
+
+
+# ---------------------------------------------------------------------------
+# Pure-Python custom op with custom gradient (PD_BUILD_OP analog)
+# ---------------------------------------------------------------------------
+
+_custom_ops: Dict[str, Callable] = {}
+
+
+def register_custom_op(name: str, forward: Callable,
+                       backward: Optional[Callable] = None) -> Callable:
+    """Register op `name` with array-level forward(*arrays) and optional
+    backward(residuals, *cotangents) -> input cotangents. The returned
+    callable dispatches through the autograd tape; under jit it traces
+    like any framework op.
+
+    When a backward is given, forward MUST return (primal, residuals) —
+    the PD_BUILD_OP forward/grad contract.
+    """
+    from ..framework.core import apply
+
+    if backward is None:
+        fn = forward
+    else:
+        @jax.custom_vjp
+        def fn(*arrays):
+            return forward(*arrays)[0]
+
+        def fwd(*arrays):
+            return forward(*arrays)  # (primal, residuals)
+
+        def bwd(res, ct):
+            grads = backward(res, ct)
+            return grads if isinstance(grads, tuple) else (grads,)
+
+        fn.defvjp(fwd, bwd)
+
+    def op(*args, **kwargs):
+        return apply(name, lambda *a: fn(*a), *args, **kwargs)
+
+    op.__name__ = name
+    _custom_ops[name] = op
+    return op

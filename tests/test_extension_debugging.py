@@ -1,0 +1,151 @@
+"""Custom-op extension (C++ XLA FFI + python custom_vjp) and amp
+numerics-debugging tests (reference: custom-op tests in
+test/custom_op/, TensorCheckerConfig tests in test/amp/)."""
+import os
+import textwrap
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.amp import debugging as dbg
+from paddle_tpu.utils import cpp_extension
+
+
+def n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+AXPY_CC = textwrap.dedent("""
+    #include <cstdint>
+    #include "xla/ffi/api/ffi.h"
+
+    namespace ffi = xla::ffi;
+
+    static ffi::Error AxpyImpl(float a, ffi::Buffer<ffi::F32> x,
+                               ffi::Buffer<ffi::F32> y,
+                               ffi::ResultBuffer<ffi::F32> out) {
+      size_t size = x.element_count();
+      for (size_t i = 0; i < size; ++i) {
+        out->typed_data()[i] = a * x.typed_data()[i] + y.typed_data()[i];
+      }
+      return ffi::Error::Success();
+    }
+
+    XLA_FFI_DEFINE_HANDLER_SYMBOL(
+        Axpy, AxpyImpl,
+        ffi::Ffi::Bind()
+            .Attr<float>("a")
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Arg<ffi::Buffer<ffi::F32>>()
+            .Ret<ffi::Buffer<ffi::F32>>());
+""")
+
+
+class TestCppExtension:
+    @pytest.fixture(scope="class")
+    def axpy_module(self, tmp_path_factory):
+        src = tmp_path_factory.mktemp("ext") / "axpy.cc"
+        src.write_text(AXPY_CC)
+        mod = cpp_extension.load("test_axpy", [str(src)])
+        mod.register("Axpy", platform="cpu")
+        return mod
+
+    def test_ffi_custom_call(self, axpy_module):
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+        y = paddle.to_tensor(np.ones(6, np.float32))
+        out = axpy_module.call("Axpy", (6,), np.float32, x, y,
+                               a=np.float32(2.0))
+        np.testing.assert_allclose(n(out), 2.0 * n(x) + 1.0)
+
+    def test_make_op_infer_shape(self, axpy_module):
+        axpy = axpy_module.make_op("Axpy", lambda sx, sy: sx,
+                                   a=np.float32(3.0))
+        x = paddle.to_tensor(np.ones((2, 3), np.float32))
+        y = paddle.to_tensor(np.zeros((2, 3), np.float32))
+        np.testing.assert_allclose(n(axpy(x, y)), 3.0)
+
+    def test_build_error_surfaces(self, tmp_path):
+        bad = tmp_path / "bad.cc"
+        bad.write_text("this is not C++")
+        with pytest.raises(RuntimeError, match="build failed"):
+            cpp_extension.load("test_bad", [str(bad)])
+
+
+class TestPythonCustomOp:
+    def test_custom_vjp_matches_analytic(self):
+        import jax.numpy as jnp
+        calls = {"bwd": 0}
+
+        def fwd(x):
+            return x ** 3, (x,)
+
+        def bwd(res, ct):
+            calls["bwd"] += 1
+            (x,) = res
+            return (2.0 * ct,)  # deliberately NOT 3x^2: prove custom grad
+
+        cube = cpp_extension.register_custom_op("my_cube", fwd, bwd)
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        out = cube(x)
+        np.testing.assert_allclose(n(out), [8.0])
+        out.backward()
+        np.testing.assert_allclose(n(x.grad), [2.0])  # custom grad used
+        assert calls["bwd"] == 1
+
+    def test_forward_only_op(self):
+        import jax.numpy as jnp
+        clip01 = cpp_extension.register_custom_op(
+            "clip01", lambda a: jnp.clip(a, 0.0, 1.0))
+        x = paddle.to_tensor(np.array([-1.0, 0.5, 2.0], np.float32))
+        np.testing.assert_allclose(n(clip01(x)), [0.0, 0.5, 1.0])
+
+
+class TestTensorChecker:
+    def teardown_method(self):
+        dbg.disable_tensor_checker()
+
+    def test_abort_on_nan(self):
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            debug_mode=dbg.DebugMode.CHECK_NAN_INF_AND_ABORT))
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        with pytest.raises(FloatingPointError, match="nan/inf"):
+            _ = x / x  # 0/0 → nan
+        dbg.disable_tensor_checker()
+        _ = x / x  # no raise once disabled
+
+    def test_record_mode_collects(self):
+        dbg._found.clear()
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            debug_mode=dbg.DebugMode.CHECK_NAN_INF))
+        x = paddle.to_tensor(np.array([1.0, 0.0], np.float32))
+        _ = x / x
+        issues = dbg.found_issues()
+        assert issues and issues[0]["num_nan"] >= 1
+
+    def test_skipped_op_list(self):
+        dbg.enable_tensor_checker(dbg.TensorCheckerConfig(
+            skipped_op_list=["divide"]))
+        x = paddle.to_tensor(np.array([0.0], np.float32))
+        _ = x / x  # skipped → no raise
+
+    def test_check_numerics_api(self):
+        t = paddle.to_tensor(np.array([1.0, np.inf, 0.0], np.float32))
+        with pytest.raises(FloatingPointError):
+            dbg.check_numerics(t, "op", "t")
+        nan_ct, inf_ct, zero_ct = dbg.check_numerics(
+            t, debug_mode=dbg.DebugMode.CHECK_NAN_INF)
+        assert int(n(inf_ct)) == 1 and int(n(zero_ct)) == 1
+
+
+class TestOperatorStats:
+    def test_collects_per_dtype(self, capsys):
+        with dbg.collect_operator_stats():
+            a = paddle.ones([2, 2])
+            b = a + a
+            c = b.astype("bfloat16") * 2
+        out = capsys.readouterr().out
+        assert "op list of amp running" in out
+        assert "bfloat16" in out
