@@ -46,7 +46,10 @@ from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
-from .hapi import Model, summary  # noqa: F401
+from . import device  # noqa: F401
+from . import regularizer  # noqa: F401
+from .hapi import callbacks  # noqa: F401  — paddle.callbacks alias
+from .hapi import Model, summary, flops  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 
 from .jit import to_static  # noqa: F401

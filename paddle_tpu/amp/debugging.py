@@ -62,6 +62,11 @@ class TensorCheckerConfig:
             return False
         return True
 
+    def step(self):
+        """Advance the training-step counter that debug_step windows are
+        measured against. Called automatically by Optimizer.step()."""
+        self._step += 1
+
 
 _checker: Optional[TensorCheckerConfig] = None
 _found: List[Dict] = []
@@ -69,9 +74,11 @@ _found: List[Dict] = []
 
 def enable_tensor_checker(config: TensorCheckerConfig):
     """Install the per-op nan/inf hook (reference
-    enable_tensor_checker)."""
+    enable_tensor_checker). Starts a fresh findings list."""
     global _checker
     _checker = config
+    _found.clear()
+    _pending.clear()
     from ..framework import core as fcore
     fcore._set_check_hook(_check_outputs)
 
@@ -85,35 +92,53 @@ def disable_tensor_checker():
 
 def _check_outputs(op_name: str, arrays):
     """Called by the dispatcher with each op's output arrays (eager
-    path). Returns nothing; raises or records per debug_mode."""
+    path). ABORT mode blocks on a scalar readback per op (debugging is
+    the point there); record modes enqueue device-side flags and resolve
+    them lazily in found_issues(), preserving async dispatch."""
     cfg = _checker
     if cfg is None or not cfg._should_check(op_name):
         return
+    abort = cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
     for i, a in enumerate(arrays):
         if not isinstance(a, jax.Array) or isinstance(a, jax.core.Tracer):
             continue  # traced values are checked by the jitted variant
         if not jnp.issubdtype(a.dtype, jnp.floating):
             continue
-        finite = bool(jnp.isfinite(a).all())
-        if finite:
+        if not abort:
+            if len(_pending) < 10000:  # bounded: call found_issues()
+                _pending.append((op_name, i, jnp.isfinite(a).all(), a))
             continue
-        arr = np.asarray(a)
-        info = {
-            "op": op_name, "output_index": i,
-            "num_nan": int(np.isnan(arr).sum()),
-            "num_inf": int(np.isinf(arr).sum()),
-            "shape": tuple(arr.shape), "dtype": str(arr.dtype),
-        }
+        if bool(jnp.isfinite(a).all()):
+            continue
+        info = _describe(op_name, i, a)
         _found.append(info)
-        if cfg.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
-            raise FloatingPointError(
-                f"nan/inf detected in output {i} of op {op_name!r}: "
-                f"{info['num_nan']} NaN, {info['num_inf']} Inf "
-                f"(shape {info['shape']}, dtype {info['dtype']})")
+        raise FloatingPointError(
+            f"nan/inf detected in output {i} of op {op_name!r}: "
+            f"{info['num_nan']} NaN, {info['num_inf']} Inf "
+            f"(shape {info['shape']}, dtype {info['dtype']})")
+
+
+_pending: List[tuple] = []
+
+
+def _describe(op_name, i, a) -> Dict:
+    arr = np.asarray(a)
+    return {
+        "op": op_name, "output_index": i,
+        "num_nan": int(np.isnan(arr).sum()),
+        "num_inf": int(np.isinf(arr).sum()),
+        "shape": tuple(arr.shape), "dtype": str(arr.dtype),
+    }
 
 
 def found_issues() -> List[Dict]:
-    """Recorded non-abort findings (CHECK_NAN_INF mode)."""
+    """Findings so far; resolves the lazily-enqueued record-mode flags
+    (the only point record mode synchronizes with the device)."""
+    global _pending
+    pending, _pending = _pending, []
+    for op_name, i, flag, a in pending:
+        if not bool(flag):
+            _found.append(_describe(op_name, i, a))
     return list(_found)
 
 

@@ -1,0 +1,112 @@
+"""paddle.device namespace parity
+(/root/reference/python/paddle/device/__init__.py): device selection /
+introspection. Streams and events have no user-facing analog on TPU
+(XLA owns scheduling); the Stream/Event API is accepted as no-ops so
+ported code runs."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..framework.core import get_device, set_device  # noqa: F401
+
+__all__ = ["set_device", "get_device", "get_all_device_type",
+           "get_available_device", "get_available_custom_device",
+           "get_device_count", "is_compiled_with_cuda",
+           "is_compiled_with_xpu", "is_compiled_with_custom_device",
+           "synchronize", "Stream", "Event", "current_stream",
+           "device_count", "cuda"]
+
+
+def get_all_device_type() -> List[str]:
+    import jax
+    return sorted({d.platform for d in jax.devices()})
+
+
+def get_available_device() -> List[str]:
+    import jax
+    return [f"{d.platform}:{d.id}" for d in jax.devices()]
+
+
+def get_available_custom_device() -> List[str]:
+    return [d for d in get_available_device()
+            if not d.startswith(("cpu", "tpu", "gpu"))]
+
+
+def get_device_count() -> int:
+    import jax
+    return jax.device_count()
+
+
+device_count = get_device_count
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return device_type in get_all_device_type()
+
+
+def synchronize(device=None):
+    """Block until all queued device work completes (reference
+    paddle.device.synchronize). JAX arrays are futures — sync by
+    blocking on a trivial readiness barrier."""
+    import jax
+    (jax.device_put(0) + 0).block_until_ready()
+
+
+class Stream:
+    """Accepted for API compat; XLA schedules its own streams."""
+
+    def __init__(self, device=None, priority=2):
+        self.device = device
+
+    def synchronize(self):
+        synchronize()
+
+    def wait_event(self, event):
+        pass
+
+    def wait_stream(self, stream):
+        pass
+
+    def record_event(self, event=None):
+        return event or Event()
+
+
+class Event:
+    def __init__(self, device=None, enable_timing=False, blocking=False):
+        pass
+
+    def record(self, stream=None):
+        pass
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream(device)
+
+
+class _CudaNamespace:
+    """paddle.device.cuda shim: reports zero CUDA devices."""
+
+    @staticmethod
+    def device_count() -> int:
+        return 0
+
+    @staticmethod
+    def is_available() -> bool:
+        return False
+
+
+cuda = _CudaNamespace()

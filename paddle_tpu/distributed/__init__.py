@@ -29,6 +29,7 @@ from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .elastic import ElasticLevel, ElasticManager, ElasticStatus  # noqa: F401
 from .ring_attention import ring_attention, ring_attention_local  # noqa: F401

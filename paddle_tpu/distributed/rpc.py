@@ -1,0 +1,211 @@
+"""paddle.distributed.rpc parity
+(/root/reference/python/paddle/distributed/rpc/ — RpcAgent over brpc,
+rpc.py: init_rpc/rpc_sync/rpc_async/shutdown). TPU-native transport: the
+native TCP KV store carries pickled call/result envelopes (host-side
+control plane only — tensor traffic belongs to the in-program XLA
+collectives, same division as the reference).
+
+Each worker runs a serving thread that polls its inbox key; rpc_sync /
+rpc_async post to the callee's inbox and wait on a per-call result key.
+"""
+from __future__ import annotations
+
+import pickle
+import threading
+import time
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.native import TCPStore
+
+__all__ = ["init_rpc", "shutdown", "rpc_sync", "rpc_async",
+           "get_worker_info", "get_all_worker_infos", "WorkerInfo"]
+
+
+@dataclass
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str = "127.0.0.1"
+    port: int = 0
+
+
+class _Agent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        self._stop = threading.Event()
+        self._seq = 0
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        # register self; wait for peers
+        store.set(f"rpc/worker{rank}", pickle.dumps(
+            WorkerInfo(name, rank)))
+        store.add("rpc/registered", 1)
+        self._thread.start()
+
+    # -- serving ------------------------------------------------------------
+    def _serve(self):
+        inbox_ctr = f"rpc/inbox{self.rank}/n"
+        served = 0
+        while not self._stop.is_set():
+            try:
+                pending = self.store.add(inbox_ctr, 0)
+            except Exception:
+                return
+            if pending <= served:
+                time.sleep(0.005)
+                continue
+            for i in range(served, pending):
+                # the envelope is a 2-tuple (call_id, payload_bytes) so a
+                # payload that fails to unpickle (module only importable
+                # on the caller) still yields an id to report back on
+                blob = None
+                for _attempt in range(3):
+                    try:
+                        blob = self.store.get(
+                            f"rpc/inbox{self.rank}/{i}", timeout=10)
+                        break
+                    except Exception:
+                        continue
+                if blob is None:
+                    continue  # unreadable slot; caller hits its timeout
+                call_id = None
+                try:
+                    call_id, body = pickle.loads(blob)
+                    call = pickle.loads(body)
+                    result = call["fn"](*call["args"], **call["kwargs"])
+                    payload = pickle.dumps({"ok": True, "value": result})
+                except Exception as e:  # noqa: BLE001 — ship to caller
+                    payload = pickle.dumps({"ok": False, "error": repr(e)})
+                if call_id is not None:
+                    try:
+                        self.store.set(f"rpc/result/{call_id}", payload)
+                    except Exception:
+                        pass
+            served = pending
+
+    # -- calling ------------------------------------------------------------
+    def call(self, to: str, fn: Callable, args: tuple, kwargs: dict,
+             timeout: float):
+        target = None
+        for info in get_all_worker_infos():
+            if info.name == to:
+                target = info
+                break
+        if target is None:
+            raise ValueError(f"unknown rpc worker {to!r}")
+        call_id = f"{self.rank}-{uuid.uuid4().hex[:12]}"
+        body = pickle.dumps({"fn": fn, "args": args, "kwargs": kwargs})
+        blob = pickle.dumps((call_id, body))
+        idx = self.store.add(f"rpc/inbox{target.rank}/n", 1) - 1
+        self.store.set(f"rpc/inbox{target.rank}/{idx}", blob)
+        return call_id
+
+    def wait(self, call_id: str, timeout: float):
+        blob = self.store.get(f"rpc/result/{call_id}", timeout=timeout)
+        res = pickle.loads(blob)
+        if not res["ok"]:
+            raise RuntimeError(f"rpc call failed remotely: {res['error']}")
+        return res["value"]
+
+    def shutdown(self):
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+_agent: Optional[_Agent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Reference init_rpc parity: master_endpoint "ip:port" hosts the
+    store on rank 0."""
+    global _agent
+    import os
+    rank = rank if rank is not None else int(
+        os.environ.get("PADDLE_TRAINER_ID", "0"))
+    world_size = world_size if world_size is not None else int(
+        os.environ.get("PADDLE_TRAINERS_NUM", "1"))
+    ep = master_endpoint or os.environ.get("PADDLE_MASTER",
+                                           "127.0.0.1:8790")
+    host, port = ep.rsplit(":", 1)
+    store = TCPStore(host, int(port), is_master=(rank == 0),
+                     world_size=world_size)
+    _agent = _Agent(name, rank, world_size, store)
+    # barrier until all workers registered
+    deadline = time.time() + 60
+    while _agent.store.add("rpc/registered", 0) < world_size:
+        if time.time() > deadline:
+            raise TimeoutError("init_rpc: peers missing")
+        time.sleep(0.01)
+    return _agent
+
+
+def shutdown():
+    global _agent
+    if _agent is not None:
+        _agent.store.add("rpc/done", 1)
+        # drain until everyone is done so late callers don't hang
+        deadline = time.time() + 30
+        while _agent.store.add("rpc/done", 0) < _agent.world_size and \
+                time.time() < deadline:
+            time.sleep(0.01)
+        _agent.shutdown()
+        _agent = None
+
+
+class _Future:
+    def __init__(self, agent: _Agent, call_id: str, timeout: float):
+        self._agent = agent
+        self._id = call_id
+        self._timeout = timeout
+
+    def wait(self):
+        return self._agent.wait(self._id, self._timeout)
+
+
+def _require_agent() -> _Agent:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent
+
+
+def rpc_sync(to: str, fn: Callable, args: tuple = (), kwargs=None,
+             timeout: float = 180.0):
+    agent = _require_agent()
+    cid = agent.call(to, fn, args, kwargs or {}, timeout)
+    return agent.wait(cid, timeout)
+
+
+def rpc_async(to: str, fn: Callable, args: tuple = (), kwargs=None,
+              timeout: float = 180.0) -> _Future:
+    agent = _require_agent()
+    cid = agent.call(to, fn, args, kwargs or {}, timeout)
+    return _Future(agent, cid, timeout)
+
+
+def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
+    agent = _require_agent()
+    if name is None:
+        return WorkerInfo(agent.name, agent.rank)
+    for info in get_all_worker_infos():
+        if info.name == name:
+            return info
+    raise ValueError(f"unknown worker {name!r}")
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    agent = _require_agent()
+    out = []
+    for r in range(agent.world_size):
+        try:
+            out.append(pickle.loads(
+                agent.store.get(f"rpc/worker{r}", timeout=30)))
+        except Exception:
+            continue
+    return out

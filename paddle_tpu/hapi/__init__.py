@@ -7,6 +7,7 @@ from .callbacks import (  # noqa: F401
 )
 from .model import Model  # noqa: F401
 from .model_summary import summary  # noqa: F401
+from .flops import flops  # noqa: F401
 
 __all__ = ["Model", "summary", "callbacks", "Callback", "CallbackList",
            "ProgBarLogger", "ModelCheckpoint", "LRScheduler",

@@ -110,6 +110,10 @@ class Optimizer:
 
     # -- eager API -----------------------------------------------------------
     def step(self):
+        # advance the numerics-checker's debug_step window, if active
+        from ..amp import debugging as _dbg
+        if _dbg._checker is not None:
+            _dbg._checker.step()
         params = self._parameter_list
         raw_params = [p._value for p in params]
         raw_grads = [None if p.grad is None else p.grad._value for p in params]
@@ -163,10 +167,15 @@ class Optimizer:
 
 
 def _wd_grad(p, g, wd):
-    """Couple L2 weight decay into the gradient (paddle regularizer style)."""
-    if wd and g is not None:
-        return g + wd * p.astype(g.dtype)
-    return g
+    """Couple weight decay into the gradient (paddle regularizer style).
+    wd may be a float coefficient or a paddle.regularizer L1Decay/L2Decay
+    object (reference: regularizer applied at grad time)."""
+    if g is None or not wd:
+        return g
+    from ..regularizer import L1Decay, WeightDecayRegularizer
+    if isinstance(wd, WeightDecayRegularizer):
+        return wd.apply_to_grad(p.astype(g.dtype), g)
+    return g + wd * p.astype(g.dtype)
 
 
 class SGD(Optimizer):
@@ -285,7 +294,11 @@ class Adam(Optimizer):
                 denom = jnp.sqrt(v_hat) + eps
             upd = m_hat / denom
             if self._decoupled_wd and self._weight_decay:
-                mp = mp * (1.0 - lr.astype(mp.dtype) * self._weight_decay)
+                wd = self._weight_decay
+                from ..regularizer import WeightDecayRegularizer
+                if isinstance(wd, WeightDecayRegularizer):
+                    wd = wd.coeff  # decoupled path uses the coefficient
+                mp = mp * (1.0 - lr.astype(mp.dtype) * wd)
             mp = mp - lr.astype(mp.dtype) * upd
             new_params.append(mp.astype(p.dtype))
             new_m.append(m_s)

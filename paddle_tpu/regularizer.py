@@ -1,0 +1,32 @@
+"""paddle.regularizer parity
+(/root/reference/python/paddle/regularizer.py): L1/L2 weight decay
+objects accepted by optimizers' weight_decay argument. On TPU both fold
+into the compiled update step (L2 is the optimizer's decoupled/coupled
+decay; L1 adds a sign term)."""
+from __future__ import annotations
+
+__all__ = ["L1Decay", "L2Decay", "WeightDecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, coeff: float = 0.0):
+        self.coeff = float(coeff)
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self.coeff})"
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param)."""
+
+    def apply_to_grad(self, param_arr, grad_arr):
+        import jax.numpy as jnp
+        return grad_arr + self.coeff * jnp.sign(param_arr)
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (coupled form; AdamW-style optimizers apply
+    it decoupled instead)."""
+
+    def apply_to_grad(self, param_arr, grad_arr):
+        return grad_arr + self.coeff * param_arr

@@ -55,7 +55,13 @@ def _compile(name: str, sources: Sequence[str],
              extra_cxx_flags: Sequence[str] = (),
              extra_include_paths: Sequence[str] = (),
              verbose: bool = False) -> str:
-    out = os.path.join(get_build_dir(), f"{name}.so")
+    import hashlib
+    # cache key covers sources AND flags: changed -D flags must rebuild,
+    # and two extensions sharing a name must not collide
+    sig = hashlib.sha1("\0".join(
+        [*sorted(sources), *extra_cxx_flags,
+         *extra_include_paths]).encode()).hexdigest()[:12]
+    out = os.path.join(get_build_dir(), f"{name}_{sig}.so")
     if os.path.exists(out) and all(
             os.path.getmtime(s) <= os.path.getmtime(out) for s in sources):
         return out
@@ -171,8 +177,17 @@ def register_custom_op(name: str, forward: Callable,
 
         fn.defvjp(fwd, bwd)
 
+    has_backward = backward is not None
+
     def op(*args, **kwargs):
-        return apply(name, lambda *a: fn(*a), *args, **kwargs)
+        if kwargs:
+            if has_backward:
+                raise ValueError(
+                    f"custom op {name!r} with a custom backward cannot "
+                    f"take keyword args (jax.custom_vjp limitation); "
+                    f"close over them in forward/backward instead")
+            return apply(name, lambda *a: fn(*a, **kwargs), *args)
+        return apply(name, lambda *a: fn(*a), *args)
 
     op.__name__ = name
     _custom_ops[name] = op
