@@ -79,6 +79,7 @@ def enable_tensor_checker(config: TensorCheckerConfig):
     _checker = config
     _found.clear()
     _pending.clear()
+    _dropped[0] = 0
     from ..framework import core as fcore
     fcore._set_check_hook(_check_outputs)
 
@@ -105,8 +106,16 @@ def _check_outputs(op_name: str, arrays):
         if not jnp.issubdtype(a.dtype, jnp.floating):
             continue
         if not abort:
-            if len(_pending) < 10000:  # bounded: call found_issues()
-                _pending.append((op_name, i, jnp.isfinite(a).all(), a))
+            if len(_pending) < 10000:
+                # keep only SCALAR device values (not the output array —
+                # retaining it would pin activations in HBM); resolved
+                # lazily in found_issues()
+                af = a.astype(jnp.float32)
+                _pending.append((op_name, i, jnp.isnan(af).sum(),
+                                 jnp.isinf(af).sum(), tuple(a.shape),
+                                 str(a.dtype)))
+            else:
+                _dropped[0] += 1  # surface saturation, don't lie
             continue
         if bool(jnp.isfinite(a).all()):
             continue
@@ -119,6 +128,7 @@ def _check_outputs(op_name: str, arrays):
 
 
 _pending: List[tuple] = []
+_dropped = [0]
 
 
 def _describe(op_name, i, a) -> Dict:
@@ -132,13 +142,24 @@ def _describe(op_name, i, a) -> Dict:
 
 
 def found_issues() -> List[Dict]:
-    """Findings so far; resolves the lazily-enqueued record-mode flags
-    (the only point record mode synchronizes with the device)."""
+    """Findings so far; resolves the lazily-enqueued record-mode
+    counters (the only point record mode synchronizes with the device).
+    Raises if the pending queue saturated (checks were dropped)."""
     global _pending
+    if _dropped[0]:
+        k, _dropped[0] = _dropped[0], 0
+        _pending.clear()
+        raise RuntimeError(
+            f"nan/inf record queue saturated: {k} op outputs were not "
+            f"checked — call found_issues() periodically (e.g. once per "
+            f"step) to drain it")
     pending, _pending = _pending, []
-    for op_name, i, flag, a in pending:
-        if not bool(flag):
-            _found.append(_describe(op_name, i, a))
+    for op_name, i, nan_ct, inf_ct, shape, dtype in pending:
+        num_nan, num_inf = int(nan_ct), int(inf_ct)
+        if num_nan or num_inf:
+            _found.append({"op": op_name, "output_index": i,
+                           "num_nan": num_nan, "num_inf": num_inf,
+                           "shape": shape, "dtype": dtype})
     return list(_found)
 
 

@@ -53,11 +53,14 @@ def is_compiled_with_custom_device(device_type: str) -> bool:
 
 
 def synchronize(device=None):
-    """Block until all queued device work completes (reference
-    paddle.device.synchronize). JAX arrays are futures — sync by
-    blocking on a trivial readiness barrier."""
+    """Block until queued work on every local device completes
+    (reference paddle.device.synchronize). Per-device programs execute
+    in dispatch order, so a trivial computation enqueued now on each
+    device becomes ready only after everything already queued there."""
     import jax
-    (jax.device_put(0) + 0).block_until_ready()
+    import jax.numpy as jnp
+    for d in jax.local_devices():
+        jax.device_put(jnp.zeros(()), d).block_until_ready()
 
 
 class Stream:

@@ -91,13 +91,14 @@ class _Agent:
     # -- calling ------------------------------------------------------------
     def call(self, to: str, fn: Callable, args: tuple, kwargs: dict,
              timeout: float):
-        target = None
-        for info in get_all_worker_infos():
-            if info.name == to:
-                target = info
-                break
+        # worker registry is immutable after the init barrier: cache it
+        if not hasattr(self, "_infos"):
+            self._infos = {i.name: i for i in _fetch_worker_infos(self)}
+        target = self._infos.get(to)
         if target is None:
-            raise ValueError(f"unknown rpc worker {to!r}")
+            raise ValueError(
+                f"unknown rpc worker {to!r}; registered: "
+                f"{sorted(self._infos)}")
         call_id = f"{self.rank}-{uuid.uuid4().hex[:12]}"
         body = pickle.dumps({"fn": fn, "args": args, "kwargs": kwargs})
         blob = pickle.dumps((call_id, body))
@@ -199,13 +200,18 @@ def get_worker_info(name: Optional[str] = None) -> WorkerInfo:
     raise ValueError(f"unknown worker {name!r}")
 
 
-def get_all_worker_infos() -> List[WorkerInfo]:
-    agent = _require_agent()
+def _fetch_worker_infos(agent: "_Agent") -> List[WorkerInfo]:
+    """All registered workers; after the init barrier every rank must be
+    present — a missing entry is a real error, not something to skip."""
     out = []
     for r in range(agent.world_size):
-        try:
-            out.append(pickle.loads(
-                agent.store.get(f"rpc/worker{r}", timeout=30)))
-        except Exception:
-            continue
+        out.append(pickle.loads(
+            agent.store.get(f"rpc/worker{r}", timeout=30)))
     return out
+
+
+def get_all_worker_infos() -> List[WorkerInfo]:
+    agent = _require_agent()
+    if not hasattr(agent, "_infos"):
+        agent._infos = {i.name: i for i in _fetch_worker_infos(agent)}
+    return list(agent._infos.values())

@@ -295,9 +295,14 @@ class Adam(Optimizer):
             upd = m_hat / denom
             if self._decoupled_wd and self._weight_decay:
                 wd = self._weight_decay
-                from ..regularizer import WeightDecayRegularizer
+                from ..regularizer import L1Decay, WeightDecayRegularizer
+                if isinstance(wd, L1Decay):
+                    raise NotImplementedError(
+                        "L1Decay has no decoupled (AdamW-style) form; "
+                        "use a coupled optimizer (SGD/Momentum/Adam) "
+                        "for L1 regularization")
                 if isinstance(wd, WeightDecayRegularizer):
-                    wd = wd.coeff  # decoupled path uses the coefficient
+                    wd = wd.coeff  # L2: decoupled uses the coefficient
                 mp = mp * (1.0 - lr.astype(mp.dtype) * wd)
             mp = mp - lr.astype(mp.dtype) * upd
             new_params.append(mp.astype(p.dtype))

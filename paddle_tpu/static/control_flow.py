@@ -1,0 +1,165 @@
+"""Control-flow ops: cond / while_loop / switch_case / case.
+
+Reference: paddle.static.nn.cond & control-flow OpDescs
+(/root/reference/python/paddle/static/nn/control_flow.py, C++ side
+conditional_block/while ops + PIR control_flow_op.cc). TPU-native: these
+ARE jax.lax.cond / lax.while_loop / lax.switch — compiler-understood
+structured control flow with no interpreter — dispatched through the
+framework tape so they differentiate (cond/switch) and jit cleanly.
+Branch callables receive and return Tensors; inside they run on traced
+arrays like any framework op. Usable in eager, to_static and
+static-Program modes.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor, apply, apply_nodiff, no_grad
+
+__all__ = ["cond", "while_loop", "switch_case", "case"]
+
+
+def _wrap(arrs):
+    return tuple(Tensor(a) for a in arrs)
+
+
+def _unwrap_outs(out):
+    if isinstance(out, Tensor):
+        return (out._value,), True
+    return tuple(o._value if isinstance(o, Tensor) else jnp.asarray(o)
+                 for o in out), False
+
+
+def _run_branch(fn, arrs):
+    out = fn(*_wrap(arrs)) if arrs else fn()
+    return _unwrap_outs(out)
+
+
+def cond(pred, true_fn: Callable, false_fn: Callable,
+         inputs: Sequence = (), name=None):
+    """paddle.static.nn.cond parity: evaluates ONE branch (lax.cond —
+    unlike where/select both sides are not computed). Differentiable
+    w.r.t. inputs. Branch outputs must match in structure/shape/dtype
+    (same contract as the reference)."""
+    inputs = tuple(inputs)
+    single = {}
+
+    def f(p, *arrs):
+        def tb(a):
+            outs, single_out = _run_branch(true_fn, a)
+            single["flag"] = single_out
+            return outs
+
+        def fb(a):
+            outs, _ = _run_branch(false_fn, a)
+            return outs
+
+        outs = jax.lax.cond(jnp.asarray(p).astype(bool).reshape(()),
+                            tb, fb, arrs)
+        return outs if len(outs) > 1 else outs[0]
+
+    result = apply("cond", f, pred, *inputs)
+    return result
+
+
+def while_loop(cond_fn: Callable, body_fn: Callable,
+               loop_vars: Sequence, is_test: bool = False, name=None):
+    """paddle.static.nn.while_loop parity over lax.while_loop.
+    cond_fn(*vars) → scalar bool Tensor; body_fn(*vars) → same-structure
+    vars. Like the reference (and XLA), the loop is not differentiated
+    in reverse mode — use lax.scan-style constructs (or fori with known
+    trip count) for training loops."""
+    loop_vars = tuple(loop_vars)
+
+    def f(*arrs):
+        def c(vs):
+            out = cond_fn(*_wrap(vs))
+            return jnp.asarray(
+                out._value if isinstance(out, Tensor) else out
+            ).astype(bool).reshape(())
+
+        def b(vs):
+            out = body_fn(*_wrap(vs))
+            if isinstance(out, Tensor):
+                out = (out,)
+            return tuple(o._value if isinstance(o, Tensor)
+                         else jnp.asarray(o) for o in out)
+
+        outs = jax.lax.while_loop(c, b, arrs)
+        return outs if len(outs) > 1 else outs[0]
+
+    result = apply_nodiff("while_loop", f, *loop_vars)
+    return list(result) if isinstance(result, tuple) else [result]
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """paddle.static.nn.switch_case parity over lax.switch.
+    branch_fns: dict {index: fn} or list of (index, fn) / fns. default
+    runs when the index matches nothing."""
+    if isinstance(branch_fns, dict):
+        items = sorted(branch_fns.items())
+    elif branch_fns and isinstance(branch_fns[0], (tuple, list)):
+        items = sorted((int(i), f) for i, f in branch_fns)
+    else:
+        items = list(enumerate(branch_fns))
+    keys = [k for k, _ in items]
+    fns = [f for _, f in items]
+    if default is None:
+        default = fns[-1]
+
+    def f(idx):
+        # map the user index onto 0..n (n = default) with a lookup table
+        table = jnp.asarray(keys)
+        i = jnp.asarray(idx).reshape(()).astype(jnp.int32)
+        matches = (table == i)
+        pos = jnp.where(matches.any(),
+                        jnp.argmax(matches).astype(jnp.int32),
+                        jnp.int32(len(fns)))
+
+        def mk(fn):
+            def branch(_):
+                outs, single_out = _run_branch(fn, ())
+                return outs
+            return branch
+
+        outs = jax.lax.switch(pos, [mk(f_) for f_ in fns]
+                              + [mk(default)], ())
+        return outs if len(outs) > 1 else outs[0]
+
+    return apply_nodiff("switch_case", f, branch_index)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """paddle.static.nn.case parity: first pair whose pred is True runs.
+    Lowers to nested lax.cond."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        default = pairs[-1][1]
+
+    preds = [p for p, _ in pairs]
+    fns = [f for _, f in pairs]
+
+    def f(*pred_arrs):
+        def build(i):
+            if i == len(fns):
+                def d(_):
+                    outs, _s = _run_branch(default, ())
+                    return outs
+                return d
+
+            def branch(_):
+                def taken(__):
+                    outs, _s = _run_branch(fns[i], ())
+                    return outs
+                return jax.lax.cond(
+                    jnp.asarray(pred_arrs[i]).astype(bool).reshape(()),
+                    taken, build(i + 1), ())
+            return branch
+
+        outs = build(0)(())
+        return outs if len(outs) > 1 else outs[0]
+
+    return apply_nodiff("case", f, *preds)

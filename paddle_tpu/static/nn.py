@@ -16,7 +16,9 @@ from .. import nn as _nn
 from ..nn import functional as F
 
 __all__ = ["fc", "conv2d", "batch_norm", "embedding", "layer_norm",
-           "dropout"]
+           "dropout", "cond", "while_loop", "switch_case", "case"]
+
+from .control_flow import case, cond, switch_case, while_loop  # noqa: E402,F401
 
 
 def _param(shape, dtype, initializer=None, name=None):

@@ -1,0 +1,165 @@
+"""paddle.vision.ops (nms/roi_align/roi_pool/box ops) +
+static.nn control-flow (cond/while_loop/switch_case/case) tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+from paddle_tpu.vision import ops as V
+
+
+def n(t):
+    return np.asarray(t._value if hasattr(t, "_value") else t)
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11],   # heavy overlap
+            [50, 50, 60, 60],                  # far away
+        ], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8, 0.7], np.float32))
+        keep = n(V.nms(boxes, 0.5, scores))
+        assert keep.tolist() == [0, 2]
+
+    def test_category_aware(self):
+        boxes = paddle.to_tensor(np.array([
+            [0, 0, 10, 10], [1, 1, 11, 11]], np.float32))
+        scores = paddle.to_tensor(np.array([0.9, 0.8], np.float32))
+        cats = paddle.to_tensor(np.array([0, 1]))
+        keep = n(V.nms(boxes, 0.5, scores, category_idxs=cats,
+                       categories=[0, 1]))
+        assert sorted(keep.tolist()) == [0, 1]  # different classes survive
+
+    def test_top_k_and_score_order(self):
+        rng = np.random.RandomState(0)
+        boxes = rng.rand(20, 2) * 50
+        boxes = np.concatenate([boxes, boxes + 5], 1).astype(np.float32)
+        scores = rng.rand(20).astype(np.float32)
+        keep = n(V.nms(paddle.to_tensor(boxes), 0.4,
+                       paddle.to_tensor(scores), top_k=3))
+        assert len(keep) <= 3
+        kept_scores = scores[keep]
+        assert (np.diff(kept_scores) <= 1e-6).all()  # descending
+
+
+class TestBoxOps:
+    def test_box_iou_identity_and_disjoint(self):
+        a = paddle.to_tensor(np.array([[0, 0, 10, 10]], np.float32))
+        b = paddle.to_tensor(np.array([[0, 0, 10, 10],
+                                       [20, 20, 30, 30]], np.float32))
+        iou = n(V.box_iou(a, b))
+        np.testing.assert_allclose(iou, [[1.0, 0.0]], atol=1e-6)
+
+    def test_box_coder_roundtrip(self):
+        rng = np.random.RandomState(0)
+        prior = rng.rand(5, 2) * 50
+        prior = np.concatenate([prior, prior + 10], 1).astype(np.float32)
+        var = np.full((5, 4), 0.1, np.float32)
+        target = prior + rng.randn(5, 4).astype(np.float32)
+        enc = V.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                          paddle.to_tensor(target))
+        dec = V.box_coder(paddle.to_tensor(prior), paddle.to_tensor(var),
+                          enc, code_type="decode_center_size")
+        np.testing.assert_allclose(n(dec), target, rtol=1e-4, atol=1e-3)
+
+
+class TestRoI:
+    def test_roi_align_constant_region(self):
+        # constant image → every aligned value equals the constant
+        x = paddle.to_tensor(np.full((1, 3, 16, 16), 7.0, np.float32))
+        boxes = paddle.to_tensor(np.array([[2, 2, 10, 10]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        out = V.roi_align(x, boxes, bn, output_size=4)
+        assert out.shape == [1, 3, 4, 4]
+        np.testing.assert_allclose(n(out), 7.0, rtol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        x = paddle.to_tensor(
+            np.random.RandomState(0).rand(1, 2, 8, 8).astype(np.float32),
+            stop_gradient=False)
+        boxes = paddle.to_tensor(np.array([[1, 1, 6, 6]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        V.roi_align(x, boxes, bn, 2).sum().backward()
+        assert x.grad is not None and np.abs(n(x.grad)).sum() > 0
+
+    def test_roi_pool_takes_max(self):
+        img = np.zeros((1, 1, 8, 8), np.float32)
+        img[0, 0, 3, 3] = 5.0
+        x = paddle.to_tensor(img)
+        boxes = paddle.to_tensor(np.array([[0, 0, 8, 8]], np.float32))
+        bn = paddle.to_tensor(np.array([1], np.int32))
+        out = n(V.roi_pool(x, boxes, bn, 2))
+        assert out.max() == 5.0
+
+    def test_multi_image_batch(self):
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.rand(2, 1, 8, 8).astype(np.float32))
+        boxes = paddle.to_tensor(np.array(
+            [[0, 0, 4, 4], [0, 0, 4, 4]], np.float32))
+        bn = paddle.to_tensor(np.array([1, 1], np.int32))
+        out = n(V.roi_align(x, boxes, bn, 2))
+        assert out.shape == (2, 1, 2, 2)
+        assert not np.allclose(out[0], out[1])  # different images
+
+
+class TestControlFlow:
+    def test_cond_takes_one_branch_and_grads(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        pred = paddle.to_tensor(np.array(True))
+        out = static.nn.cond(pred, lambda a: a * 3.0, lambda a: a * 5.0,
+                             inputs=[x])
+        assert float(n(out)) == 6.0
+        out.backward()
+        np.testing.assert_allclose(n(x.grad), [3.0])
+        pred_f = paddle.to_tensor(np.array(False))
+        out2 = static.nn.cond(pred_f, lambda a: a * 3.0,
+                              lambda a: a * 5.0, inputs=[x])
+        assert float(n(out2)) == 10.0
+
+    def test_while_loop(self):
+        i = paddle.to_tensor(np.array(0, np.int32))
+        s = paddle.to_tensor(np.array(0.0, np.float32))
+        out_i, out_s = static.nn.while_loop(
+            lambda i, s: i < 5,
+            lambda i, s: (i + 1, s + i.astype("float32")),
+            [i, s])
+        assert int(n(out_i)) == 5
+        assert float(n(out_s)) == 10.0  # 0+1+2+3+4
+
+    def test_switch_case_with_default(self):
+        def mk(v):
+            return lambda: paddle.full([1], v)
+        for idx, want in [(1, 1.0), (2, 2.0), (9, -1.0)]:
+            out = static.nn.switch_case(
+                paddle.to_tensor(np.array(idx, np.int32)),
+                {1: mk(1.0), 2: mk(2.0)}, default=mk(-1.0))
+            assert float(n(out)) == want
+
+    def test_case_first_true_wins(self):
+        t = paddle.to_tensor(np.array(True))
+        f = paddle.to_tensor(np.array(False))
+        out = static.nn.case(
+            [(f, lambda: paddle.full([1], 1.0)),
+             (t, lambda: paddle.full([1], 2.0))],
+            default=lambda: paddle.full([1], 3.0))
+        assert float(n(out)) == 2.0
+        out2 = static.nn.case(
+            [(f, lambda: paddle.full([1], 1.0))],
+            default=lambda: paddle.full([1], 3.0))
+        assert float(n(out2)) == 3.0
+
+    def test_cond_inside_jit(self):
+        import jax
+
+        def step(xa):
+            t = paddle.to_tensor(xa)
+            t.stop_gradient = True
+            pred = t.sum() > 0
+            return static.nn.cond(pred, lambda a: a * 2.0,
+                                  lambda a: a * 0.5, inputs=[t])._value
+
+        j = jax.jit(step)
+        assert float(j(np.array([1.0], np.float32))[0]) == 2.0
+        assert float(j(np.array([-1.0], np.float32))[0]) == -0.5
