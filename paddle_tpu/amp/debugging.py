@@ -109,10 +109,10 @@ def _check_outputs(op_name: str, arrays):
             if len(_pending) < 10000:
                 # keep only SCALAR device values (not the output array —
                 # retaining it would pin activations in HBM); resolved
-                # lazily in found_issues()
-                af = a.astype(jnp.float32)
-                _pending.append((op_name, i, jnp.isnan(af).sum(),
-                                 jnp.isinf(af).sum(), tuple(a.shape),
+                # lazily in found_issues(). Counting runs on the native
+                # dtype (an f32 cast would flag big finite f64 as inf).
+                _pending.append((op_name, i, jnp.isnan(a).sum(),
+                                 jnp.isinf(a).sum(), tuple(a.shape),
                                  str(a.dtype)))
             else:
                 _dropped[0] += 1  # surface saturation, don't lie
@@ -146,13 +146,6 @@ def found_issues() -> List[Dict]:
     counters (the only point record mode synchronizes with the device).
     Raises if the pending queue saturated (checks were dropped)."""
     global _pending
-    if _dropped[0]:
-        k, _dropped[0] = _dropped[0], 0
-        _pending.clear()
-        raise RuntimeError(
-            f"nan/inf record queue saturated: {k} op outputs were not "
-            f"checked — call found_issues() periodically (e.g. once per "
-            f"step) to drain it")
     pending, _pending = _pending, []
     for op_name, i, nan_ct, inf_ct, shape, dtype in pending:
         num_nan, num_inf = int(nan_ct), int(inf_ct)
@@ -160,6 +153,15 @@ def found_issues() -> List[Dict]:
             _found.append({"op": op_name, "output_index": i,
                            "num_nan": num_nan, "num_inf": num_inf,
                            "shape": shape, "dtype": dtype})
+    if _dropped[0]:
+        # resolve what WAS queued first (evidence preserved), then report
+        # the saturation
+        k, _dropped[0] = _dropped[0], 0
+        raise RuntimeError(
+            f"nan/inf record queue saturated: {k} op outputs were not "
+            f"checked — call found_issues() periodically (e.g. once per "
+            f"step) to drain it; findings so far remain available via "
+            f"found_issues()")
     return list(_found)
 
 

@@ -59,8 +59,11 @@ def synchronize(device=None):
     device becomes ready only after everything already queued there."""
     import jax
     import jax.numpy as jnp
+    # a jitted computation (not a bare transfer) lands on each device's
+    # execution queue behind everything already enqueued there
+    noop = jax.jit(lambda x: x + 0)
     for d in jax.local_devices():
-        jax.device_put(jnp.zeros(()), d).block_until_ready()
+        noop(jax.device_put(jnp.zeros(()), d)).block_until_ready()
 
 
 class Stream:

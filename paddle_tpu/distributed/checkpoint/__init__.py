@@ -29,6 +29,15 @@ _META = "metadata.json"
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, async_save=False):
+    if async_save or jax.process_count() > 1:
+        # multi-host / async → orbax backend (per-host shard writes,
+        # overlapped serialization). A synchronous request must not
+        # return before the checkpoint is committed.
+        from .orbax_io import save_state_dict_async, wait_until_finished
+        save_state_dict_async(state_dict, path)
+        if not async_save:
+            wait_until_finished()
+        return
     os.makedirs(path, exist_ok=True)
     meta = {"tensors": {}}
     for name, t in state_dict.items():
@@ -55,6 +64,10 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     """In-place load into the provided state_dict tensors, resharding each
     array to the destination tensor's current sharding."""
     import jax.numpy as jnp
+    if not os.path.exists(os.path.join(path, _META)):
+        # orbax-format checkpoint (async/multi-host save)
+        from .orbax_io import load_state_dict_orbax
+        return load_state_dict_orbax(state_dict, path)
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
     for name, t in state_dict.items():

@@ -1,0 +1,89 @@
+"""Multi-host async checkpoint over orbax.
+
+Reference: the reference's distributed checkpoint writes per-rank shard
+files + global metadata with dedup and cross-topology restore
+(/root/reference/python/paddle/distributed/checkpoint/save_state_dict.py
+:104, load_state_dict.py:65). On TPU pods the production-grade engine
+for exactly that is orbax: every host writes only its address-able
+shards, metadata is global, restore reshards to the destination
+sharding, and async_save overlaps serialization with training.
+
+This backend upgrades paddle_tpu.distributed.checkpoint when requested
+(use_async=True or multi-process runtime); the np/json backend in
+__init__.py remains the single-host default (zero deps, readable
+files).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...framework.core import Tensor
+
+__all__ = ["save_state_dict_async", "load_state_dict_orbax",
+           "wait_until_finished"]
+
+_checkpointer = None
+_lock = threading.Lock()
+
+
+def _get_checkpointer():
+    global _checkpointer
+    with _lock:
+        if _checkpointer is None:
+            import orbax.checkpoint as ocp
+            _checkpointer = ocp.AsyncCheckpointer(
+                ocp.StandardCheckpointHandler())
+        return _checkpointer
+
+
+def _to_tree(state_dict: Dict[str, Any]):
+    return {name: t._value for name, t in state_dict.items()
+            if isinstance(t, Tensor)}
+
+
+def save_state_dict_async(state_dict: Dict[str, Any], path: str,
+                          **kwargs):
+    """Non-blocking sharded save: each host writes its shards; training
+    continues while serialization runs. Call wait_until_finished()
+    before exiting (or before a dependent restore)."""
+    import os
+    ckptr = _get_checkpointer()
+    ckptr.save(os.path.abspath(path), _to_tree(state_dict), force=True)
+
+
+def wait_until_finished():
+    if _checkpointer is not None:
+        _checkpointer.wait_until_finished()
+
+
+def load_state_dict_orbax(state_dict: Dict[str, Any], path: str,
+                          **kwargs):
+    """Restore in-place, resharding every array to the destination
+    tensor's CURRENT sharding — topology-changing restore across
+    different mesh shapes, per the reference's cross-topology ReadItem
+    planning."""
+    import os
+    import orbax.checkpoint as ocp
+    ckptr = _get_checkpointer()
+    ckptr.wait_until_finished()
+    # restore with target structure: shapes/dtypes/shardings from the
+    # destination tensors so orbax reads each host's needed shards only
+    targets = {}
+    for name, t in state_dict.items():
+        if not isinstance(t, Tensor):
+            continue
+        v = t._value
+        sharding = getattr(v, "sharding", None)
+        targets[name] = jax.ShapeDtypeStruct(v.shape, v.dtype,
+                                             sharding=sharding)
+    import orbax.checkpoint.args as ocp_args
+    restored = ckptr.restore(
+        os.path.abspath(path),
+        args=ocp_args.StandardRestore(targets))
+    for name, t in state_dict.items():
+        if isinstance(t, Tensor) and name in restored:
+            t._replace(restored[name])

@@ -45,12 +45,10 @@ def cond(pred, true_fn: Callable, false_fn: Callable,
     w.r.t. inputs. Branch outputs must match in structure/shape/dtype
     (same contract as the reference)."""
     inputs = tuple(inputs)
-    single = {}
 
     def f(p, *arrs):
         def tb(a):
-            outs, single_out = _run_branch(true_fn, a)
-            single["flag"] = single_out
+            outs, _ = _run_branch(true_fn, a)
             return outs
 
         def fb(a):

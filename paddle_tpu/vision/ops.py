@@ -166,8 +166,9 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 
 
 def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
-             name=None):
-    """Max-pool RoI extraction (reference roi_pool): [R, C, oh, ow]."""
+             name=None, _reduce: str = "max"):
+    """Max-pool RoI extraction (reference roi_pool): [R, C, oh, ow].
+    _reduce='mean' gives the average-pool variant PSRoIPool needs."""
     oh, ow = (output_size if isinstance(output_size, (tuple, list))
               else (output_size, output_size))
 
@@ -198,6 +199,11 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
                     jnp.arange(oh)[None, None, :, None]) & \
                    (bxp[None, :, None, None] ==
                     jnp.arange(ow)[None, None, None, :])  # [h,w,oh,ow]
+            if _reduce == "mean":
+                s = jnp.where(mask[None], img[:, :, :, None, None],
+                              0.0).sum(axis=(1, 2))
+                cnt = mask.sum(axis=(0, 1))
+                return s / jnp.maximum(cnt, 1)[None]
             vals = jnp.where(mask[None], img[:, :, :, None, None],
                              -jnp.inf)
             out = vals.max(axis=(1, 2))        # [c, oh, ow]
@@ -211,8 +217,16 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
 def box_coder(prior_box, prior_box_var, target_box,
               code_type: str = "encode_center_size",
               box_normalized: bool = True, axis: int = 0, name=None):
-    """SSD-style box encode/decode (reference box_coder)."""
-    def f(pb, pbv, tb):
+    """SSD-style box encode/decode (reference box_coder). Decode
+    supports [N, M, 4] target boxes with priors broadcast along `axis`
+    (0: priors along N, 1: priors along M); prior_box_var may be None
+    (treated as ones), a 4-vector, or per-box [N, 4]."""
+    var_is_none = prior_box_var is None
+
+    def f(pb, tb, *rest):
+        pbv = rest[0] if rest else jnp.ones_like(pb)
+        if pbv.ndim == 1:
+            pbv = jnp.broadcast_to(pbv, pb.shape)
         norm = 0.0 if box_normalized else 1.0
         pw = pb[:, 2] - pb[:, 0] + norm
         ph = pb[:, 3] - pb[:, 1] + norm
@@ -228,16 +242,25 @@ def box_coder(prior_box, prior_box_var, target_box,
             dw = jnp.log(tw / pw) / pbv[:, 2]
             dh = jnp.log(th / ph) / pbv[:, 3]
             return jnp.stack([dx, dy, dw, dh], axis=1)
-        # decode_center_size
-        dcx = pbv[:, 0] * tb[:, 0] * pw + pcx
-        dcy = pbv[:, 1] * tb[:, 1] * ph + pcy
-        dw = jnp.exp(pbv[:, 2] * tb[:, 2]) * pw
-        dh = jnp.exp(pbv[:, 3] * tb[:, 3]) * ph
+        # decode_center_size: broadcast priors across [N, M, 4] targets
+        if tb.ndim == 3:
+            exp = (slice(None), None) if axis == 0 else (None, slice(None))
+            pw, ph, pcx, pcy = (v[exp] for v in (pw, ph, pcx, pcy))
+            pbv = pbv[exp + (slice(None),)]
+            v0, v1, v2, v3 = (pbv[..., k] for k in range(4))
+        else:
+            v0, v1, v2, v3 = (pbv[:, k] for k in range(4))
+        dcx = v0 * tb[..., 0] * pw + pcx
+        dcy = v1 * tb[..., 1] * ph + pcy
+        dw = jnp.exp(v2 * tb[..., 2]) * pw
+        dh = jnp.exp(v3 * tb[..., 3]) * ph
         return jnp.stack([dcx - dw * 0.5, dcy - dh * 0.5,
                           dcx + dw * 0.5 - norm,
-                          dcy + dh * 0.5 - norm], axis=1)
+                          dcy + dh * 0.5 - norm], axis=-1)
 
-    return apply("box_coder", f, prior_box, prior_box_var, target_box)
+    args = (prior_box, target_box) + \
+        (() if var_is_none else (prior_box_var,))
+    return apply("box_coder", f, *args)
 
 
 class RoIAlign:
@@ -261,8 +284,9 @@ class RoIPool:
 
 
 class PSRoIPool:
-    """Position-sensitive RoI pooling: input channels = C*oh*ow; each
-    output bin reads its own channel group (reference PSRoIPool)."""
+    """Position-sensitive RoI AVERAGE pooling: input channels = C*oh*ow;
+    each output bin averages its own channel group (reference
+    psroi_pool, vision/ops.py — 'position-sensitive average pooling')."""
 
     def __init__(self, output_size, spatial_scale: float = 1.0):
         self.output_size = output_size if isinstance(
@@ -272,7 +296,7 @@ class PSRoIPool:
     def __call__(self, x, boxes, boxes_num):
         oh, ow = self.output_size
         pooled = roi_pool(x, boxes, boxes_num, (oh, ow),
-                          self.spatial_scale)
+                          self.spatial_scale, _reduce="mean")
 
         def f(p):
             r, c_all, _, _ = p.shape

@@ -179,3 +179,19 @@ class TestCheckpoint:
                                [dist.Shard(1), dist.Shard(0)])
         dist.checkpoint.load_state_dict({"w": w2}, str(tmp_path / "ckpt"))
         assert np.allclose(w2.numpy(), w.numpy())
+
+    def test_orbax_async_save_topology_change(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint.orbax_io import (
+            wait_until_finished)
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        w = paddle.randn([8, 16])
+        sw = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Shard(1)])
+        dist.checkpoint.save_state_dict(
+            {"w": sw}, str(tmp_path / "ock"), async_save=True)
+        wait_until_finished()
+        mesh2 = dist.ProcessMesh(np.arange(8).reshape(4, 2),
+                                 ["dp", "mp"])
+        w2 = dist.shard_tensor(paddle.zeros([8, 16]), mesh2,
+                               [dist.Shard(1), dist.Shard(0)])
+        dist.checkpoint.load_state_dict({"w": w2}, str(tmp_path / "ock"))
+        assert np.allclose(w2.numpy(), w.numpy())

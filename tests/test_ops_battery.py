@@ -1,0 +1,193 @@
+"""Op battery over the OpTest harness (reference:
+test/legacy_test/test_*_op.py pattern): each op checked in eager + jit +
+static modes vs numpy, plus numeric-vs-analytic gradients."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.nn import functional as F
+
+from op_test import OpTest
+
+rng = np.random.RandomState(7)
+
+
+class TestMatmulOp(OpTest):
+    op = staticmethod(paddle.matmul)
+    ref = staticmethod(lambda a, b: a @ b)
+    inputs = {"x": rng.randn(4, 6).astype(np.float32),
+              "y": rng.randn(6, 3).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestAddBroadcastOp(OpTest):
+    op = staticmethod(paddle.add)
+    ref = staticmethod(np.add)
+    inputs = {"x": rng.randn(3, 4).astype(np.float32),
+              "y": rng.randn(4).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(grad_inputs=["x"])
+
+
+class TestExpOp(OpTest):
+    op = staticmethod(paddle.exp)
+    ref = staticmethod(np.exp)
+    inputs = {"x": rng.randn(5, 5).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSoftmaxOp(OpTest):
+    op = staticmethod(F.softmax)
+    ref = staticmethod(
+        lambda x: np.exp(x - x.max(-1, keepdims=True))
+        / np.exp(x - x.max(-1, keepdims=True)).sum(-1, keepdims=True))
+    inputs = {"x": rng.randn(4, 8).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestMeanReduceOp(OpTest):
+    op = staticmethod(lambda x: paddle.mean(x, axis=1))
+    ref = staticmethod(lambda x: x.mean(axis=1))
+    inputs = {"x": rng.randn(3, 7).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestTransposeReshapeOp(OpTest):
+    op = staticmethod(
+        lambda x: paddle.reshape(paddle.transpose(x, [1, 0]), [2, 6]))
+    ref = staticmethod(lambda x: x.T.reshape(2, 6))
+    inputs = {"x": rng.randn(4, 3).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestSigmoidOp(OpTest):
+    op = staticmethod(F.sigmoid)
+    ref = staticmethod(lambda x: 1 / (1 + np.exp(-x)))
+    inputs = {"x": rng.randn(6).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLayerNormOp(OpTest):
+    op = staticmethod(lambda x: F.layer_norm(x, (8,)))
+    ref = staticmethod(
+        lambda x: (x - x.mean(-1, keepdims=True))
+        / np.sqrt(x.var(-1, keepdims=True) + 1e-5))
+    inputs = {"x": rng.randn(4, 8).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestConcatOp(OpTest):
+    op = staticmethod(lambda a, b: paddle.concat([a, b], axis=1))
+    ref = staticmethod(lambda a, b: np.concatenate([a, b], axis=1))
+    inputs = {"x": rng.randn(2, 3).astype(np.float32),
+              "y": rng.randn(2, 4).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestWhereOp(OpTest):
+    op = staticmethod(
+        lambda c, a, b: paddle.where(c.astype("bool"), a, b))
+    ref = staticmethod(lambda c, a, b: np.where(c.astype(bool), a, b))
+    inputs = {"c": (rng.rand(3, 3) > 0.5).astype(np.float32),
+              "x": rng.randn(3, 3).astype(np.float32),
+              "y": rng.randn(3, 3).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output()
+
+    def test_grad(self):
+        self.check_grad(grad_inputs=["x", "y"])
+
+
+class TestGeluOp(OpTest):
+    op = staticmethod(F.gelu)
+    ref = staticmethod(
+        lambda x: x * 0.5 * (1.0 + np.vectorize(
+            lambda v: float(__import__("math").erf(v / np.sqrt(2))))(x)))
+    inputs = {"x": rng.randn(4, 4).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestLogSumExpOp(OpTest):
+    op = staticmethod(lambda x: paddle.logsumexp(x, axis=-1))
+    ref = staticmethod(
+        lambda x: np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1))
+        + x.max(-1))
+    inputs = {"x": rng.randn(5, 6).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad()
+
+
+class TestCrossEntropyOp(OpTest):
+    @staticmethod
+    def _ref(logits, labels):
+        m = logits.max(-1, keepdims=True)
+        lse = np.log(np.exp(logits - m).sum(-1)) + m[:, 0]
+        picked = logits[np.arange(len(labels)), labels.astype(int)]
+        return (lse - picked).mean()
+
+    op = staticmethod(
+        lambda lg, lb: F.cross_entropy(lg, lb.astype("int64")))
+    ref = _ref
+    inputs = {"logits": rng.randn(6, 5).astype(np.float32),
+              "labels": rng.randint(0, 5, 6).astype(np.float32)}
+
+    def test_output(self):
+        self.check_output(rtol=1e-4, atol=1e-5)
+
+    def test_grad(self):
+        self.check_grad(grad_inputs=["logits"])
