@@ -29,7 +29,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["LayerDesc", "PipelineLayer", "pipeline_apply"]
+__all__ = ["LayerDesc", "PipelineLayer", "pipeline_apply",
+           "pipeline_apply_interleaved"]
 
 
 class LayerDesc:
@@ -136,5 +137,100 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
 
     f = shard_map(body, mesh=jmesh,
                   in_specs=(param_specs, x_spec), out_specs=P(),
+                  check_vma=False)
+    return f(stacked_params, x_microbatches)
+
+
+def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
+                               x_microbatches, mesh, vpp_degree: int,
+                               axis: str = "pp"):
+    """Interleaved (virtual-pipeline / VPP) chunk placement — reference:
+    PipelineParallelWithInterleave (meta_parallel/pipeline_parallel.py:906)
+    and the VPP pass (passes/pipeline_scheduler_pass.py:465).
+
+    The model is V = vpp_degree * n_stages chunks; physical stage s hosts
+    virtual chunks {j * n_stages + s : j < vpp}. Consecutive virtual
+    stages sit on consecutive physical stages, so every hop is the same
+    neighbor ppermute as the plain schedule (wrapping n-1 → 0 advances a
+    microbatch to its next chunk group).
+
+    SCHEDULE NOTE: this runs lock-step — every stage computes all of its
+    vpp chunk slots each tick, and the fill is V-1 full-work ticks. That
+    provides the interleaved PLACEMENT and semantics (state dicts,
+    chunk-wise sharding, schedule-order parity with the reference) but
+    NOT the reduced-bubble wall-clock benefit of Megatron-style
+    interleaving; for raw throughput at vpp>1 prefer composing each
+    stage's chunks and using pipeline_apply (bubble (n_stages-1) ticks).
+    A one-chunk-per-tick circular schedule is the planned upgrade.
+
+    stage_fn(params_slice, x) -> y  — one CHUNK's computation.
+    stacked_params: pytree, leaves [vpp, n_stages, ...] (axis 1 sharded
+      over `axis`).
+    x_microbatches: [n_micro, ...].
+    Returns [n_micro, ...] final-chunk outputs (psum-broadcast).
+    """
+    jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
+    n_stages = jmesh.shape[axis]
+    V = vpp_degree * n_stages
+    for leaf in jax.tree_util.tree_leaves(stacked_params):
+        if leaf.shape[0] != vpp_degree or leaf.shape[1] != n_stages:
+            raise ValueError(
+                f"stacked_params leaves must be [vpp={vpp_degree}, "
+                f"n_stages={n_stages}, ...]; got {leaf.shape} — a "
+                f"mismatched leading dim would silently clamp chunk "
+                f"indices")
+
+    param_specs = jax.tree_util.tree_map(
+        lambda _: P(None, axis), stacked_params)
+
+    def body(params, xs):
+        # params leaves: [vpp, 1, ...] → this stage's vpp chunk slices
+        p_local = jax.tree_util.tree_map(lambda a: a[:, 0], params)
+        stage = jax.lax.axis_index(axis)
+        n_micro = xs.shape[0]
+        n_ticks = n_micro + V - 1
+        ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+        p_first = jax.tree_util.tree_map(lambda a: a[0], p_local)
+        y_shape = jax.eval_shape(lambda p, x: stage_fn(p, x),
+                                 p_first, xs[0])
+        zero = jnp.zeros(y_shape.shape, y_shape.dtype)
+
+        def tick(t, carry):
+            acts, outputs = carry          # acts: [vpp, ...]
+            outs = jax.vmap(stage_fn)(p_local, acts)
+            arrived = jax.lax.ppermute(outs, axis, ring)
+            # stage 0 re-routes on the wrap: slot j's arrival came from
+            # virtual stage j*n + (n-1); its successor lives in slot j+1.
+            # slot 0 consumes a fresh microbatch; the last slot's arrival
+            # is a FINISHED microbatch (left the final virtual stage).
+            fresh = xs[jnp.clip(t + 1, 0, n_micro - 1)].astype(
+                arrived.dtype)
+            shifted = jnp.concatenate(
+                [fresh[None], arrived[:-1]], axis=0)
+            acts_new = jnp.where(stage == 0, shifted, arrived)
+            m = t - (V - 1)                 # finished microbatch id
+            done = jnp.where(stage == 0, arrived[vpp_degree - 1], zero)
+            outputs = jax.lax.cond(
+                m >= 0,
+                lambda o: o.at[jnp.maximum(m, 0)].set(
+                    jnp.where(stage == 0, done, o[jnp.maximum(m, 0)])),
+                lambda o: o, outputs)
+            return acts_new, outputs
+
+        acts0 = jnp.broadcast_to(zero, (vpp_degree,) + zero.shape)
+        # seed slot 0 of stage 0 with microbatch 0 for tick 0
+        acts0 = jnp.where(stage == 0,
+                          acts0.at[0].set(xs[0].astype(zero.dtype)),
+                          acts0)
+        outputs0 = jnp.zeros((n_micro,) + zero.shape, zero.dtype)
+        _, outputs = jax.lax.fori_loop(0, n_ticks, tick,
+                                       (acts0, outputs0))
+        # finished outputs live on stage 0 → broadcast to all pp ranks
+        mask = (stage == 0).astype(outputs.dtype)
+        return jax.lax.psum(outputs * mask, axis)
+
+    f = shard_map(body, mesh=jmesh,
+                  in_specs=(param_specs, P()), out_specs=P(),
                   check_vma=False)
     return f(stacked_params, x_microbatches)
