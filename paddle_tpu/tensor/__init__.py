@@ -19,8 +19,10 @@ from .manipulation import *  # noqa: F401,F403
 from .random import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .stat import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 
-from . import creation, math, linalg, manipulation, random, logic, stat
+from . import (creation, math, linalg, manipulation, random, logic, stat,
+               extras)
 
 from .einsum import einsum  # noqa: F401  (overrides linalg.einsum alias)
 
@@ -141,7 +143,8 @@ _NO_PATCH = {"to_tensor", "is_tensor", "shape", "rand", "randn", "randint",
 
 def _install_methods():
     import inspect
-    mods = [creation, math, linalg, manipulation, random, logic, stat]
+    mods = [creation, math, linalg, manipulation, random, logic, stat,
+            extras]
     for mod in mods:
         for name in getattr(mod, "__all__", []):
             if name in _NO_PATCH:

@@ -1,0 +1,338 @@
+"""Long-tail tensor ops completing paddle.tensor parity
+(/root/reference/python/paddle/tensor/: math.py, linalg.py,
+manipulation.py entries not covered by the main modules). Same dispatch
+contract as everything else: pure jnp/lax compositions on the tape.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtypes
+from ..framework.core import Tensor, apply, apply_nodiff, default_generator
+
+__all__ = [
+    "add_n", "as_complex", "as_real", "broadcast_shape", "cast",
+    "cholesky_solve", "combinations", "copysign", "cumulative_trapezoid",
+    "diag_embed", "diagonal", "diagonal_scatter", "eig", "eigvals",
+    "floor_mod", "frexp", "gammaln", "hypot", "i0", "i0e", "i1", "i1e",
+    "index_fill", "index_sample", "inverse", "ldexp", "logaddexp",
+    "logcumsumexp", "lu_unpack", "multigammaln", "nextafter", "polar",
+    "polygamma", "renorm", "reverse", "select_scatter", "sgn", "signbit",
+    "slice_scatter", "unflatten", "vander", "top_p_sampling",
+]
+
+
+def add_n(inputs, name=None):
+    """Sum of a tensor list (reference math.py add_n)."""
+    if isinstance(inputs, Tensor):
+        return apply("add_n", lambda a: a, inputs)
+    return apply("add_n", lambda *xs: sum(xs[1:], xs[0]), *inputs)
+
+
+def as_complex(x, name=None):
+    return apply("as_complex",
+                 lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply("as_real",
+                 lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], -1), x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def cast(x, dtype):
+    d = dtypes.convert_dtype(dtype)
+    return apply("cast", lambda a: a.astype(d), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A @ out = x given y = chol(A) (reference linalg)."""
+    def f(b, L):
+        return jax.scipy.linalg.cho_solve((L, not upper), b)
+    return apply("cholesky_solve", f, x, y)
+
+
+def combinations(x, r: int = 2, with_replacement: bool = False, name=None):
+    import itertools
+    n = x.shape[0]
+    gen = itertools.combinations_with_replacement if with_replacement \
+        else itertools.combinations
+    idx = np.asarray(list(gen(range(n), r)), np.int32).reshape(-1, r)
+    return apply("combinations", lambda a: a[jnp.asarray(idx)], x)
+
+
+def copysign(x, y, name=None):
+    return apply("copysign", jnp.copysign, x, y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    def f(ya, *rest):
+        if rest:
+            xa = rest[0]
+            d = jnp.diff(xa, axis=axis)
+        else:
+            d = dx if dx is not None else 1.0
+        yl = jax.lax.slice_in_dim(ya, 0, ya.shape[axis] - 1, axis=axis)
+        yr = jax.lax.slice_in_dim(ya, 1, ya.shape[axis], axis=axis)
+        return jnp.cumsum((yl + yr) * d / 2.0, axis=axis)
+    args = (y,) + ((x,) if x is not None else ())
+    return apply("cumulative_trapezoid", f, *args)
+
+
+def diag_embed(input, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        out = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = out.at[..., r, c].set(a)
+        # move the two new dims to dim1/dim2
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        order = sorted([(d1, nd - 2), (d2, nd - 1)])
+        for pos, src in order:
+            perm.insert(pos, src)
+        return jnp.transpose(out, perm)
+    return apply("diag_embed", f, input)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply("diagonal",
+                 lambda a: jnp.diagonal(a, offset, axis1, axis2), x)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, b):
+        k = b.shape[-1]
+        i = jnp.arange(k) + max(-offset, 0)
+        j = jnp.arange(k) + max(offset, 0)
+        am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        bm = jnp.moveaxis(b, -1, 0)
+        am = am.at[i, j].set(bm)
+        return jnp.moveaxis(am, (0, 1), (axis1, axis2))
+    return apply("diagonal_scatter", f, x, y)
+
+
+def eig(x, name=None):
+    """General eigendecomposition — CPU-only in XLA; computed on host
+    (the reference's eig is CPU-only too)."""
+    arr = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    w, v = np.linalg.eig(arr)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    arr = np.asarray(jax.device_get(
+        x._value if isinstance(x, Tensor) else x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(arr)))
+
+
+from .math import mod as floor_mod  # noqa: E402 — reference alias
+
+
+def frexp(x, name=None):
+    def f(a):
+        mm, ee = jnp.frexp(a)
+        return mm, ee.astype(jnp.int32)
+    return apply("frexp", f, x)
+
+
+def gammaln(x, name=None):
+    return apply("gammaln", jax.scipy.special.gammaln, x)
+
+
+def hypot(x, y, name=None):
+    return apply("hypot", jnp.hypot, x, y)
+
+
+def i0(x, name=None):
+    return apply("i0", jax.scipy.special.i0, x)
+
+
+def i0e(x, name=None):
+    return apply("i0e", jax.scipy.special.i0e, x)
+
+
+def i1(x, name=None):
+    return apply("i1", jax.scipy.special.i1, x)
+
+
+def i1e(x, name=None):
+    return apply("i1e", jax.scipy.special.i1e, x)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, idx):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[idx].set(value)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply("index_fill", f, x, index)
+
+
+def index_sample(x, index):
+    """Per-row gather: out[i, j] = x[i, index[i, j]] (reference
+    index_sample)."""
+    return apply("index_sample",
+                 lambda a, idx: jnp.take_along_axis(a, idx, axis=1),
+                 x, index)
+
+
+def inverse(x, name=None):
+    return apply("inverse", jnp.linalg.inv, x)
+
+
+def ldexp(x, y, name=None):
+    return apply("ldexp", lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)),
+                 x, y)
+
+
+def logaddexp(x, y, name=None):
+    return apply("logaddexp", jnp.logaddexp, x, y)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        if axis is None:
+            a = a.reshape(-1)
+            ax = 0
+        else:
+            ax = axis % a.ndim
+        # numerically stable prefix logsumexp as an associative scan
+        return jax.lax.associative_scan(jnp.logaddexp, a, axis=ax)
+    return apply("logcumsumexp", f, x)
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    def f(lu, piv):
+        n = lu.shape[-2]
+        L = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
+        U = jnp.triu(lu)
+        # pivots (1-based sequential swaps) → permutation matrices,
+        # batched over every leading dim
+        pv = np.asarray(jax.device_get(piv)).reshape(-1, piv.shape[-1])
+        perms = []
+        for row in pv:
+            perm = np.arange(n)
+            for i, p in enumerate(row[:n]):
+                j = int(p) - 1
+                perm[[i, j]] = perm[[j, i]]
+            perms.append(np.eye(n)[perm].T)
+        P = jnp.asarray(np.stack(perms).reshape(
+            piv.shape[:-1] + (n, n)), lu.dtype)
+        return P, L, U
+    P, L, U = apply_nodiff("lu_unpack", f, lu_data, lu_pivots)
+    return P, L, U
+
+
+def multigammaln(x, p, name=None):
+    return apply("multigammaln",
+                 lambda a: jax.scipy.special.multigammaln(a, p), x)
+
+
+def nextafter(x, y, name=None):
+    return apply_nodiff("nextafter", jnp.nextafter, x, y)
+
+
+def polar(abs, angle, name=None):
+    return apply("polar",
+                 lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                              r * jnp.sin(t)),
+                 abs, angle)
+
+
+def polygamma(x, n, name=None):
+    return apply("polygamma",
+                 lambda a: jax.scipy.special.polygamma(n, a), x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        moved = jnp.moveaxis(a, axis, 0)
+        flat = moved.reshape(moved.shape[0], -1)
+        norms = jnp.linalg.norm(flat, ord=p, axis=1)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / jnp.maximum(norms, 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(moved.shape), 0, axis)
+    return apply("renorm", f, x)
+
+
+from .manipulation import flip as reverse  # noqa: E402 — reference alias
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        moved = jnp.moveaxis(a, axis, 0)
+        moved = moved.at[index].set(v)
+        return jnp.moveaxis(moved, 0, axis)
+    return apply("select_scatter", f, x, values)
+
+
+def sgn(x, name=None):
+    """sign for real; x/|x| for complex (reference sgn)."""
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.maximum(mag, 1e-300))
+        return jnp.sign(a)
+    return apply("sgn", f, x)
+
+
+def signbit(x, name=None):
+    return apply_nodiff("signbit", jnp.signbit, x)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = slice(s, e, st)
+        return a.at[tuple(idx)].set(v)
+    return apply("slice_scatter", f, x, value)
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        new = list(a.shape[:ax]) + list(shape) + list(a.shape[ax + 1:])
+        return a.reshape(new)
+    return apply("unflatten", f, x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    def f(a):
+        return jnp.vander(a, n, increasing=increasing)
+    return apply("vander", f, x)
+
+
+def top_p_sampling(x, ps, threshold=None, seed=None, name=None):
+    """Nucleus sampling over the last axis (reference top_p_sampling):
+    returns (sampled values, sampled ids). seed fixes the draw;
+    threshold additionally drops tokens whose probability is below it."""
+    def f(logits, p):
+        key = jax.random.PRNGKey(seed) if seed is not None \
+            else default_generator.next_key()
+        sorted_idx = jnp.argsort(-logits, axis=-1)
+        sorted_logits = jnp.take_along_axis(logits, sorted_idx, -1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < p[..., None]  # always keep the top token
+        if threshold is not None:
+            keep = keep & (probs >= threshold)
+            keep = keep.at[..., 0].set(True)  # never drop every token
+        masked = jnp.where(keep, sorted_logits, -jnp.inf)
+        choice = jax.random.categorical(key, masked, axis=-1)
+        ids = jnp.take_along_axis(sorted_idx, choice[..., None], -1)
+        vals = jnp.take_along_axis(logits, ids, -1)
+        return vals, ids.astype(jnp.int64)
+    return apply_nodiff("top_p_sampling", f, x, ps)

@@ -1,0 +1,151 @@
+"""Long-tail tensor op tests (extras.py) — numpy parity + a few grads."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+t = paddle.to_tensor
+rng = np.random.RandomState(0)
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+def test_add_n_and_cast():
+    x = rng.randn(3, 3).astype(np.float32)
+    np.testing.assert_allclose(n(paddle.add_n([t(x), t(x), t(x)])), 3 * x,
+                               rtol=1e-6)
+    assert n(paddle.cast(t(x), "int32")).dtype == np.int32
+
+
+def test_complex_roundtrip_and_polar():
+    x = rng.randn(4, 2).astype(np.float32)
+    c = paddle.as_complex(t(x))
+    back = paddle.as_real(c)
+    np.testing.assert_allclose(n(back), x, rtol=1e-6)
+    p = paddle.polar(t(np.array([1.0], np.float32)),
+                     t(np.array([np.pi / 2], np.float32)))
+    np.testing.assert_allclose(n(p), [1j], atol=1e-6)
+
+
+def test_diag_family():
+    x = rng.randn(4, 5).astype(np.float32)
+    np.testing.assert_allclose(n(paddle.diagonal(t(x))), np.diagonal(x))
+    d = paddle.diag_embed(t(np.array([1., 2.], np.float32)), offset=1)
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1], want[1, 2] = 1, 2
+    np.testing.assert_allclose(n(d), want)
+    ds = paddle.diagonal_scatter(t(np.zeros((3, 3), np.float32)),
+                                 t(np.ones(3, np.float32)))
+    np.testing.assert_allclose(n(ds), np.eye(3))
+
+
+def test_scatter_family():
+    x = np.zeros((4, 5), np.float32)
+    out = paddle.select_scatter(t(x), t(np.ones(5, np.float32)), 0, 2)
+    assert n(out)[2].sum() == 5
+    out2 = paddle.slice_scatter(t(x), t(np.ones((2, 5), np.float32)),
+                                axes=[0], starts=[1], ends=[3],
+                                strides=[1])
+    assert n(out2).sum() == 10
+    filled = paddle.index_fill(t(x), t(np.array([0, 3])), 0, 7.0)
+    assert n(filled)[0].sum() == 35 and n(filled)[1].sum() == 0
+
+
+def test_linalg_extras():
+    m = rng.randn(4, 4).astype(np.float32)
+    spd = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    L = np.linalg.cholesky(spd)
+    b = rng.randn(4, 2).astype(np.float32)
+    got = n(paddle.cholesky_solve(t(b), t(L)))
+    np.testing.assert_allclose(got, np.linalg.solve(spd, b), rtol=1e-3,
+                               atol=1e-4)
+    np.testing.assert_allclose(n(paddle.inverse(t(spd))),
+                               np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+    w, v = paddle.eig(t(m))
+    np.testing.assert_allclose(sorted(n(w).real),
+                               sorted(np.linalg.eigvals(m).real),
+                               atol=1e-4)
+    np.testing.assert_allclose(
+        sorted(n(paddle.eigvals(t(m))).real),
+        sorted(np.linalg.eigvals(m).real), atol=1e-4)
+
+
+def test_lu_unpack_reconstructs():
+    import jax
+    a = rng.randn(4, 4).astype(np.float32)
+    import jax.scipy.linalg as jsl
+    lu, piv = jax.scipy.linalg.lu_factor(a)
+    P, L, U = paddle.lu_unpack(t(np.asarray(lu)),
+                               t(np.asarray(piv) + 1))
+    np.testing.assert_allclose(n(P) @ n(L) @ n(U), a, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_special_functions():
+    x = np.abs(rng.randn(5).astype(np.float32)) + 0.5
+    import scipy.special as sp
+    np.testing.assert_allclose(n(paddle.gammaln(t(x))), sp.gammaln(x),
+                               rtol=1e-5)
+    np.testing.assert_allclose(n(paddle.i0e(t(x))), sp.i0e(x), rtol=1e-5)
+    np.testing.assert_allclose(n(paddle.i1e(t(x))), sp.i1e(x), rtol=1e-5)
+    np.testing.assert_allclose(n(paddle.polygamma(t(x), 1)),
+                               sp.polygamma(1, x), rtol=1e-4)
+    np.testing.assert_allclose(n(paddle.multigammaln(t(x + 2), 2)),
+                               sp.multigammaln(x + 2, 2), rtol=1e-4)
+
+
+def test_math_extras():
+    x = rng.randn(6).astype(np.float32)
+    y = rng.randn(6).astype(np.float32)
+    np.testing.assert_allclose(n(paddle.copysign(t(x), t(y))),
+                               np.copysign(x, y))
+    np.testing.assert_allclose(n(paddle.logaddexp(t(x), t(y))),
+                               np.logaddexp(x, y), rtol=1e-6)
+    np.testing.assert_allclose(n(paddle.logcumsumexp(t(x), 0)),
+                               np.logaddexp.accumulate(x), rtol=1e-5,
+                               atol=1e-5)
+    m, e = paddle.frexp(t(x))
+    np.testing.assert_allclose(n(m) * 2.0 ** n(e), x, rtol=1e-6)
+    np.testing.assert_allclose(n(paddle.ldexp(t(x), t(np.ones(6)))),
+                               x * 2, rtol=1e-6)
+    assert (n(paddle.signbit(t(x))) == np.signbit(x)).all()
+    np.testing.assert_allclose(n(paddle.sgn(t(x))), np.sign(x))
+    np.testing.assert_allclose(n(paddle.nextafter(t(x), t(y))),
+                               np.nextafter(x, y))
+
+
+def test_shape_utilities():
+    assert paddle.broadcast_shape([2, 1, 3], [4, 3]) == [2, 4, 3]
+    x = rng.randn(2, 12).astype(np.float32)
+    assert paddle.unflatten(t(x), 1, [3, 4]).shape == [2, 3, 4]
+    np.testing.assert_allclose(
+        n(paddle.reverse(t(x), 1)), x[:, ::-1])
+    v = paddle.vander(t(np.array([2., 3.], np.float32)), 3,
+                      increasing=True)
+    np.testing.assert_allclose(n(v), [[1, 2, 4], [1, 3, 9]])
+    c = paddle.combinations(t(np.arange(4).astype(np.float32)), 2)
+    assert c.shape == [6, 2]
+
+
+def test_trapezoid_and_renorm():
+    y = np.array([1., 2., 3., 4.], np.float32)
+    got = n(paddle.cumulative_trapezoid(t(y), dx=1.0))
+    np.testing.assert_allclose(got, [1.5, 4.0, 7.5])
+    x = rng.randn(3, 4).astype(np.float32) * 10
+    out = n(paddle.renorm(t(x), 2.0, 0, 1.0))
+    norms = np.linalg.norm(out.reshape(3, -1), axis=1)
+    assert (norms <= 1.0 + 1e-5).all()
+
+
+def test_index_sample_and_top_p():
+    x = rng.randn(3, 8).astype(np.float32)
+    idx = np.array([[0, 1], [2, 3], [7, 7]], np.int32)
+    np.testing.assert_allclose(n(paddle.index_sample(t(x), t(idx))),
+                               np.take_along_axis(x, idx, 1))
+    paddle.seed(0)
+    vals, ids = paddle.top_p_sampling(
+        t(x), t(np.full((3,), 0.01, np.float32)))
+    # p→0 nucleus keeps only the argmax
+    np.testing.assert_array_equal(n(ids)[:, 0], x.argmax(1))
