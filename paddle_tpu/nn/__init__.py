@@ -12,6 +12,8 @@ from .layer.pooling import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.moe import MoELayer, SwitchGate, GShardGate  # noqa: F401
+from .layer.rnn import *  # noqa: F401,F403
+from .layer.extras import *  # noqa: F401,F403
 from ..optimizer.clip import (  # noqa: F401 — paddle.nn.ClipGradBy* parity
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )

@@ -74,8 +74,13 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
-    return _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
-                 "max", ceil_mode)
+    out = _pool(x, kernel_size, stride, padding, 1, data_format == "NLC",
+                "max", ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, 1,
+                                data_format == "NLC")
+        return out, idx
+    return out
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
@@ -91,8 +96,13 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
-    return _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
-                 "max", ceil_mode)
+    out = _pool(x, kernel_size, stride, padding, 3, data_format == "NDHWC",
+                "max", ceil_mode)
+    if return_mask:
+        idx = _max_pool_indices(x, kernel_size, stride, padding, 3,
+                                data_format == "NDHWC")
+        return out, idx
+    return out
 
 
 def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
@@ -116,9 +126,41 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
 
 
 def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
-    # flat indices of max within each window (eager helper for return_mask)
+    """Flat spatial index (row-major over the input's spatial dims) of
+    each window's max — the contract MaxUnPoolND consumes (reference
+    return_mask semantics). Computed as a reduce_window argmax: the
+    payload is (value, flat_index) and the reducer picks the larger
+    value's index."""
+    k = _t(kernel, n)
+    s = _t(stride if stride is not None else kernel, n)
+    p = _t(padding, n)
+
     def f(a):
-        return jnp.zeros((1,), jnp.int64)  # placeholder; rarely used on TPU
+        if channel_last:
+            a = jnp.moveaxis(a, -1, 1)
+        spatial = a.shape[2:]
+        flat = jnp.arange(np.prod(spatial), dtype=jnp.int32).reshape(
+            spatial)
+        idx = jnp.broadcast_to(flat, a.shape)
+        neg = jnp.iinfo(a.dtype).min if jnp.issubdtype(
+            a.dtype, jnp.integer) else jnp.finfo(a.dtype).min
+        dims = (1, 1) + tuple(k)
+        strides = (1, 1) + tuple(s)
+        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+
+        def reducer(x1, x2):
+            v1, i1 = x1
+            v2, i2 = x2
+            take2 = v2 > v1
+            return (jnp.where(take2, v2, v1), jnp.where(take2, i2, i1))
+
+        _, out_idx = jax.lax.reduce_window(
+            (a, idx), (jnp.asarray(neg, a.dtype), jnp.asarray(0, jnp.int32)),
+            reducer, dims, strides, pads)
+        if channel_last:
+            out_idx = jnp.moveaxis(out_idx, 1, -1)
+        return out_idx.astype(jnp.int32)
+
     return apply_nodiff("max_pool_mask", f, x)
 
 

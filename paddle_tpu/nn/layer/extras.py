@@ -1,0 +1,398 @@
+"""Remaining layer/loss parity (reference python/paddle/nn/layer/):
+ZeroPad2D, Unflatten, Softmax2D, PairwiseDistance, MaxUnPool1/2/3D,
+CTCLoss (lax.scan forward algorithm), GaussianNLLLoss, SoftMarginLoss,
+MultiLabelSoftMarginLoss, MultiMarginLoss,
+TripletMarginWithDistanceLoss, HSigmoidLoss."""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Parameter, Tensor, apply, default_generator
+from .layers import Layer
+
+__all__ = ["ZeroPad2D", "Unflatten", "Softmax2D", "PairwiseDistance",
+           "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "CTCLoss",
+           "GaussianNLLLoss", "SoftMarginLoss", "MultiLabelSoftMarginLoss",
+           "MultiMarginLoss", "TripletMarginWithDistanceLoss",
+           "HSigmoidLoss"]
+
+
+class ZeroPad2D(Layer):
+    def __init__(self, padding, data_format="NCHW", name=None):
+        super().__init__()
+        p = padding if isinstance(padding, (list, tuple)) else [padding] * 4
+        self.padding = list(p)  # [left, right, top, bottom]
+        self.data_format = data_format
+
+    def forward(self, x):
+        l, r, t, b = self.padding
+        if self.data_format == "NCHW":
+            pads = ((0, 0), (0, 0), (t, b), (l, r))
+        else:
+            pads = ((0, 0), (t, b), (l, r), (0, 0))
+        return apply("zero_pad2d", lambda a: jnp.pad(a, pads), x)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis = axis
+        self.shape = list(shape)
+
+    def forward(self, x):
+        from ...tensor.extras import unflatten
+        return unflatten(x, self.axis, self.shape)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW (reference Softmax2D)."""
+
+    def forward(self, x):
+        return apply("softmax2d", lambda a: jax.nn.softmax(a, axis=-3), x)
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p = p
+        self.epsilon = epsilon
+        self.keepdim = keepdim
+
+    def forward(self, x, y):
+        def f(a, b):
+            d = a - b + self.epsilon
+            return jnp.linalg.norm(d, ord=self.p, axis=-1,
+                                   keepdims=self.keepdim)
+        return apply("pairwise_distance", f, x, y)
+
+
+class _MaxUnPoolND(Layer):
+    """Scatter pooled values back to pre-pool positions using the
+    indices MaxPool returned (reference MaxUnPool1D/2D/3D)."""
+
+    ND = 2
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format=None, output_size=None, name=None):
+        super().__init__()
+        nd = self.ND
+        as_t = lambda v: tuple(v) if isinstance(v, (list, tuple)) \
+            else (v,) * nd
+        self.kernel = as_t(kernel_size)
+        self.stride = as_t(stride if stride is not None else kernel_size)
+        self.padding = as_t(padding)
+        self.output_size = output_size
+
+    def _out_spatial(self, in_spatial):
+        if self.output_size is not None:
+            return tuple(self.output_size[-self.ND:])
+        return tuple((s - 1) * st - 2 * p + k for s, st, p, k in
+                     zip(in_spatial, self.stride, self.padding,
+                         self.kernel))
+
+    def forward(self, x, indices):
+        nd = self.ND
+
+        def f(a, idx):
+            b, c = a.shape[0], a.shape[1]
+            out_sp = self._out_spatial(a.shape[2:])
+            flat_len = int(jnp.prod(jnp.asarray(out_sp)))
+            flat = jnp.zeros((b, c, flat_len), a.dtype)
+            vals = a.reshape(b, c, -1)
+            ids = idx.reshape(b, c, -1).astype(jnp.int32)
+            bi = jnp.arange(b)[:, None, None]
+            ci = jnp.arange(c)[None, :, None]
+            flat = flat.at[bi, ci, ids].set(vals)
+            return flat.reshape((b, c) + tuple(out_sp))
+
+        return apply("max_unpool", f, x, indices)
+
+
+class MaxUnPool1D(_MaxUnPoolND):
+    ND = 1
+
+
+class MaxUnPool2D(_MaxUnPoolND):
+    ND = 2
+
+
+class MaxUnPool3D(_MaxUnPoolND):
+    ND = 3
+
+
+class CTCLoss(Layer):
+    """Connectionist temporal classification (reference CTCLoss over
+    warpctc). TPU-native: the alpha recursion of the CTC forward
+    algorithm as one lax.scan over time in log space — differentiable,
+    so the gradient is exact (autodiff of the forward algorithm)."""
+
+    def __init__(self, blank: int = 0, reduction: str = "mean"):
+        super().__init__()
+        self.blank = blank
+        self.reduction = reduction
+
+    def forward(self, log_probs, labels, input_lengths, label_lengths,
+                norm_by_times=False):
+        """log_probs: [T, B, C] (logits accepted — re-normalized);
+        labels: [B, L]; lengths: [B]."""
+        blank = self.blank
+
+        def f(lp, lab, in_len, lab_len):
+            lp = jax.nn.log_softmax(lp, axis=-1)
+            t_max, b, _ = lp.shape
+            l_max = lab.shape[1]
+            s_max = 2 * l_max + 1
+            # extended label sequence: blank a1 blank a2 ... blank
+            ext = jnp.full((b, s_max), blank, lab.dtype)
+            ext = ext.at[:, 1::2].set(lab)
+            neg_inf = -1e30
+
+            # alpha init: positions 0 (blank) and 1 (first label)
+            def emit(t):
+                # [B, S] log prob of emitting ext symbol at time t
+                return jnp.take_along_axis(lp[t], ext, axis=1)
+
+            alpha0 = jnp.full((b, s_max), neg_inf)
+            alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(lab_len > 0, emit(0)[:, 1], neg_inf))
+
+            same_as_prev2 = jnp.concatenate(
+                [jnp.ones((b, 2), bool),
+                 ext[:, 2:] == ext[:, :-2]], axis=1)
+
+            def step(alpha, t):
+                a_shift1 = jnp.concatenate(
+                    [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+                a_shift2 = jnp.concatenate(
+                    [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+                a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+                merged = jnp.logaddexp(
+                    jnp.logaddexp(alpha, a_shift1), a_shift2)
+                new = merged + emit(t)
+                # frozen past input length
+                new = jnp.where((t < in_len)[:, None], new, alpha)
+                return new, None
+
+            alpha, _ = jax.lax.scan(step, alpha0,
+                                    jnp.arange(1, t_max))
+            # total prob: last blank or last label position
+            send = 2 * lab_len  # index of final blank
+            last_blank = jnp.take_along_axis(alpha, send[:, None],
+                                             axis=1)[:, 0]
+            last_lab = jnp.take_along_axis(
+                alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+            last_lab = jnp.where(lab_len > 0, last_lab, neg_inf)
+            nll = -jnp.logaddexp(last_blank, last_lab)
+            if norm_by_times:
+                nll = nll / jnp.maximum(in_len, 1)
+            return nll
+
+        loss = apply("ctc_loss", f, log_probs, labels, input_lengths,
+                     label_lengths)
+        if self.reduction == "mean":
+            # reference (functional/loss.py:1962): mean of per-sample
+            # loss normalized by label length
+            norm = apply("ctc_norm",
+                         lambda l, ll: l / jnp.maximum(
+                             ll.astype(l.dtype), 1.0),
+                         loss, label_lengths)
+            return norm.mean()
+        if self.reduction == "sum":
+            return loss.sum()
+        return loss
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full = full
+        self.epsilon = epsilon
+        self.reduction = reduction
+
+    def forward(self, input, label, variance):
+        def f(mu, y, var):
+            var = jnp.maximum(var, self.epsilon)
+            loss = 0.5 * (jnp.log(var) + (y - mu) ** 2 / var)
+            if self.full:
+                loss = loss + 0.5 * math.log(2 * math.pi)
+            return loss
+        out = apply("gaussian_nll", f, input, label, variance)
+        if self.reduction == "mean":
+            return out.mean()
+        if self.reduction == "sum":
+            return out.sum()
+        return out
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        out = apply("soft_margin",
+                    lambda x, y: jnp.log1p(jnp.exp(-y * x)), input, label)
+        if self.reduction == "mean":
+            return out.mean()
+        if self.reduction == "sum":
+            return out.sum()
+        return out
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        def f(x, y, *w):
+            loss = -(y * jax.nn.log_sigmoid(x)
+                     + (1 - y) * jax.nn.log_sigmoid(-x))
+            if w:
+                loss = loss * w[0]
+            return loss.mean(axis=-1)
+        args = (input, label) + ((self.weight,)
+                                 if self.weight is not None else ())
+        out = apply("multilabel_soft_margin", f, *args)
+        if self.reduction == "mean":
+            return out.mean()
+        if self.reduction == "sum":
+            return out.sum()
+        return out
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p: int = 1, margin: float = 1.0, weight=None,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.p = p
+        self.margin = margin
+        self.weight = weight
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        def f(x, y, *w):
+            n, c = x.shape
+            correct = jnp.take_along_axis(x, y[:, None].astype(jnp.int32),
+                                          axis=1)
+            m = jnp.maximum(0.0, self.margin - correct + x) ** self.p
+            if w:
+                m = m * jnp.take(w[0], y.astype(jnp.int32))[:, None]
+            mask = jax.nn.one_hot(y.astype(jnp.int32), c) == 0
+            return (m * mask).sum(axis=1) / c
+        args = (input, label) + ((self.weight,)
+                                 if self.weight is not None else ())
+        out = apply("multi_margin", f, *args)
+        if self.reduction == "mean":
+            return out.mean()
+        if self.reduction == "sum":
+            return out.sum()
+        return out
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function: Optional[Callable] = None,
+                 margin: float = 1.0, swap: bool = False,
+                 reduction: str = "mean", name=None):
+        super().__init__()
+        self.dist = distance_function
+        self.margin = margin
+        self.swap = swap
+        self.reduction = reduction
+
+    def forward(self, input, positive, negative):
+        if self.dist is not None:
+            d_ap = self.dist(input, positive)
+            d_an = self.dist(input, negative)
+            if self.swap:
+                d_pn = self.dist(positive, negative)
+                from ...tensor.math import minimum
+                d_an = minimum(d_an, d_pn)
+            from ...tensor.math import maximum
+            from ...framework.core import Tensor as _T
+            import numpy as _np
+            zero = Tensor(jnp.zeros_like(d_ap._value))
+            out = maximum(d_ap - d_an + self.margin, zero)
+        else:
+            def f(a, p, n):
+                d_ap = jnp.linalg.norm(a - p, axis=-1)
+                d_an = jnp.linalg.norm(a - n, axis=-1)
+                if self.swap:
+                    d_pn = jnp.linalg.norm(p - n, axis=-1)
+                    d_an = jnp.minimum(d_an, d_pn)
+                return jnp.maximum(d_ap - d_an + self.margin, 0.0)
+            out = apply("triplet_margin_dist", f, input, positive,
+                        negative)
+        if self.reduction == "mean":
+            return out.mean()
+        if self.reduction == "sum":
+            return out.sum()
+        return out
+
+
+class HSigmoidLoss(Layer):
+    """Hierarchical sigmoid over a default complete binary tree
+    (reference HSigmoidLoss without custom paths: feature_size →
+    num_classes via log2(C) binary decisions)."""
+
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        if is_custom:
+            raise NotImplementedError(
+                "custom tree paths: pass path_table/path_code to forward")
+        self.num_classes = num_classes
+        d = feature_size
+        n_inner = num_classes - 1  # inner nodes of the complete tree
+        std = 1.0 / math.sqrt(d)
+        k = default_generator.next_key()
+        self.weight = Parameter(
+            jax.random.uniform(k, (n_inner, d), jnp.float32, -std, std))
+        self.bias = Parameter(jnp.zeros((n_inner,), jnp.float32))
+        # complete-binary-tree paths depend only on num_classes: build
+        # ONCE here (per-forward this O(C*depth) python loop would
+        # dominate step time at real vocab sizes)
+        import numpy as np
+        C = num_classes
+        depth = max(1, math.ceil(math.log2(max(C, 2))))
+        table = np.zeros((C, depth), np.int32)
+        code = np.zeros((C, depth), np.float32)
+        valid = np.zeros((C, depth), np.float32)
+        for cls in range(C):
+            node = cls + C - 1  # leaf id in heap order
+            path = []
+            while node > 0:
+                parent = (node - 1) // 2
+                path.append((parent, float(node == 2 * parent + 2)))
+                node = parent
+            for dpt, (p, bit) in enumerate(reversed(path)):
+                table[cls, dpt] = p
+                code[cls, dpt] = bit
+                valid[cls, dpt] = 1.0
+        self._table, self._code, self._valid = table, code, valid
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        table, code, valid = self._table, self._code, self._valid
+
+        def f(x, y, w, b):
+            tb = jnp.asarray(table)[y.astype(jnp.int32)]   # [B, D]
+            cd = jnp.asarray(code)[y.astype(jnp.int32)]
+            vd = jnp.asarray(valid)[y.astype(jnp.int32)]
+            wn = w[tb]                                     # [B, D, F]
+            bn = b[tb]
+            logits = jnp.einsum("bf,bdf->bd", x, wn) + bn
+            # bit=1 → sigmoid(logit), bit=0 → 1-sigmoid
+            logp = jnp.where(cd > 0.5, jax.nn.log_sigmoid(logits),
+                             jax.nn.log_sigmoid(-logits))
+            return -(logp * vd).sum(axis=1, keepdims=True)
+
+        return apply("hsigmoid_loss", f, input, label, self.weight,
+                     self.bias)

@@ -1,0 +1,368 @@
+"""Recurrent layers (parity:
+/root/reference/python/paddle/nn/layer/rnn.py — RNNCellBase,
+SimpleRNNCell/LSTMCell/GRUCell, RNN/BiRNN wrappers, SimpleRNN/LSTM/GRU
+multi-layer networks).
+
+TPU-native: the time loop is ONE jax.lax.scan per layer/direction — the
+whole sequence compiles to a single fused XLA while-op; the per-step
+matmuls batch over [batch, hidden] (MXU-shaped), and input projections
+for all timesteps are hoisted out of the scan (x @ W_ih computed as one
+big [B*T, H] matmul). sequence_length masking carries the pre-step state
+through padded steps, matching the reference's variable-length
+semantics.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Parameter, Tensor, apply, default_generator
+from ...framework import dtype as dtypes
+from .layers import Layer
+
+__all__ = ["RNNCellBase", "SimpleRNNCell", "LSTMCell", "GRUCell", "RNN",
+           "BiRNN", "SimpleRNN", "LSTM", "GRU"]
+
+
+def _uniform(shape, bound, dtype=jnp.float32):
+    k = default_generator.next_key()
+    return jax.random.uniform(k, shape, dtype, -bound, bound)
+
+
+class RNNCellBase(Layer):
+    """Base cell (reference RNNCellBase): single-step state transition
+    with get_initial_states."""
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value: float = 0.0, batch_dim_idx: int = 0):
+        b = batch_ref.shape[batch_dim_idx]
+        n = self.state_shape
+        if isinstance(n, (tuple, list)) and isinstance(n[0], (tuple, list)):
+            return tuple(
+                Tensor(jnp.full((b,) + tuple(s), init_value, jnp.float32))
+                for s in n)
+        return Tensor(jnp.full((b,) + tuple(n), init_value, jnp.float32))
+
+
+class SimpleRNNCell(RNNCellBase):
+    """h' = act(W_ih x + b_ih + W_hh h + b_hh)."""
+
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.activation = activation
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = Parameter(_uniform((hidden_size, input_size), std))
+        self.weight_hh = Parameter(_uniform((hidden_size, hidden_size), std))
+        self.bias_ih = Parameter(_uniform((hidden_size,), std))
+        self.bias_hh = Parameter(_uniform((hidden_size,), std))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _step(self, pre_x, h, wih, whh, bih, bhh):
+        """pre_x: x @ wih.T + bih, already hoisted."""
+        act = jnp.tanh if self.activation == "tanh" else jax.nn.relu
+        return act(pre_x + h @ whh.T + bhh)
+
+    def _gate_params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wih, whh, bih, bhh):
+            return self._step(x @ wih.T + bih, h, wih, whh, bih, bhh)
+        h = apply("simple_rnn_cell", f, inputs, states,
+                  *self._gate_params())
+        return h, h
+
+
+class LSTMCell(RNNCellBase):
+    """Standard LSTM step (gates i, f, g, o in paddle's order)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, proj_size=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = Parameter(
+            _uniform((4 * hidden_size, input_size), std))
+        self.weight_hh = Parameter(
+            _uniform((4 * hidden_size, hidden_size), std))
+        self.bias_ih = Parameter(_uniform((4 * hidden_size,), std))
+        self.bias_hh = Parameter(_uniform((4 * hidden_size,), std))
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+    def _gate_params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def _step(self, pre_x, hc, wih, whh, bih, bhh):
+        h, c = hc
+        gates = pre_x + h @ whh.T + bhh
+        i, f, g, o = jnp.split(gates, 4, axis=-1)
+        i = jax.nn.sigmoid(i)
+        f = jax.nn.sigmoid(f)
+        g = jnp.tanh(g)
+        o = jax.nn.sigmoid(o)
+        c2 = f * c + i * g
+        h2 = o * jnp.tanh(c2)
+        return h2, c2
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, c, wih, whh, bih, bhh):
+            h2, c2 = self._step(x @ wih.T + bih, (h, c), wih, whh, bih,
+                                bhh)
+            return h2, c2
+        h, c = apply("lstm_cell", f, inputs, states[0], states[1],
+                     *self._gate_params())
+        return h, (h, c)
+
+
+class GRUCell(RNNCellBase):
+    """GRU step (gates r, z, c in paddle's layout)."""
+
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        self.weight_ih = Parameter(
+            _uniform((3 * hidden_size, input_size), std))
+        self.weight_hh = Parameter(
+            _uniform((3 * hidden_size, hidden_size), std))
+        self.bias_ih = Parameter(_uniform((3 * hidden_size,), std))
+        self.bias_hh = Parameter(_uniform((3 * hidden_size,), std))
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+    def _gate_params(self):
+        return (self.weight_ih, self.weight_hh, self.bias_ih, self.bias_hh)
+
+    def _step(self, pre_x, h, wih, whh, bih, bhh):
+        xr, xz, xc = jnp.split(pre_x, 3, axis=-1)
+        hr, hz, hc = jnp.split(h @ whh.T + bhh, 3, axis=-1)
+        r = jax.nn.sigmoid(xr + hr)
+        z = jax.nn.sigmoid(xz + hz)
+        c = jnp.tanh(xc + r * hc)
+        return (1 - z) * c + z * h
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        def f(x, h, wih, whh, bih, bhh):
+            return self._step(x @ wih.T + bih, h, wih, whh, bih, bhh)
+        h = apply("gru_cell", f, inputs, states, *self._gate_params())
+        return h, h
+
+
+def _scan_layer(cell, xs, init_states, wih, whh, bih, bhh,
+                seq_lens=None, reverse=False):
+    """One direction of one layer as a lax.scan. xs: [B, T, I] arrays.
+    Returns (outputs [B, T, H], final_states)."""
+    b, t_len = xs.shape[0], xs.shape[1]
+    # hoist the input projection: one big MXU matmul for all steps
+    pre = (xs.reshape(b * t_len, -1) @ wih.T + bih).reshape(
+        b, t_len, -1).transpose(1, 0, 2)  # [T, B, 4H?]
+    if reverse:
+        pre = pre[::-1]
+
+    is_lstm = isinstance(init_states, tuple)
+
+    def step(carry, inp):
+        pre_x, t = inp
+        new = cell._step(pre_x, carry, wih, whh, bih, bhh)
+        if seq_lens is not None:
+            # padded steps carry the previous state through
+            tt = (t_len - 1 - t) if reverse else t
+            active = (tt < seq_lens)[:, None]
+            if is_lstm:
+                new = (jnp.where(active, new[0], carry[0]),
+                       jnp.where(active, new[1], carry[1]))
+            else:
+                new = jnp.where(active, new, carry)
+        out = new[0] if is_lstm else new
+        if seq_lens is not None:
+            # outputs at padded steps are zero (reference semantics)
+            out = jnp.where(active, out, jnp.zeros_like(out))
+        return new, out
+
+    ts = jnp.arange(t_len)
+    final, outs = jax.lax.scan(step, init_states, (pre, ts))
+    outs = outs.transpose(1, 0, 2)
+    if reverse:
+        outs = outs[:, ::-1]
+    return outs, final
+
+
+class RNN(Layer):
+    """Wraps a cell into a full-sequence layer (reference RNN)."""
+
+    def __init__(self, cell, is_reverse: bool = False,
+                 time_major: bool = False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        cell = self.cell
+        if initial_states is None:
+            ref = inputs if not self.time_major else \
+                inputs.transpose([1, 0, 2])
+            initial_states = cell.get_initial_states(ref)
+        is_lstm = isinstance(initial_states, (tuple, list))
+
+        def f(xs, *arrs):
+            it = iter(arrs)
+            if is_lstm:
+                st = (next(it), next(it))
+            else:
+                st = next(it)
+            wih, whh, bih, bhh = next(it), next(it), next(it), next(it)
+            lens = next(it) if sequence_length is not None else None
+            if self.time_major:
+                xs = xs.transpose(1, 0, 2)
+            outs, final = _scan_layer(cell, xs, st, wih, whh, bih, bhh,
+                                      lens, self.is_reverse)
+            if self.time_major:
+                outs = outs.transpose(1, 0, 2)
+            if is_lstm:
+                return outs, final[0], final[1]
+            return outs, final
+
+        states = list(initial_states) if is_lstm else [initial_states]
+        args = [inputs, *states, *cell._gate_params()]
+        if sequence_length is not None:
+            args.append(sequence_length)
+        res = apply("rnn", f, *args)
+        if is_lstm:
+            return res[0], (res[1], res[2])
+        return res
+
+
+class BiRNN(Layer):
+    """Forward + backward cells, outputs concatenated (reference BiRNN)."""
+
+    def __init__(self, cell_fw, cell_bw, time_major: bool = False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        sf = sb = None
+        if initial_states is not None:
+            sf, sb = initial_states
+        out_f, st_f = self.rnn_fw(inputs, sf, sequence_length)
+        out_b, st_b = self.rnn_bw(inputs, sb, sequence_length)
+        from ...tensor.manipulation import concat
+        return concat([out_f, out_b], axis=-1), (st_f, st_b)
+
+
+class _MultiLayerRNN(Layer):
+    """Shared machinery of SimpleRNN / LSTM / GRU (reference rnn.py
+    RNNBase): num_layers stacked, optional bidirection, inter-layer
+    dropout."""
+
+    CELL = None
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation=None, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.time_major = time_major
+        self.dropout = dropout
+        self.bidirectional = direction in ("bidirect", "bidirectional")
+        num_dir = 2 if self.bidirectional else 1
+        self.is_reverse_single = direction == "backward"
+        kw = {}
+        if activation is not None and self.CELL is SimpleRNNCell:
+            kw["activation"] = activation
+        from .container import LayerList
+        self._cells = LayerList()
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else hidden_size * num_dir
+            for _ in range(num_dir):
+                self._cells.append(self.CELL(in_sz, hidden_size, **kw))
+
+    @property
+    def _num_dir(self):
+        return 2 if self.bidirectional else 1
+
+    def _state_slice(self, initial_states, idx):
+        """Slice layer*dir entry `idx` out of reference-layout initial
+        states ([num_layers*num_dir, B, H], or an (h, c) pair for
+        LSTM)."""
+        if initial_states is None:
+            return None
+        if isinstance(initial_states, (tuple, list)):
+            return tuple(s[idx] for s in initial_states)
+        return initial_states[idx]
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...nn import functional as F
+        x = inputs
+        finals = []
+        nd = self._num_dir
+        for layer in range(self.num_layers):
+            if self.bidirectional:
+                cf = self._cells[layer * nd]
+                cb = self._cells[layer * nd + 1]
+                bi = BiRNN(cf, cb, time_major=self.time_major)
+                init = None
+                if initial_states is not None:
+                    init = (self._state_slice(initial_states, layer * nd),
+                            self._state_slice(initial_states,
+                                              layer * nd + 1))
+                x, (sf, sb) = bi(x, init, sequence_length)
+                finals.extend([sf, sb])
+            else:
+                cell = self._cells[layer]
+                rnn = RNN(cell, is_reverse=self.is_reverse_single,
+                          time_major=self.time_major)
+                x, st = rnn(x, self._state_slice(initial_states, layer),
+                            sequence_length)
+                finals.append(st)
+            if self.dropout and layer < self.num_layers - 1:
+                x = F.dropout(x, p=self.dropout, training=self.training)
+
+        from ...tensor.manipulation import stack
+        if isinstance(finals[0], tuple):  # LSTM: (h, c) per layer*dir
+            h = stack([f[0] for f in finals], axis=0)
+            c = stack([f[1] for f in finals], axis=0)
+            return x, (h, c)
+        return x, stack(finals, axis=0)
+
+
+class SimpleRNN(_MultiLayerRNN):
+    CELL = SimpleRNNCell
+
+
+class LSTM(_MultiLayerRNN):
+    CELL = LSTMCell
+
+
+class GRU(_MultiLayerRNN):
+    CELL = GRUCell

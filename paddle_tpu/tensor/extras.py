@@ -213,24 +213,31 @@ def logcumsumexp(x, axis=None, name=None):
 
 def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
               name=None):
-    def f(lu, piv):
-        n = lu.shape[-2]
-        L = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1], dtype=lu.dtype)
-        U = jnp.triu(lu)
-        # pivots (1-based sequential swaps) → permutation matrices,
-        # batched over every leading dim
-        pv = np.asarray(jax.device_get(piv)).reshape(-1, piv.shape[-1])
-        perms = []
-        for row in pv:
-            perm = np.arange(n)
-            for i, p in enumerate(row[:n]):
-                j = int(p) - 1
-                perm[[i, j]] = perm[[j, i]]
-            perms.append(np.eye(n)[perm].T)
-        P = jnp.asarray(np.stack(perms).reshape(
-            piv.shape[:-1] + (n, n)), lu.dtype)
-        return P, L, U
-    P, L, U = apply_nodiff("lu_unpack", f, lu_data, lu_pivots)
+    L = U = P = None
+    if unpack_ludata:
+        def f_lu(lu):
+            n = lu.shape[-2]
+            L_ = jnp.tril(lu, -1) + jnp.eye(n, lu.shape[-1],
+                                            dtype=lu.dtype)
+            return L_, jnp.triu(lu)
+        L, U = apply_nodiff("lu_unpack_lu", f_lu, lu_data)
+    if unpack_pivots:
+        def f_p(lu, piv):
+            n = lu.shape[-2]
+            # pivots (1-based sequential swaps) → permutation matrices,
+            # batched over every leading dim
+            pv = np.asarray(jax.device_get(piv)).reshape(
+                -1, piv.shape[-1])
+            perms = []
+            for row in pv:
+                perm = np.arange(n)
+                for i, p in enumerate(row[:n]):
+                    j = int(p) - 1
+                    perm[[i, j]] = perm[[j, i]]
+                perms.append(np.eye(n)[perm].T)
+            return jnp.asarray(np.stack(perms).reshape(
+                piv.shape[:-1] + (n, n)), lu.dtype)
+        P = apply_nodiff("lu_unpack_pivots", f_p, lu_data, lu_pivots)
     return P, L, U
 
 

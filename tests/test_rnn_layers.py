@@ -1,0 +1,185 @@
+"""RNN family + long-tail layer/loss tests (reference:
+test/legacy_test/test_rnn_op.py, test_lstm/gru, loss tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+t = paddle.to_tensor
+rng = np.random.RandomState(0)
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestCells:
+    def test_simple_rnn_cell_matches_numpy(self):
+        cell = nn.SimpleRNNCell(4, 8)
+        x = rng.randn(3, 4).astype(np.float32)
+        h0 = rng.randn(3, 8).astype(np.float32)
+        out, h1 = cell(t(x), t(h0))
+        ref = np.tanh(x @ n(cell.weight_ih).T + n(cell.bias_ih)
+                      + h0 @ n(cell.weight_hh).T + n(cell.bias_hh))
+        np.testing.assert_allclose(n(out), ref, rtol=1e-5, atol=1e-6)
+        assert out is h1 or np.allclose(n(out), n(h1))
+
+    def test_lstm_cell_shapes_and_gates(self):
+        cell = nn.LSTMCell(4, 8)
+        x = t(rng.randn(3, 4).astype(np.float32))
+        out, (h, c) = cell(x)
+        assert out.shape == [3, 8] and c.shape == [3, 8]
+        # zero weights → h = o*tanh(c) with gates at sigmoid(0)=0.5
+        for p in (cell.weight_ih, cell.weight_hh, cell.bias_ih,
+                  cell.bias_hh):
+            p.set_value(np.zeros(p.shape, np.float32))
+        out2, (h2, c2) = cell(x)
+        np.testing.assert_allclose(n(c2), 0.0, atol=1e-6)
+
+    def test_gru_cell_runs(self):
+        cell = nn.GRUCell(5, 7)
+        out, h = cell(t(rng.randn(2, 5).astype(np.float32)))
+        assert out.shape == [2, 7]
+
+
+class TestRNNNetworks:
+    def test_rnn_scan_matches_stepwise(self):
+        cell = nn.SimpleRNNCell(4, 6)
+        xs = rng.randn(2, 5, 4).astype(np.float32)
+        out, final = nn.RNN(cell)(t(xs))
+        # step-by-step reference through the cell
+        h = t(np.zeros((2, 6), np.float32))
+        for i in range(5):
+            _, h = cell(t(xs[:, i]), h)
+            np.testing.assert_allclose(n(out)[:, i], n(h), rtol=1e-5,
+                                       atol=1e-5)
+        np.testing.assert_allclose(n(final), n(h), rtol=1e-5, atol=1e-5)
+
+    def test_sequence_length_masking(self):
+        cell = nn.SimpleRNNCell(3, 4)
+        xs = rng.randn(2, 6, 3).astype(np.float32)
+        lens = np.array([4, 6], np.int32)
+        out, final = nn.RNN(cell)(t(xs), sequence_length=t(lens))
+        # padded outputs are zero
+        np.testing.assert_allclose(n(out)[0, 4:], 0.0)
+        # final state of seq 0 equals the state at its last valid step
+        out_full, _ = nn.RNN(cell)(t(xs[:1, :4]))
+        np.testing.assert_allclose(n(final)[0], n(out_full)[0, -1],
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_lstm_network_and_grads(self):
+        net = nn.LSTM(4, 8, num_layers=2)
+        xs = t(rng.randn(2, 5, 4).astype(np.float32), stop_gradient=False)
+        out, (h, c) = net(xs)
+        assert out.shape == [2, 5, 8]
+        assert h.shape == [2, 2, 8] and c.shape == [2, 2, 8]
+        out.sum().backward()
+        assert xs.grad is not None
+        grads = [p.grad for p in net.parameters()]
+        assert all(g is not None for g in grads)
+
+    def test_bidirectional_gru(self):
+        net = nn.GRU(4, 8, direction="bidirect")
+        out, h = net(t(rng.randn(2, 5, 4).astype(np.float32)))
+        assert out.shape == [2, 5, 16]
+        assert h.shape == [2, 2, 8]
+
+    def test_birnn_concat(self):
+        cf, cb = nn.SimpleRNNCell(3, 4), nn.SimpleRNNCell(3, 4)
+        out, (sf, sb) = nn.BiRNN(cf, cb)(
+            t(rng.randn(2, 5, 3).astype(np.float32)))
+        assert out.shape == [2, 5, 8]
+
+
+class TestExtraLayers:
+    def test_zeropad_unflatten_softmax2d(self):
+        x = t(rng.randn(1, 2, 3, 3).astype(np.float32))
+        padded = nn.ZeroPad2D([1, 2, 0, 1])(x)
+        assert padded.shape == [1, 2, 4, 6]
+        u = nn.Unflatten(1, [1, 2])(x)
+        assert u.shape == [1, 1, 2, 3, 3]
+        s = nn.Softmax2D()(x)
+        np.testing.assert_allclose(n(s).sum(1), 1.0, rtol=1e-5)
+
+    def test_pairwise_distance(self):
+        a = rng.randn(4, 6).astype(np.float32)
+        b = rng.randn(4, 6).astype(np.float32)
+        d = nn.PairwiseDistance()(t(a), t(b))
+        np.testing.assert_allclose(n(d),
+                                   np.linalg.norm(a - b + 1e-6, axis=1),
+                                   rtol=1e-4)
+
+    def test_max_unpool2d_roundtrip(self):
+        from paddle_tpu.nn import functional as F
+        x = t(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+        pooled, idx = F.max_pool2d(x, 2, 2, return_mask=True)
+        un = nn.MaxUnPool2D(2, 2)(pooled, idx)
+        assert un.shape == [1, 1, 4, 4]
+        want = np.zeros((1, 1, 4, 4), np.float32)
+        want[0, 0, 1, 1], want[0, 0, 1, 3] = 5, 7
+        want[0, 0, 3, 1], want[0, 0, 3, 3] = 13, 15
+        np.testing.assert_allclose(n(un), want)
+
+
+class TestExtraLosses:
+    def test_ctc_loss_simple_alignment(self):
+        # T=2, C=3 (blank=0): target "1"; paths: [1,blank],[blank,1],[1,1]
+        logits = np.log(np.array([
+            [[0.2, 0.7, 0.1]],
+            [[0.5, 0.4, 0.1]],
+        ], np.float32))
+        labels = np.array([[1]], np.int64)
+        loss = nn.CTCLoss(blank=0, reduction="none")(
+            t(logits), t(labels), t(np.array([2])), t(np.array([1])))
+        p = 0.7 * 0.5 + 0.2 * 0.4 + 0.7 * 0.4
+        np.testing.assert_allclose(float(n(loss)[0]), -np.log(p),
+                                   rtol=1e-4)
+
+    def test_ctc_loss_differentiable(self):
+        logits = t(rng.randn(6, 2, 5).astype(np.float32),
+                   stop_gradient=False)
+        labels = t(rng.randint(1, 5, (2, 3)).astype(np.int64))
+        loss = nn.CTCLoss()(logits, labels, t(np.array([6, 5])),
+                            t(np.array([3, 2])))
+        loss.backward()
+        assert logits.grad is not None
+        assert np.isfinite(n(logits.grad)).all()
+
+    def test_soft_margin_and_multilabel(self):
+        x = rng.randn(4, 3).astype(np.float32)
+        y = np.sign(rng.randn(4, 3)).astype(np.float32)
+        out = nn.SoftMarginLoss()(t(x), t(y))
+        np.testing.assert_allclose(float(n(out)),
+                                   np.log1p(np.exp(-y * x)).mean(),
+                                   rtol=1e-5)
+        yl = (rng.rand(4, 3) > 0.5).astype(np.float32)
+        ml = nn.MultiLabelSoftMarginLoss()(t(x), t(yl))
+        assert np.isfinite(float(n(ml)))
+
+    def test_multi_margin_and_triplet(self):
+        x = rng.randn(5, 4).astype(np.float32)
+        y = rng.randint(0, 4, 5).astype(np.int64)
+        mm = nn.MultiMarginLoss()(t(x), t(y))
+        assert float(n(mm)) >= 0
+        a, p, ng = (rng.randn(3, 8).astype(np.float32) for _ in range(3))
+        tl = nn.TripletMarginWithDistanceLoss()(t(a), t(p), t(ng))
+        assert float(n(tl)) >= 0
+
+    def test_gaussian_nll(self):
+        mu = rng.randn(4).astype(np.float32)
+        y = rng.randn(4).astype(np.float32)
+        var = np.abs(rng.randn(4)).astype(np.float32) + 0.1
+        out = nn.GaussianNLLLoss()(t(mu), t(y), t(var))
+        ref = 0.5 * (np.log(var) + (y - mu) ** 2 / var)
+        np.testing.assert_allclose(float(n(out)), ref.mean(), rtol=1e-5)
+
+    def test_hsigmoid_loss(self):
+        layer = nn.HSigmoidLoss(feature_size=6, num_classes=8)
+        x = t(rng.randn(4, 6).astype(np.float32), stop_gradient=False)
+        y = t(rng.randint(0, 8, 4).astype(np.int64))
+        loss = layer(x, y)
+        assert loss.shape == [4, 1]
+        assert (n(loss) > 0).all()
+        loss.sum().backward()
+        assert x.grad is not None
