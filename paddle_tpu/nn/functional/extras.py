@@ -1,0 +1,455 @@
+"""Remaining nn.functional parity (reference
+python/paddle/nn/functional/): unpooling, extra losses, grid sampling,
+sequence utilities, in-place activation aliases."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.core import Tensor, apply, apply_nodiff
+from . import activation as A
+
+__all__ = [
+    "max_unpool1d", "max_unpool2d", "max_unpool3d",
+    "fractional_max_pool2d", "fractional_max_pool3d",
+    "gaussian_nll_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss",
+    "triplet_margin_with_distance_loss", "pairwise_distance",
+    "hsigmoid_loss", "zeropad2d", "sequence_mask", "dice_loss",
+    "npair_loss", "temporal_shift", "bilinear", "affine_grid",
+    "grid_sample", "gather_tree", "margin_cross_entropy", "rnnt_loss",
+    "sparse_attention",
+    "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+# -- unpooling (layer impls already exist; functional forms) ----------------
+
+def _unpool(nd):
+    def fn(x, indices, kernel_size, stride=None, padding=0,
+           data_format=None, output_size=None, name=None):
+        from ..layer.extras import MaxUnPool1D, MaxUnPool2D, MaxUnPool3D
+        cls = {1: MaxUnPool1D, 2: MaxUnPool2D, 3: MaxUnPool3D}[nd]
+        return cls(kernel_size, stride, padding,
+                   output_size=output_size)(x, indices)
+    fn.__name__ = f"max_unpool{nd}d"
+    return fn
+
+
+max_unpool1d = _unpool(1)
+max_unpool2d = _unpool(2)
+max_unpool3d = _unpool(3)
+
+
+def _fractional_pool(nd):
+    def fn(x, output_size, kernel_size=None, random_u=None,
+           return_mask=False, name=None):
+        """Fractional max pool (reference fractional_max_pool2d/3d):
+        pseudo-random pooling regions hitting an exact output size. The
+        deterministic variant uses the u-sequence formula with a fixed
+        (or provided) u."""
+        out_sz = output_size if isinstance(output_size, (tuple, list)) \
+            else (output_size,) * nd
+        u = 0.5 if random_u is None else float(random_u)
+
+        def f(a):
+            spatial = a.shape[-nd:]
+            idxs = []
+            for i, (n_in, n_out) in enumerate(zip(spatial, out_sz)):
+                alpha = n_in / n_out
+                ks = [int(math.ceil(alpha * (k + u))) -
+                      int(math.ceil(alpha * u)) for k in range(n_out + 1)]
+                edges = np.minimum(ks, n_in)
+                idxs.append(edges)
+            out = a
+            # pool each spatial dim by segment max
+            for d in range(nd):
+                ax = a.ndim - nd + d
+                edges = idxs[d]
+                segs = []
+                for k in range(out_sz[d]):
+                    lo, hi = edges[k], max(edges[k + 1], edges[k] + 1)
+                    seg = jax.lax.slice_in_dim(out, lo, hi, axis=ax)
+                    segs.append(seg.max(axis=ax, keepdims=True))
+                out = jnp.concatenate(segs, axis=ax)
+            return out
+
+        if return_mask:
+            raise NotImplementedError(
+                f"fractional_max_pool{nd}d(return_mask=True): indices "
+                f"for fractional regions are not implemented; use "
+                f"max_pool{nd}d for unpooling workflows")
+        return apply(f"fractional_max_pool{nd}d", f, x)
+    fn.__name__ = f"fractional_max_pool{nd}d"
+    return fn
+
+
+fractional_max_pool2d = _fractional_pool(2)
+fractional_max_pool3d = _fractional_pool(3)
+
+
+# -- losses (functional forms of the new layers) ----------------------------
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    from ..layer.extras import GaussianNLLLoss
+    return GaussianNLLLoss(full, epsilon, reduction)(input, label,
+                                                     variance)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    from ..layer.extras import SoftMarginLoss
+    return SoftMarginLoss(reduction)(input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    from ..layer.extras import MultiLabelSoftMarginLoss
+    return MultiLabelSoftMarginLoss(weight, reduction)(input, label)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    from ..layer.extras import MultiMarginLoss
+    return MultiMarginLoss(p, margin, weight, reduction)(input, label)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    from ..layer.extras import TripletMarginWithDistanceLoss
+    return TripletMarginWithDistanceLoss(
+        distance_function, margin, swap, reduction)(input, positive,
+                                                    negative)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+    from ..layer.extras import PairwiseDistance
+    return PairwiseDistance(p, epsilon, keepdim)(x, y)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Functional hierarchical sigmoid using caller-provided weights
+    (reference F.hsigmoid_loss)."""
+    from ..layer.extras import HSigmoidLoss, _hsigmoid_tree_tables
+    layer = HSigmoidLoss.__new__(HSigmoidLoss)
+    from ..layer.layers import Layer
+    Layer.__init__(layer)
+    layer.num_classes = num_classes
+    layer.weight = weight
+    layer.bias = bias if bias is not None else \
+        Tensor(jnp.zeros((num_classes - 1,), jnp.float32))
+    layer._table, layer._code, layer._valid = \
+        _hsigmoid_tree_tables(num_classes)
+    return layer(input, label)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """Dice loss over softmaxed predictions (reference dice_loss:
+    input [N, ..., C] probabilities, label [N, ..., 1] ints)."""
+    def f(x, y):
+        num_classes = x.shape[-1]
+        y1 = jax.nn.one_hot(y[..., 0], num_classes, dtype=x.dtype)
+        red = tuple(range(1, x.ndim))
+        inter = (x * y1).sum(red)
+        union = x.sum(red) + y1.sum(red)
+        return (1 - (2 * inter + epsilon) / (union + epsilon)).mean()
+    return apply("dice_loss", f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair loss (reference npair_loss)."""
+    def f(a, p, y):
+        logits = a @ p.T
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / eq.sum(axis=1, keepdims=True)
+        logp = jax.nn.log_softmax(logits, axis=1)
+        ce = -(tgt * logp).sum(1).mean()
+        reg = l2_reg * ((a * a).sum(1) + (p * p).sum(1)).mean() * 0.25
+        return ce + reg
+    return apply("npair_loss", f, anchor, positive, labels)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (reference margin_cross_entropy:
+    cos(m1*θ + m2) - m3 on the target logit)."""
+    def f(lg, y):
+        n, c = lg.shape
+        yi = y.astype(jnp.int32)
+        # arccos only on the GATHERED target logit, clipped strictly
+        # inside (-1, 1): arccos' derivative is infinite at ±1, and
+        # normalized-embedding logits routinely hit exactly 1.0 — the
+        # inf would leak through where() as NaN for the whole row
+        eps = 1e-6
+        tgt_cos = jnp.take_along_axis(lg, yi[:, None], axis=1)[:, 0]
+        theta = jnp.arccos(jnp.clip(tgt_cos, -1.0 + eps, 1.0 - eps))
+        tgt = jnp.cos(margin1 * theta + margin2) - margin3
+        onehot = jax.nn.one_hot(yi, c, dtype=lg.dtype)
+        out = (lg * (1 - onehot) + tgt[:, None] * onehot) * scale
+        logp = jax.nn.log_softmax(out, axis=1)
+        nll = -jnp.take_along_axis(logp, yi[:, None], axis=1)[:, 0]
+        return nll, jnp.exp(logp)
+    loss, sm = apply("margin_cross_entropy", f, logits, label)
+    if reduction == "mean":
+        loss = loss.mean()
+    elif reduction == "sum":
+        loss = loss.sum()
+    if return_softmax:
+        return loss, sm
+    return loss
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.001, reduction="mean", name=None):
+    """RNN-T transducer loss (reference rnnt_loss over warprnnt): the
+    log-space alpha recursion over (t, u) as a lax.scan over t with a
+    cumulative-logsumexp sweep over u inside each step."""
+    def f(logits, lab, t_len, u_len):
+        # logits: [B, T, U+1, C]; lab: [B, U]
+        b, t_max, u1, c = logits.shape
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        blank_lp = lp[..., blank]                     # [B, T, U+1]
+        lab_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :],
+            lab[:, None, :, None].astype(jnp.int32), axis=3)[..., 0]
+        # pad so emit at u reads lab_lp[:, t, u]     # [B, T, U]
+        neg = -1e30
+
+        def step(alpha, t):
+            # alpha: [B, U+1] at time t-1 → time t.
+            # blank move first: stay[u] = alpha[u] + blank(t-1, u); then
+            # the emit recursion along u:
+            #   alpha_t[u] = logaddexp(stay[u], alpha_t[u-1] + emit(t, u-1))
+            stay = alpha + blank_lp[:, t - 1, :]
+            emits = lab_lp[:, t, :]                  # [B, U]
+
+            def u_step(prev, inp):
+                stay_u, emit_u = inp
+                cur = jnp.logaddexp(stay_u, prev + emit_u)
+                return cur, cur
+
+            first = stay[:, 0]
+            _, rest = jax.lax.scan(
+                u_step, first,
+                (stay[:, 1:].T, emits.T))
+            new = jnp.concatenate([first[:, None], rest.T], axis=1)
+            return jnp.where((t < t_len)[:, None], new, alpha), None
+
+        # t=0 row: alpha[0,0]=0; alpha[0,u] = sum emits
+        emits0 = lab_lp[:, 0, :]
+        a0 = jnp.concatenate(
+            [jnp.zeros((b, 1)), jnp.cumsum(emits0, axis=1)], axis=1)
+        alpha, _ = jax.lax.scan(step, a0, jnp.arange(1, t_max))
+        # total: alpha[t_len-1, u_len] + blank at (t_len-1, u_len)
+        ti = jnp.maximum(t_len - 1, 0)
+        final = jnp.take_along_axis(alpha, u_len[:, None], axis=1)[:, 0]
+        final_blank = blank_lp[jnp.arange(b), ti, u_len]
+        return -(final + final_blank)
+
+    loss = apply("rnnt_loss", f, input, label, input_lengths,
+                 label_lengths)
+    if reduction == "mean":
+        return loss.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
+
+
+# -- spatial / sequence utilities ------------------------------------------
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ..layer.extras import ZeroPad2D
+    return ZeroPad2D(padding, data_format)(x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    """[..., maxlen] mask of positions < length (reference
+    sequence_mask)."""
+    from ...framework import dtype as dtypes
+    d = dtypes.convert_dtype(dtype)
+
+    def f(lens):
+        m = maxlen or int(jax.device_get(lens).max())
+        return (jnp.arange(m)[None, :] <
+                lens[..., None]).astype(d)
+    return apply_nodiff("sequence_mask", f, x)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM temporal shift (reference temporal_shift): shift a fraction
+    of channels one step along time within each segment."""
+    def f(a):
+        if data_format == "NHWC":
+            a = jnp.moveaxis(a, -1, 1)
+        nt, c, h, w = a.shape
+        n = nt // seg_num
+        v = a.reshape(n, seg_num, c, h, w)
+        c1 = int(c * shift_ratio)
+        c2 = int(c * 2 * shift_ratio)
+        fwd = jnp.concatenate(
+            [v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])], axis=1)
+        bwd = jnp.concatenate(
+            [jnp.zeros_like(v[:, :1, c1:c2]), v[:, :-1, c1:c2]], axis=1)
+        keep = v[:, :, c2:]
+        out = jnp.concatenate([fwd, bwd, keep], axis=2)
+        out = out.reshape(nt, c, h, w)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    return apply("temporal_shift", f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """Bilinear transform out[n, k] = x1ᵀ W_k x2 (reference bilinear)."""
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bi,kij,bj->bk", a, w, b)
+        if rest:
+            out = out + rest[0]
+        return out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply("bilinear", f, *args)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2D affine sampling grid (reference affine_grid): theta [N, 2, 3]
+    → grid [N, H, W, 2]."""
+    n, c, h, w = out_shape
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1, 1, h)
+            xs = jnp.linspace(-1, 1, w)
+        else:
+            ys = (jnp.arange(h) * 2 + 1) / h - 1
+            xs = (jnp.arange(w) * 2 + 1) / w - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1)     # [H, W, 3]
+        return jnp.einsum("nij,hwj->nhwi", th, base)  # [N, H, W, 2]
+    return apply("affine_grid", f, theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at grid [N,Ho,Wo,2] of xy coords in [-1,1]
+    (reference grid_sample). Differentiable bilinear gather."""
+    def f(a, g):
+        n, c, h, w = a.shape
+        gx = g[..., 0]
+        gy = g[..., 1]
+        if align_corners:
+            fx = (gx + 1) * (w - 1) / 2
+            fy = (gy + 1) * (h - 1) / 2
+        else:
+            fx = ((gx + 1) * w - 1) / 2
+            fy = ((gy + 1) * h - 1) / 2
+
+        def sample(ix, iy):
+            inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
+            iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
+            vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]
+            # vals: [N, Ho, Wo, C]
+            if padding_mode == "zeros":
+                vals = vals * inb[..., None]
+            return vals
+
+        if mode == "nearest":
+            out = sample(jnp.round(fx), jnp.round(fy))
+        else:
+            x0 = jnp.floor(fx)
+            y0 = jnp.floor(fy)
+            wx = fx - x0
+            wy = fy - y0
+            out = (sample(x0, y0) * ((1 - wx) * (1 - wy))[..., None]
+                   + sample(x0 + 1, y0) * (wx * (1 - wy))[..., None]
+                   + sample(x0, y0 + 1) * ((1 - wx) * wy)[..., None]
+                   + sample(x0 + 1, y0 + 1) * (wx * wy)[..., None])
+        return jnp.moveaxis(out, -1, 1)  # [N, C, Ho, Wo]
+    return apply("grid_sample", f, x, grid)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (reference gather_tree): ids/parents
+    [T, B, beam] → full sequences."""
+    def f(idw, par):
+        t_max = idw.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam index per slot
+            tok = jnp.take_along_axis(idw[t], beams, axis=1)
+            prev = jnp.take_along_axis(par[t], beams, axis=1)
+            return prev, tok
+
+        init = jnp.broadcast_to(jnp.arange(idw.shape[2])[None, :],
+                                idw.shape[1:])
+        _, toks = jax.lax.scan(step, init, jnp.arange(t_max - 1, -1, -1))
+        return toks[::-1]
+    return apply_nodiff("gather_tree", f, ids, parents)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention (reference binds a CUDA kernel). On TPU a
+    mask-materialized flash path is both simpler and faster for the
+    sizes this API targets; the CSR pattern becomes an additive mask."""
+    def f(q, k, v, off, cols):
+        b, h, s, d = q.shape
+        # CSR → dense mask (host loop over rows is static per pattern)
+        offs = np.asarray(jax.device_get(off)).reshape(-1, s + 1)
+        colz = np.asarray(jax.device_get(cols)).reshape(offs.shape[0], -1)
+        allow = np.zeros((offs.shape[0], s, s), bool)
+        for bi in range(offs.shape[0]):
+            for r in range(s):
+                cs = colz[bi, offs[bi, r]:offs[bi, r + 1]]
+                allow[bi, r, cs] = True
+        amask = jnp.asarray(allow)[:, None, :, :]
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
+        scores = jnp.where(amask, scores, -1e30)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    return apply("sparse_attention", f, query, key, value,
+                 sparse_csr_offset, sparse_csr_columns)
+
+
+# -- in-place activation aliases -------------------------------------------
+
+def _inplace(fn_name):
+    base = getattr(A, fn_name)
+
+    def fn(x, *args, **kwargs):
+        # record the op against a SNAPSHOT of x, then overwrite x: if the
+        # new node's input were x itself, x._node would point at a node
+        # listing x as input (a self-cycle) and backward would silently
+        # drop all upstream gradients.
+        snap = Tensor(x._value, stop_gradient=x.stop_gradient)
+        snap._node = x._node
+        snap._out_idx = x._out_idx
+        out = base(snap, *args, **kwargs)
+        x._value = out._value
+        x._node = out._node
+        x._out_idx = out._out_idx
+        x.stop_gradient = out.stop_gradient
+        return x
+    fn.__name__ = fn_name + "_"
+    return fn
+
+
+elu_ = _inplace("elu")
+hardtanh_ = _inplace("hardtanh")
+leaky_relu_ = _inplace("leaky_relu")
+softmax_ = _inplace("softmax")
+tanh_ = _inplace("tanh")
+thresholded_relu_ = _inplace("thresholded_relu")

@@ -22,13 +22,21 @@ def _t(v, n):
     return tuple(int(e) for e in v)
 
 
-def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode,
-          exclusive=True):
-    k = _t(kernel, n)
-    s = _t(stride if stride is not None else kernel, n)
+def _explicit_pads(padding, n, spatial, k, s, ceil_mode):
+    """Resolve paddle's padding forms (int, per-dim, pair-list, 'SAME'/
+    'VALID') plus ceil_mode into explicit per-dim (lo, hi) pairs. ceil
+    mode adds high padding so reduce_window emits ceil((in+p-k)/s)+1
+    windows (reference output-shape semantics)."""
     if isinstance(padding, str):
-        pad_mode = padding.upper()
-        pads = None
+        m = padding.upper()
+        if m == "VALID":
+            pads = [(0, 0)] * n
+        else:  # SAME
+            pads = []
+            for i in range(n):
+                out = -(-spatial[i] // s[i])
+                total = max((out - 1) * s[i] + k[i] - spatial[i], 0)
+                pads.append((total // 2, total - total // 2))
     else:
         p = _t(padding, n) if not isinstance(padding, (list, tuple)) or \
             all(isinstance(e, int) for e in padding) else padding
@@ -36,35 +44,48 @@ def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode,
             pads = [(e, e) for e in p]
         else:
             pads = [tuple(e) for e in p]
-        pad_mode = None
+    if ceil_mode:
+        out = []
+        for i in range(n):
+            lo, hi = pads[i]
+            eff = spatial[i] + lo + hi - k[i]
+            out_c = -(-eff // s[i]) + 1
+            extra = (out_c - 1) * s[i] + k[i] - (spatial[i] + lo + hi)
+            out.append((lo, hi + max(extra, 0)))
+        pads = out
+    return pads
+
+
+def _pool(x, kernel, stride, padding, n, channel_last, kind, ceil_mode,
+          exclusive=True):
+    k = _t(kernel, n)
+    s = _t(stride if stride is not None else kernel, n)
 
     if channel_last:
         window = (1,) + k + (1,)
         strides = (1,) + s + (1,)
-        full_pads = ([(0, 0)] + pads + [(0, 0)]) if pads is not None else None
     else:
         window = (1, 1) + k
         strides = (1, 1) + s
-        full_pads = ([(0, 0), (0, 0)] + pads) if pads is not None else None
 
     def f(a):
-        if pad_mode is not None:
-            pcfg = pad_mode
-        else:
-            pcfg = full_pads
+        spatial = a.shape[1:-1] if channel_last else a.shape[2:]
+        pads = _explicit_pads(padding, n, spatial, k, s, ceil_mode)
+        full_pads = ([(0, 0)] + pads + [(0, 0)]) if channel_last \
+            else ([(0, 0), (0, 0)] + pads)
         if kind == "max":
-            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) else jnp.iinfo(a.dtype).min
-            return jax.lax.reduce_window(a, jnp.asarray(init, a.dtype).item() if isinstance(init, jnp.ndarray) else init,
-                                         jax.lax.max, window, strides,
-                                         pcfg if not isinstance(pcfg, str) else pcfg)
+            init = -jnp.inf if jnp.issubdtype(a.dtype, jnp.floating) \
+                else jnp.iinfo(a.dtype).min
+            return jax.lax.reduce_window(a, init, jax.lax.max, window,
+                                         strides, full_pads)
         # avg
-        summed = jax.lax.reduce_window(a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0,
-                                       jax.lax.add, window, strides,
-                                       pcfg if not isinstance(pcfg, str) else pcfg)
-        if exclusive and pcfg not in ("VALID",):
+        summed = jax.lax.reduce_window(
+            a, 0.0 if jnp.issubdtype(a.dtype, jnp.floating) else 0,
+            jax.lax.add, window, strides, full_pads)
+        if exclusive and any(p != (0, 0) for p in pads):
             ones = jnp.ones_like(a)
-            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window,
-                                           strides, pcfg)
+            counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                           window, strides, full_pads)
             return summed / counts
         denom = float(np.prod(k))
         return summed / denom
@@ -78,7 +99,7 @@ def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 "max", ceil_mode)
     if return_mask:
         idx = _max_pool_indices(x, kernel_size, stride, padding, 1,
-                                data_format == "NLC")
+                                data_format == "NLC", ceil_mode)
         return out, idx
     return out
 
@@ -89,7 +110,7 @@ def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 "max", ceil_mode)
     if return_mask:
         idx = _max_pool_indices(x, kernel_size, stride, padding, 2,
-                                data_format == "NHWC")
+                                data_format == "NHWC", ceil_mode)
         return out, idx
     return out
 
@@ -100,7 +121,7 @@ def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                 "max", ceil_mode)
     if return_mask:
         idx = _max_pool_indices(x, kernel_size, stride, padding, 3,
-                                data_format == "NDHWC")
+                                data_format == "NDHWC", ceil_mode)
         return out, idx
     return out
 
@@ -125,7 +146,8 @@ def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
                  "avg", ceil_mode, exclusive)
 
 
-def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
+def _max_pool_indices(x, kernel, stride, padding, n, channel_last,
+                      ceil_mode=False):
     """Flat spatial index (row-major over the input's spatial dims) of
     each window's max — the contract MaxUnPoolND consumes (reference
     return_mask semantics). Computed as a reduce_window argmax: the
@@ -133,7 +155,6 @@ def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
     value's index."""
     k = _t(kernel, n)
     s = _t(stride if stride is not None else kernel, n)
-    p = _t(padding, n)
 
     def f(a):
         if channel_last:
@@ -146,7 +167,8 @@ def _max_pool_indices(x, kernel, stride, padding, n, channel_last):
             a.dtype, jnp.integer) else jnp.finfo(a.dtype).min
         dims = (1, 1) + tuple(k)
         strides = (1, 1) + tuple(s)
-        pads = ((0, 0), (0, 0)) + tuple((pi, pi) for pi in p)
+        pads = ((0, 0), (0, 0)) + tuple(
+            _explicit_pads(padding, n, spatial, k, s, ceil_mode))
 
         def reducer(x1, x2):
             v1, i1 = x1

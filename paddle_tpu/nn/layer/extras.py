@@ -337,6 +337,34 @@ class TripletMarginWithDistanceLoss(Layer):
         return out
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=32)
+def _hsigmoid_tree_tables(num_classes: int):
+    """Complete-binary-tree (path_table, path_code, valid) arrays —
+    shared by the HSigmoidLoss layer and F.hsigmoid_loss, cached since
+    they depend only on num_classes."""
+    import numpy as np
+    C = num_classes
+    depth = max(1, math.ceil(math.log2(max(C, 2))))
+    table = np.zeros((C, depth), np.int32)
+    code = np.zeros((C, depth), np.float32)
+    valid = np.zeros((C, depth), np.float32)
+    for cls in range(C):
+        node = cls + C - 1  # leaf id in heap order
+        path = []
+        while node > 0:
+            parent = (node - 1) // 2
+            path.append((parent, float(node == 2 * parent + 2)))
+            node = parent
+        for dpt, (p, bit) in enumerate(reversed(path)):
+            table[cls, dpt] = p
+            code[cls, dpt] = bit
+            valid[cls, dpt] = 1.0
+    return table, code, valid
+
+
 class HSigmoidLoss(Layer):
     """Hierarchical sigmoid over a default complete binary tree
     (reference HSigmoidLoss without custom paths: feature_size →
@@ -357,27 +385,8 @@ class HSigmoidLoss(Layer):
         self.weight = Parameter(
             jax.random.uniform(k, (n_inner, d), jnp.float32, -std, std))
         self.bias = Parameter(jnp.zeros((n_inner,), jnp.float32))
-        # complete-binary-tree paths depend only on num_classes: build
-        # ONCE here (per-forward this O(C*depth) python loop would
-        # dominate step time at real vocab sizes)
-        import numpy as np
-        C = num_classes
-        depth = max(1, math.ceil(math.log2(max(C, 2))))
-        table = np.zeros((C, depth), np.int32)
-        code = np.zeros((C, depth), np.float32)
-        valid = np.zeros((C, depth), np.float32)
-        for cls in range(C):
-            node = cls + C - 1  # leaf id in heap order
-            path = []
-            while node > 0:
-                parent = (node - 1) // 2
-                path.append((parent, float(node == 2 * parent + 2)))
-                node = parent
-            for dpt, (p, bit) in enumerate(reversed(path)):
-                table[cls, dpt] = p
-                code[cls, dpt] = bit
-                valid[cls, dpt] = 1.0
-        self._table, self._code, self._valid = table, code, valid
+        self._table, self._code, self._valid = \
+            _hsigmoid_tree_tables(num_classes)
 
     def forward(self, input, label, path_table=None, path_code=None):
         table, code, valid = self._table, self._code, self._valid

@@ -92,6 +92,10 @@ class LSTMCell(RNNCellBase):
                  weight_hh_attr=None, bias_ih_attr=None,
                  bias_hh_attr=None, proj_size=None, name=None):
         super().__init__()
+        if proj_size:
+            raise NotImplementedError(
+                "LSTMCell proj_size (projected LSTM) is not implemented; "
+                "silently ignoring it would compute a different model")
         self.input_size = input_size
         self.hidden_size = hidden_size
         std = 1.0 / math.sqrt(hidden_size)

@@ -7,3 +7,4 @@ from .optimizer import (  # noqa: F401
 from .clip import (  # noqa: F401
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )
+from .lbfgs import LBFGS, Rprop  # noqa: F401

@@ -1,0 +1,194 @@
+"""LBFGS and Rprop optimizers (reference python/paddle/optimizer/
+lbfgs.py, rprop.py).
+
+LBFGS is eager-by-nature (it re-evaluates the loss via a closure during
+line search), so unlike the functional SGD/Adam family its step() takes
+a closure — exactly the reference's API. The two-loop recursion runs on
+device arrays; only the strong-Wolfe bracketing logic is host-side
+control flow."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS", "Rprop"]
+
+
+def _flat(arrs):
+    return jnp.concatenate([a.reshape(-1).astype(jnp.float32)
+                            for a in arrs])
+
+
+class LBFGS(Optimizer):
+    """Limited-memory BFGS with strong-Wolfe line search (reference
+    lbfgs.py LBFGS). Usage:
+
+        opt = LBFGS(parameters=model.parameters(), history_size=10)
+        def closure():
+            opt.clear_grad()
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            return loss
+        opt.step(closure)
+    """
+
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9,
+                 history_size=100, line_search_fn=None, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval or max_iter * 5 // 4
+        self.tol_grad = tolerance_grad
+        self.tol_change = tolerance_change
+        self.history_size = history_size
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+
+    def _gather(self):
+        params = self._parameter_list
+        x = _flat([p._value for p in params])
+        g = _flat([p.grad._value if p.grad is not None
+                   else jnp.zeros_like(p._value) for p in params])
+        return x, g
+
+    def _scatter(self, x):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._value.shape))
+            p._replace(x[off:off + n].reshape(p._value.shape).astype(
+                p._value.dtype))
+            off += n
+
+    def _direction(self, g):
+        """Two-loop recursion over the (s, y) history."""
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.maximum(jnp.vdot(y, s), 1e-10)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho, s, y))
+        if self._y:
+            y_last, s_last = self._y[-1], self._s[-1]
+            gamma = jnp.vdot(s_last, y_last) / jnp.maximum(
+                jnp.vdot(y_last, y_last), 1e-10)
+            q = q * gamma
+        for a, rho, s, y in reversed(alphas):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    def step(self, closure: Callable):
+        loss = closure()
+        x, g = self._gather()
+        if float(jnp.abs(g).max()) <= self.tol_grad:
+            return loss
+        evals = 1
+        for _ in range(self.max_iter):
+            d = self._direction(g)
+            t = float(self._learning_rate) if not self._s else 1.0
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -1e-15:  # not a descent direction: reset memory
+                self._s.clear()
+                self._y.clear()
+                d = -g
+                gtd = float(jnp.vdot(g, d))
+
+            f0 = float(loss.numpy() if isinstance(loss, Tensor) else loss)
+            # backtracking (Armijo) line search; strong_wolfe tightens
+            # with a curvature check like the reference
+            success = False
+            for _ls in range(20):
+                self._scatter(x + t * d)
+                loss_new = closure()
+                evals += 1
+                f1 = float(loss_new.numpy()
+                           if isinstance(loss_new, Tensor) else loss_new)
+                if f1 <= f0 + 1e-4 * t * gtd:
+                    if self.line_search_fn == "strong_wolfe":
+                        _, g_new = self._gather()
+                        if abs(float(jnp.vdot(g_new, d))) <= \
+                                0.9 * abs(gtd):
+                            success = True
+                            break
+                        t *= 1.5 if float(jnp.vdot(g_new, d)) < 0 else 0.5
+                        continue
+                    success = True
+                    break
+                t *= 0.5
+            if not success:
+                self._scatter(x)  # restore
+                return loss
+            x_new, g_new = self._gather()
+            s = x_new - x
+            ygap = g_new - g
+            if float(jnp.vdot(s, ygap)) > 1e-10:
+                self._s.append(s)
+                self._y.append(ygap)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0)
+                    self._y.pop(0)
+            delta = float(jnp.abs(s).max())
+            x, g, loss = x_new, g_new, loss_new
+            if delta < self.tol_change or \
+                    float(jnp.abs(g).max()) <= self.tol_grad or \
+                    evals >= self.max_eval:
+                break
+        return loss
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (reference rprop.py): per-weight step sizes
+    grown/shrunk by gradient sign agreement; gradients' magnitudes are
+    ignored. Full-batch method, per the reference docs."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name,
+                         multi_precision=multi_precision)
+        self.lr_min, self.lr_max = learning_rate_range
+        self.eta_neg, self.eta_pos = etas
+
+    def _init_state_impl(self, params):
+        lr0 = float(self._learning_rate) if not callable(
+            getattr(self._learning_rate, "get_lr", None)) else \
+            self._learning_rate.get_lr()
+        return {
+            "step_size": [jnp.full(p.shape, lr0, jnp.float32)
+                          for p in params],
+            "prev_grad": [jnp.zeros(p.shape, jnp.float32)
+                          for p in params],
+        }
+
+    def _update_impl(self, params, grads, state, lr):
+        new_p, new_sz, new_pg = [], [], []
+        for p, g, sz, pg in zip(params, grads, state["step_size"],
+                                state["prev_grad"]):
+            if g is None:
+                new_p.append(None)
+                new_sz.append(sz)
+                new_pg.append(pg)
+                continue
+            g = g.astype(jnp.float32)
+            sign = jnp.sign(g * pg)
+            sz2 = jnp.clip(
+                jnp.where(sign > 0, sz * self.eta_pos,
+                          jnp.where(sign < 0, sz * self.eta_neg, sz)),
+                self.lr_min, self.lr_max)
+            # on sign flip: no step, zero the remembered grad
+            g_eff = jnp.where(sign < 0, 0.0, g)
+            step = sz2 * jnp.sign(g_eff)
+            new_p.append((p.astype(jnp.float32) - step).astype(p.dtype))
+            new_sz.append(sz2)
+            new_pg.append(g_eff)
+        return new_p, {"step_size": new_sz, "prev_grad": new_pg}

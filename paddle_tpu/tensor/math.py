@@ -354,7 +354,12 @@ def log_normalize(x, axis=-1):
 
 def _inplace(fn):
     def op(x, y, name=None):
-        out = fn(x, y)
+        # snapshot x before recording: a node whose input is the tensor
+        # being overwritten would self-cycle and sever upstream grads
+        snap = Tensor(x._value, stop_gradient=x.stop_gradient)
+        snap._node = x._node
+        snap._out_idx = x._out_idx
+        out = fn(snap, y)
         x._value = out._value
         x._node = out._node
         x._out_idx = out._out_idx

@@ -1,0 +1,166 @@
+"""nn.functional long-tail tests + LBFGS/Rprop optimizers."""
+import itertools
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+from paddle_tpu.nn import functional as F
+
+t = paddle.to_tensor
+rng = np.random.RandomState(0)
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestSpatial:
+    def test_grid_sample_identity(self):
+        x = t(rng.rand(1, 2, 5, 5).astype(np.float32))
+        theta = t(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 2, 5, 5])
+        out = F.grid_sample(x, grid)
+        np.testing.assert_allclose(n(out), n(x), atol=1e-5)
+
+    def test_grid_sample_shift_and_grad(self):
+        x = t(rng.rand(1, 1, 4, 4).astype(np.float32),
+              stop_gradient=False)
+        theta = t(np.array([[[1, 0, 0.5], [0, 1, 0]]], np.float32))
+        grid = F.affine_grid(theta, [1, 1, 4, 4])
+        out = F.grid_sample(x, grid)
+        out.sum().backward()
+        assert x.grad is not None and np.isfinite(n(x.grad)).all()
+
+    def test_temporal_shift_moves_channels(self):
+        x = rng.rand(4, 8, 2, 2).astype(np.float32)
+        out = n(F.temporal_shift(t(x), seg_num=2, shift_ratio=0.25))
+        v = x.reshape(2, 2, 8, 2, 2)
+        # first quarter shifted forward: out[t] = in[t+1]
+        np.testing.assert_allclose(
+            out.reshape(2, 2, 8, 2, 2)[:, 0, :2], v[:, 1, :2])
+
+    def test_fractional_pool_shapes(self):
+        out = F.fractional_max_pool2d(
+            t(rng.rand(2, 3, 7, 9).astype(np.float32)), [3, 4])
+        assert out.shape == [2, 3, 3, 4]
+
+
+class TestSequenceUtils:
+    def test_sequence_mask(self):
+        m = F.sequence_mask(t(np.array([2, 4])), maxlen=5)
+        assert n(m).tolist() == [[1, 1, 0, 0, 0], [1, 1, 1, 1, 0]]
+
+    def test_gather_tree_backtrace(self):
+        # T=2, B=1, beam=2: final beam 0 came from parent 1
+        ids = t(np.array([[[9, 8]], [[5, 6]]], np.int32))
+        par = t(np.array([[[0, 0]], [[1, 0]]], np.int32))
+        out = n(F.gather_tree(ids, par))
+        # beam 0: step1 token 5, parent 1 → step0 token 8
+        assert out[:, 0, 0].tolist() == [8, 5]
+
+
+class TestLosses:
+    def test_dice_perfect_is_zero(self):
+        probs = np.zeros((2, 4, 3), np.float32)
+        lbl = rng.randint(0, 3, (2, 4, 1))
+        for i in range(2):
+            for j in range(4):
+                probs[i, j, lbl[i, j, 0]] = 1.0
+        assert float(n(F.dice_loss(t(probs), t(lbl.astype(np.int64))))) \
+            < 1e-3
+
+    def test_bilinear_matches_einsum(self):
+        x1 = rng.rand(3, 4).astype(np.float32)
+        x2 = rng.rand(3, 5).astype(np.float32)
+        w = rng.rand(6, 4, 5).astype(np.float32)
+        out = n(F.bilinear(t(x1), t(x2), t(w)))
+        ref = np.einsum("bi,kij,bj->bk", x1, w, x2)
+        np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+    def test_rnnt_loss_matches_bruteforce(self):
+        """Exact check: enumerate all monotonic (blank/emit) paths of a
+        tiny transducer and compare the path-sum probability."""
+        T, U, C = 3, 2, 4
+        logits = rng.randn(1, T, U + 1, C).astype(np.float32)
+        labels = np.array([[1, 2]], np.int64)
+        loss = F.rnnt_loss(t(logits), t(labels),
+                           t(np.array([T])), t(np.array([U])),
+                           reduction="none")
+        # brute force: paths are distinct orderings of T blanks + U emits
+        lp = logits[0] - np.log(
+            np.exp(logits[0]).sum(-1, keepdims=True))
+        total = -np.inf
+        for path in set(itertools.permutations(["B"] * T + ["E"] * U)):
+            tpos, upos, score, ok = 0, 0, 0.0, True
+            for mv in path:
+                if mv == "B":
+                    if tpos >= T:
+                        ok = False
+                        break
+                    score += lp[tpos, upos, 0]
+                    tpos += 1
+                else:
+                    if upos >= U or tpos >= T:
+                        ok = False
+                        break
+                    score += lp[tpos, upos, labels[0, upos]]
+                    upos += 1
+            if ok and tpos == T and upos == U:
+                total = np.logaddexp(total, score)
+        np.testing.assert_allclose(float(n(loss)[0]), -total, rtol=1e-4)
+
+    def test_margin_ce_and_npair_finite(self):
+        mce = F.margin_cross_entropy(
+            t(rng.rand(4, 10).astype(np.float32) * 2 - 1),
+            t(np.arange(4)))
+        npl = F.npair_loss(t(rng.rand(4, 8).astype(np.float32)),
+                           t(rng.rand(4, 8).astype(np.float32)),
+                           t(np.array([0, 1, 0, 1])))
+        assert np.isfinite(float(n(mce))) and np.isfinite(float(n(npl)))
+
+    def test_inplace_aliases(self):
+        x = t(np.array([-1.0, 2.0], np.float32))
+        F.tanh_(x)
+        np.testing.assert_allclose(n(x), np.tanh([-1.0, 2.0]), rtol=1e-6)
+        y = t(np.array([-1.0, 2.0], np.float32))
+        F.leaky_relu_(y)
+        np.testing.assert_allclose(n(y), [-0.01, 2.0], rtol=1e-5)
+
+
+class TestSecondOrderOptims:
+    def test_lbfgs_solves_quadratic(self):
+        w_true = rng.randn(6).astype(np.float32)
+        lin = nn.Linear(6, 1, bias_attr=False)
+        opt = optimizer.LBFGS(parameters=lin.parameters(),
+                              line_search_fn="strong_wolfe", max_iter=10)
+        X = t(rng.randn(32, 6).astype(np.float32))
+        Y = t((n(X) @ w_true)[:, None])
+
+        def closure():
+            opt.clear_grad()
+            loss = ((lin(X) - Y) ** 2).mean()
+            loss.backward()
+            return loss
+
+        for _ in range(5):
+            loss = opt.step(closure)
+        assert float(n(loss)) < 1e-4
+        np.testing.assert_allclose(n(lin.weight).ravel(), w_true,
+                                   atol=1e-2)
+
+    def test_rprop_decreases_loss(self):
+        lin = nn.Linear(6, 1, bias_attr=False)
+        opt = optimizer.Rprop(learning_rate=0.01,
+                              parameters=lin.parameters())
+        X = t(rng.randn(32, 6).astype(np.float32))
+        Y = t(rng.randn(32, 1).astype(np.float32))
+        losses = []
+        for _ in range(30):
+            loss = ((lin(X) - Y) ** 2).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(n(loss)))
+        assert losses[-1] < losses[0] * 0.6
