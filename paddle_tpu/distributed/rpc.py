@@ -150,12 +150,18 @@ def init_rpc(name: str, rank: Optional[int] = None,
 def shutdown():
     global _agent
     if _agent is not None:
-        _agent.store.add("rpc/done", 1)
-        # drain until everyone is done so late callers don't hang
-        deadline = time.time() + 30
-        while _agent.store.add("rpc/done", 0) < _agent.world_size and \
-                time.time() < deadline:
-            time.sleep(0.01)
+        try:
+            _agent.store.add("rpc/done", 1)
+            # drain until everyone is done so late callers don't hang.
+            # The store-hosting rank tears the server down once it sees
+            # the full count — a lost connection here on other ranks
+            # MEANS everyone is done, not an error.
+            deadline = time.time() + 30
+            while _agent.store.add("rpc/done", 0) < _agent.world_size \
+                    and time.time() < deadline:
+                time.sleep(0.01)
+        except RuntimeError:
+            pass  # server already gone → all peers finished
         _agent.shutdown()
         _agent = None
 

@@ -55,7 +55,15 @@ def _fractional_pool(nd):
         (or provided) u."""
         out_sz = output_size if isinstance(output_size, (tuple, list)) \
             else (output_size,) * nd
-        u = 0.5 if random_u is None else float(random_u)
+        if random_u is None:
+            # the stochastic regions ARE the op's regularization value:
+            # draw a fresh u per call like the reference
+            from ...framework.core import default_generator
+            key = default_generator.next_key()
+            u = float(jax.device_get(
+                jax.random.uniform(key, (), jnp.float32)))
+        else:
+            u = float(random_u)
 
         def f(a):
             spatial = a.shape[-nd:]
@@ -210,10 +218,17 @@ def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
 
 
 def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
-              fastemit_lambda=0.001, reduction="mean", name=None):
+              fastemit_lambda=0.0, reduction="mean", name=None):
     """RNN-T transducer loss (reference rnnt_loss over warprnnt): the
     log-space alpha recursion over (t, u) as a lax.scan over t with a
-    cumulative-logsumexp sweep over u inside each step."""
+    cumulative-logsumexp sweep over u inside each step. FastEmit
+    regularization is not implemented — nonzero fastemit_lambda raises
+    rather than silently computing a different loss."""
+    if fastemit_lambda:
+        raise NotImplementedError(
+            "rnnt_loss fastemit_lambda: FastEmit regularization is not "
+            "implemented; pass fastemit_lambda=0")
+
     def f(logits, lab, t_len, u_len):
         # logits: [B, T, U+1, C]; lab: [B, U]
         b, t_max, u1, c = logits.shape
@@ -223,7 +238,6 @@ def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
             lp[:, :, :-1, :],
             lab[:, None, :, None].astype(jnp.int32), axis=3)[..., 0]
         # pad so emit at u reads lab_lp[:, t, u]     # [B, T, U]
-        neg = -1e30
 
         def step(alpha, t):
             # alpha: [B, U+1] at time t-1 → time t.
@@ -355,8 +369,18 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             fx = ((gx + 1) * w - 1) / 2
             fy = ((gy + 1) * h - 1) / 2
 
+        def reflect(i, size):
+            # reflect across edges onto [0, size-1] (align_corners form)
+            span = max(2 * (size - 1), 1)
+            i = jnp.abs(i)
+            i = i % span
+            return jnp.where(i > size - 1, span - i, i)
+
         def sample(ix, iy):
             inb = ((ix >= 0) & (ix < w) & (iy >= 0) & (iy < h))
+            if padding_mode == "reflection":
+                ix = reflect(ix, w)
+                iy = reflect(iy, h)
             ixc = jnp.clip(ix, 0, w - 1).astype(jnp.int32)
             iyc = jnp.clip(iy, 0, h - 1).astype(jnp.int32)
             vals = a[jnp.arange(n)[:, None, None], :, iyc, ixc]
@@ -404,24 +428,37 @@ def sparse_attention(query, key, value, sparse_csr_offset,
                      attn_mask=None, name=None):
     """Block-sparse attention (reference binds a CUDA kernel). On TPU a
     mask-materialized flash path is both simpler and faster for the
-    sizes this API targets; the CSR pattern becomes an additive mask."""
-    def f(q, k, v, off, cols):
+    sizes this API targets; the CSR pattern (offsets/columns shaped
+    [B, H, ...] like the reference) becomes an additive mask, combined
+    with the optional key-padding and attention masks."""
+    def f(q, k, v, off, cols, *extra):
         b, h, s, d = q.shape
-        # CSR → dense mask (host loop over rows is static per pattern)
-        offs = np.asarray(jax.device_get(off)).reshape(-1, s + 1)
-        colz = np.asarray(jax.device_get(cols)).reshape(offs.shape[0], -1)
-        allow = np.zeros((offs.shape[0], s, s), bool)
-        for bi in range(offs.shape[0]):
+        # CSR → dense mask; per-(batch, head) patterns, host loop is
+        # static per pattern
+        offs = np.asarray(jax.device_get(off)).reshape(b * h, s + 1)
+        colz = np.asarray(jax.device_get(cols)).reshape(b * h, -1)
+        allow = np.zeros((b * h, s, s), bool)
+        for bi in range(b * h):
             for r in range(s):
                 cs = colz[bi, offs[bi, r]:offs[bi, r + 1]]
                 allow[bi, r, cs] = True
-        amask = jnp.asarray(allow)[:, None, :, :]
+        amask = jnp.asarray(allow).reshape(b, h, s, s)
         scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(d)
         scores = jnp.where(amask, scores, -1e30)
+        it = iter(extra)
+        if key_padding_mask is not None:
+            kpm = next(it)  # [B, S]: 1 = valid key
+            scores = jnp.where(
+                kpm[:, None, None, :] > 0, scores, -1e30)
+        if attn_mask is not None:
+            scores = scores + next(it)
         p = jax.nn.softmax(scores, axis=-1)
         return jnp.einsum("bhqk,bhkd->bhqd", p, v)
+
+    extra = tuple(m for m in (key_padding_mask, attn_mask)
+                  if m is not None)
     return apply("sparse_attention", f, query, key, value,
-                 sparse_csr_offset, sparse_csr_columns)
+                 sparse_csr_offset, sparse_csr_columns, *extra)
 
 
 # -- in-place activation aliases -------------------------------------------

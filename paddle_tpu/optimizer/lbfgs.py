@@ -56,9 +56,16 @@ class LBFGS(Optimizer):
     def _gather(self):
         params = self._parameter_list
         x = _flat([p._value for p in params])
-        g = _flat([p.grad._value if p.grad is not None
-                   else jnp.zeros_like(p._value) for p in params])
-        return x, g
+        grads = [p.grad._value if p.grad is not None
+                 else jnp.zeros_like(p._value) for p in params]
+        # honor the base-class args every other optimizer applies
+        if self._grad_clip is not None:
+            grads = self._grad_clip.apply(grads)
+        if self._weight_decay:
+            from .optimizer import _wd_grad
+            grads = [_wd_grad(p._value, g, self._weight_decay)
+                     for p, g in zip(params, grads)]
+        return x, _flat(grads)
 
     def _scatter(self, x):
         off = 0
@@ -104,30 +111,38 @@ class LBFGS(Optimizer):
                 gtd = float(jnp.vdot(g, d))
 
             f0 = float(loss.numpy() if isinstance(loss, Tensor) else loss)
-            # backtracking (Armijo) line search; strong_wolfe tightens
-            # with a curvature check like the reference
-            success = False
-            for _ls in range(20):
+            if self.line_search_fn is None:
+                # reference semantics: no search — one fixed-lr step
+                t = float(self._learning_rate)
                 self._scatter(x + t * d)
                 loss_new = closure()
                 evals += 1
-                f1 = float(loss_new.numpy()
-                           if isinstance(loss_new, Tensor) else loss_new)
-                if f1 <= f0 + 1e-4 * t * gtd:
-                    if self.line_search_fn == "strong_wolfe":
+            elif self.line_search_fn == "strong_wolfe":
+                success = False
+                for _ls in range(20):
+                    self._scatter(x + t * d)
+                    loss_new = closure()
+                    evals += 1
+                    f1 = float(loss_new.numpy()
+                               if isinstance(loss_new, Tensor)
+                               else loss_new)
+                    if f1 <= f0 + 1e-4 * t * gtd:  # Armijo
                         _, g_new = self._gather()
                         if abs(float(jnp.vdot(g_new, d))) <= \
-                                0.9 * abs(gtd):
+                                0.9 * abs(gtd):  # curvature
                             success = True
                             break
                         t *= 1.5 if float(jnp.vdot(g_new, d)) < 0 else 0.5
                         continue
-                    success = True
-                    break
-                t *= 0.5
-            if not success:
-                self._scatter(x)  # restore
-                return loss
+                    t *= 0.5
+                if not success:
+                    self._scatter(x)  # restore
+                    return loss
+            else:
+                raise ValueError(
+                    f"unsupported line_search_fn "
+                    f"{self.line_search_fn!r}; use None or "
+                    f"'strong_wolfe'")
             x_new, g_new = self._gather()
             s = x_new - x
             ygap = g_new - g
