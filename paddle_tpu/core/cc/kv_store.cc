@@ -31,6 +31,8 @@
 #include <chrono>
 #include <condition_variable>
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
 #include <cstring>
 #include <map>
 #include <mutex>
@@ -39,6 +41,11 @@
 #include <vector>
 
 namespace {
+
+constexpr uint32_t kMaxKeyLen = 1u << 16;   // 64 KiB keys
+constexpr uint32_t kMaxValLen = 1u << 28;   // 256 MiB values
+constexpr int64_t kStatusTooLarge = -3;     // frame exceeded the caps
+constexpr int64_t kStatusMalformed = -4;    // bad op-specific encoding
 
 enum Op : uint8_t {
   kSet = 1,
@@ -100,6 +107,16 @@ bool ReadFull(int fd, void* buf, size_t n, int timeout_ms) {
   return true;
 }
 
+bool DrainN(int fd, size_t n, int timeout_ms) {
+  uint8_t scratch[4096];
+  while (n > 0) {
+    size_t chunk = n < sizeof(scratch) ? n : sizeof(scratch);
+    if (!ReadFull(fd, scratch, chunk, timeout_ms)) return false;
+    n -= chunk;
+  }
+  return true;
+}
+
 bool WriteFull(int fd, const void* buf, size_t n) {
   auto* p = static_cast<const uint8_t*>(buf);
   while (n > 0) {
@@ -119,11 +136,32 @@ void ServeClient(Daemon* d, int fd) {
     if (!ReadFull(fd, &op, 1, 0)) break;
     uint32_t klen;
     if (!ReadFull(fd, &klen, 4, 10000)) break;
+    // Bound allocations. Oversized KEYS (any op) are a protocol
+    // violation — legit keys are short strings — so the connection is
+    // dropped. An oversized VALUE on kSet/kCompareSet (the two ops that
+    // legitimately carry big payloads and whose status field is a pure
+    // status) gets drained and answered with kStatusTooLarge so the
+    // shared client handle survives; on value-carrying ops (kAdd etc.)
+    // the status field is the return value, so -3 would be ambiguous —
+    // those frames also drop the connection.
+    if (klen > kMaxKeyLen) break;
     std::string key(klen, '\0');
     if (klen && !ReadFull(fd, key.data(), klen, 10000)) break;
     uint32_t vlen;
     if (!ReadFull(fd, &vlen, 4, 10000)) break;
-    std::vector<uint8_t> val(vlen);
+    std::vector<uint8_t> val;
+    if (vlen > kMaxValLen) {
+      if (op != kSet && op != kCompareSet) break;
+      if (!DrainN(fd, vlen, 10000)) break;
+      int64_t status = kStatusTooLarge;
+      uint32_t zero = 0;
+      uint8_t hdr[12];
+      std::memcpy(hdr, &status, 8);
+      std::memcpy(hdr + 8, &zero, 4);
+      if (!WriteFull(fd, hdr, 12)) break;
+      continue;
+    }
+    val.resize(vlen);
     if (vlen && !ReadFull(fd, val.data(), vlen, 10000)) break;
 
     int64_t status = 0;
@@ -188,9 +226,19 @@ void ServeClient(Daemon* d, int fd) {
         break;
       }
       case kCompareSet: {
-        // val = u32 oldlen | old | new
+        // val = u32 oldlen | old | new — reject malformed frames instead of
+        // slicing past the end (hostile/corrupt clients must not crash the
+        // rendezvous master).
         uint32_t olen = 0;
-        if (val.size() >= 4) std::memcpy(&olen, val.data(), 4);
+        if (val.size() < 4) {
+          status = kStatusMalformed;
+          break;
+        }
+        std::memcpy(&olen, val.data(), 4);
+        if ((size_t)olen > val.size() - 4) {
+          status = kStatusMalformed;
+          break;
+        }
         std::vector<uint8_t> oldv(val.begin() + 4, val.begin() + 4 + olen);
         std::vector<uint8_t> newv(val.begin() + 4 + olen, val.end());
         std::lock_guard<std::mutex> lk(d->mu);
@@ -237,7 +285,26 @@ void* pt_kv_server_start(int port) {
   ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
+  // Default INADDR_ANY (multi-host rendezvous needs it); deployments on
+  // untrusted networks can pin the listen interface via PT_KV_BIND_ADDR
+  // (e.g. "127.0.0.1" for single-host runs). The store carries pickled
+  // control-plane envelopes and MUST only be reachable from the trusted
+  // pod network — same trust model as the reference TCPStore.
   addr.sin_addr.s_addr = INADDR_ANY;
+  if (const char* bind_addr = ::getenv("PT_KV_BIND_ADDR")) {
+    in_addr parsed{};
+    if (::inet_pton(AF_INET, bind_addr, &parsed) != 1) {
+      // Fail closed: a typo'd bind address must not silently fall back
+      // to listening on every interface.
+      std::fprintf(stderr,
+                   "paddle_tpu kv_store: PT_KV_BIND_ADDR=%s is not a "
+                   "valid IPv4 dotted-quad address; refusing to start\n",
+                   bind_addr);
+      ::close(fd);
+      return nullptr;
+    }
+    addr.sin_addr = parsed;
+  }
   addr.sin_port = htons((uint16_t)port);
   if (::bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
       ::listen(fd, 128) != 0) {

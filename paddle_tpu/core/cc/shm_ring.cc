@@ -159,21 +159,41 @@ uint32_t pt_ring_n_slots(void* h) { return ((Ring*)h)->hdr->n_slots; }
 
 // Producer: claim the next slot for writing. Returns pointer to payload or
 // nullptr on timeout. *ticket_out receives the claimed ticket.
+//
+// A ticket is only claimed (head CAS) once the consumer's tail proves the
+// target slot has been released for this wrap (ticket < tail + n_slots).
+// The consumer stores kFree before advancing tail, so tail ordering alone
+// serializes slot reuse across producers — no producer can observe a stale
+// kFree from a previous wrap and clobber a peer. On timeout nothing was
+// claimed, so the ring is left fully consistent (no skipped tickets).
 uint8_t* pt_ring_acquire_write(void* h, uint64_t* ticket_out, int timeout_ms) {
   auto* r = (Ring*)h;
-  uint64_t ticket = r->hdr->head.fetch_add(1, std::memory_order_acq_rel);
-  SlotHeader* s = slot_hdr(r, ticket);
-  // Wait for the consumer to have freed this slot (ring wrap).
-  if (!wait_state(s->state, kFree, timeout_ms)) {
-    // Cannot un-claim the ticket (other producers raced past); mark the
-    // slot ready with a "skip" sentinel so the consumer doesn't deadlock.
-    // In practice timeout_ms is large and this path means shutdown.
-    return nullptr;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int spins = 0;
+  while (true) {
+    uint64_t ticket = r->hdr->head.load(std::memory_order_acquire);
+    uint64_t tail = r->hdr->tail.load(std::memory_order_acquire);
+    if (ticket < tail + r->hdr->n_slots) {
+      if (!r->hdr->head.compare_exchange_weak(ticket, ticket + 1,
+                                              std::memory_order_acq_rel)) {
+        continue;  // lost the claim race; retry with the new head
+      }
+      SlotHeader* s = slot_hdr(r, ticket);
+      s->state.store(kWriting, std::memory_order_release);
+      s->ticket = ticket;
+      *ticket_out = ticket;
+      return slot_payload(s);
+    }
+    // Ring full: wait for consumer progress.
+    if (++spins < 64) {
+      std::this_thread::yield();
+    } else {
+      std::this_thread::sleep_for(std::chrono::microseconds(200));
+      if (timeout_ms > 0 && std::chrono::steady_clock::now() > deadline)
+        return nullptr;
+    }
   }
-  s->state.store(kWriting, std::memory_order_release);
-  s->ticket = ticket;
-  *ticket_out = ticket;
-  return slot_payload(s);
 }
 
 void pt_ring_commit_write(void* h, uint64_t ticket, uint32_t payload_len,

@@ -201,13 +201,31 @@ class TCPStore:
             raise RuntimeError(f"cannot connect to KV store {host}:{port}")
         self.world_size = world_size
 
+    # Mirrors of the server's frame caps (kv_store.cc kMaxKeyLen/kMaxValLen):
+    # checked client-side so a cooperative caller gets a deterministic error
+    # without shipping a doomed multi-hundred-MiB payload first (the server
+    # drain stays as the hostile-client backstop).
+    MAX_KEY_LEN = 1 << 16
+    MAX_VAL_LEN = 1 << 28
+
+    def _check_frame(self, key: str, nval: int) -> None:
+        if len(key.encode()) > self.MAX_KEY_LEN or nval > self.MAX_VAL_LEN:
+            raise ValueError(
+                f"KV frame for key {key!r} exceeds the store's size caps "
+                f"(64KiB keys / 256MiB values)")
+
     def set(self, key: str, value) -> None:
         data = value.encode() if isinstance(value, str) else bytes(value)
+        self._check_frame(key, len(data))
         buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data \
             else None
         rc = self._lib.pt_kv_set(self._h, key.encode(), buf, len(data))
         if rc == -(2 ** 63):
             raise RuntimeError("KV store connection lost")
+        if rc == -3:
+            raise ValueError(
+                f"KV set({key!r}): frame exceeds the store's size caps "
+                f"(64KiB keys / 256MiB values)")
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
         out = ctypes.POINTER(ctypes.c_uint8)()
@@ -244,10 +262,16 @@ class TCPStore:
         return int(self._lib.pt_kv_num_keys(self._h))
 
     def compare_set(self, key: str, old: bytes, new: bytes) -> bool:
+        self._check_frame(key, 4 + len(old) + len(new))
         ob = (ctypes.c_uint8 * len(old)).from_buffer_copy(old) if old else None
         nb = (ctypes.c_uint8 * len(new)).from_buffer_copy(new) if new else None
-        return self._lib.pt_kv_compare_set(
-            self._h, key.encode(), ob, len(old), nb, len(new)) == 1
+        rc = self._lib.pt_kv_compare_set(
+            self._h, key.encode(), ob, len(old), nb, len(new))
+        if rc in (-3, -4):  # kStatusTooLarge / kStatusMalformed
+            raise ValueError(
+                f"KV compare_set({key!r}): frame rejected by the store "
+                f"(status {rc})")
+        return rc == 1
 
     def barrier(self, name: str = "barrier", world_size: Optional[int] = None,
                 timeout: Optional[float] = None) -> None:

@@ -7,6 +7,13 @@ collectives, same division as the reference).
 
 Each worker runs a serving thread that polls its inbox key; rpc_sync /
 rpc_async post to the callee's inbox and wait on a per-call result key.
+
+Security model (same as the reference's brpc RpcAgent): envelopes are
+pickled callables executed on the callee, so anyone who can reach the
+master store port can run code on every worker. RPC is only safe on a
+TRUSTED, ISOLATED cluster network. Single-host runs should set
+``PT_KV_BIND_ADDR=127.0.0.1`` to pin the store to loopback; multi-host
+deployments must firewall the master port to the pod network.
 """
 from __future__ import annotations
 
@@ -38,6 +45,11 @@ class _Agent:
         self.rank = rank
         self.world_size = world_size
         self.store = store
+        # The serving thread gets its own client connection: the native
+        # client serializes one request per handle, so a caller blocked in
+        # a long wait() would otherwise starve serving (deadlocking
+        # self-calls and any call arriving while this rank waits).
+        self._serve_store = TCPStore(store.host, store.port)
         self._stop = threading.Event()
         self._seq = 0
         self._thread = threading.Thread(target=self._serve, daemon=True)
@@ -53,7 +65,7 @@ class _Agent:
         served = 0
         while not self._stop.is_set():
             try:
-                pending = self.store.add(inbox_ctr, 0)
+                pending = self._serve_store.add(inbox_ctr, 0)
             except Exception:
                 return
             if pending <= served:
@@ -66,7 +78,7 @@ class _Agent:
                 blob = None
                 for _attempt in range(3):
                     try:
-                        blob = self.store.get(
+                        blob = self._serve_store.get(
                             f"rpc/inbox{self.rank}/{i}", timeout=10)
                         break
                     except Exception:
@@ -83,7 +95,8 @@ class _Agent:
                     payload = pickle.dumps({"ok": False, "error": repr(e)})
                 if call_id is not None:
                     try:
-                        self.store.set(f"rpc/result/{call_id}", payload)
+                        self._serve_store.set(f"rpc/result/{call_id}",
+                                              payload)
                     except Exception:
                         pass
             served = pending
@@ -103,7 +116,18 @@ class _Agent:
         body = pickle.dumps({"fn": fn, "args": args, "kwargs": kwargs})
         blob = pickle.dumps((call_id, body))
         idx = self.store.add(f"rpc/inbox{target.rank}/n", 1) - 1
-        self.store.set(f"rpc/inbox{target.rank}/{idx}", blob)
+        slot = f"rpc/inbox{target.rank}/{idx}"
+        try:
+            self.store.set(slot, blob)
+        except Exception:
+            # The index is already reserved; tombstone it so the callee's
+            # in-order scan doesn't stall ~30s on an empty slot. A None
+            # body fails to unpickle remotely, bouncing an error to us.
+            try:
+                self.store.set(slot, pickle.dumps((call_id, None)))
+            except Exception:
+                pass
+            raise
         return call_id
 
     def wait(self, call_id: str, timeout: float):
@@ -116,6 +140,14 @@ class _Agent:
     def shutdown(self):
         self._stop.set()
         self._thread.join(timeout=5)
+        # Only free the native handle once the serving thread is out of
+        # it; a still-blocked daemon thread keeps the (leaked) handle
+        # until process exit rather than risking a use-after-free.
+        if not self._thread.is_alive():
+            try:
+                self._serve_store.close()
+            except Exception:
+                pass
 
 
 _agent: Optional[_Agent] = None

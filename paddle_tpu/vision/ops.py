@@ -103,9 +103,46 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
               name=None):
     """paddle.vision.ops.roi_align parity: x [N,C,H,W], boxes [R,4] xyxy
     in input coords, boxes_num [N] rois per image. Bilinear-sampled
-    [R, C, oh, ow]; differentiable w.r.t. x."""
+    [R, C, oh, ow]; differentiable w.r.t. x.
+
+    sampling_ratio=-1 (adaptive): the reference derives the grid per bin
+    as ceil(roi_size/output_size). A data-dependent grid is not a static
+    XLA shape, so eager calls size one shared grid for the largest RoI
+    (capped at 8x8); under jit tracing this falls back to a fixed 2x2
+    grid — a small numeric deviation from the reference for very large
+    RoIs. Pass an explicit sampling_ratio for bit-stable behavior."""
     oh, ow = (output_size if isinstance(output_size, (tuple, list))
               else (output_size, output_size))
+
+    if sampling_ratio > 0:
+        samp = sampling_ratio
+    else:
+        # Adaptive: one shared grid sized for the largest RoI (a denser
+        # uniform grid over-samples small bins, converging to the same bin
+        # integral). Resolved from the user-facing boxes BEFORE any
+        # autograd/jit tracing so training and eval agree; falls back to
+        # 2x2 under to_static tracing or with zero RoIs.
+        # NOTE: reading boxes forces a device→host sync; on eager hot
+        # paths pass an explicit sampling_ratio to avoid it.
+        samp = 2
+        try:
+            b = np.asarray(getattr(boxes, "value", boxes), dtype=np.float64)
+            if b.shape[0]:
+                brw = np.maximum((b[:, 2] - b[:, 0]) * spatial_scale,
+                                 1e-3 if aligned else 1.0)
+                brh = np.maximum((b[:, 3] - b[:, 1]) * spatial_scale,
+                                 1e-3 if aligned else 1.0)
+                peak = max(brh.max() / oh, brw.max() / ow)
+                if np.isfinite(peak):  # NaN/Inf boxes: keep the 2x2 grid
+                    samp = max(1, min(int(np.ceil(peak)), 8))
+        except jax.errors.ConcretizationTypeError:
+            pass
+
+    if boxes.shape[0] == 0:  # static shape: no RoIs → empty result
+        return apply("roi_align",
+                     lambda xa, bxs, bn: jnp.zeros(
+                         (0, xa.shape[1], oh, ow), xa.dtype),
+                     x, boxes, boxes_num)
 
     def f(xa, bxs, bn):
         n, c, h, w = xa.shape
@@ -121,7 +158,7 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale: float = 1.0,
         rh = jnp.maximum(y2 - y1, 1e-3 if aligned else 1.0)
         bin_w = rw / ow
         bin_h = rh / oh
-        s = sampling_ratio if sampling_ratio > 0 else 2
+        s = samp
         # sample grid: [r, oh, ow, s, s]
         iy = (jnp.arange(s) + 0.5) / s
         ix = (jnp.arange(s) + 0.5) / s
