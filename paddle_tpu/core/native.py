@@ -267,6 +267,8 @@ class TCPStore:
         nb = (ctypes.c_uint8 * len(new)).from_buffer_copy(new) if new else None
         rc = self._lib.pt_kv_compare_set(
             self._h, key.encode(), ob, len(old), nb, len(new))
+        if rc == -(2 ** 63):  # a dead daemon must not read as CAS-miss:
+            raise RuntimeError("KV store connection lost")  # retry loops spin
         if rc in (-3, -4):  # kStatusTooLarge / kStatusMalformed
             raise ValueError(
                 f"KV compare_set({key!r}): frame rejected by the store "

@@ -1,87 +1,285 @@
-"""Distributed checkpoint (parity:
-/root/reference/python/paddle/distributed/checkpoint/ —
-save_state_dict.py:104, load_state_dict.py:65).
+"""Distributed checkpoint: per-shard files + global metadata, dedup of
+replicated shards, read-planned topology-changing restore, async save.
 
-TPU-native: sharded arrays save per-shard with a global metadata file;
-load reshards to the *current* placements (topology-changing restore) by
-constructing the global array then device_put to the new sharding — the
-reference's ReadItem planning collapses into jax.device_put.
+Parity:
+/root/reference/python/paddle/distributed/checkpoint/save_state_dict.py
+:104 (per-rank shard files + dedup :66-101) and load_state_dict.py:65-127
+(rank->file read planning + cross-topology reshard).
 
-Single-host implementation now (np per-shard files + metadata json);
-multi-host via orbax planned (paddle_tpu.distributed.checkpoint.orbax_io).
+TPU-native format:
+- save walks ``arr.addressable_shards`` and writes one .npy PER SHARD
+  (replica_id == 0 only — replicated shards are deduped); a full array is
+  NEVER materialized on one host. File names are a pure function of the
+  shard's index bounds, so every process writes independently and the
+  coordinator can enumerate the global file set from the sharding alone.
+- metadata.json records global shape/dtype and every shard's bounds.
+- load plans reads per DESTINATION shard: only files intersecting the
+  local shard's bounds are opened (np.load mmap — only the needed pages
+  are read), assembled host-side, and the global array is built with
+  jax.make_array_from_single_device_arrays under the destination
+  sharding. Saving on a 2x4 mesh and restoring on 8x1 (or 1-device) just
+  works; the reference's ReadItem planning collapses into bounds
+  intersection.
+- async_save snapshots shards to host (d2h per shard, no gather) and
+  hands file IO to a background writer thread; wait_until_finished()
+  blocks on the queue. Orbax remains available as an alternative backend
+  (checkpoint.orbax_io) for multi-host storage stacks.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 
 from ...framework.core import Parameter, Tensor
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "wait_until_finished"]
 
 _META = "metadata.json"
 
 
+def _bounds(index: Tuple, shape: Sequence[int]) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[start, stop], ...]."""
+    out = []
+    for sl, n in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = n if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    # scalar arrays: index == ()
+    return out
+
+
+def _shard_fname(name: str, bounds: List[List[int]]) -> str:
+    safe = name.replace("/", "_").replace(".", "_")
+    if not bounds:
+        return f"{safe}.scalar.npy"
+    span = "-".join(f"{a}_{b}" for a, b in bounds)
+    return f"{safe}.{span}.npy"
+
+
+def _np_save(path: str, arr: np.ndarray):
+    # bfloat16 (ml_dtypes) isn't np.save-serializable — store the raw bits
+    if arr.dtype.name == "bfloat16":
+        arr = arr.view(np.uint16)
+    np.save(path, arr)
+
+
+def _np_load(path: str, dtype_name: str, mmap: bool = True):
+    arr = np.load(path, mmap_mode="r" if mmap else None)
+    if dtype_name == "bfloat16":
+        import ml_dtypes
+        arr = arr.view(ml_dtypes.bfloat16)
+    return arr
+
+
+class _AsyncWriter:
+    """Background file writer: save_state_dict(async_save=True) snapshots
+    device shards to host, then returns while this thread writes files."""
+
+    def __init__(self):
+        self._threads: List[threading.Thread] = []
+        self._lock = threading.Lock()
+        self._errors: List[BaseException] = []
+
+    def submit(self, work):
+        def run():
+            try:
+                work()
+            except BaseException as e:  # surfaced on wait
+                with self._lock:
+                    self._errors.append(e)
+        t = threading.Thread(target=run, daemon=True)
+        with self._lock:
+            self._threads.append(t)
+        t.start()
+
+    def wait(self):
+        with self._lock:
+            threads, self._threads = self._threads, []
+        for t in threads:
+            t.join()
+        with self._lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            raise errors[0]
+
+
+_writer = _AsyncWriter()
+
+
+def wait_until_finished():
+    """Block until all async checkpoint writes are durable. Errors from
+    either backend's writer propagate — a failed write must never read as
+    a durable checkpoint."""
+    _writer.wait()
+    try:  # orbax backend, only if importable (it may not be installed)
+        from .orbax_io import wait_until_finished as _orbax_wait
+    except ImportError:
+        return
+    _orbax_wait()
+
+
+def _global_shard_table(arr) -> List[List[List[int]]]:
+    """All unique shard bounds of the GLOBAL array (not just addressable),
+    derived from the sharding — every process computes the same table."""
+    shape = arr.shape
+    try:
+        imap = arr.sharding.devices_indices_map(shape)
+        seen, table = set(), []
+        for idx in imap.values():
+            b = _bounds(idx, shape)
+            key = tuple(map(tuple, b))
+            if key not in seen:
+                seen.add(key)
+                table.append(b)
+        return table
+    except Exception:
+        return [_bounds(tuple(slice(0, n) for n in shape), shape)]
+
+
 def save_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
-                    unique_id=None, async_save=False):
-    if async_save or jax.process_count() > 1:
-        # multi-host / async → orbax backend (per-host shard writes,
-        # overlapped serialization). A synchronous request must not
-        # return before the checkpoint is committed.
-        from .orbax_io import save_state_dict_async, wait_until_finished
-        save_state_dict_async(state_dict, path)
-        if not async_save:
-            wait_until_finished()
-        return
+                    unique_id=None, async_save: bool = False):
+    """Write each tensor as per-shard .npy files + metadata.json.
+
+    Never gathers a full array to one host: each process writes only its
+    addressable replica-0 shards."""
     os.makedirs(path, exist_ok=True)
-    meta = {"tensors": {}}
+    meta = {"format": "paddle_tpu.sharded.v1", "tensors": {}}
+    pending = []
     for name, t in state_dict.items():
         if not isinstance(t, Tensor):
             continue
-        arr = np.asarray(jax.device_get(t._value))
-        fname = name.replace("/", "_") + ".npy"
-        np.save(os.path.join(path, fname), arr)
+        arr = t._value
+        dtype_name = str(arr.dtype)
+        shards_meta = [{"file": _shard_fname(name, b), "bounds": b}
+                       for b in _global_shard_table(arr)]
         placements = getattr(t, "placements", None)
         meta["tensors"][name] = {
-            "file": fname,
             "shape": list(arr.shape),
-            "dtype": str(t._value.dtype),
+            "dtype": dtype_name,
             "is_param": isinstance(t, Parameter),
-            "placements": [repr(p) for p in placements] if placements else None,
+            "placements": [repr(p) for p in placements] if placements
+            else None,
+            "shards": shards_meta,
         }
-    with open(os.path.join(path, _META), "w") as f:
-        json.dump(meta, f, indent=1)
+        # snapshot this process's replica-0 shards to host (no gather)
+        for sh in arr.addressable_shards:
+            if sh.replica_id != 0:
+                continue  # dedup: exactly one replica writes each shard
+            b = _bounds(sh.index, arr.shape)
+            host = np.asarray(sh.data)
+            pending.append((os.path.join(path, _shard_fname(name, b)),
+                            host))
+
+    # Commit protocol: every file is written to a temp name and renamed
+    # into place, and metadata.json is renamed LAST, only after all of
+    # this process's shards are durable — a crash mid-save never leaves a
+    # valid-looking metadata pointing at torn shard files.
+    write_meta = jax.process_index() == coordinator_rank
+
+    def write_files(items=tuple(pending), meta=meta, do_meta=write_meta):
+        for fpath, host in items:
+            tmp = fpath + ".tmp.npy"   # .npy suffix: np.save won't append
+            _np_save(tmp, host)
+            os.replace(tmp, fpath)
+        if do_meta:
+            mpath = os.path.join(path, _META)
+            with open(mpath + ".tmp", "w") as f:
+                json.dump(meta, f, indent=1)
+            os.replace(mpath + ".tmp", mpath)
+
+    if async_save:
+        _writer.submit(write_files)
+    else:
+        write_files()
+
+
+def _assemble(dst_bounds: List[List[int]], info: dict, path: str):
+    """Read only the saved shards intersecting dst_bounds; returns the
+    assembled host array for that destination shard."""
+    if info["dtype"] == "bfloat16":
+        import ml_dtypes
+        out_dtype = ml_dtypes.bfloat16
+    else:
+        out_dtype = np.dtype(info["dtype"])
+    out_shape = [b - a for a, b in dst_bounds]
+    out = np.empty(out_shape, out_dtype)
+    for sh in info["shards"]:
+        src_b = sh["bounds"]
+        inter = [[max(a1, a2), min(b1, b2)]
+                 for (a1, b1), (a2, b2) in zip(dst_bounds, src_b)]
+        if any(a >= b for a, b in inter):
+            continue
+        src = _np_load(os.path.join(path, sh["file"]), info["dtype"])
+        src_sl = tuple(slice(a - sa, b - sa)
+                       for (a, b), (sa, _) in zip(inter, src_b))
+        dst_sl = tuple(slice(a - da, b - da)
+                       for (a, b), (da, _) in zip(inter, dst_bounds))
+        out[dst_sl] = src[src_sl]
+    return out
 
 
 def load_state_dict(state_dict: Dict[str, Any], path: str,
                     process_group=None, coordinator_rank: int = 0,
                     unique_id=None, offload: bool = False):
     """In-place load into the provided state_dict tensors, resharding each
-    array to the destination tensor's current sharding."""
+    array to the destination tensor's CURRENT sharding — reading only the
+    shard files the destination placement needs."""
     import jax.numpy as jnp
     if not os.path.exists(os.path.join(path, _META)):
-        # orbax-format checkpoint (async/multi-host save)
+        # orbax-format checkpoint (orbax backend save)
         from .orbax_io import load_state_dict_orbax
         return load_state_dict_orbax(state_dict, path)
     with open(os.path.join(path, _META)) as f:
         meta = json.load(f)
+    legacy = meta.get("format") is None
     for name, t in state_dict.items():
         if not isinstance(t, Tensor):
             continue
         info = meta["tensors"].get(name)
         if info is None:
             raise KeyError(f"checkpoint has no tensor named {name!r}")
-        arr = np.load(os.path.join(path, info["file"]))
-        new = jnp.asarray(arr)
-        if info["dtype"] == "bfloat16":
-            new = new.astype(jnp.bfloat16)
         cur = t._value
-        if hasattr(cur, "sharding") and cur.sharding is not None:
-            # reshard to the destination topology (may differ from save-time)
-            new = jax.device_put(new, cur.sharding)
+        if legacy:  # round-1 format: one full .npy per tensor
+            arr = np.load(os.path.join(path, info["file"]))
+            new = jnp.asarray(arr)
+            if info["dtype"] == "bfloat16":
+                new = new.astype(jnp.bfloat16)
+            if hasattr(cur, "sharding") and cur.sharding is not None:
+                new = jax.device_put(new, cur.sharding)
+            t._replace(new.astype(cur.dtype))
+            continue
+        shape = tuple(info["shape"])
+        if shape != tuple(cur.shape):
+            raise ValueError(
+                f"shape mismatch for {name!r}: checkpoint {shape} vs "
+                f"destination {tuple(cur.shape)}")
+        sharding = getattr(cur, "sharding", None)
+        if sharding is not None and not sharding.is_fully_replicated \
+                and shape != ():
+            # plan per destination shard; read only intersecting files.
+            # Devices holding identical bounds (replicated mesh dims)
+            # share one assembled host array — no redundant reads.
+            dst_map = sharding.addressable_devices_indices_map(shape)
+            cache: Dict[tuple, np.ndarray] = {}
+            bufs = []
+            for dev, idx in dst_map.items():
+                db = _bounds(idx, shape)
+                key = tuple(map(tuple, db))
+                host = cache.get(key)
+                if host is None:
+                    host = cache[key] = _assemble(db, info, path)
+                bufs.append(jax.device_put(host, dev))
+            new = jax.make_array_from_single_device_arrays(
+                shape, sharding, bufs)
+        else:
+            full = _assemble([[0, n] for n in shape], info, path)
+            new = jnp.asarray(full)
+            if sharding is not None:
+                new = jax.device_put(new, sharding)
         t._replace(new.astype(cur.dtype))

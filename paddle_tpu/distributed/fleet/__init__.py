@@ -19,6 +19,10 @@ from .mpu import (  # noqa: F401
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
 from .pipeline import LayerDesc, PipelineLayer, pipeline_apply  # noqa: F401
+from .pp_schedule import (  # noqa: F401
+    PipelineSchedule, build_pipeline_schedule, pipeline_forward_backward,
+    make_pipeline_loss_fn,
+)
 from .sequence_parallel_utils import (  # noqa: F401
     ScatterOp, GatherOp, ColumnSequenceParallelLinear,
     RowSequenceParallelLinear,
@@ -30,7 +34,10 @@ __all__ = ["init", "fleet", "DistributedStrategy", "HybridCommunicateGroup",
            "RowParallelLinear", "VocabParallelEmbedding",
            "ParallelCrossEntropy", "LayerDesc", "PipelineLayer",
            "pipeline_apply", "ScatterOp", "GatherOp",
-           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear"]
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "PipelineSchedule", "build_pipeline_schedule",
+           "pipeline_forward_backward", "make_pipeline_loss_fn",
+           "pipeline_schedule_from_strategy"]
 
 _hcg: Optional[HybridCommunicateGroup] = None
 _strategy: Optional[DistributedStrategy] = None
@@ -71,6 +78,21 @@ def distributed_model(model):
 def distributed_optimizer(optimizer, strategy=None):
     from ..api import shard_optimizer
     return shard_optimizer(optimizer)
+
+
+def pipeline_schedule_from_strategy(strategy: DistributedStrategy,
+                                    n_micro: int = None):
+    """Build the PipelineSchedule that strategy.pipeline_configs selects
+    (schedule_mode is validated — an unknown mode fails loudly rather than
+    silently meaning GPipe). n_micro defaults to accumulate_steps."""
+    hc = strategy.hybrid_configs
+    pc = strategy.pipeline_configs
+    if n_micro is None:
+        n_micro = int(pc.get("accumulate_steps", 1))
+    return build_pipeline_schedule(
+        n_stages=int(hc.get("pp_degree", 1)), n_micro=n_micro,
+        vpp=int(pc.get("vpp_degree", 1)),
+        mode=pc.get("schedule_mode", "1F1B"))
 
 
 class _FleetNamespace:

@@ -155,13 +155,11 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     microbatch to its next chunk group).
 
     SCHEDULE NOTE: this runs lock-step — every stage computes all of its
-    vpp chunk slots each tick, and the fill is V-1 full-work ticks. That
-    provides the interleaved PLACEMENT and semantics (state dicts,
-    chunk-wise sharding, schedule-order parity with the reference) but
-    NOT the reduced-bubble wall-clock benefit of Megatron-style
-    interleaving; for raw throughput at vpp>1 prefer composing each
-    stage's chunks and using pipeline_apply (bubble (n_stages-1) ticks).
-    A one-chunk-per-tick circular schedule is the planned upgrade.
+    vpp chunk slots each tick. It provides the interleaved PLACEMENT
+    (state dicts, chunk-wise sharding) for forward-only use; for
+    TRAINING with the real one-chunk-per-tick circular interleaved 1F1B
+    schedule (reduced bubble, bounded activation memory) use
+    pp_schedule.build_pipeline_schedule + pipeline_forward_backward.
 
     stage_fn(params_slice, x) -> y  — one CHUNK's computation.
     stacked_params: pytree, leaves [vpp, n_stages, ...] (axis 1 sharded

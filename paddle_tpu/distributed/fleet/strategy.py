@@ -40,7 +40,11 @@ _DEFAULT_RECOMPUTE = {
 _DEFAULT_PIPELINE = {
     "accumulate_steps": 1,
     "micro_batch_size": 1,
+    # selects the pp_schedule table: "1F1B", "FThenB"/"GPipe", or (with
+    # vpp_degree > 1) circular interleaved 1F1B. Validated by
+    # fleet.pipeline_schedule_from_strategy — unknown modes raise.
     "schedule_mode": "1F1B",
+    "vpp_degree": 1,
 }
 
 

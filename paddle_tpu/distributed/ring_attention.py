@@ -7,11 +7,24 @@ is the TPU-native design: the ring rides ICI neighbor links, compute on
 the current KV block overlaps the DMA of the next one (XLA schedules the
 ppermute async), and the online-softmax merge makes the math exact.
 
+The inner block is the Pallas flash kernel (ops/pallas/flash_attention):
+each ring step computes (out_blk, lse_blk) with blocked online softmax —
+no [s_q, s_kv] score materialization — and merges via
+logaddexp(lse, lse_blk). The backward is a second ring pass: q/out/do/lse
+stay resident while (k, v, dk, dv) circulate; each step runs the flash
+backward kernels against the MERGED lse, so dk/dv accumulate exactly and
+arrive home after n hops. GQA needs no head expansion on the Pallas path
+(kv-head index mapping + grouped dk/dv accumulation live in the kernel).
+
+A jnp blockwise fallback (still per-shard-block, f32) serves CPU tests
+and shapes the kernel doesn't tile.
+
 Used inside shard_map / jitted programs; also exposed as an eager Tensor
 op through paddle_tpu.nn.functional.ring_attention.
 """
 from __future__ import annotations
 
+import functools
 import math
 from functools import partial
 from typing import Optional
@@ -26,86 +39,211 @@ _NEG_INF = -1e30
 __all__ = ["ring_attention_local", "ring_attention"]
 
 
-def _block_attend(q, k, v, scale, mask):
-    """One (q_chunk × kv_chunk) blockwise attention partial.
+# ---------------------------------------------------------------------------
+# per-block fwd/bwd implementations (pallas | jnp), shared signature:
+#   blk_fwd(q, k, v, causal, scale)            -> out [b,s,h,d], lse [b,h,s]
+#   blk_bwd(q, k, v, out, lse, do, causal, scale) -> dq, dk, dv  (f32)
+# ---------------------------------------------------------------------------
 
-    q [b, sq, h, d]; k/v [b, sk, h, d]; mask broadcastable [sq, sk] bool or
-    None. Returns partial (acc [b,h,sq,d] f32, m [b,h,sq], l [b,h,sq])."""
+def _jnp_blk_fwd(q, k, v, causal, scale):
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hk != h:
+        k = jnp.repeat(k, h // hk, axis=2)
+        v = jnp.repeat(v, h // hk, axis=2)
     s = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
-    if mask is not None:
+    if causal:
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
         s = jnp.where(mask[None, None], s, _NEG_INF)
     m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
+    p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
     l = jnp.sum(p, axis=-1)
-    acc = jnp.einsum("bhqk,bkhd->bhqd", p, v.astype(jnp.float32))
-    return acc, m, l
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(l, 1e-30)[..., None].swapaxes(1, 2)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return out.astype(q.dtype), lse
+
+
+def _jnp_blk_bwd(q, k, v, out, lse, do, causal, scale):
+    b, sq, h, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    group = h // hk
+    ke, ve = k, v
+    if group > 1:
+        ke = jnp.repeat(k, group, axis=2)
+        ve = jnp.repeat(v, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, ke).astype(jnp.float32) * scale
+    if causal:
+        mask = jnp.arange(sq)[:, None] >= jnp.arange(sk)[None, :]
+        s = jnp.where(mask[None, None], s, _NEG_INF)
+    p = jnp.where(s > _NEG_INF * 0.5, jnp.exp(s - lse[..., None]), 0.0)
+    do32 = do.astype(jnp.float32)
+    delta = jnp.sum(out.astype(jnp.float32) * do32, axis=-1)  # [b,s,h]
+    delta = delta.swapaxes(1, 2)                              # [b,h,s]
+    dv = jnp.einsum("bhqk,bqhd->bkhd", p, do32)
+    dp = jnp.einsum("bqhd,bkhd->bhqk", do32, ve.astype(jnp.float32))
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bhqk,bkhd->bqhd", ds, ke.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bqhd->bkhd", ds, q.astype(jnp.float32))
+    if group > 1:
+        dk = dk.reshape(b, sk, hk, group, d).sum(axis=3)
+        dv = dv.reshape(b, sk, hk, group, d).sum(axis=3)
+    return dq, dk, dv
+
+
+def _pallas_blk_fwd(q, k, v, causal, scale):
+    from ..ops.pallas.flash_attention import flash_attention_with_lse
+    from ..ops.flash_attention import pallas_attention_plan
+    plan = pallas_attention_plan(q, k, min_seq=128) or (None, None)
+    return flash_attention_with_lse(q, k, v, causal=causal, scale=scale,
+                                    block_q=plan[0] or q.shape[1],
+                                    block_k=plan[1] or k.shape[1])
+
+
+def _pallas_blk_bwd(q, k, v, out, lse, do, causal, scale):
+    from ..ops.pallas.flash_attention import flash_attention_bwd_block
+    from ..ops.flash_attention import pallas_attention_plan
+    plan = pallas_attention_plan(q, k, min_seq=128) or (None, None)
+    return flash_attention_bwd_block(q, k, v, out, lse, do, causal=causal,
+                                     scale=scale,
+                                     block_q=plan[0] or q.shape[1],
+                                     block_k=plan[1] or k.shape[1])
+
+
+def _pallas_ok(q, k):
+    # shared gate with ops.flash_attention (ring shards are often shorter
+    # than a full sequence, hence the lower min_seq)
+    from ..ops.flash_attention import pallas_attention_plan
+    return pallas_attention_plan(q, k, min_seq=128) is not None
+
+
+# ---------------------------------------------------------------------------
+# the ring (custom_vjp: fwd merges lse online; bwd circulates dk/dv)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_attention_core(q, k, v, axis_name, causal, scale, use_pallas):
+    out, _ = _ring_fwd(q, k, v, axis_name, causal, scale, use_pallas)
+    return out
+
+
+def _ring_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+    blk_fwd = _pallas_blk_fwd if use_pallas else _jnp_blk_fwd
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    b, s, h, d = q.shape
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        out, lse, k_cur, v_cur = carry
+        src = jnp.mod(my - t, n)    # global chunk id we hold this step
+        if causal:
+            o_blk, lse_blk = jax.lax.cond(
+                t == 0,
+                lambda a: blk_fwd(a[0], a[1], a[2], True, scale),
+                lambda a: blk_fwd(a[0], a[1], a[2], False, scale),
+                (q, k_cur, v_cur))
+            visible = jnp.logical_or(t == 0, src < my)
+            lse_blk = jnp.where(visible, lse_blk, _NEG_INF)
+            o_blk = jnp.where(visible, o_blk, 0.0)
+        else:
+            o_blk, lse_blk = blk_fwd(q, k_cur, v_cur, False, scale)
+        lse_new = jnp.logaddexp(lse, lse_blk)
+        c_old = jnp.exp(lse - lse_new)
+        c_blk = jnp.exp(lse_blk - lse_new)
+        out = (out * c_old.swapaxes(1, 2)[..., None]
+               + o_blk.astype(jnp.float32)
+               * c_blk.swapaxes(1, 2)[..., None])
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        return (out, lse_new, k_nxt, v_nxt), None
+
+    out0 = jnp.zeros((b, s, h, d), jnp.float32)
+    lse0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    (out, lse, _, _), _ = jax.lax.scan(
+        step, (out0, lse0, k, v), jnp.arange(n))
+    return out.astype(q.dtype), lse
+
+
+def _ring_core_fwd(q, k, v, axis_name, causal, scale, use_pallas):
+    out, lse = _ring_fwd(q, k, v, axis_name, causal, scale, use_pallas)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_core_bwd(axis_name, causal, scale, use_pallas, res, do):
+    q, k, v, out, lse = res
+    blk_bwd = _pallas_blk_bwd if use_pallas else _jnp_blk_bwd
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, t):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = jnp.mod(my - t, n)
+        if causal:
+            dq_blk, dk_blk, dv_blk = jax.lax.cond(
+                t == 0,
+                lambda a: blk_bwd(a[0], a[1], a[2], a[3], a[4], a[5],
+                                  True, scale),
+                lambda a: blk_bwd(a[0], a[1], a[2], a[3], a[4], a[5],
+                                  False, scale),
+                (q, k_cur, v_cur, out, lse, do))
+            vis = jnp.logical_or(t == 0, src < my).astype(jnp.float32)
+            dq_blk = dq_blk * vis
+            dk_blk = dk_blk * vis
+            dv_blk = dv_blk * vis
+        else:
+            dq_blk, dk_blk, dv_blk = blk_bwd(q, k_cur, v_cur, out, lse,
+                                             do, False, scale)
+        dq = dq + dq_blk.astype(jnp.float32)
+        dk_cur = dk_cur + dk_blk.astype(jnp.float32)
+        dv_cur = dv_cur + dv_blk.astype(jnp.float32)
+        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
+        dk_nxt = jax.lax.ppermute(dk_cur, axis_name, perm)
+        dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
+
+    dq0 = jnp.zeros(q.shape, jnp.float32)
+    dk0 = jnp.zeros(k.shape, jnp.float32)
+    dv0 = jnp.zeros(v.shape, jnp.float32)
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        step, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    # after n hops the dk/dv accumulators are back at their home shard
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_attention_core.defvjp(_ring_core_fwd, _ring_core_bwd)
 
 
 def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
-                         scale: Optional[float] = None):
+                         scale: Optional[float] = None,
+                         use_pallas: Optional[bool] = None):
     """Per-shard ring attention body (call inside shard_map).
 
     q/k/v: the LOCAL sequence chunk [b, s_local, h, d]; the global sequence
-    is the concatenation over `axis_name` in axis-index order.
+    is the concatenation over `axis_name` in axis-index order. kv heads may
+    be fewer than q heads (GQA). Differentiable (custom ring backward).
     Returns the local output chunk [b, s_local, h, d].
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    n = jax.lax.psum(1, axis_name)
-    my = jax.lax.axis_index(axis_name)
-    b, s, h, d = q.shape
-    kv_heads = k.shape[2]
-    if kv_heads != h:
-        k = jnp.repeat(k, h // kv_heads, axis=2)
-        v = jnp.repeat(v, h // kv_heads, axis=2)
-
-    perm = [(i, (i + 1) % n) for i in range(n)]
-    causal_mask = (jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]) \
-        if causal else None
-
-    def step(t, carry):
-        acc, m, l, k_cur, v_cur = carry
-        src = (my - t) % n  # which chunk of the global sequence we hold now
-
-        if causal:
-            # chunk relation selects ONE mask: src < my → all-visible;
-            # src == my → causal inside; src > my → fully masked
-            mask = jnp.where(src < my, jnp.ones_like(causal_mask),
-                             jnp.where(src == my, causal_mask,
-                                       jnp.zeros_like(causal_mask)))
-            a_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, mask)
-        else:
-            a_blk, m_blk, l_blk = _block_attend(q, k_cur, v_cur, scale, None)
-
-        m_new = jnp.maximum(m, m_blk)
-        # guard both corrections against exp(-inf - -inf)
-        c_old = jnp.exp(jnp.maximum(m - m_new, -1e30))
-        c_blk = jnp.exp(jnp.maximum(m_blk - m_new, -1e30))
-        acc = acc * c_old[..., None] + a_blk * c_blk[..., None]
-        l = l * c_old + l_blk * c_blk
-        m = m_new
-
-        k_nxt = jax.lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
-        return acc, m, l, k_nxt, v_nxt
-
-    acc0 = jnp.zeros((b, h, s, d), jnp.float32)
-    m0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((b, h, s), jnp.float32)
-    acc, m, l, _, _ = jax.lax.fori_loop(0, n, step, (acc0, m0, l0, k, v))
-    out = acc / jnp.maximum(l, 1e-30)[..., None]
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    if use_pallas is None:
+        use_pallas = _pallas_ok(q, k)
+    return _ring_attention_core(q, k, v, axis_name, causal, scale,
+                                bool(use_pallas))
 
 
 def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = True,
-                   scale: Optional[float] = None):
+                   scale: Optional[float] = None,
+                   use_pallas: Optional[bool] = None):
     """Whole-array entry: q/k/v [b, S_global, h, d] (sharded or not) →
     output with the sequence dim sharded over `axis`."""
     jmesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
     spec = P(None, axis, None, None)
     f = shard_map(
         partial(ring_attention_local, axis_name=axis, causal=causal,
-                scale=scale),
+                scale=scale, use_pallas=use_pallas),
         mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return f(q, k, v)

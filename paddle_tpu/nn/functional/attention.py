@@ -39,8 +39,26 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
                         causal=False, return_softmax=False,
                         fixed_seed_offset=None, rng_name="", training=True,
                         name=None):
-    raise NotImplementedError(
-        "varlen flash attention: planned (segment-ids Pallas kernel)")
+    """Varlen (packed-sequence) flash attention (parity:
+    /root/reference/python/paddle/nn/functional/flash_attention.py:302).
+
+    query/key/value: packed [total_tokens, num_heads, head_dim];
+    cu_seqlens_*: [n_seqs+1] cumulative lengths. Returns (out, None) like
+    the padded API. On TPU this runs the segment-ids Pallas kernel; the
+    dense reference path is used on CPU/odd shapes."""
+    from ...ops.flash_attention import flash_attn_varlen
+
+    def _raw(t):
+        return t._value if isinstance(t, Tensor) else t
+
+    cu_q = _raw(cu_seqlens_q)
+    cu_k = _raw(cu_seqlens_k)
+    out = apply("flash_attn_unpadded",
+                lambda q, k, v: flash_attn_varlen(
+                    q, k, v, cu_q, cu_k, max_seqlen_q, max_seqlen_k,
+                    scale=scale, causal=causal),
+                query, key, value)
+    return out, None
 
 
 class sdp_kernel:

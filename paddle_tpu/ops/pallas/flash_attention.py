@@ -1,10 +1,22 @@
-"""Flash attention, Pallas TPU implementation (fwd + bwd).
+"""Flash attention, Pallas TPU implementation (fwd + bwd), with optional
+segment-ids (varlen/packed-sequence) masking.
 
 Replaces the reference's third_party/flashattn CUDA kernels
-(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu). Blocked
-online-softmax over KV tiles; LSE saved for the backward; causal masking
-with early loop exit. GQA handled by head-index mapping in the forward and
-group-summed dk/dv in the backward.
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu; varlen API
+/root/reference/python/paddle/nn/functional/flash_attention.py:302).
+Blocked online-softmax over KV tiles; LSE saved for the backward; causal
+masking with early loop exit.
+
+GQA is handled WITHOUT expanding K/V in HBM: forward and dq kernels read
+the shared kv-head block via index maps (hi // group), and the dk/dv
+kernel accumulates the query-head group in-place by revisiting the same
+output block across the innermost grid dimension — no jnp.repeat, no
+group-expanded HBM traffic.
+
+Segment ids (int32, [batch, seq]) restrict attention to tokens of equal
+id — the packed-sequence ("varlen"/"unpadded") training path. Negative or
+mismatched ids are fully masked; fully-masked query rows produce zero
+output (guarded online softmax, not NaN).
 
 Layout contract (paddle convention at the API): q/k/v [batch, seq, heads,
 head_dim]; kernels internally run [batch, heads, seq, head_dim]. head_dim
@@ -20,6 +32,7 @@ from __future__ import annotations
 import functools
 import math
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
@@ -42,18 +55,25 @@ DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
-                block_k, seq_k):
+def _fwd_kernel(*refs, scale, causal, block_k, seq_q, seq_k, segmented):
+    if segmented:
+        q_ref, k_ref, v_ref, qseg_ref, kseg_ref, o_ref, lse_ref = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref = refs
     # block shapes: q [1, 1, bq, d]; k/v [1, 1, seq_k, d]
     q = q_ref[0, 0].astype(jnp.float32) * scale          # [bq, d]
     bq = q.shape[0]
     qi = pl.program_id(2)
     q_offset = qi * bq
+    if segmented:
+        qseg = qseg_ref[0]                                # [bq]
 
     num_kv = pl.cdiv(seq_k, block_k)
+    off = seq_k - seq_q   # causal aligns queries to the END of the keys
     if causal:
-        # only blocks whose start <= last query row
-        num_kv_run = jax.lax.div(q_offset + bq - 1, block_k) + 1
+        # only blocks whose start <= last query row's global position
+        num_kv_run = jnp.maximum(
+            jax.lax.div(q_offset + bq - 1 + off, block_k) + 1, 0)
     else:
         num_kv_run = num_kv
 
@@ -65,12 +85,18 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
             q, k_blk, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)              # [bq, bk]
         if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            rows = q_offset + off + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
+        if segmented:
+            kseg = kseg_ref[0, pl.ds(kj * block_k, block_k)]  # [bk]
+            s = jnp.where(qseg[:, None] == kseg[None, :], s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1)                          # [bq]
         m_new = jnp.maximum(m_prev, m_cur)
-        p = jnp.exp(s - m_new[:, None])                      # [bq, bk]
+        # guard: fully-masked rows keep p == 0 (else exp(-inf - -inf) = 1)
+        p = jnp.where(s > _NEG_INF * 0.5,
+                      jnp.exp(s - m_new[:, None]), 0.0)      # [bq, bk]
         corr = jnp.exp(m_prev - m_new)                       # [bq]
         l_new = l_prev * corr + jnp.sum(p, axis=-1)
         acc = acc * corr[:, None] + jax.lax.dot_general(
@@ -89,27 +115,38 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, causal,
     lse_ref[0, 0, :, 0] = (m + jnp.log(l_safe)).astype(jnp.float32)
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
-    """q [b,h,sq,d]; k/v [b,hk,sk,d] → out [b,h,sq,d], lse [b,h,sq]."""
+def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k):
+    """q [b,h,sq,d]; k/v [b,hk,sk,d]; segs [b,s] or None
+    → out [b,h,sq,d], lse [b,h,sq]."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
     group = h // hk
     bq = min(block_q, sq)
     bk = min(block_k, sk)
     grid = (b, h, pl.cdiv(sq, bq))
+    segmented = q_seg is not None
 
     kernel = functools.partial(_fwd_kernel, scale=scale, causal=causal,
-                               block_k=bk, seq_k=sk)
+                               block_k=bk, seq_q=sq, seq_k=sk,
+                               segmented=segmented)
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, sk, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+    ]
+    args = [q, k, v]
+    if segmented:
+        in_specs += [
+            pl.BlockSpec((1, bq), lambda bi, hi, qi: (bi, qi)),
+            pl.BlockSpec((1, sk), lambda bi, hi, qi: (bi, 0)),
+        ]
+        args += [q_seg, kv_seg]
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d),
-                         lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -119,12 +156,17 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k):
             jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v)
+    )(*args)
     return out, lse[..., 0]
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale, causal, block_k, seq_k):
+def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_q, seq_k,
+                   segmented):
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dq_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref = refs
     q = q_ref[0, 0].astype(jnp.float32)                     # [bq, d]
     do = do_ref[0, 0].astype(jnp.float32)
     lse = lse_ref[0, 0, :, 0]                               # [bq]
@@ -132,10 +174,14 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     bq = q.shape[0]
     qi = pl.program_id(2)
     q_offset = qi * bq
+    if segmented:
+        qseg = qseg_ref[0]
 
     num_kv = pl.cdiv(seq_k, block_k)
+    off = seq_k - seq_q
     if causal:
-        num_kv_run = jax.lax.div(q_offset + bq - 1, block_k) + 1
+        num_kv_run = jnp.maximum(
+            jax.lax.div(q_offset + bq - 1 + off, block_k) + 1, 0)
     else:
         num_kv_run = num_kv
 
@@ -145,10 +191,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            rows = q_offset + off + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                        # [bq, bk]
+        if segmented:
+            kseg = kseg_ref[0, pl.ds(kj * block_k, block_k)]
+            s = jnp.where(qseg[:, None] == kseg[None, :], s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF * 0.5,
+                      jnp.exp(s - lse[:, None]), 0.0)        # [bq, bk]
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         ds = p * (dp - delta[:, None]) * scale               # [bq, bk]
@@ -160,18 +211,31 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0, 0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, scale, causal, block_q, seq_q):
+def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_k, group,
+                    segmented):
+    """Grid (b, hk, n_kblocks, group): the innermost `group` dimension
+    revisits the same dk/dv output block, accumulating the kv-head's query
+    group in VMEM (GQA without expanding K/V or group-partial HBM writes)."""
+    if segmented:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
+         kseg_ref, dk_ref, dv_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dk_ref,
+         dv_ref) = refs
     k_blk = k_ref[0, 0].astype(jnp.float32)                  # [bk, d]
     v_blk = v_ref[0, 0].astype(jnp.float32)
     bk = k_blk.shape[0]
     kj = pl.program_id(2)
+    gi = pl.program_id(3)
     k_offset = kj * bk
+    if segmented:
+        kseg = kseg_ref[0, pl.ds(k_offset, bk)]
 
     num_q = pl.cdiv(seq_q, block_q)
+    off = seq_k - seq_q
     if causal:
-        # first q block that can see this k block
-        first_q = jax.lax.div(k_offset, block_q)
+        # first q block whose END position (q + off) can see this k block
+        first_q = jax.lax.div(jnp.maximum(k_offset - off, 0), block_q)
     else:
         first_q = 0
 
@@ -184,10 +248,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            rows = qi * block_q + off + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
-        p = jnp.exp(s - lse[:, None])                        # [bq, bk]
+        if segmented:
+            qseg = qseg_ref[0, pl.ds(qi * block_q, block_q)]
+            s = jnp.where(qseg[:, None] == kseg[None, :], s, _NEG_INF)
+        p = jnp.where(s > _NEG_INF * 0.5,
+                      jnp.exp(s - lse[:, None]), 0.0)        # [bq, bk]
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v_blk, (((1,), (1,)), ((), ())),
@@ -201,62 +270,104 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk0 = jnp.zeros((bk, d), jnp.float32)
     dv0 = jnp.zeros((bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(first_q, num_q, body, (dk0, dv0))
-    dk_ref[0, 0] = dk.astype(dk_ref.dtype)
-    dv_ref[0, 0] = dv.astype(dv_ref.dtype)
+
+    @pl.when(gi == 0)
+    def _init():
+        dk_ref[0, 0] = dk
+        dv_ref[0, 0] = dv
+
+    @pl.when(gi > 0)
+    def _accum():
+        dk_ref[0, 0] += dk
+        dv_ref[0, 0] += dv
 
 
-def _flash_bwd(q, k, v, out, lse, do, causal, scale, block_q, block_k):
-    """All [b,h,s,d] (kv already expanded to h heads). Returns dq,dk,dv."""
+def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
+               block_q, block_k):
+    """q/do [b,h,sq,d]; k/v [b,hk,sk,d] (NOT expanded). Returns dq [b,h,..]
+    and group-summed dk/dv [b,hk,sk,d] (float32)."""
     b, h, sq, d = q.shape
-    sk = k.shape[2]
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
     bq = min(block_q, sq)
     bk = min(block_k, sk)
+    segmented = q_seg is not None
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1)[..., None]                      # [b,h,sq,1]
     lse4 = lse[..., None]                                    # [b,h,sq,1]
 
+    dq_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, sk, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((1, 1, sk, d),
+                     lambda bi, hi, qi, _g=group: (bi, hi // _g, 0, 0)),
+        pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+        pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
+    ]
+    dq_args = [q, k, v, do, lse4, delta]
+    if segmented:
+        dq_specs += [
+            pl.BlockSpec((1, bq), lambda bi, hi, qi: (bi, qi)),
+            pl.BlockSpec((1, sk), lambda bi, hi, qi: (bi, 0)),
+        ]
+        dq_args += [q_seg, kv_seg]
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
-                          block_k=bk, seq_k=sk),
+                          block_k=bk, seq_q=sq, seq_k=sk,
+                          segmented=segmented),
         grid=(b, h, pl.cdiv(sq, bq)),
-        in_specs=[
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sk, d), lambda bi, hi, qi: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-            pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
         out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
         interpret=_interpret(),
-    )(q, k, v, do, lse4, delta)
+    )(*dq_args)
 
+    # dk/dv: grid (b, hk, kblocks, group); q-head = hk_index*group + g
+    def qmap(bi, hki, kj, g, _g=group):
+        return (bi, hki * _g + g, 0, 0)
+
+    dkv_specs = [
+        pl.BlockSpec((1, 1, sq, d), qmap),
+        pl.BlockSpec((1, 1, bk, d), lambda bi, hki, kj, g: (bi, hki, kj, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda bi, hki, kj, g: (bi, hki, kj, 0)),
+        pl.BlockSpec((1, 1, sq, d), qmap),
+        pl.BlockSpec((1, 1, sq, 1), qmap),
+        pl.BlockSpec((1, 1, sq, 1), qmap),
+    ]
+    dkv_args = [q, k, v, do, lse4, delta]
+    if segmented:
+        dkv_specs += [
+            pl.BlockSpec((1, sq), lambda bi, hki, kj, g: (bi, 0)),
+            pl.BlockSpec((1, sk), lambda bi, hki, kj, g: (bi, 0)),
+        ]
+        dkv_args += [q_seg, kv_seg]
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
-                          block_q=bq, seq_q=sq),
-        grid=(b, h, pl.cdiv(sk, bk)),
-        in_specs=[
-            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, sq, d), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
-            pl.BlockSpec((1, 1, sq, 1), lambda bi, hi, kj: (bi, hi, 0, 0)),
-        ],
+                          block_q=bq, seq_q=sq, seq_k=sk, group=group,
+                          segmented=segmented),
+        grid=(b, hk, pl.cdiv(sk, bk), group),
+        in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
-            pl.BlockSpec((1, 1, bk, d), lambda bi, hi, kj: (bi, hi, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hki, kj, g: (bi, hki, kj, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda bi, hki, kj, g: (bi, hki, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sk, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32),
         ],
         interpret=_interpret(),
-    )(q, k, v, do, lse4, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
+
+# ---------------------------------------------------------------------------
+# public custom-vjp entry points
+# ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention_pallas(q, k, v, causal=False, scale=None,
@@ -272,7 +383,8 @@ def _fa_fwd(q, k, v, causal, scale, block_q, block_k):
     qt = jnp.swapaxes(q, 1, 2)   # [b,h,s,d]
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    out_t, lse = _flash_fwd(qt, kt, vt, causal, scale, block_q, block_k)
+    out_t, lse = _flash_fwd(qt, kt, vt, None, None, causal, scale,
+                            block_q, block_k)
     out = jnp.swapaxes(out_t, 1, 2)
     return out, (q, k, v, out, lse)
 
@@ -281,23 +393,13 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
     q, k, v, out, lse = res
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
-    h = q.shape[2]
-    hk = k.shape[2]
-    group = h // hk
     qt = jnp.swapaxes(q, 1, 2)
     kt = jnp.swapaxes(k, 1, 2)
     vt = jnp.swapaxes(v, 1, 2)
-    if group > 1:  # expand kv heads for the backward kernels
-        kt = jnp.repeat(kt, group, axis=1)
-        vt = jnp.repeat(vt, group, axis=1)
     out_t = jnp.swapaxes(out, 1, 2)
     do_t = jnp.swapaxes(g, 1, 2)
-    dq_t, dk_t, dv_t = _flash_bwd(qt, kt, vt, out_t, lse, do_t, causal,
-                                  scale, block_q, block_k)
-    if group > 1:  # sum grads over each kv-head's query group
-        b, _, sk, d = dk_t.shape
-        dk_t = dk_t.reshape(b, hk, group, sk, d).sum(axis=2)
-        dv_t = dv_t.reshape(b, hk, group, sk, d).sum(axis=2)
+    dq_t, dk_t, dv_t = _flash_bwd(qt, kt, vt, out_t, lse, do_t, None, None,
+                                  causal, scale, block_q, block_k)
     dq = jnp.swapaxes(dq_t, 1, 2).astype(q.dtype)
     dk = jnp.swapaxes(dk_t, 1, 2).astype(k.dtype)
     dv = jnp.swapaxes(dv_t, 1, 2).astype(v.dtype)
@@ -305,3 +407,88 @@ def _fa_bwd(causal, scale, block_q, block_k, res, g):
 
 
 flash_attention_pallas.defvjp(_fa_fwd, _fa_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def flash_attention_pallas_segmented(q, k, v, q_segment_ids, kv_segment_ids,
+                                     causal=False, scale=None,
+                                     block_q=DEFAULT_BLOCK_Q,
+                                     block_k=DEFAULT_BLOCK_K):
+    """Segment-masked (varlen/packed) flash attention.
+
+    q/k/v: [batch, seq, heads, head_dim]; segment ids [batch, seq] int32.
+    Tokens attend only to equal segment ids (intersected with causal);
+    rows with no visible keys output zeros."""
+    out, _ = _fas_fwd(q, k, v, q_segment_ids, kv_segment_ids, causal,
+                      scale, block_q, block_k)
+    return out
+
+
+def _fas_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k):
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t, lse = _flash_fwd(qt, kt, vt, q_seg, kv_seg, causal, scale,
+                            block_q, block_k)
+    out = jnp.swapaxes(out_t, 1, 2)
+    return out, (q, k, v, q_seg, kv_seg, out, lse)
+
+
+def _fas_bwd(causal, scale, block_q, block_k, res, g):
+    q, k, v, q_seg, kv_seg, out, lse = res
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t = jnp.swapaxes(out, 1, 2)
+    do_t = jnp.swapaxes(g, 1, 2)
+    dq_t, dk_t, dv_t = _flash_bwd(qt, kt, vt, out_t, lse, do_t, q_seg,
+                                  kv_seg, causal, scale, block_q, block_k)
+    dq = jnp.swapaxes(dq_t, 1, 2).astype(q.dtype)
+    dk = jnp.swapaxes(dk_t, 1, 2).astype(k.dtype)
+    dv = jnp.swapaxes(dv_t, 1, 2).astype(v.dtype)
+    zseg = lambda s: np.zeros(s.shape, jax.dtypes.float0)
+    return dq, dk, dv, zseg(q_seg), zseg(kv_seg)
+
+
+flash_attention_pallas_segmented.defvjp(_fas_fwd, _fas_bwd)
+
+
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=DEFAULT_BLOCK_Q,
+                             block_k=DEFAULT_BLOCK_K):
+    """Raw forward returning (out, lse) — the ring-attention inner block
+    (online-softmax merge across ring steps needs the lse). [b,s,h,d] in,
+    out [b,s,h,d], lse [b,h,s]. Not differentiable; ring attention
+    implements its own backward over the ring."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t, lse = _flash_fwd(qt, kt, vt, None, None, causal, scale,
+                            block_q, block_k)
+    return jnp.swapaxes(out_t, 1, 2), lse
+
+
+def flash_attention_bwd_block(q, k, v, out, lse, do, causal=False,
+                              scale=None, block_q=DEFAULT_BLOCK_Q,
+                              block_k=DEFAULT_BLOCK_K):
+    """Raw backward for one (q-shard, kv-shard) block given the MERGED lse
+    — the ring-attention backward inner step. Layouts as
+    flash_attention_with_lse; returns (dq, dk, dv) with dk/dv float32
+    [b, s, hk, d]."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    qt = jnp.swapaxes(q, 1, 2)
+    kt = jnp.swapaxes(k, 1, 2)
+    vt = jnp.swapaxes(v, 1, 2)
+    out_t = jnp.swapaxes(out, 1, 2)
+    do_t = jnp.swapaxes(do, 1, 2)
+    dq_t, dk_t, dv_t = _flash_bwd(qt, kt, vt, out_t, lse, do_t, None, None,
+                                  causal, scale, block_q, block_k)
+    return (jnp.swapaxes(dq_t, 1, 2), jnp.swapaxes(dk_t, 1, 2),
+            jnp.swapaxes(dv_t, 1, 2))

@@ -180,9 +180,8 @@ class TestCheckpoint:
         dist.checkpoint.load_state_dict({"w": w2}, str(tmp_path / "ckpt"))
         assert np.allclose(w2.numpy(), w.numpy())
 
-    def test_orbax_async_save_topology_change(self, tmp_path):
-        from paddle_tpu.distributed.checkpoint.orbax_io import (
-            wait_until_finished)
+    def test_async_save_topology_change(self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import wait_until_finished
         mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
         w = paddle.randn([8, 16])
         sw = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Shard(1)])
@@ -195,3 +194,58 @@ class TestCheckpoint:
                                [dist.Shard(1), dist.Shard(0)])
         dist.checkpoint.load_state_dict({"w": w2}, str(tmp_path / "ock"))
         assert np.allclose(w2.numpy(), w.numpy())
+
+    def test_per_shard_files_and_dedup(self, tmp_path):
+        """The save must write one file per unique shard (2x4 Shard(0)/
+        Shard(1) -> 8 files), dedup replicated shards (replicated tensor
+        -> 1 file), and never write a full-array file for sharded
+        tensors."""
+        import json
+        import os
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        w = paddle.randn([16, 32])
+        sw = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Shard(1)])
+        r = paddle.randn([4, 4])
+        sr = dist.shard_tensor(r, mesh, [dist.Replicate(), dist.Replicate()])
+        p = str(tmp_path / "ck2")
+        dist.checkpoint.save_state_dict({"w": sw, "r": sr}, p)
+        files = sorted(os.listdir(p))
+        w_files = [f for f in files if f.startswith("w.")]
+        r_files = [f for f in files if f.startswith("r.")]
+        assert len(w_files) == 8, w_files          # one per shard
+        assert len(r_files) == 1, r_files          # replicated: deduped
+        meta = json.load(open(os.path.join(p, "metadata.json")))
+        assert meta["format"] == "paddle_tpu.sharded.v1"
+        assert len(meta["tensors"]["w"]["shards"]) == 8
+        # every written file is shard-sized, not full-array-sized
+        full_bytes = 16 * 32 * 4
+        for f in w_files:
+            assert os.path.getsize(os.path.join(p, f)) < full_bytes
+
+    def test_load_on_8x1_and_single_device(self, tmp_path):
+        mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4), ["dp", "mp"])
+        w = paddle.randn([16, 32])
+        sw = dist.shard_tensor(w, mesh, [dist.Shard(0), dist.Shard(1)])
+        p = str(tmp_path / "ck3")
+        dist.checkpoint.save_state_dict({"w": sw}, p)
+        mesh2 = dist.ProcessMesh(np.arange(8), ["mp"])
+        w2 = dist.shard_tensor(paddle.zeros([16, 32]), mesh2,
+                               [dist.Shard(0)])
+        dist.checkpoint.load_state_dict({"w": w2}, p)
+        assert np.allclose(w2.numpy(), w.numpy())
+        w3 = paddle.zeros([16, 32])   # plain single-device tensor
+        dist.checkpoint.load_state_dict({"w": w3}, p)
+        assert np.allclose(w3.numpy(), w.numpy())
+
+    def test_bf16_roundtrip(self, tmp_path):
+        mesh = dist.ProcessMesh(np.arange(8), ["mp"])
+        w = paddle.randn([8, 128]).astype("bfloat16")
+        sw = dist.shard_tensor(w, mesh, [dist.Shard(1)])
+        p = str(tmp_path / "ck4")
+        dist.checkpoint.save_state_dict({"w": sw}, p)
+        w2 = dist.shard_tensor(
+            paddle.zeros([8, 128]).astype("bfloat16"), mesh,
+            [dist.Shard(0)])
+        dist.checkpoint.load_state_dict({"w": w2}, p)
+        assert np.allclose(w2.astype("float32").numpy(),
+                           w.astype("float32").numpy())

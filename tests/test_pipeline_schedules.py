@@ -1,0 +1,224 @@
+"""Table-driven pipeline schedules: 1F1B / interleaved / FThenB.
+
+Reference parity targets:
+- 1F1B: /root/reference/python/paddle/distributed/fleet/meta_parallel/
+  pipeline_parallel.py:440 (forward_backward_pipeline)
+- interleaved VPP: pipeline_parallel.py:906
+- FThenB: pipeline_parallel.py:1489
+
+Checks (per VERDICT round-1 item 1):
+- schedule-level: 1F1B activation memory is O(n_stages), FThenB is
+  O(n_micro); circular interleaved beats composed-chunk GPipe on total
+  work units; schedule_mode selection fails loudly on unknown modes.
+- numeric: pipelined loss/grads match plain sequential autodiff to
+  tolerance, for every schedule, including vpp>1 and the custom_vjp
+  composition path (embedding outside the pipeline).
+
+XLA-bug note (documented workaround): sharding an array over 'mp' that
+enters the manual-'pp' shard_map as a pp-replicated operand crashes the
+XLA SPMD partitioner (CHECK at spmd_partitioner_util.cc:495) on meshes
+with >= 2 auto axes. llama_pp therefore replicates embed/head; trunk
+weights dual-shard over ('sharding','mp') fine.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.pp_schedule import (
+    build_pipeline_schedule, pipeline_forward_backward,
+    make_pipeline_loss_fn)
+
+
+# ---------------------------------------------------------------------------
+# schedule-table properties (no devices needed)
+# ---------------------------------------------------------------------------
+
+def test_1f1b_memory_cap_is_stage_bound():
+    """1F1B's reason to exist: in-flight activations ~ O(p), not O(m)."""
+    for m in (8, 16, 32):
+        s1 = build_pipeline_schedule(2, m, 1, "1F1B")
+        sf = build_pipeline_schedule(2, m, 1, "FThenB")
+        assert s1.act_buf_size <= 2
+        assert sf.act_buf_size >= m // 2
+    s1 = build_pipeline_schedule(4, 32, 1, "1F1B")
+    sf = build_pipeline_schedule(4, 32, 1, "FThenB")
+    assert s1.act_buf_size <= 8          # O(p)
+    assert sf.act_buf_size >= 16         # O(m)
+
+
+def test_interleaved_beats_gpipe_on_work_units():
+    """Circular interleaved 1F1B (one chunk per tick) vs composing each
+    stage's vpp chunks into one fat stage_fn under GPipe: total work =
+    n_ticks * per-tick chunk cost. Interleaving shrinks the fill/drain
+    bubble by ~vpp."""
+    p, m, v = 2, 8, 4
+    inter = build_pipeline_schedule(p, m, v, "1F1B")
+    gpipe_composed = build_pipeline_schedule(p, m, 1, "FThenB")
+    onef1b_composed = build_pipeline_schedule(p, m, 1, "1F1B")
+    # composed schedules run v chunks of work per tick
+    assert inter.work_units < v * gpipe_composed.work_units
+    assert inter.work_units < v * onef1b_composed.work_units
+
+
+def test_1f1b_fewer_ticks_than_fthenb():
+    for (p, m) in [(2, 8), (4, 16)]:
+        s1 = build_pipeline_schedule(p, m, 1, "1F1B")
+        sf = build_pipeline_schedule(p, m, 1, "FThenB")
+        assert s1.n_ticks < sf.n_ticks
+
+
+def test_schedule_mode_validation():
+    with pytest.raises(ValueError, match="schedule_mode"):
+        build_pipeline_schedule(2, 4, 1, "NotASchedule")
+    with pytest.raises(ValueError, match="divisible"):
+        build_pipeline_schedule(2, 3, 2, "1F1B")
+
+
+def test_strategy_selects_schedule():
+    import paddle_tpu.distributed.fleet as fleet
+    st = fleet.DistributedStrategy()
+    st.hybrid_configs = {"pp_degree": 2}
+    st.pipeline_configs["accumulate_steps"] = 4
+    st.pipeline_configs["schedule_mode"] = "FThenB"
+    sched = fleet.pipeline_schedule_from_strategy(st)
+    assert sched.mode == "fthenb" and sched.n_micro == 4
+    st.pipeline_configs["schedule_mode"] = "bogus"
+    with pytest.raises(ValueError):
+        fleet.pipeline_schedule_from_strategy(st)
+
+
+# ---------------------------------------------------------------------------
+# numeric parity vs plain autodiff
+# ---------------------------------------------------------------------------
+
+def _mesh_pp(p):
+    return Mesh(np.array(jax.devices()[:p]), ("pp",))
+
+
+def _setup(p, m, v, d=6, b=3, seed=0):
+    rng = np.random.RandomState(seed)
+    params = {
+        "w": jnp.asarray(rng.randn(v, p, d, d) * 0.3, jnp.float32),
+        "b": jnp.asarray(rng.randn(v, p, d) * 0.1, jnp.float32),
+    }
+    lp = jnp.asarray(rng.randn(d) * 0.5, jnp.float32)
+    xs = jnp.asarray(rng.randn(m, b, d), jnp.float32)
+    ys = jnp.asarray(rng.randn(m, b, d), jnp.float32)
+    return params, lp, xs, ys
+
+
+def _stage_fn(cp, x):
+    return jnp.tanh(x @ cp["w"] + cp["b"])
+
+
+def _loss_fn(lp, o, y):
+    return jnp.mean((o * lp - y) ** 2)
+
+
+def _ref(params, lp, xs, ys, p, V):
+    def loss(pr, l, xs, ys):
+        tot = 0.0
+        for mb in range(xs.shape[0]):
+            h = xs[mb]
+            for q in range(V):
+                cp = {k: a[q // p, q % p] for k, a in pr.items()}
+                h = _stage_fn(cp, h)
+            tot = tot + _loss_fn(l, h, ys[mb])
+        return tot / xs.shape[0]
+    return jax.value_and_grad(loss, argnums=(0, 1, 2))(params, lp, xs, ys)
+
+
+@pytest.mark.parametrize("p,m,v,mode", [
+    (2, 4, 1, "1F1B"),
+    (2, 4, 1, "FThenB"),
+    (2, 4, 2, "1F1B"),      # circular interleaved
+    (4, 8, 2, "1F1B"),
+])
+def test_pipeline_matches_sequential(p, m, v, mode):
+    mesh = _mesh_pp(p)
+    params, lp, xs, ys = _setup(p, m, v)
+    sched = build_pipeline_schedule(p, m, v, mode)
+    loss, gs, glp, dxs = jax.jit(
+        lambda pr, l, x, y: pipeline_forward_backward(
+            _stage_fn, _loss_fn, pr, l, x, y, mesh, sched))(
+        params, lp, xs, ys)
+    rl, (rgs, rglp, rdxs) = _ref(params, lp, xs, ys, p, v * p)
+    assert abs(float(loss) - float(rl)) < 1e-5
+    np.testing.assert_allclose(np.asarray(gs["w"]), np.asarray(rgs["w"]),
+                               atol=2e-5, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(gs["b"]), np.asarray(rgs["b"]),
+                               atol=2e-5, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(glp), np.asarray(rglp),
+                               atol=2e-5, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(dxs), np.asarray(rdxs),
+                               atol=2e-5, rtol=2e-4)
+
+
+def test_custom_vjp_composes_with_outer_grad():
+    """Embedding-outside-the-pipeline path: outer jax.grad flows through
+    the engine's custom_vjp, with correct cotangent scaling."""
+    p, m, v = 2, 4, 1
+    mesh = _mesh_pp(p)
+    params, lp, xs, ys = _setup(p, m, v)
+    sched = build_pipeline_schedule(p, m, v, "1F1B")
+    ploss = make_pipeline_loss_fn(_stage_fn, _loss_fn, mesh, sched)
+    g = jax.jit(jax.grad(
+        lambda pr, l, x: 2.0 * ploss(pr, l, x, ys),
+        argnums=(0, 1, 2)))(params, lp, xs)
+    _, (rgs, rglp, rdxs) = _ref(params, lp, xs, ys, p, v * p)
+    np.testing.assert_allclose(np.asarray(g[0]["w"]),
+                               2 * np.asarray(rgs["w"]),
+                               atol=5e-5, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(g[2]), 2 * np.asarray(rdxs),
+                               atol=5e-5, rtol=2e-4)
+
+
+def test_int_labels_get_float0_cotangent():
+    """ys as int labels must not break outer autodiff."""
+    p, m = 2, 2
+    mesh = _mesh_pp(p)
+    rng = np.random.RandomState(0)
+    params = {"w": jnp.asarray(rng.randn(1, p, 4, 4) * 0.3, jnp.float32),
+              "b": jnp.zeros((1, p, 4), jnp.float32)}
+    lp = jnp.asarray(rng.randn(4, 8) * 0.3, jnp.float32)
+    xs = jnp.asarray(rng.randn(m, 2, 4), jnp.float32)
+    ys = jnp.asarray(rng.randint(0, 8, (m, 2)), jnp.int32)
+
+    def loss_fn(lp, o, y):
+        logp = jax.nn.log_softmax(o @ lp, -1)
+        return -jnp.mean(jnp.take_along_axis(logp, y[..., None], -1))
+
+    sched = build_pipeline_schedule(p, m, 1, "1F1B")
+    ploss = make_pipeline_loss_fn(_stage_fn, loss_fn, mesh, sched)
+    g = jax.jit(jax.grad(lambda pr: ploss(pr, lp, xs, ys)))(params)
+    assert np.all(np.isfinite(np.asarray(g["w"])))
+
+
+# ---------------------------------------------------------------------------
+# flagship: 4D llama (dp x pp x sharding x mp) with interleaved 1F1B
+# ---------------------------------------------------------------------------
+
+def test_llama_pp_4d_trains():
+    from paddle_tpu.models.llama_pp import (PipelinedLlamaConfig,
+                                            build_pipelined_llama_step)
+    mesh = Mesh(np.array(jax.devices()).reshape(1, 2, 2, 2),
+                ("dp", "pp", "sharding", "mp"))
+    cfg = PipelinedLlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_attention_heads=4, num_key_value_heads=2,
+        layers_per_chunk=1, vpp_degree=2)
+    m, b, seq = 4, 2, 16
+    state, step_fn, sched = build_pipelined_llama_step(
+        cfg, mesh, m, b, seq, lr=1e-3)
+    assert sched.mode == "1f1b" and sched.vpp == 2
+    rng = np.random.RandomState(0)
+    ids = jnp.asarray(rng.randint(0, 64, (m * b, seq)), jnp.int32)
+    losses = []
+    for _ in range(3):
+        state, loss = step_fn(state, ids, ids)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
