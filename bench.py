@@ -1,15 +1,27 @@
-"""Benchmark: Llama causal-LM training throughput on one TPU chip.
+"""Benchmark suite for one TPU chip.
 
 Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, "extra": {...}}
 
-value   = steady-state training tokens/sec/chip (compiled TrainStep,
-          bf16 weights, AdamW with f32 masters)
-vs_baseline = achieved_MFU / 0.40 (BASELINE.md north star: >=40% MFU).
+Headline = Llama causal-LM training throughput (largest config that fits
+the chip: llama_mid ~0.7B with GQA, fallback llama_small 0.5B), measured
+as steady-state tokens/sec/chip with a compiled TrainStep (bf16 weights,
+AdamW with f32 masters). vs_baseline = achieved_MFU / 0.40 (BASELINE.md
+north star: >=40% MFU at Llama-3-8B class).
+
+extra also records the two secondary benches BASELINE.md lists:
+- resnet50_imgs_per_sec: ResNet-50 training imgs/sec/chip (bf16,
+  momentum-SGD, batch 256)
+- paged_decode_tok_per_sec: serving decode throughput over the paged KV
+  cache (inference.paged_decode.PagedLlamaDecoder, Pallas scalar-prefetch
+  decode kernel)
 
 MFU accounting follows the PaLM-appendix convention:
   flops/token = 6*N_params + 12*L*H*Q*S  (attention term)
 Peak chip flops: v5e = 197e12 bf16, v5p = 459e12.
+
+Modes: `python bench.py [auto|mid|small|tiny|resnet|decode]` — auto (the
+driver default) runs the full set.
 """
 from __future__ import annotations
 
@@ -32,15 +44,19 @@ def detect_peak_flops() -> float:
     return 197e12
 
 
-def run(config: str = "small"):
+def run_llama(config: str = "mid"):
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
-    from paddle_tpu.models import (LlamaForCausalLM, llama_small, llama_tiny)
+    from paddle_tpu.models import (LlamaForCausalLM, llama_mid, llama_small,
+                                   llama_tiny)
 
     paddle.seed(0)
-    if config == "small":
-        # Pallas flash attention keeps activations light → no remat needed;
-        # measured best at batch 8 (72% MFU on v5e vs 61% with remat)
+    if config == "mid":
+        # ~0.7B, GQA 3:1; flash attention keeps activations light enough
+        # to train without remat at batch 4
+        cfg = llama_mid(dtype="bfloat16", use_recompute=False)
+        batch, seq, iters = 4, 2048, 10
+    elif config == "small":
         cfg = llama_small(dtype="bfloat16", use_recompute=False)
         batch, seq, iters = 8, 1024, 10
     else:
@@ -56,7 +72,6 @@ def run(config: str = "small"):
     ids = paddle.to_tensor(
         rng.randint(0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
 
-    # warmup/compile
     for _ in range(2):
         loss = step(ids, ids)
     float(loss)
@@ -89,14 +104,108 @@ def run(config: str = "small"):
     }
 
 
+def run_resnet():
+    """ResNet-50 training imgs/sec/chip (BASELINE.md secondary metric)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.vision.models import resnet50
+    import paddle_tpu.nn.functional as F
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    for p in model.parameters():  # bf16 weights, f32 masters in SGD
+        p._replace(p._value.astype("bfloat16"))
+    opt = optimizer.Momentum(learning_rate=0.1, momentum=0.9,
+                             parameters=model.parameters())
+    step = paddle.jit.TrainStep(
+        model, lambda o, l: F.cross_entropy(o.astype("float32"), l), opt)
+
+    batch, iters = 256, 10
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randn(batch, 3, 224, 224).astype(np.float32)).astype("bfloat16")
+    y = paddle.to_tensor(rng.randint(0, 1000, batch).astype(np.int64))
+    for _ in range(2):
+        loss = step(x, y)
+    float(loss)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = step(x, y)
+    float(loss)
+    dt = time.perf_counter() - t0
+    return {"resnet50_imgs_per_sec": round(batch * iters / dt, 1),
+            "resnet50_step_ms": round(1000 * dt / iters, 2)}
+
+
+def run_decode():
+    """Paged-KV serving decode tokens/sec (Pallas decode kernel)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference.paged_decode import PagedLlamaDecoder
+
+    paddle.seed(0)
+    cfg = llama_small(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    batch, prompt, steps = 8, 512, 64
+    block_size = 64
+    dec = PagedLlamaDecoder(
+        model, num_blocks=(prompt + steps + block_size) * batch // block_size
+        + batch, block_size=block_size)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    # warmup with the SAME token count (the scanned decode loop's length
+    # is a compile-time constant)
+    dec.generate(ids, max_new_tokens=steps)
+    timings = {}
+    out = dec.generate(ids, max_new_tokens=steps, timings=timings)
+    dt = timings["decode_s"]            # decode phase only — the prefill
+    assert out.shape == (batch, prompt + steps)   # is reported separately
+    return {"paged_decode_tok_per_sec": round(batch * (steps - 1) / dt, 1),
+            "paged_decode_batch": batch,
+            "paged_decode_ms_per_step": round(1000 * dt / (steps - 1), 2),
+            "prefill_ms": round(1000 * timings["prefill_s"], 2)}
+
+
+def main(mode: str):
+    if mode in ("mid", "small", "tiny"):
+        result = run_llama(mode)
+    elif mode == "resnet":
+        result = {"metric": "resnet50_train_imgs_per_sec_chip",
+                  "unit": "imgs/s/chip", "vs_baseline": 0.0}
+        result.update({"value": run_resnet()["resnet50_imgs_per_sec"]})
+    elif mode == "decode":
+        r = run_decode()
+        result = {"metric": "paged_decode_tokens_per_sec",
+                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "value": r["paged_decode_tok_per_sec"], "extra": r}
+    else:  # auto: headline llama + secondary benches in extra
+        try:
+            result = run_llama("mid")
+        except Exception as e:
+            sys.stderr.write(f"bench mid failed ({e}); retrying small\n")
+            result = run_llama("small")
+        for name, fn in (("resnet", run_resnet), ("decode", run_decode)):
+            try:
+                result["extra"].update(fn())
+            except Exception as e:
+                sys.stderr.write(f"bench {name} failed: {e}\n")
+    return result
+
+
+_VALID_MODES = ("auto", "mid", "small", "tiny", "resnet", "decode")
+
 if __name__ == "__main__":
-    config = sys.argv[1] if len(sys.argv) > 1 else "small"
+    mode = sys.argv[1] if len(sys.argv) > 1 else "auto"
+    if mode not in _VALID_MODES:
+        sys.exit(f"unknown bench mode {mode!r}; expected one of "
+                 f"{_VALID_MODES}")
     try:
-        result = run(config)
-    except Exception as e:  # OOM or compile failure: fall back to tiny
-        if config == "small":
-            sys.stderr.write(f"bench small failed ({e}); retrying tiny\n")
-            result = run("tiny")
+        result = main(mode)
+    except Exception as e:
+        if mode == "auto":
+            sys.stderr.write(f"bench auto failed ({e}); retrying tiny\n")
+            result = run_llama("tiny")
         else:
             raise
     print(json.dumps(result))

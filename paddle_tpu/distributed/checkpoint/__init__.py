@@ -30,6 +30,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -40,6 +41,7 @@ from ...framework.core import Parameter, Tensor
 __all__ = ["save_state_dict", "load_state_dict", "wait_until_finished"]
 
 _META = "metadata.json"
+_save_seq = 0
 
 
 def _bounds(index: Tuple, shape: Sequence[int]) -> List[List[int]]:
@@ -177,21 +179,46 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                             host))
 
     # Commit protocol: every file is written to a temp name and renamed
-    # into place, and metadata.json is renamed LAST, only after all of
-    # this process's shards are durable — a crash mid-save never leaves a
-    # valid-looking metadata pointing at torn shard files.
+    # into place; each process then drops a per-save sentinel, and the
+    # coordinator renames metadata.json LAST, only after EVERY process's
+    # sentinel exists (shared-filesystem barrier) — a crash mid-save
+    # never leaves a valid-looking metadata pointing at missing or torn
+    # shard files, on one host or many.
     write_meta = jax.process_index() == coordinator_rank
+    global _save_seq
+    _save_seq += 1
+    save_id = unique_id if unique_id is not None else _save_seq
+    world = jax.process_count()
+    my_sentinel = os.path.join(
+        path, f".shards_done.{save_id}.{jax.process_index()}")
 
     def write_files(items=tuple(pending), meta=meta, do_meta=write_meta):
         for fpath, host in items:
             tmp = fpath + ".tmp.npy"   # .npy suffix: np.save won't append
             _np_save(tmp, host)
             os.replace(tmp, fpath)
+        with open(my_sentinel, "w") as f:
+            f.write("ok")
         if do_meta:
+            deadline = time.monotonic() + 600.0
+            want = [os.path.join(path, f".shards_done.{save_id}.{r}")
+                    for r in range(world)]
+            while not all(os.path.exists(w) for w in want):
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"checkpoint save {save_id}: waited 600s for "
+                        f"all {world} processes' shard sentinels in "
+                        f"{path}")
+                time.sleep(0.05)
             mpath = os.path.join(path, _META)
             with open(mpath + ".tmp", "w") as f:
                 json.dump(meta, f, indent=1)
             os.replace(mpath + ".tmp", mpath)
+            for w in want:
+                try:
+                    os.remove(w)
+                except OSError:
+                    pass
 
     if async_save:
         _writer.submit(write_files)

@@ -324,6 +324,27 @@ def _run_backward(root: "Tensor", grad_arr, retain_graph: bool,
 
 _tensor_method_registry = {}
 
+# When set, Tensor._replace records every mutated Tensor and
+# Tensor.__init__ every created one — to_static's plain-function path
+# uses this to detect writes to PRE-EXISTING state (buffers/globals)
+# that tracing would silently drop (jit/__init__.py).
+_mutation_watch = None
+
+
+class _watch_mutations:
+    """Yields (mutated_ids -> Tensor, created_ids) for the with-block."""
+
+    def __enter__(self):
+        global _mutation_watch
+        self._prev = _mutation_watch
+        _mutation_watch = ({}, set())
+        return _mutation_watch
+
+    def __exit__(self, *exc):
+        global _mutation_watch
+        _mutation_watch = self._prev
+        return False
+
 
 class Tensor:
     """Eager tensor: a jax.Array plus autograd metadata.
@@ -338,6 +359,8 @@ class Tensor:
                  "process_mesh", "placements")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = ""):
+        if _mutation_watch is not None:
+            _mutation_watch[1].add(id(self))
         self._value = value
         self.stop_gradient = stop_gradient
         self.grad = None
@@ -461,6 +484,8 @@ class Tensor:
     def _replace(self, new_value):
         """Replace the underlying array (optimizer updates, buffer updates).
         Breaks no autograd invariants because leaves have no recorded node."""
+        if _mutation_watch is not None:
+            _mutation_watch[0][id(self)] = self
         self._value = new_value
 
     def set_value(self, value):

@@ -1,0 +1,249 @@
+"""Paged-KV serving decode engine for Llama-family models.
+
+Reference: the block_multihead_attention serving path
+(/root/reference/python/paddle/incubate/nn/functional/
+block_multihead_attention.py + paddle/phi/kernels/fusion/ CUDA kernels):
+fixed-size KV pages + per-sequence block tables, so batched decode serves
+mixed-length sequences without reallocation.
+
+TPU-native structure: two compiled programs —
+- prefill: dense causal attention over the prompt, k/v scattered into the
+  page pool at precomputed flat slots;
+- decode_step: one token for the whole batch; attention over the pool via
+  ops.paged_attention.paged_attention_decode (Pallas scalar-prefetch
+  kernel on TPU), pools donated so page writes are in-place in HBM.
+The Python loop only replays decode_step with fresh host-side slot
+mappings from the PagedKVCache block allocator.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops.paged_attention import PagedKVCache, paged_attention_decode
+from ..ops.flash_attention import flash_attention_reference
+from ..ops.rms_norm import rms_norm
+from ..ops.rope import build_rope_cache
+
+__all__ = ["PagedLlamaDecoder"]
+
+
+def _rotate_half(x):
+    h1, h2 = jnp.split(x, 2, axis=-1)
+    return jnp.concatenate([-h2, h1], axis=-1)
+
+
+def _extract_weights(model):
+    """Pull raw arrays out of a LlamaForCausalLM (single-device serving)."""
+    m = model.model
+    layers = []
+    for lyr in m.layers:
+        a, mlp = lyr.self_attn, lyr.mlp
+        layers.append({
+            "ln1": lyr.input_layernorm.weight._value,
+            "ln2": lyr.post_attention_layernorm.weight._value,
+            "wq": a.q_proj.weight._value, "wk": a.k_proj.weight._value,
+            "wv": a.v_proj.weight._value, "wo": a.o_proj.weight._value,
+            "wg": mlp.gate_proj.weight._value,
+            "wu": mlp.up_proj.weight._value,
+            "wd": mlp.down_proj.weight._value,
+        })
+    head = (model.lm_head.weight._value if model.lm_head is not None
+            else m.embed_tokens.weight._value.T)
+    return {"embed": m.embed_tokens.weight._value, "layers": layers,
+            "norm": m.norm.weight._value, "head": head}
+
+
+class PagedLlamaDecoder:
+    """Batched paged-KV generation for a LlamaForCausalLM."""
+
+    def __init__(self, model, num_blocks: int = 512, block_size: int = 16,
+                 max_pages_per_seq: Optional[int] = None):
+        cfg = model.cfg
+        self.cfg = cfg
+        self.block_size = block_size
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.max_pages = max_pages_per_seq or \
+            -(-cfg.max_position_embeddings // block_size)
+        self.weights = _extract_weights(model)
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
+            block_size=block_size, kv_heads=cfg.num_key_value_heads,
+            head_dim=self.head_dim,
+            dtype=self.weights["embed"].dtype)
+        cos, sin = build_rope_cache(cfg.max_position_embeddings,
+                                    self.head_dim, cfg.rope_theta,
+                                    jnp.float32)
+        self._cos = cos[0, :, 0, :]   # [max_len, head_dim]
+        self._sin = sin[0, :, 0, :]
+        self._prefill = jax.jit(self._prefill_impl,
+                                donate_argnums=(1, 2))
+        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2, 7))
+        self._decode_scan = jax.jit(self._decode_scan_impl,
+                                    donate_argnums=(1, 2))
+
+    # -- attention building blocks -----------------------------------------
+    def _proj_qkv(self, w, hn, b, s):
+        cfg = self.cfg
+        q = (hn @ w["wq"]).reshape(b, s, cfg.num_attention_heads,
+                                   self.head_dim)
+        k = (hn @ w["wk"]).reshape(b, s, cfg.num_key_value_heads,
+                                   self.head_dim)
+        v = (hn @ w["wv"]).reshape(b, s, cfg.num_key_value_heads,
+                                   self.head_dim)
+        return q, k, v
+
+    def _rope(self, x, positions):
+        # x [b, s, h, d]; positions [b, s]
+        cos = self._cos[positions][:, :, None, :].astype(x.dtype)
+        sin = self._sin[positions][:, :, None, :].astype(x.dtype)
+        return x * cos + _rotate_half(x) * sin
+
+    # -- compiled programs ---------------------------------------------------
+    def _prefill_impl(self, weights, k_pool, v_pool, ids, slots):
+        """ids [b, s]; slots [b, s] flat page slots. Returns (logits of
+        the LAST prompt token [b, vocab], updated pools)."""
+        cfg = self.cfg
+        b, s = ids.shape
+        h = jnp.take(weights["embed"], ids, axis=0)
+        positions = jnp.broadcast_to(jnp.arange(s)[None], (b, s))
+        flat = slots.reshape(-1)
+        for li, w in enumerate(weights["layers"]):
+            hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)
+            q, k, v = self._proj_qkv(w, hn, b, s)
+            q = self._rope(q, positions)
+            k = self._rope(k, positions)
+            attn = flash_attention_reference(q, k, v, causal=True)
+            h = h + attn.reshape(b, s, cfg.hidden_size) @ w["wo"]
+            hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(hn @ w["wg"]) * (hn @ w["wu"])) @ w["wd"]
+            # scatter this layer's k/v into the pool pages (list swap —
+            # no stacked-pool slice copies)
+            from ..ops.paged_attention import reshape_and_cache
+            nk, nv = reshape_and_cache(
+                k.reshape(b * s, -1, self.head_dim),
+                v.reshape(b * s, -1, self.head_dim),
+                k_pool[li], v_pool[li], flat)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = nk
+            v_pool[li] = nv
+        h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
+        logits = (h[:, -1] @ weights["head"]).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
+                     ctx_lens, slots):
+        """One decode token for the batch (shared by the single-step and
+        scanned programs). last_ids [b]; tables [b, max_pages]; ctx_lens
+        [b] (tokens already cached, EXCLUDING this one); slots [b] flat
+        slot for this token's k/v."""
+        cfg = self.cfg
+        b = last_ids.shape[0]
+        h = jnp.take(weights["embed"], last_ids, axis=0)  # [b, d]
+        pos = ctx_lens[:, None]                            # [b, 1]
+        for li, w in enumerate(weights["layers"]):
+            hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)
+            q, k, v = self._proj_qkv(w, hn[:, None, :], b, 1)
+            q = self._rope(q, pos)[:, 0]                   # [b, nh, d]
+            k = self._rope(k, pos)[:, 0]                   # [b, kvh, d]
+            v = v[:, 0]
+            from ..ops.paged_attention import reshape_and_cache
+            kp, vp = reshape_and_cache(k, v, k_pool[li], v_pool[li],
+                                       slots)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = kp
+            v_pool[li] = vp
+            attn = paged_attention_decode(q, kp, vp, tables, ctx_lens + 1)
+            h = h + attn.reshape(b, cfg.hidden_size) @ w["wo"]
+            hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
+            h = h + (jax.nn.silu(hn @ w["wg"]) * (hn @ w["wu"])) @ w["wd"]
+        h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
+        logits = (h @ weights["head"]).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, k_pool, v_pool
+
+    def _decode_impl(self, weights, k_pool, v_pool, last_ids, tables,
+                     ctx_lens, slots, tok_buf, t):
+        nxt, k_pool, v_pool = self._decode_body(
+            weights, k_pool, v_pool, last_ids, tables, ctx_lens, slots)
+        tok_buf = jax.lax.dynamic_update_slice_in_dim(
+            tok_buf, nxt[:, None], t, axis=1)
+        return nxt, k_pool, v_pool, tok_buf
+
+    def _decode_scan_impl(self, weights, k_pool, v_pool, first_ids,
+                          tables_all, ctx_all, slots_all):
+        """The WHOLE decode loop as one compiled lax.scan — one dispatch
+        for T tokens (the page/slot schedule is deterministic, so the
+        host precomputes it). Essential when per-dispatch latency is
+        high; also the canonical TPU shape for the serving loop."""
+        def step(carry, xs):
+            last_ids, kp, vp = carry
+            tables, ctx, slots = xs
+            nxt, kp, vp = self._decode_body(weights, kp, vp, last_ids,
+                                            tables, ctx, slots)
+            return (nxt, kp, vp), nxt
+        (_, k_pool, v_pool), toks = jax.lax.scan(
+            step, (first_ids, k_pool, v_pool),
+            (tables_all, ctx_all, slots_all))
+        return toks.swapaxes(0, 1), k_pool, v_pool   # [b, T]
+
+    # -- public API ----------------------------------------------------------
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 timings: dict = None):
+        """Greedy batched generation. input_ids [b, prompt_len] (np /
+        Tensor); returns np.ndarray [b, prompt_len + max_new_tokens].
+        When `timings` is a dict it receives prefill_s / decode_s wall
+        times (each phase synchronized for honest accounting)."""
+        import time as _time
+        ids = input_ids._value if isinstance(input_ids, Tensor) \
+            else jnp.asarray(input_ids)
+        ids = np.asarray(ids).astype(np.int32)
+        b, s = ids.shape
+        cache = self.cache
+        seqs = list(range(b))
+        slot_rows = []
+        for i in seqs:
+            cache.allocate(i, s + max_new_tokens)
+            slot_rows.append([cache.extend(i) for _ in range(s)])
+        slots = jnp.asarray(np.asarray(slot_rows, np.int32))
+        t0 = _time.perf_counter()
+        logits, cache.k, cache.v = self._prefill(
+            self.weights, cache.k, cache.v, jnp.asarray(ids), slots)
+        next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if timings is not None:
+            next_ids.block_until_ready()
+            timings["prefill_s"] = _time.perf_counter() - t0
+
+        # precompute the whole schedule host-side (deterministic), then
+        # run ONE compiled scan for all remaining tokens
+        T = max_new_tokens - 1
+        ctx_all = np.zeros((T, b), np.int32)
+        slots_all = np.zeros((T, b), np.int32)
+        tables_all = np.zeros((T, b, self.max_pages), np.int32)
+        for t in range(T):
+            ctx_all[t] = [cache.context_len(i) for i in seqs]
+            slots_all[t] = [cache.extend(i) for i in seqs]
+            tables_all[t] = np.stack(
+                [cache.block_table(i, self.max_pages) for i in seqs])
+        t1 = _time.perf_counter()
+        if T > 0:
+            toks, cache.k, cache.v = self._decode_scan(
+                self.weights, cache.k, cache.v, next_ids,
+                jnp.asarray(tables_all), jnp.asarray(ctx_all),
+                jnp.asarray(slots_all))
+            toks = np.asarray(toks)
+        else:
+            toks = np.zeros((b, 0), np.int32)
+        if timings is not None:
+            timings["decode_s"] = _time.perf_counter() - t1
+        for i in seqs:
+            cache.free(i)
+        return np.concatenate(
+            [ids, np.asarray(next_ids)[:, None], toks], axis=1)

@@ -112,12 +112,54 @@ def functional_call(layer, param_arrays: Sequence[jax.Array],
 # to_static
 # ---------------------------------------------------------------------------
 
+_RETRACE_WARN_THRESHOLD = 8
+
+
+def _trace_error(exc, fn_name):
+    """Rewrap jax tracing failures with actionable paddle-level guidance
+    (the SOT-guard analog: reference jit/sot/translate.py:31 falls back on
+    graph breaks; here we say exactly what to change or offer
+    full_graph=False eager fallback)."""
+    import jax.errors as jerr
+    msg = None
+    if isinstance(exc, jerr.TracerBoolConversionError) or \
+            "TracerBoolConversionError" in type(exc).__name__:
+        msg = ("data-dependent Python control flow (if/while on a traced "
+               "Tensor value). Use paddle_tpu.static.nn.cond / "
+               "while_loop / switch_case, move the branch out of the "
+               "compiled function, or pass full_graph=False to run this "
+               "function eagerly")
+    elif isinstance(exc, jerr.ConcretizationTypeError):
+        msg = ("a traced Tensor was used where a concrete Python value is "
+               "required (e.g. int(x), x.item(), shape-dependent Python "
+               "logic). Hoist the value out of the compiled function or "
+               "pass full_graph=False")
+    elif isinstance(exc, jerr.TracerArrayConversionError):
+        msg = ("a traced Tensor was converted to numpy (np.asarray/"
+               ".numpy()) inside the compiled region. Keep the "
+               "computation in paddle/jax ops, or pass full_graph=False")
+    if msg is None:
+        return None
+    return RuntimeError(
+        f"to_static({fn_name}): cannot compile — {msg}.\n"
+        f"Original error: {type(exc).__name__}: {exc}")
+
+
 class StaticFunction:
     """Compiled callable over a Layer or plain function of Tensors.
 
     Forward runs under jax.jit; backward through the result is ONE taped
     node whose VJP is the XLA-compiled cotangent program (the analog of the
-    reference's whole-program backward in partial_program.py)."""
+    reference's whole-program backward in partial_program.py).
+
+    Robustness (reference SOT parity, jit/sot/):
+    - untraceable constructs raise actionable errors naming the fix;
+    - full_graph=False falls back to EAGER execution when tracing fails
+      (the graph-break analog: correctness first, speed when possible);
+    - every retrace is counted and the triggering signature recorded
+      (`retrace_count` / `trace_signatures`); crossing
+      _RETRACE_WARN_THRESHOLD logs a cache-churn warning.
+    """
 
     def __init__(self, fn_or_layer, input_spec=None, build_strategy=None,
                  full_graph=True):
@@ -125,13 +167,39 @@ class StaticFunction:
         self._fn = fn_or_layer if self._layer is None else None
         self._compiled = None
         self._input_spec = input_spec
+        self._full_graph = full_graph
+        self._eager_fallback = False
+        self.retrace_count = 0
+        self.trace_signatures = []
+
+    def _note_trace(self, in_arrays):
+        self.retrace_count += 1
+        sig = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays)
+        self.trace_signatures.append(sig)
+        if len(self.trace_signatures) > 16:   # telemetry, not a log
+            del self.trace_signatures[:-16]
+        if self.retrace_count == _RETRACE_WARN_THRESHOLD:
+            import warnings
+            warnings.warn(
+                f"to_static({self._name()}) retraced "
+                f"{self.retrace_count} times — every new input "
+                f"shape/dtype compiles a new program. Recent signatures: "
+                f"{self.trace_signatures[-4:]}. Pad inputs to fixed "
+                f"shapes or bucket them.", RuntimeWarning)
+
+    def _name(self):
+        target = self._layer if self._layer is not None else self._fn
+        return getattr(target, "__name__",
+                       type(target).__name__ if target is not None else "?")
 
     # the pure array function
     def _build(self):
         layer = self._layer
+        note = self._note_trace
 
         if layer is not None:
             def pure(param_arrays, buffer_arrays, rng_key, training, *in_arrays):
+                note(in_arrays)
                 layer.training = training
                 with with_rng_key(rng_key):
                     out, new_bufs = functional_call(
@@ -141,16 +209,56 @@ class StaticFunction:
             fn = self._fn
 
             def pure(param_arrays, buffer_arrays, rng_key, training, *in_arrays):
+                note(in_arrays)
                 targs = tuple(Tensor(a) for a in in_arrays)
-                with with_rng_key(rng_key), no_grad():
+                from ..framework.core import _watch_mutations
+                with with_rng_key(rng_key), no_grad(), \
+                        _watch_mutations() as (mutated, created):
                     out = fn(*targs)
+                arg_ids = {id(t) for t in targs}
+                leaked = [t for i, t in mutated.items()
+                          if i not in created and i not in arg_ids]
+                if leaked:
+                    raise RuntimeError(
+                        f"to_static({fn.__name__}): the function mutates "
+                        f"{len(leaked)} Tensor(s) it does not own (buffer/"
+                        f"global state writes). Tracing would silently "
+                        f"drop these updates. Wrap the owning Layer with "
+                        f"to_static instead (its buffers are threaded "
+                        f"through the compiled program), or return the "
+                        f"updated values explicitly.")
                 return _unwrap_tree(out), []
 
         return jax.jit(pure, static_argnums=(3,))
 
     def __call__(self, *args, **kwargs):
+        if self._eager_fallback:
+            return self._call_eager(args, kwargs)
         if self._compiled is None:
             self._compiled = self._build()
+        try:
+            return self._call_compiled(args, kwargs)
+        except Exception as e:
+            wrapped = _trace_error(e, self._name())
+            if wrapped is None:
+                raise
+            if not self._full_graph:
+                # graph-break fallback (SOT parity): run eagerly, warn once
+                import warnings
+                warnings.warn(
+                    f"to_static({self._name()}): tracing failed "
+                    f"({type(e).__name__}); falling back to EAGER "
+                    f"execution (full_graph=False). The function will not "
+                    f"be compiled.", RuntimeWarning)
+                self._eager_fallback = True
+                return self._call_eager(args, kwargs)
+            raise wrapped from e
+
+    def _call_eager(self, args, kwargs):
+        target = self._layer if self._layer is not None else self._fn
+        return target(*args, **kwargs)
+
+    def _call_compiled(self, args, kwargs):
         layer = self._layer
         if layer is not None:
             params, buffers = _collect(layer)
@@ -211,13 +319,18 @@ class StaticFunction:
 
 def to_static(function=None, input_spec=None, build_strategy=None,
               backend=None, full_graph=True):
-    """paddle.jit.to_static parity (/root/reference/python/paddle/jit/api.py:171)."""
+    """paddle.jit.to_static parity (/root/reference/python/paddle/jit/api.py:171).
+
+    full_graph=False enables the graph-break analog: if tracing fails on
+    an untraceable construct, the function runs eagerly instead (with a
+    one-time warning) rather than erroring."""
     def decorate(fn):
         if hasattr(fn, "forward"):  # Layer: wrap call while keeping layer API
-            static = StaticFunction(fn, input_spec, build_strategy)
-            fn.__call__ = static  # not ideal for instances; return wrapper
+            static = StaticFunction(fn, input_spec, build_strategy,
+                                    full_graph=full_graph)
             return _StaticLayerProxy(fn, static)
-        return StaticFunction(fn, input_spec, build_strategy)
+        return StaticFunction(fn, input_spec, build_strategy,
+                              full_graph=full_graph)
 
     if function is not None:
         return decorate(function)
@@ -226,11 +339,18 @@ def to_static(function=None, input_spec=None, build_strategy=None,
 
 class _StaticLayerProxy:
     """Layer wrapper whose __call__ is compiled but which forwards
-    everything else (state_dict, parameters, train/eval) to the layer."""
+    everything else (state_dict, parameters, train/eval) to the layer.
+    Reports the wrapped layer's __class__, so isinstance(proxy, Layer)
+    (and isinstance against the concrete model class) hold; the layer
+    instance itself is never mutated."""
 
     def __init__(self, layer, static_fn):
         object.__setattr__(self, "_layer", layer)
         object.__setattr__(self, "_static_fn", static_fn)
+
+    @property
+    def __class__(self):
+        return type(self._layer)
 
     def __call__(self, *args, **kwargs):
         return self._static_fn(*args, **kwargs)
@@ -339,6 +459,13 @@ class TrainStep:
         model(*inputs); loss as loss_fn(model_out, *labels)."""
         if self._compiled is None:
             self._compiled = self._build()
+            import os as _os
+            from ..utils.flags import FLAGS
+            if getattr(FLAGS, "enable_watchdog", None) or \
+                    _os.environ.get("FLAGS_enable_watchdog", "").lower() \
+                    in ("1", "true"):
+                from ..distributed.watchdog import enable_watchdog
+                enable_watchdog()
         if self.optimizer._state is None:
             self.optimizer._state = self.optimizer.init_state(
                 [p._value for p in self.optimizer._parameter_list])
@@ -367,6 +494,8 @@ class TrainStep:
         self.optimizer._state = new_state
         self.optimizer._step_count += 1
         self._step_i += 1
+        from ..distributed.watchdog import notify_step
+        notify_step(self._step_i)
         return Tensor(loss)
 
 

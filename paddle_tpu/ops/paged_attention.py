@@ -7,9 +7,12 @@ the KV cache lives in fixed-size blocks; a per-sequence block table maps
 logical positions to physical blocks, so sequences grow without
 reallocation and memory fragments are reclaimed per-block (vLLM-style).
 
-TPU-native: the decode gather is expressed as one jnp.take over the
-block axis followed by a flash-style softmax over the gathered window —
-XLA lowers the gather efficiently and fuses the rest; everything is
+TPU-native: on TPU the decode runs a Pallas kernel
+(ops/pallas/paged_attention.py) whose K/V BlockSpec index maps consume a
+scalar-prefetched block table — each grid step DMAs one physical page
+from the HBM pool, no gathered [batch, window, ...] materialization, with
+an online-softmax accumulated across pages in VMEM scratch. The jnp.take
+composition below is the reference oracle + CPU path; everything is
 fixed-shape (max_blocks per sequence) so one compiled program serves all
 lengths, with masking by context length.
 """
@@ -21,54 +24,59 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedKVCache", "paged_attention_decode", "reshape_and_cache"]
+__all__ = ["PagedKVCache", "paged_attention_decode",
+           "paged_attention_decode_reference", "reshape_and_cache"]
 
 
 def reshape_and_cache(k, v, k_cache, v_cache, slot_mapping):
     """Scatter this step's K/V ([batch, kv_heads, head_dim]) into the
     block pool at flat slot ids (block_id * block_size + offset).
-    Returns updated caches. Cache layout: [num_blocks, block_size,
-    kv_heads, head_dim]."""
-    nb, bs, h, d = k_cache.shape
-    flat_k = k_cache.reshape(nb * bs, h, d)
-    flat_v = v_cache.reshape(nb * bs, h, d)
-    flat_k = flat_k.at[slot_mapping].set(k)
-    flat_v = flat_v.at[slot_mapping].set(v)
-    return flat_k.reshape(nb, bs, h, d), flat_v.reshape(nb, bs, h, d)
+    Returns updated caches. Cache layout: [num_blocks, kv_heads,
+    block_size, head_dim] — a physical page is one contiguous
+    [kv_heads, block_size, head_dim] region, so the Pallas decode kernel
+    fetches a whole page (all kv heads) with a single DMA."""
+    nb, h, bs, d = k_cache.shape
+    blocks = slot_mapping // bs
+    offs = slot_mapping % bs
+    heads = jnp.arange(h)[None, :]
+    k_cache = k_cache.at[blocks[:, None], heads, offs[:, None]].set(k)
+    v_cache = v_cache.at[blocks[:, None], heads, offs[:, None]].set(v)
+    return k_cache, v_cache
 
 
-def paged_attention_decode(q, k_cache, v_cache, block_tables, context_lens,
-                           scale: Optional[float] = None):
-    """One-token decode attention over the paged cache.
+def paged_attention_decode_reference(q, k_cache, v_cache, block_tables,
+                                     context_lens,
+                                     scale: Optional[float] = None):
+    """One-token decode attention over the paged cache (jnp oracle).
 
     q:            [batch, num_heads, head_dim]  (this step's query)
-    k_cache/v_cache: [num_blocks, block_size, kv_heads, head_dim]
+    k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim]
     block_tables: [batch, max_blocks] int32 physical block ids
     context_lens: [batch] int32 — valid tokens per sequence (incl. this)
     Returns [batch, num_heads, head_dim].
     """
     b, nh, d = q.shape
-    nb, bs, kvh, _ = k_cache.shape
+    nb, kvh, bs, _ = k_cache.shape
     max_blocks = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     group = nh // kvh  # GQA: queries per kv head
 
-    # gather each sequence's blocks: [b, max_blocks, bs, kvh, d]
+    # gather each sequence's blocks: [b, max_blocks, kvh, bs, d]
     k = jnp.take(k_cache, block_tables, axis=0)
     v = jnp.take(v_cache, block_tables, axis=0)
-    k = k.reshape(b, max_blocks * bs, kvh, d)
-    v = v.reshape(b, max_blocks * bs, kvh, d)
+    k = k.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
+    v = v.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
 
     qg = q.reshape(b, kvh, group, d)
     # scores: [b, kvh, group, S]
-    scores = jnp.einsum("bkgd,bskd->bkgs", qg.astype(jnp.float32),
+    scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
     pos = jnp.arange(max_blocks * bs)[None, None, None, :]
     mask = pos < context_lens[:, None, None, None]
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bskd->bkgd", probs, v.astype(jnp.float32))
+    out = jnp.einsum("bkgs,bksd->bkgd", probs, v.astype(jnp.float32))
     return out.reshape(b, nh, d).astype(q.dtype)
 
 
@@ -85,9 +93,12 @@ class PagedKVCache:
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
-        self.k = jnp.zeros((num_layers, num_blocks, block_size, kv_heads,
-                            head_dim), dtype)
-        self.v = jnp.zeros_like(self.k)
+        # per-layer pools as a LIST pytree: updating one layer swaps a
+        # list element — no [L, ...] slice/update copies in the compiled
+        # decode step
+        self.k = [jnp.zeros((num_blocks, kv_heads, block_size, head_dim),
+                            dtype) for _ in range(num_layers)]
+        self.v = [jnp.zeros_like(self.k[0]) for _ in range(num_layers)]
         self._free = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id → [block ids]
         self._lens: dict = {}     # seq_id → context length
@@ -138,5 +149,31 @@ class PagedKVCache:
         """Write one step's K/V for `layer` at the given flat slots."""
         nk, nv = reshape_and_cache(k, v, self.k[layer], self.v[layer],
                                    slot_mapping)
-        self.k = self.k.at[layer].set(nk)
-        self.v = self.v.at[layer].set(nv)
+        self.k[layer] = nk
+        self.v[layer] = nv
+
+
+def _pallas_decode_ok(q, k_cache):
+    if jax.default_backend() in ("cpu", "gpu"):
+        return False
+    from ..utils.flags import FLAGS
+    if not getattr(FLAGS, "use_pallas_kernels", True):
+        return False
+    d = q.shape[-1]
+    bs = k_cache.shape[2]   # layout [num_blocks, kv_heads, block_size, d]
+    return d in (64, 128, 256) and bs % 8 == 0
+
+
+def paged_attention_decode(q, k_cache, v_cache, block_tables, context_lens,
+                           scale: Optional[float] = None):
+    """One-token decode attention over the paged cache; Pallas
+    scalar-prefetch kernel on TPU, jnp reference elsewhere. See
+    paged_attention_decode_reference for the signature."""
+    if _pallas_decode_ok(q, k_cache):
+        from .pallas.paged_attention import paged_attention_decode_pallas
+        return paged_attention_decode_pallas(q, k_cache, v_cache,
+                                             block_tables, context_lens,
+                                             scale)
+    return paged_attention_decode_reference(q, k_cache, v_cache,
+                                            block_tables, context_lens,
+                                            scale)

@@ -1,0 +1,119 @@
+"""to_static robustness: actionable trace errors, eager graph-break
+fallback (full_graph=False), retrace telemetry, proxy hygiene, and
+plain-function state-write detection.
+
+Reference parity: the SOT guard/graph-break design
+(/root/reference/python/paddle/jit/sot/translate.py:31,
+opcode_translator/executor/opcode_executor.py) — untraceable Python either
+falls back or fails with a pointed message, never silently misbehaves.
+"""
+import warnings
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+
+def test_data_dependent_branch_actionable_error():
+    @paddle.jit.to_static
+    def f(x):
+        if (x.sum() > 0):          # traced bool -> untraceable
+            return x * 2
+        return x
+
+    with pytest.raises(RuntimeError) as ei:
+        f(paddle.randn([4]))
+    msg = str(ei.value)
+    assert "cannot compile" in msg
+    assert "cond" in msg and "full_graph=False" in msg
+
+
+def test_full_graph_false_falls_back_to_eager():
+    calls = []
+
+    @paddle.jit.to_static(full_graph=False)
+    def f(x):
+        calls.append(1)
+        if float(x.sum()) > 0:     # concretization under trace
+            return x * 2.0
+        return x - 1.0
+
+    x = paddle.to_tensor(np.ones(4, np.float32))
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        out = f(x)
+    assert any("EAGER" in str(wi.message) for wi in w)
+    np.testing.assert_allclose(out.numpy(), 2 * np.ones(4), rtol=1e-6)
+    # subsequent calls stay eager and correct, with no further warnings
+    out2 = f(paddle.to_tensor(-np.ones(4, np.float32)))
+    np.testing.assert_allclose(out2.numpy(), -2 * np.ones(4), rtol=1e-6)
+
+
+def test_retrace_telemetry_and_churn_warning():
+    @paddle.jit.to_static
+    def f(x):
+        return x * 2.0
+
+    for n in range(1, 10):
+        f(paddle.randn([n, 2]))   # every call: new shape -> retrace
+    assert f.retrace_count >= 8
+    assert len(f.trace_signatures) == f.retrace_count
+    assert f.trace_signatures[0][0][0] == (1, 2)
+    # the churn warning fired at the threshold
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        g = paddle.jit.to_static(lambda x: x + 1)
+        for n in range(1, 10):
+            g(paddle.randn([n]))
+    assert any("retraced" in str(wi.message) for wi in w)
+
+
+def test_layer_proxy_isinstance_and_no_instance_pollution():
+    m = nn.Linear(4, 4)
+    static = paddle.jit.to_static(m)
+    assert isinstance(static, nn.Linear)
+    assert isinstance(static, nn.Layer)
+    # the underlying instance is not mutated with a __call__ attribute
+    assert "__call__" not in vars(m)
+    x = paddle.randn([2, 4])
+    out = static(x)
+    want = x.numpy() @ m.weight.numpy() + m.bias.numpy()
+    np.testing.assert_allclose(out.numpy(), want, rtol=1e-5, atol=1e-5)
+    # layer API still reachable through the proxy
+    assert len(static.parameters()) == 2
+
+
+def test_plain_function_state_write_detected():
+    state = paddle.zeros([4])
+
+    @paddle.jit.to_static
+    def f(x):
+        state.set_value(state + x)   # external state write: must not be
+        return x * 1.0               # silently dropped
+
+    with pytest.raises(RuntimeError, match="mutates"):
+        f(paddle.randn([4]))
+
+
+def test_plain_function_internal_temporaries_allowed():
+    @paddle.jit.to_static
+    def f(x):
+        tmp = paddle.zeros([4])
+        tmp.set_value(x * 2.0)       # owns tmp: fine
+        return tmp + 1.0
+
+    out = f(paddle.to_tensor(np.ones(4, np.float32)))
+    np.testing.assert_allclose(out.numpy(), 3 * np.ones(4), rtol=1e-6)
+
+
+def test_layer_buffer_updates_still_threaded():
+    """The Layer path must keep threading buffer updates (BatchNorm)."""
+    bn = nn.BatchNorm1D(4)
+    bn.train()
+    static = paddle.jit.to_static(bn)
+    before = bn._mean.numpy().copy()
+    static(paddle.randn([8, 4]) + 3.0)
+    after = bn._mean.numpy()
+    assert not np.allclose(before, after)
