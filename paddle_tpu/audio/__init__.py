@@ -9,6 +9,7 @@ instructions, info works for WAV via the stdlib wave module).
 """
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
-from . import backends  # noqa: F401
+from . import backends
+from . import datasets  # noqa: F401
 
-__all__ = ["functional", "features", "backends"]
+__all__ = ["functional", "features", "backends", "datasets"]

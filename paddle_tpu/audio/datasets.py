@@ -1,0 +1,112 @@
+"""paddle_tpu.audio.datasets — local-file audio datasets (reference:
+/root/reference/python/paddle/audio/datasets/ — ESC50, TESS). No-network
+environment: readers parse the standard on-disk layouts."""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..io import Dataset
+from .backends import load as _load
+from .features import MelSpectrogram
+
+__all__ = ["ESC50", "TESS"]
+
+
+class ESC50(Dataset):
+    """ESC-50 environmental sound classification from a local checkout
+    (meta/esc50.csv + audio/*.wav; reference audio/datasets/esc50.py).
+    mode='train' uses folds != split_fold; 'dev' the held-out fold.
+    feat_type: 'raw' waveform or 'melspectrogram'."""
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 split_fold: int = 1, feat_type: str = "raw",
+                 archive=None, **feat_kwargs):
+        if data_dir is None:
+            raise ValueError(
+                "data_dir is required (no network in this environment)")
+        meta = os.path.join(data_dir, "meta", "esc50.csv")
+        rows = [l.rstrip("\n").split(",") for l in
+                open(meta, errors="ignore").read().splitlines()[1:]]
+        self.files, self.labels = [], []
+        for r in rows:
+            fname, fold, target = r[0], int(r[1]), int(r[2])
+            keep = (fold != split_fold) if mode == "train" \
+                else (fold == split_fold)
+            if keep:
+                self.files.append(os.path.join(data_dir, "audio", fname))
+                self.labels.append(target)
+        self.feat_type = feat_type
+        self._feat = None
+        if feat_type == "melspectrogram":
+            self._feat = MelSpectrogram(**feat_kwargs)
+
+    def _waveform(self, path):
+        wav, sr = _load(path)
+        w = np.asarray(wav.numpy() if hasattr(wav, "numpy") else wav,
+                       np.float32)
+        return w[0] if w.ndim > 1 else w
+
+    def __getitem__(self, idx):
+        w = self._waveform(self.files[idx])
+        label = np.int64(self.labels[idx])
+        if self._feat is not None:
+            import paddle_tpu as paddle
+            feat = self._feat(paddle.to_tensor(w[None]))
+            return np.asarray(feat.numpy()[0]), label
+        return w, label
+
+    def __len__(self):
+        return len(self.files)
+
+
+class TESS(Dataset):
+    """Toronto Emotional Speech Set from a local directory of
+    <...>_<emotion>.wav files (reference audio/datasets/tess.py)."""
+
+    EMOTIONS = ["angry", "disgust", "fear", "happy", "neutral", "ps",
+                "sad"]
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 n_folds: int = 5, split_fold: int = 1,
+                 feat_type: str = "raw", **feat_kwargs):
+        if data_dir is None:
+            raise ValueError(
+                "data_dir is required (no network in this environment)")
+        files = []
+        for root, _, names in os.walk(data_dir):
+            for n in sorted(names):
+                if n.lower().endswith(".wav"):
+                    files.append(os.path.join(root, n))
+        self.files, self.labels = [], []
+        for i, f in enumerate(sorted(files)):
+            emo = os.path.splitext(os.path.basename(f))[0] \
+                .split("_")[-1].lower()
+            if emo not in self.EMOTIONS:
+                continue
+            fold = i % n_folds + 1
+            keep = (fold != split_fold) if mode == "train" \
+                else (fold == split_fold)
+            if keep:
+                self.files.append(f)
+                self.labels.append(self.EMOTIONS.index(emo))
+        self.feat_type = feat_type
+        self._feat = MelSpectrogram(**feat_kwargs) \
+            if feat_type == "melspectrogram" else None
+
+    def __getitem__(self, idx):
+        wav, sr = _load(self.files[idx])
+        w = np.asarray(wav.numpy() if hasattr(wav, "numpy") else wav,
+                       np.float32)
+        w = w[0] if w.ndim > 1 else w
+        label = np.int64(self.labels[idx])
+        if self._feat is not None:
+            import paddle_tpu as paddle
+            feat = self._feat(paddle.to_tensor(w[None]))
+            return np.asarray(feat.numpy()[0]), label
+        return w, label
+
+    def __len__(self):
+        return len(self.files)

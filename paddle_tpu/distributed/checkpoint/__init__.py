@@ -187,10 +187,23 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     write_meta = jax.process_index() == coordinator_rank
     global _save_seq
     _save_seq += 1
-    save_id = unique_id if unique_id is not None else _save_seq
+    if unique_id is not None:
+        save_id = unique_id
+    else:
+        # launcher restarts relaunch every rank with a bumped generation,
+        # so generation.seq never collides with a crashed run's sentinels
+        gen = os.environ.get("PADDLE_RESTART_GENERATION", "0")
+        save_id = f"{gen}.{_save_seq}"
     world = jax.process_count()
     my_sentinel = os.path.join(
         path, f".shards_done.{save_id}.{jax.process_index()}")
+    # drop any stale sentinel for this (save_id, rank) BEFORE any writer
+    # could re-create it — a crashed previous save must not satisfy the
+    # coordinator's barrier
+    try:
+        os.remove(my_sentinel)
+    except OSError:
+        pass
 
     def write_files(items=tuple(pending), meta=meta, do_meta=write_meta):
         for fpath, host in items:

@@ -100,13 +100,14 @@ def _stacked(x: Tensor, g: Group):
 
 
 def _run(g: Group, fn, arr, out_spec=P("rank")):
-    from .watchdog import watch_section
+    from .watchdog import get_default_watchdog, watch_section
     f = shard_map(fn, mesh=g.mesh, in_specs=(P("rank"),),
                   out_specs=out_spec, check_vma=False)
-    # the watchdog reports this section if the collective never lands
-    # (CommTaskManager parity: comm_task_manager.h:37). jax dispatch is
-    # async, so block inside the section — otherwise a device-side hang
-    # would never be attributed to it.
+    if get_default_watchdog() is None:   # default: keep async dispatch
+        return jax.jit(f)(arr)
+    # watchdog active: block inside the watched section so a device-side
+    # hang is attributed to THIS collective (CommTaskManager parity:
+    # comm_task_manager.h:37) — jax dispatch alone returns immediately.
     with watch_section(getattr(fn, "__name__", "collective")):
         out = jax.jit(f)(arr)
         jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)

@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..ops.paged_attention import PagedKVCache, paged_attention_decode
-from ..ops.flash_attention import flash_attention_reference
+from ..ops.flash_attention import flash_attention
 from ..ops.rms_norm import rms_norm
 from ..ops.rope import build_rope_cache
 
@@ -83,7 +83,6 @@ class PagedLlamaDecoder:
         self._sin = sin[0, :, 0, :]
         self._prefill = jax.jit(self._prefill_impl,
                                 donate_argnums=(1, 2))
-        self._decode = jax.jit(self._decode_impl, donate_argnums=(1, 2, 7))
         self._decode_scan = jax.jit(self._decode_scan_impl,
                                     donate_argnums=(1, 2))
 
@@ -118,7 +117,7 @@ class PagedLlamaDecoder:
             q, k, v = self._proj_qkv(w, hn, b, s)
             q = self._rope(q, positions)
             k = self._rope(k, positions)
-            attn = flash_attention_reference(q, k, v, causal=True)
+            attn = flash_attention(q, k, v, causal=True)
             h = h + attn.reshape(b, s, cfg.hidden_size) @ w["wo"]
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
             h = h + (jax.nn.silu(hn @ w["wg"]) * (hn @ w["wu"])) @ w["wd"]
@@ -169,14 +168,6 @@ class PagedLlamaDecoder:
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, k_pool, v_pool
 
-    def _decode_impl(self, weights, k_pool, v_pool, last_ids, tables,
-                     ctx_lens, slots, tok_buf, t):
-        nxt, k_pool, v_pool = self._decode_body(
-            weights, k_pool, v_pool, last_ids, tables, ctx_lens, slots)
-        tok_buf = jax.lax.dynamic_update_slice_in_dim(
-            tok_buf, nxt[:, None], t, axis=1)
-        return nxt, k_pool, v_pool, tok_buf
-
     def _decode_scan_impl(self, weights, k_pool, v_pool, first_ids,
                           tables_all, ctx_all, slots_all):
         """The WHOLE decode loop as one compiled lax.scan — one dispatch
@@ -221,6 +212,10 @@ class PagedLlamaDecoder:
             next_ids.block_until_ready()
             timings["prefill_s"] = _time.perf_counter() - t0
 
+        if max_new_tokens <= 0:
+            for i in seqs:
+                cache.free(i)
+            return ids
         # precompute the whole schedule host-side (deterministic), then
         # run ONE compiled scan for all remaining tokens
         T = max_new_tokens - 1

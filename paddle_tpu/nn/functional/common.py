@@ -203,4 +203,33 @@ def channel_shuffle(x, groups, data_format="NCHW", name=None):
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):
-    raise NotImplementedError("class_center_sample: PS-era API, descoped")
+    """Sample `num_samples` class centers: every positive class (present
+    in `label`) is kept, negatives fill the rest uniformly at random.
+    Returns (remapped_label, sampled_class_center) — remapped_label maps
+    each label to its index within the sorted sampled set; labels whose
+    class was not sampled map to -1 (only possible when the number of
+    unique positives exceeds num_samples).
+
+    Parity: /root/reference/python/paddle/nn/functional/common.py
+    class_center_sample (PLSC margin-softmax sampling; the CUDA kernel
+    paddle/phi/kernels/gpu/class_center_sample_kernel.cu). TPU-native:
+    fixed-shape top-k over a present-mask + random score — one compiled
+    program, no host sync."""
+    import jax
+    from ...framework.core import Tensor, apply, default_generator
+
+    key = default_generator.next_key()
+
+    def f(lab):
+        lab_i = lab.astype(jnp.int32)
+        present = jnp.zeros((num_classes,), jnp.float32).at[lab_i].set(1.0)
+        noise = jax.random.uniform(key, (num_classes,))
+        # positives (>=2) always outrank negatives (<1)
+        score = present * 2.0 + noise
+        _, picked = jax.lax.top_k(score, num_samples)
+        sampled = jnp.sort(picked).astype(lab_i.dtype)
+        remap = jnp.full((num_classes,), -1, jnp.int32).at[sampled].set(
+            jnp.arange(num_samples, dtype=jnp.int32))
+        return remap[lab_i].astype(lab.dtype), sampled.astype(lab.dtype)
+
+    return apply("class_center_sample", f, label)

@@ -113,16 +113,32 @@ def _conv_transpose(x, weight, bias, stride, padding, output_padding, dilation,
                 lo = k - 1 - pad[i][0]
                 hi = k - 1 - pad[i][1] + opad[i]
                 padding_cfg.append((lo, hi))
+        def one_group(a_g, w_g):
+            w_t = jnp.swapaxes(w_g, 0, 1)  # -> [out_c, in_c, *k]
+            w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
+            return jax.lax.conv_general_dilated(
+                a_g, w_t.astype(a_g.dtype), window_strides=(1,) * n,
+                padding=padding_cfg, lhs_dilation=strides,
+                rhs_dilation=dil,
+                dimension_numbers=jax.lax.conv_dimension_numbers(
+                    (1,) * (n + 2), (1,) * (n + 2),
+                    (lhs_spec, "OI" + spatial, lhs_spec)))
+
         if groups > 1:
-            raise NotImplementedError("grouped conv_transpose: use groups=1")
-        w_t = jnp.swapaxes(w, 0, 1)  # -> [out_c, in_c, *k]
-        w_t = jnp.flip(w_t, axis=tuple(range(2, 2 + n)))
-        out = jax.lax.conv_general_dilated(
-            a, w_t.astype(a.dtype), window_strides=(1,) * n,
-            padding=padding_cfg, lhs_dilation=strides, rhs_dilation=dil,
-            dimension_numbers=jax.lax.conv_dimension_numbers(
-                (1,) * (n + 2), (1,) * (n + 2),
-                (lhs_spec, "OI" + spatial, lhs_spec)))
+            # grouped transpose conv: per-group slices of the input
+            # channels and the [in_c, out_c/groups, *k] weight, outputs
+            # concatenated on the channel axis (parity:
+            # /root/reference/python/paddle/nn/functional/conv.py
+            # conv2d_transpose groups semantics)
+            ch_ax = (n + 1) if channel_last else 1
+            icg = a.shape[ch_ax] // groups
+            outs = [one_group(
+                jax.lax.slice_in_dim(a, g * icg, (g + 1) * icg,
+                                     axis=ch_ax),
+                w[g * icg:(g + 1) * icg]) for g in range(groups)]
+            out = jnp.concatenate(outs, axis=ch_ax)
+        else:
+            out = one_group(a, w)
         if b:
             bias_shape = [1] * out.ndim
             bias_shape[out.ndim - 1 if channel_last else 1] = -1

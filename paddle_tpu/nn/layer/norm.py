@@ -3,9 +3,10 @@ BatchNorm keeps running stats as buffers updated in-place; under the jit
 path functional_call reads the updated values back out of the trace."""
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
-from ...framework.core import Tensor
+from ...framework.core import Tensor, apply
 from .. import functional as F
 from .. import initializer as I
 from .layers import Layer
@@ -199,7 +200,50 @@ class LocalResponseNorm(Layer):
 
 
 class SpectralNorm(Layer):
+    """Spectral normalization: forward(weight) -> weight / sigma_max,
+    sigma estimated by `power_iters` rounds of power iteration with
+    persistent u/v buffers (parity:
+    /root/reference/python/paddle/nn/layer/norm.py SpectralNorm; GAN
+    discriminator regularizer). `axis` is the dim treated as rows when
+    the weight is flattened to a matrix."""
+
     def __init__(self, weight_shape, axis=0, power_iters=1, epsilon=1e-12,
                  dtype="float32"):
-        super().__init__()
-        raise NotImplementedError("SpectralNorm: planned")
+        super().__init__(dtype=dtype)
+        self.weight_shape = list(weight_shape)
+        self.axis = axis
+        self.power_iters = int(power_iters)
+        self.epsilon = float(epsilon)
+        import numpy as _np
+        h = self.weight_shape[axis]
+        w = int(_np.prod(self.weight_shape)) // h
+        from ...framework.core import default_generator
+        ku, kv = jax.random.split(default_generator.next_key())
+        self.register_buffer(
+            "weight_u", Tensor(jax.random.normal(ku, (h,), jnp.float32)))
+        self.register_buffer(
+            "weight_v", Tensor(jax.random.normal(kv, (w,), jnp.float32)))
+
+    def forward(self, weight):
+        axis, eps, iters = self.axis, self.epsilon, self.power_iters
+
+        def f(wt, u, v):
+            perm = [axis] + [i for i in range(wt.ndim) if i != axis]
+            mat = jnp.transpose(wt, perm).reshape(wt.shape[axis], -1)
+            mat32 = mat.astype(jnp.float32)
+
+            def norm(x):
+                return x / (jnp.linalg.norm(x) + eps)
+
+            for _ in range(max(iters, 1)):
+                v = norm(mat32.T @ u)
+                u = norm(mat32 @ v)
+            sigma = u @ (mat32 @ v)
+            out = (wt.astype(jnp.float32) / sigma).astype(wt.dtype)
+            return out, u, v
+
+        out, nu, nv = apply("spectral_norm", f, weight,
+                            self.weight_u, self.weight_v)
+        self.weight_u._replace(jax.lax.stop_gradient(nu._value))
+        self.weight_v._replace(jax.lax.stop_gradient(nv._value))
+        return out

@@ -19,8 +19,10 @@ from ..framework.core import Tensor, apply
 from ..nn.layer.layers import Layer
 
 __all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
-           "MovingAverageAbsmaxObserver", "FakeQuanterWithAbsMaxObserver",
-           "quanter", "QuantedLinear"]
+           "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
+           "HistogramObserver", "KLObserver",
+           "FakeQuanterWithAbsMaxObserver", "quanter", "QuantedLinear",
+           "QuantedConv2D", "Int8Linear", "convert_to_int8"]
 
 
 def _fake_quant(x, scale, bit_length=8):
@@ -173,9 +175,17 @@ class QAT:
         return target
 
     def _convert(self, layer: Layer):
-        from ..nn import Linear
+        from ..nn import Linear, Conv2D
         for name, sub in list(layer.named_children()):
-            if isinstance(sub, Linear):
+            if isinstance(sub, Conv2D):
+                a, w_cfg = self.config._config_for(sub)
+                make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
+                    cfg, str) else (cfg() if isinstance(cfg, type)
+                                    else cfg))
+                setattr(layer, name, QuantedConv2D(
+                    sub, make(a) if a is not None else None,
+                    make(w_cfg) if w_cfg is not None else None))
+            elif isinstance(sub, Linear):
                 a, w = self.config._config_for(sub)
                 make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
                     cfg, str) else (cfg() if isinstance(cfg, type)
@@ -210,3 +220,202 @@ class PTQ:
 
     def convert(self, model: Layer, inplace: bool = False) -> Layer:
         return self._qat.convert(model, inplace)
+
+
+class PerChannelAbsmaxObserver(BaseObserver):
+    """Per-output-channel absmax for weights (reference
+    quanters/FakeQuanterChannelWiseAbsMaxObserver). `channel_axis` is the
+    output-feature dim — 1 for paddle Linear's [in, out] layout, 0 for
+    Conv's [out, in, kh, kw]."""
+
+    def __init__(self, quant_bits: int = 8, channel_axis: int = 1):
+        super().__init__(quant_bits)
+        self.channel_axis = channel_axis
+
+    def observe(self, x_arr):
+        axes = tuple(i for i in range(x_arr.ndim)
+                     if i != self.channel_axis)
+        m = jnp.max(jnp.abs(x_arr), axis=axes)
+        self._scale = m if self._scale is None else jnp.maximum(
+            self._scale, m)
+        return self._scale
+
+    def broadcast_scale(self, ndim):
+        shape = [1] * ndim
+        shape[self.channel_axis] = -1
+        return self._scale.reshape(shape)
+
+
+class HistogramObserver(BaseObserver):
+    """Percentile calibration over an accumulated |x| histogram
+    (reference observers + slim HistQuanter): the scale is the
+    `percent`-quantile of the observed magnitude distribution — robust to
+    activation outliers that wreck plain absmax."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048,
+                 percent: float = 0.999):
+        super().__init__(quant_bits)
+        self.bins = bins
+        self.percent = percent
+        self._hist = None
+        self._upper = None
+
+    def observe(self, x_arr):
+        ax = jnp.abs(x_arr.astype(jnp.float32)).reshape(-1)
+        m = float(jnp.max(ax))
+        if self._hist is None:
+            self._upper = max(m, 1e-9)
+            self._hist = np.zeros(self.bins, np.float64)
+        if m > self._upper:  # stretch: rebin old mass into the new range
+            ratio = self._upper / m
+            old = self._hist
+            idx = (np.arange(self.bins) * ratio).astype(np.int64)
+            stretched = np.zeros_like(old)
+            np.add.at(stretched, idx, old)
+            self._hist = stretched
+            self._upper = m
+        h, _ = np.histogram(np.asarray(ax), bins=self.bins,
+                            range=(0.0, self._upper))
+        self._hist += h
+        cdf = np.cumsum(self._hist)
+        cut = np.searchsorted(cdf, cdf[-1] * self.percent)
+        self._scale = jnp.asarray(
+            (cut + 1) / self.bins * self._upper, jnp.float32)
+        return self._scale
+
+
+class KLObserver(HistogramObserver):
+    """KL-divergence calibration (TensorRT-style, the reference slim KL
+    quanter): choose the clip threshold whose quantized distribution has
+    minimal KL divergence from the observed one."""
+
+    def __init__(self, quant_bits: int = 8, bins: int = 2048):
+        super().__init__(quant_bits, bins)
+
+    def _kl(self, p, q):
+        p = p / max(p.sum(), 1e-12)
+        q = q / max(q.sum(), 1e-12)
+        mask = p > 0
+        qm = np.where(q > 0, q, 1e-12)
+        return float(np.sum(p[mask] * np.log(p[mask] / qm[mask])))
+
+    def observe(self, x_arr):
+        super().observe(x_arr)   # maintain the histogram
+        levels = 2 ** (self.quant_bits - 1)  # 128 for int8
+        hist = self._hist
+        best, best_div = self.bins, np.inf
+        # candidate thresholds: from 2*levels bins up to the full range
+        for cut in range(levels * 2, self.bins + 1, max(self.bins // 64, 1)):
+            ref = hist[:cut].copy()
+            ref[cut - 1] += hist[cut:].sum()   # clip mass into last bin
+            # quantize: collapse cut bins into `levels` buckets and expand
+            chunks = np.array_split(ref, levels)
+            q = np.concatenate([
+                np.full(len(c), c.sum() / max((c > 0).sum(), 1))
+                * (c > 0) for c in chunks])
+            div = self._kl(ref, q)
+            if div < best_div:
+                best_div, best = div, cut
+        self._scale = jnp.asarray(best / self.bins * self._upper,
+                                  jnp.float32)
+        return self._scale
+
+
+class QuantedConv2D(Layer):
+    """Conv2D with weight/activation fake-quant (reference
+    nn/quant/qat/conv.py). Weight scales are per-output-channel."""
+
+    def __init__(self, conv, act_quanter=_DEFAULT, weight_quanter=_DEFAULT):
+        super().__init__()
+        self.conv = conv
+        self.act_quanter = FakeQuanterWithAbsMaxObserver() \
+            if act_quanter is _DEFAULT else act_quanter
+        self.weight_observer = PerChannelAbsmaxObserver(channel_axis=0) \
+            if weight_quanter is _DEFAULT else weight_quanter
+
+    def forward(self, x):
+        from ..nn import functional as F
+        xq = self.act_quanter(x) if self.act_quanter is not None else x
+        w = self.conv.weight
+        wq = self.weight_observer
+        if isinstance(wq, Layer):        # quanter layer (per-tensor STE)
+            w = wq(w)
+        elif wq is not None:             # per-channel observer
+            wq.observe(jax.lax.stop_gradient(w._value))
+            scale = wq.broadcast_scale(w._value.ndim)
+            w = apply("fake_quant_w", lambda a: _fake_quant(a, scale), w)
+        return F.conv2d(xq, w, self.conv.bias, stride=self.conv.stride,
+                        padding=self.conv.padding,
+                        dilation=self.conv.dilation,
+                        groups=self.conv.groups)
+
+
+class Int8Linear(Layer):
+    """CONVERTED linear: weights stored int8 (per-channel scales),
+    activations quantized dynamically at the recorded calibration scale,
+    matmul runs in int8 with int32 accumulation — XLA lowers this to the
+    native int8 MXU path on TPU. Reference analog: the int8 kernels
+    behind paddle slim's converted inference graphs."""
+
+    def __init__(self, linear, act_scale, w_observer=None):
+        super().__init__()
+        w = linear.weight._value
+        if w_observer is None:
+            w_observer = PerChannelAbsmaxObserver(channel_axis=1)
+            w_observer.observe(w)
+        w_scale = w_observer.scale().astype(jnp.float32)   # [out]
+        bnd = 127.0
+        q = jnp.clip(jnp.round(w.astype(jnp.float32)
+                               / jnp.maximum(w_scale, 1e-9) * bnd),
+                     -bnd, bnd).astype(jnp.int8)
+        self.register_buffer("qweight", Tensor(q))
+        self.register_buffer("w_scale", Tensor(w_scale))
+        self.register_buffer("act_scale",
+                             Tensor(jnp.asarray(act_scale, jnp.float32)))
+        self.bias = linear.bias
+
+    def forward(self, x):
+        def f(a, qw, ws, as_, *b):
+            bnd = 127.0
+            sa = jnp.maximum(as_, 1e-9)
+            aq = jnp.clip(jnp.round(a.astype(jnp.float32) / sa * bnd),
+                          -bnd, bnd).astype(jnp.int8)
+            acc = jax.lax.dot_general(
+                aq, qw, (((a.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            out = acc.astype(jnp.float32) * (sa / bnd) * (ws / bnd)
+            out = out.astype(a.dtype)
+            if b:
+                out = out + b[0]
+            return out
+
+        args = (x, self.qweight, self.w_scale, self.act_scale)
+        if self.bias is not None:
+            return apply("int8_linear", f, *args, self.bias)
+        return apply("int8_linear", f, *args)
+
+
+def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
+    """Convert a calibrated QAT/PTQ model: every QuantedLinear whose
+    observers hold scales becomes an Int8Linear executing the int8
+    dot path."""
+    target = model if inplace else copy.deepcopy(model)
+
+    def _walk(layer):
+        for name, sub in list(layer.named_children()):
+            if isinstance(sub, QuantedLinear):
+                act_scale = (sub.act_quanter.observer.scale()
+                             if sub.act_quanter is not None else None)
+                if act_scale is None:
+                    raise RuntimeError(
+                        "convert_to_int8: activation scale missing — run "
+                        "calibration batches through the quantized model "
+                        "first (PTQ.quantize -> forward passes -> "
+                        "convert)")
+                setattr(layer, name, Int8Linear(sub.linear, act_scale))
+            else:
+                _walk(sub)
+
+    _walk(target)
+    target.eval()
+    return target

@@ -18,7 +18,8 @@ from ..framework.core import Tensor, apply, apply_nodiff
 from ..nn.layer.layers import Layer
 from ..io import Dataset
 
-__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb"]
+__all__ = ["viterbi_decode", "ViterbiDecoder", "UCIHousing", "Imdb",
+           "Imikolov", "Movielens", "Conll05st", "WMT14", "WMT16"]
 
 
 def viterbi_decode(potentials, transition_params, lengths=None,
@@ -162,3 +163,204 @@ class Imdb(Dataset):
 
     def __len__(self):
         return len(self.docs)
+
+
+class Imikolov(Dataset):
+    """PTB-style n-gram language-model dataset from local ptb.*.txt files
+    (reference text/datasets/imikolov.py). Items are n-gram id tuples
+    (data_type='NGRAM') or (src, trg) sequences ('SEQ')."""
+
+    def __init__(self, data_dir: Optional[str] = None, data_type="NGRAM",
+                 window_size=5, mode="train", min_word_freq=50,
+                 download: bool = False):
+        import os
+        from collections import Counter
+        if data_dir is None:
+            raise ValueError(
+                "data_dir is required (no network in this environment); "
+                "expected ptb.train.txt / ptb.valid.txt inside")
+        fname = "ptb.train.txt" if mode == "train" else "ptb.valid.txt"
+        train_lines = open(os.path.join(data_dir, "ptb.train.txt"),
+                           errors="ignore").read().lower().splitlines()
+        freq = Counter(w for l in train_lines for w in l.split())
+        vocab = {w for w, c in freq.items() if c >= min_word_freq}
+        self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
+        self.word_idx["<unk>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"]
+        eos = self.word_idx["<e>"] = len(self.word_idx)
+        lines = train_lines if mode == "train" else open(
+            os.path.join(data_dir, fname), errors="ignore"
+        ).read().lower().splitlines()
+        self.data = []
+        for l in lines:
+            ids = [self.word_idx.get(w, unk) for w in l.split()] + [eos]
+            if data_type.upper() == "NGRAM":
+                for i in range(len(ids) - window_size + 1):
+                    self.data.append(
+                        np.asarray(ids[i:i + window_size], np.int64))
+            else:  # SEQ
+                if len(ids) > 1:
+                    self.data.append((np.asarray(ids[:-1], np.int64),
+                                      np.asarray(ids[1:], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Movielens(Dataset):
+    """MovieLens ml-1m ratings from a local directory with users.dat /
+    movies.dat / ratings.dat ('::'-separated; reference
+    text/datasets/movielens.py). Items: (user_id, gender, age, job,
+    movie_id, title_ids, category_vec, rating)."""
+
+    GENRES = ["Action", "Adventure", "Animation", "Children's", "Comedy",
+              "Crime", "Documentary", "Drama", "Fantasy", "Film-Noir",
+              "Horror", "Musical", "Mystery", "Romance", "Sci-Fi",
+              "Thriller", "War", "Western"]
+
+    def __init__(self, data_dir: Optional[str] = None, mode: str = "train",
+                 test_ratio: float = 0.1, rand_seed=0,
+                 download: bool = False):
+        import os
+        if data_dir is None:
+            raise ValueError(
+                "data_dir is required (no network in this environment)")
+
+        def rows(name):
+            with open(os.path.join(data_dir, name), errors="ignore") as f:
+                return [l.rstrip("\n").split("::") for l in f if l.strip()]
+
+        self.users = {int(u[0]): (u[1], int(u[2]), int(u[3]))
+                      for u in rows("users.dat")}
+        gidx = {g: i for i, g in enumerate(self.GENRES)}
+        titles = {}
+        self.movies = {}
+        for m in rows("movies.dat"):
+            mid, title, cats = int(m[0]), m[1], m[2]
+            vec = np.zeros(len(self.GENRES), np.float32)
+            for c in cats.split("|"):
+                if c in gidx:
+                    vec[gidx[c]] = 1.0
+            for w in title.split():
+                titles.setdefault(w, len(titles))
+            self.movies[mid] = (np.asarray(
+                [titles[w] for w in title.split()], np.int64), vec)
+        rng = np.random.RandomState(rand_seed)
+        data = []
+        for r in rows("ratings.dat"):
+            uid, mid, rating = int(r[0]), int(r[1]), float(r[2])
+            if uid in self.users and mid in self.movies:
+                data.append((uid, mid, rating))
+        mask = rng.rand(len(data)) < test_ratio
+        self.data = [d for d, m in zip(data, mask)
+                     if (m if mode == "test" else not m)]
+
+    def __getitem__(self, idx):
+        uid, mid, rating = self.data[idx]
+        gender, age, job = self.users[uid]
+        title_ids, cats = self.movies[mid]
+        return (np.int64(uid), np.int64(0 if gender == "M" else 1),
+                np.int64(age), np.int64(job), np.int64(mid), title_ids,
+                cats, np.float32(rating))
+
+    def __len__(self):
+        return len(self.data)
+
+
+class Conll05st(Dataset):
+    """CoNLL-2005 SRL test split from local column files: `words_file`
+    (one sentence per line) and `props_file` (predicate + per-token SRL
+    tags, CoNLL columns; reference text/datasets/conll05.py). Items:
+    (word_ids, predicate_id, label_ids)."""
+
+    def __init__(self, words_file: Optional[str] = None,
+                 props_file: Optional[str] = None, mode: str = "test",
+                 download: bool = False):
+        if words_file is None or props_file is None:
+            raise ValueError(
+                "words_file and props_file are required (no network in "
+                "this environment)")
+        sents = [l.split() for l in open(words_file, errors="ignore")
+                 if l.strip()]
+        props = [l.split() for l in open(props_file, errors="ignore")
+                 if l.strip()]
+        vocab, labels, preds = {}, {}, {}
+        self.data = []
+        for words, pr in zip(sents, props):
+            pred, tags = pr[0], pr[1:1 + len(words)]
+            for w in words:
+                vocab.setdefault(w.lower(), len(vocab))
+            preds.setdefault(pred.lower(), len(preds))
+            for t in tags:
+                labels.setdefault(t, len(labels))
+            self.data.append((
+                np.asarray([vocab[w.lower()] for w in words], np.int64),
+                np.int64(preds[pred.lower()]),
+                np.asarray([labels[t] for t in tags], np.int64)))
+        self.word_dict, self.label_dict, self.predicate_dict = \
+            vocab, labels, preds
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class _WMTBase(Dataset):
+    """Parallel-corpus dataset from local src/trg files (one sentence per
+    line each). Items: (src_ids, trg_ids, trg_ids_next) with <s>/<e>/<unk>
+    following the reference's wmt14/wmt16 convention."""
+
+    def __init__(self, src_file: Optional[str] = None,
+                 trg_file: Optional[str] = None, mode: str = "train",
+                 dict_size: int = -1, lang: str = "en",
+                 download: bool = False):
+        from collections import Counter
+        if src_file is None or trg_file is None:
+            raise ValueError(
+                "src_file and trg_file are required (no network in this "
+                "environment)")
+        src_lines = [l.split() for l in
+                     open(src_file, errors="ignore").read().splitlines()]
+        trg_lines = [l.split() for l in
+                     open(trg_file, errors="ignore").read().splitlines()]
+
+        def build(lines):
+            freq = Counter(w for l in lines for w in l)
+            words = [w for w, _ in freq.most_common(
+                None if dict_size < 0 else max(dict_size - 3, 0))]
+            d = {"<s>": 0, "<e>": 1, "<unk>": 2}
+            for w in words:
+                d[w] = len(d)
+            return d
+
+        self.src_dict = build(src_lines)
+        self.trg_dict = build(trg_lines)
+        s_unk, t_unk = self.src_dict["<unk>"], self.trg_dict["<unk>"]
+        self.data = []
+        for s, t in zip(src_lines, trg_lines):
+            if not s or not t:
+                continue
+            sid = [self.src_dict.get(w, s_unk) for w in s]
+            tid = [0] + [self.trg_dict.get(w, t_unk) for w in t]
+            self.data.append((np.asarray(sid, np.int64),
+                              np.asarray(tid, np.int64),
+                              np.asarray(tid[1:] + [1], np.int64)))
+
+    def __getitem__(self, idx):
+        return self.data[idx]
+
+    def __len__(self):
+        return len(self.data)
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en-fr from local files (reference text/datasets/wmt14.py)."""
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en-de from local files (reference text/datasets/wmt16.py)."""

@@ -212,3 +212,131 @@ class TestQuantization:
                 np.random.RandomState(1).randn(2, 4).astype(np.float32)))
         final = ptq.convert(q)
         assert not final.training
+
+
+class TestTextDatasets:
+    def test_imikolov_ngram(self, tmp_path):
+        from paddle_tpu.text import Imikolov
+        (tmp_path / "ptb.train.txt").write_text(
+            "the cat sat on the mat\n" * 60)
+        (tmp_path / "ptb.valid.txt").write_text("the cat sat\n")
+        ds = Imikolov(str(tmp_path), window_size=3, min_word_freq=10)
+        assert len(ds) > 0
+        gram = ds[0]
+        assert gram.shape == (3,)
+        valid = Imikolov(str(tmp_path), data_type="SEQ", mode="valid",
+                         min_word_freq=10)
+        src, trg = valid[0]
+        assert len(src) == len(trg)
+
+    def test_movielens(self, tmp_path):
+        from paddle_tpu.text import Movielens
+        (tmp_path / "users.dat").write_text(
+            "1::M::25::4::12345\n2::F::35::7::54321\n")
+        (tmp_path / "movies.dat").write_text(
+            "10::Toy Story (1995)::Animation|Comedy\n"
+            "20::Heat (1995)::Action|Crime\n")
+        (tmp_path / "ratings.dat").write_text(
+            "1::10::5::978300760\n2::20::3::978302109\n"
+            "1::20::4::978301968\n")
+        ds = Movielens(str(tmp_path), mode="train", test_ratio=0.0)
+        assert len(ds) == 3
+        uid, gender, age, job, mid, title_ids, cats, rating = ds[0]
+        assert cats.shape == (18,) and cats.sum() == 2
+
+    def test_conll05(self, tmp_path):
+        from paddle_tpu.text import Conll05st
+        wf = tmp_path / "words"; pf = tmp_path / "props"
+        wf.write_text("He bought a car\nShe sold it\n")
+        pf.write_text("bought B-A0 B-V B-A1 I-A1\nsold B-A0 B-V B-A1\n")
+        ds = Conll05st(str(wf), str(pf))
+        words, pred, labels = ds[0]
+        assert len(words) == 4 and len(labels) == 4
+
+    def test_wmt(self, tmp_path):
+        from paddle_tpu.text import WMT14
+        sf_ = tmp_path / "src"; tf_ = tmp_path / "trg"
+        sf_.write_text("hello world\ngood morning\n")
+        tf_.write_text("bonjour monde\nbon matin\n")
+        ds = WMT14(str(sf_), str(tf_))
+        src, trg, trg_next = ds[0]
+        assert trg[0] == 0          # <s>
+        assert trg_next[-1] == 1    # <e>
+        assert len(trg) == len(trg_next)
+
+
+class TestAudioDatasets:
+    def _wav(self, path, sr=16000, n=1600):
+        import wave, struct
+        with wave.open(str(path), "wb") as f:
+            f.setnchannels(1); f.setsampwidth(2); f.setframerate(sr)
+            data = (np.sin(np.arange(n) * 0.1) * 20000).astype(np.int16)
+            f.writeframes(data.tobytes())
+
+    def test_esc50(self, tmp_path):
+        from paddle_tpu.audio.datasets import ESC50
+        (tmp_path / "meta").mkdir(); (tmp_path / "audio").mkdir()
+        rows = ["filename,fold,target,category,esc10,src_file,take"]
+        for i in range(4):
+            name = f"1-{i}-A-{i}.wav"
+            self._wav(tmp_path / "audio" / name)
+            rows.append(f"{name},{i % 2 + 1},{i},cat,{i},x,A")
+        (tmp_path / "meta" / "esc50.csv").write_text("\n".join(rows))
+        tr = ESC50(str(tmp_path), mode="train", split_fold=1)
+        dv = ESC50(str(tmp_path), mode="dev", split_fold=1)
+        assert len(tr) == 2 and len(dv) == 2
+        w, y = tr[0]
+        assert w.ndim == 1 and w.dtype == np.float32
+
+    def test_tess(self, tmp_path):
+        from paddle_tpu.audio.datasets import TESS
+        for i, emo in enumerate(["angry", "happy", "sad", "neutral",
+                                 "fear"]):
+            self._wav(tmp_path / f"OAF_word_{emo}.wav")
+        ds = TESS(str(tmp_path), mode="train", n_folds=5, split_fold=1)
+        assert len(ds) == 4
+        w, y = ds[0]
+        assert 0 <= int(y) < len(TESS.EMOTIONS)
+
+
+class TestFilledGaps:
+    def test_spectral_norm(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        w = rng.randn(6, 4, 3, 3).astype(np.float32)
+        sn = nn.SpectralNorm([6, 4, 3, 3], axis=0, power_iters=30)
+        out = sn(paddle.to_tensor(w))
+        sigma = np.linalg.svd(w.reshape(6, -1), compute_uv=False)[0]
+        np.testing.assert_allclose(out.numpy(), w / sigma, atol=2e-2)
+        t = paddle.to_tensor(w); t.stop_gradient = False
+        sn(t).sum().backward()
+        assert t.grad is not None
+
+    def test_grouped_conv_transpose_matches_torch(self):
+        import torch
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        x = rng.randn(2, 8, 7, 7).astype(np.float32)
+        w = rng.randn(8, 3, 3, 3).astype(np.float32)
+        out = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                                 stride=2, padding=1, groups=2)
+        ref = torch.nn.functional.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2, padding=1,
+            groups=2)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+
+    def test_class_center_sample(self):
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        paddle.seed(0)
+        label = paddle.to_tensor(np.array([3, 7, 3, 42, 99], np.int64))
+        new_label, sampled = F.class_center_sample(label, 100, 10)
+        s, nl = sampled.numpy(), new_label.numpy()
+        assert len(set(s.tolist())) == 10
+        for pos in (3, 7, 42, 99):
+            assert pos in s
+        lab = label.numpy()
+        assert all(s[nl[i]] == lab[i] for i in range(5))

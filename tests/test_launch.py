@@ -237,3 +237,80 @@ class TestAutoTuner:
         t2 = self._tuner()
         t2.recorder.load(p)
         assert t2.recorder.get(c) == 0.123
+
+
+class TestFailureInjectionResume:
+    """Kill a worker mid-train; the launcher must relaunch with a bumped
+    generation and the worker must RESUME from its last checkpoint with
+    loss continuity (reference pattern: the subprocess-kill tests of
+    /root/reference/test/collective/ + elastic manager restart loop,
+    fleet/elastic/manager.py:126,254-296)."""
+
+    TRAIN = r'''
+import json, os, signal, sys
+import numpy as np
+# CPU backend for the trainer subprocess
+from jax._src import xla_bridge as _xb
+_xb._backend_factories.pop("axon", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import paddle_tpu as paddle
+from paddle_tpu import nn, optimizer
+
+gen = int(os.environ.get("PADDLE_RESTART_GENERATION", "0"))
+workdir = os.environ["TEST_WORKDIR"]
+ckpt = os.path.join(workdir, "ckpt.pdparams")
+log = open(os.path.join(workdir, f"train_gen{gen}.jsonl"), "a")
+
+paddle.seed(0)
+model = nn.Linear(8, 8)
+opt = optimizer.SGD(learning_rate=0.05, parameters=model.parameters())
+start_step = 0
+if os.path.exists(ckpt):
+    state = paddle.load(ckpt)
+    model.set_state_dict(state["model"])
+    start_step = int(state["step"])
+
+rng = np.random.RandomState(7)
+X = paddle.to_tensor(rng.randn(16, 8).astype(np.float32))
+Y = paddle.to_tensor((rng.randn(16, 8) * 0.1).astype(np.float32))
+step_fn = paddle.jit.TrainStep(model, lambda o, l: ((o - l) ** 2).mean(),
+                               opt)
+TOTAL, KILL_AT = 12, 6
+for step in range(start_step, TOTAL):
+    loss = float(step_fn(X, Y))
+    log.write(json.dumps({"gen": gen, "step": step, "loss": loss}) + "\n")
+    log.flush()
+    paddle.save({"model": model.state_dict(), "step": step + 1}, ckpt)
+    if gen == 0 and step + 1 == KILL_AT:
+        os.kill(os.getpid(), signal.SIGKILL)   # die mid-train
+print("training complete at", TOTAL)
+'''
+
+    def test_kill_relaunch_resume(self, tmp_path):
+        script = tmp_path / "train.py"
+        script.write_text(self.TRAIN)
+        proc = _run_launcher(
+            ["--nproc_per_node=1", "--max_restart=2", str(script)],
+            cwd=str(tmp_path),
+            extra_env={"TEST_WORKDIR": str(tmp_path),
+                       "JAX_PLATFORMS": "cpu",
+                       "PALLAS_AXON_POOL_IPS": ""})
+        out, _ = proc.communicate(timeout=240)
+        text = out.decode()
+        assert proc.returncode == 0, text
+        assert "restarting (attempt 1" in text, text
+
+        def read(gen):
+            p = tmp_path / f"train_gen{gen}.jsonl"
+            return [json.loads(l) for l in p.read_text().splitlines()]
+
+        g0, g1 = read(0), read(1)
+        # generation 0 died after step 5 (KILL_AT=6)
+        assert [r["step"] for r in g0] == list(range(6))
+        # generation 1 RESUMED at step 6 — not from scratch
+        assert [r["step"] for r in g1] == list(range(6, 12))
+        # loss continuity: the resumed first loss continues the descent —
+        # strictly below generation 0's last recorded loss
+        assert g1[0]["loss"] < g0[-1]["loss"], (g0, g1)
+        # and total descent across the failure
+        assert g1[-1]["loss"] < g0[0]["loss"]

@@ -1,0 +1,133 @@
+"""Quantization: observers (absmax/per-channel/histogram/KL), QAT/PTQ
+flows, int8 execution, quantized-BERT parity.
+
+Reference: /root/reference/python/paddle/quantization/ (config.py,
+qat.py, ptq.py, observers/, quanters/)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu import quantization as Q
+
+
+class TestObservers:
+    def test_absmax(self):
+        ob = Q.AbsmaxObserver()
+        ob.observe(jnp.asarray([-3.0, 2.0]))
+        ob.observe(jnp.asarray([1.0, -5.0]))
+        assert float(ob.scale()) == 5.0
+
+    def test_per_channel(self):
+        ob = Q.PerChannelAbsmaxObserver(channel_axis=1)
+        ob.observe(jnp.asarray([[1.0, -4.0], [-2.0, 3.0]]))
+        np.testing.assert_allclose(np.asarray(ob.scale()), [2.0, 4.0])
+
+    def test_histogram_robust_to_outliers(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(10000).astype(np.float32)
+        x[0] = 1000.0                      # a single outlier
+        ob = Q.HistogramObserver(percent=0.999)
+        ob.observe(jnp.asarray(x))
+        ab = Q.AbsmaxObserver()
+        ab.observe(jnp.asarray(x))
+        assert float(ob.scale()) < 10.0    # percentile ignores the spike
+        assert float(ab.scale()) == 1000.0
+
+    def test_kl_observer_reasonable(self):
+        rng = np.random.RandomState(1)
+        x = rng.randn(8192).astype(np.float32)
+        ob = Q.KLObserver(bins=512)
+        ob.observe(jnp.asarray(x))
+        s = float(ob.scale())
+        assert 0.5 < s < float(np.abs(x).max()) + 1e-6
+
+
+class TestFlows:
+    def _mlp(self):
+        paddle.seed(0)
+        return nn.Sequential(nn.Linear(16, 32), nn.ReLU(),
+                             nn.Linear(32, 8))
+
+    def test_qat_swaps_and_trains(self):
+        model = self._mlp()
+        qat = Q.QAT(Q.QuantConfig(activation="FakeQuanterWithAbsMaxObserver",
+                                  weight="FakeQuanterWithAbsMaxObserver"))
+        q = qat.quantize(model)
+        assert isinstance(q[0], Q.QuantedLinear)
+        x = paddle.randn([4, 16])
+        x.stop_gradient = False
+        out = q(x)
+        out.sum().backward()               # STE gradient flows
+        assert q[0].linear.weight.grad is not None
+
+    def test_ptq_int8_linear_close_to_fp(self):
+        model = self._mlp()
+        model.eval()
+        x = paddle.randn([8, 16])
+        fp = model(x).numpy()
+        ptq = Q.PTQ(Q.QuantConfig(
+            activation="FakeQuanterWithAbsMaxObserver",
+            weight="FakeQuanterWithAbsMaxObserver"))
+        q = ptq.quantize(model)
+        for _ in range(4):
+            q(x)
+        q = ptq.convert(q)
+        i8 = Q.convert_to_int8(q)
+        assert isinstance(i8[0], Q.Int8Linear)
+        assert i8[0].qweight._value.dtype == jnp.int8
+        out = i8(x).numpy()
+        rel = np.abs(out - fp).max() / (np.abs(fp).max() + 1e-9)
+        assert rel < 0.1, rel
+
+    def test_conv_qat(self):
+        paddle.seed(0)
+        model = nn.Sequential(nn.Conv2D(3, 8, 3, padding=1), nn.ReLU())
+        qat = Q.QAT(Q.QuantConfig(
+            activation="FakeQuanterWithAbsMaxObserver",
+            weight="FakeQuanterWithAbsMaxObserver"))
+        q = qat.quantize(model)
+        assert isinstance(q[0], Q.QuantedConv2D)
+        out = q(paddle.randn([2, 3, 8, 8]))
+        assert tuple(out.shape) == (2, 8, 8, 8)
+
+    def test_missing_calibration_raises(self):
+        model = self._mlp()
+        qat = Q.QAT(Q.QuantConfig(
+            activation="FakeQuanterWithAbsMaxObserver",
+            weight="FakeQuanterWithAbsMaxObserver"))
+        q = qat.quantize(model)           # never calibrated
+        with pytest.raises(RuntimeError, match="calibration"):
+            Q.convert_to_int8(q)
+
+
+def test_quantized_bert_eval_matches_fp():
+    from paddle_tpu.models.bert import BertModel, bert_tiny
+    paddle.seed(0)
+    cfg = bert_tiny()
+    model = BertModel(cfg)
+    model.eval()
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (4, 32)).astype(np.int64))
+    out = model(ids)
+    fp = (out[0] if isinstance(out, tuple) else out).numpy()
+    ptq = Q.PTQ(Q.QuantConfig(
+        activation="FakeQuanterWithAbsMaxObserver",
+        weight="FakeQuanterWithAbsMaxObserver"))
+    q = ptq.quantize(model)
+    for _ in range(4):
+        q(ids)
+    q = ptq.convert(q)
+    qo = q(ids)
+    qv = (qo[0] if isinstance(qo, tuple) else qo).numpy()
+    rel = np.abs(qv - fp).max() / (np.abs(fp).max() + 1e-9)
+    assert rel < 0.1, rel
+    i8 = Q.convert_to_int8(q)
+    io = i8(ids)
+    iv = (io[0] if isinstance(io, tuple) else io).numpy()
+    rel8 = np.abs(iv - fp).max() / (np.abs(fp).max() + 1e-9)
+    assert rel8 < 0.15, rel8
