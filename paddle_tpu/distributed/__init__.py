@@ -26,6 +26,7 @@ from .collective import (  # noqa: F401
     ReduceOp, get_group, wait,
 )
 from . import fleet  # noqa: F401
+from . import auto_parallel  # noqa: F401
 from . import checkpoint  # noqa: F401
 from . import launch  # noqa: F401
 from . import auto_tuner  # noqa: F401

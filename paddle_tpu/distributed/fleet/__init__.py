@@ -13,6 +13,7 @@ from typing import Optional
 from .topology import CommunicateTopology, HybridCommunicateGroup
 from .strategy import DistributedStrategy
 from . import mpu  # noqa: F401
+from .. import auto_parallel as auto  # noqa: F401  (fleet.auto.Engine parity)
 from .mpu import (  # noqa: F401
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
     ParallelCrossEntropy,

@@ -347,7 +347,9 @@ class QuantedConv2D(Layer):
         return F.conv2d(xq, w, self.conv.bias, stride=self.conv.stride,
                         padding=self.conv.padding,
                         dilation=self.conv.dilation,
-                        groups=self.conv.groups)
+                        groups=self.conv.groups,
+                        data_format=getattr(self.conv, "data_format",
+                                            "NCHW"))
 
 
 class Int8Linear(Layer):
@@ -404,8 +406,20 @@ def convert_to_int8(model: Layer, inplace: bool = False) -> Layer:
     def _walk(layer):
         for name, sub in list(layer.named_children()):
             if isinstance(sub, QuantedLinear):
-                act_scale = (sub.act_quanter.observer.scale()
-                             if sub.act_quanter is not None else None)
+                aq = sub.act_quanter
+                act_scale = None
+                if aq is not None:
+                    # standard quanters expose .observer.scale(); custom
+                    # quanters may expose .scale() directly
+                    ob = getattr(aq, "observer", aq)
+                    scale_fn = getattr(ob, "scale", None)
+                    if scale_fn is None:
+                        raise RuntimeError(
+                            f"convert_to_int8: activation quanter "
+                            f"{type(aq).__name__} exposes no scale() — "
+                            f"int8 conversion needs a calibrated scale "
+                            f"(provide .observer.scale() or .scale())")
+                    act_scale = scale_fn()
                 if act_scale is None:
                     raise RuntimeError(
                         "convert_to_int8: activation scale missing — run "

@@ -183,17 +183,22 @@ class Imikolov(Dataset):
         train_lines = open(os.path.join(data_dir, "ptb.train.txt"),
                            errors="ignore").read().lower().splitlines()
         freq = Counter(w for l in train_lines for w in l.split())
+        # PTB files contain literal '<unk>' tokens — drop them before
+        # building the dict so the reserved ids stay distinct (reference
+        # text/datasets/imikolov.py:142-144)
+        freq.pop("<unk>", None)
         vocab = {w for w, c in freq.items() if c >= min_word_freq}
         self.word_idx = {w: i for i, w in enumerate(sorted(vocab))}
-        self.word_idx["<unk>"] = len(self.word_idx)
-        unk = self.word_idx["<unk>"]
+        bos = self.word_idx["<s>"] = len(self.word_idx)
         eos = self.word_idx["<e>"] = len(self.word_idx)
+        unk = self.word_idx["<unk>"] = len(self.word_idx)
         lines = train_lines if mode == "train" else open(
             os.path.join(data_dir, fname), errors="ignore"
         ).read().lower().splitlines()
         self.data = []
         for l in lines:
-            ids = [self.word_idx.get(w, unk) for w in l.split()] + [eos]
+            ids = [bos] + [self.word_idx.get(w, unk)
+                           for w in l.split()] + [eos]
             if data_type.upper() == "NGRAM":
                 for i in range(len(ids) - window_size + 1):
                     self.data.append(
