@@ -147,12 +147,17 @@ def shard_optimizer(optimizer, shard_fn=None):
 
         def match(i, arr):
             p = optimizer._parameter_list[i]
-            if hasattr(p, "process_mesh") and arr.shape == tuple(p.shape):
-                if shard_fn is not None:
-                    return shard_fn(p, arr)
-                return jax.device_put(
-                    arr, _named_sharding(p.process_mesh, p.placements))
-            return arr
+            if getattr(p, "process_mesh", None) is None or \
+                    arr.shape != tuple(p.shape):
+                return arr
+            if shard_fn is not None:
+                return shard_fn(p, arr)
+            # ZeRO-1/2: state placements may shard where the param is
+            # replicated (set by fleet.sharding_recipes)
+            placements = getattr(p, "_opt_state_placements", None) \
+                or p.placements
+            return jax.device_put(
+                arr, _named_sharding(p.process_mesh, placements))
 
         for k, v in state.items():
             if isinstance(v, list):

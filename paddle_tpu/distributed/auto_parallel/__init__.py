@@ -15,7 +15,6 @@ compiled program; XLA inserts every collective the placements imply.
 """
 from __future__ import annotations
 
-import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as np
@@ -106,32 +105,51 @@ class Engine:
         self._prepared_mode = mode
         return self
 
+    def _forward(self, ins):
+        """Compiled forward for eval/predict (to_static), built lazily so
+        a train-prepared Engine can still evaluate."""
+        import paddle_tpu as paddle
+        if self._fwd_fn is None:
+            self._fwd_fn = paddle.jit.to_static(self.model)
+        return self._fwd_fn(*ins)
+
     # -- data handling -------------------------------------------------------
-    def _loader(self, data, batch_size, shuffle=False):
-        from ...io import DataLoader, Dataset
+    def _loader(self, data, batch_size, shuffle=False, drop_last=False):
+        from ...io import DataLoader
         if data is None:
             return None
         if isinstance(data, DataLoader):
             return data
         if hasattr(data, "__getitem__") or hasattr(data, "__iter__"):
+            # train drops the tail partial batch (stable compiled shapes);
+            # evaluate/predict must see every sample
             return DataLoader(data, batch_size=batch_size or 1,
-                              shuffle=shuffle, drop_last=True)
+                              shuffle=shuffle, drop_last=drop_last)
         raise TypeError(f"unsupported data {type(data)}")
 
     def _shard_batch(self, t):
-        """Shard the batch dim over the data axes of the hybrid mesh."""
+        """Shard the batch dim over the data axes of the hybrid mesh.
+        Honors strategy.split_data; a tail batch whose size doesn't
+        divide the data degree stays replicated (correct, just not
+        split) rather than crashing device_put."""
         import paddle_tpu.distributed as dist
-        if self._hcg is None:
+        if self._hcg is None or \
+                not getattr(self.strategy, "split_data", True):
             return t
         mesh = self._hcg.mesh
         placements = [dist.Shard(0) if name in ("dp", "sharding")
                       else dist.Replicate() for name in mesh.dim_names]
-        if not any(isinstance(p, dist.Shard) for p in placements):
+        degree = 1
+        for name, size in zip(mesh.dim_names, mesh.shape):
+            if name in ("dp", "sharding"):
+                degree *= size
+        if degree <= 1 or t.shape[0] % degree != 0:
             return t
         return dist.shard_tensor(t, mesh, placements)
 
-    def _split(self, batch):
-        """(inputs, labels) from a dataloader item, sharded."""
+    def _split(self, batch, has_labels=True):
+        """(inputs, labels) from a dataloader item, sharded. Predict
+        passes has_labels=False: the whole item is inputs."""
         import paddle_tpu as paddle
         from ...framework.core import Tensor
 
@@ -139,8 +157,12 @@ class Engine:
             t = x if isinstance(x, Tensor) else paddle.to_tensor(x)
             return self._shard_batch(t)
 
-        if isinstance(batch, (list, tuple)):
-            if len(batch) == 2:
+        if not has_labels:
+            ins, labs = batch, None
+        elif isinstance(batch, (list, tuple)):
+            if len(batch) == 1:      # single-field items: inputs only
+                ins, labs = batch[0], None
+            elif len(batch) == 2:
                 ins, labs = batch[0], batch[1]
             else:
                 ins, labs = batch[:-1], batch[-1]
@@ -159,7 +181,8 @@ class Engine:
             = None, steps_per_epoch: Optional[int] = None,
             valid_data=None, log_freq: int = 10, verbose: int = 1):
         self.prepare("train")
-        loader = self._loader(train_data, batch_size, shuffle=True)
+        loader = self._loader(train_data, batch_size, shuffle=True,
+                              drop_last=True)
         for epoch in range(epochs):
             for step, batch in enumerate(loader):
                 if steps_per_epoch is not None and step >= steps_per_epoch:
@@ -168,8 +191,6 @@ class Engine:
                 loss = self._train_step(ins, labs)
                 val = float(loss)
                 self.history["loss"].append(val)
-                for m in self.metrics:
-                    pass  # metrics on train are epoch-level; see evaluate
                 if verbose and step % log_freq == 0:
                     print(f"[auto.Engine] epoch {epoch} step {step} "
                           f"loss {val:.5f}")
@@ -191,11 +212,12 @@ class Engine:
             if steps is not None and i >= steps:
                 break
             ins, labs = self._split(batch)
-            out = self.model(*ins)
+            out = self._forward(ins)
             if self.loss is not None and labs:
                 losses.append(float(self.loss(out, *labs)))
-            for m in self.metrics:
-                m.update(m.compute(out, *labs))
+            if labs:
+                for m in self.metrics:
+                    m.update(m.compute(out, *labs))
         self.model.train()
         result = {"eval_loss": float(np.mean(losses)) if losses else None}
         for m in self.metrics:
@@ -205,7 +227,12 @@ class Engine:
         return result
 
     def predict(self, test_data, batch_size: Optional[int] = None,
-                steps: Optional[int] = None):
+                steps: Optional[int] = None, has_labels: bool = True):
+        """has_labels=True (default) treats dataloader items like
+        evaluate does — (inputs..., labels) with labels dropped. Pass
+        has_labels=False when items are PURE inputs (e.g. a multi-input
+        model with unlabeled data), so no input is mistaken for a
+        label."""
         self.prepare("train" if self._train_step is not None else "eval")
         self.model.eval()
         loader = self._loader(test_data, batch_size)
@@ -213,11 +240,12 @@ class Engine:
         for i, batch in enumerate(loader):
             if steps is not None and i >= steps:
                 break
-            ins, _ = self._split(batch)
-            out = self.model(*ins)
-            outs.append(np.asarray(
-                out[0].numpy() if isinstance(out, (tuple, list))
-                else out.numpy()))
+            ins, _ = self._split(batch, has_labels=has_labels)
+            out = self._forward(ins)
+            if isinstance(out, (tuple, list)):   # keep ALL outputs
+                outs.append(tuple(np.asarray(o.numpy()) for o in out))
+            else:
+                outs.append(np.asarray(out.numpy()))
         self.model.train()
         return outs
 

@@ -356,7 +356,7 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_idx",
                  "name", "persistable", "trainable", "is_leaf_",
-                 "process_mesh", "placements")
+                 "process_mesh", "placements", "_opt_state_placements")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = ""):
         if _mutation_watch is not None:
@@ -372,6 +372,9 @@ class Tensor:
         self.is_leaf_ = True
         self.process_mesh = None
         self.placements = None
+        # ZeRO-1/2: optimizer-state placements may differ from the
+        # param's own (states sharded while params stay replicated)
+        self._opt_state_placements = None
 
     # -- basic properties ---------------------------------------------------
     @property

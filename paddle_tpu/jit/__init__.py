@@ -449,10 +449,26 @@ class TrainStep:
             for p, np_ in zip(optimizer._parameter_list, new_opt_params):
                 if np_ is not None:
                     new_params[id2idx[id(p)]] = np_
+            # pin each output param to its input sharding: placements must
+            # be STABLE across steps (otherwise e.g. ZeRO-1's sharded
+            # optimizer update makes XLA emit sharded params, silently
+            # drifting stage 1 into stage 3 after the first step)
+            new_params = [
+                jax.lax.with_sharding_constraint(a, s)
+                if s is not None else a
+                for a, s in zip(new_params, self._param_shardings())]
             return loss, new_params, new_bufs, new_opt_state
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
+
+    def _param_shardings(self):
+        out = []
+        for p in self._p_tensors:
+            s = getattr(p._value, "sharding", None)
+            out.append(s if isinstance(s, jax.sharding.NamedSharding)
+                       else None)
+        return out
 
     def __call__(self, inputs, labels):
         """inputs / labels: a Tensor or tuple of Tensors. Model is called as

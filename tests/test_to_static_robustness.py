@@ -148,8 +148,8 @@ def test_eager_overhead_guard():
         v = eager_chain(x)
     float(v)
     eager_ms = (time.perf_counter() - t0) / n * 1e3
-    # sanity ceiling: per-op dispatch through the tape stays sub-10ms for
-    # a 4-op chain on CPU (catches pathological per-op regressions, e.g.
-    # accidental recompiles or host syncs per op)
-    assert eager_ms < 50.0, f"eager chain {eager_ms:.1f} ms — tape " \
-        f"dispatch regressed"
+    # generous load-tolerant ceiling — this catches PATHOLOGICAL per-op
+    # regressions (accidental recompiles / host syncs per op, which put
+    # the chain in the 100ms+ range), not normal variance
+    assert eager_ms < 250.0, f"eager chain {eager_ms:.1f} ms — tape " \
+        f"dispatch regressed pathologically"
