@@ -19,7 +19,9 @@ from .mpu import (  # noqa: F401
     ParallelCrossEntropy,
 )
 from .recompute import recompute, recompute_sequential  # noqa: F401
-from .pipeline import LayerDesc, PipelineLayer, pipeline_apply  # noqa: F401
+from .pipeline import (  # noqa: F401
+    LayerDesc, PipelineLayer, PipelineParallel, pipeline_apply,
+)
 from .pp_schedule import (  # noqa: F401
     PipelineSchedule, build_pipeline_schedule, pipeline_forward_backward,
     make_pipeline_loss_fn,
@@ -34,6 +36,7 @@ __all__ = ["init", "fleet", "DistributedStrategy", "HybridCommunicateGroup",
            "distributed_optimizer", "recompute", "ColumnParallelLinear",
            "RowParallelLinear", "VocabParallelEmbedding",
            "ParallelCrossEntropy", "LayerDesc", "PipelineLayer",
+           "PipelineParallel",
            "pipeline_apply", "ScatterOp", "GatherOp",
            "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
            "PipelineSchedule", "build_pipeline_schedule",
@@ -69,9 +72,14 @@ def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
 def distributed_model(model):
     """Apply the sharding recipe implied by the strategy (parity:
     /root/reference/python/paddle/distributed/fleet/model.py:32). On TPU
-    this annotates parameter shardings; TP layers already carry theirs."""
+    this annotates parameter shardings; TP layers already carry theirs.
+    A PipelineLayer with pp_degree > 1 returns the PipelineParallel
+    train_batch driver (reference fleet/model.py:160)."""
     if _hcg is None:
         return model
+    if isinstance(model, PipelineLayer) and \
+            _hcg.get_pipe_parallel_world_size() > 1:
+        return PipelineParallel(model, _hcg, _strategy)
     from .sharding_recipes import apply_hybrid_shardings
     return apply_hybrid_shardings(model, _hcg, _strategy)
 

@@ -29,8 +29,8 @@ import jax.numpy as jnp
 from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-__all__ = ["LayerDesc", "PipelineLayer", "pipeline_apply",
-           "pipeline_apply_interleaved"]
+__all__ = ["LayerDesc", "PipelineLayer", "PipelineParallel",
+           "pipeline_apply", "pipeline_apply_interleaved"]
 
 
 class LayerDesc:
@@ -232,3 +232,211 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
                   in_specs=(param_specs, P()), out_specs=P(),
                   check_vma=False)
     return f(stacked_params, x_microbatches)
+
+
+class PipelineParallel:
+    """train_batch-style driver over the table-driven schedules (parity:
+    PipelineParallel.train_batch, /root/reference/python/paddle/
+    distributed/fleet/meta_parallel/pipeline_parallel.py:657, with the
+    1F1B schedule at :440). fleet.distributed_model returns this wrapper
+    for a PipelineLayer when pp_degree > 1 (reference fleet/model.py:160).
+
+    Requires HOMOGENEOUS stages (identical per-stage parameter
+    structure — the transformer case): per-stage parameters are stacked
+    on a leading [n_stages] axis sharded over 'pp', and one pp_schedule
+    program runs the whole fwd+bwd. The optimizer step is the caller's
+    own eager optimizer over the per-stage Tensors (grads are written
+    back unstacked), so every paddle optimizer / lr scheduler / clip
+    composes unchanged.
+    """
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        import jax
+        self._layers = layers
+        self._hcg = hcg
+        from .pp_schedule import _resolve_mesh
+        self._mesh = _resolve_mesh(hcg.mesh)
+        self._pp = self._mesh.shape["pp"]
+        if len(layers.stage_layers) != self._pp:
+            raise ValueError(
+                f"PipelineLayer has {len(layers.stage_layers)} stages but "
+                f"mesh pp degree is {self._pp}")
+        pc = (strategy.pipeline_configs if strategy is not None
+              else {"schedule_mode": "1F1B", "accumulate_steps": 1})
+        self._mode = pc.get("schedule_mode", "1F1B")
+        self._n_micro = int(pc.get("accumulate_steps", 1))
+        self._scheds = {}
+        self._compiled = {}
+
+        # homogeneity check + per-stage param lists
+        self._stage_params = []
+        struct0 = None
+        for si, stage in enumerate(layers.stage_layers):
+            ps = []
+            for l in stage:
+                ps.extend(p for _, p in l.named_parameters())
+                if any(b is not None for _, b in l.named_buffers()):
+                    raise ValueError(
+                        "PipelineParallel stages with buffers (BatchNorm "
+                        "running stats etc.) are not supported — buffer "
+                        "updates cannot thread through the pipelined "
+                        "schedule; use buffer-free stage layers")
+            struct = [(tuple(p.shape), str(p._value.dtype))
+                      for p in ps]
+            if struct0 is None:
+                struct0 = struct
+            elif struct != struct0:
+                raise ValueError(
+                    "PipelineParallel needs homogeneous stages (same "
+                    f"param shapes per stage); stage 0 has {struct0}, "
+                    f"stage {si} has {struct}")
+            self._stage_params.append(ps)
+        self._template_stage = layers.stage_layers[0]
+
+    # -- functional stage ----------------------------------------------------
+    def _stage_fn(self, chunk_params, x):
+        """Run the (template) stage layers with swapped-in arrays.
+        chunk_params: list of arrays matching stage-0's param order."""
+        from ...jit import functional_call
+        idx = 0
+        h = x
+        for l in self._template_stage:
+            n = len(list(l.named_parameters()))
+            arrs = chunk_params[idx:idx + n]
+            idx += n
+            h, _ = functional_call(l, arrs, [], (h,))
+        return h
+
+    def _stacked(self):
+        import jax.numpy as jnp
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        leaves = []
+        n_params = len(self._stage_params[0])
+        for i in range(n_params):
+            stacked = jnp.stack([self._stage_params[s][i]._value
+                                 for s in range(self._pp)])[None]
+            # [1(vpp), pp, ...] — pp axis sharded
+            spec = [None, "pp"] + [None] * (stacked.ndim - 2)
+            leaves.append(jax.device_put(
+                stacked, NamedSharding(self._mesh, P(*spec))))
+        return leaves
+
+    def _sched(self, n_micro):
+        key = (self._pp, n_micro, self._mode)
+        if key not in self._scheds:
+            from .pp_schedule import build_pipeline_schedule
+            self._scheds[key] = build_pipeline_schedule(
+                self._pp, n_micro, 1, self._mode)
+        return self._scheds[key]
+
+    # -- public API ----------------------------------------------------------
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        """data: (inputs, labels) Tensors; the batch splits into
+        accumulate_steps microbatches on dim 0. Returns the mean loss
+        Tensor. Runs fwd+bwd through the schedule, writes grads onto the
+        per-stage param Tensors, then steps the caller's optimizer (via
+        scaler.step when a GradScaler is passed, preserving its inf-skip
+        and scale-update semantics)."""
+        import jax.numpy as jnp
+        from ...framework.core import Tensor
+        from .pp_schedule import pipeline_forward_backward
+
+        x, y = data
+        xa = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+        m = self._n_micro
+        if xa.shape[0] % m:
+            raise ValueError(
+                f"batch {xa.shape[0]} not divisible into "
+                f"accumulate_steps={m} microbatches")
+        xs = xa.reshape((m, xa.shape[0] // m) + xa.shape[1:])
+        ys = ya.reshape((m, ya.shape[0] // m) + ya.shape[1:])
+
+        user_loss = self._layers.loss_fn
+
+        def engine_loss(lp, out, target):
+            if user_loss is None:
+                return jnp.mean(out.astype(jnp.float32))
+            l = user_loss(Tensor(out), Tensor(target))
+            return l._value if isinstance(l, Tensor) else l
+
+        def stage_fn(chunk, xv):
+            return self._stage_fn(list(chunk), xv)
+
+        stacked = self._stacked()
+        sched = self._sched(m)
+        dummy_lp = jnp.zeros((1,), jnp.float32)
+        # the engine must run under jit: shard_map with auto (non-'pp')
+        # axes only composes inside a traced program
+        fb = self._compiled.get(("train", m))
+        if fb is None:
+            import jax as _jax
+
+            def _fb(stacked_, lp_, xs_, ys_):
+                return pipeline_forward_backward(
+                    stage_fn, engine_loss, stacked_, lp_, xs_, ys_,
+                    self._mesh, sched, axis="pp")
+            fb = self._compiled[("train", m)] = _jax.jit(_fb)
+        loss, gstacked, _, _ = fb(stacked, dummy_lp, xs, ys)
+
+        # unstack grads back onto the stage param Tensors
+        for i, g in enumerate(gstacked):
+            for s in range(self._pp):
+                p = self._stage_params[s][i]
+                ga = g[0, s]
+                p.grad = Tensor(ga.astype(p._value.dtype))
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(loss)
+
+    def eval_batch(self, data, compute_loss=True):
+        """Forward-only microbatched eval; returns mean loss (or last
+        stage outputs when compute_loss=False, in which case labels may
+        be omitted)."""
+        import jax.numpy as jnp
+        from ...framework.core import Tensor
+        if isinstance(data, (tuple, list)) and len(data) == 2:
+            x, y = data
+        else:
+            x = data[0] if isinstance(data, (tuple, list)) else data
+            y = None
+        if compute_loss and y is None and self._layers.loss_fn is not None:
+            raise ValueError("eval_batch(compute_loss=True) needs "
+                             "(inputs, labels)")
+        xa = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+        ya = None if y is None else (
+            y._value if isinstance(y, Tensor) else jnp.asarray(y))
+        m = self._n_micro
+        if xa.shape[0] % m:
+            raise ValueError(
+                f"batch {xa.shape[0]} not divisible into "
+                f"accumulate_steps={m} microbatches")
+        xs = xa.reshape((m, xa.shape[0] // m) + xa.shape[1:])
+        stacked = self._stacked()
+        # forward via pipeline_apply on composed stage params (jitted:
+        # shard_map over a hybrid mesh only composes inside a trace)
+        fw = self._compiled.get(("eval", m))
+        if fw is None:
+            def _fw(stacked_, xs_):
+                squeezed = jax.tree_util.tree_map(lambda a: a[0],
+                                                  stacked_)
+                return pipeline_apply(
+                    lambda p, v: self._stage_fn(list(p), v),
+                    squeezed, xs_, self._mesh, axis="pp")
+            fw = self._compiled[("eval", m)] = jax.jit(_fw)
+        out = fw(stacked, xs)
+        out_full = out.reshape((-1,) + out.shape[2:])
+        if not compute_loss or self._layers.loss_fn is None:
+            return Tensor(out_full)
+        loss = self._layers.loss_fn(Tensor(out_full), Tensor(ya))
+        return loss
+
+    def parameters(self):
+        return self._layers.parameters()

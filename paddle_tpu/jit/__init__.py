@@ -449,14 +449,23 @@ class TrainStep:
             for p, np_ in zip(optimizer._parameter_list, new_opt_params):
                 if np_ is not None:
                     new_params[id2idx[id(p)]] = np_
-            # pin each output param to its input sharding: placements must
-            # be STABLE across steps (otherwise e.g. ZeRO-1's sharded
+            # pin outputs to their INPUT shardings: placements must be
+            # STABLE across steps (otherwise e.g. ZeRO-1's sharded
             # optimizer update makes XLA emit sharded params, silently
-            # drifting stage 1 into stage 3 after the first step)
+            # drifting stage 1 into stage 3 after the first step; the
+            # same applies to the optimizer states in reverse)
             new_params = [
                 jax.lax.with_sharding_constraint(a, s)
                 if s is not None else a
                 for a, s in zip(new_params, self._param_shardings())]
+            new_opt_state = jax.tree_util.tree_map(
+                lambda new, old: jax.lax.with_sharding_constraint(
+                    new, old.sharding)
+                if (hasattr(old, "sharding") and hasattr(new, "shape")
+                    and isinstance(old.sharding,
+                                   jax.sharding.NamedSharding)
+                    and new.shape == old.shape) else new,
+                new_opt_state, opt_state)
             return loss, new_params, new_bufs, new_opt_state
 
         donate = (0, 2) if self._donate else ()

@@ -302,6 +302,11 @@ class TestZeroStages:
         # placement STABILITY: params must remain replicated after steps
         # (no silent drift into stage-3 via XLA output-sharding choice)
         assert not self._spec_names(p._value), p._value.sharding
+        # ...and optimizer states must remain SHARDED (the symmetric
+        # drift: XLA choosing replicated state outputs would silently
+        # lose the ZeRO-1 memory win)
+        m_leaf2 = opt._state["m"][0]
+        assert "sharding" in str(m_leaf2.sharding.spec)
 
     def test_stage3_params_sharded(self):
         model, opt = self._setup(stage=3)

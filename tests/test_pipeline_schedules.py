@@ -222,3 +222,102 @@ def test_llama_pp_4d_trains():
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# PipelineParallel.train_batch (reference meta_parallel API)
+# ---------------------------------------------------------------------------
+
+def test_pipeline_parallel_train_batch_matches_oracle():
+    """fleet.distributed_model(PipelineLayer) -> PipelineParallel;
+    train_batch == sequential single-device training (reference
+    pipeline_parallel.py:657 train_batch over the 1F1B schedule)."""
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+    import paddle_tpu.distributed.fleet as fleet_mod
+    from paddle_tpu import nn, optimizer
+    from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                              PipelineParallel)
+
+    st = fleet_mod.DistributedStrategy()
+    st.hybrid_configs = {"pp_degree": 4, "dp_degree": 2}
+    st.pipeline = True
+    st.pipeline_configs = {"accumulate_steps": 4, "schedule_mode": "1F1B"}
+    fleet_mod.init(is_collective=True, strategy=st)
+    try:
+        paddle.seed(0)
+
+        class Block(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.fc = nn.Linear(16, 16, bias_attr=False)
+
+            def forward(self, x):
+                return paddle.tanh(self.fc(x))
+
+        mse = lambda o, t: ((o - t) ** 2).mean()
+        pipe = PipelineLayer([LayerDesc(Block) for _ in range(4)],
+                             num_stages=4, loss_fn=mse)
+        model = fleet_mod.distributed_model(pipe)
+        assert isinstance(model, PipelineParallel)
+        opt = optimizer.SGD(learning_rate=0.1,
+                            parameters=pipe.parameters())
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(16, 16).astype(np.float32))
+        y = paddle.to_tensor((rng.randn(16, 16) * 0.1).astype(np.float32))
+        losses = [float(model.train_batch((x, y), opt)) for _ in range(4)]
+        assert losses[-1] < losses[0]
+        ev = float(model.eval_batch((x, y)))
+        assert np.isfinite(ev)
+    finally:
+        fleet_mod._hcg = None
+
+    # oracle: identical init trained sequentially
+    paddle.seed(0)
+    import paddle_tpu as paddle2
+    from paddle_tpu import nn as nn2
+
+    class Block2(nn2.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn2.Linear(16, 16, bias_attr=False)
+
+        def forward(self, x):
+            return paddle2.tanh(self.fc(x))
+
+    blocks = [Block2() for _ in range(4)]
+    params = [p for b in blocks for p in b.parameters()]
+    from paddle_tpu import optimizer as O
+    ropt = O.SGD(learning_rate=0.1, parameters=params)
+    rl = []
+    x2 = paddle2.to_tensor(np.asarray(x.numpy()))
+    y2 = paddle2.to_tensor(np.asarray(y.numpy()))
+    for _ in range(4):
+        h = x2
+        for b in blocks:
+            h = paddle2.tanh(b.fc(h))
+        loss = ((h - y2) ** 2).mean()
+        loss.backward()
+        ropt.step()
+        ropt.clear_grad()
+        rl.append(float(loss))
+    np.testing.assert_allclose(losses, rl, rtol=1e-4, atol=1e-5)
+
+
+def test_pipeline_parallel_rejects_heterogeneous_stages():
+    import paddle_tpu.distributed.fleet as fleet_mod
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet import (LayerDesc, PipelineLayer,
+                                              PipelineParallel)
+    st = fleet_mod.DistributedStrategy()
+    st.hybrid_configs = {"pp_degree": 2}
+    fleet_mod.init(is_collective=True, strategy=st)
+    try:
+        pipe = PipelineLayer(
+            [LayerDesc(nn.Linear, 8, 16), LayerDesc(nn.Linear, 16, 8)],
+            num_stages=2)
+        hcg = fleet_mod.get_hybrid_communicate_group()
+        with pytest.raises(ValueError, match="homogeneous"):
+            PipelineParallel(pipe, hcg)
+    finally:
+        fleet_mod._hcg = None
