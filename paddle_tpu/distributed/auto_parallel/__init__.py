@@ -81,24 +81,34 @@ class Engine:
             inner = getattr(self.strategy, "_inner", self.strategy)
             fleet_mod.init(is_collective=True, strategy=inner)
         self._hcg = fleet_mod.get_hybrid_communicate_group()
-        self.model = fleet_mod.distributed_model(self.model)
+        # wrap exactly once: prepare() can run again for a different mode
+        # (eval-first then fit) and re-wrapping a PipelineParallel would
+        # double-wrap the model
+        if not getattr(self, "_model_wrapped", False):
+            self.model = fleet_mod.distributed_model(self.model)
+            self._model_wrapped = True
 
         if mode == "train":
             if self.optimizer is None:
                 raise ValueError("Engine.fit needs an optimizer")
-            self.optimizer = fleet_mod.distributed_optimizer(
-                self.optimizer)
-            loss = self.loss
+            # one-time, like the model wrap: re-entering train after an
+            # eval prepare must NOT re-wrap the optimizer (nested
+            # shard_optimizer wrappers) or rebuild TrainStep (would drop
+            # the compiled program and replay the RNG step stream)
+            if self._train_step is None:
+                self.optimizer = fleet_mod.distributed_optimizer(
+                    self.optimizer)
+                loss = self.loss
 
-            def loss_fn(out, *labels):
-                if loss is None:
-                    return out
-                if hasattr(loss, "forward") or callable(loss):
-                    return loss(out, *labels)
-                raise TypeError(f"unsupported loss {loss!r}")
+                def loss_fn(out, *labels):
+                    if loss is None:
+                        return out
+                    if hasattr(loss, "forward") or callable(loss):
+                        return loss(out, *labels)
+                    raise TypeError(f"unsupported loss {loss!r}")
 
-            self._train_step = paddle.jit.TrainStep(
-                self.model, loss_fn, self.optimizer)
+                self._train_step = paddle.jit.TrainStep(
+                    self.model, loss_fn, self.optimizer)
         else:
             if self._fwd_fn is None:
                 self._fwd_fn = paddle.jit.to_static(self.model)

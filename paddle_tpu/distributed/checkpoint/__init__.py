@@ -27,6 +27,7 @@ TPU-native format:
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import threading
@@ -56,11 +57,14 @@ def _bounds(index: Tuple, shape: Sequence[int]) -> List[List[int]]:
 
 
 def _shard_fname(name: str, bounds: List[List[int]]) -> str:
+    # '/' and '.' both normalize to '_', so distinct keys like 'a.b' and
+    # 'a_b' would collide; a short hash of the RAW name disambiguates.
     safe = name.replace("/", "_").replace(".", "_")
+    tag = hashlib.md5(name.encode()).hexdigest()[:8]
     if not bounds:
-        return f"{safe}.scalar.npy"
+        return f"{safe}.{tag}.scalar.npy"
     span = "-".join(f"{a}_{b}" for a, b in bounds)
-    return f"{safe}.{span}.npy"
+    return f"{safe}.{tag}.{span}.npy"
 
 
 def _np_save(path: str, arr: np.ndarray):

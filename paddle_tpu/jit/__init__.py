@@ -458,14 +458,32 @@ class TrainStep:
                 jax.lax.with_sharding_constraint(a, s)
                 if s is not None else a
                 for a, s in zip(new_params, self._param_shardings())]
-            new_opt_state = jax.tree_util.tree_map(
-                lambda new, old: jax.lax.with_sharding_constraint(
-                    new, old.sharding)
-                if (hasattr(old, "sharding") and hasattr(new, "shape")
-                    and isinstance(old.sharding,
-                                   jax.sharding.NamedSharding)
-                    and new.shape == old.shape) else new,
-                new_opt_state, opt_state)
+            # `opt_state` here is a tracer: reading `.sharding` off it
+            # raises on jax>=0.9, so the pin must come from the LIVE
+            # concrete state captured at trace time (tracing happens on
+            # the first __call__, after optimizer state init).
+            opt_shardings = self._opt_state_shardings()
+            new_leaves, new_td = jax.tree_util.tree_flatten(new_opt_state)
+            old_leaves = jax.tree_util.tree_leaves(opt_state)
+            if len(new_leaves) == len(old_leaves) == len(opt_shardings):
+                pinned = [
+                    jax.lax.with_sharding_constraint(new, s)
+                    if (s is not None and hasattr(new, "shape")
+                        and getattr(old, "shape", None) == new.shape)
+                    else new
+                    for new, old, s in zip(new_leaves, old_leaves,
+                                           opt_shardings)]
+                new_opt_state = jax.tree_util.tree_unflatten(new_td, pinned)
+            elif any(s is not None for s in opt_shardings):
+                # an optimizer whose update() changes the state's leaf
+                # count would silently lose the ZeRO placement pin —
+                # fail loudly instead of drifting sharded state
+                raise ValueError(
+                    "optimizer.update() returned a state tree whose leaf "
+                    f"count ({len(new_leaves)}) differs from init_state's "
+                    f"({len(opt_shardings)}); the sharded optimizer-state "
+                    "placement pin cannot be applied. Keep the state "
+                    "structure stable across steps.")
             return loss, new_params, new_bufs, new_opt_state
 
         donate = (0, 2) if self._donate else ()
@@ -475,6 +493,16 @@ class TrainStep:
         out = []
         for p in self._p_tensors:
             s = getattr(p._value, "sharding", None)
+            out.append(s if isinstance(s, jax.sharding.NamedSharding)
+                       else None)
+        return out
+
+    def _opt_state_shardings(self):
+        """Concrete per-leaf NamedShardings of the live optimizer state
+        (flattened order), None where unsharded/non-array."""
+        out = []
+        for leaf in jax.tree_util.tree_leaves(self.optimizer._state):
+            s = getattr(leaf, "sharding", None)
             out.append(s if isinstance(s, jax.sharding.NamedSharding)
                        else None)
         return out

@@ -10,9 +10,21 @@ __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
+def _reject_dropout(dropout, training, api):
+    """Attention dropout is not implemented on the TPU kernels; silently
+    training without the requested dropout would be wrong, so every
+    attention entry point rejects it loudly (inference calls with
+    training=False are fine — dropout is a no-op there)."""
+    if dropout and float(dropout) != 0.0 and training:
+        raise NotImplementedError(
+            f"{api}: attention dropout is not implemented on the TPU "
+            "kernels; pass dropout=0.0 (or training=False).")
+
+
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, rng_name="",
                     training=True, name=None):
+    _reject_dropout(dropout, training, "flash_attention")
     out = apply("flash_attention",
                 lambda q, k, v: _fa(q, k, v, causal=causal, dropout=dropout),
                 query, key, value)
@@ -24,6 +36,7 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
+    _reject_dropout(dropout_p, training, "scaled_dot_product_attention")
     if attn_mask is not None:
         return apply("sdpa",
                      lambda q, k, v, m: _fa(q, k, v, attn_mask=m,
@@ -46,6 +59,7 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     cu_seqlens_*: [n_seqs+1] cumulative lengths. Returns (out, None) like
     the padded API. On TPU this runs the segment-ids Pallas kernel; the
     dense reference path is used on CPU/odd shapes."""
+    _reject_dropout(dropout, training, "flash_attn_unpadded")
     from ...ops.flash_attention import flash_attn_varlen
 
     def _raw(t):
