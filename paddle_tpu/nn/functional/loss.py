@@ -253,8 +253,89 @@ def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
 
 def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
              reduction="mean", norm_by_times=False):
-    raise NotImplementedError(
-        "ctc_loss: planned via optax.ctc_loss integration")
+    """Connectionist temporal classification loss (parity:
+    /root/reference/python/paddle/nn/functional/loss.py:1820, warpctc
+    kernel). TPU-native: the CTC forward algorithm's alpha recursion over
+    the blank-interleaved extended label sequence, as one lax.scan over
+    time in log space — fully differentiable, so the gradient is the
+    exact autodiff of the forward algorithm (warpctc computes the same
+    thing by hand with a beta sweep).
+
+    log_probs: [T, B, C] raw logits (softmax is applied internally, like
+    warpctc); labels: [B, L] int; lengths: [B]. norm_by_times scales the
+    GRADIENT by 1/T (the loss value is unchanged — warpctc semantics).
+    reduction='mean' divides per-sample loss by label length then means.
+    """
+    def f(logits, lab, t_len, u_len):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        t_max, b, _ = lp.shape
+        l_max = lab.shape[1]
+        s_max = 2 * l_max + 1
+        lab = lab.astype(jnp.int32)
+        t_len = t_len.astype(jnp.int32)
+        u_len = u_len.astype(jnp.int32)
+        # extended label sequence: blank a1 blank a2 ... blank
+        ext = jnp.full((b, s_max), blank, jnp.int32)
+        ext = ext.at[:, 1::2].set(lab)
+        neg_inf = -1e30
+
+        def emit(t):
+            # [B, S] log prob of emitting ext symbol at time t
+            return jnp.take_along_axis(lp[t], ext, axis=1)
+
+        alpha0 = jnp.full((b, s_max), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(u_len > 0, emit(0)[:, 1], neg_inf))
+
+        # the s-2 skip is legal only when ext[s] is a label differing
+        # from ext[s-2] (can't skip the separating blank between equal
+        # labels, nor skip into a blank)
+        same_as_prev2 = jnp.concatenate(
+            [jnp.ones((b, 2), bool), ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1),
+                                   a_shift2)
+            new = merged + emit(t)
+            # frozen past each sample's input length
+            new = jnp.where((t < t_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, t_max))
+        # total prob: final blank (s=2U) or final label (s=2U-1)
+        send = 2 * u_len
+        last_blank = jnp.take_along_axis(alpha, send[:, None],
+                                         axis=1)[:, 0]
+        last_lab = jnp.take_along_axis(
+            alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
+        last_lab = jnp.where(u_len > 0, last_lab, neg_inf)
+        nll = -jnp.logaddexp(last_blank, last_lab)
+        if norm_by_times:
+            # warpctc scales only the GRADIENT by 1/T; keep the value
+            # and route autodiff through the scaled branch
+            scaled = nll / jnp.maximum(t_len, 1).astype(nll.dtype)
+            nll = scaled + jax.lax.stop_gradient(nll - scaled)
+        return nll.astype(logits.dtype)
+
+    loss = apply("ctc_loss", f, log_probs, labels, input_lengths,
+                 label_lengths)
+    if reduction == "mean":
+        # reference (loss.py:1962): mean of per-sample loss normalized
+        # by label length
+        norm = apply("ctc_norm",
+                     lambda l, ll: l / jnp.maximum(ll.astype(l.dtype),
+                                                   1.0),
+                     loss, label_lengths)
+        return norm.mean()
+    if reduction == "sum":
+        return loss.sum()
+    return loss
 
 
 def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,

@@ -138,73 +138,12 @@ class CTCLoss(Layer):
     def forward(self, log_probs, labels, input_lengths, label_lengths,
                 norm_by_times=False):
         """log_probs: [T, B, C] (logits accepted — re-normalized);
-        labels: [B, L]; lengths: [B]."""
-        blank = self.blank
-
-        def f(lp, lab, in_len, lab_len):
-            lp = jax.nn.log_softmax(lp, axis=-1)
-            t_max, b, _ = lp.shape
-            l_max = lab.shape[1]
-            s_max = 2 * l_max + 1
-            # extended label sequence: blank a1 blank a2 ... blank
-            ext = jnp.full((b, s_max), blank, lab.dtype)
-            ext = ext.at[:, 1::2].set(lab)
-            neg_inf = -1e30
-
-            # alpha init: positions 0 (blank) and 1 (first label)
-            def emit(t):
-                # [B, S] log prob of emitting ext symbol at time t
-                return jnp.take_along_axis(lp[t], ext, axis=1)
-
-            alpha0 = jnp.full((b, s_max), neg_inf)
-            alpha0 = alpha0.at[:, 0].set(emit(0)[:, 0])
-            alpha0 = alpha0.at[:, 1].set(
-                jnp.where(lab_len > 0, emit(0)[:, 1], neg_inf))
-
-            same_as_prev2 = jnp.concatenate(
-                [jnp.ones((b, 2), bool),
-                 ext[:, 2:] == ext[:, :-2]], axis=1)
-
-            def step(alpha, t):
-                a_shift1 = jnp.concatenate(
-                    [jnp.full((b, 1), neg_inf), alpha[:, :-1]], axis=1)
-                a_shift2 = jnp.concatenate(
-                    [jnp.full((b, 2), neg_inf), alpha[:, :-2]], axis=1)
-                a_shift2 = jnp.where(same_as_prev2, neg_inf, a_shift2)
-                merged = jnp.logaddexp(
-                    jnp.logaddexp(alpha, a_shift1), a_shift2)
-                new = merged + emit(t)
-                # frozen past input length
-                new = jnp.where((t < in_len)[:, None], new, alpha)
-                return new, None
-
-            alpha, _ = jax.lax.scan(step, alpha0,
-                                    jnp.arange(1, t_max))
-            # total prob: last blank or last label position
-            send = 2 * lab_len  # index of final blank
-            last_blank = jnp.take_along_axis(alpha, send[:, None],
-                                             axis=1)[:, 0]
-            last_lab = jnp.take_along_axis(
-                alpha, jnp.maximum(send - 1, 0)[:, None], axis=1)[:, 0]
-            last_lab = jnp.where(lab_len > 0, last_lab, neg_inf)
-            nll = -jnp.logaddexp(last_blank, last_lab)
-            if norm_by_times:
-                nll = nll / jnp.maximum(in_len, 1)
-            return nll
-
-        loss = apply("ctc_loss", f, log_probs, labels, input_lengths,
-                     label_lengths)
-        if self.reduction == "mean":
-            # reference (functional/loss.py:1962): mean of per-sample
-            # loss normalized by label length
-            norm = apply("ctc_norm",
-                         lambda l, ll: l / jnp.maximum(
-                             ll.astype(l.dtype), 1.0),
-                         loss, label_lengths)
-            return norm.mean()
-        if self.reduction == "sum":
-            return loss.sum()
-        return loss
+        labels: [B, L]; lengths: [B]. Delegates to the canonical
+        functional (nn.functional.ctc_loss)."""
+        from ..functional.loss import ctc_loss as _ctc
+        return _ctc(log_probs, labels, input_lengths, label_lengths,
+                    blank=self.blank, reduction=self.reduction,
+                    norm_by_times=norm_by_times)
 
 
 class GaussianNLLLoss(Layer):

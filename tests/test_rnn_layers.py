@@ -183,3 +183,173 @@ class TestExtraLosses:
         assert (n(loss) > 0).all()
         loss.sum().backward()
         assert x.grad is not None
+
+
+class TestCTCLossFunctional:
+    """nn.functional.ctc_loss (parity:
+    /root/reference/python/paddle/nn/functional/loss.py:1820)."""
+
+    def _ref_example(self):
+        # the reference docstring example (loss.py:1860-1900)
+        log_probs = np.array([
+            [[4.17021990e-01, 7.20324516e-01, 1.14374816e-04],
+             [3.02332580e-01, 1.46755889e-01, 9.23385918e-02]],
+            [[1.86260208e-01, 3.45560730e-01, 3.96767467e-01],
+             [5.38816750e-01, 4.19194520e-01, 6.85219526e-01]],
+            [[2.04452246e-01, 8.78117442e-01, 2.73875929e-02],
+             [6.70467496e-01, 4.17304814e-01, 5.58689833e-01]],
+            [[1.40386939e-01, 1.98101491e-01, 8.00744593e-01],
+             [9.68261600e-01, 3.13424170e-01, 6.92322612e-01]],
+            [[8.76389146e-01, 8.94606650e-01, 8.50442126e-02],
+             [3.90547849e-02, 1.69830427e-01, 8.78142476e-01]],
+        ], np.float32)
+        labels = np.array([[1, 2, 2], [1, 2, 2]], np.int32)
+        return log_probs, labels
+
+    def test_reference_golden_values(self):
+        from paddle_tpu.nn import functional as F
+        lp, labels = self._ref_example()
+        il, ll = np.array([5, 5], np.int64), np.array([3, 3], np.int64)
+        loss = F.ctc_loss(t(lp), t(labels), t(il), t(ll), blank=0,
+                          reduction="none")
+        np.testing.assert_allclose(n(loss), [3.91798496, 2.90765190],
+                                   rtol=1e-5)
+        mean = F.ctc_loss(t(lp), t(labels), t(il), t(ll), blank=0,
+                          reduction="mean")
+        np.testing.assert_allclose(float(n(mean)), 1.13760614, rtol=1e-5)
+        tot = F.ctc_loss(t(lp), t(labels), t(il), t(ll), blank=0,
+                         reduction="sum")
+        np.testing.assert_allclose(float(n(tot)),
+                                   3.91798496 + 2.90765190, rtol=1e-5)
+
+    def test_brute_force_oracle(self):
+        # enumerate every alignment path of length T, collapse it
+        # (dedupe-then-drop-blank), and sum path probabilities
+        from itertools import product
+        from paddle_tpu.nn import functional as F
+        T, C, blank = 4, 3, 0
+        logits = rng.randn(T, 1, C).astype(np.float32)
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(
+            -1, keepdims=True)
+        for label in ([1, 2], [1, 1], [2], [1, 2, 1]):
+            total = 0.0
+            for path in product(range(C), repeat=T):
+                collapsed = []
+                prev = None
+                for s in path:
+                    if s != prev:
+                        collapsed.append(s)
+                    prev = s
+                collapsed = [s for s in collapsed if s != blank]
+                if collapsed == label:
+                    p = 1.0
+                    for ti, s in enumerate(path):
+                        p *= probs[ti, s]
+                    total += p
+            lab = np.array([label], np.int32)
+            loss = F.ctc_loss(
+                t(logits), t(lab), t(np.array([T], np.int64)),
+                t(np.array([len(label)], np.int64)), blank=blank,
+                reduction="none")
+            np.testing.assert_allclose(float(n(loss)[0]), -np.log(total),
+                                       rtol=1e-4, err_msg=str(label))
+
+    def test_numeric_grad_check(self):
+        from paddle_tpu.nn import functional as F
+        T, B, C = 5, 2, 4
+        logits = rng.randn(T, B, C).astype(np.float64)
+        labels = np.array([[1, 2, 3], [2, 2, 0]], np.int32)
+        il = np.array([5, 4], np.int64)
+        ll = np.array([3, 2], np.int64)
+
+        def f_np(x):
+            out = F.ctc_loss(t(x.astype(np.float32)), t(labels), t(il),
+                             t(ll), reduction="sum")
+            return float(n(out))
+
+        x_t = t(logits.astype(np.float32), stop_gradient=False)
+        loss = F.ctc_loss(x_t, t(labels), t(il), t(ll), reduction="sum")
+        loss.backward()
+        analytic = n(x_t.grad)
+        eps = 1e-3
+        for idx in [(0, 0, 1), (2, 1, 2), (4, 0, 0), (3, 1, 3)]:
+            dp = logits.copy(); dp[idx] += eps
+            dm = logits.copy(); dm[idx] -= eps
+            num = (f_np(dp) - f_np(dm)) / (2 * eps)
+            np.testing.assert_allclose(analytic[idx], num, rtol=2e-2,
+                                       atol=1e-3)
+        # grads past input_length must be zero (sample 1 has T=4)
+        np.testing.assert_allclose(analytic[4, 1], 0.0, atol=1e-7)
+
+    def test_norm_by_times_scales_grad_only(self):
+        from paddle_tpu.nn import functional as F
+        T, B, C = 6, 1, 4
+        logits = rng.randn(T, B, C).astype(np.float32)
+        labels = np.array([[1, 3]], np.int32)
+        il, ll = np.array([6], np.int64), np.array([2], np.int64)
+
+        x1 = t(logits, stop_gradient=False)
+        l1 = F.ctc_loss(x1, t(labels), t(il), t(ll), reduction="sum")
+        l1.backward()
+        x2 = t(logits, stop_gradient=False)
+        l2 = F.ctc_loss(x2, t(labels), t(il), t(ll), reduction="sum",
+                        norm_by_times=True)
+        l2.backward()
+        # warpctc: value unchanged, gradient scaled by 1/T
+        np.testing.assert_allclose(float(n(l2)), float(n(l1)), rtol=1e-6)
+        np.testing.assert_allclose(n(x2.grad), n(x1.grad) / T,
+                                   rtol=1e-5, atol=1e-8)
+
+    def test_empty_label(self):
+        from paddle_tpu.nn import functional as F
+        T, C = 3, 3
+        logits = rng.randn(T, 1, C).astype(np.float32)
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(
+            -1, keepdims=True)
+        loss = F.ctc_loss(
+            t(logits), t(np.zeros((1, 2), np.int32)),
+            t(np.array([T], np.int64)), t(np.array([0], np.int64)),
+            reduction="none")
+        # only path is all-blank
+        want = -np.log(probs[:, 0]).sum()
+        np.testing.assert_allclose(float(n(loss)[0]), want, rtol=1e-4)
+
+    def test_layer_delegates(self):
+        from paddle_tpu.nn import functional as F
+        lp, labels = self._ref_example()
+        il, ll = np.array([5, 5], np.int64), np.array([3, 3], np.int64)
+        lyr = nn.CTCLoss(blank=0, reduction="mean")
+        got = lyr(t(lp), t(labels), t(il), t(ll))
+        want = F.ctc_loss(t(lp), t(labels), t(il), t(ll))
+        np.testing.assert_allclose(float(n(got)), float(n(want)),
+                                   rtol=1e-6)
+
+    def test_nonzero_blank(self):
+        from itertools import product
+        from paddle_tpu.nn import functional as F
+        T, C, blank = 3, 3, 2
+        logits = rng.randn(T, 1, C).astype(np.float32)
+        probs = np.exp(logits[:, 0]) / np.exp(logits[:, 0]).sum(
+            -1, keepdims=True)
+        label = [0, 1]
+        total = 0.0
+        for path in product(range(C), repeat=T):
+            collapsed = []
+            prev = None
+            for s in path:
+                if s != prev:
+                    collapsed.append(s)
+                prev = s
+            collapsed = [s for s in collapsed if s != blank]
+            if collapsed == label:
+                p = 1.0
+                for ti, s in enumerate(path):
+                    p *= probs[ti, s]
+                total += p
+        loss = F.ctc_loss(
+            t(logits), t(np.array([label], np.int32)),
+            t(np.array([T], np.int64)),
+            t(np.array([len(label)], np.int64)), blank=blank,
+            reduction="none")
+        np.testing.assert_allclose(float(n(loss)[0]), -np.log(total),
+                                   rtol=1e-4)
