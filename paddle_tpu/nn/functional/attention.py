@@ -1,33 +1,41 @@
 """Attention functionals (parity:
 /root/reference/python/paddle/nn/functional/flash_attention.py:146,441).
-Layout matches paddle: [batch, seq, num_heads, head_dim]."""
+Layout matches paddle: [batch, seq, num_heads, head_dim].
+
+Attention dropout (training): applied to the softmax probs on the dense
+XLA path (the Pallas kernel only serves dropout=0; the gate is
+dropout-aware). Keys come from the framework RNG stream, so compiled
+TrainStep runs are deterministic per step key."""
 from __future__ import annotations
 
-from ...framework.core import Tensor, apply
+from ...framework.core import Tensor, apply, default_generator
 from ...ops import flash_attention as _fa
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
            "flash_attn_unpadded", "sdp_kernel"]
 
 
-def _reject_dropout(dropout, training, api):
-    """Attention dropout is not implemented on the TPU kernels; silently
-    training without the requested dropout would be wrong, so every
-    attention entry point rejects it loudly (inference calls with
-    training=False are fine — dropout is a no-op there)."""
+def _dropout_key(dropout, training):
     if dropout and float(dropout) != 0.0 and training:
-        raise NotImplementedError(
-            f"{api}: attention dropout is not implemented on the TPU "
-            "kernels; pass dropout=0.0 (or training=False).")
+        return float(dropout), default_generator.next_key()
+    return 0.0, None
 
 
 def flash_attention(query, key, value, dropout=0.0, causal=False,
                     return_softmax=False, fixed_seed_offset=None, rng_name="",
                     training=True, name=None):
-    _reject_dropout(dropout, training, "flash_attention")
-    out = apply("flash_attention",
-                lambda q, k, v: _fa(q, k, v, causal=causal, dropout=dropout),
-                query, key, value)
+    p, dkey = _dropout_key(dropout, training)
+    if dkey is None:
+        out = apply("flash_attention",
+                    lambda q, k, v: _fa(q, k, v, causal=causal),
+                    query, key, value)
+    else:
+        # key as a positional arg (not closure) — partial capture lifts
+        # it to a segment input, keeping stochastic segments cacheable
+        out = apply("flash_attention",
+                    lambda q, k, v, dk: _fa(q, k, v, causal=causal,
+                                            dropout=p, dropout_key=dk),
+                    query, key, value, dkey)
     if return_softmax:
         return out, None
     return out, None  # paddle returns (out, softmax) tuple
@@ -36,15 +44,26 @@ def flash_attention(query, key, value, dropout=0.0, causal=False,
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False,
                                  training=True, name=None):
-    _reject_dropout(dropout_p, training, "scaled_dot_product_attention")
+    p, dkey = _dropout_key(dropout_p, training)
     if attn_mask is not None:
+        if dkey is None:
+            return apply("sdpa",
+                         lambda q, k, v, m: _fa(q, k, v, attn_mask=m,
+                                                causal=is_causal),
+                         query, key, value, attn_mask)
         return apply("sdpa",
-                     lambda q, k, v, m: _fa(q, k, v, attn_mask=m,
-                                            causal=is_causal),
-                     query, key, value, attn_mask)
+                     lambda q, k, v, m, dk: _fa(q, k, v, attn_mask=m,
+                                                causal=is_causal,
+                                                dropout=p, dropout_key=dk),
+                     query, key, value, attn_mask, dkey)
+    if dkey is None:
+        return apply("sdpa",
+                     lambda q, k, v: _fa(q, k, v, causal=is_causal),
+                     query, key, value)
     return apply("sdpa",
-                 lambda q, k, v: _fa(q, k, v, causal=is_causal),
-                 query, key, value)
+                 lambda q, k, v, dk: _fa(q, k, v, causal=is_causal,
+                                         dropout=p, dropout_key=dk),
+                 query, key, value, dkey)
 
 
 def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
@@ -59,7 +78,12 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     cu_seqlens_*: [n_seqs+1] cumulative lengths. Returns (out, None) like
     the padded API. On TPU this runs the segment-ids Pallas kernel; the
     dense reference path is used on CPU/odd shapes."""
-    _reject_dropout(dropout, training, "flash_attn_unpadded")
+    if dropout and float(dropout) != 0.0 and training:
+        raise NotImplementedError(
+            "flash_attn_unpadded: attention dropout is not implemented "
+            "on the packed varlen kernel; pass dropout=0.0 (or "
+            "training=False). Silently training without the requested "
+            "dropout would be wrong.")
     from ...ops.flash_attention import flash_attn_varlen
 
     def _raw(t):

@@ -26,8 +26,11 @@ import jax.numpy as jnp
 _NEG_INF = -1e30
 
 
-def _sdpa_core(q, k, v, bias, causal, scale):
-    """[b, s, h, d] reference attention with f32 softmax accumulation."""
+def _sdpa_core(q, k, v, bias, causal, scale, dropout=0.0,
+               dropout_key=None):
+    """[b, s, h, d] reference attention with f32 softmax accumulation.
+    dropout (with a key) is applied to the attention probabilities,
+    upscale-in-train — the reference flashattn semantics."""
     b, sq, h, d = q.shape
     sk = k.shape[1]
     kv_heads = k.shape[2]
@@ -44,6 +47,10 @@ def _sdpa_core(q, k, v, bias, causal, scale):
         mask = qi >= ki
         logits = jnp.where(mask[None, None], logits, _NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
+    if dropout and dropout_key is not None:
+        keep = jax.random.bernoulli(dropout_key, 1.0 - dropout,
+                                    probs.shape)
+        probs = jnp.where(keep, probs / (1.0 - dropout), 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v)
     return out
 
@@ -84,22 +91,31 @@ def pallas_attention_plan(q, k, min_seq: int = 512):
 
 
 def flash_attention(q, k, v, attn_mask=None, causal=False, dropout=0.0,
-                    scale=None, return_softmax=False):
+                    scale=None, return_softmax=False, dropout_key=None):
     """Differentiable flash attention on raw arrays.
 
     On TPU backends dispatches to the Pallas kernel (custom VJP) when
-    shapes qualify (no mask, seq divisible by a block size, head_dim MXU
-    friendly); otherwise the jnp reference (XLA still fuses well). Both
-    paths match numerically up to f32 accumulation order.
+    shapes qualify (no mask, no dropout, seq divisible by a block size,
+    head_dim MXU friendly); otherwise the jnp reference (XLA still fuses
+    well). Both paths match numerically up to f32 accumulation order.
+    Attention dropout requires a dropout_key (the dense path applies it
+    to the probs); dropout > 0 without a key is an error — never a
+    silent no-op.
     """
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
+    if dropout and dropout_key is None:
+        raise ValueError(
+            "flash_attention: dropout > 0 needs dropout_key (the "
+            "nn.functional wrappers pass one from the RNG stream when "
+            "training)")
     plan = pallas_attention_plan(q, k) if (attn_mask is None
                                            and dropout == 0.0) else None
     if plan is not None:
         from .pallas.flash_attention import flash_attention_pallas
         return flash_attention_pallas(q, k, v, causal, scale, *plan)
-    return _sdpa_core(q, k, v, attn_mask, causal, scale)
+    return _sdpa_core(q, k, v, attn_mask, causal, scale, dropout,
+                      dropout_key)
 
 
 # ---------------------------------------------------------------------------

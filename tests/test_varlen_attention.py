@@ -162,3 +162,47 @@ def test_flash_attn_unpadded_functional_and_grad():
     for t in (q, k, v):
         ga = np.asarray(t.grad._value)
         assert np.all(np.isfinite(ga)) and np.abs(ga).max() > 0
+
+
+class TestAttentionDropout:
+    """Attention dropout is real on the dense path (applied to probs,
+    upscale-in-train), not a silently-ignored argument."""
+
+    def test_sdpa_dropout_changes_output(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(0)
+        q = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+        ev = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                            training=False)
+        ev2 = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                             training=False)
+        np.testing.assert_array_equal(np.asarray(ev._value),
+                                      np.asarray(ev2._value))
+        tr = F.scaled_dot_product_attention(q, q, q, dropout_p=0.5,
+                                            training=True)
+        assert not np.allclose(np.asarray(tr._value),
+                               np.asarray(ev._value))
+
+    def test_flash_attention_dropout_changes_output(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        rng = np.random.RandomState(1)
+        q = paddle.to_tensor(rng.randn(2, 8, 2, 16).astype(np.float32))
+        ev, _ = F.flash_attention(q, q, q, dropout=0.3, training=False)
+        tr, _ = F.flash_attention(q, q, q, dropout=0.3, training=True)
+        assert not np.allclose(np.asarray(tr._value),
+                               np.asarray(ev._value))
+
+    def test_varlen_dropout_still_rejected(self):
+        import numpy as np
+        import pytest
+        import paddle_tpu as paddle
+        import paddle_tpu.nn.functional as F
+        q = paddle.to_tensor(np.zeros((8, 2, 16), np.float32))
+        cu = paddle.to_tensor(np.array([0, 8], np.int32))
+        with pytest.raises(NotImplementedError, match="dropout"):
+            F.flash_attn_unpadded(q, q, q, cu, cu, 8, 8, scale=0.25,
+                                  dropout=0.1)
