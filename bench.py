@@ -167,6 +167,59 @@ def run_decode():
             "prefill_ms": round(1000 * timings["prefill_s"], 2)}
 
 
+def run_serving(weight_dtype=None, concurrency=8):
+    """Continuous-batching serving bench (VERDICT r3 protocol): mixed
+    prompt lengths, 2x oversubscribed request queue; reports tok/s and
+    p50/p99 request latency."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    paddle.seed(0)
+    cfg = llama_small(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    block_size = 64
+    new_tokens = 64
+    n_requests = concurrency * 2
+    eng = ServingEngine(
+        model, max_batch_size=concurrency,
+        num_blocks=concurrency * ((512 + new_tokens) // block_size + 2) + 1,
+        block_size=block_size, prompt_buckets=(512,),
+        weight_dtype=weight_dtype, chunk_size=16)
+    rng = np.random.RandomState(0)
+    lens = rng.randint(128, 513, n_requests)
+    # warmup: compile prefill + decode with one short request
+    eng.add_request(rng.randint(0, cfg.vocab_size, 32),
+                    SamplingParams(max_new_tokens=2))
+    eng.run_to_completion()
+    eng.clear_finished()   # warmup (compiles) must not skew stats
+    t0 = time.perf_counter()
+    for l in lens:
+        eng.add_request(rng.randint(0, cfg.vocab_size, int(l)),
+                        SamplingParams(max_new_tokens=new_tokens))
+    eng.run_to_completion()
+    dt = time.perf_counter() - t0
+    st = eng.stats()
+    gen = st["generated_tokens"]
+    tag = f"serving_{'int8' if weight_dtype else 'bf16'}_c{concurrency}"
+    return {
+        f"{tag}_tok_per_sec": round(gen / dt, 1),
+        f"{tag}_latency_p50_s": round(st["latency_p50_s"], 3),
+        f"{tag}_latency_p99_s": round(st["latency_p99_s"], 3),
+        f"{tag}_ttft_p50_s": round(st["ttft_p50_s"], 3),
+    }
+
+
+def run_serving_suite():
+    """fp and int8 at two concurrency levels."""
+    out = {}
+    for wd in (None, "int8"):
+        for conc in (4, 8):
+            out.update(run_serving(weight_dtype=wd, concurrency=conc))
+    return out
+
+
 def main(mode: str):
     if mode in ("mid", "small", "tiny"):
         result = run_llama(mode)
@@ -179,13 +232,19 @@ def main(mode: str):
         result = {"metric": "paged_decode_tokens_per_sec",
                   "unit": "tokens/s", "vs_baseline": 0.0,
                   "value": r["paged_decode_tok_per_sec"], "extra": r}
+    elif mode == "serving":
+        r = run_serving_suite()
+        result = {"metric": "serving_bf16_c8_tok_per_sec",
+                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "value": r["serving_bf16_c8_tok_per_sec"], "extra": r}
     else:  # auto: headline llama + secondary benches in extra
         try:
             result = run_llama("mid")
         except Exception as e:
             sys.stderr.write(f"bench mid failed ({e}); retrying small\n")
             result = run_llama("small")
-        for name, fn in (("resnet", run_resnet), ("decode", run_decode)):
+        for name, fn in (("resnet", run_resnet), ("decode", run_decode),
+                         ("serving", run_serving_suite)):
             try:
                 result["extra"].update(fn())
             except Exception as e:
@@ -193,7 +252,8 @@ def main(mode: str):
     return result
 
 
-_VALID_MODES = ("auto", "mid", "small", "tiny", "resnet", "decode")
+_VALID_MODES = ("auto", "mid", "small", "tiny", "resnet", "decode",
+                "serving")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

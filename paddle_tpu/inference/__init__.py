@@ -11,12 +11,15 @@ analysis passes + TensorRT engines.
 """
 from __future__ import annotations
 
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
 
+from .serving import Request, SamplingParams, ServingEngine  # noqa: F401
+
 __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
-           "PlaceType"]
+           "PlaceType", "ServingEngine", "SamplingParams", "Request"]
 
 
 class PrecisionType:
@@ -56,8 +59,12 @@ class Config:
 
     def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
                        device_id: int = 0, precision=None):
-        self._device = "tpu"  # accelerator routing: gpu name → local chip
+        # accelerator routing: the reference's "gpu" means "the
+        # accelerator" — here that is the local TPU chip
+        self._device = "tpu"
         self._device_id = device_id
+        if precision is not None:
+            self.set_precision(precision)
 
     def enable_tpu(self, device_id: int = 0):
         self._device = "tpu"
@@ -67,10 +74,31 @@ class Config:
         self._device = "cpu"
 
     def enable_memory_optim(self, x: bool = True):
+        """Real: controls input-buffer donation in the executor (the XLA
+        analog of the reference's memory-reuse pass)."""
         self._enable_memory_optim = x
 
     def switch_ir_optim(self, x: bool = True):
-        pass  # XLA always optimizes
+        if not x:
+            # no silent no-op: the knob cannot do what it says here
+            warnings.warn(
+                "switch_ir_optim(False) has no effect on TPU: the "
+                "artifact is StableHLO and XLA always runs its "
+                "optimization pipeline (there is no unoptimized "
+                "interpreter to fall back to).", stacklevel=2)
+
+    def set_precision(self, precision):
+        """Int8 is a build-time property on TPU: quantize before export
+        (paddle_tpu.quantization PTQ/QAT) or serve LLMs via
+        ServingEngine(weight_dtype='int8'). Requesting int8 on an
+        fp-exported artifact is rejected rather than silently ignored."""
+        if precision == PrecisionType.Int8:
+            raise ValueError(
+                "int8 execution requires an int8 artifact: quantize the "
+                "model with paddle_tpu.quantization (PTQ/QAT) before "
+                "export, or use inference.ServingEngine("
+                "weight_dtype='int8') for LLM serving.")
+        self._precision = precision
 
     def model_dir(self):
         return self.prefix
@@ -106,7 +134,8 @@ class Predictor:
         from ..static.io import _LoadedPredictor
         if not config.prefix:
             raise ValueError("Config has no model path")
-        self._loaded = _LoadedPredictor(config.prefix)
+        self._loaded = _LoadedPredictor(
+            config.prefix, donate_feeds=config._enable_memory_optim)
         self._inputs = {n: _IOHandle(n) for n in self._loaded.feed_names}
         self._outputs = {n: _IOHandle(n) for n in self._loaded.fetch_names}
 

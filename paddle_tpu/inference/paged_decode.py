@@ -38,8 +38,37 @@ def _rotate_half(x):
     return jnp.concatenate([-h2, h1], axis=-1)
 
 
-def _extract_weights(model):
-    """Pull raw arrays out of a LlamaForCausalLM (single-device serving)."""
+def _quantize_w(w):
+    """Per-output-channel symmetric absmax int8 (the serving half of the
+    quantization stack's PTQ weight scheme — same math as
+    quantization.AbsmaxObserver over axis 0). Runs on-device (jnp) so a
+    billion-parameter model quantizes without a host roundtrip.
+    Returns (int8, scale[out])."""
+    w = jnp.asarray(w, jnp.float32)
+    scale = jnp.abs(w).max(axis=0) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    wi = jnp.clip(jnp.round(w / scale[None, :]), -127, 127).astype(jnp.int8)
+    return wi, scale
+
+
+def _mm(x, w):
+    """x @ w where w is either a dense array or an int8 (w_i8, scale)
+    pair. The int8 weight is dequantized at use — weight HBM reads halve
+    vs bf16, which is what decode (memory-bound) cares about."""
+    if isinstance(w, tuple):
+        wi, scale = w
+        return (x @ wi.astype(x.dtype)) * scale.astype(x.dtype)
+    return x @ w
+
+
+def _extract_weights(model, weight_dtype=None):
+    """Pull raw arrays out of a LlamaForCausalLM (single-device serving).
+    weight_dtype='int8' stores matmul weights as per-channel int8 pairs
+    (norm/embedding stay full precision)."""
+    if weight_dtype not in (None, "int8"):
+        raise ValueError(f"weight_dtype must be None or 'int8', "
+                         f"got {weight_dtype!r}")
+    q = _quantize_w if weight_dtype == "int8" else (lambda w: w)
     m = model.model
     layers = []
     for lyr in m.layers:
@@ -47,30 +76,33 @@ def _extract_weights(model):
         layers.append({
             "ln1": lyr.input_layernorm.weight._value,
             "ln2": lyr.post_attention_layernorm.weight._value,
-            "wq": a.q_proj.weight._value, "wk": a.k_proj.weight._value,
-            "wv": a.v_proj.weight._value, "wo": a.o_proj.weight._value,
-            "wg": mlp.gate_proj.weight._value,
-            "wu": mlp.up_proj.weight._value,
-            "wd": mlp.down_proj.weight._value,
+            "wq": q(a.q_proj.weight._value),
+            "wk": q(a.k_proj.weight._value),
+            "wv": q(a.v_proj.weight._value),
+            "wo": q(a.o_proj.weight._value),
+            "wg": q(mlp.gate_proj.weight._value),
+            "wu": q(mlp.up_proj.weight._value),
+            "wd": q(mlp.down_proj.weight._value),
         })
     head = (model.lm_head.weight._value if model.lm_head is not None
             else m.embed_tokens.weight._value.T)
     return {"embed": m.embed_tokens.weight._value, "layers": layers,
-            "norm": m.norm.weight._value, "head": head}
+            "norm": m.norm.weight._value, "head": q(head)}
 
 
 class PagedLlamaDecoder:
     """Batched paged-KV generation for a LlamaForCausalLM."""
 
     def __init__(self, model, num_blocks: int = 512, block_size: int = 16,
-                 max_pages_per_seq: Optional[int] = None):
+                 max_pages_per_seq: Optional[int] = None,
+                 weight_dtype: Optional[str] = None):
         cfg = model.cfg
         self.cfg = cfg
         self.block_size = block_size
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
-        self.weights = _extract_weights(model)
+        self.weights = _extract_weights(model, weight_dtype)
         self.cache = PagedKVCache(
             num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
             block_size=block_size, kv_heads=cfg.num_key_value_heads,
@@ -89,12 +121,12 @@ class PagedLlamaDecoder:
     # -- attention building blocks -----------------------------------------
     def _proj_qkv(self, w, hn, b, s):
         cfg = self.cfg
-        q = (hn @ w["wq"]).reshape(b, s, cfg.num_attention_heads,
-                                   self.head_dim)
-        k = (hn @ w["wk"]).reshape(b, s, cfg.num_key_value_heads,
-                                   self.head_dim)
-        v = (hn @ w["wv"]).reshape(b, s, cfg.num_key_value_heads,
-                                   self.head_dim)
+        q = _mm(hn, w["wq"]).reshape(b, s, cfg.num_attention_heads,
+                                     self.head_dim)
+        k = _mm(hn, w["wk"]).reshape(b, s, cfg.num_key_value_heads,
+                                     self.head_dim)
+        v = _mm(hn, w["wv"]).reshape(b, s, cfg.num_key_value_heads,
+                                     self.head_dim)
         return q, k, v
 
     def _rope(self, x, positions):
@@ -104,9 +136,12 @@ class PagedLlamaDecoder:
         return x * cos + _rotate_half(x) * sin
 
     # -- compiled programs ---------------------------------------------------
-    def _prefill_impl(self, weights, k_pool, v_pool, ids, slots):
-        """ids [b, s]; slots [b, s] flat page slots. Returns (logits of
-        the LAST prompt token [b, vocab], updated pools)."""
+    def _prefill_impl(self, weights, k_pool, v_pool, ids, slots,
+                      last_idx=None):
+        """ids [b, s]; slots [b, s] flat page slots; last_idx [b] index
+        of each sequence's final REAL token (defaults to s-1 — bucketed
+        right-padded prompts pass the real length). Returns (logits at
+        last_idx [b, vocab], updated pools)."""
         cfg = self.cfg
         b, s = ids.shape
         h = jnp.take(weights["embed"], ids, axis=0)
@@ -118,9 +153,10 @@ class PagedLlamaDecoder:
             q = self._rope(q, positions)
             k = self._rope(k, positions)
             attn = flash_attention(q, k, v, causal=True)
-            h = h + attn.reshape(b, s, cfg.hidden_size) @ w["wo"]
+            h = h + _mm(attn.reshape(b, s, cfg.hidden_size), w["wo"])
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + (jax.nn.silu(hn @ w["wg"]) * (hn @ w["wu"])) @ w["wd"]
+            h = h + _mm(jax.nn.silu(_mm(hn, w["wg"])) * _mm(hn, w["wu"]),
+                        w["wd"])
             # scatter this layer's k/v into the pool pages (list swap —
             # no stacked-pool slice copies)
             from ..ops.paged_attention import reshape_and_cache
@@ -133,15 +169,20 @@ class PagedLlamaDecoder:
             k_pool[li] = nk
             v_pool[li] = nv
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
-        logits = (h[:, -1] @ weights["head"]).astype(jnp.float32)
+        if last_idx is None:
+            hl = h[:, -1]
+        else:
+            hl = h[jnp.arange(b), last_idx]
+        logits = _mm(hl, weights["head"]).astype(jnp.float32)
         return logits, k_pool, v_pool
 
-    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
-                     ctx_lens, slots):
-        """One decode token for the batch (shared by the single-step and
-        scanned programs). last_ids [b]; tables [b, max_pages]; ctx_lens
-        [b] (tokens already cached, EXCLUDING this one); slots [b] flat
-        slot for this token's k/v."""
+    def _decode_logits(self, weights, k_pool, v_pool, last_ids, tables,
+                       ctx_lens, slots):
+        """One decode token for the batch, up to the logits (shared by
+        the greedy body and the serving engine's sampling step).
+        last_ids [b]; tables [b, max_pages]; ctx_lens [b] (tokens
+        already cached, EXCLUDING this one); slots [b] flat slot for
+        this token's k/v."""
         cfg = self.cfg
         b = last_ids.shape[0]
         h = jnp.take(weights["embed"], last_ids, axis=0)  # [b, d]
@@ -160,11 +201,19 @@ class PagedLlamaDecoder:
             k_pool[li] = kp
             v_pool[li] = vp
             attn = paged_attention_decode(q, kp, vp, tables, ctx_lens + 1)
-            h = h + attn.reshape(b, cfg.hidden_size) @ w["wo"]
+            h = h + _mm(attn.reshape(b, cfg.hidden_size), w["wo"])
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + (jax.nn.silu(hn @ w["wg"]) * (hn @ w["wu"])) @ w["wd"]
+            h = h + _mm(jax.nn.silu(_mm(hn, w["wg"])) * _mm(hn, w["wu"]),
+                        w["wd"])
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
-        logits = (h @ weights["head"]).astype(jnp.float32)
+        logits = _mm(h, weights["head"]).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
+                     ctx_lens, slots):
+        """Greedy single decode token (the scanned batch path)."""
+        logits, k_pool, v_pool = self._decode_logits(
+            weights, k_pool, v_pool, last_ids, tables, ctx_lens, slots)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, k_pool, v_pool
 

@@ -88,9 +88,13 @@ def save_inference_model(path_prefix: str, feed_vars: Sequence[Variable],
 
 
 class _LoadedPredictor:
-    """Callable rebuilt from the serialized artifact."""
+    """Callable rebuilt from the serialized artifact.
 
-    def __init__(self, path_prefix: str):
+    donate_feeds=True (inference.Config.enable_memory_optim) re-jits the
+    exported call with the feed buffers donated — XLA reuses them for
+    outputs, the analog of the reference's memory-reuse pass."""
+
+    def __init__(self, path_prefix: str, donate_feeds: bool = False):
         with open(path_prefix + ".pdmodel", "rb") as f:
             meta = pickle.load(f)
         self.feed_names: List[str] = meta["feed_names"]
@@ -110,15 +114,17 @@ class _LoadedPredictor:
         else:
             self._params = stored
             self._buffers = None
+        self._call = self._exported.call
+        if donate_feeds:
+            self._call = jax.jit(self._exported.call, donate_argnums=(0,))
 
     def run(self, feeds: Sequence) -> List[np.ndarray]:
         feed_arrays = [jnp.asarray(x._value if isinstance(x, Tensor) else x)
                        for x in feeds]
         if self._buffers is not None:
-            out = self._exported.call(feed_arrays, self._params,
-                                      self._buffers)
+            out = self._call(feed_arrays, self._params, self._buffers)
         else:
-            out = self._exported.call(feed_arrays, self._params)
+            out = self._call(feed_arrays, self._params)
         return [np.asarray(o) for o in out]
 
     def __call__(self, *feeds):

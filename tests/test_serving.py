@@ -207,3 +207,188 @@ class TestPagedDecodePallas:
         dec = PagedLlamaDecoder(model, num_blocks=64, block_size=8)
         out = dec.generate(ids, max_new_tokens=8)
         assert (ref == out).mean() >= 0.95
+
+
+class TestServingEngine:
+    """Continuous-batching engine (VERDICT r2 #1): mixed-length
+    concurrent requests over the paged pool, fp and int8."""
+
+    def setup_method(self):
+        paddle.seed(0)
+        self.cfg = llama_tiny()
+        self.model = LlamaForCausalLM(self.cfg)
+        self.model.eval()
+        self.rng = np.random.RandomState(42)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32))
+        return ServingEngine(self.model, **kw)
+
+    def _prompts(self):
+        from paddle_tpu.inference import SamplingParams
+        lens = [5, 12, 20, 9, 16]
+        news = [6, 4, 8, 5, 3]
+        return [(self.rng.randint(0, self.cfg.vocab_size, (l,))
+                 .astype(np.int32), SamplingParams(max_new_tokens=m))
+                for l, m in zip(lens, news)]
+
+    def test_concurrent_matches_solo(self):
+        reqs = self._prompts()
+        eng = self._engine()
+        ids = [eng.add_request(p, s) for p, s in reqs]
+        got = eng.run_to_completion()
+        assert set(got) == set(ids)
+        # oracle: same engine shape, one request at a time — scheduling
+        # must not change greedy results
+        solo = self._engine()
+        for rid, (p, s) in zip(ids, reqs):
+            srid = solo.add_request(p, s)
+            while solo.step():
+                pass
+            np.testing.assert_array_equal(got[rid], solo.result(srid))
+        for rid, (_, s) in zip(ids, reqs):
+            assert len(got[rid]) == s.max_new_tokens
+
+    def test_staggered_arrivals(self):
+        from paddle_tpu.inference import SamplingParams
+        reqs = self._prompts()
+        eng = self._engine()
+        first = [eng.add_request(*reqs[i]) for i in range(2)]
+        for _ in range(3):
+            eng.step()
+        late = [eng.add_request(*reqs[i]) for i in range(2, 5)]
+        got = eng.run_to_completion()
+        assert set(got) == set(first + late)
+        solo = self._engine()
+        for rid, (p, s) in zip(first + late, reqs):
+            srid = solo.add_request(p, s)
+            while solo.step():
+                pass
+            np.testing.assert_array_equal(got[rid], solo.result(srid))
+
+    def test_eos_frees_slot_and_admits_queue(self):
+        from paddle_tpu.inference import SamplingParams
+        p0, _ = self._prompts()[0]
+        eng = self._engine(max_batch_size=1)
+        # find the first generated token, then use it as eos for a rerun
+        rid = eng.add_request(p0, SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        eos = int(eng.result(rid)[0])
+        eng2 = self._engine(max_batch_size=1)
+        a = eng2.add_request(p0, SamplingParams(max_new_tokens=10,
+                                                eos_token_id=eos))
+        b = eng2.add_request(p0, SamplingParams(max_new_tokens=3))
+        eng2.run_to_completion()
+        assert eng2.result(a).tolist() == [eos]  # stopped at first token
+        assert len(eng2.result(b)) == 3          # queued req still served
+        req = eng2.request(a)
+        assert req.latency_s is not None and req.ttft_s is not None
+
+    def test_int8_engine(self):
+        from paddle_tpu.inference import SamplingParams
+        from paddle_tpu.inference.paged_decode import _quantize_w
+        # per-channel int8 roundtrip error is small on real weights
+        w = self.model.model.layers[0].self_attn.q_proj.weight._value
+        wi, sc = _quantize_w(w)
+        err = np.abs(np.asarray(wi, np.float32) * np.asarray(sc)[None]
+                     - np.asarray(w, np.float32))
+        assert err.max() <= np.abs(np.asarray(w)).max() / 127.0 + 1e-6
+        eng = self._engine(weight_dtype="int8")
+        # int8 weights actually stored as int8
+        wq = eng.dec.weights["layers"][0]["wq"]
+        assert isinstance(wq, tuple) and wq[0].dtype == jnp.int8
+        p, _ = self._prompts()[0]
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=6))
+        got = eng.run_to_completion()
+        assert len(got[rid]) == 6
+        assert (got[rid] >= 0).all() and (got[rid] < self.cfg.vocab_size).all()
+
+    def test_add_request_validation(self):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine()
+        with pytest.raises(ValueError, match="bucket"):
+            eng.add_request(np.zeros(100, np.int32))
+        with pytest.raises(ValueError, match="pages"):
+            eng.add_request(np.zeros(8, np.int32),
+                            SamplingParams(max_new_tokens=10000))
+        with pytest.raises(ValueError, match="empty"):
+            eng.add_request(np.zeros(0, np.int32))
+
+    def test_capacity_deferral(self):
+        """Pool smaller than the sum of requests: admission defers but
+        everything completes (slots/pages recycled)."""
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(num_blocks=12, max_batch_size=2)
+        reqs = self._prompts()[:4]
+        ids = [eng.add_request(p, s) for p, s in reqs]
+        got = eng.run_to_completion()
+        for rid, (_, s) in zip(ids, reqs):
+            assert len(got[rid]) == s.max_new_tokens
+        # all pages returned (only the scratch page stays reserved)
+        assert eng.dec.cache.free_blocks == 12 - 1
+
+    def test_stats_fields(self):
+        eng = self._engine()
+        for p, s in self._prompts()[:3]:
+            eng.add_request(p, s)
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["finished"] == 3
+        assert st["generated_tokens"] > 0
+        assert st["latency_p50_s"] > 0 and st["latency_p99_s"] > 0
+        assert st["ttft_p50_s"] > 0
+
+
+class TestConfigKnobs:
+    def test_switch_ir_optim_warns(self):
+        c = inference.Config("x")
+        with pytest.warns(UserWarning, match="no effect"):
+            c.switch_ir_optim(False)
+
+    def test_int8_precision_rejected_for_fp_artifact(self):
+        c = inference.Config("x")
+        with pytest.raises(ValueError, match="int8"):
+            c.set_precision(inference.PrecisionType.Int8)
+
+    def test_memory_optim_donation(self, tmp_path):
+        paddle.enable_static()
+        from paddle_tpu.static import program as prog_mod
+        prog_mod._state.main = prog_mod.Program()
+        from paddle_tpu import static
+        x = static.data("x", [2, 6], "float32")
+        out = nn.functional.relu(nn.Linear(6, 3)(x))
+        prefix = str(tmp_path / "m" / "model")
+        static.save_inference_model(prefix, [x], [out])
+        paddle.disable_static()
+        for optim in (True, False):
+            c = inference.Config(prefix)
+            c.enable_memory_optim(optim)
+            pred = inference.create_predictor(c)
+            outs = pred.run([np.ones((2, 6), np.float32)])
+            assert outs[0].shape == (2, 3)
+
+
+def test_serving_chunk_invariance():
+    """Greedy results must not depend on decode chunk size (chunking is
+    a dispatch-amortization detail, not a semantics change)."""
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    rng = np.random.RandomState(7)
+    prompts = [rng.randint(0, 512, (l,)).astype(np.int32)
+               for l in (5, 11, 17)]
+    outs = []
+    for chunk in (1, 4, 16):
+        eng = ServingEngine(model, max_batch_size=2, num_blocks=64,
+                            block_size=8, prompt_buckets=(32,),
+                            chunk_size=chunk)
+        ids = [eng.add_request(p, SamplingParams(max_new_tokens=7))
+               for p in prompts]
+        got = eng.run_to_completion()
+        outs.append([got[i].tolist() for i in ids])
+    assert outs[0] == outs[1] == outs[2]
