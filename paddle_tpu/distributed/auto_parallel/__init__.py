@@ -107,8 +107,13 @@ class Engine:
                         return loss(out, *labels)
                     raise TypeError(f"unsupported loss {loss!r}")
 
+                inner = getattr(self.strategy, "_inner", self.strategy)
+                gm_k, gm_avg = (inner.gradient_merge_k()
+                                if hasattr(inner, "gradient_merge_k")
+                                else (1, True))
                 self._train_step = paddle.jit.TrainStep(
-                    self.model, loss_fn, self.optimizer)
+                    self.model, loss_fn, self.optimizer,
+                    gradient_merge=gm_k, gradient_merge_avg=gm_avg)
         else:
             if self._fwd_fn is None:
                 self._fwd_fn = paddle.jit.to_static(self.model)

@@ -1,9 +1,15 @@
 """DistributedStrategy (parity:
 /root/reference/python/paddle/distributed/fleet/base/distributed_strategy.py
 :1808 hybrid_configs — the protobuf-backed config becomes a plain typed
-dict with the same keys)."""
+dict with the same keys).
+
+Every knob is either consumed by a code path, or rejected/warned at set
+time — silent no-op configs are a bug class this file exists to prevent
+(see tests/test_distributed.py strategy-consumption test).
+"""
 from __future__ import annotations
 
+import warnings
 from typing import Any, Dict
 
 __all__ = ["DistributedStrategy"]
@@ -47,8 +53,65 @@ _DEFAULT_PIPELINE = {
     "vpp_degree": 1,
 }
 
+_DEFAULT_GRADIENT_MERGE = {
+    "k_steps": 1,
+    "avg": True,
+}
+
 
 class DistributedStrategy:
+    """Where each knob is consumed (the 'consumed or rejected' registry —
+    audited by tests):
+
+    - hybrid_configs     → fleet.init (HybridCommunicateGroup mesh axes)
+    - amp / amp_configs  → sharding_recipes.apply_hybrid_shardings
+    - sharding(+configs) → sharding_recipes (ZeRO stage placements)
+    - recompute(+configs)→ sharding_recipes (jax.checkpoint policy)
+    - pipeline(+configs) → fleet.pipeline / pp_schedule tables
+    - gradient_merge(+configs) → auto.Engine → jit.TrainStep k-step
+      accumulation (f32 accumulators, optimizer applied every k)
+    - find_unused_parameters, fuse_grad_size_in_MB → meaningless under
+      whole-program jit (grads are always computed in-program and fused
+      by XLA); setting a non-default value WARNS instead of silently
+      doing nothing.
+    """
+
+    # config-dict attr → allowed keys (assignment merges into defaults;
+    # unknown keys are rejected loudly)
+    _CONFIG_KEYS = {
+        "hybrid_configs": set(_DEFAULT_HYBRID),
+        "amp_configs": set(_DEFAULT_AMP),
+        "sharding_configs": set(_DEFAULT_SHARDING),
+        "recompute_configs": set(_DEFAULT_RECOMPUTE),
+        "pipeline_configs": set(_DEFAULT_PIPELINE),
+        "gradient_merge_configs": set(_DEFAULT_GRADIENT_MERGE),
+    }
+    # knobs that cannot do anything under whole-program jit: warn, don't
+    # silently accept (value = the inert default)
+    _NOOP_KNOBS = {
+        "find_unused_parameters": False,
+        "fuse_grad_size_in_MB": 32,
+    }
+    # knobs that EXIST in the reference DistributedStrategy but are
+    # consciously inert here (descoped/irrelevant on TPU — see
+    # COVERAGE.md): accepted with a warning so reference-ported code
+    # runs, while typos still raise. Distinct from _NOOP_KNOBS only in
+    # not being pre-initialized attributes.
+    _REFERENCE_INERT_KNOBS = frozenset({
+        "a_sync", "a_sync_configs",               # parameter-server mode
+        "without_graph_optimization",             # XLA always optimizes
+        "heter_ccl_mode", "is_fl_ps_mode",        # heterogeneous PS
+        "localsgd", "localsgd_configs",           # see COVERAGE.md
+        "adaptive_localsgd", "adaptive_localsgd_configs",
+        "dgc", "dgc_configs",                     # grad compression
+        "lars", "lars_configs", "lamb", "lamb_configs",
+        "fp16_allreduce", "sync_nccl_allreduce",  # NCCL-specific
+        "nccl_comm_num", "use_hierarchical_allreduce",
+        "sync_batch_norm", "cudnn_exhaustive_search",
+        "cudnn_batchnorm_spatial_persistent", "conv_workspace_size_limit",
+        "auto", "semi_auto", "auto_search", "qat", "qat_configs",
+    })
+
     def __init__(self):
         self.hybrid_configs: Dict[str, Any] = dict(_DEFAULT_HYBRID)
         self.amp = False
@@ -60,20 +123,56 @@ class DistributedStrategy:
         self.pipeline = False
         self.pipeline_configs: Dict[str, Any] = dict(_DEFAULT_PIPELINE)
         self.gradient_merge = False
-        self.gradient_merge_configs = {"k_steps": 1, "avg": True}
+        self.gradient_merge_configs = dict(_DEFAULT_GRADIENT_MERGE)
         self.find_unused_parameters = False
         self.fuse_grad_size_in_MB = 32
+        object.__setattr__(self, "_sealed", True)
 
     def __setattr__(self, name, value):
-        if name == "hybrid_configs" and isinstance(value, dict) and \
-                hasattr(self, "hybrid_configs"):
-            merged = dict(self.hybrid_configs)
-            merged.update(value)
-            object.__setattr__(self, name, merged)
-        else:
-            object.__setattr__(self, name, value)
+        if getattr(self, "_sealed", False) and name not in self.__dict__:
+            if name in self._REFERENCE_INERT_KNOBS:
+                warnings.warn(
+                    f"DistributedStrategy.{name} exists in the reference "
+                    "API but is inert on TPU (descoped or subsumed by "
+                    "XLA — see COVERAGE.md); the value is stored and "
+                    "ignored.", stacklevel=2)
+                object.__setattr__(self, name, value)
+                return
+            raise AttributeError(
+                f"DistributedStrategy has no knob {name!r} — unknown "
+                "names are rejected so a typo can't become a silent "
+                f"no-op. Known knobs: "
+                f"{sorted(k for k in self.__dict__ if not k.startswith('_'))}")
+        if name in self._CONFIG_KEYS and isinstance(value, dict):
+            known = self._CONFIG_KEYS[name]
+            unknown = set(value) - known
+            if unknown:
+                raise ValueError(
+                    f"unknown {name} key(s) {sorted(unknown)}; "
+                    f"known: {sorted(known)}")
+            if hasattr(self, name):
+                merged = dict(getattr(self, name))
+                merged.update(value)
+                value = merged
+        if name in self._NOOP_KNOBS and getattr(self, "_sealed", False) \
+                and value != self._NOOP_KNOBS[name]:
+            warnings.warn(
+                f"DistributedStrategy.{name} has no effect on TPU: "
+                "gradients are computed in-program under jit and fused "
+                "by XLA, so there is no reducer to configure.",
+                stacklevel=2)
+        object.__setattr__(self, name, value)
+
+    def gradient_merge_k(self):
+        """(k_steps, avg) if gradient merge is enabled, else (1, True).
+        The consumer seam for auto.Engine / TrainStep."""
+        if not self.gradient_merge:
+            return 1, True
+        cfg = self.gradient_merge_configs
+        return int(cfg.get("k_steps", 1)), bool(cfg.get("avg", True))
 
     def __repr__(self):
         return (f"DistributedStrategy(hybrid={self.hybrid_configs}, "
                 f"amp={self.amp}, sharding={self.sharding}, "
-                f"recompute={self.recompute}, pipeline={self.pipeline})")
+                f"recompute={self.recompute}, pipeline={self.pipeline}, "
+                f"gradient_merge={self.gradient_merge})")

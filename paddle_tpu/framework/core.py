@@ -356,7 +356,8 @@ class Tensor:
 
     __slots__ = ("_value", "stop_gradient", "grad", "_node", "_out_idx",
                  "name", "persistable", "trainable", "is_leaf_",
-                 "process_mesh", "placements", "_opt_state_placements")
+                 "process_mesh", "placements", "_opt_state_placements",
+                 "__weakref__")
 
     def __init__(self, value, stop_gradient: bool = True, name: str = ""):
         if _mutation_watch is not None:
@@ -489,6 +490,11 @@ class Tensor:
         Breaks no autograd invariants because leaves have no recorded node."""
         if _mutation_watch is not None:
             _mutation_watch[0][id(self)] = self
+        # partial-capture placeholders unwrap to their concrete array
+        # once materialized (jit/partial_capture._SymValue)
+        unwrap = getattr(new_value, "_pt_unwrap", None)
+        if unwrap is not None:
+            new_value = unwrap()
         self._value = new_value
 
     def set_value(self, value):
@@ -593,6 +599,18 @@ def _set_static_handler(fn):
     _static_handler = fn
 
 
+# Partial-graph capture handler (jit/partial_capture.py — the SOT analog:
+# /root/reference/python/paddle/jit/sot/opcode_translator/executor/
+# opcode_executor.py). Receives (op_name, fn, args, kwargs, diff);
+# NotImplemented defers to the normal eager path.
+_capture_handler: Optional[Callable] = None
+
+
+def _set_capture_handler(fn):
+    global _capture_handler
+    _capture_handler = fn
+
+
 # Numerics-checker + op-stats hooks (installed by paddle_tpu.amp.debugging
 # — the FLAGS_check_nan_inf / op-stats analog of the reference's
 # paddle/fluid/eager/nan_inf_utils.h). Both receive (op_name, out_arrays).
@@ -622,6 +640,10 @@ def apply(op_name: str, fn: Callable, *args: Any, **kwargs: Any):
     """
     if _static_handler is not None:
         out = _static_handler(op_name, fn, args, kwargs)
+        if out is not NotImplemented:
+            return out
+    if _capture_handler is not None:
+        out = _capture_handler(op_name, fn, args, kwargs, True)
         if out is not NotImplemented:
             return out
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]
@@ -678,6 +700,10 @@ def apply_nodiff(op_name: str, fn: Callable, *args, **kwargs):
     """Dispatch for non-differentiable ops (argmax, comparisons, ...)."""
     if _static_handler is not None:
         out = _static_handler(op_name, fn, args, kwargs)
+        if out is not NotImplemented:
+            return out
+    if _capture_handler is not None:
+        out = _capture_handler(op_name, fn, args, kwargs, False)
         if out is not NotImplemented:
             return out
     tensor_pos = [i for i, a in enumerate(args) if isinstance(a, Tensor)]

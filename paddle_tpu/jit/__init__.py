@@ -169,6 +169,7 @@ class StaticFunction:
         self._input_spec = input_spec
         self._full_graph = full_graph
         self._eager_fallback = False
+        self._partial = None        # PartialProgram after a graph break
         self.retrace_count = 0
         self.trace_signatures = []
 
@@ -232,6 +233,8 @@ class StaticFunction:
         return jax.jit(pure, static_argnums=(3,))
 
     def __call__(self, *args, **kwargs):
+        if self._partial is not None:
+            return self._partial(*args, **kwargs)
         if self._eager_fallback:
             return self._call_eager(args, kwargs)
         if self._compiled is None:
@@ -243,16 +246,42 @@ class StaticFunction:
             if wrapped is None:
                 raise
             if not self._full_graph:
-                # graph-break fallback (SOT parity): run eagerly, warn once
-                import warnings
-                warnings.warn(
-                    f"to_static({self._name()}): tracing failed "
-                    f"({type(e).__name__}); falling back to EAGER "
-                    f"execution (full_graph=False). The function will not "
-                    f"be compiled.", RuntimeWarning)
-                self._eager_fallback = True
-                return self._call_eager(args, kwargs)
+                # graph break (SOT parity, reference jit/sot/translate.py):
+                # compile the traceable segments, run the breaking
+                # constructs eagerly between them
+                return self._enter_partial(e, args, kwargs)
             raise wrapped from e
+
+    def _enter_partial(self, cause, args, kwargs):
+        import warnings
+        from .partial_capture import PartialProgram
+        target = (self._layer if self._layer is not None else self._fn)
+        self._partial = PartialProgram(target, name=self._name())
+        try:
+            out = self._partial(*args, **kwargs)
+        except Exception:
+            # Do NOT re-run eagerly: segments already executed with real
+            # side effects (buffer updates, RNG draws) — a rerun would
+            # double-apply them. Propagate; the next call retries
+            # (whole-graph first, then partial) from clean state.
+            self._partial = None
+            raise
+        warnings.warn(
+            f"to_static({self._name()}): whole-graph tracing failed "
+            f"({type(cause).__name__}); switched to partial-graph "
+            f"capture — {self._partial.num_subgraphs} compiled "
+            f"subgraph(s), {self._partial.graph_break_count} graph "
+            f"break(s) on the first call.", RuntimeWarning)
+        return out
+
+    # partial-capture telemetry (SOT parity surface)
+    @property
+    def graph_break_count(self):
+        return self._partial.graph_break_count if self._partial else 0
+
+    @property
+    def num_subgraphs(self):
+        return self._partial.num_subgraphs if self._partial else 0
 
     def _call_eager(self, args, kwargs):
         target = self._layer if self._layer is not None else self._fn
@@ -387,7 +416,8 @@ class TrainStep:
     """
 
     def __init__(self, model, loss_fn: Callable, optimizer,
-                 donate: bool = True, mesh=None, in_shardings=None):
+                 donate: bool = True, mesh=None, in_shardings=None,
+                 gradient_merge: int = 1, gradient_merge_avg: bool = True):
         self.model = model
         self.loss_fn = loss_fn
         self.optimizer = optimizer
@@ -404,17 +434,30 @@ class TrainStep:
         self._compiled = None
         self._donate = donate
         self._step_i = 0
+        # gradient merge (k-step accumulation; parity:
+        # /root/reference/python/paddle/distributed/fleet/meta_optimizers/
+        # gradient_merge_optimizer.py:21): accumulate k micro-step grads
+        # in f32, apply the optimizer every k-th call
+        self._gm_k = int(gradient_merge)
+        if self._gm_k < 1:
+            raise ValueError(f"gradient_merge must be >= 1, got {gradient_merge}")
+        self._gm_avg = bool(gradient_merge_avg)
+        self._gm_accum = None
+        self._gm_compiled = None
 
-    def _build(self):
+    def _make_loss_and_grads(self):
+        """Closure computing (loss, new_buffers, per-param grads) — the
+        shared forward+backward of both the plain and gradient-merge
+        compiled programs."""
         model = self.model
         loss_fn = self.loss_fn
-        optimizer = self.optimizer
         trainable_mask = self._trainable_mask
 
-        def step(param_arrays, buffer_arrays, opt_state, lr, key, inputs,
-                 labels):
-            train_params = [a for a, m in zip(param_arrays, trainable_mask) if m]
-            frozen = [a for a, m in zip(param_arrays, trainable_mask) if not m]
+        def loss_and_grads(param_arrays, buffer_arrays, key, inputs, labels):
+            train_params = [a for a, m in zip(param_arrays, trainable_mask)
+                            if m]
+            frozen = [a for a, m in zip(param_arrays, trainable_mask)
+                      if not m]
 
             def loss_f(tp):
                 it_t, it_f = iter(tp), iter(frozen)
@@ -431,11 +474,19 @@ class TrainStep:
 
             (loss, new_bufs), grads = jax.value_and_grad(
                 loss_f, has_aux=True)(train_params)
-
             # re-expand grads to the full param list (None for frozen)
             gi = iter(grads)
             full_grads = [next(gi) if m else None for m in trainable_mask]
-            opt_params = [p._value for p in optimizer._parameter_list]
+            return loss, new_bufs, full_grads
+
+        return loss_and_grads
+
+    def _make_opt_update(self):
+        """Closure applying the optimizer to full-per-param grads and
+        pinning output placements (shared by both compiled programs)."""
+        optimizer = self.optimizer
+
+        def opt_update(param_arrays, full_grads, opt_state, lr):
             # align: optimizer params are a subset (usually ==) of model params
             id2idx = {id(p): i for i, p in enumerate(self._p_tensors)}
             opt_grads = [full_grads[id2idx[id(p)]] if id(p) in id2idx else None
@@ -484,10 +535,81 @@ class TrainStep:
                     f"({len(opt_shardings)}); the sharded optimizer-state "
                     "placement pin cannot be applied. Keep the state "
                     "structure stable across steps.")
+            return new_params, new_opt_state
+
+        return opt_update
+
+    def _build(self):
+        loss_and_grads = self._make_loss_and_grads()
+        opt_update = self._make_opt_update()
+
+        def step(param_arrays, buffer_arrays, opt_state, lr, key, inputs,
+                 labels):
+            loss, new_bufs, full_grads = loss_and_grads(
+                param_arrays, buffer_arrays, key, inputs, labels)
+            new_params, new_opt_state = opt_update(
+                param_arrays, full_grads, opt_state, lr)
             return loss, new_params, new_bufs, new_opt_state
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
+
+    def _build_gm(self):
+        """Two compiled programs for gradient merge — an accumulate-only
+        micro-step and an apply step — selected host-side by
+        step_i % k (compile-static: no lax.cond over the optimizer)."""
+        loss_and_grads = self._make_loss_and_grads()
+        opt_update = self._make_opt_update()
+        k, avg = self._gm_k, self._gm_avg
+        mask = self._trainable_mask
+
+        def accum_step(param_arrays, buffer_arrays, accum, key, inputs,
+                       labels):
+            loss, new_bufs, full_grads = loss_and_grads(
+                param_arrays, buffer_arrays, key, inputs, labels)
+            tg = [g for g, m in zip(full_grads, mask) if m]
+            new_accum = [a + g.astype(jnp.float32)
+                         for a, g in zip(accum, tg)]
+            return loss, new_bufs, new_accum
+
+        def apply_step(param_arrays, buffer_arrays, opt_state, lr, accum,
+                       key, inputs, labels):
+            loss, new_bufs, full_grads = loss_and_grads(
+                param_arrays, buffer_arrays, key, inputs, labels)
+            it = iter(accum)
+            merged = []
+            for g, m in zip(full_grads, mask):
+                if not m:
+                    merged.append(None)
+                    continue
+                tot = next(it) + g.astype(jnp.float32)
+                if avg:
+                    tot = tot / k
+                # back to the native grad dtype so the optimizer update
+                # behaves exactly like a plain step (keeps param dtype
+                # stable for donation)
+                merged.append(tot.astype(g.dtype))
+            new_params, new_opt_state = opt_update(
+                param_arrays, merged, opt_state, lr)
+            zero_accum = [jnp.zeros_like(a) for a in accum]
+            return loss, new_params, new_bufs, new_opt_state, zero_accum
+
+        da = (2,) if self._donate else ()
+        db = (0, 2, 4) if self._donate else ()
+        return (jax.jit(accum_step, donate_argnums=da),
+                jax.jit(apply_step, donate_argnums=db))
+
+    def _init_gm_accum(self):
+        out = []
+        for p, m in zip(self._p_tensors, self._trainable_mask):
+            if not m:
+                continue
+            z = jnp.zeros(p._value.shape, jnp.float32)
+            s = getattr(p._value, "sharding", None)
+            if isinstance(s, jax.sharding.NamedSharding):
+                z = jax.device_put(z, s)
+            out.append(z)
+        return out
 
     def _param_shardings(self):
         out = []
@@ -510,8 +632,12 @@ class TrainStep:
     def __call__(self, inputs, labels):
         """inputs / labels: a Tensor or tuple of Tensors. Model is called as
         model(*inputs); loss as loss_fn(model_out, *labels)."""
-        if self._compiled is None:
-            self._compiled = self._build()
+        first = self._compiled is None and self._gm_compiled is None
+        if first:
+            if self._gm_k > 1:
+                self._gm_compiled = self._build_gm()
+            else:
+                self._compiled = self._build()
             import os as _os
             from ..utils.flags import FLAGS
             if getattr(FLAGS, "enable_watchdog", None) or \
@@ -537,19 +663,52 @@ class TrainStep:
 
         in_arrays = _unwrap_batch(inputs)
         label_arrays = _unwrap_batch(labels)
-        loss, new_params, new_bufs, new_state = self._compiled(
-            p_arrays, b_arrays, self.optimizer._state, lr, key, in_arrays,
-            label_arrays)
+        if self._gm_k > 1:
+            loss = self._call_gm(p_arrays, b_arrays, lr, key, in_arrays,
+                                 label_arrays)
+        else:
+            loss, new_params, new_bufs, new_state = self._compiled(
+                p_arrays, b_arrays, self.optimizer._state, lr, key,
+                in_arrays, label_arrays)
+            for p, a in zip(self._p_tensors, new_params):
+                p._replace(a)
+            for b, a in zip(self._b_tensors, new_bufs):
+                b._replace(a)
+            self.optimizer._state = new_state
+            self.optimizer._step_count += 1
+        self._step_i += 1
+        from ..distributed.watchdog import notify_step
+        notify_step(self._step_i)
+        return Tensor(loss)
+
+    def _call_gm(self, p_arrays, b_arrays, lr, key, in_arrays,
+                 label_arrays):
+        """One gradient-merge micro-step: accumulate, or (every k-th
+        call) merge + optimizer apply. The optimizer steps — and its
+        step count / LR schedule advance — only on apply."""
+        accum_fn, apply_fn = self._gm_compiled
+        if self._gm_accum is None:
+            self._gm_accum = self._init_gm_accum()
+        is_apply = (self._step_i + 1) % self._gm_k == 0
+        if not is_apply:
+            loss, new_bufs, new_accum = accum_fn(
+                p_arrays, b_arrays, self._gm_accum, key, in_arrays,
+                label_arrays)
+            for b, a in zip(self._b_tensors, new_bufs):
+                b._replace(a)
+            self._gm_accum = new_accum
+            return loss
+        loss, new_params, new_bufs, new_state, new_accum = apply_fn(
+            p_arrays, b_arrays, self.optimizer._state, lr,
+            self._gm_accum, key, in_arrays, label_arrays)
         for p, a in zip(self._p_tensors, new_params):
             p._replace(a)
         for b, a in zip(self._b_tensors, new_bufs):
             b._replace(a)
         self.optimizer._state = new_state
         self.optimizer._step_count += 1
-        self._step_i += 1
-        from ..distributed.watchdog import notify_step
-        notify_step(self._step_i)
-        return Tensor(loss)
+        self._gm_accum = new_accum
+        return loss
 
 
 # ---------------------------------------------------------------------------

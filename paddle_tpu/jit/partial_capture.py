@@ -1,0 +1,546 @@
+"""Partial-graph capture — the SOT analog (reference:
+/root/reference/python/paddle/jit/sot/opcode_translator/executor/
+opcode_executor.py; frame hook paddle/fluid/pybind/eval_frame.c used at
+python/paddle/jit/sot/translate.py:99).
+
+The reference simulates CPython bytecode to compile traceable subgraphs
+and falls back to eager at graph breaks. The TPU-native equivalent needs
+no frame hook: every op already flows through the framework's apply()
+dispatch seam, so capture is a *lazy segment recorder* installed there:
+
+- ops append nodes to an open segment; their outputs are Tensors whose
+  values are symbolic placeholders (shape/dtype from jax.eval_shape —
+  nothing executes);
+- when Python demands a concrete value (bool() of a comparison, .item(),
+  int()/float()/np conversion — exactly the constructs that kill whole-
+  graph tracing), the open segment is CLOSED: compiled with jax.jit,
+  executed through the normal taped apply() path (so autograd sees one
+  node per segment, like whole-graph to_static), and the concrete arrays
+  are grafted back into the placeholder Tensors. That is a *graph
+  break*: the data-dependent Python code then runs eagerly on concrete
+  values, and the next op opens a fresh segment;
+- re-running the Python function each call replays control flow with
+  fresh break values — the guard mechanism is the Python interpreter
+  itself. Compiled segments are cached by an op-sequence signature
+  (op names, shapes/dtypes, fingerprinted constants incl. closure
+  cells); a signature miss recompiles, exactly like a SOT guard miss.
+  Constants that cannot be fingerprinted (e.g. large captured arrays)
+  make a segment uncacheable — it still runs correctly, just without
+  the jit cache.
+"""
+from __future__ import annotations
+
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import (
+    Tensor, apply, _set_capture_handler,
+)
+from ..framework import core as _core
+
+__all__ = ["PartialProgram", "GraphBreak"]
+
+
+# ---------------------------------------------------------------------------
+# symbolic placeholder value
+# ---------------------------------------------------------------------------
+
+class _SymValue:
+    """Stands in for Tensor._value inside an open segment. Carries only
+    shape/dtype; any demand for the real array closes the segment (a
+    graph break) and returns the concrete result."""
+
+    __slots__ = ("_ctx", "aval", "_concrete", "__weakref__")
+
+    def __init__(self, ctx, aval):
+        object.__setattr__(self, "_ctx", ctx)
+        object.__setattr__(self, "aval", aval)
+        object.__setattr__(self, "_concrete", None)
+
+    # cheap structural queries — no break
+    @property
+    def shape(self):
+        return self.aval.shape
+
+    @property
+    def dtype(self):
+        return self.aval.dtype
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        return int(np.prod(self.aval.shape)) if self.aval.shape else 1
+
+    def _force(self):
+        if self._concrete is None:
+            self._ctx._materialize("concrete value demanded")
+        if self._concrete is None:  # pragma: no cover — invariant
+            raise RuntimeError("partial capture: materialization failed "
+                               "to produce a value")
+        return self._concrete
+
+    def _pt_unwrap(self):
+        """Transparent unwrap for code that stored this placeholder."""
+        return self._concrete if self._concrete is not None else self
+
+    # concretization points = graph breaks
+    def __bool__(self):
+        return bool(self._force())
+
+    def __int__(self):
+        return int(self._force())
+
+    def __float__(self):
+        return float(self._force())
+
+    def __index__(self):
+        return int(self._force())
+
+    def __len__(self):
+        if not self.aval.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self.aval.shape[0]
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._force())
+        return a.astype(dtype) if dtype is not None else a
+
+    def __jax_array__(self):
+        # direct jnp use of a symbolic value (an op that bypasses the
+        # apply seam) breaks the graph rather than erroring
+        return jnp.asarray(self._force())
+
+    def __getattr__(self, name):
+        # anything beyond shape/dtype metadata (item, tolist, devices,
+        # sharding, ...) needs the real array
+        return getattr(self._force(), name)
+
+    # raw-array arithmetic on a placeholder (framework internals that
+    # compute on ._value directly, e.g. BatchNorm running stats): break
+    # and compute on the concrete array
+    def __add__(self, o):
+        return self._force() + o
+
+    def __radd__(self, o):
+        return o + self._force()
+
+    def __sub__(self, o):
+        return self._force() - o
+
+    def __rsub__(self, o):
+        return o - self._force()
+
+    def __mul__(self, o):
+        return self._force() * o
+
+    def __rmul__(self, o):
+        return o * self._force()
+
+    def __truediv__(self, o):
+        return self._force() / o
+
+    def __rtruediv__(self, o):
+        return o / self._force()
+
+    def __matmul__(self, o):
+        return self._force() @ o
+
+    def __rmatmul__(self, o):
+        return o @ self._force()
+
+    def __neg__(self):
+        return -self._force()
+
+    def __pow__(self, o):
+        return self._force() ** o
+
+    def __getitem__(self, idx):
+        return self._force()[idx]
+
+    def __lt__(self, o):
+        return self._force() < o
+
+    def __le__(self, o):
+        return self._force() <= o
+
+    def __gt__(self, o):
+        return self._force() > o
+
+    def __ge__(self, o):
+        return self._force() >= o
+
+    def __repr__(self):
+        state = "materialized" if self._concrete is not None else "open"
+        return f"_SymValue(shape={self.aval.shape}, " \
+               f"dtype={self.aval.dtype}, {state})"
+
+
+class GraphBreak:
+    """Telemetry record for one break."""
+
+    def __init__(self, reason: str, n_ops: int):
+        self.reason = reason
+        self.n_ops = n_ops
+
+    def __repr__(self):
+        return f"GraphBreak({self.reason!r}, ops={self.n_ops})"
+
+
+# ---------------------------------------------------------------------------
+# constant fingerprinting (the guard condition for segment cache reuse)
+# ---------------------------------------------------------------------------
+
+_MAX_CONST_ELEMS = 64
+
+
+def _fp_const(c) -> Optional[tuple]:
+    """Hashable fingerprint of a captured constant, or None if the
+    constant cannot be fingerprinted (→ segment uncacheable)."""
+    if c is None or isinstance(c, (bool, int, float, str, bytes)):
+        return ("py", c)
+    if isinstance(c, (np.dtype, type)):
+        return ("ty", str(c))
+    if isinstance(c, np.generic):
+        return ("np0", c.dtype.str, c.item())
+    if isinstance(c, (np.ndarray, jnp.ndarray, jax.Array)):
+        try:
+            if c.size <= _MAX_CONST_ELEMS:
+                return ("arr", str(c.dtype), tuple(c.shape),
+                        np.asarray(c).tobytes())
+        except Exception:
+            return None
+        return None
+    if isinstance(c, (tuple, list)):
+        parts = tuple(_fp_const(e) for e in c)
+        if any(p is None for p in parts):
+            return None
+        return ("seq", type(c).__name__, parts)
+    if isinstance(c, dict):
+        try:
+            items = sorted(c.items())
+        except TypeError:
+            return None
+        parts = tuple((k, _fp_const(v)) for k, v in items)
+        if any(p[1] is None for p in parts):
+            return None
+        return ("map", parts)
+    if callable(c):
+        return _fp_fn(c)
+    return None
+
+
+def _fp_fn(fn) -> Optional[tuple]:
+    """Fingerprint a function by code identity + captured cells (two
+    lambdas from the same source line with equal captures fingerprint
+    equal — that is the point: per-call closures must hit the cache)."""
+    import functools
+    if isinstance(fn, functools.partial):
+        parts = (_fp_fn(fn.func), _fp_const(fn.args),
+                 _fp_const(fn.keywords))
+        if any(p is None for p in parts):
+            return None
+        return ("partial",) + parts
+    bound = getattr(fn, "__self__", None)
+    if bound is not None and hasattr(fn, "__func__"):
+        inner = _fp_fn(fn.__func__)
+        if inner is None:
+            return None
+        return ("method", inner, id(bound))
+    code = getattr(fn, "__code__", None)
+    if code is None:
+        # module-level callables without code objects (jax custom_jvp /
+        # custom_vjp wrappers, builtins, callable classes): long-lived
+        # stateless objects — identity is a sound fingerprint. Transient
+        # lambdas always have __code__ and never take this path.
+        return ("objid", id(fn), type(fn).__name__)
+    cells = []
+    for cell in (fn.__closure__ or ()):
+        try:
+            fp = _fp_const(cell.cell_contents)
+        except ValueError:  # empty cell
+            fp = ("empty",)
+        if fp is None:
+            return None
+        cells.append(fp)
+    defaults = tuple(_fp_const(d) for d in (fn.__defaults__ or ()))
+    if any(d is None for d in defaults):
+        return None
+    return ("fn", code.co_filename, code.co_firstlineno,
+            hash(code.co_code), tuple(cells), defaults)
+
+
+# ---------------------------------------------------------------------------
+# segment recorder
+# ---------------------------------------------------------------------------
+
+class _Node:
+    __slots__ = ("op_name", "fn", "arg_specs", "kwargs", "out_syms",
+                 "multi")
+
+    def __init__(self, op_name, fn, arg_specs, kwargs, out_syms, multi):
+        self.op_name = op_name
+        self.fn = fn
+        # arg_specs: ("sym", _SymValue) | ("in", input_index) | ("const", value)
+        self.arg_specs = arg_specs
+        self.kwargs = kwargs
+        self.out_syms = out_syms
+        self.multi = multi
+
+
+class _CaptureContext:
+    def __init__(self, owner: "PartialProgram"):
+        self.owner = owner
+        self.nodes: List[_Node] = []
+        self.inputs: List[Tensor] = []       # concrete segment inputs
+        self._input_ids: Dict[int, int] = {}  # id(Tensor) → input index
+        # every Tensor holding a live placeholder of the open segment
+        self.sym_tensors: List[Tuple[weakref.ref, _SymValue]] = []
+        self.n_segments = 0
+        self.breaks: List[GraphBreak] = []
+        self._suspended = False
+        self._cacheable = True
+        self._sig_parts: List[tuple] = []
+
+    # -- recording -----------------------------------------------------------
+    def handle(self, op_name, fn, args, kwargs, diff):
+        if self._suspended:
+            return NotImplemented
+        if _core._static_handler is not None:
+            return NotImplemented  # static-graph mode wins
+        try:
+            from ..amp import is_auto_cast_enabled
+            if is_auto_cast_enabled():
+                # AMP autocasts per-op on concrete tensors; composing it
+                # with deferred segments would skip the casts — run
+                # eagerly under AMP instead
+                return NotImplemented
+        except ImportError:  # pragma: no cover
+            pass
+        arg_specs = []
+        sig_args = []
+        eval_args = []
+        for a in args:
+            if isinstance(a, Tensor):
+                v = a._value
+                if isinstance(v, _SymValue) and v._concrete is None:
+                    if v._ctx is not self:
+                        # placeholder from a stale context: force it
+                        v._force()
+                        arg_specs.append(("in", self._input_index(a)))
+                        eval_args.append(jax.ShapeDtypeStruct(
+                            a._value.shape, a._value.dtype))
+                        sig_args.append(("in", tuple(a._value.shape),
+                                         str(a._value.dtype)))
+                    else:
+                        arg_specs.append(("sym", v))
+                        eval_args.append(v.aval)
+                        sig_args.append(("sym", self._sym_index(v),
+                                         tuple(v.aval.shape),
+                                         str(v.aval.dtype)))
+                else:
+                    vv = v._pt_unwrap() if isinstance(v, _SymValue) else v
+                    a._value = vv
+                    arg_specs.append(("in", self._input_index(a)))
+                    eval_args.append(jax.ShapeDtypeStruct(vv.shape,
+                                                          vv.dtype))
+                    sig_args.append(("in", tuple(vv.shape), str(vv.dtype)))
+            elif isinstance(a, (np.ndarray, jnp.ndarray, jax.Array)) and \
+                    not isinstance(a, np.generic):
+                # raw array positional arg: lift to an input (it may
+                # change between calls — e.g. cu_seqlens)
+                t = Tensor(jnp.asarray(a))
+                arg_specs.append(("in", self._input_index(t)))
+                eval_args.append(jax.ShapeDtypeStruct(t._value.shape,
+                                                      t._value.dtype))
+                sig_args.append(("in", tuple(t._value.shape),
+                                 str(t._value.dtype)))
+            else:
+                arg_specs.append(("const", a))
+                fp = _fp_const(a)
+                if fp is None:
+                    self._cacheable = False
+                sig_args.append(("const", fp))
+                eval_args.append(a)
+
+        kw_fp = _fp_const(kwargs) if kwargs else ("map", ())
+        fn_fp = _fp_fn(fn)
+        if kw_fp is None or fn_fp is None:
+            self._cacheable = False
+
+        # constants are BOUND in the closure (not abstracted — reshape
+        # dims, axis ints etc. must stay concrete Python values);
+        # only array slots go through eval_shape
+        array_slots = [i for i, (kind, _) in enumerate(arg_specs)
+                       if kind != "const"]
+        eval_arrays = [eval_args[i] for i in array_slots]
+
+        def pure(*xs):
+            full = [val if kind == "const" else None
+                    for kind, val in arg_specs]
+            for i, x in zip(array_slots, xs):
+                full[i] = x
+            return fn(*full, **kwargs)
+
+        try:
+            out_aval = jax.eval_shape(pure, *eval_arrays)
+        except Exception:
+            # the op itself is untraceable: break, then run it eagerly
+            self._materialize(f"untraceable op {op_name}")
+            return NotImplemented
+
+        multi = isinstance(out_aval, (tuple, list))
+        avals = list(out_aval) if multi else [out_aval]
+        out_syms = [_SymValue(self, av) for av in avals]
+        self.nodes.append(_Node(op_name, fn, arg_specs, kwargs, out_syms,
+                                multi))
+        self._sig_parts.append((op_name, tuple(sig_args), kw_fp, fn_fp,
+                                len(avals)))
+
+        need_grad = (diff and _core._grad_state.enabled
+                     and any(isinstance(a, Tensor) and not a.stop_gradient
+                             for a in args))
+        outs = []
+        for sv in out_syms:
+            t = Tensor(sv, stop_gradient=not need_grad)
+            self.sym_tensors.append((weakref.ref(t), sv))
+            outs.append(t)
+        return tuple(outs) if multi else outs[0]
+
+    def _input_index(self, t: Tensor) -> int:
+        idx = self._input_ids.get(id(t))
+        if idx is None:
+            idx = len(self.inputs)
+            self._input_ids[id(t)] = idx
+            self.inputs.append(t)
+        return idx
+
+    def _sym_index(self, sv: _SymValue) -> int:
+        # stable per-segment index: position in creation order
+        for i, (_, s) in enumerate(self.sym_tensors):
+            if s is sv:
+                return i
+        return -1
+
+    # -- materialization (segment close = graph break) -----------------------
+    def _materialize(self, reason: str):
+        if not self.nodes:
+            return
+        nodes, self.nodes = self.nodes, []
+        inputs, self.inputs = self.inputs, []
+        self._input_ids = {}
+        sym_entries, self.sym_tensors = self.sym_tensors, []
+        sig = (tuple(self._sig_parts), len(inputs))
+        self._sig_parts = []
+        cacheable, self._cacheable = self._cacheable, True
+
+        # outputs worth computing: placeholders whose Tensor is alive
+        live = [(wr, sv) for wr, sv in sym_entries if wr() is not None]
+        if not live:
+            return  # fully dead segment: drop (ops are pure)
+        out_syms = [sv for _, sv in live]
+
+        def seg_fn(*in_arrays):
+            env: Dict[int, Any] = {}
+            for node in nodes:
+                xs = []
+                for kind, val in node.arg_specs:
+                    if kind == "sym":
+                        xs.append(env[id(val)])
+                    elif kind == "in":
+                        xs.append(in_arrays[val])
+                    else:
+                        xs.append(val)
+                out = node.fn(*xs, **node.kwargs)
+                outs = list(out) if node.multi else [out]
+                for sv, o in zip(node.out_syms, outs):
+                    env[id(sv)] = o
+            return tuple(env[id(sv)] for sv in out_syms)
+
+        if cacheable:
+            cache = self.owner._seg_cache
+            cached = cache.get(sig)
+            if cached is None:
+                cached = jax.jit(seg_fn)
+                cache[sig] = cached
+                # bound the cache: volatile constants (e.g. a per-call
+                # RNG key captured in a closure that the op layer didn't
+                # lift into an arg) would otherwise grow it per call
+                while len(cache) > self.owner.max_cached_segments:
+                    cache.pop(next(iter(cache)))
+            else:
+                cache[sig] = cache.pop(sig)  # LRU touch
+            runner = cached
+        else:
+            runner = seg_fn  # correct but uncached (op-by-op dispatch)
+
+        self._suspended = True
+        try:
+            results = apply(f"subgraph[{len(nodes)}ops]", runner, *inputs)
+        finally:
+            self._suspended = False
+        if not isinstance(results, tuple):
+            results = (results,)
+        # graft concrete values (and tape linkage) back into the
+        # original Tensor objects the user's code is holding
+        for (wr, sv), rt in zip(live, results):
+            t = wr()
+            object.__setattr__(sv, "_concrete", rt._value)
+            if t is not None:
+                t._value = rt._value
+                t._node = rt._node
+                t._out_idx = rt._out_idx
+                t.stop_gradient = rt.stop_gradient
+        self.n_segments += 1
+        self.breaks.append(GraphBreak(reason, len(nodes)))
+
+
+# ---------------------------------------------------------------------------
+# public driver
+# ---------------------------------------------------------------------------
+
+class PartialProgram:
+    """Run ``fn`` under partial-graph capture.
+
+    Each call re-executes the Python function (control flow replays with
+    fresh break values — implicit guards); tensor ops accumulate into
+    compiled segments cached across calls by op-sequence signature.
+
+    Telemetry: ``graph_break_count`` (breaks before function end, i.e.
+    concretization demands), ``num_subgraphs`` (compiled segments run on
+    the last call), ``last_breaks`` (reasons)."""
+
+    max_cached_segments = 64  # LRU bound (volatile closure constants)
+
+    def __init__(self, fn: Callable, name: str = ""):
+        self.fn = fn
+        self.name = name or getattr(fn, "__name__", "fn")
+        self._seg_cache: Dict[Any, Callable] = {}
+        self.graph_break_count = 0
+        self.num_subgraphs = 0
+        self.last_breaks: List[GraphBreak] = []
+        self.call_count = 0
+
+    def __call__(self, *args, **kwargs):
+        if _core._capture_handler is not None:
+            # no nesting: inner partial programs run inside the outer one
+            return self.fn(*args, **kwargs)
+        ctx = _CaptureContext(self)
+        _set_capture_handler(ctx.handle)
+        try:
+            out = self.fn(*args, **kwargs)
+        finally:
+            _set_capture_handler(None)
+        n_breaks = ctx.n_segments  # segments closed before function end
+        ctx._materialize("function end")
+        self.call_count += 1
+        self.graph_break_count += n_breaks
+        self.num_subgraphs = ctx.n_segments
+        self.last_breaks = ctx.breaks
+        return out

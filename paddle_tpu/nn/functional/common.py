@@ -37,18 +37,21 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return x
     key = default_generator.next_key()
 
-    def f(a):
+    # key passes as a positional arg (not a closure cell) so partial
+    # capture lifts it into a segment input — stochastic segments stay
+    # cache-hittable across calls
+    def f(a, k):
         if axis is None:
             shape = a.shape
         else:
             axes = (axis,) if isinstance(axis, int) else tuple(axis)
             shape = tuple(a.shape[i] if i in axes else 1 for i in range(a.ndim))
-        keep = jax.random.bernoulli(key, 1.0 - p, shape)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a)).astype(a.dtype)
         return jnp.where(keep, a, jnp.zeros_like(a))
 
-    return apply("dropout", f, x)
+    return apply("dropout", f, x, key)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

@@ -62,3 +62,26 @@ def test_engine_requires_optimizer_for_fit(clean_fleet):
     engine = auto.Engine(nn.Linear(4, 2), nn.CrossEntropyLoss())
     with pytest.raises(ValueError, match="optimizer"):
         engine.fit(_Toy(32), batch_size=8, verbose=0)
+
+
+def test_engine_gradient_merge(tmp_path):
+    """strategy.gradient_merge drives TrainStep k-step accumulation."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu import nn
+    from paddle_tpu.distributed.fleet import auto
+
+    strategy = auto.Strategy()
+    strategy.gradient_merge = True
+    strategy.gradient_merge_configs = {"k_steps": 2}
+    m = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.Linear(16, 4))
+    opt = paddle.optimizer.SGD(learning_rate=0.05,
+                               parameters=m.parameters())
+    eng = auto.Engine(model=m, loss=nn.CrossEntropyLoss(), optimizer=opt,
+                      strategy=strategy)
+    xs = np.random.RandomState(0).randn(32, 8).astype("float32")
+    ys = np.random.RandomState(1).randint(0, 4, (32,)).astype("int64")
+    eng.fit(list(zip(xs, ys)), epochs=1, batch_size=8)
+    # 4 micro-batches, k=2 -> optimizer stepped twice
+    assert eng._train_step._gm_k == 2
+    assert eng.optimizer._step_count == 2
