@@ -211,6 +211,93 @@ def run_serving(weight_dtype=None, concurrency=8):
     }
 
 
+def run_pp():
+    """Pipeline-schedule efficiency microbench (VERDICT r3 #3): wall
+    time per step, remat vs store-activations, on a 1-stage mesh on the
+    real chip (isolates the remat compute overhead — the bubble itself
+    is analytic, reported from the schedule tables)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from paddle_tpu.distributed.fleet.pp_schedule import (
+        build_pipeline_schedule, pipeline_forward_backward)
+
+    rng = np.random.RandomState(0)
+    d, ff, m, tokens, heads = 1024, 4096, 8, 512, 8
+    hd = d // heads
+    mesh = Mesh(np.array(jax.devices()[:1]), ("pp",))
+
+    def w(*shape, s=0.02):
+        return jnp.asarray(rng.randn(1, 1, *shape).astype(np.float32)
+                           * s).astype(jnp.bfloat16)
+
+    # a representative transformer block: attention remat is the
+    # expensive part (an MLP-only stage remats for free under XLA —
+    # recompute hides behind HBM traffic)
+    params = {"wq": w(d, d), "wk": w(d, d), "wv": w(d, d),
+              "wo": w(d, d), "w1": w(d, ff), "w2": w(ff, d)}
+
+    def stage_fn(pj, x):
+        t = x.shape[0]
+        q = (x @ pj["wq"]).reshape(t, heads, hd)
+        k = (x @ pj["wk"]).reshape(t, heads, hd)
+        v = (x @ pj["wv"]).reshape(t, heads, hd)
+        s = jnp.einsum("qhd,khd->hqk", q, k).astype(jnp.float32) \
+            / np.sqrt(hd)
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        s = jnp.where(mask[None], s, -1e30)
+        a = jax.nn.softmax(s, axis=-1).astype(x.dtype)
+        att = jnp.einsum("hqk,khd->qhd", a, v).reshape(t, d)
+        h = x + att @ pj["wo"]
+        return (h + jax.nn.gelu(h @ pj["w1"]) @ pj["w2"]).astype(x.dtype)
+
+    lp = {"h": jnp.zeros((d,), jnp.bfloat16)}
+
+    def loss_fn(lpp, y, t):
+        return jnp.mean(((y + t) @ lpp["h"]).astype(jnp.float32) ** 2)
+
+    xs = jnp.asarray(rng.randn(m, tokens, d).astype(np.float32)) \
+        .astype(jnp.bfloat16)
+    ys = xs
+    sched = build_pipeline_schedule(1, m, 1, "1F1B")
+    out = {}
+    for remat in (True, False):
+        def f_(p_, l_, x_, y_, r=remat):
+            loss, gs, glp, dxs = pipeline_forward_backward(
+                stage_fn, loss_fn, p_, l_, x_, y_, mesh, sched, remat=r)
+            # keep the backward live (a loss-only return lets XLA DCE
+            # the whole gradient computation)
+            gnorm = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                        for g in jax.tree_util.tree_leaves(gs))
+            return loss, gnorm
+        iters = 10
+
+        # the timed loop lives INSIDE the program (lax.scan): one
+        # dispatch + one scalar fetch, so tunnel round-trips don't
+        # inflate the per-step time
+        def many(p_, l_, x_, y_):
+            def body(c, _):
+                loss, gn = f_(p_, l_, x_, y_)
+                return c + gn + loss, None
+            tot, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                  length=iters)
+            return tot
+        g = jax.jit(many)
+        float(g(params, lp, xs, ys))   # compile + sync
+        t0 = time.perf_counter()
+        float(g(params, lp, xs, ys))
+        ms = 1000 * (time.perf_counter() - t0) / iters
+        out["pp_step_ms_remat" if remat else "pp_step_ms_store"] = \
+            round(ms, 2)
+    out["pp_remat_overhead_x"] = round(
+        out["pp_step_ms_remat"] / out["pp_step_ms_store"], 3)
+    # analytic bubble for representative configs (CPU-free, from tables)
+    for p, mm, v in ((4, 16, 1), (8, 32, 1), (4, 16, 2)):
+        s = build_pipeline_schedule(p, mm, v, "1F1B")
+        out[f"pp_bubble_p{p}m{mm}v{v}"] = round(s.bubble_overhead(), 4)
+    return out
+
+
 def run_serving_suite():
     """fp and int8 at two concurrency levels."""
     out = {}
@@ -237,6 +324,11 @@ def main(mode: str):
         result = {"metric": "serving_bf16_c8_tok_per_sec",
                   "unit": "tokens/s", "vs_baseline": 0.0,
                   "value": r["serving_bf16_c8_tok_per_sec"], "extra": r}
+    elif mode == "pp":
+        r = run_pp()
+        result = {"metric": "pp_remat_overhead_x", "unit": "x",
+                  "vs_baseline": 0.0, "value": r["pp_remat_overhead_x"],
+                  "extra": r}
     else:  # auto: headline llama + secondary benches in extra
         try:
             result = run_llama("mid")
@@ -244,7 +336,7 @@ def main(mode: str):
             sys.stderr.write(f"bench mid failed ({e}); retrying small\n")
             result = run_llama("small")
         for name, fn in (("resnet", run_resnet), ("decode", run_decode),
-                         ("serving", run_serving_suite)):
+                         ("serving", run_serving_suite), ("pp", run_pp)):
             try:
                 result["extra"].update(fn())
             except Exception as e:
@@ -253,7 +345,7 @@ def main(mode: str):
 
 
 _VALID_MODES = ("auto", "mid", "small", "tiny", "resnet", "decode",
-                "serving")
+                "serving", "pp")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

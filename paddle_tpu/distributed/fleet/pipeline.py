@@ -265,8 +265,17 @@ class PipelineParallel:
               else {"schedule_mode": "1F1B", "accumulate_steps": 1})
         self._mode = pc.get("schedule_mode", "1F1B")
         self._n_micro = int(pc.get("accumulate_steps", 1))
+        # backward mode: strategy.recompute forces remat; otherwise pick
+        # automatically — store activations (reference default,
+        # pipeline_parallel.py:440 stores, no remat) when the residual
+        # buffers fit the budget, remat when they don't
+        self._remat_mode = ("remat" if (strategy is not None
+                                        and getattr(strategy, "recompute",
+                                                    False))
+                            else "auto")
         self._scheds = {}
         self._compiled = {}
+        self._remat_choice = {}
 
         # homogeneity check + per-stage param lists
         self._stage_params = []
@@ -330,6 +339,38 @@ class PipelineParallel:
                 self._pp, n_micro, 1, self._mode)
         return self._scheds[key]
 
+    def _pick_remat(self, stage_fn, stacked, sched, x_aval) -> bool:
+        """auto mode: store activations when the vjp-residual buffers fit
+        FLAGS_pp_store_budget_mb (default 2048 MB per device), else
+        remat. Explicit strategy.recompute always remats. The decision
+        is cached — the abstract vjp trace must not re-run per step."""
+        if self._remat_mode == "remat":
+            return True
+        import os
+        budget = float(os.environ.get("FLAGS_pp_store_budget_mb",
+                                      "2048")) * 1e6
+        key = (sched.n_micro, x_aval.shape, str(x_aval.dtype), budget)
+        cached = self._remat_choice.get(key)
+        if cached is not None:
+            return cached
+        import jax
+        import numpy as np
+        from .pp_schedule import probe_residuals
+        chunk_avals = [jax.ShapeDtypeStruct(leaf[0, 0].shape,
+                                            leaf[0, 0].dtype)
+                       for leaf in stacked]
+        try:
+            # same probe the store-mode engine allocates buffers from —
+            # the budget estimate and the actual allocation agree
+            probe = probe_residuals(stage_fn, chunk_avals, x_aval)
+            need = sum(int(np.prod(a.shape)) * a.dtype.itemsize
+                       for a in probe["buf_avals"]) * sched.res_buf_size
+            choice = need > budget
+        except Exception:
+            choice = True  # unprobeable stage: safe default
+        self._remat_choice[key] = choice
+        return choice
+
     # -- public API ----------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
         """data: (inputs, labels) Tensors; the batch splits into
@@ -367,17 +408,19 @@ class PipelineParallel:
         stacked = self._stacked()
         sched = self._sched(m)
         dummy_lp = jnp.zeros((1,), jnp.float32)
+        import jax as _jax
+        x_aval = _jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)
+        remat = self._pick_remat(stage_fn, stacked, sched, x_aval)
+        self.last_remat = remat   # observability (tests/bench)
         # the engine must run under jit: shard_map with auto (non-'pp')
         # axes only composes inside a traced program
-        fb = self._compiled.get(("train", m))
+        fb = self._compiled.get(("train", m, remat))
         if fb is None:
-            import jax as _jax
-
             def _fb(stacked_, lp_, xs_, ys_):
                 return pipeline_forward_backward(
                     stage_fn, engine_loss, stacked_, lp_, xs_, ys_,
-                    self._mesh, sched, axis="pp")
-            fb = self._compiled[("train", m)] = _jax.jit(_fb)
+                    self._mesh, sched, axis="pp", remat=remat)
+            fb = self._compiled[("train", m, remat)] = _jax.jit(_fb)
         loss, gstacked, _, _ = fb(stacked, dummy_lp, xs, ys)
 
         # unstack grads back onto the stage param Tensors

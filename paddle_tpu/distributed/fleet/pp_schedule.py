@@ -55,17 +55,40 @@ class PipelineSchedule:
     act_buf_size: int
     grad_buf_size: int
     tables: Dict[str, np.ndarray] = field(repr=False)
+    # store-activations mode: vjp-residual slots (write fwd-tick, read
+    # bwd-tick). Defaulted for schedules built before this field existed.
+    res_buf_size: int = 1
 
-    # Tick cost model: every tick executes one chunk-forward plus one
-    # rematerialized chunk-backward (~2x fwd), masked or not — lock-step
-    # SPMD burns the compute either way. Used by tests/autotuner to
-    # compare schedules; chunk_cost is relative to one *chunk* forward.
-    CHUNK_COST_PER_TICK = 3.0
+    # Tick cost model (single-chunk-forward units): every tick executes
+    # one chunk-forward plus one chunk-backward, masked or not —
+    # lock-step SPMD burns the compute either way. With remat the
+    # backward re-runs the forward first (fwd 1 + remat-fwd 1 + bwd 1);
+    # store-activations drops the remat (fwd 1 + bwd 1). Used by
+    # tests/autotuner to compare schedules.
+    CHUNK_COST_PER_TICK = 3.0          # remat mode (back-compat name)
+
+    def chunk_cost_per_tick(self, remat: bool = True) -> float:
+        return 3.0 if remat else 2.0
 
     @property
     def work_units(self) -> float:
         """Total compute in single-chunk-forward units for the whole step."""
         return self.n_ticks * self.CHUNK_COST_PER_TICK
+
+    def ideal_work_units(self, remat: bool = True) -> float:
+        """Per-stage compute with zero bubble: each stage runs
+        n_micro*vpp chunk fwd+bwd pairs."""
+        per_pair = self.chunk_cost_per_tick(remat)
+        return self.n_micro * self.vpp * per_pair
+
+    def efficiency(self) -> float:
+        """ideal / achieved compute ratio — 1.0 means no bubble (the
+        per-tick cost cancels, so the bubble fraction is mode-
+        independent: n_micro*vpp / n_ticks)."""
+        return self.n_micro * self.vpp / self.n_ticks
+
+    def bubble_overhead(self) -> float:
+        return 1.0 - self.efficiency()
 
     def __hash__(self):  # identity — schedules are built once per step fn
         return id(self)
@@ -208,6 +231,12 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
         grad_iv[(mb, q)] = (stage_of(q), t_w, bt)
     act_slot, act_size = _alloc(act_iv)
     grad_slot, grad_size = _alloc(grad_iv)
+    # residual slots (store-activations mode): written at the fwd tick,
+    # read at the bwd tick — every (mb, q) including q == 0 (whose act
+    # input comes from xs and has no act slot)
+    res_iv = {(mb, q): (stage_of(q), ft, bwd_tick[(mb, q)])
+              for (mb, q), ft in fwd_tick.items()}
+    res_slot, res_size = _alloc(res_iv)
 
     # --- emit tables -----------------------------------------------------
     def zi():
@@ -219,7 +248,7 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     T = {k: zi() for k in
          ("fwd_chunk", "fwd_mb", "fwd_in_slot", "fwd_seed_slot",
           "rx_slot", "grx_slot", "bwd_chunk", "bwd_mb", "bwd_in_slot",
-          "bwd_gslot")}
+          "bwd_gslot", "res_slot", "bwd_res_slot")}
     T.update({k: zb() for k in
               ("fwd_valid", "fwd_is_first", "fwd_is_last", "rx_valid",
                "grx_valid", "bwd_valid", "bwd_is_first")})
@@ -232,6 +261,7 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
             T["fwd_is_last"][tick, s] = q == V - 1
             if q >= 1:
                 T["fwd_in_slot"][tick, s] = act_slot[(mb, q)]
+            T["res_slot"][tick, s] = res_slot[(mb, q)]
             if q == V - 1:
                 T["fwd_seed_slot"][tick, s] = grad_slot[(mb, q)]
             # receiver-side arrival of this fwd's output (next virtual stage)
@@ -246,6 +276,7 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
             T["bwd_is_first"][tick, s] = q == 0
             if q >= 1:
                 T["bwd_in_slot"][tick, s] = act_slot[(mb, q)]
+            T["bwd_res_slot"][tick, s] = res_slot[(mb, q)]
             T["bwd_gslot"][tick, s] = grad_slot[(mb, q)]
             if q >= 1:  # this bwd's dx arrives at the upstream stage
                 rs, rt = stage_of(q - 1), tick + 1
@@ -261,18 +292,51 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     return PipelineSchedule(
         n_stages=p, n_micro=m, vpp=v, mode=mkey, n_ticks=n_ticks,
         act_buf_size=max(1, act_size), grad_buf_size=max(1, grad_size),
-        tables=T)
+        res_buf_size=max(1, res_size), tables=T)
 
 
 def _resolve_mesh(mesh):
     return mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
 
 
+def probe_residuals(stage_fn: Callable, chunk_avals, x_aval) -> Dict[str, Any]:
+    """Abstractly trace one chunk's jax.vjp and report its residual
+    layout: {"treedef", "param_pos" (per-leaf index into the chunk's
+    param leaves, -1 = activation-derived), "buf_avals" (avals of the
+    leaves that must ride buffers in store-activations mode)}.
+
+    Single source of truth for both the store-mode engine and the
+    memory-budget auto-pick — the two must agree on what gets buffered.
+    Residual leaves that ARE param leaves (same tracer in this trace —
+    jaxpr construction is deterministic, so positions are stable) are
+    re-picked from live params at the backward tick instead of being
+    buffered.
+    """
+    import jax
+
+    out: Dict[str, Any] = {}
+
+    def _probe(pj, x):
+        res, vjp = jax.vjp(stage_fn, pj, x)
+        leaves, td = jax.tree_util.tree_flatten(vjp)
+        pleaves = jax.tree_util.tree_leaves(pj)
+        pmap = {id(pl): k for k, pl in enumerate(pleaves)}
+        out["treedef"] = td
+        out["param_pos"] = [pmap.get(id(l), -1) for l in leaves]
+        out["buf_avals"] = [
+            jax.ShapeDtypeStruct(l.shape, l.dtype)
+            for l, pos in zip(leaves, out["param_pos"]) if pos < 0]
+        return res
+
+    jax.eval_shape(_probe, chunk_avals, x_aval)
+    return out
+
+
 def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
                               stacked_params, loss_params,
                               x_microbatches, y_microbatches,
                               mesh, sched: PipelineSchedule,
-                              axis: str = "pp"):
+                              axis: str = "pp", remat: bool = True):
     """Run one pipelined train micro-step: forward + backward fused.
 
     stage_fn(chunk_params, x) -> y      one chunk's computation; uniform
@@ -281,6 +345,15 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
     stacked_params: pytree, leaves [vpp, n_stages, ...] (dim 1 sharded
         over `axis`; dim 0 is the chunk round).
     x_microbatches / y_microbatches: [n_micro, ...].
+
+    remat=True (the 1F1B memory story): backward re-runs the chunk
+    forward from its saved input — O(act_buf_size) inputs held, +1 fwd
+    of compute per tick. remat=False (store-activations, the reference
+    default — pipeline_parallel.py:440 stores, it doesn't remat): the
+    forward slot runs jax.vjp and its residuals ride buffers to the
+    backward tick; param-only residual leaves are substituted from the
+    live params at backward instead of being buffered, so params are
+    never duplicated per slot.
 
     Returns (loss, grads_stacked, grads_loss_params, dxs) where loss is
     the mean over microbatches, grads are summed cotangents (d mean-loss),
@@ -330,6 +403,31 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
             return jax.tree_util.tree_map(
                 lambda a: jax.lax.dynamic_index_in_dim(a, j, 0, False), tree)
 
+        # --- store-activations support: the shared residual-layout
+        # probe (see probe_residuals) tells which vjp residual leaves
+        # ride buffers vs get re-picked from live params at backward.
+        res_probe: Dict[str, Any] = {}
+        if not remat:
+            res_probe = probe_residuals(stage_fn, chunk0, act_z)
+
+        def _store_res(res_buf, vjp, slot, valid):
+            leaves = jax.tree_util.tree_leaves(vjp)
+            buffered = [l for l, pos in zip(leaves,
+                                            res_probe["param_pos"])
+                        if pos < 0]
+            return tuple(
+                rb.at[slot].set(jnp.where(valid, lf.astype(rb.dtype),
+                                          rb[slot]))
+                for rb, lf in zip(res_buf, buffered))
+
+        def _load_vjp(res_buf, slot, pj):
+            pleaves = jax.tree_util.tree_leaves(pj)
+            it = iter(res_buf)
+            leaves = [pleaves[pos] if pos >= 0 else next(it)[slot]
+                      for pos in res_probe["param_pos"]]
+            return jax.tree_util.tree_unflatten(res_probe["treedef"],
+                                                leaves)
+
         def loss_and_seeds(out, y):
             (lv, (g_lp, g_out)) = jax.value_and_grad(
                 lambda lp, o: loss_fn(lp, o, y), argnums=(0, 1))(lparams, out)
@@ -338,8 +436,8 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
         zero_lp = jax.tree_util.tree_map(jnp.zeros_like, lparams)
 
         def tick(carry, row):
-            (fwd_msg, bwd_msg, act_buf, grad_buf, gacc, lp_acc, loss_sum,
-             dxs) = carry
+            (fwd_msg, bwd_msg, act_buf, grad_buf, res_buf, gacc, lp_acc,
+             loss_sum, dxs) = carry
             r = {k: a[stage] for k, a in row.items()}
 
             # -- message arrivals (written before compute reads) --
@@ -354,7 +452,13 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
             # -- forward slot --
             x_in = jnp.where(r["fwd_is_first"], xs[r["fwd_mb"]],
                              act_buf[r["fwd_in_slot"]])
-            out = stage_fn(pick_chunk(p_local, r["fwd_chunk"]), x_in)
+            pj_f = pick_chunk(p_local, r["fwd_chunk"])
+            if remat:
+                out = stage_fn(pj_f, x_in)
+            else:
+                out, vjp_f = jax.vjp(stage_fn, pj_f, x_in)
+                res_buf = _store_res(res_buf, vjp_f, r["res_slot"],
+                                     r["fwd_valid"])
             lv, g_seed, g_lp = jax.lax.cond(
                 r["fwd_is_last"],
                 lambda o: loss_and_seeds(o, ys[r["fwd_mb"]]),
@@ -371,12 +475,17 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
                 lambda a, g: a + jnp.where(last_valid, g, 0.0).astype(a.dtype),
                 lp_acc, g_lp)
 
-            # -- backward slot (remat from saved chunk input) --
-            xb = jnp.where(r["bwd_is_first"], xs[r["bwd_mb"]],
-                           act_buf[r["bwd_in_slot"]])
+            # -- backward slot --
             pj = pick_chunk(p_local, r["bwd_chunk"])
             g_in = grad_buf[r["bwd_gslot"]]
-            _, vjp = jax.vjp(stage_fn, pj, xb)
+            if remat:
+                # remat from the saved chunk input
+                xb = jnp.where(r["bwd_is_first"], xs[r["bwd_mb"]],
+                               act_buf[r["bwd_in_slot"]])
+                _, vjp = jax.vjp(stage_fn, pj, xb)
+            else:
+                # stored residuals (param leaves re-picked live)
+                vjp = _load_vjp(res_buf, r["bwd_res_slot"], pj)
             dp, dx = vjp(g_in)
             gacc = jax.tree_util.tree_map(
                 lambda acc, g: acc.at[r["bwd_chunk"]].add(
@@ -387,20 +496,26 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
                 jnp.where(first_valid, dx.astype(dxs.dtype),
                           dxs[r["bwd_mb"]]))
 
-            return (out, dx, act_buf, grad_buf, gacc, lp_acc, loss_sum,
-                    dxs), None
+            return (out, dx, act_buf, grad_buf, res_buf, gacc, lp_acc,
+                    loss_sum, dxs), None
 
+        res_buf0 = ()
+        if not remat:
+            res_buf0 = tuple(
+                jnp.zeros((sched.res_buf_size,) + av.shape, av.dtype)
+                for av in res_probe["buf_avals"])
         carry0 = (
             act_z, act_z,
             jnp.zeros((sched.act_buf_size,) + act_z.shape, act_z.dtype),
             jnp.zeros((sched.grad_buf_size,) + act_z.shape, act_z.dtype),
+            res_buf0,
             jax.tree_util.tree_map(jnp.zeros_like, p_local),
             zero_lp,
             jnp.zeros((), jnp.float32),
             jnp.zeros((m,) + act_z.shape, act_z.dtype),
         )
         carry, _ = jax.lax.scan(tick, carry0, tables)
-        (_, _, _, _, gacc, lp_acc, loss_sum, dxs) = carry
+        (_, _, _, _, _, gacc, lp_acc, loss_sum, dxs) = carry
 
         # loss / loss-param grads / dxs live on one stage — broadcast.
         loss = jax.lax.psum(loss_sum, axis) * inv_m
@@ -420,7 +535,8 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
 
 
 def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, mesh,
-                          sched: PipelineSchedule, axis: str = "pp"):
+                          sched: PipelineSchedule, axis: str = "pp",
+                          remat: bool = True):
     """Wrap the fused engine as a scalar-loss function differentiable by
     outer jax.grad: f(stacked_params, loss_params, xs, ys) -> loss.
 
@@ -436,13 +552,13 @@ def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, mesh,
     def pipeline_loss(stacked_params, loss_params, xs, ys):
         loss, _, _, _ = pipeline_forward_backward(
             stage_fn, loss_fn, stacked_params, loss_params, xs, ys,
-            mesh, sched, axis)
+            mesh, sched, axis, remat=remat)
         return loss
 
     def fwd(stacked_params, loss_params, xs, ys):
         loss, gs, glp, dxs = pipeline_forward_backward(
             stage_fn, loss_fn, stacked_params, loss_params, xs, ys,
-            mesh, sched, axis)
+            mesh, sched, axis, remat=remat)
         return loss, (gs, glp, dxs, ys)
 
     def bwd(res, gbar):

@@ -168,7 +168,6 @@ class StaticFunction:
         self._compiled = None
         self._input_spec = input_spec
         self._full_graph = full_graph
-        self._eager_fallback = False
         self._partial = None        # PartialProgram after a graph break
         self.retrace_count = 0
         self.trace_signatures = []
@@ -235,8 +234,6 @@ class StaticFunction:
     def __call__(self, *args, **kwargs):
         if self._partial is not None:
             return self._partial(*args, **kwargs)
-        if self._eager_fallback:
-            return self._call_eager(args, kwargs)
         if self._compiled is None:
             self._compiled = self._build()
         try:
@@ -255,10 +252,17 @@ class StaticFunction:
     def _enter_partial(self, cause, args, kwargs):
         import warnings
         from .partial_capture import PartialProgram
+        # warn BEFORE executing anything: under warnings-as-errors this
+        # must raise while state is still clean (no segments run)
+        warnings.warn(
+            f"to_static({self._name()}): whole-graph tracing failed "
+            f"({type(cause).__name__}); switching to partial-graph "
+            f"capture (compiled subgraphs around the breaking "
+            f"constructs).", RuntimeWarning)
         target = (self._layer if self._layer is not None else self._fn)
         self._partial = PartialProgram(target, name=self._name())
         try:
-            out = self._partial(*args, **kwargs)
+            return self._partial(*args, **kwargs)
         except Exception:
             # Do NOT re-run eagerly: segments already executed with real
             # side effects (buffer updates, RNG draws) — a rerun would
@@ -266,13 +270,6 @@ class StaticFunction:
             # (whole-graph first, then partial) from clean state.
             self._partial = None
             raise
-        warnings.warn(
-            f"to_static({self._name()}): whole-graph tracing failed "
-            f"({type(cause).__name__}); switched to partial-graph "
-            f"capture — {self._partial.num_subgraphs} compiled "
-            f"subgraph(s), {self._partial.graph_break_count} graph "
-            f"break(s) on the first call.", RuntimeWarning)
-        return out
 
     # partial-capture telemetry (SOT parity surface)
     @property
@@ -283,9 +280,6 @@ class StaticFunction:
     def num_subgraphs(self):
         return self._partial.num_subgraphs if self._partial else 0
 
-    def _call_eager(self, args, kwargs):
-        target = self._layer if self._layer is not None else self._fn
-        return target(*args, **kwargs)
 
     def _call_compiled(self, args, kwargs):
         layer = self._layer

@@ -302,6 +302,7 @@ class _CaptureContext:
         self._input_ids: Dict[int, int] = {}  # id(Tensor) → input index
         # every Tensor holding a live placeholder of the open segment
         self.sym_tensors: List[Tuple[weakref.ref, _SymValue]] = []
+        self._sym_ids: Dict[int, int] = {}  # id(_SymValue) → index
         self.n_segments = 0
         self.breaks: List[GraphBreak] = []
         self._suspended = False
@@ -409,6 +410,7 @@ class _CaptureContext:
         outs = []
         for sv in out_syms:
             t = Tensor(sv, stop_gradient=not need_grad)
+            self._sym_ids[id(sv)] = len(self.sym_tensors)
             self.sym_tensors.append((weakref.ref(t), sv))
             outs.append(t)
         return tuple(outs) if multi else outs[0]
@@ -423,10 +425,7 @@ class _CaptureContext:
 
     def _sym_index(self, sv: _SymValue) -> int:
         # stable per-segment index: position in creation order
-        for i, (_, s) in enumerate(self.sym_tensors):
-            if s is sv:
-                return i
-        return -1
+        return self._sym_ids.get(id(sv), -1)
 
     # -- materialization (segment close = graph break) -----------------------
     def _materialize(self, reason: str):
@@ -436,6 +435,7 @@ class _CaptureContext:
         inputs, self.inputs = self.inputs, []
         self._input_ids = {}
         sym_entries, self.sym_tensors = self.sym_tensors, []
+        self._sym_ids = {}
         sig = (tuple(self._sig_parts), len(inputs))
         self._sig_parts = []
         cacheable, self._cacheable = self._cacheable, True
