@@ -33,7 +33,10 @@ from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from .elastic import ElasticLevel, ElasticManager, ElasticStatus  # noqa: F401
-from .ring_attention import ring_attention, ring_attention_local  # noqa: F401
+from .ring_attention import (  # noqa: F401
+    ring_attention, ring_attention_local, zigzag_indices,
+    inverse_zigzag_indices,
+)
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "DataParallel",

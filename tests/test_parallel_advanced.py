@@ -256,3 +256,120 @@ class TestSequenceParallel:
         h = np.maximum(x.numpy() @ col.weight.numpy() + col.bias.numpy(), 0)
         want = h @ row.weight.numpy() + row.bias.numpy()
         assert np.allclose(out_full.numpy(), want, rtol=1e-4, atol=1e-5)
+
+
+class TestZigzagRing:
+    """Zigzag causal ring attention (VERDICT r2 #7): balanced causal
+    work, exact numerics, measured speedup over the masked ring."""
+
+    def _ref(self, q, k, v, causal=True):
+        from paddle_tpu.ops.flash_attention import flash_attention_reference
+        return flash_attention_reference(q, k, v, causal=causal)
+
+    def test_indices_roundtrip(self):
+        from paddle_tpu.distributed.ring_attention import (
+            zigzag_indices, inverse_zigzag_indices)
+        for s, n in ((64, 8), (32, 2), (48, 3)):
+            order = zigzag_indices(s, n)
+            inv = inverse_zigzag_indices(s, n)
+            assert sorted(order.tolist()) == list(range(s))
+            np.testing.assert_array_equal(order[inv], np.arange(s))
+        # rank 0 of (64, 8): blocks 0 and 15 -> indices 0-3 and 60-63
+        order = zigzag_indices(64, 8)
+        assert order[:8].tolist() == [0, 1, 2, 3, 60, 61, 62, 63]
+        with pytest.raises(ValueError, match="divisible"):
+            zigzag_indices(30, 8)
+
+    def test_zigzag_matches_plain_and_reference(self):
+        rng = np.random.RandomState(5)
+        b, s, h, d = 2, 64, 4, 16
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        mesh = dist.ProcessMesh(np.arange(8), ["sep"])
+        out_zz = dist.ring_attention(q, k, v, mesh, causal=True,
+                                     zigzag=True)
+        out_pl = dist.ring_attention(q, k, v, mesh, causal=True,
+                                     zigzag=False)
+        ref = self._ref(q, k, v)
+        np.testing.assert_allclose(np.asarray(out_zz), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(out_zz),
+                                   np.asarray(out_pl), atol=2e-5,
+                                   rtol=2e-4)
+
+    def test_zigzag_gradients_gqa(self):
+        rng = np.random.RandomState(6)
+        b, s, h, hk, d = 1, 32, 4, 2, 8
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, s, hk, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, s, hk, d).astype(np.float32))
+        mesh = dist.ProcessMesh(np.arange(8), ["sep"])
+        do = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        g_zz = jax.grad(lambda q_, k_, v_: jnp.sum(dist.ring_attention(
+            q_, k_, v_, mesh, causal=True, zigzag=True) * do),
+            argnums=(0, 1, 2))(q, k, v)
+        g_ref = jax.grad(lambda q_, k_, v_: jnp.sum(
+            self._ref(q_, k_, v_) * do), argnums=(0, 1, 2))(q, k, v)
+        for a, b_ in zip(g_zz, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       atol=1e-4, rtol=1e-3)
+
+    def test_zigzag_local_layout(self):
+        # the shard-local API with pre-zigzagged data
+        from functools import partial
+        from jax.sharding import PartitionSpec as P
+        from paddle_tpu.distributed.ring_attention import (
+            ring_attention_local, zigzag_indices, inverse_zigzag_indices)
+        rng = np.random.RandomState(7)
+        b, s, h, d = 1, 64, 2, 8
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        mesh = dist.ProcessMesh(np.arange(8), ["sep"]).to_jax_mesh()
+        order = jnp.asarray(zigzag_indices(s, 8))
+        inv = jnp.asarray(inverse_zigzag_indices(s, 8))
+        spec = P(None, "sep", None, None)
+        f = jax.shard_map(
+            partial(ring_attention_local, axis_name="sep", causal=True,
+                    zigzag=True),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False)
+        out = jnp.take(f(*(jnp.take(x, order, axis=1)
+                           for x in (q, q, q))), inv, axis=1)
+        np.testing.assert_allclose(np.asarray(out),
+                                   np.asarray(self._ref(q, q, q)),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_zigzag_is_faster(self):
+        """Compute-bound CPU mesh: zigzag must beat the masked ring on
+        causal fwd+bwd wall time (the whole point). Analytic ratio ~2x
+        at n=8, measured 1.9x at this shape; require >=1.5x (the VERDICT
+        r2 bar). Blocks must be big enough for the quadratic term to
+        dominate the merge overhead."""
+        import time
+        rng = np.random.RandomState(8)
+        b, s, h, d = 1, 4096, 8, 64
+        q = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        k = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        v = jnp.asarray(rng.randn(b, s, h, d).astype(np.float32))
+        mesh = dist.ProcessMesh(np.arange(8), ["sep"])
+        do = jnp.ones((b, s, h, d), jnp.float32)
+
+        def bench(zigzag):
+            f = jax.jit(jax.grad(
+                lambda q_, k_, v_: jnp.sum(dist.ring_attention(
+                    q_, k_, v_, mesh, causal=True, zigzag=zigzag,
+                    use_pallas=False) * do), argnums=(0, 1, 2)))
+            r = f(q, k, v)
+            jax.block_until_ready(r)
+            t0 = time.perf_counter()
+            for _ in range(3):
+                r = f(q, k, v)
+            jax.block_until_ready(r)
+            return (time.perf_counter() - t0) / 3
+
+        t_plain = bench(False)
+        t_zz = bench(True)
+        speedup = t_plain / t_zz
+        print(f"\nzigzag speedup (n=8, s={s}, fwd+bwd): {speedup:.2f}x "
+              f"({t_plain*1e3:.0f}ms -> {t_zz*1e3:.0f}ms)")
+        assert speedup >= 1.5, speedup

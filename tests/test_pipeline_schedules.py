@@ -410,10 +410,12 @@ class TestStoreActivationsMode:
         eff = {(p, m, v): e for p, m, v, _, e, _ in rows}
         # more microbatches amortize the bubble
         assert eff[(4, 16, 1)] > eff[(4, 8, 1)]
-        # store mode does 2/3 the compute of remat per tick
+        # store mode skips the remat forward: 3 vs 4 fwd-units per tick
+        # (bwd alone ~2 fwd) — model ratio 1.33x; bench.py pp measures
+        # the real on-chip overhead
         s = build_pipeline_schedule(4, 16, 1, "1F1B")
         assert s.chunk_cost_per_tick(remat=False) \
-            == pytest.approx(s.chunk_cost_per_tick(remat=True) * 2 / 3)
+            == pytest.approx(s.chunk_cost_per_tick(remat=True) * 3 / 4)
 
     def test_res_buf_bounded(self):
         # residual slots stay O(p [* v]), never O(m): the 1F1B memory
