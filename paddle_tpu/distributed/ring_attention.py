@@ -420,8 +420,19 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = True,
         check_vma=False)
     if not zigzag:
         return f(q, k, v)
+    # the permutation is a cross-shard all-to-all; re-pin the layouts so
+    # the permuted operands and the final output keep the documented
+    # seq-sharded placement instead of decaying to replicated
+    ns = jax.sharding.NamedSharding(jmesh, spec)
+
+    def pin(x):
+        if isinstance(x, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(x, ns)
+        return jax.device_put(x, ns)
+
     order = jnp.asarray(zigzag_indices(q.shape[1], n))
     inv = jnp.asarray(inverse_zigzag_indices(q.shape[1], n))
-    out = f(jnp.take(q, order, axis=1), jnp.take(k, order, axis=1),
-            jnp.take(v, order, axis=1))
-    return jnp.take(out, inv, axis=1)
+    out = f(pin(jnp.take(q, order, axis=1)),
+            pin(jnp.take(k, order, axis=1)),
+            pin(jnp.take(v, order, axis=1)))
+    return pin(jnp.take(out, inv, axis=1))

@@ -8,5 +8,6 @@ from . import nn  # noqa: F401
 from . import autograd  # noqa: F401
 from . import asp  # noqa: F401
 from . import optimizer  # noqa: F401
+from . import distributed  # noqa: F401
 
-__all__ = ["nn", "autograd", "asp", "optimizer"]
+__all__ = ["nn", "autograd", "asp", "optimizer", "distributed"]

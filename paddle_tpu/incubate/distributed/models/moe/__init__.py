@@ -1,0 +1,8 @@
+"""incubate.distributed.models.moe (parity:
+/root/reference/python/paddle/incubate/distributed/models/moe/): the
+MoELayer itself lives in paddle_tpu.nn (nn.MoELayer); this namespace
+carries the MoE training utilities — notably the MoE-aware global-norm
+gradient clip."""
+from .grad_clip import ClipGradForMOEByGlobalNorm
+
+__all__ = ["ClipGradForMOEByGlobalNorm"]

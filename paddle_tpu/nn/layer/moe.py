@@ -105,16 +105,40 @@ class MoELayer(Layer):
         ep_sharding = self._ep_sharding()
 
         def f(xa, gw, w1, w2):
-            out, aux = moe_dispatch_combine(
+            out, aux, stats = moe_dispatch_combine(
                 xa, gw, w1, w2, self.top_k, self.capacity_factor, act,
                 ep_sharding)
-            return out, aux
+            return (out, aux, stats["tokens_per_expert"],
+                    stats["assigned_per_expert"],
+                    stats["dropped_fraction"], stats["capacity"])
 
-        out, aux = apply("moe", f, x, self.gate_weight, self.w1, self.w2)
+        out, aux, routed, assigned, dropped, cap = apply(
+            "moe", f, x, self.gate_weight, self.w1, self.w2)
         self._aux_loss = aux
+        if isinstance(routed._value, jax.core.Tracer):
+            # inside a compiled program the stats are traced values that
+            # must not leak out of the trace; None (not stale numbers)
+            self._last_stats = None
+        else:
+            self._last_stats = {
+                "tokens_per_expert": routed,
+                "assigned_per_expert": assigned,
+                "dropped_fraction": dropped,
+                "capacity": cap,
+            }
         return out
 
     @property
     def aux_loss(self) -> Optional[Tensor]:
         """Load-balancing loss of the last forward (add to the train loss)."""
         return self._aux_loss
+
+    @property
+    def routing_stats(self) -> Optional[dict]:
+        """Expert-utilization / capacity-overflow diagnostics of the last
+        EAGER forward (reference surfaces these through the moe utils
+        counters): tokens_per_expert, assigned_per_expert,
+        dropped_fraction, capacity — Tensors, fetch with .numpy().
+        None when the last forward ran inside a compiled program (run
+        one eager forward to sample routing)."""
+        return getattr(self, "_last_stats", None)

@@ -24,7 +24,12 @@ def topk_gating(logits, top_k: int, capacity: int):
 
     logits [T, E] → (dispatch [T, E, C] bool-ish f32,
                      combine  [T, E, C] f32 weights,
-                     aux_loss scalar)
+                     aux_loss scalar,
+                     stats dict: tokens_per_expert [E] (routed within
+                     capacity), assigned_per_expert [E] (pre-capacity),
+                     dropped_fraction scalar — the capacity-overflow
+                     diagnostics the reference MoE surfaces via
+                     moe/grad_clip + utils counters)
     """
     t, e = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
@@ -61,17 +66,24 @@ def topk_gating(logits, top_k: int, capacity: int):
         combine = combine + d * (gate / denom)[:, None, None]
         prior_fill = prior_fill + mask.sum(axis=0)
 
-    return dispatch, combine, aux_loss
+    assigned = sum(m.sum(axis=0) for m in masks)       # [E] pre-capacity
+    routed = dispatch.sum(axis=(0, 2))                 # [E] within capacity
+    dropped = 1.0 - routed.sum() / jnp.maximum(assigned.sum(), 1.0)
+    stats = {"tokens_per_expert": routed,
+             "assigned_per_expert": assigned,
+             "dropped_fraction": dropped}
+    return dispatch, combine, aux_loss, stats
 
 
 def moe_dispatch_combine(x, gate_w, w1, w2, top_k: int,
                          capacity_factor: float, activation=jax.nn.gelu,
                          ep_sharding=None):
-    """Full MoE FFN: x [B, S, D] → (out [B, S, D], aux_loss).
+    """Full MoE FFN: x [B, S, D] → (out [B, S, D], aux_loss, stats).
 
     w1 [E, D, H], w2 [E, H, D]. When ep_sharding (a NamedSharding for the
     [E, C, D] expert-batch layout) is given, the dispatched tensor gets a
     sharding constraint so GSPMD all-to-alls tokens to expert shards.
+    stats: see topk_gating (expert utilization + token-drop counters).
     """
     b, s, d = x.shape
     tokens = x.reshape(b * s, d)
@@ -82,7 +94,8 @@ def moe_dispatch_combine(x, gate_w, w1, w2, top_k: int,
     capacity = -(-capacity // 8) * 8
 
     logits = tokens.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-    dispatch, combine, aux = topk_gating(logits, top_k, capacity)
+    dispatch, combine, aux, stats = topk_gating(logits, top_k, capacity)
+    stats = dict(stats, capacity=jnp.float32(capacity))
 
     expert_in = jnp.einsum("tec,td->ecd", dispatch.astype(x.dtype), tokens)
     if ep_sharding is not None:
@@ -92,7 +105,7 @@ def moe_dispatch_combine(x, gate_w, w1, w2, top_k: int,
     if ep_sharding is not None:
         expert_out = jax.lax.with_sharding_constraint(expert_out, ep_sharding)
     out = jnp.einsum("tec,ecd->td", combine.astype(x.dtype), expert_out)
-    return out.reshape(b, s, d), aux
+    return out.reshape(b, s, d), aux, stats
 
 
 moe_mlp_forward = moe_dispatch_combine
