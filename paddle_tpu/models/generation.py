@@ -2,12 +2,13 @@
 
 Reference analog: the serving decode loops built on
 block_multihead_attention + masked_multihead_attention
-(/root/reference/python/paddle/incubate/nn/functional/). TPU-native
-structure: two compiled programs — prefill (prompt chunk, fills the
-caches) and a single-token decode step (traced position into fixed
-[b, max_len] caches, donated so updates happen in-place in HBM). The
-Python loop only replays the compiled decode step: no per-step
-recompiles, no dynamic shapes.
+(/root/reference/python/paddle/incubate/nn/functional/), plus the
+BeamSearchDecoder semantics (/root/reference/python/paddle/nn/
+decode.py:153) for beam_search. TPU-native structure: two compiled
+programs — prefill (prompt chunk, fills the caches) and a single-token
+decode step (traced position into fixed [b, max_len] caches, donated so
+updates happen in-place in HBM). The Python loop only replays the
+compiled decode step: no per-step recompiles, no dynamic shapes.
 """
 from __future__ import annotations
 
@@ -19,25 +20,42 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..jit import functional_call
 
-__all__ = ["generate"]
+__all__ = ["generate", "beam_search"]
 
 
-def _sample(logits, temperature, top_k, key):
+def _sample(logits, temperature, top_k, key, top_p=1.0):
     if temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     logits = logits / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # whose mass reaches top_p (the top token always survives)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff_mask = cum - probs > top_p         # tokens past the mass
+        kth_val = jnp.where(cutoff_mask, jnp.inf, sorted_logits).min(
+            axis=-1, keepdims=True)
+        logits = jnp.where(logits < kth_val, -1e30, logits)
     return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
 
-def generate(model, input_ids, max_new_tokens: int = 32,
-             max_length: Optional[int] = None, temperature: float = 0.0,
-             top_k: int = 0, eos_token_id: Optional[int] = None,
-             seed: int = 0):
-    """Returns a Tensor [batch, prompt_len + generated] of token ids
-    (prompt included). Greedy when temperature == 0."""
+def _apply_repetition_penalty(logits, seen_mask, penalty):
+    """HF/reference semantics: for already-generated tokens, divide
+    positive logits by `penalty` and multiply negative ones."""
+    if penalty == 1.0:
+        return logits
+    penalized = jnp.where(logits > 0, logits / penalty, logits * penalty)
+    return jnp.where(seen_mask, penalized, logits)
+
+
+def _setup_decode(model, input_ids, max_new_tokens, max_length):
+    """Shared generate/beam_search preamble: unwrap ids, bound the new-
+    token budget, collect param/buffer arrays (same ordering
+    functional_call uses), and allocate the static KV caches."""
     cfg = model.cfg
     ids = input_ids._value if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
@@ -46,38 +64,57 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     max_length = max_length or min(cfg.max_position_embeddings,
                                    prompt_len + max_new_tokens)
     n_new = min(max_new_tokens, max_length - prompt_len)
-    if n_new <= 0:
-        return Tensor(ids)
-
     model.eval()
-    # same collection functional_call uses internally — ordering must match
     from ..jit import _collect
     params, buffers = _collect(model)
     p_arrays = [p._value for _, p in params]
     b_arrays = [bf._value for _, bf in buffers]
-    n_layers = cfg.num_hidden_layers
     kv_heads = cfg.num_key_value_heads
     head_dim = cfg.hidden_size // cfg.num_attention_heads
     dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-
     caches = [(jnp.zeros((b, max_length, kv_heads, head_dim), dtype),
                jnp.zeros((b, max_length, kv_heads, head_dim), dtype))
-              for _ in range(n_layers)]
+              for _ in range(cfg.num_hidden_layers)]
+    return ids, b, prompt_len, n_new, p_arrays, b_arrays, caches
 
-    def step(pa, ba, chunk, caches_in, pos, key):
+
+def generate(model, input_ids, max_new_tokens: int = 32,
+             max_length: Optional[int] = None, temperature: float = 0.0,
+             top_k: int = 0, eos_token_id: Optional[int] = None,
+             seed: int = 0, top_p: float = 1.0,
+             repetition_penalty: float = 1.0):
+    """Returns a Tensor [batch, prompt_len + generated] of token ids
+    (prompt included). Greedy when temperature == 0; top_k/top_p
+    filtering and repetition penalty follow the reference generate
+    semantics. (New kwargs append after the r2 signature so positional
+    callers keep their meaning.)"""
+    cfg = model.cfg
+    ids, b, prompt_len, n_new, p_arrays, b_arrays, caches = \
+        _setup_decode(model, input_ids, max_new_tokens, max_length)
+    if n_new <= 0:
+        return Tensor(ids)
+
+    def step(pa, ba, chunk, caches_in, pos, key, seen_mask):
         (logits, new_caches), _ = functional_call(
             model, pa, ba, (chunk,),
             kwargs={"caches": caches_in, "pos": pos})
-        next_tok = _sample(logits[:, -1, :], temperature, top_k, key)
+        last = _apply_repetition_penalty(logits[:, -1, :], seen_mask,
+                                         repetition_penalty)
+        next_tok = _sample(last, temperature, top_k, key, top_p)
         return next_tok, new_caches
 
     prefill_j = jax.jit(step)
     decode_j = jax.jit(step, donate_argnums=(3,))
 
+    # token-presence mask for the repetition penalty (prompt + generated)
+    seen = jnp.zeros((b, cfg.vocab_size), bool)
+    if repetition_penalty != 1.0:
+        seen = seen.at[jnp.arange(b)[:, None], ids].set(True)
+
     key = jax.random.PRNGKey(seed)
     key, k0 = jax.random.split(key)
     tok, caches = prefill_j(p_arrays, b_arrays, ids, caches,
-                            jnp.int32(0), k0)
+                            jnp.int32(0), k0, seen)
     out_tokens = [tok]
     pos = prompt_len
     finished = jnp.zeros((b,), bool)
@@ -86,9 +123,11 @@ def generate(model, input_ids, max_new_tokens: int = 32,
     for _ in range(n_new - 1):
         if eos_token_id is not None and bool(finished.all()):
             break
+        if repetition_penalty != 1.0:
+            seen = seen.at[jnp.arange(b), tok].set(True)
         key, kd = jax.random.split(key)
         tok, caches = decode_j(p_arrays, b_arrays, tok[:, None], caches,
-                               jnp.int32(pos), kd)
+                               jnp.int32(pos), kd, seen)
         if eos_token_id is not None:
             tok = jnp.where(finished, eos_token_id, tok)
             finished = finished | (tok == eos_token_id)
@@ -96,3 +135,126 @@ def generate(model, input_ids, max_new_tokens: int = 32,
         pos += 1
     gen = jnp.stack(out_tokens, axis=1)
     return Tensor(jnp.concatenate([ids, gen], axis=1))
+
+
+def _lp_array(lengths, alpha):
+    """Elementwise GNMT length penalty over a [b, nb] length array."""
+    if alpha == 0.0:
+        return jnp.ones_like(lengths, dtype=jnp.float32)
+    return ((5.0 + lengths.astype(jnp.float32)) / 6.0) ** alpha
+
+
+def beam_step(scores, logp, finished, eos_token_id, lengths=None,
+              length_penalty=0.0):
+    """One beam-search expansion (shared by models.beam_search and
+    nn.dynamic_decode): scores [b, nb], logp [b, nb, V], finished
+    [b, nb], lengths [b, nb] (generated tokens so far, FROZEN at eos)
+    → (new_scores, beam_idx, tok_idx, new_finished, new_lengths).
+
+    Finished beams continue by emitting eos at logp 0 with frozen
+    length. Candidates rank by score / lp(candidate_length) — a
+    per-candidate penalty, so finished short hypotheses genuinely
+    compete against longer live ones (the reference BeamSearchDecoder
+    ranks by raw score, i.e. length_penalty=0). Accumulated scores stay
+    raw; apply the penalty again for the final selection."""
+    b, nb, vocab = logp.shape
+    if lengths is None:
+        lengths = jnp.zeros((b, nb), jnp.int32)
+    if eos_token_id is not None:
+        eos_row = jnp.full((vocab,), -jnp.inf, jnp.float32) \
+            .at[eos_token_id].set(0.0)
+        logp = jnp.where(finished[:, :, None], eos_row[None, None], logp)
+    cand = scores[:, :, None] + logp
+    # candidate length: live beams grow by one, finished stay frozen
+    cand_len = jnp.where(finished, lengths, lengths + 1)    # [b, nb]
+    rank = cand / _lp_array(cand_len, length_penalty)[:, :, None]
+    _, top_idx = jax.lax.top_k(rank.reshape(b, nb * vocab), nb)
+    beam_idx = (top_idx // vocab).astype(jnp.int32)
+    tok_idx = (top_idx % vocab).astype(jnp.int32)
+    new_scores = jnp.take_along_axis(cand.reshape(b, nb * vocab),
+                                     top_idx, axis=1)
+    new_finished = jnp.take_along_axis(finished, beam_idx, axis=1)
+    new_lengths = jnp.take_along_axis(cand_len, beam_idx, axis=1)
+    if eos_token_id is not None:
+        new_finished = new_finished | (tok_idx == eos_token_id)
+    return new_scores, beam_idx, tok_idx, new_finished, new_lengths
+
+
+def beam_search(model, input_ids, num_beams: int = 4,
+                max_new_tokens: int = 32, length_penalty: float = 0.0,
+                eos_token_id: Optional[int] = None,
+                max_length: Optional[int] = None):
+    """Beam-search decode over the KV caches (reference semantics:
+    BeamSearchDecoder, /root/reference/python/paddle/nn/decode.py:153 —
+    candidates ranked by cumulative log-prob scaled by the GNMT length
+    penalty; finished beams propagate by emitting eos at logp 0; early
+    stop when every beam is finished).
+
+    Returns a Tensor [batch, prompt_len + generated] with the best beam
+    per batch element (prompt included).
+    """
+    nb = int(num_beams)
+    ids, b, prompt_len, n_new, p_arrays, b_arrays, caches = \
+        _setup_decode(model, input_ids, max_new_tokens, max_length)
+    if n_new <= 0:
+        return Tensor(ids)
+
+    def prefill(pa, ba, chunk, caches_in):
+        (logits, new_caches), _ = functional_call(
+            model, pa, ba, (chunk,),
+            kwargs={"caches": caches_in, "pos": jnp.int32(0)})
+        return jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32), axis=-1), new_caches
+
+    def decode(pa, ba, toks, caches_in, pos, beam_sel):
+        # reorder each cache row to its surviving parent beam, then step
+        caches_in = jax.tree_util.tree_map(
+            lambda c: c[beam_sel], caches_in)
+        (logits, new_caches), _ = functional_call(
+            model, pa, ba, (toks[:, None],),
+            kwargs={"caches": caches_in, "pos": pos})
+        return jax.nn.log_softmax(
+            logits[:, -1, :].astype(jnp.float32), axis=-1), new_caches
+
+    prefill_j = jax.jit(prefill)
+    decode_j = jax.jit(decode, donate_argnums=(3,))
+
+    logp0, caches = prefill_j(p_arrays, b_arrays, ids, caches)
+    vocab = logp0.shape[-1]
+    # tile caches across beams: [b, ...] -> [b*nb, ...]
+    caches = jax.tree_util.tree_map(
+        lambda c: jnp.repeat(c, nb, axis=0), caches)
+
+    # first expansion: top nb continuations of the single prompt
+    scores, toks = jax.lax.top_k(logp0, nb)            # [b, nb]
+    toks = toks.astype(jnp.int32)
+    history = toks[:, :, None]                         # [b, nb, 1]
+    finished = jnp.zeros((b, nb), bool)
+    if eos_token_id is not None:
+        finished = toks == eos_token_id
+    lengths = jnp.ones((b, nb), jnp.int32)
+    beam_sel = jnp.arange(b * nb, dtype=jnp.int32)
+    pos = prompt_len
+    for t in range(1, n_new):
+        if eos_token_id is not None and bool(finished.all()):
+            break
+        logp, caches = decode_j(p_arrays, b_arrays,
+                                toks.reshape(b * nb), caches,
+                                jnp.int32(pos), beam_sel)
+        logp = logp.reshape(b, nb, vocab)
+        scores, beam_idx, toks, finished, lengths = beam_step(
+            scores, logp, finished, eos_token_id, lengths,
+            length_penalty)
+        history = jnp.concatenate(
+            [jnp.take_along_axis(history, beam_idx[:, :, None], axis=1),
+             toks[:, :, None]], axis=2)
+        beam_sel = (jnp.arange(b, dtype=jnp.int32)[:, None] * nb
+                    + beam_idx).reshape(b * nb)
+        pos += 1
+
+    final_rank = scores / _lp_array(lengths, length_penalty)
+    best = jnp.argmax(final_rank, axis=1)
+    best_seq = jnp.take_along_axis(
+        history, best[:, None, None], axis=1)[:, 0]    # [b, gen_len]
+    return Tensor(jnp.concatenate([ids, best_seq.astype(jnp.int32)],
+                                  axis=1))

@@ -319,18 +319,38 @@ class LlamaForCausalLM(nn.Layer):
     def generate(self, input_ids, max_new_tokens: int = 32,
                  max_length: Optional[int] = None,
                  temperature: float = 0.0, top_k: int = 0,
-                 eos_token_id: Optional[int] = None, seed: int = 0):
+                 eos_token_id: Optional[int] = None, seed: int = 0,
+                 top_p: float = 1.0, repetition_penalty: float = 1.0,
+                 num_beams: int = 1, length_penalty: float = 0.0):
         """KV-cached autoregressive generation (the serving decode loop —
         reference analog: the generation path over
         block_multihead_attention). Prefill compiles once, the
         single-token decode step compiles once (static cache shapes,
         traced position), then every step is a fast replay.
+        num_beams > 1 switches to deterministic beam search (per-beam
+        GNMT length penalty, eos early-stop) — sampling knobs don't
+        combine with it and are rejected. (New kwargs append after the
+        r2 signature so positional callers keep their meaning.)
         """
+        if num_beams > 1:
+            if temperature > 0 or top_k > 0 or top_p < 1.0 \
+                    or repetition_penalty != 1.0:
+                raise ValueError(
+                    "num_beams > 1 is deterministic beam search; "
+                    "temperature/top_k/top_p/repetition_penalty do not "
+                    "apply — drop them or use num_beams=1 sampling")
+            from .generation import beam_search as _beam
+            return _beam(self, input_ids, num_beams=num_beams,
+                         max_new_tokens=max_new_tokens,
+                         length_penalty=length_penalty,
+                         eos_token_id=eos_token_id,
+                         max_length=max_length)
         from .generation import generate as _generate
         return _generate(self, input_ids, max_new_tokens=max_new_tokens,
                          max_length=max_length, temperature=temperature,
-                         top_k=top_k, eos_token_id=eos_token_id,
-                         seed=seed)
+                         top_k=top_k, top_p=top_p,
+                         repetition_penalty=repetition_penalty,
+                         eos_token_id=eos_token_id, seed=seed)
 
 
 def llama_tiny(**kw) -> LlamaConfig:

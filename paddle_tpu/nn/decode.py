@@ -1,0 +1,184 @@
+"""Seq2seq decoding API (parity:
+/root/reference/python/paddle/nn/decode.py — Decoder base,
+BeamSearchDecoder:153, dynamic_decode:994).
+
+The reference drives a cell step-by-step through a while_loop with beam
+bookkeeping in-graph. TPU-native: the beam expansion math is the shared
+jnp core (models.generation.beam_step — same code the causal-LM
+beam_search uses); the cell steps run eagerly over Tensors (cells are
+tiny — the compiled-decode fast path for LLM serving lives in
+models.generation / inference.ServingEngine).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Tensor
+
+__all__ = ["Decoder", "BeamSearchDecoder", "dynamic_decode"]
+
+
+class Decoder:
+    """Abstract decoder interface. THIS driver's contract (which is
+    narrower than the reference's — dynamic_decode here drives beam
+    decoding only):
+
+    - ``end_token`` attribute (int);
+    - ``initialize(inits) -> (ids, states, scores, finished)`` with ids
+      [batch*beam] int32, scores/finished [batch, beam];
+    - ``step(time, ids, states, scores, finished, lengths, **kw) ->
+      (tok_idx, beam_idx, scores, finished, lengths, next_ids,
+      new_states)``;
+    - ``finalize(predicted_ids, parent_idx, scores) -> [b, T, beam]``
+      numpy token array (parent-pointer backtracking).
+    """
+
+    def initialize(self, inits):
+        raise NotImplementedError
+
+    def step(self, time, ids, states, scores, finished, lengths,
+             **kwargs):
+        raise NotImplementedError
+
+    def finalize(self, predicted_ids, parent_idx, scores):
+        raise NotImplementedError
+
+
+def _tile_beam(x, beam_size):
+    """[batch, ...] -> [batch * beam, ...] (repeat each row)."""
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    return Tensor(jnp.repeat(a, beam_size, axis=0))
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search wrapper over an RNN cell (reference
+    BeamSearchDecoder, decode.py:153).
+
+    cell(input, states) -> (output, new_states); embedding_fn maps
+    selected ids to the next input; output_fn maps cell output to
+    vocab logits.
+    """
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = int(start_token)
+        self.end_token = int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    @staticmethod
+    def tile_beam_merge_with_batch(x, beam_size):
+        """Tile a [batch, ...] tensor to [batch * beam, ...] (for
+        encoder outputs used inside the cell)."""
+        return _tile_beam(x, beam_size)
+
+    # -- Decoder interface ---------------------------------------------------
+    def initialize(self, initial_cell_states):
+        nb = self.beam_size
+        states = jax.tree_util.tree_map(
+            lambda t: _tile_beam(t, nb), initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        leaves = jax.tree_util.tree_leaves(
+            initial_cell_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        b = leaves[0].shape[0]
+        ids = jnp.full((b * nb,), self.start_token, jnp.int32)
+        # beam 0 carries the live hypothesis; the rest start dead so the
+        # first expansion doesn't pick duplicates
+        scores = jnp.tile(
+            jnp.asarray([0.0] + [-1e30] * (nb - 1), jnp.float32), (b, 1))
+        finished = jnp.zeros((b, nb), bool)
+        return ids, states, scores, finished
+
+    def _logits(self, ids, states):
+        inp = Tensor(ids)
+        if self.embedding_fn is not None:
+            inp = self.embedding_fn(inp)
+        out, new_states = self.cell(inp, states)
+        if self.output_fn is not None:
+            out = self.output_fn(out)
+        return out, new_states
+
+    def step(self, time, ids, states, scores, finished, lengths,
+             **kwargs):
+        from ..models.generation import beam_step
+        nb = self.beam_size
+        out, new_states = self._logits(ids, states)
+        logits = out._value.astype(jnp.float32)
+        b = logits.shape[0] // nb
+        logp = jax.nn.log_softmax(logits, axis=-1).reshape(b, nb, -1)
+        scores, beam_idx, tok_idx, finished, lengths = beam_step(
+            scores, logp, finished, self.end_token, lengths)
+        sel = (jnp.arange(b, dtype=jnp.int32)[:, None] * nb
+               + beam_idx).reshape(b * nb)
+        new_states = jax.tree_util.tree_map(
+            lambda t: Tensor(t._value[sel]), new_states,
+            is_leaf=lambda t: isinstance(t, Tensor))
+        return (tok_idx, beam_idx, scores, finished, lengths,
+                tok_idx.reshape(b * nb), new_states)
+
+    def finalize(self, predicted_ids, parent_idx, scores):
+        """Backtrack parent pointers into full sequences
+        [batch, time, beam] (reference gather_tree semantics)."""
+        t_max = len(predicted_ids)
+        b, nb = scores.shape
+        seqs = np.zeros((b, t_max, nb), np.int32)
+        # walk backwards following parents
+        cur_parent = np.tile(np.arange(nb, dtype=np.int32), (b, 1))
+        for t in range(t_max - 1, -1, -1):
+            toks = np.asarray(predicted_ids[t])
+            pars = np.asarray(parent_idx[t])
+            seqs[:, t, :] = np.take_along_axis(toks, cur_parent, axis=1)
+            cur_parent = np.take_along_axis(pars, cur_parent, axis=1)
+        return seqs
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=None,
+                   output_time_major=False, impute_finished=False,
+                   is_test=False, return_length=False, **kwargs):
+    """Drive decoder.step until every beam finishes or max_step_num
+    (reference dynamic_decode, decode.py:994). Returns
+    (predicted_ids Tensor [batch, time, beam] — or time-major — sorted
+    best-first, final_states[, sequence_lengths]).
+
+    is_test is accepted (a memory hint with no effect here — the eager
+    loop already keeps only per-step ids). impute_finished is NOT
+    implemented: states of finished beams keep evolving (their outputs
+    are frozen to eos regardless); requesting it is rejected rather
+    than silently ignored."""
+    if impute_finished:
+        raise NotImplementedError(
+            "dynamic_decode(impute_finished=True): state imputation for "
+            "finished beams is not implemented; final_states of "
+            "finished beams reflect continued (discarded) steps")
+    ids, states, scores, finished = decoder.initialize(inits)
+    lengths0 = jnp.zeros_like(scores, dtype=jnp.int32)
+    lengths = lengths0
+    max_steps = max_step_num if max_step_num is not None else 256
+    pred_steps = []
+    parent_steps = []
+    for t in range(int(max_steps)):
+        (tok_idx, beam_idx, scores, finished, lengths, ids,
+         states) = decoder.step(t, ids, states, scores, finished,
+                                lengths, **kwargs)
+        pred_steps.append(np.asarray(tok_idx))
+        parent_steps.append(np.asarray(beam_idx))
+        if bool(np.asarray(finished).all()):
+            break
+    seqs = decoder.finalize(pred_steps, parent_steps, scores)  # [b,T,nb]
+    # order beams best-first by final score
+    order = np.argsort(-np.asarray(scores), axis=1)
+    seqs = np.take_along_axis(seqs, order[:, None, :], axis=2)
+    end = decoder.end_token
+    lengths = (seqs != end).cumprod(axis=1).sum(axis=1)  # pre-eos length
+    out = seqs.transpose(1, 0, 2) if output_time_major else seqs
+    result = (Tensor(jnp.asarray(out)), states)
+    if return_length:
+        result = result + (Tensor(jnp.asarray(lengths.astype(np.int64))),)
+    return result

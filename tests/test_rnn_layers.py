@@ -353,3 +353,73 @@ class TestCTCLossFunctional:
             reduction="none")
         np.testing.assert_allclose(float(n(loss)[0]), -np.log(total),
                                    rtol=1e-4)
+
+
+class TestBeamSearchDecoderAPI:
+    """nn.BeamSearchDecoder + dynamic_decode (parity:
+    /root/reference/python/paddle/nn/decode.py:153, :994)."""
+
+    def _build(self, beam_size, vocab=20, hidden=16):
+        import paddle_tpu as paddle
+        paddle.seed(0)
+        emb = nn.Embedding(vocab, hidden)
+        out_fc = nn.Linear(hidden, vocab)
+        cell = nn.GRUCell(hidden, hidden)
+        dec = nn.BeamSearchDecoder(cell, start_token=0, end_token=1,
+                                   beam_size=beam_size,
+                                   embedding_fn=emb, output_fn=out_fc)
+        return dec, cell, emb, out_fc
+
+    def test_beam1_equals_greedy_rollout(self):
+        import paddle_tpu as paddle
+        dec, cell, emb, out_fc = self._build(beam_size=1)
+        b, hidden = 2, 16
+        h0 = paddle.to_tensor(
+            rng.randn(b, hidden).astype(np.float32))
+        seqs, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=6)
+        got = n(seqs)[:, :, 0]                      # [b, T]
+        # greedy oracle: step the cell by hand
+        ids = np.zeros((b,), np.int32)
+        h = h0
+        want = []
+        for _ in range(got.shape[1]):
+            x = emb(paddle.to_tensor(ids))
+            o, h = cell(x, h)
+            logits = n(out_fc(o))
+            ids = logits.argmax(-1).astype(np.int32)
+            want.append(ids.copy())
+        np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+    def test_beam4_shapes_order_and_eos(self):
+        import paddle_tpu as paddle
+        dec, *_ = self._build(beam_size=4)
+        h0 = paddle.to_tensor(rng.randn(3, 16).astype(np.float32))
+        seqs, states, lengths = nn.dynamic_decode(
+            dec, inits=h0, max_step_num=8, return_length=True)
+        s = n(seqs)
+        assert s.shape[0] == 3 and s.shape[2] == 4
+        ln = n(lengths)
+        assert ln.shape == (3, 4)
+        # after an eos, a finished beam only emits eos
+        for bi in range(3):
+            for k in range(4):
+                row = s[bi, :, k].tolist()
+                if 1 in row:
+                    after = row[row.index(1):]
+                    assert all(t == 1 for t in after)
+
+    def test_time_major_layout(self):
+        import paddle_tpu as paddle
+        dec, *_ = self._build(beam_size=2)
+        h0 = paddle.to_tensor(rng.randn(2, 16).astype(np.float32))
+        a, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=5)
+        b_, _ = nn.dynamic_decode(dec, inits=h0, max_step_num=5,
+                                  output_time_major=True)
+        np.testing.assert_array_equal(n(a).transpose(1, 0, 2), n(b_))
+
+    def test_tile_beam_merge_with_batch(self):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+        t_ = nn.BeamSearchDecoder.tile_beam_merge_with_batch(x, 2)
+        np.testing.assert_array_equal(
+            n(t_), np.repeat(np.arange(6).reshape(2, 3), 2, axis=0))
