@@ -37,6 +37,8 @@ from .ring_attention import (  # noqa: F401
     ring_attention, ring_attention_local, zigzag_indices,
     inverse_zigzag_indices,
 )
+from .compat import *  # noqa: F401,F403
+from .compat import __all__ as _compat_all
 
 __all__ = [
     "init_parallel_env", "get_rank", "get_world_size", "DataParallel",
@@ -44,5 +46,15 @@ __all__ = [
     "shard_tensor", "reshard", "shard_layer", "shard_optimizer",
     "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
     "reduce_scatter", "scatter", "gather", "barrier", "send", "recv",
-    "new_group", "ReduceOp", "fleet", "checkpoint",
-]
+    "new_group", "ReduceOp", "fleet", "checkpoint", "Strategy",
+] + _compat_all
+
+
+def __getattr__(name):
+    # lazy: auto_parallel imports fleet which imports this package —
+    # resolving Strategy at first access breaks the cycle
+    if name == "Strategy":
+        from .auto_parallel import Strategy
+        return Strategy
+    raise AttributeError(
+        f"module 'paddle_tpu.distributed' has no attribute {name!r}")

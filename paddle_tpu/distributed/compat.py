@@ -1,0 +1,261 @@
+"""paddle.distributed long-tail parity (reference
+python/paddle/distributed/__init__.py exports beyond the core that
+paddle_tpu.distributed already implements).
+
+REAL: enums (ReduceType/ParallelMode), object collectives (trivially
+exact in single-controller mode — every process sees the global
+objects), alltoall aliases, split (megatron-style layer splitter),
+process-group state queries, checkpoint re-exports, shard_dataloader,
+dtensor to_static/DistModel wrappers, distributed.io.
+LOUD STUBS: parameter-server datasets/entries (COVERAGE.md descope).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+__all__ = [
+    "ReduceType", "ParallelMode", "DistAttr", "DistModel",
+    "all_gather_object", "broadcast_object_list", "scatter_object_list",
+    "alltoall", "alltoall_single", "split", "destroy_process_group",
+    "get_backend", "is_available", "is_initialized", "gloo_barrier",
+    "gloo_init_parallel_env", "gloo_release", "load_state_dict",
+    "save_state_dict", "shard_dataloader", "to_static", "io",
+    "InMemoryDataset", "QueueDataset", "CountFilterEntry",
+    "ProbabilityEntry", "ShowClickEntry",
+]
+
+
+class ReduceType:
+    """Reference paddle.distributed.ReduceType (auto-parallel partial
+    reductions)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class ParallelMode:
+    """Reference paddle.distributed.ParallelMode."""
+    DATA_PARALLEL = 0
+    TENSOR_PARALLEL = 1
+    PIPELINE_PARALLEL = 2
+    SHARDING_PARALLEL = 3
+
+
+def DistAttr(mesh=None, sharding_specs=None):
+    """Reference dist_attr factory: here the (mesh, placements) pair IS
+    the dist attr — returns it as a dict consumable by shard_tensor."""
+    return {"process_mesh": mesh, "sharding_specs": sharding_specs}
+
+
+
+
+class DistModel:
+    """Reference auto-parallel DistModel (api.py:983): a to_static'd
+    model + optimizer driven by the compiled sharded step. Thin wrapper
+    over fleet.auto.Engine."""
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        from .auto_parallel import Engine
+        self._engine = Engine(model=layer, loss=loss,
+                              optimizer=optimizer, strategy=strategy)
+        self._mode = "train" if optimizer is not None else "predict"
+
+    def train(self):
+        self._mode = "train"
+
+    def eval(self):
+        self._mode = "eval"
+
+    def predict(self):
+        self._mode = "predict"
+
+    def __call__(self, *args):
+        if self._mode == "train":
+            self._engine.prepare("train")
+            x, y = args if len(args) == 2 else (args[0], args[0])
+            return self._engine._train_step(x, y)
+        self._engine.prepare("eval")
+        return self._engine._forward(args)
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None):
+    """Reference paddle.distributed.to_static → DistModel."""
+    return DistModel(layer, loader=loader, loss=loss,
+                     optimizer=optimizer, strategy=strategy)
+
+
+# -- object collectives ------------------------------------------------------
+# Single-controller SPMD: every process executes this SAME python, so
+# "the object on rank r" is already globally visible — the semantics of
+# the reference (pickle over the comm ring) reduce to identity/copies.
+
+def all_gather_object(object_list: List, obj, group=None):
+    import copy
+    from . import get_world_size
+    n = max(1, get_world_size())
+    object_list.clear()
+    object_list.extend(copy.deepcopy(obj) for _ in range(n))
+
+
+def broadcast_object_list(object_list: List, src=0, group=None):
+    return object_list
+
+
+def scatter_object_list(out_object_list: List, in_object_list=None,
+                        src=0, group=None):
+    from . import get_rank
+    if in_object_list is None:
+        raise ValueError("scatter_object_list needs in_object_list on "
+                         "the src rank (single-controller: pass it)")
+    out_object_list.clear()
+    out_object_list.append(in_object_list[get_rank()])
+
+
+# -- aliases / state ---------------------------------------------------------
+
+def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
+    from .collective import all_to_all
+    return all_to_all(out_tensor_list, in_tensor_list, group=group,
+                      sync_op=sync_op)
+
+
+def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
+                    out_split_sizes=None, group=None, sync_op=True):
+    """Single-tensor all-to-all (reference alltoall_single): the rank-
+    stacked emulation splits dim 0 across ranks."""
+    from .collective import all_to_all
+    return all_to_all(out_tensor, in_tensor, group=group,
+                      sync_op=sync_op)
+
+
+def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
+          weight_attr=None, bias_attr=None, name=None):
+    """Reference paddle.distributed.split auto-parallelizes a layer op
+    (embedding/linear) across ranks. The TPU-native form is the mpu
+    layer set; this wrapper routes to it."""
+    from .fleet import (ColumnParallelLinear, RowParallelLinear,
+                        VocabParallelEmbedding)
+    if operation == "embedding":
+        return VocabParallelEmbedding(size[0], size[1])
+    if operation == "linear":
+        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
+        return cls(size[0], size[1], gather_output=gather_out) \
+            if cls is ColumnParallelLinear else cls(size[0], size[1])
+    raise ValueError(f"unsupported split operation {operation!r}")
+
+
+_pg_alive = True
+
+
+def destroy_process_group(group=None):
+    global _pg_alive
+    _pg_alive = False
+
+
+def get_backend(group=None) -> str:
+    import jax
+    return "xla:" + jax.default_backend()
+
+
+def is_available() -> bool:
+    return True
+
+
+def is_initialized() -> bool:
+    from . import parallel
+    return getattr(parallel, "_initialized", False) and _pg_alive
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    from . import init_parallel_env
+    return init_parallel_env()
+
+
+def gloo_barrier():
+    from .collective import barrier
+    return barrier()
+
+
+def gloo_release():
+    destroy_process_group()
+
+
+def load_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    from .checkpoint import load_state_dict as _l
+    return _l(state_dict, path)
+
+
+def save_state_dict(state_dict, path, process_group=None,
+                    coordinator_rank=0):
+    from .checkpoint import save_state_dict as _s
+    return _s(state_dict, path)
+
+
+def shard_dataloader(dataloader, meshes=None, shard_dims=None,
+                     input_keys=None, is_dataset_splitted=False):
+    """Reference shard_dataloader wraps a loader so each rank reads its
+    split. Single-controller: the DataLoader already yields global
+    batches that shard_tensor placements split — return it unchanged
+    (documented identity, not a silent stub: the semantics hold)."""
+    return dataloader
+
+
+class _IONamespace:
+    """paddle.distributed.io (save/load for distributed programs)."""
+
+    @staticmethod
+    def save_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        from ..static.compat import save
+        return save(main_program, dirname + "/persistables")
+
+    @staticmethod
+    def load_persistables(executor, dirname, main_program=None,
+                          filename=None):
+        from ..static.compat import load
+        return load(main_program, dirname + "/persistables")
+
+
+io = _IONamespace()
+
+
+# -- parameter-server era (descoped; COVERAGE.md) ----------------------------
+
+def _ps_descope(name):
+    raise NotImplementedError(
+        f"{name} belongs to the parameter-server training stack, "
+        "deliberately descoped on TPU (SURVEY §2.5 item 15, "
+        "COVERAGE.md); use array-sharded embeddings (EP/MoE recipes) "
+        "instead")
+
+
+class InMemoryDataset:
+    def __init__(self, *a, **k):
+        _ps_descope("InMemoryDataset")
+
+
+class QueueDataset:
+    def __init__(self, *a, **k):
+        _ps_descope("QueueDataset")
+
+
+class CountFilterEntry:
+    def __init__(self, *a, **k):
+        _ps_descope("CountFilterEntry")
+
+
+class ProbabilityEntry:
+    def __init__(self, *a, **k):
+        _ps_descope("ProbabilityEntry")
+
+
+class ShowClickEntry:
+    def __init__(self, *a, **k):
+        _ps_descope("ShowClickEntry")

@@ -18,6 +18,7 @@ __all__ = [
     "segment_sum", "segment_mean", "segment_max", "segment_min",
     "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
     "sample_neighbors",
+    "reindex_heter_graph", "weighted_sample_neighbors",
 ]
 
 
@@ -162,6 +163,61 @@ def sample_neighbors(row, colptr, input_nodes, sample_size=-1,
         nbrs = r[cp[v]:cp[v + 1]]
         if 0 <= sample_size < len(nbrs):
             nbrs = rng.choice(nbrs, size=sample_size, replace=False)
+        out_n.extend(nbrs.tolist())
+        out_count.append(len(nbrs))
+    return (Tensor(jnp.asarray(out_n, jnp.int64)),
+            Tensor(jnp.asarray(out_count, jnp.int64)))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reference reindex_heter_graph):
+    like reindex_graph but neighbors/count are per-edge-type lists
+    sharing one id mapping."""
+    xa = np.asarray(x._value if isinstance(x, Tensor) else x)
+    uniq = list(dict.fromkeys(xa.tolist()))
+    mapping = {v: i for i, v in enumerate(uniq)}
+    out_nodes = list(uniq)
+    re_all, cnt_all = [], []
+    for nb, cnt in zip(neighbors, count):
+        nba = np.asarray(nb._value if isinstance(nb, Tensor) else nb)
+        ca = np.asarray(cnt._value if isinstance(cnt, Tensor) else cnt)
+        re = []
+        for v in nba.tolist():
+            if v not in mapping:
+                mapping[v] = len(out_nodes)
+                out_nodes.append(v)
+            re.append(mapping[v])
+        re_all.append(re)
+        cnt_all.append(ca)
+    flat = [v for re in re_all for v in re]
+    cnts = np.concatenate([np.asarray(c) for c in cnt_all])
+    return (Tensor(jnp.asarray(flat, jnp.int64)),
+            Tensor(jnp.asarray(out_nodes, xa.dtype)),
+            Tensor(jnp.asarray(cnts)))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size=-1, eids=None,
+                              return_eids=False, name=None):
+    """Weight-proportional neighbor sampling on CSC (reference
+    weighted_sample_neighbors). Host-side (data-loading path)."""
+    r = np.asarray(row._value if isinstance(row, Tensor) else row)
+    cp = np.asarray(colptr._value if isinstance(colptr, Tensor)
+                    else colptr)
+    w = np.asarray(edge_weight._value
+                   if isinstance(edge_weight, Tensor) else edge_weight)
+    nodes = np.asarray(input_nodes._value
+                       if isinstance(input_nodes, Tensor) else input_nodes)
+    rng = np.random.default_rng()
+    out_n, out_count = [], []
+    for v in nodes.tolist():
+        lo, hi = cp[v], cp[v + 1]
+        nbrs, ws = r[lo:hi], w[lo:hi].astype(np.float64)
+        if 0 <= sample_size < len(nbrs):
+            p = ws / ws.sum() if ws.sum() > 0 else None
+            nbrs = rng.choice(nbrs, size=sample_size, replace=False,
+                              p=p)
         out_n.extend(nbrs.tolist())
         out_count.append(len(nbrs))
     return (Tensor(jnp.asarray(out_n, jnp.int64)),

@@ -206,3 +206,196 @@ def variable_length_memory_efficient_attention(query, key, value, seq_lens=None,
     from ....nn.functional.attention import flash_attention
     out, _ = flash_attention(query, key, value, causal=causal)
     return out
+
+
+def fused_matmul_bias(x, y, bias=None, transpose_x=False,
+                      transpose_y=False, name=None):
+    """Reference incubate fused_matmul_bias (cublasLt epilogue): one
+    XLA dot + add — the fusion happens in the compiler."""
+    from ....tensor.linalg import matmul as _mm
+    out = _mm(x, y, transpose_x=transpose_x, transpose_y=transpose_y)
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+def fused_bias_dropout_residual_layer_norm(
+        x, residual, bias=None, ln_scale=None, ln_bias=None,
+        dropout_rate=0.5, ln_epsilon=1e-5, training=True,
+        mode="upscale_in_train", name=None):
+    """Reference fused op: layer_norm(residual + dropout(x + bias)).
+    One composition; XLA fuses the elementwise chain into the norm."""
+    from ....nn import functional as F
+    h = x if bias is None else x + bias
+    h = F.dropout(h, dropout_rate, training=training, mode=mode)
+    h = residual + h
+    d = h.shape[-1]
+    return F.layer_norm(h, [d], weight=ln_scale, bias=ln_bias,
+                        epsilon=ln_epsilon)
+
+
+def fused_ec_moe(x, gate, bmm0_weight, bmm0_bias, bmm1_weight, bmm1_bias,
+                 act_type="gelu", name=None):
+    """Reference fused_ec_moe (expert-choice MoE FFN). GShard dispatch/
+    combine (ops/moe.py topk_gating) with per-expert biased FFN:
+    act(x@w1 + b1) @ w2 + b2, weights [E, D, H] / [E, H, D], biases
+    [E, 1, H] / [E, 1, D]."""
+    import jax
+    import jax.numpy as jnp
+    from ....framework.core import Tensor, apply
+    from ....ops.moe import topk_gating
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[act_type]
+
+    def f(xa, gw, w1, b1, w2, b2):
+        b, s, d = xa.shape
+        tokens = xa.reshape(b * s, d)
+        e = w1.shape[0]
+        capacity = -(-2 * tokens.shape[0] // e // 8) * 8
+        logits = tokens.astype(jnp.float32) @ gw.astype(jnp.float32)
+        dispatch, combine, aux, stats = topk_gating(logits, 2, capacity)
+        ein = jnp.einsum("tec,td->ecd", dispatch.astype(xa.dtype), tokens)
+        h = act(jnp.einsum("ecd,edh->ech", ein, w1.astype(xa.dtype))
+                + b1.reshape(e, 1, -1).astype(xa.dtype))
+        eout = jnp.einsum("ech,ehd->ecd", h, w2.astype(xa.dtype)) \
+            + b2.reshape(e, 1, -1).astype(xa.dtype)
+        # bias must only reach tokens actually routed to a slot
+        slot_used = dispatch.sum(axis=0).astype(xa.dtype)[..., None]
+        eout = eout * jnp.minimum(slot_used, 1.0)
+        out = jnp.einsum("tec,ecd->td", combine.astype(xa.dtype), eout)
+        return out.reshape(b, s, d)
+    return apply("fused_ec_moe", f, x, gate, bmm0_weight, bmm0_bias,
+                 bmm1_weight, bmm1_bias)
+
+
+def masked_multihead_attention(x, cache_kv=None, bias=None,
+                               src_mask=None, sequence_lengths=None,
+                               rotary_tensor=None, beam_cache_offset=None,
+                               qkv_out_scale=None, out_shift=None,
+                               out_smooth=None, seq_len=1, rotary_emb_dims=0,
+                               use_neox_rotary_style=False,
+                               compute_dtype="default",
+                               out_scale=-1, quant_round_type=1,
+                               quant_max_bound=127.0,
+                               quant_min_bound=-127.0, name=None):
+    """Reference masked_multihead_attention: single-token decode
+    attention over a [2, B, H, MaxLen, D] cache. The serving engine's
+    paged path (inference.ServingEngine) is the production form; this
+    wrapper implements the dense-cache reference semantics for API
+    parity."""
+    import jax.numpy as jnp
+    from ....framework.core import Tensor, apply
+
+    if cache_kv is None:
+        raise ValueError("masked_multihead_attention needs cache_kv "
+                         "[2, batch, heads, max_len, head_dim]")
+
+    def f(qkv, cache, *maybe_seq):
+        # qkv: [B, 3*H*D] single decode token
+        _, b, h, max_len, d = cache.shape
+        q, k, v = jnp.split(qkv.reshape(b, 3, h, d), 3, axis=1)
+        q, k, v = q[:, 0], k[:, 0], v[:, 0]          # [b, h, d]
+        if maybe_seq:
+            pos = maybe_seq[0].reshape(b)
+        else:
+            pos = jnp.zeros((b,), jnp.int32)
+        bi = jnp.arange(b)[:, None]
+        hi = jnp.arange(h)[None, :]
+        cache = cache.at[0, bi, hi, pos[:, None]].set(k)
+        cache = cache.at[1, bi, hi, pos[:, None]].set(v)
+        ks, vs = cache[0], cache[1]                   # [b, h, L, d]
+        s = jnp.einsum("bhd,bhld->bhl", q.astype(jnp.float32),
+                       ks.astype(jnp.float32)) / jnp.sqrt(float(d))
+        mask = jnp.arange(max_len)[None, None, :] <= pos[:, None, None]
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        o = jnp.einsum("bhl,bhld->bhd", p, vs.astype(jnp.float32))
+        return o.reshape(b, h * d).astype(qkv.dtype), cache
+
+    import jax
+    args = [x, cache_kv] + ([sequence_lengths]
+                            if sequence_lengths is not None else [])
+    out, new_cache = apply("masked_mha", f, *args)
+    return out, new_cache
+
+
+def block_multihead_attention(
+        qkv, key_cache, value_cache, seq_lens_encoder, seq_lens_decoder,
+        seq_lens_this_time, padding_offsets, cum_offsets, cu_seqlens_q,
+        cu_seqlens_k, block_tables, pre_key_cache=None,
+        pre_value_cache=None, cache_k_quant_scales=None,
+        cache_v_quant_scales=None, cache_k_dequant_scales=None,
+        cache_v_dequant_scales=None, qkv_out_scale=None, qkv_bias=None,
+        out_shift=None, out_smooth=None, max_enc_len_this_time=None,
+        max_dec_len_this_time=None, rope_emb=None, mask=None,
+        tgt_mask=None, max_input_length=-1, block_size=64,
+        use_neox_style=False, **kwargs):
+    """Reference block_multihead_attention (the paged-KV serving
+    kernel). The TPU-native implementation is ops.paged_attention
+    (Pallas scalar-prefetch decode kernel) driven by
+    inference.ServingEngine; this wrapper exposes the decode step for
+    API parity: qkv [B, 3*H*D] one token per sequence, caches
+    [num_blocks, kv_heads, block_size, head_dim]."""
+    import jax.numpy as jnp
+    from ....framework.core import Tensor, apply
+    from ....ops.paged_attention import (paged_attention_decode,
+                                         reshape_and_cache)
+
+    def f(qkv_a, kc, vc, tables, dec_lens):
+        nb, kvh, bs, d = kc.shape
+        b = qkv_a.shape[0]
+        h = qkv_a.shape[1] // (3 * d)
+        q, k, v = jnp.split(qkv_a.reshape(b, 3, h, d), 3, axis=1)
+        q, k, v = q[:, 0], k[:, 0, :kvh], v[:, 0, :kvh]
+        ctx = dec_lens.reshape(b).astype(jnp.int32)
+        # this token's slot: position ctx within the sequence's table
+        blk = jnp.take_along_axis(tables, (ctx // bs)[:, None],
+                                  axis=1)[:, 0]
+        slots = blk * bs + ctx % bs
+        kc, vc = reshape_and_cache(k, v, kc, vc, slots)
+        out = paged_attention_decode(q, kc, vc, tables, ctx + 1)
+        return out.reshape(b, h * d), kc, vc
+
+    out, kc, vc = apply("block_mha", f, qkv, key_cache, value_cache,
+                        block_tables, seq_lens_decoder)
+    return out, kc, vc
+
+
+def fused_multi_transformer(
+        x, ln_scales, ln_biases, qkv_weights, qkv_biases, linear_weights,
+        linear_biases, ffn_ln_scales, ffn_ln_biases, ffn1_weights,
+        ffn1_biases, ffn2_weights, ffn2_biases, pre_layer_norm=True,
+        epsilon=1e-5, cache_kvs=None, time_step=None, attn_mask=None,
+        dropout_rate=0.0, activation="gelu", training=False, mode=None,
+        trans_qkvw=True, ring_id=-1, name=None):
+    """Reference fused_multi_transformer: N pre-LN transformer layers in
+    one call (the serving fast path). Composed from the existing fused
+    primitives — XLA fuses within each layer; the per-layer loop is
+    unrolled at trace time."""
+    from ....nn import functional as F
+    h = x
+    n_layers = len(qkv_weights)
+    for i in range(n_layers):
+        h = fused_multi_head_attention(
+            h, qkv_weights[i], linear_weights[i],
+            pre_layer_norm=pre_layer_norm,
+            pre_ln_scale=ln_scales[i] if ln_scales else None,
+            pre_ln_bias=ln_biases[i] if ln_biases else None,
+            pre_ln_epsilon=epsilon,
+            qkv_bias=qkv_biases[i] if qkv_biases else None,
+            linear_bias=linear_biases[i] if linear_biases else None,
+            attn_mask=attn_mask, dropout_rate=dropout_rate,
+            attn_dropout_rate=dropout_rate, ln_epsilon=epsilon)
+        h = fused_feedforward(
+            h, ffn1_weights[i], ffn2_weights[i],
+            linear1_bias=ffn1_biases[i] if ffn1_biases else None,
+            linear2_bias=ffn2_biases[i] if ffn2_biases else None,
+            ln1_scale=ffn_ln_scales[i] if ffn_ln_scales else None,
+            ln1_bias=ffn_ln_biases[i] if ffn_ln_biases else None,
+            dropout1_rate=dropout_rate, dropout2_rate=dropout_rate,
+            activation=activation, pre_layer_norm=pre_layer_norm)
+    return h
+
+
+__all__ += ["fused_matmul_bias", "fused_bias_dropout_residual_layer_norm",
+            "fused_ec_moe", "masked_multihead_attention",
+            "block_multihead_attention", "fused_multi_transformer"]

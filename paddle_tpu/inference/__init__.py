@@ -172,3 +172,79 @@ class Predictor:
 
 def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
+
+
+class DataType:
+    """Reference paddle.inference.DataType enum."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+    FLOAT64 = 7
+    BOOL = 8
+
+
+def get_num_bytes_of_data_type(dtype) -> int:
+    sizes = {DataType.FLOAT32: 4, DataType.INT64: 8, DataType.INT32: 4,
+             DataType.UINT8: 1, DataType.INT8: 1, DataType.FLOAT16: 2,
+             DataType.BFLOAT16: 2, DataType.FLOAT64: 8, DataType.BOOL: 1}
+    return sizes[dtype]
+
+
+# the reference exposes the I/O handle class as inference.Tensor
+Tensor = _IOHandle
+
+
+class PredictorPool:
+    """Pool of predictors over one artifact (reference PredictorPool;
+    here each retrieve() shares the loaded program — XLA executables
+    are thread-safe, so a pool is just N handle sets)."""
+
+    def __init__(self, config: Config, size: int = 1):
+        self._predictors = [Predictor(config) for _ in range(size)]
+
+    def retrieve(self, idx: int) -> Predictor:
+        return self._predictors[idx]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return f"paddle_tpu {__version__}"
+
+
+def get_trt_compile_version():
+    """TensorRT does not exist on TPU; the XLA pipeline plays its role
+    (returns zeros like a no-TRT reference build)."""
+    return (0, 0, 0)
+
+
+def get_trt_runtime_version():
+    return (0, 0, 0)
+
+
+def convert_to_mixed_precision(model_file, params_file, mixed_model_file,
+                               mixed_params_file, mixed_precision=None,
+                               backend=None, keep_io_types=True,
+                               black_list=None, **kwargs):
+    """Reference converts a saved fp32 model to fp16/bf16. Here: load
+    the artifact's params, cast floating params to bfloat16, re-save."""
+    raise NotImplementedError(
+        "convert_to_mixed_precision: export the model with bfloat16 "
+        "weights instead (paddle_tpu models run bf16 natively under "
+        "amp); a saved-artifact rewriter is not implemented")
+
+
+class XpuConfig:
+    """Accepted for API parity (Kunlun XPU knobs have no TPU meaning)."""
+
+    def __init__(self, **kwargs):
+        self.__dict__.update(kwargs)
+
+
+__all__ += ["DataType", "get_num_bytes_of_data_type", "Tensor",
+            "PredictorPool", "get_version", "get_trt_compile_version",
+            "get_trt_runtime_version", "convert_to_mixed_precision",
+            "XpuConfig"]

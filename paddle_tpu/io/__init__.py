@@ -24,6 +24,7 @@ __all__ = [
     "ChainDataset", "Subset", "random_split", "Sampler", "SequenceSampler",
     "RandomSampler", "WeightedRandomSampler", "BatchSampler",
     "DistributedBatchSampler", "DataLoader", "get_worker_info",
+    "ConcatDataset", "SubsetRandomSampler",
 ]
 
 
@@ -399,3 +400,46 @@ def _np_tree_to_tensor(obj):
     if isinstance(obj, dict):
         return {k: _np_tree_to_tensor(v) for k, v in obj.items()}
     return obj
+
+
+class ConcatDataset(Dataset):
+    """Concatenation of datasets (reference paddle.io.ConcatDataset)."""
+
+    def __init__(self, datasets):
+        self.datasets = list(datasets)
+        if not self.datasets:
+            raise ValueError("ConcatDataset needs at least one dataset")
+        self.cumulative_sizes = []
+        total = 0
+        for d in self.datasets:
+            total += len(d)
+            self.cumulative_sizes.append(total)
+
+    def __len__(self):
+        return self.cumulative_sizes[-1]
+
+    def __getitem__(self, idx):
+        if idx < 0:
+            idx += len(self)
+        import bisect
+        di = bisect.bisect_right(self.cumulative_sizes, idx)
+        prev = self.cumulative_sizes[di - 1] if di > 0 else 0
+        return self.datasets[di][idx - prev]
+
+
+class SubsetRandomSampler(Sampler):
+    """Sample randomly from a fixed index subset (reference
+    paddle.io.SubsetRandomSampler)."""
+
+    def __init__(self, indices):
+        if len(indices) == 0:
+            raise ValueError("indices cannot be empty")
+        self.indices = list(indices)
+
+    def __iter__(self):
+        import numpy as _np
+        order = _np.random.permutation(len(self.indices))
+        return iter(self.indices[i] for i in order)
+
+    def __len__(self):
+        return len(self.indices)

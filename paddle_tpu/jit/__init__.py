@@ -232,6 +232,10 @@ class StaticFunction:
         return jax.jit(pure, static_argnums=(3,))
 
     def __call__(self, *args, **kwargs):
+        if not _to_static_enabled:
+            # paddle.jit.enable_to_static(False): run the target eagerly
+            target = self._layer if self._layer is not None else self._fn
+            return target(*args, **kwargs)
         if self._partial is not None:
             return self._partial(*args, **kwargs)
         if self._compiled is None:
@@ -809,3 +813,35 @@ class TranslatedLayer:
 
 def load(path, **configs) -> TranslatedLayer:
     return TranslatedLayer(path)
+
+
+# --- telemetry/config parity (reference jit/api.py) ------------------------
+
+_to_static_enabled = True
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static compilation (reference
+    paddle.jit.enable_to_static). When disabled, StaticFunction runs
+    its target eagerly."""
+    global _to_static_enabled
+    _to_static_enabled = bool(enable)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """Reference sets dy2static transformed-code logging verbosity; the
+    tracing pipeline here has no transformed source to print — the knob
+    is accepted and recorded (telemetry lives on StaticFunction:
+    retrace_count / trace_signatures / graph_break_count)."""
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if also_to_stdout else logging.INFO)
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    import logging
+    logging.getLogger("paddle_tpu.jit").setLevel(
+        logging.DEBUG if level > 0 else logging.WARNING)
+
+
+__all__ += ["enable_to_static", "set_code_level", "set_verbosity"]

@@ -3,5 +3,7 @@ from .tensor.linalg import (  # noqa: F401
     cholesky, inv, pinv, det, slogdet, svd, qr, eigh, eigvalsh, solve,
     triangular_solve, lstsq, matrix_power, matrix_rank, cond, lu,
     householder_product, cov, corrcoef, norm, matmul, multi_dot,
-    matrix_transpose,
+    matrix_transpose, cholesky_solve, matrix_exp, eig, eigvals,
+    lu_unpack,
 )
+from .tensor import pca_lowrank  # noqa: F401

@@ -15,6 +15,7 @@ from .layer.moe import MoELayer, SwitchGate, GShardGate  # noqa: F401
 from .layer.rnn import *  # noqa: F401,F403
 from .layer.extras import *  # noqa: F401,F403
 from .decode import BeamSearchDecoder, dynamic_decode  # noqa: F401
+from . import utils  # noqa: F401
 from ..optimizer.clip import (  # noqa: F401 — paddle.nn.ClipGradBy* parity
     ClipGradByValue, ClipGradByNorm, ClipGradByGlobalNorm,
 )

@@ -173,3 +173,40 @@ class Dirac(Initializer):
         for i in range(min(oc, ic * self.groups)):
             arr[(i, i % ic) + spatial_center] = 1.0
         return jnp.asarray(arr, dtypes.convert_dtype(dtype))
+
+
+class Bilinear(Initializer):
+    """Bilinear-upsampling kernel init for transposed conv (reference
+    paddle.nn.initializer.Bilinear). Weight layout
+    [in_c, out_c/groups, kh, kw]."""
+
+    def __call__(self, shape, dtype):
+        if len(shape) != 4:
+            raise ValueError("Bilinear initializer needs a 4-D conv "
+                             f"weight, got shape {list(shape)}")
+        kh, kw = shape[2], shape[3]
+        import numpy as _np
+        fh, fw = (kh + 1) // 2, (kw + 1) // 2
+        ch = (2 * fh - 1 - fh % 2) / (2.0 * fh)
+        cw = (2 * fw - 1 - fw % 2) / (2.0 * fw)
+        yy, xx = _np.meshgrid(_np.arange(kh), _np.arange(kw),
+                              indexing="ij")
+        filt = ((1 - _np.abs(yy / fh - ch))
+                * (1 - _np.abs(xx / fw - cw))).astype(_np.float32)
+        w = _np.zeros(tuple(shape), _np.float32)
+        w[:, :] = filt
+        return jnp.asarray(w, dtypes.convert_dtype(dtype))
+
+
+_global_initializer = None
+
+
+def set_global_initializer(weight_init, bias_init=None):
+    """Reference paddle.nn.initializer.set_global_initializer: default
+    initializers applied by create_parameter when a layer doesn't
+    specify its own. Pass None to reset."""
+    global _global_initializer
+    _global_initializer = (weight_init, bias_init)
+
+
+__all__ += ["Bilinear", "set_global_initializer"]

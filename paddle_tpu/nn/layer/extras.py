@@ -15,7 +15,8 @@ from ...framework.core import Parameter, Tensor, apply, default_generator
 from .layers import Layer
 
 __all__ = ["ZeroPad2D", "Unflatten", "Softmax2D", "PairwiseDistance",
-           "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "CTCLoss",
+           "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "CTCLoss", "RNNTLoss",
+           "FractionalMaxPool2D", "FractionalMaxPool3D",
            "GaussianNLLLoss", "SoftMarginLoss", "MultiLabelSoftMarginLoss",
            "MultiMarginLoss", "TripletMarginWithDistanceLoss",
            "HSigmoidLoss"]
@@ -344,3 +345,89 @@ class HSigmoidLoss(Layer):
 
         return apply("hsigmoid_loss", f, input, label, self.weight,
                      self.bias)
+
+
+class RNNTLoss(Layer):
+    """RNN-T transducer loss layer (reference paddle.nn.RNNTLoss) —
+    wraps nn.functional.rnnt_loss (lax.scan alpha recursion)."""
+
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank = blank
+        self.fastemit_lambda = fastemit_lambda
+        self.reduction = reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        from ..functional.extras import rnnt_loss
+        return rnnt_loss(input, label, input_lengths, label_lengths,
+                         blank=self.blank,
+                         fastemit_lambda=self.fastemit_lambda,
+                         reduction=self.reduction)
+
+
+class _FractionalMaxPoolND(Layer):
+    """Fractional max pooling (reference paddle.nn.FractionalMaxPool2D/
+    3D): pooling regions from the fractional index sequence
+    floor(alpha*(i+u)) with alpha = in/out (pseudo-random u, fixed per
+    call via random_u or the global RNG)."""
+
+    ND = 2
+
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def _edges(self, n_in, n_out, u):
+        import numpy as _np
+        alpha = n_in / n_out
+        idx = _np.floor(alpha * (_np.arange(n_out) + u)).astype(int)
+        idx = _np.clip(idx, 0, n_in - 1)
+        end = _np.floor(alpha * (_np.arange(1, n_out + 1) + u)) \
+            .astype(int)
+        end = _np.clip(end, idx + 1, n_in)
+        return idx, end
+
+    def forward(self, x):
+        import numpy as _np
+        from ...framework.core import default_generator
+        nd = self.ND
+        spatial = x.shape[-nd:]
+        out_sz = self.output_size
+        if isinstance(out_sz, int):
+            out_sz = (out_sz,) * nd
+        if self.random_u is not None:
+            us = [float(self.random_u)] * nd
+        else:
+            import jax as _jax
+            key = default_generator.next_key()
+            us = [float(v) for v in _jax.random.uniform(key, (nd,))]
+        # slice-and-reduce per output cell, built as gather of cumulative
+        # maxima: simple (loop over output cells host-side — shapes are
+        # static and small for pooling layers)
+        out = x
+        for d in range(nd):
+            axis = x.ndim - nd + d
+            starts, ends = self._edges(spatial[d], out_sz[d], us[d])
+            from ...tensor.manipulation import stack as _stack
+            slices = []
+            for s0, e0 in zip(starts, ends):
+                sl = [slice(None)] * out.ndim
+                sl[axis] = slice(int(s0), int(e0))
+                piece = out[tuple(sl)]
+                slices.append(piece.max(axis=axis))
+            out = _stack(slices, axis=axis)
+        if self.return_mask:
+            return out, None
+        return out
+
+
+class FractionalMaxPool2D(_FractionalMaxPoolND):
+    ND = 2
+
+
+class FractionalMaxPool3D(_FractionalMaxPoolND):
+    ND = 3

@@ -1,0 +1,132 @@
+"""paddle.nn.utils parity (reference python/paddle/nn/utils/):
+weight_norm / remove_weight_norm / spectral_norm reparameterizations,
+parameters_to_vector / vector_to_parameters."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.core import Parameter, Tensor, apply
+
+__all__ = ["weight_norm", "remove_weight_norm", "spectral_norm",
+           "parameters_to_vector", "vector_to_parameters"]
+
+
+def _norm_except(w, dim):
+    axes = tuple(i for i in range(w.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(w.astype(jnp.float32)), axis=axes,
+                            keepdims=True))
+
+
+def weight_norm(layer, name: str = "weight", dim: int = 0):
+    """Reparameterize layer.<name> as g * v / ||v|| (reference
+    nn.utils.weight_norm): trains g (per-dim magnitude) and v
+    (direction); a forward-pre-hook recomputes the weight each call so
+    gradients flow into g and v."""
+    w = getattr(layer, name)
+    if dim is None:
+        dim = -1
+    warr = w._value
+    g0 = _norm_except(warr, dim % warr.ndim)
+    g = Parameter(g0.astype(warr.dtype))
+    v = Parameter(warr)
+    setattr(layer, name + "_g", g)
+    setattr(layer, name + "_v", v)
+    # the original param stops being trainable state
+    layer._parameters.pop(name, None)
+
+    def _recompute(lyr, inputs):
+        def f(gv, vv):
+            axes = tuple(i for i in range(vv.ndim) if i != dim % vv.ndim)
+            nrm = jnp.sqrt(jnp.sum(jnp.square(
+                vv.astype(jnp.float32)), axis=axes, keepdims=True))
+            return (gv.astype(jnp.float32) * vv.astype(jnp.float32)
+                    / jnp.maximum(nrm, 1e-12)).astype(vv.dtype)
+        object.__setattr__(lyr, name, apply("weight_norm", f, g, v))
+        return None
+
+    handle = layer.register_forward_pre_hook(_recompute)
+    layer._weight_norm_hook = (name, handle)
+    _recompute(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name: str = "weight"):
+    """Fold g*v/||v|| back into a plain parameter."""
+    hook = getattr(layer, "_weight_norm_hook", None)
+    if hook is None or hook[0] != name:
+        raise ValueError(f"layer has no weight_norm on {name!r}")
+    hook[1].remove()
+    w = getattr(layer, name)
+    setattr(layer, name, Parameter(w._value))
+    for suffix in ("_g", "_v"):
+        layer._parameters.pop(name + suffix, None)
+        if hasattr(layer, name + suffix):
+            object.__delattr__(layer, name + suffix)
+    del layer._weight_norm_hook
+    return layer
+
+
+def spectral_norm(layer, name: str = "weight", n_power_iterations: int = 1,
+                  eps: float = 1e-12, dim: int = 0):
+    """Spectral normalization via power iteration (reference
+    nn.utils.spectral_norm): weight / sigma_max recomputed per call,
+    with the u vector persisted across calls so the estimate converges
+    over training."""
+    from ...framework.core import default_generator
+    w = getattr(layer, name)
+    warr = w._value
+    mat = jnp.moveaxis(warr, dim, 0).reshape(warr.shape[dim], -1)
+    key = default_generator.next_key()
+    u0 = jax.random.normal(key, (mat.shape[0],), jnp.float32)
+    layer._sn_u = u0 / jnp.linalg.norm(u0)
+    v = Parameter(warr)
+    setattr(layer, name + "_orig", v)
+    layer._parameters.pop(name, None)
+
+    def _power_iter(m, u, iters):
+        for _ in range(iters):
+            vvec = m.T @ u
+            vvec = vvec / jnp.maximum(jnp.linalg.norm(vvec), eps)
+            u = m @ vvec
+            u = u / jnp.maximum(jnp.linalg.norm(u), eps)
+        return u, vvec
+
+    def _recompute(lyr, inputs):
+        # persist the power-iteration vector across calls (the reference
+        # updates the u buffer every forward, so the sigma estimate
+        # converges over training even with n_power_iterations=1)
+        if not isinstance(v._value, jax.core.Tracer):
+            m_c = jnp.moveaxis(v._value.astype(jnp.float32), dim, 0) \
+                .reshape(v._value.shape[dim], -1)
+            u_new, _ = _power_iter(m_c, lyr._sn_u, n_power_iterations)
+            object.__setattr__(lyr, "_sn_u", u_new)
+
+        def f(vv):
+            m = jnp.moveaxis(vv.astype(jnp.float32), dim, 0) \
+                .reshape(vv.shape[dim], -1)
+            u, vvec = _power_iter(m, lyr._sn_u, n_power_iterations)
+            sigma = u @ (m @ vvec)
+            return (vv.astype(jnp.float32) / jnp.maximum(sigma, eps)) \
+                .astype(vv.dtype)
+        object.__setattr__(lyr, name, apply("spectral_norm", f, v))
+        return None
+
+    layer.register_forward_pre_hook(_recompute)
+    _recompute(layer, None)
+    return layer
+
+
+def parameters_to_vector(parameters, name=None):
+    from ...tensor.manipulation import concat
+    flats = [p.reshape([-1]) for p in parameters]
+    return concat(flats, axis=0)
+
+
+def vector_to_parameters(vec, parameters, name=None):
+    off = 0
+    for p in parameters:
+        n = p.size
+        chunk = vec[off:off + n].reshape(p.shape)
+        p._replace(chunk._value if isinstance(chunk, Tensor) else chunk)
+        off += n

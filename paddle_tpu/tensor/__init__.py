@@ -167,3 +167,149 @@ def _install_methods():
 
 _install_operators()
 _install_methods()
+
+
+# ---------------------------------------------------------------------------
+# in-place variants (reference: python/paddle/tensor/__init__.py
+# tensor_method_func's trailing-underscore entries). Functionally the
+# out-of-place op + a write-back into the SAME Tensor (and its method
+# form returns self, paddle-style chaining). Autograd note: like the
+# reference, in-place writes on leaves that already require grad don't
+# rewrite history — the write-back targets the Tensor's VALUE only.
+# ---------------------------------------------------------------------------
+
+_INPLACE_NAMES = [
+    "abs_", "acos_", "acosh_", "addmm_", "asin_", "asinh_", "atan_",
+    "atanh_", "bitwise_and_", "bitwise_left_shift_", "bitwise_not_",
+    "bitwise_or_", "bitwise_right_shift_", "bitwise_xor_", "cast_",
+    "ceil_", "clip_", "copysign_", "cos_", "cosh_", "cumprod_",
+    "cumsum_", "digamma_", "divide_", "equal_", "erfinv_", "exp_",
+    "flatten_", "floor_", "floor_divide_", "floor_mod_", "frac_",
+    "gammaln_", "gcd_", "greater_equal_", "greater_than_", "hypot_",
+    "i0_", "index_add_", "index_fill_", "index_put_", "lcm_", "ldexp_",
+    "lerp_", "less_equal_", "less_than_", "lgamma_", "log10_", "log1p_",
+    "log2_", "log_", "logical_and_", "logical_not_", "logical_or_",
+    "logical_xor_", "logit_", "masked_fill_", "masked_scatter_", "mod_",
+    "multigammaln_", "nan_to_num_", "neg_", "not_equal_", "polygamma_",
+    "pow_", "put_along_axis_", "reciprocal_", "remainder_", "renorm_",
+    "round_", "rsqrt_", "scale_", "scatter_", "sigmoid_", "sin_",
+    "sinh_", "sqrt_", "t_", "tan_", "tanh_", "transpose_", "tril_",
+    "triu_", "trunc_", "where_", "erf_", "expm1_", "square_",
+]
+
+
+def _make_inplace(base_fn, name):
+    def _inplace(x, *args, **kwargs):
+        out = base_fn(x, *args, **kwargs)
+        x._replace(out._value if isinstance(out, Tensor) else out)
+        return x
+    _inplace.__name__ = name
+    _inplace.__qualname__ = name
+    _inplace.__doc__ = (f"In-place variant of `{name[:-1]}` (reference "
+                        f"paddle.{name}): computes out-of-place, writes "
+                        "the result back into x, returns x.")
+    return _inplace
+
+
+def _install_inplace():
+    g = globals()
+    for name in _INPLACE_NAMES:
+        base = g.get(name[:-1])
+        if base is None or name in g:
+            continue
+        fn = _make_inplace(base, name)
+        g[name] = fn
+        Tensor._register_method(name, fn)
+
+
+def cauchy_(x, loc=0, scale=1, name=None):
+    """Fill x in place with Cauchy(loc, scale) samples (reference
+    paddle.Tensor.cauchy_)."""
+    from ..framework.core import default_generator
+    import jax
+    key = default_generator.next_key()
+    u = jax.random.uniform(key, x.shape, jnp.float32, 1e-7, 1 - 1e-7)
+    vals = loc + scale * jnp.tan(jnp.pi * (u - 0.5))
+    x._replace(vals.astype(x._value.dtype))
+    return x
+
+
+def geometric_(x, probs, name=None):
+    """Fill x in place with Geometric(probs) samples (reference
+    paddle.Tensor.geometric_)."""
+    from ..framework.core import default_generator
+    import jax
+    key = default_generator.next_key()
+    u = jax.random.uniform(key, x.shape, jnp.float32, 1e-7, 1 - 1e-7)
+    vals = jnp.ceil(jnp.log(u) / jnp.log1p(-jnp.asarray(probs,
+                                                        jnp.float32)))
+    x._replace(vals.astype(x._value.dtype))
+    return x
+
+
+def create_tensor(dtype, name=None, persistable=False):
+    """Reference paddle.create_tensor: an empty (0-size) typed tensor."""
+    from ..framework.dtype import convert_dtype
+    return Tensor(jnp.zeros((0,), convert_dtype(dtype)))
+
+
+def create_parameter(shape, dtype, name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """Reference paddle.create_parameter."""
+    import jax
+    from ..framework.core import default_generator
+    from ..framework.dtype import convert_dtype
+    jdt = convert_dtype(dtype)
+    if default_initializer is not None:
+        t = Parameter(jnp.zeros(shape, jdt))
+        default_initializer(t)
+        return t
+    if is_bias:
+        return Parameter(jnp.zeros(shape, jdt))
+    key = default_generator.next_key()
+    fan_in = shape[0] if shape else 1
+    # NB: builtins.max — the module-level `max` is the tensor reduction
+    import builtins
+    bound = float(np.sqrt(6.0 / builtins.max(1, fan_in)))
+    return Parameter(jax.random.uniform(key, tuple(shape), jdt,
+                                        -bound, bound))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA (reference paddle.linalg.pca_lowrank): returns
+    (U, S, V) with V's columns the principal directions."""
+    a = x._value if isinstance(x, Tensor) else jnp.asarray(x)
+    m, n = a.shape[-2], a.shape[-1]
+    if q is None:
+        q = min(6, m, n)
+    if center:
+        a = a - a.mean(axis=-2, keepdims=True)
+    u, s, vt = jnp.linalg.svd(a, full_matrices=False)
+    return (Tensor(u[..., :q]), Tensor(s[..., :q]),
+            Tensor(jnp.swapaxes(vt, -1, -2)[..., :q]))
+
+
+_install_inplace()
+Tensor._register_method("cauchy_", cauchy_)
+Tensor._register_method("geometric_", geometric_)
+
+
+# signal-processing methods (reference exposes these as Tensor methods)
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False,
+         onesided=True, name=None):
+    from ..signal import stft as _stft
+    return _stft(x, n_fft, hop_length, win_length, window, center,
+                 pad_mode, normalized, onesided, name)
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    from ..signal import istft as _istft
+    return _istft(x, n_fft, hop_length, win_length, window, center,
+                  normalized, onesided, length, return_complex, name)
+
+
+Tensor._register_method("stft", stft)
+Tensor._register_method("istft", istft)

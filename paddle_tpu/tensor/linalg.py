@@ -221,3 +221,74 @@ def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
 
 def corrcoef(x, rowvar=True, name=None):
     return apply("corrcoef", lambda a: jnp.corrcoef(a, rowvar=rowvar), x)
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    """Solve A X = B given the Cholesky factor of A (reference
+    paddle.linalg.cholesky_solve; y is the factor, x the rhs)."""
+    def f(b, c):
+        return jax.scipy.linalg.cho_solve((c, not upper), b)
+    return apply("cholesky_solve", f, x, y)
+
+
+def matrix_exp(x, name=None):
+    """Matrix exponential (reference paddle.linalg.matrix_exp)."""
+    return apply("matrix_exp", jax.scipy.linalg.expm, x)
+
+
+def _on_cpu(fn):
+    """Run fn on the host CPU backend (general eig has no TPU lowering
+    — the reference computes it on host LAPACK too)."""
+    def wrapped(a):
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            return fn(jax.device_put(a, cpu))
+    return wrapped
+
+
+def eig(x, name=None):
+    """General (non-symmetric) eigendecomposition (reference
+    paddle.linalg.eig). Complex outputs; computed on the host CPU
+    backend (no TPU lowering exists — same as the reference's LAPACK
+    path)."""
+    def f(a):
+        return _on_cpu(jnp.linalg.eig)(a)
+    return apply_nodiff("eig", f, x)
+
+
+def eigvals(x, name=None):
+    def f(a):
+        return _on_cpu(jnp.linalg.eigvals)(a)
+    return apply_nodiff("eigvals", f, x)
+
+
+def lu_unpack(x, y, unpack_ludata=True, unpack_pivots=True, name=None):
+    """Unpack lu()'s packed factorization into (P, L, U) (reference
+    paddle.linalg.lu_unpack; y is the pivot vector). unpack_pivots
+    gates P (and its permutation cost); unpack_ludata gates L/U."""
+    def f(lu_, piv):
+        outs = []
+        m, n = lu_.shape[-2], lu_.shape[-1]
+        if unpack_pivots:
+            perm = jnp.arange(m)
+
+            def body(i, p):
+                j = piv[i]
+                pi, pj = p[i], p[j]
+                return p.at[i].set(pj).at[j].set(pi)
+            perm = jax.lax.fori_loop(0, piv.shape[-1], body, perm)
+            outs.append(jnp.eye(m, dtype=lu_.dtype)[perm].T)
+        if unpack_ludata:
+            k = min(m, n)
+            outs.append(jnp.tril(lu_[..., :, :k], -1)
+                        + jnp.eye(m, k, dtype=lu_.dtype))
+            outs.append(jnp.triu(lu_[..., :k, :]))
+        return tuple(outs)
+    outs = apply_nodiff("lu_unpack", f, x, y)
+    if not isinstance(outs, (tuple, list)):
+        outs = (outs,)
+    it = iter(outs)
+    p_out = next(it) if unpack_pivots else None
+    l_out = next(it) if unpack_ludata else None
+    u_out = next(it) if unpack_ludata else None
+    return p_out, l_out, u_out

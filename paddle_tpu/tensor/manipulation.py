@@ -482,6 +482,12 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
                 dims = list(range(1, nd - 1))
             else:
                 dims = list(range(nd - k, nd))
+            if not dims or k > len(dims):
+                raise ValueError(
+                    f"pad: partial pad of length {len(p)} does not fit a "
+                    f"{nd}-D input with data_format={data_format!r}; "
+                    "pass the full 2*ndim spec (silently padding "
+                    "nothing would hide the mistake)")
             for j, d in enumerate(reversed(dims[-k:])):
                 widths[d] = (p[2 * j], p[2 * j + 1])
         jmode = {"constant": "constant", "reflect": "reflect",

@@ -20,5 +20,5 @@ from .inceptionv3 import InceptionV3, inception_v3  # noqa: F401
 from .shufflenetv2 import (  # noqa: F401
     ShuffleNetV2, shufflenet_v2_x0_25, shufflenet_v2_x0_33,
     shufflenet_v2_x0_5, shufflenet_v2_x1_0, shufflenet_v2_x1_5,
-    shufflenet_v2_x2_0,
+    shufflenet_v2_x2_0, shufflenet_v2_swish,
 )

@@ -8,7 +8,8 @@ from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
 
 __all__ = ["ResNet", "resnet18", "resnet34", "resnet50", "resnet101",
            "resnet152", "wide_resnet50_2", "wide_resnet101_2", "resnext50_32x4d",
-           "resnext101_32x4d"]
+           "resnext101_32x4d", "resnext50_64x4d", "resnext101_64x4d",
+           "resnext152_32x4d", "resnext152_64x4d"]
 
 
 class BasicBlock(Layer):
@@ -170,3 +171,27 @@ def resnext101_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
     return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)

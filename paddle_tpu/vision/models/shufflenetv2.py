@@ -4,11 +4,12 @@ from __future__ import annotations
 
 from ...tensor.manipulation import concat, reshape, split, swapaxes
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
-                   MaxPool2D, ReLU, Sequential)
+                   MaxPool2D, ReLU, Sequential, Swish)
 
 __all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
            "shufflenet_v2_x0_5", "shufflenet_v2_x1_0",
-           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0"]
+           "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+           "shufflenet_v2_swish"]
 
 
 def channel_shuffle(x, groups):
@@ -19,36 +20,37 @@ def channel_shuffle(x, groups):
 
 
 class ConvBNReLU(Sequential):
-    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, act=True):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1,
+                 act=True):
         layers = [Conv2D(in_c, out_c, kernel, stride=stride,
                          padding=kernel // 2, groups=groups,
                          bias_attr=False),
                   BatchNorm2D(out_c)]
-        if act:
-            layers.append(ReLU())
+        if act:  # True/'relu' -> ReLU; 'swish' -> Swish
+            layers.append(Swish() if act == "swish" else ReLU())
         super().__init__(*layers)
 
 
 class InvertedResidual(Layer):
-    def __init__(self, in_c, out_c, stride):
+    def __init__(self, in_c, out_c, stride, act="relu"):
         super().__init__()
         self.stride = stride
         branch_c = out_c // 2
         if stride == 1:
             self.branch2 = Sequential(
-                ConvBNReLU(branch_c, branch_c, 1),
+                ConvBNReLU(branch_c, branch_c, 1, act=act),
                 ConvBNReLU(branch_c, branch_c, 3, stride, branch_c,
                            act=False),
-                ConvBNReLU(branch_c, branch_c, 1))
+                ConvBNReLU(branch_c, branch_c, 1, act=act))
         else:
             self.branch1 = Sequential(
                 ConvBNReLU(in_c, in_c, 3, stride, in_c, act=False),
-                ConvBNReLU(in_c, branch_c, 1))
+                ConvBNReLU(in_c, branch_c, 1, act=act))
             self.branch2 = Sequential(
-                ConvBNReLU(in_c, branch_c, 1),
+                ConvBNReLU(in_c, branch_c, 1, act=act),
                 ConvBNReLU(branch_c, branch_c, 3, stride, branch_c,
                            act=False),
-                ConvBNReLU(branch_c, branch_c, 1))
+                ConvBNReLU(branch_c, branch_c, 1, act=act))
 
     def forward(self, x):
         if self.stride == 1:
@@ -71,19 +73,19 @@ class ShuffleNetV2(Layer):
             0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
             1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048],
         }[scale]
-        self.conv1 = ConvBNReLU(3, out_channels[0], 3, 2)
+        self.conv1 = ConvBNReLU(3, out_channels[0], 3, 2, act=act)
         self.maxpool = MaxPool2D(3, 2, padding=1)
         in_c = out_channels[0]
         stages = []
         for i, repeats in enumerate(stage_repeats):
             out_c = out_channels[i + 1]
-            blocks = [InvertedResidual(in_c, out_c, 2)]
+            blocks = [InvertedResidual(in_c, out_c, 2, act=act)]
             for _ in range(repeats - 1):
-                blocks.append(InvertedResidual(out_c, out_c, 1))
+                blocks.append(InvertedResidual(out_c, out_c, 1, act=act))
             stages.append(Sequential(*blocks))
             in_c = out_c
         self.stages = Sequential(*stages)
-        self.conv_last = ConvBNReLU(in_c, out_channels[-1], 1)
+        self.conv_last = ConvBNReLU(in_c, out_channels[-1], 1, act=act)
         if with_pool:
             self.pool = AdaptiveAvgPool2D(1)
         if num_classes > 0:
@@ -121,3 +123,7 @@ def shufflenet_v2_x1_5(pretrained=False, **kwargs):
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
     return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

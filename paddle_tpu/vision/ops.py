@@ -16,6 +16,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.core import Tensor, apply, apply_nodiff
+from ..nn.layer.layers import Layer as _Layer
 
 __all__ = ["nms", "box_iou", "roi_align", "roi_pool", "box_coder",
            "PSRoIPool", "RoIAlign", "RoIPool"]
@@ -344,3 +345,457 @@ class PSRoIPool:
             j = jnp.arange(ow)[None, :]
             return p[:, :, i, j, i, j]
         return apply("psroi_select", f, pooled)
+
+
+# ---------------------------------------------------------------------------
+# detection long tail (reference vision/ops.py): real implementations —
+# anchor generation, YOLO box decoding, matrix NMS, PSRoI pooling,
+# deformable conv (bilinear-gather formulation), FPN routing, proposal
+# generation, jpeg IO. yolo_loss remains a loud stub (its target-
+# assignment spec is large; COVERAGE.md notes the gap).
+# ---------------------------------------------------------------------------
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5, min_max_aspect_ratios_order=False,
+              name=None):
+    """SSD prior (anchor) boxes (reference vision/ops.py prior_box)."""
+    fh, fw = input.shape[2], input.shape[3]
+    ih, iw = image.shape[2], image.shape[3]
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = []
+    for ar in aspect_ratios:
+        ars.append(ar)
+        if flip and ar != 1.0:
+            ars.append(1.0 / ar)
+    boxes = []
+    for s in min_sizes:
+        for ar in ars:
+            boxes.append((s * np.sqrt(ar), s / np.sqrt(ar)))
+        if max_sizes:
+            for smax in max_sizes:
+                sp = np.sqrt(s * smax)
+                boxes.append((sp, sp))
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    cxg, cyg = np.meshgrid(cx, cy)
+    out = np.zeros((fh, fw, len(boxes), 4), np.float32)
+    for k, (bw, bh) in enumerate(boxes):
+        out[..., k, 0] = (cxg - bw / 2) / iw
+        out[..., k, 1] = (cyg - bh / 2) / ih
+        out[..., k, 2] = (cxg + bw / 2) / iw
+        out[..., k, 3] = (cyg + bh / 2) / ih
+    if clip:
+        out = np.clip(out, 0.0, 1.0)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(jnp.asarray(out)), Tensor(jnp.asarray(var))
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None,
+             scale_x_y=1.0, iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output to boxes+scores (reference yolo_box)."""
+    def f(xa, imgs):
+        b, c, h, w = xa.shape
+        na = len(anchors) // 2
+        an = jnp.asarray(np.asarray(anchors, np.float32).reshape(na, 2))
+        xa = xa.reshape(b, na, 5 + class_num, h, w)
+        gx = jnp.arange(w, dtype=jnp.float32)[None, None, None, :]
+        gy = jnp.arange(h, dtype=jnp.float32)[None, None, :, None]
+        sig = jax.nn.sigmoid
+        bx = (sig(xa[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx) / w
+        by = (sig(xa[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy) / h
+        in_w = w * downsample_ratio
+        in_h = h * downsample_ratio
+        bw = jnp.exp(xa[:, :, 2]) * an[None, :, 0, None, None] / in_w
+        bh = jnp.exp(xa[:, :, 3]) * an[None, :, 1, None, None] / in_h
+        conf = sig(xa[:, :, 4])
+        probs = sig(xa[:, :, 5:]) * conf[:, :, None]
+        ih = imgs[:, 0].astype(jnp.float32)[:, None, None, None]
+        iw = imgs[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (bx - bw / 2) * iw
+        y1 = (by - bh / 2) * ih
+        x2 = (bx + bw / 2) * iw
+        y2 = (by + bh / 2) * ih
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, iw - 1)
+            y1 = jnp.clip(y1, 0, ih - 1)
+            x2 = jnp.clip(x2, 0, iw - 1)
+            y2 = jnp.clip(y2, 0, ih - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1) \
+            .transpose(0, 1, 3, 4, 2).reshape(b, -1, 4)
+        mask = (conf > conf_thresh).astype(boxes.dtype)
+        boxes = boxes * mask.reshape(b, -1)[..., None]
+        scores = probs.transpose(0, 1, 3, 4, 2).reshape(b, -1, class_num)
+        return boxes, scores
+    return apply_nodiff("yolo_box", f, x, img_size)
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh, downsample_ratio, gt_score=None,
+              use_label_smooth=True, name=None, scale_x_y=1.0):
+    raise NotImplementedError(
+        "yolo_loss: the YOLOv3 target-assignment spec is not "
+        "implemented (COVERAGE.md gap); compose yolo_box with your own "
+        "assignment, or use generic detection losses")
+
+
+def matrix_nms(bboxes, scores, score_threshold, post_threshold,
+               nms_top_k, keep_top_k, use_gaussian=False, gaussian_sigma=2.0,
+               background_label=0, normalized=True, return_index=False,
+               return_rois_num=True, name=None):
+    """Matrix NMS (reference matrix_nms, SOLOv2): score decay from the
+    IoU matrix instead of hard suppression. Host-side (detection post-
+    processing)."""
+    bb = np.asarray(bboxes._value if isinstance(bboxes, Tensor)
+                    else bboxes)
+    sc = np.asarray(scores._value if isinstance(scores, Tensor)
+                    else scores)
+    outs, indices, nums = [], [], []
+    b, c, n = sc.shape
+    for bi in range(b):
+        dets = []
+        idxs = []
+        for ci in range(c):
+            if ci == background_label:
+                continue
+            s = sc[bi, ci]
+            keep = np.where(s > score_threshold)[0]
+            if keep.size == 0:
+                continue
+            order = keep[np.argsort(-s[keep])][:nms_top_k]
+            boxes_c = bb[bi, order]
+            s_c = s[order]
+            x1, y1, x2, y2 = boxes_c.T
+            off = 0.0 if normalized else 1.0
+            area = np.maximum(x2 - x1 + off, 0) * \
+                np.maximum(y2 - y1 + off, 0)
+            ix1 = np.maximum(x1[:, None], x1[None, :])
+            iy1 = np.maximum(y1[:, None], y1[None, :])
+            ix2 = np.minimum(x2[:, None], x2[None, :])
+            iy2 = np.minimum(y2[:, None], y2[None, :])
+            inter = np.maximum(ix2 - ix1 + off, 0) * \
+                np.maximum(iy2 - iy1 + off, 0)
+            iou = inter / np.maximum(area[:, None] + area[None, :]
+                                     - inter, 1e-9)
+            iou = np.triu(iou, 1)
+            iou_cmax = iou.max(axis=0)
+            if use_gaussian:
+                decay = np.exp((iou_cmax ** 2 - iou ** 2)
+                               / gaussian_sigma).min(axis=0)
+            else:
+                decay = ((1 - iou) / np.maximum(1 - iou_cmax[:, None],
+                                                1e-9)).min(axis=0)
+            s_dec = s_c * decay
+            ok = s_dec > post_threshold
+            for j in np.where(ok)[0]:
+                dets.append([ci, s_dec[j], *boxes_c[j]])
+                idxs.append(order[j])
+        dets = np.asarray(dets, np.float32) if dets else \
+            np.zeros((0, 6), np.float32)
+        if dets.shape[0] > keep_top_k >= 0:
+            top = np.argsort(-dets[:, 1])[:keep_top_k]
+            dets = dets[top]
+            idxs = [idxs[i] for i in top]
+        outs.append(dets)
+        indices.extend(idxs)
+        nums.append(dets.shape[0])
+    out = Tensor(jnp.asarray(np.concatenate(outs, 0)))
+    res = [out]
+    if return_index:
+        res.append(Tensor(jnp.asarray(np.asarray(indices, np.int32))))
+    if return_rois_num:
+        res.append(Tensor(jnp.asarray(np.asarray(nums, np.int32))))
+    return tuple(res) if len(res) > 1 else out
+
+
+def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+               name=None):
+    """Position-sensitive RoI pooling (reference psroi_pool): channel
+    group (i, j) pools from spatial bin (i, j)."""
+    def f(xa, bx):
+        b, c, h, w = xa.shape
+        oh = ow = output_size if isinstance(output_size, int) \
+            else output_size[0]
+        oc = c // (oh * ow)
+        outs = []
+        for r in range(bx.shape[0]):
+            x1, y1, x2, y2 = bx[r] * spatial_scale
+            rh = jnp.maximum(y2 - y1, 1e-4) / oh
+            rw = jnp.maximum(x2 - x1, 1e-4) / ow
+            pooled = jnp.zeros((oc, oh, ow), xa.dtype)
+            for i in range(oh):
+                for j in range(ow):
+                    # average over the bin via a soft mask (static shape)
+                    ys = jnp.arange(h, dtype=jnp.float32)
+                    xs = jnp.arange(w, dtype=jnp.float32)
+                    my = ((ys >= y1 + i * rh) &
+                          (ys < y1 + (i + 1) * rh)).astype(xa.dtype)
+                    mx = ((xs >= x1 + j * rw) &
+                          (xs < x1 + (j + 1) * rw)).astype(xa.dtype)
+                    mask = my[:, None] * mx[None, :]
+                    grp = xa[0, (i * ow + j) * oc:(i * ow + j + 1) * oc]
+                    s = (grp * mask[None]).sum(axis=(1, 2))
+                    cnt = jnp.maximum(mask.sum(), 1.0)
+                    pooled = pooled.at[:, i, j].set(s / cnt)
+            outs.append(pooled)
+        return jnp.stack(outs)
+    return apply("psroi_pool", f, x, boxes)
+
+
+def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, mask=None,
+                  name=None):
+    """Deformable convolution v1/v2 (reference deform_conv2d) as a
+    bilinear-gather + matmul: offsets bend each kernel tap's sampling
+    point; v2 modulation via `mask`. MXU-friendly (one big matmul over
+    gathered patches)."""
+    def _pair(v):
+        return (v, v) if isinstance(v, int) else tuple(v)
+    sh, sw = _pair(stride)
+    ph, pw = _pair(padding)
+    dh, dw = _pair(dilation)
+
+    def f(xa, off, w, *rest):
+        b, cin, h, wdt = xa.shape
+        cout, cin_g, kh, kw = w.shape
+        oh = (h + 2 * ph - dh * (kh - 1) - 1) // sh + 1
+        ow = (wdt + 2 * pw - dw * (kw - 1) - 1) // sw + 1
+        xa_p = jnp.pad(xa, ((0, 0), (0, 0), (ph, ph), (pw, pw)))
+        # base sampling grid per output position and tap
+        oy = jnp.arange(oh) * sh
+        ox = jnp.arange(ow) * sw
+        ky = jnp.arange(kh) * dh
+        kx = jnp.arange(kw) * dw
+        base_y = oy[:, None, None, None] + ky[None, None, :, None]
+        base_x = ox[None, :, None, None] + kx[None, None, None, :]
+        # offsets: [b, 2*dg*kh*kw, oh, ow] (y then x per tap)
+        off = off.reshape(b, deformable_groups, 2, kh * kw, oh, ow)
+        oy_ = off[:, :, 0].reshape(b, deformable_groups, kh, kw, oh, ow)
+        ox_ = off[:, :, 1].reshape(b, deformable_groups, kh, kw, oh, ow)
+        # sampling positions [b, dg, oh, ow, kh, kw]
+        yy = base_y[None, None] + oy_.transpose(0, 1, 4, 5, 2, 3)
+        xx = base_x[None, None] + ox_.transpose(0, 1, 4, 5, 2, 3)
+        hp, wp = xa_p.shape[2], xa_p.shape[3]
+        y0 = jnp.floor(yy)
+        x0 = jnp.floor(xx)
+        wy = yy - y0
+        wx = xx - x0
+
+        def gather(yi, xi):
+            yi_c = jnp.clip(yi.astype(jnp.int32), 0, hp - 1)
+            xi_c = jnp.clip(xi.astype(jnp.int32), 0, wp - 1)
+            valid = ((yi >= 0) & (yi <= hp - 1) &
+                     (xi >= 0) & (xi <= wp - 1)).astype(xa.dtype)
+            # per deformable group, gather its channel slab
+            cg = cin // deformable_groups
+            slabs = []
+            for g in range(deformable_groups):
+                slab = xa_p[:, g * cg:(g + 1) * cg]    # [b, cg, hp, wp]
+                bi = jnp.arange(b)[:, None, None, None, None]
+                gat = slab[bi, :, yi_c[:, g], xi_c[:, g]]
+                # gat: [b, oh, ow, kh, kw, cg] → [b, cg, oh, ow, kh, kw]
+                slabs.append(jnp.moveaxis(gat, -1, 1)
+                             * valid[:, g][:, None])
+            return jnp.concatenate(slabs, axis=1)
+
+        v = (gather(y0, x0) * ((1 - wy) * (1 - wx)).repeat(
+                cin // deformable_groups, axis=1).reshape(
+                b, cin, oh, ow, kh, kw)
+             + gather(y0, x0 + 1) * ((1 - wy) * wx).repeat(
+                cin // deformable_groups, axis=1).reshape(
+                b, cin, oh, ow, kh, kw)
+             + gather(y0 + 1, x0) * (wy * (1 - wx)).repeat(
+                cin // deformable_groups, axis=1).reshape(
+                b, cin, oh, ow, kh, kw)
+             + gather(y0 + 1, x0 + 1) * (wy * wx).repeat(
+                cin // deformable_groups, axis=1).reshape(
+                b, cin, oh, ow, kh, kw))
+        rest_i = 0
+        mod = None
+        if mask is not None:
+            mod = rest[rest_i]
+            rest_i += 1
+            mod = mod.reshape(b, deformable_groups, kh, kw, oh, ow) \
+                .transpose(0, 1, 4, 5, 2, 3)
+            v = v * mod.repeat(cin // deformable_groups, axis=1) \
+                .reshape(b, cin, oh, ow, kh, kw)
+        # contraction: out[b,co,oh,ow] = sum_ci,kh,kw v * w
+        out = jnp.einsum("bcoykl,dckl->bdoy",
+                         v.reshape(b, cin, oh, ow, kh, kw), w)
+        if bias is not None:
+            bval = rest[rest_i]
+            out = out + bval[None, :, None, None]
+        return out
+
+    args = [x, offset, weight]
+    if mask is not None:
+        args.append(mask)
+    if bias is not None:
+        args.append(bias)
+    return apply("deform_conv2d", f, *args)
+
+
+class DeformConv2D(_Layer):
+    """Layer form of deform_conv2d (reference vision/ops.py
+    DeformConv2D). A real nn.Layer: weight/bias register in
+    parameters()/state_dict and train under any optimizer."""
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, deformable_groups=1, groups=1,
+                 weight_attr=None, bias_attr=None):
+        super().__init__()
+        from ..nn.initializer import XavierUniform
+        from ..framework.core import Parameter
+        kh, kw = (kernel_size, kernel_size) \
+            if isinstance(kernel_size, int) else kernel_size
+        init = XavierUniform()
+        self.weight = Parameter(init(
+            (out_channels, in_channels // groups, kh, kw), "float32"))
+        self.bias = None
+        if bias_attr is not False:
+            self.bias = Parameter(jnp.zeros((out_channels,), jnp.float32))
+        self._cfg = dict(stride=stride, padding=padding,
+                         dilation=dilation,
+                         deformable_groups=deformable_groups,
+                         groups=groups)
+
+    def forward(self, x, offset, mask=None):
+        return deform_conv2d(x, offset, self.weight, self.bias,
+                             mask=mask, **self._cfg)
+
+
+def distribute_fpn_proposals(fpn_rois, min_level, max_level, refer_level,
+                             refer_scale, pixel_offset=False,
+                             rois_num=None, name=None):
+    """Route RoIs to FPN levels by scale (reference
+    distribute_fpn_proposals). Host-side."""
+    rois = np.asarray(fpn_rois._value if isinstance(fpn_rois, Tensor)
+                      else fpn_rois)
+    off = 1.0 if pixel_offset else 0.0
+    scale = np.sqrt(np.maximum(rois[:, 2] - rois[:, 0] + off, 0)
+                    * np.maximum(rois[:, 3] - rois[:, 1] + off, 0))
+    lvl = np.floor(np.log2(scale / refer_scale + 1e-8)) + refer_level
+    lvl = np.clip(lvl, min_level, max_level).astype(int)
+    outs, idxs = [], []
+    for level in range(min_level, max_level + 1):
+        sel = np.where(lvl == level)[0]
+        outs.append(Tensor(jnp.asarray(rois[sel])))
+        idxs.append(sel)
+    order = np.concatenate(idxs) if idxs else np.zeros(0, int)
+    restore = np.argsort(order).astype(np.int32).reshape(-1, 1)
+    nums = [Tensor(jnp.asarray(np.asarray([len(i)], np.int32)))
+            for i in idxs]
+    if rois_num is not None:
+        return outs, Tensor(jnp.asarray(restore)), nums
+    return outs, Tensor(jnp.asarray(restore))
+
+
+def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
+                       pre_nms_top_n=6000, post_nms_top_n=1000,
+                       nms_thresh=0.5, min_size=0.1, eta=1.0,
+                       pixel_offset=False, return_rois_num=False,
+                       name=None):
+    """RPN proposal generation (reference generate_proposals):
+    decode → clip → filter → NMS, host-side per image."""
+    sc = np.asarray(scores._value if isinstance(scores, Tensor)
+                    else scores)
+    bd = np.asarray(bbox_deltas._value
+                    if isinstance(bbox_deltas, Tensor) else bbox_deltas)
+    ims = np.asarray(img_size._value if isinstance(img_size, Tensor)
+                     else img_size)
+    an = np.asarray(anchors._value if isinstance(anchors, Tensor)
+                    else anchors).reshape(-1, 4)
+    va = np.asarray(variances._value if isinstance(variances, Tensor)
+                    else variances).reshape(-1, 4)
+    b = sc.shape[0]
+    all_rois, all_probs, nums = [], [], []
+    off = 1.0 if pixel_offset else 0.0
+    for bi in range(b):
+        n_before = len(all_rois)
+        s = sc[bi].transpose(1, 2, 0).reshape(-1)
+        d = bd[bi].transpose(1, 2, 0).reshape(-1, 4)
+        aw = an[:, 2] - an[:, 0] + off
+        ah = an[:, 3] - an[:, 1] + off
+        acx = an[:, 0] + aw / 2
+        acy = an[:, 1] + ah / 2
+        cx = va[:, 0] * d[:, 0] * aw + acx
+        cy = va[:, 1] * d[:, 1] * ah + acy
+        w = np.exp(np.minimum(va[:, 2] * d[:, 2], 10)) * aw
+        h = np.exp(np.minimum(va[:, 3] * d[:, 3], 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2 - off, cy + h / 2 - off], axis=1)
+        ih, iw = ims[bi][0], ims[bi][1]
+        boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - off)
+        boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - off)
+        keep = np.where((boxes[:, 2] - boxes[:, 0] + off >= min_size)
+                        & (boxes[:, 3] - boxes[:, 1] + off >= min_size))[0]
+        s, boxes = s[keep], boxes[keep]
+        order = np.argsort(-s)[:pre_nms_top_n]
+        s, boxes = s[order], boxes[order]
+        pick = []
+        while order.size and len(pick) < post_nms_top_n:
+            i = 0
+            pick.append(i)
+            x1 = np.maximum(boxes[i, 0], boxes[:, 0])
+            y1 = np.maximum(boxes[i, 1], boxes[:, 1])
+            x2 = np.minimum(boxes[i, 2], boxes[:, 2])
+            y2 = np.minimum(boxes[i, 3], boxes[:, 3])
+            inter = np.maximum(x2 - x1 + off, 0) * \
+                np.maximum(y2 - y1 + off, 0)
+            a_i = (boxes[:, 2] - boxes[:, 0] + off) * \
+                (boxes[:, 3] - boxes[:, 1] + off)
+            iou = inter / np.maximum(a_i[i] + a_i - inter, 1e-9)
+            rest = np.where(iou <= nms_thresh)[0]
+            rest = rest[rest != i]
+            sel = boxes[i:i + 1]
+            all_rois.append(sel)
+            all_probs.append(s[i:i + 1])
+            boxes, s, order = boxes[rest], s[rest], order[rest]
+        nums.append(len(all_rois) - n_before)
+    rois = np.concatenate(all_rois, 0) if all_rois \
+        else np.zeros((0, 4), np.float32)
+    probs = np.concatenate(all_probs, 0) if all_probs \
+        else np.zeros((0,), np.float32)
+    out = (Tensor(jnp.asarray(rois.astype(np.float32))),
+           Tensor(jnp.asarray(probs.astype(np.float32)[:, None])))
+    if return_rois_num:
+        out = out + (Tensor(jnp.asarray(np.asarray(nums, np.int32))),)
+    return out
+
+
+def read_file(filename, name=None):
+    """Read raw bytes as a uint8 tensor (reference read_file)."""
+    with open(filename, "rb") as f:
+        data = np.frombuffer(f.read(), np.uint8)
+    return Tensor(jnp.asarray(data))
+
+
+def decode_jpeg(x, mode="unchanged", name=None):
+    """Decode a JPEG byte tensor to CHW uint8 (reference decode_jpeg;
+    PIL plays the role of the reference's nvjpeg)."""
+    import io as _io
+    from PIL import Image
+    data = np.asarray(x._value if isinstance(x, Tensor) else x,
+                      np.uint8).tobytes()
+    img = Image.open(_io.BytesIO(data))
+    if mode == "gray":
+        img = img.convert("L")
+    elif mode == "rgb":
+        img = img.convert("RGB")
+    arr = np.asarray(img)
+    if arr.ndim == 2:
+        arr = arr[None]
+    else:
+        arr = arr.transpose(2, 0, 1)
+    return Tensor(jnp.asarray(arr))
+
+
+__all__ += ["prior_box", "yolo_box", "yolo_loss", "matrix_nms",
+            "psroi_pool", "deform_conv2d", "DeformConv2D",
+            "distribute_fpn_proposals", "generate_proposals",
+            "read_file", "decode_jpeg"]

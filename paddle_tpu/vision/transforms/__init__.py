@@ -2,8 +2,8 @@
 /root/reference/python/paddle/vision/transforms/__init__.py)."""
 from .functional import (  # noqa: F401
     adjust_brightness, adjust_contrast, adjust_hue, adjust_saturation,
-    center_crop, crop, erase, hflip, normalize, pad, resize, rotate,
-    to_grayscale, to_tensor, vflip,
+    affine, center_crop, crop, erase, hflip, normalize, pad, perspective,
+    resize, rotate, to_grayscale, to_tensor, vflip,
 )
 from .transforms import (  # noqa: F401
     BaseTransform, BrightnessTransform, CenterCrop, ColorJitter, Compose,
@@ -11,4 +11,5 @@ from .transforms import (  # noqa: F401
     RandomCrop, RandomErasing, RandomHorizontalFlip, RandomResizedCrop,
     RandomRotation, RandomVerticalFlip, Resize, SaturationTransform,
     ToTensor, Transpose,
+    RandomAffine, RandomPerspective,
 )

@@ -283,3 +283,93 @@ def erase(img, i, j, h, w, v, inplace=False):
     out = arr if (inplace and arr.flags.writeable) else arr.copy()
     out[i:i + h, j:j + w] = v
     return out
+
+
+def _inverse_warp(arr, xs, ys, interpolation, fill):
+    """Sample arr at float source coords (xs, ys) [oh, ow] — shared by
+    affine/perspective (same scheme as rotate)."""
+    h, w = arr.shape[:2]
+    if interpolation == "bilinear":
+        x0 = np.floor(xs).astype(np.int64)
+        y0 = np.floor(ys).astype(np.int64)
+        fx, fy = xs - x0, ys - y0
+        acc = 0.0
+        wsum = 0.0
+        for dy, wy in ((0, 1 - fy), (1, fy)):
+            for dx, wx in ((0, 1 - fx), (1, fx)):
+                xi = np.clip(x0 + dx, 0, w - 1)
+                yi = np.clip(y0 + dy, 0, h - 1)
+                inside = ((x0 + dx >= 0) & (x0 + dx < w)
+                          & (y0 + dy >= 0) & (y0 + dy < h))
+                wgt = (wy * wx) * inside
+                pix = arr[yi, xi].astype(np.float32)
+                if arr.ndim == 3:
+                    wgt = wgt[..., None]
+                acc = acc + wgt * pix
+                wsum = wsum + wgt
+        out = np.where(wsum > 1e-8, acc / np.maximum(wsum, 1e-8),
+                       np.float32(fill))
+        if arr.dtype == np.uint8:
+            return np.clip(np.round(out), 0, 255).astype(np.uint8)
+        return out.astype(arr.dtype)
+    xi = np.round(xs).astype(np.int64)
+    yi = np.round(ys).astype(np.int64)
+    valid = (xi >= 0) & (xi < w) & (yi >= 0) & (yi < h)
+    out = np.full(xs.shape + arr.shape[2:], fill, arr.dtype)
+    out[valid] = arr[yi[valid], xi[valid]]
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    """Affine transform (reference transforms.functional.affine):
+    rotation + translation + scale + shear about the center."""
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    cy, cx = ((h - 1) / 2.0, (w - 1) / 2.0) if center is None \
+        else (center[1], center[0])
+    rad = np.deg2rad(angle)
+    sx, sy = [np.deg2rad(s) for s in
+              (shear if isinstance(shear, (list, tuple))
+               else (shear, 0.0))]
+    # forward matrix: T(center) R(angle) Shear Scale T(-center) + trans
+    a = np.cos(rad - sy) / np.cos(sy)
+    b = -np.cos(rad - sy) * np.tan(sx) / np.cos(sy) - np.sin(rad)
+    c = np.sin(rad - sy) / np.cos(sy)
+    d = -np.sin(rad - sy) * np.tan(sx) / np.cos(sy) + np.cos(rad)
+    m = np.array([[a, b], [c, d]], np.float64) * scale
+    inv = np.linalg.inv(m)
+    tx, ty = translate
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ox = xx - cx - tx
+    oy = yy - cy - ty
+    xs = inv[0, 0] * ox + inv[0, 1] * oy + cx
+    ys = inv[1, 0] * ox + inv[1, 1] * oy + cy
+    return _inverse_warp(arr, xs, ys, interpolation, fill)
+
+
+def _persp_coeffs(src, dst):
+    """Solve the 8-dof homography mapping dst → src points."""
+    A = []
+    B = []
+    for (xs, ys), (xd, yd) in zip(src, dst):
+        A.append([xd, yd, 1, 0, 0, 0, -xs * xd, -xs * yd])
+        B.append(xs)
+        A.append([0, 0, 0, xd, yd, 1, -ys * xd, -ys * yd])
+        B.append(ys)
+    return np.linalg.solve(np.asarray(A, np.float64),
+                           np.asarray(B, np.float64))
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Perspective transform (reference functional.perspective):
+    startpoints (source corners) map to endpoints."""
+    arr = _to_np(img)
+    h, w = arr.shape[:2]
+    co = _persp_coeffs(startpoints, endpoints)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = co[6] * xx + co[7] * yy + 1.0
+    xs = (co[0] * xx + co[1] * yy + co[2]) / den
+    ys = (co[3] * xx + co[4] * yy + co[5]) / den
+    return _inverse_warp(arr, xs, ys, interpolation, fill)

@@ -16,6 +16,7 @@ __all__ = [
     "Transpose", "Normalize", "BrightnessTransform", "ContrastTransform",
     "SaturationTransform", "HueTransform", "ColorJitter", "RandomCrop",
     "Pad", "RandomRotation", "Grayscale", "RandomErasing",
+    "RandomAffine", "RandomPerspective",
 ]
 
 
@@ -326,3 +327,72 @@ class RandomErasing(BaseTransform):
                 return F.erase(arr, top, left, eh, ew, self.value,
                                self.inplace)
         return arr
+
+
+class RandomAffine(BaseTransform):
+    """Random affine (reference transforms.RandomAffine)."""
+
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        super().__init__(keys)
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else tuple(degrees)
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.interpolation = interpolation
+        self.fill = fill
+        self.center = center
+
+    def _apply_image(self, img):
+        from . import functional as F
+        rng = np.random
+        angle = rng.uniform(*self.degrees)
+        arr = F._to_np(img)
+        h, w = arr.shape[:2]
+        tx = ty = 0.0
+        if self.translate is not None:
+            tx = rng.uniform(-self.translate[0], self.translate[0]) * w
+            ty = rng.uniform(-self.translate[1], self.translate[1]) * h
+        sc = rng.uniform(*self.scale) if self.scale else 1.0
+        if self.shear is None:
+            sh = (0.0, 0.0)
+        elif np.isscalar(self.shear):
+            sh = (rng.uniform(-self.shear, self.shear), 0.0)
+        else:
+            lo, hi = self.shear[0], self.shear[1]
+            sh = (rng.uniform(lo, hi), 0.0)
+        return F.affine(img, angle, (tx, ty), sc, sh,
+                        interpolation=self.interpolation, fill=self.fill,
+                        center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    """Random perspective distortion (reference RandomPerspective)."""
+
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        super().__init__(keys)
+        self.prob = prob
+        self.distortion_scale = distortion_scale
+        self.interpolation = interpolation
+        self.fill = fill
+
+    def _apply_image(self, img):
+        from . import functional as F
+        rng = np.random
+        if rng.rand() > self.prob:
+            return F._to_np(img)
+        arr = F._to_np(img)
+        h, w = arr.shape[:2]
+        d = self.distortion_scale
+        dx, dy = int(w * d / 2), int(h * d / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [(rng.randint(0, dx + 1), rng.randint(0, dy + 1)),
+               (w - 1 - rng.randint(0, dx + 1), rng.randint(0, dy + 1)),
+               (w - 1 - rng.randint(0, dx + 1),
+                h - 1 - rng.randint(0, dy + 1)),
+               (rng.randint(0, dx + 1), h - 1 - rng.randint(0, dy + 1))]
+        return F.perspective(img, start, end,
+                             interpolation=self.interpolation,
+                             fill=self.fill)

@@ -191,3 +191,266 @@ class TestCrossEntropyOp(OpTest):
 
     def test_grad(self):
         self.check_grad(grad_inputs=["logits"])
+
+
+# ---------------------------------------------------------------------------
+# Wide battery (VERDICT r2 #10): table-driven output + numeric-grad
+# checks over the ~100 most-used tensor ops (reference pattern:
+# test/legacy_test/op_test.py:420 check_grad — numeric central
+# difference vs the eager tape). Inputs stay tiny: the numeric
+# gradient costs 2 op calls per element.
+# ---------------------------------------------------------------------------
+import scipy.special as _sps
+
+_r = np.random.RandomState(11)
+
+
+def _pos(*s):
+    return (_r.rand(*s) + 0.5).astype(np.float32)
+
+
+def _unit(*s):
+    return (_r.rand(*s) * 1.6 - 0.8).astype(np.float32)
+
+
+def _std(*s):
+    return _r.randn(*s).astype(np.float32)
+
+
+# (name, paddle_fn, numpy_ref, inputs, check_grad)
+_BATTERY = [
+    ("sin", paddle.sin, np.sin, [_std(2, 3)], True),
+    ("cos", paddle.cos, np.cos, [_std(2, 3)], True),
+    ("tan", paddle.tan, np.tan, [_unit(2, 3)], True),
+    ("asin", paddle.asin, np.arcsin, [_unit(2, 3)], True),
+    ("acos", paddle.acos, np.arccos, [_unit(2, 3)], True),
+    ("atan", paddle.atan, np.arctan, [_std(2, 3)], True),
+    ("sinh", paddle.sinh, np.sinh, [_std(2, 3)], True),
+    ("cosh", paddle.cosh, np.cosh, [_std(2, 3)], True),
+    ("tanh", paddle.tanh, np.tanh, [_std(2, 3)], True),
+    ("asinh", paddle.asinh, np.arcsinh, [_std(2, 3)], True),
+    ("acosh", paddle.acosh, np.arccosh, [_pos(2, 3) + 1.0], True),
+    ("atanh", paddle.atanh, np.arctanh, [_unit(2, 3)], True),
+    ("exp", paddle.exp, np.exp, [_std(2, 3)], True),
+    ("expm1", paddle.expm1, np.expm1, [_std(2, 3)], True),
+    ("log", paddle.log, np.log, [_pos(2, 3)], True),
+    ("log2", paddle.log2, np.log2, [_pos(2, 3)], True),
+    ("log10", paddle.log10, np.log10, [_pos(2, 3)], True),
+    ("log1p", paddle.log1p, np.log1p, [_pos(2, 3)], True),
+    ("sqrt", paddle.sqrt, np.sqrt, [_pos(2, 3)], True),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x), [_pos(2, 3)], True),
+    ("abs", paddle.abs, np.abs, [_pos(2, 3)], True),
+    ("square", paddle.square, np.square, [_std(2, 3)], True),
+    ("reciprocal", paddle.reciprocal, lambda x: 1 / x, [_pos(2, 3)], True),
+    ("sign", paddle.sign, np.sign, [_std(2, 3)], False),
+    ("ceil", paddle.ceil, np.ceil, [_std(2, 3)], False),
+    ("floor", paddle.floor, np.floor, [_std(2, 3)], False),
+    ("round", paddle.round, np.round, [_std(2, 3)], False),
+    ("trunc", paddle.trunc, np.trunc, [_std(2, 3)], False),
+    ("frac", paddle.frac, lambda x: x - np.trunc(x), [_std(2, 3)], True),
+    ("sigmoid", F.sigmoid, _sps.expit, [_std(2, 3)], True),
+    ("erf", paddle.erf, _sps.erf, [_std(2, 3)], True),
+    ("erfinv", paddle.erfinv, _sps.erfinv, [_unit(2, 3)], True),
+    ("lgamma", paddle.lgamma, _sps.gammaln, [_pos(2, 3)], True),
+    ("digamma", paddle.digamma, _sps.digamma, [_pos(2, 3) + 1], True),
+    ("logit", paddle.logit, _sps.logit,
+     [(_r.rand(2, 3) * 0.8 + 0.1).astype(np.float32)], True),
+    ("i0", paddle.i0, _sps.i0, [_pos(2, 3)], True),
+    ("add", paddle.add, np.add, [_std(2, 3), _std(2, 3)], True),
+    ("subtract", paddle.subtract, np.subtract,
+     [_std(2, 3), _std(2, 3)], True),
+    ("multiply", paddle.multiply, np.multiply,
+     [_std(2, 3), _std(2, 3)], True),
+    ("divide", paddle.divide, np.divide, [_std(2, 3), _pos(2, 3)], True),
+    ("pow", paddle.pow, np.power, [_pos(2, 3), _unit(2, 3) + 1.2], True),
+    ("maximum", paddle.maximum, np.maximum,
+     [_std(2, 3), _std(2, 3)], True),
+    ("minimum", paddle.minimum, np.minimum,
+     [_std(2, 3), _std(2, 3)], True),
+    ("fmax", paddle.fmax, np.fmax, [_std(2, 3), _std(2, 3)], True),
+    ("fmin", paddle.fmin, np.fmin, [_std(2, 3), _std(2, 3)], True),
+    ("atan2", paddle.atan2, np.arctan2, [_std(2, 3), _pos(2, 3)], True),
+    ("hypot", paddle.hypot, np.hypot, [_pos(2, 3), _pos(2, 3)], True),
+    ("remainder", paddle.remainder, np.remainder,
+     [_pos(2, 3) * 3, _pos(2, 3)], False),
+    ("floor_divide", paddle.floor_divide, np.floor_divide,
+     [_pos(2, 3) * 5, _pos(2, 3)], False),
+    ("logaddexp", paddle.logaddexp, np.logaddexp,
+     [_std(2, 3), _std(2, 3)], True),
+    ("sum", lambda x: paddle.sum(x, axis=1),
+     lambda x: x.sum(axis=1), [_std(2, 4)], True),
+    ("mean", lambda x: paddle.mean(x, axis=0),
+     lambda x: x.mean(axis=0), [_std(3, 3)], True),
+    ("prod", lambda x: paddle.prod(x, axis=1),
+     lambda x: x.prod(axis=1), [_pos(2, 3)], True),
+    ("max", lambda x: paddle.max(x, axis=1),
+     lambda x: x.max(axis=1), [_std(2, 4)], True),
+    ("min", lambda x: paddle.min(x, axis=1),
+     lambda x: x.min(axis=1), [_std(2, 4)], True),
+    ("amax", lambda x: paddle.amax(x, axis=1),
+     lambda x: x.max(axis=1), [_std(2, 4)], False),
+    ("amin", lambda x: paddle.amin(x, axis=1),
+     lambda x: x.min(axis=1), [_std(2, 4)], False),
+    ("logsumexp", lambda x: paddle.logsumexp(x, axis=1),
+     lambda x: np.log(np.exp(x).sum(axis=1)), [_std(2, 4)], True),
+    ("std", lambda x: paddle.std(x, axis=1),
+     lambda x: x.std(axis=1, ddof=1), [_std(2, 5)], True),
+    ("var", lambda x: paddle.var(x, axis=1),
+     lambda x: x.var(axis=1, ddof=1), [_std(2, 5)], True),
+    ("norm", lambda x: paddle.norm(x, p=2),
+     lambda x: np.linalg.norm(x.reshape(-1)), [_std(2, 3)], True),
+    ("cumsum", lambda x: paddle.cumsum(x, axis=1),
+     lambda x: x.cumsum(axis=1), [_std(2, 4)], True),
+    ("cumprod", lambda x: paddle.cumprod(x, dim=1),
+     lambda x: x.cumprod(axis=1), [_pos(2, 3)], True),
+    ("reshape", lambda x: paddle.reshape(x, [3, 2]),
+     lambda x: x.reshape(3, 2), [_std(2, 3)], True),
+    ("transpose", lambda x: paddle.transpose(x, [1, 0]),
+     lambda x: x.T, [_std(2, 3)], True),
+    ("squeeze", lambda x: paddle.squeeze(x, axis=0),
+     lambda x: x.squeeze(0), [_std(1, 4)], True),
+    ("unsqueeze", lambda x: paddle.unsqueeze(x, axis=1),
+     lambda x: x[:, None], [_std(3,)], True),
+    ("flatten", lambda x: paddle.flatten(x),
+     lambda x: x.reshape(-1), [_std(2, 3)], True),
+    ("flip", lambda x: paddle.flip(x, axis=[1]),
+     lambda x: x[:, ::-1], [_std(2, 3)], True),
+    ("roll", lambda x: paddle.roll(x, 1, axis=1),
+     lambda x: np.roll(x, 1, axis=1), [_std(2, 3)], True),
+    ("tile", lambda x: paddle.tile(x, [2, 1]),
+     lambda x: np.tile(x, (2, 1)), [_std(2, 3)], True),
+    ("broadcast_to", lambda x: paddle.broadcast_to(x, [3, 4]),
+     lambda x: np.broadcast_to(x, (3, 4)).copy(), [_std(1, 4)], True),
+    ("clip", lambda x: paddle.clip(x, -0.5, 0.5),
+     lambda x: np.clip(x, -0.5, 0.5), [_std(2, 3)], True),
+    ("pad", lambda x: paddle.nn.functional.pad(x, [0, 0, 1, 1],
+                                               value=0.0),
+     lambda x: np.pad(x, ((0, 0), (1, 1))), [_std(2, 3)], True),
+    ("matmul", paddle.matmul, lambda a, b: a @ b,
+     [_std(2, 3), _std(3, 2)], True),
+    ("bmm", paddle.bmm, lambda a, b: a @ b,
+     [_std(2, 2, 3), _std(2, 3, 2)], True),
+    ("dot", paddle.dot, np.dot, [_std(4,), _std(4,)], True),
+    ("outer", paddle.outer, np.outer, [_std(3,), _std(2,)], True),
+    ("inner", paddle.inner, np.inner, [_std(2, 3), _std(2, 3)], True),
+    ("t", paddle.t, lambda x: x.T, [_std(2, 3)], True),
+    ("trace", paddle.trace, np.trace, [_std(3, 3)], True),
+    ("diag", paddle.diag, np.diag, [_std(3,)], True),
+    ("diagonal", paddle.diagonal, lambda x: np.diagonal(x),
+     [_std(3, 3)], True),
+    ("kron", paddle.kron, np.kron, [_std(2, 2), _std(2, 2)], True),
+    ("cross", paddle.cross, lambda a, b: np.cross(a, b),
+     [_std(2, 3), _std(2, 3)], True),
+    ("triu", paddle.triu, np.triu, [_std(3, 3)], True),
+    ("tril", paddle.tril, np.tril, [_std(3, 3)], True),
+    ("relu", F.relu, lambda x: np.maximum(x, 0), [_std(2, 3)], True),
+    ("gelu", F.gelu,
+     lambda x: x * 0.5 * (1 + _sps.erf(x / np.sqrt(2))),
+     [_std(2, 3)], True),
+    ("silu", F.silu, lambda x: x * _sps.expit(x), [_std(2, 3)], True),
+    ("softplus", F.softplus, lambda x: np.log1p(np.exp(x)),
+     [_std(2, 3)], True),
+    ("softsign", F.softsign, lambda x: x / (1 + np.abs(x)),
+     [_pos(2, 3)], True),
+    ("elu", F.elu,
+     lambda x: np.where(x > 0, x, np.expm1(x)), [_std(2, 3)], True),
+    ("leaky_relu", F.leaky_relu,
+     lambda x: np.where(x > 0, x, 0.01 * x), [_std(2, 3)], True),
+    ("relu6", F.relu6, lambda x: np.clip(x, 0, 6), [_std(2, 3)], True),
+    ("hardtanh", F.hardtanh, lambda x: np.clip(x, -1, 1),
+     [_std(2, 3) * 2], True),
+    ("hardsigmoid", F.hardsigmoid,
+     lambda x: np.clip(x / 6 + 0.5, 0, 1), [_std(2, 3)], True),
+    ("hardswish", F.hardswish,
+     lambda x: x * np.clip(x + 3, 0, 6) / 6, [_std(2, 3)], True),
+    ("mish", F.mish,
+     lambda x: x * np.tanh(np.log1p(np.exp(x))), [_std(2, 3)], True),
+    ("log_sigmoid", F.log_sigmoid,
+     lambda x: np.log(_sps.expit(x)), [_std(2, 3)], True),
+    ("log_softmax", lambda x: F.log_softmax(x, axis=-1),
+     lambda x: x - x.max(-1, keepdims=True)
+     - np.log(np.exp(x - x.max(-1, keepdims=True)).sum(-1,
+                                                       keepdims=True)),
+     [_std(2, 4)], True),
+    ("tanhshrink", F.tanhshrink, lambda x: x - np.tanh(x),
+     [_std(2, 3)], True),
+    ("softshrink", lambda x: F.softshrink(x, 0.3),
+     lambda x: np.where(x > 0.3, x - 0.3,
+                        np.where(x < -0.3, x + 0.3, 0)),
+     [_std(2, 3)], True),
+    ("hardshrink", lambda x: F.hardshrink(x, 0.3),
+     lambda x: np.where(np.abs(x) > 0.3, x, 0), [_std(2, 3)], True),
+    ("where", lambda c, x, y: paddle.where(c, x, y),
+     lambda c, x, y: np.where(c, x, y),
+     [(_r.rand(2, 3) > 0.5), _std(2, 3), _std(2, 3)], False),
+    ("lerp", paddle.lerp,
+     lambda x, y, w: x + w * (y - x),
+     [_std(2, 3), _std(2, 3), _pos(2, 3) * 0.4], True),
+    ("nan_to_num", paddle.nan_to_num, np.nan_to_num,
+     [_std(2, 3)], True),
+    ("gather", lambda x: paddle.gather(x, paddle.to_tensor(
+        np.array([0, 2], np.int64))),
+     lambda x: x[[0, 2]], [_std(3, 2)], True),
+    ("index_select", lambda x: paddle.index_select(
+        x, paddle.to_tensor(np.array([1, 0], np.int64)), axis=1),
+     lambda x: x[:, [1, 0]], [_std(2, 3)], True),
+    ("equal", paddle.equal, np.equal,
+     [_std(2, 3), _std(2, 3)], False),
+    ("isnan", paddle.isnan, np.isnan, [_std(2, 3)], False),
+    ("isinf", paddle.isinf, np.isinf, [_std(2, 3)], False),
+    ("isfinite", paddle.isfinite, np.isfinite, [_std(2, 3)], False),
+]
+
+
+@pytest.mark.parametrize(
+    "name,op,ref,inputs,grad", _BATTERY,
+    ids=[row[0] for row in _BATTERY])
+def test_battery_output(name, op, ref, inputs, grad):
+    ts = [paddle.to_tensor(a) for a in inputs]
+    got = op(*ts)
+    if isinstance(got, (tuple, list)):
+        got = got[0]
+    want = np.asarray(ref(*inputs))
+    np.testing.assert_allclose(
+        np.asarray(got._value).reshape(want.shape), want,
+        rtol=2e-5, atol=2e-5, err_msg=name)
+
+
+@pytest.mark.parametrize(
+    "name,op,ref,inputs,grad",
+    [row for row in _BATTERY if row[4]],
+    ids=[row[0] for row in _BATTERY if row[4]])
+def test_battery_numeric_grad(name, op, ref, inputs, grad):
+    """Analytic (tape) vs central-difference gradient of sum(op)."""
+    float_pos = [i for i, a in enumerate(inputs)
+                 if np.asarray(a).dtype == np.float32]
+    ts = [paddle.to_tensor(a, stop_gradient=(i not in float_pos))
+          for i, a in enumerate(inputs)]
+    out = op(*ts)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    out.sum().backward()
+
+    def fval(args):
+        o = op(*[paddle.to_tensor(a) for a in args])
+        if isinstance(o, (tuple, list)):
+            o = o[0]
+        return float(np.asarray(o.sum()._value))
+
+    eps = 1e-3
+    for i in float_pos:
+        analytic = np.asarray(ts[i].grad._value)
+        base = np.asarray(inputs[i], np.float32)
+        num = np.zeros_like(base)
+        it = np.nditer(base, flags=["multi_index"])
+        while not it.finished:
+            idx = it.multi_index
+            hi = [a.copy() if hasattr(a, "copy") else a for a in inputs]
+            lo = [a.copy() if hasattr(a, "copy") else a for a in inputs]
+            hi[i][idx] += eps
+            lo[i][idx] -= eps
+            num[idx] = (fval(hi) - fval(lo)) / (2 * eps)
+            it.iternext()
+        np.testing.assert_allclose(
+            analytic, num, rtol=2e-2, atol=2e-3,
+            err_msg=f"{name}: numeric grad mismatch for input {i}")

@@ -1,0 +1,401 @@
+"""Round-3 parity fills (VERDICT r2 #10): inplace tensor variants,
+linalg/static/sparse/io/nn.utils/geometric/inference long tails, and
+the parity-audit ratchet."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+
+t = paddle.to_tensor
+rng = np.random.RandomState(0)
+
+
+def n(x):
+    return np.asarray(x._value if hasattr(x, "_value") else x)
+
+
+class TestInplaceVariants:
+    def test_inplace_returns_self_and_mutates(self):
+        x = t(np.array([-1.0, 4.0], np.float32))
+        assert x.abs_() is x
+        np.testing.assert_allclose(n(x), [1.0, 4.0])
+        x.sqrt_()
+        np.testing.assert_allclose(n(x), [1.0, 2.0])
+        x.scale_(3.0)
+        np.testing.assert_allclose(n(x), [3.0, 6.0])
+
+    def test_inplace_namespace_functions(self):
+        import paddle_tpu.tensor as T
+        for name in ("exp_", "clip_", "floor_", "tanh_", "tril_",
+                     "logical_not_", "cumsum_", "where_"):
+            assert hasattr(T, name), name
+        y = T.clip_(t(np.array([-5.0, 5.0], np.float32)), -1.0, 1.0)
+        np.testing.assert_allclose(n(y), [-1.0, 1.0])
+
+    def test_random_fills(self):
+        x = t(np.zeros(500, np.float32))
+        x.cauchy_()
+        assert np.isfinite(n(x)).all() and n(x).std() > 0
+        x2 = t(np.zeros(500, np.float32))
+        x2.geometric_(0.5)
+        assert (n(x2) >= 1).all()
+
+    def test_factories(self):
+        import paddle_tpu.tensor as T
+        p = T.create_parameter([4, 8], "float32")
+        assert p.trainable and p.shape == [4, 8]
+        assert T.create_tensor("int32").shape == [0]
+
+
+class TestLinalgFills:
+    def test_eig_matches_numpy(self):
+        a = rng.randn(5, 5).astype(np.float32)
+        w, v = paddle.linalg.eig(t(a))
+        got = sorted(n(w).real)
+        want = sorted(np.linalg.eigvals(a).real)
+        np.testing.assert_allclose(got, want, atol=1e-3)
+        wv = paddle.linalg.eigvals(t(a))
+        np.testing.assert_allclose(sorted(n(wv).real), want, atol=1e-3)
+
+    def test_matrix_exp(self):
+        out = paddle.linalg.matrix_exp(t(np.zeros((3, 3), np.float32)))
+        np.testing.assert_allclose(n(out), np.eye(3), atol=1e-6)
+
+    def test_cholesky_solve_and_lu_unpack(self):
+        a = rng.randn(4, 4).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        c = np.linalg.cholesky(spd).astype(np.float32)
+        b = rng.randn(4, 2).astype(np.float32)
+        xs = paddle.linalg.cholesky_solve(t(b), t(c))
+        np.testing.assert_allclose(n(xs), np.linalg.solve(spd, b),
+                                   atol=1e-4)
+        lu_t, piv = paddle.linalg.lu(t(spd))
+        P, L, U = paddle.linalg.lu_unpack(lu_t, piv)
+        np.testing.assert_allclose(n(P) @ n(L) @ n(U), spd, atol=1e-4)
+
+    def test_pca_lowrank(self):
+        u, s, v = paddle.linalg.pca_lowrank(
+            t(rng.randn(12, 6).astype(np.float32)), q=3)
+        assert u.shape == [12, 3] and s.shape == [3] and v.shape == [6, 3]
+
+
+class TestStaticCompat:
+    def test_metric_ops(self):
+        import paddle_tpu.static as S
+        pred = t(np.array([[0.1, 0.9], [0.8, 0.2], [0.3, 0.7]],
+                          np.float32))
+        y = t(np.array([[1], [0], [0]], np.int64))
+        np.testing.assert_allclose(float(n(S.accuracy(pred, y))), 2 / 3,
+                                   atol=1e-6)
+        a, _, _ = S.auc(pred, y)
+        assert 0.0 <= float(n(a)) <= 1.0
+
+    def test_ema_roundtrip(self):
+        import paddle_tpu.static as S
+        m = nn.Linear(3, 3)
+        w0 = n(m.weight).copy()
+        ema = S.ExponentialMovingAverage(0.9)
+        ema.update(m.parameters())
+        m.weight._replace(m.weight._value + 10.0)
+        ema.update(m.parameters())
+        with ema.apply():
+            shadow = n(m.weight).copy()
+        np.testing.assert_allclose(n(m.weight), w0 + 10.0)
+        assert not np.allclose(shadow, w0 + 10.0)
+
+    def test_places_guards_and_print(self):
+        import paddle_tpu.static as S
+        assert S.cpu_places(2) == ["cpu:0", "cpu:1"]
+        assert len(S.cuda_places()) >= 1
+        with S.name_scope("blk"), S.device_guard("cpu"), \
+                S.scope_guard(None):
+            v = S.create_global_var([2], 1.5, "float32")
+        np.testing.assert_allclose(n(v), [1.5, 1.5])
+        out = S.Print(t(np.ones(2, np.float32)), message="dbg")
+        np.testing.assert_allclose(n(out), 1.0)
+
+    def test_program_state_roundtrip(self, tmp_path):
+        import paddle_tpu.static as S
+
+        class FakeProg:
+            def __init__(self):
+                self._ps = [t(np.ones(3, np.float32))]
+
+            def parameters(self):
+                return self._ps
+
+        prog = FakeProg()
+        path = str(tmp_path / "m")
+        S.save(prog, path)
+        prog._ps[0]._replace(prog._ps[0]._value * 0)
+        S.load(prog, path)
+        np.testing.assert_allclose(n(prog._ps[0]), 1.0)
+        state = S.load_program_state(path)
+        S.set_program_state(prog, state)
+
+    def test_descoped_raise(self):
+        import paddle_tpu.static as S
+        with pytest.raises(NotImplementedError):
+            S.IpuStrategy()
+        with pytest.raises(NotImplementedError):
+            S.WeightNormParamAttr()
+
+
+class TestNNUtils:
+    def test_weight_norm_preserves_and_trains(self):
+        paddle.seed(0)
+        m = nn.Linear(4, 6)
+        x = t(rng.randn(3, 4).astype(np.float32))
+        y0 = n(m(x))
+        nn.utils.weight_norm(m, "weight", dim=0)
+        y1 = m(x)
+        np.testing.assert_allclose(n(y1), y0, atol=1e-5)
+        names = [nm for nm, _ in m.named_parameters()]
+        assert "weight_g" in names and "weight_v" in names \
+            and "weight" not in names
+        (y1 ** 2).sum().backward()
+        assert m.weight_g.grad is not None
+        assert m.weight_v.grad is not None
+        nn.utils.remove_weight_norm(m)
+        np.testing.assert_allclose(n(m(x)), y0, atol=1e-5)
+
+    def test_spectral_norm_bounds_sigma(self):
+        paddle.seed(1)
+        m = nn.Linear(5, 5)
+        nn.utils.spectral_norm(m, "weight", n_power_iterations=30)
+        s = np.linalg.svd(n(m.weight), compute_uv=False)
+        assert s[0] <= 1.05
+
+    def test_param_vector_roundtrip(self):
+        m = nn.Linear(3, 2)
+        vec = nn.utils.parameters_to_vector(m.parameters())
+        assert vec.shape == [3 * 2 + 2]
+        nn.utils.vector_to_parameters(vec * 2, m.parameters())
+        assert np.allclose(n(m.bias), n(vec)[6:] * 2)
+
+
+class TestSparseFills:
+    def _st(self):
+        import paddle_tpu.sparse as S
+        return S, S.sparse_coo_tensor(
+            np.array([[0, 1], [1, 0]]),
+            np.array([2.0, -3.0], np.float32), (2, 2))
+
+    def test_unary_keep_pattern(self):
+        S, st = self._st()
+        out = S.abs(st)
+        assert out.nnz == 2
+        np.testing.assert_allclose(
+            n(out.to_dense()), [[0, 2.0], [3.0, 0]])
+
+    def test_structural(self):
+        S, st = self._st()
+        assert float(n(S.sum(st))) == -1.0
+        tr = S.transpose(st, [1, 0])
+        np.testing.assert_allclose(n(tr.to_dense()),
+                                   [[0, -3.0], [2.0, 0]])
+        mv = S.mv(st, t(np.ones(2, np.float32)))
+        np.testing.assert_allclose(n(mv), [2.0, -3.0])
+        sl = S.slice(st, [0], [0], [1])
+        assert sl.shape == [1, 2]
+
+
+class TestMiscFills:
+    def test_io_concat_subset(self):
+        from paddle_tpu.io import (ConcatDataset, Dataset,
+                                   SubsetRandomSampler)
+
+        class DS(Dataset):
+            def __init__(self, lo, hi):
+                self.items = list(range(lo, hi))
+
+            def __len__(self):
+                return len(self.items)
+
+            def __getitem__(self, i):
+                return self.items[i]
+
+        cd = ConcatDataset([DS(0, 3), DS(10, 12)])
+        assert len(cd) == 5
+        assert [cd[i] for i in range(5)] == [0, 1, 2, 10, 11]
+        s = SubsetRandomSampler([3, 7, 9])
+        assert sorted(s) == [3, 7, 9] and len(s) == 3
+
+    def test_fractional_pool_and_rnnt_layer(self):
+        x = t(rng.randn(1, 2, 9, 9).astype(np.float32))
+        y = nn.FractionalMaxPool2D(output_size=4, random_u=0.4)(x)
+        assert y.shape == [1, 2, 4, 4]
+        logits = t(rng.randn(1, 4, 3, 5).astype(np.float32))
+        out = nn.RNNTLoss()(logits, t(np.array([[1, 2]], np.int32)),
+                            t(np.array([4])), t(np.array([2])))
+        assert np.isfinite(float(n(out)))
+
+    def test_geometric_fills(self):
+        import paddle_tpu.geometric as G
+        row = np.array([1, 2, 0, 2, 0, 1], np.int64)
+        colptr = np.array([0, 2, 4, 6], np.int64)
+        w = np.ones(6, np.float32)
+        nbr, cnt = G.weighted_sample_neighbors(
+            t(row), t(colptr), t(w), t(np.array([0, 1], np.int64)),
+            sample_size=1)
+        assert n(cnt).tolist() == [1, 1]
+        out = G.reindex_heter_graph(
+            t(np.array([5, 9], np.int64)),
+            [t(np.array([9, 7], np.int64))], [t(np.array([2], np.int64))])
+        assert n(out[0]).tolist() == [1, 2]
+
+    def test_inference_names(self):
+        import paddle_tpu.inference as inf
+        assert inf.get_num_bytes_of_data_type(inf.DataType.BFLOAT16) == 2
+        assert inf.get_trt_compile_version() == (0, 0, 0)
+        assert "paddle_tpu" in inf.get_version()
+        with pytest.raises(NotImplementedError):
+            inf.convert_to_mixed_precision("a", "b", "c", "d")
+
+    def test_jit_enable_to_static_toggle(self):
+        calls = []
+
+        @paddle.jit.to_static
+        def f(x):
+            calls.append(1)
+            return x * 2
+
+        paddle.jit.enable_to_static(False)
+        try:
+            out = f(t(np.ones(2, np.float32)))
+            np.testing.assert_allclose(n(out), 2.0)
+        finally:
+            paddle.jit.enable_to_static(True)
+
+    def test_resnext_and_shufflenet_variants(self):
+        from paddle_tpu.vision.models import (resnext50_64x4d,
+                                              shufflenet_v2_swish)
+        m = shufflenet_v2_swish(num_classes=10)
+        x = t(rng.randn(1, 3, 64, 64).astype(np.float32))
+        assert m(x).shape == [1, 10]
+
+
+class TestParityRatchet:
+    def test_overall_parity_floor(self):
+        import sys
+        sys.path.insert(0, "tools")
+        import parity_audit
+        rows, overall = parity_audit.audit()
+        assert overall >= parity_audit.FLOORS["_overall"], (
+            f"API parity regressed: {overall:.1f}% < "
+            f"{parity_audit.FLOORS['_overall']}%")
+
+
+class TestDistributedCompat:
+    def test_enums_and_state(self):
+        import paddle_tpu.distributed as D
+        assert D.ReduceType.kRedSum == 0
+        assert D.ParallelMode.TENSOR_PARALLEL == 1
+        assert D.is_available()
+        assert "xla" in D.get_backend()
+        assert D.Strategy is not None
+
+    def test_object_collectives(self):
+        import paddle_tpu.distributed as D
+        objs = []
+        D.all_gather_object(objs, {"k": 3})
+        assert objs and all(o == {"k": 3} for o in objs)
+        lst = ["a", "b"]
+        assert D.broadcast_object_list(lst) == ["a", "b"]
+
+    def test_ps_descopes_raise(self):
+        import paddle_tpu.distributed as D
+        for cls in (D.InMemoryDataset, D.QueueDataset,
+                    D.CountFilterEntry):
+            with pytest.raises(NotImplementedError, match="descoped"):
+                cls()
+
+    def test_checkpoint_reexports(self, tmp_path):
+        import paddle_tpu.distributed as D
+        sd = {"w": t(np.ones(4, np.float32))}
+        D.save_state_dict(sd, str(tmp_path))
+        import paddle_tpu.distributed.checkpoint as ck
+        ck.wait_until_finished()
+        out = {"w": t(np.zeros(4, np.float32))}
+        D.load_state_dict(out, str(tmp_path))
+        np.testing.assert_allclose(n(out["w"]), 1.0)
+
+
+class TestIncubateFusedFills:
+    def test_fused_matmul_bias(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = t(rng.randn(3, 4).astype(np.float32))
+        w = t(rng.randn(4, 5).astype(np.float32))
+        b = t(rng.randn(5).astype(np.float32))
+        out = IF.fused_matmul_bias(x, w, b)
+        # bf16 MXU accumulation on-chip: loose tolerance
+        np.testing.assert_allclose(n(out), n(x) @ n(w) + n(b),
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_bias_dropout_residual_ln(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        x = t(rng.randn(2, 6).astype(np.float32))
+        out = IF.fused_bias_dropout_residual_layer_norm(
+            x, x, dropout_rate=0.0)
+        got = n(out)
+        # normalized over last dim
+        np.testing.assert_allclose(got.mean(-1), 0.0, atol=1e-5)
+        np.testing.assert_allclose(got.std(-1), 1.0, atol=2e-2)
+
+    def test_masked_multihead_attention_steps(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        B, H, L, D = 1, 2, 4, 4
+        cache = t(np.zeros((2, B, H, L, D), np.float32))
+        seq = t(np.zeros((B, 1), np.int32))
+        qkv0 = t(rng.randn(B, 3 * H * D).astype(np.float32))
+        o0, cache = IF.masked_multihead_attention(
+            qkv0, cache_kv=cache, sequence_lengths=seq)
+        # first token attends only itself: out == v0
+        v0 = n(qkv0).reshape(B, 3, H, D)[:, 2]
+        np.testing.assert_allclose(n(o0).reshape(B, H, D), v0,
+                                   rtol=2e-2, atol=2e-2)
+        seq1 = t(np.ones((B, 1), np.int32))
+        qkv1 = t(rng.randn(B, 3 * H * D).astype(np.float32))
+        o1, cache = IF.masked_multihead_attention(
+            qkv1, cache_kv=cache, sequence_lengths=seq1)
+        assert np.isfinite(n(o1)).all()
+
+    def test_block_multihead_attention_decode(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        B, H, D, bs, nb = 2, 2, 4, 4, 6
+        kc = t(np.zeros((nb, H, bs, D), np.float32))
+        vc = t(np.zeros((nb, H, bs, D), np.float32))
+        tables = t(np.array([[0, 1], [2, 3]], np.int32))
+        dec = t(np.zeros((B, 1), np.int32))
+        qkv = t(rng.randn(B, 3 * H * D).astype(np.float32))
+        out, kc, vc = IF.block_multihead_attention(
+            qkv, kc, vc, None, dec, None, None, None, None, None,
+            tables, block_size=bs)
+        v0 = n(qkv).reshape(B, 3, H, D)[:, 2]
+        np.testing.assert_allclose(n(out).reshape(B, H, D), v0,
+                                   rtol=2e-2, atol=2e-2)
+
+    def test_fused_multi_transformer_runs(self):
+        import paddle_tpu.incubate.nn.functional as IF
+        d, ff, L = 8, 16, 2
+        heads, hd = 2, 4
+        x = t(rng.randn(2, 3, d).astype(np.float32))
+        mk = lambda *s: t(rng.randn(*s).astype(np.float32) * 0.1)
+        out = IF.fused_multi_transformer(
+            x,
+            ln_scales=[t(np.ones(d, np.float32))] * L,
+            ln_biases=[t(np.zeros(d, np.float32))] * L,
+            qkv_weights=[mk(3, heads, hd, d)] * L,
+            qkv_biases=None,
+            linear_weights=[mk(d, d)] * L,
+            linear_biases=None,
+            ffn_ln_scales=[t(np.ones(d, np.float32))] * L,
+            ffn_ln_biases=[t(np.zeros(d, np.float32))] * L,
+            ffn1_weights=[mk(d, ff)] * L,
+            ffn1_biases=None,
+            ffn2_weights=[mk(ff, d)] * L,
+            ffn2_biases=None,
+            pre_layer_norm=True, dropout_rate=0.0)
+        assert out.shape == [2, 3, d]
+        assert np.isfinite(n(out)).all()
