@@ -1,7 +1,7 @@
 """paddle_tpu.models — flagship model zoo (BASELINE.json configs)."""
 from .llama import (  # noqa: F401
     LlamaConfig, LlamaForCausalLM, LlamaModel, llama_tiny, llama_small,
-    llama_mid, llama_3_8b,
+    llama_mid, llama_1b, llama_3_8b,
 )
 from .gpt import (  # noqa: F401
     GPTConfig, GPTForCausalLM, GPTModel, gpt_tiny, gpt_345m,

@@ -28,7 +28,7 @@ from ..nn import functional as F
 from ..ops.rope import build_rope_cache, rope_reference
 
 __all__ = ["LlamaConfig", "LlamaForCausalLM", "LlamaModel", "llama_tiny",
-           "llama_small", "llama_mid", "llama_3_8b"]
+           "llama_small", "llama_mid", "llama_1b", "llama_3_8b"]
 
 
 @dataclass
@@ -48,6 +48,11 @@ class LlamaConfig:
     # parallelism knobs (consumed when a fleet mesh is active)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # >0: forward() returns hidden states and loss() computes the head
+    # matmul + cross entropy in chunks of this many tokens under
+    # jax.checkpoint (training-memory config; generate() still works —
+    # the cached decode path keeps the normal head)
+    chunked_ce_tokens: int = 0
 
 
 def _mp_active() -> bool:
@@ -295,6 +300,9 @@ class LlamaForCausalLM(nn.Layer):
             h, new_caches = self.model(input_ids, caches=caches, pos=pos)
         else:
             h = self.model(input_ids)
+        if self.cfg.chunked_ce_tokens and caches is None:
+            # chunked-CE training config: loss() owns the head matmul
+            return h
         if self.lm_head is None:
             from ..tensor.linalg import matmul
             logits = matmul(h, self.model.embed_tokens.weight,
@@ -306,12 +314,27 @@ class LlamaForCausalLM(nn.Layer):
         return logits
 
     def loss(self, logits, labels):
-        """Shifted causal-LM cross entropy."""
+        """Shifted causal-LM cross entropy. With
+        cfg.chunked_ce_tokens > 0, forward() returns HIDDEN states and
+        this computes the head matmul + CE in sequence chunks under
+        jax.checkpoint — the [B, S, V] logits (1 GB at b4 s2048 v32k
+        f32, the single biggest activation) are never materialized; the
+        backward rematerializes one chunk's logits at a time."""
+        if self.cfg.chunked_ce_tokens:
+            return self._chunked_loss(logits, labels)
         from ..tensor.manipulation import reshape
         v = logits.shape[-1]
         shift_logits = logits[:, :-1, :].reshape([-1, v])
         shift_labels = labels[:, 1:].reshape([-1])
         return F.cross_entropy(shift_logits, shift_labels)
+
+    def _chunked_loss(self, hidden, labels):
+        from ..nn.functional.loss import chunked_softmax_cross_entropy
+        w = (self.model.embed_tokens.weight if self.lm_head is None
+             else self.lm_head.weight)
+        return chunked_softmax_cross_entropy(
+            hidden, labels, w, int(self.cfg.chunked_ce_tokens),
+            transpose_weight=self.lm_head is None)
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())
@@ -366,6 +389,17 @@ def llama_small(**kw) -> LlamaConfig:
                        intermediate_size=5632, num_hidden_layers=8,
                        num_attention_heads=16, num_key_value_heads=8,
                        max_position_embeddings=2048, **kw)
+
+
+def llama_1b(**kw) -> LlamaConfig:
+    """~1.0B largest-fitting config for one 16GB v5e chip: llama_mid's
+    MXU-efficient width at 18 layers; trains with remat + chunked CE
+    (BASELINE.md protocol: record the largest fit, not just the sweet
+    spot)."""
+    return LlamaConfig(vocab_size=32000, hidden_size=2048,
+                       intermediate_size=5632, num_hidden_layers=18,
+                       num_attention_heads=16, num_key_value_heads=8,
+                       max_position_embeddings=4096, **kw)
 
 
 def llama_mid(**kw) -> LlamaConfig:

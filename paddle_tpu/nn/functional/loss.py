@@ -14,6 +14,7 @@ __all__ = [
     "cosine_embedding_loss", "label_smooth", "square_error_cost",
     "log_loss", "hinge_embedding_loss", "triplet_margin_loss",
     "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss",
+    "chunked_softmax_cross_entropy",
 ]
 
 
@@ -336,6 +337,53 @@ def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
     if reduction == "sum":
         return loss.sum()
     return loss
+
+
+def chunked_softmax_cross_entropy(hidden, labels, weight,
+                                  chunk_tokens: int,
+                                  transpose_weight: bool = False,
+                                  ignore_index: int = -100):
+    """Head-matmul + shifted-CE computed in token chunks under
+    jax.checkpoint — the [N, V] logits are never materialized; the
+    backward rematerializes one chunk at a time. Serves every CausalLM
+    in the zoo (the memory pressure is identical across them).
+
+    hidden [B, S, D]; labels [B, S] (shift applied here, like the dense
+    loss paths); weight [D, V] (or [V, D] with transpose_weight=True,
+    the tied-embedding layout). ignore_index positions are masked from
+    numerator AND denominator — exact parity with
+    cross_entropy(ignore_index=...)."""
+    def f(h, y, wv):
+        b, s, d = h.shape
+        hs = h[:, :-1].reshape(b * (s - 1), d)
+        ys = y[:, 1:].reshape(-1)
+        n = hs.shape[0]
+        nc = -(-n // chunk_tokens)
+        pad = nc * chunk_tokens - n
+        hs = jnp.pad(hs, ((0, pad), (0, 0)))
+        ys = jnp.pad(ys, (0, pad), constant_values=ignore_index)
+        valid = (ys != ignore_index)
+        mask = valid.astype(jnp.float32)
+        ys_safe = jnp.where(valid, ys, 0)
+        hs = hs.reshape(nc, chunk_tokens, d)
+        ys_safe = ys_safe.reshape(nc, chunk_tokens)
+        mask = mask.reshape(nc, chunk_tokens)
+
+        @jax.checkpoint
+        def body(carry, xs):
+            hc, yc, mc = xs
+            wm = wv.T if transpose_weight else wv
+            logits = (hc @ wm.astype(hc.dtype)).astype(jnp.float32)
+            lse = jax.nn.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(
+                logits, yc[:, None].astype(jnp.int32), axis=1)[:, 0]
+            return carry + jnp.sum((lse - tgt) * mc), None
+
+        total, _ = jax.lax.scan(body, jnp.float32(0),
+                                (hs, ys_safe, mask))
+        return total / jnp.maximum(mask.sum(), 1.0)
+
+    return apply("chunked_ce", f, hidden, labels, weight)
 
 
 def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,
