@@ -23,7 +23,7 @@ from .api import (  # noqa: F401
 from .collective import (  # noqa: F401
     all_reduce, all_gather, all_to_all, broadcast, reduce, reduce_scatter,
     scatter, gather, barrier, send, recv, isend, irecv, new_group,
-    ReduceOp, get_group, wait,
+    ReduceOp, get_group, wait, P2POp, batch_isend_irecv,
 )
 from . import fleet  # noqa: F401
 from . import auto_parallel  # noqa: F401
@@ -47,6 +47,7 @@ __all__ = [
     "all_reduce", "all_gather", "all_to_all", "broadcast", "reduce",
     "reduce_scatter", "scatter", "gather", "barrier", "send", "recv",
     "new_group", "ReduceOp", "fleet", "checkpoint", "Strategy",
+    "P2POp", "batch_isend_irecv",
 ] + _compat_all
 
 

@@ -282,14 +282,106 @@ def barrier(group=None):
 def send(tensor: Tensor, dst=0, group=None, sync_op=True):
     raise NotImplementedError(
         "eager p2p send/recv: use ppermute inside jitted programs "
-        "(paddle_tpu.distributed.fleet pipeline) — single-controller "
-        "eager p2p has no meaning")
+        "(paddle_tpu.distributed.fleet pipeline), or the rank-stacked "
+        "batch_isend_irecv debug facade — single-controller eager "
+        "point-to-point has no peer to talk to")
 
 
 def recv(tensor: Tensor, src=0, group=None, sync_op=True):
     raise NotImplementedError(
-        "eager p2p send/recv: use ppermute inside jitted programs")
+        "eager p2p send/recv: use ppermute inside jitted programs, or "
+        "the rank-stacked batch_isend_irecv debug facade")
 
 
 isend = send
 irecv = recv
+
+
+class P2POp:
+    """One batched point-to-point op (reference
+    python/paddle/distributed/communication/batch_isend_irecv.py P2POp).
+
+    Debug-parity semantics in single-controller mode: `tensor` is the
+    RANK-STACKED view [nranks, ...] (like every eager collective here) —
+    row r is rank r's local buffer. op is distributed.isend or
+    distributed.irecv; peer is the PER-RANK peer mapping, a list
+    (peer[r] = rank r's peer) or a callable rank -> peer. A plain int
+    (the reference's per-rank local form) cannot express a rank-stacked
+    route for nranks > 1 and is rejected at execution."""
+
+    def __init__(self, op, tensor: Tensor, peer, group=None):
+        if op not in (isend, irecv, send, recv):
+            raise ValueError("P2POp op must be distributed.isend/irecv")
+        self.op = "send" if op in (isend, send) else "recv"
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+    def _peer_of(self, rank: int, nranks: int) -> int:
+        if callable(self.peer):
+            return int(self.peer(rank)) % nranks
+        if isinstance(self.peer, (list, tuple)):
+            return int(self.peer[rank]) % nranks
+        return int(self.peer) % nranks
+
+
+def batch_isend_irecv(p2p_op_list) -> List[_Task]:
+    """Execute a batch of matched sends/recvs (reference
+    communication/batch_isend_irecv.py) as a REAL lax.ppermute under
+    shard_map — the same lowering the compiled pipeline p2p uses, so
+    this debug facade exercises the production collective.
+
+    Sends pair with recvs in list order; the send's peer mapping is the
+    route and must be a permutation of the ranks (a batch where two
+    ranks send to the same destination has no single-step answer — the
+    reference would deadlock there too). Each recv op's peer is checked
+    against the route's inverse, so a mis-ordered batch fails loudly
+    instead of writing into the wrong buffer."""
+    g = _group(p2p_op_list[0].group if p2p_op_list else None)
+    n = g.nranks
+    sends = [op for op in p2p_op_list if op.op == "send"]
+    recvs = [op for op in p2p_op_list if op.op == "recv"]
+    if len(sends) != len(recvs):
+        raise ValueError(
+            f"batch_isend_irecv needs matched send/recv counts, got "
+            f"{len(sends)} sends / {len(recvs)} recvs")
+    tasks = []
+    for s_op, r_op in zip(sends, recvs):
+        src_arr = s_op.tensor._value
+        if src_arr.shape[0] != n:
+            raise ValueError(
+                f"P2POp tensors must be rank-stacked [{n}, ...]; got "
+                f"{list(src_arr.shape)}")
+        if tuple(r_op.tensor._value.shape) != tuple(src_arr.shape):
+            raise ValueError(
+                f"recv buffer shape {list(r_op.tensor._value.shape)} "
+                f"!= send shape {list(src_arr.shape)}")
+        for op_ in (s_op, r_op):
+            if n > 1 and not (callable(op_.peer)
+                              or isinstance(op_.peer, (list, tuple))):
+                raise ValueError(
+                    "P2POp peer must be a list or callable (per-rank "
+                    "mapping) in the rank-stacked facade — a plain int "
+                    f"({op_.peer!r}) is the same peer for every rank, "
+                    "which is never a valid route for nranks > 1; use "
+                    "peer=lambda r: ... or a list")
+        dest = [s_op._peer_of(r, n) for r in range(n)]
+        if sorted(dest) != list(range(n)):
+            raise ValueError(
+                f"send route {dest} is not a permutation of the ranks — "
+                "two sends target the same destination; split the batch")
+        inv = {d: s for s, d in enumerate(dest)}
+        for d in range(n):
+            declared = r_op._peer_of(d, n)
+            if declared != inv[d]:
+                raise ValueError(
+                    f"recv op expects rank {d} to receive from "
+                    f"{declared}, but the paired send routes "
+                    f"{inv[d]} -> {d}; send/recv ops are paired in "
+                    "list order — reorder the batch or fix the peers")
+        perm = [(s, d) for s, d in enumerate(dest)]
+        arr = _stacked(s_op.tensor, g)
+        out = _run(g, lambda x: jax.lax.ppermute(x, "rank", perm), arr)
+        r_op.tensor._replace(out)
+        tasks.append(_Task(out))
+    return tasks

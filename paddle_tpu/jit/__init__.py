@@ -145,6 +145,16 @@ def _trace_error(exc, fn_name):
         f"Original error: {type(exc).__name__}: {exc}")
 
 
+def _snapshot_lower(p_arrays, b_arrays, key, training, args):
+    """Aval-only snapshot for concrete_program (live arrays would pin
+    the batch + params in HBM)."""
+    sds = lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype)
+    return ([sds(p) for p in p_arrays], [sds(b) for b in b_arrays],
+            key, training,
+            tuple(sds(a._value) if isinstance(a, Tensor) else a
+                  for a in args))
+
+
 class StaticFunction:
     """Compiled callable over a Layer or plain function of Tensors.
 
@@ -286,6 +296,12 @@ class StaticFunction:
 
 
     def _call_compiled(self, args, kwargs):
+        if kwargs:
+            raise NotImplementedError(
+                f"to_static({self._name()}): keyword arguments "
+                f"{sorted(kwargs)} are not supported by the compiled "
+                "call signature — pass them positionally (silently "
+                "running with defaults would be wrong)")
         layer = self._layer
         if layer is not None:
             params, buffers = _collect(layer)
@@ -309,6 +325,14 @@ class StaticFunction:
                 return tuple(flat_out) + tuple(new_bufs)
 
             results = apply("to_static", whole_graph, *p_tensors, *args)
+            if getattr(self, "_lower_trace_count", -1) != \
+                    self.retrace_count:
+                # aval-only snapshot for concrete_program, refreshed per
+                # retrace (not per call): ShapeDtypeStructs, ALL args
+                self._lower_args = _snapshot_lower(
+                    [p._value for p in p_tensors], b_arrays, key,
+                    training, args)
+                self._lower_trace_count = self.retrace_count
             if not isinstance(results, tuple):
                 results = (results,)
             n_out = self._last_n_out
@@ -330,6 +354,9 @@ class StaticFunction:
             return tuple(flat_out) if len(flat_out) > 1 else flat_out[0]
 
         results = apply("to_static", whole_graph, *args)
+        if getattr(self, "_lower_trace_count", -1) != self.retrace_count:
+            self._lower_args = _snapshot_lower([], [], key, True, args)
+            self._lower_trace_count = self.retrace_count
         if isinstance(results, tuple):
             return jax.tree_util.tree_unflatten(self._last_treedef,
                                                 list(results))
@@ -340,8 +367,61 @@ class StaticFunction:
     def forward(self):
         return self.__call__
 
+    @property
     def concrete_program(self):
-        raise NotImplementedError
+        """The traced program of the LAST call (reference
+        ConcreteProgram, jit/dy2static/program_translator.py): inputs/
+        outputs specs, parameters, and main_program — here the
+        framework's IR is StableHLO, so main_program is the lowered
+        StableHLO module text of the compiled forward."""
+        if self._partial is not None:
+            raise RuntimeError(
+                "concrete_program: this function runs under PARTIAL "
+                "graph capture (whole-graph tracing failed) — there is "
+                "no single whole program to show; see num_subgraphs / "
+                "graph_break_count for the capture telemetry")
+        if self._compiled is None or \
+                getattr(self, "_lower_args", None) is None:
+            raise RuntimeError(
+                "concrete_program: call the to_static function at least "
+                "once (tracing is input-driven — shapes come from the "
+                "first call)")
+        return _ConcreteProgram(self)
+
+
+class _ConcreteProgram:
+    """Reference ConcreteProgram parity surface over the last trace:
+    .inputs (specs), .parameters, .main_program — this framework's IR
+    is StableHLO, so main_program is the lowered module text."""
+
+    def __init__(self, static_fn: "StaticFunction"):
+        self._sf = static_fn
+
+    @property
+    def inputs(self):
+        # derived from the same snapshot main_program lowers — the two
+        # views always describe the SAME program
+        from ..static.program import InputSpec
+        _, _, _, _, ia = self._sf._lower_args
+        return [InputSpec(list(a.shape), a.dtype) for a in ia
+                if hasattr(a, "shape")]
+
+    @property
+    def parameters(self):
+        layer = self._sf._layer
+        if layer is None:
+            return []
+        return [p for _, p in layer.named_parameters()]
+
+    @property
+    def main_program(self) -> str:
+        pa, ba, key, training, ia = self._sf._lower_args
+        lowered = self._sf._compiled.lower(pa, ba, key, training, *ia)
+        return lowered.as_text()
+
+    def __repr__(self):
+        return (f"ConcreteProgram(inputs={self.inputs}, "
+                f"n_params={len(self.parameters)}, ir=stablehlo)")
 
 
 def to_static(function=None, input_spec=None, build_strategy=None,
@@ -382,7 +462,15 @@ class _StaticLayerProxy:
     def __call__(self, *args, **kwargs):
         return self._static_fn(*args, **kwargs)
 
+    # to_static telemetry/introspection lives on the StaticFunction
+    _STATIC_ATTRS = frozenset({
+        "concrete_program", "retrace_count", "trace_signatures",
+        "graph_break_count", "num_subgraphs",
+    })
+
     def __getattr__(self, name):
+        if name in _StaticLayerProxy._STATIC_ATTRS:
+            return getattr(self._static_fn, name)
         return getattr(self._layer, name)
 
     def __setattr__(self, name, value):

@@ -377,18 +377,21 @@ class LlamaForCausalLM(nn.Layer):
 
 
 def llama_tiny(**kw) -> LlamaConfig:
-    return LlamaConfig(vocab_size=512, hidden_size=128, intermediate_size=352,
-                       num_hidden_layers=2, num_attention_heads=4,
-                       num_key_value_heads=2, max_position_embeddings=256,
-                       **kw)
+    base = dict(vocab_size=512, hidden_size=128, intermediate_size=352,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=256)
+    base.update(kw)          # callers may override any default
+    return LlamaConfig(**base)
 
 
 def llama_small(**kw) -> LlamaConfig:
     """~0.5B bench config sized for a single v5e chip."""
-    return LlamaConfig(vocab_size=32000, hidden_size=2048,
-                       intermediate_size=5632, num_hidden_layers=8,
-                       num_attention_heads=16, num_key_value_heads=8,
-                       max_position_embeddings=2048, **kw)
+    base = dict(vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_hidden_layers=8,
+                num_attention_heads=16, num_key_value_heads=8,
+                max_position_embeddings=2048)
+    base.update(kw)
+    return LlamaConfig(**base)
 
 
 def llama_1b(**kw) -> LlamaConfig:
@@ -396,10 +399,12 @@ def llama_1b(**kw) -> LlamaConfig:
     MXU-efficient width at 18 layers; trains with remat + chunked CE
     (BASELINE.md protocol: record the largest fit, not just the sweet
     spot)."""
-    return LlamaConfig(vocab_size=32000, hidden_size=2048,
-                       intermediate_size=5632, num_hidden_layers=18,
-                       num_attention_heads=16, num_key_value_heads=8,
-                       max_position_embeddings=4096, **kw)
+    base = dict(vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_hidden_layers=18,
+                num_attention_heads=16, num_key_value_heads=8,
+                max_position_embeddings=4096)
+    base.update(kw)
+    return LlamaConfig(**base)
 
 
 def llama_mid(**kw) -> LlamaConfig:
@@ -409,15 +414,18 @@ def llama_mid(**kw) -> LlamaConfig:
     llama_small (MXU-efficient 2048x5632 matmuls); measured sweep: this
     shape at batch 4, seq 2048 gives 70.3% MFU vs 62.4% for a
     narrow-deep 24-layer 717M variant."""
-    return LlamaConfig(vocab_size=32000, hidden_size=2048,
-                       intermediate_size=5632, num_hidden_layers=11,
-                       num_attention_heads=16, num_key_value_heads=8,
-                       max_position_embeddings=2048, **kw)
+    base = dict(vocab_size=32000, hidden_size=2048,
+                intermediate_size=5632, num_hidden_layers=11,
+                num_attention_heads=16, num_key_value_heads=8,
+                max_position_embeddings=2048)
+    base.update(kw)
+    return LlamaConfig(**base)
 
 
 def llama_3_8b(**kw) -> LlamaConfig:
-    return LlamaConfig(vocab_size=128256, hidden_size=4096,
-                       intermediate_size=14336, num_hidden_layers=32,
-                       num_attention_heads=32, num_key_value_heads=8,
-                       max_position_embeddings=8192, rope_theta=500000.0,
-                       **kw)
+    base = dict(vocab_size=128256, hidden_size=4096,
+                intermediate_size=14336, num_hidden_layers=32,
+                num_attention_heads=32, num_key_value_heads=8,
+                max_position_embeddings=8192, rope_theta=500000.0)
+    base.update(kw)
+    return LlamaConfig(**base)
