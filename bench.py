@@ -204,10 +204,8 @@ def run_serving(weight_dtype=None, concurrency=8):
     rng = np.random.RandomState(0)
     lens = rng.randint(128, 513, n_requests)
     # warmup: compile prefill + decode with one short request
-    eng.add_request(rng.randint(0, cfg.vocab_size, 32),
-                    SamplingParams(max_new_tokens=2))
-    eng.run_to_completion()
-    eng.clear_finished()   # warmup (compiles) must not skew stats
+    eng.warmup(prompt_len=512)  # compiles (both prefill widths +
+    # decode chunk) must not skew the measured stats
     t0 = time.perf_counter()
     for l in lens:
         eng.add_request(rng.randint(0, cfg.vocab_size, int(l)),

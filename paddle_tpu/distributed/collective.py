@@ -339,6 +339,12 @@ def batch_isend_irecv(p2p_op_list) -> List[_Task]:
     instead of writing into the wrong buffer."""
     g = _group(p2p_op_list[0].group if p2p_op_list else None)
     n = g.nranks
+    for op_ in p2p_op_list:
+        og = _group(op_.group)
+        if og.ranks != g.ranks:
+            raise ValueError(
+                f"batch_isend_irecv ops span different groups "
+                f"({og.ranks} vs {g.ranks}); one batch = one group")
     sends = [op for op in p2p_op_list if op.op == "send"]
     recvs = [op for op in p2p_op_list if op.op == "recv"]
     if len(sends) != len(recvs):

@@ -215,34 +215,75 @@ class ServingEngine:
         return -(-total // self.dec.cache.block_size)
 
     def _admit(self):
-        """Fill free batch slots from the queue (one batch-1 bucketed
-        prefill each). Admission is capacity-aware: a request enters only
-        if its whole worst-case page demand fits, so a running request
-        can never hit pool exhaustion mid-decode."""
+        """Fill free batch slots from the queue. Admission is
+        capacity-aware (a request enters only if its whole worst-case
+        page demand fits, so a running request can never hit pool
+        exhaustion mid-decode) and BATCHED: admissible requests sharing
+        a prompt bucket prefill in one dispatch (padded to a power-of-
+        two group size to bound compile variants) — a burst of K
+        arrivals costs ~1 prefill instead of K."""
         cache = self.dec.cache
-        for si in range(self.max_b):
-            if self._slots[si] is not None or not self._queue:
-                continue
+        free_slots = [si for si in range(self.max_b)
+                      if self._slots[si] is None]
+        admitted = []              # (slot, req, bucket)
+        for si in free_slots:
+            if not self._queue:
+                break
             req = self._queue[0]
             if cache.free_blocks < self._required_blocks(req):
                 break  # head-of-line: keep FIFO order, wait for frees
             self._queue.popleft()
+            cache.allocate(req.req_id,
+                           int(req.prompt.size)
+                           + req.sampling.max_new_tokens)
+            admitted.append((si, req,
+                             _bucket_for(int(req.prompt.size),
+                                         self.buckets)))
+        by_bucket: dict = {}
+        for si, req, bucket in admitted:
+            by_bucket.setdefault(bucket, []).append((si, req))
+        for bucket, group in by_bucket.items():
+            self._prefill_group(bucket, group)
+
+    # prefill dispatch widths: exactly TWO compile variants per bucket
+    # (a variant per group size would compile-storm on bursty arrivals —
+    # measured 4x throughput loss through the remote-compile tunnel)
+    PREFILL_GROUP = 4
+
+    def _prefill_group(self, bucket: int, group):
+        """Prefill dispatches for the (slot, request) pairs of one
+        bucket: singles go through the width-1 program, anything larger
+        through width-PREFILL_GROUP chunks (padded with scratch rows)."""
+        if len(group) > 1:
+            w = min(self.PREFILL_GROUP, self.max_b)
+            for i in range(0, len(group), w):
+                self._prefill_chunk(bucket, group[i:i + w], w)
+        else:
+            self._prefill_chunk(bucket, group, 1)
+
+    def _prefill_chunk(self, bucket: int, group, gp: int):
+        cache = self.dec.cache
+        ids = np.zeros((gp, bucket), np.int32)
+        slots = np.full((gp, bucket), self._scratch_slot, np.int32)
+        last_idx = np.zeros(gp, np.int32)
+        temps = np.zeros(gp, np.float32)
+        for row, (si, req) in enumerate(group):
             s = int(req.prompt.size)
-            bucket = _bucket_for(s, self.buckets)
-            cache.allocate(req.req_id, s + req.sampling.max_new_tokens)
-            ids = np.full(bucket, 0, np.int32)
-            ids[:s] = req.prompt
-            slots = np.full(bucket, self._scratch_slot, np.int32)
-            slots[:s] = [cache.extend(req.req_id) for _ in range(s)]
-            tok, cache.k, cache.v = self._prefill_j(
-                self.dec.weights, cache.k, cache.v,
-                jnp.asarray(ids)[None], jnp.asarray(slots)[None],
-                jnp.asarray([s - 1], np.int32),
-                jnp.asarray([req.sampling.temperature], np.float32),
-                self._next_key())
-            tok = int(np.asarray(tok)[0])
+            ids[row, :s] = req.prompt
+            slots[row, :s] = [cache.extend(req.req_id)
+                              for _ in range(s)]
+            last_idx[row] = s - 1
+            temps[row] = req.sampling.temperature
+        toks, cache.k, cache.v = self._prefill_j(
+            self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
+            jnp.asarray(slots), jnp.asarray(last_idx),
+            jnp.asarray(temps), self._next_key())
+        toks = np.asarray(toks)
+        now = time.perf_counter()
+        for row, (si, req) in enumerate(group):
+            tok = int(toks[row])
             req.state = "running"
-            req.t_first_token = time.perf_counter()
+            req.t_first_token = now
             req.out_tokens.append(tok)
             self.generated_tokens += 1
             self._slots[si] = req
@@ -320,6 +361,24 @@ class ServingEngine:
         while self.step():
             pass
         return {rid: self.result(rid) for rid in list(self._done)}
+
+    def warmup(self, prompt_len: Optional[int] = None):
+        """Pre-compile the serving programs (both prefill widths + the
+        decode chunk) with throwaway requests, so no user request pays a
+        compile. Worth calling once at deployment; finished-request
+        stats are cleared afterwards."""
+        plen = prompt_len or self.buckets[0]
+        # phase 1: a single request — the width-1 prefill program
+        self.add_request(np.ones(plen, np.int32),
+                         SamplingParams(max_new_tokens=2))
+        self.run_to_completion()
+        # phase 2: a burst — the width-PREFILL_GROUP program (admitted
+        # together, so the group path runs even when slots abound)
+        for _ in range(min(self.PREFILL_GROUP, self.max_b) or 1):
+            self.add_request(np.ones(plen, np.int32),
+                             SamplingParams(max_new_tokens=2))
+        self.run_to_completion()
+        self.clear_finished()
 
     def clear_finished(self):
         """Drop finished requests + counters (e.g. after warmup) so

@@ -183,6 +183,8 @@ class StaticFunction:
         self.trace_signatures = []
 
     def _note_trace(self, in_arrays):
+        if getattr(self, "_suppress_note", False):
+            return  # introspective lowering is not a retrace
         self.retrace_count += 1
         sig = tuple((tuple(a.shape), str(a.dtype)) for a in in_arrays)
         self.trace_signatures.append(sig)
@@ -308,7 +310,6 @@ class StaticFunction:
             p_tensors = [p for _, p in params]
             b_tensors = [b for _, b in buffers]
             b_arrays = [b._value for b in b_tensors]
-            in_tensors = [a for a in args if isinstance(a, Tensor)]
             key = default_generator.next_key()
 
             compiled = self._compiled
@@ -415,8 +416,19 @@ class _ConcreteProgram:
 
     @property
     def main_program(self) -> str:
-        pa, ba, key, training, ia = self._sf._lower_args
-        lowered = self._sf._compiled.lower(pa, ba, key, training, *ia)
+        sf = self._sf
+        pa, ba, key, training, ia = sf._lower_args
+        layer = sf._layer
+        prev_training = getattr(layer, "training", None)
+        sf._suppress_note = True     # tracing here is introspection,
+        try:                         # not a retrace of the live model
+            lowered = sf._compiled.lower(pa, ba, key, training, *ia)
+        finally:
+            sf._suppress_note = False
+            if layer is not None and prev_training is not None:
+                # pure() sets layer.training as a trace side effect —
+                # introspection must not flip the live train/eval mode
+                layer.training = prev_training
         return lowered.as_text()
 
     def __repr__(self):
