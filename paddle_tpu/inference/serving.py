@@ -10,11 +10,14 @@ scheduler: XLA programs cannot change batch size per step, so continuous
 batching becomes a fixed grid of batch slots with per-slot activity —
 the same trick the paged pool already plays for sequence length.
 
-Architecture (all shapes static, three compiled programs total):
-- admission: a queued request is prefetched into a free batch slot via a
-  batch-1 prefill bucketed to a few prompt lengths (right-padding writes
-  its K/V to a reserved scratch page, so the pool never sees pad junk;
-  logits are taken at the real last token).
+Architecture (all shapes static; compiled programs: ONE decode chunk
+plus TWO prefill widths per active prompt bucket):
+- admission: queued requests prefill into free batch slots, grouped per
+  prompt bucket into shared dispatches (width 1 for singles, width
+  PREFILL_GROUP for bursts, padded with scratch rows — bounding the
+  compile-variant count; right-padding writes its K/V to a reserved
+  scratch page, so the pool never sees pad junk; logits are taken at
+  the real last token).
 - decode: ONE program serves every step — a lax.scan over a
   chunk_size-token schedule (the page/slot schedule is deterministic, so
   the host precomputes it), [max_batch] wide, inactive or finished slots
@@ -363,21 +366,36 @@ class ServingEngine:
         return {rid: self.result(rid) for rid in list(self._done)}
 
     def warmup(self, prompt_len: Optional[int] = None):
-        """Pre-compile the serving programs (both prefill widths + the
-        decode chunk) with throwaway requests, so no user request pays a
-        compile. Worth calling once at deployment; finished-request
-        stats are cleared afterwards."""
-        plen = prompt_len or self.buckets[0]
-        # phase 1: a single request — the width-1 prefill program
-        self.add_request(np.ones(plen, np.int32),
-                         SamplingParams(max_new_tokens=2))
-        self.run_to_completion()
-        # phase 2: a burst — the width-PREFILL_GROUP program (admitted
-        # together, so the group path runs even when slots abound)
-        for _ in range(min(self.PREFILL_GROUP, self.max_b) or 1):
+        """Pre-compile the serving programs — BOTH prefill widths for
+        every bucket (or just prompt_len's bucket when given) plus the
+        decode chunk — with throwaway requests, so no user request pays
+        a compile. Worth calling once at deployment; finished-request
+        stats are cleared afterwards. Warns if the KV pool is too small
+        to exercise the burst width (that variant would then compile on
+        the first real burst)."""
+        import warnings as _warnings
+        plens = ([prompt_len] if prompt_len is not None
+                 else list(self.buckets))
+        cache = self.dec.cache
+        for plen in plens:
+            # phase 1: a single request — the width-1 program
             self.add_request(np.ones(plen, np.int32),
                              SamplingParams(max_new_tokens=2))
-        self.run_to_completion()
+            self.run_to_completion()
+            # phase 2: a burst — the width-PREFILL_GROUP program. The
+            # burst path only runs if >= 2 requests admit TOGETHER.
+            need = 2 * -(-(plen + 2) // cache.block_size)
+            if cache.free_blocks < need or self.max_b < 2:
+                _warnings.warn(
+                    f"warmup: pool/batch too small to exercise the "
+                    f"width-{self.PREFILL_GROUP} prefill at bucket "
+                    f"{plen} (need {need} free pages and >=2 slots); "
+                    "the first real burst will pay that compile")
+                continue
+            for _ in range(min(self.PREFILL_GROUP, self.max_b)):
+                self.add_request(np.ones(plen, np.int32),
+                                 SamplingParams(max_new_tokens=2))
+            self.run_to_completion()
         self.clear_finished()
 
     def clear_finished(self):

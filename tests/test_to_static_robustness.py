@@ -320,3 +320,36 @@ def test_to_static_kwargs_rejected_loudly():
     np.testing.assert_allclose(f(x, 3.0).numpy(), 3.0)  # positional OK
     with pytest.raises(NotImplementedError, match="keyword"):
         f(x, scale=3.0)
+
+
+def test_partial_capture_full_llama():
+    """Partial capture over a real model: a data-dependent branch on
+    the logits splits a full Llama forward+loss into 2 compiled
+    segments; values match the straight-line path up to XLA fusion-
+    order noise (different programs, different f32 reduction orders)."""
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.jit.partial_capture import PartialProgram
+
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 512, (2, 16)).astype(np.int32))
+
+    def fn(x):
+        logits = m(x)
+        conf = float(logits.max().numpy())      # graph break
+        if conf > 100.0:
+            return logits.mean() * 0.0
+        return m.loss(logits, x)
+
+    pp = PartialProgram(fn)
+    out = pp(ids)
+    ref = m.loss(m(ids), ids)
+    np.testing.assert_allclose(float(out.numpy()), float(ref.numpy()),
+                               rtol=1e-3)
+    assert pp.num_subgraphs == 2 and pp.graph_break_count == 1
+    # repeat call reuses the segment cache
+    n_cache = len(pp._seg_cache)
+    pp(ids)
+    assert len(pp._seg_cache) == n_cache
