@@ -377,22 +377,29 @@ class ServingEngine:
         plens = ([prompt_len] if prompt_len is not None
                  else list(self.buckets))
         cache = self.dec.cache
+        width = min(self.PREFILL_GROUP, self.max_b)
+        if self.max_b < 2:
+            _warnings.warn(
+                "warmup: max_batch_size < 2 — the burst prefill path "
+                "never runs on this engine; only width-1 is warmed")
         for plen in plens:
             # phase 1: a single request — the width-1 program
             self.add_request(np.ones(plen, np.int32),
                              SamplingParams(max_new_tokens=2))
             self.run_to_completion()
-            # phase 2: a burst — the width-PREFILL_GROUP program. The
-            # burst path only runs if >= 2 requests admit TOGETHER.
-            need = 2 * -(-(plen + 2) // cache.block_size)
-            if cache.free_blocks < need or self.max_b < 2:
-                _warnings.warn(
-                    f"warmup: pool/batch too small to exercise the "
-                    f"width-{self.PREFILL_GROUP} prefill at bucket "
-                    f"{plen} (need {need} free pages and >=2 slots); "
-                    "the first real burst will pay that compile")
+            if self.max_b < 2:
                 continue
-            for _ in range(min(self.PREFILL_GROUP, self.max_b)):
+            # phase 2: a burst — the width-`width` program. The burst
+            # path only runs if >= 2 requests admit TOGETHER.
+            need = 2 * -(-(plen + 2) // cache.block_size)
+            if cache.free_blocks < need:
+                _warnings.warn(
+                    f"warmup: pool too small to exercise the width-"
+                    f"{width} prefill at bucket {plen} (need {need} "
+                    "free pages); the first real burst there will pay "
+                    "that compile")
+                continue
+            for _ in range(width):
                 self.add_request(np.ones(plen, np.int32),
                                  SamplingParams(max_new_tokens=2))
             self.run_to_completion()

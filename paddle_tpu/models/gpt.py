@@ -38,6 +38,9 @@ class GPTConfig:
     dtype: str = "float32"
     use_recompute: bool = False
     tensor_parallel: bool = False
+    # >0: forward() returns hidden states; loss() runs the chunked
+    # head-matmul + CE (see nn.functional.chunked_softmax_cross_entropy)
+    chunked_ce_tokens: int = 0
 
 
 def _mp_active() -> bool:
@@ -177,6 +180,8 @@ class GPTForCausalLM(nn.Layer):
 
     def forward(self, input_ids):
         h = self.gpt(input_ids)
+        if self.cfg.chunked_ce_tokens:
+            return h          # loss() owns the head matmul (chunked CE)
         if self.lm_head is None:
             from ..tensor.linalg import matmul
             return matmul(h, self.gpt.embed_tokens.weight,
@@ -184,6 +189,13 @@ class GPTForCausalLM(nn.Layer):
         return self.lm_head(h)
 
     def loss(self, logits, labels):
+        if self.cfg.chunked_ce_tokens:
+            from ..nn.functional.loss import chunked_causal_lm_loss
+            return chunked_causal_lm_loss(
+                logits, labels,
+                None if self.lm_head is None else self.lm_head.weight,
+                self.gpt.embed_tokens.weight,
+                int(self.cfg.chunked_ce_tokens))
         v = logits.shape[-1]
         shift_logits = logits[:, :-1, :].reshape([-1, v])
         shift_labels = labels[:, 1:].reshape([-1])

@@ -329,12 +329,12 @@ class LlamaForCausalLM(nn.Layer):
         return F.cross_entropy(shift_logits, shift_labels)
 
     def _chunked_loss(self, hidden, labels):
-        from ..nn.functional.loss import chunked_softmax_cross_entropy
-        w = (self.model.embed_tokens.weight if self.lm_head is None
-             else self.lm_head.weight)
-        return chunked_softmax_cross_entropy(
-            hidden, labels, w, int(self.cfg.chunked_ce_tokens),
-            transpose_weight=self.lm_head is None)
+        from ..nn.functional.loss import chunked_causal_lm_loss
+        return chunked_causal_lm_loss(
+            hidden, labels,
+            None if self.lm_head is None else self.lm_head.weight,
+            self.model.embed_tokens.weight,
+            int(self.cfg.chunked_ce_tokens))
 
     def num_params(self) -> int:
         return sum(p.size for p in self.parameters())

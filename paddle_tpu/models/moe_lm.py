@@ -44,6 +44,9 @@ class MoEConfig:
     dtype: str = "float32"
     use_recompute: bool = False
     tensor_parallel: bool = False
+    # >0: forward() returns hidden states; loss() runs the chunked
+    # head-matmul + CE (see nn.functional.chunked_softmax_cross_entropy)
+    chunked_ce_tokens: int = 0
 
     def _attn_cfg(self) -> LlamaConfig:
         return LlamaConfig(
@@ -161,14 +164,23 @@ class MoEForCausalLM(nn.Layer):
                                  bias_attr=False)
 
     def forward(self, input_ids):
-        return self.lm_head(self.model(input_ids))
+        h = self.model(input_ids)
+        if self.cfg.chunked_ce_tokens:
+            return h          # loss() owns the head matmul (chunked CE)
+        return self.lm_head(h)
 
     def loss(self, logits, labels):
         """Shifted CE + router load-balance auxiliary loss."""
-        v = logits.shape[-1]
-        shift_logits = logits[:, :-1, :].reshape([-1, v])
-        shift_labels = labels[:, 1:].reshape([-1])
-        ce = F.cross_entropy(shift_logits, shift_labels)
+        if self.cfg.chunked_ce_tokens:
+            from ..nn.functional.loss import chunked_causal_lm_loss
+            ce = chunked_causal_lm_loss(
+                logits, labels, self.lm_head.weight, None,
+                int(self.cfg.chunked_ce_tokens))
+        else:
+            v = logits.shape[-1]
+            shift_logits = logits[:, :-1, :].reshape([-1, v])
+            shift_labels = labels[:, 1:].reshape([-1])
+            ce = F.cross_entropy(shift_logits, shift_labels)
         aux = self.model.aux_losses()
         if aux and self.cfg.aux_loss_weight:
             total_aux = aux[0]

@@ -14,7 +14,7 @@ __all__ = [
     "cosine_embedding_loss", "label_smooth", "square_error_cost",
     "log_loss", "hinge_embedding_loss", "triplet_margin_loss",
     "sigmoid_focal_loss", "ctc_loss", "poisson_nll_loss",
-    "chunked_softmax_cross_entropy",
+    "chunked_softmax_cross_entropy", "chunked_causal_lm_loss",
 ]
 
 
@@ -384,6 +384,22 @@ def chunked_softmax_cross_entropy(hidden, labels, weight,
         return total / jnp.maximum(mask.sum(), 1.0)
 
     return apply("chunked_ce", f, hidden, labels, weight)
+
+
+def chunked_causal_lm_loss(hidden, labels, lm_head_weight,
+                           embedding_weight, chunk_tokens: int,
+                           ignore_index: int = -100):
+    """The CausalLM adoption seam for chunked CE: pass the lm_head
+    weight (or None when embeddings are tied) and the embedding weight;
+    the tied case transposes. One call site per model — the weight-
+    selection logic lives here, not copied into every zoo model."""
+    if lm_head_weight is not None:
+        return chunked_softmax_cross_entropy(
+            hidden, labels, lm_head_weight, chunk_tokens,
+            ignore_index=ignore_index)
+    return chunked_softmax_cross_entropy(
+        hidden, labels, embedding_weight, chunk_tokens,
+        transpose_weight=True, ignore_index=ignore_index)
 
 
 def poisson_nll_loss(input, label, log_input=True, full=False, epsilon=1e-8,

@@ -232,3 +232,37 @@ def test_chunked_ce_tied_and_ignore_index():
         m_d.model.embed_tokens.weight.grad.numpy(),
         m_c.model.embed_tokens.weight.grad.numpy(), rtol=1e-3,
         atol=1e-5)
+
+
+def test_chunked_ce_gpt_and_moe():
+    """GPT (tied head) and MoE (aux losses) adopt the shared chunked
+    CE: values match their dense paths."""
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models import MoEForCausalLM, moe_tiny
+
+    base = dict(vocab_size=128, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=2,
+                max_position_embeddings=64)
+    paddle.seed(0)
+    g_d = GPTForCausalLM(GPTConfig(**base))
+    paddle.seed(0)
+    g_c = GPTForCausalLM(GPTConfig(**base, chunked_ce_tokens=16))
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 128, (2, 17)).astype(np.int32))
+    l_d = g_d.loss(g_d(ids), ids)
+    l_c = g_c.loss(g_c(ids), ids)
+    np.testing.assert_allclose(float(l_d.numpy()), float(l_c.numpy()),
+                               rtol=1e-5)
+
+    paddle.seed(1)
+    m_d = MoEForCausalLM(moe_tiny())
+    paddle.seed(1)
+    m_c = MoEForCausalLM(moe_tiny(chunked_ce_tokens=16))
+    ids2 = paddle.to_tensor(np.random.RandomState(1).randint(
+        0, m_d.cfg.vocab_size, (2, 17)).astype(np.int32))
+    l_d2 = m_d.loss(m_d(ids2), ids2)
+    l_c2 = m_c.loss(m_c(ids2), ids2)
+    np.testing.assert_allclose(float(l_d2.numpy()), float(l_c2.numpy()),
+                               rtol=1e-4)
