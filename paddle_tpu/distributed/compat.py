@@ -76,8 +76,12 @@ class DistModel:
 
     def __call__(self, *args):
         if self._mode == "train":
+            if len(args) != 2:
+                raise ValueError(
+                    "DistModel train call takes exactly (input, label); "
+                    f"got {len(args)} argument(s)")
             self._engine.prepare("train")
-            x, y = args if len(args) == 2 else (args[0], args[0])
+            x, y = args
             return self._engine._train_step(x, y)
         self._engine.prepare("eval")
         return self._engine._forward(args)
@@ -127,27 +131,95 @@ def alltoall(out_tensor_list, in_tensor_list, group=None, sync_op=True):
 
 def alltoall_single(out_tensor, in_tensor, in_split_sizes=None,
                     out_split_sizes=None, group=None, sync_op=True):
-    """Single-tensor all-to-all (reference alltoall_single): the rank-
-    stacked emulation splits dim 0 across ranks."""
-    from .collective import all_to_all
-    return all_to_all(out_tensor, in_tensor, group=group,
+    """Single-tensor all-to-all (reference alltoall_single): each rank's
+    dim 0 is split evenly into nranks chunks; chunk d goes to rank d.
+    Rank-stacked emulation: in_tensor is [nranks_src, nranks*k, ...];
+    out_tensor receives [nranks_dst, nranks*k, ...] in place (chunk s of
+    dst's row came from src s). Uneven splits are a loud descope — the
+    even-split path would silently move the wrong slices (MoE token
+    routing uses uneven splits in the reference)."""
+    from ..framework.core import Tensor
+    from .collective import _group, all_to_all
+    g = _group(group)
+    n = g.nranks
+    arr = in_tensor._value
+    if arr.ndim < 2 or arr.shape[0] != n or arr.shape[1] % n != 0:
+        raise ValueError(
+            f"alltoall_single expects rank-stacked [nranks={n}, "
+            f"nranks*k, ...]; got shape {tuple(arr.shape)}")
+    k = arr.shape[1] // n
+    for name_, sizes in (("in_split_sizes", in_split_sizes),
+                         ("out_split_sizes", out_split_sizes)):
+        if sizes is None:
+            continue
+        if list(sizes) != [k] * n:
+            raise NotImplementedError(
+                f"alltoall_single with uneven {name_}={list(sizes)} is "
+                "not supported (even chunk here is "
+                f"{k}); pad to even splits or use ops.moe ragged "
+                "dispatch (COVERAGE.md descope)")
+    if (tuple(out_tensor._value.shape) != tuple(arr.shape)
+            or out_tensor._value.dtype != arr.dtype):
+        raise ValueError(
+            f"alltoall_single out_tensor {tuple(out_tensor._value.shape)}"
+            f"/{out_tensor._value.dtype} must match in_tensor "
+            f"{tuple(arr.shape)}/{arr.dtype}")
+    # [src, dst, k, ...] -> all_to_all -> [dst, src, k, ...]
+    chunks = arr.reshape((n, n, k) + tuple(arr.shape[2:]))
+    received: list = []
+    task = all_to_all(received, Tensor(chunks), group=group,
                       sync_op=sync_op)
+    out = received[0]._value.reshape((n, n * k) + tuple(arr.shape[2:]))
+    out_tensor._replace(out)
+    return task
 
 
 def split(x, size, operation, axis=0, num_partitions=1, gather_out=True,
           weight_attr=None, bias_attr=None, name=None):
     """Reference paddle.distributed.split auto-parallelizes a layer op
-    (embedding/linear) across ranks. The TPU-native form is the mpu
-    layer set; this wrapper routes to it."""
+    (embedding/linear) across ranks and applies it to x (reference
+    python/paddle/distributed/collective.py split). The TPU-native form
+    is the mpu layer set; this wrapper builds one, forwards
+    weight_attr/bias_attr, validates num_partitions against the mesh's
+    'mp' degree (GSPMD partitions by mesh axis, not an ad-hoc count),
+    and returns layer(x) — or, as a documented extension, the layer
+    itself when x is None."""
     from .fleet import (ColumnParallelLinear, RowParallelLinear,
                         VocabParallelEmbedding)
+    from .fleet.mpu import _get_mesh
+    mesh = _get_mesh()
+    mp = mesh.get_dim_size("mp") if mesh is not None else 1
+    if num_partitions not in (1, mp):
+        raise ValueError(
+            f"num_partitions={num_partitions} does not match the mesh "
+            f"'mp' degree ({mp}); GSPMD partitions by mesh axis — "
+            "resize the mesh instead of passing a partition count")
     if operation == "embedding":
-        return VocabParallelEmbedding(size[0], size[1])
-    if operation == "linear":
-        cls = ColumnParallelLinear if axis == 1 else RowParallelLinear
-        return cls(size[0], size[1], gather_output=gather_out) \
-            if cls is ColumnParallelLinear else cls(size[0], size[1])
-    raise ValueError(f"unsupported split operation {operation!r}")
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr, name=name)
+    elif operation == "linear":
+        if axis not in (0, 1):
+            raise ValueError(
+                f"split(..., 'linear') axis must be 0 (row-parallel) or "
+                f"1 (column-parallel); got {axis}")
+        if axis == 1:
+            layer = ColumnParallelLinear(
+                size[0], size[1], weight_attr=weight_attr,
+                bias_attr=bias_attr, gather_output=gather_out, name=name)
+        else:
+            if not gather_out:
+                raise NotImplementedError(
+                    "row-parallel split with gather_out=False (partial "
+                    "sums left unreduced) cannot be expressed through "
+                    "GSPMD's replicated-output constraint; use "
+                    "RowParallelLinear with a manual shard_map if you "
+                    "need the partials")
+            layer = RowParallelLinear(size[0], size[1],
+                                      weight_attr=weight_attr,
+                                      bias_attr=bias_attr, name=name)
+    else:
+        raise ValueError(f"unsupported split operation {operation!r}")
+    return layer if x is None else layer(x)
 
 
 _pg_alive = True

@@ -63,13 +63,23 @@ def _constrain(t: Tensor, mesh: ProcessMesh, spec) -> Tensor:
     return apply("sharding_constraint", f, t)
 
 
+def _maybe_bias(layer: Layer, out_features: int, has_bias, bias_attr):
+    """nn.Linear's bias convention (nn/layer/common.py Linear):
+    bias_attr=False suppresses the bias, anything else flows to
+    create_parameter as the attr."""
+    if not has_bias or bias_attr is False:
+        return None
+    return layer.create_parameter((out_features,), attr=bias_attr,
+                                  is_bias=True)
+
+
 class ColumnParallelLinear(Layer):
     """Y = X @ W with W column-sharded over the 'mp' axis. Output stays
     sharded on the feature dim unless gather_output=True."""
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, gather_output=True, fuse_matmul_bias=False,
-                 mp_group=None, name=None):
+                 mp_group=None, name=None, bias_attr=None):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
@@ -79,10 +89,7 @@ class ColumnParallelLinear(Layer):
         self.weight = self.create_parameter(
             (in_features, out_features), attr=weight_attr,
             default_initializer=I.XavierUniform())
-        if has_bias:
-            self.bias = self.create_parameter((out_features,), is_bias=True)
-        else:
-            self.bias = None
+        self.bias = _maybe_bias(self, out_features, has_bias, bias_attr)
         if self.mesh is not None and self.mesh.get_dim_size(self.axis) > 1:
             _annotate_param(self.weight, self.mesh, 1, self.axis)
             if self.bias is not None:
@@ -108,7 +115,8 @@ class RowParallelLinear(Layer):
 
     def __init__(self, in_features, out_features, weight_attr=None,
                  has_bias=True, input_is_parallel=False,
-                 fuse_matmul_bias=False, mp_group=None, name=None):
+                 fuse_matmul_bias=False, mp_group=None, name=None,
+                 bias_attr=None):
         super().__init__()
         self.in_features = in_features
         self.out_features = out_features
@@ -118,10 +126,7 @@ class RowParallelLinear(Layer):
         self.weight = self.create_parameter(
             (in_features, out_features), attr=weight_attr,
             default_initializer=I.XavierUniform())
-        if has_bias:
-            self.bias = self.create_parameter((out_features,), is_bias=True)
-        else:
-            self.bias = None
+        self.bias = _maybe_bias(self, out_features, has_bias, bias_attr)
         if self.mesh is not None and self.mesh.get_dim_size(self.axis) > 1:
             _annotate_param(self.weight, self.mesh, 0, self.axis)
             # bias replicated

@@ -402,3 +402,85 @@ class TestBatchIsendIrecv:
         with pytest.raises(NotImplementedError,
                            match="batch_isend_irecv"):
             dist.send(x, dst=1)
+
+
+class TestCompatGuards:
+    """ADVICE r3: compat surface must fail loudly, not silently."""
+
+    def test_alltoall_single_uneven_splits_raise(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        y = paddle.to_tensor(np.zeros((8, 16), np.float32))
+        uneven = [1, 3] + [2] * 6          # sums to 16, not even
+        with pytest.raises(NotImplementedError, match="uneven"):
+            dist.alltoall_single(y, x, in_split_sizes=uneven)
+        with pytest.raises(NotImplementedError, match="uneven"):
+            dist.alltoall_single(y, x, out_split_sizes=uneven)
+        # even explicit splits are the supported case
+        dist.alltoall_single(y, x, in_split_sizes=[2] * 8,
+                             out_split_sizes=[2] * 8).wait()
+        # non-rank-stacked input is a loud shape error
+        bad = paddle.to_tensor(np.zeros((8, 2), np.float32))
+        with pytest.raises(ValueError, match="rank-stacked"):
+            dist.alltoall_single(bad, bad)
+
+    def test_split_validates_num_partitions(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(ValueError, match="num_partitions"):
+            dist.split(None, (16, 32), "linear", axis=1,
+                       num_partitions=7)
+
+    def test_split_row_parallel_gather_out_false_raises(self):
+        import paddle_tpu.distributed as dist
+        with pytest.raises(NotImplementedError, match="gather_out"):
+            dist.split(None, (16, 32), "linear", axis=0,
+                       gather_out=False)
+
+    def test_split_forwards_bias_attr_false(self):
+        import paddle_tpu.distributed as dist
+        layer = dist.split(None, (16, 32), "linear", axis=1,
+                           bias_attr=False)
+        assert layer.bias is None
+
+    def test_split_honors_bias_attr_initializer(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.nn.initializer as I
+        attr = paddle.ParamAttr(initializer=I.Constant(1.5))
+        layer = dist.split(None, (4, 6), "linear", axis=1,
+                           bias_attr=attr)
+        np.testing.assert_allclose(np.asarray(layer.bias._value), 1.5)
+
+    def test_split_applies_to_x(self):
+        import paddle_tpu.distributed as dist
+        x = paddle.to_tensor(np.random.randn(2, 16).astype(np.float32))
+        out = dist.split(x, (16, 32), "linear", axis=0)
+        assert tuple(out.shape) == (2, 32)
+
+    def test_alltoall_single_even_path_moves_chunks(self):
+        import paddle_tpu.distributed as dist
+        nr, k = 8, 2
+        # rank-stacked [src, nr*k]: value encodes (src, dst, j)
+        src_ids = np.arange(nr)[:, None]
+        col = np.arange(nr * k)[None, :]
+        x = (src_ids * 100 + col).astype(np.float32)
+        xt = paddle.to_tensor(x)
+        out = paddle.to_tensor(np.zeros_like(x))
+        task = dist.alltoall_single(out, xt)
+        task.wait()
+        got = np.asarray(out._value)
+        # dst row d, chunk s = src s's chunk d
+        want = np.zeros_like(x)
+        for d in range(nr):
+            for s in range(nr):
+                want[d, s * k:(s + 1) * k] = x[s, d * k:(d + 1) * k]
+        np.testing.assert_allclose(got, want)
+
+    def test_distmodel_train_arity(self):
+        import paddle_tpu.distributed as dist
+        model = paddle.nn.Linear(4, 4)
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=model.parameters())
+        dm = dist.to_static(model, optimizer=opt,
+                            loss=paddle.nn.MSELoss())
+        with pytest.raises(ValueError, match="exactly"):
+            dm(paddle.to_tensor(np.zeros((2, 4), np.float32)))
