@@ -61,34 +61,43 @@ def black_list():
     return (BLACK_LIST | _state.custom_black) - _state.custom_white
 
 
-def _amp_hook(op_name, tensors):
-    if not _state.enabled:
-        return tensors
-    tgt = _state.dtype
+def cast_plan(op_name):
+    """The autocast decision for one op under the current state:
+    ('down'|'up'|None, target np.dtype). Shared by the eager hook below
+    and the partial-capture recorder (jit/partial_capture.py), which
+    applies the same casts to symbolic segment values at record time."""
+    if not _state.enabled or op_name == "cast" or \
+            op_name.startswith("subgraph["):
+        # "cast" would recurse; a captured subgraph replay
+        # (partial_capture._materialize) already has its casts recorded
+        # inside — re-casting its inputs would corrupt the segment
+        return None, None
     wl = op_name in WHITE_LIST or op_name in _state.custom_white
     # explicit custom white-list entries override the built-in black list
     bl = (op_name in BLACK_LIST or op_name in _state.custom_black) and \
         op_name not in _state.custom_white
-    if _state.level == "O2":
-        cast_down = not bl
-    else:
-        cast_down = wl and not bl
-    out = []
-    if cast_down:
-        for t in tensors:
-            if t.dtype == np.float32:
-                out.append(t.astype(tgt))
-            else:
-                out.append(t)
-        return out
+    if (not bl) if _state.level == "O2" else (wl and not bl):
+        return "down", _state.dtype
     if bl:
-        for t in tensors:
-            if t.dtype == np.dtype(tgt):
-                out.append(t.astype(np.float32))
-            else:
-                out.append(t)
-        return out
-    return tensors
+        return "up", np.float32
+    return None, None
+
+
+def cast_needed(plan, dtype):
+    """Whether a tensor of `dtype` needs casting under `plan`."""
+    if plan == "down":
+        return dtype == np.float32
+    if plan == "up":
+        return dtype == np.dtype(_state.dtype)
+    return False
+
+
+def _amp_hook(op_name, tensors):
+    plan, tgt = cast_plan(op_name)
+    if plan is None:
+        return tensors
+    return [t.astype(tgt) if cast_needed(plan, t.dtype) else t
+            for t in tensors]
 
 
 _set_amp_hook(_amp_hook)

@@ -276,6 +276,9 @@ class PipelineParallel:
         self._scheds = {}
         self._compiled = {}
         self._remat_choice = {}
+        # observability: times from the LAST store-vs-remat measurement
+        # (None until one runs; cached later steps do not re-measure)
+        self.last_mode_times = None
 
         # homogeneity check + per-stage param lists
         self._stage_params = []
@@ -339,11 +342,21 @@ class PipelineParallel:
                 self._pp, n_micro, 1, self._mode)
         return self._scheds[key]
 
-    def _pick_remat(self, stage_fn, stacked, sched, x_aval) -> bool:
-        """auto mode: store activations when the vjp-residual buffers fit
-        FLAGS_pp_store_budget_mb (default 2048 MB per device), else
-        remat. Explicit strategy.recompute always remats. The decision
-        is cached — the abstract vjp trace must not re-run per step."""
+    def _pick_remat(self, stage_fn, stacked, sched, x_aval,
+                    runner=None, run_args=None) -> bool:
+        """auto mode. Two gates, cached per (n_micro, x shape/dtype):
+        1. memory: store-activations is only a candidate when the
+           vjp-residual buffers fit FLAGS_pp_store_budget_mb (default
+           2048 MB per device) — else remat is forced.
+        2. speed: when both fit and a `runner` is provided (train_batch
+           passes the compiled-engine factory), BOTH modes run once on
+           the real batch and the faster wall time wins (r3 measured
+           store 24% slower than remat on an attention stage — the
+           winner is shape-dependent, so it is measured, not assumed).
+           Disable with FLAGS_pp_auto_measure=0 (then store wins ties,
+           matching the reference default: pipeline_parallel.py:440
+           stores, it never remats).
+        Explicit strategy.recompute always remats."""
         if self._remat_mode == "remat":
             return True
         import os
@@ -368,8 +381,25 @@ class PipelineParallel:
             choice = need > budget
         except Exception:
             choice = True  # unprobeable stage: safe default
+        if (not choice and runner is not None
+                and os.environ.get("FLAGS_pp_auto_measure", "1") != "0"):
+            try:
+                t_store = self._time_mode(runner, run_args, remat=False)
+                t_remat = self._time_mode(runner, run_args, remat=True)
+                choice = t_remat < t_store
+                self.last_mode_times = {"remat_s": t_remat,
+                                        "store_s": t_store}
+            except Exception:
+                pass  # keep the memory-gate choice (store)
         self._remat_choice[key] = choice
         return choice
+
+    @staticmethod
+    def _time_mode(runner, run_args, remat):
+        """Per-step wall time of one engine mode (dispatch-count
+        differencing so a remote-dispatch round trip cancels out)."""
+        from ...utils.timing import timed_dispatch_diff
+        return timed_dispatch_diff(runner(remat), run_args)
 
     # -- public API ----------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
@@ -410,18 +440,24 @@ class PipelineParallel:
         dummy_lp = jnp.zeros((1,), jnp.float32)
         import jax as _jax
         x_aval = _jax.ShapeDtypeStruct(xs.shape[1:], xs.dtype)
-        remat = self._pick_remat(stage_fn, stacked, sched, x_aval)
-        self.last_remat = remat   # observability (tests/bench)
+
         # the engine must run under jit: shard_map with auto (non-'pp')
         # axes only composes inside a traced program
-        fb = self._compiled.get(("train", m, remat))
-        if fb is None:
-            def _fb(stacked_, lp_, xs_, ys_):
-                return pipeline_forward_backward(
-                    stage_fn, engine_loss, stacked_, lp_, xs_, ys_,
-                    self._mesh, sched, axis="pp", remat=remat)
-            fb = self._compiled[("train", m, remat)] = _jax.jit(_fb)
-        loss, gstacked, _, _ = fb(stacked, dummy_lp, xs, ys)
+        def get_fb(remat_):
+            fb_ = self._compiled.get(("train", m, remat_))
+            if fb_ is None:
+                def _fb(stacked_, lp_, xs_, ys_, r=remat_):
+                    return pipeline_forward_backward(
+                        stage_fn, engine_loss, stacked_, lp_, xs_, ys_,
+                        self._mesh, sched, axis="pp", remat=r)
+                fb_ = self._compiled[("train", m, remat_)] = _jax.jit(_fb)
+            return fb_
+
+        remat = self._pick_remat(stage_fn, stacked, sched, x_aval,
+                                 runner=get_fb,
+                                 run_args=(stacked, dummy_lp, xs, ys))
+        self.last_remat = remat   # observability (tests/bench)
+        loss, gstacked, _, _ = get_fb(remat)(stacked, dummy_lp, xs, ys)
 
         # unstack grads back onto the stage param Tensors
         for i, g in enumerate(gstacked):

@@ -59,38 +59,49 @@ class PipelineSchedule:
     # bwd-tick). Defaulted for schedules built before this field existed.
     res_buf_size: int = 1
 
-    # Tick cost model (single-chunk-forward units): every tick executes
-    # one chunk-forward plus one chunk-backward, masked or not —
-    # lock-step SPMD burns the compute either way. Backward alone costs
-    # ~2 forwards, so: remat tick = fwd 1 + remat-fwd 1 + bwd 2 = 4;
-    # store-activations tick = fwd 1 + bwd 2 = 3 (a ~1.33x model ratio;
-    # bench.py `pp` measures the real on-chip number). Used by
-    # tests/autotuner to compare schedules.
-    CHUNK_COST_PER_TICK = 4.0          # remat mode (back-compat name)
+    # Tick cost model (single-chunk-forward units). The engine cond-
+    # skips invalid slots (pipeline_forward_backward wraps the fwd and
+    # bwd compute in lax.cond on the per-stage validity bits), so a tick
+    # costs what its *busiest stage* actually runs: fwd = 1; bwd = 2
+    # from stored residuals, 3 under remat (remat-fwd 1 + bwd 2). The
+    # lock-step barrier is the per-tick ppermute pair, hence max over
+    # stages. bench.py `pp` measures the real on-chip number.
+    CHUNK_COST_PER_TICK = 4.0          # full fwd+bwd tick, remat (back-compat)
 
     def chunk_cost_per_tick(self, remat: bool = True) -> float:
         return 4.0 if remat else 3.0
 
+    def tick_costs(self, remat: bool = True) -> np.ndarray:
+        """Per-tick wall cost [n_ticks]: max over stages of the work the
+        cond-skipping engine actually executes that tick."""
+        b = 3.0 if remat else 2.0
+        per_stage = (self.tables["fwd_valid"].astype(np.float64)
+                     + b * self.tables["bwd_valid"].astype(np.float64))
+        return per_stage.max(axis=1)
+
     @property
     def work_units(self) -> float:
-        """Total compute in single-chunk-forward units for the whole step."""
-        return self.n_ticks * self.CHUNK_COST_PER_TICK
+        """Total wall cost in single-chunk-forward units for the whole
+        step (cond-skipping engine, remat mode)."""
+        return float(self.tick_costs(remat=True).sum())
 
-    def efficiency(self) -> float:
-        """ideal / achieved compute ratio — 1.0 means no bubble (the
-        per-tick cost cancels, so the bubble fraction is mode-
-        independent: n_micro*vpp / n_ticks)."""
-        return self.n_micro * self.vpp / self.n_ticks
+    def efficiency(self, remat: bool = True) -> float:
+        """ideal / achieved wall ratio — 1.0 means no bubble. Ideal
+        per-stage work is n_micro*vpp fwd + n_micro*vpp bwd."""
+        b = 3.0 if remat else 2.0
+        ideal = self.n_micro * self.vpp * (1.0 + b)
+        return ideal / float(self.tick_costs(remat).sum())
 
-    def bubble_overhead(self) -> float:
-        return 1.0 - self.efficiency()
+    def bubble_overhead(self, remat: bool = True) -> float:
+        return 1.0 - self.efficiency(remat)
 
     def __hash__(self):  # identity — schedules are built once per step fn
         return id(self)
 
 
 def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
-                            mode: str = "1F1B") -> PipelineSchedule:
+                            mode: str = "1F1B",
+                            inflight_cap=None) -> PipelineSchedule:
     """Greedy dependency-respecting list scheduler.
 
     Work items: fwd(m, q) and bwd(m, q) for microbatch m and virtual stage
@@ -98,7 +109,18 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     (chunk j = q // p), so consecutive virtual stages are ring neighbors.
     Per tick each stage runs at most one fwd and one bwd item. A message
     (activation or gradient) sent at tick t is consumable from tick t+1.
-    """
+
+    inflight_cap: per-stage in-flight microbatch limit (int, per-stage
+    list, or None = auto). The lock-step tick runs one fwd AND one bwd
+    slot, so a stage only reaches full throughput when enough
+    microbatches are in flight to cover the fwd+bwd ring round-trip —
+    2*(p-s)-1 at stage s. That is the v=1 auto default (it reaches the
+    classic async-1F1B bubble (p-1)/(m+p-1) exactly, at ~2x the
+    reference's p-deep in-flight window — cheap here because remat mode
+    only holds chunk *inputs* in flight). Pass the Megatron depth
+    (p - s) to reproduce the reference's tighter memory story at the
+    cost of ~2x bubble. v>1 auto uses the Megatron interleave depth,
+    which already reaches the classic bound."""
     p, m, v = int(n_stages), int(n_micro), int(vpp)
     mkey = _MODES.get(mode.lower())
     if mkey is None:
@@ -117,11 +139,18 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     bwd_tick: Dict[Tuple[int, int], int] = {}
     stage_items = [[(mb, q) for q in range(V) if stage_of(q) == s
                     for mb in range(m)] for s in range(p)]
-    # 1F1B in-flight cap on *injection* (q==0): Megatron warmup depth.
-    if v > 1:
+    # 1F1B in-flight cap on *injection* (q==0).
+    if inflight_cap is not None:
+        caps = [int(inflight_cap)] * p if np.isscalar(inflight_cap) \
+            else [int(c) for c in inflight_cap]
+        if len(caps) != p or min(caps) < 1:
+            raise ValueError(
+                f"inflight_cap must be a positive int or a length-{p} "
+                f"per-stage list; got {inflight_cap!r}")
+    elif v > 1:
         caps = [2 * (p - s - 1) + (v - 1) * p + 1 for s in range(p)]
     else:
-        caps = [p - s for s in range(p)]
+        caps = [2 * (p - s) - 1 for s in range(p)]
 
     fwd_sched = [[] for _ in range(p)]   # per tick: list over stages
     bwd_sched = [[] for _ in range(p)]
@@ -405,14 +434,13 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
         if not remat:
             res_probe = probe_residuals(stage_fn, chunk0, act_z)
 
-        def _store_res(res_buf, vjp, slot, valid):
+        def _store_res(res_buf, vjp, slot):
             leaves = jax.tree_util.tree_leaves(vjp)
             buffered = [l for l, pos in zip(leaves,
                                             res_probe["param_pos"])
                         if pos < 0]
             return tuple(
-                rb.at[slot].set(jnp.where(valid, lf.astype(rb.dtype),
-                                          rb[slot]))
+                rb.at[slot].set(lf.astype(rb.dtype))
                 for rb, lf in zip(res_buf, buffered))
 
         def _load_vjp(res_buf, slot, pj):
@@ -444,16 +472,22 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
                 jnp.where(r["grx_valid"], g_incoming,
                           grad_buf[r["grx_slot"]]))
 
-            # -- forward slot --
+            # -- forward slot (cond-skipped: a stage with no fwd work
+            # this tick pays nothing — warmup/cooldown ticks no longer
+            # burn a full masked chunk-forward) --
             x_in = jnp.where(r["fwd_is_first"], xs[r["fwd_mb"]],
                              act_buf[r["fwd_in_slot"]])
             pj_f = pick_chunk(p_local, r["fwd_chunk"])
-            if remat:
-                out = stage_fn(pj_f, x_in)
-            else:
+
+            def fwd_do(x_in, res_buf):
+                if remat:
+                    return stage_fn(pj_f, x_in), res_buf
                 out, vjp_f = jax.vjp(stage_fn, pj_f, x_in)
-                res_buf = _store_res(res_buf, vjp_f, r["res_slot"],
-                                     r["fwd_valid"])
+                return out, _store_res(res_buf, vjp_f, r["res_slot"])
+
+            out, res_buf = jax.lax.cond(
+                r["fwd_valid"], fwd_do,
+                lambda x_in, res_buf: (act_z, res_buf), x_in, res_buf)
             lv, g_seed, g_lp = jax.lax.cond(
                 r["fwd_is_last"],
                 lambda o: loss_and_seeds(o, ys[r["fwd_mb"]]),
@@ -470,22 +504,29 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
                 lambda a, g: a + jnp.where(last_valid, g, 0.0).astype(a.dtype),
                 lp_acc, g_lp)
 
-            # -- backward slot --
+            # -- backward slot (cond-skipped like the forward) --
             pj = pick_chunk(p_local, r["bwd_chunk"])
             g_in = grad_buf[r["bwd_gslot"]]
-            if remat:
-                # remat from the saved chunk input
-                xb = jnp.where(r["bwd_is_first"], xs[r["bwd_mb"]],
-                               act_buf[r["bwd_in_slot"]])
-                _, vjp = jax.vjp(stage_fn, pj, xb)
-            else:
-                # stored residuals (param leaves re-picked live)
-                vjp = _load_vjp(res_buf, r["bwd_res_slot"], pj)
-            dp, dx = vjp(g_in)
+
+            def bwd_do(g_in, act_buf, res_buf):
+                if remat:
+                    # remat from the saved chunk input
+                    xb = jnp.where(r["bwd_is_first"], xs[r["bwd_mb"]],
+                                   act_buf[r["bwd_in_slot"]])
+                    _, vjp = jax.vjp(stage_fn, pj, xb)
+                else:
+                    # stored residuals (param leaves re-picked live)
+                    vjp = _load_vjp(res_buf, r["bwd_res_slot"], pj)
+                return vjp(g_in)
+
+            dp, dx = jax.lax.cond(
+                r["bwd_valid"], bwd_do,
+                lambda g_in, act_buf, res_buf: (
+                    jax.tree_util.tree_map(jnp.zeros_like, pj), act_z),
+                g_in, act_buf, res_buf)
             gacc = jax.tree_util.tree_map(
                 lambda acc, g: acc.at[r["bwd_chunk"]].add(
-                    jnp.where(r["bwd_valid"], g, 0.0).astype(acc.dtype)),
-                gacc, dp)
+                    g.astype(acc.dtype)), gacc, dp)
             first_valid = jnp.logical_and(r["bwd_valid"], r["bwd_is_first"])
             dxs = dxs.at[r["bwd_mb"]].set(
                 jnp.where(first_valid, dx.astype(dxs.dtype),

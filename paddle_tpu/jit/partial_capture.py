@@ -315,15 +315,26 @@ class _CaptureContext:
             return NotImplemented
         if _core._static_handler is not None:
             return NotImplemented  # static-graph mode wins
-        try:
-            from ..amp import is_auto_cast_enabled
-            if is_auto_cast_enabled():
-                # AMP autocasts per-op on concrete tensors; composing it
-                # with deferred segments would skip the casts — run
-                # eagerly under AMP instead
-                return NotImplemented
-        except ImportError:  # pragma: no cover
-            pass
+        if op_name != "cast":
+            # AMP composes with capture by applying the same per-op cast
+            # decision the eager hook makes (amp.cast_plan) at RECORD
+            # time: each needed cast is itself recorded as a "cast" node
+            # (a.astype re-enters this handler), so compiled segments
+            # reproduce eager-AMP numerics exactly and bf16 training
+            # still gets segment acceleration.
+            from ..amp import cast_needed, cast_plan
+            plan, tgt = cast_plan(op_name)
+            if plan is not None:
+                cast_args = []
+                for a in args:
+                    if isinstance(a, Tensor):
+                        v = a._value
+                        dt = v.aval.dtype if isinstance(v, _SymValue) \
+                            else v.dtype
+                        if cast_needed(plan, dt):
+                            a = a.astype(tgt)
+                    cast_args.append(a)
+                args = tuple(cast_args)
         arg_specs = []
         sig_args = []
         eval_args = []

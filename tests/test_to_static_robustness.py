@@ -353,3 +353,81 @@ def test_partial_capture_full_llama():
     n_cache = len(pp._seg_cache)
     pp(ids)
     assert len(pp._seg_cache) == n_cache
+
+
+def test_partial_capture_composes_with_amp():
+    """VERDICT r3 #10: autocast applies at RECORD time (cast nodes enter
+    the segment), so full_graph=False accelerates bf16 training instead
+    of bowing out to eager. Checks: segments actually compile under
+    auto_cast, numerics match eager AMP, grads flow, and the recorded
+    segment signature contains the cast ops."""
+    from paddle_tpu.jit.partial_capture import PartialProgram
+    from paddle_tpu import amp, nn
+
+    paddle.seed(7)
+    lin1 = nn.Linear(8, 16)
+    lin2 = nn.Linear(16, 8)
+    x = paddle.to_tensor(np.random.RandomState(3).randn(4, 8)
+                         .astype(np.float32))
+
+    def fn(a):
+        h = lin2(paddle.nn.functional.relu(lin1(a)))
+        s = float(h.sum().numpy())          # graph break mid-function
+        scale = 2.0 if s < 1e9 else 0.0
+        return (h * scale).mean()
+
+    pp = PartialProgram(fn)
+    with amp.auto_cast(True):
+        out = pp(x)
+    assert pp.num_subgraphs >= 1, "AMP must not force eager fallback"
+    assert len(pp._seg_cache) >= 1, "segments must compile under AMP"
+    # the cached signature must include recorded cast nodes
+    sig_ops = [op for (parts, _n) in pp._seg_cache
+               for (op, *_rest) in parts]
+    assert "cast" in sig_ops
+    with amp.auto_cast(True):
+        ref = fn(x)                          # eager AMP (same cast plan)
+    np.testing.assert_allclose(float(out.numpy()), float(ref.numpy()),
+                               rtol=1e-3)
+    # f32 math differs from the bf16 path — proves the casts really ran
+    assert abs(float(out.numpy()) - float(fn(x).numpy())) > 0
+
+    # grads flow through captured cast nodes
+    x2 = paddle.to_tensor(np.random.RandomState(4).randn(4, 8)
+                          .astype(np.float32))
+    with amp.auto_cast(True):
+        loss = pp(x2)
+    loss.backward()
+    assert lin1.weight.grad is not None
+    assert lin1.weight.grad.shape == lin1.weight.shape
+
+
+def test_partial_capture_amp_o2_and_cache_reuse():
+    """O2 (everything-down) capture: repeat calls under the same amp
+    state hit the segment cache; toggling amp off yields a different
+    (cast-free) signature rather than stale bf16 segments."""
+    from paddle_tpu.jit.partial_capture import PartialProgram
+    from paddle_tpu import amp, nn
+
+    paddle.seed(8)
+    lin = nn.Linear(6, 6)
+    x = paddle.to_tensor(np.random.RandomState(5).randn(3, 6)
+                         .astype(np.float32))
+
+    def fn(a):
+        h = lin(a)
+        _ = float(h.max().numpy())           # break
+        return h.sum()
+
+    pp = PartialProgram(fn)
+    with amp.auto_cast(True, level="O2"):
+        pp(x)
+    n_amp = len(pp._seg_cache)
+    with amp.auto_cast(True, level="O2"):
+        pp(x)
+    assert len(pp._seg_cache) == n_amp      # cache hit, no regrowth
+    out_plain = pp(x)                        # amp off: new segments
+    assert len(pp._seg_cache) > n_amp
+    ref = fn(x)
+    np.testing.assert_allclose(float(out_plain.numpy()),
+                               float(ref.numpy()), rtol=1e-5)
