@@ -63,11 +63,22 @@ def run_llama(config: str = "mid"):
                         chunked_ce_tokens=1024,
                         max_position_embeddings=4096)
         batch, seq, iters = 2, 4096, 10
+    elif config == "mid8k":
+        # long-context flagship row (VERDICT r3 #6): seq-8192 flash
+        # attention on one chip, chunked CE
+        cfg = llama_mid(dtype="bfloat16", use_recompute=False,
+                        chunked_ce_tokens=1024,
+                        max_position_embeddings=8192)
+        batch, seq, iters = 1, 8192, 10
     elif config == "1b":
-        # largest-fitting row: ~1.0B with remat + chunked CE. AdamW f32
-        # masters for 1.0B are ~12GB of the 16GB chip — batch 4 is the
-        # activation budget that remains
+        # largest-fitting row: ~1.0B. r4 recipe (VERDICT r3 #3, the
+        # 0.65B->1B MFU cliff): bf16 Adam moments (AdamW
+        # moment_dtype='bfloat16' halves optimizer-state HBM) buy back
+        # enough memory to drop full remat for full_attn granularity
+        # (MLP activations stored, attention rematerialized) — measured
+        # 57.9% -> 70.9% MFU at b4 s2048
         cfg = llama_1b(dtype="bfloat16", use_recompute=True,
+                       recompute_granularity="full_attn",
                        chunked_ce_tokens=1024)
         batch, seq, iters = 4, 2048, 10
     elif config == "small":
@@ -79,7 +90,9 @@ def run_llama(config: str = "mid"):
 
     model = LlamaForCausalLM(cfg)
     opt = optimizer.AdamW(learning_rate=1e-4, parameters=model.parameters(),
-                          weight_decay=0.01)
+                          weight_decay=0.01,
+                          moment_dtype="bfloat16" if config == "1b"
+                          else None)
     step = paddle.jit.TrainStep(model, lambda o, l: model.loss(o, l), opt)
 
     rng = np.random.RandomState(0)
@@ -90,18 +103,11 @@ def run_llama(config: str = "mid"):
         loss = step(ids, ids)
     float(loss)
 
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        loss = step(ids, ids)
-    final = float(loss)  # blocks
-    dt = time.perf_counter() - t0
-
+    dt = _timed_train_steps(step, ids, iters) * iters
+    final = float(step(ids, ids))   # loss AFTER all trained steps
     tokens_per_sec = batch * seq * iters / dt
     n_params = model.num_params()
-    l_, h_, q_ = (cfg.num_hidden_layers, cfg.num_attention_heads,
-                  cfg.hidden_size // cfg.num_attention_heads)
-    flops_per_token = 6 * n_params + 12 * l_ * h_ * q_ * seq
-    mfu = tokens_per_sec * flops_per_token / detect_peak_flops()
+    mfu = _mfu(tokens_per_sec, n_params, cfg, seq)
     return {
         "metric": f"llama_{config}_train_tokens_per_sec_chip",
         "value": round(tokens_per_sec, 1),
@@ -116,6 +122,69 @@ def run_llama(config: str = "mid"):
             "step_ms": round(1000 * dt / iters, 2),
         },
     }
+
+
+def _mfu(tokens_per_sec, n_params, cfg, seq):
+    """PaLM-appendix MFU: flops/token = 6N + 12*L*H*Q*S — ONE formula
+    for every bench row (llama and MoE) so the numbers stay
+    comparable. For MoE pass the ACTIVATED parameter count."""
+    l_, h_, q_ = (cfg.num_hidden_layers, cfg.num_attention_heads,
+                  cfg.hidden_size // cfg.num_attention_heads)
+    fpt = 6 * n_params + 12 * l_ * h_ * q_ * seq
+    return tokens_per_sec * fpt / detect_peak_flops()
+
+
+def _timed_train_steps(step, ids, iters):
+    """Per-step wall seconds of a TrainStep via dispatch-count
+    differencing (cancels the ~75 ms tunnel fetch RTT that polluted the
+    r2/r3 numbers — see paddle_tpu.utils.timing)."""
+    from paddle_tpu.utils.timing import timed_dispatch_diff
+    return timed_dispatch_diff(lambda a, b: step(a, b)._value,
+                               (ids, ids), calls=(2, 2 + iters),
+                               repeats=2)
+
+
+def run_moe():
+    """MoE-LM training row (VERDICT r3 #7: EP/MoE cost measured, not
+    assumed): dense (GShard one-hot) vs ragged (sort-based dropless)
+    dispatch at E=8 top-2, single chip. MFU is computed over ACTIVATED
+    params (the MoE accounting convention)."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
+
+    out = {}
+    batch, seq, iters = 4, 2048, 8
+    for mode in ("dense", "ragged"):
+        paddle.seed(0)
+        cfg = MoEConfig(dtype="bfloat16", hidden_size=1024,
+                        intermediate_size=2816,
+                        moe_intermediate_size=1408,
+                        num_hidden_layers=8, num_attention_heads=16,
+                        num_key_value_heads=8, num_experts=8,
+                        num_experts_per_tok=2,
+                        max_position_embeddings=2048,
+                        chunked_ce_tokens=1024,
+                        moe_dispatch_mode=mode)
+        model = MoEForCausalLM(cfg)
+        opt = optimizer.AdamW(learning_rate=1e-4,
+                              parameters=model.parameters(),
+                              weight_decay=0.01)
+        step = paddle.jit.TrainStep(model, lambda o, l: model.loss(o, l),
+                                    opt)
+        rng = np.random.RandomState(0)
+        ids = paddle.to_tensor(rng.randint(
+            0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+        for _ in range(2):
+            loss = step(ids, ids)
+        float(loss)
+        tok = batch * seq / _timed_train_steps(step, ids, iters)
+        out[f"moe_{mode}_tok_per_sec"] = round(tok, 1)
+        out[f"moe_{mode}_mfu_activated"] = round(
+            _mfu(tok, model.num_activated_params(), cfg, seq), 4)
+    out["moe_total_params"] = model.num_params()
+    out["moe_activated_params"] = model.num_activated_params()
+    return out
 
 
 def run_resnet():
@@ -152,7 +221,14 @@ def run_resnet():
 
 
 def run_decode():
-    """Paged-KV serving decode tokens/sec (Pallas decode kernel)."""
+    """Paged-KV serving decode tokens/sec (Pallas decode kernel).
+
+    Methodology (changed r4): the decode phase is timed at TWO scan
+    lengths and differenced — a blocking token fetch through the axon
+    tunnel costs a ~75 ms (±several ms) round trip, which the r2/r3
+    numbers divided into ~63 steps (~1.2 ms/step of constant noise;
+    the r3 '-7%' decode regression sat entirely inside that band).
+    The differenced number is pure device time per step."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_small
     from paddle_tpu.inference.paged_decode import PagedLlamaDecoder
@@ -161,30 +237,43 @@ def run_decode():
     cfg = llama_small(dtype="bfloat16")
     model = LlamaForCausalLM(cfg)
     model.eval()
-    batch, prompt, steps = 8, 512, 64
-    block_size = 64
+    batch, prompt, block_size = 8, 512, 64
+    steps_lo, steps_hi = 64, 192
     dec = PagedLlamaDecoder(
-        model, num_blocks=(prompt + steps + block_size) * batch // block_size
+        model,
+        num_blocks=(prompt + steps_hi + block_size) * batch // block_size
         + batch, block_size=block_size)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
-    # warmup with the SAME token count (the scanned decode loop's length
-    # is a compile-time constant)
-    dec.generate(ids, max_new_tokens=steps)
-    timings = {}
-    out = dec.generate(ids, max_new_tokens=steps, timings=timings)
-    dt = timings["decode_s"]            # decode phase only — the prefill
-    assert out.shape == (batch, prompt + steps)   # is reported separately
-    return {"paged_decode_tok_per_sec": round(batch * (steps - 1) / dt, 1),
+    # warmup BOTH lengths (the scanned decode loop's length is a
+    # compile-time constant), then take best-of-2 per length
+    dt = {}
+    for steps in (steps_lo, steps_hi):
+        dec.generate(ids, max_new_tokens=steps)
+        best = float("inf")
+        for _ in range(2):
+            timings = {}
+            out = dec.generate(ids, max_new_tokens=steps,
+                               timings=timings)
+            best = min(best, timings["decode_s"])
+        assert out.shape == (batch, prompt + steps)
+        dt[steps] = best
+    per_step = (dt[steps_hi] - dt[steps_lo]) / (steps_hi - steps_lo)
+    raw = dt[steps_lo] / (steps_lo - 1)     # r2/r3-comparable (RTT in)
+    return {"paged_decode_tok_per_sec": round(batch / per_step, 1),
             "paged_decode_batch": batch,
-            "paged_decode_ms_per_step": round(1000 * dt / (steps - 1), 2),
+            "paged_decode_ms_per_step": round(1000 * per_step, 2),
+            "paged_decode_ms_per_step_with_rtt": round(1000 * raw, 2),
             "prefill_ms": round(1000 * timings["prefill_s"], 2)}
 
 
 def run_serving(weight_dtype=None, concurrency=8):
-    """Continuous-batching serving bench (VERDICT r3 protocol): mixed
-    prompt lengths, 2x oversubscribed request queue; reports tok/s and
-    p50/p99 request latency."""
+    """Continuous-batching serving bench (r4 protocol, VERDICT r3 #5):
+    OPEN-LOOP Poisson arrivals over mixed prompt buckets (128/256/512)
+    and mixed max_new_tokens (32..96), so p50/p99 are non-degenerate
+    and the engine schedules under realistic churn. Reports throughput,
+    latency/TTFT percentiles, and the prefill/decode-stall/host wall
+    breakdown (where the engine-vs-raw-decode gap goes)."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_small
     from paddle_tpu.inference import ServingEngine, SamplingParams
@@ -194,23 +283,37 @@ def run_serving(weight_dtype=None, concurrency=8):
     model = LlamaForCausalLM(cfg)
     model.eval()
     block_size = 64
-    new_tokens = 64
-    n_requests = concurrency * 2
+    n_requests = concurrency * 3
     eng = ServingEngine(
         model, max_batch_size=concurrency,
-        num_blocks=concurrency * ((512 + new_tokens) // block_size + 2) + 1,
-        block_size=block_size, prompt_buckets=(512,),
+        num_blocks=concurrency * ((512 + 96) // block_size + 2) + 8,
+        block_size=block_size, prompt_buckets=(128, 256, 512),
         weight_dtype=weight_dtype, chunk_size=16)
     rng = np.random.RandomState(0)
-    lens = rng.randint(128, 513, n_requests)
-    # warmup: compile prefill + decode with one short request
-    eng.warmup(prompt_len=512)  # compiles (both prefill widths +
-    # decode chunk) must not skew the measured stats
+    # compile every variant up front so no request pays a compile
+    eng.warmup()
+    eng.clear_finished()
+
+    # Poisson arrivals at ~80% of the drained-throughput estimate the
+    # r3 run measured (~600 tok/s / 64 tok ≈ 9 req/s full capacity →
+    # 0.8 * 9 = 7.2 req/s): the queue drains between bursts, so the
+    # percentiles describe an operating point, not saturation noise
+    arrivals = np.cumsum(rng.exponential(1.0 / 7.2, n_requests))
+    lens = rng.choice([100, 200, 460], n_requests)
+    news = rng.randint(32, 97, n_requests)
     t0 = time.perf_counter()
-    for l in lens:
-        eng.add_request(rng.randint(0, cfg.vocab_size, int(l)),
-                        SamplingParams(max_new_tokens=new_tokens))
-    eng.run_to_completion()
+    sent = 0
+    while sent < n_requests or eng.has_work:
+        now = time.perf_counter() - t0
+        while sent < n_requests and arrivals[sent] <= now:
+            eng.add_request(
+                rng.randint(0, cfg.vocab_size, int(lens[sent])),
+                SamplingParams(max_new_tokens=int(news[sent])))
+            sent += 1
+        if not eng.step() and sent < n_requests:
+            # idle until the next arrival
+            time.sleep(max(0.0, arrivals[sent] - (time.perf_counter()
+                                                  - t0)))
     dt = time.perf_counter() - t0
     st = eng.stats()
     gen = st["generated_tokens"]
@@ -220,6 +323,11 @@ def run_serving(weight_dtype=None, concurrency=8):
         f"{tag}_latency_p50_s": round(st["latency_p50_s"], 3),
         f"{tag}_latency_p99_s": round(st["latency_p99_s"], 3),
         f"{tag}_ttft_p50_s": round(st["ttft_p50_s"], 3),
+        f"{tag}_ttft_p99_s": round(st["ttft_p99_s"], 3),
+        f"{tag}_prefill_s": round(st["time_prefill_s"], 2),
+        f"{tag}_decode_stall_s": round(st["time_decode_stall_s"], 2),
+        f"{tag}_host_s": round(st["time_host_s"], 2),
+        f"{tag}_wall_s": round(dt, 2),
     }
 
 
@@ -375,16 +483,17 @@ def _pp_bubble_measured(stage_fn, params, xs, build_pipeline_schedule):
 
 
 def run_serving_suite():
-    """fp and int8 at two concurrency levels."""
+    """bf16 and int8 at c8 (the r4 open-loop protocol compiles 3 prompt
+    buckets x 2 prefill widths per engine, so the c4 rows were dropped
+    to keep the auto-suite bounded; c4 behavior is covered by tests)."""
     out = {}
     for wd in (None, "int8"):
-        for conc in (4, 8):
-            out.update(run_serving(weight_dtype=wd, concurrency=conc))
+        out.update(run_serving(weight_dtype=wd, concurrency=8))
     return out
 
 
 def main(mode: str):
-    if mode in ("mid", "mid4k", "1b", "small", "tiny"):
+    if mode in ("mid", "mid4k", "mid8k", "1b", "small", "tiny"):
         result = run_llama(mode)
     elif mode == "resnet":
         result = {"metric": "resnet50_train_imgs_per_sec_chip",
@@ -405,6 +514,11 @@ def main(mode: str):
         result = {"metric": "pp_remat_overhead_x", "unit": "x",
                   "vs_baseline": 0.0, "value": r["pp_remat_overhead_x"],
                   "extra": r}
+    elif mode == "moe":
+        r = run_moe()
+        result = {"metric": "moe_ragged_tok_per_sec", "unit": "tokens/s",
+                  "vs_baseline": 0.0,
+                  "value": r["moe_ragged_tok_per_sec"], "extra": r}
     else:  # auto: headline llama + secondary benches in extra
         try:
             result = run_llama("mid")
@@ -413,7 +527,7 @@ def main(mode: str):
             result = run_llama("small")
         # BASELINE protocol rows: long-context + largest-fitting configs
         import gc
-        for cfg_name in ("mid4k", "1b"):
+        for cfg_name in ("mid4k", "mid8k", "1b"):
             try:
                 r = run_llama(cfg_name)
                 result["extra"][f"llama_{cfg_name}_tok_per_sec"] = \
@@ -426,7 +540,8 @@ def main(mode: str):
                 sys.stderr.write(f"bench {cfg_name} failed: {e}\n")
             gc.collect()  # release the failed attempt's HBM promptly
         for name, fn in (("resnet", run_resnet), ("decode", run_decode),
-                         ("serving", run_serving_suite), ("pp", run_pp)):
+                         ("serving", run_serving_suite), ("pp", run_pp),
+                         ("moe", run_moe)):
             try:
                 result["extra"].update(fn())
             except Exception as e:
@@ -435,8 +550,8 @@ def main(mode: str):
     return result
 
 
-_VALID_MODES = ("auto", "mid", "mid4k", "1b", "small", "tiny", "resnet",
-                "decode", "serving", "pp")
+_VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
+                "resnet", "decode", "serving", "pp", "moe")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

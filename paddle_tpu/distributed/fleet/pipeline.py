@@ -349,13 +349,16 @@ class PipelineParallel:
            vjp-residual buffers fit FLAGS_pp_store_budget_mb (default
            2048 MB per device) — else remat is forced.
         2. speed: when both fit and a `runner` is provided (train_batch
-           passes the compiled-engine factory), BOTH modes run once on
+           passes the compiled-engine factory), both modes are TIMED on
            the real batch and the faster wall time wins (r3 measured
            store 24% slower than remat on an attention stage — the
            winner is shape-dependent, so it is measured, not assumed).
-           Disable with FLAGS_pp_auto_measure=0 (then store wins ties,
-           matching the reference default: pipeline_parallel.py:440
-           stores, it never remats).
+           One-time cost on the first train_batch: a second engine
+           compile plus ~4 extra step executions per mode (dispatch-
+           count differencing needs warm + 1 + 2 calls). Disable with
+           FLAGS_pp_auto_measure=0 (then store wins ties, matching the
+           reference default: pipeline_parallel.py:440 stores, it
+           never remats).
         Explicit strategy.recompute always remats."""
         if self._remat_mode == "remat":
             return True
@@ -397,9 +400,11 @@ class PipelineParallel:
     @staticmethod
     def _time_mode(runner, run_args, remat):
         """Per-step wall time of one engine mode (dispatch-count
-        differencing so a remote-dispatch round trip cancels out)."""
+        differencing so a remote-dispatch round trip cancels out;
+        repeats=1 keeps the one-time pick cheap)."""
         from ...utils.timing import timed_dispatch_diff
-        return timed_dispatch_diff(runner(remat), run_args)
+        return timed_dispatch_diff(runner(remat), run_args,
+                                   calls=(1, 2), repeats=1)
 
     # -- public API ----------------------------------------------------------
     def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):

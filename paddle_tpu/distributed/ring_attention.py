@@ -131,7 +131,19 @@ def _jnp_blk_bwd(q, k, v, out, lse, do, causal, scale):
     return dq, dk, dv
 
 
+def _interp_vma_fallback(q) -> bool:
+    """Pallas interpret mode (the CPU test vehicle) cannot evaluate
+    kernels whose operands carry varying-manual-axes tags (its internal
+    dynamic_slices trip the vma checker); use the jnp oracle there.
+    Real TPU lowering takes the tagged out_shape fine."""
+    from ..ops.pallas.flash_attention import _interpret
+    vma = getattr(getattr(q, "aval", None), "vma", None)
+    return bool(vma) and _interpret()
+
+
 def _pallas_blk_fwd(q, k, v, causal, scale):
+    if _interp_vma_fallback(q):
+        return _jnp_blk_fwd(q, k, v, causal, scale)
     from ..ops.pallas.flash_attention import flash_attention_with_lse
     from ..ops.flash_attention import pallas_attention_plan
     plan = pallas_attention_plan(q, k, min_seq=128) or (None, None)
@@ -141,6 +153,8 @@ def _pallas_blk_fwd(q, k, v, causal, scale):
 
 
 def _pallas_blk_bwd(q, k, v, out, lse, do, causal, scale):
+    if _interp_vma_fallback(q):
+        return _jnp_blk_bwd(q, k, v, out, lse, do, causal, scale)
     from ..ops.pallas.flash_attention import flash_attention_bwd_block
     from ..ops.flash_attention import pallas_attention_plan
     plan = pallas_attention_plan(q, k, min_seq=128) or (None, None)
@@ -296,8 +310,13 @@ def _ring_fwd(q, k, v, axis_name, causal, scale, use_pallas, zigzag):
         v_nxt = jax.lax.ppermute(v_cur, axis_name, perm)
         return (out, lse_new, k_nxt, v_nxt), None
 
-    out0 = jnp.zeros((b, s, h, d), jnp.float32)
-    lse0 = jnp.full((b, h, s), _NEG_INF, jnp.float32)
+    # pvary: zero-init carries are axis-invariant constants, but the scan
+    # writes axis-varying values into them — required typing under the
+    # (default) vma checker when shard_map is manual over a subset axis
+    out0 = jax.lax.pvary(jnp.zeros((b, s, h, d), jnp.float32),
+                         (axis_name,))
+    lse0 = jax.lax.pvary(jnp.full((b, h, s), _NEG_INF, jnp.float32),
+                         (axis_name,))
     (out, lse, _, _), _ = jax.lax.scan(
         step, (out0, lse0, k, v), jnp.arange(n))
     return out.astype(q.dtype), lse
@@ -349,9 +368,9 @@ def _ring_core_bwd(axis_name, causal, scale, use_pallas, zigzag, res,
         dv_nxt = jax.lax.ppermute(dv_cur, axis_name, perm)
         return (dq, k_nxt, v_nxt, dk_nxt, dv_nxt), None
 
-    dq0 = jnp.zeros(q.shape, jnp.float32)
-    dk0 = jnp.zeros(k.shape, jnp.float32)
-    dv0 = jnp.zeros(v.shape, jnp.float32)
+    dq0 = jax.lax.pvary(jnp.zeros(q.shape, jnp.float32), (axis_name,))
+    dk0 = jax.lax.pvary(jnp.zeros(k.shape, jnp.float32), (axis_name,))
+    dv0 = jax.lax.pvary(jnp.zeros(v.shape, jnp.float32), (axis_name,))
     (dq, _, _, dk, dv), _ = jax.lax.scan(
         step, (dq0, k, v, dk0, dv0), jnp.arange(n))
     # after n hops the dk/dv accumulators are back at their home shard
@@ -413,11 +432,22 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = True,
     if zigzag is None:
         zigzag = bool(causal) and n > 1 and q.shape[1] % (2 * n) == 0
     spec = P(None, axis, None, None)
+    # single-axis mesh: manual over everything, vma checker off (the
+    # pre-CP behavior; pallas interpret mode dislikes vma tags).
+    # multi-axis mesh: manual over `axis` ONLY so dp/mp compose as GSPMD
+    # auto axes; the vma checker must stay ON there — jax 0.9
+    # mis-validates out_specs when check_vma=False combines with a
+    # subset axis_names (it demands the None entries "refer to" the
+    # auto axes)
+    if set(jmesh.axis_names) == {axis}:
+        sm_kwargs = dict(check_vma=False)
+    else:
+        sm_kwargs = dict(axis_names={axis})
     f = shard_map(
         partial(ring_attention_local, axis_name=axis, causal=causal,
                 scale=scale, use_pallas=use_pallas, zigzag=zigzag),
         mesh=jmesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False)
+        **sm_kwargs)
     if not zigzag:
         return f(q, k, v)
     # the permutation is a cross-shard all-to-all; re-pin the layouts so

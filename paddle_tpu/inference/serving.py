@@ -56,12 +56,26 @@ __all__ = ["SamplingParams", "Request", "ServingEngine"]
 
 @dataclass
 class SamplingParams:
-    """Per-request sampling controls. temperature<=0 means greedy.
-    top_k is engine-static (an XLA shape constant): it is set on the
-    engine, not per request."""
+    """Per-request sampling controls (reference generation surface:
+    /root/reference/python/paddle/nn/decode.py:994 dynamic_decode +
+    the incubate serving path). temperature<=0 means greedy; top_k=None
+    defers to the engine-level top_k default while top_k=0 explicitly
+    disables the filter (even against an engine default); top_p=1.0
+    and repetition_penalty=1.0 are off. All are PER REQUEST and applied
+    in-program (mask-based — no new compile variants per value)."""
     temperature: float = 0.0
     max_new_tokens: int = 32
     eos_token_id: Optional[int] = None
+    top_k: Optional[int] = None
+    top_p: float = 1.0
+    repetition_penalty: float = 1.0
+
+    @property
+    def needs_rich_sampling(self) -> bool:
+        # an EXPLICIT top_k (including 0, which must be able to override
+        # an engine-level default) routes through the per-request path
+        return (self.top_k is not None or self.top_p < 1.0
+                or self.repetition_penalty != 1.0)
 
 
 @dataclass
@@ -74,6 +88,9 @@ class Request:
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
     state: str = "queued"                 # queued | running | done
+    # tokens DISPATCHED (prefill + scheduled decode steps) — may exceed
+    # len(out_tokens) while a chunk is in flight or after an EOS cut
+    planned: int = 0
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -111,14 +128,22 @@ class ServingEngine:
                  num_blocks: int = 512, block_size: int = 16,
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  weight_dtype: Optional[str] = None, top_k: int = 0,
-                 chunk_size: int = 8, seed: int = 0):
+                 chunk_size: int = 8, seed: int = 0,
+                 overlap: bool = True, mesh=None):
         self.dec = PagedLlamaDecoder(model, num_blocks=num_blocks,
                                      block_size=block_size,
-                                     weight_dtype=weight_dtype)
+                                     weight_dtype=weight_dtype,
+                                     mesh=mesh)
         self.max_b = int(max_batch_size)
         self.buckets = tuple(sorted(prompt_buckets))
         self.top_k = int(top_k)
         self.chunk = max(1, int(chunk_size))
+        # overlap: dispatch decode chunk t+1 (first tokens taken from
+        # chunk t's DEVICE output) before fetching chunk t's tokens, so
+        # host admission/bookkeeping runs while the device decodes.
+        # Falls back to synchronous collection while any active request
+        # uses repetition_penalty (its seen-mask needs fetched history).
+        self.overlap = bool(overlap)
         self._key = jax.random.PRNGKey(seed)
         cache = self.dec.cache
         # reserve one scratch page: pad-token prefill writes and inactive
@@ -134,13 +159,26 @@ class ServingEngine:
         self._ids = itertools.count()
         self.decode_steps = 0
         self.generated_tokens = 0
+        # async pipeline state (overlap mode)
+        self._inflight: deque = deque()   # dispatched, unfetched chunks
+        self._fresh_slots: set = set()    # slots (re)filled since the
+        #                                   last dispatch: their first
+        #                                   token comes from the host
+        # phase-time breakdown (bench: prefill / decode-stall / host)
+        self.time_prefill_s = 0.0
+        self.time_stall_s = 0.0
+        self.time_host_s = 0.0
+        self._zeros_seen_cache: Dict[int, jax.Array] = {}
 
         dec = self.dec
 
-        def prefill(weights, k, v, ids, slots, last_idx, temp, key):
+        def prefill(weights, k, v, ids, slots, last_idx, temp, key,
+                    top_ks, top_ps, rep, seen):
             logits, k, v = dec._prefill_impl(weights, k, v, ids, slots,
                                              last_idx)
-            return self._sample(logits, temp, key), k, v
+            tok = self._sample_rich(logits, temp, key, top_ks, top_ps,
+                                    rep, seen)
+            return tok, k, v
 
         def decode_chunk(weights, k, v, first_ids, tables_all, ctx_all,
                          slots_all, temp, keys_all):
@@ -157,8 +195,40 @@ class ServingEngine:
                 (tables_all, ctx_all, slots_all, keys_all))
             return toks.swapaxes(0, 1), k, v   # [b, T]
 
+        def decode_chunk_rich(weights, k, v, first_ids, tables_all,
+                              ctx_all, slots_all, temp, keys_all,
+                              top_ks, top_ps, rep, seen):
+            """Per-request-sampling variant: the scan additionally
+            carries the token-presence mask (repetition penalty) and
+            applies per-slot top_k/top_p masks. Compiled only when a
+            request actually asks for them."""
+            def step(carry, xs):
+                last_ids, kp, vp, seen_c = carry
+                tables, ctx, slots, key = xs
+                logits, kp, vp = dec._decode_logits(
+                    weights, kp, vp, last_ids, tables, ctx, slots)
+                nxt = self._sample_rich(logits, temp, key, top_ks,
+                                        top_ps, rep, seen_c)
+                seen_c = seen_c.at[
+                    jnp.arange(seen_c.shape[0]), nxt].set(True)
+                return (nxt, kp, vp, seen_c), nxt
+            (_, k, v, _), toks = jax.lax.scan(
+                step, (first_ids, k, v, seen),
+                (tables_all, ctx_all, slots_all, keys_all))
+            return toks.swapaxes(0, 1), k, v   # [b, T]
+
+        def merge_first(toks_dev, last_idx, overrides, use_host):
+            """First tokens of the next chunk from the previous chunk's
+            device output (continuing slots) or host values (fresh
+            slots) — keeps the chunk-to-chunk dependency on-device."""
+            gathered = toks_dev[jnp.arange(toks_dev.shape[0]), last_idx]
+            return jnp.where(use_host, overrides, gathered)
+
         self._prefill_j = jax.jit(prefill, donate_argnums=(1, 2))
         self._decode_j = jax.jit(decode_chunk, donate_argnums=(1, 2))
+        self._decode_rich_j = jax.jit(decode_chunk_rich,
+                                      donate_argnums=(1, 2))
+        self._merge_first_j = jax.jit(merge_first)
 
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
@@ -170,6 +240,48 @@ class ServingEngine:
         t = jnp.maximum(temp, 1e-6)[:, None]
         sampled = jax.random.categorical(
             key, logits / t, axis=-1).astype(jnp.int32)
+        return jnp.where(temp > 0.0, sampled, greedy)
+
+    def _sample_rich(self, logits, temp, key, top_ks, top_ps, rep,
+                     seen):
+        """Per-request sampling, all mask-based so one compiled program
+        serves every parameter combination (models/generation.py:26-46
+        semantics): repetition penalty over the seen mask, per-slot
+        top_k via the k-th order statistic of the sorted logits,
+        per-slot top_p nucleus over the tempered distribution.
+        logits [b, V] f32; temp/top_ps/rep [b] f32; top_ks [b] i32;
+        seen [b, V] bool."""
+        v = logits.shape[-1]
+        logits = logits.astype(jnp.float32)
+        # repetition penalty (HF semantics: shrink positive logits,
+        # amplify negative ones, only for already-seen tokens)
+        pen = jnp.where(logits > 0, logits / rep[:, None],
+                        logits * rep[:, None])
+        logits = jnp.where(seen & (rep != 1.0)[:, None], pen, logits)
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        lt = logits / jnp.maximum(temp, 1e-6)[:, None]
+        # ONE descending sort serves both filters
+        sorted_l = jnp.sort(lt, axis=-1)[..., ::-1]         # [b, V]
+        # per-slot top_k: k-th largest value as the cutoff
+        k_idx = jnp.clip(top_ks - 1, 0, v - 1)
+        kth = jnp.take_along_axis(sorted_l, k_idx[:, None], axis=1)
+        lt = jnp.where((top_ks > 0)[:, None] & (lt < kth), -1e30, lt)
+        # per-slot top_p over the top_k-FILTERED distribution (the
+        # generation.py order: top_k first, then nucleus). The filtered
+        # sorted array is just the sorted prefix with ranks >= k masked,
+        # so the single sort above still serves.
+        rank = jnp.arange(v)[None, :]
+        sorted_k = jnp.where(
+            (top_ks > 0)[:, None] & (rank >= top_ks[:, None]),
+            -1e30, sorted_l)
+        probs = jax.nn.softmax(sorted_k, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        cutoff = cum - probs > top_ps[:, None]
+        pth = jnp.where(cutoff, jnp.inf, sorted_k).min(
+            axis=-1, keepdims=True)
+        lt = jnp.where((top_ps < 1.0)[:, None] & (lt < pth), -1e30, lt)
+        sampled = jax.random.categorical(key, lt, axis=-1) \
+            .astype(jnp.int32)
         return jnp.where(temp > 0.0, sampled, greedy)
 
     def _next_key(self):
@@ -210,7 +322,8 @@ class ServingEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._queue) or any(r is not None for r in self._slots)
+        return (bool(self._queue) or bool(self._inflight)
+                or any(r is not None for r in self._slots))
 
     # -- scheduler -----------------------------------------------------------
     def _required_blocks(self, req: Request) -> int:
@@ -265,22 +378,41 @@ class ServingEngine:
             self._prefill_chunk(bucket, group, 1)
 
     def _prefill_chunk(self, bucket: int, group, gp: int):
+        t0 = time.perf_counter()
         cache = self.dec.cache
+        vocab = self.dec.cfg.vocab_size
         ids = np.zeros((gp, bucket), np.int32)
         slots = np.full((gp, bucket), self._scratch_slot, np.int32)
         last_idx = np.zeros(gp, np.int32)
         temps = np.zeros(gp, np.float32)
+        top_ks = np.zeros(gp, np.int32)
+        top_ps = np.ones(gp, np.float32)
+        reps = np.ones(gp, np.float32)
+        any_rep = any(req.sampling.repetition_penalty != 1.0
+                      for _, req in group)
+        seen = np.zeros((gp, vocab), bool) if any_rep else None
         for row, (si, req) in enumerate(group):
             s = int(req.prompt.size)
             ids[row, :s] = req.prompt
             slots[row, :s] = [cache.extend(req.req_id)
                               for _ in range(s)]
             last_idx[row] = s - 1
-            temps[row] = req.sampling.temperature
+            sp = req.sampling
+            temps[row] = sp.temperature
+            # engine-level top_k is the default where the request does
+            # not set its own (None); an explicit 0 disables it
+            top_ks[row] = self.top_k if sp.top_k is None else sp.top_k
+            top_ps[row] = sp.top_p
+            reps[row] = sp.repetition_penalty
+            if sp.repetition_penalty != 1.0:
+                seen[row, req.prompt] = True
+        seen_dev = jnp.asarray(seen) if any_rep \
+            else self._zeros_seen(gp, vocab)
         toks, cache.k, cache.v = self._prefill_j(
             self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
             jnp.asarray(slots), jnp.asarray(last_idx),
-            jnp.asarray(temps), self._next_key())
+            jnp.asarray(temps), self._next_key(), jnp.asarray(top_ks),
+            jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
         toks = np.asarray(toks)
         now = time.perf_counter()
         for row, (si, req) in enumerate(group):
@@ -288,11 +420,14 @@ class ServingEngine:
             req.state = "running"
             req.t_first_token = now
             req.out_tokens.append(tok)
+            req.planned = 1
             self.generated_tokens += 1
             self._slots[si] = req
             self._last_tok[si] = tok
+            self._fresh_slots.add(si)
             if self._is_finished(req):
                 self._retire(si)
+        self.time_prefill_s += time.perf_counter() - t0
 
     def _is_finished(self, req: Request) -> bool:
         sp = req.sampling
@@ -304,20 +439,42 @@ class ServingEngine:
         req = self._slots[si]
         req.state = "done"
         req.t_done = time.perf_counter()
-        self.dec.cache.free(req.req_id)
         self._done[req.req_id] = req
         self._slots[si] = None
+        if self._inflight:
+            # an in-flight chunk still reads/writes this request's pages
+            # (it was dispatched assuming continuation): free them only
+            # after the LAST dispatched chunk is fetched
+            self._inflight[-1]["free_after"].append(req.req_id)
+        else:
+            self.dec.cache.free(req.req_id)
 
-    def step(self) -> bool:
-        """One engine iteration: admit, then one scanned decode chunk
-        (chunk_size tokens per slot, one dispatch). Returns True while
-        there is still work."""
-        self._admit()
+    def _zeros_seen(self, rows: int, vocab: int):
+        """Cached device-resident all-False seen mask (per row count)."""
+        cached = self._zeros_seen_cache.get(rows)
+        if cached is None:
+            cached = jnp.zeros((rows, vocab), bool)
+            self._zeros_seen_cache[rows] = cached
+        return cached
+
+    def _rep_active(self) -> bool:
+        return any(r is not None and
+                   r.sampling.repetition_penalty != 1.0
+                   for r in self._slots)
+
+    def _dispatch_chunk(self) -> bool:
+        """Dispatch ONE decode chunk for the current active slots
+        without waiting for the previous chunk: first tokens of
+        continuing slots are gathered from the in-flight chunk's DEVICE
+        output (no host round trip); freshly admitted slots take their
+        prefill token from the host."""
+        t0 = time.perf_counter()
         cache = self.dec.cache
         active = [si for si in range(self.max_b)
                   if self._slots[si] is not None]
         if not active:
-            return self.has_work
+            self.time_host_s += time.perf_counter() - t0
+            return False
         T, mb, mp = self.chunk, self.max_b, self.dec.max_pages
         # host-precomputed page schedule: slots past their token budget
         # (or inactive) aim at the scratch page for the rest of the chunk
@@ -325,38 +482,131 @@ class ServingEngine:
         ctx = np.zeros((T, mb), np.int32)
         slots = np.full((T, mb), self._scratch_slot, np.int32)
         temps = np.zeros(mb, np.float32)
-        remaining = {}
+        top_ks = np.zeros(mb, np.int32)
+        top_ps = np.ones(mb, np.float32)
+        reps = np.ones(mb, np.float32)
+        vocab = self.dec.cfg.vocab_size
+        rich = False
+        steps_of: Dict[int, int] = {}
+        reqs_of: Dict[int, Request] = {}
         for si in active:
             req = self._slots[si]
-            temps[si] = req.sampling.temperature
-            remaining[si] = (req.sampling.max_new_tokens
-                             - len(req.out_tokens))
-            for t in range(min(T, remaining[si])):
+            sp = req.sampling
+            temps[si] = sp.temperature
+            top_ks[si] = self.top_k if sp.top_k is None else sp.top_k
+            top_ps[si] = sp.top_p
+            reps[si] = sp.repetition_penalty
+            rich = rich or sp.needs_rich_sampling
+            # budget at DISPATCH time: tokens planned (dispatched), not
+            # tokens fetched — EOS cuts are discovered at collection
+            steps = max(0, min(T, sp.max_new_tokens - req.planned))
+            req.planned += steps
+            steps_of[si] = steps
+            reqs_of[si] = req
+            for t in range(steps):
                 ctx[t, si] = cache.context_len(req.req_id)
                 slots[t, si] = cache.extend(req.req_id)
             # one table per slot per chunk: after the extends above the
             # block list is final for the whole chunk, and entries past
             # a step's context length are masked by ctx anyway
             tables[:, si, :] = cache.block_table(req.req_id, mp)[None]
+        if all(s == 0 for s in steps_of.values()):
+            # every active slot is budget-drained and just awaiting
+            # collection — nothing to run
+            self.time_host_s += time.perf_counter() - t0
+            return False
+
+        # first tokens: device gather from the newest in-flight chunk
+        # for continuing slots, host values for fresh/0-step slots
+        if self._inflight:
+            prev = self._inflight[-1]
+            last_idx = np.zeros(mb, np.int32)
+            override = np.asarray(self._last_tok, np.int32).copy()
+            use_host = np.ones(mb, bool)
+            for si in active:
+                psteps = prev["steps"].get(si, 0)
+                if (psteps > 0 and si not in self._fresh_slots
+                        and prev["reqs"].get(si) is reqs_of[si]):
+                    use_host[si] = False
+                    last_idx[si] = psteps - 1
+            first_ids = self._merge_first_j(
+                prev["toks"], jnp.asarray(last_idx),
+                jnp.asarray(override), jnp.asarray(use_host))
+        else:
+            first_ids = jnp.asarray(self._last_tok)
+        self._fresh_slots.clear()
+
         keys = jax.random.split(self._next_key(), T)
-        toks, cache.k, cache.v = self._decode_j(
-            self.dec.weights, cache.k, cache.v,
-            jnp.asarray(self._last_tok), jnp.asarray(tables),
-            jnp.asarray(ctx), jnp.asarray(slots), jnp.asarray(temps),
-            keys)
-        toks = np.asarray(toks)                 # [mb, T]
-        self.decode_steps += T
-        for si in active:
-            req = self._slots[si]
-            for t in range(min(T, remaining[si])):
+        if rich:
+            if any(reqs_of[si].sampling.repetition_penalty != 1.0
+                   for si in active):
+                seen = np.zeros((mb, vocab), bool)
+                for si in active:
+                    req = reqs_of[si]
+                    if req.sampling.repetition_penalty != 1.0:
+                        seen[si, req.prompt] = True
+                        if req.out_tokens:
+                            seen[si, np.asarray(req.out_tokens)] = True
+                seen_dev = jnp.asarray(seen)
+            else:
+                # top_k/top_p-only chunk: the mask is multiplied by
+                # (rep != 1) == False in-program — reuse a cached
+                # device-resident zeros mask instead of shipping
+                # [mb, vocab] bools through the tunnel every chunk
+                seen_dev = self._zeros_seen(mb, vocab)
+            toks, cache.k, cache.v = self._decode_rich_j(
+                self.dec.weights, cache.k, cache.v, first_ids,
+                jnp.asarray(tables), jnp.asarray(ctx),
+                jnp.asarray(slots), jnp.asarray(temps), keys,
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(reps), seen_dev)
+        else:
+            toks, cache.k, cache.v = self._decode_j(
+                self.dec.weights, cache.k, cache.v, first_ids,
+                jnp.asarray(tables), jnp.asarray(ctx),
+                jnp.asarray(slots), jnp.asarray(temps), keys)
+        self._inflight.append({"toks": toks, "steps": steps_of,
+                               "reqs": reqs_of, "T": T,
+                               "free_after": []})
+        self.time_host_s += time.perf_counter() - t0
+        return True
+
+    def _collect_oldest(self):
+        """Fetch and process the oldest in-flight chunk (the only
+        host-blocking point of the decode path)."""
+        ch = self._inflight.popleft()
+        t0 = time.perf_counter()
+        toks = np.asarray(ch["toks"])              # [mb, T] — blocks
+        self.time_stall_s += time.perf_counter() - t0
+        self.decode_steps += ch["T"]
+        for si, steps in ch["steps"].items():
+            req = ch["reqs"][si]
+            if req.state != "running":
+                continue       # retired while this chunk was in flight
+            for t in range(steps):
                 tok = int(toks[si, t])
                 req.out_tokens.append(tok)
                 self.generated_tokens += 1
                 self._last_tok[si] = tok
                 if self._is_finished(req):
-                    break  # mid-chunk EOS: discard the tail
-            if self._is_finished(req):
+                    break      # mid-chunk EOS: discard the tail
+            if self._is_finished(req) and self._slots[si] is req:
                 self._retire(si)
+        for rid in ch["free_after"]:
+            self.dec.cache.free(rid)
+
+    def step(self) -> bool:
+        """One engine iteration: admit, dispatch the next decode chunk,
+        then collect down to the pipeline depth (1 chunk stays in
+        flight in overlap mode, so host admission/bookkeeping runs
+        while the device decodes). Returns True while there is still
+        work."""
+        self._admit()
+        dispatched = self._dispatch_chunk()
+        depth = 1 if (dispatched and self.overlap
+                      and not self._rep_active()) else 0
+        while len(self._inflight) > depth:
+            self._collect_oldest()
         return self.has_work
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
@@ -403,6 +653,19 @@ class ServingEngine:
                 self.add_request(np.ones(plen, np.int32),
                                  SamplingParams(max_new_tokens=2))
             self.run_to_completion()
+        # rich-sampling decode program (one per engine, bucket-
+        # independent): top_k=1 is greedy, so this throwaway request is
+        # deterministic but routes through _decode_rich_j. It spans
+        # MULTIPLE decode chunks so the overlap-mode _merge_first_j
+        # (chunk-to-chunk first-token gather) compiles here too.
+        self.add_request(np.ones(plens[0], np.int32),
+                         SamplingParams(max_new_tokens=self.chunk + 2,
+                                        temperature=1.0, top_k=1))
+        self.run_to_completion()
+        # ... and the PLAIN multi-chunk path (merge over _decode_j)
+        self.add_request(np.ones(plens[0], np.int32),
+                         SamplingParams(max_new_tokens=self.chunk + 2))
+        self.run_to_completion()
         self.clear_finished()
 
     def clear_finished(self):
@@ -411,6 +674,9 @@ class ServingEngine:
         self._done.clear()
         self.decode_steps = 0
         self.generated_tokens = 0
+        self.time_prefill_s = 0.0
+        self.time_stall_s = 0.0
+        self.time_host_s = 0.0
 
     def stats(self) -> dict:
         """Latency/throughput summary over finished requests."""
@@ -432,4 +698,10 @@ class ServingEngine:
             "latency_p99_s": pct(lats, 0.99),
             "ttft_p50_s": pct(ttfts, 0.50),
             "ttft_p99_s": pct(ttfts, 0.99),
+            # where the wall time went (bench breakdown): prefill
+            # dispatch+fetch, blocking decode-chunk fetches (device-
+            # bound stall), host scheduling/bookkeeping
+            "time_prefill_s": self.time_prefill_s,
+            "time_decode_stall_s": self.time_stall_s,
+            "time_host_s": self.time_host_s,
         }

@@ -45,9 +45,25 @@ class LlamaConfig:
     tie_word_embeddings: bool = False
     dtype: str = "float32"
     use_recompute: bool = False
+    # reference recompute_granularity (PaddleNLP llama configs):
+    # "full"      — whole block rematerialized (max memory savings)
+    # "full_attn" — only the attention sublayer (ln1 + attn)
+    #               rematerialized; MLP activations stored. The middle
+    #               ground that keeps most of the no-remat MFU
+    # "core_attn" — only the attention inner (scores/softmax/context)
+    #               recomputed. With the Pallas flash kernel this is the
+    #               plain forward: flash backward already recomputes
+    #               from q/k/v instead of storing probabilities
+    recompute_granularity: str = "full"
     # parallelism knobs (consumed when a fleet mesh is active)
     tensor_parallel: bool = False
     sequence_parallel: bool = False
+    # context parallelism (reference hybrid_configs sep_degree,
+    # fleet/base/topology.py:497 + meta_parallel/segment_parallel.py):
+    # >1 = training attention runs zigzag ring attention over the
+    # fleet mesh's 'sep' axis (must match its size); the sequence dim
+    # of q/k/v shards across the ring, KV blocks rotate over ICI
+    sep_degree: int = 1
     # >0: forward() returns hidden states and loss() computes the head
     # matmul + cross entropy in chunks of this many tokens under
     # jax.checkpoint (training-memory config; generate() still works —
@@ -59,6 +75,26 @@ def _mp_active() -> bool:
     from ..distributed.fleet import get_hybrid_communicate_group
     hcg = get_hybrid_communicate_group()
     return hcg is not None and hcg.get_model_parallel_world_size() > 1
+
+
+def _sep_mesh(sep_degree: int):
+    """The fleet mesh, when CP is requested and the mesh has a 'sep'
+    axis of the configured size (loud on mismatch)."""
+    if sep_degree <= 1:
+        return None
+    from ..distributed.fleet import get_hybrid_communicate_group
+    hcg = get_hybrid_communicate_group()
+    if hcg is None:
+        return None                      # single-device runs/tests
+    mesh = hcg.mesh
+    if "sep" not in mesh.dim_names or \
+            mesh.get_dim_size("sep") != sep_degree:
+        raise ValueError(
+            f"sep_degree={sep_degree} needs a fleet mesh with a 'sep' "
+            f"axis of that size; got {mesh.dim_names} "
+            f"{[mesh.get_dim_size(a) for a in mesh.dim_names]} — set "
+            "hybrid_configs sep_degree")
+    return mesh
 
 
 class LlamaMLP(nn.Layer):
@@ -130,6 +166,7 @@ class LlamaAttention(nn.Layer):
             self.v_proj = nn.Linear(cfg.hidden_size, kv_out, bias_attr=False)
             self.o_proj = nn.Linear(q_out, cfg.hidden_size, bias_attr=False)
         self.rope_theta = cfg.rope_theta
+        self.sep_degree = cfg.sep_degree
 
     def forward(self, x, rope_cos=None, rope_sin=None, past_kv=None,
                 pos=None):
@@ -164,7 +201,20 @@ class LlamaAttention(nn.Layer):
                                     sin.astype(ka.dtype))
                 return qo, ko
             q, k = apply("fused_rope", rope_fn, q, k)
-            out = F.scaled_dot_product_attention(q, k, v, is_causal=True)
+            sep_mesh = _sep_mesh(self.sep_degree)
+            if sep_mesh is not None:
+                # context parallelism: zigzag ring attention over the
+                # 'sep' axis (sequence sharded, KV rotates the ring);
+                # dp/mp compose as GSPMD auto axes around it
+                from ..distributed.ring_attention import ring_attention
+                out = apply(
+                    "ring_attention",
+                    lambda qa, ka, va: ring_attention(
+                        qa, ka, va, sep_mesh, axis="sep", causal=True),
+                    q, k, v)
+            else:
+                out = F.scaled_dot_product_attention(q, k, v,
+                                                     is_causal=True)
             out = out.reshape([b, s, self.num_heads * self.head_dim])
             if self._tp:
                 from ..distributed.fleet.mpu import _constrain, _get_mesh
@@ -219,6 +269,7 @@ class LlamaDecoderLayer(nn.Layer):
                                                    dtype=cfg.dtype)
         self.mlp = LlamaMLP(cfg)
         self.use_recompute = cfg.use_recompute
+        self.recompute_granularity = cfg.recompute_granularity
 
     def _block(self, x):
         h = x + self.self_attn(self.input_layernorm(x))
@@ -232,7 +283,19 @@ class LlamaDecoderLayer(nn.Layer):
             return h + self.mlp(self.post_attention_layernorm(h)), new_kv
         if self.use_recompute:
             from ..distributed.fleet import recompute
-            return recompute(_LayerFn(self), x)
+            gran = self.recompute_granularity
+            if gran == "full":
+                return recompute(_LayerFn(self), x)
+            if gran == "full_attn":
+                h = x + recompute(_AttnFn(self), x)
+                return h + self.mlp(self.post_attention_layernorm(h))
+            if gran == "core_attn":
+                # flash backward recomputes scores/probs from q/k/v by
+                # construction — the plain forward IS core_attn remat
+                return self._block(x)
+            raise ValueError(
+                f"unknown recompute_granularity {gran!r}; expected "
+                "'full', 'full_attn' or 'core_attn'")
         return self._block(x)
 
 
@@ -247,6 +310,21 @@ class _LayerFn:
 
     def __call__(self, x):
         return self.layer._block(x)
+
+
+class _AttnFn:
+    """recompute_granularity='full_attn': the rematerialized region is
+    ln1 + attention (the residual add and MLP stay stored)."""
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def parameters(self):
+        return (list(self.layer.input_layernorm.parameters())
+                + list(self.layer.self_attn.parameters()))
+
+    def __call__(self, x):
+        return self.layer.self_attn(self.layer.input_layernorm(x))
 
 
 class LlamaModel(nn.Layer):

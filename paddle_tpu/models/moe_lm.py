@@ -37,6 +37,10 @@ class MoEConfig:
     num_shared_experts: int = 1          # DeepSeek-style dense experts
     first_k_dense_replace: int = 1       # first k layers use dense MLP
     capacity_factor: float = 1.25
+    # 'dense' = GShard one-hot dispatch (EP-shardable); 'ragged' =
+    # sort-based dropless grouped-matmul dispatch (the large-E on-chip
+    # path — memory O(T*k*D) instead of O(T*E*C))
+    moe_dispatch_mode: str = "dense"
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-6
     rope_theta: float = 10000.0
@@ -81,7 +85,8 @@ class MoEBlock(nn.Layer):
             d_hidden=cfg.moe_intermediate_size,
             num_experts=cfg.num_experts, gate="gshard",
             top_k=cfg.num_experts_per_tok,
-            capacity_factor=cfg.capacity_factor)
+            capacity_factor=cfg.capacity_factor,
+            dispatch_mode=cfg.moe_dispatch_mode)
         self.shared = _DenseMLP(
             cfg.hidden_size,
             cfg.moe_intermediate_size * cfg.num_shared_experts,

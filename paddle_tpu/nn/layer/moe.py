@@ -43,8 +43,15 @@ class MoELayer(Layer):
     def __init__(self, d_model: int, d_hidden: int, num_experts: int,
                  gate="gshard", top_k: int = 2,
                  capacity_factor: float = 1.25, activation="gelu",
-                 ep_axis: str = "ep", name=None):
+                 ep_axis: str = "ep", name=None,
+                 dispatch_mode: str = "dense"):
         super().__init__()
+        if dispatch_mode not in ("dense", "ragged"):
+            raise ValueError(
+                f"dispatch_mode must be 'dense' (GShard one-hot, "
+                f"EP-shardable) or 'ragged' (sort-based dropless, the "
+                f"large-E on-chip path); got {dispatch_mode!r}")
+        self.dispatch_mode = dispatch_mode
         self.d_model = d_model
         self.d_hidden = d_hidden
         self.num_experts = num_experts
@@ -99,18 +106,30 @@ class MoELayer(Layer):
             self._mesh.to_jax_mesh(), jax.sharding.PartitionSpec(*spec))
 
     def forward(self, x):
-        from ...ops.moe import moe_dispatch_combine
+        from ...ops.moe import moe_dispatch_combine, moe_ragged_forward
         act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu,
                "silu": jax.nn.silu}[self._act_name]
         ep_sharding = self._ep_sharding()
+        ragged = self.dispatch_mode == "ragged"
+        if ragged and ep_sharding is not None:
+            raise NotImplementedError(
+                "dispatch_mode='ragged' cannot shard over an expert-"
+                "parallel mesh axis (segment sizes are data-dependent); "
+                "use dispatch_mode='dense' under EP")
 
         def f(xa, gw, w1, w2):
-            out, aux, stats = moe_dispatch_combine(
-                xa, gw, w1, w2, self.top_k, self.capacity_factor, act,
-                ep_sharding)
+            if ragged:
+                out, aux, stats = moe_ragged_forward(
+                    xa, gw, w1, w2, self.top_k, act)
+                cap = jnp.float32(0.0)       # dropless: no capacity
+            else:
+                out, aux, stats = moe_dispatch_combine(
+                    xa, gw, w1, w2, self.top_k, self.capacity_factor,
+                    act, ep_sharding)
+                cap = stats["capacity"]
             return (out, aux, stats["tokens_per_expert"],
                     stats["assigned_per_expert"],
-                    stats["dropped_fraction"], stats["capacity"])
+                    stats["dropped_fraction"], cap)
 
         out, aux, routed, assigned, dropped, cap = apply(
             "moe", f, x, self.gate_weight, self.w1, self.w2)

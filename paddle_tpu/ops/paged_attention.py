@@ -89,16 +89,22 @@ class PagedKVCache:
     """
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
-                 kv_heads: int, head_dim: int, dtype=jnp.float32):
+                 kv_heads: int, head_dim: int, dtype=jnp.float32,
+                 kv_sharding=None):
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
         # per-layer pools as a LIST pytree: updating one layer swaps a
         # list element — no [L, ...] slice/update copies in the compiled
-        # decode step
+        # decode step. kv_sharding (a NamedSharding over the kv-head
+        # dim) places the pool for tensor-parallel serving.
         self.k = [jnp.zeros((num_blocks, kv_heads, block_size, head_dim),
                             dtype) for _ in range(num_layers)]
         self.v = [jnp.zeros_like(self.k[0]) for _ in range(num_layers)]
+        if kv_sharding is not None:
+            import jax
+            self.k = [jax.device_put(a, kv_sharding) for a in self.k]
+            self.v = [jax.device_put(a, kv_sharding) for a in self.v]
         self._free = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id → [block ids]
         self._lens: dict = {}     # seq_id → context length

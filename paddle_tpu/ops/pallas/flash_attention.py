@@ -50,6 +50,17 @@ def _on_tpu() -> bool:
     except Exception:
         return False
 
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct carrying `like`'s varying-manual-axes tag: when
+    a kernel runs inside a check_vma shard_map (e.g. ring attention
+    manual over 'sep' with dp/mp auto), pallas_call demands the output
+    vma be stated explicitly — propagate it from an input operand."""
+    vma = getattr(getattr(like, "aval", None), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
 DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 _NEG_INF = -1e30
@@ -152,8 +163,8 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k):
             pl.BlockSpec((1, 1, bq, 1), lambda bi, hi, qi: (bi, hi, qi, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b, h, sq, 1), jnp.float32),
+            _sds((b, h, sq, d), q.dtype, q),
+            _sds((b, h, sq, 1), jnp.float32, q),
         ],
         interpret=_interpret(),
     )(*args)
@@ -161,7 +172,7 @@ def _flash_fwd(q, k, v, q_seg, kv_seg, causal, scale, block_q, block_k):
 
 
 def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_q, seq_k,
-                   segmented):
+                   segmented, q_base, k_base):
     if segmented:
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, qseg_ref,
          kseg_ref, dq_ref) = refs
@@ -177,11 +188,14 @@ def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_q, seq_k,
     if segmented:
         qseg = qseg_ref[0]
 
+    # q_base/k_base: GLOBAL sequence positions of this call's first
+    # query/key row — the wrapper may be feeding a [q-chunk, k-chunk]
+    # slice of a longer sequence (VMEM-bounded long-seq backward)
     num_kv = pl.cdiv(seq_k, block_k)
-    off = seq_k - seq_q
     if causal:
-        num_kv_run = jnp.maximum(
-            jax.lax.div(q_offset + bq - 1 + off, block_k) + 1, 0)
+        num_kv_run = jnp.clip(
+            jax.lax.div(q_base + q_offset + bq - 1 - k_base, block_k)
+            + 1, 0, num_kv)
     else:
         num_kv_run = num_kv
 
@@ -191,9 +205,10 @@ def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_q, seq_k,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = q_offset + off + \
+            rows = q_base + q_offset + \
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = kj * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            cols = k_base + kj * block_k + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if segmented:
             kseg = kseg_ref[0, pl.ds(kj * block_k, block_k)]
@@ -212,7 +227,7 @@ def _bwd_dq_kernel(*refs, scale, causal, block_k, seq_q, seq_k,
 
 
 def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_k, group,
-                    segmented):
+                    segmented, q_base, k_base):
     """Grid (b, hk, n_kblocks, group): the innermost `group` dimension
     revisits the same dk/dv output block, accumulating the kv-head's query
     group in VMEM (GQA without expanding K/V or group-partial HBM writes)."""
@@ -232,10 +247,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_k, group,
         kseg = kseg_ref[0, pl.ds(k_offset, bk)]
 
     num_q = pl.cdiv(seq_q, block_q)
-    off = seq_k - seq_q
     if causal:
-        # first q block whose END position (q + off) can see this k block
-        first_q = jax.lax.div(jnp.maximum(k_offset - off, 0), block_q)
+        # first q block whose END global position can see this k block
+        first_q = jax.lax.div(
+            jnp.maximum(k_base + k_offset - q_base, 0), block_q)
     else:
         first_q = 0
 
@@ -248,9 +263,10 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_k, group,
         s = jax.lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
-            rows = qi * block_q + off + \
+            rows = q_base + qi * block_q + \
                 jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-            cols = k_offset + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            cols = k_base + k_offset + \
+                jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             s = jnp.where(rows >= cols, s, _NEG_INF)
         if segmented:
             qseg = qseg_ref[0, pl.ds(qi * block_q, block_q)]
@@ -282,19 +298,13 @@ def _bwd_dkv_kernel(*refs, scale, causal, block_q, seq_q, seq_k, group,
         dv_ref[0, 0] += dv
 
 
-def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
-               block_q, block_k):
-    """q/do [b,h,sq,d]; k/v [b,hk,sk,d] (NOT expanded). Returns dq [b,h,..]
-    and group-summed dk/dv [b,hk,sk,d] (float32)."""
+def _bwd_pair_call(q, k, v, do, lse4, delta, q_seg, kv_seg, causal,
+                   scale, bq, bk, group, q_base, k_base, dq_dtype):
+    """dq + dk/dv pallas calls for one (q-slice, k-slice) pair whose
+    first rows sit at GLOBAL positions q_base/k_base."""
     b, h, sq, d = q.shape
     hk, sk = k.shape[1], k.shape[2]
-    group = h // hk
-    bq = min(block_q, sq)
-    bk = min(block_k, sk)
     segmented = q_seg is not None
-    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
-                    axis=-1)[..., None]                      # [b,h,sq,1]
-    lse4 = lse[..., None]                                    # [b,h,sq,1]
 
     dq_specs = [
         pl.BlockSpec((1, 1, bq, d), lambda bi, hi, qi: (bi, hi, qi, 0)),
@@ -316,12 +326,13 @@ def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           block_k=bk, seq_q=sq, seq_k=sk,
-                          segmented=segmented),
+                          segmented=segmented, q_base=q_base,
+                          k_base=k_base),
         grid=(b, h, pl.cdiv(sq, bq)),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, 1, bq, d),
                                lambda bi, hi, qi: (bi, hi, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((b, h, sq, d), q.dtype),
+        out_shape=_sds((b, h, sq, d), dq_dtype, q),
         interpret=_interpret(),
     )(*dq_args)
 
@@ -347,7 +358,8 @@ def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=bq, seq_q=sq, seq_k=sk, group=group,
-                          segmented=segmented),
+                          segmented=segmented, q_base=q_base,
+                          k_base=k_base),
         grid=(b, hk, pl.cdiv(sk, bk), group),
         in_specs=dkv_specs,
         out_specs=[
@@ -357,12 +369,66 @@ def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
                          lambda bi, hki, kj, g: (bi, hki, kj, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32),
-            jax.ShapeDtypeStruct((b, hk, sk, d), jnp.float32),
+            _sds((b, hk, sk, d), jnp.float32, q),
+            _sds((b, hk, sk, d), jnp.float32, q),
         ],
         interpret=_interpret(),
     )(*dkv_args)
     return dq, dk, dv
+
+
+# backward VMEM story: each dq call holds its k-slice (and each dkv call
+# its q-slice) whole in VMEM, so slices past ~2k at d=128 blow the
+# ~16MB scoped-vmem budget (measured: a 4096 slice needs 16.6MB).
+# Above this length the wrapper tiles the backward into
+# [q-chunk, k-chunk] pair calls (global offsets keep the causal mask
+# exact; fully-invisible pairs are skipped outright).
+BWD_SEQ_CHUNK = 2048
+
+
+def _flash_bwd(q, k, v, out, lse, do, q_seg, kv_seg, causal, scale,
+               block_q, block_k):
+    """q/do [b,h,sq,d]; k/v [b,hk,sk,d] (NOT expanded). Returns dq [b,h,..]
+    and group-summed dk/dv [b,hk,sk,d] (float32)."""
+    b, h, sq, d = q.shape
+    hk, sk = k.shape[1], k.shape[2]
+    group = h // hk
+    bq = min(block_q, sq)
+    bk = min(block_k, sk)
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1)[..., None]                      # [b,h,sq,1]
+    lse4 = lse[..., None]                                    # [b,h,sq,1]
+
+    cs = BWD_SEQ_CHUNK
+    base = sk - sq     # causal aligns queries to the END of the keys
+    if sq <= cs and sk <= cs:
+        return _bwd_pair_call(q, k, v, do, lse4, delta, q_seg, kv_seg,
+                              causal, scale, bq, bk, group,
+                              q_base=base, k_base=0, dq_dtype=q.dtype)
+
+    dq = jnp.zeros((b, h, sq, d), jnp.float32)
+    dk = jnp.zeros((b, hk, sk, d), jnp.float32)
+    dv = jnp.zeros((b, hk, sk, d), jnp.float32)
+    for q0 in range(0, sq, cs):
+        qe = min(q0 + cs, sq)
+        for k0 in range(0, sk, cs):
+            ke = min(k0 + cs, sk)
+            if causal and k0 > base + qe - 1:
+                continue                       # fully invisible pair
+            pair_causal = causal and (ke - 1 > base + q0)
+            dq_p, dk_p, dv_p = _bwd_pair_call(
+                q[:, :, q0:qe], k[:, :, k0:ke], v[:, :, k0:ke],
+                do[:, :, q0:qe], lse4[:, :, q0:qe],
+                delta[:, :, q0:qe],
+                None if q_seg is None else q_seg[:, q0:qe],
+                None if kv_seg is None else kv_seg[:, k0:ke],
+                pair_causal, scale, min(bq, qe - q0),
+                min(bk, ke - k0), group,
+                q_base=base + q0, k_base=k0, dq_dtype=jnp.float32)
+            dq = dq.at[:, :, q0:qe].add(dq_p)
+            dk = dk.at[:, :, k0:ke].add(dk_p)
+            dv = dv.at[:, :, k0:ke].add(dv_p)
+    return dq.astype(q.dtype), dk, dv
 
 
 # ---------------------------------------------------------------------------

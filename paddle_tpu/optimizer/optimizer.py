@@ -244,20 +244,35 @@ class Adam(Optimizer):
     def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
                  epsilon=1e-8, parameters=None, weight_decay=None,
                  grad_clip=None, lazy_mode=False, multi_precision=True,
-                 name=None, amsgrad=False):
+                 name=None, amsgrad=False, moment_dtype=None):
         super().__init__(learning_rate, parameters, weight_decay, grad_clip,
                          name, multi_precision)
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
         self._amsgrad = amsgrad
+        # moment_dtype: storage dtype for m/v (None = master dtype).
+        # 'bfloat16' halves optimizer-state HBM — the arithmetic stays
+        # f32 (moments cast up on read, down on write), so only the
+        # STORED moments are rounded. On a 16 GB chip this is what lets
+        # a ~1B AdamW model trade remat for stored activations.
+        self._moment_dtype = None if moment_dtype is None else \
+            jnp.dtype(moment_dtype) if not isinstance(moment_dtype, str) \
+            else {"bfloat16": jnp.bfloat16, "float32": jnp.float32,
+                  "float16": jnp.float16}[moment_dtype]
+
+    def _moment_zeros(self, p):
+        # zeros_like: the moment inherits the master's SHARDING (a
+        # plain zeros would replicate sharded optimizer state)
+        mp = self._master(p)
+        return jnp.zeros_like(mp, dtype=self._moment_dtype or mp.dtype)
 
     def _init_state_impl(self, params):
         st = {"step": jnp.zeros((), jnp.int32),
-              "m": [jnp.zeros_like(self._master(p)) for p in params],
-              "v": [jnp.zeros_like(self._master(p)) for p in params]}
+              "m": [self._moment_zeros(p) for p in params],
+              "v": [self._moment_zeros(p) for p in params]}
         if self._amsgrad:
-            st["vmax"] = [jnp.zeros_like(self._master(p)) for p in params]
+            st["vmax"] = [self._moment_zeros(p) for p in params]
         return st
 
     def _update_impl(self, params, grads, state, lr):
@@ -282,13 +297,15 @@ class Adam(Optimizer):
             if not self._decoupled_wd:
                 g = _wd_grad(p, g, self._weight_decay)
             g32 = g.astype(mp.dtype)
-            m_s = b1 * m_s + (1 - b1) * g32
-            v_s = b2 * v_s + (1 - b2) * jnp.square(g32)
+            store_dt = m_s.dtype
+            m_s = b1 * m_s.astype(g32.dtype) + (1 - b1) * g32
+            v_s = b2 * v_s.astype(g32.dtype) + (1 - b2) * jnp.square(g32)
             m_hat = m_s / bc1
             v_hat = v_s / bc2
             if self._amsgrad:
-                vm = jnp.maximum(state["vmax"][i], v_hat)
-                new_vmax.append(vm)
+                vm = jnp.maximum(state["vmax"][i].astype(g32.dtype),
+                                 v_hat)
+                new_vmax.append(vm.astype(store_dt))
                 denom = jnp.sqrt(vm) + eps
             else:
                 denom = jnp.sqrt(v_hat) + eps
@@ -306,8 +323,8 @@ class Adam(Optimizer):
                 mp = mp * (1.0 - lr.astype(mp.dtype) * wd)
             mp = mp - lr.astype(mp.dtype) * upd
             new_params.append(mp.astype(p.dtype))
-            new_m.append(m_s)
-            new_v.append(v_s)
+            new_m.append(m_s.astype(store_dt))
+            new_v.append(v_s.astype(store_dt))
         out_state = {"step": t, "m": new_m, "v": new_v}
         if self._amsgrad:
             out_state["vmax"] = new_vmax
@@ -323,10 +340,10 @@ class AdamW(Adam):
                  epsilon=1e-8, parameters=None, weight_decay=0.01,
                  lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
                  lazy_mode=False, multi_precision=True, name=None,
-                 amsgrad=False):
+                 amsgrad=False, moment_dtype=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          weight_decay, grad_clip, lazy_mode, multi_precision,
-                         name, amsgrad)
+                         name, amsgrad, moment_dtype=moment_dtype)
         self._apply_decay_param_fun = apply_decay_param_fun
         # static per-param decay mask (True = apply decay), from param names
         if apply_decay_param_fun is not None:
@@ -539,6 +556,12 @@ class Lamb(Optimizer):
                 "m": [jnp.zeros_like(self._master(p)) for p in params],
                 "v": [jnp.zeros_like(self._master(p)) for p in params]}
 
+    def _trust_norm_source(self, mp, p):
+        """Array the layer-wise trust ratio norms are taken over
+        (DistributedFusedLamb's use_master_param_norm=False overrides
+        this to use the low-precision weights)."""
+        return mp
+
     def _update_impl(self, params, grads, state, lr):
         grads = self._apply_clip_and_decay(params, grads)
         b1, b2, eps = self._beta1, self._beta2, self._epsilon
@@ -564,7 +587,8 @@ class Lamb(Optimizer):
                     self._parameter_list[i]):
                 wd = 0.0
             r = r + wd * mp
-            w_norm = jnp.sqrt(jnp.sum(jnp.square(mp)))
+            nsrc = self._trust_norm_source(mp, p)
+            w_norm = jnp.sqrt(jnp.sum(jnp.square(nsrc)))
             r_norm = jnp.sqrt(jnp.sum(jnp.square(r)))
             trust = jnp.where((w_norm > 0) & (r_norm > 0),
                               w_norm / r_norm, 1.0)

@@ -167,3 +167,73 @@ class TestLookAhead:
             opt.clear_grad()
             losses.append(float(n(loss)))
         assert losses[-1] < losses[0] * 0.2
+
+
+class TestDistributedFusedLamb:
+    """VERDICT r3 #9 (reference incubate/optimizer/
+    distributed_fused_lamb.py): sharded-LAMB semantics over GSPMD."""
+
+    def _setup(self, **kw):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        paddle.seed(0)
+        model = nn.Linear(8, 8)
+        opt = DistributedFusedLamb(learning_rate=0.01,
+                                   parameters=model.parameters(), **kw)
+        return model, opt
+
+    def _grad_step(self, model, opt, scale=1.0):
+        import paddle_tpu as paddle
+        x = paddle.to_tensor(np.ones((4, 8), np.float32) * scale)
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+
+    def test_matches_plain_lamb(self):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer as optim
+        paddle.seed(0)
+        m1 = nn.Linear(8, 8)
+        paddle.seed(0)
+        m2 = nn.Linear(8, 8)
+        o1 = optim.Lamb(learning_rate=0.01, parameters=m1.parameters())
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        o2 = DistributedFusedLamb(learning_rate=0.01,
+                                  parameters=m2.parameters())
+        for m, o in ((m1, o1), (m2, o2)):
+            self._grad_step(m, o)
+        np.testing.assert_allclose(np.asarray(m1.weight._value),
+                                   np.asarray(m2.weight._value),
+                                   rtol=1e-6)
+
+    def test_grad_accumulation_means_micros(self):
+        m_acc, o_acc = self._setup(gradient_accumulation_steps=2)
+        w0 = np.asarray(m_acc.weight._value).copy()
+        self._grad_step(m_acc, o_acc, scale=1.0)   # buffered, no update
+        np.testing.assert_allclose(np.asarray(m_acc.weight._value), w0)
+        self._grad_step(m_acc, o_acc, scale=3.0)   # applies mean grad
+        assert not np.allclose(np.asarray(m_acc.weight._value), w0)
+        # equivalent single step on the mean input gradient
+        m_ref, o_ref = self._setup()
+        self._grad_step(m_ref, o_ref, scale=2.0)   # mean of 1 and 3
+        np.testing.assert_allclose(np.asarray(m_acc.weight._value),
+                                   np.asarray(m_ref.weight._value),
+                                   rtol=1e-5, atol=1e-7)
+
+    def test_clip_before_allreduce_is_loud(self):
+        from paddle_tpu.incubate.optimizer import DistributedFusedLamb
+        with pytest.raises(NotImplementedError, match="allreduce"):
+            DistributedFusedLamb(clip_after_allreduce=False,
+                                 parameters=[])
+
+    def test_unscaled_grads_divided_by_world_size(self):
+        # single-process world size is 1 -> same result either way, but
+        # the path must execute without error
+        m, o = self._setup(is_grad_scaled_by_nranks=False)
+        self._grad_step(m, o)
+
+    def test_master_param_norm_toggle_runs(self):
+        m, o = self._setup(use_master_param_norm=False)
+        self._grad_step(m, o)

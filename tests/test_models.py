@@ -266,3 +266,40 @@ def test_chunked_ce_gpt_and_moe():
     l_c2 = m_c.loss(m_c(ids2), ids2)
     np.testing.assert_allclose(float(l_d2.numpy()), float(l_c2.numpy()),
                                rtol=1e-4)
+
+
+class TestRecomputeGranularity:
+    """recompute_granularity (reference PaddleNLP llama configs):
+    all granularities are numerically the plain forward — they only
+    change WHAT is stored for backward."""
+
+    def _loss_and_grad(self, gran):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        paddle.seed(11)
+        cfg = llama_tiny(use_recompute=gran is not None,
+                         recompute_granularity=gran or "full")
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(2).randint(
+            0, 512, (2, 16)).astype(np.int32))
+        loss = m.loss(m(ids), ids)
+        loss.backward()
+        g = m.model.layers[0].self_attn.q_proj.weight.grad
+        return float(loss.numpy()), np.asarray(g._value)
+
+    def test_granularities_match_plain(self):
+        l_ref, g_ref = self._loss_and_grad(None)
+        for gran in ("full", "full_attn", "core_attn"):
+            l, g = self._loss_and_grad(gran)
+            np.testing.assert_allclose(l, l_ref, rtol=1e-5)
+            np.testing.assert_allclose(g, g_ref, rtol=1e-3, atol=1e-5)
+
+    def test_unknown_granularity_raises(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        cfg = llama_tiny(use_recompute=True,
+                         recompute_granularity="bogus")
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.zeros((1, 8), np.int32))
+        with pytest.raises(ValueError, match="recompute_granularity"):
+            m(ids)

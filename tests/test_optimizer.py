@@ -189,3 +189,54 @@ class TestReviewRegressions:
                                   dtype="bfloat16"):
             s = paddle.nn.functional.softmax(a.astype("bfloat16"))
         assert s._value.dtype == jnp.bfloat16
+
+
+class TestMomentDtype:
+    """Adam/AdamW moment_dtype='bfloat16' (VERDICT r3 #3: optimizer-state
+    HBM for the ~1B single-chip row): stored moments are bf16, the
+    arithmetic stays f32, updates track the f32-state optimizer."""
+
+    def _train(self, moment_dtype, steps=20):
+        import paddle_tpu as paddle
+        from paddle_tpu import nn, optimizer as optim
+        paddle.seed(0)
+        model = nn.Linear(16, 16)
+        opt = optim.AdamW(learning_rate=0.01,
+                          parameters=model.parameters(),
+                          moment_dtype=moment_dtype)
+        rng = np.random.RandomState(0)
+        x = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        y = paddle.to_tensor(rng.randn(8, 16).astype(np.float32))
+        loss_fn = nn.MSELoss()
+        for _ in range(steps):
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        return model, opt, float(loss.numpy())
+
+    def test_bf16_moments_track_f32(self):
+        _, _, l32 = self._train(None)
+        _, _, lbf = self._train("bfloat16")
+        assert abs(l32 - lbf) < 0.05 * max(abs(l32), 1e-3)
+
+    def test_moment_state_dtype(self):
+        import jax.numpy as jnp
+        model, opt, _ = self._train("bfloat16", steps=1)
+        st = opt._state
+        assert all(m.dtype == jnp.bfloat16 for m in st["m"])
+        assert all(v.dtype == jnp.bfloat16 for v in st["v"])
+
+    def test_amsgrad_moment_dtype(self):
+        import paddle_tpu as paddle
+        import jax.numpy as jnp
+        from paddle_tpu import nn, optimizer as optim
+        model = nn.Linear(4, 4)
+        opt = optim.Adam(learning_rate=0.01,
+                         parameters=model.parameters(),
+                         amsgrad=True, moment_dtype="bfloat16")
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        loss = model(x).sum()
+        loss.backward()
+        opt.step()
+        assert all(v.dtype == jnp.bfloat16 for v in opt._state["vmax"])

@@ -466,3 +466,157 @@ class TestMoEDepth:
         finally:
             import paddle_tpu.distributed.fleet as fm
             fm._hcg = None
+
+
+class TestRaggedMoE:
+    """VERDICT r3 #7: sort-based dropless dispatch beside the dense
+    einsum — parity at E=8 when capacity never drops."""
+
+    def test_ragged_matches_dense_no_drops(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.moe import (moe_dispatch_combine,
+                                        moe_ragged_forward)
+        rng = np.random.RandomState(0)
+        b, s, d, h, e, k = 2, 16, 32, 64, 8, 2
+        x = jnp.asarray(rng.randn(b, s, d), jnp.float32)
+        gw = jnp.asarray(rng.randn(d, e) * 0.1, jnp.float32)
+        w1 = jnp.asarray(rng.randn(e, d, h) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.randn(e, h, d) * 0.1, jnp.float32)
+        # capacity_factor e: every token fits -> dense == ragged
+        out_d, aux_d, st_d = moe_dispatch_combine(x, gw, w1, w2, k,
+                                                  float(e), jax.nn.gelu)
+        out_r, aux_r, st_r = moe_ragged_forward(x, gw, w1, w2, k,
+                                                jax.nn.gelu)
+        assert float(st_d["dropped_fraction"]) == 0.0
+        assert float(st_r["dropped_fraction"]) == 0.0
+        np.testing.assert_allclose(np.asarray(out_r), np.asarray(out_d),
+                                   rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(float(aux_r), float(aux_d), rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(st_r["tokens_per_expert"]),
+                                   np.asarray(st_d["tokens_per_expert"]))
+
+    def test_ragged_is_dropless_under_skew(self):
+        """All tokens route to ONE expert: dense at cf=1 drops most,
+        ragged drops none."""
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.moe import (moe_dispatch_combine,
+                                        moe_ragged_forward)
+        rng = np.random.RandomState(1)
+        b, s, d, h, e, k = 1, 32, 16, 32, 8, 1
+        # positive tokens + one hot gate column: every token routes to
+        # expert 3 deterministically
+        x = jnp.asarray(np.abs(rng.randn(b, s, d)) + 0.1, jnp.float32)
+        gw = jnp.zeros((d, e), jnp.float32).at[:, 3].set(5.0)
+        w1 = jnp.asarray(rng.randn(e, d, h) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.randn(e, h, d) * 0.1, jnp.float32)
+        _, _, st_d = moe_dispatch_combine(x, gw, w1, w2, k, 1.0,
+                                          jax.nn.gelu)
+        _, _, st_r = moe_ragged_forward(x, gw, w1, w2, k, jax.nn.gelu)
+        assert float(st_d["dropped_fraction"]) >= 0.5
+        assert float(st_r["dropped_fraction"]) == 0.0
+        assert float(st_r["tokens_per_expert"][3]) == b * s * k
+
+    def test_ragged_grads_flow(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.ops.moe import moe_ragged_forward
+        rng = np.random.RandomState(2)
+        d, h, e, k = 8, 16, 4, 2
+        x = jnp.asarray(rng.randn(1, 8, d), jnp.float32)
+        gw = jnp.asarray(rng.randn(d, e) * 0.1, jnp.float32)
+        w1 = jnp.asarray(rng.randn(e, d, h) * 0.1, jnp.float32)
+        w2 = jnp.asarray(rng.randn(e, h, d) * 0.1, jnp.float32)
+
+        def loss(w1_, w2_):
+            out, aux, _ = moe_ragged_forward(x, gw, w1_, w2_, k,
+                                             jax.nn.gelu)
+            return jnp.sum(out ** 2) + aux
+
+        g1, g2 = jax.grad(loss, argnums=(0, 1))(w1, w2)
+        assert float(jnp.abs(g1).sum()) > 0
+        assert float(jnp.abs(g2).sum()) > 0
+
+    def test_model_config_selects_ragged(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
+        cfg = MoEConfig(vocab_size=128, hidden_size=32,
+                        intermediate_size=64, moe_intermediate_size=32,
+                        num_hidden_layers=2, num_attention_heads=4,
+                        num_key_value_heads=4, num_experts=4,
+                        max_position_embeddings=64,
+                        moe_dispatch_mode="ragged")
+        m = MoEForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(0).randint(
+            0, 128, (2, 12)).astype(np.int32))
+        loss = m.loss(m(ids), ids)
+        loss.backward()
+        lyr = m.model.layers[-1].mlp.moe
+        assert lyr.dispatch_mode == "ragged"
+        assert lyr.w1.grad is not None
+
+    def test_ragged_under_ep_mesh_is_loud(self):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.fleet as fleet_mod
+        from paddle_tpu import nn
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = {"dp_degree": 2, "mp_degree": 4,
+                                   "pp_degree": 1}
+        fleet_mod.init(is_collective=True, strategy=strategy)
+        try:
+            with pytest.raises(NotImplementedError, match="ragged"):
+                lyr = nn.MoELayer(16, 32, 4, top_k=2,
+                                  dispatch_mode="ragged")
+                import paddle_tpu as paddle
+                lyr(paddle.to_tensor(
+                    np.zeros((1, 8, 16), np.float32)))
+        finally:
+            fleet_mod._hcg = None
+
+
+class TestLlamaContextParallel:
+    """VERDICT r3 #6: sep_degree in the Llama config drives zigzag ring
+    attention over the fleet mesh's 'sep' axis, composed with dp/mp."""
+
+    def teardown_method(self, _):
+        import paddle_tpu.distributed.fleet as fleet_mod
+        fleet_mod._hcg = None
+
+    def _init_mesh(self, **degrees):
+        import paddle_tpu.distributed as dist
+        import paddle_tpu.distributed.fleet as fleet_mod
+        strategy = dist.fleet.DistributedStrategy()
+        strategy.hybrid_configs = degrees
+        fleet_mod.init(is_collective=True, strategy=strategy)
+
+    def _loss(self, sep_degree, seed=5):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        paddle.seed(seed)
+        cfg = llama_tiny(sep_degree=sep_degree,
+                         max_position_embeddings=64)
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.random.RandomState(1).randint(
+            0, 512, (2, 64)).astype(np.int32))
+        loss = m.loss(m(ids), ids)
+        loss.backward()
+        g = m.model.layers[0].self_attn.q_proj.weight.grad
+        return float(loss.numpy()), np.asarray(g._value)
+
+    def test_cp_matches_single_device(self):
+        l_ref, g_ref = self._loss(1)
+        self._init_mesh(dp_degree=2, sep_degree=2, mp_degree=2)
+        l_cp, g_cp = self._loss(2)
+        np.testing.assert_allclose(l_cp, l_ref, rtol=2e-4)
+        np.testing.assert_allclose(g_cp, g_ref, rtol=5e-3, atol=1e-5)
+
+    def test_sep_mismatch_is_loud(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        self._init_mesh(dp_degree=4, mp_degree=2)   # no sep axis > 1
+        cfg = llama_tiny(sep_degree=2, max_position_embeddings=64)
+        m = LlamaForCausalLM(cfg)
+        ids = paddle.to_tensor(np.zeros((1, 64), np.int32))
+        with pytest.raises(ValueError, match="sep"):
+            m(ids)

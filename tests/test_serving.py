@@ -2,6 +2,7 @@
 artifacts, paged KV-cache attention, KV-cached generation."""
 import numpy as np
 import pytest
+import jax
 import jax.numpy as jnp
 
 import paddle_tpu as paddle
@@ -541,3 +542,149 @@ class TestGenerateDepth:
             for t in gen.tolist():
                 assert t not in seen
                 seen.add(t)
+
+
+class TestPerRequestSampling:
+    """VERDICT r3 #5: per-request top_k/top_p/repetition_penalty applied
+    IN-PROGRAM (mask-based; no compile variant per value)."""
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (32,))
+        kw.setdefault("chunk_size", 4)
+        return ServingEngine(model, **kw), model
+
+    def test_topk1_is_greedy_at_any_temperature(self):
+        from paddle_tpu.inference import SamplingParams
+        eng, _ = self._engine()
+        p = np.random.RandomState(0).randint(0, 512, (9,)).astype(np.int32)
+        r_greedy = eng.add_request(p, SamplingParams(max_new_tokens=8))
+        r_k1 = eng.add_request(p, SamplingParams(
+            max_new_tokens=8, temperature=5.0, top_k=1))
+        got = eng.run_to_completion()
+        np.testing.assert_array_equal(got[r_greedy], got[r_k1])
+
+    def test_topp_tiny_is_greedy(self):
+        from paddle_tpu.inference import SamplingParams
+        eng, _ = self._engine()
+        p = np.random.RandomState(1).randint(0, 512, (7,)).astype(np.int32)
+        a = eng.add_request(p, SamplingParams(max_new_tokens=6))
+        b = eng.add_request(p, SamplingParams(
+            max_new_tokens=6, temperature=3.0, top_p=1e-9))
+        got = eng.run_to_completion()
+        np.testing.assert_array_equal(got[a], got[b])
+
+    def test_repetition_penalty_matches_generate(self):
+        """Greedy + repetition penalty is deterministic: the engine must
+        reproduce models.generation.generate exactly."""
+        from paddle_tpu.inference import SamplingParams
+        eng, model = self._engine()
+        p = np.random.RandomState(2).randint(0, 512, (6,)).astype(np.int32)
+        rid = eng.add_request(p, SamplingParams(
+            max_new_tokens=10, repetition_penalty=1.7))
+        got = eng.run_to_completion()[rid]
+        ref = model.generate(paddle.to_tensor(p[None]),
+                             max_new_tokens=10,
+                             repetition_penalty=1.7)
+        ref_new = np.asarray(ref._value)[0, len(p):]
+        np.testing.assert_array_equal(got, ref_new)
+
+    def test_mixed_rich_and_plain_requests_coexist(self):
+        from paddle_tpu.inference import SamplingParams
+        eng, _ = self._engine()
+        p1 = np.random.RandomState(3).randint(0, 512, (5,)).astype(np.int32)
+        p2 = np.random.RandomState(4).randint(0, 512, (11,)).astype(np.int32)
+        a = eng.add_request(p1, SamplingParams(max_new_tokens=6))
+        b = eng.add_request(p2, SamplingParams(
+            max_new_tokens=6, temperature=1.0, top_k=4, top_p=0.9,
+            repetition_penalty=1.3))
+        got = eng.run_to_completion()
+        # plain request must be unaffected by the rich slot beside it
+        eng2, _ = self._engine()
+        a2 = eng2.add_request(p1, SamplingParams(max_new_tokens=6))
+        got2 = eng2.run_to_completion()
+        np.testing.assert_array_equal(got[a], got2[a2])
+        assert len(got[b]) == 6
+
+    def test_overlap_off_matches_on(self):
+        """The async pipeline must not change results (greedy)."""
+        from paddle_tpu.inference import SamplingParams
+        outs = []
+        for ov in (True, False):
+            eng, _ = self._engine(overlap=ov)
+            rng = np.random.RandomState(5)
+            rids = [eng.add_request(
+                rng.randint(0, 512, (l,)).astype(np.int32),
+                SamplingParams(max_new_tokens=n))
+                for l, n in ((5, 9), (12, 4), (8, 7))]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+        assert outs[0] == outs[1]
+
+    def test_stats_breakdown_present(self):
+        from paddle_tpu.inference import SamplingParams
+        eng, _ = self._engine()
+        eng.add_request(np.ones(5, np.int32),
+                        SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["time_prefill_s"] >= 0
+        assert st["time_decode_stall_s"] >= 0
+        assert st["time_host_s"] >= 0
+
+
+@pytest.mark.skipif(jax.device_count() < 2, reason="needs 2+ devices")
+class TestTPServing:
+    """VERDICT r3 #4: TP-sharded serving over the mp axis must equal the
+    single-device engine token-for-token (greedy)."""
+
+    def test_mp2_equals_unsharded(self):
+        from jax.sharding import Mesh
+        from paddle_tpu.inference import SamplingParams, ServingEngine
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        rng = np.random.RandomState(7)
+        prompts = [rng.randint(0, 512, (l,)).astype(np.int32)
+                   for l in (5, 11)]
+        outs = []
+        for mesh in (None, Mesh(np.array(jax.devices()[:2]), ("mp",))):
+            eng = ServingEngine(model, max_batch_size=2, num_blocks=64,
+                                block_size=8, prompt_buckets=(32,),
+                                chunk_size=4, mesh=mesh)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=6))
+                    for p in prompts]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+        assert outs[0] == outs[1]
+
+
+def test_explicit_topk_zero_overrides_engine_default():
+    """SamplingParams(top_k=0) must disable an engine-level top_k
+    (None defers to it; 0 is an explicit opt-out)."""
+    from paddle_tpu.inference import SamplingParams, ServingEngine
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    p = np.random.RandomState(3).randint(0, 512, (6,)).astype(np.int32)
+
+    def run(engine_topk, req_topk):
+        eng = ServingEngine(m, max_batch_size=1, num_blocks=64,
+                            block_size=8, prompt_buckets=(32,),
+                            chunk_size=4, top_k=engine_topk, seed=11)
+        r = eng.add_request(p, SamplingParams(
+            max_new_tokens=8, temperature=1.3, top_k=req_topk))
+        return eng.run_to_completion()[r].tolist()
+
+    # explicit 0 == no filter anywhere == engine without a default
+    assert run(1, 0) == run(0, 0) == run(0, None)
+    # engine default applies when the request leaves top_k unset:
+    # top_k=1 forces greedy, so it must differ from the unfiltered
+    # high-temperature sample (and equal an explicit top_k=1)
+    assert run(1, None) == run(0, 1)
