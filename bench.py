@@ -291,8 +291,8 @@ def run_serving(weight_dtype=None, concurrency=8):
         weight_dtype=weight_dtype, chunk_size=16)
     rng = np.random.RandomState(0)
     # compile every variant up front so no request pays a compile
+    # (warmup clears its own throwaway stats)
     eng.warmup()
-    eng.clear_finished()
 
     # Poisson arrivals at ~80% of the drained-throughput estimate the
     # r3 run measured (~600 tok/s / 64 tok ≈ 9 req/s full capacity →

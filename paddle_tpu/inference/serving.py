@@ -698,9 +698,15 @@ class ServingEngine:
             "latency_p99_s": pct(lats, 0.99),
             "ttft_p50_s": pct(ttfts, 0.50),
             "ttft_p99_s": pct(ttfts, 0.99),
-            # where the wall time went (bench breakdown): prefill
-            # dispatch+fetch, blocking decode-chunk fetches (device-
-            # bound stall), host scheduling/bookkeeping
+            # where the wall time went (bench breakdown): wall time of
+            # the engine's blocking call sites. CAVEAT under overlap:
+            # the device runs one queue, so a prefill fetch issued
+            # while a decode chunk is in flight also waits for that
+            # chunk — time_prefill_s then absorbs in-flight decode
+            # time and time_decode_stall_s undercounts it. The split
+            # is exact with overlap=False; with overlap it bounds
+            # host-side attribution (time_host_s) exactly and the
+            # device phases jointly.
             "time_prefill_s": self.time_prefill_s,
             "time_decode_stall_s": self.time_stall_s,
             "time_host_s": self.time_host_s,
