@@ -319,6 +319,10 @@ def run_serving(weight_dtype=None, concurrency=8):
     gen = st["generated_tokens"]
     tag = f"serving_{'int8' if weight_dtype else 'bf16'}_c{concurrency}"
     return {
+        # r4 protocol note: NOT comparable to the r2/r3 closed-loop
+        # drain numbers — arrivals are rate-limited (open loop), so
+        # tok/s reflects an operating point, not peak drain throughput
+        f"{tag}_protocol": "open_loop_poisson_0.8cap_mixed",
         f"{tag}_tok_per_sec": round(gen / dt, 1),
         f"{tag}_latency_p50_s": round(st["latency_p50_s"], 3),
         f"{tag}_latency_p99_s": round(st["latency_p99_s"], 3),

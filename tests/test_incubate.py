@@ -237,3 +237,123 @@ class TestDistributedFusedLamb:
     def test_master_param_norm_toggle_runs(self):
         m, o = self._setup(use_master_param_norm=False)
         self._grad_step(m, o)
+
+
+class TestFusedMultiTransformerScan:
+    """Scan-over-layers fast path (homogeneous stacks) must match the
+    unrolled trace exactly — numerics AND gradients."""
+
+    def _weights(self, L=3, d=16, nh=2, ff=32, seed=0):
+        r = np.random.RandomState(seed)
+        hd = d // nh
+
+        def t(*shape, s=0.2):
+            return paddle.to_tensor((r.randn(*shape) * s)
+                                    .astype(np.float32))
+
+        return dict(
+            ln_scales=[t(d, s=1.0) for _ in range(L)],
+            ln_biases=[t(d) for _ in range(L)],
+            qkv_weights=[t(3, nh, hd, d) for _ in range(L)],
+            qkv_biases=[t(3, nh, hd) for _ in range(L)],
+            linear_weights=[t(d, d) for _ in range(L)],
+            linear_biases=[t(d) for _ in range(L)],
+            ffn_ln_scales=[t(d, s=1.0) for _ in range(L)],
+            ffn_ln_biases=[t(d) for _ in range(L)],
+            ffn1_weights=[t(d, ff) for _ in range(L)],
+            ffn1_biases=[t(ff) for _ in range(L)],
+            ffn2_weights=[t(ff, d) for _ in range(L)],
+            ffn2_biases=[t(d) for _ in range(L)],
+        )
+
+    def test_scan_matches_unrolled(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        ws = self._weights()
+        x = paddle.to_tensor(np.random.RandomState(1)
+                             .randn(2, 8, 16).astype(np.float32))
+        out_scan = IF.fused_multi_transformer(x, **ws)   # homogeneous
+        # cache_kvs=[] (non-None) forces the unrolled trace
+        out_unroll = IF.fused_multi_transformer(x, **ws, cache_kvs=[])
+        np.testing.assert_allclose(np.asarray(out_scan._value),
+                                   np.asarray(out_unroll._value),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_scan_grads_flow(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        ws = self._weights()
+        x = paddle.to_tensor(np.random.RandomState(2)
+                             .randn(2, 8, 16).astype(np.float32),
+                             stop_gradient=False)
+        out = IF.fused_multi_transformer(x, **ws)
+        out.sum().backward()
+        assert x.grad is not None
+        assert np.isfinite(np.asarray(x.grad._value)).all()
+
+    def test_masked_scan_matches_unrolled(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        ws = self._weights()
+        x = paddle.to_tensor(np.random.RandomState(3)
+                             .randn(2, 8, 16).astype(np.float32))
+        # a REAL causal additive mask: outputs must differ from the
+        # unmasked run, and scan must match unrolled under it
+        mask = paddle.to_tensor(
+            (1.0 - np.tril(np.ones((1, 1, 8, 8), np.float32))) * -1e4)
+        a = IF.fused_multi_transformer(x, **ws, attn_mask=mask)
+        b = IF.fused_multi_transformer(x, **ws, attn_mask=mask,
+                                       cache_kvs=[])
+        np.testing.assert_allclose(np.asarray(a._value),
+                                   np.asarray(b._value),
+                                   rtol=2e-4, atol=2e-5)
+        unmasked = IF.fused_multi_transformer(x, **ws)
+        assert not np.allclose(np.asarray(a._value),
+                               np.asarray(unmasked._value))
+
+    def test_bf16_scan_matches_unrolled(self):
+        """bf16 stacks must not change numerics when they switch to
+        the scan path (f32 LN statistics on both)."""
+        import jax.numpy as jnp
+        from paddle_tpu.incubate.nn import functional as IF
+        from paddle_tpu.framework.core import Tensor
+        ws = {k: [Tensor(w._value.astype(jnp.bfloat16)) for w in v]
+              for k, v in self._weights().items()}
+        x = paddle.to_tensor(np.random.RandomState(5)
+                             .randn(2, 8, 16).astype(np.float32)) \
+            .astype("bfloat16")
+        a = IF.fused_multi_transformer(x, **ws)
+        b = IF.fused_multi_transformer(x, **ws, cache_kvs=[])
+        np.testing.assert_allclose(
+            np.asarray(a._value, np.float32),
+            np.asarray(b._value, np.float32), rtol=3e-2, atol=3e-2)
+
+    def test_stack_cache_reused_across_calls(self):
+        from paddle_tpu.incubate.nn import functional as IF
+        ws = self._weights()
+        x = paddle.to_tensor(np.random.RandomState(6)
+                             .randn(1, 4, 16).astype(np.float32))
+        IF._FMT_STACK_CACHE.clear()
+        IF.fused_multi_transformer(x, **ws)
+        assert len(IF._FMT_STACK_CACHE) == 1
+        IF.fused_multi_transformer(x, **ws)
+        assert len(IF._FMT_STACK_CACHE) == 1    # same weights: cached
+
+    def test_trace_then_eager_does_not_leak_tracers(self):
+        """First scan-path call under to_static tracing must not poison
+        the stacked-weight cache for later eager calls (regression:
+        UnexpectedTracerError)."""
+        from paddle_tpu.incubate.nn import functional as IF
+        ws = self._weights(seed=9)
+        IF._FMT_STACK_CACHE.clear()
+
+        @paddle.jit.to_static
+        def traced(x):
+            return IF.fused_multi_transformer(x, **ws)
+
+        x = paddle.to_tensor(np.random.RandomState(9)
+                             .randn(1, 4, 16).astype(np.float32))
+        a = traced(x)
+        b = IF.fused_multi_transformer(x, **ws)     # eager, same weights
+        np.testing.assert_allclose(np.asarray(a._value),
+                                   np.asarray(b._value),
+                                   rtol=2e-4, atol=2e-5)
+        IF.clear_fused_multi_transformer_cache()
+        assert not IF._FMT_STACK_CACHE

