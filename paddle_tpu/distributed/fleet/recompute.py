@@ -20,6 +20,27 @@ from ...jit import _SwapGuard, _unwrap_tree
 __all__ = ["recompute", "recompute_sequential"]
 
 
+class _SubFn:
+    """Generic recompute() adapter over a named sub-block of a layer:
+    _SubFn(layer, "method", (modules...)) rematerializes
+    layer.method(x), exposing the modules' parameters for the swap.
+    Model families share this instead of growing bespoke adapters."""
+
+    def __init__(self, layer, method, modules):
+        self.layer = layer
+        self.method = method
+        self.modules = modules
+
+    def parameters(self):
+        ps = []
+        for m in self.modules:
+            ps.extend(m.parameters())
+        return ps
+
+    def __call__(self, x):
+        return getattr(self.layer, self.method)(x)
+
+
 def recompute(function, *args, use_reentrant: bool = True, **kwargs):
     """Run function(*args) with activation rematerialization in backward."""
     preserve = kwargs.pop("preserve_rng_state", True)

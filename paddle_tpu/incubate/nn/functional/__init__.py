@@ -495,7 +495,6 @@ def _fused_multi_transformer_scan(x, ln_scales, ln_biases, qkv_weights,
              linear_weights, linear_biases, ffn_ln_scales,
              ffn_ln_biases, ffn1_weights, ffn1_biases, ffn2_weights,
              ffn2_biases)
-    import jax as _jax
     cacheable = all(w.stop_gradient for ws in lists for w in ws)
     if not cacheable:
         stacked = tuple(stack(list(ws)) for ws in lists)
@@ -508,7 +507,7 @@ def _fused_multi_transformer_scan(x, ln_scales, ln_biases, qkv_weights,
             # jit/to_static tracing would otherwise leak its tracers
             # into later eager calls (UnexpectedTracerError)
             concrete = not any(
-                isinstance(t._value, _jax.core.Tracer)
+                isinstance(t._value, jax.core.Tracer)
                 for t in stacked)
             if concrete:
                 refs = tuple(w._value for ws in lists for w in ws)

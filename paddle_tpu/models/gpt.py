@@ -37,6 +37,8 @@ class GPTConfig:
     tie_word_embeddings: bool = True
     dtype: str = "float32"
     use_recompute: bool = False
+    # 'full' | 'full_attn' | 'core_attn' (see LlamaConfig)
+    recompute_granularity: str = "full"
     tensor_parallel: bool = False
     # >0: forward() returns hidden states; loss() runs the chunked
     # head-matmul + CE (see nn.functional.chunked_softmax_cross_entropy)
@@ -117,6 +119,7 @@ class GPTDecoderLayer(nn.Layer):
         self.mlp = GPTMLP(cfg)
         self.dropout = cfg.hidden_dropout
         self.use_recompute = cfg.use_recompute
+        self.recompute_granularity = cfg.recompute_granularity
 
     def _block(self, x):
         h = self.attn(self.ln_1(x))
@@ -128,12 +131,39 @@ class GPTDecoderLayer(nn.Layer):
             h = F.dropout(h, p=self.dropout, training=self.training)
         return x + h
 
+    def _attn_sub(self, x):
+        h = self.attn(self.ln_1(x))
+        if self.dropout:
+            h = F.dropout(h, p=self.dropout, training=self.training)
+        return h
+
+    def _mlp_sub(self, x):
+        h = self.mlp(self.ln_2(x))
+        if self.dropout:
+            h = F.dropout(h, p=self.dropout, training=self.training)
+        return h
+
     def forward(self, x):
         if self.use_recompute:
             from ..distributed.fleet import recompute
+            from ..distributed.fleet.recompute import _SubFn
             from .llama import _LayerFn
-            return recompute(_LayerFn(self), x)
+            gran = self.recompute_granularity
+            if gran == "full":
+                return recompute(_LayerFn(self), x)
+            if gran == "full_attn":
+                h = x + recompute(
+                    _SubFn(self, "_attn_sub",
+                           (self.ln_1, self.attn)), x)
+                return h + self._mlp_sub(h)
+            if gran == "core_attn":
+                # flash backward recomputes scores/probs internally
+                return self._block(x)
+            raise ValueError(
+                f"unknown recompute_granularity {gran!r}; expected "
+                "'full', 'full_attn' or 'core_attn'")
         return self._block(x)
+
 
 
 class GPTModel(nn.Layer):

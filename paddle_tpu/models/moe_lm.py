@@ -47,6 +47,8 @@ class MoEConfig:
     aux_loss_weight: float = 0.01
     dtype: str = "float32"
     use_recompute: bool = False
+    # 'full' | 'full_attn' | 'core_attn' (see LlamaConfig)
+    recompute_granularity: str = "full"
     tensor_parallel: bool = False
     # >0: forward() returns hidden states; loss() runs the chunked
     # head-matmul + CE (see nn.functional.chunked_softmax_cross_entropy)
@@ -120,6 +122,7 @@ class MoEDecoderLayer(nn.Layer):
         else:
             self.mlp = MoEBlock(cfg)
         self.use_recompute = cfg.use_recompute
+        self.recompute_granularity = cfg.recompute_granularity
 
     def _block(self, x):
         h = x + self.self_attn(self.input_layernorm(x))
@@ -128,8 +131,42 @@ class MoEDecoderLayer(nn.Layer):
     def forward(self, x):
         if self.use_recompute:
             from ..distributed.fleet import recompute
-            return recompute(_LayerFn(self), x)
+            from .llama import _AttnFn
+            gran = self.recompute_granularity
+            if gran == "full":
+                if isinstance(self.mlp, MoEBlock):
+                    # the router aux-loss must cross the checkpoint
+                    # boundary as an OUTPUT — a side-channel store from
+                    # inside jax.checkpoint leaks an escaped tracer
+                    out, aux = recompute(_MoEBlockFn(self), x)
+                    self.mlp.moe._aux_loss = aux
+                    return out
+                return recompute(_LayerFn(self), x)
+            if gran == "full_attn":
+                h = x + recompute(_AttnFn(self), x)
+                return h + self.mlp(self.post_attention_layernorm(h))
+            if gran == "core_attn":
+                return self._block(x)
+            raise ValueError(
+                f"unknown recompute_granularity {gran!r}; expected "
+                "'full', 'full_attn' or 'core_attn'")
         return self._block(x)
+
+
+class _MoEBlockFn:
+    """recompute() adapter for an MoE decoder layer: returns
+    (block_output, router_aux_loss) so the aux-loss is a real
+    checkpoint output with a grad path, not an escaped tracer."""
+
+    def __init__(self, layer):
+        self.layer = layer
+
+    def parameters(self):
+        return self.layer.parameters()
+
+    def __call__(self, x):
+        out = self.layer._block(x)
+        return out, self.layer.mlp.aux_loss
 
 
 class MoEModel(nn.Layer):
