@@ -335,6 +335,50 @@ def run_serving(weight_dtype=None, concurrency=8):
     }
 
 
+def run_serving_capacity(concurrency=8):
+    """Closed-loop CAPACITY row (the engine-vs-raw-decode gap metric,
+    VERDICT r3 weak#4): all requests enqueued at t0, decode-heavy load
+    (short prompts, long generations), drained flat out. The decode-
+    phase throughput is directly comparable to paged_decode_tok_per_sec
+    (same model/batch geometry); the gap is scheduling + sampling +
+    first-token plumbing overhead."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    paddle.seed(0)
+    cfg = llama_small(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    block_size = 64
+    new_tokens = 128
+    n_requests = concurrency * 2
+    eng = ServingEngine(
+        model, max_batch_size=concurrency,
+        num_blocks=concurrency * ((128 + new_tokens) // block_size + 2)
+        + 8, block_size=block_size, prompt_buckets=(128,),
+        chunk_size=16)
+    eng.warmup()
+    rng = np.random.RandomState(0)
+    t0 = time.perf_counter()
+    for _ in range(n_requests):
+        eng.add_request(rng.randint(0, cfg.vocab_size, 100),
+                        SamplingParams(max_new_tokens=new_tokens))
+    eng.run_to_completion()
+    dt = time.perf_counter() - t0
+    st = eng.stats()
+    gen = st["generated_tokens"]
+    decode_s = max(st["time_decode_stall_s"], 1e-9)
+    return {
+        "serving_capacity_tok_per_sec": round(gen / dt, 1),
+        "serving_capacity_decode_tok_per_sec": round(gen / decode_s, 1),
+        "serving_capacity_wall_s": round(dt, 2),
+        "serving_capacity_prefill_s": round(st["time_prefill_s"], 2),
+        "serving_capacity_decode_s": round(decode_s, 2),
+        "serving_capacity_host_s": round(st["time_host_s"], 2),
+    }
+
+
 def run_pp():
     """Pipeline-schedule efficiency microbench (VERDICT r3 #3): wall
     time per step, remat vs store-activations, on a 1-stage mesh on the
@@ -493,6 +537,7 @@ def run_serving_suite():
     out = {}
     for wd in (None, "int8"):
         out.update(run_serving(weight_dtype=wd, concurrency=8))
+    out.update(run_serving_capacity(concurrency=8))
     return out
 
 

@@ -50,6 +50,7 @@ from . import audio  # noqa: F401
 from . import text  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
+from . import decomposition  # noqa: F401
 from . import device  # noqa: F401
 from . import regularizer  # noqa: F401
 from .hapi import callbacks  # noqa: F401  — paddle.callbacks alias

@@ -1,8 +1,8 @@
 """audio.backends — file IO (reference:
 /root/reference/python/paddle/audio/backends/: init_backend.py with
 wave_backend default, soundfile optional). The image ships no soundfile;
-WAV load/save/info work through the stdlib wave module (16-bit PCM),
-other formats need soundfile."""
+WAV load/save/info work through the stdlib wave module (8/16/24/32-bit
+PCM), other formats need soundfile."""
 from __future__ import annotations
 
 import wave as _wave
@@ -92,8 +92,17 @@ def load(filepath: str, frame_offset: int = 0, num_frames: int = -1,
         raw = f.readframes(count)
         width = f.getsampwidth()
         ch = f.getnchannels()
-    dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
-    data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
+    if width == 3:
+        # 24-bit PCM: sign-extend each 3-byte little-endian frame to int32
+        b = np.frombuffer(raw, dtype=np.uint8).reshape(-1, 3)
+        data = (b[:, 0].astype(np.int32)
+                | (b[:, 1].astype(np.int32) << 8)
+                | (b[:, 2].astype(np.int32) << 16))
+        data = (data << 8) >> 8  # arithmetic shift sign-extends bit 23
+        data = data.reshape(-1, ch)
+    else:
+        dtype = {1: np.uint8, 2: np.int16, 4: np.int32}[width]
+        data = np.frombuffer(raw, dtype=dtype).reshape(-1, ch)
     if normalize:
         if width == 1:
             data = (data.astype(np.float32) - 128) / 128.0
@@ -116,17 +125,34 @@ def save(filepath: str, src, sample_rate: int,
             bits_per_sample, "PCM_16")
         sf.write(filepath, arr, sample_rate, subtype=subtype)
         return
-    if bits_per_sample != 16:
-        raise NotImplementedError(
-            "wave_backend saves 16-bit PCM; install soundfile for others")
+    if bits_per_sample not in (8, 16, 24, 32):
+        raise ValueError(
+            f"bits_per_sample must be one of 8/16/24/32, "
+            f"got {bits_per_sample}")
     arr = np.asarray(src._value if isinstance(src, Tensor) else src)
     if channels_first:
         arr = arr.T
+    width = bits_per_sample // 8
+    full = float(2 ** (bits_per_sample - 1))
     if arr.dtype.kind == "f":
-        arr = np.clip(arr, -1.0, 1.0)
-        arr = (arr * 32767.0).astype(np.int16)
+        # scale in float64: float32 can't represent 2**31-1 exactly, so
+        # full-scale samples would overflow int32 and flip sign
+        arr = np.clip(arr.astype(np.float64), -1.0, 1.0)
+        arr = np.clip(np.round(arr * (full - 1)),
+                      -full, full - 1).astype(np.int32)
+    else:
+        arr = arr.astype(np.int32)
+    if bits_per_sample == 8:
+        payload = (arr + 128).astype(np.uint8)  # WAV 8-bit is unsigned
+    elif bits_per_sample == 16:
+        payload = arr.astype(np.int16)
+    elif bits_per_sample == 32:
+        payload = arr
+    else:  # 24-bit: emit the low 3 little-endian bytes of each int32
+        flat = np.ascontiguousarray(arr).astype("<i4")
+        payload = flat.view(np.uint8).reshape(-1, 4)[:, :3]
     with _wave.open(filepath, "wb") as f:
         f.setnchannels(arr.shape[1] if arr.ndim > 1 else 1)
-        f.setsampwidth(2)
+        f.setsampwidth(width)
         f.setframerate(sample_rate)
-        f.writeframes(np.ascontiguousarray(arr).tobytes())
+        f.writeframes(np.ascontiguousarray(payload).tobytes())

@@ -1,0 +1,24 @@
+"""paddle.decomposition — composite-op → primitive-op lowering.
+
+Reference surface: /root/reference/python/paddle/decomposition/
+(__init__.py exports decompose + register_decomp; rules.py;
+primitives.py; C++ rules in paddle/fluid/primitive/composite/).
+
+TPU-native design: instead of a PIR pass, the registry hangs off the
+``apply()`` dispatch seam. Decomposable functional ops wrap their kernel
+closure in ``DecompAware`` (op name + attrs); ``enable_prim()`` swaps in
+the registered primitive-only rule at kernel-call time — covering eager,
+jit traces, and partial capture alike — while ``decompose(program)``
+rewrites already-recorded static Programs via the executor node-override
+table. Rules lower to a closed whitelist of jax primitives
+(primitives.py), asserted by tests/test_decomposition.py.
+"""
+from . import rules  # noqa: F401  (registers the built-in rules)
+from .decomp import decompose
+from .primitives import ALLOWED_PRIMITIVES
+from .register import (DecompAware, disable_prim, enable_prim, has_decomp,
+                       lookup, prim_enabled, register_decomp)
+
+__all__ = ["decompose", "register_decomp", "has_decomp", "lookup",
+           "enable_prim", "disable_prim", "prim_enabled", "DecompAware",
+           "ALLOWED_PRIMITIVES"]

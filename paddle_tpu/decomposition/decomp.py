@@ -1,0 +1,89 @@
+"""decompose() over recorded static Programs (reference:
+/root/reference/python/paddle/decomposition/decomp.py — walks a PIR
+program, calls each op's registered rule, splices the primitive subgraph
+in place).
+
+TPU-native: a static Program node's kernel closure IS the op body, so
+decomposition is a node-override swap — no graph surgery. For every node
+whose fn is DecompAware with a registered rule, install
+``partial(rule, **attrs)`` through the executor's override table
+(static/executor.py:88) after an eval_shape equivalence check (the
+InferMeta safety net: a rule must preserve output shapes/dtypes exactly).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Iterable, Optional, Sequence
+
+import jax
+
+from .register import DecompAware, lookup
+
+__all__ = ["decompose"]
+
+
+def _check_avals(node, new_fn):
+    """Assert the rule reproduces the node's recorded output avals."""
+    from ..framework.core import Tensor
+    from ..static.program import Variable
+
+    args = node.args
+    sym_pos = [i for i, a in enumerate(args) if isinstance(a, Variable)]
+    avals = [args[i].aval for i in sym_pos]
+
+    def abstract(*sym_vals):
+        full = list(args)
+        for i, v in zip(sym_pos, sym_vals):
+            full[i] = v
+        full = [a._value if isinstance(a, Tensor) else a for a in full]
+        return new_fn(*full, **node.kwargs)
+
+    out = jax.eval_shape(abstract, *avals)
+    out_list = list(out) if isinstance(out, (tuple, list)) else [out]
+    if len(out_list) != len(node.out_vars):
+        raise ValueError(
+            f"decomposition rule for {node.op_name!r} returns "
+            f"{len(out_list)} outputs, op has {len(node.out_vars)}")
+    for av, var in zip(out_list, node.out_vars):
+        if tuple(av.shape) != tuple(var.aval.shape) or \
+                av.dtype != var.aval.dtype:
+            raise ValueError(
+                f"decomposition rule for {node.op_name!r} changes output "
+                f"{var.name}: {var.aval.shape}/{var.aval.dtype} -> "
+                f"{av.shape}/{av.dtype}")
+
+
+def decompose(program, src_vars: Optional[Sequence] = None,
+              blacklist: Iterable[str] = frozenset(),
+              whitelist: Optional[Iterable[str]] = None):
+    """Rewrite registered composite ops in ``program`` to primitive rules.
+
+    Returns ``src_vars`` unchanged (node overrides keep the same output
+    Variables — reference decompose() returns dst_vars because PIR
+    splicing re-creates values; here identity is preserved), and records
+    the swap in the executor override table. ``blacklist``/``whitelist``
+    filter by op name, matching the reference signature
+    (python/paddle/decomposition/decomp.py:decompose).
+    """
+    blacklist = set(blacklist)
+    whitelist = set(whitelist) if whitelist is not None else None
+    changed = []
+    for node in program.nodes:
+        fn = node.fn
+        if not isinstance(fn, DecompAware):
+            continue
+        name = fn.op_name
+        if name in blacklist or (whitelist is not None
+                                 and name not in whitelist):
+            continue
+        rule = lookup(name)
+        if rule is None:
+            continue
+        new_fn = functools.partial(rule, **fn.attrs)
+        _check_avals(node, new_fn)
+        program._node_overrides[id(node)] = new_fn
+        changed.append(name)
+    if changed:
+        program.version += 1  # invalidate the executor's compile cache
+    program._decomposed_ops = tuple(changed)
+    return src_vars

@@ -1,0 +1,33 @@
+"""Primitive whitelist for decomposition rules (reference:
+/root/reference/python/paddle/decomposition/primitives.py — the flat list
+of primitive python ops composite rules may use).
+
+Here the primitive set is jax/lax primitives: a rule's jaxpr must contain
+only these (tests/test_decomposition.py traces every rule and asserts
+it). Notably EXCLUDED: custom_jvp_call / custom_vjp_call (jax.nn
+composites), rsqrt and erf_inv (decompose via sqrt/div), reduce_prod,
+and any pjit-wrapped composite — the point of a rule is that a compiler
+backend sees only this closed basis.
+"""
+
+ALLOWED_PRIMITIVES = frozenset({
+    # elementwise arithmetic
+    "add", "sub", "mul", "div", "neg", "sign", "abs", "max", "min",
+    "rem", "floor", "ceil", "round",
+    # transcendental (TPU-native: these map to VPU ops / XLA intrinsics)
+    "exp", "log", "log1p", "expm1", "tanh", "erf", "sqrt",
+    "integer_pow", "pow", "logistic",
+    # comparisons / selection
+    "eq", "ne", "lt", "le", "gt", "ge", "select_n", "clamp",
+    "is_finite", "and", "or", "not", "xor",
+    # type / shape plumbing
+    "convert_element_type", "bitcast_convert_type", "stop_gradient",
+    "broadcast_in_dim", "reshape", "transpose", "squeeze",
+    "expand_dims", "rev", "concatenate", "slice", "dynamic_slice",
+    "pad", "iota",
+    # reductions
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_and", "reduce_or",
+    "argmax", "argmin",
+    # gather/scatter family (index_select & friends)
+    "gather", "scatter", "scatter-add", "dynamic_update_slice",
+})

@@ -1,0 +1,108 @@
+"""Decomposition registry (reference:
+/root/reference/python/paddle/decomposition/register.py Registry /
+register_decomp; lookup consumed by decomp.py decompose()).
+
+Holds op_name -> rule, where a rule is a jax-traceable function with the
+same positional (array) signature as the op's kernel closure plus the
+op's attributes as keyword arguments. Rules must compose only whitelisted
+jax primitives (see primitives.py; enforced by
+tests/test_decomposition.py::test_rules_are_primitive_only).
+"""
+from __future__ import annotations
+
+import inspect
+
+
+class Registry:
+    """A general registry object."""
+
+    __slots__ = ["name", "rules"]
+
+    def __init__(self, name: str):
+        self.name = name
+        self.rules = {}
+
+    def register(self, op_type: str, rule):
+        assert isinstance(op_type, str)
+        assert inspect.isfunction(rule)
+        if op_type in self.rules:
+            raise ValueError(
+                f"decomposition rule for {op_type!r} already registered")
+        self.rules[op_type] = rule
+
+    def lookup(self, op_type: str):
+        return self.rules.get(op_type)
+
+
+_decomposition_ops = Registry("decomposition")
+
+
+def register_decomp(op_type: str):
+    """Decorator registering the primitive-lowering rule for ``op_type``."""
+
+    def wrapper(rule):
+        _decomposition_ops.register(op_type, rule)
+        return rule
+
+    return wrapper
+
+
+def has_decomp(op_type: str) -> bool:
+    return _decomposition_ops.lookup(op_type) is not None
+
+
+def lookup(op_type: str):
+    return _decomposition_ops.lookup(op_type)
+
+
+# process-global like the reference's prim flag (FLAGS_prim_all): ops
+# evaluated on worker threads (DataLoader, serving) must see the toggle
+class _PrimState:
+    __slots__ = ("enabled",)
+
+    def __init__(self):
+        self.enabled = False
+
+
+_prim = _PrimState()
+
+
+def enable_prim():
+    """Route decomposable ops through their primitive rules (eager and
+    inside jit traces alike — the swap happens at kernel-call time)."""
+    _prim.enabled = True
+
+
+def disable_prim():
+    _prim.enabled = False
+
+
+def prim_enabled() -> bool:
+    return _prim.enabled
+
+
+class DecompAware:
+    """Kernel closure that knows its op name and attributes.
+
+    Decomposable functional ops wrap their kernel fn in this before
+    handing it to ``apply()``; under ``enable_prim()`` the call routes to
+    the registered primitive rule instead, and ``decompose(program)``
+    reads ``.attrs`` off recorded static nodes to rewrite them. This is
+    the dispatch-seam analog of the reference's PIR decompose pass
+    (/root/reference/python/paddle/decomposition/decomp.py) — no IR walk
+    is needed because the kernel fn IS the op body.
+    """
+
+    __slots__ = ("op_name", "fn", "attrs")
+
+    def __init__(self, op_name: str, fn, **attrs):
+        self.op_name = op_name
+        self.fn = fn
+        self.attrs = attrs
+
+    def __call__(self, *xs, **kw):
+        if _prim.enabled:
+            rule = _decomposition_ops.lookup(self.op_name)
+            if rule is not None:
+                return rule(*xs, **self.attrs)
+        return self.fn(*xs, **kw)

@@ -1,0 +1,166 @@
+"""Composite → primitive decomposition rules (reference:
+/root/reference/python/paddle/decomposition/rules.py and
+/root/reference/paddle/fluid/primitive/composite/composite.h —
+mean/softmax/silu/relu/rsqrt/squeeze/unsqueeze/add_n/layer_norm/
+full_like/gelu/sigmoid/leaky_relu/index_select/stack decomps).
+
+Each rule has the same positional (array) signature as the op's kernel
+closure, with the op attributes as keyword arguments (captured off the
+DecompAware wrapper at the call site). Rules use only whitelisted jax
+primitives (primitives.py) — no jax.nn composites, no custom_jvp — so a
+backend consuming the decomposed program sees a closed primitive basis.
+Numerics are the stable forms (shifted softmax, tanh-form sigmoid), and
+normalizations accumulate in f32 like the fused kernels they replace.
+"""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+from jax import lax
+
+from .register import register_decomp
+
+
+@register_decomp("relu")
+def relu(x):
+    return jnp.maximum(x, jnp.zeros((), x.dtype))
+
+
+@register_decomp("sigmoid")
+def sigmoid(x):
+    # tanh form: stable at both tails (exp-form overflows for x << 0)
+    half = jnp.asarray(0.5, x.dtype)
+    return half * (jnp.tanh(half * x) + jnp.asarray(1.0, x.dtype))
+
+
+@register_decomp("silu")
+def silu(x):
+    half = jnp.asarray(0.5, x.dtype)
+    return x * (half * (jnp.tanh(half * x) + jnp.asarray(1.0, x.dtype)))
+
+
+@register_decomp("gelu")
+def gelu(x, approximate=False):
+    one = jnp.asarray(1.0, x.dtype)
+    half = jnp.asarray(0.5, x.dtype)
+    if approximate:
+        c = jnp.asarray(math.sqrt(2.0 / math.pi), x.dtype)
+        k = jnp.asarray(0.044715, x.dtype)
+        return half * x * (one + jnp.tanh(c * (x + k * x * x * x)))
+    inv_sqrt2 = jnp.asarray(1.0 / math.sqrt(2.0), x.dtype)
+    return half * x * (one + lax.erf(x * inv_sqrt2))
+
+
+@register_decomp("leaky_relu")
+def leaky_relu(x, negative_slope=0.01):
+    slope = jnp.asarray(negative_slope, x.dtype)
+    return jnp.where(x > jnp.zeros((), x.dtype), x, slope * x)
+
+
+@register_decomp("softmax")
+def softmax(x, axis=-1, dtype=None):
+    if dtype is not None:
+        x = x.astype(dtype)
+    shifted = x - lax.stop_gradient(
+        jnp.max(x, axis=axis, keepdims=True))
+    e = jnp.exp(shifted)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("mean")
+def mean(x, axis=None, keepdim=False):
+    count = 1
+    shape = x.shape
+    axes = (tuple(range(len(shape))) if axis is None
+            else tuple(a % len(shape) for a in
+                       (axis if isinstance(axis, (tuple, list))
+                        else (axis,))))
+    for a in axes:
+        count *= shape[a]
+    total = jnp.sum(x, axis=axes, keepdims=keepdim)
+    return total / jnp.asarray(count, total.dtype)
+
+
+@register_decomp("rsqrt")
+def rsqrt(x):
+    return jnp.asarray(1.0, x.dtype) / jnp.sqrt(x)
+
+
+@register_decomp("square")
+def square(x):
+    return x * x
+
+
+@register_decomp("stack")
+def stack(*xs, axis=0):
+    nd = xs[0].ndim + 1
+    ax = axis % nd
+    expanded = [lax.expand_dims(a, (ax,)) for a in xs]
+    return lax.concatenate(expanded, ax) if len(expanded) > 1 \
+        else expanded[0]
+
+
+@register_decomp("squeeze")
+def squeeze(x, axis=None):
+    if axis is None:
+        axes = tuple(i for i, d in enumerate(x.shape) if d == 1)
+    else:
+        raw = axis if isinstance(axis, (tuple, list)) else (axis,)
+        axes = tuple(a % x.ndim for a in raw)
+        axes = tuple(a for a in axes if x.shape[a] == 1)
+    return lax.squeeze(x, axes) if axes else x
+
+
+@register_decomp("unsqueeze")
+def unsqueeze(x, axis=0):
+    raw = axis if isinstance(axis, (tuple, list)) else (axis,)
+    out = x
+    for ax in sorted(int(a) for a in raw):
+        out = lax.expand_dims(out, (ax % (out.ndim + 1),))
+    return out
+
+
+@register_decomp("add_n")
+def add_n(*xs):
+    out = xs[0]
+    for x in xs[1:]:
+        out = out + x
+    return out
+
+
+@register_decomp("index_select")
+def index_select(x, index, axis=0):
+    return jnp.take(x, index, axis=axis, mode="clip")
+
+
+@register_decomp("full_like")
+def full_like(x, fill_value=0, dtype=None):
+    d = dtype if dtype is not None else x.dtype
+    return lax.broadcast_in_dim(jnp.asarray(fill_value, d), x.shape, ())
+
+
+@register_decomp("layer_norm")
+def layer_norm(x, *wb, axes=(-1,), epsilon=1e-5):
+    acc = x.astype(jnp.float32)
+    mu = jnp.mean(acc, axis=axes, keepdims=True)
+    centered = acc - mu
+    var = jnp.mean(centered * centered, axis=axes, keepdims=True)
+    out = (centered / jnp.sqrt(var + jnp.asarray(epsilon, jnp.float32))
+           ).astype(x.dtype)
+    if len(wb) >= 1:
+        out = out * wb[0].astype(x.dtype)
+    if len(wb) == 2:
+        out = out + wb[1].astype(x.dtype)
+    return out
+
+
+@register_decomp("rms_norm")
+def rms_norm(x, *w, epsilon=1e-6, axis=-1):
+    acc = x.astype(jnp.float32)
+    ms = jnp.mean(acc * acc, axis=axis, keepdims=True)
+    out = (acc / jnp.sqrt(ms + jnp.asarray(epsilon, jnp.float32))
+           ).astype(x.dtype)
+    if w and w[0] is not None:
+        out = out * w[0].astype(x.dtype)
+    return out

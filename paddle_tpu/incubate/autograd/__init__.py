@@ -171,16 +171,7 @@ class Hessian:
         return np.asarray(self._materialize())
 
 
-_prim = {"on": False}
-
-
-def enable_prim():
-    _prim["on"] = True
-
-
-def disable_prim():
-    _prim["on"] = False
-
-
-def prim_enabled() -> bool:
-    return _prim["on"]
+# prim mode delegates to the real decomposition registry (round 4 —
+# closes SURVEY §2.1 "decomposition registry" partial): enabling routes
+# decomposable ops through primitive-only rules at the apply() seam.
+from ...decomposition import disable_prim, enable_prim, prim_enabled  # noqa: E402,F401

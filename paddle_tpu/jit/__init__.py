@@ -145,6 +145,11 @@ def _trace_error(exc, fn_name):
         f"Original error: {type(exc).__name__}: {exc}")
 
 
+def _prim() -> bool:
+    from ..decomposition.register import prim_enabled
+    return prim_enabled()
+
+
 def _snapshot_lower(p_arrays, b_arrays, key, training, args):
     """Aval-only snapshot for concrete_program (live arrays would pin
     the batch + params in HBM)."""
@@ -210,8 +215,12 @@ class StaticFunction:
         note = self._note_trace
 
         if layer is not None:
-            def pure(param_arrays, buffer_arrays, rng_key, training, *in_arrays):
+            # `mode` is the static cache token: (training, prim_enabled).
+            # The prim flag only forces a retrace when toggled — the new
+            # trace then reads the live flag through each DecompAware
+            def pure(param_arrays, buffer_arrays, rng_key, mode, *in_arrays):
                 note(in_arrays)
+                training = mode[0] if isinstance(mode, tuple) else mode
                 layer.training = training
                 with with_rng_key(rng_key):
                     out, new_bufs = functional_call(
@@ -220,7 +229,7 @@ class StaticFunction:
         else:
             fn = self._fn
 
-            def pure(param_arrays, buffer_arrays, rng_key, training, *in_arrays):
+            def pure(param_arrays, buffer_arrays, rng_key, mode, *in_arrays):
                 note(in_arrays)
                 targs = tuple(Tensor(a) for a in in_arrays)
                 from ..framework.core import _watch_mutations
@@ -319,7 +328,8 @@ class StaticFunction:
             def whole_graph(*arrs):
                 pa = arrs[:n_params]
                 ia = arrs[n_params:]
-                out, new_bufs = compiled(list(pa), b_arrays, key, training, *ia)
+                out, new_bufs = compiled(list(pa), b_arrays, key,
+                                         (training, _prim()), *ia)
                 flat_out, treedef = jax.tree_util.tree_flatten(out)
                 self._last_treedef = treedef
                 self._last_n_out = len(flat_out)
@@ -332,7 +342,7 @@ class StaticFunction:
                 # retrace (not per call): ShapeDtypeStructs, ALL args
                 self._lower_args = _snapshot_lower(
                     [p._value for p in p_tensors], b_arrays, key,
-                    training, args)
+                    (training, _prim()), args)
                 self._lower_trace_count = self.retrace_count
             if not isinstance(results, tuple):
                 results = (results,)
@@ -349,14 +359,15 @@ class StaticFunction:
         compiled = self._compiled
 
         def whole_graph(*arrs):
-            out, _ = compiled([], [], key, True, *arrs)
+            out, _ = compiled([], [], key, (True, _prim()), *arrs)
             flat_out, treedef = jax.tree_util.tree_flatten(out)
             self._last_treedef = treedef
             return tuple(flat_out) if len(flat_out) > 1 else flat_out[0]
 
         results = apply("to_static", whole_graph, *args)
         if getattr(self, "_lower_trace_count", -1) != self.retrace_count:
-            self._lower_args = _snapshot_lower([], [], key, True, args)
+            self._lower_args = _snapshot_lower([], [], key,
+                                               (True, _prim()), args)
             self._lower_trace_count = self.retrace_count
         if isinstance(results, tuple):
             return jax.tree_util.tree_unflatten(self._last_treedef,

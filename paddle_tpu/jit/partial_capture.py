@@ -247,6 +247,15 @@ def _fp_fn(fn) -> Optional[tuple]:
         if any(p is None for p in parts):
             return None
         return ("partial",) + parts
+    from ..decomposition.register import DecompAware, prim_enabled
+    if isinstance(fn, DecompAware):
+        # per-call wrapper: fingerprint by the wrapped kernel + attrs +
+        # the prim flag (the flag changes which body the call runs)
+        inner = _fp_fn(fn.fn)
+        attrs = _fp_const(fn.attrs)
+        if inner is None or attrs is None:
+            return None
+        return ("decomp", fn.op_name, inner, attrs, prim_enabled())
     bound = getattr(fn, "__self__", None)
     if bound is not None and hasattr(fn, "__func__"):
         inner = _fp_fn(fn.__func__)

@@ -1,6 +1,7 @@
 """paddle_tpu.nn — parity with paddle.nn
 (/root/reference/python/paddle/nn/__init__.py)."""
 from . import functional  # noqa: F401
+from . import quant  # noqa: F401
 from . import initializer  # noqa: F401
 from .layer.layers import Layer  # noqa: F401
 from .layer.common import *  # noqa: F401,F403

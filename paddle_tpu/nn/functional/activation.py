@@ -6,6 +6,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...decomposition import DecompAware
 from ...framework.core import Tensor, apply
 
 __all__ = [
@@ -18,7 +19,7 @@ __all__ = [
 
 
 def relu(x, name=None):
-    return apply("relu", jax.nn.relu, x)
+    return apply("relu", DecompAware("relu", jax.nn.relu), x)
 
 
 def relu_(x, name=None):
@@ -45,18 +46,20 @@ def celu(x, alpha=1.0, name=None):
 
 
 def gelu(x, approximate=False, name=None):
-    return apply("gelu", lambda a: jax.nn.gelu(a, approximate=approximate), x)
+    return apply("gelu", DecompAware(
+        "gelu", lambda a: jax.nn.gelu(a, approximate=approximate),
+        approximate=approximate), x)
 
 
 def silu(x, name=None):
-    return apply("silu", jax.nn.silu, x)
+    return apply("silu", DecompAware("silu", jax.nn.silu), x)
 
 
 swish = silu
 
 
 def sigmoid(x, name=None):
-    return apply("sigmoid", jax.nn.sigmoid, x)
+    return apply("sigmoid", DecompAware("sigmoid", jax.nn.sigmoid), x)
 
 
 def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
@@ -93,7 +96,9 @@ def hardshrink(x, threshold=0.5, name=None):
 
 
 def leaky_relu(x, negative_slope=0.01, name=None):
-    return apply("leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope), x)
+    return apply("leaky_relu", DecompAware(
+        "leaky_relu", lambda a: jax.nn.leaky_relu(a, negative_slope),
+        negative_slope=negative_slope), x)
 
 
 def prelu(x, weight, data_format="NCHW", name=None):
@@ -136,12 +141,16 @@ def softsign(x, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
+    if dtype is not None:
+        from ...framework import dtype as dtypes
+        dtype = dtypes.convert_dtype(dtype)
+
     def f(a):
         if dtype is not None:
-            from ...framework import dtype as dtypes
-            a = a.astype(dtypes.convert_dtype(dtype))
+            a = a.astype(dtype)
         return jax.nn.softmax(a, axis=axis)
-    return apply("softmax", f, x)
+    return apply("softmax", DecompAware("softmax", f, axis=axis,
+                                        dtype=dtype), x)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):

@@ -7,6 +7,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from ...decomposition.register import DecompAware
 from ...framework.core import Tensor, apply
 
 __all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
@@ -88,7 +89,8 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         out = layer_norm(x, normalized_shape, None, None, epsilon)
         from ...tensor.math import add
         return add(out, bias)
-    return apply("layer_norm", f, *args)
+    return apply("layer_norm", DecompAware(
+        "layer_norm", f, axes=axes, epsilon=epsilon), *args)
 
 
 def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
@@ -98,7 +100,8 @@ def rms_norm(x, weight=None, epsilon=1e-6, axis=-1, name=None):
     args = [x] if weight is None else [x, weight]
     def f(a, *w):
         return _rms(a, w[0] if w else None, epsilon, axis)
-    return apply("rms_norm", f, *args)
+    return apply("rms_norm", DecompAware(
+        "rms_norm", f, epsilon=epsilon, axis=axis), *args)
 
 
 def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,

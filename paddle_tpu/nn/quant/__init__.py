@@ -1,0 +1,10 @@
+"""paddle.nn.quant — weight-only quantized ops + quantization stubs
+(reference: /root/reference/python/paddle/nn/quant/__init__.py exports
+Stub, weight_only_linear, llm_int8_linear, weight_quantize,
+weight_dequantize)."""
+from .quantized_linear import (llm_int8_linear, weight_dequantize,
+                               weight_only_linear, weight_quantize)
+from .stub import QuanterStub, Stub
+
+__all__ = ["Stub", "weight_only_linear", "llm_int8_linear",
+           "weight_quantize", "weight_dequantize"]

@@ -1,0 +1,175 @@
+"""Weight-only quantized linear ops (reference:
+/root/reference/python/paddle/nn/quant/quantized_linear.py —
+weight_quantize:39, weight_dequantize:96, weight_only_linear:152,
+llm_int8_linear:240).
+
+TPU-native design notes:
+- The reference's int8/int4 layouts are CUTLASS tile permutations keyed
+  on SM arch; here the layout is plain row-major [out, in] (int4 packs
+  two nibbles per int8 along the in-dim) and XLA fuses the dequant into
+  the matmul's operand read — the win is HBM traffic (the usual decode
+  bottleneck), not a special tensor-core path. `arch` is accepted and
+  ignored (no SM tiers on TPU).
+- Grouped scales (group_size 64/128) quantize in-dim blocks
+  independently: scale shape [out, in/group_size].
+- llm_int8_linear implements the LLM.int8() outlier decomposition with
+  static shapes: a threshold mask splits activation channels; inlier
+  channels run through the int8 weight path, outlier channels matmul the
+  dequantized weight in the activation dtype. No dynamic gather — XLA
+  sees two fixed-shape matmuls and a select.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ...framework.core import Tensor, apply, apply_nodiff
+
+__all__ = ["weight_quantize", "weight_dequantize", "weight_only_linear",
+           "llm_int8_linear"]
+
+_ALGOS = ("weight_only_int8", "weight_only_int4", "llm.int8")
+
+
+def _check(algo, group_size):
+    if algo not in _ALGOS:
+        raise ValueError(f"algo must be one of {_ALGOS}, got {algo!r}")
+    if group_size not in (-1, 64, 128):
+        raise ValueError(
+            f"group_size must be -1, 64 or 128, got {group_size}")
+
+
+def weight_quantize(x, algo: str = "weight_only_int8", arch=None,
+                    group_size: int = -1):
+    """Quantize a [in, out] float weight; returns (quantized [out, in]
+    int8 tensor, float32 scales). Per-channel scales have shape [out];
+    grouped scales [out, in/group_size]. int4 packs value pairs along
+    the in-dim into one int8 (low nibble = even index)."""
+    _check(algo, group_size)
+    bits = 4 if algo == "weight_only_int4" else 8
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(w):
+        wt = w.astype(jnp.float32).T          # [out, in]
+        o, i = wt.shape
+        if group_size == -1:
+            absmax = jnp.max(jnp.abs(wt), axis=1, keepdims=True)
+            scale = absmax / qmax              # [out, 1]
+            q = jnp.round(wt / jnp.maximum(scale, 1e-9))
+            scale_out = scale[:, 0]
+        else:
+            if i % group_size:
+                raise ValueError(
+                    f"in_features {i} not divisible by group_size "
+                    f"{group_size}")
+            g = wt.reshape(o, i // group_size, group_size)
+            absmax = jnp.max(jnp.abs(g), axis=2, keepdims=True)
+            scale = absmax / qmax              # [out, groups, 1]
+            q = jnp.round(g / jnp.maximum(scale, 1e-9)).reshape(o, i)
+            scale_out = scale[:, :, 0]
+        q = jnp.clip(q, -qmax - 1, qmax).astype(jnp.int8)
+        if bits == 4:
+            if i % 2:
+                raise ValueError(
+                    f"weight_only_int4 needs even in_features, got {i}")
+            lo = q[:, 0::2] & 0x0F
+            hi = (q[:, 1::2] & 0x0F) << 4
+            q = (lo | hi).astype(jnp.int8)     # [out, in/2]
+        return q, scale_out.astype(jnp.float32)
+
+    return apply_nodiff("weight_quantize", f, x)
+
+
+def _unpack_int4(q):
+    """[out, in/2] packed int8 → [out, in] int8 (sign-extended nibbles)."""
+    lo = (q << 4).astype(jnp.int8) >> 4        # arithmetic shift extends
+    hi = q >> 4                                 # int8 >> is arithmetic
+    return jnp.stack([lo, hi], axis=-1).reshape(q.shape[0], -1)
+
+
+def _dequant(q, scale, algo, group_size, out_dtype):
+    w = _unpack_int4(q) if algo == "weight_only_int4" else q
+    wf = w.astype(jnp.float32)
+    if scale.ndim == 1:
+        wf = wf * scale[:, None]
+    else:                                       # grouped [out, groups]
+        o, i = wf.shape
+        wf = (wf.reshape(o, scale.shape[1], -1)
+              * scale[:, :, None]).reshape(o, i)
+    return wf.astype(out_dtype)
+
+
+def weight_dequantize(x, scale, algo: str = "weight_only_int8",
+                      out_dtype="float16", group_size: int = -1):
+    """Inverse of weight_quantize: [out, in(/2)] int8 + scales →
+    [in, out] float (reference returns the transposition back)."""
+    _check(algo, group_size)
+    from ...framework import dtype as dtypes
+    d = dtypes.convert_dtype(out_dtype)
+
+    def f(q, s):
+        return _dequant(q, s, algo, group_size, d).T
+
+    return apply_nodiff("weight_dequantize", f, x, scale)
+
+
+def weight_only_linear(x, weight, bias=None, weight_scale=None,
+                       weight_dtype: str = "int8", arch=None,
+                       group_size: int = -1):
+    """y = x @ dequant(weight).T + bias with int8/int4-stored weight
+    [out, in(/2)]. The dequant happens in-trace so XLA fuses it into the
+    matmul's weight read — HBM traffic drops 2×/4× vs bf16 weights."""
+    if weight_dtype not in ("int8", "int4"):
+        raise ValueError(f"weight_dtype must be int8/int4, "
+                         f"got {weight_dtype!r}")
+    algo = "weight_only_int4" if weight_dtype == "int4" \
+        else "weight_only_int8"
+    _check(algo, group_size)
+    if weight_scale is None:
+        raise ValueError("weight_only_linear requires weight_scale "
+                         "(output of weight_quantize)")
+    args = [x, weight, weight_scale] + ([bias] if bias is not None else [])
+
+    def f(a, q, s, *b):
+        w = _dequant(q, s, algo, group_size, a.dtype)   # [out, in]
+        y = a @ w.T
+        if b:
+            y = y + b[0].astype(y.dtype)
+        return y
+
+    return apply("weight_only_linear", f, *args)
+
+
+def llm_int8_linear(x, weight, bias=None, weight_scale=None,
+                    threshold: float = 6.0):
+    """LLM.int8() linear: activation channels whose absmax exceeds
+    ``threshold`` bypass quantization (matmul the dequantized weight in
+    x.dtype); the rest run the int8 weight path. Static-shape form: the
+    channel mask selects between the two matmul results — no gather, so
+    one compiled program serves every outlier pattern."""
+    if weight_scale is None:
+        raise ValueError("llm_int8_linear requires weight_scale")
+    args = [x, weight, weight_scale] + ([bias] if bias is not None else [])
+
+    def f(a, q, s, *b):
+        af = a.astype(jnp.float32)
+        # per-channel outlier mask over the in-dim (reduce batch dims)
+        red = tuple(range(af.ndim - 1))
+        outlier = jnp.max(jnp.abs(af), axis=red) > threshold   # [in]
+        w = _dequant(q, s, "weight_only_int8", -1, jnp.float32)  # [o,i]
+        a_out = jnp.where(outlier, af, 0.0)
+        a_in = jnp.where(outlier, 0.0, af)
+        # inlier path: dynamic per-row int8 activations × int8 weight
+        row_max = jnp.max(jnp.abs(a_in), axis=-1, keepdims=True)
+        a_scale = jnp.maximum(row_max, 1e-9) / 127.0
+        a_q = jnp.clip(jnp.round(a_in / a_scale), -128, 127
+                       ).astype(jnp.int8)
+        acc = jnp.matmul(a_q, q.T.astype(jnp.int8),
+                         preferred_element_type=jnp.int32)
+        y_in = acc.astype(jnp.float32) * a_scale * s  # s: [out]
+        y_out = a_out @ w.T
+        y = (y_in + y_out).astype(a.dtype)
+        if b:
+            y = y + b[0].astype(y.dtype)
+        return y
+
+    return apply("llm_int8_linear", f, *args)

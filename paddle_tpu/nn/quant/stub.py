@@ -1,0 +1,37 @@
+"""Quantization stub (reference:
+/root/reference/python/paddle/nn/quant/stub.py Stub/QuanterStub).
+
+A placeholder sublayer marking where an activation observer should be
+inserted for a functional API call; QAT/PTQ conversion replaces it with
+the configured quanter. Identity until converted.
+"""
+from __future__ import annotations
+
+from ..layer.layers import Layer
+
+__all__ = ["Stub", "QuanterStub"]
+
+
+class Stub(Layer):
+    """Marks a quantization insertion point. ``observer`` is a quanter
+    layer/factory (or None to use the QuantConfig's global activation
+    quanter at conversion time)."""
+
+    def __init__(self, observer=None):
+        super().__init__()
+        self._observer = observer
+
+    def forward(self, x):
+        return x
+
+
+class QuanterStub(Layer):
+    """Converted form of Stub: wraps the materialized quanter and
+    applies it to the input (reference stub.py QuanterStub)."""
+
+    def __init__(self, quanter):
+        super().__init__()
+        self.quanter = quanter
+
+    def forward(self, x):
+        return self.quanter(x) if self.quanter is not None else x

@@ -186,26 +186,32 @@ class QAT:
         self._convert(target)
         return target
 
+    @staticmethod
+    def _make(cfg):
+        """Materialize a quanter from a config entry: registry name,
+        quanter class, instance, or None (= do not quantize)."""
+        if cfg is None:
+            return None
+        if isinstance(cfg, str):
+            return _QUANTERS.get(cfg)()
+        return cfg() if isinstance(cfg, type) else cfg
+
     def _convert(self, layer: Layer):
         from ..nn import Linear, Conv2D
+        from ..nn.quant.stub import QuanterStub, Stub
         for name, sub in list(layer.named_children()):
             if isinstance(sub, Conv2D):
                 a, w_cfg = self.config._config_for(sub)
-                make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
-                    cfg, str) else (cfg() if isinstance(cfg, type)
-                                    else cfg))
                 setattr(layer, name, QuantedConv2D(
-                    sub, make(a) if a is not None else None,
-                    make(w_cfg) if w_cfg is not None else None))
+                    sub, self._make(a), self._make(w_cfg)))
             elif isinstance(sub, Linear):
                 a, w = self.config._config_for(sub)
-                make = lambda cfg: (_QUANTERS.get(cfg)() if isinstance(
-                    cfg, str) else (cfg() if isinstance(cfg, type)
-                                    else cfg))
-                # None in the config means: do not quantize that tensor
                 setattr(layer, name, QuantedLinear(
-                    sub, make(a) if a is not None else None,
-                    make(w) if w is not None else None))
+                    sub, self._make(a), self._make(w)))
+            elif isinstance(sub, Stub):
+                a, _w = self.config._config_for(sub)
+                obs = sub._observer if sub._observer is not None else a
+                setattr(layer, name, QuanterStub(self._make(obs)))
             else:
                 self._convert(sub)
 

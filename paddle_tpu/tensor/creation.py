@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtypes
+from ..decomposition.register import DecompAware
 from ..framework.core import Tensor, apply, apply_nodiff, to_tensor
 
 __all__ = [
@@ -63,7 +64,10 @@ def ones_like(x, dtype=None, name=None):
 
 
 def full_like(x, fill_value, dtype=None, name=None):
-    return apply_nodiff("full_like", lambda a: jnp.full_like(a, fill_value, dtype=_d(dtype, np.dtype(x.dtype))), x)
+    d = _d(dtype, np.dtype(x.dtype))
+    return apply_nodiff("full_like", DecompAware(
+        "full_like", lambda a: jnp.full_like(a, fill_value, dtype=d),
+        fill_value=fill_value, dtype=d), x)
 
 
 def empty_like(x, dtype=None, name=None):

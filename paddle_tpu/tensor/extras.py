@@ -10,6 +10,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtypes
+from ..decomposition.register import DecompAware
 from ..framework.core import Tensor, apply, apply_nodiff, default_generator
 
 __all__ = [
@@ -28,7 +29,8 @@ def add_n(inputs, name=None):
     """Sum of a tensor list (reference math.py add_n)."""
     if isinstance(inputs, Tensor):
         return apply("add_n", lambda a: a, inputs)
-    return apply("add_n", lambda *xs: sum(xs[1:], xs[0]), *inputs)
+    return apply("add_n", DecompAware(
+        "add_n", lambda *xs: sum(xs[1:], xs[0])), *inputs)
 
 
 def as_complex(x, name=None):

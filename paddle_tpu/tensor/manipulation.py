@@ -9,6 +9,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtypes
+from ..decomposition.register import DecompAware
 from ..framework.core import Tensor, apply, apply_nodiff
 
 __all__ = [
@@ -76,7 +77,7 @@ def squeeze(x, axis=None, name=None):
         axes = tuple(ax % a.ndim for ax in axes)
         axes = tuple(ax for ax in axes if a.shape[ax] == 1)
         return jnp.squeeze(a, axis=axes) if axes else a
-    return apply("squeeze", f, x)
+    return apply("squeeze", DecompAware("squeeze", f, axis=axis), x)
 
 
 def squeeze_(x, axis=None, name=None):
@@ -92,7 +93,7 @@ def unsqueeze(x, axis, name=None):
         for ax in sorted(axes):
             out = jnp.expand_dims(out, ax)
         return out
-    return apply("unsqueeze", f, x)
+    return apply("unsqueeze", DecompAware("unsqueeze", f, axis=axes), x)
 
 
 def unsqueeze_(x, axis, name=None):
@@ -107,7 +108,8 @@ def concat(x, axis=0, name=None):
 
 
 def stack(x, axis=0, name=None):
-    return apply("stack", lambda *xs: jnp.stack(xs, axis=axis), *x)
+    return apply("stack", DecompAware(
+        "stack", lambda *xs: jnp.stack(xs, axis=axis), axis=axis), *x)
 
 
 def split(x, num_or_sections, axis=0, name=None):
@@ -236,7 +238,9 @@ def scatter_nd_add(x, index, updates, name=None):
 
 
 def index_select(x, index, axis=0, name=None):
-    return apply("index_select", lambda a, i: jnp.take(a, i, axis=axis), x, index)
+    return apply("index_select", DecompAware(
+        "index_select", lambda a, i: jnp.take(a, i, axis=axis),
+        axis=axis), x, index)
 
 
 def index_add(x, index, axis, value, name=None):

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework import dtype as dtypes
+from ..decomposition.register import DecompAware
 from ..framework.core import Tensor, apply, apply_nodiff
 
 __all__ = [
@@ -71,8 +72,12 @@ def float_power(x, y, name=None):
 
 
 def _uw(op_name, fn):
+    # DecompAware: any unary op picks up a registered decomposition rule
+    # under enable_prim() with no per-site wiring (see paddle.decomposition)
+    aware = DecompAware(op_name, fn)
+
     def op(x, name=None):  # `name` = paddle output-name arg
-        return apply(op_name, fn, x)
+        return apply(op_name, aware, x)
     op.__name__ = op_name
     return op
 
@@ -208,7 +213,9 @@ def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
 
 
 def mean(x, axis=None, keepdim=False, name=None):
-    return apply("mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim), x)
+    return apply("mean", DecompAware(
+        "mean", lambda a: jnp.mean(a, axis=_axis(axis), keepdims=keepdim),
+        axis=_axis(axis), keepdim=keepdim), x)
 
 
 def nanmean(x, axis=None, keepdim=False, name=None):

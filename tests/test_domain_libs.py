@@ -132,6 +132,46 @@ class TestAudio:
         assert sr2 == sr
         np.testing.assert_allclose(n(loaded), data, atol=1e-3)
 
+    @pytest.mark.parametrize("bits,atol", [(8, 2e-2), (24, 1e-6),
+                                           (32, 1e-8)])
+    def test_wav_backend_wide_pcm_roundtrip(self, tmp_path, bits, atol):
+        # wave_backend handles 8/24/32-bit PCM natively (24-bit packs
+        # 3-byte frames; load sign-extends them back)
+        sr = 8000
+        wavf = str(tmp_path / f"t{bits}.wav")
+        data = np.sin(np.linspace(0, 20, 800)).astype(np.float32)[None]
+        audio.backends.save(wavf, paddle.to_tensor(data), sr,
+                            bits_per_sample=bits)
+        info = audio.backends.info(wavf)
+        assert info.bits_per_sample == bits
+        assert info.num_samples == 800
+        loaded, sr2 = audio.backends.load(wavf)
+        assert sr2 == sr
+        np.testing.assert_allclose(n(loaded), data, atol=atol)
+
+    def test_wav_backend_full_scale_32bit(self, tmp_path):
+        # +1.0 at 32-bit: float32 scaling would overflow int32 and flip
+        # the sign — the save path must scale in float64 and clip
+        sr = 8000
+        wavf = str(tmp_path / "fs.wav")
+        data = np.array([[1.0, -1.0, 0.5]], np.float32)
+        audio.backends.save(wavf, paddle.to_tensor(data), sr,
+                            bits_per_sample=32)
+        loaded, _ = audio.backends.load(wavf)
+        np.testing.assert_allclose(n(loaded), data, atol=1e-6)
+
+    def test_wav_backend_stereo_24bit(self, tmp_path):
+        sr = 16000
+        wavf = str(tmp_path / "st.wav")
+        data = np.stack([np.sin(np.linspace(0, 10, 400)),
+                         np.cos(np.linspace(0, 10, 400))]).astype(
+                             np.float32)
+        audio.backends.save(wavf, paddle.to_tensor(data), sr,
+                            bits_per_sample=24)
+        loaded, _ = audio.backends.load(wavf)
+        assert n(loaded).shape == (2, 400)
+        np.testing.assert_allclose(n(loaded), data, atol=1e-6)
+
 
 class TestText:
     def test_viterbi_decode_simple(self):

@@ -62,6 +62,7 @@ NAMESPACES = {
     "paddle.incubate.nn.functional": ("incubate/nn/functional/"
                                       "__init__.py", "__all__"),
     "paddle.quantization": ("quantization/__init__.py", "__all__"),
+    "paddle.nn.quant": ("nn/quant/__init__.py", "__all__"),
     "paddle.inference": ("inference/__init__.py", "__all__"),
 }
 
