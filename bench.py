@@ -357,7 +357,7 @@ def run_serving_capacity(concurrency=8):
         model, max_batch_size=concurrency,
         num_blocks=concurrency * ((128 + new_tokens) // block_size + 2)
         + 8, block_size=block_size, prompt_buckets=(128,),
-        chunk_size=16)
+        chunk_schedule=(16, 64))
     eng.warmup()
     rng = np.random.RandomState(0)
     t0 = time.perf_counter()
@@ -454,8 +454,14 @@ def run_pp():
         ms = _timed_scan_diff(make, 10, params, lp, xs, ys) * 1e3
         out["pp_step_ms_remat" if remat else "pp_step_ms_store"] = \
             round(ms, 2)
-    out["pp_remat_overhead_x"] = round(
-        out["pp_step_ms_remat"] / out["pp_step_ms_store"], 3)
+    if out["pp_step_ms_store"] >= 0.01:
+        out["pp_remat_overhead_x"] = round(
+            out["pp_step_ms_remat"] / out["pp_step_ms_store"], 3)
+    else:
+        # a collapsed dispatch diff (timing noise swallowed the delta)
+        # must not crash the suite — flag it instead
+        out["pp_remat_overhead_x"] = None
+        out["pp_timing_note"] = "store-mode dispatch diff collapsed"
     # analytic bubble (cost-aware: the engine cond-skips invalid slots,
     # so a tick costs what its busiest stage runs — see
     # PipelineSchedule.tick_costs)

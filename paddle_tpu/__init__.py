@@ -51,6 +51,8 @@ from . import text  # noqa: F401
 from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from . import decomposition  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import onnx  # noqa: F401
 from . import device  # noqa: F401
 from . import regularizer  # noqa: F401
 from .hapi import callbacks  # noqa: F401  — paddle.callbacks alias

@@ -129,7 +129,8 @@ class ServingEngine:
                  prompt_buckets: Sequence[int] = (32, 64, 128, 256, 512),
                  weight_dtype: Optional[str] = None, top_k: int = 0,
                  chunk_size: int = 8, seed: int = 0,
-                 overlap: bool = True, mesh=None):
+                 overlap: bool = True, mesh=None,
+                 chunk_schedule: Optional[Sequence[int]] = None):
         self.dec = PagedLlamaDecoder(model, num_blocks=num_blocks,
                                      block_size=block_size,
                                      weight_dtype=weight_dtype,
@@ -137,7 +138,18 @@ class ServingEngine:
         self.max_b = int(max_batch_size)
         self.buckets = tuple(sorted(prompt_buckets))
         self.top_k = int(top_k)
-        self.chunk = max(1, int(chunk_size))
+        # chunk ladder (adaptive decode granularity): each dispatch
+        # picks a rung via _pick_chunk — after warmup, the rung
+        # maximizing measured tokens/sec for the current slot budgets
+        # (big chunks amortize host round trips; small chunks keep slot
+        # turnover and admission prompt). Single-entry schedule (the
+        # default) = fixed chunk.
+        if chunk_schedule:
+            self.chunks = tuple(sorted({max(1, int(c))
+                                        for c in chunk_schedule}))
+        else:
+            self.chunks = (max(1, int(chunk_size)),)
+        self.chunk = self.chunks[0]
         # overlap: dispatch decode chunk t+1 (first tokens taken from
         # chunk t's DEVICE output) before fetching chunk t's tokens, so
         # host admission/bookkeeping runs while the device decodes.
@@ -169,6 +181,10 @@ class ServingEngine:
         self.time_stall_s = 0.0
         self.time_host_s = 0.0
         self._zeros_seen_cache: Dict[int, jax.Array] = {}
+        # per-rung measured chunk cost (seconds/chunk), built by warmup;
+        # empty → _pick_chunk uses the zero-waste heuristic
+        self._chunk_cost: Dict[int, float] = {}
+        self._force_chunk: Optional[int] = None
 
         dec = self.dec
 
@@ -462,6 +478,49 @@ class ServingEngine:
                    r.sampling.repetition_penalty != 1.0
                    for r in self._slots)
 
+    def _pick_chunk(self, active) -> int:
+        """Pick the ladder rung for this chunk.
+
+        With a measured per-rung cost table (built by warmup): maximize
+        delivered tokens per second — tokens(c) = sum over active slots
+        of min(c, remaining budget); cost(c) was measured on THIS
+        device/link. Overshooting a slot's budget (it idles on the
+        scratch page for the tail) is chosen exactly when the per-chunk
+        overhead (e.g. host↔device round trip) outweighs the wasted
+        steps — a property of the deployment, not a constant.
+
+        Without the table (warmup not run): zero-waste heuristic —
+        largest rung every budget covers when idle; when requests are
+        queued, largest rung the SOONEST-draining slot covers (so its
+        slot frees promptly). Either way, queue pressure with EOS-able
+        requests pins the smallest rung: such a slot may free any step.
+        """
+        if len(self.chunks) == 1:
+            return self.chunks[0]
+        if self._queue and any(
+                self._slots[si].sampling.eos_token_id is not None
+                for si in active):
+            return self.chunks[0]
+        lefts = [self._slots[si].sampling.max_new_tokens
+                 - self._slots[si].planned for si in active]
+        if self._chunk_cost:
+            best, best_rate = self.chunks[0], -1.0
+            for c in self.chunks:
+                cost = self._chunk_cost.get(c)
+                if cost is None:
+                    continue
+                tokens = sum(min(c, max(0, lf)) for lf in lefts)
+                rate = tokens / cost
+                if rate > best_rate + 1e-9:
+                    best, best_rate = c, rate
+            return best
+        bound = min(lefts) if self._queue else max(lefts)
+        best = self.chunks[0]
+        for c in self.chunks[1:]:
+            if c <= bound:
+                best = c
+        return best
+
     def _dispatch_chunk(self) -> bool:
         """Dispatch ONE decode chunk for the current active slots
         without waiting for the previous chunk: first tokens of
@@ -475,7 +534,8 @@ class ServingEngine:
         if not active:
             self.time_host_s += time.perf_counter() - t0
             return False
-        T, mb, mp = self.chunk, self.max_b, self.dec.max_pages
+        T = self._force_chunk or self._pick_chunk(active)
+        mb, mp = self.max_b, self.dec.max_pages
         # host-precomputed page schedule: slots past their token budget
         # (or inactive) aim at the scratch page for the rest of the chunk
         tables = np.full((T, mb, mp), self._scratch_block, np.int32)
@@ -653,19 +713,45 @@ class ServingEngine:
                 self.add_request(np.ones(plen, np.int32),
                                  SamplingParams(max_new_tokens=2))
             self.run_to_completion()
-        # rich-sampling decode program (one per engine, bucket-
-        # independent): top_k=1 is greedy, so this throwaway request is
-        # deterministic but routes through _decode_rich_j. It spans
-        # MULTIPLE decode chunks so the overlap-mode _merge_first_j
-        # (chunk-to-chunk first-token gather) compiles here too.
-        self.add_request(np.ones(plens[0], np.int32),
-                         SamplingParams(max_new_tokens=self.chunk + 2,
-                                        temperature=1.0, top_k=1))
-        self.run_to_completion()
-        # ... and the PLAIN multi-chunk path (merge over _decode_j)
-        self.add_request(np.ones(plens[0], np.int32),
-                         SamplingParams(max_new_tokens=self.chunk + 2))
-        self.run_to_completion()
+        # rich-sampling + plain decode programs, once per ladder chunk
+        # size (each T is its own compiled program): top_k=1 is greedy,
+        # so the rich throwaway is deterministic but routes through
+        # _decode_rich_j. Spanning MULTIPLE decode chunks also compiles
+        # the overlap-mode _merge_first_j chunk-to-chunk gather.
+        for c in self.chunks:
+            # pin the rung: the heuristic could skip a middle rung whose
+            # budget lands on a bigger one (its compile would then leak
+            # into the timed cost loop below)
+            self._force_chunk = c
+            try:
+                self.add_request(np.ones(plens[0], np.int32),
+                                 SamplingParams(max_new_tokens=c + 2,
+                                                temperature=1.0,
+                                                top_k=1))
+                self.run_to_completion()
+                self.add_request(np.ones(plens[0], np.int32),
+                                 SamplingParams(max_new_tokens=c + 2))
+                self.run_to_completion()
+            finally:
+                self._force_chunk = None
+        # measure each rung's steady chunk cost (compiles are done):
+        # one request pinned to rung c for 3 chunks; the stall+host
+        # delta over 3 chunks is the per-chunk cost _pick_chunk's
+        # tokens/cost policy uses
+        if len(self.chunks) > 1:
+            for c in self.chunks:
+                self._force_chunk = c
+                try:
+                    before = self.time_stall_s + self.time_host_s
+                    self.add_request(
+                        np.ones(plens[0], np.int32),
+                        SamplingParams(max_new_tokens=3 * c))
+                    self.run_to_completion()
+                    delta = (self.time_stall_s + self.time_host_s
+                             - before)
+                finally:
+                    self._force_chunk = None
+                self._chunk_cost[c] = max(delta / 3.0, 1e-6)
         self.clear_finished()
 
     def clear_finished(self):

@@ -1,0 +1,22 @@
+"""paddle.onnx — ONNX export surface (reference:
+/root/reference/python/paddle/onnx/export.py, a thin wrapper over the
+external paddle2onnx package).
+
+Descoped with a loud redirect: ONNX is a CPU/GPU-runtime interchange
+format; the TPU-native deployment artifact is StableHLO —
+``paddle.jit.save`` exports a jax.export archive that the serving stack
+(inference.Config/create_predictor) loads AOT. See COVERAGE.md descope
+table.
+"""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    raise NotImplementedError(
+        "paddle.onnx.export is not provided by this TPU-native build "
+        "(the reference delegates to the external paddle2onnx package). "
+        "Export a StableHLO artifact instead: paddle.jit.save(layer, "
+        "path, input_spec=...) produces an AOT archive servable via "
+        "paddle.inference.Config/create_predictor.")

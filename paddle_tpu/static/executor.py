@@ -133,13 +133,17 @@ class Executor:
         trainable = [p for p in params if not p.stop_gradient] \
             if spec is not None else []
 
+        from ..decomposition.register import prim_enabled
         key = (program.id, program.version,
                tuple(id(v) for v in fetch_vars), tuple(feed_names),
                tuple((a.shape, str(a.dtype)) for a in feed_arrays),
                # compiled step closes over the optimizer and loss: a new
                # minimize() must recompile, not reuse the old update rule
                None if spec is None else (id(spec["optimizer"]),
-                                          id(spec["loss"])))
+                                          id(spec["loss"])),
+               # DecompAware kernels read the prim flag at trace time —
+               # a toggle must recompile, not reuse the other mode's trace
+               prim_enabled())
         compiled = self._cache.get(key)
         if compiled is None:
             compiled = self._build(program, fetch_vars, feed_names,

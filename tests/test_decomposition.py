@@ -204,6 +204,32 @@ class TestStaticDecompose:
         decomposition.decompose(main2, whitelist={"mean"})
         assert main2._decomposed_ops == ("mean",)
 
+    def test_executor_cache_keys_on_prim_flag(self):
+        # toggling enable_prim between exe.run calls must recompile,
+        # not reuse the other mode's trace
+        from paddle_tpu.decomposition.register import _decomposition_ops
+        calls = {"n": 0}
+        orig = _decomposition_ops.rules["gelu"]
+
+        def counting_gelu(x, approximate=False):
+            calls["n"] += 1
+            return orig(x, approximate=approximate)
+
+        _decomposition_ops.rules["gelu"] = counting_gelu
+        try:
+            main, out = self._build()
+            feed = {"x": _rand(4, 8)}
+            exe = static.Executor()
+            ref = exe.run(main, feed=feed, fetch_list=[out])[0]
+            assert calls["n"] == 0
+            decomposition.enable_prim()
+            got = exe.run(main, feed=feed, fetch_list=[out])[0]
+            decomposition.disable_prim()
+            assert calls["n"] >= 1
+            np.testing.assert_allclose(got, ref, atol=1e-5)
+        finally:
+            _decomposition_ops.rules["gelu"] = orig
+
     def test_bad_rule_fails_aval_check(self):
         from paddle_tpu.decomposition.register import _decomposition_ops
         _decomposition_ops.rules["__bad_op__"] = lambda x: x[:2]

@@ -639,6 +639,135 @@ class TestPerRequestSampling:
         assert st["time_host_s"] >= 0
 
 
+class TestChunkLadder:
+    """Adaptive chunk schedule (r4): big decode chunks when the queue is
+    idle and budgets are long, small chunks under churn — same tokens
+    either way (greedy decoding is chunk-partition invariant)."""
+
+    def setup_method(self):
+        paddle.seed(0)
+        self.cfg = llama_tiny()
+        self.model = LlamaForCausalLM(self.cfg)
+        self.model.eval()
+        self.rng = np.random.RandomState(7)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16))
+        return ServingEngine(self.model, **kw)
+
+    def _reqs(self, news=(20, 20)):
+        from paddle_tpu.inference import SamplingParams
+        return [(self.rng.randint(0, self.cfg.vocab_size, (6,))
+                 .astype(np.int32), SamplingParams(max_new_tokens=m))
+                for m in news]
+
+    def test_ladder_tokens_match_fixed_chunk(self):
+        reqs = self._reqs()
+        eng = self._engine(chunk_schedule=(4, 16))
+        ids = [eng.add_request(p, s) for p, s in reqs]
+        got = eng.run_to_completion()
+        ref_eng = self._engine(chunk_size=4)
+        ref_ids = [ref_eng.add_request(p, s) for p, s in reqs]
+        ref = ref_eng.run_to_completion()
+        for a, b in zip(ids, ref_ids):
+            np.testing.assert_array_equal(got[a], ref[b])
+
+    def test_big_chunk_picked_when_idle(self):
+        eng = self._engine(chunk_schedule=(4, 16))
+        for p, s in self._reqs((20, 20)):
+            eng.add_request(p, s)
+        sizes = []
+        while eng.step():
+            if eng._inflight:
+                sizes.append(eng._inflight[-1]["T"])
+        assert 16 in sizes          # long budgets + empty queue → big
+        assert 4 in sizes           # drained tails fall down the ladder
+
+    def test_queue_pressure_forces_small_chunk_only_with_eos(self):
+        from paddle_tpu.inference import SamplingParams
+        # no EOS: budgets fully determine slot turnover, so a queued
+        # request gains nothing from small chunks — big rung stays
+        eng = self._engine(chunk_schedule=(4, 16))
+        for p, s in self._reqs((20, 20, 20)):
+            eng.add_request(p, s)
+        sizes_queued = []
+        while eng.step():
+            if eng._inflight and eng._queue:
+                sizes_queued.append(eng._inflight[-1]["T"])
+        assert sizes_queued and 16 in sizes_queued
+        # with EOS possible the slot may free any step: queue pressure
+        # must force the small rung for prompt admission
+        eng = self._engine(chunk_schedule=(4, 16))
+        for p, _ in self._reqs((20, 20, 20)):
+            eng.add_request(p, SamplingParams(max_new_tokens=20,
+                                              eos_token_id=-1))
+        sizes_queued = []
+        while eng.step():
+            if eng._inflight and eng._queue:
+                sizes_queued.append(eng._inflight[-1]["T"])
+        assert sizes_queued and set(sizes_queued) == {4}
+
+    def test_cost_table_drives_rate_policy(self):
+        # with measured costs, the rung maximizing tokens/cost wins —
+        # including OVERSHOOT when per-chunk overhead dominates
+        eng = self._engine(chunk_schedule=(4, 16))
+        for p, s in self._reqs((10, 10)):   # budgets below the big rung
+            eng.add_request(p, s)
+        # overhead-dominated link: 16-rung costs barely more than 4 →
+        # overshooting the 10-token budgets still delivers more tok/s
+        eng._chunk_cost = {4: 0.100, 16: 0.110}
+        sizes = []
+        while eng.step():
+            if eng._inflight:
+                sizes.append(eng._inflight[-1]["T"])
+        assert set(sizes) == {16}
+        # compute-dominated device: cost scales with steps → zero-waste
+        eng2 = self._engine(chunk_schedule=(4, 16))
+        for p, s in self._reqs((10, 10)):
+            eng2.add_request(p, s)
+        eng2._chunk_cost = {4: 0.100, 16: 0.400}
+        sizes2 = []
+        while eng2.step():
+            if eng2._inflight:
+                sizes2.append(eng2._inflight[-1]["T"])
+        # 9 left: 4-rung rate 8/0.1=80 vs 16-rung 18/0.4=45 → small
+        assert 4 in sizes2 and 16 not in sizes2
+
+    def test_warmup_builds_cost_table(self):
+        eng = self._engine(chunk_schedule=(4, 8))
+        eng.warmup(prompt_len=8)
+        assert set(eng._chunk_cost) == {4, 8}
+        assert all(c > 0 for c in eng._chunk_cost.values())
+        assert not eng.has_work     # warmup drains its own requests
+
+    def test_warmup_compiles_every_rung_even_close_spacing(self):
+        # rungs 2 apart: the idle heuristic would skip the middle rung
+        # (budget c+2 lands on the next one) — warmup must pin each so
+        # no compile leaks into the timed cost measurement
+        eng = self._engine(chunk_schedule=(4, 6, 8))
+        seen = set()
+        orig = eng._decode_j
+
+        def spy(*a, **k):
+            seen.add(int(a[4].shape[0]))     # tables [T, mb, mp]
+            return orig(*a, **k)
+
+        eng._decode_j = spy
+        eng.warmup(prompt_len=8)
+        assert {4, 6, 8} <= seen
+
+    def test_short_budget_uses_small_chunk(self):
+        eng = self._engine(chunk_schedule=(4, 16))
+        for p, s in self._reqs((5, 5)):
+            eng.add_request(p, s)
+        got = eng.run_to_completion()
+        assert all(len(v) == 5 for v in got.values())
+
+
 @pytest.mark.skipif(jax.device_count() < 2, reason="needs 2+ devices")
 class TestTPServing:
     """VERDICT r3 #4: TP-sharded serving over the mp axis must equal the

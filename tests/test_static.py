@@ -156,3 +156,57 @@ def test_eager_unaffected_after_static_session():
     t = paddle.ones([2, 2]) * 3
     assert float(t.sum().numpy()) == 12.0
     paddle.enable_static()
+
+
+class TestCostModel:
+    """paddle.cost_model over static Programs (reference
+    python/paddle/cost_model/cost_model.py — here measured on-device
+    instead of loaded from a GPU calibration JSON)."""
+
+    def test_profile_measure_and_lookup(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.cost_model import CostModel
+
+        cm = CostModel()
+        startup, main = cm.build_program()
+        prof = cm.profile_measure(startup, main, device="cpu")
+        assert prof, "profile should contain measured nodes"
+        for rec in prof.values():
+            assert rec["op_time"] >= 0 and rec["calls"] >= 1
+            assert len(rec["per_call"]) == rec["calls"]
+        some_op = next(iter(prof))
+        t = cm.get_static_op_time(some_op)
+        assert t["op_time"] >= 0
+        assert cm.get_static_op_time("no_such_op") == {}
+        with pytest.raises(ValueError):
+            cm.get_static_op_time("")
+
+    def test_static_cost_data_requires_measurement(self):
+        from paddle_tpu.cost_model import CostModel
+        with pytest.raises(RuntimeError, match="profile_measure"):
+            CostModel().static_cost_data()
+
+    def test_feed_overrides_default_zeros(self):
+        import numpy as np
+        import paddle_tpu as paddle
+        from paddle_tpu import static
+        from paddle_tpu.cost_model import CostModel
+
+        paddle.enable_static()
+        try:
+            main = static.Program()
+            with static.program_guard(main, static.Program()):
+                x = static.data("x", [4, 8], "float32")
+                paddle.mean(x * 2.0)
+        finally:
+            paddle.disable_static()
+        cm = CostModel()
+        prof = cm.profile_measure(
+            None, main, feed={"x": np.ones((4, 8), np.float32)})
+        assert sum(r["calls"] for r in prof.values()) == len(main.nodes)
+
+
+def test_onnx_export_is_loud():
+    import paddle_tpu as paddle
+    with pytest.raises(NotImplementedError, match="StableHLO"):
+        paddle.onnx.export(None, "/tmp/x")

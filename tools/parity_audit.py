@@ -63,6 +63,8 @@ NAMESPACES = {
                                       "__init__.py", "__all__"),
     "paddle.quantization": ("quantization/__init__.py", "__all__"),
     "paddle.nn.quant": ("nn/quant/__init__.py", "__all__"),
+    "paddle.onnx": ("onnx/__init__.py", "__all__"),
+    "paddle.cost_model": ("cost_model/__init__.py", "__all__"),
     "paddle.inference": ("inference/__init__.py", "__all__"),
 }
 
