@@ -719,6 +719,12 @@ class ServingEngine:
         # _decode_rich_j. Spanning MULTIPLE decode chunks also compiles
         # the overlap-mode _merge_first_j chunk-to-chunk gather.
         for c in self.chunks:
+            if -(-(plens[0] + c + 2) // cache.block_size) > \
+                    cache.free_blocks:
+                _warnings.warn(
+                    f"warmup: pool too small to warm chunk rung {c}; "
+                    f"its first real dispatch will pay the compile")
+                continue
             # pin the rung: the heuristic could skip a middle rung whose
             # budget lands on a bigger one (its compile would then leak
             # into the timed cost loop below)
@@ -740,18 +746,36 @@ class ServingEngine:
         # tokens/cost policy uses
         if len(self.chunks) > 1:
             for c in self.chunks:
+                # clamp the measurement to the pool: a production pool
+                # sized for small budgets must not fail warmup. Prefer
+                # 3 chunks; fall back to fewer; skip the rung (leaving
+                # it out of the cost table) if even one doesn't fit.
+                n_chunks = 3
+                while n_chunks > 0:
+                    need = -(-(plens[0] + n_chunks * c)
+                             // cache.block_size)
+                    if need <= cache.free_blocks:
+                        break
+                    n_chunks -= 1
+                if n_chunks == 0:
+                    _warnings.warn(
+                        f"warmup: pool too small to measure chunk rung "
+                        f"{c} (needs {-(-(plens[0] + c) // cache.block_size)} "
+                        f"free pages); rung left uncosted — the rate "
+                        f"policy will not select it")
+                    continue
                 self._force_chunk = c
                 try:
                     before = self.time_stall_s + self.time_host_s
                     self.add_request(
                         np.ones(plens[0], np.int32),
-                        SamplingParams(max_new_tokens=3 * c))
+                        SamplingParams(max_new_tokens=n_chunks * c))
                     self.run_to_completion()
                     delta = (self.time_stall_s + self.time_host_s
                              - before)
                 finally:
                     self._force_chunk = None
-                self._chunk_cost[c] = max(delta / 3.0, 1e-6)
+                self._chunk_cost[c] = max(delta / n_chunks, 1e-6)
         self.clear_finished()
 
     def clear_finished(self):

@@ -84,13 +84,22 @@ def _evaluate(program: Program, env: Dict[int, Any],
             continue
         if all(id(v) in env for v in node.out_vars):
             continue
-        vals = [value_of(a) for a in node.args]
-        fn = program._node_overrides.get(id(node), node.fn)
+        fn, vals = resolve_node(program, node, value_of)
         out = fn(*vals, **node.kwargs)
         outs = list(out) if isinstance(out, (tuple, list)) else [out]
         for v, o in zip(node.out_vars, outs):
             env[id(v)] = o
     return [value_of(t) for t in targets]
+
+
+def resolve_node(program, node, value_of):
+    """The one place node-execution semantics live (arg resolution +
+    override lookup) — shared by the executor walk above and
+    cost_model.profile_measure so the profiled semantics can never
+    drift from the executed ones."""
+    vals = [value_of(a) for a in node.args]
+    fn = program._node_overrides.get(id(node), node.fn)
+    return fn, vals
 
 
 class Executor:

@@ -760,6 +760,28 @@ class TestChunkLadder:
         eng.warmup(prompt_len=8)
         assert {4, 6, 8} <= seen
 
+    def test_warmup_survives_small_pool(self):
+        # pool sized for short production budgets: the big rung's cost
+        # measurement must clamp the chunk count (or skip the rung),
+        # never raise at startup
+        eng = self._engine(chunk_schedule=(4, 16), num_blocks=5,
+                           block_size=8, prompt_buckets=(8,))
+        eng.warmup(prompt_len=8)         # must not raise
+        assert not eng.has_work
+        assert 4 in eng._chunk_cost      # small rung still measured
+        # a pool too tight even for one big-rung chunk: rung skipped
+        # with a warning, engine still serves
+        eng2 = self._engine(chunk_schedule=(4, 32), num_blocks=4,
+                            block_size=8, prompt_buckets=(8,))
+        with pytest.warns(UserWarning):
+            eng2.warmup(prompt_len=8)
+        assert 32 not in eng2._chunk_cost
+        from paddle_tpu.inference import SamplingParams
+        rid = eng2.add_request(np.ones(6, np.int32),
+                               SamplingParams(max_new_tokens=8))
+        out = eng2.run_to_completion()
+        assert len(out[rid]) == 8
+
     def test_short_budget_uses_small_chunk(self):
         eng = self._engine(chunk_schedule=(4, 16))
         for p, s in self._reqs((5, 5)):
