@@ -103,7 +103,7 @@ def run_llama(config: str = "mid"):
         loss = step(ids, ids)
     float(loss)
 
-    dt = _timed_train_steps(step, ids, iters) * iters
+    dt = _timed_train_steps(step, ids, ids, iters) * iters
     final = float(step(ids, ids))   # loss AFTER all trained steps
     tokens_per_sec = batch * seq * iters / dt
     n_params = model.num_params()
@@ -134,13 +134,13 @@ def _mfu(tokens_per_sec, n_params, cfg, seq):
     return tokens_per_sec * fpt / detect_peak_flops()
 
 
-def _timed_train_steps(step, ids, iters):
+def _timed_train_steps(step, inputs, labels, iters):
     """Per-step wall seconds of a TrainStep via dispatch-count
     differencing (cancels the ~75 ms tunnel fetch RTT that polluted the
     r2/r3 numbers — see paddle_tpu.utils.timing)."""
     from paddle_tpu.utils.timing import timed_dispatch_diff
     return timed_dispatch_diff(lambda a, b: step(a, b)._value,
-                               (ids, ids), calls=(2, 2 + iters),
+                               (inputs, labels), calls=(2, 2 + iters),
                                repeats=2)
 
 
@@ -178,7 +178,7 @@ def run_moe():
         for _ in range(2):
             loss = step(ids, ids)
         float(loss)
-        tok = batch * seq / _timed_train_steps(step, ids, iters)
+        tok = batch * seq / _timed_train_steps(step, ids, ids, iters)
         out[f"moe_{mode}_tok_per_sec"] = round(tok, 1)
         out[f"moe_{mode}_mfu_activated"] = round(
             _mfu(tok, model.num_activated_params(), cfg, seq), 4)
@@ -218,6 +218,51 @@ def run_resnet():
     dt = time.perf_counter() - t0
     return {"resnet50_imgs_per_sec": round(batch * iters / dt, 1),
             "resnet50_step_ms": round(1000 * dt / iters, 2)}
+
+
+def run_dit():
+    """DiT-XL/2 diffusion-transformer training row (BASELINE.md configs:
+    SD3/DiT class). 256px-latent setup: [B, 4, 32, 32] noisy latents,
+    class conditioning, MSE to the noise target. MFU uses the PaLM
+    formula over the 256-token patch sequence."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer
+    from paddle_tpu.models.dit import DiT, dit_xl_2
+
+    paddle.seed(0)
+    cfg = dit_xl_2(dtype="bfloat16", learn_sigma=False)
+    batch, iters = 32, 8
+    model = DiT(cfg)
+    opt = optimizer.AdamW(parameters=model.parameters(),
+                          learning_rate=1e-4)
+
+    def loss_fn(out, target):
+        import paddle_tpu.nn.functional as F
+        return F.mse_loss(out, target)
+
+    step = paddle.jit.TrainStep(model, loss_fn, opt)
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(
+        rng.randn(batch, 4, 32, 32).astype(np.float32)).astype("bfloat16")
+    t = paddle.to_tensor(rng.randint(0, 1000, batch).astype(np.int32))
+    y = paddle.to_tensor(
+        rng.randint(0, cfg.num_classes, batch).astype(np.int32))
+    noise = paddle.to_tensor(
+        rng.randn(batch, 4, 32, 32).astype(np.float32)).astype("bfloat16")
+    for _ in range(2):
+        loss = step((x, t, y), noise)
+    float(loss)
+    dt = _timed_train_steps(step, (x, t, y), noise, iters) * iters
+    n_params = model.num_params()
+    n_tokens = (cfg.input_size // cfg.patch_size) ** 2
+    imgs_per_sec = batch * iters / dt
+    flops_per_img = 6 * n_params * n_tokens + \
+        12 * cfg.depth * cfg.hidden_size * n_tokens ** 2
+    mfu = imgs_per_sec * flops_per_img / detect_peak_flops()
+    return {"dit_xl2_imgs_per_sec": round(imgs_per_sec, 1),
+            "dit_xl2_mfu": round(mfu, 4),
+            "dit_xl2_params": n_params,
+            "dit_xl2_step_ms": round(1000 * dt / iters, 2)}
 
 
 def run_decode():
@@ -569,6 +614,11 @@ def main(mode: str):
         result = {"metric": "pp_remat_overhead_x", "unit": "x",
                   "vs_baseline": 0.0, "value": r["pp_remat_overhead_x"],
                   "extra": r}
+    elif mode == "dit":
+        r = run_dit()
+        result = {"metric": "dit_xl2_imgs_per_sec", "unit": "imgs/s",
+                  "vs_baseline": 0.0,
+                  "value": r["dit_xl2_imgs_per_sec"], "extra": r}
     elif mode == "moe":
         r = run_moe()
         result = {"metric": "moe_ragged_tok_per_sec", "unit": "tokens/s",
@@ -596,7 +646,7 @@ def main(mode: str):
             gc.collect()  # release the failed attempt's HBM promptly
         for name, fn in (("resnet", run_resnet), ("decode", run_decode),
                          ("serving", run_serving_suite), ("pp", run_pp),
-                         ("moe", run_moe)):
+                         ("moe", run_moe), ("dit", run_dit)):
             try:
                 result["extra"].update(fn())
             except Exception as e:
@@ -606,7 +656,7 @@ def main(mode: str):
 
 
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
-                "resnet", "decode", "serving", "pp", "moe")
+                "resnet", "decode", "serving", "pp", "moe", "dit")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

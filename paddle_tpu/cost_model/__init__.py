@@ -13,7 +13,6 @@ numbers from the actual chip rather than a calibration file.
 """
 from __future__ import annotations
 
-import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -78,20 +77,12 @@ class CostModel:
                 return x._value
             return x
 
-        def fetch(out):
-            # force completion with a host fetch of one leaf: the axon
-            # tunnel acknowledges block_until_ready without draining the
-            # queue (see utils/timing.py), so only a value crossing to
-            # the host proves the op ran. The fetch round trip is
-            # cancelled below by differencing two repeat counts.
-            np.asarray(jax.tree_util.tree_leaves(out)[0])
-
         from ..jit.partial_capture import _fp_const, _fp_fn
         from ..static.executor import resolve_node
+        from ..utils.timing import timed_dispatch_diff
 
         jit_cache: Dict[tuple, object] = {}
         profile: Dict[str, dict] = {}
-        n_lo, n_hi = 1, 1 + max(1, repeats)
         for node in main_program.nodes:
             fn, vals = resolve_node(main_program, node, value_of)
             # reuse the compiled kernel across structurally identical
@@ -113,19 +104,14 @@ class CostModel:
                               _fn(*xs, **_kw))
                 if key is not None:
                     jit_cache[key] = jfn
-            out = jfn(*vals)
-            fetch(out)                          # compile + warm
-            # (T(n_hi calls) - T(n_lo calls)) / (n_hi - n_lo): the
-            # constant per-measurement fetch round trip cancels
-            ts = {}
-            for n_calls in (n_lo, n_hi):
-                t0 = time.perf_counter()
-                o = None
-                for _ in range(n_calls):
-                    o = jfn(*vals)
-                fetch(o)
-                ts[n_calls] = time.perf_counter() - t0
-            best = max(ts[n_hi] - ts[n_lo], 0.0) / (n_hi - n_lo)
+            out = jfn(*vals)                    # compile + warm +
+            np.asarray(jax.tree_util.tree_leaves(out)[0])  # env values
+            # fetch-forced dispatch-count differencing with min-over-
+            # repeats and a positive floor — the one timing recipe
+            # (utils/timing.py), not a local re-derivation
+            best = timed_dispatch_diff(
+                jfn, tuple(vals), calls=(1, 1 + max(1, repeats)),
+                repeats=2)
             outs = list(out) if isinstance(out, (tuple, list)) else [out]
             for v, o in zip(node.out_vars, outs):
                 env[id(v)] = o

@@ -718,6 +718,7 @@ class ServingEngine:
         # so the rich throwaway is deterministic but routes through
         # _decode_rich_j. Spanning MULTIPLE decode chunks also compiles
         # the overlap-mode _merge_first_j chunk-to-chunk gather.
+        warmed_rungs = set()
         for c in self.chunks:
             if -(-(plens[0] + c + 2) // cache.block_size) > \
                     cache.free_blocks:
@@ -725,6 +726,7 @@ class ServingEngine:
                     f"warmup: pool too small to warm chunk rung {c}; "
                     f"its first real dispatch will pay the compile")
                 continue
+            warmed_rungs.add(c)
             # pin the rung: the heuristic could skip a middle rung whose
             # budget lands on a bigger one (its compile would then leak
             # into the timed cost loop below)
@@ -746,6 +748,11 @@ class ServingEngine:
         # tokens/cost policy uses
         if len(self.chunks) > 1:
             for c in self.chunks:
+                if c not in warmed_rungs:
+                    # never time an un-warmed rung: the measurement
+                    # would absorb its XLA compile and the rate policy
+                    # would shun the rung forever
+                    continue
                 # clamp the measurement to the pool: a production pool
                 # sized for small budgets must not fail warmup. Prefer
                 # 3 chunks; fall back to fewer; skip the rung (leaving

@@ -741,8 +741,21 @@ class TrainStep:
     def __call__(self, inputs, labels):
         """inputs / labels: a Tensor or tuple of Tensors. Model is called as
         model(*inputs); loss as loss_fn(model_out, *labels)."""
+        # DecompAware kernels read the prim flag at trace time: a toggle
+        # must rebuild, not silently keep the other mode's trace (same
+        # contract as to_static's (training, prim) mode token)
+        if getattr(self, "_built_prim", None) is not None and \
+                self._built_prim != _prim():
+            self._compiled = None
+            self._gm_compiled = None
+            # a partial gradient-merge window would blend gradients
+            # traced under both decomposition modes — drop it and
+            # restart the window cleanly
+            self._gm_accum = None
+            self._step_i -= self._step_i % self._gm_k
         first = self._compiled is None and self._gm_compiled is None
         if first:
+            self._built_prim = _prim()
             if self._gm_k > 1:
                 self._gm_compiled = self._build_gm()
             else:

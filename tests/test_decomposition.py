@@ -286,6 +286,39 @@ class TestJitInteraction:
             _decomposition_ops.rules["gelu"] = orig
 
 
+class TestTrainStepInteraction:
+    def test_enable_prim_rebuilds_train_step(self):
+        from paddle_tpu import jit, nn, optimizer
+        from paddle_tpu.decomposition.register import _decomposition_ops
+
+        calls = {"n": 0}
+        orig = _decomposition_ops.rules["gelu"]
+
+        def counting_gelu(x, approximate=False):
+            calls["n"] += 1
+            return orig(x, approximate=approximate)
+
+        _decomposition_ops.rules["gelu"] = counting_gelu
+        try:
+            model = nn.Sequential(nn.Linear(4, 8), nn.GELU(),
+                                  nn.Linear(8, 2))
+            opt = optimizer.SGD(learning_rate=0.01,
+                                parameters=model.parameters())
+            step = jit.TrainStep(
+                model, lambda o, l: ((o - l) ** 2).mean(), opt)
+            x = paddle.to_tensor(_rand(4, 4))
+            y = paddle.to_tensor(_rand(4, 2))
+            l1 = float(step(x, y).numpy())
+            assert calls["n"] == 0
+            decomposition.enable_prim()
+            l2 = float(step(x, y).numpy())   # must rebuild via the rule
+            decomposition.disable_prim()
+            assert calls["n"] >= 1
+            assert np.isfinite([l1, l2]).all()
+        finally:
+            _decomposition_ops.rules["gelu"] = orig
+
+
 class TestRegistry:
     def test_double_registration_rejected(self):
         with pytest.raises(ValueError, match="already registered"):
