@@ -305,11 +305,33 @@ def run_decode():
         dt[steps] = best
     per_step = (dt[steps_hi] - dt[steps_lo]) / (steps_hi - steps_lo)
     raw = dt[steps_lo] / (steps_lo - 1)     # r2/r3-comparable (RTT in)
-    return {"paged_decode_tok_per_sec": round(batch / per_step, 1),
-            "paged_decode_batch": batch,
-            "paged_decode_ms_per_step": round(1000 * per_step, 2),
-            "paged_decode_ms_per_step_with_rtt": round(1000 * raw, 2),
-            "prefill_ms": round(1000 * timings["prefill_s"], 2)}
+    out = {"paged_decode_tok_per_sec": round(batch / per_step, 1),
+           "paged_decode_batch": batch,
+           "paged_decode_ms_per_step": round(1000 * per_step, 2),
+           "paged_decode_ms_per_step_with_rtt": round(1000 * raw, 2),
+           "prefill_ms": round(1000 * timings["prefill_s"], 2)}
+    # weight-only int4 decode (nibble-packed, VERDICT bandwidth story:
+    # decode is weight-HBM-bound, so 4x smaller reads)
+    del dec
+    import gc
+    gc.collect()
+    dec4 = PagedLlamaDecoder(
+        model,
+        num_blocks=(prompt + steps_hi + block_size) * batch // block_size
+        + batch, block_size=block_size, weight_dtype="int4")
+    dt4 = {}
+    for steps in (steps_lo, steps_hi):
+        dec4.generate(ids, max_new_tokens=steps)
+        best = float("inf")
+        for _ in range(2):
+            timings = {}
+            dec4.generate(ids, max_new_tokens=steps, timings=timings)
+            best = min(best, timings["decode_s"])
+        dt4[steps] = best
+    per4 = (dt4[steps_hi] - dt4[steps_lo]) / (steps_hi - steps_lo)
+    out["paged_decode_int4_tok_per_sec"] = round(batch / per4, 1)
+    out["paged_decode_int4_ms_per_step"] = round(1000 * per4, 2)
+    return out
 
 
 def run_serving(weight_dtype=None, concurrency=8):

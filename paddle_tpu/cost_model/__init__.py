@@ -104,11 +104,10 @@ class CostModel:
                               _fn(*xs, **_kw))
                 if key is not None:
                     jit_cache[key] = jfn
-            out = jfn(*vals)                    # compile + warm +
-            np.asarray(jax.tree_util.tree_leaves(out)[0])  # env values
+            out = jfn(*vals)        # lazy env values for downstream
             # fetch-forced dispatch-count differencing with min-over-
             # repeats and a positive floor — the one timing recipe
-            # (utils/timing.py), not a local re-derivation
+            # (utils/timing.py; its own warm call proves compile)
             best = timed_dispatch_diff(
                 jfn, tuple(vals), calls=(1, 1 + max(1, repeats)),
                 repeats=2)

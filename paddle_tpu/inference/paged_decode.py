@@ -51,24 +51,57 @@ def _quantize_w(w):
     return wi, scale
 
 
+def _quantize_w4(w):
+    """Per-output-channel symmetric absmax int4, two values nibble-packed
+    per int8 byte along the IN dim (rows 2i → low nibble, 2i+1 → high;
+    same layout as nn.quant.weight_quantize int4 — see
+    nn/quant/quantized_linear.py). Weight HBM reads drop 4× vs bf16.
+    Returns (packed [in/2, out] int8, scale [out]) — _mm tells int4
+    from int8 by the packed array having HALF the activation's in-dim
+    (a string tag could not ride the weights pytree through jit)."""
+    w = jnp.asarray(w, jnp.float32)
+    if w.shape[0] % 2:
+        raise ValueError(f"int4 packing needs even in_features, "
+                         f"got {w.shape[0]}")
+    scale = jnp.abs(w).max(axis=0) / 7.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    wi = jnp.clip(jnp.round(w / scale[None, :]), -8, 7).astype(jnp.int8)
+    lo = wi[0::2] & 0x0F
+    hi = (wi[1::2] & 0x0F) << 4
+    return ((lo | hi).astype(jnp.int8), scale)
+
+
 def _mm(x, w):
-    """x @ w where w is either a dense array or an int8 (w_i8, scale)
-    pair. The int8 weight is dequantized at use — weight HBM reads halve
-    vs bf16, which is what decode (memory-bound) cares about."""
+    """x @ w where w is a dense array or a quantized (w_q, scale) pair
+    (int8 full-rows, or int4 nibble-packed — told apart by the packed
+    array having half the activation's in-dim). Quantized weights
+    dequantize at use — the weight HBM read halves (int8) or quarters
+    (int4) vs bf16, which is what memory-bound decode cares about."""
     if isinstance(w, tuple):
         wi, scale = w
+        if wi.shape[0] * 2 == x.shape[-1]:     # int4 nibble-packed
+            # split the CONTRACTION instead of materializing the
+            # unpacked matrix: even in-rows hit the low nibbles, odd
+            # rows the high. lo/hi are pure elementwise transforms of
+            # the packed bytes, so XLA fuses them into the dot's
+            # operand read — no [in, out] int8 intermediate in HBM
+            lo = ((wi << 4).astype(jnp.int8) >> 4).astype(x.dtype)
+            hi = (wi >> 4).astype(x.dtype)
+            y = x[..., 0::2] @ lo + x[..., 1::2] @ hi
+            return y * scale.astype(x.dtype)
         return (x @ wi.astype(x.dtype)) * scale.astype(x.dtype)
     return x @ w
 
 
 def _extract_weights(model, weight_dtype=None):
     """Pull raw arrays out of a LlamaForCausalLM (single-device serving).
-    weight_dtype='int8' stores matmul weights as per-channel int8 pairs
-    (norm/embedding stay full precision)."""
-    if weight_dtype not in (None, "int8"):
-        raise ValueError(f"weight_dtype must be None or 'int8', "
+    weight_dtype='int8'/'int4' stores matmul weights quantized
+    per-channel (norm/embedding stay full precision)."""
+    if weight_dtype not in (None, "int8", "int4"):
+        raise ValueError(f"weight_dtype must be None, 'int8' or 'int4', "
                          f"got {weight_dtype!r}")
-    q = _quantize_w if weight_dtype == "int8" else (lambda w: w)
+    q = {None: lambda w: w, "int8": _quantize_w,
+         "int4": _quantize_w4}[weight_dtype]
     m = model.model
     layers = []
     for lyr in m.layers:
@@ -103,6 +136,7 @@ class PagedLlamaDecoder:
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
+        self.weight_dtype = weight_dtype
         self.weights = _extract_weights(model, weight_dtype)
         self.mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") \
             else mesh
@@ -151,10 +185,26 @@ class PagedLlamaDecoder:
                 f"/{self.cfg.num_key_value_heads}) and intermediate size "
                 f"({self.cfg.intermediate_size}) divisible by the "
                 f"'{self.mp_axis}' degree {mp}")
+        if self.weight_dtype == "int4" and (
+                (self.cfg.hidden_size // 2) % mp
+                or (self.cfg.intermediate_size // 2) % mp):
+            # row-sharded int4 weights (wo, wd) shard the PACKED in-dim
+            # (in/2); it must still divide by mp or device_put fails
+            # with a raw sharding error
+            raise ValueError(
+                f"int4 TP serving needs hidden_size/2 "
+                f"({self.cfg.hidden_size // 2}) and intermediate_size/2 "
+                f"({self.cfg.intermediate_size // 2}) divisible by the "
+                f"'{self.mp_axis}' degree {mp} (nibble-packed in-dim)")
 
         def put(w, spec):
             ns = NamedSharding(self.mesh, spec)
-            if isinstance(w, tuple):       # int8 (w, scale) pair
+            if isinstance(w, tuple):
+                # quantized (w, scale): scale follows the OUT dim. The
+                # int4 packed array shards like the weight — packing is
+                # along in-dim pairs, so row-sharding stays aligned as
+                # long as in/2 divides by mp (guaranteed by the
+                # divisibility checks above for even hidden sizes)
                 wq, sc = w
                 sc_spec = P(spec[1]) if spec[1] is not None else P()
                 return (jax.device_put(wq, ns),

@@ -312,10 +312,10 @@ class TestTrainStepInteraction:
             assert calls["n"] == 0
             decomposition.enable_prim()
             l2 = float(step(x, y).numpy())   # must rebuild via the rule
-            decomposition.disable_prim()
             assert calls["n"] >= 1
             assert np.isfinite([l1, l2]).all()
         finally:
+            decomposition.disable_prim()
             _decomposition_ops.rules["gelu"] = orig
 
 
