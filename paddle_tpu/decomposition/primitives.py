@@ -30,4 +30,8 @@ ALLOWED_PRIMITIVES = frozenset({
     "argmax", "argmin",
     # gather/scatter family (index_select & friends)
     "gather", "scatter", "scatter-add", "dynamic_update_slice",
+    # counter-based RNG (dropout): random bits are primitive on every
+    # backend — the composition into distributions is what decomposes
+    "threefry2x32", "random_wrap", "random_bits",
+    "shift_right_logical",
 })

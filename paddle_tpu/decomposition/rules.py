@@ -155,6 +155,72 @@ def layer_norm(x, *wb, axes=(-1,), epsilon=1e-5):
     return out
 
 
+@register_decomp("bn_stats")
+def bn_stats(x, axes=()):
+    mu_keep = jnp.mean(x, axis=axes, keepdims=True)
+    centered = x - mu_keep
+    return (lax.squeeze(mu_keep, axes),
+            jnp.mean(centered * centered, axis=axes))
+
+
+@register_decomp("batch_norm")
+def batch_norm(x, mean, var, *wb, ch_axis=1, epsilon=1e-5,
+               has_w=False, has_b=False):
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    inv = (jnp.asarray(1.0, jnp.float32)
+           / jnp.sqrt(var.astype(jnp.float32)
+                      + jnp.asarray(epsilon, jnp.float32))).astype(x.dtype)
+    out = (x - mean.reshape(shape).astype(x.dtype)) * inv.reshape(shape)
+    it = iter(wb)
+    if has_w:
+        out = out * next(it).reshape(shape).astype(x.dtype)
+    if has_b:
+        out = out + next(it).reshape(shape).astype(x.dtype)
+    return out
+
+
+@register_decomp("instance_norm")
+def instance_norm(x, *wb, axes=(), ch_axis=1, eps=1e-5,
+                  has_w=False, has_b=False):
+    acc = x.astype(jnp.float32)
+    mu = jnp.mean(acc, axis=axes, keepdims=True)
+    centered = acc - mu
+    var = jnp.mean(centered * centered, axis=axes, keepdims=True)
+    out = (centered / jnp.sqrt(var + jnp.asarray(eps, jnp.float32))
+           ).astype(x.dtype)
+    shape = [1] * x.ndim
+    shape[ch_axis] = -1
+    it = iter(wb)
+    if has_w:
+        out = out * next(it).reshape(shape).astype(x.dtype)
+    if has_b:
+        out = out + next(it).reshape(shape).astype(x.dtype)
+    return out
+
+
+@register_decomp("dropout")
+def dropout(x, key, p=0.5, axis=None, mode="upscale_in_train"):
+    import jax
+
+    if axis is None:
+        shape = x.shape
+    else:
+        axes = (axis,) if isinstance(axis, int) else tuple(axis)
+        shape = tuple(x.shape[i] if i in axes else 1
+                      for i in range(x.ndim))
+    # jax.random.bernoulli is itself a primitive composition (counter
+    # RNG + arithmetic — no custom_jvp), so the rule draws through it
+    # and stays bit-exact with the composite under the same key
+    keep = jax.random.bernoulli(key, 1.0 - p, shape)
+    if mode == "upscale_in_train":
+        # divide (not multiply-by-reciprocal): bit-identical to the
+        # composite kernel
+        return jnp.where(keep, x / (1.0 - p), jnp.zeros_like(x)
+                         ).astype(x.dtype)
+    return jnp.where(keep, x, jnp.zeros_like(x))
+
+
 @register_decomp("rms_norm")
 def rms_norm(x, *w, epsilon=1e-6, axis=-1):
     acc = x.astype(jnp.float32)

@@ -51,7 +51,9 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
             return jnp.where(keep, a / (1.0 - p), jnp.zeros_like(a)).astype(a.dtype)
         return jnp.where(keep, a, jnp.zeros_like(a))
 
-    return apply("dropout", f, x, key)
+    from ...decomposition.register import DecompAware
+    return apply("dropout", DecompAware(
+        "dropout", f, p=p, axis=axis, mode=mode), x, key)
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):

@@ -29,7 +29,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             mean = jnp.mean(a, axis=reduce_axes)
             var = jnp.var(a, axis=reduce_axes)
             return mean, var
-        mean_t, var_t = apply("bn_stats", f_stats, x)
+        mean_t, var_t = apply("bn_stats", DecompAware(
+            "bn_stats", f_stats, axes=reduce_axes), x)
         # update running stats in place (on the raw arrays, no tape)
         m = momentum
         running_mean._replace(
@@ -59,7 +60,9 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         args.append(weight)
     if has_b:
         args.append(bias)
-    return apply("batch_norm", f, *args)
+    return apply("batch_norm", DecompAware(
+        "batch_norm", f, ch_axis=ch_axis, epsilon=epsilon,
+        has_w=has_w, has_b=has_b), *args)
 
 
 def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
@@ -167,7 +170,9 @@ def instance_norm(x, running_mean=None, running_var=None, weight=None,
         args.append(weight)
     if has_b:
         args.append(bias)
-    return apply("instance_norm", f, *args)
+    return apply("instance_norm", DecompAware(
+        "instance_norm", f, axes=axes, ch_axis=ch_axis, eps=eps,
+        has_w=has_w, has_b=has_b), *args)
 
 
 def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):

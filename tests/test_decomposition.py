@@ -50,6 +50,8 @@ _CASES = {
     "unsqueeze": (lambda x: paddle.unsqueeze(x, axis=[0, 2]), False),
     "layer_norm": (lambda x: F.layer_norm(x, x.shape[-1:]), False),
     "rms_norm": (lambda x: F.rms_norm(x), False),
+    "instance_norm": (lambda x: F.instance_norm(
+        x.reshape([2, 2, 2, 4])).reshape([4, 8]), False),
 }
 
 
@@ -149,6 +151,12 @@ class TestPrimitiveBasis:
         "full_like": lambda: (_rand(4, 8),),
         "layer_norm": lambda: (_rand(4, 8), _rand(8), _rand(8)),
         "rms_norm": lambda: (_rand(4, 8), _rand(8)),
+        "bn_stats": lambda: (_rand(4, 8),),
+        "batch_norm": lambda: (_rand(2, 3, 4, 4), _rand(3),
+                               np.abs(_rand(3)) + 0.1),
+        "instance_norm": lambda: (_rand(2, 3, 4, 4),),
+        "dropout": lambda: (_rand(4, 8),
+                            __import__("jax").random.PRNGKey(0)),
     }
 
     def test_every_rule_has_args(self):
@@ -249,6 +257,65 @@ class TestStaticDecompose:
                 decomposition.decompose(main)
         finally:
             del _decomposition_ops.rules["__bad_op__"]
+
+
+class TestStatefulOpRules:
+    def test_batch_norm_train_and_eval_parity(self):
+        bn = nn.BatchNorm2D(3)
+        x = paddle.to_tensor(_rand(2, 3, 4, 4))
+        for training in (True, False):
+            bn.train() if training else bn.eval()
+            ref = n(bn(x))
+            decomposition.enable_prim()
+            got = n(bn(x))
+            decomposition.disable_prim()
+            np.testing.assert_allclose(got, ref, atol=1e-5,
+                                       err_msg=f"training={training}")
+
+    def test_norm_rules_bias_without_weight(self):
+        # the rules must track has_w/has_b, not positional guessing:
+        # bias-only must ADD, never multiply
+        x = paddle.to_tensor(_rand(2, 3, 4, 4))
+        b = paddle.to_tensor(np.full(3, 5.0, np.float32))
+        mean = paddle.to_tensor(np.zeros(3, np.float32))
+        var = paddle.to_tensor(np.ones(3, np.float32))
+        for fn in (lambda: F.batch_norm(x, mean, var, weight=None,
+                                        bias=b, training=False),
+                   lambda: F.instance_norm(x, bias=b)):
+            ref = n(fn())
+            decomposition.enable_prim()
+            got = n(fn())
+            decomposition.disable_prim()
+            np.testing.assert_allclose(got, ref, atol=1e-5)
+
+    def test_dropout_rule_bit_exact_same_key(self):
+        # the rule mirrors bernoulli's uniform<q draw, so under the
+        # same seed the masks are identical
+        x = paddle.to_tensor(_rand(64, 64))
+        paddle.seed(123)
+        ref = n(F.dropout(x, p=0.4, training=True))
+        paddle.seed(123)
+        decomposition.enable_prim()
+        got = n(F.dropout(x, p=0.4, training=True))
+        decomposition.disable_prim()
+        np.testing.assert_array_equal(got, ref)
+
+    def test_instance_norm_grad_parity(self):
+        arr = _rand(2, 3, 4, 4)
+
+        def run():
+            x = paddle.to_tensor(arr)
+            x.stop_gradient = False
+            out = F.instance_norm(x)
+            out.sum().backward()
+            return n(out), n(x.grad)
+
+        ref_o, ref_g = run()
+        decomposition.enable_prim()
+        got_o, got_g = run()
+        decomposition.disable_prim()
+        np.testing.assert_allclose(got_o, ref_o, atol=1e-5)
+        np.testing.assert_allclose(got_g, ref_g, atol=1e-4)
 
 
 class TestJitInteraction:
