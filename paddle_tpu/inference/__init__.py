@@ -16,10 +16,13 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .gpt_decode import PagedGPTDecoder  # noqa: F401
+from .paged_decode import PagedLlamaDecoder  # noqa: F401
 from .serving import Request, SamplingParams, ServingEngine  # noqa: F401
 
 __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
-           "PlaceType", "ServingEngine", "SamplingParams", "Request"]
+           "PlaceType", "ServingEngine", "SamplingParams", "Request",
+           "PagedLlamaDecoder", "PagedGPTDecoder"]
 
 
 class PrecisionType:

@@ -1,0 +1,193 @@
+"""Paged-KV serving decode for the GPT/ERNIE family.
+
+Reference: the same block_multihead_attention serving path as the Llama
+decoder (/root/reference/python/paddle/incubate/nn/functional/
+block_multihead_attention.py) — the reference serving kernels are
+model-agnostic over {pre-LN transformer + paged KV}. This is the GPT
+instantiation of the TPU-native structure (paged_decode.py): learned
+position embeddings instead of rope, LayerNorm (with bias) instead of
+RMSNorm, fused-QKV projection, GELU MLP with biases, MHA (kv heads ==
+heads).
+
+Same two compiled programs: dense-causal prefill that scatters K/V into
+pool pages, and the whole decode loop as ONE lax.scan over a
+host-precomputed page schedule. Weight-only int8/int4 reuse the Llama
+decoder's quantizers and split-contraction dequant (_mm).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..framework.core import Tensor
+from ..ops.flash_attention import flash_attention
+from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
+                                   reshape_and_cache)
+from .paged_decode import _mm, _quantize_w, _quantize_w4
+
+__all__ = ["PagedGPTDecoder"]
+
+
+def _layer_norm(x, w, b, eps):
+    acc = x.astype(jnp.float32)
+    mu = jnp.mean(acc, axis=-1, keepdims=True)
+    centered = acc - mu
+    var = jnp.mean(centered * centered, axis=-1, keepdims=True)
+    out = centered * jax.lax.rsqrt(var + eps)
+    return (out * w.astype(jnp.float32)
+            + b.astype(jnp.float32)).astype(x.dtype)
+
+
+def _extract_gpt_weights(model, weight_dtype=None):
+    """Raw arrays from a GPTForCausalLM. Matmul weights optionally
+    quantized; biases/norms/embeddings stay full precision."""
+    if weight_dtype not in (None, "int8", "int4"):
+        raise ValueError(f"weight_dtype must be None, 'int8' or 'int4', "
+                         f"got {weight_dtype!r}")
+    q = {None: lambda w: w, "int8": _quantize_w,
+         "int4": _quantize_w4}[weight_dtype]
+    m = model.gpt
+    layers = []
+    for lyr in m.layers:
+        layers.append({
+            "ln1_w": lyr.ln_1.weight._value,
+            "ln1_b": lyr.ln_1.bias._value,
+            "ln2_w": lyr.ln_2.weight._value,
+            "ln2_b": lyr.ln_2.bias._value,
+            "wqkv": q(lyr.attn.qkv_proj.weight._value),
+            "bqkv": lyr.attn.qkv_proj.bias._value,
+            "wo": q(lyr.attn.out_proj.weight._value),
+            "bo": lyr.attn.out_proj.bias._value,
+            "wi": q(lyr.mlp.fc_in.weight._value),
+            "bi": lyr.mlp.fc_in.bias._value,
+            "wf": q(lyr.mlp.fc_out.weight._value),
+            "bf": lyr.mlp.fc_out.bias._value,
+        })
+    head = (model.lm_head.weight._value if model.lm_head is not None
+            else m.embed_tokens.weight._value.T)
+    return {"embed": m.embed_tokens.weight._value,
+            "pos": m.embed_positions.weight._value,
+            "lnf_w": m.ln_f.weight._value,
+            "lnf_b": m.ln_f.bias._value,
+            "layers": layers, "head": q(head)}
+
+
+class PagedGPTDecoder:
+    """Batched paged-KV greedy generation for a GPTForCausalLM
+    (structure mirrors inference.paged_decode.PagedLlamaDecoder)."""
+
+    def __init__(self, model, num_blocks: int = 512,
+                 block_size: int = 16,
+                 max_pages_per_seq: Optional[int] = None,
+                 weight_dtype: Optional[str] = None):
+        cfg = model.cfg
+        self.cfg = cfg
+        self.block_size = block_size
+        self.head_dim = cfg.hidden_size // cfg.num_attention_heads
+        self.max_pages = max_pages_per_seq or \
+            -(-cfg.max_position_embeddings // block_size)
+        self.weights = _extract_gpt_weights(model, weight_dtype)
+        self.cache = PagedKVCache(
+            num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
+            block_size=block_size, kv_heads=cfg.num_attention_heads,
+            head_dim=self.head_dim,
+            dtype=self.weights["embed"].dtype)
+        self._prefill = jax.jit(self._prefill_impl,
+                                donate_argnums=(1, 2))
+        self._decode_scan = jax.jit(self._decode_scan_impl,
+                                    donate_argnums=(1, 2))
+
+    def _qkv(self, w, hn, b, s):
+        nh = self.cfg.num_attention_heads
+        qkv = _mm(hn, w["wqkv"]) + w["bqkv"].astype(hn.dtype)
+        qkv = qkv.reshape(b, s, 3, nh, self.head_dim)
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def _block(self, w, h, attn_out):
+        cfg = self.cfg
+        eps = cfg.layer_norm_epsilon
+        h = h + (_mm(attn_out, w["wo"]) + w["bo"].astype(h.dtype))
+        hn = _layer_norm(h, w["ln2_w"], w["ln2_b"], eps)
+        mid = jax.nn.gelu(_mm(hn, w["wi"]) + w["bi"].astype(h.dtype),
+                          approximate=False)
+        return h + (_mm(mid, w["wf"]) + w["bf"].astype(h.dtype))
+
+    def _prefill_impl(self, weights, k_pool, v_pool, ids, slots,
+                      last_idx=None):
+        cfg = self.cfg
+        b, s = ids.shape
+        h = (jnp.take(weights["embed"], ids, axis=0)
+             + weights["pos"][None, :s])
+        if self.weights["embed"].dtype != jnp.float32:
+            h = h.astype(self.weights["embed"].dtype)
+        flat = slots.reshape(-1)
+        for li, w in enumerate(weights["layers"]):
+            hn = _layer_norm(h, w["ln1_w"], w["ln1_b"],
+                             cfg.layer_norm_epsilon)
+            q, k, v = self._qkv(w, hn, b, s)
+            attn = flash_attention(q, k, v, causal=True)
+            h = self._block(w, h, attn.reshape(b, s, cfg.hidden_size))
+            nk, nv = reshape_and_cache(
+                k.reshape(b * s, -1, self.head_dim),
+                v.reshape(b * s, -1, self.head_dim),
+                k_pool[li], v_pool[li], flat)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = nk
+            v_pool[li] = nv
+        h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
+                        cfg.layer_norm_epsilon)
+        hl = h[:, -1] if last_idx is None else h[jnp.arange(b), last_idx]
+        return _mm(hl, weights["head"]).astype(jnp.float32), \
+            k_pool, v_pool
+
+    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
+                     ctx_lens, slots):
+        cfg = self.cfg
+        b = last_ids.shape[0]
+        h = (jnp.take(weights["embed"], last_ids, axis=0)
+             + jnp.take(weights["pos"], ctx_lens, axis=0))
+        h = h.astype(self.weights["embed"].dtype)
+        for li, w in enumerate(weights["layers"]):
+            hn = _layer_norm(h, w["ln1_w"], w["ln1_b"],
+                             cfg.layer_norm_epsilon)
+            q, k, v = self._qkv(w, hn[:, None, :], b, 1)
+            q, k, v = q[:, 0], k[:, 0], v[:, 0]
+            kp, vp = reshape_and_cache(k, v, k_pool[li], v_pool[li],
+                                       slots)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = kp
+            v_pool[li] = vp
+            attn = paged_attention_decode(q, kp, vp, tables,
+                                          ctx_lens + 1)
+            h = self._block(w, h, attn.reshape(b, cfg.hidden_size))
+        h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
+                        cfg.layer_norm_epsilon)
+        logits = _mm(h, weights["head"]).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return nxt, k_pool, v_pool
+
+    def _decode_scan_impl(self, weights, k_pool, v_pool, first_ids,
+                          tables_all, ctx_all, slots_all):
+        def step(carry, xs):
+            last_ids, kp, vp = carry
+            tables, ctx, slots = xs
+            nxt, kp, vp = self._decode_body(weights, kp, vp, last_ids,
+                                            tables, ctx, slots)
+            return (nxt, kp, vp), nxt
+        (_, k_pool, v_pool), toks = jax.lax.scan(
+            step, (first_ids, k_pool, v_pool),
+            (tables_all, ctx_all, slots_all))
+        return toks.swapaxes(0, 1), k_pool, v_pool
+
+    def generate(self, input_ids, max_new_tokens: int = 32,
+                 timings: dict = None):
+        """Greedy batched generation; same contract as
+        PagedLlamaDecoder.generate (EQUAL-length prompts — mixed
+        lengths are the serving engine's bucketed-admission job)."""
+        from .paged_decode import _paged_generate
+        return _paged_generate(self, input_ids, max_new_tokens, timings)

@@ -348,56 +348,66 @@ class PagedLlamaDecoder:
     def generate(self, input_ids, max_new_tokens: int = 32,
                  timings: dict = None):
         """Greedy batched generation. input_ids [b, prompt_len] (np /
-        Tensor); returns np.ndarray [b, prompt_len + max_new_tokens].
-        When `timings` is a dict it receives prefill_s / decode_s wall
-        times (each phase synchronized for honest accounting)."""
-        import time as _time
-        ids = input_ids._value if isinstance(input_ids, Tensor) \
-            else jnp.asarray(input_ids)
-        ids = np.asarray(ids).astype(np.int32)
-        b, s = ids.shape
-        cache = self.cache
-        seqs = list(range(b))
-        slot_rows = []
-        for i in seqs:
-            cache.allocate(i, s + max_new_tokens)
-            slot_rows.append([cache.extend(i) for _ in range(s)])
-        slots = jnp.asarray(np.asarray(slot_rows, np.int32))
-        t0 = _time.perf_counter()
-        logits, cache.k, cache.v = self._prefill(
-            self.weights, cache.k, cache.v, jnp.asarray(ids), slots)
-        next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        if timings is not None:
-            next_ids.block_until_ready()
-            timings["prefill_s"] = _time.perf_counter() - t0
+        Tensor), EQUAL-length prompts (mixed lengths are the
+        ServingEngine's job — its bucketed admission right-pads onto a
+        scratch page); returns np.ndarray [b, prompt_len +
+        max_new_tokens]. When `timings` is a dict it receives
+        prefill_s / decode_s wall times."""
+        return _paged_generate(self, input_ids, max_new_tokens, timings)
 
-        if max_new_tokens <= 0:
-            for i in seqs:
-                cache.free(i)
-            return ids
-        # precompute the whole schedule host-side (deterministic), then
-        # run ONE compiled scan for all remaining tokens
-        T = max_new_tokens - 1
-        ctx_all = np.zeros((T, b), np.int32)
-        slots_all = np.zeros((T, b), np.int32)
-        tables_all = np.zeros((T, b, self.max_pages), np.int32)
-        for t in range(T):
-            ctx_all[t] = [cache.context_len(i) for i in seqs]
-            slots_all[t] = [cache.extend(i) for i in seqs]
-            tables_all[t] = np.stack(
-                [cache.block_table(i, self.max_pages) for i in seqs])
-        t1 = _time.perf_counter()
-        if T > 0:
-            toks, cache.k, cache.v = self._decode_scan(
-                self.weights, cache.k, cache.v, next_ids,
-                jnp.asarray(tables_all), jnp.asarray(ctx_all),
-                jnp.asarray(slots_all))
-            toks = np.asarray(toks)
-        else:
-            toks = np.zeros((b, 0), np.int32)
-        if timings is not None:
-            timings["decode_s"] = _time.perf_counter() - t1
+
+def _paged_generate(dec, input_ids, max_new_tokens, timings=None):
+    """Shared batch-generate engine for the paged decoders (Llama and
+    GPT expose the same .cache/._prefill/._decode_scan surface): page
+    allocation, ONE compiled prefill, host-precomputed decode schedule,
+    ONE compiled scan, page free."""
+    import time as _time
+    ids = input_ids._value if isinstance(input_ids, Tensor) \
+        else jnp.asarray(input_ids)
+    ids = np.asarray(ids).astype(np.int32)
+    b, s = ids.shape
+    cache = dec.cache
+    seqs = list(range(b))
+    slot_rows = []
+    for i in seqs:
+        cache.allocate(i, s + max_new_tokens)
+        slot_rows.append([cache.extend(i) for _ in range(s)])
+    slots = jnp.asarray(np.asarray(slot_rows, np.int32))
+    t0 = _time.perf_counter()
+    logits, cache.k, cache.v = dec._prefill(
+        dec.weights, cache.k, cache.v, jnp.asarray(ids), slots)
+    next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if timings is not None:
+        next_ids.block_until_ready()
+        timings["prefill_s"] = _time.perf_counter() - t0
+
+    if max_new_tokens <= 0:
         for i in seqs:
             cache.free(i)
-        return np.concatenate(
-            [ids, np.asarray(next_ids)[:, None], toks], axis=1)
+        return ids
+    # precompute the whole schedule host-side (deterministic), then
+    # run ONE compiled scan for all remaining tokens
+    T = max_new_tokens - 1
+    ctx_all = np.zeros((T, b), np.int32)
+    slots_all = np.zeros((T, b), np.int32)
+    tables_all = np.zeros((T, b, dec.max_pages), np.int32)
+    for t in range(T):
+        ctx_all[t] = [cache.context_len(i) for i in seqs]
+        slots_all[t] = [cache.extend(i) for i in seqs]
+        tables_all[t] = np.stack(
+            [cache.block_table(i, dec.max_pages) for i in seqs])
+    t1 = _time.perf_counter()
+    if T > 0:
+        toks, cache.k, cache.v = dec._decode_scan(
+            dec.weights, cache.k, cache.v, next_ids,
+            jnp.asarray(tables_all), jnp.asarray(ctx_all),
+            jnp.asarray(slots_all))
+        toks = np.asarray(toks)
+    else:
+        toks = np.zeros((b, 0), np.int32)
+    if timings is not None:
+        timings["decode_s"] = _time.perf_counter() - t1
+    for i in seqs:
+        cache.free(i)
+    return np.concatenate(
+        [ids, np.asarray(next_ids)[:, None], toks], axis=1)

@@ -904,3 +904,65 @@ def test_explicit_topk_zero_overrides_engine_default():
     # top_k=1 forces greedy, so it must differ from the unfiltered
     # high-temperature sample (and equal an explicit top_k=1)
     assert run(1, None) == run(0, 1)
+
+
+class TestPagedGPTDecoder:
+    """GPT-family paged serving (second model family on the paged
+    decode path; reference block_multihead_attention is model-agnostic
+    over pre-LN transformers)."""
+
+    def setup_method(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        paddle.seed(0)
+        self.cfg = gpt_tiny()
+        self.model = GPTForCausalLM(self.cfg)
+        self.model.eval()
+        self.rng = np.random.RandomState(21)
+
+    def test_matches_model_greedy(self):
+        from paddle_tpu.inference import PagedGPTDecoder
+        ids = self.rng.randint(0, self.cfg.vocab_size,
+                               (2, 7)).astype(np.int32)
+        dec = PagedGPTDecoder(self.model, num_blocks=64, block_size=8)
+        got = dec.generate(ids, max_new_tokens=6)
+        assert got.shape == (2, 13)
+        # oracle: the model's own full-forward greedy loop
+        cur = paddle.to_tensor(ids)
+        for _ in range(6):
+            logits = self.model(cur)
+            nxt = np.asarray(logits._value[:, -1, :]).argmax(-1)
+            cur = paddle.to_tensor(np.concatenate(
+                [np.asarray(cur.numpy()),
+                 nxt[:, None].astype(np.int32)], axis=1))
+        np.testing.assert_array_equal(got, np.asarray(cur.numpy()))
+
+    def test_batch_generation_preserves_prompts(self):
+        from paddle_tpu.inference import PagedGPTDecoder
+        ids = self.rng.randint(0, self.cfg.vocab_size,
+                               (3, 5)).astype(np.int32)
+        dec = PagedGPTDecoder(self.model, num_blocks=64, block_size=8)
+        timings = {}
+        out = dec.generate(ids, max_new_tokens=4, timings=timings)
+        assert out.shape == (3, 9)
+        assert (out[:, :5] == ids).all()
+        assert timings["prefill_s"] > 0 and timings["decode_s"] > 0
+
+    @pytest.mark.parametrize("wd", ["int8", "int4"])
+    def test_quantized_paths_run(self, wd):
+        from paddle_tpu.inference import PagedGPTDecoder
+        ids = self.rng.randint(0, self.cfg.vocab_size,
+                               (2, 6)).astype(np.int32)
+        dec = PagedGPTDecoder(self.model, num_blocks=64, block_size=8,
+                              weight_dtype=wd)
+        out = dec.generate(ids, max_new_tokens=5)
+        assert out.shape == (2, 11)
+        assert (out >= 0).all() and (out < self.cfg.vocab_size).all()
+
+    def test_pool_pages_freed_after_generate(self):
+        from paddle_tpu.inference import PagedGPTDecoder
+        dec = PagedGPTDecoder(self.model, num_blocks=32, block_size=8)
+        free0 = dec.cache.free_blocks
+        ids = self.rng.randint(0, self.cfg.vocab_size,
+                               (2, 6)).astype(np.int32)
+        dec.generate(ids, max_new_tokens=4)
+        assert dec.cache.free_blocks == free0
