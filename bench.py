@@ -20,8 +20,11 @@ MFU accounting follows the PaLM-appendix convention:
   flops/token = 6*N_params + 12*L*H*Q*S  (attention term)
 Peak chip flops: v5e = 197e12 bf16, v5p = 459e12.
 
-Modes: `python bench.py [auto|mid|small|tiny|resnet|decode]` — auto (the
-driver default) runs the full set.
+Modes: `python bench.py [auto|mid|mid4k|mid8k|1b|small|tiny|resnet|
+decode|serving|pp|moe|dit]` — auto (the driver default) runs the full
+set: headline llama + long-context rows + ResNet-50 + paged decode
+(bf16/int4) + the open-loop serving suite + capacity row + pipeline
+engine + MoE dense/ragged + DiT-XL/2.
 """
 from __future__ import annotations
 
