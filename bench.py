@@ -9,30 +9,85 @@ as steady-state tokens/sec/chip with a compiled TrainStep (bf16 weights,
 AdamW with f32 masters). vs_baseline = achieved_MFU / 0.40 (BASELINE.md
 north star: >=40% MFU at Llama-3-8B class).
 
-extra also records the two secondary benches BASELINE.md lists:
-- resnet50_imgs_per_sec: ResNet-50 training imgs/sec/chip (bf16,
-  momentum-SGD, batch 256)
-- paged_decode_tok_per_sec: serving decode throughput over the paged KV
-  cache (inference.paged_decode.PagedLlamaDecoder, Pallas scalar-prefetch
-  decode kernel)
-
 MFU accounting follows the PaLM-appendix convention:
   flops/token = 6*N_params + 12*L*H*Q*S  (attention term)
 Peak chip flops: v5e = 197e12 bf16, v5p = 459e12.
 
+Self-defense (r5, after the poisoned r4 capture): `auto` mode is a
+JAX-free ORCHESTRATOR that runs every row in its own subprocess, so one
+OOM cannot cascade through the suite, and brackets the run with a
+known-FLOPs calibration matmul:
+  - calibration preamble: a scanned bf16 4096^3 matmul must reach a
+    plausible fraction of the chip's peak (>=25%); below that the
+    environment (not the code) is broken -> retry with backoff, and if
+    it never clears, emit {"env_suspect": true} + the calibration
+    number INSTEAD of recording garbage perf rows.
+  - per-mode isolation + retry: a failed/slow row is retried once in a
+    fresh process after re-calibrating; a row that is still <30% of its
+    last-known-good is recorded with a per-row "suspect" flag.
+  - per-mode vs_baseline: every row reports value / last-known-good
+    (the judge-verified r4 numbers), so single-mode driver runs track
+    trends. The headline keeps its MFU/0.40 semantic; its LKG ratio is
+    in extra.
+The reference treats perf capture as gated CI infrastructure
+(tools/ci_op_benchmark.sh:128-145 + check_op_benchmark_result.py); this
+is the TPU-side equivalent.
+
 Modes: `python bench.py [auto|mid|mid4k|mid8k|1b|small|tiny|resnet|
-decode|serving|pp|moe|dit]` — auto (the driver default) runs the full
-set: headline llama + long-context rows + ResNet-50 + paged decode
-(bf16/int4) + the open-loop serving suite + capacity row + pipeline
-engine + MoE dense/ragged + DiT-XL/2.
+decode|serving|pp|moe|dit|calibrate]` — auto (the driver default)
+orchestrates the full set: headline llama + long-context rows +
+ResNet-50 + paged decode (bf16/int4) + the open-loop serving suite +
+capacity row + pipeline engine + MoE dense/ragged + DiT-XL/2.
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
 import sys
 import time
 
 import numpy as np
+
+# ---------------------------------------------------------------------------
+# Last-known-good table (r4 judge re-runs on the same v5e chip, plus r3
+# captures where r4 has no number). Every mode's child emits
+# extra["lkg_ratio"] = primary_value / LKG (inverted for lower-is-better
+# metrics) so the parent can tell "code got slower" from "env is broken"
+# and single-mode runs report a real trend ratio.
+# ---------------------------------------------------------------------------
+LKG = {
+    #  mode: [(path into the child's result, value, lower_is_better)];
+    #  the reported ratio is the MIN over resolvable entries, so modes
+    #  whose primary value and health metric differ (serving's
+    #  arrival-limited open-loop tok/s vs its capacity decode) gate on
+    #  whichever regressed
+    "mid":     [("value", 32859.0, False)],
+    "mid4k":   [("extra.mfu", 0.740, False)],
+    "mid8k":   [("extra.mfu", 0.760, False)],
+    "1b":      [("extra.mfu", 0.703, False)],
+    "small":   [("extra.mfu", 0.72, False)],
+    "resnet":  [("value", 2170.0, False)],
+    "decode":  [("value", 4434.0, False)],
+    "serving": [("extra.serving_bf16_c8_tok_per_sec", 289.0, False),
+                ("extra.serving_capacity_decode_tok_per_sec", 3398.0,
+                 False)],
+    "pp":      [("extra.pp_tick_fwd_ms", 0.086, True),
+                ("extra.pp_tick_bwd_ms", 0.301, True)],
+    "moe":     [("value", 66282.0, False)],
+    "dit":     [("extra.dit_xl2_mfu", 0.779, False)],
+}
+
+AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "serving",
+              "pp", "moe", "dit")
+
+MODE_TIMEOUT_S = {"serving": 2700, "decode": 2100}
+DEFAULT_TIMEOUT_S = 1800
+
+# calibration plausibility band: a big scanned bf16 matmul on an
+# otherwise-idle chip lands 50-90% of peak; the r4 poisoned env ran 24x
+# slow (~3-4%). >1.5 means the dispatch-diff timing itself collapsed.
+CAL_BAND = (0.25, 1.5)
 
 
 def detect_peak_flops() -> float:
@@ -45,6 +100,67 @@ def detect_peak_flops() -> float:
         return 275e12
     # default: v5e / "TPU v5 lite"
     return 197e12
+
+
+def _lkg_ratio(mode: str, result: dict):
+    """value-vs-last-known-good for a finished child result: the min
+    ratio over the mode's LKG entries (None when the mode has no entry
+    or none of the paths resolve)."""
+    ratios = []
+    for path, lkg, lower in LKG.get(mode, ()):
+        node = result
+        for part in path.split("."):
+            if not isinstance(node, dict) or part not in node:
+                node = None
+                break
+            node = node[part]
+        if isinstance(node, (int, float)) and node > 0:
+            ratios.append(lkg / node if lower else node / lkg)
+    return round(min(ratios), 4) if ratios else None
+
+
+def run_calibration():
+    """Known-FLOPs sanity probe (VERDICT r4 weak#1): a scanned bf16
+    square matmul whose achieved FLOP/s must land in a plausible band
+    for the detected chip. Uses the dispatch-diff timer so the tunnel
+    RTT cancels. On CPU (tests) the band check is skipped — there is no
+    trustworthy CPU peak number."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.utils.timing import timed_dispatch_diff
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform not in ("cpu",)
+    n, iters = (4096, 32) if on_tpu else (256, 4)
+    x = jnp.full((n, n), 1.0 / n, jnp.bfloat16)
+
+    def many(a):
+        def body(c, _):
+            return (c @ a) * 2.0, None
+        y, _ = jax.lax.scan(body, a, None, length=iters)
+        # scalar return: fetching the full [n, n] product through the
+        # tunnel costs more (and varies more) than the matmuls being
+        # timed, which collapses the dispatch diff
+        return jnp.sum(y.astype(jnp.float32))
+
+    f = jax.jit(many)
+    sec_per_iter = timed_dispatch_diff(f, (x,), calls=(1, 3), repeats=3,
+                                       per_call=iters)
+    achieved = 2.0 * n ** 3 / sec_per_iter
+    out = {
+        "calibration_tflops": round(achieved / 1e12, 2),
+        "calibration_platform": platform,
+        "calibration_device": getattr(jax.devices()[0], "device_kind",
+                                      str(jax.devices()[0])),
+    }
+    if on_tpu:
+        frac = achieved / detect_peak_flops()
+        out["calibration_frac_peak"] = round(frac, 4)
+        out["calibration_ok"] = bool(CAL_BAND[0] <= frac <= CAL_BAND[1])
+    else:
+        out["calibration_frac_peak"] = None
+        out["calibration_ok"] = True   # no CPU band; presence = alive
+    return out
 
 
 def run_llama(config: str = "mid"):
@@ -596,14 +712,17 @@ def _pp_bubble_measured(stage_fn, params, xs, build_pipeline_schedule):
     t_fb = _timed_scan_diff(make_pair, 32, pj, x0)
     t_b = max(t_fb - t_f, 1e-9)
 
-    s = build_pipeline_schedule(4, 16, 1, "1F1B")
-    fv = s.tables["fwd_valid"].astype(np.float64)
-    bv = s.tables["bwd_valid"].astype(np.float64)
-    total = (fv * t_f + bv * t_b).max(axis=1).sum()
-    ideal = s.n_micro * s.vpp * (t_f + t_b)
-    return {"pp_bubble_measured_p4m16v1": round(1.0 - ideal / total, 4),
-            "pp_tick_fwd_ms": round(t_f * 1e3, 3),
-            "pp_tick_bwd_ms": round(t_b * 1e3, 3)}
+    out = {"pp_tick_fwd_ms": round(t_f * 1e3, 3),
+           "pp_tick_bwd_ms": round(t_b * 1e3, 3)}
+    for p, mm, v in ((4, 16, 1), (4, 16, 2)):
+        s = build_pipeline_schedule(p, mm, v, "1F1B")
+        fv = s.tables["fwd_valid"].astype(np.float64)
+        bv = s.tables["bwd_valid"].astype(np.float64)
+        total = (fv * t_f + bv * t_b).max(axis=1).sum()
+        ideal = s.n_micro * s.vpp * (t_f + t_b)
+        out[f"pp_bubble_measured_p{p}m{mm}v{v}"] = round(
+            1.0 - ideal / total, 4)
+    return out
 
 
 def run_serving_suite():
@@ -617,83 +736,233 @@ def run_serving_suite():
     return out
 
 
+# ---------------------------------------------------------------------------
+# auto-mode orchestrator (JAX-free parent; every row is a subprocess)
+# ---------------------------------------------------------------------------
+
+def _default_child_runner(mode, timeout):
+    """Run `python bench.py <mode>` in a fresh process; return
+    (parsed_json_or_None, stderr_tail). The parent never imports jax,
+    so the chip is exclusively the child's."""
+    env = os.environ.copy()
+    # persistent XLA compile cache: retries and overlapping configs
+    # skip recompiles
+    env.setdefault("JAX_COMPILATION_CACHE_DIR",
+                   "/tmp/paddle_tpu_xla_cache")
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), mode],
+            capture_output=True, text=True, timeout=timeout, env=env)
+    except subprocess.TimeoutExpired:
+        return None, f"timeout after {timeout}s"
+    if p.returncode != 0:
+        # a crashed child's stdout may still contain dict-shaped noise
+        # (structured log lines); never mistake it for a result
+        return None, ((p.stderr or "") + (p.stdout or ""))[-400:]
+    for line in reversed(p.stdout.strip().splitlines()):
+        try:
+            parsed = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(parsed, dict) and "metric" in parsed:
+            return parsed, (p.stderr or "")[-400:]
+    return None, ((p.stderr or "") + (p.stdout or ""))[-400:]
+
+
+def _calibrate_with_retry(child_runner, backoff, notes):
+    """Run the calibration probe until it lands in the plausible band,
+    sleeping between attempts (the r4 poison was transient external HBM
+    pressure — worth waiting out). Returns (cal_dict_or_None, ok)."""
+    cal = None
+    for i, pause in enumerate(backoff):
+        if pause:
+            time.sleep(pause)
+        res, err = child_runner("calibrate", 600)
+        if res is None:
+            notes.append(f"calibration attempt {i}: crashed: {err}")
+            continue
+        cal = res.get("extra", res)
+        if cal.get("calibration_ok"):
+            return cal, True
+        notes.append(
+            f"calibration attempt {i}: frac_peak="
+            f"{cal.get('calibration_frac_peak')} outside band {CAL_BAND}")
+    return cal, False
+
+
+def run_auto(child_runner=None, backoff=None):
+    """Subprocess-isolated full suite with calibration gating.
+
+    Flow: calibrate (retry w/ backoff; never-ok -> env_suspect JSON with
+    NO perf rows) -> headline -> each AUTO_MODE in its own process. A
+    mode that fails or lands <30% of last-known-good is retried ONCE
+    after re-calibrating; if re-calibration fails, the environment died
+    mid-suite -> stop, flag env_suspect, report what was captured."""
+    child_runner = child_runner or _default_child_runner
+    backoff = (0, 30, 60, 120) if backoff is None else backoff
+    notes = []
+
+    cal, cal_ok = _calibrate_with_retry(child_runner, backoff, notes)
+    if not cal_ok:
+        return {
+            "metric": "llama_mid_train_tokens_per_sec_chip",
+            "value": 0.0, "unit": "tokens/s/chip", "vs_baseline": 0.0,
+            "env_suspect": True,
+            "extra": {
+                "env_suspect_reason":
+                    "calibration matmul never reached the plausible "
+                    "band; perf rows withheld (r4 lesson: a poisoned "
+                    "environment must not be recorded as a slow code)",
+                "calibration": cal, "notes": notes,
+            },
+        }
+
+    env_suspect = False
+
+    def run_mode(mode):
+        """(result, suspect) with one recalibrate+retry on fail/slow."""
+        nonlocal env_suspect
+        timeout = MODE_TIMEOUT_S.get(mode, DEFAULT_TIMEOUT_S)
+        res, err = child_runner(mode, timeout)
+        ratio = _lkg_ratio(mode, res) if res else None
+        if res is not None and (ratio is None or ratio >= 0.3):
+            return res, False
+        notes.append(f"{mode}: first attempt "
+                     + (f"slow (lkg_ratio={ratio})" if res else
+                        f"failed: {err}"))
+        recal, ok = _calibrate_with_retry(child_runner, backoff[:2],
+                                          notes)
+        if not ok:
+            env_suspect = True
+            notes.append(f"{mode}: re-calibration failed -> environment "
+                         "broke mid-suite")
+            return res, res is not None
+        res2, err2 = child_runner(mode, timeout)
+        ratio2 = _lkg_ratio(mode, res2) if res2 else None
+        if res2 is not None:
+            return res2, bool(ratio2 is not None and ratio2 < 0.3)
+        notes.append(f"{mode}: retry failed: {err2}")
+        return res, res is not None
+
+    headline_mode = "mid"
+    result, headline_suspect = run_mode("mid")
+    if result is None and not env_suspect:
+        # only fall back to the small config while the environment
+        # still calibrates clean — a dead env would just burn ~30 min
+        # and record small's number as the headline
+        headline_mode = "small"
+        result, headline_suspect = run_mode("small")
+    if result is None:
+        return {
+            "metric": "llama_mid_train_tokens_per_sec_chip",
+            "value": 0.0, "unit": "tokens/s/chip",
+            "vs_baseline": 0.0, "env_suspect": True,
+            "extra": {"env_suspect_reason":
+                      ("environment broke during the headline attempt"
+                       if env_suspect else
+                       "headline failed twice after good calibration"),
+                      "calibration": cal, "notes": notes},
+        }
+    result.setdefault("extra", {})
+    ex = result["extra"]
+    headline_ratio = _lkg_ratio(headline_mode, result)
+    if headline_suspect:
+        ex["headline_suspect"] = True
+
+    for mode in AUTO_MODES:
+        if env_suspect:
+            notes.append(f"{mode}: skipped (environment flagged suspect)")
+            continue
+        t0 = time.perf_counter()
+        child, suspect = run_mode(mode)
+        if child is None:
+            ex[f"{mode}_error"] = notes[-1] if notes else "failed"
+            continue
+        if mode in ("mid4k", "mid8k", "1b"):
+            ce = child.get("extra", {})
+            ex[f"llama_{mode}_tok_per_sec"] = child.get("value")
+            ex[f"llama_{mode}_mfu"] = ce.get("mfu")
+            ex[f"llama_{mode}_params"] = ce.get("params")
+            ex[f"llama_{mode}_step_ms"] = ce.get("step_ms")
+        else:
+            ce = dict(child.get("extra") or {})
+            # each child stamps its own extra["lkg_ratio"] via main();
+            # merged as-is it would clobber the headline's — rename to
+            # the per-mode key instead
+            ce.pop("lkg_ratio", None)
+            ex.update(ce)
+        ratio = _lkg_ratio(mode, child)
+        if ratio is not None:
+            ex[f"{mode}_lkg_ratio"] = ratio
+        if suspect:
+            ex[f"{mode}_suspect"] = True
+        ex[f"{mode}_bench_s"] = round(time.perf_counter() - t0, 1)
+
+    ex["lkg_ratio"] = headline_ratio
+    ex["calibration_tflops"] = cal.get("calibration_tflops")
+    ex["calibration_frac_peak"] = cal.get("calibration_frac_peak")
+    if notes:
+        ex["notes"] = notes
+    result["env_suspect"] = env_suspect
+    return result
+
+
 def main(mode: str):
     if mode in ("mid", "mid4k", "mid8k", "1b", "small", "tiny"):
         result = run_llama(mode)
+    elif mode == "calibrate":
+        r = run_calibration()
+        result = {"metric": "calibration_tflops", "unit": "TFLOP/s",
+                  "value": r["calibration_tflops"],
+                  "vs_baseline": r.get("calibration_frac_peak") or 0.0,
+                  "extra": r}
     elif mode == "resnet":
+        r = run_resnet()
         result = {"metric": "resnet50_train_imgs_per_sec_chip",
-                  "unit": "imgs/s/chip", "vs_baseline": 0.0}
-        result.update({"value": run_resnet()["resnet50_imgs_per_sec"]})
+                  "unit": "imgs/s/chip",
+                  "value": r["resnet50_imgs_per_sec"], "extra": r}
     elif mode == "decode":
         r = run_decode()
         result = {"metric": "paged_decode_tokens_per_sec",
-                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "unit": "tokens/s",
                   "value": r["paged_decode_tok_per_sec"], "extra": r}
     elif mode == "serving":
         r = run_serving_suite()
         result = {"metric": "serving_bf16_c8_tok_per_sec",
-                  "unit": "tokens/s", "vs_baseline": 0.0,
+                  "unit": "tokens/s",
                   "value": r["serving_bf16_c8_tok_per_sec"], "extra": r}
     elif mode == "pp":
         r = run_pp()
         result = {"metric": "pp_remat_overhead_x", "unit": "x",
-                  "vs_baseline": 0.0, "value": r["pp_remat_overhead_x"],
-                  "extra": r}
+                  "value": r["pp_remat_overhead_x"], "extra": r}
     elif mode == "dit":
         r = run_dit()
         result = {"metric": "dit_xl2_imgs_per_sec", "unit": "imgs/s",
-                  "vs_baseline": 0.0,
                   "value": r["dit_xl2_imgs_per_sec"], "extra": r}
     elif mode == "moe":
         r = run_moe()
         result = {"metric": "moe_ragged_tok_per_sec", "unit": "tokens/s",
-                  "vs_baseline": 0.0,
                   "value": r["moe_ragged_tok_per_sec"], "extra": r}
-    else:  # auto: headline llama + secondary benches in extra
-        try:
-            result = run_llama("mid")
-        except Exception as e:
-            sys.stderr.write(f"bench mid failed ({e}); retrying small\n")
-            result = run_llama("small")
-        # BASELINE protocol rows: long-context + largest-fitting configs
-        import gc
-        for cfg_name in ("mid4k", "mid8k", "1b"):
-            try:
-                r = run_llama(cfg_name)
-                result["extra"][f"llama_{cfg_name}_tok_per_sec"] = \
-                    r["value"]
-                result["extra"][f"llama_{cfg_name}_mfu"] = \
-                    r["extra"]["mfu"]
-                result["extra"][f"llama_{cfg_name}_params"] = \
-                    r["extra"]["params"]
-            except Exception as e:
-                sys.stderr.write(f"bench {cfg_name} failed: {e}\n")
-            gc.collect()  # release the failed attempt's HBM promptly
-        for name, fn in (("resnet", run_resnet), ("decode", run_decode),
-                         ("serving", run_serving_suite), ("pp", run_pp),
-                         ("moe", run_moe), ("dit", run_dit)):
-            try:
-                result["extra"].update(fn())
-            except Exception as e:
-                sys.stderr.write(f"bench {name} failed: {e}\n")
-            gc.collect()
+    else:  # auto: subprocess-isolated suite (see run_auto)
+        return run_auto()
+    # real per-mode vs_baseline (VERDICT r4 #8): ratio to the
+    # last-known-good capture, so single-mode runs track trends
+    if "vs_baseline" not in result:
+        result["vs_baseline"] = _lkg_ratio(mode, result) or 0.0
+    if "lkg_ratio" not in result.get("extra", {}):
+        result.setdefault("extra", {})["lkg_ratio"] = \
+            _lkg_ratio(mode, result)
     return result
 
 
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
-                "resnet", "decode", "serving", "pp", "moe", "dit")
+                "resnet", "decode", "serving", "pp", "moe", "dit",
+                "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"
     if mode not in _VALID_MODES:
         sys.exit(f"unknown bench mode {mode!r}; expected one of "
                  f"{_VALID_MODES}")
-    try:
-        result = main(mode)
-    except Exception as e:
-        if mode == "auto":
-            sys.stderr.write(f"bench auto failed ({e}); retrying tiny\n")
-            result = run_llama("tiny")
-        else:
-            raise
+    result = main(mode)
     print(json.dumps(result))
