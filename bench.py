@@ -78,10 +78,10 @@ LKG = {
     "dit":     [("extra.dit_xl2_mfu", 0.779, False)],
 }
 
-AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "serving",
-              "pp", "moe", "dit")
+AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "8b",
+              "serving", "pp", "moe", "dit", "profile")
 
-MODE_TIMEOUT_S = {"serving": 2700, "decode": 2100}
+MODE_TIMEOUT_S = {"serving": 2700, "decode": 2100, "8b": 3600}
 DEFAULT_TIMEOUT_S = 1800
 
 # calibration plausibility band: a big scanned bf16 matmul on an
@@ -263,46 +263,148 @@ def _timed_train_steps(step, inputs, labels, iters):
                                repeats=2)
 
 
-def run_moe():
-    """MoE-LM training row (VERDICT r3 #7: EP/MoE cost measured, not
-    assumed): dense (GShard one-hot) vs ragged (sort-based dropless)
-    dispatch at E=8 top-2, single chip. MFU is computed over ACTIVATED
-    params (the MoE accounting convention)."""
+def _run_moe_config(mode, num_experts=8, moe_intermediate=1408,
+                    tag=None):
+    """One MoE-LM training measurement; returns rows keyed by tag."""
     import paddle_tpu as paddle
     from paddle_tpu import optimizer
     from paddle_tpu.models.moe_lm import MoEConfig, MoEForCausalLM
 
     out = {}
+    tag = tag or f"moe_{mode}"
     batch, seq, iters = 4, 2048, 8
-    for mode in ("dense", "ragged"):
-        paddle.seed(0)
-        cfg = MoEConfig(dtype="bfloat16", hidden_size=1024,
-                        intermediate_size=2816,
-                        moe_intermediate_size=1408,
-                        num_hidden_layers=8, num_attention_heads=16,
-                        num_key_value_heads=8, num_experts=8,
-                        num_experts_per_tok=2,
-                        max_position_embeddings=2048,
-                        chunked_ce_tokens=1024,
-                        moe_dispatch_mode=mode)
-        model = MoEForCausalLM(cfg)
-        opt = optimizer.AdamW(learning_rate=1e-4,
-                              parameters=model.parameters(),
-                              weight_decay=0.01)
-        step = paddle.jit.TrainStep(model, lambda o, l: model.loss(o, l),
-                                    opt)
-        rng = np.random.RandomState(0)
-        ids = paddle.to_tensor(rng.randint(
-            0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
-        for _ in range(2):
-            loss = step(ids, ids)
-        float(loss)
-        tok = batch * seq / _timed_train_steps(step, ids, ids, iters)
-        out[f"moe_{mode}_tok_per_sec"] = round(tok, 1)
-        out[f"moe_{mode}_mfu_activated"] = round(
-            _mfu(tok, model.num_activated_params(), cfg, seq), 4)
-    out["moe_total_params"] = model.num_params()
-    out["moe_activated_params"] = model.num_activated_params()
+    paddle.seed(0)
+    cfg = MoEConfig(dtype="bfloat16", hidden_size=1024,
+                    intermediate_size=2816,
+                    moe_intermediate_size=moe_intermediate,
+                    num_hidden_layers=8, num_attention_heads=16,
+                    num_key_value_heads=8, num_experts=num_experts,
+                    num_experts_per_tok=2,
+                    max_position_embeddings=2048,
+                    chunked_ce_tokens=1024,
+                    moe_dispatch_mode=mode)
+    model = MoEForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters(),
+                          weight_decay=0.01)
+    step = paddle.jit.TrainStep(model, lambda o, l: model.loss(o, l),
+                                opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(rng.randint(
+        0, cfg.vocab_size, size=(batch, seq)).astype(np.int32))
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss)
+    tok = batch * seq / _timed_train_steps(step, ids, ids, iters)
+    out[f"{tag}_tok_per_sec"] = round(tok, 1)
+    out[f"{tag}_mfu_activated"] = round(
+        _mfu(tok, model.num_activated_params(), cfg, seq), 4)
+    out[f"{tag}_total_params"] = model.num_params()
+    out[f"{tag}_activated_params"] = model.num_activated_params()
+    return out
+
+
+def _moe_phase_breakdown():
+    """route/permute/expert-mm/combine wall split of ONE ragged MoE FFN
+    at the bench geometry (VERDICT r4 #3: say where the non-MXU time
+    goes). Forward only, each phase a jitted scanned program with the
+    dispatch-diff timer; TPU only (the grouped matmuls are sized for
+    the MXU)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.moe import _grouped_mm
+
+    t_, d_, h_, e_, k_ = 8192, 1024, 1408, 8, 2
+    rng = np.random.RandomState(0)
+    tokens = jnp.asarray(rng.randn(t_, d_).astype(np.float32)) \
+        .astype(jnp.bfloat16)
+    gate_w = jnp.asarray(rng.randn(d_, e_).astype(np.float32) * 0.02)
+    w1 = jnp.asarray(rng.randn(e_, d_, h_).astype(np.float32) * 0.02) \
+        .astype(jnp.bfloat16)
+    w2 = jnp.asarray(rng.randn(e_, h_, d_).astype(np.float32) * 0.02) \
+        .astype(jnp.bfloat16)
+
+    def route_of(tok):
+        logits = tok.astype(jnp.float32) @ gate_w
+        probs = jax.nn.softmax(logits, axis=-1)
+        top_p, top_i = jax.lax.top_k(probs, k_)
+        return top_i.astype(jnp.int32), top_p
+
+    top_i, top_p = jax.jit(route_of)(tokens)
+    flat_expert = top_i.reshape(-1)
+    order = jnp.argsort(flat_expert, stable=True).astype(jnp.int32)
+    group_sizes = jnp.bincount(flat_expert, length=e_).astype(jnp.int32)
+    xs = jnp.take(tokens, order // k_, axis=0)
+    gates = top_p / top_p.sum(-1, keepdims=True)
+    ys = jax.jit(lambda a, g: _grouped_mm(a, w2, g))(
+        jax.jit(lambda a, g: jax.nn.gelu(_grouped_mm(a, w1, g)))(
+            xs, group_sizes), group_sizes)
+
+    # every phase folds the scan carry into its input so the body can't
+    # be hoisted; scalar checksum return (tunnel fetch stays tiny)
+    def ph_route(tok, c):
+        ti, tp = route_of(tok + (c * 1e-24).astype(tok.dtype))
+        return jnp.float32(jnp.sum(ti) + jnp.sum(tp))
+
+    def ph_permute(fe, tok, c):
+        fe2 = fe + (c * 1e-24).astype(jnp.int32)
+        o = jnp.argsort(fe2, stable=True).astype(jnp.int32)
+        gs = jnp.bincount(fe2, length=e_)
+        x2 = jnp.take(tok, o // k_, axis=0)
+        return (jnp.sum(o).astype(jnp.float32) + jnp.sum(gs)
+                + jnp.sum(x2.astype(jnp.float32)))
+
+    def ph_mm(x2, gs, c):
+        hh = jax.nn.gelu(_grouped_mm(x2 + (c * 1e-24).astype(x2.dtype),
+                                     w1, gs))
+        yy = _grouped_mm(hh, w2, gs)
+        return jnp.sum(yy.astype(jnp.float32))
+
+    def ph_combine(yy, o, g, c):
+        y2 = yy + (c * 1e-24).astype(yy.dtype)
+        ws = g.reshape(t_ * k_)[o].astype(y2.dtype)
+        outv = jnp.zeros((t_, d_), y2.dtype).at[o // k_].add(
+            y2 * ws[:, None])
+        return jnp.sum(outv.astype(jnp.float32))
+
+    def timed(fn, *args):
+        def make(iters):
+            def many(*a):
+                def body(c, _):
+                    return fn(*a, c), None
+                y, _ = jax.lax.scan(body, jnp.float32(0), None,
+                                    length=iters)
+                return y
+            return jax.jit(many)
+        return round(_timed_scan_diff(make, 16, *args) * 1e3, 3)
+
+    return {
+        "moe_phase_route_ms": timed(ph_route, tokens),
+        "moe_phase_permute_ms": timed(ph_permute, flat_expert, tokens),
+        "moe_phase_expert_mm_ms": timed(ph_mm, xs, group_sizes),
+        "moe_phase_combine_ms": timed(ph_combine, ys, order, gates),
+    }
+
+
+def run_moe():
+    """MoE-LM training rows (VERDICT r3 #7 / r4 #3): dense (GShard
+    one-hot) vs ragged (sort-based dropless, Pallas grouped matmul) at
+    E=8 top-2, a DeepSeek-class E=64 ragged row, and the ragged phase
+    breakdown. MFU is over ACTIVATED params (the MoE convention)."""
+    import jax
+
+    out = _run_moe_config("dense")
+    out.update(_run_moe_config("ragged"))
+    # DeepSeek-class expert count: E=64 top-2, narrower experts so the
+    # optimizer state still fits one chip (H=512 keeps 4 MXU tiles)
+    out.update(_run_moe_config("ragged", num_experts=64,
+                               moe_intermediate=512,
+                               tag="moe_ragged_e64"))
+    # back-compat aliases for the r3/r4 row names
+    out["moe_total_params"] = out["moe_ragged_total_params"]
+    out["moe_activated_params"] = out["moe_ragged_activated_params"]
+    if jax.default_backend() == "tpu":
+        out.update(_moe_phase_breakdown())
     return out
 
 
@@ -450,6 +552,127 @@ def run_decode():
     per4 = (dt4[steps_hi] - dt4[steps_lo]) / (steps_hi - steps_lo)
     out["paged_decode_int4_tok_per_sec"] = round(batch / per4, 1)
     out["paged_decode_int4_ms_per_step"] = round(1000 * per4, 2)
+    return out
+
+
+def run_profile():
+    """Hardware-proven device profiler row (VERDICT r4 #6): drive
+    profiler.Profiler (which starts jax.profiler's xprof capture) over
+    three real training steps on the chip, then assert the artifact
+    contains DEVICE-lane kernel events — the TPU analog of the
+    reference's CudaTracer timeline (/root/reference/paddle/fluid/
+    platform/profiler/cuda_tracer.h). Ships the trace path so the
+    capture is inspectable after the run."""
+    import paddle_tpu as paddle
+    from paddle_tpu import optimizer, profiler
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+
+    paddle.seed(0)
+    cfg = llama_small(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    opt = optimizer.AdamW(learning_rate=1e-4,
+                          parameters=model.parameters())
+    step = paddle.jit.TrainStep(model, lambda o, l: model.loss(o, l),
+                                opt)
+    rng = np.random.RandomState(0)
+    ids = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, size=(4, 1024)).astype(np.int32))
+    for _ in range(2):
+        loss = step(ids, ids)
+    float(loss)
+
+    prof = profiler.Profiler(
+        targets=[profiler.ProfilerTarget.CPU, profiler.ProfilerTarget.TPU])
+    prof.start()
+    for _ in range(3):
+        loss = step(ids, ids)
+    float(loss)
+    prof.stop()
+    trace_dir = prof.device_trace_dir
+    summary = profiler.device_trace_summary(trace_dir) if trace_dir \
+        else {"device_lanes": [], "device_events": 0, "top_kernels": []}
+    assert summary["device_events"] > 0, \
+        f"no device events captured in {trace_dir}"
+    host_path = f"/tmp/paddle_tpu_profile_host_{os.getpid()}.json"
+    prof.export(host_path)
+    return {
+        "profile_trace_dir": trace_dir,
+        "profile_device_lanes": summary["device_lanes"],
+        "profile_device_events": summary["device_events"],
+        "profile_top_kernels": summary["top_kernels"][:3],
+        "profile_host_chrome_json": host_path,
+    }
+
+
+def run_8b():
+    """Llama-3-8B serving on ONE 16 GB chip (VERDICT r4 #2 — the
+    BASELINE.md north-star model class, finally at its real geometry):
+    bf16 weights (~16 GB) cannot fit, so the decoder is built lazily
+    with on-device quantization (int4 ~3.9 GB, int8 ~7.5 GB) via
+    PagedLlamaDecoder.from_config; the KV pool (bf16) is sized to the
+    remaining HBM. Rows: raw paged decode tok/s at both widths
+    (dispatch-diff timed like the 0.5B row) + an int4 serving-capacity
+    drain through the full engine."""
+    import gc
+    import paddle_tpu as paddle
+    from paddle_tpu.models import llama_3_8b
+    from paddle_tpu.inference.paged_decode import PagedLlamaDecoder
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    paddle.seed(0)
+    cfg = llama_3_8b(dtype="bfloat16")
+    batch, prompt, block_size = 8, 512, 64
+    steps_lo, steps_hi = 32, 96
+    num_blocks = (prompt + steps_hi + block_size) * batch // block_size \
+        + batch
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, prompt)).astype(np.int32)
+    out = {}
+    for wd in ("int4", "int8"):
+        dec = PagedLlamaDecoder.from_config(
+            cfg, weight_dtype=wd, num_blocks=num_blocks,
+            block_size=block_size)
+        dt = {}
+        for steps in (steps_lo, steps_hi):
+            dec.generate(ids, max_new_tokens=steps)     # compile warmup
+            best = float("inf")
+            for _ in range(2):
+                timings = {}
+                o = dec.generate(ids, max_new_tokens=steps,
+                                 timings=timings)
+                best = min(best, timings["decode_s"])
+            assert o.shape == (batch, prompt + steps)
+            dt[steps] = best
+        per = (dt[steps_hi] - dt[steps_lo]) / (steps_hi - steps_lo)
+        out[f"paged_decode_8b_{wd}_tok_per_sec"] = round(batch / per, 1)
+        out[f"paged_decode_8b_{wd}_ms_per_step"] = round(1000 * per, 2)
+        out[f"paged_decode_8b_{wd}_prefill_ms"] = round(
+            1000 * timings["prefill_s"], 2)
+        if wd == "int4":
+            # capacity drain through the full engine on the SAME
+            # decoder/pool (closed loop, decode-heavy — comparable to
+            # the raw decode row above)
+            eng = ServingEngine(dec, max_batch_size=batch,
+                                prompt_buckets=(128,),
+                                chunk_schedule=(16, 64))
+            eng.warmup()
+            t0 = time.perf_counter()
+            for _ in range(batch * 2):
+                eng.add_request(rng.randint(0, cfg.vocab_size, 100),
+                                SamplingParams(max_new_tokens=128))
+            eng.run_to_completion()
+            wall = time.perf_counter() - t0
+            st = eng.stats()
+            decode_s = max(st["time_decode_stall_s"], 1e-9)
+            out["serving_8b_int4_capacity_tok_per_sec"] = round(
+                st["generated_tokens"] / wall, 1)
+            out["serving_8b_int4_capacity_decode_tok_per_sec"] = round(
+                st["generated_tokens"] / decode_s, 1)
+            out["serving_8b_int4_capacity_wall_s"] = round(wall, 2)
+            del eng
+        del dec
+        gc.collect()
+    out["8b_params_total"] = 8.03e9
     return out
 
 
@@ -708,12 +931,45 @@ def _pp_bubble_measured(stage_fn, params, xs, build_pipeline_schedule):
             return jnp.sum(y.astype(jnp.float32)) + jnp.sum(gns)
         return jax.jit(tick_pair)
 
+    def make_bx(iters):
+        """fwd + input-grad only (the zero-bubble B slot): the unused
+        dp return lets XLA DCE the weight-grad matmuls."""
+        def prog(p_, c0):
+            def body(c, _):
+                _, vjp = jax.vjp(stage_fn, p_, c * 1.001)
+                dp, dx = vjp(g0 + c * 1e-9)
+                return c + dx * 1e-9, None
+            y, _ = jax.lax.scan(body, c0, None, length=iters)
+            return jnp.sum(y.astype(jnp.float32))
+        return jax.jit(prog)
+
+    def make_bw(iters):
+        """fwd + weight-grad only (the zero-bubble W slot)."""
+        def prog(p_, c0):
+            def body(c, _):
+                _, vjp = jax.vjp(stage_fn, p_, c * 1.001)
+                dp, dx = vjp(g0 + c * 1e-9)
+                gn = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                         for g in jax.tree_util.tree_leaves(dp))
+                return c + gn.astype(c.dtype) * 1e-24, None
+            y, _ = jax.lax.scan(body, c0, None, length=iters)
+            return jnp.sum(y.astype(jnp.float32))
+        return jax.jit(prog)
+
     t_f = _timed_scan_diff(make_fwd, 32, pj, x0)
     t_fb = _timed_scan_diff(make_pair, 32, pj, x0)
     t_b = max(t_fb - t_f, 1e-9)
+    t_bx = max(_timed_scan_diff(make_bx, 32, pj, x0) - t_f, 1e-9)
+    t_bw = max(_timed_scan_diff(make_bw, 32, pj, x0) - t_f, 1e-9)
 
     out = {"pp_tick_fwd_ms": round(t_f * 1e3, 3),
-           "pp_tick_bwd_ms": round(t_b * 1e3, 3)}
+           "pp_tick_bwd_ms": round(t_b * 1e3, 3),
+           "pp_tick_bx_ms": round(t_bx * 1e3, 3),
+           "pp_tick_bw_ms": round(t_bw * 1e3, 3),
+           # cost-model validation (VERDICT r4 #5): the tick tables
+           # price a remat bwd at 3 fwd units; the measured ratio says
+           # how true that is for a real transformer block
+           "pp_bwd_over_fwd_measured": round(t_b / t_f, 3)}
     for p, mm, v in ((4, 16, 1), (4, 16, 2)):
         s = build_pipeline_schedule(p, mm, v, "1F1B")
         fv = s.tables["fwd_valid"].astype(np.float64)
@@ -722,6 +978,28 @@ def _pp_bubble_measured(stage_fn, params, xs, build_pipeline_schedule):
         ideal = s.n_micro * s.vpp * (t_f + t_b)
         out[f"pp_bubble_measured_p{p}m{mm}v{v}"] = round(
             1.0 - ideal / total, 4)
+    # zero-bubble schedule, measured with its own split-slot costs
+    # (store mode: B and W run off stored residuals, no remat fwd)
+    s = build_pipeline_schedule(4, 16, 1, "zb")
+    fv = s.tables["fwd_valid"].astype(np.float64)
+    bv = s.tables["bwd_valid"].astype(np.float64)
+    wv = s.tables["w_valid"].astype(np.float64)
+    total = (fv * t_f + bv * t_bx + wv * t_bw).max(axis=1).sum()
+    ideal = s.n_micro * (t_f + t_bx + t_bw)
+    out["pp_bubble_measured_p4m16zb"] = round(1.0 - ideal / total, 4)
+    out["pp_bubble_p4m16zb"] = round(s.bubble_overhead(), 4)
+    # honest net-wall comparison (zb vs 1F1B-store at p4/m16): the
+    # block-granularity vjp split duplicates the shared cotangent
+    # chain (t_bx + t_bw > t_b_store), so the smaller bubble does not
+    # automatically mean a faster step — this ratio is the verdict.
+    # zb pays off when a stage's dw does not share a backward chain
+    # with dx (single-matmul stages), not for full transformer blocks.
+    s1 = build_pipeline_schedule(4, 16, 1, "1F1B")
+    f1 = s1.tables["fwd_valid"].astype(np.float64)
+    b1 = s1.tables["bwd_valid"].astype(np.float64)
+    t_b_store = max(t_b - t_f, 1e-9)   # store mode skips the remat fwd
+    total_store = (f1 * t_f + b1 * t_b_store).max(axis=1).sum()
+    out["pp_zb_net_wall_ratio_vs_store"] = round(total / total_store, 3)
     return out
 
 
@@ -943,6 +1221,16 @@ def main(mode: str):
         r = run_moe()
         result = {"metric": "moe_ragged_tok_per_sec", "unit": "tokens/s",
                   "value": r["moe_ragged_tok_per_sec"], "extra": r}
+    elif mode == "8b":
+        r = run_8b()
+        result = {"metric": "paged_decode_8b_int4_tok_per_sec",
+                  "unit": "tokens/s",
+                  "value": r["paged_decode_8b_int4_tok_per_sec"],
+                  "extra": r}
+    elif mode == "profile":
+        r = run_profile()
+        result = {"metric": "profile_device_events", "unit": "events",
+                  "value": r["profile_device_events"], "extra": r}
     else:  # auto: subprocess-isolated suite (see run_auto)
         return run_auto()
     # real per-mode vs_baseline (VERDICT r4 #8): ratio to the
@@ -956,8 +1244,8 @@ def main(mode: str):
 
 
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
-                "resnet", "decode", "serving", "pp", "moe", "dit",
-                "calibrate")
+                "resnet", "decode", "8b", "serving", "pp", "moe", "dit",
+                "profile", "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

@@ -39,6 +39,14 @@ _MODES = {
     "fthenb": "fthenb", "gpipe": "fthenb", "f-then-b": "fthenb",
     "1f1b": "1f1b", "vpp": "1f1b", "interleave": "1f1b",
     "interleaved": "1f1b", "1f1b-interleave": "1f1b",
+    # zero-bubble (ZB-H1 family): the backward splits into an
+    # input-grad slot B (critical path) and a weight-grad slot W that
+    # the scheduler defers into would-be bubble ticks. The reference
+    # has no such schedule (pipeline_scheduler_pass.py:48 stops at
+    # 1F1B/VPP); store-activations mode only — under jax.vjp, a
+    # dx-only call DCEs the dw matmuls and vice versa, so B and W cost
+    # ~1 forward each with shared residuals.
+    "zb": "zb", "zb1": "zb", "zero-bubble": "zb", "zbh1": "zb",
 }
 
 
@@ -74,9 +82,15 @@ class PipelineSchedule:
     def tick_costs(self, remat: bool = True) -> np.ndarray:
         """Per-tick wall cost [n_ticks]: max over stages of the work the
         cond-skipping engine actually executes that tick."""
-        b = 3.0 if remat else 2.0
-        per_stage = (self.tables["fwd_valid"].astype(np.float64)
-                     + b * self.tables["bwd_valid"].astype(np.float64))
+        if self.mode == "zb":
+            # store-mode units: fwd 1, input-grad B 1, weight-grad W 1
+            per_stage = (self.tables["fwd_valid"].astype(np.float64)
+                         + self.tables["bwd_valid"].astype(np.float64)
+                         + self.tables["w_valid"].astype(np.float64))
+        else:
+            b = 3.0 if remat else 2.0
+            per_stage = (self.tables["fwd_valid"].astype(np.float64)
+                         + b * self.tables["bwd_valid"].astype(np.float64))
         return per_stage.max(axis=1)
 
     @property
@@ -88,8 +102,11 @@ class PipelineSchedule:
     def efficiency(self, remat: bool = True) -> float:
         """ideal / achieved wall ratio — 1.0 means no bubble. Ideal
         per-stage work is n_micro*vpp fwd + n_micro*vpp bwd."""
-        b = 3.0 if remat else 2.0
-        ideal = self.n_micro * self.vpp * (1.0 + b)
+        if self.mode == "zb":
+            ideal = self.n_micro * self.vpp * 3.0
+        else:
+            b = 3.0 if remat else 2.0
+            ideal = self.n_micro * self.vpp * (1.0 + b)
         return ideal / float(self.tick_costs(remat).sum())
 
     def bubble_overhead(self, remat: bool = True) -> float:
@@ -154,11 +171,13 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
 
     fwd_sched = [[] for _ in range(p)]   # per tick: list over stages
     bwd_sched = [[] for _ in range(p)]
-    per_tick = []                        # [(fwd_sel, bwd_sel)] per tick
+    per_tick = []                        # [(fwd_sel, bwd_sel, w_sel)]
+    w_tick: Dict[Tuple[int, int], int] = {}
     n_items = m * V
     t = 0
     limit = 6 * n_items + 8 * V + 64
-    while len(bwd_tick) < n_items:
+    while len(bwd_tick) < n_items or \
+            (mkey == "zb" and len(w_tick) < n_items):
         if t > limit:
             raise RuntimeError(
                 f"pipeline scheduler failed to converge (p={p}, m={m}, "
@@ -204,7 +223,33 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
                 it = min(cands, key=lambda it: (it[0], -it[1]))
                 bwd_sel[s] = it
                 bwd_tick[it] = t
-        per_tick.append((fwd_sel, bwd_sel))
+        # zero-bubble W pass. Policy (swept over cap shapes on the
+        # lock-step max-cost model): stage s runs W inline with fwd+B
+        # (a 3-unit tick is bubble-free — ideal work IS 3 units/micro)
+        # but keeps up to `s` W items deferred, exactly filling its
+        # cooldown while the B-chain of the last microbatches drains
+        # through shallower stages. p4/m16: bubble 0.158 (1F1B-store)
+        # -> 0.111; the residual is the forced lock-step B drain (the
+        # async-model ZB-H1 floor (p-1)/3m is not reachable here).
+        w_sel: Dict[int, Tuple[int, int]] = {}
+        if mkey == "zb":
+            drained = (len(fwd_tick) == n_items
+                       and len(bwd_tick) == n_items)
+            for s in range(p):
+                busy = (s in fwd_sel) + (s in bwd_sel)
+                backlog = sum(1 for it in stage_items[s]
+                              if bwd_tick.get(it, t + 1) <= t
+                              and it not in w_tick)
+                if busy >= 2 and not drained and backlog <= s:
+                    continue
+                cands = [it for it in stage_items[s]
+                         if bwd_tick.get(it, t + 1) <= t
+                         and it not in w_tick]
+                if cands:
+                    it = min(cands, key=lambda it: (it[0], -it[1]))
+                    w_sel[s] = it
+                    w_tick[it] = t
+        per_tick.append((fwd_sel, bwd_sel, w_sel))
         t += 1
     n_ticks = t
 
@@ -252,13 +297,18 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     for (mb, q), bt in bwd_tick.items():
         t_w = fwd_tick[(mb, V - 1)] if q == V - 1 \
             else bwd_tick[(mb, q + 1)] + 1
-        grad_iv[(mb, q)] = (stage_of(q), t_w, bt)
+        # zb: the incoming gradient is read again by the deferred
+        # weight-grad slot, extending the slot's lifetime
+        t_r = max(bt, w_tick.get((mb, q), bt))
+        grad_iv[(mb, q)] = (stage_of(q), t_w, t_r)
     act_slot, act_size = _alloc(act_iv)
     grad_slot, grad_size = _alloc(grad_iv)
     # residual slots (store-activations mode): written at the fwd tick,
-    # read at the bwd tick — every (mb, q) including q == 0 (whose act
-    # input comes from xs and has no act slot)
-    res_iv = {(mb, q): (stage_of(q), ft, bwd_tick[(mb, q)])
+    # read at the bwd tick (and the W tick under zb) — every (mb, q)
+    # including q == 0 (whose act input comes from xs, no act slot)
+    res_iv = {(mb, q): (stage_of(q), ft,
+                        max(bwd_tick[(mb, q)],
+                            w_tick.get((mb, q), bwd_tick[(mb, q)])))
               for (mb, q), ft in fwd_tick.items()}
     res_slot, res_size = _alloc(res_iv)
 
@@ -276,7 +326,17 @@ def build_pipeline_schedule(n_stages: int, n_micro: int, vpp: int = 1,
     T.update({k: zb() for k in
               ("fwd_valid", "fwd_is_first", "fwd_is_last", "rx_valid",
                "grx_valid", "bwd_valid", "bwd_is_first")})
-    for tick, (fwd_sel, bwd_sel) in enumerate(per_tick):
+    if mkey == "zb":
+        T.update({k: zi() for k in ("w_chunk", "w_mb", "w_res_slot",
+                                    "w_gslot")})
+        T["w_valid"] = zb()
+    for tick, (fwd_sel, bwd_sel, w_sel) in enumerate(per_tick):
+        for s, (mb, q) in w_sel.items():
+            T["w_valid"][tick, s] = True
+            T["w_chunk"][tick, s] = q // p
+            T["w_mb"][tick, s] = mb
+            T["w_res_slot"][tick, s] = res_slot[(mb, q)]
+            T["w_gslot"][tick, s] = grad_slot[(mb, q)]
         for s, (mb, q) in fwd_sel.items():
             T["fwd_valid"][tick, s] = True
             T["fwd_chunk"][tick, s] = q // p
@@ -390,6 +450,12 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
 
     jmesh = _resolve_mesh(mesh)
     p, v, m = sched.n_stages, sched.vpp, sched.n_micro
+    is_zb = sched.mode == "zb"
+    if is_zb and remat:
+        raise ValueError(
+            "zero-bubble schedules require store-activations mode "
+            "(remat=False): the B and W slots share stored vjp "
+            "residuals; remat would re-run each chunk forward twice")
     if jmesh.shape[axis] != p:
         raise ValueError(f"mesh axis {axis!r} has size {jmesh.shape[axis]}, "
                          f"schedule built for {p} stages")
@@ -508,25 +574,56 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
             pj = pick_chunk(p_local, r["bwd_chunk"])
             g_in = grad_buf[r["bwd_gslot"]]
 
-            def bwd_do(g_in, act_buf, res_buf):
-                if remat:
-                    # remat from the saved chunk input
-                    xb = jnp.where(r["bwd_is_first"], xs[r["bwd_mb"]],
-                                   act_buf[r["bwd_in_slot"]])
-                    _, vjp = jax.vjp(stage_fn, pj, xb)
-                else:
-                    # stored residuals (param leaves re-picked live)
+            if is_zb:
+                # zero-bubble: the backward slot computes ONLY the
+                # input gradient (the critical-path B item); XLA DCEs
+                # the unused dw matmuls out of the vjp call. The
+                # weight gradient runs in the separate W slot below,
+                # re-deriving the vjp from the same stored residuals.
+                def bwdx_do(g_in, res_buf):
                     vjp = _load_vjp(res_buf, r["bwd_res_slot"], pj)
-                return vjp(g_in)
+                    _, dx = vjp(g_in)
+                    return dx
 
-            dp, dx = jax.lax.cond(
-                r["bwd_valid"], bwd_do,
-                lambda g_in, act_buf, res_buf: (
-                    jax.tree_util.tree_map(jnp.zeros_like, pj), act_z),
-                g_in, act_buf, res_buf)
-            gacc = jax.tree_util.tree_map(
-                lambda acc, g: acc.at[r["bwd_chunk"]].add(
-                    g.astype(acc.dtype)), gacc, dp)
+                dx = jax.lax.cond(
+                    r["bwd_valid"], bwdx_do,
+                    lambda g_in, res_buf: act_z, g_in, res_buf)
+                pj_w = pick_chunk(p_local, r["w_chunk"])
+
+                def w_do(res_buf, grad_buf):
+                    vjp_w = _load_vjp(res_buf, r["w_res_slot"], pj_w)
+                    dpw, _ = vjp_w(grad_buf[r["w_gslot"]])  # dx DCE'd
+                    return dpw
+
+                dp_w = jax.lax.cond(
+                    r["w_valid"], w_do,
+                    lambda res_buf, grad_buf: jax.tree_util.tree_map(
+                        jnp.zeros_like, pj_w), res_buf, grad_buf)
+                gacc = jax.tree_util.tree_map(
+                    lambda acc, g: acc.at[r["w_chunk"]].add(
+                        g.astype(acc.dtype)), gacc, dp_w)
+            else:
+                def bwd_do(g_in, act_buf, res_buf):
+                    if remat:
+                        # remat from the saved chunk input
+                        xb = jnp.where(r["bwd_is_first"],
+                                       xs[r["bwd_mb"]],
+                                       act_buf[r["bwd_in_slot"]])
+                        _, vjp = jax.vjp(stage_fn, pj, xb)
+                    else:
+                        # stored residuals (param leaves re-picked live)
+                        vjp = _load_vjp(res_buf, r["bwd_res_slot"], pj)
+                    return vjp(g_in)
+
+                dp, dx = jax.lax.cond(
+                    r["bwd_valid"], bwd_do,
+                    lambda g_in, act_buf, res_buf: (
+                        jax.tree_util.tree_map(jnp.zeros_like, pj),
+                        act_z),
+                    g_in, act_buf, res_buf)
+                gacc = jax.tree_util.tree_map(
+                    lambda acc, g: acc.at[r["bwd_chunk"]].add(
+                        g.astype(acc.dtype)), gacc, dp)
             first_valid = jnp.logical_and(r["bwd_valid"], r["bwd_is_first"])
             dxs = dxs.at[r["bwd_mb"]].set(
                 jnp.where(first_valid, dx.astype(dxs.dtype),

@@ -131,10 +131,17 @@ class ServingEngine:
                  chunk_size: int = 8, seed: int = 0,
                  overlap: bool = True, mesh=None,
                  chunk_schedule: Optional[Sequence[int]] = None):
-        self.dec = PagedLlamaDecoder(model, num_blocks=num_blocks,
-                                     block_size=block_size,
-                                     weight_dtype=weight_dtype,
-                                     mesh=mesh)
+        if hasattr(model, "cache") and hasattr(model, "_prefill_impl"):
+            # a prebuilt paged decoder (e.g. PagedLlamaDecoder
+            # .from_config for 8B-class weights that must be quantized
+            # at load); its pool/quantization choices stand — the
+            # num_blocks/block_size/weight_dtype args here are ignored
+            self.dec = model
+        else:
+            self.dec = PagedLlamaDecoder(model, num_blocks=num_blocks,
+                                         block_size=block_size,
+                                         weight_dtype=weight_dtype,
+                                         mesh=mesh)
         self.max_b = int(max_batch_size)
         self.buckets = tuple(sorted(prompt_buckets))
         self.top_k = int(top_k)

@@ -109,6 +109,21 @@ def moe_dispatch_combine(x, gate_w, w1, w2, top_k: int,
     return out.reshape(b, s, d), aux, stats
 
 
+def _grouped_mm(lhs, rhs, group_sizes):
+    """Grouped matmul over contiguous per-expert row segments:
+    lhs [R, K] x rhs [E, K, N] -> [R, N], rows partitioned into E
+    segments by group_sizes.
+
+    lax.ragged_dot: measured r5 on the v5e at the bench geometry
+    ([16384, 1024] x [8, 1024, 1408]), XLA's native lowering runs at
+    121 TF/s (62% of peak) — faster than the Pallas megablox gmm
+    kernel on this backend (6.6 ms default tiling, 1.7 ms best tiling
+    vs 0.39 ms here), so the hand kernel is NOT used. The ragged MFU
+    gap lives in dispatch/combine, not the matmuls.
+    """
+    return jax.lax.ragged_dot(lhs, rhs, group_sizes)
+
+
 def moe_ragged_forward(x, gate_w, w1, w2, top_k: int,
                        activation=jax.nn.gelu, capacity_factor=None):
     """Sort-based DROPLESS MoE FFN (the large-E path, VERDICT r3 #7):
@@ -146,15 +161,23 @@ def moe_ragged_forward(x, gate_w, w1, w2, top_k: int,
     aux_loss = (probs.mean(axis=0) * ce).sum() * e
 
     flat_expert = top_i.reshape(t * top_k)                     # [T*k]
-    flat_tok = jnp.repeat(jnp.arange(t, dtype=jnp.int32), top_k)
-    order = jnp.argsort(flat_expert, stable=True)
-    sorted_tok = flat_tok[order]
-    xs = tokens[sorted_tok]                                    # [T*k, D]
+    # flat layout is token-major (flat slot i = token i//k, choice i%k),
+    # so the token index needs no stored array — int32 metadata only
+    order = jnp.argsort(flat_expert, stable=True).astype(jnp.int32)
+    sorted_tok = order // top_k
+    xs = jnp.take(tokens, sorted_tok, axis=0)                  # [T*k, D]
     group_sizes = jnp.bincount(flat_expert, length=e).astype(jnp.int32)
 
-    h = activation(jax.lax.ragged_dot(xs, w1.astype(xs.dtype),
-                                      group_sizes))
-    ys = jax.lax.ragged_dot(h, w2.astype(xs.dtype), group_sizes)
+    h = activation(_grouped_mm(xs, w1.astype(xs.dtype), group_sizes))
+    ys = _grouped_mm(h, w2.astype(xs.dtype), group_sizes)
+    # combine: weighted scatter-ADD back to token rows. Measured r5 on
+    # the v5e (model-level A/B at the bench geometry): this XLA-fused
+    # form runs the whole ragged model at 66.2k tok/s vs 53.6k for a
+    # scatter-free rewrite (bijective-inverse Pallas permute + reshape
+    # reduce, custom vjps) and 58.1k for a hybrid — the fused
+    # multiply-into-scatter and its cheap gather transpose beat
+    # "faster" index plumbing that breaks XLA fusion at custom_vjp
+    # boundaries. Keep this form; don't re-learn the lesson.
     wsorted = gates.reshape(t * top_k)[order].astype(ys.dtype)
     out = jnp.zeros((t, d), ys.dtype).at[sorted_tok].add(
         ys * wsorted[:, None])

@@ -1,0 +1,122 @@
+"""Lazy-quantized serving construction + 8B-geometry engine coverage
+(VERDICT r4 #2).
+
+Llama-3-8B bf16 is ~16 GB — the whole of a v5e's HBM — so serving it
+requires building the decoder WITHOUT ever materializing the bf16
+weight set: PagedLlamaDecoder.from_weight_loader pulls one weight at a
+time and quantizes it on device. These tests prove (a) the lazy path is
+bit-identical to the extract-from-model path, and (b) the full
+llama_3_8b geometry (hidden 4096, GQA 32:8, intermediate 14336, vocab
+128256) serves through the ServingEngine at a shrunk layer count on the
+CPU mesh. Reference analog: the predictor load pipeline
+(/root/reference/paddle/fluid/inference/api/analysis_predictor.h:100)
+and block_multihead_attention serving
+(/root/reference/python/paddle/incubate/nn/functional/
+block_multihead_attention.py:19).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import SamplingParams, ServingEngine
+from paddle_tpu.inference.paged_decode import (PagedLlamaDecoder,
+                                               _weight_specs)
+from paddle_tpu.models import LlamaForCausalLM, llama_3_8b, llama_tiny
+
+
+def _model_loader(model):
+    """Adapter: serve _weight_specs names out of a built model (the
+    shard-at-a-time pattern a checkpoint reader would follow)."""
+    m = model.model
+
+    def load(name, shape):
+        if name == "embed":
+            return m.embed_tokens.weight._value
+        if name == "norm":
+            return m.norm.weight._value
+        if name == "head":
+            return (model.lm_head.weight._value
+                    if model.lm_head is not None
+                    else m.embed_tokens.weight._value.T)
+        _, li, key = name.split(".")
+        lyr = m.layers[int(li)]
+        return {
+            "ln1": lyr.input_layernorm.weight,
+            "ln2": lyr.post_attention_layernorm.weight,
+            "wq": lyr.self_attn.q_proj.weight,
+            "wk": lyr.self_attn.k_proj.weight,
+            "wv": lyr.self_attn.v_proj.weight,
+            "wo": lyr.self_attn.o_proj.weight,
+            "wg": lyr.mlp.gate_proj.weight,
+            "wu": lyr.mlp.up_proj.weight,
+            "wd": lyr.mlp.down_proj.weight,
+        }[key]._value
+
+    return load
+
+
+@pytest.mark.parametrize("weight_dtype", [None, "int4"])
+def test_lazy_loader_matches_model_path(weight_dtype):
+    paddle.seed(7)
+    cfg = llama_tiny(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    rng = np.random.RandomState(3)
+    ids = rng.randint(0, cfg.vocab_size, (2, 12)).astype(np.int32)
+
+    ref = PagedLlamaDecoder(model, num_blocks=32, block_size=8,
+                            weight_dtype=weight_dtype)
+    out_ref = ref.generate(ids, max_new_tokens=6)
+    lazy = PagedLlamaDecoder.from_weight_loader(
+        cfg, _model_loader(model), num_blocks=32, block_size=8,
+        weight_dtype=weight_dtype)
+    out_lazy = lazy.generate(ids, max_new_tokens=6)
+    np.testing.assert_array_equal(out_ref, out_lazy)
+
+
+def test_weight_specs_cover_8b():
+    cfg = llama_3_8b()
+    specs = _weight_specs(cfg)
+    names = [s[0] for s in specs]
+    assert names[0] == "embed" and names[-1] == "head"
+    assert len(names) == 2 + 9 * 32 + 1
+    # int4-packability of the real 8B geometry: every quantized in-dim
+    # is even (the nibble-packing precondition)
+    for name, shape, is_mat in specs:
+        if is_mat:
+            assert shape[0] % 2 == 0, name
+    # quantized params (32 layers ~6.98e9 + head 0.53e9) ~= 3.75 GB
+    # packed at int4 — the number that fits a 16 GB chip
+    qparams = sum(int(np.prod(s)) for _, s, m in specs if m)
+    assert 7.0e9 < qparams < 8.0e9
+
+
+def test_8b_geometry_engine_on_cpu():
+    """Full llama_3_8b geometry — hidden 4096, GQA 32:8, intermediate
+    14336, vocab 128256, rope_theta 5e5 — at 2 layers, built lazily at
+    int4, served end-to-end through the ServingEngine (which accepts
+    the prebuilt decoder; its own pool args are ignored)."""
+    cfg = llama_3_8b(dtype="bfloat16", num_hidden_layers=2)
+    dec = PagedLlamaDecoder.from_config(cfg, seed=11, num_blocks=24,
+                                        block_size=16,
+                                        weight_dtype="int4")
+    assert dec.weight_dtype == "int4"
+    # quantized layer weights are (packed int8, scale) pairs with the
+    # packed in-dim = half the activation's
+    w0 = dec.weights["layers"][0]["wq"]
+    assert isinstance(w0, tuple) and w0[0].shape == (2048, 4096)
+    assert w0[0].dtype == np.int8
+
+    eng = ServingEngine(dec, max_batch_size=2, prompt_buckets=(16,),
+                        chunk_schedule=(4,))
+    rng = np.random.RandomState(0)
+    rids = [eng.add_request(rng.randint(0, cfg.vocab_size, 9),
+                            SamplingParams(max_new_tokens=5))
+            for _ in range(3)]
+    eng.run_to_completion()
+    for rid in rids:
+        toks = eng.result(rid)
+        assert len(toks) == 5
+        assert all(0 <= t < cfg.vocab_size for t in toks)
+    st = eng.stats()
+    assert st["generated_tokens"] >= 15

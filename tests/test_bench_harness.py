@@ -88,6 +88,11 @@ def _full_script(**overrides):
                          "pp_bubble_measured_p4m16v1": 0.158}), "")],
         "moe": [(_simple("moe_ragged_tok_per_sec", 66282.0,
                          {"moe_ragged_tok_per_sec": 66282.0}), "")],
+        "8b": [(_simple("paged_decode_8b_int4_tok_per_sec", 580.0,
+                        {"paged_decode_8b_int4_tok_per_sec": 580.0}),
+                "")],
+        "profile": [(_simple("profile_device_events", 8211,
+                             {"profile_device_events": 8211}), "")],
         "dit": [(_simple("dit_xl2_imgs_per_sec", 2500.0,
                          {"dit_xl2_mfu": 0.779}), "")],
     }

@@ -555,3 +555,56 @@ def test_inflight_cap_schedule_still_numerically_exact():
         np.testing.assert_allclose(np.asarray(gs[k]),
                                    np.asarray(rgs[k]), rtol=2e-4,
                                    atol=2e-5)
+
+
+# ---------------------------------------------------------------------------
+# zero-bubble schedule (r5: a schedule family the reference does not have —
+# pipeline_scheduler_pass.py:48 stops at 1F1B/VPP)
+# ---------------------------------------------------------------------------
+
+class TestZeroBubble:
+    @pytest.mark.parametrize("p,m", [(2, 4), (4, 8)])
+    def test_zb_matches_sequential(self, p, m):
+        mesh = _mesh_pp(p)
+        params, lp, xs, ys = _setup(p, m, 1)
+        sched = build_pipeline_schedule(p, m, 1, "ZB")
+        loss, gs, glp, dxs = jax.jit(
+            lambda pr, l, x, y: pipeline_forward_backward(
+                _stage_fn, _loss_fn, pr, l, x, y, mesh, sched,
+                remat=False))(params, lp, xs, ys)
+        rl, (rgs, rglp, rdxs) = _ref(params, lp, xs, ys, p, p)
+        assert abs(float(loss) - float(rl)) < 1e-5
+        np.testing.assert_allclose(np.asarray(gs["w"]),
+                                   np.asarray(rgs["w"]),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(gs["b"]),
+                                   np.asarray(rgs["b"]),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(glp), np.asarray(rglp),
+                                   atol=2e-5, rtol=2e-4)
+        np.testing.assert_allclose(np.asarray(dxs), np.asarray(rdxs),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_zb_requires_store_mode(self):
+        mesh = _mesh_pp(2)
+        params, lp, xs, ys = _setup(2, 4, 1)
+        sched = build_pipeline_schedule(2, 4, 1, "zero-bubble")
+        with pytest.raises(ValueError, match="store-activations"):
+            pipeline_forward_backward(_stage_fn, _loss_fn, params, lp,
+                                      xs, ys, mesh, sched, remat=True)
+
+    def test_zb_schedules_every_w_item(self):
+        for p, m in [(2, 4), (4, 16), (8, 32)]:
+            s = build_pipeline_schedule(p, m, 1, "zb")
+            assert s.tables["w_valid"].sum() == m * p
+            # B wave identical item count
+            assert s.tables["bwd_valid"].sum() == m * p
+
+    def test_zb_beats_1f1b_bubble(self):
+        # the whole point: deferred W fills the cooldown bubble
+        for p, m in [(4, 16), (8, 32)]:
+            zb = build_pipeline_schedule(p, m, 1, "zb")
+            f1 = build_pipeline_schedule(p, m, 1, "1F1B")
+            assert zb.bubble_overhead() < f1.bubble_overhead(remat=False)
+        zb = build_pipeline_schedule(4, 16, 1, "zb")
+        assert zb.bubble_overhead() == pytest.approx(0.1111, abs=1e-3)
