@@ -191,7 +191,11 @@ def build_pipelined_llama_step(cfg: PipelinedLlamaConfig, mesh,
         ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)
         return -jnp.mean(ll)
 
-    ploss = make_pipeline_loss_fn(stage_fn, loss_fn, jmesh, sched)
+    # zb schedules split the backward off stored residuals (B/W slots)
+    # and require store-activations mode; everything else defaults to
+    # the 1F1B remat memory story
+    ploss = make_pipeline_loss_fn(stage_fn, loss_fn, jmesh, sched,
+                                  remat=sched.mode != "zb")
 
     # ---- init ----
     key = jax.random.PRNGKey(seed)

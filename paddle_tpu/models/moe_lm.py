@@ -248,12 +248,14 @@ class MoEForCausalLM(nn.Layer):
 
 
 def moe_tiny(**kw) -> MoEConfig:
-    return MoEConfig(vocab_size=512, hidden_size=128,
-                     intermediate_size=256, moe_intermediate_size=64,
-                     num_hidden_layers=2, num_attention_heads=4,
-                     num_key_value_heads=4, num_experts=4,
-                     num_experts_per_tok=2, first_k_dense_replace=1,
-                     max_position_embeddings=256, **kw)
+    base = dict(vocab_size=512, hidden_size=128,
+                intermediate_size=256, moe_intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=4, num_experts=4,
+                num_experts_per_tok=2, first_k_dense_replace=1,
+                max_position_embeddings=256)
+    base.update(kw)          # callers may override any default
+    return MoEConfig(**base)
 
 
 def deepseek_moe_16b_like(**kw) -> MoEConfig:
