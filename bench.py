@@ -68,7 +68,8 @@ LKG = {
     "1b":      [("extra.mfu", 0.703, False)],
     "small":   [("extra.mfu", 0.72, False)],
     "resnet":  [("value", 2170.0, False)],
-    "decode":  [("value", 4434.0, False)],
+    "decode":  [("value", 4434.0, False),
+                ("extra.paged_decode_int4_tok_per_sec", 5364.0, False)],
     "serving": [("extra.serving_bf16_c8_tok_per_sec", 289.0, False),
                 ("extra.serving_capacity_decode_tok_per_sec", 3398.0,
                  False)],
@@ -1134,11 +1135,25 @@ def run_auto(child_runner=None, backoff=None):
 
     env_suspect = False
 
+    def _is_transient(err):
+        """Known tunnel stream drop (seen several times per session):
+        the chip is fine, the RPC died — worth same-mode retries before
+        the recalibrate path burns a backoff cycle. Anchored on the
+        full stream-drop signature: EVERY remote error mentions the
+        remote_compile endpoint, including deterministic ones that
+        must not be re-run at full timeout."""
+        return "response body closed" in (err or "")
+
     def run_mode(mode):
         """(result, suspect) with one recalibrate+retry on fail/slow."""
         nonlocal env_suspect
         timeout = MODE_TIMEOUT_S.get(mode, DEFAULT_TIMEOUT_S)
         res, err = child_runner(mode, timeout)
+        for _ in range(2):
+            if res is not None or not _is_transient(err):
+                break
+            notes.append(f"{mode}: transient tunnel fault, retrying")
+            res, err = child_runner(mode, timeout)
         ratio = _lkg_ratio(mode, res) if res else None
         if res is not None and (ratio is None or ratio >= 0.3):
             return res, False

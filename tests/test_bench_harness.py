@@ -257,3 +257,21 @@ def test_calibrate_child_real_subprocess():
     assert row["extra"]["calibration_ok"] is True
     assert row["extra"]["calibration_platform"] == "cpu"
     assert row["value"] > 0
+
+
+def test_auto_transient_tunnel_fault_gets_extra_retries():
+    """A child dying with the known remote_compile stream-drop
+    signature retries same-mode (no recalibration burned) and the row
+    is captured — the failure shape that cost the mid4k row in an
+    otherwise-clean full-suite run."""
+    boom = (None, "jax.errors.JaxRuntimeError: INTERNAL: "
+            "http://127.0.0.1:8083/remote_compile: read body: "
+            "response body closed before all bytes were read")
+    script = _full_script(
+        mid4k=[boom, boom, (_mid(29990.0, 0.740), "")])
+    r = Runner(script)
+    out = bench.run_auto(child_runner=r, backoff=(0,))
+    assert out["extra"]["llama_mid4k_tok_per_sec"] == 29990.0
+    assert "mid4k_error" not in out["extra"]
+    assert r.calls.count("mid4k") == 3
+    assert r.calls.count("calibrate") == 1     # transients skip recal
