@@ -117,6 +117,15 @@ def _mm(x, w, allow_kernel: bool = True):
     return x @ w
 
 
+def _fuse_out(ws):
+    """Concatenate weights along the OUT dim (dense arrays or
+    quantized (w_q, scale) pairs with matching in-dims)."""
+    if isinstance(ws[0], tuple):
+        return (jnp.concatenate([w[0] for w in ws], axis=1),
+                jnp.concatenate([w[1] for w in ws], axis=0))
+    return jnp.concatenate(ws, axis=1)
+
+
 def _extract_weights(model, weight_dtype=None):
     """Pull raw arrays out of a LlamaForCausalLM (single-device serving).
     weight_dtype='int8'/'int4' stores matmul weights quantized
@@ -189,7 +198,17 @@ class PagedLlamaDecoder:
         # the Pallas decode kernel cannot be GSPMD-partitioned: only
         # unsharded (single-device) weights may route to it
         self._allow_kernel = self.mesh is None
-        if self.mesh is not None:
+        if self.mesh is None:
+            # fuse q/k/v and gate/up along the OUT dim: decode runs
+            # ~257 matmul dispatches per step at 8B, each with a fixed
+            # launch cost — 4 wider matmuls per layer instead of 7
+            # (measured r5: 8B int4 742 -> 839 tok/s). TP keeps the
+            # per-projection layout _shard_weights expects.
+            for lw in self.weights["layers"]:
+                lw["wqkv"] = _fuse_out([lw.pop("wq"), lw.pop("wk"),
+                                        lw.pop("wv")])
+                lw["wgu"] = _fuse_out([lw.pop("wg"), lw.pop("wu")])
+        else:
             self._shard_weights()
         self.cache = PagedKVCache(
             num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
@@ -350,13 +369,28 @@ class PagedLlamaDecoder:
     # -- attention building blocks -----------------------------------------
     def _proj_qkv(self, w, hn, b, s):
         cfg = self.cfg
-        q = _mm(hn, w["wq"], self._allow_kernel).reshape(b, s, cfg.num_attention_heads,
-                                     self.head_dim)
-        k = _mm(hn, w["wk"], self._allow_kernel).reshape(b, s, cfg.num_key_value_heads,
-                                     self.head_dim)
-        v = _mm(hn, w["wv"], self._allow_kernel).reshape(b, s, cfg.num_key_value_heads,
-                                     self.head_dim)
+        nh, kvh, hd = (cfg.num_attention_heads,
+                       cfg.num_key_value_heads, self.head_dim)
+        if "wqkv" in w:
+            qkv = _mm(hn, w["wqkv"], self._allow_kernel)
+            q, k, v = jnp.split(
+                qkv, [nh * hd, nh * hd + kvh * hd], axis=-1)
+            return (q.reshape(b, s, nh, hd), k.reshape(b, s, kvh, hd),
+                    v.reshape(b, s, kvh, hd))
+        q = _mm(hn, w["wq"], self._allow_kernel).reshape(b, s, nh, hd)
+        k = _mm(hn, w["wk"], self._allow_kernel).reshape(b, s, kvh, hd)
+        v = _mm(hn, w["wv"], self._allow_kernel).reshape(b, s, kvh, hd)
         return q, k, v
+
+    def _mlp(self, w, hn):
+        ak = self._allow_kernel
+        if "wgu" in w:
+            gu = _mm(hn, w["wgu"], ak)
+            g_, u_ = jnp.split(gu, [self.cfg.intermediate_size],
+                               axis=-1)
+            return _mm(jax.nn.silu(g_) * u_, w["wd"], ak)
+        return _mm(jax.nn.silu(_mm(hn, w["wg"], ak))
+                   * _mm(hn, w["wu"], ak), w["wd"], ak)
 
     def _rope(self, x, positions):
         # x [b, s, h, d]; positions [b, s]
@@ -385,9 +419,7 @@ class PagedLlamaDecoder:
             h = h + _mm(attn.reshape(b, s, cfg.hidden_size), w["wo"],
                         self._allow_kernel)
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            ak = self._allow_kernel
-            h = h + _mm(jax.nn.silu(_mm(hn, w["wg"], ak))
-                        * _mm(hn, w["wu"], ak), w["wd"], ak)
+            h = h + self._mlp(w, hn)
             # scatter this layer's k/v into the pool pages (list swap —
             # no stacked-pool slice copies)
             from ..ops.paged_attention import reshape_and_cache
@@ -436,9 +468,7 @@ class PagedLlamaDecoder:
             h = h + _mm(attn.reshape(b, cfg.hidden_size), w["wo"],
                         self._allow_kernel)
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            ak = self._allow_kernel
-            h = h + _mm(jax.nn.silu(_mm(hn, w["wg"], ak))
-                        * _mm(hn, w["wu"], ak), w["wd"], ak)
+            h = h + self._mlp(w, hn)
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
         logits = _mm(h, weights["head"],
                      self._allow_kernel).astype(jnp.float32)

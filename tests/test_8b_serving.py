@@ -103,8 +103,9 @@ def test_8b_geometry_engine_on_cpu():
     assert dec.weight_dtype == "int4"
     # quantized layer weights are (packed int8, scale) pairs with the
     # packed in-dim = half the activation's
-    w0 = dec.weights["layers"][0]["wq"]
-    assert isinstance(w0, tuple) and w0[0].shape == (2048, 4096)
+    # q/k/v fused along out: 4096 + 2*(8*128) = 6144 out features
+    w0 = dec.weights["layers"][0]["wqkv"]
+    assert isinstance(w0, tuple) and w0[0].shape == (2048, 6144)
     assert w0[0].dtype == np.int8
 
     eng = ServingEngine(dec, max_batch_size=2, prompt_buckets=(16,),

@@ -300,8 +300,9 @@ class TestServingEngine:
         assert err.max() <= np.abs(np.asarray(w)).max() / 127.0 + 1e-6
         eng = self._engine(weight_dtype="int8")
         # int8 weights actually stored as int8
-        wq = eng.dec.weights["layers"][0]["wq"]
-        assert isinstance(wq, tuple) and wq[0].dtype == jnp.int8
+        # single-device decoders fuse q/k/v along the out dim
+        wqkv = eng.dec.weights["layers"][0]["wqkv"]
+        assert isinstance(wqkv, tuple) and wqkv[0].dtype == jnp.int8
         p, _ = self._prompts()[0]
         rid = eng.add_request(p, SamplingParams(max_new_tokens=6))
         got = eng.run_to_completion()
@@ -322,9 +323,9 @@ class TestServingEngine:
                      - np.asarray(w, np.float32))
         assert err.max() <= np.abs(np.asarray(w)).max() / 6.9
         eng = self._engine(weight_dtype="int4")
-        wq = eng.dec.weights["layers"][0]["wq"]
-        assert isinstance(wq, tuple) and \
-            wq[0].shape[0] == w.shape[0] // 2
+        wqkv = eng.dec.weights["layers"][0]["wqkv"]
+        assert isinstance(wqkv, tuple) and \
+            wqkv[0].shape[0] == w.shape[0] // 2
         p, _ = self._prompts()[0]
         rid = eng.add_request(p, SamplingParams(max_new_tokens=6))
         got = eng.run_to_completion()
