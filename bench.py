@@ -69,8 +69,8 @@ LKG = {
     "small":   [("extra.mfu", 0.72, False)],
     "resnet":  [("value", 2170.0, False)],
     "decode":  [("value", 4434.0, False),
-                ("extra.paged_decode_int4_tok_per_sec", 5533.0, False)],
-    "8b":      [("value", 742.0, False),
+                ("extra.paged_decode_int4_tok_per_sec", 5604.0, False)],
+    "8b":      [("value", 866.0, False),
                 ("extra.paged_decode_8b_int8_tok_per_sec", 674.0,
                  False)],
     "serving": [("extra.serving_bf16_c8_tok_per_sec", 289.0, False),

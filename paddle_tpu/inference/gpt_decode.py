@@ -26,7 +26,7 @@ from ..framework.core import Tensor
 from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
                                    reshape_and_cache)
-from .paged_decode import _mm, _quantize_w, _quantize_w4
+from .paged_decode import _mm, _quantize_w, _quantize_w4_halves
 
 __all__ = ["PagedGPTDecoder"]
 
@@ -47,8 +47,10 @@ def _extract_gpt_weights(model, weight_dtype=None):
     if weight_dtype not in (None, "int8", "int4"):
         raise ValueError(f"weight_dtype must be None, 'int8' or 'int4', "
                          f"got {weight_dtype!r}")
+    # single-device family: halves int4 packing (matches the module
+    # _mm default and the Pallas streaming kernel)
     q = {None: lambda w: w, "int8": _quantize_w,
-         "int4": _quantize_w4}[weight_dtype]
+         "int4": _quantize_w4_halves}[weight_dtype]
     m = model.gpt
     layers = []
     for lyr in m.layers:

@@ -58,7 +58,13 @@ def _quantize_w4(w):
     nn/quant/quantized_linear.py). Weight HBM reads drop 4× vs bf16.
     Returns (packed [in/2, out] int8, scale [out]) — _mm tells int4
     from int8 by the packed array having HALF the activation's in-dim
-    (a string tag could not ride the weights pytree through jit)."""
+    (a string tag could not ride the weights pytree through jit).
+
+    LAYOUT CONTRACT: this interleaved packing is for TP decoders and
+    must be consumed with _mm(..., allow_kernel=False); the layout is
+    not encoded in the (packed, scale) tuple, so pairing it with the
+    default halves math silently computes garbage. Single-device
+    decoders pack with _quantize_w4_halves."""
     w = jnp.asarray(w, jnp.float32)
     if w.shape[0] % 2:
         raise ValueError(f"int4 packing needs even in_features, "
@@ -71,6 +77,28 @@ def _quantize_w4(w):
     return ((lo | hi).astype(jnp.int8), scale)
 
 
+def _quantize_w4_halves(w):
+    """int4 with HALVES packing: packed row r holds in-rows r (low
+    nibble) and r + in/2 (high). Single-device decoders use this
+    layout so both the Pallas streaming kernel and the XLA fallback
+    pair nibbles with CONTIGUOUS activation halves — the even/odd
+    interleave's strided activation slices cost 1.6 ms/step at 8B.
+    TP decoders keep the interleaved layout (_quantize_w4): halves
+    would pair a row-shard of packed weights with two disjoint
+    activation bands, which row-sharding cannot express."""
+    w = jnp.asarray(w, jnp.float32)
+    if w.shape[0] % 2:
+        raise ValueError(f"int4 packing needs even in_features, "
+                         f"got {w.shape[0]}")
+    scale = jnp.abs(w).max(axis=0) / 7.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    wi = jnp.clip(jnp.round(w / scale[None, :]), -8, 7).astype(jnp.int8)
+    half = w.shape[0] // 2
+    lo = wi[:half] & 0x0F
+    hi = (wi[half:] & 0x0F) << 4
+    return ((lo | hi).astype(jnp.int8), scale)
+
+
 def _mm(x, w, allow_kernel: bool = True):
     """x @ w where w is a dense array or a quantized (w_q, scale) pair
     (int8 full-rows, or int4 nibble-packed — told apart by the packed
@@ -80,8 +108,8 @@ def _mm(x, w, allow_kernel: bool = True):
 
     INT4 decode-shaped calls (few activation rows) route to the Pallas
     weight-streaming kernel (718 GB/s vs XLA's ~250 at the 8B MLP
-    shape): 8B int4 decode 563 -> 742 tok/s (+32%), 0.5B 5,364 ->
-    5,533. The kernel per-matmul also beats XLA for bf16 (841 GB/s)
+    shape): 8B int4 decode 563 -> 867 tok/s (+54% with the halves
+    packing below), 0.5B 5,364 -> 5,604. The kernel per-matmul also beats XLA for bf16 (841 GB/s)
     and int8 (957), but at MODEL level both lose — ~57 pallas
     dispatches per decode step plus lost fusion cost more than the
     streaming saves (measured: bf16 1.80 -> 3.09 ms/step at 0.5B,
@@ -105,13 +133,19 @@ def _mm(x, w, allow_kernel: bool = True):
                         y = decode_matmul(x2, w)
                         return y.reshape(*x.shape[:-1], y.shape[-1])
             # split the CONTRACTION instead of materializing the
-            # unpacked matrix: even in-rows hit the low nibbles, odd
-            # rows the high. lo/hi are pure elementwise transforms of
-            # the packed bytes, so XLA fuses them into the dot's
-            # operand read — no [in, out] int8 intermediate in HBM
+            # unpacked matrix; lo/hi are pure elementwise transforms
+            # of the packed bytes, so XLA fuses them into the dot's
+            # operand read — no [in, out] int8 intermediate in HBM.
+            # allow_kernel doubles as the layout flag: single-device
+            # decoders pack HALVES (contiguous activation slices), TP
+            # decoders pack even/odd (row-sharding stays aligned).
             lo = ((wi << 4).astype(jnp.int8) >> 4).astype(x.dtype)
             hi = (wi >> 4).astype(x.dtype)
-            y = x[..., 0::2] @ lo + x[..., 1::2] @ hi
+            half = x.shape[-1] // 2
+            if allow_kernel:
+                y = x[..., :half] @ lo + x[..., half:] @ hi
+            else:
+                y = x[..., 0::2] @ lo + x[..., 1::2] @ hi
             return y * scale.astype(x.dtype)
         return (x @ wi.astype(x.dtype)) * scale.astype(x.dtype)
     return x @ w
@@ -126,15 +160,18 @@ def _fuse_out(ws):
     return jnp.concatenate(ws, axis=1)
 
 
-def _extract_weights(model, weight_dtype=None):
+def _extract_weights(model, weight_dtype=None, int4_halves=True):
     """Pull raw arrays out of a LlamaForCausalLM (single-device serving).
     weight_dtype='int8'/'int4' stores matmul weights quantized
-    per-channel (norm/embedding stay full precision)."""
+    per-channel (norm/embedding stay full precision). int4_halves
+    selects the packing layout (halves for single-device, even/odd
+    interleave for TP row-sharding — see _quantize_w4_halves)."""
     if weight_dtype not in (None, "int8", "int4"):
         raise ValueError(f"weight_dtype must be None, 'int8' or 'int4', "
                          f"got {weight_dtype!r}")
     q = {None: lambda w: w, "int8": _quantize_w,
-         "int4": _quantize_w4}[weight_dtype]
+         "int4": _quantize_w4_halves if int4_halves
+         else _quantize_w4}[weight_dtype]
     m = model.model
     layers = []
     for lyr in m.layers:
@@ -190,7 +227,8 @@ class PagedLlamaDecoder:
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
         self.weight_dtype = weight_dtype
-        self.weights = (_extract_weights(model, weight_dtype)
+        self.weights = (_extract_weights(model, weight_dtype,
+                                         int4_halves=mesh is None)
                         if model is not None else _weights)
         self.mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") \
             else mesh
@@ -201,13 +239,15 @@ class PagedLlamaDecoder:
         if self.mesh is None:
             # fuse q/k/v and gate/up along the OUT dim: decode runs
             # ~257 matmul dispatches per step at 8B, each with a fixed
-            # launch cost — 4 wider matmuls per layer instead of 7
-            # (measured r5: 8B int4 742 -> 839 tok/s). TP keeps the
-            # per-projection layout _shard_weights expects.
+            # launch cost — 4 wider matmuls per layer instead of 7.
+            # TP keeps the per-projection layout _shard_weights
+            # expects. The "wq" guard keeps construction idempotent
+            # when a caller reuses one _weights dict across decoders.
             for lw in self.weights["layers"]:
-                lw["wqkv"] = _fuse_out([lw.pop("wq"), lw.pop("wk"),
-                                        lw.pop("wv")])
-                lw["wgu"] = _fuse_out([lw.pop("wg"), lw.pop("wu")])
+                if "wq" in lw:
+                    lw["wqkv"] = _fuse_out([lw.pop("wq"), lw.pop("wk"),
+                                            lw.pop("wv")])
+                    lw["wgu"] = _fuse_out([lw.pop("wg"), lw.pop("wu")])
         else:
             self._shard_weights()
         self.cache = PagedKVCache(
@@ -249,7 +289,8 @@ class PagedLlamaDecoder:
             raise ValueError(f"weight_dtype must be None, 'int8' or "
                              f"'int4', got {weight_dtype!r}")
         qf = {None: jnp.asarray, "int8": _quantize_w,
-              "int4": _quantize_w4}[weight_dtype]
+              "int4": (_quantize_w4_halves if mesh is None
+                       else _quantize_w4)}[weight_dtype]
         layers = [dict() for _ in range(cfg.num_hidden_layers)]
         flat = {}
         for name, shape, is_mat in _weight_specs(cfg):

@@ -46,8 +46,8 @@ def _pick_tile(dim: int, limit: int, cap: int = 2048,
 
 def _tiles(K: int, N: int, w_bytes_per_elem: float):
     """(TK, TN) or None when the shape doesn't tile cleanly. int4's
-    even/odd activation blocks are [b, TK/2], so TK must be a multiple
-    of 256 there (the lane rule applies to the HALVED tile)."""
+    half-activation blocks are [b, TK/2], so TK must be a multiple of
+    256 there (the lane rule applies to the HALVED tile)."""
     tn = _pick_tile(N, 1024)
     if not tn:
         return None
@@ -88,8 +88,13 @@ def _make_kernel(nk: int, kind: str, out_dtype):
         # program_id(1) is the k step (grid = (n, k), k minor)
         ki = pl.program_id(1)
         if kind == "int4":
-            # Mosaic cannot shape-cast [b, tk] -> [b, tk/2, 2], so the
-            # even/odd activation split happens OUTSIDE (it's tiny)
+            # halves packing: packed row r encodes in-rows r (low
+            # nibble) and r + K/2 (high) — the two activation views
+            # are CONTIGUOUS halves, addressed by block specs over the
+            # same x input (no host-side strided slicing; the old
+            # even/odd layout burned 1.6 ms/step in slice fusions at
+            # 8B). Mosaic can't shape-cast/stride in-kernel, which is
+            # why the layout carries the split.
             xe_ref, xo_ref, w_ref, s_ref, o_ref, acc_ref = refs
         else:
             x_ref, w_ref, s_ref, o_ref, acc_ref = refs
@@ -129,8 +134,11 @@ def _make_kernel(nk: int, kind: str, out_dtype):
 
 def decode_matmul(x, w):
     """x [b, K] @ w -> [b, N]; w is dense [K, N], (int8 [K, N], scale
-    [N]) or (int4-packed [K/2, N], scale [N]). Caller must have
-    checked decode_matmul_supported."""
+    [N]) or (int4-packed [K/2, N], scale [N]). int4 packing MUST be
+    the HALVES layout (_quantize_w4_halves: packed row r = in-rows r
+    and r + K/2); the interleaved even/odd layout is not detectable
+    from the tuple and would silently produce wrong results. Caller
+    must have checked decode_matmul_supported."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -152,10 +160,12 @@ def decode_matmul(x, w):
 
     kernel = _make_kernel(nk, kind, x.dtype)
     if kind == "int4":
-        ins = (x[:, 0::2], x[:, 1::2], wq, scale.reshape(1, N))
+        # the same x feeds two specs: k-th block of the FIRST half
+        # (low nibbles) and of the SECOND half (block index k + nk)
+        ins = (x, x, wq, scale.reshape(1, N))
         in_specs = [
             pl.BlockSpec((b, tk // 2), lambda j, k: (0, k)),
-            pl.BlockSpec((b, tk // 2), lambda j, k: (0, k)),
+            pl.BlockSpec((b, tk // 2), lambda j, k, _nk=nk: (0, k + _nk)),
             pl.BlockSpec((wtk, tn), lambda j, k: (k, j)),
             pl.BlockSpec((1, tn), lambda j, k: (0, j)),
         ]

@@ -75,8 +75,9 @@ def test_kernel_matches_dequant_matmul():
         else:
             s = (np.abs(wf).max(0) / 7).astype(np.float32)
             q = np.clip(np.round(wf / s), -8, 7).astype(np.int8)
-            packed = ((q[0::2] & 0x0F)
-                      | ((q[1::2] & 0x0F) << 4)).astype(np.int8)
+            half = K // 2
+            packed = ((q[:half] & 0x0F)
+                      | ((q[half:] & 0x0F) << 4)).astype(np.int8)
             w = (jnp.asarray(packed), jnp.asarray(s))
             ref = (np.asarray(x, np.float32) @ q.astype(np.float32)) * s
         got = np.asarray(jax.jit(decode_matmul)(x, w), np.float32)

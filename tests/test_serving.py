@@ -334,20 +334,22 @@ class TestServingEngine:
             (got[rid] < self.cfg.vocab_size).all()
 
     def test_int4_mm_split_contraction_accuracy(self):
-        # the fused _mm path (contraction split over even/odd in-rows)
-        # must reproduce the dense product within the int4 bound on a
-        # REAL weight — this is the path decode actually runs
+        # the fused _mm paths must reproduce the dense product within
+        # the int4 bound on a REAL weight, in BOTH packings: halves
+        # (single-device, allow_kernel=True default) and even/odd
+        # interleave (TP row-sharding, allow_kernel=False)
         import jax.numpy as jnp
-        from paddle_tpu.inference.paged_decode import _mm, _quantize_w4
+        from paddle_tpu.inference.paged_decode import (
+            _mm, _quantize_w, _quantize_w4, _quantize_w4_halves)
         w = self.model.model.layers[0].self_attn.q_proj.weight._value
-        q = _quantize_w4(w)
         x = jnp.asarray(self.rng.randn(4, w.shape[0]).astype(np.float32))
         ref = np.asarray(x @ w.astype(jnp.float32))
-        got = np.asarray(_mm(x, q))
-        rel = np.abs(got - ref).max() / np.abs(ref).max()
-        assert rel < 0.25, rel
+        for q, kern in ((_quantize_w4_halves(w), True),
+                        (_quantize_w4(w), False)):
+            got = np.asarray(_mm(x, q, kern))
+            rel = np.abs(got - ref).max() / np.abs(ref).max()
+            assert rel < 0.25, (kern, rel)
         # and the int8 pair stays bit-better than int4
-        from paddle_tpu.inference.paged_decode import _quantize_w
         rel8 = np.abs(np.asarray(_mm(x, _quantize_w(w))) - ref).max() \
             / np.abs(ref).max()
         assert rel8 < rel
