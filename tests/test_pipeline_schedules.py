@@ -563,16 +563,18 @@ def test_inflight_cap_schedule_still_numerically_exact():
 # ---------------------------------------------------------------------------
 
 class TestZeroBubble:
-    @pytest.mark.parametrize("p,m", [(2, 4), (4, 8)])
-    def test_zb_matches_sequential(self, p, m):
+    @pytest.mark.parametrize("p,m,v", [(2, 4, 1), (4, 8, 1),
+                                       (2, 4, 2)])
+    def test_zb_matches_sequential(self, p, m, v):
+        # v=2: the deferred-W pass composes with circular interleave
         mesh = _mesh_pp(p)
-        params, lp, xs, ys = _setup(p, m, 1)
-        sched = build_pipeline_schedule(p, m, 1, "ZB")
+        params, lp, xs, ys = _setup(p, m, v)
+        sched = build_pipeline_schedule(p, m, v, "ZB")
         loss, gs, glp, dxs = jax.jit(
             lambda pr, l, x, y: pipeline_forward_backward(
                 _stage_fn, _loss_fn, pr, l, x, y, mesh, sched,
                 remat=False))(params, lp, xs, ys)
-        rl, (rgs, rglp, rdxs) = _ref(params, lp, xs, ys, p, p)
+        rl, (rgs, rglp, rdxs) = _ref(params, lp, xs, ys, p, v * p)
         assert abs(float(loss) - float(rl)) < 1e-5
         np.testing.assert_allclose(np.asarray(gs["w"]),
                                    np.asarray(rgs["w"]),
