@@ -37,7 +37,8 @@ Modes: `python bench.py [auto|mid|mid4k|mid8k|1b|small|tiny|resnet|
 decode|serving|pp|moe|dit|calibrate]` — auto (the driver default)
 orchestrates the full set: headline llama + long-context rows +
 ResNet-50 + paged decode (bf16/int4) + the open-loop serving suite +
-capacity row + pipeline engine + MoE dense/ragged + DiT-XL/2.
+capacity row + shared-prefix cache A/B + pipeline engine + MoE
+dense/ragged + DiT-XL/2.
 """
 from __future__ import annotations
 
@@ -820,6 +821,67 @@ def run_serving_capacity(concurrency=8, weight_dtype=None):
     }
 
 
+def run_serving_prefix(weight_dtype=None):
+    """Automatic prefix caching A/B (the ISSUE-1 acceptance scenario):
+    8 requests sharing a 256-token system prompt (distinct 32-token
+    user tails), drained closed-loop with the cache ON vs OFF on
+    otherwise identical engines. Cache-on splices the shared prefix's
+    pages on admission and prefills only each request's suffix, so the
+    prefill-seconds ratio directly measures the FLOPs/TTFT the cache
+    buys; tests (tests/test_prefix_cache.py) pin token-identity of the
+    two configurations, so this row is pure speed."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    paddle.seed(0)
+    cfg = llama_small(dtype="bfloat16")
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    block_size = 32
+    n_requests, shared_len, tail_len, new_tokens = 8, 256, 32, 32
+    rng = np.random.RandomState(0)
+    shared = rng.randint(0, cfg.vocab_size, shared_len).astype(np.int32)
+    tails = [rng.randint(0, cfg.vocab_size, tail_len).astype(np.int32)
+             for _ in range(n_requests)]
+    out = {}
+    for pc in (False, True):
+        eng = ServingEngine(
+            model, max_batch_size=n_requests,
+            num_blocks=n_requests
+            * ((shared_len + tail_len + new_tokens) // block_size + 2)
+            + 8, block_size=block_size,
+            prompt_buckets=(64, shared_len + tail_len),
+            weight_dtype=weight_dtype, chunk_size=16,
+            prefix_caching=pc)
+        eng.warmup()
+        t0 = time.perf_counter()
+        for t in tails:
+            eng.add_request(np.concatenate([shared, t]),
+                            SamplingParams(max_new_tokens=new_tokens))
+        eng.run_to_completion()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        tag = "prefix_on" if pc else "prefix_off"
+        out[f"serving_{tag}_prefill_s"] = round(st["time_prefill_s"], 4)
+        out[f"serving_{tag}_ttft_p50_s"] = round(st["ttft_p50_s"], 4)
+        out[f"serving_{tag}_ttft_p99_s"] = round(st["ttft_p99_s"], 4)
+        out[f"serving_{tag}_wall_s"] = round(wall, 3)
+        if pc:
+            out["serving_prefix_hit_rate"] = round(
+                st["prefix_cache_hit_rate"], 4)
+            out["serving_prefix_hit_tokens"] = st[
+                "prefix_cache_hit_tokens"]
+        del eng
+    out["serving_prefix_prefill_speedup_x"] = round(
+        out["serving_prefix_off_prefill_s"]
+        / max(out["serving_prefix_on_prefill_s"], 1e-9), 2)
+    out["serving_prefix_ttft_p50_speedup_x"] = round(
+        out["serving_prefix_off_ttft_p50_s"]
+        / max(out["serving_prefix_on_ttft_p50_s"], 1e-9), 2)
+    return out
+
+
 def run_pp():
     """Pipeline-schedule efficiency microbench (VERDICT r3 #3): wall
     time per step, remat vs store-activations, on a 1-stage mesh on the
@@ -1044,6 +1106,9 @@ def run_serving_suite():
         out.update(run_serving(weight_dtype=wd, concurrency=8))
     for wd in (None, "int8", "int4"):
         out.update(run_serving_capacity(concurrency=8, weight_dtype=wd))
+    # shared-prefix A/B (automatic prefix caching): same serving-mode
+    # timeout budget — two small engines, 8 requests each
+    out.update(run_serving_prefix())
     # engine-vs-raw account (r5): the decode chunks run FASTER per step
     # on device than the raw row (1.49 vs 1.80 ms measured via xprof);
     # the residual decode-phase gap is one ~85 ms tunnel RTT per chunk

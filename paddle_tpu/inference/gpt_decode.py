@@ -26,7 +26,9 @@ from ..framework.core import Tensor
 from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
                                    reshape_and_cache)
-from .paged_decode import _mm, _quantize_w, _quantize_w4_halves
+from .paged_decode import (_gather_prefix_pages, _mm,
+                           _prefix_suffix_attention, _quantize_w,
+                           _quantize_w4_halves)
 
 __all__ = ["PagedGPTDecoder"]
 
@@ -146,8 +148,48 @@ class PagedGPTDecoder:
         return _mm(hl, weights["head"]).astype(jnp.float32), \
             k_pool, v_pool
 
-    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
-                     ctx_lens, slots):
+    def _prefill_prefix_impl(self, weights, k_pool, v_pool, ids, slots,
+                             last_idx, n_cached, prefix_tables):
+        """Suffix prefill over a cached prefix (the GPT instantiation of
+        PagedLlamaDecoder._prefill_prefix_impl): learned position
+        embeddings are gathered at the offset positions, attention runs
+        over [gathered prefix pages ++ suffix]."""
+        cfg = self.cfg
+        b, s = ids.shape
+        positions = jnp.arange(s)[None] + n_cached[:, None]    # [b, s]
+        h = (jnp.take(weights["embed"], ids, axis=0)
+             + jnp.take(weights["pos"], positions, axis=0))
+        if self.weights["embed"].dtype != jnp.float32:
+            h = h.astype(self.weights["embed"].dtype)
+        flat = slots.reshape(-1)
+        for li, w in enumerate(weights["layers"]):
+            hn = _layer_norm(h, w["ln1_w"], w["ln1_b"],
+                             cfg.layer_norm_epsilon)
+            q, k, v = self._qkv(w, hn, b, s)
+            k_pre = _gather_prefix_pages(k_pool[li], prefix_tables)
+            v_pre = _gather_prefix_pages(v_pool[li], prefix_tables)
+            attn = _prefix_suffix_attention(q, k, v, k_pre, v_pre,
+                                            n_cached)
+            h = self._block(w, h, attn.reshape(b, s, cfg.hidden_size))
+            nk, nv = reshape_and_cache(
+                k.reshape(b * s, -1, self.head_dim),
+                v.reshape(b * s, -1, self.head_dim),
+                k_pool[li], v_pool[li], flat)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = nk
+            v_pool[li] = nv
+        h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
+                        cfg.layer_norm_epsilon)
+        hl = h[jnp.arange(b), last_idx]
+        return _mm(hl, weights["head"]).astype(jnp.float32), \
+            k_pool, v_pool
+
+    def _decode_logits(self, weights, k_pool, v_pool, last_ids, tables,
+                       ctx_lens, slots):
+        """One decode token up to the logits (the surface the
+        ServingEngine's sampling step consumes — same contract as
+        PagedLlamaDecoder._decode_logits)."""
         cfg = self.cfg
         b = last_ids.shape[0]
         h = (jnp.take(weights["embed"], last_ids, axis=0)
@@ -170,6 +212,12 @@ class PagedGPTDecoder:
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
         logits = _mm(h, weights["head"]).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
+                     ctx_lens, slots):
+        logits, k_pool, v_pool = self._decode_logits(
+            weights, k_pool, v_pool, last_ids, tables, ctx_lens, slots)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return nxt, k_pool, v_pool
 

@@ -151,6 +151,51 @@ def _mm(x, w, allow_kernel: bool = True):
     return x @ w
 
 
+def _prefix_suffix_attention(q, k_suf, v_suf, k_pre, v_pre, n_cached,
+                             scale: Optional[float] = None):
+    """Causal attention for a SUFFIX prefill over a cached prefix.
+
+    The suffix's queries sit at global positions ``n_cached + i``; their
+    keys are the cached prefix K/V (gathered pool pages, flattened) plus
+    the suffix's own K/V. Mask: every valid prefix key (position <
+    n_cached) is visible to every suffix query (they all come after it),
+    and the suffix-vs-suffix part is ordinary causal — which also hides
+    right-padded bucket rows from real queries, exactly like the dense
+    prefill's causal mask does.
+
+    q/k_suf/v_suf: [b, s, (kv)h, d]; k_pre/v_pre: [b, kvh, P, d];
+    n_cached: [b] int32. Returns [b, s, nh, d]."""
+    b, s, nh, d = q.shape
+    kvh = k_suf.shape[2]
+    group = nh // kvh
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    p = k_pre.shape[2]
+    qg = q.reshape(b, s, kvh, group, d).astype(jnp.float32)
+    sp = jnp.einsum("bskgd,bkpd->bskgp", qg,
+                    k_pre.astype(jnp.float32)) * scale
+    pvalid = jnp.arange(p)[None] < n_cached[:, None]          # [b, p]
+    sp = jnp.where(pvalid[:, None, None, None, :], sp, -1e30)
+    ss = jnp.einsum("bskgd,btkd->bskgt", qg,
+                    k_suf.astype(jnp.float32)) * scale
+    causal = jnp.arange(s)[:, None] >= jnp.arange(s)[None, :]  # [s, t]
+    ss = jnp.where(causal[None, :, None, None, :], ss, -1e30)
+    probs = jax.nn.softmax(jnp.concatenate([sp, ss], axis=-1), axis=-1)
+    out = jnp.einsum("bskgp,bkpd->bskgd", probs[..., :p],
+                     v_pre.astype(jnp.float32)) \
+        + jnp.einsum("bskgt,btkd->bskgd", probs[..., p:],
+                     v_suf.astype(jnp.float32))
+    return out.reshape(b, s, nh, d).astype(q.dtype)
+
+
+def _gather_prefix_pages(pool, prefix_tables):
+    """[num_blocks, kvh, bs, d] pool + [b, P] page ids →
+    [b, kvh, P*bs, d] per-row contiguous prefix K/V."""
+    g = jnp.take(pool, prefix_tables, axis=0)   # [b, P, kvh, bs, d]
+    b, p, kvh, bs, d = g.shape
+    return g.transpose(0, 2, 1, 3, 4).reshape(b, kvh, p * bs, d)
+
+
 def _fuse_out(ws):
     """Concatenate weights along the OUT dim (dense arrays or
     quantized (w_q, scale) pairs with matching in-dims)."""
@@ -477,6 +522,50 @@ class PagedLlamaDecoder:
             hl = h[:, -1]
         else:
             hl = h[jnp.arange(b), last_idx]
+        logits = _mm(hl, weights["head"],
+                     self._allow_kernel).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _prefill_prefix_impl(self, weights, k_pool, v_pool, ids, slots,
+                             last_idx, n_cached, prefix_tables):
+        """SUFFIX prefill for prefix-cache hits: `ids` [b, s] holds each
+        row's uncovered suffix (right-padded to the bucket), `n_cached`
+        [b] the tokens already sitting in the pool, and `prefix_tables`
+        [b, P] the physical pages holding them (scratch-padded past the
+        row's prefix). RoPE positions are offset by n_cached (data, not
+        shape — one compiled program serves every hit length) and every
+        layer attends over [gathered prefix pages ++ suffix]. Rows with
+        n_cached == 0 degenerate to the ordinary bucketed prefill.
+        Returns (logits at last_idx [b, vocab], updated pools)."""
+        cfg = self.cfg
+        b, s = ids.shape
+        h = jnp.take(weights["embed"], ids, axis=0)
+        positions = jnp.arange(s)[None] + n_cached[:, None]   # [b, s]
+        flat = slots.reshape(-1)
+        for li, w in enumerate(weights["layers"]):
+            hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)
+            q, k, v = self._proj_qkv(w, hn, b, s)
+            q = self._rope(q, positions)
+            k = self._rope(k, positions)
+            k_pre = _gather_prefix_pages(k_pool[li], prefix_tables)
+            v_pre = _gather_prefix_pages(v_pool[li], prefix_tables)
+            attn = _prefix_suffix_attention(q, k, v, k_pre, v_pre,
+                                            n_cached)
+            h = h + _mm(attn.reshape(b, s, cfg.hidden_size), w["wo"],
+                        self._allow_kernel)
+            hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
+            h = h + self._mlp(w, hn)
+            from ..ops.paged_attention import reshape_and_cache
+            nk, nv = reshape_and_cache(
+                k.reshape(b * s, -1, self.head_dim),
+                v.reshape(b * s, -1, self.head_dim),
+                k_pool[li], v_pool[li], flat)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = nk
+            v_pool[li] = nv
+        h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
+        hl = h[jnp.arange(b), last_idx]
         logits = _mm(hl, weights["head"],
                      self._allow_kernel).astype(jnp.float32)
         return logits, k_pool, v_pool

@@ -18,6 +18,22 @@ plus TWO prefill widths per active prompt bucket):
   compile-variant count; right-padding writes its K/V to a reserved
   scratch page, so the pool never sees pad junk; logits are taken at
   the real last token).
+- automatic prefix caching (prefix_caching=True, the default): on
+  admission the prompt is hashed at block granularity against the
+  pool's chain-hash index (PagedKVCache.match_prefix); matched full
+  blocks are spliced into the request's block table (ref++, no copy)
+  and ONLY the uncovered suffix prefills — bucketed on SUFFIX length,
+  RoPE positions and slot mappings offset by n_cached, attention run
+  over [gathered prefix pages ++ suffix] (the decoder's
+  _prefill_prefix_impl; n_cached is data, so one compiled program per
+  (bucket, width) serves every hit length). The worst-case admission
+  capacity check credits reusable blocks, so cache hits raise
+  effective pool capacity. Requests whose matched blocks are written
+  by a prefill admitted in the SAME wave are dispatched in a later
+  wave (device program order makes the write visible to the read).
+  Retired requests return blocks through the ref-counted path: full
+  hashed blocks park in the pool's LRU for future splices and are
+  evicted only when the free list runs dry.
 - decode: ONE program serves every step — a lax.scan over a
   chunk_size-token schedule (the page/slot schedule is deterministic, so
   the host precomputes it), [max_batch] wide, inactive or finished slots
@@ -39,6 +55,7 @@ weight bytes is the serving-side quantization that actually pays on TPU.
 from __future__ import annotations
 
 import itertools
+import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -109,8 +126,12 @@ def _bucket_for(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
             return b
-    raise ValueError(f"prompt length {n} exceeds the largest prefill "
-                     f"bucket {buckets[-1]}; raise prompt_buckets")
+    raise ValueError(
+        f"prompt length {n} exceeds the largest prefill bucket: "
+        f"configured prompt_buckets={tuple(buckets)} top out at "
+        f"{buckets[-1]} tokens; raise prompt_buckets (or shorten the "
+        f"prompt). Oversized prompts are rejected at add_request time "
+        f"so they never reach dispatch.")
 
 
 class ServingEngine:
@@ -130,7 +151,8 @@ class ServingEngine:
                  weight_dtype: Optional[str] = None, top_k: int = 0,
                  chunk_size: int = 8, seed: int = 0,
                  overlap: bool = True, mesh=None,
-                 chunk_schedule: Optional[Sequence[int]] = None):
+                 chunk_schedule: Optional[Sequence[int]] = None,
+                 prefix_caching: bool = True):
         from .gpt_decode import PagedGPTDecoder
         if isinstance(model, (PagedLlamaDecoder, PagedGPTDecoder)):
             # a prebuilt paged decoder (e.g. PagedLlamaDecoder
@@ -167,10 +189,22 @@ class ServingEngine:
         self._key = jax.random.PRNGKey(seed)
         cache = self.dec.cache
         # reserve one scratch page: pad-token prefill writes and inactive
-        # decode slots land here, never in a live page
-        cache.allocate(-1, 1)
+        # decode slots land here, never in a live page (a prebuilt
+        # decoder reused across engines keeps its existing scratch page)
+        if -1 not in cache._tables:
+            cache.allocate(-1, 1)
         self._scratch_block = cache._tables[-1][0]
         self._scratch_slot = self._scratch_block * cache.block_size
+        # automatic prefix caching: block-granular KV reuse on admission
+        # (needs the decoder's suffix-prefill program — prebuilt
+        # decoders without one fall back to full prefills)
+        self.prefix_caching = bool(prefix_caching) and \
+            hasattr(self.dec, "_prefill_prefix_impl")
+        # static prefix-gather width: a hit prefix is < the prompt, and
+        # prompts are bounded by the largest bucket
+        self._prefix_pages = -(-self.buckets[-1] // cache.block_size)
+        self._debug_pool = os.environ.get(
+            "PADDLE_TPU_POOL_DEBUG", "") not in ("", "0")
 
         self._slots: List[Optional[Request]] = [None] * self.max_b
         self._last_tok = np.zeros(self.max_b, np.int32)
@@ -200,6 +234,16 @@ class ServingEngine:
                     top_ks, top_ps, rep, seen):
             logits, k, v = dec._prefill_impl(weights, k, v, ids, slots,
                                              last_idx)
+            tok = self._sample_rich(logits, temp, key, top_ks, top_ps,
+                                    rep, seen)
+            return tok, k, v
+
+        def prefill_prefix(weights, k, v, ids, slots, last_idx,
+                           n_cached, prefix_tables, temp, key, top_ks,
+                           top_ps, rep, seen):
+            logits, k, v = dec._prefill_prefix_impl(
+                weights, k, v, ids, slots, last_idx, n_cached,
+                prefix_tables)
             tok = self._sample_rich(logits, temp, key, top_ks, top_ps,
                                     rep, seen)
             return tok, k, v
@@ -249,6 +293,8 @@ class ServingEngine:
             return jnp.where(use_host, overrides, gathered)
 
         self._prefill_j = jax.jit(prefill, donate_argnums=(1, 2))
+        self._prefill_prefix_j = jax.jit(prefill_prefix,
+                                         donate_argnums=(1, 2))
         self._decode_j = jax.jit(decode_chunk, donate_argnums=(1, 2))
         self._decode_rich_j = jax.jit(decode_chunk_rich,
                                       donate_argnums=(1, 2))
@@ -357,39 +403,70 @@ class ServingEngine:
     def _admit(self):
         """Fill free batch slots from the queue. Admission is
         capacity-aware (a request enters only if its whole worst-case
-        page demand fits, so a running request can never hit pool
-        exhaustion mid-decode) and BATCHED: admissible requests sharing
-        a prompt bucket prefill in one dispatch (padded to a power-of-
-        two group size to bound compile variants) — a burst of K
-        arrivals costs ~1 prefill instead of K."""
+        page demand fits — net of prefix-cache reuse — so a running
+        request can never hit pool exhaustion mid-decode) and BATCHED:
+        admissible requests sharing a (wave, bucket) prefill in one
+        dispatch (padded to a power-of-two group size to bound compile
+        variants) — a burst of K arrivals costs ~1 prefill instead of K.
+
+        Prefix caching buckets on SUFFIX length and splices matched
+        blocks at allocation time. A matched block may be written by a
+        prefill admitted in this same wave (its hashes register at
+        allocation, before the write is dispatched): such a dependent
+        request is assigned a LATER wave, and waves dispatch in order —
+        on-device program order then guarantees the reader sees the
+        writer's pages. Requests in one dispatch never read each
+        other's blocks (same-wave ⇒ no pending-block dependency)."""
         cache = self.dec.cache
         free_slots = [si for si in range(self.max_b)
                       if self._slots[si] is None]
-        admitted = []              # (slot, req, bucket)
+        admitted = []              # (slot, req, bucket, n_cached, wave)
+        pending_wave: Dict[int, int] = {}   # block → wave writing it
         for si in free_slots:
             if not self._queue:
                 break
             req = self._queue[0]
-            if cache.free_blocks < self._required_blocks(req):
-                break  # head-of-line: keep FIFO order, wait for frees
-            self._queue.popleft()
-            cache.allocate(req.req_id,
-                           int(req.prompt.size)
-                           + req.sampling.max_new_tokens)
-            admitted.append((si, req,
-                             _bucket_for(int(req.prompt.size),
-                                         self.buckets)))
-        by_bucket: dict = {}
-        for si, req, bucket in admitted:
-            by_bucket.setdefault(bucket, []).append((si, req))
-        # dispatch EVERY admission prefill before fetching ANY result:
-        # through the remote tunnel a blocking fetch costs a full round
-        # trip (~75 ms), so a 16-request burst over 4 groups paid 4
-        # RTTs; one batched device_get pays it once while the chunks
-        # pipeline on the device (measured r5: capacity-row prefill
-        # wall 0.47 s -> ~0.15 s for 17.6 ms of device work)
+            total = int(req.prompt.size) + req.sampling.max_new_tokens
+            if self.prefix_caching:
+                try:
+                    # one hash walk: the capacity check happens inside
+                    # allocate_with_prefix BEFORE any mutation, so a
+                    # refusal leaves the pool untouched
+                    reused, n_cached = cache.allocate_with_prefix(
+                        req.req_id, req.prompt, total)
+                except RuntimeError:
+                    break  # head-of-line: keep FIFO, wait for frees
+                self._queue.popleft()
+                wave = 1 + max((pending_wave.get(b, -1)
+                                for b in reused), default=-1)
+                table = cache.seq_blocks(req.req_id)
+                n_full = int(req.prompt.size) // cache.block_size
+                for b in table[len(reused):n_full]:
+                    pending_wave[b] = wave
+                bucket = _bucket_for(int(req.prompt.size) - n_cached,
+                                     self.buckets)
+            else:
+                if cache.free_blocks < self._required_blocks(req):
+                    break
+                self._queue.popleft()
+                cache.allocate(req.req_id, total)
+                n_cached, wave = 0, 0
+                bucket = _bucket_for(int(req.prompt.size), self.buckets)
+            admitted.append((si, req, bucket, n_cached, wave))
+        by_group: dict = {}
+        for si, req, bucket, n_cached, wave in admitted:
+            by_group.setdefault((wave, bucket), []).append(
+                (si, req, n_cached))
+        # dispatch EVERY admission prefill before fetching ANY result
+        # (waves ascending — see above): through the remote tunnel a
+        # blocking fetch costs a full round trip (~75 ms), so a
+        # 16-request burst over 4 groups paid 4 RTTs; one batched
+        # device_get pays it once while the chunks pipeline on the
+        # device (measured r5: capacity-row prefill wall 0.47 s ->
+        # ~0.15 s for 17.6 ms of device work)
         pending = []
-        for bucket, group in by_bucket.items():
+        for wave, bucket in sorted(by_group):
+            group = by_group[(wave, bucket)]
             if len(group) > 1:
                 w = min(self.PREFILL_GROUP, self.max_b)
                 for i in range(0, len(group), w):
@@ -410,25 +487,38 @@ class ServingEngine:
     PREFILL_GROUP = 4
 
     def _prefill_dispatch(self, bucket: int, group, gp: int):
+        """Dispatch one prefill group. `group` rows are
+        (slot, req, n_cached): with prefix caching every row prefills
+        only its uncovered suffix — `bucket` is a SUFFIX bucket, RoPE
+        positions/slot mappings start at n_cached, and the row's cached
+        pages ride along as a scratch-padded prefix table."""
         t0 = time.perf_counter()
         cache = self.dec.cache
         vocab = self.dec.cfg.vocab_size
         ids = np.zeros((gp, bucket), np.int32)
         slots = np.full((gp, bucket), self._scratch_slot, np.int32)
         last_idx = np.zeros(gp, np.int32)
+        ncv = np.zeros(gp, np.int32)
+        ptab = np.full((gp, self._prefix_pages), self._scratch_block,
+                       np.int32)
         temps = np.zeros(gp, np.float32)
         top_ks = np.zeros(gp, np.int32)
         top_ps = np.ones(gp, np.float32)
         reps = np.ones(gp, np.float32)
         any_rep = any(req.sampling.repetition_penalty != 1.0
-                      for _, req in group)
+                      for _, req, _ in group)
         seen = np.zeros((gp, vocab), bool) if any_rep else None
-        for row, (si, req) in enumerate(group):
-            s = int(req.prompt.size)
-            ids[row, :s] = req.prompt
+        for row, (si, req, n_cached) in enumerate(group):
+            s = int(req.prompt.size) - n_cached
+            ids[row, :s] = req.prompt[n_cached:]
             slots[row, :s] = [cache.extend(req.req_id)
                               for _ in range(s)]
             last_idx[row] = s - 1
+            ncv[row] = n_cached
+            if n_cached:
+                pb = cache.seq_blocks(req.req_id)[
+                    :n_cached // cache.block_size]
+                ptab[row, :len(pb)] = pb
             sp = req.sampling
             temps[row] = sp.temperature
             # engine-level top_k is the default where the request does
@@ -437,21 +527,36 @@ class ServingEngine:
             top_ps[row] = sp.top_p
             reps[row] = sp.repetition_penalty
             if sp.repetition_penalty != 1.0:
-                seen[row, req.prompt] = True
+                seen[row, req.prompt] = True   # FULL prompt, cached too
         seen_dev = jnp.asarray(seen) if any_rep \
             else self._zeros_seen(gp, vocab)
-        toks, cache.k, cache.v = self._prefill_j(
-            self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
-            jnp.asarray(slots), jnp.asarray(last_idx),
-            jnp.asarray(temps), self._next_key(), jnp.asarray(top_ks),
-            jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
+        # the suffix-prefix program pays a per-layer page gather plus
+        # dense attention over the (possibly all-masked) prefix columns:
+        # only groups with at least one actual hit take it — all-miss
+        # groups keep the plain flash prefill, so disjoint traffic is
+        # unchanged by enabling the cache
+        if any(n for _, _, n in group):
+            toks, cache.k, cache.v = self._prefill_prefix_j(
+                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
+                jnp.asarray(slots), jnp.asarray(last_idx),
+                jnp.asarray(ncv), jnp.asarray(ptab),
+                jnp.asarray(temps), self._next_key(),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(reps), seen_dev)
+        else:
+            toks, cache.k, cache.v = self._prefill_j(
+                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
+                jnp.asarray(slots), jnp.asarray(last_idx),
+                jnp.asarray(temps), self._next_key(),
+                jnp.asarray(top_ks), jnp.asarray(top_ps),
+                jnp.asarray(reps), seen_dev)
         self.time_prefill_s += time.perf_counter() - t0
         return toks, group
 
     def _prefill_complete(self, toks: np.ndarray, group):
         """Post-fetch bookkeeping for one dispatched prefill chunk."""
         now = time.perf_counter()
-        for row, (si, req) in enumerate(group):
+        for row, (si, req, _) in enumerate(group):
             tok = int(toks[row])
             req.state = "running"
             req.t_first_token = now
@@ -491,6 +596,16 @@ class ServingEngine:
             cached = jnp.zeros((rows, vocab), bool)
             self._zeros_seen_cache[rows] = cached
         return cached
+
+    def _warmup_prompt(self, n: int) -> np.ndarray:
+        """Throwaway warmup prompt with a per-call token fill: two
+        warmup prompts must never share a block-aligned prefix, or the
+        prefix cache would splice them together and the full-length
+        (bucket, width) prefill programs warmup exists to compile would
+        never run."""
+        self._warmup_fill = getattr(self, "_warmup_fill", 0) + 1
+        v = 1 + self._warmup_fill % max(1, self.dec.cfg.vocab_size - 1)
+        return np.full(n, v, np.int32)
 
     def _rep_active(self) -> bool:
         return any(r is not None and
@@ -686,6 +801,11 @@ class ServingEngine:
                       and not self._rep_active()) else 0
         while len(self._inflight) > depth:
             self._collect_oldest()
+        if self._debug_pool:
+            # PADDLE_TPU_POOL_DEBUG=1: assert the pool invariant
+            # (free + cached + referenced == num_blocks, refs == table
+            # contents) after every scheduler step
+            self.dec.cache.debug_check()
         return self.has_work
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
@@ -696,12 +816,14 @@ class ServingEngine:
 
     def warmup(self, prompt_len: Optional[int] = None):
         """Pre-compile the serving programs — BOTH prefill widths for
-        every bucket (or just prompt_len's bucket when given) plus the
-        decode chunk — with throwaway requests, so no user request pays
-        a compile. Worth calling once at deployment; finished-request
-        stats are cleared afterwards. Warns if the KV pool is too small
-        to exercise the burst width (that variant would then compile on
-        the first real burst)."""
+        every bucket (or just prompt_len's bucket when given), the
+        prefix-cache HIT prefill for every hit-reachable suffix bucket,
+        plus the decode chunk — with throwaway requests, so no user
+        request pays a compile. Worth calling once at deployment;
+        finished-request stats AND the prefix cache are cleared
+        afterwards. Warns if the KV pool is too small to exercise the
+        burst width (that variant would then compile on the first real
+        burst)."""
         import warnings as _warnings
         plens = ([prompt_len] if prompt_len is not None
                  else list(self.buckets))
@@ -713,7 +835,7 @@ class ServingEngine:
                 "never runs on this engine; only width-1 is warmed")
         for plen in plens:
             # phase 1: a single request — the width-1 program
-            self.add_request(np.ones(plen, np.int32),
+            self.add_request(self._warmup_prompt(plen),
                              SamplingParams(max_new_tokens=2))
             self.run_to_completion()
             if self.max_b < 2:
@@ -721,7 +843,7 @@ class ServingEngine:
             # phase 2: a burst — the width-`width` program. The burst
             # path only runs if >= 2 requests admit TOGETHER.
             need = 2 * -(-(plen + 2) // cache.block_size)
-            if cache.free_blocks < need:
+            if cache.available_blocks < need:
                 _warnings.warn(
                     f"warmup: pool too small to exercise the width-"
                     f"{width} prefill at bucket {plen} (need {need} "
@@ -729,9 +851,50 @@ class ServingEngine:
                     "that compile")
                 continue
             for _ in range(width):
-                self.add_request(np.ones(plen, np.int32),
+                self.add_request(self._warmup_prompt(plen),
                                  SamplingParams(max_new_tokens=2))
             self.run_to_completion()
+        # prefix-cache HIT programs: the suffix-prefix prefill compiles
+        # per (suffix bucket, width), and warmup's distinct-fill miss
+        # traffic never runs it — seed a one-block prefix, then admit
+        # hits whose suffix lands in each reachable bucket (width 1),
+        # plus one burst at the first reachable bucket (width `width`)
+        if self.prefix_caching:
+            bs = cache.block_size
+            prefix = self._warmup_prompt(bs)
+            seeded = burst_done = False
+
+            def _hit_round(s_suf, rows):
+                for _ in range(rows):
+                    self.add_request(
+                        np.concatenate([prefix,
+                                        self._warmup_prompt(s_suf)]),
+                        SamplingParams(max_new_tokens=2))
+                self.run_to_completion()
+
+            for b in self.buckets:
+                s_suf = min(b, self.buckets[-1] - bs)
+                if s_suf <= 0 or _bucket_for(s_suf, self.buckets) != b:
+                    continue   # no runtime hit can land in this bucket
+                per_hit = -(-(bs + s_suf + 2) // bs)
+                if cache.available_blocks < per_hit + 1:
+                    _warnings.warn(
+                        f"warmup: pool too small to warm the prefix-hit "
+                        f"prefill at suffix bucket {b}; the first real "
+                        "hit there will pay that compile")
+                    continue
+                if not seeded:
+                    # park the shared prefix block (suffix of 1 token)
+                    self.add_request(
+                        np.concatenate([prefix, self._warmup_prompt(1)]),
+                        SamplingParams(max_new_tokens=1))
+                    self.run_to_completion()
+                    seeded = True
+                _hit_round(s_suf, 1)
+                if not burst_done and self.max_b >= 2 and \
+                        cache.available_blocks >= width * per_hit:
+                    _hit_round(s_suf, width)
+                    burst_done = True
         # rich-sampling + plain decode programs, once per ladder chunk
         # size (each T is its own compiled program): top_k=1 is greedy,
         # so the rich throwaway is deterministic but routes through
@@ -740,7 +903,7 @@ class ServingEngine:
         warmed_rungs = set()
         for c in self.chunks:
             if -(-(plens[0] + c + 2) // cache.block_size) > \
-                    cache.free_blocks:
+                    cache.available_blocks:
                 _warnings.warn(
                     f"warmup: pool too small to warm chunk rung {c}; "
                     f"its first real dispatch will pay the compile")
@@ -751,12 +914,12 @@ class ServingEngine:
             # into the timed cost loop below)
             self._force_chunk = c
             try:
-                self.add_request(np.ones(plens[0], np.int32),
+                self.add_request(self._warmup_prompt(plens[0]),
                                  SamplingParams(max_new_tokens=c + 2,
                                                 temperature=1.0,
                                                 top_k=1))
                 self.run_to_completion()
-                self.add_request(np.ones(plens[0], np.int32),
+                self.add_request(self._warmup_prompt(plens[0]),
                                  SamplingParams(max_new_tokens=c + 2))
                 self.run_to_completion()
             finally:
@@ -780,7 +943,7 @@ class ServingEngine:
                 while n_chunks > 0:
                     need = -(-(plens[0] + n_chunks * c)
                              // cache.block_size)
-                    if need <= cache.free_blocks:
+                    if need <= cache.available_blocks:
                         break
                     n_chunks -= 1
                 if n_chunks == 0:
@@ -794,7 +957,7 @@ class ServingEngine:
                 try:
                     before = self.time_stall_s + self.time_host_s
                     self.add_request(
-                        np.ones(plens[0], np.int32),
+                        self._warmup_prompt(plens[0]),
                         SamplingParams(max_new_tokens=n_chunks * c))
                     self.run_to_completion()
                     delta = (self.time_stall_s + self.time_host_s
@@ -802,20 +965,28 @@ class ServingEngine:
                 finally:
                     self._force_chunk = None
                 self._chunk_cost[c] = max(delta / n_chunks, 1e-6)
+        # warmup traffic must leave no trace: parked throwaway blocks
+        # would otherwise occupy LRU slots (and could in principle be
+        # spliced by a real request with the same fill pattern)
+        cache.clear_prefix_cache()
         self.clear_finished()
 
     def clear_finished(self):
         """Drop finished requests + counters (e.g. after warmup) so
-        stats() reflect only the workload that follows."""
+        stats() reflect only the workload that follows — including the
+        prefix-cache hit/eviction counters, so warmup traffic cannot
+        pollute the reported hit rate."""
         self._done.clear()
         self.decode_steps = 0
         self.generated_tokens = 0
         self.time_prefill_s = 0.0
         self.time_stall_s = 0.0
         self.time_host_s = 0.0
+        self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
         """Latency/throughput summary over finished requests."""
+        cache = self.dec.cache
         lats = [r.latency_s for r in self._done.values()
                 if r.latency_s is not None]
         ttfts = [r.ttft_s for r in self._done.values()
@@ -846,4 +1017,14 @@ class ServingEngine:
             "time_prefill_s": self.time_prefill_s,
             "time_decode_stall_s": self.time_stall_s,
             "time_host_s": self.time_host_s,
+            # prefix cache: hit tokens = prompt tokens whose KV was
+            # spliced from cached blocks instead of re-prefilled;
+            # hit rate is over all prompt tokens seen at admission
+            "prefix_cache_hit_tokens": cache.prefix_hit_tokens,
+            "prefix_cache_hit_rate": (
+                cache.prefix_hit_tokens / cache.prefix_query_tokens
+                if cache.prefix_query_tokens else 0.0),
+            "prefix_cache_evictions": cache.prefix_evictions,
+            "free_blocks": cache.free_blocks,
+            "cached_blocks": cache.cached_blocks,
         }

@@ -18,7 +18,8 @@ lengths, with masking by context length.
 """
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from collections import Counter, OrderedDict
+from typing import List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -86,6 +87,18 @@ class PagedKVCache:
 
     One instance per layer set: caches are stacked [num_layers, ...] so a
     decode step updates all layers functionally.
+
+    Automatic prefix caching (vLLM-style): every block carries a ref
+    count, and FULL blocks whose token content is known get a chain hash
+    ``hash(parent_hash, block_tokens)`` registered in a hash→block
+    index. Because full blocks are immutable once written, a new request
+    whose prompt shares a block-aligned prefix with previously seen
+    content can splice the physical blocks into its table
+    (``allocate_with_prefix``) instead of re-prefilling — a ref-count
+    bump, no copy. Freed blocks that still carry a valid hash are PARKED
+    in an LRU of cached-but-unreferenced blocks rather than zeroed; they
+    are only truly evicted (hash invalidated) when the free list runs
+    dry, so hot prefixes survive across requests at zero capacity cost.
     """
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
@@ -108,34 +121,194 @@ class PagedKVCache:
         self._free = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id → [block ids]
         self._lens: dict = {}     # seq_id → context length
+        self._ref: dict = {}      # block → ref count (present iff > 0)
+        # prefix-cache index: chain hash ↔ physical block, plus the LRU
+        # of cached-but-unreferenced blocks (insertion order = park
+        # order; oldest evicted first when the free list runs dry)
+        self._hash_of: dict = {}        # block → chain hash
+        self._block_of: dict = {}       # chain hash → block
+        self._lru: OrderedDict = OrderedDict()   # block → None
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+        self.prefix_evictions = 0
 
     # -- allocation ---------------------------------------------------------
+    def _take_block(self) -> int:
+        """Pop a writable block: the free list first, then (free list
+        dry) evict the least-recently-parked cached block, invalidating
+        its hash so it can never be spliced again."""
+        if self._free:
+            return self._free.pop()
+        if self._lru:
+            blk, _ = self._lru.popitem(last=False)
+            h = self._hash_of.pop(blk)
+            self._block_of.pop(h, None)
+            self.prefix_evictions += 1
+            return blk
+        raise RuntimeError("KV cache exhausted")
+
     def allocate(self, seq_id: int, num_tokens: int):
         """Reserve blocks for a sequence of num_tokens (prefill)."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id} already allocated")
         needed = -(-num_tokens // self.block_size)
-        if len(self._free) < needed:
+        if self.available_blocks < needed:
             raise RuntimeError(
                 f"KV cache exhausted: need {needed} blocks, "
-                f"{len(self._free)} free")
-        self._tables[seq_id] = [self._free.pop() for _ in range(needed)]
+                f"{self.available_blocks} free")
+        blocks = [self._take_block() for _ in range(needed)]
+        for b in blocks:
+            self._ref[b] = 1
+        self._tables[seq_id] = blocks
         self._lens[seq_id] = 0
         return self._tables[seq_id]
+
+    # -- prefix caching ------------------------------------------------------
+    def _chain_hashes(self, tokens) -> List[int]:
+        """Chain hash per FULL block of `tokens`:
+        h_i = hash(h_{i-1}, tokens[i*bs:(i+1)*bs]); the chain makes a
+        block's identity cover its whole prefix, so equal hashes mean
+        equal content AND equal position history."""
+        bs = self.block_size
+        toks = [int(t) for t in tokens]
+        out: List[int] = []
+        h = None
+        for i in range(len(toks) // bs):
+            h = hash((h, tuple(toks[i * bs:(i + 1) * bs])))
+            out.append(h)
+        return out
+
+    def _match(self, hashes: List[int],
+               n_tokens: int) -> List[Tuple[int, int]]:
+        matched: List[Tuple[int, int]] = []
+        for h in hashes:
+            blk = self._block_of.get(h)
+            if blk is None:
+                break
+            matched.append((h, blk))
+        if matched and len(matched) * self.block_size >= n_tokens:
+            matched.pop()
+        return matched
+
+    def match_prefix(self, tokens) -> List[Tuple[int, int]]:
+        """Longest chain of already-cached full blocks covering a
+        prefix of `tokens` — [(hash, block)], non-mutating. Capped so at
+        least one token is left uncovered: the caller always prefills a
+        non-empty suffix (the last position's logits must be computed)."""
+        return self._match(self._chain_hashes(tokens), len(tokens))
+
+    def _prefix_capacity(self, matched, num_tokens: int):
+        """(fresh blocks needed, blocks claimable) for an allocation
+        splicing `matched`: matched blocks cost nothing (ref bump), and
+        cached blocks not part of the match are evictable on demand."""
+        needed = -(-num_tokens // self.block_size) - len(matched)
+        evictable = len(self._lru) - sum(1 for _, b in matched
+                                         if b in self._lru)
+        return needed, len(self._free) + evictable
+
+    def can_allocate_with_prefix(self, tokens, num_tokens: int) -> bool:
+        """Worst-case admission check that credits reusable blocks."""
+        needed, avail = self._prefix_capacity(self.match_prefix(tokens),
+                                              num_tokens)
+        return avail >= needed
+
+    def allocate_with_prefix(self, seq_id: int, tokens,
+                             num_tokens: Optional[int] = None):
+        """Reserve blocks for a prompt of `tokens` (worst-case capacity
+        `num_tokens` ≥ len(tokens)), splicing in every cached block of
+        the longest matching block-aligned prefix (ref++, no copy).
+        Returns (reused_blocks, n_cached_tokens); the sequence's context
+        length starts at n_cached_tokens, so `extend` hands out slots
+        for the uncovered suffix only. The suffix's own full prompt
+        blocks are registered in the hash index immediately — their
+        content is fully determined by the prompt, so later requests may
+        splice them as soon as the owning prefill has been dispatched
+        (dispatch ordering is the caller's job; see ServingEngine's
+        admission waves)."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id} already allocated")
+        n_tok = len(tokens) if num_tokens is None else int(num_tokens)
+        hashes = self._chain_hashes(tokens)
+        matched = self._match(hashes, len(tokens))
+        needed_new, avail = self._prefix_capacity(matched, n_tok)
+        if avail < needed_new:
+            raise RuntimeError(
+                f"KV cache exhausted: need {needed_new} blocks, "
+                f"{avail} free")
+        reused = []
+        for _, blk in matched:          # revive/ref BEFORE taking fresh
+            self._lru.pop(blk, None)    # blocks so eviction can't steal
+            self._ref[blk] = self._ref.get(blk, 0) + 1   # a matched one
+            reused.append(blk)
+        fresh = [self._take_block() for _ in range(needed_new)]
+        for b in fresh:
+            self._ref[b] = 1
+        table = reused + fresh
+        self._tables[seq_id] = table
+        n_cached = len(reused) * self.block_size
+        self._lens[seq_id] = n_cached
+        self.prefix_query_tokens += len(tokens)
+        self.prefix_hit_tokens += n_cached
+        # register the suffix's full prompt blocks for future reuse
+        for i in range(len(reused), len(hashes)):
+            h, b = hashes[i], table[i]
+            if h not in self._block_of and b not in self._hash_of:
+                self._block_of[h] = b
+                self._hash_of[b] = h
+        return reused, n_cached
+
+    def clear_prefix_cache(self):
+        """Drop every cached (unreferenced) block back to the free list
+        and forget all hashes — e.g. between warmup phases so throwaway
+        traffic cannot splice into real requests' programs."""
+        for blk in self._lru:
+            self._free.append(blk)
+        self._lru.clear()
+        self._hash_of.clear()
+        self._block_of.clear()
+
+    def reset_prefix_stats(self):
+        self.prefix_hit_tokens = 0
+        self.prefix_query_tokens = 0
+        self.prefix_evictions = 0
 
     def extend(self, seq_id: int):
         """Ensure room for one more token; returns the flat slot id."""
         pos = self._lens[seq_id]
         blocks = self._tables[seq_id]
         if pos >= len(blocks) * self.block_size:
-            if not self._free:
+            if self.available_blocks == 0:
                 raise RuntimeError("KV cache exhausted on extend")
-            blocks.append(self._free.pop())
+            blk = self._take_block()
+            self._ref[blk] = 1
+            blocks.append(blk)
         self._lens[seq_id] = pos + 1
         block = blocks[pos // self.block_size]
         return block * self.block_size + pos % self.block_size
 
     def free(self, seq_id: int):
-        self._free.extend(reversed(self._tables.pop(seq_id, [])))
+        """Release a sequence: ref-- on each of its blocks; blocks
+        reaching ref 0 are parked in the cached-LRU when they carry a
+        valid hash (contents stay reusable) or returned to the free
+        list otherwise. A no-op for unknown / already-freed seq_ids —
+        a double free must not decrement someone else's refs."""
+        blocks = self._tables.pop(seq_id, None)
         self._lens.pop(seq_id, None)
+        if blocks is None:
+            return
+        returned = []
+        # park LEAF-first: eviction pops oldest-parked, and a chain dies
+        # from its head — parking the head last keeps the hot prefix
+        # matchable longest (evicting a head orphans every descendant)
+        for b in reversed(blocks):
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                if b in self._hash_of:
+                    self._lru[b] = None      # park: newest at the end
+                else:
+                    returned.append(b)
+        self._free.extend(returned)
 
     def context_len(self, seq_id: int) -> int:
         return self._lens.get(seq_id, 0)
@@ -146,9 +319,51 @@ class PagedKVCache:
         out[:len(t)] = t
         return out
 
+    def seq_blocks(self, seq_id: int) -> List[int]:
+        """The sequence's physical block list (read-only view)."""
+        return list(self._tables[seq_id])
+
     @property
     def free_blocks(self) -> int:
         return len(self._free)
+
+    @property
+    def cached_blocks(self) -> int:
+        """Blocks parked in the prefix-cache LRU (reusable, evictable)."""
+        return len(self._lru)
+
+    @property
+    def available_blocks(self) -> int:
+        """Blocks a fresh allocation can claim: free + evictable."""
+        return len(self._free) + len(self._lru)
+
+    def debug_check(self):
+        """Pool invariant: free + cached + referenced == num_blocks,
+        the three sets disjoint, table refs exactly matching the ref
+        counts (no leak, no double free), and the hash index a
+        bijection with every cached block hash-registered. Raises
+        AssertionError on violation; cheap enough to run after every
+        scheduler step in tests."""
+        free = set(self._free)
+        cached = set(self._lru)
+        referenced = set(self._ref)
+        assert len(free) == len(self._free), "duplicate free blocks"
+        assert not free & cached and not free & referenced \
+            and not cached & referenced, "block in two pools at once"
+        assert len(free) + len(cached) + len(referenced) \
+            == self.num_blocks, (
+                f"pool leak: free={len(free)} cached={len(cached)} "
+                f"referenced={len(referenced)} != {self.num_blocks}")
+        counts = Counter()
+        for t in self._tables.values():
+            counts.update(t)
+        assert dict(counts) == self._ref, "ref counts out of sync"
+        assert all(self._block_of.get(h) == b
+                   for b, h in self._hash_of.items()) \
+            and len(self._block_of) == len(self._hash_of), \
+            "hash index not a bijection"
+        assert all(b in self._hash_of for b in cached), \
+            "cached block without a hash"
 
     # -- device updates -----------------------------------------------------
     def write(self, layer: int, k, v, slot_mapping):

@@ -440,10 +440,45 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 
 
 def mode(x, axis=-1, keepdim=False, name=None):
-    xv = np.asarray(x._value)
-    from scipy import stats  # available via numpy ecosystem
-    m = stats.mode(xv, axis=axis, keepdims=keepdim)
-    return Tensor(jnp.asarray(m.mode)), Tensor(jnp.asarray(m.count))
+    """Most frequent value along `axis` (+ its index); ties break to
+    the smallest value. Pure jnp so gradients flow to the selected
+    element (the scipy path returned graph-less Tensors, which broke
+    backward once scipy started reporting float counts). O(n log n):
+    sort, then per-position run length from the run-start cummax and
+    next-run-start cummin — no pairwise n×n comparison."""
+    def f(a):
+        last = a.ndim - 1
+        m = jnp.moveaxis(a, axis, -1)
+        n = m.shape[-1]
+        s = jnp.sort(m, axis=-1)
+        order = jnp.argsort(m, axis=-1)          # stable, ascending
+        p = jnp.arange(n)
+        change = jnp.concatenate(
+            [jnp.ones(m.shape[:-1] + (1,), bool),
+             s[..., 1:] != s[..., :-1]], axis=-1)
+        start = jax.lax.cummax(jnp.where(change, p, 0), axis=last)
+        nxt = jax.lax.cummin(jnp.where(change, p, n)[..., ::-1],
+                             axis=last)[..., ::-1]
+        end = jnp.concatenate(
+            [nxt[..., 1:], jnp.full(m.shape[:-1] + (1,), n)], axis=-1)
+        counts = end - start                     # run length at each pos
+        # first max → leftmost run → smallest value among count ties;
+        # the reported index is the LAST original occurrence (paddle
+        # semantics): the run's final sorted slot, whose stable-argsort
+        # entry is the largest original index of that value
+        best = jnp.argmax(counts, axis=-1, keepdims=True)
+        pick = jnp.take_along_axis(
+            order, jnp.take_along_axis(end, best, -1) - 1, -1)
+        v = jnp.moveaxis(jnp.take_along_axis(m, pick, -1), -1, axis)
+        i = jnp.moveaxis(pick, -1, axis)
+        if not keepdim:
+            v = jnp.squeeze(v, axis)
+            i = jnp.squeeze(i, axis)
+        idx_dtype = jnp.int64 if jax.config.jax_enable_x64 else jnp.int32
+        return v, i.astype(idx_dtype)
+    vals, idxs = apply("mode", f, x)
+    idxs.stop_gradient = True
+    return vals, idxs
 
 
 def median(x, axis=None, keepdim=False, mode="avg", name=None):

@@ -1,0 +1,78 @@
+"""Version shims so one source tree spans the jax releases we run on.
+
+The code targets the modern surface (``jax.shard_map`` & friends); older
+installs (0.4.x) spell the same objects under ``jax.experimental``. The
+shims alias the new names onto the ``jax`` module BEFORE any paddle_tpu
+module imports them — `from jax import shard_map` is an attribute lookup
+at import time, so patching here is enough. No behavior changes: every
+alias points at the identical implementation object.
+"""
+from __future__ import annotations
+
+import jax
+
+if not hasattr(jax, "shard_map"):
+    try:
+        from jax.experimental.shard_map import shard_map as _shard_map
+        import inspect as _inspect
+
+        if "check_vma" in _inspect.signature(_shard_map).parameters:
+            jax.shard_map = _shard_map
+        else:
+            # pre-rename shard_map: check_vma was check_rep, and
+            # "manual over a subset" was spelled auto=<complement set>
+            # instead of axis_names=<manual set>
+            import functools as _functools
+
+            @_functools.wraps(_shard_map)
+            def _shard_map_compat(f, *args, **kwargs):
+                if "check_vma" in kwargs:
+                    kwargs["check_rep"] = kwargs.pop("check_vma")
+                names = kwargs.pop("axis_names", None)
+                if names is not None:
+                    mesh = kwargs.get("mesh", args[0] if args else None)
+                    kwargs["auto"] = (frozenset(mesh.axis_names)
+                                      - frozenset(names))
+                return _shard_map(f, *args, **kwargs)
+
+            jax.shard_map = _shard_map_compat
+    except ImportError:  # pragma: no cover — very old jax; leave as-is
+        pass
+
+# jax.lax.pvary (newer VMA tagging) is value-identity; the old rep
+# system either skips the check (check_rep=False) or infers reps itself
+if not hasattr(jax.lax, "pvary"):
+    jax.lax.pvary = lambda x, axis_name=None: x
+
+# 0.4.x ships jax.export as a submodule but does not import it into the
+# jax namespace by default (attribute access lands in the deprecation
+# __getattr__ and raises); importing it here registers the attribute
+try:
+    import jax.export  # noqa: F401
+except ImportError:  # pragma: no cover
+    pass
+
+# jax.P (PartitionSpec alias) appeared alongside jax.shard_map
+if not hasattr(jax, "P"):
+    try:
+        from jax.sharding import PartitionSpec as _P
+        jax.P = _P
+    except ImportError:  # pragma: no cover
+        pass
+
+# jax.ffi graduated from jax.extend.ffi; alias the old module forward
+if not hasattr(jax, "ffi"):
+    try:
+        import jax.extend.ffi as _ffi
+        jax.ffi = _ffi
+    except ImportError:  # pragma: no cover
+        pass
+
+# pallas-TPU renamed TPUCompilerParams -> CompilerParams; alias forward
+try:
+    import jax.experimental.pallas.tpu as _pltpu
+    if not hasattr(_pltpu, "CompilerParams") and \
+            hasattr(_pltpu, "TPUCompilerParams"):
+        _pltpu.CompilerParams = _pltpu.TPUCompilerParams
+except ImportError:  # pragma: no cover
+    pass

@@ -15,7 +15,14 @@ if not _xb.backends_are_initialized():
     _xb._backend_factories.pop("axon", None)
     os.environ["JAX_PLATFORMS"] = "cpu"
     jax.config.update("jax_platforms", "cpu")
-    jax.config.update("jax_num_cpu_devices", 8)
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except AttributeError:
+        # jax < 0.5 has no jax_num_cpu_devices config; the pre-init
+        # XLA flag spells the same 8-virtual-device CPU backend
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8")
 elif jax.default_backend() != "cpu":
     raise RuntimeError(
         "JAX backend initialized before conftest; run pytest with "

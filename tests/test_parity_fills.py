@@ -278,9 +278,13 @@ class TestMiscFills:
 
 class TestParityRatchet:
     def test_overall_parity_floor(self):
+        import os
         import sys
         sys.path.insert(0, "tools")
         import parity_audit
+        if not os.path.isdir(parity_audit.REF):
+            pytest.skip("reference source tree not present in this "
+                        "environment — nothing to audit against")
         rows, overall = parity_audit.audit()
         assert overall >= parity_audit.FLOORS["_overall"], (
             f"API parity regressed: {overall:.1f}% < "

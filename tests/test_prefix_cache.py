@@ -1,0 +1,366 @@
+"""Automatic prefix caching: ref-counted KV block reuse + LRU eviction.
+
+Three layers under test (mirroring the serving stack):
+- PagedKVCache: chain hashes, match/splice, ref counts, the cached-LRU,
+  eviction, double-free/leak guards, the pool invariant
+  (free + cached + referenced == num_blocks);
+- ServingEngine admission: suffix-only prefill must be TOKEN-IDENTICAL
+  to full prefill for shared-prefix and disjoint prompts, including
+  same-wave bursts, eviction pressure, and randomized admit/retire;
+- stats plumbing: hit tokens/rate, evictions, counter reset.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.ops.paged_attention import PagedKVCache
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+
+class TestPoolPrefixCache:
+    """PagedKVCache unit surface (no device work — pure allocator)."""
+
+    def _pool(self, num_blocks=16, block_size=4):
+        return PagedKVCache(num_layers=1, num_blocks=num_blocks,
+                            block_size=block_size, kv_heads=1, head_dim=4)
+
+    def test_match_prefix_walks_chain_and_caps(self):
+        c = self._pool()
+        toks = np.arange(12, dtype=np.int32)       # 3 full blocks
+        reused, n = c.allocate_with_prefix(0, toks, 12)
+        assert (reused, n) == ([], 0)
+        c.free(0)                                   # park 3 hashed blocks
+        assert c.cached_blocks == 3
+        # identical prompt: full coverage would leave no suffix — the
+        # match must cap at 2 blocks so >= 1 token prefills
+        assert len(c.match_prefix(toks)) == 2
+        # longer prompt sharing the prefix: all 3 blocks match
+        longer = np.concatenate([toks, [99, 98]]).astype(np.int32)
+        assert len(c.match_prefix(longer)) == 3
+        # diverging content matches only up to the divergence
+        fork = toks.copy()
+        fork[5] = 77                                # middle of block 1
+        assert len(c.match_prefix(fork)) == 1
+        c.debug_check()
+
+    def test_splice_refcounts_and_lru_revive(self):
+        c = self._pool()
+        toks = np.arange(8, dtype=np.int32)         # 2 full blocks
+        c.allocate_with_prefix(0, toks, 10)
+        c.free(0)
+        assert c.cached_blocks == 2 and c.free_blocks == 14
+        longer = np.concatenate([toks, [5, 6, 7]]).astype(np.int32)
+        reused, n = c.allocate_with_prefix(1, longer, 11)
+        assert n == 8 and len(reused) == 2
+        assert c.cached_blocks == 0                 # revived out of LRU
+        # a second request over the same prefix shares the SAME blocks
+        reused2, n2 = c.allocate_with_prefix(2, longer, 11)
+        assert n2 == 8 and reused2 == reused
+        c.debug_check()
+        c.free(1)
+        c.debug_check()                             # shared blocks still live
+        c.free(2)
+        c.debug_check()
+        assert c.free_blocks + c.cached_blocks == 16
+
+    def test_eviction_invalidates_hash(self):
+        c = self._pool(num_blocks=4, block_size=4)
+        a = np.arange(8, dtype=np.int32)
+        c.allocate_with_prefix(0, a, 8)
+        c.free(0)                                   # 2 cached (capped reg?)
+        cached0 = c.cached_blocks
+        assert cached0 >= 1
+        # a disjoint allocation bigger than the free list forces evictions
+        b = np.arange(100, 112, dtype=np.int32)
+        c.allocate_with_prefix(1, b, 12)
+        assert c.prefix_evictions >= 1
+        c.debug_check()
+        c.free(1)
+        # the evicted blocks' hashes are gone: the original prompt can
+        # only match whatever survived
+        assert len(c.match_prefix(a)) <= cached0
+        c.debug_check()
+
+    def test_eviction_eats_chains_leaf_first(self):
+        # blocks park leaf-first, so pressure evicts a cached chain
+        # from its TAIL — the head (the hot shared prefix) stays
+        # matchable longest instead of orphaning its descendants
+        c = self._pool(num_blocks=4, block_size=4)
+        toks = np.arange(13, dtype=np.int32)     # 3 full blocks + 1
+        c.allocate_with_prefix(0, toks, 16)
+        c.free(0)                                 # park chain of 3
+        assert c.cached_blocks == 3
+        c.allocate(1, 8)                          # free list dry → evict 1
+        assert c.prefix_evictions == 1
+        assert len(c.match_prefix(toks)) == 2     # head + middle survive
+        c.debug_check()
+
+    def test_double_free_and_unknown_free_are_noops(self):
+        c = self._pool()
+        c.allocate(0, 8)
+        c.free(0)
+        before = (c.free_blocks, c.cached_blocks)
+        c.free(0)                                   # double free
+        c.free(12345)                               # never allocated
+        assert (c.free_blocks, c.cached_blocks) == before
+        c.debug_check()
+
+    def test_allocate_existing_seq_rejected(self):
+        c = self._pool()
+        c.allocate(0, 4)
+        with pytest.raises(ValueError, match="already allocated"):
+            c.allocate(0, 4)
+        with pytest.raises(ValueError, match="already allocated"):
+            c.allocate_with_prefix(0, np.arange(4, dtype=np.int32), 4)
+
+    def test_exhaustion_counts_evictable(self):
+        c = self._pool(num_blocks=4, block_size=4)
+        toks = np.arange(8, dtype=np.int32)
+        c.allocate_with_prefix(0, toks, 8)
+        c.free(0)
+        # free list has 2, LRU has 2: a 4-block disjoint demand fits
+        assert c.can_allocate_with_prefix(
+            np.arange(50, 64, dtype=np.int32), 16)
+        assert not c.can_allocate_with_prefix(
+            np.arange(50, 70, dtype=np.int32), 20)
+        with pytest.raises(RuntimeError, match="exhausted"):
+            c.allocate_with_prefix(
+                1, np.arange(50, 70, dtype=np.int32), 20)
+        c.debug_check()
+
+    def test_clear_prefix_cache_returns_blocks(self):
+        c = self._pool()
+        c.allocate_with_prefix(0, np.arange(8, dtype=np.int32), 8)
+        c.free(0)
+        assert c.cached_blocks > 0
+        c.clear_prefix_cache()
+        assert c.cached_blocks == 0 and c.free_blocks == 16
+        assert c.match_prefix(np.arange(8, dtype=np.int32)) == []
+        c.debug_check()
+
+    def test_invariant_over_random_schedule(self):
+        rng = np.random.RandomState(0)
+        c = self._pool(num_blocks=24, block_size=4)
+        prefixes = [rng.randint(0, 512, (8,)).astype(np.int32)
+                    for _ in range(3)]
+        live = {}
+        for step in range(300):
+            if live and (len(live) >= 4 or rng.rand() < 0.4):
+                sid = rng.choice(sorted(live))
+                c.free(sid)
+                del live[sid]
+            else:
+                sid = step
+                pre = prefixes[rng.randint(3)]
+                tail = rng.randint(0, 512,
+                                   (rng.randint(1, 6),)).astype(np.int32)
+                toks = np.concatenate([pre, tail])
+                total = len(toks) + rng.randint(1, 8)
+                if not c.can_allocate_with_prefix(toks, total):
+                    continue
+                _, n_cached = c.allocate_with_prefix(sid, toks, total)
+                live[sid] = True
+                for _ in range(len(toks) - n_cached):
+                    c.extend(sid)
+            c.debug_check()
+        for sid in list(live):
+            c.free(sid)
+        c.debug_check()
+        assert c.free_blocks + c.cached_blocks == 24
+
+
+def _shared_prefix_prompts(rng, vocab, shared_len=24, n_shared=4,
+                           n_disjoint=2, tail=(3, 9)):
+    shared = rng.randint(0, vocab, (shared_len,)).astype(np.int32)
+    ps = [np.concatenate([shared, rng.randint(
+        0, vocab, (int(rng.randint(*tail)),)).astype(np.int32)])
+        for _ in range(n_shared)]
+    ps += [rng.randint(0, vocab, (shared_len - 3,)).astype(np.int32)
+           for _ in range(n_disjoint)]
+    return ps
+
+
+class TestEnginePrefixCache:
+    """Cache-on vs cache-off must be token-identical; the pool
+    invariant must hold after every scheduler step (enforced by
+    PADDLE_TPU_POOL_DEBUG=1 via ServingEngine.step)."""
+
+    def setup_method(self):
+        paddle.seed(0)
+        self.cfg = llama_tiny()
+        self.model = LlamaForCausalLM(self.cfg)
+        self.model.eval()
+        self.rng = np.random.RandomState(11)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32))
+        kw.setdefault("chunk_size", 4)
+        return ServingEngine(self.model, **kw)
+
+    def _run(self, prompts, news, **kw):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(**kw)
+        rids = [eng.add_request(p, SamplingParams(max_new_tokens=n))
+                for p, n in zip(prompts, news)]
+        got = eng.run_to_completion()
+        eng.dec.cache.debug_check()
+        return [got[r].tolist() for r in rids], eng
+
+    def test_on_off_token_identical_mixed_batch(self):
+        prompts = _shared_prefix_prompts(self.rng, self.cfg.vocab_size)
+        news = [6, 4, 8, 5, 7, 3]
+        off, _ = self._run(prompts, news, prefix_caching=False)
+        on, eng = self._run(prompts, news, prefix_caching=True)
+        assert on == off
+        st = eng.stats()
+        # 3 of the 4 shared-prefix requests splice the 24-token prefix
+        assert st["prefix_cache_hit_tokens"] == 3 * 24
+        assert 0 < st["prefix_cache_hit_rate"] < 1
+        assert st["free_blocks"] + st["cached_blocks"] == 64 - 1
+
+    def test_on_off_identical_same_wave_burst(self):
+        # all shared-prefix requests admitted in ONE admission wave:
+        # later rows splice blocks the first row's prefill writes —
+        # wave-ordered dispatch must keep results exact
+        prompts = _shared_prefix_prompts(self.rng, self.cfg.vocab_size,
+                                         shared_len=16, n_shared=5,
+                                         n_disjoint=1)
+        news = [5] * 6
+        off, _ = self._run(prompts, news, prefix_caching=False,
+                           max_batch_size=6)
+        on, eng = self._run(prompts, news, prefix_caching=True,
+                            max_batch_size=6)
+        assert on == off
+        assert eng.stats()["prefix_cache_hit_tokens"] == 4 * 16
+
+    def test_eviction_under_pressure_results_exact(self):
+        # pool far smaller than total demand: parked prefixes are
+        # evicted to make room, and results must STILL be exact. The
+        # tail pair of fresh-prefix requests lands when the LRU holds
+        # the earlier groups' blocks and the free list cannot cover
+        # 2 × 4 pages — evictions are forced, results stay exact.
+        rng = np.random.RandomState(3)
+        vocab = self.cfg.vocab_size
+        groups = [_shared_prefix_prompts(rng, vocab, shared_len=16,
+                                         n_shared=2, n_disjoint=0)
+                  for _ in range(3)]
+        prompts = [p for g in groups for p in g]
+        prompts += [rng.randint(0, vocab, (17,)).astype(np.int32)
+                    for _ in range(2)]
+        news = [5] * len(prompts)
+        off, _ = self._run(prompts, news, prefix_caching=False,
+                           num_blocks=10)
+        on, eng = self._run(prompts, news, prefix_caching=True,
+                            num_blocks=10)
+        assert on == off
+        st = eng.stats()
+        assert st["prefix_cache_evictions"] > 0
+        assert st["free_blocks"] + st["cached_blocks"] == 10 - 1
+
+    def test_refcount_invariant_random_admit_retire(self):
+        from paddle_tpu.inference import SamplingParams
+        rng = np.random.RandomState(5)
+        eng = self._engine(num_blocks=24, max_batch_size=3)
+        prompts = _shared_prefix_prompts(rng, self.cfg.vocab_size,
+                                         shared_len=16, n_shared=8,
+                                         n_disjoint=4)
+        pending = list(prompts) * 2
+        rng.shuffle(pending)
+        cache = eng.dec.cache
+        while pending or eng.has_work:
+            for _ in range(int(rng.randint(0, 3))):
+                if pending:
+                    eng.add_request(pending.pop(), SamplingParams(
+                        max_new_tokens=int(rng.randint(2, 9))))
+            eng.step()
+            cache.debug_check()
+        cache.debug_check()
+        assert cache.free_blocks + cache.cached_blocks == 24 - 1
+
+    def test_cache_raises_effective_capacity(self):
+        # pool that cannot hold two requests WITHOUT reuse admits both
+        # at once WITH reuse (the worst-case check credits matched
+        # blocks): 29-token prompts + 8 new = 5 pages each; pool 8
+        # usable pages ⇒ cache-off admits one at a time, cache-on
+        # admits both (3 shared pages counted once)
+        from paddle_tpu.inference import SamplingParams
+        shared = self.rng.randint(0, self.cfg.vocab_size,
+                                  (24,)).astype(np.int32)
+        mk = lambda: np.concatenate(
+            [shared, self.rng.randint(0, self.cfg.vocab_size,
+                                      (5,)).astype(np.int32)])
+        eng = self._engine(num_blocks=9, max_batch_size=2)
+        a = eng.add_request(mk(), SamplingParams(max_new_tokens=8))
+        eng.step()                 # admit + prefill A, register prefix
+        b = eng.add_request(mk(), SamplingParams(max_new_tokens=8))
+        eng.step()
+        running = [r for r in eng._slots if r is not None]
+        assert len(running) == 2   # B admitted while A still runs
+        eng.run_to_completion()
+        assert len(eng.result(a)) == 8 and len(eng.result(b)) == 8
+        eng.dec.cache.debug_check()
+
+    def test_clear_finished_resets_prefix_counters(self):
+        prompts = _shared_prefix_prompts(self.rng, self.cfg.vocab_size)
+        _, eng = self._run(prompts, [4] * 6)
+        assert eng.stats()["prefix_cache_hit_tokens"] > 0
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["prefix_cache_hit_tokens"] == 0
+        assert st["prefix_cache_hit_rate"] == 0.0
+        assert st["prefix_cache_evictions"] == 0
+
+    def test_warmup_leaves_cache_clean(self):
+        eng = self._engine(prompt_buckets=(8, 16))
+        eng.warmup(prompt_len=8)
+        cache = eng.dec.cache
+        assert cache.cached_blocks == 0        # warmup traffic flushed
+        st = eng.stats()
+        assert st["prefix_cache_hit_tokens"] == 0
+        cache.debug_check()
+
+    def test_oversized_prompt_rejected_at_enqueue(self):
+        eng = self._engine()
+        with pytest.raises(ValueError, match=r"prompt_buckets=\(8, 16, 32\)"):
+            eng.add_request(np.zeros(100, np.int32))
+        # nothing was queued or allocated by the failed enqueue
+        assert not eng.has_work
+        eng.dec.cache.debug_check()
+
+
+class TestGPTEnginePrefixCache:
+    """The second model family: suffix prefill over learned position
+    embeddings must also be exact."""
+
+    def test_gpt_on_off_identical(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import (PagedGPTDecoder, SamplingParams,
+                                          ServingEngine)
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        model = GPTForCausalLM(cfg)
+        model.eval()
+        rng = np.random.RandomState(2)
+        prompts = _shared_prefix_prompts(rng, cfg.vocab_size,
+                                         shared_len=16, n_shared=3,
+                                         n_disjoint=1)
+        outs = []
+        for pc in (False, True):
+            dec = PagedGPTDecoder(model, num_blocks=64, block_size=8)
+            eng = ServingEngine(dec, max_batch_size=2,
+                                prompt_buckets=(8, 16, 32),
+                                chunk_size=4, prefix_caching=pc)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=5))
+                    for p in prompts]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]

@@ -1,0 +1,47 @@
+#!/usr/bin/env python
+"""Serving-pool invariant gate (ISSUE 1 satellite).
+
+Runs the serving-path test files with PADDLE_TPU_POOL_DEBUG=1, which
+makes ServingEngine.step() call PagedKVCache.debug_check() after every
+scheduler iteration — asserting the pool invariant
+
+    free + cached + referenced == num_blocks
+
+plus ref-count/table consistency (no leak, no double free) and the
+hash-index bijection, across every admit/retire/evict cycle the tests
+drive. Exit code is pytest's: non-zero means a test failed OR an
+invariant tripped mid-schedule.
+
+    python tools/check_serving_invariants.py            # both files
+    python tools/check_serving_invariants.py -k prefix  # pass-through
+"""
+from __future__ import annotations
+
+import os
+import sys
+
+os.environ["PADDLE_TPU_POOL_DEBUG"] = "1"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+TEST_FILES = [
+    os.path.join(REPO, "tests", "test_prefix_cache.py"),
+    os.path.join(REPO, "tests", "test_serving.py"),
+]
+
+
+def main() -> int:
+    import pytest
+    args = TEST_FILES + ["-q", "-m", "not slow", "-p", "no:cacheprovider",
+                         "-p", "no:randomly"] + sys.argv[1:]
+    rc = pytest.main(args)
+    print(("POOL INVARIANTS OK — debug_check ran after every "
+           "engine step") if rc == 0 else
+          f"POOL INVARIANT GATE FAILED (pytest exit {rc})")
+    return int(rc)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
