@@ -185,6 +185,17 @@ class PagedGPTDecoder:
         return _mm(hl, weights["head"]).astype(jnp.float32), \
             k_pool, v_pool
 
+    def _prefill_chunk_impl(self, weights, k_pool, v_pool, ids, slots,
+                            n_cached, prefix_tables):
+        """Mid-prompt prefill chunk, no last-token logits (the GPT twin
+        of PagedLlamaDecoder._prefill_chunk_impl — see its docstring;
+        XLA dead-code-eliminates the head matmul of the wrapped
+        suffix-prefill). Returns (k_pool, v_pool)."""
+        _, k_pool, v_pool = self._prefill_prefix_impl(
+            weights, k_pool, v_pool, ids, slots,
+            jnp.zeros(ids.shape[0], jnp.int32), n_cached, prefix_tables)
+        return k_pool, v_pool
+
     def _decode_logits(self, weights, k_pool, v_pool, last_ids, tables,
                        ctx_lens, slots):
         """One decode token up to the logits (the surface the

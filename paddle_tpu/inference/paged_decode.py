@@ -570,6 +570,26 @@ class PagedLlamaDecoder:
                      self._allow_kernel).astype(jnp.float32)
         return logits, k_pool, v_pool
 
+    def _prefill_chunk_impl(self, weights, k_pool, v_pool, ids, slots,
+                            n_cached, prefix_tables):
+        """One MID-PROMPT prefill chunk (chunked prefill): the
+        suffix-prefill attention of _prefill_prefix_impl at offset
+        n_cached — chunk i of a long prompt prefills with chunks
+        0..i-1's pages riding along as the prefix table, exactly like
+        a prefix-cache hit — but intermediate chunks only write K/V:
+        no last-token logits exist until the FINAL chunk. Jitting this
+        wrapper lets XLA dead-code-eliminate the head matmul and the
+        logit gather, and the engine's no-sample dispatch consumes no
+        PRNG key (so chunked and monolithic prefill share one key
+        stream for a solo request). n_cached need NOT be block-aligned:
+        the prefix gather fetches whole pages and masks positions >=
+        n_cached, so a chunk boundary may land mid-page.
+        Returns (k_pool, v_pool)."""
+        _, k_pool, v_pool = self._prefill_prefix_impl(
+            weights, k_pool, v_pool, ids, slots,
+            jnp.zeros(ids.shape[0], jnp.int32), n_cached, prefix_tables)
+        return k_pool, v_pool
+
     def _decode_logits(self, weights, k_pool, v_pool, last_ids, tables,
                        ctx_lens, slots):
         """One decode token for the batch, up to the logits (shared by

@@ -11,13 +11,26 @@ batching becomes a fixed grid of batch slots with per-slot activity —
 the same trick the paged pool already plays for sequence length.
 
 Architecture (all shapes static; compiled programs: ONE decode chunk
-plus TWO prefill widths per active prompt bucket):
-- admission: queued requests prefill into free batch slots, grouped per
-  prompt bucket into shared dispatches (width 1 for singles, width
-  PREFILL_GROUP for bursts, padded with scratch rows — bounding the
-  compile-variant count; right-padding writes its K/V to a reserved
-  scratch page, so the pool never sees pad junk; logits are taken at
-  the real last token).
+per ladder rung, TWO prefill widths per active prompt bucket, plus two
+width-1 no-sample chunk programs when chunked prefill is on):
+- admission: queued requests claim free batch slots (capacity-aware,
+  FIFO) and enter the "prefilling" state. Admission only allocates —
+  it never dispatches or blocks on the device.
+- chunked prefill (Sarathi-style; prefill_chunk=256 by default): a
+  prompt suffix longer than one chunk is split into fixed-size chunks;
+  chunk i prefills at position offset i*C with chunks 0..i-1's pages
+  riding along as a prefix table — exactly the prefix-cache-hit
+  machinery, so one compiled (C, width-1) program serves every chunk
+  of every prompt. Intermediate chunks sample nothing (no last-token
+  logits; the no-sample programs consume no PRNG key); only the FINAL
+  chunk takes the first-token logits. The scheduler interleaves
+  prefill chunks with decode chunks under a per-step token budget
+  (prefill_budget, default one chunk), so a long prompt arriving
+  mid-stream delays running decodes by at most ~one chunk of prefill
+  per decode chunk instead of the whole prompt — the ITL cliff the
+  monolithic path had. Prefill dispatches join the SAME in-flight
+  queue as decode chunks; their results are fetched at collection
+  time, never inside admission.
 - automatic prefix caching (prefix_caching=True, the default): on
   admission the prompt is hashed at block granularity against the
   pool's chain-hash index (PagedKVCache.match_prefix); matched full
@@ -28,22 +41,25 @@ plus TWO prefill widths per active prompt bucket):
   _prefill_prefix_impl; n_cached is data, so one compiled program per
   (bucket, width) serves every hit length). The worst-case admission
   capacity check credits reusable blocks, so cache hits raise
-  effective pool capacity. Requests whose matched blocks are written
-  by a prefill admitted in the SAME wave are dispatched in a later
-  wave (device program order makes the write visible to the read).
-  Retired requests return blocks through the ref-counted path: full
-  hashed blocks park in the pool's LRU for future splices and are
-  evicted only when the free list runs dry.
+  effective pool capacity. A request may splice blocks that another
+  still-prefilling request has yet to write (they register in the
+  hash index at allocation): the reader records a dependency on the
+  writer's dispatch progress and its own chunks hold back until the
+  writer's covering chunk has been dispatched — device program order
+  then makes the write visible to the read. Retired requests return
+  blocks through the ref-counted path: full hashed blocks park in the
+  pool's LRU for future splices and are evicted only when the free
+  list runs dry.
 - decode: ONE program serves every step — a lax.scan over a
   chunk_size-token schedule (the page/slot schedule is deterministic, so
-  the host precomputes it), [max_batch] wide, inactive or finished slots
-  aimed at the scratch page and their outputs discarded. Sampling
-  (per-slot temperature, engine-static top_k) happens in-program, so
-  only [max_batch, chunk] token ids cross the host boundary per chunk.
-  Chunking is what makes continuous batching viable on TPU: per-dispatch
-  round-trips (hundreds of ms through a remote-compile tunnel, ~10us
-  locally) amortize over chunk_size tokens, while admission still
-  happens every chunk boundary.
+  the host precomputes it), [max_batch] wide, inactive / finished /
+  still-prefilling slots aimed at the scratch page and their outputs
+  discarded. Sampling (per-slot temperature, engine-static top_k)
+  happens in-program, so only [max_batch, chunk] token ids cross the
+  host boundary per chunk. Chunking is what makes continuous batching
+  viable on TPU: per-dispatch round-trips (hundreds of ms through a
+  remote-compile tunnel, ~10us locally) amortize over chunk_size
+  tokens, while admission still happens every chunk boundary.
 - completion: EOS/max-token slots free their pages (mid-chunk EOS trims
   the tail tokens); the slot admits the next queued request at the next
   chunk boundary.
@@ -59,7 +75,7 @@ import os
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import jax
@@ -102,18 +118,43 @@ class Request:
     sampling: SamplingParams
     out_tokens: List[int] = field(default_factory=list)
     t_submit: float = 0.0
+    t_admit: Optional[float] = None       # slot claimed (queue wait ends)
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
-    state: str = "queued"                 # queued | running | done
+    state: str = "queued"         # queued | prefilling | running | done
     # tokens DISPATCHED (prefill + scheduled decode steps) — may exceed
     # len(out_tokens) while a chunk is in flight or after an EOS cut
     planned: int = 0
+    # -- chunked-prefill progress (valid from admission) ------------------
+    n_cached: int = 0             # prompt tokens spliced from the cache
+    prefill_sent: int = 0         # suffix tokens DISPATCHED so far
+    # splice-pending dependencies: (writer request, suffix tokens the
+    # writer must have dispatched before our first chunk may read its
+    # pages) — see ServingEngine._admit
+    deps: List[Tuple["Request", int]] = field(default_factory=list)
+    pending_blocks: List[int] = field(default_factory=list)
+    slot: Optional[int] = None
+    # inter-token latency samples (seconds/token, chunk time split
+    # evenly over the chunk's delivered tokens — see _collect_oldest)
+    itls: List[float] = field(default_factory=list)
+    t_last_emit: Optional[float] = None
+
+    @property
+    def suffix_len(self) -> int:
+        """Prompt tokens that must actually prefill (past the splice)."""
+        return int(self.prompt.size) - self.n_cached
 
     @property
     def ttft_s(self) -> Optional[float]:
         if self.t_first_token is None:
             return None
         return self.t_first_token - self.t_submit
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
 
     @property
     def latency_s(self) -> Optional[float]:
@@ -152,7 +193,9 @@ class ServingEngine:
                  chunk_size: int = 8, seed: int = 0,
                  overlap: bool = True, mesh=None,
                  chunk_schedule: Optional[Sequence[int]] = None,
-                 prefix_caching: bool = True):
+                 prefix_caching: bool = True,
+                 prefill_chunk: Optional[int] = 256,
+                 prefill_budget: Optional[int] = None):
         from .gpt_decode import PagedGPTDecoder
         if isinstance(model, (PagedLlamaDecoder, PagedGPTDecoder)):
             # a prebuilt paged decoder (e.g. PagedLlamaDecoder
@@ -200,9 +243,38 @@ class ServingEngine:
         # decoders without one fall back to full prefills)
         self.prefix_caching = bool(prefix_caching) and \
             hasattr(self.dec, "_prefill_prefix_impl")
+        # chunked prefill (the stall-free interleaving path): suffixes
+        # longer than prefill_chunk split into fixed-size chunks that
+        # interleave with decode chunks. Needs the decoder's chunk
+        # program; prefill_chunk=None restores monolithic prefill
+        # (whole suffix in one dispatch — still queued/async, so the
+        # ONLY behavioral difference is the device-side interleaving).
+        self.prefill_chunk = (int(prefill_chunk)
+                              if prefill_chunk and
+                              hasattr(self.dec, "_prefill_chunk_impl")
+                              else None)
+        # per-step prefill token budget while decodes are running
+        # (idle engines dispatch every ready chunk): at most ~budget
+        # prefill tokens slot between consecutive decode chunks, which
+        # is the running streams' worst-case added inter-token latency
+        self.prefill_budget = max(1, int(prefill_budget)) \
+            if prefill_budget else (self.prefill_chunk or 0)
         # static prefix-gather width: a hit prefix is < the prompt, and
         # prompts are bounded by the largest bucket
         self._prefix_pages = -(-self.buckets[-1] // cache.block_size)
+        # mid-chunk prefix widths are power-of-two BUCKETED: chunk i's
+        # prefix is only i*C tokens, and paying the max-bucket gather +
+        # masked attention on every chunk made early chunks cost as
+        # much as late ones (the chunk program is width-1 and runs
+        # O(prompt/C) times per long prompt, so ~log2 variants are
+        # cheap; the one-shot final keeps the single max-width program
+        # shared with the prefix-cache-hit path)
+        self._prefix_page_buckets = []
+        p = 1
+        while p < self._prefix_pages:
+            self._prefix_page_buckets.append(p)
+            p *= 2
+        self._prefix_page_buckets.append(self._prefix_pages)
         self._debug_pool = os.environ.get(
             "PADDLE_TPU_POOL_DEBUG", "") not in ("", "0")
 
@@ -213,8 +285,19 @@ class ServingEngine:
         self._ids = itertools.count()
         self.decode_steps = 0
         self.generated_tokens = 0
-        # async pipeline state (overlap mode)
-        self._inflight: deque = deque()   # dispatched, unfetched chunks
+        # decode-utilization accounting (chunk-ladder tuning): a decode
+        # dispatch runs T steps x max_b slots regardless of how many
+        # slots had real work — slot_steps counts everything the
+        # program ran, useful_tokens what reached a request
+        self.decode_slot_steps = 0
+        self.decode_useful_tokens = 0
+        # splice-pending writer index: block -> (writer request, suffix
+        # tokens the writer must dispatch for the block to be written);
+        # entries live only while the writer is mid-prefill
+        self._pending_writes: Dict[int, Tuple[Request, int]] = {}
+        # async pipeline state (overlap mode): dispatched, unfetched
+        # prefill AND decode chunks, in device program order
+        self._inflight: deque = deque()
         self._fresh_slots: set = set()    # slots (re)filled since the
         #                                   last dispatch: their first
         #                                   token comes from the host
@@ -299,6 +382,25 @@ class ServingEngine:
         self._decode_rich_j = jax.jit(decode_chunk_rich,
                                       donate_argnums=(1, 2))
         self._merge_first_j = jax.jit(merge_first)
+        if self.prefill_chunk:
+            # no-sample chunk programs (width 1, exactly prefill_chunk
+            # tokens; prefill_mid retraces per power-of-two prefix-
+            # width bucket — ~log2(prefix_pages) variants — plus one
+            # cold-start prefill_mid0): mid chunks only write K/V, so
+            # the wrappers drop the logits and XLA DCEs the head
+            # matmul; no PRNG key is consumed
+            def prefill_mid(weights, k, v, ids, slots, n_cached, ptab):
+                return dec._prefill_chunk_impl(weights, k, v, ids,
+                                               slots, n_cached, ptab)
+
+            def prefill_mid0(weights, k, v, ids, slots):
+                _, k, v = dec._prefill_impl(weights, k, v, ids, slots)
+                return k, v
+
+            self._prefill_mid_j = jax.jit(prefill_mid,
+                                          donate_argnums=(1, 2))
+            self._prefill_mid0_j = jax.jit(prefill_mid0,
+                                           donate_argnums=(1, 2))
 
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
@@ -401,28 +503,27 @@ class ServingEngine:
         return -(-total // self.dec.cache.block_size)
 
     def _admit(self):
-        """Fill free batch slots from the queue. Admission is
+        """Claim free batch slots for queued requests. Admission is
         capacity-aware (a request enters only if its whole worst-case
         page demand fits — net of prefix-cache reuse — so a running
-        request can never hit pool exhaustion mid-decode) and BATCHED:
-        admissible requests sharing a (wave, bucket) prefill in one
-        dispatch (padded to a power-of-two group size to bound compile
-        variants) — a burst of K arrivals costs ~1 prefill instead of K.
+        request can never hit pool exhaustion mid-prefill or
+        mid-decode) and NON-BLOCKING: it allocates pages and puts the
+        request in the "prefilling" state; the actual prefill chunks
+        are dispatched by _dispatch_prefill and their results fetched
+        at collection time, like decode chunks.
 
-        Prefix caching buckets on SUFFIX length and splices matched
-        blocks at allocation time. A matched block may be written by a
-        prefill admitted in this same wave (its hashes register at
-        allocation, before the write is dispatched): such a dependent
-        request is assigned a LATER wave, and waves dispatch in order —
+        Prefix caching splices matched blocks at allocation time. A
+        matched block may belong to a request that is still mid-prefill
+        (its suffix's full prompt blocks register in the hash index at
+        allocation, before any write is dispatched): the reader records
+        (writer, suffix-tokens-needed) dependencies and its chunks hold
+        back until the writer's covering dispatch has been issued —
         on-device program order then guarantees the reader sees the
-        writer's pages. Requests in one dispatch never read each
-        other's blocks (same-wave ⇒ no pending-block dependency)."""
+        writer's pages."""
         cache = self.dec.cache
-        free_slots = [si for si in range(self.max_b)
-                      if self._slots[si] is None]
-        admitted = []              # (slot, req, bucket, n_cached, wave)
-        pending_wave: Dict[int, int] = {}   # block → wave writing it
-        for si in free_slots:
+        for si in range(self.max_b):
+            if self._slots[si] is not None:
+                continue
             if not self._queue:
                 break
             req = self._queue[0]
@@ -436,62 +537,164 @@ class ServingEngine:
                         req.req_id, req.prompt, total)
                 except RuntimeError:
                     break  # head-of-line: keep FIFO, wait for frees
-                self._queue.popleft()
-                wave = 1 + max((pending_wave.get(b, -1)
-                                for b in reused), default=-1)
+                req.deps = [self._pending_writes[b] for b in reused
+                            if b in self._pending_writes]
+                # register OUR fresh full prompt blocks as splice-
+                # pending until our dispatches cover them
                 table = cache.seq_blocks(req.req_id)
-                n_full = int(req.prompt.size) // cache.block_size
-                for b in table[len(reused):n_full]:
-                    pending_wave[b] = wave
-                bucket = _bucket_for(int(req.prompt.size) - n_cached,
-                                     self.buckets)
+                bs = cache.block_size
+                n_full = int(req.prompt.size) // bs
+                for j in range(len(reused), n_full):
+                    self._pending_writes[table[j]] = \
+                        (req, (j + 1) * bs - n_cached)
+                    req.pending_blocks.append(table[j])
             else:
                 if cache.free_blocks < self._required_blocks(req):
                     break
-                self._queue.popleft()
                 cache.allocate(req.req_id, total)
-                n_cached, wave = 0, 0
-                bucket = _bucket_for(int(req.prompt.size), self.buckets)
-            admitted.append((si, req, bucket, n_cached, wave))
-        by_group: dict = {}
-        for si, req, bucket, n_cached, wave in admitted:
-            by_group.setdefault((wave, bucket), []).append(
-                (si, req, n_cached))
-        # dispatch EVERY admission prefill before fetching ANY result
-        # (waves ascending — see above): through the remote tunnel a
-        # blocking fetch costs a full round trip (~75 ms), so a
-        # 16-request burst over 4 groups paid 4 RTTs; one batched
-        # device_get pays it once while the chunks pipeline on the
-        # device (measured r5: capacity-row prefill wall 0.47 s ->
-        # ~0.15 s for 17.6 ms of device work)
-        pending = []
-        for wave, bucket in sorted(by_group):
-            group = by_group[(wave, bucket)]
-            if len(group) > 1:
-                w = min(self.PREFILL_GROUP, self.max_b)
-                for i in range(0, len(group), w):
-                    pending.append(
-                        self._prefill_dispatch(bucket, group[i:i + w], w))
-            else:
-                pending.append(self._prefill_dispatch(bucket, group, 1))
-        if pending:
-            t0 = time.perf_counter()
-            fetched = jax.device_get([t for t, _ in pending])
-            for (_, group), toks in zip(pending, fetched):
-                self._prefill_complete(np.asarray(toks), group)
-            self.time_prefill_s += time.perf_counter() - t0
+                n_cached = 0
+            self._queue.popleft()
+            req.n_cached = n_cached
+            req.state = "prefilling"
+            req.slot = si
+            req.t_admit = time.perf_counter()
+            self._slots[si] = req
+
+    def _deps_ready(self, req: Request) -> bool:
+        """True when every splice-pending writer has dispatched the
+        chunks covering the blocks `req` spliced (prefill_sent is
+        monotone, so a satisfied dependency stays satisfied)."""
+        return all(w.prefill_sent >= need for w, need in req.deps)
+
+    def _clear_pending_writes(self, req: Request):
+        for b in req.pending_blocks:
+            if self._pending_writes.get(b, (None, 0))[0] is req:
+                del self._pending_writes[b]
+        req.pending_blocks = []
+
+    def _dispatch_prefill(self):
+        """Dispatch prefill work for prefilling slots, oldest request
+        first (FIFO completes the earliest prompt soonest, which
+        minimizes its TTFT and resolves splice dependencies in
+        admission order). While decodes are running the dispatched
+        tokens are capped at prefill_budget per step — the bound on
+        how much prefill can slot between two decode chunks; an idle
+        engine dispatches everything ready. Suffixes longer than
+        prefill_chunk go out as width-1 fixed-size chunks (no-sample
+        programs); each request's last dispatch is its bucketed,
+        sampling "final" — grouped across requests per bucket exactly
+        like monolithic admission prefills."""
+        pending = sorted((r for r in self._slots
+                          if r is not None and r.state == "prefilling"
+                          and r.prefill_sent < r.suffix_len),
+                         key=lambda r: r.req_id)
+        if not pending:
+            return
+        decoding = any(r is not None and r.state == "running"
+                       for r in self._slots)
+        budget = self.prefill_budget if (decoding and
+                                         self.prefill_budget) else None
+        def _is_mid(r):
+            return (self.prefill_chunk and
+                    r.suffix_len - r.prefill_sent > self.prefill_chunk)
+
+        spent = 0
+        while True:
+            ready = [r for r in pending
+                     if r.prefill_sent < r.suffix_len
+                     and self._deps_ready(r)]
+            if not ready:
+                return
+            # strict FIFO: the OLDEST ready request's next dispatch goes
+            # first — a newer long prompt's chunks must never starve an
+            # older short request's final
+            head = ready[0]
+            if _is_mid(head):
+                self._dispatch_mid(head)
+                spent += self.prefill_chunk
+                if budget is not None and spent >= budget:
+                    return
+                continue
+            # head's remainder fits one dispatch: group every ready
+            # same-bucket final with it (equal priority, shared
+            # program), closing a sub-group early when it crosses the
+            # remaining budget — so at most ~budget + one row's suffix
+            # ever slots between two decode chunks, not a whole
+            # width-PREFILL_GROUP burst
+            bucket = _bucket_for(head.suffix_len - head.prefill_sent,
+                                 self.buckets)
+            group = [(r.slot, r, r.n_cached + r.prefill_sent)
+                     for r in ready if not _is_mid(r)
+                     and _bucket_for(r.suffix_len - r.prefill_sent,
+                                     self.buckets) == bucket]
+            w = min(self.PREFILL_GROUP, self.max_b) \
+                if len(group) > 1 else 1
+            sub, toks = [], 0
+            for row in group:
+                sub.append(row)
+                toks += int(row[1].prompt.size) - row[2]
+                if len(sub) == w or (budget is not None
+                                     and spent + toks >= budget):
+                    self._dispatch_final(bucket, sub, w)
+                    spent += toks
+                    sub, toks = [], 0
+                    if budget is not None and spent >= budget:
+                        return
+            if sub:
+                self._dispatch_final(bucket, sub, w)
+                spent += toks
+                if budget is not None and spent >= budget:
+                    return
 
     # prefill dispatch widths: exactly TWO compile variants per bucket
     # (a variant per group size would compile-storm on bursty arrivals —
     # measured 4x throughput loss through the remote-compile tunnel)
     PREFILL_GROUP = 4
 
-    def _prefill_dispatch(self, bucket: int, group, gp: int):
-        """Dispatch one prefill group. `group` rows are
-        (slot, req, n_cached): with prefix caching every row prefills
-        only its uncovered suffix — `bucket` is a SUFFIX bucket, RoPE
-        positions/slot mappings start at n_cached, and the row's cached
-        pages ride along as a scratch-padded prefix table."""
+    def _dispatch_mid(self, req: Request):
+        """Dispatch ONE fixed-size no-sample prefill chunk (width 1).
+        The chunk prefills at global offset n_cached + prefill_sent
+        with everything before it — spliced prefix AND previously
+        dispatched chunks — riding along as the prefix page table;
+        offsets need not be page-aligned (the attention masks the
+        partial last page)."""
+        t0 = time.perf_counter()
+        cache = self.dec.cache
+        c = self.prefill_chunk
+        off = req.n_cached + req.prefill_sent
+        ids = req.prompt[off:off + c][None]
+        slots = np.asarray([[cache.extend(req.req_id)
+                             for _ in range(c)]], np.int32)
+        if off:
+            need = -(-off // cache.block_size)
+            width = next(b for b in self._prefix_page_buckets
+                         if b >= need)
+            ptab = np.full((1, width), self._scratch_block, np.int32)
+            pb = cache.seq_blocks(req.req_id)[:need]
+            ptab[0, :len(pb)] = pb
+            cache.k, cache.v = self._prefill_mid_j(
+                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
+                jnp.asarray(slots), jnp.asarray([off], np.int32),
+                jnp.asarray(ptab))
+        else:
+            cache.k, cache.v = self._prefill_mid0_j(
+                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
+                jnp.asarray(slots))
+        req.prefill_sent += c
+        self._inflight.append({"kind": "prefill", "toks": None,
+                               "group": [], "free_after": []})
+        self.time_prefill_s += time.perf_counter() - t0
+
+    def _dispatch_final(self, bucket: int, group, gp: int):
+        """Dispatch one FINAL (first-token-sampling) prefill for rows
+        whose remaining suffix fits a single bucketed dispatch —
+        either a whole short prompt or the tail of a chunked one.
+        `group` rows are (slot, req, off): `off` counts spliced prefix
+        plus already-dispatched chunk tokens, so `bucket` is the
+        REMAINDER bucket, RoPE positions/slot mappings start at `off`,
+        and the covered pages ride along as a scratch-padded prefix
+        table. The dispatch is queued; tokens are fetched at
+        collection time."""
         t0 = time.perf_counter()
         cache = self.dec.cache
         vocab = self.dec.cfg.vocab_size
@@ -508,16 +711,16 @@ class ServingEngine:
         any_rep = any(req.sampling.repetition_penalty != 1.0
                       for _, req, _ in group)
         seen = np.zeros((gp, vocab), bool) if any_rep else None
-        for row, (si, req, n_cached) in enumerate(group):
-            s = int(req.prompt.size) - n_cached
-            ids[row, :s] = req.prompt[n_cached:]
+        for row, (si, req, off) in enumerate(group):
+            s = int(req.prompt.size) - off
+            ids[row, :s] = req.prompt[off:]
             slots[row, :s] = [cache.extend(req.req_id)
                               for _ in range(s)]
             last_idx[row] = s - 1
-            ncv[row] = n_cached
-            if n_cached:
+            ncv[row] = off
+            if off:
                 pb = cache.seq_blocks(req.req_id)[
-                    :n_cached // cache.block_size]
+                    : -(-off // cache.block_size)]
                 ptab[row, :len(pb)] = pb
             sp = req.sampling
             temps[row] = sp.temperature
@@ -528,14 +731,16 @@ class ServingEngine:
             reps[row] = sp.repetition_penalty
             if sp.repetition_penalty != 1.0:
                 seen[row, req.prompt] = True   # FULL prompt, cached too
+            req.prefill_sent = req.suffix_len
+            self._clear_pending_writes(req)
         seen_dev = jnp.asarray(seen) if any_rep \
             else self._zeros_seen(gp, vocab)
         # the suffix-prefix program pays a per-layer page gather plus
         # dense attention over the (possibly all-masked) prefix columns:
-        # only groups with at least one actual hit take it — all-miss
-        # groups keep the plain flash prefill, so disjoint traffic is
-        # unchanged by enabling the cache
-        if any(n for _, _, n in group):
+        # only groups with at least one covered prefix take it —
+        # cold-start groups keep the plain flash prefill, so disjoint
+        # unchunked traffic is unchanged
+        if any(off for _, _, off in group):
             toks, cache.k, cache.v = self._prefill_prefix_j(
                 self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
                 jnp.asarray(slots), jnp.asarray(last_idx),
@@ -550,20 +755,24 @@ class ServingEngine:
                 jnp.asarray(temps), self._next_key(),
                 jnp.asarray(top_ks), jnp.asarray(top_ps),
                 jnp.asarray(reps), seen_dev)
+        self._inflight.append({"kind": "prefill", "toks": toks,
+                               "group": [(si, req)
+                                         for si, req, _ in group],
+                               "free_after": []})
         self.time_prefill_s += time.perf_counter() - t0
-        return toks, group
 
     def _prefill_complete(self, toks: np.ndarray, group):
-        """Post-fetch bookkeeping for one dispatched prefill chunk."""
+        """Post-fetch bookkeeping for one collected FINAL prefill:
+        the request leaves "prefilling" with its first token."""
         now = time.perf_counter()
-        for row, (si, req, _) in enumerate(group):
+        for row, (si, req) in enumerate(group):
             tok = int(toks[row])
             req.state = "running"
             req.t_first_token = now
+            req.t_last_emit = now
             req.out_tokens.append(tok)
             req.planned = 1
             self.generated_tokens += 1
-            self._slots[si] = req
             self._last_tok[si] = tok
             self._fresh_slots.add(si)
             if self._is_finished(req):
@@ -608,8 +817,8 @@ class ServingEngine:
         return np.full(n, v, np.int32)
 
     def _rep_active(self) -> bool:
-        return any(r is not None and
-                   r.sampling.repetition_penalty != 1.0
+        return any(r is not None and r.state == "running"
+                   and r.sampling.repetition_penalty != 1.0
                    for r in self._slots)
 
     def _pick_chunk(self, active) -> int:
@@ -655,16 +864,24 @@ class ServingEngine:
                 best = c
         return best
 
+    def _newest_decode_entry(self):
+        for e in reversed(self._inflight):
+            if e["kind"] == "decode":
+                return e
+        return None
+
     def _dispatch_chunk(self) -> bool:
-        """Dispatch ONE decode chunk for the current active slots
+        """Dispatch ONE decode chunk for the current RUNNING slots
         without waiting for the previous chunk: first tokens of
         continuing slots are gathered from the in-flight chunk's DEVICE
         output (no host round trip); freshly admitted slots take their
-        prefill token from the host."""
+        prefill token from the host. Slots still mid-prefill aim at the
+        scratch page like inactive ones."""
         t0 = time.perf_counter()
         cache = self.dec.cache
         active = [si for si in range(self.max_b)
-                  if self._slots[si] is not None]
+                  if self._slots[si] is not None
+                  and self._slots[si].state == "running"]
         if not active:
             self.time_host_s += time.perf_counter() - t0
             return False
@@ -710,10 +927,11 @@ class ServingEngine:
             self.time_host_s += time.perf_counter() - t0
             return False
 
-        # first tokens: device gather from the newest in-flight chunk
-        # for continuing slots, host values for fresh/0-step slots
-        if self._inflight:
-            prev = self._inflight[-1]
+        # first tokens: device gather from the newest in-flight DECODE
+        # chunk for continuing slots, host values for fresh/0-step
+        # slots (prefill entries between them don't carry decode toks)
+        prev = self._newest_decode_entry()
+        if prev is not None:
             last_idx = np.zeros(mb, np.int32)
             override = np.asarray(self._last_tok, np.int32).copy()
             use_host = np.ones(mb, bool)
@@ -759,52 +977,114 @@ class ServingEngine:
                 self.dec.weights, cache.k, cache.v, first_ids,
                 jnp.asarray(tables), jnp.asarray(ctx),
                 jnp.asarray(slots), jnp.asarray(temps), keys)
-        self._inflight.append({"toks": toks, "steps": steps_of,
-                               "reqs": reqs_of, "T": T,
-                               "free_after": []})
+        self._inflight.append({"kind": "decode", "toks": toks,
+                               "steps": steps_of, "reqs": reqs_of,
+                               "T": T, "free_after": []})
         self.time_host_s += time.perf_counter() - t0
         return True
 
     def _collect_oldest(self):
-        """Fetch and process the oldest in-flight chunk (the only
-        host-blocking point of the decode path)."""
+        """Fetch and process the oldest in-flight chunk — prefill or
+        decode (the only host-blocking points of the engine). Mid
+        prefill chunks carry no result and cost no fetch; final
+        prefill chunks deliver the first token; decode chunks deliver
+        T tokens per live slot and are timestamped here for the ITL
+        accounting (the chunk's wall interval is attributed evenly
+        over the tokens it delivered to each request)."""
         ch = self._inflight.popleft()
+        if ch["kind"] == "prefill":
+            if ch["toks"] is not None:
+                t0 = time.perf_counter()
+                toks = np.asarray(ch["toks"])          # [gp] — blocks
+                self.time_prefill_s += time.perf_counter() - t0
+                self._prefill_complete(toks, ch["group"])
+            for rid in ch["free_after"]:
+                self.dec.cache.free(rid)
+            return
         t0 = time.perf_counter()
         toks = np.asarray(ch["toks"])              # [mb, T] — blocks
         self.time_stall_s += time.perf_counter() - t0
+        now = time.perf_counter()
         self.decode_steps += ch["T"]
+        self.decode_slot_steps += ch["T"] * self.max_b
         for si, steps in ch["steps"].items():
             req = ch["reqs"][si]
             if req.state != "running":
                 continue       # retired while this chunk was in flight
+            delivered = 0
             for t in range(steps):
                 tok = int(toks[si, t])
                 req.out_tokens.append(tok)
+                delivered += 1
                 self.generated_tokens += 1
                 self._last_tok[si] = tok
                 if self._is_finished(req):
                     break      # mid-chunk EOS: discard the tail
+            self.decode_useful_tokens += delivered
+            if delivered:
+                if req.t_last_emit is not None:
+                    itl = (now - req.t_last_emit) / delivered
+                    req.itls.extend([itl] * delivered)
+                req.t_last_emit = now
             if self._is_finished(req) and self._slots[si] is req:
                 self._retire(si)
         for rid in ch["free_after"]:
             self.dec.cache.free(rid)
 
+    def _collect_prefill_run(self, n: int):
+        """Collect `n` CONSECUTIVE leading prefill entries with ONE
+        batched device_get: through the remote tunnel a blocking fetch
+        costs a full round trip (~75 ms), so a 16-request burst over 4
+        final groups must pay it once, not once per group (measured
+        r5: capacity-row prefill wall 0.47 s -> ~0.15 s for 17.6 ms of
+        device work) — the chunk pipeline's analog of the batched
+        fetch the old blocking admission used. No-sample mid entries
+        carry no result and are skipped by the fetch."""
+        chs = [self._inflight.popleft() for _ in range(n)]
+        t0 = time.perf_counter()
+        fetch = [ch["toks"] for ch in chs if ch["toks"] is not None]
+        fetched = jax.device_get(fetch) if fetch else []
+        self.time_prefill_s += time.perf_counter() - t0
+        it = iter(fetched)
+        for ch in chs:
+            if ch["toks"] is not None:
+                self._prefill_complete(np.asarray(next(it)),
+                                       ch["group"])
+            for rid in ch["free_after"]:
+                self.dec.cache.free(rid)
+
     def step(self) -> bool:
-        """One engine iteration: admit, dispatch the next decode chunk,
-        then collect down to the pipeline depth (1 chunk stays in
-        flight in overlap mode, so host admission/bookkeeping runs
-        while the device decodes). Returns True while there is still
+        """One engine iteration: admit, dispatch budget-bounded prefill
+        chunks, dispatch the next decode chunk, then collect down to
+        the pipeline depth (1 chunk stays in flight in overlap mode, so
+        host admission/bookkeeping runs while the device decodes; the
+        newest entry is the decode chunk whenever one was dispatched,
+        so prefill results are always collected by the end of the step
+        that could consume them). Returns True while there is still
         work."""
         self._admit()
+        self._dispatch_prefill()
         dispatched = self._dispatch_chunk()
         depth = 1 if (dispatched and self.overlap
                       and not self._rep_active()) else 0
         while len(self._inflight) > depth:
-            self._collect_oldest()
+            # a RUN of leading prefill entries is fetched with one
+            # batched device_get (one tunnel RTT per burst, not per
+            # group); decode entries collect singly
+            n = 0
+            while (n < len(self._inflight) - depth
+                   and self._inflight[n]["kind"] == "prefill"):
+                n += 1
+            if n > 1:
+                self._collect_prefill_run(n)
+            else:
+                self._collect_oldest()
         if self._debug_pool:
             # PADDLE_TPU_POOL_DEBUG=1: assert the pool invariant
             # (free + cached + referenced == num_blocks, refs == table
-            # contents) after every scheduler step
+            # contents, partial-prefill length bounds) after every
+            # scheduler step — including between the chunks of a
+            # multi-step prefill
             self.dec.cache.debug_check()
         return self.has_work
 
@@ -819,11 +1099,14 @@ class ServingEngine:
         every bucket (or just prompt_len's bucket when given), the
         prefix-cache HIT prefill for every hit-reachable suffix bucket,
         plus the decode chunk — with throwaway requests, so no user
-        request pays a compile. Worth calling once at deployment;
-        finished-request stats AND the prefix cache are cleared
-        afterwards. Warns if the KV pool is too small to exercise the
-        burst width (that variant would then compile on the first real
-        burst)."""
+        request pays a compile. Prompts longer than prefill_chunk run
+        the CHUNKED path (exactly as production traffic at that length
+        will), compiling the no-sample chunk programs and the
+        remainder-bucket finals instead of the monolithic full-length
+        variants. Worth calling once at deployment; finished-request
+        stats AND the prefix cache are cleared afterwards. Warns if
+        the KV pool is too small to exercise the burst width (that
+        variant would then compile on the first real burst)."""
         import warnings as _warnings
         plens = ([prompt_len] if prompt_len is not None
                  else list(self.buckets))
@@ -834,7 +1117,8 @@ class ServingEngine:
                 "warmup: max_batch_size < 2 — the burst prefill path "
                 "never runs on this engine; only width-1 is warmed")
         for plen in plens:
-            # phase 1: a single request — the width-1 program
+            # phase 1: a single request — the width-1 program(s); a
+            # plen past the chunk size compiles the chunk ladder
             self.add_request(self._warmup_prompt(plen),
                              SamplingParams(max_new_tokens=2))
             self.run_to_completion()
@@ -858,7 +1142,9 @@ class ServingEngine:
         # per (suffix bucket, width), and warmup's distinct-fill miss
         # traffic never runs it — seed a one-block prefix, then admit
         # hits whose suffix lands in each reachable bucket (width 1),
-        # plus one burst at the first reachable bucket (width `width`)
+        # plus one burst at the first reachable bucket (width `width`).
+        # Suffixes past prefill_chunk take the chunked path here too,
+        # warming the offset chunk program a long cache hit runs.
         if self.prefix_caching:
             bs = cache.block_size
             prefix = self._warmup_prompt(bs)
@@ -974,23 +1260,59 @@ class ServingEngine:
     def clear_finished(self):
         """Drop finished requests + counters (e.g. after warmup) so
         stats() reflect only the workload that follows — including the
-        prefix-cache hit/eviction counters, so warmup traffic cannot
-        pollute the reported hit rate."""
+        prefix-cache hit/eviction counters and the ITL/utilization
+        accounting, so warmup traffic cannot pollute the reported
+        numbers."""
         self._done.clear()
         self.decode_steps = 0
         self.generated_tokens = 0
+        self.decode_slot_steps = 0
+        self.decode_useful_tokens = 0
         self.time_prefill_s = 0.0
         self.time_stall_s = 0.0
         self.time_host_s = 0.0
         self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
-        """Latency/throughput summary over finished requests."""
+        """Latency/throughput summary over finished requests.
+
+        Timing keys:
+        - latency/ttft percentiles: per-request wall clocks.
+        - itl_p50_s / itl_p99_s: inter-token latency — each collected
+          decode chunk's wall interval split evenly over the tokens it
+          delivered to a request (chunks of T tokens arrive together;
+          the per-token attribution is T-ths of the gap, the standard
+          chunked-serving convention). The headline metric for
+          chunked prefill: a long prompt admitted mid-stream must not
+          spike running requests' ITL. Aggregated over finished AND
+          currently-running requests.
+        - queue_wait_p50_s: submit → batch-slot admission.
+        - time_prefill_s / time_decode_stall_s / time_host_s: wall
+          time of the engine's blocking call sites. Prefill results
+          are fetched at collection time in device order (never inside
+          admission), so a prefill fetch waits only on work dispatched
+          BEFORE it — the old overlap caveat (a blocking prefill fetch
+          silently absorbing in-flight decode time) is gone; the one
+          residual coupling is that the device runs a single queue, so
+          the oldest entry's fetch covers any earlier entries still
+          executing.
+
+        Utilization keys (chunk-ladder tuning): a decode dispatch runs
+        T steps x max_batch slots regardless of real work —
+        padded_token_waste counts slot-steps that produced no delivered
+        token (inactive slots, budget-drained tails, post-EOS
+        discards), decode_utilization = delivered / slot-steps."""
         cache = self.dec.cache
         lats = [r.latency_s for r in self._done.values()
                 if r.latency_s is not None]
         ttfts = [r.ttft_s for r in self._done.values()
                  if r.ttft_s is not None]
+        waits = [r.queue_wait_s for r in self._done.values()
+                 if r.queue_wait_s is not None]
+        itls = [x for r in itertools.chain(
+            self._done.values(),
+            (r for r in self._slots if r is not None))
+            for x in r.itls]
 
         def pct(xs, p):
             # Interpolated (the truncating index form overstated
@@ -1005,18 +1327,18 @@ class ServingEngine:
             "latency_p99_s": pct(lats, 0.99),
             "ttft_p50_s": pct(ttfts, 0.50),
             "ttft_p99_s": pct(ttfts, 0.99),
-            # where the wall time went (bench breakdown): wall time of
-            # the engine's blocking call sites. CAVEAT under overlap:
-            # the device runs one queue, so a prefill fetch issued
-            # while a decode chunk is in flight also waits for that
-            # chunk — time_prefill_s then absorbs in-flight decode
-            # time and time_decode_stall_s undercounts it. The split
-            # is exact with overlap=False; with overlap it bounds
-            # host-side attribution (time_host_s) exactly and the
-            # device phases jointly.
+            "itl_p50_s": pct(itls, 0.50),
+            "itl_p99_s": pct(itls, 0.99),
+            "queue_wait_p50_s": pct(waits, 0.50),
             "time_prefill_s": self.time_prefill_s,
             "time_decode_stall_s": self.time_stall_s,
             "time_host_s": self.time_host_s,
+            "decode_slot_steps": self.decode_slot_steps,
+            "padded_token_waste": (self.decode_slot_steps
+                                   - self.decode_useful_tokens),
+            "decode_utilization": (
+                self.decode_useful_tokens / self.decode_slot_steps
+                if self.decode_slot_steps else 0.0),
             # prefix cache: hit tokens = prompt tokens whose KV was
             # spliced from cached blocks instead of re-prefilled;
             # hit rate is over all prompt tokens seen at admission

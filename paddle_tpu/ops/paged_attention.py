@@ -340,10 +340,15 @@ class PagedKVCache:
     def debug_check(self):
         """Pool invariant: free + cached + referenced == num_blocks,
         the three sets disjoint, table refs exactly matching the ref
-        counts (no leak, no double free), and the hash index a
-        bijection with every cached block hash-registered. Raises
-        AssertionError on violation; cheap enough to run after every
-        scheduler step in tests."""
+        counts (no leak, no double free), the hash index a bijection
+        with every cached block hash-registered, and every live
+        sequence's context length inside its table's capacity —
+        PARTIALLY-PREFILLED sequences included (a chunked prefill
+        extends its length over several scheduler steps; between any
+        two chunks the length must sit within the blocks reserved at
+        admission and never go negative). Raises AssertionError on
+        violation; cheap enough to run after every scheduler step in
+        tests."""
         free = set(self._free)
         cached = set(self._lru)
         referenced = set(self._ref)
@@ -364,6 +369,16 @@ class PagedKVCache:
             "hash index not a bijection"
         assert all(b in self._hash_of for b in cached), \
             "cached block without a hash"
+        # per-sequence consistency, incl. partially-prefilled sequences
+        assert set(self._lens) == set(self._tables), \
+            "length/table bookkeeping out of sync"
+        for s, t in self._tables.items():
+            ln = self._lens[s]
+            assert t and 0 <= ln <= len(t) * self.block_size, (
+                f"seq {s}: context length {ln} outside its "
+                f"{len(t)}-block table (partial-prefill bound)")
+            assert all(0 <= b < self.num_blocks for b in t), \
+                f"seq {s}: block id out of range"
 
     # -- device updates -----------------------------------------------------
     def write(self, layer: int, k, v, slot_mapping):

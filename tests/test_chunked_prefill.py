@@ -1,0 +1,452 @@
+"""Chunked prefill with prefill/decode interleaving (ISSUE 2).
+
+Layers under test:
+- token identity: chunked prefill (fixed-size chunks interleaved with
+  decode chunks) must produce EXACTLY the tokens of monolithic prefill
+  — greedy, deterministic-rich sampling (top_k=1 / tiny top_p /
+  repetition penalty, whose outputs ignore the PRNG stream), prompts
+  whose prefix-cache hit ends mid-chunk, a long prompt admitted while
+  decodes are running, and eviction pressure during a multi-chunk
+  prefill;
+- scheduler state machine: a partially-prefilled request occupies its
+  slot in "prefilling" state, running decodes keep emitting between its
+  chunks, and the splice-pending dependency gate orders readers after
+  writers;
+- pool invariants between chunks (PADDLE_TPU_POOL_DEBUG=1 makes
+  ServingEngine.step run PagedKVCache.debug_check after every
+  scheduler step, i.e. between the chunks of a multi-step prefill);
+- the new stats surface: itl_p50/p99, queue_wait_p50,
+  padded_token_waste, decode_utilization.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+
+def _mk_model():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    return model
+
+
+class TestChunkedTokenIdentity:
+    """Chunked vs monolithic prefill must be token-identical (chunking
+    is a scheduling/latency change, not a semantics change)."""
+
+    def setup_method(self):
+        self.model = _mk_model()
+        self.cfg = self.model.cfg
+        self.rng = np.random.RandomState(17)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 3)
+        kw.setdefault("num_blocks", 96)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32, 64))
+        kw.setdefault("chunk_size", 4)
+        return ServingEngine(self.model, **kw)
+
+    def _run(self, reqs, **kw):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(**kw)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        got = eng.run_to_completion()
+        eng.dec.cache.debug_check()
+        return [got[r].tolist() for r in rids], eng
+
+    def _reqs(self, lens, news, sampling=None):
+        from paddle_tpu.inference import SamplingParams
+        out = []
+        for l, m in zip(lens, news):
+            sp = sampling(m) if sampling else SamplingParams(
+                max_new_tokens=m)
+            out.append((self.rng.randint(0, self.cfg.vocab_size, (l,))
+                        .astype(np.int32), sp))
+        return out
+
+    def test_greedy_identity_mixed_lengths(self):
+        reqs = self._reqs([5, 20, 60, 33, 12], [6, 5, 8, 4, 7])
+        mono, _ = self._run(reqs, prefill_chunk=None)
+        for c in (8, 16):
+            chunked, eng = self._run(reqs, prefill_chunk=c)
+            assert chunked == mono, f"prefill_chunk={c}"
+            assert eng.prefill_chunk == c
+
+    def test_solo_stochastic_identity(self):
+        # a solo request consumes NO keys for mid chunks (no-sample
+        # programs), so even true stochastic sampling is stream-
+        # identical between chunked and monolithic prefill
+        from paddle_tpu.inference import SamplingParams
+        reqs = self._reqs([50], [8], lambda m: SamplingParams(
+            max_new_tokens=m, temperature=0.9, top_p=0.95))
+        mono, _ = self._run(reqs, prefill_chunk=None, max_batch_size=1)
+        chunked, _ = self._run(reqs, prefill_chunk=8, max_batch_size=1)
+        assert chunked == mono
+
+    def test_rich_deterministic_identity(self):
+        # rich-sampling configurations whose outputs don't depend on
+        # the PRNG stream: top_k=1 at high temperature, tiny top_p,
+        # and greedy repetition penalty
+        from paddle_tpu.inference import SamplingParams
+        kinds = [
+            lambda m: SamplingParams(max_new_tokens=m, temperature=5.0,
+                                     top_k=1),
+            lambda m: SamplingParams(max_new_tokens=m, temperature=3.0,
+                                     top_p=1e-9),
+            lambda m: SamplingParams(max_new_tokens=m,
+                                     repetition_penalty=1.6),
+        ]
+        for sampling in kinds:
+            reqs = self._reqs([40, 25], [6, 5], sampling)
+            mono, _ = self._run(reqs, prefill_chunk=None)
+            chunked, _ = self._run(reqs, prefill_chunk=8)
+            assert chunked == mono
+
+    def test_prefix_hit_ends_mid_chunk(self):
+        # cached prefix of 24 tokens with chunk size 16: the hit ends
+        # mid-chunk (24 % 16 != 0) and the remaining 36-token suffix
+        # still spans multiple chunks — offsets must stay exact
+        shared = self.rng.randint(0, self.cfg.vocab_size,
+                                  (24,)).astype(np.int32)
+        tails = [self.rng.randint(0, self.cfg.vocab_size,
+                                  (36,)).astype(np.int32)
+                 for _ in range(2)]
+        from paddle_tpu.inference import SamplingParams
+        outs = []
+        for pc in (None, 16):
+            eng = self._engine(prefill_chunk=pc)
+            rids = []
+            # serial admissions so the second+ prompts hit the cache
+            for t in tails:
+                rids.append(eng.add_request(
+                    np.concatenate([shared, t]),
+                    SamplingParams(max_new_tokens=6)))
+                eng.run_to_completion()
+            outs.append([eng.result(r).tolist() for r in rids])
+            if pc:
+                assert eng.stats()["prefix_cache_hit_tokens"] >= 24
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]
+
+    def test_long_prompt_mid_stream_identity(self):
+        # two short requests decode; a 60-token prompt arrives while
+        # they run — every request's tokens must match the monolithic
+        # engine's, and the long prompt must actually chunk
+        from paddle_tpu.inference import SamplingParams
+        shorts = self._reqs([6, 9], [24, 24])
+        longp = self._reqs([60], [5])[0]
+        outs = []
+        for pc in (None, 8):
+            eng = self._engine(prefill_chunk=pc)
+            rids = [eng.add_request(p, s) for p, s in shorts]
+            for _ in range(3):
+                eng.step()
+            rl = eng.add_request(*longp)
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids + [rl]])
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]
+
+    def test_gpt_chunked_identity(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import (PagedGPTDecoder,
+                                          SamplingParams, ServingEngine)
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny())
+        model.eval()
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, model.cfg.vocab_size,
+                               (l,)).astype(np.int32)
+                   for l in (42, 7, 23)]
+        outs = []
+        for pc in (None, 8):
+            dec = PagedGPTDecoder(model, num_blocks=64, block_size=8)
+            eng = ServingEngine(dec, max_batch_size=2,
+                                prompt_buckets=(8, 16, 32, 64),
+                                chunk_size=4, prefill_chunk=pc)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=5))
+                    for p in prompts]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]
+
+
+class TestChunkedScheduler:
+    """State machine + interleaving behavior of the chunked path."""
+
+    def setup_method(self):
+        self.model = _mk_model()
+        self.cfg = self.model.cfg
+        self.rng = np.random.RandomState(3)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 3)
+        kw.setdefault("num_blocks", 96)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32, 64))
+        kw.setdefault("chunk_size", 4)
+        kw.setdefault("prefill_chunk", 8)
+        return ServingEngine(self.model, **kw)
+
+    def test_prefilling_state_occupies_slot(self):
+        # budget 8 tokens/step vs a 64-token prompt: the prefill spans
+        # multiple scheduler steps, during which the request holds its
+        # slot in "prefilling" state with zero emitted tokens — and
+        # the pool invariant holds between every chunk (debug_check
+        # runs inside step() under PADDLE_TPU_POOL_DEBUG=1)
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine()
+        short = self.rng.randint(0, 512, (6,)).astype(np.int32)
+        a = eng.add_request(short, SamplingParams(max_new_tokens=40))
+        for _ in range(3):
+            eng.step()
+        longp = self.rng.randint(0, 512, (64,)).astype(np.int32)
+        b = eng.add_request(longp, SamplingParams(max_new_tokens=4))
+        saw_prefilling = False
+        decoded_during_prefill = 0
+        while eng.has_work:
+            before = sum(len(r.itls) for r in eng._slots
+                         if r is not None and r.state == "running")
+            eng.step()
+            reqs = [r for r in eng._slots if r is not None]
+            for r in reqs:
+                if r.req_id == b and r.state == "prefilling":
+                    saw_prefilling = True
+                    assert r.out_tokens == []
+                    assert 0 < r.prefill_sent <= r.suffix_len or \
+                        r.prefill_sent == 0
+                    # the running request keeps decoding between chunks
+                    run = [x for x in reqs if x.req_id == a]
+                    if run and run[0].state == "running":
+                        decoded_during_prefill = max(
+                            decoded_during_prefill,
+                            len(run[0].out_tokens))
+        assert saw_prefilling
+        assert decoded_during_prefill > 0
+        assert len(eng.result(b)) == 4
+        assert len(eng.result(a)) == 40
+
+    def test_budget_bounds_chunks_per_step(self):
+        # with decodes running and prefill_budget == one chunk, no
+        # step dispatches more than one mid chunk of the long prompt
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(prefill_chunk=8, prefill_budget=8)
+        a = eng.add_request(np.ones(6, np.int32),
+                            SamplingParams(max_new_tokens=30))
+        for _ in range(2):
+            eng.step()
+        b = eng.add_request(
+            self.rng.randint(0, 512, (64,)).astype(np.int32),
+            SamplingParams(max_new_tokens=3))
+        sent_hist = []
+        while eng.has_work:
+            eng.step()
+            req = next((r for r in eng._slots
+                        if r is not None and r.req_id == b), None)
+            if req is not None and req.state == "prefilling":
+                sent_hist.append(req.prefill_sent)
+        deltas = np.diff([0] + sent_hist)
+        assert len(sent_hist) >= 3          # spread over many steps
+        assert all(d <= 8 for d in deltas)  # never more than budget
+        eng.run_to_completion()
+
+    def test_idle_engine_ignores_budget(self):
+        # no decodes running: the whole prompt pipeline dispatches in
+        # one step (the budget protects running streams, not cold
+        # starts)
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(prefill_chunk=8, prefill_budget=8)
+        rid = eng.add_request(
+            self.rng.randint(0, 512, (60,)).astype(np.int32),
+            SamplingParams(max_new_tokens=3))
+        eng.step()
+        req = next((r for r in list(eng._slots) + list(
+            eng._done.values()) if r is not None and r.req_id == rid))
+        assert req.prefill_sent == req.suffix_len
+        eng.run_to_completion()
+        assert len(eng.result(rid)) == 3
+
+    def test_splice_pending_dependency_orders_reader_after_writer(self):
+        # B splices blocks A's chunked prefill has not yet dispatched:
+        # B must hold back until A's covering chunks are out, and the
+        # results must equal the cache-off run
+        from paddle_tpu.inference import SamplingParams
+        shared = self.rng.randint(0, 512, (48,)).astype(np.int32)
+        tails = [self.rng.randint(0, 512, (9,)).astype(np.int32)
+                 for _ in range(2)]
+        prompts = [np.concatenate([shared, t]) for t in tails]
+        outs = []
+        for pc_cache in (False, True):
+            eng = self._engine(prefill_chunk=8, prefix_caching=pc_cache)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=5))
+                    for p in prompts]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+            if pc_cache:
+                assert eng.stats()["prefix_cache_hit_tokens"] == 48
+            assert not eng._pending_writes   # all writers drained
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]
+
+    def test_eviction_pressure_during_multi_chunk_prefill(self):
+        # a tight pool whose LRU holds parked prefixes: admissions
+        # during/around a multi-chunk prefill force evictions, and
+        # results must still equal the monolithic cache-off run
+        from paddle_tpu.inference import SamplingParams
+        warm = [self.rng.randint(0, 512, (16,)).astype(np.int32)
+                for _ in range(3)]
+        longp = self.rng.randint(0, 512, (56,)).astype(np.int32)
+        follow = [self.rng.randint(0, 512, (17,)).astype(np.int32)
+                  for _ in range(2)]
+        news = [4] * 3 + [5] + [4] * 2
+        prompts = warm + [longp] + follow
+        outs = []
+        for pc in (None, 8):
+            eng = self._engine(num_blocks=14, max_batch_size=2,
+                               prefill_chunk=pc)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=n))
+                    for p, n in zip(prompts, news)]
+            got = eng.run_to_completion()
+            outs.append([got[r].tolist() for r in rids])
+            st = eng.stats()
+            assert st["free_blocks"] + st["cached_blocks"] == 14 - 1
+            if pc:
+                assert st["prefix_cache_evictions"] > 0
+            eng.dec.cache.debug_check()
+        assert outs[0] == outs[1]
+
+    def test_chunking_disable_knob(self):
+        # prefill_chunk=None/0 restores monolithic prefill (whole
+        # suffix in one dispatch); a decoder without the chunk program
+        # would take the same gate (hasattr check in __init__)
+        from paddle_tpu.inference import SamplingParams
+        for off in (None, 0):
+            eng = self._engine(prefill_chunk=off)
+            assert eng.prefill_chunk is None
+            assert eng.prefill_budget == 0       # never throttles
+            rid = eng.add_request(
+                self.rng.randint(0, 512, (50,)).astype(np.int32),
+                SamplingParams(max_new_tokens=3))
+            eng.step()
+            req = next(r for r in list(eng._slots)
+                       + list(eng._done.values())
+                       if r is not None and r.req_id == rid)
+            # monolithic: the whole suffix went out in one dispatch
+            assert req.prefill_sent == req.suffix_len
+            eng.run_to_completion()
+            assert len(eng.result(rid)) == 3
+
+    def test_warmup_precompiles_chunk_programs(self):
+        # warmup must drive the chunked path for long buckets so no
+        # real long prompt pays the chunk-program compiles
+        eng = self._engine(prompt_buckets=(8, 32), prefill_chunk=8)
+        calls = {"mid": 0, "mid0": 0}
+        mid, mid0 = eng._prefill_mid_j, eng._prefill_mid0_j
+
+        def spy_mid(*a, **k):
+            calls["mid"] += 1
+            return mid(*a, **k)
+
+        def spy_mid0(*a, **k):
+            calls["mid0"] += 1
+            return mid0(*a, **k)
+
+        eng._prefill_mid_j = spy_mid
+        eng._prefill_mid0_j = spy_mid0
+        eng.warmup()
+        assert calls["mid0"] > 0      # cold chunk 0
+        assert calls["mid"] > 0       # offset chunks
+        assert not eng.has_work
+
+
+class TestChunkedStats:
+    """ITL / queue-wait / decode-utilization observability."""
+
+    def setup_method(self):
+        self.model = _mk_model()
+        self.rng = np.random.RandomState(9)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 2)
+        kw.setdefault("num_blocks", 64)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32))
+        kw.setdefault("chunk_size", 4)
+        return ServingEngine(self.model, **kw)
+
+    def test_itl_and_queue_wait_reported(self):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine()
+        rids = [eng.add_request(
+            self.rng.randint(0, 512, (l,)).astype(np.int32),
+            SamplingParams(max_new_tokens=12)) for l in (6, 11, 9)]
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["itl_p50_s"] is not None and st["itl_p50_s"] > 0
+        assert st["itl_p99_s"] >= st["itl_p50_s"]
+        assert st["queue_wait_p50_s"] is not None \
+            and st["queue_wait_p50_s"] >= 0
+        # 12 tokens per request: 1 prefill token + 11 decode tokens,
+        # each decode token carrying one ITL sample
+        for r in rids:
+            assert len(eng.request(r).itls) == 11
+
+    def test_decode_utilization_and_waste(self):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(max_batch_size=2)
+        # ONE request on a 2-slot engine: every chunk runs a fully
+        # padded second row, so waste must be visible
+        rid = eng.add_request(np.ones(6, np.int32),
+                              SamplingParams(max_new_tokens=9))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["decode_slot_steps"] == 2 * st["decode_steps"]
+        assert st["padded_token_waste"] >= st["decode_steps"]  # idle row
+        assert 0 < st["decode_utilization"] <= 1.0
+        delivered = st["decode_slot_steps"] - st["padded_token_waste"]
+        assert delivered == len(eng.result(rid)) - 1  # minus prefill tok
+
+    def test_clear_finished_resets_new_counters(self):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine()
+        eng.add_request(np.ones(6, np.int32),
+                        SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.stats()["decode_slot_steps"] > 0
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["decode_slot_steps"] == 0
+        assert st["padded_token_waste"] == 0
+        assert st["decode_utilization"] == 0.0
+        assert st["itl_p50_s"] is None
+        assert st["queue_wait_p50_s"] is None
+
+    def test_mid_stream_long_prompt_itl_with_chunking(self):
+        """Functional ITL plumbing for the interleave scenario: the
+        running request keeps accumulating ITL samples while the long
+        prompt prefills chunk by chunk (the bench asserts the ratio;
+        here we assert the samples exist and the stream never pauses
+        for more than the whole prefill)."""
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(prompt_buckets=(8, 16, 32), num_blocks=96,
+                           prefill_chunk=8, prefill_budget=8)
+        a = eng.add_request(np.ones(6, np.int32),
+                            SamplingParams(max_new_tokens=30))
+        for _ in range(3):
+            eng.step()
+        eng.add_request(self.rng.randint(0, 512, (30,))
+                        .astype(np.int32),
+                        SamplingParams(max_new_tokens=3))
+        eng.run_to_completion()
+        assert len(eng.request(a).itls) == 29

@@ -393,6 +393,13 @@ class TestServingEngine:
         assert st["generated_tokens"] > 0
         assert st["latency_p50_s"] > 0 and st["latency_p99_s"] > 0
         assert st["ttft_p50_s"] > 0
+        # chunked-prefill observability (ISSUE 2): ITL, queue wait,
+        # and the fixed-shape decode utilization account
+        assert st["itl_p50_s"] > 0 and st["itl_p99_s"] >= st["itl_p50_s"]
+        assert st["queue_wait_p50_s"] >= 0
+        assert st["decode_slot_steps"] >= st["decode_steps"]
+        assert st["padded_token_waste"] >= 0
+        assert 0 < st["decode_utilization"] <= 1.0
 
 
 class TestConfigKnobs:

@@ -1,5 +1,6 @@
 #!/usr/bin/env python
-"""Serving-pool invariant gate (ISSUE 1 satellite).
+"""Serving-pool invariant gate (ISSUE 1 satellite; extended for the
+ISSUE 2 chunked-prefill schedules).
 
 Runs the serving-path test files with PADDLE_TPU_POOL_DEBUG=1, which
 makes ServingEngine.step() call PagedKVCache.debug_check() after every
@@ -7,12 +8,16 @@ scheduler iteration — asserting the pool invariant
 
     free + cached + referenced == num_blocks
 
-plus ref-count/table consistency (no leak, no double free) and the
-hash-index bijection, across every admit/retire/evict cycle the tests
-drive. Exit code is pytest's: non-zero means a test failed OR an
-invariant tripped mid-schedule.
+plus ref-count/table consistency (no leak, no double free), the
+hash-index bijection, and the partial-prefill length bound (a chunked
+prefill extends a sequence over several scheduler steps; its context
+length must sit inside the blocks reserved at admission BETWEEN every
+pair of chunks — test_chunked_prefill.py drives multi-chunk prompts,
+mid-stream admissions, splice-pending dependencies, and eviction
+pressure through that window). Exit code is pytest's: non-zero means a
+test failed OR an invariant tripped mid-schedule.
 
-    python tools/check_serving_invariants.py            # both files
+    python tools/check_serving_invariants.py            # all files
     python tools/check_serving_invariants.py -k prefix  # pass-through
 """
 from __future__ import annotations
@@ -28,6 +33,7 @@ sys.path.insert(0, REPO)
 
 TEST_FILES = [
     os.path.join(REPO, "tests", "test_prefix_cache.py"),
+    os.path.join(REPO, "tests", "test_chunked_prefill.py"),
     os.path.join(REPO, "tests", "test_serving.py"),
 ]
 
