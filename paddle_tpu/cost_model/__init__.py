@@ -81,7 +81,11 @@ class CostModel:
         from ..static.executor import resolve_node
         from ..utils.timing import timed_dispatch_diff
 
-        jit_cache: Dict[tuple, object] = {}
+        # instance-level so repeated profile_measure calls (and repeated
+        # nodes within one) reuse compiled kernels instead of minting a
+        # fresh jit callable per visit (flightcheck FC202)
+        jit_cache: Dict[tuple, object] = \
+            self.__dict__.setdefault("_jit_cache", {})
         profile: Dict[str, dict] = {}
         for node in main_program.nodes:
             fn, vals = resolve_node(main_program, node, value_of)
@@ -98,12 +102,27 @@ class CostModel:
                     (getattr(v, "shape", None), str(getattr(v, "dtype",
                                                             None)))
                     for v in vals))
-            jfn = jit_cache.get(key) if key is not None else None
+            if key is None:
+                # unfingerprintable closure: fall back to identity of
+                # (node, resolved fn) — still one compile per node per
+                # kernel instead of a fresh jit callable (and recompile)
+                # per profile run (flightcheck FC202). Both OBJECTS are
+                # the key (identity hash, kept alive by the entry), so
+                # a recycled id() can never alias a dead node's kernel,
+                # and a decomposition override installing a NEW fn for
+                # the same node misses the cache instead of serving the
+                # stale pre-override kernel.
+                key = ("node", node, fn)
+            jfn = jit_cache.get(key)
             if jfn is None:
+                if len(jit_cache) > 512:
+                    # bound the instance-level cache: profiling many
+                    # distinct programs must not pin every dead
+                    # program's nodes/executables forever
+                    jit_cache.clear()
                 jfn = jax.jit(lambda *xs, _fn=fn, _kw=node.kwargs:
                               _fn(*xs, **_kw))
-                if key is not None:
-                    jit_cache[key] = jfn
+                jit_cache[key] = jfn
             out = jfn(*vals)        # lazy env values for downstream
             # fetch-forced dispatch-count differencing with min-over-
             # repeats and a positive floor — the one timing recipe

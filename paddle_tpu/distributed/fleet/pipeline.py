@@ -94,10 +94,13 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         lambda _: P(axis), stacked_params)
     x_spec = P()  # microbatches replicated into the loop; stage0 consumes
 
-    def body(params, xs):
+    def body(params, xs, stage_ids):
         # params: leaves [1, ...] (this stage's slice) → squeeze
         p_local = jax.tree_util.tree_map(lambda a: a[0], params)
-        stage = jax.lax.axis_index(axis)
+        # sharded-arange stage id: axis_index inside a partially-manual
+        # shard_map lowers to PartitionId, which the SPMD partitioner
+        # rejects on hybrid (auto+manual) meshes on jax<=0.4.x
+        stage = stage_ids[0]
         n_micro = xs.shape[0]
         n_ticks = n_micro + n_stages - 1
         perm_fwd = [(i, i + 1) for i in range(n_stages - 1)]
@@ -136,9 +139,10 @@ def pipeline_apply(stage_fn: Callable, stacked_params, x_microbatches,
         return outputs
 
     f = shard_map(body, mesh=jmesh,
-                  in_specs=(param_specs, x_spec), out_specs=P(),
+                  in_specs=(param_specs, x_spec, P(axis)), out_specs=P(),
                   check_vma=False)
-    return f(stacked_params, x_microbatches)
+    return f(stacked_params, x_microbatches,
+             jnp.arange(n_stages, dtype=jnp.int32))
 
 
 def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
@@ -181,10 +185,12 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
     param_specs = jax.tree_util.tree_map(
         lambda _: P(None, axis), stacked_params)
 
-    def body(params, xs):
+    def body(params, xs, stage_ids):
         # params leaves: [vpp, 1, ...] → this stage's vpp chunk slices
         p_local = jax.tree_util.tree_map(lambda a: a[:, 0], params)
-        stage = jax.lax.axis_index(axis)
+        # sharded-arange stage id (see pipeline_apply: axis_index inside
+        # shard_map trips the SPMD partitioner on hybrid meshes)
+        stage = stage_ids[0]
         n_micro = xs.shape[0]
         n_ticks = n_micro + V - 1
         ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -229,9 +235,10 @@ def pipeline_apply_interleaved(stage_fn: Callable, stacked_params,
         return jax.lax.psum(outputs * mask, axis)
 
     f = shard_map(body, mesh=jmesh,
-                  in_specs=(param_specs, P()), out_specs=P(),
+                  in_specs=(param_specs, P(), P(axis)), out_specs=P(),
                   check_vma=False)
-    return f(stacked_params, x_microbatches)
+    return f(stacked_params, x_microbatches,
+             jnp.arange(n_stages, dtype=jnp.int32))
 
 
 class PipelineParallel:

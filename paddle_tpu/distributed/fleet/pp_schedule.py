@@ -383,6 +383,26 @@ def _resolve_mesh(mesh):
     return mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") else mesh
 
 
+def partial_manual_ok() -> bool:
+    """Whether this jax can run a shard_map that is manual over a SUBSET
+    of mesh axes and contains collectives. On jax 0.4.x the SPMD
+    partitioner hard-CHECKs (spmd_partitioner.cc:512
+    `target.IsManualSubgroup() == sharding().IsManualSubgroup()`) when a
+    ppermute/all_gather sits in a partially-manual region of a mesh with
+    auto axes — a fatal process abort, not a catchable error, so this is
+    version-gated rather than probed. When False, the pipeline engines
+    run the shard_map FULLY manual over every mesh axis: all in/out
+    specs only name the pp axis, so non-pp shards are replicated at the
+    boundary and the numerics are identical (auto-axis GSPMD composition
+    inside the body is what's lost, not correctness)."""
+    import jax as _jax
+    try:
+        major, minor = _jax.__version_info__[:2]
+    except Exception:  # pragma: no cover
+        return True
+    return (major, minor) >= (0, 5)
+
+
 def probe_residuals(stage_fn: Callable, chunk_avals, x_aval) -> Dict[str, Any]:
     """Abstractly trace one chunk's jax.vjp and report its residual
     layout: {"treedef", "param_pos" (per-leaf index into the chunk's
@@ -476,9 +496,13 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
     ring_fwd = [(i, (i + 1) % p) for i in range(p)]
     ring_bwd = [(i, (i - 1) % p) for i in range(p)]
 
-    def body(params, lparams, xs, ys):
+    def body(params, lparams, xs, ys, stage_ids):
         p_local = jax.tree_util.tree_map(lambda a: a[:, 0], params)
-        stage = jax.lax.axis_index(axis)
+        # stage id arrives as a P(axis)-sharded arange instead of
+        # jax.lax.axis_index: on jax<=0.4.x axis_index inside a
+        # partially-manual shard_map lowers to a PartitionId HLO that
+        # the SPMD partitioner rejects whenever the mesh has auto axes
+        stage = stage_ids[0]
 
         chunk0 = jax.tree_util.tree_map(lambda a: a[0], p_local)
         a_shape = jax.eval_shape(stage_fn, chunk0, xs[0])
@@ -659,12 +683,16 @@ def pipeline_forward_backward(stage_fn: Callable, loss_fn: Callable,
         gacc = jax.tree_util.tree_map(lambda a: (a * inv_m)[:, None], gacc)
         return loss, gacc, lp_grads, dxs
 
+    # partial-manual (auto axes compose via GSPMD) where the toolchain
+    # supports it; fully-manual otherwise — see partial_manual_ok
+    manual_kw = {"axis_names": {axis}} if partial_manual_ok() else {}
     f = jax.shard_map(
         body, mesh=jmesh,
-        in_specs=(param_specs, P(), P(), P()),
+        in_specs=(param_specs, P(), P(), P(), P(axis)),
         out_specs=(P(), param_specs, P(), P()),
-        axis_names={axis}, check_vma=False)
-    return f(stacked_params, loss_params, x_microbatches, y_microbatches)
+        check_vma=False, **manual_kw)
+    return f(stacked_params, loss_params, x_microbatches, y_microbatches,
+             jnp.arange(p, dtype=jnp.int32))
 
 
 def make_pipeline_loss_fn(stage_fn: Callable, loss_fn: Callable, mesh,

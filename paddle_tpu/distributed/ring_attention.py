@@ -439,7 +439,13 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = True,
     # mis-validates out_specs when check_vma=False combines with a
     # subset axis_names (it demands the None entries "refer to" the
     # auto axes)
-    if set(jmesh.axis_names) == {axis}:
+    from .fleet.pp_schedule import partial_manual_ok
+    if set(jmesh.axis_names) == {axis} or not partial_manual_ok():
+        # jax 0.4.x: partially-manual shard_map neither runs eagerly
+        # (shard_map.py `if auto: raise NotImplementedError`) nor
+        # lowers its collectives under jit (SPMD partitioner CHECK) —
+        # run fully manual; the in/out specs only name `axis`, so other
+        # mesh axes see replicated shards and numerics are unchanged
         sm_kwargs = dict(check_vma=False)
     else:
         sm_kwargs = dict(axis_names={axis})

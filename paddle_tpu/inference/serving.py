@@ -995,14 +995,18 @@ class ServingEngine:
         if ch["kind"] == "prefill":
             if ch["toks"] is not None:
                 t0 = time.perf_counter()
-                toks = np.asarray(ch["toks"])          # [gp] — blocks
+                # THE designed blocking point for a lone prefill entry
+                # (runs of >1 batch through _collect_prefill_run)
+                toks = np.asarray(ch["toks"])  # flightcheck: disable=FC301
                 self.time_prefill_s += time.perf_counter() - t0
                 self._prefill_complete(toks, ch["group"])
             for rid in ch["free_after"]:
                 self.dec.cache.free(rid)
             return
         t0 = time.perf_counter()
-        toks = np.asarray(ch["toks"])              # [mb, T] — blocks
+        # THE designed blocking point of the decode pipeline: collection
+        # fetches the oldest in-flight chunk, in device program order
+        toks = np.asarray(ch["toks"])  # flightcheck: disable=FC301
         self.time_stall_s += time.perf_counter() - t0
         now = time.perf_counter()
         self.decode_steps += ch["T"]
@@ -1043,7 +1047,9 @@ class ServingEngine:
         chs = [self._inflight.popleft() for _ in range(n)]
         t0 = time.perf_counter()
         fetch = [ch["toks"] for ch in chs if ch["toks"] is not None]
-        fetched = jax.device_get(fetch) if fetch else []
+        # designed batched fetch: one tunnel round trip per prefill run
+        fetched = (jax.device_get(fetch)  # flightcheck: disable=FC301
+                   if fetch else [])
         self.time_prefill_s += time.perf_counter() - t0
         it = iter(fetched)
         for ch in chs:

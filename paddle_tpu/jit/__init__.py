@@ -325,17 +325,22 @@ class StaticFunction:
             training = layer.training
             n_params = len(p_tensors)
 
-            def whole_graph(*arrs):
+            # the per-call key rides as a positional arg, not a closure
+            # cell: an outer capture context fingerprints closures by
+            # cell content, so a captured fresh key would miss the
+            # segment cache every call (FC203)
+            def whole_graph(k, *arrs):
                 pa = arrs[:n_params]
                 ia = arrs[n_params:]
-                out, new_bufs = compiled(list(pa), b_arrays, key,
+                out, new_bufs = compiled(list(pa), b_arrays, k,
                                          (training, _prim()), *ia)
                 flat_out, treedef = jax.tree_util.tree_flatten(out)
                 self._last_treedef = treedef
                 self._last_n_out = len(flat_out)
                 return tuple(flat_out) + tuple(new_bufs)
 
-            results = apply("to_static", whole_graph, *p_tensors, *args)
+            results = apply("to_static", whole_graph, key, *p_tensors,
+                            *args)
             if getattr(self, "_lower_trace_count", -1) != \
                     self.retrace_count:
                 # aval-only snapshot for concrete_program, refreshed per
@@ -358,13 +363,13 @@ class StaticFunction:
         key = default_generator.next_key()
         compiled = self._compiled
 
-        def whole_graph(*arrs):
-            out, _ = compiled([], [], key, (True, _prim()), *arrs)
+        def whole_graph(k, *arrs):
+            out, _ = compiled([], [], k, (True, _prim()), *arrs)
             flat_out, treedef = jax.tree_util.tree_flatten(out)
             self._last_treedef = treedef
             return tuple(flat_out) if len(flat_out) > 1 else flat_out[0]
 
-        results = apply("to_static", whole_graph, *args)
+        results = apply("to_static", whole_graph, key, *args)
         if getattr(self, "_lower_trace_count", -1) != self.retrace_count:
             self._lower_args = _snapshot_lower([], [], key,
                                                (True, _prim()), args)

@@ -61,8 +61,17 @@ class PipelinedLlamaConfig:
 def _constraint(mesh, spec):
     # A bare PartitionSpec resolves against the tracing context's mesh —
     # required inside shard_map(axis_names={'pp'}), where the context mesh
-    # marks 'pp' Manual and a NamedSharding over the plain mesh mismatches.
+    # marks 'pp' Manual and a NamedSharding over the plain mesh mismatches
+    # (so NO physical-mesh context manager here on the modern path).
+    # On toolchains without partial-manual shard_map support the pipeline
+    # body runs fully manual (see pp_schedule.partial_manual_ok): every
+    # mesh axis is manual there, in-body GSPMD constraints are meaningless
+    # and the specs' axes aren't auto — drop the hints (numerics are
+    # unaffected; they only steered auto-axis layout).
+    from ..distributed.fleet.pp_schedule import partial_manual_ok
     del mesh
+    if not partial_manual_ok():
+        return lambda x: x
     return lambda x: jax.lax.with_sharding_constraint(x, spec)
 
 

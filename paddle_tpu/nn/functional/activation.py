@@ -117,11 +117,13 @@ def prelu(x, weight, data_format="NCHW", name=None):
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=True, name=None):
     from ...framework.core import default_generator
     if training:
+        # key as positional arg, not closure cell — a captured per-call
+        # key defeats the partial-capture segment cache (FC203)
         key = default_generator.next_key()
-        def f(a):
-            slope = jax.random.uniform(key, a.shape, a.dtype, lower, upper)
+        def f(a, k):
+            slope = jax.random.uniform(k, a.shape, a.dtype, lower, upper)
             return jnp.where(a >= 0, a, slope * a)
-        return apply("rrelu", f, x)
+        return apply("rrelu", f, x, key)
     mid = (lower + upper) / 2.0
     return leaky_relu(x, mid)
 
@@ -190,14 +192,15 @@ def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...framework.core import default_generator
     key = default_generator.next_key()
-    def f(a):
-        g = jax.random.gumbel(key, a.shape, a.dtype)
+    def f(a, k):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis, keepdims=True)
             y_hard = jnp.zeros_like(y)
             y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis, inplace=False)
-            y = y_hard + jax.lax.stop_gradient(y) - y + y - jax.lax.stop_gradient(y)
-            y = y_hard - jax.lax.stop_gradient(y) + y if False else y_hard + y - jax.lax.stop_gradient(y)
+            # straight-through estimator: forward emits the one-hot,
+            # backward flows through the soft sample
+            y = y_hard + y - jax.lax.stop_gradient(y)
         return y
-    return apply("gumbel_softmax", f, x)
+    return apply("gumbel_softmax", f, x, key)

@@ -69,19 +69,21 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
+    # key as positional arg, not closure cell — a captured per-call
+    # key defeats the partial-capture segment cache (FC203)
     key = default_generator.next_key()
 
-    def f(a):
+    def f(a, k):
         alpha = 1.6732632423543772
         scale = 1.0507009873554805
         alpha_p = -alpha * scale
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
         q = 1.0 - p
         coef_a = (q + alpha_p ** 2 * q * p) ** -0.5
         coef_b = -coef_a * alpha_p * p
         return coef_a * jnp.where(keep, a, jnp.full_like(a, alpha_p)) + coef_b
 
-    return apply("alpha_dropout", f, x)
+    return apply("alpha_dropout", f, x, key)
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
@@ -223,12 +225,14 @@ def class_center_sample(label, num_classes, num_samples, group=None):
     import jax
     from ...framework.core import Tensor, apply, default_generator
 
+    # key as positional arg, not closure cell — a captured per-call key
+    # defeats the partial-capture segment cache (FC203)
     key = default_generator.next_key()
 
-    def f(lab):
+    def f(lab, k):
         lab_i = lab.astype(jnp.int32)
         present = jnp.zeros((num_classes,), jnp.float32).at[lab_i].set(1.0)
-        noise = jax.random.uniform(key, (num_classes,))
+        noise = jax.random.uniform(k, (num_classes,))
         # positives (>=2) always outrank negatives (<1)
         score = present * 2.0 + noise
         _, picked = jax.lax.top_k(score, num_samples)
@@ -237,4 +241,4 @@ def class_center_sample(label, num_classes, num_samples, group=None):
             jnp.arange(num_samples, dtype=jnp.int32))
         return remap[lab_i].astype(lab.dtype), sampled.astype(lab.dtype)
 
-    return apply("class_center_sample", f, label)
+    return apply("class_center_sample", f, label, key)

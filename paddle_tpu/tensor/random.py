@@ -108,29 +108,33 @@ def randn_like(x, dtype=None, name=None):
 
 
 def bernoulli(x, name=None):
+    # key rides as a positional arg, not a closure cell: the partial-
+    # capture segment cache fingerprints closures by cell CONTENT, so a
+    # captured per-call key would force a retrace every call (FC203)
     key = default_generator.next_key()
     return apply_nodiff("bernoulli",
-                        lambda p: jax.random.bernoulli(key, p).astype(p.dtype), x)
+                        lambda p, k: jax.random.bernoulli(k, p).astype(p.dtype),
+                        x, key)
 
 
 def multinomial(x, num_samples=1, replacement=False, name=None):
     key = default_generator.next_key()
-    def f(p):
-        logits = jnp.log(jnp.maximum(p, 1e-30))
+    def f(p, k):
         if p.ndim == 1:
-            return jax.random.choice(key, p.shape[-1], (num_samples,),
+            return jax.random.choice(k, p.shape[-1], (num_samples,),
                                      replace=replacement, p=p / p.sum()).astype(jnp.int64)
-        ks = jax.random.split(key, p.shape[0])
+        ks = jax.random.split(k, p.shape[0])
         return jax.vmap(lambda k_, pr: jax.random.choice(
             k_, p.shape[-1], (num_samples,), replace=replacement,
             p=pr / pr.sum()))(ks, p).astype(jnp.int64)
-    return apply_nodiff("multinomial", f, x)
+    return apply_nodiff("multinomial", f, x, key)
 
 
 def poisson(x, name=None):
     key = default_generator.next_key()
     return apply_nodiff("poisson",
-                        lambda lam: jax.random.poisson(key, lam).astype(lam.dtype), x)
+                        lambda lam, k: jax.random.poisson(k, lam).astype(lam.dtype),
+                        x, key)
 
 
 def exponential_(x, lam=1.0, name=None):
@@ -142,11 +146,12 @@ def exponential_(x, lam=1.0, name=None):
 
 def binomial(count, prob, name=None):
     key = default_generator.next_key()
-    def f(n, p):
-        return jax.random.binomial(key, n.astype(jnp.float32), p).astype(jnp.int64)
-    return apply_nodiff("binomial", f, count, prob)
+    def f(n, p, k):
+        return jax.random.binomial(k, n.astype(jnp.float32), p).astype(jnp.int64)
+    return apply_nodiff("binomial", f, count, prob, key)
 
 
 def standard_gamma(x, name=None):
     key = default_generator.next_key()
-    return apply_nodiff("standard_gamma", lambda a: jax.random.gamma(key, a), x)
+    return apply_nodiff("standard_gamma",
+                        lambda a, k: jax.random.gamma(k, a), x, key)

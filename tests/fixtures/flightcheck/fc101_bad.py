@@ -1,0 +1,12 @@
+"""known-bad: Python branch on a traced value inside jit (FC101)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clipped_step(x, lr):
+    if lr > 0.5:                       # tracer in a Python `if`
+        x = x * 0.5
+    while x.sum() > 1.0:               # tracer in a Python `while`
+        x = x * 0.9
+    return x

@@ -1,0 +1,14 @@
+"""known-good twin of fc101_bad: data-dependent control flow expressed
+as jnp.where / lax.while_loop; metadata branches stay Python."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def clipped_step(x, lr):
+    x = jnp.where(lr > 0.5, x * 0.5, x)
+    x = jax.lax.while_loop(lambda v: v.sum() > 1.0,
+                           lambda v: v * 0.9, x)
+    if x.ndim == 2:                    # shape metadata: static, fine
+        x = x[None]
+    return x

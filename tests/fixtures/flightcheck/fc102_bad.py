@@ -1,0 +1,10 @@
+"""known-bad: bool()/float() cast of a traced value inside jit (FC102)."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def any_negative(x):
+    flag = bool((x < 0).any())         # trace-time concretization
+    scale = float(x.max())
+    return jnp.where(flag, x * scale, x)

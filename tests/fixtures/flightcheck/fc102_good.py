@@ -1,0 +1,10 @@
+"""known-good twin of fc102_bad: keep the predicate on-device."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def any_negative(x):
+    flag = (x < 0).any()
+    scale = x.max()
+    return jnp.where(flag, x * scale, x)

@@ -1,0 +1,11 @@
+"""known-bad: host materialization of a traced value inside jit (FC103)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def normalize(x):
+    m = np.asarray(x).mean()           # np.* on a tracer
+    peak = x.max().item()              # .item() on a tracer
+    return x / (m + peak)

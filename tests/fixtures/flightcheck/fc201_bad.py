@@ -1,0 +1,13 @@
+"""known-bad: jit arg used as a Python shape/loop bound without
+static_argnums (FC201) — traced it fails, un-static it recompiles per
+value."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def unrolled(x, n_steps):
+    acc = jnp.zeros(n_steps)           # arg sizes a buffer
+    for i in range(n_steps):           # arg bounds a Python loop
+        acc = acc.at[i].set(x[i])
+    return acc

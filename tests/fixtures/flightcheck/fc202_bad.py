@@ -1,0 +1,12 @@
+"""known-bad: jax.jit inside a loop body (FC202) — a fresh compiled
+callable (and cache entry) per iteration."""
+import jax
+import jax.numpy as jnp
+
+
+def run_all(fns, x):
+    outs = []
+    for fn in fns:
+        jfn = jax.jit(lambda v, f=fn: f(v) + 1)
+        outs.append(jfn(x))
+    return outs

@@ -1,0 +1,16 @@
+"""known-bad: per-call PRNG key captured in a kernel closure (FC203) —
+the segment cache fingerprints closure cells by content, so every call
+retraces."""
+import jax
+
+from paddle_tpu.framework.core import apply, default_generator
+
+
+def noisy_relu(x):
+    key = default_generator.next_key()
+
+    def f(a):
+        noise = jax.random.uniform(key, a.shape, a.dtype)
+        return jax.numpy.where(a > 0, a + noise, 0.0)
+
+    return apply("noisy_relu", f, x)

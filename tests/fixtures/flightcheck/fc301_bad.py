@@ -1,0 +1,29 @@
+"""known-bad: blocking host sync inside the dispatch path of a
+serving-scheduler-shaped class (FC301)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class MiniEngine:
+    def __init__(self):
+        self._inflight = []
+        self._decode_j = jax.jit(lambda x: x + 1)
+
+    def _dispatch_chunk(self):
+        toks = self._decode_j(jnp.zeros((4,)))
+        # syncing at DISPATCH stalls the pipeline: the host blocks on
+        # the device before the next chunk can be queued
+        host = np.asarray(toks)
+        self._inflight.append({"toks": toks})
+        return host
+
+    def _collect_oldest(self):
+        ch = self._inflight.pop(0)
+        if ch["toks"][0]:              # implicit bool of a device value
+            return int(ch["toks"][0])
+        return 0
+
+    def step(self):
+        self._dispatch_chunk()
+        return self._collect_oldest()

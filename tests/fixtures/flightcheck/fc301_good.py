@@ -1,0 +1,28 @@
+"""known-good twin of fc301_bad: dispatch stays async; the ONE designed
+blocking fetch happens at collection and is laundered to host there."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+class MiniEngine:
+    def __init__(self):
+        self._inflight = []
+        self._decode_j = jax.jit(lambda x: x + 1)
+
+    def _dispatch_chunk(self):
+        toks = self._decode_j(jnp.zeros((4,)))
+        self._inflight.append({"toks": toks})
+
+    def _collect_oldest(self):
+        ch = self._inflight.pop(0)
+        # the designed blocking point — would carry an inline
+        # suppression in production code
+        host = np.asarray(ch["toks"])  # flightcheck: disable=FC301
+        if host[0]:                    # host value: free to branch on
+            return int(host[0])
+        return 0
+
+    def step(self):
+        self._dispatch_chunk()
+        return self._collect_oldest()

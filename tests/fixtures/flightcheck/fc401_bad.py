@@ -1,0 +1,16 @@
+"""known-bad: one PRNG key consumed by two primitives (FC401) — the two
+"random" draws are perfectly correlated."""
+import jax
+
+
+def sample_pair(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.normal(key, (4,))   # same key: b == a
+    return a, b
+
+
+def sample_stream(key, n):
+    outs = []
+    for _ in range(n):
+        outs.append(jax.random.uniform(key, (2,)))  # reused every turn
+    return outs

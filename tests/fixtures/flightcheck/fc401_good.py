@@ -1,0 +1,27 @@
+"""known-good twin of fc401_bad: split before every consumption."""
+import jax
+
+
+def sample_pair(key):
+    k1, k2 = jax.random.split(key)
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.normal(k2, (4,))
+    return a, b
+
+
+def sample_stream(key, n):
+    outs = []
+    for _ in range(n):
+        key, sub = jax.random.split(key)
+        outs.append(jax.random.uniform(sub, (2,)))
+    return outs
+
+
+def fold_stream(key, xs):
+    # the OTHER canonical per-step idiom: fold_in derives an
+    # independent stream per counter value from one base key
+    outs = []
+    for i, x in enumerate(xs):
+        k = jax.random.fold_in(key, i)
+        outs.append(x + jax.random.normal(k, (2,)))
+    return outs

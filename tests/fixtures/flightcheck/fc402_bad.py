@@ -1,0 +1,9 @@
+"""known-bad: dead PRNG derivations (FC402) — entropy derived and
+dropped, which usually means the OLD key kept being used."""
+import jax
+
+
+def setup_streams(key, i):
+    jax.random.fold_in(key, i)          # result discarded
+    sub = jax.random.split(key, 2)      # derived, never consumed
+    return jax.random.normal(key, (4,))

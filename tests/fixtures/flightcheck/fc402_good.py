@@ -1,0 +1,8 @@
+"""known-good twin of fc402_bad: every derivation is consumed."""
+import jax
+
+
+def setup_streams(key, i):
+    folded = jax.random.fold_in(key, i)
+    k1, k2 = jax.random.split(folded)
+    return jax.random.normal(k1, (4,)) + jax.random.normal(k2, (4,))

@@ -1,0 +1,23 @@
+"""known-bad: argument read after being donated (FC501) — the buffer is
+deleted by donation; the later read raises (or reads clobbered memory)."""
+import jax
+import jax.numpy as jnp
+
+
+def _update(pool, x):
+    return pool.at[0].add(x), x * 2
+
+
+update_j = jax.jit(_update, donate_argnums=(0,))
+
+
+def run(pool, x):
+    new_pool, y = update_j(pool, x)
+    stale = pool.sum()                 # pool was donated: deleted buffer
+    return new_pool, y + stale
+
+
+def run_loop(pool, xs):
+    for x in xs:
+        _, _ = update_j(pool, x)       # donated, never rebound: iter 2
+    return pool                        # passes a deleted buffer

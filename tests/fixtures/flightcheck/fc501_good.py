@@ -1,0 +1,23 @@
+"""known-good twin of fc501_bad: the donated reference is rebound from
+the call's result in the same statement (the serving-engine idiom)."""
+import jax
+import jax.numpy as jnp
+
+
+def _update(pool, x):
+    return pool.at[0].add(x), x * 2
+
+
+update_j = jax.jit(_update, donate_argnums=(0,))
+
+
+def run(pool, x):
+    pool, y = update_j(pool, x)
+    total = pool.sum()                 # the NEW pool — fine
+    return pool, y + total
+
+
+def run_loop(pool, xs):
+    for x in xs:
+        pool, _ = update_j(pool, x)    # rebound every iteration
+    return pool

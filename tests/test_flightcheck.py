@@ -1,0 +1,197 @@
+"""flightcheck tier-1 gate: the static-analysis suite itself.
+
+Three layers:
+1. rule self-tests over tests/fixtures/flightcheck/ — every rule must
+   fire on its known-bad fixture and stay silent on the corrected twin
+   (the suite's own regression net: a checker change that goes blind or
+   noisy fails here first);
+2. the package gate — `paddle_tpu/` must produce ZERO non-baselined
+   findings (the baseline is committed and empty; intended violations
+   carry inline suppressions at the line);
+3. the jaxpr cross-check — the serving/paged-decode entry points must
+   trace clean (abstract make_jaxpr under the leak checker, no compile)
+   and their jaxprs must pass the IR-level PRNG audit, confirming the
+   AST verdicts against ground truth.
+"""
+import os
+
+import pytest
+
+from tools.flightcheck import core
+from tools.flightcheck import DEFAULT_BASELINE
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "fixtures", "flightcheck")
+PACKAGE = os.path.join(REPO, "paddle_tpu")
+
+RULES = ["FC101", "FC102", "FC103", "FC201", "FC202", "FC203",
+         "FC301", "FC401", "FC402", "FC501"]
+
+
+def _scan(path):
+    with open(path, encoding="utf-8") as fh:
+        return core.check_source(fh.read(), path)
+
+
+class TestFixtures:
+    @pytest.mark.parametrize("rule", RULES)
+    def test_bad_fixture_fires(self, rule):
+        path = os.path.join(FIXTURES, f"{rule.lower()}_bad.py")
+        found = {f.rule for f in _scan(path)}
+        assert rule in found, (
+            f"{rule} must fire on its known-bad fixture; got {found}")
+
+    @pytest.mark.parametrize("rule", RULES)
+    def test_good_fixture_clean(self, rule):
+        path = os.path.join(FIXTURES, f"{rule.lower()}_good.py")
+        findings = _scan(path)
+        assert not findings, (
+            f"corrected twin of {rule} must be clean; got "
+            + "; ".join(core.format_finding(f) for f in findings))
+
+    def test_bad_fixture_reports_location(self):
+        path = os.path.join(FIXTURES, "fc101_bad.py")
+        f = [x for x in _scan(path) if x.rule == "FC101"][0]
+        assert f.line > 0 and f.func  # file:line + enclosing def
+
+    def test_host_sync_reports_call_chain(self):
+        path = os.path.join(FIXTURES, "fc301_bad.py")
+        fs = [x for x in _scan(path) if x.rule == "FC301"]
+        assert fs and all(f.chain for f in fs)
+        assert any("step" in f.chain for f in fs)
+
+
+class TestSuppressionsAndBaseline:
+    SRC_BAD = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def f(x):\n"
+        "    if x > 0:\n"
+        "        return x\n"
+        "    return -x\n")
+
+    def test_inline_suppression(self):
+        assert any(f.rule == "FC101"
+                   for f in core.check_source(self.SRC_BAD, "t.py"))
+        suppressed = self.SRC_BAD.replace(
+            "if x > 0:", "if x > 0:  # flightcheck: disable=FC101")
+        assert not core.check_source(suppressed, "t.py")
+
+    def test_suppress_all(self):
+        suppressed = self.SRC_BAD.replace(
+            "if x > 0:", "if x > 0:  # flightcheck: disable=all")
+        assert not core.check_source(suppressed, "t.py")
+
+    def test_suppression_with_justification(self):
+        # trailing prose after the rule code must not defeat it
+        suppressed = self.SRC_BAD.replace(
+            "if x > 0:",
+            "if x > 0:  # flightcheck: disable=FC101 designed branch")
+        assert not core.check_source(suppressed, "t.py")
+
+    def test_suppression_covers_multiline_statement(self):
+        src = (
+            "import numpy as np\nimport jax\nimport jax.numpy as jnp\n"
+            "class Eng:\n"
+            "    def __init__(self):\n"
+            "        self._j = jax.jit(lambda x: x)\n"
+            "    def _dispatch_a(self):\n"
+            "        t = self._j(jnp.zeros(2))\n"
+            "        return (  # flightcheck: disable=FC301\n"
+            "            np.asarray(t))\n"
+            "    def _collect_b(self):\n"
+            "        pass\n"
+            "    def step(self):\n"
+            "        return self._dispatch_a()\n")
+        assert not core.check_source(src, "t.py")
+
+    def test_suppression_does_not_mask_other_rules(self):
+        # regression: a disable comment for ONE rule must not filter
+        # the rest of the file's findings for other rules
+        src = self.SRC_BAD + (
+            "\nimport numpy as np  # flightcheck: disable=FC301\n")
+        assert any(f.rule == "FC101"
+                   for f in core.check_source(src, "t.py"))
+
+    def test_baseline_roundtrip(self, tmp_path):
+        findings = core.check_source(self.SRC_BAD, "t.py")
+        bl = tmp_path / "baseline.txt"
+        core.write_baseline(str(bl), findings)
+        keys = core.load_baseline(str(bl))
+        assert {core.baseline_key(f) for f in findings} == keys
+        # baseline keys are line-free: shifting the code keeps them valid
+        shifted = "# a new leading comment\n" + self.SRC_BAD
+        for f in core.check_source(shifted, "t.py"):
+            assert core.baseline_key(f) in keys
+
+    def test_rule_docs_complete(self):
+        docs = core.all_rules()
+        for rule in RULES:
+            assert rule in docs and docs[rule]
+
+
+class TestPackageGate:
+    def test_paddle_tpu_is_clean(self):
+        """The tentpole acceptance gate: zero non-baselined findings
+        over the whole package (and the committed baseline is empty)."""
+        new, old = core.run(PACKAGE, DEFAULT_BASELINE)
+        msgs = "\n".join(core.format_finding(f) for f in new)
+        assert not new, f"new flightcheck findings:\n{msgs}"
+        assert not old, (
+            "the committed baseline must stay empty — fix or inline-"
+            "suppress (with justification) instead of baselining")
+
+    def test_cli_exit_codes(self):
+        from tools.flightcheck.__main__ import main
+        assert main([os.path.join(FIXTURES, "fc101_good.py"),
+                     "--baseline", ""]) == 0
+        assert main([os.path.join(FIXTURES, "fc101_bad.py"),
+                     "--baseline", ""]) == 1
+
+
+class TestJaxprCrossCheck:
+    @pytest.fixture(scope="class")
+    def traced(self):
+        from tools.flightcheck import jaxpr_check
+        results = jaxpr_check.trace_entry_points()
+        jaxprs = results.pop("__jaxprs__")
+        return results, jaxprs
+
+    def test_entry_points_trace_clean(self, traced):
+        results, _ = traced
+        bad = {k: v for k, v in results.items() if v != "ok"}
+        assert not bad, f"entry points failed to trace: {bad}"
+        # every serving program the engine compiles is covered
+        names = {name for _, name in results}
+        assert {"prefill", "decode_chunk", "decode_chunk_rich",
+                "_prefill_impl", "_decode_logits"} <= names
+
+    def test_prng_audit_clean_on_entry_points(self, traced):
+        from tools.flightcheck.jaxpr_check import audit_prng
+        _, jaxprs = traced
+        notes = {k: audit_prng(jx) for k, jx in jaxprs.items()}
+        notes = {k: v for k, v in notes.items() if v}
+        assert not notes, f"PRNG reuse at jaxpr level: {notes}"
+
+    def test_prng_audit_detects_reuse(self):
+        import jax
+        from tools.flightcheck.jaxpr_check import audit_prng
+
+        def bad(key):
+            a = jax.random.normal(key, (4,))
+            return a + jax.random.normal(key, (4,))
+
+        jx = jax.make_jaxpr(bad)(jax.random.PRNGKey(0))
+        assert audit_prng(jx), "IR-level key reuse must be detected"
+
+    def test_cross_check_refutes_ast_fp(self, traced):
+        """An artificial FC101 'finding' placed inside a cleanly-traced
+        entry point must be refuted, not confirmed."""
+        from tools.flightcheck import jaxpr_check
+        fake = core.Finding("paddle_tpu/inference/serving.py", 1,
+                            "FC101", "synthetic", "ServingEngine."
+                            "__init__.decode_chunk")
+        real = core.Finding("paddle_tpu/other.py", 1, "FC101",
+                            "synthetic", "foo")
+        rep = jaxpr_check.cross_check([fake, real])
+        assert fake in rep.refuted and real in rep.confirmed

@@ -1,10 +1,18 @@
 #!/usr/bin/env python
-"""Serving-pool invariant gate (ISSUE 1 satellite; extended for the
-ISSUE 2 chunked-prefill schedules).
+"""Serving-path checker gate: RUNTIME invariants + STATIC analysis in
+one entry point (ISSUE 1 satellite; extended for the ISSUE 2 chunked-
+prefill schedules; ISSUE 3 added the flightcheck static half).
 
-Runs the serving-path test files with PADDLE_TPU_POOL_DEBUG=1, which
-makes ServingEngine.step() call PagedKVCache.debug_check() after every
-scheduler iteration — asserting the pool invariant
+Phase 1 — static: runs the flightcheck suite (tools/flightcheck) over
+``paddle_tpu/inference/`` — tracer safety, recompilation hazards,
+hot-path host syncs, PRNG discipline, donation aliasing. Zero cost, no
+devices; catches the hazard classes no runtime assertion can (they
+don't fail, they just serve slowly or sample wrongly).
+
+Phase 2 — runtime: runs the serving-path test files with
+PADDLE_TPU_POOL_DEBUG=1, which makes ServingEngine.step() call
+PagedKVCache.debug_check() after every scheduler iteration — asserting
+the pool invariant
 
     free + cached + referenced == num_blocks
 
@@ -14,10 +22,10 @@ prefill extends a sequence over several scheduler steps; its context
 length must sit inside the blocks reserved at admission BETWEEN every
 pair of chunks — test_chunked_prefill.py drives multi-chunk prompts,
 mid-stream admissions, splice-pending dependencies, and eviction
-pressure through that window). Exit code is pytest's: non-zero means a
-test failed OR an invariant tripped mid-schedule.
+pressure through that window). Exit code is non-zero when EITHER phase
+fails.
 
-    python tools/check_serving_invariants.py            # all files
+    python tools/check_serving_invariants.py            # both phases
     python tools/check_serving_invariants.py -k prefix  # pass-through
 """
 from __future__ import annotations
@@ -38,7 +46,24 @@ TEST_FILES = [
 ]
 
 
+def run_flightcheck() -> int:
+    """Static phase: flightcheck over the inference package."""
+    from tools.flightcheck import DEFAULT_BASELINE, core
+    target = os.path.join(REPO, "paddle_tpu", "inference")
+    new, old = core.run(target, DEFAULT_BASELINE)
+    for f in new:
+        print(core.format_finding(f))
+    if new:
+        print(f"FLIGHTCHECK GATE FAILED — {len(new)} new finding(s) in "
+              f"paddle_tpu/inference/")
+        return 1
+    print(f"FLIGHTCHECK OK — paddle_tpu/inference/ clean "
+          f"({len(old)} baselined)")
+    return 0
+
+
 def main() -> int:
+    static_rc = run_flightcheck()
     import pytest
     args = TEST_FILES + ["-q", "-m", "not slow", "-p", "no:cacheprovider",
                          "-p", "no:randomly"] + sys.argv[1:]
@@ -46,7 +71,7 @@ def main() -> int:
     print(("POOL INVARIANTS OK — debug_check ran after every "
            "engine step") if rc == 0 else
           f"POOL INVARIANT GATE FAILED (pytest exit {rc})")
-    return int(rc)
+    return int(rc) or static_rc
 
 
 if __name__ == "__main__":

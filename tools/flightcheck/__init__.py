@@ -1,0 +1,35 @@
+"""flightcheck — framework-aware static analysis for JAX/TPU hazards.
+
+A lint suite for the bug classes that make JAX code on TPUs fail
+*silently*: tracer leaks into Python control flow (FC101-FC103), jit
+recompilation storms (FC201-FC202), hidden host-device syncs on the
+serving hot path (FC301), PRNG key reuse and dead derivations
+(FC401-FC402), and use-after-donation (FC501). An optional jaxpr-backed
+mode (``--jaxpr``) traces the paged-decode/serving entry points and
+cross-checks the AST verdicts, keeping the static pass low-false-
+positive.
+
+Usage::
+
+    python -m tools.flightcheck paddle_tpu/            # lint the tree
+    python -m tools.flightcheck --list-rules
+    python -m tools.flightcheck --jaxpr paddle_tpu/    # + jaxpr mode
+
+Suppress a single intended finding inline::
+
+    toks = np.asarray(ch["toks"])  # flightcheck: disable=FC301
+
+Grandfather pre-existing findings in ``tools/flightcheck/baseline.txt``
+(see ``--write-baseline``); the CLI fails only on NEW findings.
+"""
+from .core import (Finding, all_rules, baseline_key, check_path,
+                   check_source, format_finding, load_baseline, run)
+
+__all__ = ["Finding", "all_rules", "baseline_key", "check_path",
+           "check_source", "format_finding", "load_baseline", "run",
+           "DEFAULT_BASELINE"]
+
+import os as _os
+
+DEFAULT_BASELINE = _os.path.join(_os.path.dirname(_os.path.abspath(
+    __file__)), "baseline.txt")

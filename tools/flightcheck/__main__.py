@@ -1,0 +1,90 @@
+"""flightcheck CLI — see package docstring for the rule catalog.
+
+Exit codes: 0 = clean (or only baselined findings), 1 = new findings,
+2 = usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    from . import core, DEFAULT_BASELINE
+
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.flightcheck",
+        description="Framework-aware static analysis for JAX/TPU "
+                    "hazard classes.")
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (default: the committed one); "
+                         "'' disables")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write current findings as the new baseline")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule codes to run (default "
+                         "all)")
+    ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--jaxpr", action="store_true",
+                    help="also trace the paged-decode/serving entry "
+                         "points and cross-check AST verdicts")
+    ap.add_argument("--show-baselined", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code, doc in core.all_rules().items():
+            print(f"{code}  {doc}")
+        return 0
+    if not args.paths:
+        ap.print_usage()
+        return 2
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
+        or None
+    new, old = [], []
+    for path in args.paths:
+        n, o = core.run(path, args.baseline or None, rules)
+        new.extend(n)
+        old.extend(o)
+
+    if args.write_baseline:
+        if not args.baseline:
+            print("--write-baseline needs a baseline path "
+                  "(--baseline '' disables baselining)", file=sys.stderr)
+            return 2
+        core.write_baseline(args.baseline, new + old)
+        print(f"baseline written: {len(new + old)} finding(s) -> "
+              f"{args.baseline}")
+        return 0
+
+    jaxpr_failed = False
+    if args.jaxpr:
+        # cross-check BEFORE printing: refuted findings must not appear
+        # as normal findings in a run that then reports clean
+        from . import jaxpr_check
+        report = jaxpr_check.cross_check(new)
+        print(report.summary())
+        new = report.confirmed
+        # a trace failure OR an IR-level PRNG reuse is a confirmed
+        # hazard regardless of what the AST pass saw
+        jaxpr_failed = bool(report.trace_failures or report.prng_notes)
+
+    for f in new:
+        print(core.format_finding(f))
+    if args.show_baselined:
+        for f in old:
+            print("[baselined] " + core.format_finding(f))
+    if jaxpr_failed:
+        return 1
+
+    if new:
+        print(f"\nflightcheck: {len(new)} new finding(s) "
+              f"({len(old)} baselined)")
+        return 1
+    print(f"flightcheck: clean ({len(old)} baselined finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,248 @@
+"""flightcheck core: findings, suppressions, baseline, and the runner.
+
+The suite is an AST-level linter for JAX/TPU-specific hazard classes —
+the silent failure modes a Python test suite rarely catches because the
+code *runs*, just slowly or wrongly: tracer leaks into Python control
+flow, jit-cache blowups, hidden host-device synchronization on the
+serving hot path, PRNG key reuse, and use-after-donation. Each rule
+lives in its own module (tracer_safety, recompile, host_sync, prng,
+donation) and registers a ``check(module: ast.Module, ctx: FileContext)
+-> list[Finding]`` callable here.
+
+Reporting contract:
+- findings are ``file:line RULE message``; rule codes are stable.
+- ``# flightcheck: disable=FC101`` (or ``disable=FC101,FC301`` /
+  ``disable=all``) on the offending line or its enclosing statement
+  suppresses inline — for *intended* violations (e.g. the serving
+  engine's designed host-sync collection points).
+- a committed baseline file grandfathers pre-existing findings: the CLI
+  exits non-zero only on NEW findings. Baselines key on
+  (relpath, rule, enclosing-def, normalized message) — not line
+  numbers — so unrelated edits don't churn the file.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from io import StringIO
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = [
+    "Finding", "FileContext", "register", "all_rules", "check_source",
+    "check_path", "load_baseline", "baseline_key", "format_finding",
+    "run", "RULE_DOCS",
+]
+
+# rule code -> one-line description (filled in by checker modules)
+RULE_DOCS: Dict[str, str] = {}
+
+_CHECKERS: List[Tuple[str, Callable]] = []
+
+
+def register(name: str, fn: Callable, docs: Dict[str, str]):
+    """Register a checker. ``docs`` maps each rule code the checker can
+    emit to its one-line description (surfaced by ``--list-rules``)."""
+    _CHECKERS.append((name, fn))
+    RULE_DOCS.update(docs)
+
+
+def all_rules() -> Dict[str, str]:
+    _load_checkers()
+    return dict(sorted(RULE_DOCS.items()))
+
+
+@dataclass
+class Finding:
+    path: str            # path as given (relative preferred)
+    line: int
+    rule: str            # e.g. "FC101"
+    message: str
+    func: str = ""       # enclosing def chain, e.g. "ServingEngine.step"
+    chain: str = ""      # optional call chain (host-sync findings)
+
+    def sort_key(self):
+        return (self.path, self.line, self.rule)
+
+
+@dataclass
+class FileContext:
+    path: str
+    source: str
+    # line -> set of rule codes suppressed there ("all" suppresses any)
+    suppressions: Dict[int, set] = field(default_factory=dict)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        for probe in (line,):
+            rules = self.suppressions.get(probe)
+            if rules and ("all" in rules or rule in rules):
+                return True
+        return False
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*flightcheck:\s*disable=([A-Za-z0-9_,\s]+)")
+_RULE_TOKEN_RE = re.compile(r"^(?:all|FC\d+)$")
+
+
+def _parse_suppressions(source: str) -> Dict[int, set]:
+    """Map line number -> suppressed rule codes. A suppression comment
+    covers its own line and (expanded in check_source) the span of its
+    enclosing statement. Only tokens shaped like rule codes (or `all`)
+    count, so a trailing justification — `disable=FC301 designed sync`
+    — still suppresses FC301."""
+    out: Dict[int, set] = {}
+    try:
+        tokens = tokenize.generate_tokens(StringIO(source).readline)
+        for tok in tokens:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = _SUPPRESS_RE.search(tok.string)
+            if m:
+                codes = {r for r in re.split(r"[,\s]+", m.group(1))
+                         if _RULE_TOKEN_RE.match(r)}
+                if codes:
+                    out.setdefault(tok.start[0], set()).update(codes)
+    except tokenize.TokenError:
+        pass
+    return out
+
+
+def _load_checkers():
+    if _CHECKERS:
+        return
+    from . import tracer_safety, recompile, host_sync, prng, donation
+    for mod in (tracer_safety, recompile, host_sync, prng, donation):
+        mod.setup(register)
+
+
+def check_source(source: str, path: str = "<string>",
+                 rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    """Run every registered checker over one source blob."""
+    _load_checkers()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [Finding(path, e.lineno or 0, "FC000",
+                        f"syntax error: {e.msg}")]
+    suppressions = _parse_suppressions(source)
+    # a suppression anywhere inside a multi-line statement covers the
+    # whole statement's span — a comment on the first line must keep
+    # suppressing when a reformat moves the sink call to a continuation
+    if suppressions:
+        spans = [(n.lineno, getattr(n, "end_lineno", n.lineno) or
+                  n.lineno)
+                 for n in ast.walk(tree) if isinstance(n, ast.stmt)]
+        for line, sup_rules in list(suppressions.items()):
+            best = None
+            for lo, hi in spans:
+                if lo <= line <= hi and (
+                        best is None or (hi - lo) < (best[1] - best[0])):
+                    best = (lo, hi)
+            if best:
+                for ln in range(best[0], best[1] + 1):
+                    suppressions.setdefault(ln, set()).update(sup_rules)
+    ctx = FileContext(path=path, source=source,
+                      suppressions=suppressions)
+    findings: List[Finding] = []
+    for _name, fn in _CHECKERS:
+        for f in fn(tree, ctx):
+            if rules and f.rule not in rules:
+                continue
+            # a suppression on the finding line OR on the first line of
+            # its enclosing simple statement wins
+            if ctx.suppressed(f.line, f.rule):
+                continue
+            findings.append(f)
+    findings.sort(key=Finding.sort_key)
+    return findings
+
+
+def _iter_py_files(root: str):
+    if os.path.isfile(root):
+        yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+# finding paths anchor at the repository root (the directory holding
+# the `tools` package) regardless of the lint root or the cwd — so
+# `paddle_tpu/` and `paddle_tpu/inference/` runs produce IDENTICAL
+# paths, baseline keys stay stable across invocation shapes, and the
+# jaxpr cross-check's path matching works from any entry point
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _repo_rel(path: str) -> str:
+    ap = os.path.abspath(path)
+    if ap.startswith(_REPO_ROOT + os.sep):
+        return os.path.relpath(ap, _REPO_ROOT)
+    return path
+
+
+def check_path(root: str,
+               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+    findings: List[Finding] = []
+    for path in _iter_py_files(root):
+        with open(path, encoding="utf-8") as fh:
+            src = fh.read()
+        findings.extend(check_source(src, _repo_rel(path), rules))
+    return findings
+
+
+# -- baseline --------------------------------------------------------------
+
+def baseline_key(f: Finding) -> str:
+    """Line-number-free identity so unrelated edits don't churn the
+    baseline: path, rule, enclosing def, message."""
+    return f"{f.path}::{f.rule}::{f.func}::{f.message}"
+
+
+def load_baseline(path: str) -> set:
+    if not path or not os.path.exists(path):
+        return set()
+    out = set()
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                out.add(line)
+    return out
+
+
+def write_baseline(path: str, findings: Sequence[Finding]):
+    with open(path, "w", encoding="utf-8") as fh:
+        fh.write("# flightcheck baseline — grandfathered findings.\n"
+                 "# One key per line: path::RULE::func::message.\n"
+                 "# Remove entries as the findings are fixed; never add\n"
+                 "# new ones without a written justification.\n")
+        for key in sorted({baseline_key(f) for f in findings}):
+            fh.write(key + "\n")
+
+
+def format_finding(f: Finding) -> str:
+    loc = f"{f.path}:{f.line}"
+    msg = f"{loc}: {f.rule} [{f.func or '<module>'}] {f.message}"
+    if f.chain:
+        msg += f"\n    call chain: {f.chain}"
+    return msg
+
+
+def run(root: str, baseline_path: Optional[str] = None,
+        rules: Optional[Sequence[str]] = None
+        ) -> Tuple[List[Finding], List[Finding]]:
+    """Returns (new_findings, baselined_findings)."""
+    findings = check_path(root, rules)
+    baseline = load_baseline(baseline_path) if baseline_path else set()
+    new, old = [], []
+    for f in findings:
+        (old if baseline_key(f) in baseline else new).append(f)
+    return new, old

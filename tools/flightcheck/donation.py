@@ -1,0 +1,265 @@
+"""Donation/aliasing rule (FC501): use of an argument after donating it.
+
+Hazard: ``jax.jit(f, donate_argnums=...)`` lets XLA reuse the donated
+operand's buffer for an output — the serving engine donates the KV pool
+into every prefill/decode dispatch precisely so the multi-GiB cache is
+updated in place (``serving.py``: ``jax.jit(prefill, donate_argnums=(1,
+2))``). After the call the donated buffer is DELETED: reading the old
+Python reference raises "Array has been deleted" at best, and on some
+backends silently reads clobbered memory. The safe idiom — the one this
+repo uses everywhere — immediately rebinds the donated reference to the
+returned value in the same statement: ``toks, cache.k, cache.v =
+self._prefill_j(..., cache.k, cache.v, ...)``.
+
+Mechanics: we map jit-wrapped callables to their donated positions from
+``X = jax.jit(f, donate_argnums=...)`` assignments (including
+``self._x = ...``) and ``@partial(jax.jit, donate_argnums=...)``
+decorations, then at every call site check whether a donated argument
+expression (a name or dotted attribute) is read again later in the
+enclosing function before being stored — including the implicit re-read
+on the next iteration when the call sits in a loop.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FileContext
+from .scopes import (FuncNode, dotted, func_of_map,
+                     literal_int_collection, tail_of, unwrap_partial)
+
+
+def _donate_nums(call: ast.Call) -> Set[int]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            vals = literal_int_collection(kw.value) or []
+            return {v for v in vals if isinstance(v, int)}
+    return set()
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.Call]:
+    """The jit(...) call node, unwrapping partial(jax.jit, ...)."""
+    if tail_of(dotted(call.func)) in ("jit", "pjit"):
+        return call
+    inner = unwrap_partial(call)
+    if inner is not None and \
+            tail_of(dotted(inner.func)) in ("jit", "pjit"):
+        return inner
+    return None
+
+
+def _collect_donating(tree: ast.Module) -> Dict[str, Set[int]]:
+    """dotted callee name -> donated positional indices.
+
+    Names are as they appear at call sites: 'self._prefill_j' for
+    `self._prefill_j = jax.jit(...)`, bare 'step_fn' for a decorated
+    def or local assignment."""
+    out: Dict[str, Set[int]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call):
+            jit = _jit_target(node.value)
+            if jit is None:
+                continue
+            nums = _donate_nums(jit)
+            if not nums:
+                continue
+            for t in node.targets:
+                name = dotted(t)
+                if name:
+                    out[name] = nums
+        elif isinstance(node, FuncNode):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    jit = _jit_target(dec)
+                    if jit is not None:
+                        nums = _donate_nums(jit)
+                        if nums:
+                            out[node.name] = nums
+    return out
+
+
+def _stmt_sequence(fn_node):
+    """(flat source-ordered statements, branch map) of a function body
+    (not descending into nested defs). The branch map gives each
+    statement its set of (if-node-id, arm) memberships so two
+    statements in MUTUALLY EXCLUSIVE arms of the same `if` are never
+    treated as sequential."""
+    out: List[ast.stmt] = []
+    branch: Dict[int, frozenset] = {}
+
+    def walk(stmts, arms: frozenset):
+        for st in stmts:
+            if isinstance(st, FuncNode + (ast.ClassDef,)):
+                continue
+            out.append(st)
+            branch[id(st)] = arms
+            if isinstance(st, ast.If):
+                walk(st.body, arms | {(id(st), 0)})
+                walk(st.orelse, arms | {(id(st), 1)})
+            else:
+                for field in ("body", "orelse", "finalbody"):
+                    walk(getattr(st, field, []) or [], arms)
+                for h in getattr(st, "handlers", []) or []:
+                    walk(h.body, arms)
+
+    walk(fn_node.body, frozenset())
+    out.sort(key=lambda s: (s.lineno, s.col_offset))
+    return out, branch
+
+
+def _exclusive(branch, a: ast.stmt, b: ast.stmt) -> bool:
+    """True when a and b sit in different arms of the same if."""
+    arms_a = dict(branch.get(id(a), frozenset()))
+    for if_id, arm in branch.get(id(b), frozenset()):
+        if if_id in arms_a and arms_a[if_id] != arm:
+            return True
+    return False
+
+
+def _reads_of(expr_path: str, node: ast.AST) -> List[ast.AST]:
+    hits = []
+    for sub in ast.walk(node):
+        if isinstance(sub, (ast.Name, ast.Attribute)) and \
+                dotted(sub) == expr_path and \
+                isinstance(getattr(sub, "ctx", ast.Load()), ast.Load):
+            hits.append(sub)
+    return hits
+
+
+def _stores_of(expr_path: str, st: ast.stmt) -> bool:
+    targets = []
+    if isinstance(st, ast.Assign):
+        targets = st.targets
+    elif isinstance(st, (ast.AugAssign, ast.AnnAssign)):
+        targets = [st.target]
+    elif isinstance(st, ast.For):
+        targets = [st.target]
+    for t in targets:
+        stack = [t]
+        while stack:
+            x = stack.pop()
+            if isinstance(x, (ast.Tuple, ast.List)):
+                stack.extend(x.elts)
+            elif dotted(x) == expr_path:
+                return True
+    return False
+
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    donating = _collect_donating(tree)
+    if not donating:
+        return []
+    findings: List[Finding] = []
+    owner_of = func_of_map(tree)
+
+    for fn in [n for n in ast.walk(tree) if isinstance(n, FuncNode)]:
+        seq, branch = _stmt_sequence(fn)
+        loops = [n for n in ast.walk(fn)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        seen = set()
+        for idx, st in enumerate(seq):
+            for call in _own_calls(st):
+                name = dotted(call.func)
+                nums = donating.get(name or "")
+                if not nums:
+                    continue
+                for pos in sorted(nums):
+                    if pos >= len(call.args):
+                        continue
+                    path = dotted(call.args[pos])
+                    if not path:
+                        continue  # non-name donated expr (literal/call)
+                    for f in _check_use_after(
+                            ctx, owner_of.get(st, fn.name), name, path,
+                            st, idx, seq, branch, loops):
+                        key = (f.line, f.message)
+                        if key not in seen:
+                            seen.add(key)
+                            findings.append(f)
+    return findings
+
+
+def _check_use_after(ctx, qual, callee, path, call_st, idx, seq, branch,
+                     loops):
+    out: List[Finding] = []
+    # the call's own statement: a store there (tuple-assign of results
+    # back onto the donated ref) re-binds BEFORE any later read
+    if _stores_of(path, call_st):
+        return out
+    # later statements in source order: read-before-store => bug.
+    # statements in the opposite arm of the call's `if` never execute
+    # on the same path and are skipped.
+    for later in seq[idx + 1:]:
+        if _exclusive(branch, call_st, later):
+            continue
+        if _stores_of(path, later):
+            # a store can appear in the same statement as a read
+            # (x = f(x)) — that read is of the NEW value; stop either way
+            break
+        reads = _reads_of(path, later)
+        if reads:
+            out.append(Finding(
+                ctx.path, later.lineno, "FC501",
+                f"'{path}' is read after being donated to "
+                f"'{callee}' (line {call_st.lineno}); the buffer is "
+                f"deleted by donation — rebind it from the call's "
+                f"result or drop donate_argnums", qual))
+            return out
+    # loop wrap-around: call inside a loop, donated ref never stored in
+    # that loop body => next iteration re-reads a deleted buffer
+    for loop in loops:
+        if _contains(loop, call_st):
+            stored = any(_stores_of(path, st) for st in _body_stmts(loop))
+            if not stored:
+                out.append(Finding(
+                    ctx.path, call_st.lineno, "FC501",
+                    f"'{path}' is donated to '{callee}' inside a loop "
+                    f"but never rebound in the loop body — the next "
+                    f"iteration passes a deleted buffer", qual))
+            break
+    return out
+
+
+def _own_calls(st: ast.stmt):
+    """Call nodes belonging to THIS statement — for compound statements
+    only the header expression (test/iter/items), so a call inside the
+    body is attributed to its own (innermost) statement in the
+    sequence, not to every enclosing compound."""
+    if isinstance(st, (ast.If, ast.While)):
+        exprs = [st.test]
+    elif isinstance(st, (ast.For, ast.AsyncFor)):
+        exprs = [st.iter]
+    elif isinstance(st, (ast.With, ast.AsyncWith)):
+        exprs = [i.context_expr for i in st.items]
+    elif isinstance(st, ast.Try):
+        exprs = []
+    else:
+        exprs = [st]
+    for e in exprs:
+        for sub in ast.walk(e):
+            if isinstance(sub, ast.Call):
+                yield sub
+
+
+def _body_stmts(loop):
+    out = []
+    stack = list(loop.body)
+    while stack:
+        st = stack.pop()
+        if isinstance(st, FuncNode + (ast.ClassDef,)):
+            continue
+        out.append(st)
+        for field in ("body", "orelse", "finalbody"):
+            stack.extend(getattr(st, field, []) or [])
+    return out
+
+
+def _contains(outer, target) -> bool:
+    return any(sub is target for sub in ast.walk(outer))
+
+
+def setup(register):
+    register("donation", check, {
+        "FC501": "argument read after being passed in a donated position",
+    })

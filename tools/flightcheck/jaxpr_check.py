@@ -1,0 +1,246 @@
+"""jaxpr-backed cross-check: trace the real serving/paged-decode entry
+points and verify the AST verdicts against ground truth.
+
+The AST pass is syntactic — it cannot prove a flagged branch is really
+reached with a tracer, and it cannot see hazards hidden behind dynamic
+dispatch. This mode closes both gaps for the code that matters most
+(the serving hot path):
+
+1. it builds a TINY PagedLlamaDecoder + ServingEngine on CPU and runs
+   ``jax.make_jaxpr`` (under ``jax.checking_leaks``) over every compiled
+   entry point — the decoder ``*_impl`` methods and the engine's jitted
+   prefill/decode closures. Abstract tracing executes nothing but takes
+   exactly the code paths jit takes: a genuine tracer-safety bug
+   (FC101-FC103) raises a ConcretizationTypeError / TracerArrayConversion
+   right here, and a leaked tracer trips the leak checker. A trace
+   FAILURE is reported as a confirmed hazard even if the AST pass missed
+   it.
+2. any AST tracer-safety finding located inside a function that traced
+   CLEANLY is downgraded to "refuted by jaxpr" — the cross-check that
+   keeps the AST pass low-false-positive.
+3. the produced jaxprs get an independent PRNG audit: a key variable
+   feeding two separate ``threefry``/``random_*`` equations without an
+   intervening derivation is FC401 at the IR level, immune to AST-level
+   aliasing blind spots.
+
+Used by ``python -m tools.flightcheck --jaxpr`` and by the tier-1 test
+(tests/test_flightcheck.py::TestJaxprCrossCheck).
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+
+def _build_tiny():
+    """Smallest engine that exercises every compiled serving program."""
+    import numpy as np
+    from paddle_tpu.models.llama import llama_tiny
+    from paddle_tpu.inference.paged_decode import PagedLlamaDecoder
+    from paddle_tpu.inference.serving import ServingEngine
+
+    cfg = llama_tiny(num_hidden_layers=2, hidden_size=32,
+                     intermediate_size=64, num_attention_heads=4,
+                     num_key_value_heads=2, vocab_size=64,
+                     max_position_embeddings=64)
+    dec = PagedLlamaDecoder.from_config(cfg, num_blocks=16, block_size=4)
+    eng = ServingEngine(dec, max_batch_size=2, prompt_buckets=(8, 16),
+                        chunk_size=2, prefill_chunk=8)
+    return dec, eng
+
+
+def trace_entry_points() -> Dict[Tuple[str, str], str]:
+    """{(file-suffix, func-name): "ok" | "error: ..."} for every entry
+    point. Tracing is abstract (make_jaxpr) — no compile, no execution."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    results: Dict[Tuple[str, str], str] = {}
+    dec, eng = _build_tiny()
+    cache = dec.cache
+    serving = "paddle_tpu/inference/serving.py"
+    paged = "paddle_tpu/inference/paged_decode.py"
+
+    b, s, mp_, vocab = 2, 8, dec.max_pages, dec.cfg.vocab_size
+    ids = jnp.zeros((b, s), jnp.int32)
+    slots = jnp.zeros((b, s), jnp.int32)
+    last_idx = jnp.full((b,), s - 1, jnp.int32)
+    ncv = jnp.zeros((b,), jnp.int32)
+    ptab = jnp.zeros((b, eng._prefix_pages), jnp.int32)
+    temps = jnp.zeros((b,), jnp.float32)
+    top_ks = jnp.zeros((b,), jnp.int32)
+    top_ps = jnp.ones((b,), jnp.float32)
+    reps = jnp.ones((b,), jnp.float32)
+    seen = jnp.zeros((b, vocab), bool)
+    key = jax.random.PRNGKey(0)
+    T = eng.chunk
+    tables_all = jnp.zeros((T, eng.max_b, mp_), jnp.int32)
+    ctx_all = jnp.zeros((T, eng.max_b), jnp.int32)
+    slots_all = jnp.zeros((T, eng.max_b), jnp.int32)
+    first_ids = jnp.zeros((eng.max_b,), jnp.int32)
+    temps_mb = jnp.zeros((eng.max_b,), jnp.float32)
+    keys_all = jax.random.split(key, T)
+    seen_mb = jnp.zeros((eng.max_b, vocab), bool)
+
+    entries = [
+        (paged, "_prefill_impl",
+         lambda: (dec._prefill_impl, (dec.weights, cache.k, cache.v,
+                                      ids, slots, last_idx))),
+        (paged, "_prefill_prefix_impl",
+         lambda: (dec._prefill_prefix_impl,
+                  (dec.weights, cache.k, cache.v, ids, slots, last_idx,
+                   ncv, ptab))),
+        (paged, "_prefill_chunk_impl",
+         lambda: (dec._prefill_chunk_impl,
+                  (dec.weights, cache.k, cache.v, ids[:1], slots[:1],
+                   ncv[:1], ptab[:1]))),
+        (paged, "_decode_logits",
+         lambda: (dec._decode_logits,
+                  (dec.weights, cache.k, cache.v, first_ids[:b],
+                   tables_all[0, :b], ctx_all[0, :b], slots_all[0, :b]))),
+        (serving, "prefill",
+         lambda: (eng._prefill_j, (dec.weights, cache.k, cache.v, ids,
+                                   slots, last_idx, temps, key, top_ks,
+                                   top_ps, reps, seen))),
+        (serving, "prefill_prefix",
+         lambda: (eng._prefill_prefix_j,
+                  (dec.weights, cache.k, cache.v, ids, slots, last_idx,
+                   ncv, ptab, temps, key, top_ks, top_ps, reps, seen))),
+        (serving, "decode_chunk",
+         lambda: (eng._decode_j, (dec.weights, cache.k, cache.v,
+                                  first_ids, tables_all, ctx_all,
+                                  slots_all, temps_mb, keys_all))),
+        (serving, "decode_chunk_rich",
+         lambda: (eng._decode_rich_j,
+                  (dec.weights, cache.k, cache.v, first_ids, tables_all,
+                   ctx_all, slots_all, temps_mb, keys_all,
+                   jnp.zeros((eng.max_b,), jnp.int32),
+                   jnp.ones((eng.max_b,), jnp.float32),
+                   jnp.ones((eng.max_b,), jnp.float32), seen_mb))),
+        (serving, "merge_first",
+         lambda: (eng._merge_first_j,
+                  (jnp.zeros((eng.max_b, T), jnp.int32),
+                   jnp.zeros((eng.max_b,), jnp.int32),
+                   jnp.zeros((eng.max_b,), jnp.int32),
+                   jnp.zeros((eng.max_b,), bool)))),
+    ]
+    if eng.prefill_chunk:
+        c = eng.prefill_chunk
+        entries.append(
+            (serving, "prefill_mid",
+             lambda: (eng._prefill_mid_j,
+                      (dec.weights, cache.k, cache.v,
+                       jnp.zeros((1, c), jnp.int32),
+                       jnp.zeros((1, c), jnp.int32),
+                       jnp.zeros((1,), jnp.int32),
+                       jnp.zeros((1, 1), jnp.int32)))))
+
+    jaxprs = {}
+    for file_sfx, name, build in entries:
+        try:
+            fn, args = build()
+            with jax.checking_leaks():
+                jaxpr = jax.make_jaxpr(fn)(*args)
+            jaxprs[(file_sfx, name)] = jaxpr
+            results[(file_sfx, name)] = "ok"
+        except Exception as e:  # trace failure IS the finding
+            results[(file_sfx, name)] = \
+                f"error: {type(e).__name__}: {str(e)[:200]}"
+    results["__jaxprs__"] = jaxprs   # side-channel for the PRNG audit
+    return results
+
+
+def audit_prng(jaxpr) -> List[str]:
+    """IR-level FC401: variables feeding >1 random-consuming equation.
+    Returns human-readable descriptions (empty = clean)."""
+    from collections import Counter
+
+    counts: Counter = Counter()
+
+    def is_key_var(v) -> bool:
+        aval = getattr(v, "aval", None)
+        return aval is not None and "key" in str(aval.dtype)
+
+    seen_jx = set()
+
+    def walk(jx):
+        if id(jx) in seen_jx:   # shared sub-jaxprs walk once — a var
+            return              # is consumed per REFERENCE, not per print
+        seen_jx.add(id(jx))
+        for eqn in jx.eqns:
+            prim = eqn.primitive.name
+            for v in eqn.invars:
+                if hasattr(v, "val"):              # literal
+                    continue
+                # a typed PRNG key consumed by any equation, or a raw
+                # uint32 key entering random_wrap — each counts once; a
+                # correct program consumes every key var exactly once
+                if is_key_var(v) or (prim == "random_wrap"):
+                    counts[(id(jx), v)] += 1
+            for sub in eqn.params.values():
+                core = getattr(sub, "jaxpr", None)
+                if core is not None:
+                    walk(core)
+                elif isinstance(sub, (list, tuple)):
+                    for s in sub:
+                        core = getattr(s, "jaxpr", None)
+                        if core is not None:
+                            walk(core)
+
+    walk(jaxpr.jaxpr)
+    return [f"key var {v} consumed by {n} random equations"
+            for (_, v), n in sorted(counts.items(), key=str) if n > 1]
+
+
+@dataclass
+class Report:
+    traced: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    trace_failures: List[str] = field(default_factory=list)
+    refuted: List = field(default_factory=list)
+    confirmed: List = field(default_factory=list)
+    prng_notes: List[str] = field(default_factory=list)
+
+    def summary(self) -> str:
+        n_ok = sum(1 for v in self.traced.values() if v == "ok")
+        lines = [f"jaxpr cross-check: {n_ok}/{len(self.traced)} entry "
+                 f"points traced clean"]
+        for msg in self.trace_failures:
+            lines.append(f"  TRACE FAILURE: {msg}")
+        for f in self.refuted:
+            lines.append(f"  refuted by jaxpr (function traced clean): "
+                         f"{f.path}:{f.line} {f.rule}")
+        for n in self.prng_notes:
+            lines.append(f"  PRNG audit: {n}")
+        if not self.trace_failures and not self.prng_notes:
+            lines.append("  AST verdicts agree with the traced jaxprs")
+        return "\n".join(lines)
+
+
+def cross_check(findings) -> Report:
+    """Verify AST findings against the traced entry points. Tracer-
+    safety findings (FC101-103) inside functions that traced clean are
+    refuted; trace failures surface as new confirmed hazards."""
+    rep = Report()
+    results = trace_entry_points()
+    jaxprs = results.pop("__jaxprs__", {})
+    rep.traced = results
+    for (file_sfx, name), status in results.items():
+        if status != "ok":
+            rep.trace_failures.append(f"{file_sfx}::{name}: {status}")
+    for key, jx in jaxprs.items():
+        for note in audit_prng(jx):
+            rep.prng_notes.append(f"{key[0]}::{key[1]}: {note}")
+    ok_funcs = {(f, n) for (f, n), st in results.items() if st == "ok"}
+    for f in findings:
+        if f.rule in ("FC101", "FC102", "FC103") and any(
+                f.path.endswith(file_sfx) and
+                (f.func or "").split(".")[-1] == name
+                for file_sfx, name in ok_funcs):
+            rep.refuted.append(f)
+        else:
+            rep.confirmed.append(f)
+    return rep

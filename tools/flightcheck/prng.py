@@ -1,0 +1,329 @@
+"""PRNG-discipline rules (FC401/FC402): key reuse and dead derivations.
+
+Hazard: JAX PRNG keys are values, not stateful generators. Passing the
+SAME key into two sampling primitives yields perfectly correlated
+"randomness" — e.g. feeding one key to two ``jax.random.categorical``
+calls samples identical tokens, which in a serving engine silently
+degrades every temperature>0 request (no test that checks
+"output is random-ish" catches two streams being EQUAL). The fix is
+``key, sub = jax.random.split(key)`` before each consumption — exactly
+the ``ServingEngine._next_key`` discipline in this repo
+(``serving.py``), where every dispatch derives a fresh subkey and the
+decode chunk pre-splits ``jax.random.split(key, T)`` for its scan.
+
+Rules:
+- FC401: a key variable consumed by two calls (or re-consumed across a
+  loop iteration) without an intervening ``split``/``fold_in``
+  rebinding. ``split(key)`` counts as a consumption of ``key`` too —
+  using ``key`` again AFTER splitting it is the classic reuse.
+- FC402: a ``split``/``fold_in`` result that is never used — deriving
+  entropy and dropping it usually means the OLD key kept being used.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FileContext
+from .scopes import FuncNode, dotted, func_of_map, tail_of
+
+_DERIVE_TAILS = {"split", "fold_in", "PRNGKey", "key"}
+_KEY_PARAM_NAMES = {"key", "rng", "rng_key", "prng_key", "subkey"}
+
+
+def _is_random_derive(call: ast.Call) -> Optional[str]:
+    head = dotted(call.func)
+    if not head:
+        return None
+    tail = tail_of(head)
+    if tail in _DERIVE_TAILS and ("random" in head
+                                  or head in ("split", "fold_in",
+                                              "PRNGKey")):
+        return tail
+    if tail in ("_next_key", "next_key"):
+        return "next_key"
+    return None
+
+
+class _FnAnalysis:
+    """Order-aware single-function key-lifetime analysis.
+
+    Walks the statement list linearly; branches of an if/else are
+    analyzed independently against a snapshot and merged by max-use;
+    loop bodies are walked twice to model re-entry (a key defined
+    outside a loop and consumed inside it without a rebinding is a
+    reuse on iteration 2)."""
+
+    def __init__(self, fn_node, ctx: FileContext, qual: str):
+        self.fn = fn_node
+        self.ctx = ctx
+        self.qual = qual
+        self.findings: List[Finding] = []
+        # var -> (generation id, use count for current generation)
+        self.uses: Dict[str, int] = {}
+        self.first_use_line: Dict[str, int] = {}
+        # FC402 tracking: derived-var -> assign lineno, consumed?
+        self.derived_at: Dict[str, int] = {}
+        self.derived_used: Set[str] = set()
+
+    # -- key-var bookkeeping -------------------------------------------
+    def _rebind(self, names):
+        for n in names:
+            self.uses[n] = 0
+
+    def _is_key_var(self, name: str) -> bool:
+        return name in self.uses
+
+    def run(self):
+        # seed: parameters with key-ish names are keys
+        args = self.fn.args
+        for a in (args.posonlyargs + args.args + args.kwonlyargs):
+            nm = a.arg
+            if nm in _KEY_PARAM_NAMES or nm.endswith("_key") or \
+                    nm.endswith("_rng"):
+                self.uses[nm] = 0
+        self._walk(self.fn.body, loop_depth=0)
+        # closure consumption: a nested def reading the key counts as a
+        # use for FC402 (e.g. a weight-loader closure folding a base key)
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, FuncNode) and sub is not self.fn:
+                for nm in ast.walk(sub):
+                    if isinstance(nm, ast.Name) and \
+                            isinstance(nm.ctx, ast.Load):
+                        self.derived_used.add(nm.id)
+        # FC402: derived keys never consumed
+        for name, line in self.derived_at.items():
+            if name not in self.derived_used and \
+                    not name.startswith("_"):
+                self.findings.append(Finding(
+                    self.ctx.path, line, "FC402",
+                    f"PRNG derivation result '{name}' is never "
+                    f"consumed — the old key likely kept being used",
+                    self.qual))
+        return self.findings
+
+    # -- statement walking ---------------------------------------------
+    def _walk(self, stmts, loop_depth: int):
+        for st in stmts:
+            self._stmt(st, loop_depth)
+
+    def _stmt(self, st, loop_depth: int):
+        if isinstance(st, FuncNode + (ast.ClassDef,)):
+            return  # separate scope
+        if isinstance(st, ast.Assign):
+            self._consume_in(st.value, loop_depth)
+            self._handle_assign(st.targets, st.value)
+        elif isinstance(st, ast.AugAssign):
+            self._consume_in(st.value, loop_depth)
+        elif isinstance(st, ast.Expr):
+            # bare-expression derivation = dead result
+            call = st.value if isinstance(st.value, ast.Call) else None
+            if call is not None:
+                kind = _is_random_derive(call)
+                if kind in ("split", "fold_in"):
+                    self.findings.append(Finding(
+                        self.ctx.path, st.lineno, "FC402",
+                        f"`{dotted(call.func)}(...)` result discarded "
+                        f"— a split/fold_in that nobody consumes is "
+                        f"dead entropy", self.qual))
+            self._consume_in(st.value, loop_depth)
+        elif isinstance(st, (ast.If,)):
+            self._consume_in(st.test, loop_depth)
+            snap = dict(self.uses)
+            self._walk(st.body, loop_depth)
+            after_then = self.uses
+            self.uses = dict(snap)
+            self._walk(st.orelse, loop_depth)
+            after_else = self.uses
+            # a branch that cannot fall through (return/raise/continue/
+            # break) contributes nothing to the post-If state — its key
+            # consumptions never meet the code below the If
+            if _terminates(st.body):
+                after_then = snap
+            if _terminates(st.orelse):
+                after_else = snap
+            merged = {}
+            for k in set(after_then) | set(after_else):
+                merged[k] = max(after_then.get(k, 0),
+                                after_else.get(k, 0))
+            self.uses = merged
+        elif isinstance(st, (ast.For, ast.AsyncFor)):
+            self._consume_in(st.iter, loop_depth)
+            self._rebind(n for n in _target_names(st.target)
+                         if self._is_key_var(n))
+            self._walk(st.body, loop_depth + 1)
+            self._walk(st.orelse, loop_depth)
+        elif isinstance(st, ast.While):
+            self._consume_in(st.test, loop_depth)
+            self._walk(st.body, loop_depth + 1)
+            self._walk(st.orelse, loop_depth)
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            for item in st.items:
+                self._consume_in(item.context_expr, loop_depth)
+            self._walk(st.body, loop_depth)
+        elif isinstance(st, ast.Try):
+            self._walk(st.body, loop_depth)
+            for h in st.handlers:
+                self._walk(h.body, loop_depth)
+            self._walk(st.orelse, loop_depth)
+            self._walk(st.finalbody, loop_depth)
+        elif isinstance(st, ast.Return) and st.value is not None:
+            # returning a key hands ownership out — not a consumption
+            for name in _names_in(st.value):
+                self.derived_used.add(name)
+        else:
+            for child in ast.iter_child_nodes(st):
+                if isinstance(child, ast.expr):
+                    self._consume_in(child, loop_depth)
+
+    def _handle_assign(self, targets, value):
+        # any read of a derived key (aliasing, container store) counts
+        # as "used" for FC402 — only NEVER-read derivations are dead
+        for nm in _names_in(value):
+            self.derived_used.add(nm)
+        names = []
+        for t in targets:
+            names.extend(_target_names(t))
+        derive = _is_random_derive(value) \
+            if isinstance(value, ast.Call) else None
+        if derive:
+            # key(s) freshly derived: every target becomes a gen-0 key
+            self._rebind(names)
+            for n in names:
+                self.derived_at.setdefault(n, value.lineno)
+            return
+        # subscript of a key collection (keys[i]) is also a key
+        if isinstance(value, ast.Subscript):
+            base = value.value
+            if isinstance(base, ast.Name) and self._is_key_var(base.id):
+                self._rebind(names)
+                return
+        # plain rebinding kills key-ness of the target (it now holds
+        # something else); aliasing `k2 = key` copies the generation
+        if isinstance(value, ast.Name) and self._is_key_var(value.id):
+            for n in names:
+                self.uses[n] = self.uses.get(value.id, 0)
+            return
+        for n in names:
+            self.uses.pop(n, None)
+
+    def _consume_in(self, expr, loop_depth: int):
+        """Find key-variable consumptions inside an expression: the key
+        appearing as an ARGUMENT of a call that plausibly consumes
+        entropy (jax.random.* including split itself, compiled `*_j` /
+        `*_impl` dispatches, the op-apply machinery). Passing a key to a
+        metadata-only helper (shape snapshot, logging) is not counted —
+        precision over recall."""
+        if expr is None:
+            return
+        # ANY read of a derived key (zip iteration, container build,
+        # non-consuming helper) counts as "used" for FC402
+        for nm in _names_in(expr):
+            self.derived_used.add(nm)
+        for sub in ast.walk(expr):
+            if not isinstance(sub, ast.Call):
+                continue
+            if not _is_consuming_call(sub):
+                continue
+            derive = _is_random_derive(sub)
+            arg_names = []
+            for a in sub.args:
+                if isinstance(a, ast.Name):
+                    arg_names.append(a.id)
+            for kw in sub.keywords:
+                if isinstance(kw.value, ast.Name):
+                    arg_names.append(kw.value.id)
+            if derive in ("fold_in", "next_key"):
+                # fold_in derives an INDEPENDENT stream from the base
+                # key (the canonical per-step idiom: `k = fold_in(key,
+                # i)` each iteration) — it does not consume the base;
+                # only using the base in a SAMPLER (or after split)
+                # correlates streams. Mark reads for FC402 and move on.
+                for nm in arg_names:
+                    if self._is_key_var(nm):
+                        self.derived_used.add(nm)
+                continue
+            for nm in arg_names:
+                if not self._is_key_var(nm):
+                    continue
+                self.derived_used.add(nm)
+                count = self.uses.get(nm, 0) + 1
+                # inside a loop, a consumption of a key whose current
+                # generation was minted OUTSIDE the loop repeats every
+                # iteration — model by counting it twice
+                if loop_depth > 0 and not self._assigned_in_loop(nm, sub):
+                    count += 1
+                self.uses[nm] = count
+                if count >= 2:
+                    self.findings.append(Finding(
+                        self.ctx.path, sub.lineno, "FC401",
+                        f"PRNG key '{nm}' consumed again without an "
+                        f"intervening split — correlated randomness "
+                        f"(split the key per consumption)", self.qual))
+                    self.uses[nm] = -10**6  # report once per generation
+
+    def _assigned_in_loop(self, name: str, use_site) -> bool:
+        """Is `name` (re)assigned anywhere inside the innermost loop
+        containing use_site? Approximation: assigned inside ANY loop in
+        this function."""
+        for sub in ast.walk(self.fn):
+            if isinstance(sub, (ast.For, ast.While, ast.AsyncFor)):
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Assign):
+                        for t in inner.targets:
+                            if name in _target_names(t):
+                                return True
+                    if isinstance(inner, (ast.For, ast.AsyncFor)) and \
+                            inner is not sub:
+                        if name in _target_names(inner.target):
+                            return True
+        return False
+
+
+def _is_consuming_call(call: ast.Call) -> bool:
+    if _is_random_derive(call):
+        return True
+    head = dotted(call.func) or ""
+    tail = tail_of(head) or ""
+    if "random" in head:
+        return True
+    if tail.endswith(("_j", "_impl", "_fn")):
+        return True
+    return tail in ("apply", "apply_nodiff", "sample", "categorical")
+
+
+def _terminates(stmts) -> bool:
+    """Whether a branch body always leaves the enclosing suite."""
+    return bool(stmts) and isinstance(
+        stmts[-1], (ast.Return, ast.Raise, ast.Continue, ast.Break))
+
+
+def _target_names(t) -> List[str]:
+    out = []
+    for sub in ast.walk(t):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+    return out
+
+
+def _names_in(expr) -> List[str]:
+    return [n.id for n in ast.walk(expr)
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)]
+
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    owner_of = func_of_map(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            qual = owner_of.get(node.body[0] if node.body else node,
+                                node.name)
+            findings.extend(_FnAnalysis(node, ctx, qual).run())
+    return findings
+
+
+def setup(register):
+    register("prng", check, {
+        "FC401": "PRNG key consumed twice without an intervening split",
+        "FC402": "split/fold_in derivation whose result is never used",
+    })

@@ -1,0 +1,230 @@
+"""Recompilation-hazard rules (FC201/FC202): jit cache blowups.
+
+Hazard: ``jax.jit`` retraces whenever a static input changes and
+recompiles whenever traced input SHAPES change. Two syntactic patterns
+account for most cache blowups in practice:
+
+- a jitted callee uses a (non-static) Python argument as a shape or a
+  Python loop bound — ``range(n)``, ``jnp.zeros(n)``, ``x.reshape(n,
+  -1)``, ``lax.scan(..., length=n)``. If ``n`` arrives as a tracer the
+  trace fails; if callers "fix" that by passing plain ints, every new
+  value silently compiles a fresh program. The argument must either be
+  declared in ``static_argnums`` (capping the variant count by design)
+  or become a traced operand. Real example from this tree: the serving
+  engine buckets prompt lengths (``serving.py prompt_buckets``) exactly
+  so the jitted prefill sees a CAPPED set of static shapes — FC201
+  polices the uncapped version of that mistake.
+- ``jax.jit(...)`` called inside a ``for``/``while`` body mints a fresh
+  compiled callable (and cache entry) per iteration; hoist it or cache
+  it (cf. ``ServingEngine.__init__`` jitting once and reusing across
+  every step).
+- a kernel closure captures a per-call PRNG key instead of taking it as
+  an argument. This repo's compiled-segment cache
+  (``jit/partial_capture.py``) fingerprints closures BY CELL CONTENTS
+  (``_fp_fn`` → ``_fp_const`` → ``np.asarray(key).tobytes()``), so a
+  freshly-split key baked into a closure changes the fingerprint every
+  call: guaranteed cache miss, full retrace + recompile per call, plus
+  a host transfer inside the fingerprint itself. The repo's own
+  ``nn.functional.dropout`` documents the correct idiom — "key passes
+  as a positional arg (not a closure cell) so partial capture lifts it
+  into a segment input — stochastic segments stay cache-hittable
+  across calls". Real examples fixed under this rule: ``rrelu`` /
+  ``gumbel_softmax`` (nn/functional/activation.py), ``alpha_dropout``
+  / ``class_center_sample`` (nn/functional/common.py), ``bernoulli`` /
+  ``multinomial`` / ``poisson`` / ``binomial`` / ``standard_gamma``
+  (tensor/random.py).
+
+Rules:
+- FC201: non-static parameter of a jitted function used in a Python
+  shape/loop-bound position.
+- FC202: jit wrapping inside a loop body.
+- FC203: per-call PRNG key captured in a kernel closure instead of
+  passed as an argument.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from .core import Finding, FileContext
+from .scopes import (FuncNode, call_head, dotted, find_traced_scopes,
+                     func_of_map, tail_of, unwrap_partial, value_uses)
+
+# call tails whose FIRST positional argument is a shape / count
+_SHAPE_CALL_TAILS = {"zeros", "ones", "full", "empty", "arange",
+                     "broadcast_to", "tile", "eye", "range"}
+_LENGTH_KWARGS = {"length", "num", "axis_size", "shape", "total_repeat_length"}
+
+
+def _shape_position_uses(fn_node, params: Set[str]):
+    """Yield (param, call_node, desc) for params used where Python needs
+    a concrete int: range()/creation shapes/reshape args/scan length."""
+    for sub in ast.walk(fn_node):
+        if not isinstance(sub, ast.Call):
+            continue
+        head = dotted(sub.func)
+        tail = tail_of(head)
+        cands = []
+        if tail in _SHAPE_CALL_TAILS:
+            if sub.args:
+                cands.append((sub.args[0], f"`{tail}()` shape/bound"))
+        if isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr in ("reshape", "broadcast_to", "resize"):
+            for a in sub.args:
+                cands.append((a, f"`.{sub.func.attr}()` target shape"))
+        for kw in sub.keywords:
+            if kw.arg in _LENGTH_KWARGS:
+                cands.append((kw.value, f"`{kw.arg}=` of `{tail}()`"))
+        for expr, desc in cands:
+            # value_uses skips x.shape / len(x) — sizing a buffer from
+            # traced METADATA is fine; sizing from the VALUE is not
+            for nm in value_uses(expr, params):
+                yield nm.id, sub, desc
+
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    owner_of = func_of_map(tree)
+
+    # ---- FC201: shape-position use of a non-static jit param ----------
+    for scope in find_traced_scopes(tree):
+        if "jit" not in scope.reason:
+            continue
+        node = scope.node
+        if isinstance(node, ast.Lambda):
+            continue
+        params = set(scope.traced_params())
+        if not params:
+            continue
+        seen = set()
+        for pname, call, desc in _shape_position_uses(node, params):
+            key = (pname, call.lineno)
+            if key in seen:
+                continue
+            seen.add(key)
+            findings.append(Finding(
+                ctx.path, call.lineno, "FC201",
+                f"jitted callee '{scope.qualname}' uses arg '{pname}' "
+                f"as {desc}: a traced value cannot size a Python "
+                f"shape, and an un-static python int recompiles per "
+                f"value — add it to static_argnums or bucket it",
+                owner_of.get(call, scope.qualname)))
+
+    # ---- FC203: per-call PRNG key captured by an escaping closure -----
+    from .prng import _is_random_derive
+    for fn in [n for n in ast.walk(tree)
+               if isinstance(n, FuncNode)]:
+        key_vars: Set[str] = set()
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Call) and \
+                    _is_random_derive(sub.value):
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        key_vars.add(t.id)
+        if not key_vars:
+            continue
+        # names of nested defs that are handed to the COMPILED machinery
+        # (eager-only escapes — constructors, plain helpers — don't hit
+        # the segment cache and are fine to close over a key)
+        compiled_sinks = {"apply", "apply_nodiff", "jit", "pjit",
+                          "DecompAware", "checkpoint", "remat"}
+        escaping_names: Set[str] = set()
+        escaping_lambdas = []
+        for sub in ast.walk(fn):
+            if isinstance(sub, ast.Call) and \
+                    tail_of(dotted(sub.func)) in compiled_sinks:
+                for a in list(sub.args) + [kw.value
+                                           for kw in sub.keywords]:
+                    if isinstance(a, ast.Name):
+                        escaping_names.add(a.id)
+                    elif isinstance(a, ast.Lambda):
+                        escaping_lambdas.append(a)
+        for nested in ast.walk(fn):
+            is_lambda = isinstance(nested, ast.Lambda)
+            if not (is_lambda or
+                    (isinstance(nested, FuncNode) and nested is not fn)):
+                continue
+            if is_lambda:
+                if nested not in escaping_lambdas:
+                    continue
+                bound = {a.arg for a in nested.args.args}
+                body_nodes = ast.walk(nested.body)
+            else:
+                if nested.name not in escaping_names:
+                    continue
+                bound = {a.arg for a in nested.args.args}
+                for s in ast.walk(nested):
+                    if isinstance(s, (ast.Assign, ast.For)):
+                        for t in (s.targets
+                                  if isinstance(s, ast.Assign)
+                                  else [s.target]):
+                            for nm in ast.walk(t):
+                                if isinstance(nm, ast.Name):
+                                    bound.add(nm.id)
+                body_nodes = ast.walk(nested)
+            captured = sorted({
+                nm.id for nm in body_nodes
+                if isinstance(nm, ast.Name)
+                and isinstance(nm.ctx, ast.Load)
+                and nm.id in key_vars and nm.id not in bound})
+            if captured:
+                findings.append(Finding(
+                    ctx.path, nested.lineno, "FC203",
+                    f"kernel closure captures per-call PRNG key "
+                    f"'{captured[0]}' — the segment cache fingerprints "
+                    f"closure cells by content, so every call retraces "
+                    f"and recompiles; pass the key as a positional "
+                    f"argument instead (see nn.functional.dropout)",
+                    owner_of.get(nested, "")))
+
+    # ---- FC202: jit() inside a loop body ------------------------------
+    loops = [n for n in ast.walk(tree)
+             if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+    flagged: Set[int] = set()
+    for loop in loops:
+        # the accepted memoization idiom is exempt: the jit result is
+        # stored into a cache subscript (`cache[key] = jfn`) in the
+        # same loop, so iterations after the first reuse the callable
+        memoized: Set[str] = set()
+        for sub in ast.walk(loop):
+            if isinstance(sub, ast.Assign) and \
+                    isinstance(sub.value, ast.Name):
+                for t in sub.targets:
+                    if isinstance(t, ast.Subscript):
+                        memoized.add(sub.value.id)
+        for sub in ast.walk(loop):
+            if sub in (loop,) or not isinstance(sub, ast.Call):
+                continue
+            head = tail_of(call_head(sub))
+            is_jit = head in ("jit", "pjit")
+            if not is_jit:
+                inner = unwrap_partial(sub)
+                is_jit = inner is not None and \
+                    tail_of(call_head(inner)) in ("jit", "pjit")
+            if not is_jit or sub.lineno in flagged:
+                continue
+            parent_assign = next(
+                (a for a in ast.walk(loop) if isinstance(a, ast.Assign)
+                 and any(s is sub for s in ast.walk(a.value))), None)
+            if parent_assign is not None and any(
+                    isinstance(t, ast.Name) and t.id in memoized
+                    for t in parent_assign.targets):
+                continue
+            flagged.add(sub.lineno)
+            findings.append(Finding(
+                ctx.path, sub.lineno, "FC202",
+                "jax.jit called inside a loop body creates a fresh "
+                "compiled callable (and cache entry) every "
+                "iteration; hoist the jit out of the loop or cache "
+                "the wrapped callable",
+                owner_of.get(sub, "")))
+    return findings
+
+
+def setup(register):
+    register("recompile", check, {
+        "FC201": "non-static jit arg used as a Python shape/loop bound",
+        "FC202": "jax.jit wrapped inside a loop body",
+        "FC203": "per-call PRNG key captured in a kernel closure",
+    })

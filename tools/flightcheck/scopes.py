@@ -1,0 +1,413 @@
+"""Shared AST machinery: traced-scope discovery and value-taint walking.
+
+"Traced scope" = a function whose body runs under a JAX trace — the
+region where Python control flow on array values silently goes wrong.
+We find them syntactically: ``@jax.jit``-style decorations (including
+``partial(jax.jit, ...)``), functions passed by name (or inline lambda)
+into jit/grad/vmap/scan/cond/shard_map-style higher-order entry points,
+and any ``def`` nested inside one of those (its arguments bind tracers
+when the enclosing trace calls it).
+
+"Value use" = an expression position where the runtime VALUE of an
+array flows into Python — as opposed to static metadata. ``x.shape``,
+``x.dtype``, ``x.ndim``, ``len(x)``, ``isinstance(x, ...)`` and
+``x is None`` are static under tracing and never count; ``x + 1``,
+``x[i]``, ``x > 0`` do.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+# attributes of a traced array that are Python-static during tracing
+STATIC_ATTRS = {"shape", "dtype", "ndim", "size", "aval", "sharding",
+                "weak_type", "itemsize", "nbytes"}
+
+# call heads whose RESULT is static even on a traced argument
+STATIC_CALLS = {"len", "isinstance", "type", "id", "repr", "getattr",
+                "hasattr", "callable"}
+
+# higher-order entry points that trace their function argument(s).
+# matched on the dotted tail, so jax.lax.scan / lax.scan / plain scan
+# via `from jax.lax import scan` all hit.
+TRACING_HOF_TAILS = {
+    "jit", "pjit", "vmap", "pmap", "grad", "value_and_grad", "jacfwd",
+    "jacrev", "hessian", "linearize", "vjp", "jvp", "checkpoint",
+    "remat", "custom_vjp", "custom_jvp", "scan", "cond", "while_loop",
+    "fori_loop", "switch", "associative_scan", "shard_map", "eval_shape",
+    "make_jaxpr", "named_call", "map",
+}
+
+JIT_TAILS = {"jit", "pjit"}
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_head(call: ast.Call) -> Optional[str]:
+    return dotted(call.func)
+
+
+def tail_of(name: Optional[str]) -> Optional[str]:
+    return name.rsplit(".", 1)[-1] if name else None
+
+
+def unwrap_partial(call: ast.Call) -> Optional[ast.Call]:
+    """partial(jax.jit, **kw) -> synthetic view of the inner jit call
+    (returns the call node whose head is the partial'd function)."""
+    head = tail_of(call_head(call))
+    if head == "partial" and call.args:
+        inner = call.args[0]
+        inner_name = dotted(inner)
+        if inner_name and tail_of(inner_name) in TRACING_HOF_TAILS:
+            fake = ast.Call(func=inner, args=list(call.args[1:]),
+                            keywords=list(call.keywords))
+            return fake
+    return None
+
+
+def is_tracing_call(call: ast.Call) -> Optional[str]:
+    """Return the HOF tail name if this call traces a function arg."""
+    head = tail_of(call_head(call))
+    if head in TRACING_HOF_TAILS:
+        return head
+    inner = unwrap_partial(call)
+    if inner is not None:
+        return tail_of(call_head(inner))
+    return None
+
+
+def literal_int_collection(node: ast.AST) -> Optional[List]:
+    """Constant / tuple/list of constants -> python value, else None."""
+    try:
+        val = ast.literal_eval(node)
+    except (ValueError, TypeError, SyntaxError):
+        return None
+    if isinstance(val, (int, str)):
+        return [val]
+    if isinstance(val, (tuple, list, set)):
+        return list(val)
+    return None
+
+
+def static_arg_info(call: ast.Call) -> Tuple[Set[int], Set[str]]:
+    """static_argnums / static_argnames of a jit(...) call node."""
+    nums: Set[int] = set()
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnum"):
+            vals = literal_int_collection(kw.value) or []
+            nums.update(v for v in vals if isinstance(v, int))
+        elif kw.arg == "static_argnames":
+            vals = literal_int_collection(kw.value) or []
+            names.update(v for v in vals if isinstance(v, str))
+    return nums, names
+
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+class TracedScope:
+    """One function body believed to run under a JAX trace."""
+
+    def __init__(self, node, qualname: str, reason: str,
+                 static_nums: Set[int] = frozenset(),
+                 static_names: Set[str] = frozenset()):
+        self.node = node
+        self.qualname = qualname
+        self.reason = reason          # "jit-decorator" / "scan-callee"...
+        self.static_nums = set(static_nums)
+        self.static_names = set(static_names)
+
+    def traced_params(self) -> List[str]:
+        node = self.node
+        if isinstance(node, ast.Lambda):
+            args = node.args
+        else:
+            args = node.args
+        params = [a.arg for a in args.posonlyargs + args.args]
+        # a jitted BOUND method (`jax.jit(self._impl)`) counts argnums
+        # from the first non-self parameter
+        off = 1 if params and params[0] in ("self", "cls") else 0
+        out = []
+        for i, p in enumerate(params):
+            if p in ("self", "cls"):
+                continue
+            if (i - off) in self.static_nums or p in self.static_names:
+                continue
+            out.append(p)
+        out.extend(a.arg for a in args.kwonlyargs
+                   if a.arg not in self.static_names)
+        return out
+
+
+def _qualname_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """def/lambda node -> dotted qualname."""
+    out: Dict[ast.AST, str] = {}
+
+    def walk(node, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FuncNode):
+                q = f"{prefix}{child.name}"
+                out[child] = q
+                walk(child, q + ".")
+            elif isinstance(child, ast.ClassDef):
+                walk(child, f"{prefix}{child.name}.")
+            else:
+                walk(child, prefix)
+
+    walk(tree, "")
+    return out
+
+
+def find_traced_scopes(tree: ast.Module) -> List[TracedScope]:
+    qnames = _qualname_map(tree)
+    scopes: Dict[ast.AST, TracedScope] = {}
+
+    # method name -> def node per class, so `jax.jit(self._prefill_impl)`
+    # in __init__ resolves to the class's method
+    methods_of_class: Dict[ast.AST, Dict[str, ast.AST]] = {}
+    owner_class: Dict[ast.AST, ast.AST] = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            meths = {n.name: n for n in cls.body
+                     if isinstance(n, FuncNode)}
+            methods_of_class[cls] = meths
+            for n in meths.values():
+                for sub in ast.walk(n):
+                    owner_class[sub] = cls
+
+    # local def name -> node, per enclosing function/module body, so a
+    # `jax.jit(step)` call resolves `step` defined in the same scope
+    def local_defs(body_owner) -> Dict[str, ast.AST]:
+        defs = {}
+        for child in ast.iter_child_nodes(body_owner):
+            if isinstance(child, FuncNode):
+                defs[child.name] = child
+        return defs
+
+    def add(node, reason, static_nums=frozenset(),
+            static_names=frozenset()):
+        if node in scopes:
+            return
+        q = qnames.get(node, "<lambda>")
+        scopes[node] = TracedScope(node, q, reason, static_nums,
+                                   static_names)
+
+    def scan_owner(owner):
+        defs = local_defs(owner)
+        for sub in ast.walk(owner):
+            # decorated defs
+            if isinstance(sub, FuncNode):
+                for dec in sub.decorator_list:
+                    dec_call = dec if isinstance(dec, ast.Call) else None
+                    name = dotted(dec)
+                    if name and tail_of(name) in JIT_TAILS:
+                        add(sub, "jit-decorator")
+                    elif dec_call is not None:
+                        inner = unwrap_partial(dec_call)
+                        target = inner if inner is not None else dec_call
+                        tname = tail_of(call_head(target))
+                        if tname in TRACING_HOF_TAILS:
+                            nums, names = static_arg_info(target)
+                            add(sub, f"{tname}-decorator", nums, names)
+            if not isinstance(sub, ast.Call):
+                continue
+            hof = is_tracing_call(sub)
+            if not hof:
+                continue
+            inner = unwrap_partial(sub)
+            target = inner if inner is not None else sub
+            nums, names = static_arg_info(target)
+            for arg in target.args:
+                if isinstance(arg, ast.Lambda):
+                    add(arg, f"{hof}-callee", nums, names)
+                elif isinstance(arg, ast.Name) and arg.id in defs:
+                    add(defs[arg.id], f"{hof}-callee", nums, names)
+                elif isinstance(arg, ast.Attribute) and \
+                        isinstance(arg.value, ast.Name) and \
+                        arg.value.id == "self":
+                    cls = owner_class.get(sub)
+                    meth = methods_of_class.get(cls, {}) \
+                        .get(arg.attr) if cls is not None else None
+                    if meth is not None:
+                        add(meth, f"{hof}-callee", nums, names)
+
+    # scan the module plus every function body (each is a def-owner)
+    scan_owner(tree)
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            scan_owner(node)
+
+    # defs nested inside a traced scope are traced too (their params
+    # bind tracers when the enclosing trace calls them)
+    changed = True
+    while changed:
+        changed = False
+        for node in list(scopes):
+            for sub in ast.walk(node):
+                if isinstance(sub, FuncNode) and sub not in scopes:
+                    add(sub, "nested-in-traced")
+                    changed = True
+    return list(scopes.values())
+
+
+# -- value-use walking ------------------------------------------------------
+
+def value_uses(expr: ast.AST, tainted: Set[str]) -> List[ast.Name]:
+    """Name nodes from `tainted` that are used AS VALUES in expr.
+
+    Skips static contexts: x.shape/.dtype/..., len(x), isinstance(...),
+    `x is None` identity tests, and keyword names."""
+    hits: List[ast.Name] = []
+
+    def walk(node):
+        if isinstance(node, ast.Attribute):
+            if node.attr in STATIC_ATTRS:
+                return                      # x.shape — static
+            walk(node.value)
+            return
+        if isinstance(node, ast.Call):
+            head = tail_of(dotted(node.func))
+            if head in STATIC_CALLS:
+                return                      # len(x) / isinstance(x, T)
+            # method value: x.foo() uses x as value unless static attr
+            walk(node.func)
+            for a in node.args:
+                walk(a)
+            for kw in node.keywords:
+                walk(kw.value)
+            return
+        if isinstance(node, ast.Compare):
+            ops = node.ops
+            if all(isinstance(o, (ast.Is, ast.IsNot)) for o in ops):
+                return                      # x is None
+            walk(node.left)
+            for c in node.comparators:
+                walk(c)
+            return
+        if isinstance(node, ast.Name):
+            if isinstance(node.ctx, ast.Load) and node.id in tainted:
+                hits.append(node)
+            return
+        for child in ast.iter_child_nodes(node):
+            walk(child)
+
+    walk(expr)
+    return hits
+
+
+def assign_targets(node: ast.AST) -> List[str]:
+    """Flat names assigned by an Assign/AugAssign/AnnAssign/For/With."""
+    out: List[str] = []
+
+    def collect(t):
+        if isinstance(t, ast.Name):
+            out.append(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                collect(e)
+        elif isinstance(t, ast.Starred):
+            collect(t.value)
+
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            collect(t)
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        collect(node.target)
+    elif isinstance(node, ast.For):
+        collect(node.target)
+    elif isinstance(node, ast.withitem) and node.optional_vars:
+        collect(node.optional_vars)
+    return out
+
+
+def propagate_taint(fn_node, seed: Set[str]) -> Set[str]:
+    """Fixed-point name taint inside one function body: a name assigned
+    from an expression that value-uses a tainted name becomes tainted.
+    Nested defs are skipped (they get their own scope pass)."""
+    tainted = set(seed)
+
+    def stmts_of(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, FuncNode + (ast.Lambda,)):
+                continue
+            yield child
+            yield from stmts_of(child)
+
+    def for_loop_taints(node) -> Optional[List[str]]:
+        """Positional precision for `for a, b in zip(x, y)` /
+        `for i, v in enumerate(x)`: taint only the targets whose
+        corresponding iterable is tainted (a blanket rule would taint
+        the Python-static half of a zip over (arrays, flags))."""
+        it = node.iter
+        if not (isinstance(it, ast.Call) and
+                tail_of(dotted(it.func)) in ("zip", "enumerate")):
+            return None
+        srcs = list(it.args)
+        if tail_of(dotted(it.func)) == "enumerate":
+            srcs = [None] + srcs            # index is never tainted
+        tgt = node.target
+        if not isinstance(tgt, (ast.Tuple, ast.List)) or \
+                len(tgt.elts) != len(srcs):
+            return None
+        out = []
+        for elt, src in zip(tgt.elts, srcs):
+            if isinstance(elt, ast.Name) and src is not None and \
+                    value_uses(src, tainted):
+                out.append(elt.id)
+        return out
+
+    changed = True
+    while changed:
+        changed = False
+        for node in stmts_of(fn_node):
+            value = None
+            if isinstance(node, ast.Assign):
+                value = node.value
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                value = node.value
+            elif isinstance(node, ast.For):
+                precise = for_loop_taints(node)
+                if precise is not None:
+                    for name in precise:
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+                    continue
+                value = node.iter
+            if value is None:
+                continue
+            if value_uses(value, tainted):
+                for name in assign_targets(node):
+                    if name not in tainted:
+                        tainted.add(name)
+                        changed = True
+    return tainted
+
+
+def func_of_map(tree: ast.Module) -> Dict[ast.AST, str]:
+    """node -> qualname of the INNERMOST def containing it (for reports
+    and line-free baseline keys). One walk per module."""
+    out: Dict[ast.AST, str] = {}
+    qnames = _qualname_map(tree)
+
+    def walk(node, owner: str):
+        for child in ast.iter_child_nodes(node):
+            here = owner
+            if isinstance(child, FuncNode):
+                here = qnames.get(child, child.name)
+            out[child] = here
+            walk(child, here)
+
+    walk(tree, "")
+    return out

@@ -1,0 +1,141 @@
+"""Tracer-safety rules (FC101/FC102/FC103): Python control flow and host
+conversion on traced values inside a JAX trace.
+
+Hazard: inside ``@jax.jit`` / ``shard_map`` / ``lax.scan``-style scopes,
+array arguments are abstract tracers. ``if x > 0``, ``bool(x)``,
+``x.item()`` or ``np.asarray(x)`` either raises a
+``ConcretizationTypeError`` at trace time or — worse, when the value
+happens to be a concrete constant on the first trace — silently bakes
+one branch into the compiled program (the classic "works in the test,
+wrong in production" tracer leak). This repo's serving engine compiles
+every hot path (``ServingEngine.__init__`` wraps prefill/decode in
+``jax.jit``); a stray Python branch in one of those closures would
+freeze the first request's schedule into all later dispatches.
+
+Real example from this tree: ``paddle_tpu/inference/serving.py``'s
+``decode_chunk`` runs under ``jax.jit`` + ``lax.scan`` — every decision
+inside it (sampling, masking) is correctly expressed as ``jnp.where``;
+FC101 is the rule that keeps it that way.
+
+Rules:
+- FC101: ``if``/``while``/ternary/``assert`` condition value-uses a
+  traced argument (or a value derived from one).
+- FC102: explicit ``bool()``/``int()``/``float()`` cast of a traced
+  value.
+- FC103: host materialization of a traced value — ``.item()`` /
+  ``.tolist()`` / ``.numpy()`` or a ``np.*`` call on it.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .core import Finding, FileContext
+from .scopes import (FuncNode, dotted, find_traced_scopes, func_of_map,
+                     propagate_taint, tail_of, value_uses)
+
+_CAST_HEADS = {"bool", "int", "float", "complex"}
+_HOST_METHODS = {"item", "tolist", "numpy", "block_until_ready",
+                 "copy_to_host_async"}
+_NP_PREFIXES = ("np.", "numpy.")
+# np calls that are shape/metadata-only and safe on tracers' metadata
+_NP_SAFE_TAILS = {"dtype", "shape", "ndim"}
+
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    findings: List[Finding] = []
+    owner_of = func_of_map(tree)
+    for scope in find_traced_scopes(tree):
+        node = scope.node
+        params = set(scope.traced_params())
+        if not params:
+            continue
+        tainted = propagate_taint(node, params)
+
+        body = node.body if not isinstance(node, ast.Lambda) \
+            else [ast.Expr(node.body)]
+        for sub in _walk_same_scope(node):
+            qn = owner_of.get(sub, scope.qualname) or scope.qualname
+            # FC101: control flow on traced value
+            test = None
+            if isinstance(sub, (ast.If, ast.While)):
+                test = sub.test
+            elif isinstance(sub, ast.IfExp):
+                test = sub.test
+            elif isinstance(sub, ast.Assert):
+                test = sub.test
+            if test is not None:
+                hits = value_uses(test, tainted)
+                if hits:
+                    kind = type(sub).__name__.lower()
+                    findings.append(Finding(
+                        ctx.path, sub.lineno, "FC101",
+                        f"Python `{kind}` on traced value "
+                        f"'{hits[0].id}' inside jit scope "
+                        f"({scope.reason}); use jnp.where/lax.cond or "
+                        f"mark the argument static", qn))
+            if isinstance(sub, ast.Call):
+                head = dotted(sub.func)
+                tail = tail_of(head)
+                # FC102: bool(x)/int(x)/float(x)
+                if head in _CAST_HEADS and sub.args:
+                    hits = value_uses(sub.args[0], tainted)
+                    if hits:
+                        findings.append(Finding(
+                            ctx.path, sub.lineno, "FC102",
+                            f"`{head}()` cast of traced value "
+                            f"'{hits[0].id}' inside jit scope forces a "
+                            f"trace-time concretization", qn))
+                # FC103: .item()/.tolist()/np.* on traced value
+                elif (isinstance(sub.func, ast.Attribute)
+                      and sub.func.attr in _HOST_METHODS):
+                    hits = value_uses(sub.func.value, tainted)
+                    if hits:
+                        findings.append(Finding(
+                            ctx.path, sub.lineno, "FC103",
+                            f"`.{sub.func.attr}()` on traced value "
+                            f"'{hits[0].id}' inside jit scope is a "
+                            f"host sync / trace error", qn))
+                elif head and head.startswith(_NP_PREFIXES) \
+                        and tail not in _NP_SAFE_TAILS:
+                    hits = []
+                    for a in sub.args:
+                        hits = value_uses(a, tainted)
+                        if hits:
+                            break
+                    if hits:
+                        findings.append(Finding(
+                            ctx.path, sub.lineno, "FC103",
+                            f"`{head}()` applied to traced value "
+                            f"'{hits[0].id}' inside jit scope "
+                            f"materializes on host; use the jnp "
+                            f"equivalent", qn))
+    # dedupe (nested traced scopes can visit the same node twice)
+    seen = set()
+    out = []
+    for f in findings:
+        key = (f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+def _walk_same_scope(fn_node):
+    """Walk a function body but do NOT descend into nested defs — they
+    are separate traced scopes with their own parameters."""
+    stack = list(ast.iter_child_nodes(fn_node))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, FuncNode + (ast.Lambda,)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def setup(register):
+    register("tracer_safety", check, {
+        "FC101": "Python if/while/assert on a traced value in jit scope",
+        "FC102": "bool/int/float cast of a traced value in jit scope",
+        "FC103": "host materialization (.item/np.*) of a traced value",
+    })

@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# One-command CI gate: static analysis + runtime serving invariants +
+# tier-1 pytest. Exits non-zero on ANY finding or test failure.
+#
+#   tools/run_checks.sh            # everything
+#   tools/run_checks.sh --fast     # skip the tier-1 pytest sweep
+#
+# Phases:
+#   1. flightcheck over paddle_tpu/ (AST rules FC1xx-FC5xx, committed
+#      baseline; see tools/flightcheck/ and README "Static analysis")
+#   2. flightcheck --jaxpr: trace the serving/paged-decode entry points
+#      and cross-check the AST verdicts + IR-level PRNG audit
+#   3. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
+#      serving-path tests; includes its own inference/ flightcheck)
+#   4. tier-1 pytest (tests/, -m 'not slow')
+set -u -o pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
+rc=0
+
+echo "== [1/4] flightcheck: static analysis over paddle_tpu/ =="
+python -m tools.flightcheck paddle_tpu/ || rc=1
+
+echo "== [2/4] flightcheck --jaxpr: entry-point cross-check =="
+python -m tools.flightcheck --jaxpr paddle_tpu/inference/ || rc=1
+
+echo "== [3/4] serving invariants (runtime debug_check gate) =="
+python tools/check_serving_invariants.py || rc=1
+
+if [ "${1:-}" != "--fast" ]; then
+    echo "== [4/4] tier-1 pytest =="
+    python -m pytest tests/ -q -m 'not slow' \
+        --continue-on-collection-errors -p no:cacheprovider \
+        -p no:randomly || rc=1
+else
+    echo "== [4/4] tier-1 pytest skipped (--fast) =="
+fi
+
+if [ "$rc" -ne 0 ]; then
+    echo "run_checks: FAILED"
+else
+    echo "run_checks: all gates green"
+fi
+exit "$rc"
