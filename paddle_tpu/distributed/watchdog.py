@@ -32,8 +32,8 @@ from typing import Callable, Dict, List, Optional
 
 from ..utils.flags import define_flag, FLAGS
 
-__all__ = ["StepWatchdog", "watch_section", "get_default_watchdog",
-           "enable_watchdog", "notify_step"]
+__all__ = ["StepWatchdog", "watch_section", "watch_engine",
+           "get_default_watchdog", "enable_watchdog", "notify_step"]
 
 define_flag("enable_watchdog", False,
             "start the step/comm watchdog on first TrainStep call")
@@ -51,12 +51,17 @@ class StepWatchdog:
     def __init__(self, timeout: Optional[float] = None,
                  poll_interval: float = 1.0,
                  on_hang: Optional[Callable[[str], None]] = None,
-                 dump_path: Optional[str] = None):
+                 dump_path: Optional[str] = None,
+                 extra_dump: Optional[Callable[[io.StringIO],
+                                              None]] = None):
         self.timeout = float(timeout if timeout is not None
                              else FLAGS.watchdog_timeout_s)
         self.poll_interval = poll_interval
         self.on_hang = on_hang
         self.dump_path = dump_path or (FLAGS.watchdog_dump_path or None)
+        # optional domain-specific section of the hang report (e.g.
+        # watch_engine appends the serving engine's scheduler state)
+        self.extra_dump = extra_dump
         self._lock = threading.Lock()
         self._last_beat = time.monotonic()
         self._step = 0
@@ -134,6 +139,11 @@ class StepWatchdog:
         for name, t0, _ in active:
             buf.write(f"  active section: {name!r} ({now - t0:.1f}s)\n")
         self._dump_env(buf)
+        if self.extra_dump is not None:
+            try:
+                self.extra_dump(buf)
+            except Exception as e:           # noqa: BLE001
+                buf.write(f"(extra dump failed: {e})\n")
         buf.write("---- python thread stacks ----\n")
         frames = sys._current_frames()
         for tid, frame in frames.items():
@@ -221,6 +231,45 @@ def notify_step(step: Optional[int] = None):
     wd = get_default_watchdog()
     if wd is not None:
         wd.notify_step(step)
+
+
+def watch_engine(engine, timeout: Optional[float] = None,
+                 poll_interval: float = 1.0,
+                 on_hang: Optional[Callable[[str], None]] = None,
+                 dump_path: Optional[str] = None) -> StepWatchdog:
+    """Wrap a ServingEngine's step() with the stall detector (ISSUE 4
+    satellite): a dedicated StepWatchdog whose hang report includes the
+    engine's scheduler snapshot — per-request states, queue/pipeline
+    depth, robustness counters and KV-pool occupancy (debug_dump) —
+    on top of the usual thread stacks and device state.
+
+    Each step() runs inside a watched section (a single WEDGED step —
+    e.g. a dispatch that never returns through a dead tunnel — is
+    reported with its age even though the step never completed) and
+    bumps the heartbeat on completion, so "engine alive but stuck" and
+    "engine not being stepped" both trip after `timeout` seconds.
+
+    Returns the started watchdog; call .stop() to detach monitoring
+    (the step wrapper stays installed but becomes inert sections)."""
+
+    def _dump(buf: io.StringIO):
+        # debug_dump() opens with its own "serving engine state:" header
+        buf.write(engine.debug_dump())
+
+    wd = StepWatchdog(timeout=timeout, poll_interval=poll_interval,
+                      on_hang=on_hang, dump_path=dump_path,
+                      extra_dump=_dump)
+    inner = engine.step
+
+    def step():
+        with wd.section("ServingEngine.step"):
+            out = inner()
+        wd.notify_step()
+        return out
+
+    engine.step = step
+    engine._step_watchdog = wd
+    return wd.start()
 
 
 def watch_section(name: str, timeout: Optional[float] = None):

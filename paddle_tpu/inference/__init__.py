@@ -18,11 +18,12 @@ import numpy as np
 
 from .gpt_decode import PagedGPTDecoder  # noqa: F401
 from .paged_decode import PagedLlamaDecoder  # noqa: F401
-from .serving import Request, SamplingParams, ServingEngine  # noqa: F401
+from .serving import (EngineOverloaded, Request, SamplingParams,  # noqa: F401
+                      ServingEngine)
 
 __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
            "PlaceType", "ServingEngine", "SamplingParams", "Request",
-           "PagedLlamaDecoder", "PagedGPTDecoder"]
+           "EngineOverloaded", "PagedLlamaDecoder", "PagedGPTDecoder"]
 
 
 class PrecisionType:

@@ -156,7 +156,16 @@ class PagedGPTDecoder:
         over [gathered prefix pages ++ suffix]."""
         cfg = self.cfg
         b, s = ids.shape
-        positions = jnp.arange(s)[None] + n_cached[:, None]    # [b, s]
+        # clamp: a recompute tail chunk (preemption resume) right-pads
+        # to the chunk width, so pad positions can exceed
+        # max_position_embeddings — jnp.take's out-of-bounds default is
+        # FILL (NaN), and one NaN pad key poisons the whole chunk's
+        # attention through 0 * NaN even though pad columns are masked.
+        # Clamped pad embeddings are junk, but pad K/V aim at the
+        # scratch page and pad outputs are discarded, so junk is inert.
+        positions = jnp.minimum(
+            jnp.arange(s)[None] + n_cached[:, None],
+            cfg.max_position_embeddings - 1)               # [b, s]
         h = (jnp.take(weights["embed"], ids, axis=0)
              + jnp.take(weights["pos"], positions, axis=0))
         if self.weights["embed"].dtype != jnp.float32:

@@ -540,7 +540,13 @@ class PagedLlamaDecoder:
         cfg = self.cfg
         b, s = ids.shape
         h = jnp.take(weights["embed"], ids, axis=0)
-        positions = jnp.arange(s)[None] + n_cached[:, None]   # [b, s]
+        # clamp like the GPT twin: a recompute tail chunk's pad
+        # positions can pass max_position_embeddings; the RoPE table
+        # gather would clamp implicitly, but the bound is part of the
+        # program's contract — make it explicit
+        positions = jnp.minimum(
+            jnp.arange(s)[None] + n_cached[:, None],
+            cfg.max_position_embeddings - 1)              # [b, s]
         flat = slots.reshape(-1)
         for li, w in enumerate(weights["layers"]):
             hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)

@@ -67,6 +67,29 @@ width-1 no-sample chunk programs when chunked prefill is on):
 Weight-only int8 (weight_dtype="int8") stores matmul weights as
 per-channel int8 + scale — decode is HBM-bandwidth-bound, so halving
 weight bytes is the serving-side quantization that actually pays on TPU.
+
+Fault tolerance (ISSUE 4 — the runtime analogue of flightcheck):
+failures are absorbed at REQUEST granularity; step() never raises on a
+per-request fault and the pool invariant holds through every recovery.
+- deadlines/cancel: SamplingParams.deadline_s + cancel(req_id) move a
+  request to a terminal ABORTED state from any live stage, unwinding
+  splice-pending hash registrations, restarting dependent readers and
+  freeing pages only once no in-flight chunk references them.
+- preemption-with-recompute: admission="optimistic" oversubscribes the
+  pool (prefill pages only); KV pressure preempts the newest/lowest-
+  priority running request, whose generated history re-prefills through
+  the NO-SAMPLE chunk programs (no PRNG key drawn — the engine key
+  stream is untouched, so greedy outputs are token-identical) riding
+  the prefix cache for near-zero recompute on hits. Epoch guards drop a
+  preempted life's in-flight tokens at collection.
+- bounded retry: every dispatch/fetch goes through _device_call —
+  exponential-backoff retries re-issue the SAME call (same key), then
+  fail the involved requests with a structured Request.error.
+- overload shedding: add_request raises EngineOverloaded on the queue
+  cap or when backlog/rate math says a deadline cannot be met.
+- chaos: utils/chaos.ChaosMonkey injects seeded allocator OOMs,
+  dispatch/collect faults and latency spikes at the sanctioned hooks;
+  tools/chaos_serving.py gates token-identity under fault schedules.
 """
 from __future__ import annotations
 
@@ -82,9 +105,32 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
+from ..ops.paged_attention import KVCacheExhausted
 from .paged_decode import PagedLlamaDecoder
 
-__all__ = ["SamplingParams", "Request", "ServingEngine"]
+__all__ = ["EngineOverloaded", "SamplingParams", "Request",
+           "ServingEngine"]
+
+
+class EngineOverloaded(RuntimeError):
+    """Typed admission rejection (overload shedding): the queue-depth x
+    deadline estimate says the request cannot meet its deadline, or the
+    hard queue-depth cap is hit. Raised by add_request BEFORE the
+    request is queued, so the caller can retry elsewhere / later —
+    rejecting at admission is cheaper than burning pool capacity on a
+    request that will be dead on arrival."""
+
+
+class _DispatchFailed(Exception):
+    """Internal: a device dispatch/fetch exhausted its retry budget.
+    Carries the site kind and the last underlying exception; converted
+    by the call site into structured per-request failures (the engine
+    itself never dies on a dispatch error)."""
+
+    def __init__(self, kind: str, cause: BaseException):
+        super().__init__(f"{kind}: {cause!r}")
+        self.kind = kind
+        self.cause = cause
 
 
 @dataclass
@@ -102,6 +148,15 @@ class SamplingParams:
     top_k: Optional[int] = None
     top_p: float = 1.0
     repetition_penalty: float = 1.0
+    # -- fault-tolerance surface ------------------------------------------
+    # deadline_s: wall-clock budget from submit; a request past it is
+    # ABORTED (partial tokens kept, deadline_misses counted) and — when
+    # the engine can already tell at admission that the deadline cannot
+    # be met — shed with EngineOverloaded instead of queued.
+    deadline_s: Optional[float] = None
+    # priority: higher survives longer under KV pressure (preemption
+    # victims are picked lowest-priority-first, newest-first on ties)
+    priority: int = 0
 
     @property
     def needs_rich_sampling(self) -> bool:
@@ -121,10 +176,29 @@ class Request:
     t_admit: Optional[float] = None       # slot claimed (queue wait ends)
     t_first_token: Optional[float] = None
     t_done: Optional[float] = None
-    state: str = "queued"         # queued | prefilling | running | done
+    # queued | prefilling | running | done, plus the terminal fault
+    # states: aborted (cancel/deadline — partial tokens kept) and
+    # failed (dispatch error after retries — structured `error` set)
+    state: str = "queued"
+    error: Optional[str] = None   # why the request aborted/failed
     # tokens DISPATCHED (prefill + scheduled decode steps) — may exceed
     # len(out_tokens) while a chunk is in flight or after an EOS cut
     planned: int = 0
+    # -- preemption-with-recompute ----------------------------------------
+    # resume: the request was preempted while RUNNING; on re-admission
+    # its prefill source is prompt ++ out_tokens[:-1] (the generated
+    # history re-enters the pool via no-sample chunks — no PRNG key is
+    # consumed, so the engine's key stream is untouched) and decode
+    # resumes from out_tokens[-1] without re-sampling anything.
+    resume: bool = False
+    # ctx: the token array the CURRENT allocation's prefill reads
+    # (prompt for a fresh admission, prompt ++ out_tokens[:-1] for a
+    # resume) — set by _admit, None while queued
+    ctx: Optional[np.ndarray] = None
+    # epoch: bumped every time the request loses its slot (preemption,
+    # restart); in-flight chunks record the epoch they were scheduled
+    # against so collection can drop results from a previous life
+    epoch: int = 0
     # -- chunked-prefill progress (valid from admission) ------------------
     n_cached: int = 0             # prompt tokens spliced from the cache
     prefill_sent: int = 0         # suffix tokens DISPATCHED so far
@@ -140,9 +214,21 @@ class Request:
     t_last_emit: Optional[float] = None
 
     @property
+    def prefill_tokens(self) -> np.ndarray:
+        """The token array the current prefill reads: the prompt, or
+        prompt ++ generated history for a preemption resume."""
+        return self.ctx if self.ctx is not None else self.prompt
+
+    @property
     def suffix_len(self) -> int:
-        """Prompt tokens that must actually prefill (past the splice)."""
-        return int(self.prompt.size) - self.n_cached
+        """Prefill tokens that must actually run (past the splice)."""
+        return int(len(self.prefill_tokens)) - self.n_cached
+
+    @property
+    def deadline_at(self) -> Optional[float]:
+        if self.sampling.deadline_s is None:
+            return None
+        return self.t_submit + self.sampling.deadline_s
 
     @property
     def ttft_s(self) -> Optional[float]:
@@ -195,7 +281,11 @@ class ServingEngine:
                  chunk_schedule: Optional[Sequence[int]] = None,
                  prefix_caching: bool = True,
                  prefill_chunk: Optional[int] = 256,
-                 prefill_budget: Optional[int] = None):
+                 prefill_budget: Optional[int] = None,
+                 max_dispatch_retries: int = 2,
+                 retry_backoff_s: float = 0.05,
+                 admission: str = "worst_case",
+                 max_queue_depth: Optional[int] = None):
         from .gpt_decode import PagedGPTDecoder
         if isinstance(model, (PagedLlamaDecoder, PagedGPTDecoder)):
             # a prebuilt paged decoder (e.g. PagedLlamaDecoder
@@ -259,6 +349,38 @@ class ServingEngine:
         # is the running streams' worst-case added inter-token latency
         self.prefill_budget = max(1, int(prefill_budget)) \
             if prefill_budget else (self.prefill_chunk or 0)
+        # -- fault tolerance ------------------------------------------------
+        # bounded retry with exponential backoff around every device
+        # dispatch/fetch: a transient error re-tries the SAME call
+        # (same args, same PRNG key — token-identical on success);
+        # exhaustion fails the involved requests, never the engine.
+        self.max_dispatch_retries = max(0, int(max_dispatch_retries))
+        self.retry_backoff_s = float(retry_backoff_s)
+        # admission policy: "worst_case" reserves prompt+max_new pages
+        # up front (a running request can never hit pool exhaustion —
+        # the PR-1 invariant); "optimistic" reserves only the prefill's
+        # pages and grows on demand, oversubscribing the pool — under
+        # pressure the engine preempts the newest/lowest-priority
+        # running request (frees its blocks, re-enqueues it as a
+        # no-sample chunked re-prefill that rides the prefix cache).
+        if admission not in ("worst_case", "optimistic"):
+            raise ValueError(
+                f"admission must be 'worst_case' or 'optimistic', "
+                f"got {admission!r}")
+        self.admission = admission
+        self.max_queue_depth = (int(max_queue_depth)
+                                if max_queue_depth is not None else None)
+        # robustness counters (stats(); reset by clear_finished)
+        self.preemptions = 0
+        self.recompute_tokens = 0
+        self.aborted = 0
+        self.failed = 0
+        self.deadline_misses = 0
+        self.shed_requests = 0
+        self.retries = 0
+        # optional chaos monkey (utils/chaos.py ChaosMonkey.attach):
+        # consulted by _device_call before every dispatch/fetch
+        self.chaos = None
         # static prefix-gather width: a hit prefix is < the prompt, and
         # prompts are bounded by the largest bucket
         self._prefix_pages = -(-self.buckets[-1] // cache.block_size)
@@ -275,6 +397,24 @@ class ServingEngine:
             self._prefix_page_buckets.append(p)
             p *= 2
         self._prefix_page_buckets.append(self._prefix_pages)
+        # recompute prefills (preemption resume) run at offsets up to
+        # prompt + generated history — past the largest prompt bucket —
+        # so the mid-chunk prefix ladder continues doubling up to the
+        # longest table a single sequence can hold. Entries after
+        # _prefix_pages are only ever reached by resumes, so the
+        # pre-existing bucket choices (and compiled variants) of the
+        # normal chunked-prefill path are unchanged.
+        cap_pages = min(self.dec.max_pages,
+                        max(1, cache.num_blocks - 1))
+        while p < cap_pages and self._prefix_page_buckets[-1] < cap_pages:
+            if p > self._prefix_page_buckets[-1]:
+                self._prefix_page_buckets.append(min(p, cap_pages))
+            p *= 2
+        if self._prefix_page_buckets[-1] < cap_pages:
+            self._prefix_page_buckets.append(cap_pages)
+        # chunk width for preemption-resume prefills: ride the chunked-
+        # prefill programs when enabled, else a dedicated 64-wide rung
+        self._recompute_chunk = self.prefill_chunk or 64
         self._debug_pool = os.environ.get(
             "PADDLE_TPU_POOL_DEBUG", "") not in ("", "0")
 
@@ -382,13 +522,17 @@ class ServingEngine:
         self._decode_rich_j = jax.jit(decode_chunk_rich,
                                       donate_argnums=(1, 2))
         self._merge_first_j = jax.jit(merge_first)
-        if self.prefill_chunk:
+        if hasattr(dec, "_prefill_chunk_impl"):
             # no-sample chunk programs (width 1, exactly prefill_chunk
             # tokens; prefill_mid retraces per power-of-two prefix-
             # width bucket — ~log2(prefix_pages) variants — plus one
             # cold-start prefill_mid0): mid chunks only write K/V, so
             # the wrappers drop the logits and XLA DCEs the head
-            # matmul; no PRNG key is consumed
+            # matmul; no PRNG key is consumed. Built even with chunked
+            # prefill OFF: preemption-with-recompute re-prefills a
+            # preempted request's history through these (the resume
+            # must not draw PRNG keys, or every other request's
+            # sampled stream would shift vs a fault-free run).
             def prefill_mid(weights, k, v, ids, slots, n_cached, ptab):
                 return dec._prefill_chunk_impl(weights, k, v, ids,
                                                slots, n_cached, ptab)
@@ -401,6 +545,7 @@ class ServingEngine:
                                           donate_argnums=(1, 2))
             self._prefill_mid0_j = jax.jit(prefill_mid0,
                                            donate_argnums=(1, 2))
+        self._can_recompute = hasattr(dec, "_prefill_chunk_impl")
 
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
@@ -460,6 +605,332 @@ class ServingEngine:
         self._key, k = jax.random.split(self._key)
         return k
 
+    # -- fault tolerance -----------------------------------------------------
+    def _device_call(self, kind: str, fn, *args):
+        """Every device dispatch/fetch routes through here: the chaos
+        injection point plus bounded retry with exponential backoff.
+        A transient error (injected or a flaky device/link) re-invokes
+        the SAME call — args unchanged, PRNG key already baked in, so a
+        successful retry is token-identical to a clean first try.
+        Allocator exhaustion passes straight through (it is handled by
+        preemption, not retry); anything else that survives the retry
+        budget surfaces as _DispatchFailed for the call site to turn
+        into structured per-request failures.
+
+        Caveat: a REAL device error raised after the runtime consumed
+        a donated pool buffer can leave cache.k/v unusable — the engine
+        then fails subsequent requests too, but never raises out of
+        step(). The chaos harness always injects BEFORE the underlying
+        call, so injected faults are guaranteed retry-safe."""
+        attempt = 0
+        while True:
+            try:
+                if self.chaos is not None:
+                    self.chaos.before_call(self, kind)
+                return fn(*args)
+            except KVCacheExhausted:
+                raise
+            except Exception as e:          # noqa: BLE001 — fault wall
+                if attempt >= self.max_dispatch_retries:
+                    raise _DispatchFailed(kind, e) from e
+                attempt += 1
+                self.retries += 1
+                if self.retry_backoff_s > 0:
+                    time.sleep(self.retry_backoff_s
+                               * (2 ** (attempt - 1)))
+
+    def cancel(self, req_id: int) -> bool:
+        """Explicitly abort a request in ANY live state: queued (just
+        dequeued), prefilling (allocation unwound — splice-pending
+        hashes invalidated, dependent readers restarted, blocks freed)
+        or running (partial tokens kept; pages freed once no in-flight
+        chunk references them). Returns False if the request is already
+        terminal; raises KeyError for an unknown id."""
+        req = self._find_request(req_id)
+        if req is None:
+            raise KeyError(f"unknown req_id {req_id}")
+        if req.state in ("done", "aborted", "failed"):
+            return False
+        self._abort_request(req, "cancelled")
+        return True
+
+    def _find_request(self, req_id: int) -> Optional[Request]:
+        if req_id in self._done:
+            return self._done[req_id]
+        for r in self._slots:
+            if r is not None and r.req_id == req_id:
+                return r
+        for r in self._queue:
+            if r.req_id == req_id:
+                return r
+        return None
+
+    def _enforce_deadlines(self):
+        """Abort every live request past its wall-clock deadline (the
+        terminal state is ABORTED with error='deadline...'; partial
+        tokens are kept — a caller that can use a truncated answer
+        still gets one)."""
+        now = time.perf_counter()
+        expired = [r for r in list(self._queue)
+                   + [s for s in self._slots if s is not None]
+                   if r.deadline_at is not None and now > r.deadline_at]
+        for req in expired:
+            self.deadline_misses += 1
+            self._abort_request(
+                req, f"deadline exceeded "
+                     f"({req.sampling.deadline_s:.3f}s budget)")
+
+    def _estimate_completion_s(self, sp: SamplingParams
+                               ) -> Optional[float]:
+        """Admission-time completion estimate for overload shedding:
+        backlog tokens (queued + running remainders + the candidate's
+        own budget) over the engine's measured aggregate token rate.
+        None until the engine has produced enough traffic to have a
+        rate — cold engines never shed on deadline math."""
+        busy = self.time_prefill_s + self.time_stall_s + self.time_host_s
+        if self.generated_tokens < 8 or busy <= 0:
+            return None
+        rate = self.generated_tokens / busy
+        backlog = sum(r.sampling.max_new_tokens - len(r.out_tokens)
+                      for r in self._queue)
+        backlog += sum(r.sampling.max_new_tokens - len(r.out_tokens)
+                       for r in self._slots if r is not None)
+        return (backlog + sp.max_new_tokens) / rate
+
+    def _pick_victim(self, exclude=()) -> Optional[Request]:
+        """Preemption victim under KV pressure: lowest priority first,
+        newest req_id on ties — so the oldest highest-priority request
+        always makes progress (no preemption livelock). Running
+        requests are preferred victims (their blocks free the most);
+        prefilling ones only when no running victim exists."""
+        if not self._can_recompute:
+            return None
+        for states in (("running",), ("prefilling",)):
+            cands = [r for r in self._slots
+                     if r is not None and r.state in states
+                     and r not in exclude]
+            if cands:
+                return max(cands, key=lambda r: (-r.sampling.priority,
+                                                 r.req_id))
+        return None
+
+    def _preempt(self, victim: Request):
+        """Preemption-with-recompute: evict `victim` from its slot,
+        free its blocks back to the pool NOW (safe: any in-flight chunk
+        touching them was dispatched earlier, and device program order
+        runs it before any later program that could reuse the pages;
+        collection drops the victim's in-flight tokens via the epoch
+        guard), and re-enqueue it at the queue front. A RUNNING victim
+        resumes by re-prefilling prompt ++ generated history through
+        the no-sample chunk programs — full prompt blocks usually park
+        in the prefix-cache LRU at free and splice straight back in,
+        so recompute cost is near zero on hits. A PREFILLING victim
+        restarts its prefill from scratch."""
+        self.preemptions += 1
+        self._evict_to_queue(victim)
+        self._requeue_front([victim])
+
+    def _evict_to_queue(self, req: Request):
+        """Evict a live slotted request back to a fresh queued life:
+        bump the epoch (collection drops the old life's in-flight
+        tokens), vacate the slot, unwind/free the old allocation, and
+        reset all per-life prefill progress. The unwind runs while the
+        old coverage (n_cached/prefill_sent/deps) is still intact —
+        a RUNNING request's fully-dispatched prefill lets reader deps
+        prune as met BEFORE the reset below could spuriously re-arm
+        them against the next life. The free is always IMMEDIATE (safe
+        by device program order: every in-flight chunk touching the
+        pages was dispatched earlier) — deferring it to collection
+        while the request re-enters the queue would let the next
+        _admit re-allocate its seq before the free lands and raise out
+        of step(). The caller requeues."""
+        req.epoch += 1
+        si = req.slot
+        if si is not None:
+            self._slots[si] = None
+            self._fresh_slots.discard(si)
+        req.slot = None
+        if req.state == "prefilling":
+            self._unwind_alloc(req, immediate=True)
+        else:
+            self._restart_dependent_readers(req)
+            self.dec.cache.free(req.req_id)
+        req.resume = bool(req.out_tokens)
+        req.state = "queued"
+        req.planned = len(req.out_tokens)
+        req.n_cached = 0
+        req.prefill_sent = 0
+        req.deps = []
+        req.pending_blocks = []
+        req.ctx = None
+
+    def _extend_with_preempt(self, req: Request, exclude=()) -> int:
+        """cache.extend with pressure relief: on exhaustion, preempt
+        the policy victim (lowest priority first, newest on ties —
+        see _pick_victim; no age constraint relative to `req` itself)
+        and retry. `req` stays in the victim pool — when IT is the
+        chosen victim the exhaustion propagates and the caller FAILS
+        `req` (both callers, _dispatch_mid and _dispatch_final,
+        convert it to a terminal failed state)."""
+        while True:
+            try:
+                return self.dec.cache.extend(req.req_id)
+            except KVCacheExhausted:
+                victim = self._pick_victim(exclude=tuple(exclude))
+                if victim is None or victim is req:
+                    raise
+                self._preempt(victim)
+
+    def _requeue_front(self, reqs: Sequence[Request]):
+        """Put preempted/restarted requests back into the queue in
+        global req_id order. Arrivals enter the queue in req_id order,
+        so re-sorting the whole queue keeps FIFO fairness while placing
+        every evicted request ahead of anything that arrived after it —
+        including requests requeued by EARLIER calls (a blind
+        front-prepend would let a newer victim jump an older restarted
+        request and starve it under sustained pressure)."""
+        if not reqs:
+            return
+        merged = sorted(list(self._queue) + list(reqs),
+                        key=lambda r: r.req_id)
+        self._queue.clear()
+        self._queue.extend(merged)
+
+    def _unwind_alloc(self, req: Request, immediate: bool = False):
+        """Safely unwind a PREFILLING request's allocation:
+        1. invalidate hash registrations of its own full prefill blocks
+           whose covering chunk was never dispatched (their registered
+           content will never exist — a later splice would read junk);
+        2. drop its splice-pending writer entries;
+        3. restart any reader still waiting on those unwritten blocks
+           (the reader spliced physical blocks this request will now
+           never write — its allocation is unwound recursively and it
+           re-enters the queue);
+        4. free the blocks (immediately for preemption — the caller
+           needs them NOW; otherwise after the newest in-flight chunk,
+           like _retire)."""
+        cache = self.dec.cache
+        bs = cache.block_size
+        covered = req.n_cached + req.prefill_sent
+        try:
+            table = cache.seq_blocks(req.req_id)
+        except KeyError:
+            table = None
+        if table is not None:
+            own_uncovered = [
+                table[j]
+                for j in range(req.n_cached // bs,
+                               len(req.prefill_tokens) // bs)
+                if (j + 1) * bs > covered and j < len(table)]
+            cache.unregister_block_hashes(own_uncovered)
+        self._clear_pending_writes(req)
+        self._restart_dependent_readers(req)
+        if table is not None:
+            if immediate or not self._inflight:
+                cache.free(req.req_id)
+            else:
+                self._inflight[-1]["free_after"].append(req.req_id)
+
+    def _restart_dependent_readers(self, writer: Request):
+        """Resolve every splice dependency on `writer` against its
+        CURRENT dispatch coverage, BEFORE that coverage is rolled back
+        by preemption/unwind: met deps reference chunks that were
+        really dispatched and will execute regardless of what happens
+        to the writer now — they are PRUNED here (left in place, a met
+        dep would spuriously re-arm against the writer's next life,
+        whose prefill_sent restarts at 0 with different blocks and a
+        possibly shorter suffix — the reader would stall forever).
+        Readers with UNMET deps spliced blocks the writer will now
+        never write; they restart from scratch."""
+        for r in self._slots:
+            if r is not None and r.deps:
+                r.deps = [(w, need) for w, need in r.deps
+                          if not (w is writer
+                                  and writer.prefill_sent >= need)]
+        readers = [r for r in self._slots
+                   if r is not None and r.state == "prefilling"
+                   and any(w is writer for w, need in r.deps)]
+        restarted = []
+        for r in readers:
+            # the recursive unwind below may already have restarted a
+            # later snapshot entry (a reader depending on BOTH this
+            # writer and r) — evicting it twice would double-enqueue it
+            if r.state != "prefilling":
+                continue
+            self._evict_to_queue(r)      # recursive: r may have readers
+            restarted.append(r)
+        self._requeue_front(restarted)
+
+    def _abort_request(self, req: Request, msg: str):
+        self.aborted += 1
+        self._finalize(req, "aborted", msg)
+
+    def _fail_request(self, req: Request, msg: str):
+        self.failed += 1
+        self._finalize(req, "failed", msg)
+
+    def _finalize(self, req: Request, state: str, msg: str):
+        """Move a live request to a terminal fault state, unwinding
+        whatever stage it was in. Partial tokens are kept; `error`
+        records why."""
+        if req.state == "queued":
+            try:
+                self._queue.remove(req)
+            except ValueError:
+                pass
+        else:
+            si = req.slot
+            if si is not None:
+                self._slots[si] = None
+                self._fresh_slots.discard(si)
+            req.slot = None
+            req.epoch += 1     # in-flight chunks must drop its tokens
+            if req.state == "prefilling":
+                self._unwind_alloc(req)
+            elif req.req_id in self.dec.cache._tables:
+                # running: pages freed after the newest in-flight chunk
+                # (it was dispatched assuming continuation), like
+                # _retire
+                if self._inflight:
+                    self._inflight[-1]["free_after"].append(req.req_id)
+                else:
+                    self.dec.cache.free(req.req_id)
+        req.state = state
+        req.error = msg
+        req.t_done = time.perf_counter()
+        self._done[req.req_id] = req
+
+    def debug_dump(self) -> str:
+        """One human-readable snapshot of the scheduler — per-request
+        states, queue/pipeline depth, robustness counters and cache
+        occupancy. The watchdog appends this to its hang report."""
+        cache = self.dec.cache
+        lines = ["serving engine state:"]
+        for si, r in enumerate(self._slots):
+            if r is None:
+                lines.append(f"  slot {si}: idle")
+            else:
+                lines.append(
+                    f"  slot {si}: req {r.req_id} state={r.state} "
+                    f"out={len(r.out_tokens)}/{r.sampling.max_new_tokens}"
+                    f" planned={r.planned} prefill={r.prefill_sent}/"
+                    f"{r.suffix_len} epoch={r.epoch} resume={r.resume}")
+        lines.append(f"  queue depth={len(self._queue)} ids="
+                     f"{[r.req_id for r in self._queue][:16]}")
+        lines.append(f"  inflight={len(self._inflight)} "
+                     f"finished={len(self._done)}")
+        lines.append(
+            f"  counters: preemptions={self.preemptions} "
+            f"retries={self.retries} aborted={self.aborted} "
+            f"failed={self.failed} deadline_misses={self.deadline_misses}"
+            f" shed={self.shed_requests} "
+            f"recompute_tokens={self.recompute_tokens}")
+        lines.append(
+            f"  cache: free_blocks={cache.free_blocks} "
+            f"cached_blocks={cache.cached_blocks} "
+            f"referenced={len(cache._ref)} of {cache.num_blocks}")
+        return "\n".join(lines) + "\n"
+
     # -- public API ----------------------------------------------------------
     def add_request(self, prompt, sampling: Optional[SamplingParams] = None
                     ) -> int:
@@ -479,17 +950,38 @@ class ServingEngine:
                 f"request needs {need} KV pages but the pool only has "
                 f"{cache.num_blocks - 1}; shrink max_new_tokens/prompt "
                 "or grow num_blocks")
+        # overload shedding: reject at the door what cannot be served —
+        # a hard queue-depth cap, and (for deadline'd requests, once the
+        # engine has a measured token rate) a backlog/deadline estimate
+        if self.max_queue_depth is not None and \
+                len(self._queue) >= self.max_queue_depth:
+            self.shed_requests += 1
+            raise EngineOverloaded(
+                f"queue depth {len(self._queue)} at the "
+                f"max_queue_depth={self.max_queue_depth} cap")
+        if sp.deadline_s is not None:
+            est = self._estimate_completion_s(sp)
+            if est is not None and est > sp.deadline_s:
+                self.shed_requests += 1
+                raise EngineOverloaded(
+                    f"estimated completion {est:.3f}s exceeds the "
+                    f"{sp.deadline_s:.3f}s deadline "
+                    f"(backlog {len(self._queue)} queued)")
         rid = next(self._ids)
         req = Request(rid, prompt, sp, t_submit=time.perf_counter())
         self._queue.append(req)
         return rid
 
     def result(self, req_id: int) -> np.ndarray:
-        """Generated tokens (prompt excluded) of a finished request."""
+        """Generated tokens (prompt excluded) of a terminal request.
+        For aborted/failed requests this is the PARTIAL output produced
+        before the fault — check request(req_id).state / .error."""
         req = self._done[req_id]
         return np.asarray(req.out_tokens, np.int32)
 
     def request(self, req_id: int) -> Request:
+        """The terminal Request record (state is one of done | aborted
+        | failed; error says why for the fault states)."""
         return self._done[req_id]
 
     @property
@@ -498,10 +990,6 @@ class ServingEngine:
                 or any(r is not None for r in self._slots))
 
     # -- scheduler -----------------------------------------------------------
-    def _required_blocks(self, req: Request) -> int:
-        total = req.prompt.size + req.sampling.max_new_tokens
-        return -(-total // self.dec.cache.block_size)
-
     def _admit(self):
         """Claim free batch slots for queued requests. Admission is
         capacity-aware (a request enters only if its whole worst-case
@@ -527,44 +1015,77 @@ class ServingEngine:
             if not self._queue:
                 break
             req = self._queue[0]
-            total = int(req.prompt.size) + req.sampling.max_new_tokens
+            # resume (preempted while running): the prefill source is
+            # prompt ++ generated history minus the last token — the
+            # history re-enters the pool via no-sample chunks and
+            # decode resumes from out_tokens[-1]. Fresh admissions
+            # prefill the prompt as before.
+            if req.resume and req.out_tokens:
+                toks = np.concatenate(
+                    [req.prompt,
+                     np.asarray(req.out_tokens[:-1], np.int32)])
+            else:
+                toks = req.prompt
+            # pages reserved up front: everything (worst_case — a
+            # running request can never exhaust the pool) or just the
+            # prefill + one decode slot (optimistic — oversubscribes
+            # the pool; pressure is relieved by preemption)
+            if self.admission == "optimistic":
+                total = int(len(toks)) + 1
+            else:
+                total = int(req.prompt.size) + req.sampling.max_new_tokens
             if self.prefix_caching:
                 try:
                     # one hash walk: the capacity check happens inside
                     # allocate_with_prefix BEFORE any mutation, so a
                     # refusal leaves the pool untouched
                     reused, n_cached = cache.allocate_with_prefix(
-                        req.req_id, req.prompt, total)
+                        req.req_id, toks, total)
                 except RuntimeError:
                     break  # head-of-line: keep FIFO, wait for frees
                 req.deps = [self._pending_writes[b] for b in reused
                             if b in self._pending_writes]
-                # register OUR fresh full prompt blocks as splice-
+                # register OUR fresh full prefill blocks as splice-
                 # pending until our dispatches cover them
                 table = cache.seq_blocks(req.req_id)
                 bs = cache.block_size
-                n_full = int(req.prompt.size) // bs
+                n_full = int(len(toks)) // bs
                 for j in range(len(reused), n_full):
                     self._pending_writes[table[j]] = \
                         (req, (j + 1) * bs - n_cached)
                     req.pending_blocks.append(table[j])
             else:
-                if cache.free_blocks < self._required_blocks(req):
+                if cache.free_blocks < -(-total // cache.block_size):
                     break
-                cache.allocate(req.req_id, total)
+                try:
+                    cache.allocate(req.req_id, total)
+                except RuntimeError:
+                    break
                 n_cached = 0
             self._queue.popleft()
+            req.ctx = toks if req.resume else None
             req.n_cached = n_cached
             req.state = "prefilling"
             req.slot = si
-            req.t_admit = time.perf_counter()
+            if req.t_admit is None:
+                req.t_admit = time.perf_counter()
+            if req.resume:
+                # tokens that must genuinely recompute (past the splice)
+                self.recompute_tokens += req.suffix_len
             self._slots[si] = req
 
     def _deps_ready(self, req: Request) -> bool:
         """True when every splice-pending writer has dispatched the
-        chunks covering the blocks `req` spliced (prefill_sent is
-        monotone, so a satisfied dependency stays satisfied)."""
-        return all(w.prefill_sent >= need for w, need in req.deps)
+        chunks covering the blocks `req` spliced. Satisfied entries are
+        PRUNED on the spot: a dispatched chunk executes no matter what
+        later happens to its writer, but a preempted writer's
+        prefill_sent rolls back to 0 — without pruning, a met
+        dependency could spuriously re-arm against the writer's next
+        life (whose blocks are different anyway)."""
+        if req.deps:
+            req.deps = [(w, need) for w, need in req.deps
+                        if w.prefill_sent < need]
+        return not req.deps
 
     def _clear_pending_writes(self, req: Request):
         for b in req.pending_blocks:
@@ -595,13 +1116,20 @@ class ServingEngine:
         budget = self.prefill_budget if (decoding and
                                          self.prefill_budget) else None
         def _is_mid(r):
+            # a preemption resume runs EVERY chunk through the
+            # no-sample mid program (its "first token" is already
+            # known — re-sampling would both corrupt the request and
+            # shift the engine's PRNG stream for everyone else)
+            if r.resume:
+                return True
             return (self.prefill_chunk and
                     r.suffix_len - r.prefill_sent > self.prefill_chunk)
 
         spent = 0
         while True:
             ready = [r for r in pending
-                     if r.prefill_sent < r.suffix_len
+                     if r.state == "prefilling" and r.slot is not None
+                     and r.prefill_sent < r.suffix_len
                      and self._deps_ready(r)]
             if not ready:
                 return
@@ -610,8 +1138,7 @@ class ServingEngine:
             # older short request's final
             head = ready[0]
             if _is_mid(head):
-                self._dispatch_mid(head)
-                spent += self.prefill_chunk
+                spent += self._dispatch_mid(head)
                 if budget is not None and spent >= budget:
                     return
                 continue
@@ -631,6 +1158,12 @@ class ServingEngine:
                 if len(group) > 1 else 1
             sub, toks = [], 0
             for row in group:
+                if row[1].state != "prefilling" or row[1].slot is None:
+                    # an EARLIER sub's (injected) KV exhaustion picked
+                    # this row's request as the preemption victim —
+                    # its seq is freed and the row is stale; it will
+                    # re-enter through the queue
+                    continue
                 sub.append(row)
                 toks += int(row[1].prompt.size) - row[2]
                 if len(sub) == w or (budget is not None
@@ -651,39 +1184,78 @@ class ServingEngine:
     # measured 4x throughput loss through the remote-compile tunnel)
     PREFILL_GROUP = 4
 
-    def _dispatch_mid(self, req: Request):
+    def _dispatch_mid(self, req: Request) -> int:
         """Dispatch ONE fixed-size no-sample prefill chunk (width 1).
         The chunk prefills at global offset n_cached + prefill_sent
         with everything before it — spliced prefix AND previously
         dispatched chunks — riding along as the prefix page table;
         offsets need not be page-aligned (the attention masks the
-        partial last page)."""
+        partial last page). A preemption resume's TAIL chunk may be
+        shorter than the chunk width: ids are right-padded with zeros
+        and the pad K/V aimed at the scratch page (the causal mask
+        hides pad keys from real queries, so padding is inert).
+        Returns the number of real tokens dispatched (0 when the
+        dispatch failed and the request was unwound)."""
         t0 = time.perf_counter()
         cache = self.dec.cache
-        c = self.prefill_chunk
+        c = self.prefill_chunk or self._recompute_chunk
+        toks = req.prefill_tokens
         off = req.n_cached + req.prefill_sent
-        ids = req.prompt[off:off + c][None]
-        slots = np.asarray([[cache.extend(req.req_id)
-                             for _ in range(c)]], np.int32)
-        if off:
-            need = -(-off // cache.block_size)
-            width = next(b for b in self._prefix_page_buckets
-                         if b >= need)
-            ptab = np.full((1, width), self._scratch_block, np.int32)
-            pb = cache.seq_blocks(req.req_id)[:need]
-            ptab[0, :len(pb)] = pb
-            cache.k, cache.v = self._prefill_mid_j(
-                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
-                jnp.asarray(slots), jnp.asarray([off], np.int32),
-                jnp.asarray(ptab))
-        else:
-            cache.k, cache.v = self._prefill_mid0_j(
-                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
-                jnp.asarray(slots))
-        req.prefill_sent += c
+        take = min(c, req.suffix_len - req.prefill_sent)
+        ids = np.zeros((1, c), np.int32)
+        ids[0, :take] = toks[off:off + take]
+        slots = np.full((1, c), self._scratch_slot, np.int32)
+        try:
+            for j in range(take):
+                slots[0, j] = self._extend_with_preempt(req)
+        except KVCacheExhausted as e:
+            self.time_prefill_s += time.perf_counter() - t0
+            self._fail_request(req, f"KV pool exhausted mid-prefill "
+                                    f"with no preemption victim: {e}")
+            return 0
+        try:
+            if off:
+                need = -(-off // cache.block_size)
+                width = next(b for b in self._prefix_page_buckets
+                             if b >= need)
+                ptab = np.full((1, width), self._scratch_block,
+                               np.int32)
+                pb = cache.seq_blocks(req.req_id)[:need]
+                ptab[0, :len(pb)] = pb
+                cache.k, cache.v = self._device_call(
+                    "dispatch:prefill_mid", self._prefill_mid_j,
+                    self.dec.weights, cache.k, cache.v,
+                    jnp.asarray(ids), jnp.asarray(slots),
+                    jnp.asarray([off], np.int32), jnp.asarray(ptab))
+            else:
+                cache.k, cache.v = self._device_call(
+                    "dispatch:prefill_mid", self._prefill_mid0_j,
+                    self.dec.weights, cache.k, cache.v,
+                    jnp.asarray(ids), jnp.asarray(slots))
+        except _DispatchFailed as e:
+            self.time_prefill_s += time.perf_counter() - t0
+            self._fail_request(req, f"prefill dispatch failed after "
+                                    f"retries: {e}")
+            return 0
+        req.prefill_sent += take
         self._inflight.append({"kind": "prefill", "toks": None,
                                "group": [], "free_after": []})
+        if req.resume and req.prefill_sent >= req.suffix_len:
+            self._resume_complete(req)
         self.time_prefill_s += time.perf_counter() - t0
+        return take
+
+    def _resume_complete(self, req: Request):
+        """A preemption resume finishes at DISPATCH time — no sampling
+        final, no collection barrier: the next decode input is the
+        already-emitted out_tokens[-1], supplied from the host exactly
+        like a fresh prefill's first token."""
+        req.state = "running"
+        self._clear_pending_writes(req)
+        si = req.slot
+        self._last_tok[si] = req.out_tokens[-1]
+        self._fresh_slots.add(si)
+        req.planned = len(req.out_tokens)
 
     def _dispatch_final(self, bucket: int, group, gp: int):
         """Dispatch one FINAL (first-token-sampling) prefill for rows
@@ -711,28 +1283,41 @@ class ServingEngine:
         any_rep = any(req.sampling.repetition_penalty != 1.0
                       for _, req, _ in group)
         seen = np.zeros((gp, vocab), bool) if any_rep else None
-        for row, (si, req, off) in enumerate(group):
-            s = int(req.prompt.size) - off
-            ids[row, :s] = req.prompt[off:]
-            slots[row, :s] = [cache.extend(req.req_id)
-                              for _ in range(s)]
-            last_idx[row] = s - 1
-            ncv[row] = off
-            if off:
-                pb = cache.seq_blocks(req.req_id)[
-                    : -(-off // cache.block_size)]
-                ptab[row, :len(pb)] = pb
-            sp = req.sampling
-            temps[row] = sp.temperature
-            # engine-level top_k is the default where the request does
-            # not set its own (None); an explicit 0 disables it
-            top_ks[row] = self.top_k if sp.top_k is None else sp.top_k
-            top_ps[row] = sp.top_p
-            reps[row] = sp.repetition_penalty
-            if sp.repetition_penalty != 1.0:
-                seen[row, req.prompt] = True   # FULL prompt, cached too
-            req.prefill_sent = req.suffix_len
-            self._clear_pending_writes(req)
+        members = [req for _, req, _ in group]
+        try:
+            for row, (si, req, off) in enumerate(group):
+                s = int(req.prompt.size) - off
+                ids[row, :s] = req.prompt[off:]
+                slots[row, :s] = [
+                    self._extend_with_preempt(req, exclude=members)
+                    for _ in range(s)]
+                last_idx[row] = s - 1
+                ncv[row] = off
+                if off:
+                    pb = cache.seq_blocks(req.req_id)[
+                        : -(-off // cache.block_size)]
+                    ptab[row, :len(pb)] = pb
+                sp = req.sampling
+                temps[row] = sp.temperature
+                # engine-level top_k is the default where the request
+                # does not set its own (None); an explicit 0 disables it
+                top_ks[row] = self.top_k if sp.top_k is None \
+                    else sp.top_k
+                top_ps[row] = sp.top_p
+                reps[row] = sp.repetition_penalty
+                if sp.repetition_penalty != 1.0:
+                    seen[row, req.prompt] = True  # FULL prompt, cached
+        except KVCacheExhausted as e:
+            # no victim left for the group's suffix slots (only
+            # reachable through an injected-fault storm on a
+            # worst-case-admitted pool): the group shares one dispatch
+            # and its rows are already entangled — fail it whole
+            self.time_prefill_s += time.perf_counter() - t0
+            for req in members:
+                self._fail_request(
+                    req, f"KV pool exhausted building prefill "
+                         f"group: {e}")
+            return
         seen_dev = jnp.asarray(seen) if any_rep \
             else self._zeros_seen(gp, vocab)
         # the suffix-prefix program pays a per-layer page gather plus
@@ -740,32 +1325,53 @@ class ServingEngine:
         # only groups with at least one covered prefix take it —
         # cold-start groups keep the plain flash prefill, so disjoint
         # unchunked traffic is unchanged
-        if any(off for _, _, off in group):
-            toks, cache.k, cache.v = self._prefill_prefix_j(
-                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
-                jnp.asarray(slots), jnp.asarray(last_idx),
-                jnp.asarray(ncv), jnp.asarray(ptab),
-                jnp.asarray(temps), self._next_key(),
-                jnp.asarray(top_ks), jnp.asarray(top_ps),
-                jnp.asarray(reps), seen_dev)
-        else:
-            toks, cache.k, cache.v = self._prefill_j(
-                self.dec.weights, cache.k, cache.v, jnp.asarray(ids),
-                jnp.asarray(slots), jnp.asarray(last_idx),
-                jnp.asarray(temps), self._next_key(),
-                jnp.asarray(top_ks), jnp.asarray(top_ps),
-                jnp.asarray(reps), seen_dev)
+        try:
+            if any(off for _, _, off in group):
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:prefill", self._prefill_prefix_j,
+                    self.dec.weights, cache.k, cache.v,
+                    jnp.asarray(ids), jnp.asarray(slots),
+                    jnp.asarray(last_idx), jnp.asarray(ncv),
+                    jnp.asarray(ptab), jnp.asarray(temps),
+                    self._next_key(), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
+            else:
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:prefill", self._prefill_j,
+                    self.dec.weights, cache.k, cache.v,
+                    jnp.asarray(ids), jnp.asarray(slots),
+                    jnp.asarray(last_idx), jnp.asarray(temps),
+                    self._next_key(), jnp.asarray(top_ks),
+                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
+        except _DispatchFailed as e:
+            # request mutations happen only after a SUCCESSFUL
+            # dispatch, so coverage bookkeeping is still truthful here:
+            # unwinding restarts exactly the readers whose spliced
+            # blocks will now never be written
+            self.time_prefill_s += time.perf_counter() - t0
+            for req in members:
+                self._fail_request(
+                    req, f"prefill dispatch failed after retries: {e}")
+            return
+        for si, req, off in group:
+            req.prefill_sent = req.suffix_len
+            self._clear_pending_writes(req)
         self._inflight.append({"kind": "prefill", "toks": toks,
-                               "group": [(si, req)
+                               "group": [(si, req, req.epoch)
                                          for si, req, _ in group],
                                "free_after": []})
         self.time_prefill_s += time.perf_counter() - t0
 
     def _prefill_complete(self, toks: np.ndarray, group):
         """Post-fetch bookkeeping for one collected FINAL prefill:
-        the request leaves "prefilling" with its first token."""
+        the request leaves "prefilling" with its first token. Requests
+        that lost their slot while the chunk was in flight (cancel /
+        deadline abort / preemption restart — epoch bumped) are
+        skipped: their result belongs to a previous life."""
         now = time.perf_counter()
-        for row, (si, req) in enumerate(group):
+        for row, (si, req, epoch) in enumerate(group):
+            if req.state != "prefilling" or req.epoch != epoch:
+                continue
             tok = int(toks[row])
             req.state = "running"
             req.t_first_token = now
@@ -897,30 +1503,89 @@ class ServingEngine:
         top_ps = np.ones(mb, np.float32)
         reps = np.ones(mb, np.float32)
         vocab = self.dec.cfg.vocab_size
-        rich = False
         steps_of: Dict[int, int] = {}
         reqs_of: Dict[int, Request] = {}
+        epochs_of: Dict[int, int] = {}
+        def neutralize(vsi: int):
+            """Blank a slot's rows in THIS chunk's schedule. A victim
+            preempted mid-build frees blocks a LATER slot of the same
+            chunk may take — but its already-scheduled rows would then
+            write K/V into the same flat slots within ONE program,
+            silently corrupting the surviving request (device program
+            order only protects cross-program reuse). Re-aiming the
+            victim's rows at the scratch page removes the overlap.
+            The victim's sampling contribution is dropped too: a
+            processed row would otherwise keep the whole chunk on the
+            rich program (unwarmed XLA variant + [mb, vocab] seen
+            matrix) even when every surviving row is greedy."""
+            slots[:, vsi] = self._scratch_slot
+            ctx[:, vsi] = 0
+            tables[:, vsi, :] = self._scratch_block
+            steps_of.pop(vsi, None)
+            reqs_of.pop(vsi, None)
+            epochs_of.pop(vsi, None)
+            temps[vsi] = 0.0
+            top_ks[vsi] = 0
+            top_ps[vsi] = 1.0
+            reps[vsi] = 1.0
+
         for si in active:
             req = self._slots[si]
+            if req is None or req.state != "running":
+                # preempted by an earlier slot's KV pressure while this
+                # chunk was being scheduled
+                continue
             sp = req.sampling
+            # budget at DISPATCH time: tokens planned (dispatched), not
+            # tokens fetched — EOS cuts are discovered at collection
+            steps = max(0, min(T, sp.max_new_tokens - req.planned))
+            try:
+                for t in range(steps):
+                    ctx[t, si] = cache.context_len(req.req_id)
+                    while True:
+                        try:
+                            slots[t, si] = cache.extend(req.req_id)
+                            break
+                        except KVCacheExhausted:
+                            victim = self._pick_victim()
+                            if victim is None or victim is req:
+                                raise
+                            vsi = victim.slot
+                            self._preempt(victim)
+                            if vsi is not None:
+                                neutralize(vsi)
+            except KVCacheExhausted:
+                # req itself is the policy victim (newest / lowest
+                # priority): preempt it and blank its partial rows —
+                # its freed pages may be re-taken by a later slot of
+                # this very chunk. A recompute-incapable decoder has
+                # no resume programs (_pick_victim always returns None
+                # for it), so preempting would re-admit into a mid
+                # path that doesn't exist — fail the request instead.
+                if self._can_recompute:
+                    self._preempt(req)
+                else:
+                    self._fail_request(
+                        req, "KV pool exhausted and decoder does not "
+                             "support preemption-with-recompute")
+                neutralize(si)
+                continue
+            req.planned += steps
+            steps_of[si] = steps
+            reqs_of[si] = req
+            epochs_of[si] = req.epoch
             temps[si] = sp.temperature
             top_ks[si] = self.top_k if sp.top_k is None else sp.top_k
             top_ps[si] = sp.top_p
             reps[si] = sp.repetition_penalty
-            rich = rich or sp.needs_rich_sampling
-            # budget at DISPATCH time: tokens planned (dispatched), not
-            # tokens fetched — EOS cuts are discovered at collection
-            steps = max(0, min(T, sp.max_new_tokens - req.planned))
-            req.planned += steps
-            steps_of[si] = steps
-            reqs_of[si] = req
-            for t in range(steps):
-                ctx[t, si] = cache.context_len(req.req_id)
-                slots[t, si] = cache.extend(req.req_id)
             # one table per slot per chunk: after the extends above the
             # block list is final for the whole chunk, and entries past
             # a step's context length are masked by ctx anyway
             tables[:, si, :] = cache.block_table(req.req_id, mp)[None]
+        # computed over SURVIVORS only — neutralize() may have dropped
+        # an already-accumulated victim row
+        rich = any(r.sampling.needs_rich_sampling
+                   for r in reqs_of.values())
         if all(s == 0 for s in steps_of.values()):
             # every active slot is budget-drained and just awaiting
             # collection — nothing to run
@@ -930,55 +1595,75 @@ class ServingEngine:
         # first tokens: device gather from the newest in-flight DECODE
         # chunk for continuing slots, host values for fresh/0-step
         # slots (prefill entries between them don't carry decode toks)
-        prev = self._newest_decode_entry()
-        if prev is not None:
-            last_idx = np.zeros(mb, np.int32)
-            override = np.asarray(self._last_tok, np.int32).copy()
-            use_host = np.ones(mb, bool)
-            for si in active:
-                psteps = prev["steps"].get(si, 0)
-                if (psteps > 0 and si not in self._fresh_slots
-                        and prev["reqs"].get(si) is reqs_of[si]):
-                    use_host[si] = False
-                    last_idx[si] = psteps - 1
-            first_ids = self._merge_first_j(
-                prev["toks"], jnp.asarray(last_idx),
-                jnp.asarray(override), jnp.asarray(use_host))
-        else:
-            first_ids = jnp.asarray(self._last_tok)
-        self._fresh_slots.clear()
-
-        keys = jax.random.split(self._next_key(), T)
-        if rich:
-            if any(reqs_of[si].sampling.repetition_penalty != 1.0
-                   for si in active):
-                seen = np.zeros((mb, vocab), bool)
-                for si in active:
-                    req = reqs_of[si]
-                    if req.sampling.repetition_penalty != 1.0:
-                        seen[si, req.prompt] = True
-                        if req.out_tokens:
-                            seen[si, np.asarray(req.out_tokens)] = True
-                seen_dev = jnp.asarray(seen)
+        try:
+            prev = self._newest_decode_entry()
+            if prev is not None:
+                last_idx = np.zeros(mb, np.int32)
+                override = np.asarray(self._last_tok, np.int32).copy()
+                use_host = np.ones(mb, bool)
+                for si, req in reqs_of.items():
+                    psteps = prev["steps"].get(si, 0)
+                    if (psteps > 0 and si not in self._fresh_slots
+                            and prev["reqs"].get(si) is req
+                            and prev["epochs"].get(si) == req.epoch):
+                        use_host[si] = False
+                        last_idx[si] = psteps - 1
+                first_ids = self._device_call(
+                    "dispatch:merge", self._merge_first_j,
+                    prev["toks"], jnp.asarray(last_idx),
+                    jnp.asarray(override), jnp.asarray(use_host))
             else:
-                # top_k/top_p-only chunk: the mask is multiplied by
-                # (rep != 1) == False in-program — reuse a cached
-                # device-resident zeros mask instead of shipping
-                # [mb, vocab] bools through the tunnel every chunk
-                seen_dev = self._zeros_seen(mb, vocab)
-            toks, cache.k, cache.v = self._decode_rich_j(
-                self.dec.weights, cache.k, cache.v, first_ids,
-                jnp.asarray(tables), jnp.asarray(ctx),
-                jnp.asarray(slots), jnp.asarray(temps), keys,
-                jnp.asarray(top_ks), jnp.asarray(top_ps),
-                jnp.asarray(reps), seen_dev)
-        else:
-            toks, cache.k, cache.v = self._decode_j(
-                self.dec.weights, cache.k, cache.v, first_ids,
-                jnp.asarray(tables), jnp.asarray(ctx),
-                jnp.asarray(slots), jnp.asarray(temps), keys)
+                first_ids = jnp.asarray(self._last_tok)
+            self._fresh_slots.clear()
+
+            keys = jax.random.split(self._next_key(), T)
+            if rich:
+                if any(r.sampling.repetition_penalty != 1.0
+                       for r in reqs_of.values()):
+                    seen = np.zeros((mb, vocab), bool)
+                    for si, req in reqs_of.items():
+                        if req.sampling.repetition_penalty != 1.0:
+                            seen[si, req.prompt] = True
+                            if req.out_tokens:
+                                seen[si,
+                                     np.asarray(req.out_tokens)] = True
+                    seen_dev = jnp.asarray(seen)
+                else:
+                    # top_k/top_p-only chunk: the mask is multiplied by
+                    # (rep != 1) == False in-program — reuse a cached
+                    # device-resident zeros mask instead of shipping
+                    # [mb, vocab] bools through the tunnel every chunk
+                    seen_dev = self._zeros_seen(mb, vocab)
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:decode", self._decode_rich_j,
+                    self.dec.weights, cache.k, cache.v, first_ids,
+                    jnp.asarray(tables), jnp.asarray(ctx),
+                    jnp.asarray(slots), jnp.asarray(temps), keys,
+                    jnp.asarray(top_ks), jnp.asarray(top_ps),
+                    jnp.asarray(reps), seen_dev)
+            else:
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:decode", self._decode_j,
+                    self.dec.weights, cache.k, cache.v, first_ids,
+                    jnp.asarray(tables), jnp.asarray(ctx),
+                    jnp.asarray(slots), jnp.asarray(temps), keys)
+        except _DispatchFailed as e:
+            # transient device error that survived the retry budget:
+            # the chunk's requests fail with a structured error — the
+            # ENGINE keeps serving (0-step slots awaiting collection
+            # and still-prefilling requests are untouched)
+            for si, steps in steps_of.items():
+                req = reqs_of[si]
+                if steps > 0 and self._slots[si] is req \
+                        and req.state == "running":
+                    self._fail_request(
+                        req, f"decode dispatch failed after retries: "
+                             f"{e}")
+            self.time_host_s += time.perf_counter() - t0
+            return False
         self._inflight.append({"kind": "decode", "toks": toks,
                                "steps": steps_of, "reqs": reqs_of,
+                               "epochs": epochs_of,
                                "T": T, "free_after": []})
         self.time_host_s += time.perf_counter() - t0
         return True
@@ -995,26 +1680,55 @@ class ServingEngine:
         if ch["kind"] == "prefill":
             if ch["toks"] is not None:
                 t0 = time.perf_counter()
-                # THE designed blocking point for a lone prefill entry
-                # (runs of >1 batch through _collect_prefill_run)
-                toks = np.asarray(ch["toks"])  # flightcheck: disable=FC301
+                try:
+                    # THE designed blocking point for a lone prefill
+                    # entry (runs of >1 batch through
+                    # _collect_prefill_run); retried on transient fetch
+                    # faults — a fetch never consumes the device buffer
+                    toks = np.asarray(self._device_call(  # flightcheck: disable=FC301
+                        "collect:prefill", np.asarray, ch["toks"]))
+                except _DispatchFailed as e:
+                    self.time_prefill_s += time.perf_counter() - t0
+                    self._fail_prefill_group(ch["group"], e)
+                    for rid in ch["free_after"]:
+                        self.dec.cache.free(rid)
+                    return
                 self.time_prefill_s += time.perf_counter() - t0
                 self._prefill_complete(toks, ch["group"])
             for rid in ch["free_after"]:
                 self.dec.cache.free(rid)
             return
         t0 = time.perf_counter()
-        # THE designed blocking point of the decode pipeline: collection
-        # fetches the oldest in-flight chunk, in device program order
-        toks = np.asarray(ch["toks"])  # flightcheck: disable=FC301
+        try:
+            # THE designed blocking point of the decode pipeline:
+            # collection fetches the oldest in-flight chunk, in device
+            # program order (retried on transient fetch faults; the
+            # outer asarray is a no-op re-wrap of the fetched host
+            # array)
+            toks = np.asarray(self._device_call(  # flightcheck: disable=FC301
+                "collect:decode", np.asarray, ch["toks"]))
+        except _DispatchFailed as e:
+            self.time_stall_s += time.perf_counter() - t0
+            for si, steps in ch["steps"].items():
+                req = ch["reqs"][si]
+                if steps > 0 and req.state == "running" \
+                        and req.epoch == ch["epochs"].get(si) \
+                        and self._slots[si] is req:
+                    self._fail_request(
+                        req, f"chunk collection failed after retries: "
+                             f"{e}")
+            for rid in ch["free_after"]:
+                self.dec.cache.free(rid)
+            return
         self.time_stall_s += time.perf_counter() - t0
         now = time.perf_counter()
         self.decode_steps += ch["T"]
         self.decode_slot_steps += ch["T"] * self.max_b
         for si, steps in ch["steps"].items():
             req = ch["reqs"][si]
-            if req.state != "running":
-                continue       # retired while this chunk was in flight
+            if req.state != "running" \
+                    or req.epoch != ch["epochs"].get(si):
+                continue   # retired/preempted while the chunk flew
             delivered = 0
             for t in range(steps):
                 tok = int(toks[si, t])
@@ -1047,17 +1761,40 @@ class ServingEngine:
         chs = [self._inflight.popleft() for _ in range(n)]
         t0 = time.perf_counter()
         fetch = [ch["toks"] for ch in chs if ch["toks"] is not None]
-        # designed batched fetch: one tunnel round trip per prefill run
-        fetched = (jax.device_get(fetch)  # flightcheck: disable=FC301
-                   if fetch else [])
+        try:
+            # designed batched fetch: one tunnel round trip per prefill
+            # run (retried whole on transient faults — fetches never
+            # consume device buffers)
+            fetched = (self._device_call(  # flightcheck: disable=FC301
+                "collect:prefill", jax.device_get, fetch)
+                if fetch else [])
+        except _DispatchFailed as e:
+            self.time_prefill_s += time.perf_counter() - t0
+            for ch in chs:
+                if ch["toks"] is not None:
+                    self._fail_prefill_group(ch["group"], e)
+                for rid in ch["free_after"]:
+                    self.dec.cache.free(rid)
+            return
         self.time_prefill_s += time.perf_counter() - t0
         it = iter(fetched)
         for ch in chs:
             if ch["toks"] is not None:
-                self._prefill_complete(np.asarray(next(it)),
+                # re-wrap of the batched fetch above (already host
+                # memory — the sync was paid at the designed point)
+                self._prefill_complete(np.asarray(next(it)),  # flightcheck: disable=FC301
                                        ch["group"])
             for rid in ch["free_after"]:
                 self.dec.cache.free(rid)
+
+    def _fail_prefill_group(self, group, e: Exception):
+        """Fail every request of an uncollectable final-prefill entry
+        that is still waiting on it (epoch guard: requests restarted
+        since the dispatch are someone else's problem now)."""
+        for si, req, epoch in group:
+            if req.state == "prefilling" and req.epoch == epoch:
+                self._fail_request(
+                    req, f"prefill collection failed after retries: {e}")
 
     def step(self) -> bool:
         """One engine iteration: admit, dispatch budget-bounded prefill
@@ -1067,7 +1804,11 @@ class ServingEngine:
         newest entry is the decode chunk whenever one was dispatched,
         so prefill results are always collected by the end of the step
         that could consume them). Returns True while there is still
-        work."""
+        work. Fault tolerance: deadline enforcement runs first (an
+        expired request never costs another dispatch); dispatch/fetch
+        errors and KV pressure are absorbed inside the phases — step()
+        itself never raises on a per-request fault."""
+        self._enforce_deadlines()
         self._admit()
         self._dispatch_prefill()
         dispatched = self._dispatch_chunk()
@@ -1277,6 +2018,15 @@ class ServingEngine:
         self.time_prefill_s = 0.0
         self.time_stall_s = 0.0
         self.time_host_s = 0.0
+        # robustness counters reset alongside the prefix-cache ones so
+        # a post-warmup stats() reflects only real traffic
+        self.preemptions = 0
+        self.recompute_tokens = 0
+        self.aborted = 0
+        self.failed = 0
+        self.deadline_misses = 0
+        self.shed_requests = 0
+        self.retries = 0
         self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
@@ -1290,8 +2040,9 @@ class ServingEngine:
           the per-token attribution is T-ths of the gap, the standard
           chunked-serving convention). The headline metric for
           chunked prefill: a long prompt admitted mid-stream must not
-          spike running requests' ITL. Aggregated over finished AND
-          currently-running requests.
+          spike running requests' ITL. Aggregated over successfully
+          finished AND currently-running requests (aborted/failed
+          lifetimes are excluded, like the other percentiles).
         - queue_wait_p50_s: submit → batch-slot admission.
         - time_prefill_s / time_decode_stall_s / time_host_s: wall
           time of the engine's blocking call sites. Prefill results
@@ -1309,15 +2060,16 @@ class ServingEngine:
         token (inactive slots, budget-drained tails, post-EOS
         discards), decode_utilization = delivered / slot-steps."""
         cache = self.dec.cache
-        lats = [r.latency_s for r in self._done.values()
-                if r.latency_s is not None]
-        ttfts = [r.ttft_s for r in self._done.values()
-                 if r.ttft_s is not None]
-        waits = [r.queue_wait_s for r in self._done.values()
+        ok = [r for r in self._done.values() if r.state == "done"]
+        lats = [r.latency_s for r in ok if r.latency_s is not None]
+        ttfts = [r.ttft_s for r in ok if r.ttft_s is not None]
+        waits = [r.queue_wait_s for r in ok
                  if r.queue_wait_s is not None]
+        # terminal side filtered to state=="done" like lats/ttfts/waits
+        # above: an aborted/failed request's stall-inflated gaps must
+        # not bleed into the successful-traffic ITL percentiles
         itls = [x for r in itertools.chain(
-            self._done.values(),
-            (r for r in self._slots if r is not None))
+            ok, (r for r in self._slots if r is not None))
             for x in r.itls]
 
         def pct(xs, p):
@@ -1326,7 +2078,19 @@ class ServingEngine:
             return float(np.quantile(xs, p)) if xs else None
 
         return {
-            "finished": len(self._done),
+            # finished = completed successfully; aborted/failed/shed
+            # are accounted separately below (latency/TTFT percentiles
+            # cover successful requests only — a deadline abort's
+            # truncated lifetime must not flatter the percentiles)
+            "finished": len(ok),
+            # -- robustness counters (reset by clear_finished) --------
+            "preemptions": self.preemptions,
+            "recompute_tokens": self.recompute_tokens,
+            "aborted": self.aborted,
+            "failed": self.failed,
+            "deadline_misses": self.deadline_misses,
+            "shed_requests": self.shed_requests,
+            "retries": self.retries,
             "decode_steps": self.decode_steps,
             "generated_tokens": self.generated_tokens,
             "latency_p50_s": pct(lats, 0.50),

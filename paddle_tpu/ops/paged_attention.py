@@ -25,8 +25,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["PagedKVCache", "paged_attention_decode",
+__all__ = ["KVCacheExhausted", "PagedKVCache", "paged_attention_decode",
            "paged_attention_decode_reference", "reshape_and_cache"]
+
+
+class KVCacheExhausted(RuntimeError):
+    """The block pool cannot satisfy an allocation — free list dry and
+    nothing evictable. A RuntimeError subclass so pre-existing callers
+    catching RuntimeError keep working; the ServingEngine catches THIS
+    type specifically to trigger preemption-with-recompute instead of
+    failing the request. The chaos harness (utils/chaos.py) raises it
+    from the allocator fault hook to simulate pool pressure."""
 
 
 def reshape_and_cache(k, v, k_cache, v_cache, slot_mapping):
@@ -131,12 +140,18 @@ class PagedKVCache:
         self.prefix_hit_tokens = 0
         self.prefix_query_tokens = 0
         self.prefix_evictions = 0
+        # optional fault-injection hook (utils/chaos.py): called at the
+        # top of every _take_block, BEFORE any mutation, so an injected
+        # KVCacheExhausted leaves the pool untouched
+        self.fault_hook = None
 
     # -- allocation ---------------------------------------------------------
     def _take_block(self) -> int:
         """Pop a writable block: the free list first, then (free list
         dry) evict the least-recently-parked cached block, invalidating
         its hash so it can never be spliced again."""
+        if self.fault_hook is not None:
+            self.fault_hook()
         if self._free:
             return self._free.pop()
         if self._lru:
@@ -145,7 +160,22 @@ class PagedKVCache:
             self._block_of.pop(h, None)
             self.prefix_evictions += 1
             return blk
-        raise RuntimeError("KV cache exhausted")
+        raise KVCacheExhausted("KV cache exhausted")
+
+    def _take_blocks(self, n: int) -> List[int]:
+        """Pop n blocks TRANSACTIONALLY: a mid-loop failure (free list
+        drained between the capacity check and the take — only possible
+        via an injected allocator fault) returns the already-taken
+        blocks to the free list before re-raising, so no block is ever
+        stranded outside the three pools."""
+        taken: List[int] = []
+        try:
+            for _ in range(n):
+                taken.append(self._take_block())
+        except RuntimeError:
+            self._free.extend(taken)
+            raise
+        return taken
 
     def allocate(self, seq_id: int, num_tokens: int):
         """Reserve blocks for a sequence of num_tokens (prefill)."""
@@ -153,10 +183,10 @@ class PagedKVCache:
             raise ValueError(f"seq {seq_id} already allocated")
         needed = -(-num_tokens // self.block_size)
         if self.available_blocks < needed:
-            raise RuntimeError(
+            raise KVCacheExhausted(
                 f"KV cache exhausted: need {needed} blocks, "
                 f"{self.available_blocks} free")
-        blocks = [self._take_block() for _ in range(needed)]
+        blocks = self._take_blocks(needed)
         for b in blocks:
             self._ref[b] = 1
         self._tables[seq_id] = blocks
@@ -232,7 +262,7 @@ class PagedKVCache:
         matched = self._match(hashes, len(tokens))
         needed_new, avail = self._prefix_capacity(matched, n_tok)
         if avail < needed_new:
-            raise RuntimeError(
+            raise KVCacheExhausted(
                 f"KV cache exhausted: need {needed_new} blocks, "
                 f"{avail} free")
         reused = []
@@ -240,7 +270,18 @@ class PagedKVCache:
             self._lru.pop(blk, None)    # blocks so eviction can't steal
             self._ref[blk] = self._ref.get(blk, 0) + 1   # a matched one
             reused.append(blk)
-        fresh = [self._take_block() for _ in range(needed_new)]
+        try:
+            fresh = self._take_blocks(needed_new)
+        except RuntimeError:
+            # injected fault mid-take: undo the revive so the matched
+            # blocks return to ref-0 parked state and the pool invariant
+            # holds (the refusal must leave the pool unchanged)
+            for blk in reused:
+                self._ref[blk] -= 1
+                if self._ref[blk] == 0:
+                    del self._ref[blk]
+                    self._lru[blk] = None
+            raise
         for b in fresh:
             self._ref[b] = 1
         table = reused + fresh
@@ -272,13 +313,33 @@ class PagedKVCache:
         self.prefix_query_tokens = 0
         self.prefix_evictions = 0
 
+    def unregister_block_hashes(self, blocks):
+        """Invalidate the hash registrations of `blocks` — used when a
+        prefill is unwound (cancel / failure / preemption) before the
+        dispatch covering those blocks was issued: their registered
+        content will never be written, so they must not be spliceable.
+        Only registrations actually pointing at the block are removed
+        (another request may have re-registered the same hash onto a
+        different block). No-op for unhashed blocks."""
+        for b in blocks:
+            h = self._hash_of.get(b)
+            if h is not None and self._block_of.get(h) == b:
+                del self._hash_of[b]
+                del self._block_of[h]
+                if b in self._lru:
+                    # a parked block losing its hash is no longer
+                    # spliceable — return it to the free list (cached
+                    # blocks must all be hash-registered)
+                    del self._lru[b]
+                    self._free.append(b)
+
     def extend(self, seq_id: int):
         """Ensure room for one more token; returns the flat slot id."""
         pos = self._lens[seq_id]
         blocks = self._tables[seq_id]
         if pos >= len(blocks) * self.block_size:
             if self.available_blocks == 0:
-                raise RuntimeError("KV cache exhausted on extend")
+                raise KVCacheExhausted("KV cache exhausted on extend")
             blk = self._take_block()
             self._ref[blk] = 1
             blocks.append(blk)

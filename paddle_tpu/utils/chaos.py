@@ -1,0 +1,144 @@
+"""Deterministic fault injection for the serving engine.
+
+The robustness analogue of tools/flightcheck: flightcheck proves hazard
+classes absent STATICALLY; the chaos monkey proves the engine's
+fault-tolerance machinery (ISSUE 4 — deadlines, cancellation,
+preemption-with-recompute, bounded retry) actually recovers AT RUNTIME,
+by injecting seeded failures at the engine's three fault surfaces:
+
+- allocator OOM: ``PagedKVCache.fault_hook`` fires at the top of every
+  ``_take_block`` — BEFORE any pool mutation — raising KVCacheExhausted
+  exactly as a genuinely dry pool would. The engine answers with
+  admission back-pressure or preemption-with-recompute.
+- dispatch faults: ``ServingEngine._device_call`` consults
+  ``before_call`` ahead of every jitted dispatch. An injected
+  InjectedDispatchError is raised BEFORE the underlying call, so no
+  donated buffer is consumed and a retry re-runs the identical program
+  (same args, same PRNG key) — recovery is token-identical by
+  construction.
+- collection faults ("corruption"): the same hook ahead of every
+  result fetch. Fetches never consume device buffers, so a retried
+  fetch returns the SAME tokens — an injected collect fault models a
+  torn/corrupt host read that the retry re-reads.
+- latency spikes: a seeded ``time.sleep`` ahead of a call — exercises
+  deadline enforcement and the watchdog without failing anything.
+
+Everything is driven by one ``numpy.random.RandomState(seed)``: the
+same seed + the same engine behavior reproduces the same schedule, so a
+chaos failure is a unit test, not a flake. The monkey never mutates
+engine state itself — it only raises/sleeps at the sanctioned hooks.
+
+Usage::
+
+    from paddle_tpu.utils.chaos import ChaosMonkey
+    monkey = ChaosMonkey(seed=0, p_dispatch=0.05, p_alloc_oom=0.02)
+    monkey.attach(engine)
+    while engine.step():
+        engine.dec.cache.debug_check()
+    monkey.detach(engine)
+    print(monkey.counts)
+
+``tools/chaos_serving.py`` wraps this in a full harness: randomized
+chaos schedules, per-step invariant checks, and token-identity of every
+surviving request against a fault-free run.
+"""
+from __future__ import annotations
+
+import time
+from collections import Counter
+from typing import List, Tuple
+
+import numpy as np
+
+from ..ops.paged_attention import KVCacheExhausted
+
+__all__ = ["ChaosMonkey", "InjectedFault", "InjectedDispatchError",
+           "InjectedCollectError"]
+
+
+class InjectedFault(RuntimeError):
+    """Base of every chaos-injected failure (NOT KVCacheExhausted —
+    injected allocator OOM deliberately raises the real exhaustion type
+    so the engine cannot tell it from true pressure)."""
+
+
+class InjectedDispatchError(InjectedFault):
+    """Injected ahead of a device dispatch (transient device error)."""
+
+
+class InjectedCollectError(InjectedFault):
+    """Injected ahead of a result fetch (torn/corrupt collection)."""
+
+
+class ChaosMonkey:
+    """Seeded, deterministic fault injector for one ServingEngine.
+
+    p_alloc_oom:  probability a block take raises KVCacheExhausted
+    p_dispatch:   probability a dispatch raises InjectedDispatchError
+    p_collect:    probability a fetch raises InjectedCollectError
+    p_latency:    probability a call is delayed by latency_s first
+    """
+
+    def __init__(self, seed: int = 0, p_alloc_oom: float = 0.0,
+                 p_dispatch: float = 0.0, p_collect: float = 0.0,
+                 p_latency: float = 0.0, latency_s: float = 0.002):
+        self.rng = np.random.RandomState(seed)
+        self.p_alloc_oom = float(p_alloc_oom)
+        self.p_dispatch = float(p_dispatch)
+        self.p_collect = float(p_collect)
+        self.p_latency = float(p_latency)
+        self.latency_s = float(latency_s)
+        self.counts: Counter = Counter()
+        # (call index, site) of every injection, for post-mortems
+        self.log: List[Tuple[int, str]] = []
+        self._calls = 0
+
+    # -- wiring -------------------------------------------------------------
+    def attach(self, engine) -> "ChaosMonkey":
+        """Hook this monkey into `engine` (and its KV pool)."""
+        engine.chaos = self
+        engine.dec.cache.fault_hook = self._alloc_hook
+        return self
+
+    def detach(self, engine):
+        if engine.chaos is self:
+            engine.chaos = None
+        if engine.dec.cache.fault_hook == self._alloc_hook:
+            engine.dec.cache.fault_hook = None
+
+    # -- injection sites ----------------------------------------------------
+    def _alloc_hook(self):
+        self._calls += 1
+        self.counts["alloc_calls"] += 1
+        if self.p_alloc_oom and \
+                self.rng.random_sample() < self.p_alloc_oom:
+            self.counts["alloc_oom"] += 1
+            self.log.append((self._calls, "alloc_oom"))
+            raise KVCacheExhausted("chaos: injected allocator OOM")
+
+    def before_call(self, engine, kind: str):
+        """ServingEngine._device_call consults this ahead of every
+        dispatch/fetch; `kind` is 'dispatch:*' or 'collect:*'. Raising
+        here is always retry-safe: the underlying call has not run, so
+        nothing was donated or consumed."""
+        self._calls += 1
+        self.counts["device_calls"] += 1
+        if self.p_latency and \
+                self.rng.random_sample() < self.p_latency:
+            self.counts["latency_spikes"] += 1
+            self.log.append((self._calls, f"latency:{kind}"))
+            time.sleep(self.latency_s)
+        if kind.startswith("collect"):
+            if self.p_collect and \
+                    self.rng.random_sample() < self.p_collect:
+                self.counts["collect_faults"] += 1
+                self.log.append((self._calls, kind))
+                raise InjectedCollectError(
+                    f"chaos: injected collection fault at {kind}")
+        else:
+            if self.p_dispatch and \
+                    self.rng.random_sample() < self.p_dispatch:
+                self.counts["dispatch_faults"] += 1
+                self.log.append((self._calls, kind))
+                raise InjectedDispatchError(
+                    f"chaos: injected dispatch fault at {kind}")

@@ -42,3 +42,9 @@ def _seed():
     paddle.seed(1234)
     np.random.seed(1234)
     yield
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running test excluded from tier-1 "
+                   "(-m 'not slow'); covered by dedicated gates")

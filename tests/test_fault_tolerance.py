@@ -1,0 +1,623 @@
+"""Fault-tolerant serving (ISSUE 4): deadlines, cancellation,
+preemption-with-recompute, bounded retry, overload shedding and the
+deterministic chaos harness.
+
+The load-bearing property throughout: a fault touches ONLY the faulted
+request — every other request must finish with TOKEN-IDENTICAL output
+to a fault-free run, and the KV pool invariant (debug_check) must hold
+after every scheduler step (PADDLE_TPU_POOL_DEBUG=1 below makes the
+engine assert it itself)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference import (EngineOverloaded, SamplingParams,
+                                  ServingEngine)
+from paddle_tpu.ops.paged_attention import KVCacheExhausted
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(llama_tiny())
+    m.eval()
+    return m
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_batch_size", 2)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", (8, 16, 32))
+    kw.setdefault("retry_backoff_s", 0.0)
+    return ServingEngine(model, **kw)
+
+
+def _prompts(model, n=3, seed=42):
+    rng = np.random.RandomState(seed)
+    lens = [5, 12, 20, 9, 16][:n]
+    news = [10, 8, 12, 6, 9][:n]
+    vocab = model.cfg.vocab_size
+    return [(rng.randint(0, vocab, (l,)).astype(np.int32),
+             SamplingParams(max_new_tokens=m))
+            for l, m in zip(lens, news)]
+
+
+def _clean_outputs(model, reqs, **kw):
+    eng = _engine(model, **kw)
+    rids = [eng.add_request(p, s) for p, s in reqs]
+    eng.run_to_completion()
+    return [eng.result(r).tolist() for r in rids]
+
+
+class TestCancel:
+    def test_cancel_queued(self, model):
+        eng = _engine(model, max_batch_size=1)
+        reqs = _prompts(model, 3)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        # batch 1: rids[1]/rids[2] start queued
+        assert eng.cancel(rids[2]) is True
+        eng.run_to_completion()
+        assert eng.request(rids[2]).state == "aborted"
+        assert eng.request(rids[2]).error == "cancelled"
+        assert eng.result(rids[2]).size == 0
+        clean = _clean_outputs(model, reqs[:2], max_batch_size=1)
+        for rid, want in zip(rids[:2], clean):
+            assert eng.result(rid).tolist() == want
+        assert eng.stats()["aborted"] == 1
+
+    def test_cancel_running_releases_pool(self, model):
+        eng = _engine(model)
+        reqs = [(p, SamplingParams(max_new_tokens=24))
+                for p, _ in _prompts(model, 2)]
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        for _ in range(3):
+            eng.step()
+        assert eng.cancel(rids[1]) is True
+        eng.run_to_completion()
+        req = eng.request(rids[1])
+        assert req.state == "aborted" and req.t_done is not None
+        # survivor token-identical to a solo run
+        solo = _clean_outputs(model, reqs[:1])
+        assert eng.result(rids[0]).tolist() == solo[0]
+        # every non-scratch page is back in free/cached
+        cache = eng.dec.cache
+        assert cache.free_blocks + cache.cached_blocks \
+            == cache.num_blocks - 1
+        cache.debug_check()
+
+    def test_cancel_mid_chunked_prefill_unwinds(self, model):
+        rng = np.random.RandomState(7)
+        vocab = model.cfg.vocab_size
+        eng = _engine(model, prefill_chunk=8, prompt_buckets=(8, 32))
+        long_p = rng.randint(0, vocab, (29,)).astype(np.int32)
+        rid = eng.add_request(long_p, SamplingParams(max_new_tokens=4))
+        eng.step()      # dispatches the first prefill chunk(s)
+        assert eng.cancel(rid) is True
+        while eng.step():
+            pass
+        assert eng.request(rid).state == "aborted"
+        cache = eng.dec.cache
+        assert cache.free_blocks + cache.cached_blocks \
+            == cache.num_blocks - 1
+        cache.debug_check()
+
+    def test_cancel_terminal_and_unknown(self, model):
+        eng = _engine(model)
+        reqs = _prompts(model, 1)
+        rid = eng.add_request(*reqs[0])
+        eng.run_to_completion()
+        assert eng.cancel(rid) is False          # already done
+        with pytest.raises(KeyError):
+            eng.cancel(12345)
+
+    def test_cancel_splice_writer_restarts_reader(self, model):
+        """Cancelling a mid-prefill writer whose un-dispatched blocks a
+        reader spliced must restart the reader (its splice points at
+        pages that will never be written) — and the reader must still
+        produce correct tokens via its own prefill."""
+        rng = np.random.RandomState(11)
+        vocab = model.cfg.vocab_size
+        shared = rng.randint(0, vocab, (24,)).astype(np.int32)
+        tailed = np.concatenate(
+            [shared, rng.randint(0, vocab, (6,)).astype(np.int32)])
+        # chunked prefill keeps the writer mid-prefill for several
+        # steps; budget 8 so the writer covers one chunk per step
+        eng = _engine(model, prefill_chunk=8, prompt_buckets=(8, 32),
+                      prefill_budget=8, max_batch_size=2)
+        w = eng.add_request(shared, SamplingParams(max_new_tokens=4))
+        r = eng.add_request(tailed[: 30], SamplingParams(max_new_tokens=4))
+        eng.step()                       # both admitted; writer mid-way
+        eng.cancel(w)
+        eng.run_to_completion()
+        assert eng.request(w).state == "aborted"
+        assert eng.request(r).state == "done"
+        clean = _clean_outputs(model, [(tailed[:30],
+                                        SamplingParams(max_new_tokens=4))],
+                               prefill_chunk=8, prompt_buckets=(8, 32))
+        assert eng.result(r).tolist() == clean[0]
+        eng.dec.cache.debug_check()
+
+    def test_cancel_writer_with_chained_readers_no_double_restart(
+            self, model):
+        """A reader depending on BOTH the cancelled writer and another
+        restarted reader appears twice in the restart cascade (directly
+        and via the recursion through the other reader) — it must be
+        requeued exactly once, or the duplicate's admission raises
+        'seq already allocated' out of step()."""
+        rng = np.random.RandomState(13)
+        vocab = model.cfg.vocab_size
+        shared = rng.randint(0, vocab, (16,)).astype(np.int32)
+        mid = rng.randint(0, vocab, (16,)).astype(np.int32)
+        full = np.concatenate([shared, mid])
+        eng = _engine(model, max_batch_size=3, prefill_chunk=8,
+                      prefill_budget=1, prompt_buckets=(16, 32))
+        w = eng.add_request(shared, SamplingParams(max_new_tokens=4))
+        r1 = eng.add_request(full, SamplingParams(max_new_tokens=4))
+        # r2 splices blocks pending on BOTH w (shared) and r1 (mid)
+        r2 = eng.add_request(full.copy(), SamplingParams(max_new_tokens=4))
+        eng._admit()            # all three slotted, nothing dispatched
+        eng.cancel(w)
+        ids = [q.req_id for q in eng._queue]
+        assert len(ids) == len(set(ids)), ids
+        eng.run_to_completion()
+        assert eng.request(w).state == "aborted"
+        assert eng.request(r1).state == "done"
+        assert eng.request(r2).state == "done"
+        clean = _clean_outputs(model, [(full,
+                                        SamplingParams(max_new_tokens=4))],
+                               prefill_chunk=8, prompt_buckets=(16, 32))
+        assert eng.result(r1).tolist() == clean[0]
+        assert eng.result(r2).tolist() == clean[0]
+        eng.dec.cache.debug_check()
+
+    def test_cancel_splice_writer_with_decode_in_flight(self, model):
+        """Restarting a reader while another request keeps chunks in
+        flight must free the reader's old allocation IMMEDIATELY — a
+        free deferred to collection lands after the next _admit already
+        tried to re-allocate the reader's seq, which raised out of
+        step() and wedged the engine."""
+        rng = np.random.RandomState(2)
+        vocab = model.cfg.vocab_size
+        shared = rng.randint(0, vocab, (24,)).astype(np.int32)
+        tail = rng.randint(0, vocab, (6,)).astype(np.int32)
+        reader_p = np.concatenate([shared, tail])[:30]
+        decoy = rng.randint(0, vocab, (8,)).astype(np.int32)
+        eng = _engine(model, max_batch_size=3, prefill_chunk=8,
+                      prefill_budget=8, prompt_buckets=(8, 32))
+        a = eng.add_request(decoy, SamplingParams(max_new_tokens=30))
+        for _ in range(3):
+            eng.step()          # decoy decodes, pipeline stays non-empty
+        w = eng.add_request(shared, SamplingParams(max_new_tokens=4))
+        r = eng.add_request(reader_p, SamplingParams(max_new_tokens=4))
+        eng.step()              # writer mid-prefill, reader spliced
+        eng.cancel(w)
+        eng.run_to_completion()
+        assert eng.request(w).state == "aborted"
+        assert eng.request(r).state == "done"
+        assert eng.request(a).state == "done"
+        clean = _clean_outputs(model, [(reader_p,
+                                        SamplingParams(max_new_tokens=4))],
+                               prefill_chunk=8, prompt_buckets=(8, 32))
+        assert eng.result(r).tolist() == clean[0]
+        eng.dec.cache.debug_check()
+
+
+class TestDeadlines:
+    def test_expired_deadline_aborts_with_partial_output(self, model):
+        eng = _engine(model)
+        reqs = _prompts(model, 2)
+        ok = eng.add_request(*reqs[0])
+        doomed = eng.add_request(
+            reqs[1][0], SamplingParams(max_new_tokens=8,
+                                       deadline_s=1e-6))
+        eng.run_to_completion()
+        assert eng.request(doomed).state == "aborted"
+        assert "deadline" in eng.request(doomed).error
+        assert eng.stats()["deadline_misses"] == 1
+        # the in-budget request is untouched
+        clean = _clean_outputs(model, reqs[:1])
+        assert eng.result(ok).tolist() == clean[0]
+
+    def test_generous_deadline_finishes(self, model):
+        eng = _engine(model)
+        (p, _), = _prompts(model, 1)
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=6,
+                                                deadline_s=300.0))
+        eng.run_to_completion()
+        assert eng.request(rid).state == "done"
+        assert eng.stats()["deadline_misses"] == 0
+
+
+class TestShedding:
+    def test_queue_depth_cap(self, model):
+        eng = _engine(model, max_batch_size=1, max_queue_depth=1)
+        reqs = _prompts(model, 3)
+        eng.add_request(*reqs[0])       # claims the only slot at step
+        eng.step()
+        eng.add_request(*reqs[1])       # queued (depth 1)
+        with pytest.raises(EngineOverloaded):
+            eng.add_request(*reqs[2])
+        assert eng.stats()["shed_requests"] == 1
+        eng.run_to_completion()
+
+    def test_deadline_math_sheds_infeasible_request(self, model):
+        eng = _engine(model)
+        reqs = _prompts(model, 2)
+        eng.add_request(*reqs[0])
+        eng.run_to_completion()          # establishes a token rate
+        # an absurd deadline no backlog estimate can meet
+        with pytest.raises(EngineOverloaded):
+            eng.add_request(reqs[1][0],
+                            SamplingParams(max_new_tokens=200,
+                                           deadline_s=1e-9))
+        assert eng.stats()["shed_requests"] == 1
+        # without a deadline the same request is admitted normally
+        rid = eng.add_request(reqs[1][0],
+                              SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        assert eng.request(rid).state == "done"
+
+
+class TestDispatchFaults:
+    def test_failed_prefill_fails_one_request_others_identical(
+            self, model):
+        """The crash-safety satellite: a dispatch raising mid-step must
+        fail that request alone — everyone else finishes
+        token-identically and the pool invariant holds."""
+        reqs = _prompts(model, 3)
+        clean = _clean_outputs(model, reqs)
+
+        eng = _engine(model, max_dispatch_retries=0)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        # fail exactly ONE final-prefill dispatch (prompts land in
+        # different buckets, so finals are separate dispatches)
+        orig = eng._prefill_j
+        state = {"tripped": False}
+
+        def flaky(*args, **kw):
+            if not state["tripped"]:
+                state["tripped"] = True
+                raise RuntimeError("transient device error (test)")
+            return orig(*args, **kw)
+
+        eng._prefill_j = flaky
+        eng.run_to_completion()
+        assert state["tripped"]
+        failed = [r for r in rids
+                  if eng.request(r).state == "failed"]
+        assert len(failed) >= 1
+        st = eng.stats()
+        assert st["failed"] == len(failed)
+        for rid, want in zip(rids, clean):
+            if eng.request(rid).state == "done":
+                assert eng.result(rid).tolist() == want
+        assert "dispatch failed" in eng.request(failed[0]).error
+        eng.dec.cache.debug_check()
+        cache = eng.dec.cache
+        assert cache.free_blocks + cache.cached_blocks \
+            == cache.num_blocks - 1
+
+    def test_transient_fault_retried_token_identical(self, model):
+        """With retry budget left, a transient dispatch error is
+        invisible: same args, same PRNG key, identical tokens."""
+        reqs = _prompts(model, 3)
+        clean = _clean_outputs(model, reqs)
+        eng = _engine(model, max_dispatch_retries=2)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        orig = eng._decode_j
+        state = {"raised": 0}
+
+        def flaky(*args, **kw):
+            if state["raised"] < 2:
+                state["raised"] += 1
+                raise RuntimeError("transient decode error (test)")
+            return orig(*args, **kw)
+
+        eng._decode_j = flaky
+        eng.run_to_completion()
+        assert state["raised"] == 2
+        assert eng.stats()["retries"] >= 2
+        for rid, want in zip(rids, clean):
+            assert eng.request(rid).state == "done"
+            assert eng.result(rid).tolist() == want
+
+    def test_failed_decode_collection_is_contained(self, model):
+        """A collection fetch that keeps failing fails the chunk's
+        requests but never the engine."""
+        reqs = _prompts(model, 2)
+        eng = _engine(model, max_dispatch_retries=0)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        # drive past prefill so decode chunks are flowing, then poison
+        # the NEXT decode collection (retries=0 makes it permanent)
+        for _ in range(4):
+            eng.step()
+        orig = eng._device_call
+        state = {"armed": 0}
+
+        def flaky(kind, fn, *args):
+            if kind == "collect:decode" and state["armed"] == 0:
+                state["armed"] = 1
+                raise RuntimeError("torn read (test)")
+            return orig(kind, fn, *args)
+
+        eng._device_call = flaky
+        eng.run_to_completion()
+        eng._device_call = orig
+        states = {r: eng.request(r).state for r in rids}
+        assert set(states.values()) <= {"done", "failed"}
+        eng.dec.cache.debug_check()
+
+
+class TestPreemption:
+    def test_oom_preemption_recomputes_token_identical(self, model):
+        """Optimistic admission oversubscribes a small pool; pressure
+        preempts the newest request, whose recompute must reproduce the
+        worst-case-admission output exactly (greedy)."""
+        reqs = [(p, SamplingParams(max_new_tokens=40))
+                for p, _ in _prompts(model, 2)]
+        clean = _clean_outputs(model, reqs, num_blocks=64)
+        eng = _engine(model, num_blocks=8, admission="optimistic")
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["preemptions"] >= 1
+        assert st["recompute_tokens"] > 0
+        for rid, want in zip(rids, clean):
+            assert eng.request(rid).state == "done"
+            assert eng.result(rid).tolist() == want
+        eng.dec.cache.debug_check()
+
+    def test_priority_protects_high_priority_request(self, model):
+        """Victim selection is lowest-priority-first: under pressure
+        the LOW priority request is the one preempted."""
+        (p0, _), (p1, _) = _prompts(model, 2)
+        eng = _engine(model, num_blocks=8, admission="optimistic")
+        hi = eng.add_request(
+            p0, SamplingParams(max_new_tokens=40, priority=1))
+        lo = eng.add_request(
+            p1, SamplingParams(max_new_tokens=40, priority=0))
+        eng.run_to_completion()
+        assert eng.stats()["preemptions"] >= 1
+        assert eng.request(hi).state == "done"
+        assert eng.request(lo).state == "done"
+        # the high-priority request was never preempted: it finished
+        # strictly earlier despite being older (the preempted one waits
+        # out the recompute)
+        assert eng.request(hi).t_done <= eng.request(lo).t_done
+
+    def test_mid_chunk_victim_rows_neutralized(self, model):
+        """Regression: a victim preempted while the decode chunk is
+        mid-build frees blocks a LATER slot of the SAME chunk may take.
+        Its already-scheduled rows must be re-aimed at the scratch page
+        or both rows write K/V to the same flat slots within one
+        program, silently corrupting the SURVIVOR. Priorities force
+        the victim to be the OLDER request sitting in slot 0 — i.e.
+        scheduled before the slot whose extend hits the pressure."""
+        (p0, _), (p1, _) = _prompts(model, 2)
+        reqs = [(p0, SamplingParams(max_new_tokens=40, priority=0)),
+                (p1, SamplingParams(max_new_tokens=40, priority=5))]
+        clean = _clean_outputs(model, reqs, num_blocks=64)
+        eng = _engine(model, num_blocks=8, admission="optimistic")
+        lo = eng.add_request(*reqs[0])   # slot 0, LOW priority victim
+        hi = eng.add_request(*reqs[1])   # slot 1, protected
+        eng.run_to_completion()
+        assert eng.stats()["preemptions"] >= 1
+        assert eng.result(hi).tolist() == clean[1]   # survivor intact
+        assert eng.result(lo).tolist() == clean[0]   # victim recomputed
+        eng.dec.cache.debug_check()
+
+    def test_prefill_group_victim_in_later_sub_skipped(self, model):
+        """An injected KV exhaustion while dispatching sub-group 1 of
+        a >PREFILL_GROUP prefill burst picks the NEWEST prefilling
+        request as victim — a member of not-yet-dispatched sub-group 2.
+        Its stale row must be skipped (it re-enters through the queue),
+        not dispatched against the freed seq (KeyError out of step())."""
+        rng = np.random.RandomState(21)
+        vocab = model.cfg.vocab_size
+        reqs = [(rng.randint(0, vocab, (8,)).astype(np.int32),
+                 SamplingParams(max_new_tokens=6)) for _ in range(5)]
+        clean = _clean_outputs(model, reqs, max_batch_size=5)
+        eng = _engine(model, max_batch_size=5)
+        cache = eng.dec.cache
+        orig_extend, calls = cache.extend, {"n": 0}
+
+        def failing_extend(seq_id):
+            calls["n"] += 1
+            if calls["n"] == 1:     # first extend of sub-group 1
+                raise KVCacheExhausted("injected")
+            return orig_extend(seq_id)
+
+        cache.extend = failing_extend
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        eng.step()                  # admit 5, dispatch subs of 4 + 1
+        cache.extend = orig_extend
+        assert eng.stats()["preemptions"] >= 1
+        eng.run_to_completion()
+        for rid, want in zip(rids, clean):
+            assert eng.request(rid).state == "done"
+            assert eng.result(rid).tolist() == want
+        eng.dec.cache.debug_check()
+
+    def test_mid_chunk_rich_victim_drops_rich_sampling(self, model):
+        """A neutralized victim must not leave its rich-sampling flag
+        (or seen-matrix contribution) behind — the chunk's surviving
+        all-greedy rows would ride the rich program (unwarmed XLA
+        variant + [mb, vocab] seen shipping). Every rich dispatch must
+        coincide with a rich request actually holding a slot."""
+        (p0, _), (p1, _) = _prompts(model, 2)
+        clean = _clean_outputs(model,
+                               [(p1, SamplingParams(max_new_tokens=40))],
+                               num_blocks=64)
+        eng = _engine(model, num_blocks=8, admission="optimistic")
+        rich_had_rich_slot = []
+        orig = eng._decode_rich_j
+
+        def spy(*a, **k):
+            rich_had_rich_slot.append(any(
+                r is not None and r.state == "running"
+                and r.sampling.needs_rich_sampling
+                for r in eng._slots))
+            return orig(*a, **k)
+
+        eng._decode_rich_j = spy
+        lo = eng.add_request(p0, SamplingParams(
+            max_new_tokens=40, priority=0, temperature=0.8, top_p=0.9,
+            repetition_penalty=1.3))
+        hi = eng.add_request(p1, SamplingParams(max_new_tokens=40,
+                                                priority=5))
+        eng.run_to_completion()
+        assert eng.stats()["preemptions"] >= 1
+        assert eng.result(hi).tolist() == clean[0]   # greedy survivor
+        assert eng.request(lo).state == "done"
+        assert all(rich_had_rich_slot), rich_had_rich_slot
+        eng.dec.cache.debug_check()
+
+    def test_gpt_preemption_recompute_token_identical(self):
+        """The GPT twin must survive preemption-resume too — this
+        pins the recompute tail chunk's position clamp (learned
+        position embeddings gather with jnp.take, whose out-of-bounds
+        default is NaN fill: one unclamped pad position past
+        max_position_embeddings poisons the whole chunk through
+        0 * NaN)."""
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import PagedGPTDecoder
+        paddle.seed(0)
+        cfg = gpt_tiny()
+        gm = GPTForCausalLM(cfg)
+        gm.eval()
+        rng = np.random.RandomState(0)
+        ps = [rng.randint(0, cfg.vocab_size, (8,)).astype(np.int32)
+              for _ in range(2)]
+
+        def run(nb, adm):
+            dec = PagedGPTDecoder(gm, num_blocks=nb, block_size=8)
+            eng = ServingEngine(dec, max_batch_size=2,
+                                prompt_buckets=(8, 16, 32),
+                                admission=adm, retry_backoff_s=0.0)
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=40)) for p in ps]
+            eng.run_to_completion()
+            return [eng.result(r).tolist() for r in rids], eng.stats()
+
+        clean, _ = run(64, "worst_case")
+        got, st = run(8, "optimistic")
+        assert st["preemptions"] >= 1
+        assert got == clean
+
+    def test_injected_alloc_oom_triggers_preemption(self, model):
+        """A chaos-injected allocator OOM at decode-extend time walks
+        the same preemption path as genuine pressure."""
+        from paddle_tpu.utils.chaos import ChaosMonkey
+        reqs = [(p, SamplingParams(max_new_tokens=24))
+                for p, _ in _prompts(model, 2)]
+        clean = _clean_outputs(model, reqs)
+        eng = _engine(model)
+        monkey = ChaosMonkey(seed=5, p_alloc_oom=0.25).attach(eng)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        eng.run_to_completion()
+        monkey.detach(eng)
+        assert monkey.counts["alloc_oom"] >= 1
+        for rid, want in zip(rids, clean):
+            if eng.request(rid).state == "done":
+                assert eng.result(rid).tolist() == want
+        eng.dec.cache.debug_check()
+
+    def test_no_recompute_decoder_fails_instead_of_preempting(
+            self, model):
+        """Regression: on a decoder without chunk programs
+        (_can_recompute False) the self-preemption fallback in
+        _dispatch_chunk must FAIL the exhausted request — preempting
+        would re-admit it into a resume path whose programs were never
+        built and raise AttributeError out of step(). Requests the
+        pool cannot hold fail individually (a failed running request's
+        frees are deferred to collection, so BOTH of a colliding pair
+        may fail); any that finish must be token-identical."""
+        (p0, _), (p1, _) = _prompts(model, 2)
+        # combined growth (6 + 7 blocks) outruns the 8-block pool while
+        # BOTH are live, so extends must hit the empty pool
+        reqs = [(p0, SamplingParams(max_new_tokens=40)),
+                (p1, SamplingParams(max_new_tokens=40))]
+        clean = _clean_outputs(model, reqs, num_blocks=64)
+        eng = _engine(model, num_blocks=8, admission="optimistic")
+        eng._can_recompute = False
+        rids = [eng.add_request(*r) for r in reqs]
+        eng.run_to_completion()   # must not raise
+        st = eng.stats()
+        assert st["preemptions"] == 0 and st["failed"] >= 1
+        n_failed = 0
+        for rid, want in zip(rids, clean):
+            req = eng.request(rid)   # all terminal: engine quiesced
+            if req.state == "done":
+                assert eng.result(rid).tolist() == want
+            else:
+                assert req.state == "failed"
+                assert "recompute" in req.error
+                n_failed += 1
+        assert n_failed == st["failed"]
+        eng.dec.cache.debug_check()
+
+
+class TestChaosSchedule:
+    @pytest.mark.slow
+    def test_seeded_chaos_run_token_identity(self, model):
+        """A randomized 120-step chaos schedule (OOMs + dispatch +
+        collect faults + cancels) with per-step invariant checks: every
+        surviving request is token-identical to the fault-free run."""
+        import importlib.util
+        spec = importlib.util.spec_from_file_location(
+            "chaos_serving",
+            os.path.join(os.path.dirname(__file__), os.pardir,
+                         "tools", "chaos_serving.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        args = mod.argparse.Namespace(
+            steps=120, requests=10, seed=1, num_blocks=14, retries=1,
+            p_oom=0.05, p_dispatch=0.05, p_collect=0.03, p_latency=0.0,
+            vocab=model.cfg.vocab_size)
+        base, _, _, _ = mod.run_schedule(model, args, chaotic=False)
+        chaos, eng, monkey, _ = mod.run_schedule(model, args,
+                                                 chaotic=True)
+        assert monkey.counts["dispatch_faults"] >= 1
+        for ordinal, (state, toks, err) in chaos.items():
+            if state == "done":
+                assert toks == base[ordinal][1], \
+                    f"ordinal {ordinal} diverged under chaos"
+        eng.dec.cache.debug_check()
+
+
+class TestCountersAndStats:
+    def test_robustness_counters_present_and_reset(self, model):
+        eng = _engine(model)
+        st = eng.stats()
+        for key in ("preemptions", "recompute_tokens", "aborted",
+                    "failed", "deadline_misses", "shed_requests",
+                    "retries"):
+            assert st[key] == 0
+        (p, sp), = _prompts(model, 1)
+        rid = eng.add_request(p, sp)
+        eng.cancel(rid)
+        eng.run_to_completion()
+        assert eng.stats()["aborted"] == 1
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["aborted"] == 0 and st["finished"] == 0
+
+    def test_finished_excludes_fault_states(self, model):
+        eng = _engine(model)
+        reqs = _prompts(model, 2)
+        ok = eng.add_request(*reqs[0])
+        bad = eng.add_request(reqs[1][0],
+                              SamplingParams(max_new_tokens=4,
+                                             deadline_s=1e-6))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["finished"] == 1
+        assert st["aborted"] == 1
+        assert eng.request(ok).state == "done"
+        assert eng.request(bad).state == "aborted"

@@ -90,3 +90,69 @@ def test_trainstep_heartbeat(monkeypatch):
     finally:
         wd.stop()
         monkeypatch.setattr(W, "_default", None)
+
+
+class TestEngineWatchdog:
+    """watch_engine (ISSUE 4 satellite): the serving stall detector
+    wraps ServingEngine.step() and dumps per-request scheduler state +
+    cache stats in the hang report."""
+
+    def _engine(self):
+        from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+        from paddle_tpu.inference import ServingEngine
+        paddle.seed(0)
+        model = LlamaForCausalLM(llama_tiny())
+        model.eval()
+        return ServingEngine(model, max_batch_size=2, num_blocks=32,
+                             block_size=8, prompt_buckets=(8, 16))
+
+    def test_stalled_engine_dumps_request_states(self):
+        from paddle_tpu.distributed.watchdog import watch_engine
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine()
+        rid = eng.add_request(np.arange(1, 7, dtype=np.int32),
+                              SamplingParams(max_new_tokens=4))
+        reports = []
+        wd = watch_engine(eng, timeout=0.25, poll_interval=0.05,
+                          on_hang=reports.append)
+        try:
+            # never step: the engine is wedged from the watchdog's view
+            deadline = time.monotonic() + 4.0
+            while not reports and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert reports, "engine watchdog never reported the stall"
+        text = reports[0]
+        assert "serving engine state" in text
+        assert "queue depth=1" in text          # the queued request
+        assert f"ids=[{rid}]" in text
+        assert "free_blocks=" in text           # cache occupancy dumped
+        assert "preemptions=0" in text          # robustness counters
+
+    def test_healthy_stepping_engine_stays_quiet(self):
+        from paddle_tpu.distributed.watchdog import watch_engine
+        eng = self._engine()
+        reports = []
+        wd = watch_engine(eng, timeout=0.5, poll_interval=0.05,
+                          on_hang=reports.append)
+        try:
+            for _ in range(12):
+                eng.step()          # idle engine: cheap no-op steps
+                time.sleep(0.05)
+            assert not reports
+        finally:
+            wd.stop()
+        # the section wrapper reports a WEDGED step too: simulate one
+        # by entering the section without completing it
+        reports2 = []
+        wd2 = watch_engine(eng, timeout=0.2, poll_interval=0.05,
+                           on_hang=reports2.append)
+        try:
+            with wd2.section("ServingEngine.step", timeout=0.2):
+                deadline = time.monotonic() + 4.0
+                while not reports2 and time.monotonic() < deadline:
+                    time.sleep(0.05)
+        finally:
+            wd2.stop()
+        assert reports2 and "ServingEngine.step" in reports2[0]

@@ -1,0 +1,229 @@
+#!/usr/bin/env python
+"""Deterministic chaos harness for the ServingEngine (ISSUE 4 gate).
+
+Drives the engine through a seeded randomized schedule of arrivals,
+cancellations and injected faults — allocator OOMs, dispatch
+exceptions, collection faults, latency spikes — while asserting
+``PagedKVCache.debug_check()`` after EVERY scheduler step, then replays
+the identical arrival schedule on a fault-free engine and demands that
+every request the chaos engine completed ("done") produced
+TOKEN-IDENTICAL output. Requests the chaos run cancelled / failed /
+shed are the "faulted" set and are reported, not compared.
+
+The run is deterministic end to end (one seed feeds the workload
+generator and the ChaosMonkey; sampling is greedy), so a failure here
+is a reproducible bug, not a flake.
+
+    python tools/chaos_serving.py                      # 200-step run
+    python tools/chaos_serving.py --steps 60 --require-events
+    python tools/chaos_serving.py --seed 3 --p-dispatch 0.1
+
+Exit code is non-zero on: an engine crash, a debug_check violation, a
+token mismatch, or (with --require-events) a schedule that failed to
+exercise at least one OOM-driven preemption, one injected dispatch
+fault AND one cancellation/abort. Prints one JSON summary line
+(BENCH-style extra dict).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+
+def build_engine(model, args):
+    from paddle_tpu.inference import ServingEngine
+    return ServingEngine(
+        model, max_batch_size=3, num_blocks=args.num_blocks,
+        block_size=8, prompt_buckets=(8, 16, 32), chunk_size=4,
+        prefill_chunk=8,
+        admission="optimistic",
+        max_dispatch_retries=args.retries,
+        retry_backoff_s=0.0)
+
+
+def gen_workload(args):
+    """Seeded arrival/cancel schedule, independent of engine state so
+    the chaos and fault-free runs see the same traffic."""
+    rng = np.random.RandomState(args.seed)
+    # shared block-aligned prefix templates: ~half the prompts open
+    # with one of these, so requests form splice dependencies (prefix
+    # cache hits, splice-pending readers) and cancels/preemptions hit
+    # writers with dependent readers — the riskiest recovery paths
+    templates = [rng.randint(0, args.vocab, (24,)).astype(np.int32)
+                 for _ in range(2)]
+    arrivals = []   # (step, prompt, max_new)
+    step = 0
+    while len(arrivals) < args.requests:
+        step += int(rng.randint(1, max(2, args.steps // args.requests)))
+        plen = int(rng.choice([5, 8, 12, 16, 21, 32]))
+        prompt = rng.randint(0, args.vocab, plen).astype(np.int32)
+        if rng.random_sample() < 0.5:
+            t = templates[int(rng.randint(len(templates)))]
+            keep = int(rng.choice([8, 16, 24]))
+            prompt = np.concatenate([t[:keep], prompt])[:32]
+        # decode-heavy budgets: optimistic admission reserves only the
+        # prefill's pages, so long decodes are what actually
+        # oversubscribe the pool and exercise preemption
+        max_new = int(rng.randint(8, 33))
+        arrivals.append((step % max(1, args.steps - 5), prompt, max_new))
+    arrivals.sort(key=lambda a: a[0])
+    # cancel ~10% of arrivals a few steps after they land; small
+    # schedules can draw zero, so force one mid-window cancel — the
+    # unwind/restart recovery paths must be exercised by every run
+    cancels = {}    # step -> [arrival ordinal]
+    n_cancels = 0
+    for i in range(len(arrivals)):
+        if rng.random_sample() < 0.1:
+            cstep = arrivals[i][0] + int(rng.randint(1, 6))
+            cancels.setdefault(cstep, []).append(i)
+            n_cancels += 1
+    if not n_cancels and arrivals:
+        i = len(arrivals) // 2
+        cancels.setdefault(arrivals[i][0] + 2, []).append(i)
+    return arrivals, cancels
+
+
+def run_schedule(model, args, chaotic: bool):
+    """One full run; returns (results-by-ordinal, engine, monkey)."""
+    from paddle_tpu.inference import SamplingParams
+    from paddle_tpu.utils.chaos import ChaosMonkey
+
+    eng = build_engine(model, args)
+    monkey = None
+    if chaotic:
+        monkey = ChaosMonkey(
+            seed=args.seed + 1, p_alloc_oom=args.p_oom,
+            p_dispatch=args.p_dispatch, p_collect=args.p_collect,
+            p_latency=args.p_latency).attach(eng)
+    arrivals, cancels = gen_workload(args)
+    rid_of = {}
+    next_arrival = 0
+    steps_run = 0
+
+    def inject_step_events(step):
+        nonlocal next_arrival
+        while next_arrival < len(arrivals) \
+                and arrivals[next_arrival][0] <= step:
+            _, prompt, max_new = arrivals[next_arrival]
+            rid_of[next_arrival] = eng.add_request(
+                prompt, SamplingParams(max_new_tokens=max_new))
+            next_arrival += 1
+        if chaotic:
+            for ordinal in cancels.get(step, ()):
+                rid = rid_of.get(ordinal)
+                if rid is not None and rid not in eng._done:
+                    eng.cancel(rid)
+
+    for step in range(args.steps):
+        inject_step_events(step)
+        eng.step()
+        eng.dec.cache.debug_check()
+        steps_run += 1
+    # drain (chaos stays attached: the tail is chaotic too; schedule
+    # events keep firing so nothing lands silently past the window)
+    drain_cap = 50 * args.steps
+    step = args.steps
+    while eng.has_work and drain_cap > 0:
+        inject_step_events(step)
+        eng.step()
+        eng.dec.cache.debug_check()
+        steps_run += 1
+        step += 1
+        drain_cap -= 1
+    if eng.has_work:
+        raise RuntimeError("engine failed to drain (livelock?)")
+    results = {}
+    for ordinal, rid in rid_of.items():
+        req = eng.request(rid)
+        results[ordinal] = (req.state, list(req.out_tokens), req.error)
+    return results, eng, monkey, steps_run
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--requests", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--num-blocks", type=int, default=14)
+    ap.add_argument("--retries", type=int, default=1)
+    ap.add_argument("--p-oom", type=float, default=0.05)
+    ap.add_argument("--p-dispatch", type=float, default=0.04)
+    ap.add_argument("--p-collect", type=float, default=0.03)
+    ap.add_argument("--p-latency", type=float, default=0.02)
+    ap.add_argument("--require-events", action="store_true",
+                    help="fail unless >=1 preemption, >=1 injected "
+                         "dispatch fault and >=1 cancellation/abort "
+                         "actually happened")
+    args = ap.parse_args()
+    args.vocab = None
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    paddle.seed(0)
+    cfg = llama_tiny()
+    args.vocab = cfg.vocab_size
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+
+    base_results, base_eng, _, _ = run_schedule(model, args,
+                                                chaotic=False)
+    chaos_results, eng, monkey, steps_run = run_schedule(model, args,
+                                                         chaotic=True)
+
+    mismatches = []
+    done = faulted = 0
+    for ordinal, (state, toks, err) in sorted(chaos_results.items()):
+        if state == "done":
+            done += 1
+            bstate, btoks, _ = base_results[ordinal]
+            if toks != btoks:
+                mismatches.append(
+                    {"ordinal": ordinal, "chaos": toks, "base": btoks})
+        else:
+            faulted += 1
+    st = eng.stats()
+    summary = {
+        "steps": steps_run,
+        "requests": len(chaos_results),
+        "done_identical": done - len(mismatches),
+        "mismatches": len(mismatches),
+        "faulted": faulted,
+        "preemptions": st["preemptions"],
+        "recompute_tokens": st["recompute_tokens"],
+        "retries": st["retries"],
+        "aborted": st["aborted"],
+        "failed": st["failed"],
+        "injected": dict(monkey.counts),
+    }
+    ok = not mismatches
+    if args.require_events:
+        missing = []
+        if st["preemptions"] < 1:
+            missing.append("oom_preemption")
+        if monkey.counts.get("dispatch_faults", 0) < 1:
+            missing.append("dispatch_fault")
+        if st["aborted"] < 1:
+            missing.append("cancellation")
+        if missing:
+            summary["missing_events"] = missing
+            ok = False
+    summary["ok"] = ok
+    print(json.dumps(summary))
+    if mismatches:
+        for m in mismatches[:4]:
+            print(f"MISMATCH ordinal {m['ordinal']}: chaos={m['chaos']}"
+                  f" base={m['base']}", file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,7 +1,9 @@
 #!/usr/bin/env python
-"""Serving-path checker gate: RUNTIME invariants + STATIC analysis in
-one entry point (ISSUE 1 satellite; extended for the ISSUE 2 chunked-
-prefill schedules; ISSUE 3 added the flightcheck static half).
+"""Serving-path checker gate: RUNTIME invariants + STATIC analysis +
+CHAOS in one entry point (ISSUE 1 satellite; extended for the ISSUE 2
+chunked-prefill schedules; ISSUE 3 added the flightcheck static half;
+ISSUE 4 added the fault-tolerance tests and the deterministic chaos
+phase).
 
 Phase 1 — static: runs the flightcheck suite (tools/flightcheck) over
 ``paddle_tpu/inference/`` — tracer safety, recompilation hazards,
@@ -43,6 +45,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_prefix_cache.py"),
     os.path.join(REPO, "tests", "test_chunked_prefill.py"),
     os.path.join(REPO, "tests", "test_serving.py"),
+    os.path.join(REPO, "tests", "test_fault_tolerance.py"),
 ]
 
 
@@ -62,8 +65,27 @@ def run_flightcheck() -> int:
     return 0
 
 
+def run_chaos() -> int:
+    """Chaos phase (ISSUE 4): a short DETERMINISTIC fault-injection
+    schedule — seeded OOMs, dispatch faults, collect faults and
+    cancellations over an optimistically-admitted engine — asserting
+    debug_check after every step and token identity of every surviving
+    request vs a fault-free replay. --require-events guarantees each
+    gate run exercised at least one OOM-driven preemption, one
+    injected dispatch failure and one cancellation."""
+    import subprocess
+    cmd = [sys.executable,
+           os.path.join(REPO, "tools", "chaos_serving.py"),
+           "--steps", "60", "--requests", "8", "--require-events"]
+    rc = subprocess.call(cmd)
+    print("CHAOS GATE OK — fault schedule survived, outputs identical"
+          if rc == 0 else f"CHAOS GATE FAILED (exit {rc})")
+    return rc
+
+
 def main() -> int:
     static_rc = run_flightcheck()
+    chaos_rc = run_chaos()
     import pytest
     args = TEST_FILES + ["-q", "-m", "not slow", "-p", "no:cacheprovider",
                          "-p", "no:randomly"] + sys.argv[1:]
@@ -71,7 +93,7 @@ def main() -> int:
     print(("POOL INVARIANTS OK — debug_check ran after every "
            "engine step") if rc == 0 else
           f"POOL INVARIANT GATE FAILED (pytest exit {rc})")
-    return int(rc) or static_rc
+    return int(rc) or static_rc or chaos_rc
 
 
 if __name__ == "__main__":

@@ -11,7 +11,11 @@
 #   2. flightcheck --jaxpr: trace the serving/paged-decode entry points
 #      and cross-check the AST verdicts + IR-level PRNG audit
 #   3. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
-#      serving-path tests; includes its own inference/ flightcheck)
+#      serving-path tests incl. test_fault_tolerance.py; includes its
+#      own inference/ flightcheck AND the deterministic chaos schedule
+#      — every gate run exercises >=1 OOM-preemption, >=1 injected
+#      dispatch failure and >=1 cancellation, with token-identity vs
+#      a fault-free replay)
 #   4. tier-1 pytest (tests/, -m 'not slow')
 set -u -o pipefail
 cd "$(dirname "$0")/.."
@@ -25,7 +29,7 @@ python -m tools.flightcheck paddle_tpu/ || rc=1
 echo "== [2/4] flightcheck --jaxpr: entry-point cross-check =="
 python -m tools.flightcheck --jaxpr paddle_tpu/inference/ || rc=1
 
-echo "== [3/4] serving invariants (runtime debug_check gate) =="
+echo "== [3/4] serving invariants (runtime debug_check + chaos gate) =="
 python tools/check_serving_invariants.py || rc=1
 
 if [ "${1:-}" != "--fast" ]; then
