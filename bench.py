@@ -1076,6 +1076,85 @@ def run_serving_degradation(weight_dtype=None):
     return out
 
 
+def run_serving_ragged(weight_dtype=None):
+    """Ragged unified prefill+decode batching A/B (the ISSUE-5
+    acceptance scenario): 6 short streams decode steadily, then a
+    512-token prompt lands mid-stream — the mixed regime where the
+    dense path pays merge + decode + per-prefill-chunk dispatches every
+    step while the ragged path runs ONE device program per step.
+    Headline: device dispatches per delivered token, ragged off / on
+    (the acceptance bar is >= 2x) at equal-or-better throughput/ITL,
+    with greedy outputs token-identical (re-checked here; the
+    preemption/fault cases are pinned by tests/test_ragged_batching.py
+    and the --ragged chaos gate)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    cfg = llama_small(dtype="bfloat16")
+    block_size = 32
+    n_short, short_len, short_new = 6, 96, 96
+    long_len, long_new = 512, 32
+    rng = np.random.RandomState(0)
+    shorts = [rng.randint(0, cfg.vocab_size, short_len).astype(np.int32)
+              for _ in range(n_short)]
+    longp = rng.randint(0, cfg.vocab_size, long_len).astype(np.int32)
+    n_blocks = (n_short * -(-(short_len + short_new) // block_size)
+                + -(-(long_len + long_new) // block_size) + 2)
+    out = {}
+    toks = {}
+    for tag, ragged in (("off", False), ("on", True)):
+        # model rebuilt per leg: the inter-leg barrier below deletes
+        # every live device array, a live model's weights included
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        eng = ServingEngine(
+            model, max_batch_size=n_short + 1, num_blocks=n_blocks,
+            block_size=block_size, prompt_buckets=(128, long_len),
+            weight_dtype=weight_dtype, chunk_size=8, prefill_chunk=32,
+            ragged=ragged)
+        eng.warmup()
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p,
+                                SamplingParams(max_new_tokens=short_new))
+                for p in shorts]
+        while eng.generated_tokens < n_short * short_new // 4:
+            eng.step()
+        rl = eng.add_request(longp,
+                             SamplingParams(max_new_tokens=long_new))
+        eng.run_to_completion()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        toks[tag] = [eng.result(r).tolist() for r in rids + [rl]]
+        out[f"serving_ragged_{tag}_tok_per_sec"] = round(
+            st["generated_tokens"] / wall, 1)
+        out[f"serving_ragged_{tag}_itl_p50_s"] = round(
+            st["itl_p50_s"], 4)
+        out[f"serving_ragged_{tag}_itl_p99_s"] = round(
+            st["itl_p99_s"], 4)
+        out[f"serving_ragged_{tag}_device_dispatches"] = \
+            st["device_dispatches"]
+        out[f"serving_ragged_{tag}_dispatch_per_tok"] = round(
+            st["device_dispatches"] / max(st["generated_tokens"], 1),
+            4)
+        out[f"serving_ragged_{tag}_tokens_per_dispatch"] = round(
+            st["tokens_per_dispatch"], 2)
+        out[f"serving_ragged_{tag}_padded_token_waste"] = \
+            st["padded_token_waste"]
+        out[f"serving_ragged_{tag}_wall_s"] = round(wall, 3)
+        del eng, model
+        # HBM barrier between the A/B legs: the off leg's dead engine
+        # stays pinned by jit caches until they're cleared (the same
+        # BENCH_r04 leak mode _suite_barrier guards between suites)
+        _clear_device_memory()
+    out["serving_ragged_dispatch_reduction_x"] = round(
+        out["serving_ragged_off_dispatch_per_tok"]
+        / max(out["serving_ragged_on_dispatch_per_tok"], 1e-9), 2)
+    out["serving_ragged_tokens_identical"] = toks["on"] == toks["off"]
+    return out
+
+
 def run_pp():
     """Pipeline-schedule efficiency microbench (VERDICT r3 #3): wall
     time per step, remat vs store-activations, on a 1-stage mesh on the
@@ -1291,6 +1370,39 @@ def _pp_bubble_measured(stage_fn, params, xs, build_pipeline_schedule):
     return out
 
 
+def _clear_device_memory():
+    """Drop every live device array (callers rebuild their model/engine
+    from scratch) and clear the jit caches that keep dead engines'
+    arrays pinned, so the next suite/leg starts from a clean HBM pool."""
+    import gc
+    import jax
+    gc.collect()
+    for arr in jax.live_arrays():
+        arr.delete()
+    jax.clear_caches()
+
+
+def _suite_barrier(tag, out):
+    """Inter-suite HBM barrier (BENCH_r04 lesson: one OOM'd suite
+    poisoned every later serving row with RESOURCE_EXHAUSTED after
+    mid8k). Records the suite's peak-memory watermark, then clears
+    device memory via _clear_device_memory. The TPU runtime's
+    peak_bytes_in_use is a process-lifetime high-water mark (not
+    resettable), so per-suite attribution reads as the JUMP between
+    consecutive rows; CPU backends report no memory_stats and just
+    skip the rows."""
+    import jax
+    try:
+        ms = jax.local_devices()[0].memory_stats() or {}
+    except Exception:
+        ms = {}
+    if "peak_bytes_in_use" in ms:
+        out[f"{tag}_peak_bytes_in_use"] = int(ms["peak_bytes_in_use"])
+    if "bytes_in_use" in ms:
+        out[f"{tag}_bytes_in_use"] = int(ms["bytes_in_use"])
+    _clear_device_memory()
+
+
 def run_serving_suite():
     """bf16 and int8 at c8 (the r4 open-loop protocol compiles 3 prompt
     buckets x 2 prefill widths per engine, so the c4 rows were dropped
@@ -1298,17 +1410,27 @@ def run_serving_suite():
     out = {}
     for wd in (None, "int8"):
         out.update(run_serving(weight_dtype=wd, concurrency=8))
+        _suite_barrier(f"serving_{'int8' if wd else 'bf16'}_c8", out)
     for wd in (None, "int8", "int4"):
         out.update(run_serving_capacity(concurrency=8, weight_dtype=wd))
+        _suite_barrier("serving_capacity" if wd is None
+                       else f"serving_capacity_{wd}", out)
     # shared-prefix A/B (automatic prefix caching): same serving-mode
     # timeout budget — two small engines, 8 requests each
     out.update(run_serving_prefix())
+    _suite_barrier("serving_prefix", out)
     # chunked-prefill A/B (stall-free interleaving): long prompt into a
     # running decode stream, ITL p99 of the running requests
     out.update(run_serving_interleave())
+    _suite_barrier("serving_interleave", out)
     # fault-tolerance A/B (deadlines + shedding under an overloaded
     # burst): goodput and deadline-miss rate, on vs off
     out.update(run_serving_degradation())
+    _suite_barrier("serving_degradation", out)
+    # ragged unified prefill+decode A/B: device dispatches per
+    # delivered token, one program per step vs the dense schedule
+    out.update(run_serving_ragged())
+    _suite_barrier("serving_ragged", out)
     # engine-vs-raw account (r5): the decode chunks run FASTER per step
     # on device than the raw row (1.49 vs 1.80 ms measured via xprof);
     # the residual decode-phase gap is one ~85 ms tunnel RTT per chunk
@@ -1554,6 +1676,12 @@ def main(mode: str):
                   "unit": "x",
                   "value": r["serving_degradation_goodput_x"],
                   "extra": r}
+    elif mode == "serving_ragged":
+        r = run_serving_ragged()
+        result = {"metric": "serving_ragged_dispatch_reduction_x",
+                  "unit": "x",
+                  "value": r["serving_ragged_dispatch_reduction_x"],
+                  "extra": r}
     elif mode == "pp":
         r = run_pp()
         result = {"metric": "pp_remat_overhead_x", "unit": "x",
@@ -1590,8 +1718,9 @@ def main(mode: str):
 
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
                 "resnet", "decode", "8b", "serving",
-                "serving_interleave", "serving_degradation", "pp",
-                "moe", "dit", "profile", "calibrate")
+                "serving_interleave", "serving_degradation",
+                "serving_ragged", "pp", "moe", "dit", "profile",
+                "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

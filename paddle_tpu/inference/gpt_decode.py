@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
+                                   ragged_paged_attention,
                                    reshape_and_cache)
 from .paged_decode import (_gather_prefix_pages, _mm,
                            _prefix_suffix_attention, _quantize_w,
@@ -229,6 +230,39 @@ class PagedGPTDecoder:
             attn = paged_attention_decode(q, kp, vp, tables,
                                           ctx_lens + 1)
             h = self._block(w, h, attn.reshape(b, cfg.hidden_size))
+        h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
+                        cfg.layer_norm_epsilon)
+        logits = _mm(h, weights["head"]).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
+                       slots, row_seq, row_ctx, tables):
+        """One RAGGED ministep up to the logits (the GPT twin of
+        PagedLlamaDecoder._ragged_logits — see its docstring): learned
+        position embeddings are gathered at the per-row positions
+        (clamped — pad rows may carry junk positions; their K/V aims at
+        the scratch page and their outputs are discarded, so junk is
+        inert, same contract as _prefill_prefix_impl)."""
+        cfg = self.cfg
+        r = ids.shape[0]
+        pos = jnp.minimum(positions, cfg.max_position_embeddings - 1)
+        h = (jnp.take(weights["embed"], ids, axis=0)
+             + jnp.take(weights["pos"], pos, axis=0))
+        h = h.astype(self.weights["embed"].dtype)
+        for li, w in enumerate(weights["layers"]):
+            hn = _layer_norm(h, w["ln1_w"], w["ln1_b"],
+                             cfg.layer_norm_epsilon)
+            q, k, v = self._qkv(w, hn[:, None, :], r, 1)
+            q, k, v = q[:, 0], k[:, 0], v[:, 0]
+            kp, vp = reshape_and_cache(k, v, k_pool[li], v_pool[li],
+                                       slots)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = kp
+            v_pool[li] = vp
+            attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
+                                          row_ctx)
+            h = self._block(w, h, attn.reshape(r, cfg.hidden_size))
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
         logits = _mm(h, weights["head"]).astype(jnp.float32)

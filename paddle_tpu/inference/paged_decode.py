@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from ..framework.core import Tensor
-from ..ops.paged_attention import PagedKVCache, paged_attention_decode
+from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
+                                   ragged_paged_attention)
 from ..ops.flash_attention import flash_attention
 from ..ops.rms_norm import rms_norm
 from ..ops.rope import build_rope_cache
@@ -622,6 +623,50 @@ class PagedLlamaDecoder:
             v_pool[li] = vp
             attn = paged_attention_decode(q, kp, vp, tables, ctx_lens + 1)
             h = h + _mm(attn.reshape(b, cfg.hidden_size), w["wo"],
+                        self._allow_kernel)
+            hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
+            h = h + self._mlp(w, hn)
+        h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
+        logits = _mm(h, weights["head"],
+                     self._allow_kernel).astype(jnp.float32)
+        return logits, k_pool, v_pool
+
+    def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
+                       slots, row_seq, row_ctx, tables):
+        """One RAGGED ministep up to the logits: a flattened token
+        batch mixing decode rows (one token of a running sequence) and
+        no-sample prefill-chunk rows (consecutive prompt positions),
+        no [max_batch] padding — the serving engine's unified
+        one-program-per-step path. ids/positions/slots/row_seq/row_ctx
+        [rows]; tables [num_seqs, max_pages] (a shared per-slot table,
+        scratch row included). Every row's K/V is written to the pool
+        at its flat slot BEFORE attention, so intra-call causality is
+        pure data: row_ctx bounds what each row sees (see
+        ops.paged_attention.ragged_paged_attention_reference).
+        Returns (logits [rows, vocab], k_pool, v_pool)."""
+        cfg = self.cfg
+        r = ids.shape[0]
+        h = jnp.take(weights["embed"], ids, axis=0)        # [r, d]
+        # clamp like the chunked-prefill programs: pad rows of a tail
+        # chunk may carry positions past max_position_embeddings
+        pos = jnp.minimum(positions,
+                          cfg.max_position_embeddings - 1)[:, None]
+        for li, w in enumerate(weights["layers"]):
+            hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)
+            q, k, v = self._proj_qkv(w, hn[:, None, :], r, 1)
+            q = self._rope(q, pos)[:, 0]                   # [r, nh, d]
+            k = self._rope(k, pos)[:, 0]                   # [r, kvh, d]
+            v = v[:, 0]
+            from ..ops.paged_attention import reshape_and_cache
+            kp, vp = reshape_and_cache(k, v, k_pool[li], v_pool[li],
+                                       slots)
+            k_pool = list(k_pool)
+            v_pool = list(v_pool)
+            k_pool[li] = kp
+            v_pool[li] = vp
+            attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
+                                          row_ctx)
+            h = h + _mm(attn.reshape(r, cfg.hidden_size), w["wo"],
                         self._allow_kernel)
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
             h = h + self._mlp(w, hn)

@@ -285,7 +285,8 @@ class ServingEngine:
                  max_dispatch_retries: int = 2,
                  retry_backoff_s: float = 0.05,
                  admission: str = "worst_case",
-                 max_queue_depth: Optional[int] = None):
+                 max_queue_depth: Optional[int] = None,
+                 ragged: bool = False):
         from .gpt_decode import PagedGPTDecoder
         if isinstance(model, (PagedLlamaDecoder, PagedGPTDecoder)):
             # a prebuilt paged decoder (e.g. PagedLlamaDecoder
@@ -378,6 +379,11 @@ class ServingEngine:
         self.deadline_misses = 0
         self.shed_requests = 0
         self.retries = 0
+        # device-program launch count (every successful "dispatch:*"
+        # _device_call — prefill, decode, merge, ragged); with
+        # generated_tokens it yields tokens_per_dispatch, the headline
+        # the ragged path optimizes (reset by clear_finished)
+        self.device_dispatches = 0
         # optional chaos monkey (utils/chaos.py ChaosMonkey.attach):
         # consulted by _device_call before every dispatch/fetch
         self.chaos = None
@@ -547,6 +553,97 @@ class ServingEngine:
                                            donate_argnums=(1, 2))
         self._can_recompute = hasattr(dec, "_prefill_chunk_impl")
 
+        # -- ragged unified prefill+decode batching (ISSUE 5) ---------------
+        # ragged=True collapses every per-step dispatch into ONE device
+        # program: a [T, W] schedule of flattened ragged rows — decode
+        # rows (one column per running slot, T sequential ministeps,
+        # sampled in-program with the previous chunk's device output
+        # merged IN-program, so there is no separate merge dispatch) and
+        # prefill rows (no-sample mid-chunk rows at their global offsets;
+        # a prompt's final token row samples the request's first token).
+        # W is sized by the ACTUAL rows (bucketed), not max_batch — the
+        # dense path's scratch-slot padding disappears at the source.
+        # Needs the decoder's _ragged_logits; the attention op falls
+        # back to the masked jnp oracle off-TPU.
+        self.ragged = bool(ragged) and hasattr(dec, "_ragged_logits")
+        # prefill tokens folded into one ragged dispatch (the ragged
+        # path is always chunked-style — a long prompt spreads over
+        # successive steps' programs under this per-step cap)
+        self._ragged_cap = (self.prefill_budget or self.prefill_chunk
+                            or self._recompute_chunk)
+        self._zeros_toks_cache: Dict[Tuple[int, int], jax.Array] = {}
+        if self.ragged:
+            def ragged_chunk(weights, k, v, prev_toks, last_t, prev_col,
+                             use_host, override, ids_all, pos_all,
+                             slots_all, rseq_all, rctx_all, use_carry,
+                             tables, temps_all, keys):
+                """T ragged ministeps as one lax.scan. Decode columns
+                carry their sampled token ministep-to-ministep on
+                device; their FIRST token is gathered from the previous
+                ragged chunk's [T, W] output (continuing columns) or a
+                host override (fresh slots) — the dense path's
+                merge_first folded into the program."""
+                first = jnp.where(use_host, override,
+                                  prev_toks[last_t, prev_col])
+
+                def step(carry, xs):
+                    cur, kp, vp = carry
+                    ids_d, pos, slots, rseq, rctx, uc, temp, key = xs
+                    ids = jnp.where(uc, cur, ids_d)
+                    logits, kp, vp = dec._ragged_logits(
+                        weights, kp, vp, ids, pos, slots, rseq, rctx,
+                        tables)
+                    nxt = self._sample(logits, temp, key)
+                    return (nxt, kp, vp), nxt
+
+                (_, k, v), toks = jax.lax.scan(
+                    step, (first, k, v),
+                    (ids_all, pos_all, slots_all, rseq_all, rctx_all,
+                     use_carry, temps_all, keys))
+                return toks, k, v          # [T, W]
+
+            def ragged_chunk_rich(weights, k, v, prev_toks, last_t,
+                                  prev_col, use_host, override, ids_all,
+                                  pos_all, slots_all, rseq_all,
+                                  rctx_all, use_carry, tables,
+                                  temps_all, keys, top_ks_all,
+                                  top_ps_all, reps_all, seen, upd):
+                """Per-request-sampling twin: carries the seen mask.
+                Only columns flagged in `upd` (decode columns)
+                accumulate their own samples — a final-prefill row's
+                seen mask is its prompt, seeded host-side, and other
+                ministeps sharing its column must not pollute it."""
+                first = jnp.where(use_host, override,
+                                  prev_toks[last_t, prev_col])
+                w = use_host.shape[0]
+
+                def step(carry, xs):
+                    cur, kp, vp, seen_c = carry
+                    (ids_d, pos, slots, rseq, rctx, uc, temp, key,
+                     tks, tps, rp) = xs
+                    ids = jnp.where(uc, cur, ids_d)
+                    logits, kp, vp = dec._ragged_logits(
+                        weights, kp, vp, ids, pos, slots, rseq, rctx,
+                        tables)
+                    nxt = self._sample_rich(logits, temp, key, tks,
+                                            tps, rp, seen_c)
+                    rows = jnp.arange(w)
+                    seen_c = seen_c.at[rows, nxt].set(
+                        seen_c[rows, nxt] | upd)
+                    return (nxt, kp, vp, seen_c), nxt
+
+                (_, k, v, _), toks = jax.lax.scan(
+                    step, (first, k, v, seen),
+                    (ids_all, pos_all, slots_all, rseq_all, rctx_all,
+                     use_carry, temps_all, keys, top_ks_all,
+                     top_ps_all, reps_all))
+                return toks, k, v          # [T, W]
+
+            self._ragged_j = jax.jit(ragged_chunk,
+                                     donate_argnums=(1, 2))
+            self._ragged_rich_j = jax.jit(ragged_chunk_rich,
+                                          donate_argnums=(1, 2))
+
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
         engine-static top_k."""
@@ -627,7 +724,13 @@ class ServingEngine:
             try:
                 if self.chaos is not None:
                     self.chaos.before_call(self, kind)
-                return fn(*args)
+                out = fn(*args)
+                if kind.startswith("dispatch:"):
+                    # every successful device-program launch (prefill /
+                    # decode / merge / ragged) — the denominator of
+                    # stats()["tokens_per_dispatch"]
+                    self.device_dispatches += 1
+                return out
             except KVCacheExhausted:
                 raise
             except Exception as e:          # noqa: BLE001 — fault wall
@@ -1668,6 +1771,511 @@ class ServingEngine:
         self.time_host_s += time.perf_counter() - t0
         return True
 
+    # -- ragged unified scheduler (ISSUE 5) ----------------------------------
+    # row-count buckets for the ragged [T, W] schedule: W pads up to the
+    # next rung (the ONLY padding left on this path — stats() counts it
+    # as padded_token_waste), so compile variants stay ~log-bounded
+    RAGGED_WIDTHS = (1, 2, 4, 8, 12, 16, 24, 32, 48, 64, 96, 128)
+    # prefill rows per pure-prefill (idle) ragged program: no decode
+    # stream is waiting, so bursts drain in few wide programs instead
+    # of being serialized across steps by the interleaving budget
+    _RAGGED_IDLE_CAP = 256
+
+    def _ragged_width(self, w: int) -> int:
+        for b in self.RAGGED_WIDTHS:
+            if w <= b:
+                return b
+        return -(-w // 64) * 64
+
+    def _newest_ragged_entry(self):
+        for e in reversed(self._inflight):
+            if e["kind"] == "ragged":
+                return e
+        return None
+
+    def _zeros_toks(self, t: int, w: int):
+        """Cached device-resident zero [T, W] token block: the
+        prev-toks operand of the FIRST ragged dispatch after a pipeline
+        flush (every column takes its host override)."""
+        cached = self._zeros_toks_cache.get((t, w))
+        if cached is None:
+            cached = jnp.zeros((t, w), jnp.int32)
+            self._zeros_toks_cache[(t, w)] = cached
+        return cached
+
+    def _ragged_plan(self):
+        """(T, dcols, takes): this step's decode columns and prefill
+        token takes, computed WITHOUT touching the allocator — the
+        shape pre-pass that fixes the (T, W) program variant before any
+        page is claimed (so a variant mismatch with the in-flight chunk
+        can flush the pipeline BEFORE the schedule is built)."""
+        running = [si for si in range(self.max_b)
+                   if self._slots[si] is not None
+                   and self._slots[si].state == "running"]
+        T = self._force_chunk or (self._pick_chunk(running) if running
+                                  else 1)
+        dcols = []
+        for si in running:
+            req = self._slots[si]
+            steps = max(0, min(T, req.sampling.max_new_tokens
+                               - req.planned))
+            if steps > 0:
+                dcols.append((si, req, steps))
+        takes = []
+        # while decodes run, the budget bounds how much prefill slots
+        # between consecutive decode ministep groups (the running
+        # streams' worst-case added ITL — dense-path semantics); an
+        # idle engine widens to drain bursts in few programs, and
+        # _dispatch_ragged keeps issuing pure-prefill chunks until the
+        # backlog is gone
+        budget = self._ragged_cap if dcols \
+            else max(self._ragged_cap, self._RAGGED_IDLE_CAP)
+        pending = sorted((r for r in self._slots
+                          if r is not None and r.state == "prefilling"
+                          and r.prefill_sent < r.suffix_len),
+                         key=lambda r: r.req_id)
+        for r in pending:
+            if budget <= 0:
+                break
+            if not self._deps_ready(r):
+                # splice-pending reader: its writer's covering chunk has
+                # not been DISPATCHED yet (same watermark rule as the
+                # dense path — a reader never rides the same or an
+                # earlier program than its writer's covering rows)
+                continue
+            take = min(budget, r.suffix_len - r.prefill_sent)
+            takes.append((r, take))
+            budget -= take
+        return T, dcols, takes
+
+    def _dispatch_ragged(self) -> bool:
+        """Dispatch this step's ragged work: ONE unified chunk in the
+        steady mixed regime; a pure-prefill backlog (no running
+        decodes — cold start, burst admission) keeps issuing bounded
+        prefill-only chunks until nothing is ready, mirroring the
+        dense idle path's unbudgeted _dispatch_prefill (each program
+        is dispatched before the next is built, so a splice reader's
+        same-step chunks still follow its writer's in device order)."""
+        if not self._dispatch_ragged_chunk():
+            return False
+        while (not any(r is not None and r.state == "running"
+                       for r in self._slots)
+               and self._dispatch_ragged_chunk()):
+            pass
+        return True
+
+    def _dispatch_ragged_chunk(self) -> bool:
+        """Dispatch ONE unified ragged chunk — the whole step's device
+        work as a single program: T sequential ministeps over a ragged
+        [W]-row token batch whose columns are the running slots' decode
+        tokens (sampled in-program, carried ministep-to-ministep, first
+        tokens merged in-program from the previous chunk's device
+        output) and this step's prefill-chunk tokens (no-sample rows at
+        their global offsets, spread across the T ministeps; a prompt's
+        final token row samples the request's first token). W is sized
+        by the actual rows (bucketed), so inactive batch slots cost
+        nothing. Preemption mid-build NEUTRALIZES the victim's ROW
+        RANGE (every cell it was scheduled into is re-aimed at the
+        scratch page — its freed blocks may be re-taken by later rows
+        of this very chunk, and intra-program slot overlap would
+        corrupt the survivor's KV), the ragged analogue of the dense
+        path's neutralize-by-column. Returns True when dispatched."""
+        t0 = time.perf_counter()
+        cache = self.dec.cache
+        mp = self.dec.max_pages
+        T, dcols, takes = self._ragged_plan()
+        if not dcols and not takes:
+            self.time_host_s += time.perf_counter() - t0
+            return False
+        ptotal = sum(t for _, t in takes)
+        W = self._ragged_width(len(dcols)
+                               + (-(-ptotal // T) if ptotal else 0))
+        prev = self._newest_ragged_entry()
+        if prev is not None and prev["T"] == T and W < prev["W"]:
+            # sticky width: a shrink (slot retired, prefill drained)
+            # pads up to the in-flight chunk's width instead of
+            # flushing the pipeline — only growth forces a flush
+            W = prev["W"]
+        if prev is not None and (prev["T"] != T or prev["W"] != W):
+            # program-variant change (slots came or went, prefill phase
+            # shifted): flush the pipeline so first tokens come from
+            # the host — the in-program merge consumes the previous
+            # chunk's [T, W] output and shapes must line up
+            while self._inflight:
+                self._collect_oldest()
+            # collection may retire slots / deliver first tokens:
+            # re-plan against the post-flush scheduler state
+            T, dcols, takes = self._ragged_plan()
+            if not dcols and not takes:
+                self.time_host_s += time.perf_counter() - t0
+                return False
+            ptotal = sum(t for _, t in takes)
+            W = self._ragged_width(len(dcols)
+                                   + (-(-ptotal // T) if ptotal else 0))
+            prev = None
+
+        scratch_row = self.max_b
+        vocab = self.dec.cfg.vocab_size
+        ids = np.zeros((T, W), np.int32)
+        pos = np.zeros((T, W), np.int32)
+        slots = np.full((T, W), self._scratch_slot, np.int32)
+        rseq = np.full((T, W), scratch_row, np.int32)
+        rctx = np.zeros((T, W), np.int32)
+        ucar = np.zeros((T, W), bool)
+        temps = np.zeros((T, W), np.float32)
+        top_ks = np.zeros((T, W), np.int32)
+        top_ps = np.ones((T, W), np.float32)
+        reps = np.ones((T, W), np.float32)
+        upd = np.zeros(W, bool)
+        rows_of: Dict[int, List[Tuple[int, int]]] = {}  # req_id -> cells
+        sched: Dict[int, Tuple[Request, int]] = {}  # req_id -> (req, epoch)
+        col_of: Dict[int, int] = {}                 # decode si -> column
+        steps_of: Dict[int, int] = {}
+        reqs_of: Dict[int, Request] = {}
+        epochs_of: Dict[int, int] = {}
+        take_of: Dict[int, int] = {}     # req_id -> prefill rows scheduled
+        finals: List[Tuple[Request, int, int, int]] = []
+
+        # decode columns --------------------------------------------------
+        col = 0
+        for si, req, steps in dcols:
+            if self._slots[si] is not req or req.state != "running":
+                # preempted by an earlier column's KV pressure while
+                # this chunk was being built
+                continue
+            sp = req.sampling
+            cells = rows_of.setdefault(req.req_id, [])
+            # register BEFORE the allocator loop (like the prefill loop
+            # below): when req becomes its own preemption victim
+            # mid-extend, the staleness sweep only blanks rows of
+            # requests it can see in `sched` — an unregistered victim's
+            # partial rows would keep aiming reshape_and_cache at its
+            # freed pages, which a later row of this very chunk may
+            # re-take
+            sched[req.req_id] = (req, req.epoch)
+            try:
+                for t in range(steps):
+                    ctx = cache.context_len(req.req_id)
+                    slot = self._extend_with_preempt(req)
+                    pos[t, col] = ctx
+                    rctx[t, col] = ctx + 1
+                    slots[t, col] = slot
+                    rseq[t, col] = si
+                    cells.append((t, col))
+            except KVCacheExhausted:
+                # req itself is the policy victim (already in `sched`,
+                # so the staleness sweep below blanks its partial rows
+                # — _preempt bumps the epoch, _fail_request leaves the
+                # running state)
+                if self._can_recompute:
+                    self._preempt(req)
+                else:
+                    self._fail_request(
+                        req, "KV pool exhausted and decoder does not "
+                             "support preemption-with-recompute")
+                col += 1
+                continue
+            req.planned += steps
+            ucar[:, col] = True
+            temps[:, col] = sp.temperature
+            top_ks[:, col] = self.top_k if sp.top_k is None else sp.top_k
+            top_ps[:, col] = sp.top_p
+            reps[:, col] = sp.repetition_penalty
+            upd[col] = True
+            col_of[si] = col
+            steps_of[si] = steps
+            reqs_of[si] = req
+            epochs_of[si] = req.epoch
+            col += 1
+
+        # prefill cells: ministep-major past the decode columns, so a
+        # request's tokens are sequential across (t, col) order — a row
+        # always lands at the same or a later ministep than every
+        # same-sequence row before it (pool writes precede attention
+        # within a ministep, so intra-chunk causality holds by row_ctx)
+        pcells = [(t, c) for t in range(T) for c in range(col, W)]
+        pi = 0
+        for req, take in takes:
+            if req.state != "prefilling" or req.slot is None:
+                continue   # evicted by decode-side pressure mid-build
+            si = req.slot
+            toks_src = req.prefill_tokens
+            base_off = req.n_cached + req.prefill_sent
+            cells = rows_of.setdefault(req.req_id, [])
+            sched[req.req_id] = (req, req.epoch)
+            scheduled = 0
+            try:
+                for j in range(take):
+                    if pi >= len(pcells):
+                        break
+                    off = base_off + j
+                    t, c = pcells[pi]
+                    is_final = (not req.resume
+                                and off + 1 == len(toks_src))
+                    if is_final:
+                        # at most one sampling final per COLUMN: its
+                        # rich seen mask is seeded per column. Keep
+                        # advancing — the next cell's column can hold
+                        # an earlier final too (finals of short takes
+                        # land on adjacent columns)
+                        while any(fc == c for _, _, _, fc in finals):
+                            pi += 1
+                            if pi >= len(pcells):
+                                break
+                            t, c = pcells[pi]
+                        if pi >= len(pcells):
+                            break
+                    slot = self._extend_with_preempt(req)
+                    ids[t, c] = int(toks_src[off])
+                    pos[t, c] = off
+                    rctx[t, c] = off + 1
+                    slots[t, c] = slot
+                    rseq[t, c] = si
+                    cells.append((t, c))
+                    scheduled += 1
+                    pi += 1
+                    if is_final:
+                        sp = req.sampling
+                        temps[t, c] = sp.temperature
+                        top_ks[t, c] = (self.top_k if sp.top_k is None
+                                        else sp.top_k)
+                        top_ps[t, c] = sp.top_p
+                        reps[t, c] = sp.repetition_penalty
+                        finals.append((req, req.epoch, t, c))
+            except KVCacheExhausted as e:
+                self._fail_request(
+                    req, f"KV pool exhausted mid-prefill with no "
+                         f"preemption victim: {e}")
+                continue
+            if scheduled:
+                take_of[req.req_id] = scheduled
+
+        # staleness sweep: neutralize the ROW RANGE of every request
+        # that lost its life while the chunk was being built (direct
+        # preemption victims AND cascaded reader restarts) — runs
+        # BEFORE dispatch, so a blanked row never writes into pages a
+        # survivor re-took
+        def blank(cell_list):
+            for t, c in cell_list:
+                ids[t, c] = 0
+                pos[t, c] = 0
+                slots[t, c] = self._scratch_slot
+                rseq[t, c] = scratch_row
+                rctx[t, c] = 0
+                temps[t, c] = 0.0
+                top_ks[t, c] = 0
+                top_ps[t, c] = 1.0
+                reps[t, c] = 1.0
+
+        for rid in list(sched):
+            req, epoch = sched[rid]
+            if (req.epoch == epoch and req.slot is not None
+                    and req.state in ("running", "prefilling")):
+                continue
+            blank(rows_of.get(rid, []))
+            for si in [s for s, r in reqs_of.items() if r is req]:
+                c = col_of.pop(si, None)
+                if c is not None:
+                    upd[c] = False
+                steps_of.pop(si, None)
+                reqs_of.pop(si, None)
+                epochs_of.pop(si, None)
+            take_of.pop(rid, None)
+            finals[:] = [f for f in finals if f[0] is not req]
+            del sched[rid]
+        if not sched:
+            # everything scheduled was evicted mid-build
+            self.time_host_s += time.perf_counter() - t0
+            return False
+
+        # one table row per slot (plus the scratch row at max_b): after
+        # the extends above every survivor's block list is final for
+        # the whole chunk; entries past a row's ctx are masked anyway
+        tables = np.full((self.max_b + 1, mp), self._scratch_block,
+                         np.int32)
+        for rid, (req, epoch) in sched.items():
+            tables[req.slot] = cache.block_table(req.req_id, mp)
+
+        # first decode tokens: previous ragged chunk's device output
+        # for continuing columns (merged IN-program), host values for
+        # fresh slots — prev["cols"] maps slots to the PREVIOUS chunk's
+        # column layout, which need not match this one's
+        last_t = np.zeros(W, np.int32)
+        prev_col = np.zeros(W, np.int32)
+        use_host = np.ones(W, bool)
+        override = np.zeros(W, np.int32)
+        for si, c in col_of.items():
+            req = reqs_of[si]
+            override[c] = self._last_tok[si]
+            if prev is not None:
+                pc = prev["cols"].get(si)
+                psteps = prev["steps"].get(si, 0)
+                if (pc is not None and psteps > 0
+                        and si not in self._fresh_slots
+                        and prev["reqs"].get(si) is req
+                        and prev["epochs"].get(si) == req.epoch):
+                    use_host[c] = False
+                    prev_col[c] = pc
+                    last_t[c] = psteps - 1
+        self._fresh_slots.clear()
+
+        rich = any(r.sampling.needs_rich_sampling
+                   for r in reqs_of.values()) \
+            or any(f[0].sampling.needs_rich_sampling for f in finals)
+        prev_toks = prev["toks"] if prev is not None \
+            else self._zeros_toks(T, W)
+        keys = jax.random.split(self._next_key(), T)
+        args = (self.dec.weights, cache.k, cache.v, prev_toks,
+                jnp.asarray(last_t), jnp.asarray(prev_col),
+                jnp.asarray(use_host), jnp.asarray(override),
+                jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(slots),
+                jnp.asarray(rseq), jnp.asarray(rctx), jnp.asarray(ucar),
+                jnp.asarray(tables), jnp.asarray(temps), keys)
+        try:
+            if rich:
+                any_rep = any(r.sampling.repetition_penalty != 1.0
+                              for r in reqs_of.values()) \
+                    or any(f[0].sampling.repetition_penalty != 1.0
+                           for f in finals)
+                if any_rep:
+                    seen = np.zeros((W, vocab), bool)
+                    for si, c in col_of.items():
+                        req = reqs_of[si]
+                        if req.sampling.repetition_penalty != 1.0:
+                            seen[c, req.prompt] = True
+                            if req.out_tokens:
+                                seen[c,
+                                     np.asarray(req.out_tokens)] = True
+                    for req, _, t, c in finals:
+                        if req.sampling.repetition_penalty != 1.0:
+                            seen[c, req.prompt] = True
+                    seen_dev = jnp.asarray(seen)
+                else:
+                    seen_dev = self._zeros_seen(W, vocab)
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:ragged", self._ragged_rich_j, *args,
+                    jnp.asarray(top_ks), jnp.asarray(top_ps),
+                    jnp.asarray(reps), seen_dev, jnp.asarray(upd))
+            else:
+                toks, cache.k, cache.v = self._device_call(
+                    "dispatch:ragged", self._ragged_j, *args)
+        except _DispatchFailed as e:
+            # the unified chunk is ONE program: every surviving request
+            # riding it fails together, with a structured error — the
+            # engine keeps serving (0-step slots awaiting collection
+            # and unscheduled prefills are untouched)
+            for rid, (req, epoch) in sched.items():
+                if req.epoch == epoch and req.state in ("running",
+                                                        "prefilling"):
+                    self._fail_request(
+                        req, f"ragged dispatch failed after retries: "
+                             f"{e}")
+            self.time_host_s += time.perf_counter() - t0
+            return False
+
+        # post-dispatch bookkeeping: the scheduled prefill rows are now
+        # DISPATCHED — bump the splice watermark, complete resumes (no
+        # sampling final; decode restarts from the host-held last
+        # token), clear pending-write registrations of finished finals
+        for rid, (req, epoch) in sched.items():
+            take = take_of.get(rid, 0)
+            if take and req.state == "prefilling":
+                req.prefill_sent += take
+                if req.prefill_sent >= req.suffix_len:
+                    if req.resume:
+                        self._resume_complete(req)
+                    else:
+                        self._clear_pending_writes(req)
+        self._inflight.append({
+            "kind": "ragged", "toks": toks, "T": T, "W": W,
+            "cols": dict(col_of), "steps": dict(steps_of),
+            "reqs": dict(reqs_of), "epochs": dict(epochs_of),
+            "finals": list(finals),
+            "real_rows": sum(take_of.values()),
+            "free_after": []})
+        self.time_host_s += time.perf_counter() - t0
+        return True
+
+    def _collect_ragged(self, ch):
+        """Fetch and process one collected ragged chunk: decode columns
+        deliver up to `steps` tokens (epoch-guarded, mid-chunk EOS cut),
+        sampling-final rows deliver their request's first token
+        (completing the prefill), mid-chunk prefill rows carry no
+        result. ITL attribution matches the dense path."""
+        t0 = time.perf_counter()
+        try:
+            # THE designed blocking point of the ragged pipeline, in
+            # device program order (retried on transient fetch faults)
+            toks = np.asarray(self._device_call(  # flightcheck: disable=FC301
+                "collect:ragged", np.asarray, ch["toks"]))
+        except _DispatchFailed as e:
+            self.time_stall_s += time.perf_counter() - t0
+            for si, steps in ch["steps"].items():
+                req = ch["reqs"][si]
+                if steps > 0 and req.state == "running" \
+                        and req.epoch == ch["epochs"].get(si) \
+                        and self._slots[si] is req:
+                    self._fail_request(
+                        req, f"chunk collection failed after retries: "
+                             f"{e}")
+            for req, epoch, _, _ in ch["finals"]:
+                if req.state == "prefilling" and req.epoch == epoch:
+                    self._fail_request(
+                        req, f"prefill collection failed after "
+                             f"retries: {e}")
+            for rid in ch["free_after"]:
+                self.dec.cache.free(rid)
+            return
+        self.time_stall_s += time.perf_counter() - t0
+        now = time.perf_counter()
+        self.decode_steps += ch["T"]
+        # ragged utilization accounting: the program ran T x W cells;
+        # useful work = delivered decode tokens + real prefill rows, so
+        # padded_token_waste is the true pad-to-grid remainder (plus
+        # genuine post-EOS discards) — no scratch-slot steady waste
+        self.decode_slot_steps += ch["T"] * ch["W"]
+        self.decode_useful_tokens += ch["real_rows"]
+        for si, steps in ch["steps"].items():
+            req = ch["reqs"][si]
+            if req.state != "running" \
+                    or req.epoch != ch["epochs"].get(si):
+                continue   # retired/preempted while the chunk flew
+            c = ch["cols"][si]
+            delivered = 0
+            for t in range(steps):
+                tok = int(toks[t, c])
+                req.out_tokens.append(tok)
+                delivered += 1
+                self.generated_tokens += 1
+                self._last_tok[si] = tok
+                if self._is_finished(req):
+                    break      # mid-chunk EOS: discard the tail
+            self.decode_useful_tokens += delivered
+            if delivered:
+                if req.t_last_emit is not None:
+                    itl = (now - req.t_last_emit) / delivered
+                    req.itls.extend([itl] * delivered)
+                req.t_last_emit = now
+            if self._is_finished(req) and self._slots[si] is req:
+                self._retire(si)
+        for req, epoch, t, c in ch["finals"]:
+            if req.state != "prefilling" or req.epoch != epoch:
+                continue
+            si = req.slot
+            tok = int(toks[t, c])
+            req.state = "running"
+            req.t_first_token = now
+            req.t_last_emit = now
+            req.out_tokens.append(tok)
+            req.planned = 1
+            self.generated_tokens += 1
+            self._last_tok[si] = tok
+            self._fresh_slots.add(si)
+            if self._is_finished(req):
+                self._retire(si)
+        for rid in ch["free_after"]:
+            self.dec.cache.free(rid)
+
     def _collect_oldest(self):
         """Fetch and process the oldest in-flight chunk — prefill or
         decode (the only host-blocking points of the engine). Mid
@@ -1677,6 +2285,9 @@ class ServingEngine:
         accounting (the chunk's wall interval is attributed evenly
         over the tokens it delivered to each request)."""
         ch = self._inflight.popleft()
+        if ch["kind"] == "ragged":
+            self._collect_ragged(ch)
+            return
         if ch["kind"] == "prefill":
             if ch["toks"] is not None:
                 t0 = time.perf_counter()
@@ -1810,8 +2421,14 @@ class ServingEngine:
         itself never raises on a per-request fault."""
         self._enforce_deadlines()
         self._admit()
-        self._dispatch_prefill()
-        dispatched = self._dispatch_chunk()
+        if self.ragged:
+            # unified ragged path: decode AND prefill rows ride ONE
+            # device program per step (no separate prefill dispatches,
+            # no merge dispatch)
+            dispatched = self._dispatch_ragged()
+        else:
+            self._dispatch_prefill()
+            dispatched = self._dispatch_chunk()
         depth = 1 if (dispatched and self.overlap
                       and not self._rep_active()) else 0
         while len(self._inflight) > depth:
@@ -2027,6 +2644,7 @@ class ServingEngine:
         self.deadline_misses = 0
         self.shed_requests = 0
         self.retries = 0
+        self.device_dispatches = 0
         self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
@@ -2103,7 +2721,20 @@ class ServingEngine:
             "time_prefill_s": self.time_prefill_s,
             "time_decode_stall_s": self.time_stall_s,
             "time_host_s": self.time_host_s,
+            # device-program launches and delivered tokens per launch —
+            # the ragged path's headline: one program per step instead
+            # of merge + decode + N prefill dispatches
+            "device_dispatches": self.device_dispatches,
+            "tokens_per_dispatch": (
+                self.generated_tokens / self.device_dispatches
+                if self.device_dispatches else 0.0),
             "decode_slot_steps": self.decode_slot_steps,
+            # ragged-aware: on the ragged path slot_steps counts the
+            # [T, W] grid actually dispatched (W sized by real rows)
+            # and useful tokens include dispatched prefill rows, so
+            # this is the true pad-to-grid remainder (plus post-EOS
+            # discards) — the dense path's scratch-slot waste term is
+            # structurally gone there
             "padded_token_waste": (self.decode_slot_steps
                                    - self.decode_useful_tokens),
             "decode_utilization": (

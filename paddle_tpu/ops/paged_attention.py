@@ -26,7 +26,8 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["KVCacheExhausted", "PagedKVCache", "paged_attention_decode",
-           "paged_attention_decode_reference", "reshape_and_cache"]
+           "paged_attention_decode_reference", "ragged_paged_attention",
+           "ragged_paged_attention_reference", "reshape_and_cache"]
 
 
 class KVCacheExhausted(RuntimeError):
@@ -88,6 +89,97 @@ def paged_attention_decode_reference(q, k_cache, v_cache, block_tables,
     probs = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum("bkgs,bksd->bkgd", probs, v.astype(jnp.float32))
     return out.reshape(b, nh, d).astype(q.dtype)
+
+
+def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
+                                     row_seq, row_ctx,
+                                     scale: Optional[float] = None):
+    """Ragged mixed prefill+decode attention over the paged cache (jnp
+    oracle + CPU path).
+
+    One call covers a FLATTENED token batch mixing rows from many
+    sequences — decode rows (one token of a running sequence) and
+    prefill-chunk rows (consecutive prompt positions of a prefilling
+    sequence) side by side, no [max_batch] padding:
+
+    q:            [total_rows, num_heads, head_dim]
+    k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim]
+    block_tables: [num_seqs, max_pages] int32 physical page ids
+    row_seq:      [total_rows] int32 — which table row each q row reads
+    row_ctx:      [total_rows] int32 — keys VISIBLE to the row: pool
+                  positions < row_ctx attend (the row's own K/V is
+                  already in the pool, so a decode row passes ctx+1 and
+                  chunk row j of a prefill at offset `off` passes
+                  off+j+1 — that per-row bound IS the causal mask
+                  between same-sequence rows of one call)
+    Rows with row_ctx <= 0 (grid padding) return exact zeros.
+    Returns [total_rows, num_heads, head_dim].
+    """
+    r, nh, d = q.shape
+    nb, kvh, bs, _ = k_cache.shape
+    max_pages = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / np.sqrt(d)
+    group = nh // kvh
+
+    tables_r = jnp.take(block_tables, row_seq, axis=0)   # [r, P]
+    qg = q.reshape(r, kvh, group, d).astype(jnp.float32)
+    ctx = row_ctx[:, None, None, None]
+
+    # ONLINE softmax over a page walk — the kernel's structure, not
+    # just its math: a one-page-per-iteration gather keeps peak memory
+    # at [r, kvh, bs, d] instead of materializing every row's whole
+    # [max_pages * bs] K/V (a wide idle-drain ragged program has
+    # hundreds of rows — a flat gather is gigabytes of traffic per
+    # layer on the CPU path), and the trip count is bounded by the
+    # batch's LONGEST visible context, not the table width (the
+    # kernel's n_pages bound; a traced fori_loop limit, so short-row
+    # batches in a long-bucket table skip the empty tail). Masking is
+    # per position (pos < row_ctx); fully-masked rows keep l == 0 and
+    # come out EXACTLY zero below, matching the Pallas kernel's guard,
+    # instead of averaging V over a uniform distribution.
+    def page_step(p, carry):
+        m_prev, l_prev, acc = carry
+        pids = jnp.take(tables_r, p, axis=1)             # [r]
+        k = jnp.take(k_cache, pids, axis=0).astype(jnp.float32)
+        v = jnp.take(v_cache, pids, axis=0)              # [r, kvh, bs, d]
+        sc = jnp.einsum("rkgd,rksd->rkgs", qg, k) * scale
+        pos = p * bs + jnp.arange(bs)[None, None, None, :]
+        mask = pos < ctx
+        sc = jnp.where(mask, sc, -1e30)
+        m_new = jnp.maximum(m_prev, jnp.max(sc, axis=-1))
+        prob = jnp.where(mask, jnp.exp(sc - m_new[..., None]), 0.0)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(prob, axis=-1)
+        acc = acc * corr[..., None] + jnp.einsum(
+            "rkgs,rksd->rkgd", prob, v.astype(jnp.float32))
+        return m_new, l_new, acc
+
+    n_pages = jnp.minimum((jnp.max(row_ctx) + bs - 1) // bs, max_pages)
+    m, l, acc = jax.lax.fori_loop(
+        0, n_pages, page_step,
+        (jnp.full((r, kvh, group), -1e30, jnp.float32),
+         jnp.zeros((r, kvh, group), jnp.float32),
+         jnp.zeros((r, kvh, group, d), jnp.float32)))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.reshape(r, nh, d).astype(q.dtype)
+
+
+def ragged_paged_attention(q, k_cache, v_cache, block_tables, row_seq,
+                           row_ctx, scale: Optional[float] = None):
+    """Ragged mixed prefill+decode attention; Pallas scalar-prefetch
+    kernel on TPU, jnp oracle elsewhere (CPU, or
+    FLAGS.use_pallas_kernels=False). Kernel eligibility is the decode
+    kernel's policy — same pool layout, same tiling constraints."""
+    if _pallas_decode_ok(q, k_cache):
+        from .pallas.ragged_paged_attention import \
+            ragged_paged_attention_pallas
+        return ragged_paged_attention_pallas(q, k_cache, v_cache,
+                                             block_tables, row_seq,
+                                             row_ctx, scale)
+    return ragged_paged_attention_reference(q, k_cache, v_cache,
+                                            block_tables, row_seq,
+                                            row_ctx, scale)
 
 
 class PagedKVCache:

@@ -1,0 +1,480 @@
+"""Ragged unified prefill+decode batching (ISSUE 5).
+
+Layers under test:
+- the ragged paged-attention KERNEL (ops/pallas/ragged_paged_attention,
+  interpret mode on CPU) against the masked jnp reference oracle
+  (ops.paged_attention.ragged_paged_attention_reference): randomized
+  sequence lengths, block tables, mixed prefill/decode rows,
+  context-length masking exactly at page boundaries, grid-padding rows;
+- the reference oracle itself against the decode oracle (a pure decode
+  row batch is the decode kernel's semantics row-for-row);
+- the ENGINE's ragged=True path: one device program per step must be a
+  pure scheduling change — greedy outputs token-identical to the dense
+  path (Llama and GPT, mixed lengths, chunked long prompts, shared
+  prefixes, mid-stream arrivals, EOS cuts, preemption-with-recompute,
+  cancellation), with >= 2x fewer device dispatches per delivered
+  token;
+- the new stats surface: device_dispatches, tokens_per_dispatch,
+  ragged-aware padded_token_waste, all reset by clear_finished.
+
+PADDLE_TPU_POOL_DEBUG=1 (set by the invariant gate) makes every engine
+step here assert the pool invariant between ragged chunks too.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+
+# ---------------------------------------------------------------------------
+# kernel vs oracle
+# ---------------------------------------------------------------------------
+
+def _rand_case(rng, kvh, group, d, bs, nblocks, mp, n_seqs,
+               decode_rows, chunk_rows):
+    """One randomized ragged batch: `decode_rows` single-token rows over
+    random contexts + one prefill chunk of `chunk_rows` consecutive
+    offsets, plus two grid-padding rows."""
+    import jax.numpy as jnp
+    kc = jnp.asarray(rng.randn(nblocks, kvh, bs, d), jnp.float32)
+    vc = jnp.asarray(rng.randn(nblocks, kvh, bs, d), jnp.float32)
+    tables = jnp.asarray(
+        rng.choice(nblocks, (n_seqs, mp), replace=False).astype(np.int32))
+    row_seq, row_ctx = [], []
+    for i in range(decode_rows):
+        row_seq.append(i % n_seqs)
+        row_ctx.append(int(rng.randint(1, mp * bs + 1)))
+    off = int(rng.randint(0, mp * bs - chunk_rows))
+    s = n_seqs - 1
+    for j in range(chunk_rows):
+        row_seq.append(s)
+        row_ctx.append(off + j + 1)
+    row_seq += [0, 0]
+    row_ctx += [0, 0]
+    q = jnp.asarray(rng.randn(len(row_seq), kvh * group, d), jnp.float32)
+    return (q, kc, vc, tables, jnp.asarray(row_seq, jnp.int32),
+            jnp.asarray(row_ctx, jnp.int32))
+
+
+class TestRaggedKernelVsOracle:
+    def test_property_randomized(self):
+        """Property test: kernel == oracle over randomized geometries
+        (GQA and MHA, different page sizes, mixed rows, random block
+        tables and context lengths)."""
+        from paddle_tpu.ops.paged_attention import \
+            ragged_paged_attention_reference
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention_pallas
+        rng = np.random.RandomState(0)
+        geoms = [
+            dict(kvh=2, group=4, d=64, bs=16, nblocks=32, mp=4,
+                 n_seqs=3, decode_rows=3, chunk_rows=7),
+            dict(kvh=1, group=1, d=64, bs=8, nblocks=24, mp=5,
+                 n_seqs=4, decode_rows=5, chunk_rows=4),
+            dict(kvh=4, group=1, d=64, bs=8, nblocks=40, mp=3,
+                 n_seqs=2, decode_rows=2, chunk_rows=11),
+        ]
+        for trial in range(2):
+            for g in geoms:
+                case = _rand_case(rng, **g)
+                ref = ragged_paged_attention_reference(*case)
+                out = ragged_paged_attention_pallas(*case)
+                np.testing.assert_allclose(
+                    np.asarray(out), np.asarray(ref),
+                    atol=2e-5, rtol=2e-4,
+                    err_msg=f"trial={trial} geom={g}")
+
+    def test_page_boundary_masking(self):
+        """Context lengths landing exactly ON and just around page
+        boundaries must mask identically in kernel and oracle."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.paged_attention import \
+            ragged_paged_attention_reference
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention_pallas
+        rng = np.random.RandomState(3)
+        bs, mp = 8, 4
+        kc = jnp.asarray(rng.randn(16, 2, bs, 64), jnp.float32)
+        vc = jnp.asarray(rng.randn(16, 2, bs, 64), jnp.float32)
+        tables = jnp.asarray(
+            rng.choice(16, (1, mp), replace=False).astype(np.int32))
+        ctxs = [1, bs - 1, bs, bs + 1, 2 * bs, 3 * bs + 1, mp * bs]
+        q = jnp.asarray(rng.randn(len(ctxs), 4, 64), jnp.float32)
+        rs = jnp.zeros(len(ctxs), jnp.int32)
+        rc = jnp.asarray(ctxs, jnp.int32)
+        ref = ragged_paged_attention_reference(q, kc, vc, tables, rs, rc)
+        out = ragged_paged_attention_pallas(q, kc, vc, tables, rs, rc)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_pure_decode_rows_match_decode_oracle(self):
+        """A ragged batch of single-token rows IS the decode kernel's
+        semantics — cross-check against the decode reference."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.paged_attention import (
+            paged_attention_decode_reference,
+            ragged_paged_attention_reference)
+        rng = np.random.RandomState(1)
+        b, bs, mp = 3, 16, 4
+        kc = jnp.asarray(rng.randn(32, 2, bs, 64), jnp.float32)
+        vc = jnp.asarray(rng.randn(32, 2, bs, 64), jnp.float32)
+        tables = jnp.asarray(
+            rng.choice(32, (b, mp), replace=False).astype(np.int32))
+        ctx = jnp.asarray([5, 37, 64], jnp.int32)
+        q = jnp.asarray(rng.randn(b, 8, 64), jnp.float32)
+        dref = paged_attention_decode_reference(q, kc, vc, tables, ctx)
+        rref = ragged_paged_attention_reference(
+            q, kc, vc, tables, jnp.arange(b, dtype=jnp.int32), ctx)
+        np.testing.assert_allclose(np.asarray(rref), np.asarray(dref),
+                                   atol=2e-5, rtol=2e-4)
+
+    def test_padding_rows_come_out_zero(self):
+        """row_ctx <= 0 rows (grid padding) are exactly zero in both
+        kernel and oracle — not a softmax over an all-masked row."""
+        import jax.numpy as jnp
+        from paddle_tpu.ops.paged_attention import \
+            ragged_paged_attention_reference
+        from paddle_tpu.ops.pallas.ragged_paged_attention import \
+            ragged_paged_attention_pallas
+        rng = np.random.RandomState(2)
+        kc = jnp.asarray(rng.randn(8, 1, 8, 64), jnp.float32)
+        vc = jnp.asarray(rng.randn(8, 1, 8, 64), jnp.float32)
+        tables = jnp.asarray([[0, 1]], jnp.int32)
+        q = jnp.asarray(rng.randn(3, 1, 64), jnp.float32)
+        rs = jnp.asarray([0, 0, 0], jnp.int32)
+        rc = jnp.asarray([5, 0, 0], jnp.int32)
+        ref = ragged_paged_attention_reference(q, kc, vc, tables, rs, rc)
+        out = ragged_paged_attention_pallas(q, kc, vc, tables, rs, rc)
+        assert np.all(np.asarray(ref[1:]) == 0)
+        assert np.all(np.asarray(out[1:]) == 0)
+        assert np.any(np.asarray(ref[0]) != 0)
+
+
+# ---------------------------------------------------------------------------
+# engine A/B: ragged on vs off
+# ---------------------------------------------------------------------------
+
+def _mk_model():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny())
+    model.eval()
+    return model
+
+
+class TestRaggedEngine:
+    def setup_method(self):
+        self.model = _mk_model()
+        self.cfg = self.model.cfg
+        self.rng = np.random.RandomState(17)
+
+    def _engine(self, **kw):
+        from paddle_tpu.inference import ServingEngine
+        kw.setdefault("max_batch_size", 3)
+        kw.setdefault("num_blocks", 96)
+        kw.setdefault("block_size", 8)
+        kw.setdefault("prompt_buckets", (8, 16, 32, 64))
+        kw.setdefault("chunk_size", 4)
+        kw.setdefault("prefill_chunk", 8)
+        return ServingEngine(self.model, **kw)
+
+    def _prompt(self, n):
+        return self.rng.randint(0, self.cfg.vocab_size, n) \
+            .astype(np.int32)
+
+    def _ab(self, reqs, **kw):
+        """Run the same request list ragged-off and ragged-on; returns
+        (toks_off, toks_on, stats_off, stats_on)."""
+        from paddle_tpu.inference import SamplingParams  # noqa: F401
+        outs, stats = [], []
+        for ragged in (False, True):
+            eng = self._engine(ragged=ragged, **kw)
+            rids = [eng.add_request(p, s) for p, s in reqs]
+            eng.run_to_completion()
+            outs.append([eng.result(r).tolist() for r in rids])
+            stats.append(eng.stats())
+        return outs[0], outs[1], stats[0], stats[1]
+
+    def test_greedy_identity_mixed_lengths(self):
+        from paddle_tpu.inference import SamplingParams
+        reqs = [(self._prompt(n), SamplingParams(max_new_tokens=m))
+                for n, m in ((5, 10), (12, 8), (30, 12), (9, 6),
+                             (17, 10))]
+        off, on, _, _ = self._ab(reqs)
+        assert on == off
+
+    def test_greedy_identity_chunked_long_prompt(self):
+        """A prompt spanning many ragged prefill chunks (and, on the
+        dense side, many no-sample mid programs) stays identical."""
+        from paddle_tpu.inference import SamplingParams
+        reqs = [(self._prompt(60), SamplingParams(max_new_tokens=8)),
+                (self._prompt(6), SamplingParams(max_new_tokens=16))]
+        off, on, _, _ = self._ab(reqs)
+        assert on == off
+
+    def test_greedy_identity_shared_prefix(self):
+        """Prefix-cache splices (incl. splice-pending waits on a
+        still-prefilling writer) behave identically on the ragged
+        path."""
+        from paddle_tpu.inference import SamplingParams
+        base = self._prompt(16)
+        reqs = [(np.concatenate([base, self._prompt(6)]),
+                 SamplingParams(max_new_tokens=8)),
+                (np.concatenate([base, self._prompt(9)]),
+                 SamplingParams(max_new_tokens=8)),
+                (self._prompt(11), SamplingParams(max_new_tokens=8))]
+        off, on, st_off, st_on = self._ab(reqs)
+        assert on == off
+        assert st_on["prefix_cache_hit_tokens"] == \
+            st_off["prefix_cache_hit_tokens"] > 0
+
+    def test_greedy_identity_eos_mid_chunk(self):
+        """An EOS discovered mid-chunk cuts the tail identically."""
+        from paddle_tpu.inference import SamplingParams
+        p = self._prompt(10)
+        # find a token the greedy stream actually emits, use it as EOS
+        eng = self._engine(ragged=True)
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=12))
+        eng.run_to_completion()
+        stream = eng.result(rid).tolist()
+        eos = stream[len(stream) // 2]
+        reqs = [(p, SamplingParams(max_new_tokens=12,
+                                   eos_token_id=eos)),
+                (self._prompt(7), SamplingParams(max_new_tokens=12))]
+        off, on, _, _ = self._ab(reqs)
+        assert on == off
+        assert on[0][-1] == eos and len(on[0]) < 12
+
+    def test_greedy_identity_gpt(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import ServingEngine, SamplingParams
+        from paddle_tpu.inference.gpt_decode import PagedGPTDecoder
+        paddle.seed(0)
+        model = GPTForCausalLM(gpt_tiny())
+        model.eval()
+        prompts = [self._prompt(n) for n in (5, 14, 28)]
+        outs = []
+        for ragged in (False, True):
+            dec = PagedGPTDecoder(model, num_blocks=64, block_size=8)
+            eng = ServingEngine(dec, max_batch_size=3,
+                                prompt_buckets=(8, 16, 32),
+                                chunk_size=4, prefill_chunk=8,
+                                ragged=ragged)
+            rids = [eng.add_request(p,
+                                    SamplingParams(max_new_tokens=10))
+                    for p in prompts]
+            eng.run_to_completion()
+            outs.append([eng.result(r).tolist() for r in rids])
+        assert outs[0] == outs[1]
+
+    def test_preemption_recompute_identity(self):
+        """Optimistic admission under a tiny pool forces OOM-driven
+        preemption-with-recompute on the ragged path; greedy outputs
+        stay identical to an unpressured dense run."""
+        from paddle_tpu.inference import SamplingParams
+        reqs = [(self._prompt(n), SamplingParams(max_new_tokens=24))
+                for n in (8, 16, 24, 8, 12)]
+        eng = self._engine(ragged=False, num_blocks=96)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        eng.run_to_completion()
+        ref = [eng.result(r).tolist() for r in rids]
+        eng = self._engine(ragged=True, num_blocks=12,
+                           admission="optimistic")
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        eng.run_to_completion()
+        out = [eng.result(r).tolist() for r in rids]
+        st = eng.stats()
+        assert st["preemptions"] >= 1
+        assert out == ref
+
+    def test_cancel_on_ragged_path(self):
+        """Cancelling a splice writer mid-prefill on the ragged path
+        restarts its readers and leaves the survivors identical."""
+        from paddle_tpu.inference import SamplingParams
+        base = self._prompt(16)
+        w = np.concatenate([base, self._prompt(8)])
+        r1 = np.concatenate([base, self._prompt(5)])
+        solo = self._prompt(9)
+        eng = self._engine(ragged=True)
+        rid_w = eng.add_request(w, SamplingParams(max_new_tokens=8))
+        rid_1 = eng.add_request(r1, SamplingParams(max_new_tokens=8))
+        rid_s = eng.add_request(solo, SamplingParams(max_new_tokens=8))
+        eng.step()
+        assert eng.cancel(rid_w)
+        eng.run_to_completion()
+        assert eng.request(rid_w).state == "aborted"
+        assert eng.request(rid_1).state == "done"
+        # survivors identical to a run that never saw the writer
+        eng2 = self._engine(ragged=True)
+        a = eng2.add_request(r1, SamplingParams(max_new_tokens=8))
+        b = eng2.add_request(solo, SamplingParams(max_new_tokens=8))
+        eng2.run_to_completion()
+        assert eng.result(rid_1).tolist() == eng2.result(a).tolist()
+        assert eng.result(rid_s).tolist() == eng2.result(b).tolist()
+
+    def test_rich_sampling_routes_and_is_deterministic(self):
+        """top_k=1 through the rich ragged program is greedy (the
+        single candidate wins regardless of the PRNG draw) — it must
+        match the plain greedy stream; and a seeded stochastic run is
+        reproducible."""
+        from paddle_tpu.inference import SamplingParams
+        p = self._prompt(9)
+        eng = self._engine(ragged=True)
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        greedy = eng.result(rid).tolist()
+        eng = self._engine(ragged=True)
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=8,
+                                                temperature=0.8,
+                                                top_k=1))
+        eng.run_to_completion()
+        assert eng.result(rid).tolist() == greedy
+        outs = []
+        for _ in range(2):
+            eng = self._engine(ragged=True, seed=7)
+            rid = eng.add_request(p, SamplingParams(
+                max_new_tokens=8, temperature=0.9, top_k=4,
+                repetition_penalty=1.3))
+            eng.run_to_completion()
+            outs.append(eng.result(rid).tolist())
+        assert outs[0] == outs[1]
+
+    def test_dispatch_reduction_at_least_2x(self):
+        """The acceptance ratio: a steady decode workload with a long
+        prompt arriving mid-stream must need >= 2x fewer device
+        dispatches per delivered token with ragged on (one program per
+        step vs merge + decode + prefill dispatches)."""
+        from paddle_tpu.inference import SamplingParams
+        shorts = [self._prompt(8) for _ in range(3)]
+        longp = self._prompt(48)
+        per_tok = {}
+        toks = {}
+        for ragged in (False, True):
+            eng = self._engine(ragged=ragged)
+            rids = [eng.add_request(p,
+                                    SamplingParams(max_new_tokens=24))
+                    for p in shorts]
+            while eng.generated_tokens < 12:
+                eng.step()
+            rl = eng.add_request(longp,
+                                 SamplingParams(max_new_tokens=8))
+            eng.run_to_completion()
+            st = eng.stats()
+            assert st["device_dispatches"] > 0
+            per_tok[ragged] = (st["device_dispatches"]
+                               / st["generated_tokens"])
+            toks[ragged] = [eng.result(r).tolist()
+                            for r in rids + [rl]]
+        assert toks[True] == toks[False]
+        assert per_tok[False] / per_tok[True] >= 2.0, per_tok
+
+    def test_self_victim_preemption_blanks_partial_rows(self):
+        """A decode request that becomes its OWN preemption victim
+        mid-build (extend raises with no other candidate) must have its
+        already-written rows re-aimed at the scratch page — they point
+        into pages freed by the preemption, which later rows of the
+        SAME chunk may re-take — and recover token-identically via
+        recompute. Regression: the victim was registered in the
+        staleness sweep only AFTER a successful build, so its partial
+        rows stayed live."""
+        from paddle_tpu.ops.paged_attention import KVCacheExhausted
+        from paddle_tpu.inference import SamplingParams
+        reqs = [(self._prompt(8), SamplingParams(max_new_tokens=16)),
+                (self._prompt(12), SamplingParams(max_new_tokens=16))]
+        ref_eng = self._engine(ragged=True)
+        ref_ids = [ref_eng.add_request(p, s) for p, s in reqs]
+        ref_eng.run_to_completion()
+        ref = [ref_eng.result(r).tolist() for r in ref_ids]
+        eng = self._engine(ragged=True)
+        rids = [eng.add_request(p, s) for p, s in reqs]
+        while eng.generated_tokens < 4:
+            eng.step()
+        victim = next(r for r in eng._slots
+                      if r is not None and r.req_id == rids[0])
+        vslot = victim.slot
+        assert victim.state == "running" and vslot is not None
+        orig_ext = eng._extend_with_preempt
+        state = {"armed": True, "n": 0}
+
+        def ext_spy(r, exclude=()):
+            if state["armed"] and r is victim:
+                state["n"] += 1
+                if state["n"] == 2:
+                    state["armed"] = False
+                    raise KVCacheExhausted("forced self-victim")
+            return orig_ext(r, exclude)
+
+        eng._extend_with_preempt = ext_spy
+        seen_rseq = []
+        orig_j = eng._ragged_j
+
+        def j_spy(*args):
+            seen_rseq.append(np.asarray(args[11]))   # rseq_all
+            return orig_j(*args)
+
+        eng._ragged_j = j_spy
+        eng.step()
+        # every chunk dispatched by the forced step must have dropped
+        # the victim's slot index (partial rows blanked to scratch);
+        # the survivor's column keeps the program alive
+        assert seen_rseq, "forced step dispatched nothing"
+        assert state["n"] >= 2, "spy never armed the self-preemption"
+        for rs in seen_rseq:
+            assert not np.any(rs == vslot)
+        eng._extend_with_preempt = orig_ext
+        eng._ragged_j = orig_j
+        eng.run_to_completion()
+        assert eng.stats()["preemptions"] >= 1
+        assert [eng.result(r).tolist() for r in rids] == ref
+
+    def test_finals_never_share_a_column(self):
+        """Sampling finals must land on DISTINCT columns (the rich seen
+        mask is seeded per column). Geometry that wraps a third final
+        onto two already-claimed adjacent columns: 1 decode column,
+        T=2, prefill takes of (1, 1, 6) rows — the 6-row request's
+        final wraps to ministep 1 and collides with BOTH earlier
+        finals' columns in sequence. Regression: the collision skip
+        advanced one cell without re-checking."""
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(ragged=True, max_batch_size=5, chunk_size=2,
+                           prefill_budget=8)
+        rid = eng.add_request(self._prompt(8),
+                              SamplingParams(max_new_tokens=24))
+        while eng.generated_tokens < 4:
+            eng.step()
+        for n in (1, 1, 6):
+            eng.add_request(self._prompt(n),
+                            SamplingParams(max_new_tokens=4))
+        finals_seen = 0
+        while eng.has_work:
+            eng.step()
+            for ch in eng._inflight:
+                if ch["kind"] == "ragged":
+                    cols = [c for _, _, _, c in ch["finals"]]
+                    finals_seen = max(finals_seen, len(cols))
+                    assert len(cols) == len(set(cols)), cols
+        assert eng.request(rid).state == "done"
+
+    def test_stats_plumbing(self):
+        from paddle_tpu.inference import SamplingParams
+        eng = self._engine(ragged=True)
+        rid = eng.add_request(self._prompt(9),
+                              SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["device_dispatches"] > 0
+        assert st["tokens_per_dispatch"] == pytest.approx(
+            st["generated_tokens"] / st["device_dispatches"])
+        # ragged waste is the pad-to-grid remainder: strictly smaller
+        # than the full [T, max_b] grid the dense path would have run
+        assert st["decode_slot_steps"] > 0
+        assert 0 <= st["padded_token_waste"] < st["decode_slot_steps"]
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["device_dispatches"] == 0
+        assert st["tokens_per_dispatch"] == 0.0
+        assert st["padded_token_waste"] == 0

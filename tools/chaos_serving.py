@@ -47,7 +47,8 @@ def build_engine(model, args):
         prefill_chunk=8,
         admission="optimistic",
         max_dispatch_retries=args.retries,
-        retry_backoff_s=0.0)
+        retry_backoff_s=0.0,
+        ragged=args.ragged)
 
 
 def gen_workload(args):
@@ -159,6 +160,10 @@ def main() -> int:
     ap.add_argument("--p-dispatch", type=float, default=0.04)
     ap.add_argument("--p-collect", type=float, default=0.03)
     ap.add_argument("--p-latency", type=float, default=0.02)
+    ap.add_argument("--ragged", action="store_true",
+                    help="exercise the ragged unified prefill+decode "
+                         "path (ISSUE 5): both the chaos and the "
+                         "fault-free replay run with ragged=True")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
@@ -192,6 +197,7 @@ def main() -> int:
             faulted += 1
     st = eng.stats()
     summary = {
+        "ragged": args.ragged,
         "steps": steps_run,
         "requests": len(chaos_results),
         "done_identical": done - len(mismatches),

@@ -46,6 +46,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_chunked_prefill.py"),
     os.path.join(REPO, "tests", "test_serving.py"),
     os.path.join(REPO, "tests", "test_fault_tolerance.py"),
+    os.path.join(REPO, "tests", "test_ragged_batching.py"),
 ]
 
 
@@ -66,21 +67,31 @@ def run_flightcheck() -> int:
 
 
 def run_chaos() -> int:
-    """Chaos phase (ISSUE 4): a short DETERMINISTIC fault-injection
-    schedule — seeded OOMs, dispatch faults, collect faults and
-    cancellations over an optimistically-admitted engine — asserting
-    debug_check after every step and token identity of every surviving
-    request vs a fault-free replay. --require-events guarantees each
-    gate run exercised at least one OOM-driven preemption, one
-    injected dispatch failure and one cancellation."""
+    """Chaos phase (ISSUE 4; ISSUE 5 added the ragged leg): a short
+    DETERMINISTIC fault-injection schedule — seeded OOMs, dispatch
+    faults, collect faults and cancellations over an
+    optimistically-admitted engine — asserting debug_check after every
+    step and token identity of every surviving request vs a fault-free
+    replay. --require-events guarantees each gate run exercised at
+    least one OOM-driven preemption, one injected dispatch failure and
+    one cancellation. The schedule runs TWICE: once on the dense path
+    and once with ragged=True, so preemption row-range neutralize,
+    cancel-driven reader restarts and dispatch-fault recovery are
+    exercised on the unified one-program-per-step scheduler too."""
     import subprocess
-    cmd = [sys.executable,
-           os.path.join(REPO, "tools", "chaos_serving.py"),
-           "--steps", "60", "--requests", "8", "--require-events"]
-    rc = subprocess.call(cmd)
-    print("CHAOS GATE OK — fault schedule survived, outputs identical"
-          if rc == 0 else f"CHAOS GATE FAILED (exit {rc})")
-    return rc
+    rc_all = 0
+    for leg in ((), ("--ragged",)):
+        cmd = [sys.executable,
+               os.path.join(REPO, "tools", "chaos_serving.py"),
+               "--steps", "60", "--requests", "8", "--require-events",
+               *leg]
+        rc = subprocess.call(cmd)
+        tag = "ragged" if leg else "dense"
+        print(f"CHAOS GATE ({tag}) OK — fault schedule survived, "
+              "outputs identical" if rc == 0
+              else f"CHAOS GATE ({tag}) FAILED (exit {rc})")
+        rc_all = rc_all or rc
+    return rc_all
 
 
 def main() -> int:

@@ -11,11 +11,12 @@
 #   2. flightcheck --jaxpr: trace the serving/paged-decode entry points
 #      and cross-check the AST verdicts + IR-level PRNG audit
 #   3. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
-#      serving-path tests incl. test_fault_tolerance.py; includes its
-#      own inference/ flightcheck AND the deterministic chaos schedule
-#      — every gate run exercises >=1 OOM-preemption, >=1 injected
-#      dispatch failure and >=1 cancellation, with token-identity vs
-#      a fault-free replay)
+#      serving-path tests incl. test_fault_tolerance.py and
+#      test_ragged_batching.py; includes its own inference/ flightcheck
+#      AND the deterministic chaos schedule, run on BOTH the dense and
+#      the ragged unified path — every gate run exercises >=1
+#      OOM-preemption, >=1 injected dispatch failure and >=1
+#      cancellation, with token-identity vs a fault-free replay)
 #   4. tier-1 pytest (tests/, -m 'not slow')
 set -u -o pipefail
 cd "$(dirname "$0")/.."
