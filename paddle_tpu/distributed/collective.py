@@ -99,17 +99,34 @@ def _stacked(x: Tensor, g: Group):
     return jax.device_put(arr, NamedSharding(g.mesh, P("rank")))
 
 
-def _run(g: Group, fn, arr, out_spec=P("rank")):
+# jitted collective programs memoized per (body identity, group ranks,
+# out spec): `shard_map(...)` returns a FRESH callable every call, so an
+# unmemoized `jax.jit(f)` retraced every eager collective — each
+# all_reduce paid a trace+lower. Keyed on the body's cache key (the
+# builders below return stable keys), not the closure object.
+_PROGRAM_CACHE = {}
+
+
+def _run(g: Group, fn, arr, out_spec=P("rank"), cache_key=None):
     from .watchdog import get_default_watchdog, watch_section
-    f = shard_map(fn, mesh=g.mesh, in_specs=(P("rank"),),
-                  out_specs=out_spec, check_vma=False)
+    key = None
+    jf = None
+    if cache_key is not None:
+        key = (cache_key, tuple(g.ranks), str(out_spec))
+        jf = _PROGRAM_CACHE.get(key)
+    if jf is None:
+        f = shard_map(fn, mesh=g.mesh, in_specs=(P("rank"),),
+                      out_specs=out_spec, check_vma=False)
+        jf = jax.jit(f)
+        if key is not None:
+            _PROGRAM_CACHE[key] = jf
     if get_default_watchdog() is None:   # default: keep async dispatch
-        return jax.jit(f)(arr)
+        return jf(arr)
     # watchdog active: block inside the watched section so a device-side
     # hang is attributed to THIS collective (CommTaskManager parity:
     # comm_task_manager.h:37) — jax dispatch alone returns immediately.
     with watch_section(getattr(fn, "__name__", "collective")):
-        out = jax.jit(f)(arr)
+        out = jf(arr)
         jax.tree_util.tree_map(lambda a: a.block_until_ready(), out)
     return out
 
@@ -121,6 +138,75 @@ _REDUCERS = {
     ReduceOp.AVG: lambda x, ax: jax.lax.pmean(x, ax),
     ReduceOp.PROD: lambda x, ax: jnp.exp(jax.lax.psum(jnp.log(x), ax)),
 }
+
+
+# -- per-collective shard bodies --------------------------------------------
+# Module-level builders (not inline lambdas) so (a) the public APIs and
+# the comm auditor (tools/flightcheck/comm_audit.py) trace the SAME
+# production bodies, and (b) each body carries a stable cache key for
+# the program memo above.
+
+def all_reduce_body(op):
+    def body(x):
+        return _REDUCERS[op](x, "rank")
+    return body
+
+
+def all_gather_body():
+    def body(x):
+        return jax.lax.all_gather(x, "rank", axis=0, tiled=True)
+    return body
+
+
+def broadcast_body(src_local):
+    def body(x):
+        # select src rank's slice for everyone (pbroadcast via psum of
+        # a mask)
+        idx = jax.lax.axis_index("rank")
+        contrib = jnp.where(idx == src_local, x, jnp.zeros_like(x))
+        return jax.lax.psum(contrib, "rank")
+    return body
+
+
+def reduce_body(op, dst_local):
+    def body(x):
+        total = _REDUCERS[op](x, "rank")
+        idx = jax.lax.axis_index("rank")
+        return jnp.where(idx == dst_local, total, x)
+    return body
+
+
+def reduce_scatter_body(op=ReduceOp.SUM):
+    if op != ReduceOp.SUM:
+        # the XLA primitive is sum-only; the old code silently summed
+        # for every op — fail loudly instead of returning wrong math
+        raise NotImplementedError(
+            f"reduce_scatter supports ReduceOp.SUM only (psum_scatter "
+            f"is a sum); got {op!r}")
+
+    def body(x):
+        return jax.lax.psum_scatter(x, "rank", scatter_dimension=1,
+                                    tiled=False)
+    return body
+
+
+def all_to_all_body():
+    def body(x):
+        return jax.lax.all_to_all(x, "rank", split_axis=1,
+                                  concat_axis=0, tiled=False)
+    return body
+
+
+def barrier_body():
+    def body(x):
+        return jax.lax.psum(x, "rank")
+    return body
+
+
+def ppermute_body(perm):
+    def body(x):
+        return jax.lax.ppermute(x, "rank", perm)
+    return body
 
 
 class _Task:
@@ -150,7 +236,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group=None,
                sync_op=True) -> _Task:
     g = _group(group)
     arr = _stacked(tensor, g)
-    out = _run(g, lambda x: _REDUCERS[op](x, "rank"), arr)
+    out = _run(g, all_reduce_body(op), arr,
+               cache_key=("all_reduce", op))
     tensor._replace(out)
     return _Task(out)
 
@@ -162,8 +249,8 @@ def all_gather(tensor_list: List, tensor: Tensor, group=None,
     g = _group(group)
     arr = _stacked(tensor, g)
     # per-shard [1,...] → all_gather(tiled) [nranks,...], replicated output
-    out = _run(g, lambda x: jax.lax.all_gather(x, "rank", axis=0, tiled=True),
-               arr, out_spec=P())
+    out = _run(g, all_gather_body(), arr, out_spec=P(),
+               cache_key=("all_gather",))
     gathered = jax.device_get(out)
     tensor_list.clear()
     for i in range(g.nranks):
@@ -188,9 +275,7 @@ def all_to_all(out_tensor_list: List, in_tensor_list, group=None,
             "eager all_to_all takes a rank-stacked Tensor "
             "[nranks_src, nranks_dst, ...] in single-controller mode")
     # arr: [src, dst, ...] sharded on src → output [dst, src, ...]
-    out = _run(g, lambda x: jax.lax.all_to_all(x, "rank", split_axis=1,
-                                               concat_axis=0, tiled=False),
-               arr)
+    out = _run(g, all_to_all_body(), arr, cache_key=("all_to_all",))
     out_tensor_list.clear()
     out_tensor_list.append(Tensor(out))
     return _Task(out)
@@ -200,14 +285,8 @@ def broadcast(tensor: Tensor, src=0, group=None, sync_op=True) -> _Task:
     g = _group(group)
     arr = _stacked(tensor, g)
     src_local = g.get_group_rank(src) if src in g.ranks else src
-
-    def f(x):
-        # select src rank's slice for everyone (pbroadcast via psum of mask)
-        idx = jax.lax.axis_index("rank")
-        contrib = jnp.where(idx == src_local, x, jnp.zeros_like(x))
-        return jax.lax.psum(contrib, "rank")
-
-    out = _run(g, f, arr)
+    out = _run(g, broadcast_body(src_local), arr,
+               cache_key=("broadcast", src_local))
     tensor._replace(out)
     return _Task(out)
 
@@ -217,13 +296,8 @@ def reduce(tensor: Tensor, dst=0, op=ReduceOp.SUM, group=None,
     g = _group(group)
     arr = _stacked(tensor, g)
     dst_local = g.get_group_rank(dst) if dst in g.ranks else dst
-
-    def f(x):
-        total = _REDUCERS[op](x, "rank")
-        idx = jax.lax.axis_index("rank")
-        return jnp.where(idx == dst_local, total, x)
-
-    out = _run(g, f, arr)
+    out = _run(g, reduce_body(op, dst_local), arr,
+               cache_key=("reduce", op, dst_local))
     tensor._replace(out)
     return _Task(out)
 
@@ -239,11 +313,8 @@ def reduce_scatter(tensor: Tensor, tensor_list, op=ReduceOp.SUM, group=None,
         arr = _stacked(tensor_list[0], g) if len(tensor_list) == 1 else \
             jnp.stack([t._value for t in tensor_list])
 
-    def f(x):
-        return jax.lax.psum_scatter(x, "rank", scatter_dimension=1,
-                                    tiled=False)
-
-    out = _run(g, f, arr)
+    out = _run(g, reduce_scatter_body(op), arr,
+               cache_key=("reduce_scatter", op))
     tensor._replace(out)
     return _Task(out)
 
@@ -275,7 +346,7 @@ def barrier(group=None):
     g = _group(group)
     x = jnp.zeros((g.nranks,), jnp.int32)
     arr = jax.device_put(x, NamedSharding(g.mesh, P("rank")))
-    out = _run(g, lambda v: jax.lax.psum(v, "rank"), arr)
+    out = _run(g, barrier_body(), arr, cache_key=("barrier",))
     out.block_until_ready()
 
 
@@ -387,7 +458,8 @@ def batch_isend_irecv(p2p_op_list) -> List[_Task]:
                     "list order — reorder the batch or fix the peers")
         perm = [(s, d) for s, d in enumerate(dest)]
         arr = _stacked(s_op.tensor, g)
-        out = _run(g, lambda x: jax.lax.ppermute(x, "rank", perm), arr)
+        out = _run(g, ppermute_body(perm), arr,
+                   cache_key=("ppermute", tuple(perm)))
         r_op.tensor._replace(out)
         tasks.append(_Task(out))
     return tasks

@@ -164,11 +164,24 @@ def _pallas_blk_bwd(q, k, v, out, lse, do, causal, scale):
                                      block_k=plan[1] or k.shape[1])
 
 
-def _pallas_ok(q, k):
+def _pallas_ok(q_shape, k_shape, halved=False):
     # shared gate with ops.flash_attention (ring shards are often shorter
-    # than a full sequence, hence the lower min_seq)
+    # than a full sequence, hence the lower min_seq). Shape-only on
+    # purpose: the eligibility decision is Python-static under tracing,
+    # so the gate takes shapes, not arrays — the decision provably
+    # cannot depend on traced VALUES (and flightcheck's taint pass can
+    # see that). halved=True gates the zigzag path, which feeds the
+    # kernel half-blocks.
+    import jax
     from ..ops.flash_attention import pallas_attention_plan
-    return pallas_attention_plan(q, k, min_seq=128) is not None
+    qs, ks = list(q_shape), list(k_shape)
+    if halved:
+        qs[1] //= 2
+        ks[1] //= 2
+    return pallas_attention_plan(
+        jax.ShapeDtypeStruct(tuple(qs), jnp.float32),
+        jax.ShapeDtypeStruct(tuple(ks), jnp.float32),
+        min_seq=128) is not None
 
 
 # ---------------------------------------------------------------------------
@@ -400,11 +413,7 @@ def ring_attention_local(q, k, v, axis_name: str, causal: bool = True,
     if use_pallas is None:
         # zigzag computes on half-blocks — the kernel gate must pass for
         # the shapes actually fed to it
-        if zigzag:
-            half = q.shape[1] // 2
-            use_pallas = _pallas_ok(q[:, :half], k[:, :half])
-        else:
-            use_pallas = _pallas_ok(q, k)
+        use_pallas = _pallas_ok(q.shape, k.shape, halved=zigzag)
     if zigzag:
         if not causal:
             raise ValueError("zigzag placement only helps causal "

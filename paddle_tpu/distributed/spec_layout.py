@@ -1,0 +1,101 @@
+"""Canonical PartitionSpecs for tensor-parallel serving (the
+`SpecLayout` pattern, SNIPPETS.md [2]) — ONE table that every call site
+annotating a decoder weight or the paged KV pool must agree with.
+
+ROADMAP item 1 (multi-chip TP decode on the 8-device mesh) shards the
+decoder over a ``tp`` mesh axis. The failure mode this table exists to
+prevent is *spec drift*: the same parameter annotated column-parallel at
+one call site and row-parallel at another composes into silent
+all-gathers (or wrong math under shard_map). The table is the single
+source of truth, in BOTH directions:
+
+- runtime: ``layout.sharding(mesh, name)`` / ``layout.apply(mesh,
+  weights)`` place a PagedLlamaDecoder-style weight tree (the
+  ``paged_decode._weights_from_model`` key vocabulary: wq/wk/wv/wo,
+  wg/wu/wd, embed/head/norm, cache_k/cache_v) onto a mesh;
+- static analysis: ``tools/flightcheck`` rule FC605 parses
+  ``CANONICAL_SPECS`` out of this file (AST, no import) and flags any
+  *literal* PartitionSpec in the tree that disagrees with the canonical
+  layout for the same parameter name on the same axis vocabulary.
+
+Layout choices (Megatron-style 1-allreduce-per-block decode):
+- attention: wq/wk/wv column-parallel (heads split over tp), wo
+  row-parallel — the block's only collective is the allreduce after wo;
+- mlp: wg/wu column-parallel, wd row-parallel — allreduce after wd;
+- embed/norm replicated (small), head column-parallel (per-shard logits
+  concatenate over vocab);
+- paged KV pool: [num_blocks, block_size, kv_heads, head_dim] sharded
+  over the kv-head dim, so a tp shard appends exactly the heads it
+  computed — no cross-chip traffic on the KV write path.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["SpecLayout", "CANONICAL_SPECS", "TP_AXIS"]
+
+TP_AXIS = "tp"
+
+# parameter name -> canonical PartitionSpec over the tp axis. The specs
+# describe the TRAILING dims of the parameter (stacked trunks prepend
+# bookkeeping dims; FC605 compares suffixes). Keep every value a P(...)
+# LITERAL — flightcheck reads this dict syntactically.
+CANONICAL_SPECS: Dict[str, P] = {
+    # attention (column: out-features split; row: in-features split)
+    "wq": P(None, "tp"),
+    "wk": P(None, "tp"),
+    "wv": P(None, "tp"),
+    "wo": P("tp", None),
+    # mlp
+    "wg": P(None, "tp"),
+    "wu": P(None, "tp"),
+    "wd": P("tp", None),
+    # embedding / output
+    "embed": P(None, None),
+    "norm": P(None),
+    "head": P(None, "tp"),
+    # paged KV pool: [num_blocks, block_size, kv_heads, head_dim]
+    "cache_k": P(None, None, "tp", None),
+    "cache_v": P(None, None, "tp", None),
+}
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Resolved canonical layout over a concrete tp axis name."""
+
+    tp_axis: str = TP_AXIS
+
+    def spec(self, name: str) -> P:
+        base = CANONICAL_SPECS.get(name)
+        if base is None:
+            # per-layer dicts nest under "layers"; unknown small tensors
+            # (norms, rope caches, scales) replicate
+            return P()
+        if self.tp_axis == TP_AXIS:
+            return base
+        return P(*[self.tp_axis if e == TP_AXIS else e for e in base])
+
+    def sharding(self, mesh, name: str) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(name))
+
+    def apply(self, mesh, weights):
+        """device_put a paged-decoder weight tree by key name. Leaves
+        under ``layers`` (a list of per-layer dicts) use their dict key;
+        anything without a canonical entry replicates."""
+        import jax
+
+        def put(name, leaf):
+            return jax.device_put(leaf, self.sharding(mesh, name))
+
+        out = {}
+        for k, v in weights.items():
+            if k == "layers":
+                out[k] = [{kk: put(kk, vv) for kk, vv in layer.items()}
+                          for layer in v]
+            else:
+                out[k] = put(k, v)
+        return out

@@ -116,7 +116,12 @@ def _init_trunk(key, cfg: PipelinedLlamaConfig, pp: int):
 def _trunk_shardings(mesh, has_sharding_axis: bool):
     """NamedShardings for the stacked trunk (tp on 'mp', FSDP on
     'sharding'). Column-parallel projections shard the output feature dim
-    over mp; row-parallel (wo/wd) shard the input feature dim."""
+    over mp; row-parallel (wo/wd) shard the input feature dim — the same
+    column/row layout the canonical serving table pins
+    (distributed/spec_layout.SpecLayout, 'tp' axis); flightcheck FC605
+    flags any literal spec that drifts from it, and the comm audit
+    (tools/flightcheck/comm_audit.py `llama_pp.train_step`) pins this
+    step's collectives."""
     sh = "sharding" if has_sharding_axis else None
     spec = {
         "wq": P(None, "pp", None, sh, "mp"),

@@ -185,6 +185,14 @@ def ragged_paged_attention_pallas(q, k_cache, v_cache, block_tables,
     r, nh, d = q.shape
     nb, kvh, bs, _ = k_cache.shape
     max_pages = block_tables.shape[1]
+    if nh % kvh:
+        # would otherwise surface as an opaque reshape error below;
+        # matters doubly under TP sharding, where SpecLayout shards the
+        # pool over the kv-head dim and each shard's nh/kvh must still
+        # group evenly
+        raise ValueError(
+            f"num_heads ({nh}) must be a multiple of kv_heads ({kvh}) "
+            f"for the GQA head grouping")
     group = nh // kvh
     if scale is None:
         scale = 1.0 / np.sqrt(d)

@@ -1,0 +1,32 @@
+"""known-bad: collective over an axis the shard_map never binds (FC601)
+— unbound at trace time, or an auto axis under partial-manual, which
+the jax 0.4.x SPMD partitioner hard-aborts on."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("dp", "mp"))
+
+
+def _sum_body(x):
+    return jax.lax.psum(x, "tp")        # MESH binds dp/mp, not tp
+
+
+def run(x):
+    f = shard_map(_sum_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P("dp"))
+    return f(x)
+
+
+def _partial_body(x):
+    # 'mp' is an AUTO axis here (axis_names only binds dp): this is the
+    # spmd_partitioner.cc:512 abort
+    return jax.lax.psum(x, "mp")
+
+
+def run_partial(x):
+    f = shard_map(_partial_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P("dp"), axis_names={"dp"})
+    return f(x)

@@ -1,0 +1,29 @@
+"""known-good twin of fc601_bad: every collective names an axis the
+enclosing shard_map actually binds."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("dp", "mp"))
+
+
+def _sum_body(x):
+    return jax.lax.psum(x, "dp")        # bound by the mesh
+
+
+def run(x):
+    f = shard_map(_sum_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P("dp"))
+    return f(x)
+
+
+def _partial_body(x):
+    return jax.lax.psum(x, "dp")        # the one manual axis
+
+
+def run_partial(x):
+    f = shard_map(_partial_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P("dp"), axis_names={"dp"})
+    return f(x)

@@ -1,0 +1,20 @@
+"""known-bad: out_specs claims a replicated output with the rep checker
+disabled and no psum/pvary in the body (FC602) — each shard computes its
+own mean and the P() claim silently takes one shard's value."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(8,), ("dp",))
+
+
+def _mean_body(x):
+    return jnp.mean(x, axis=0, keepdims=True)   # per-shard only
+
+
+def run(x):
+    f = shard_map(_mean_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P(), check_vma=False)
+    return f(x)

@@ -1,0 +1,20 @@
+"""known-good twin of fc602_bad: the body establishes replication with
+a pmean before the P() claim, so every shard returns the same value."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(8,), ("dp",))
+
+
+def _mean_body(x):
+    local = jnp.mean(x, axis=0, keepdims=True)
+    return jax.lax.pmean(local, "dp")           # replicated for real
+
+
+def run(x):
+    f = shard_map(_mean_body, mesh=MESH, in_specs=(P("dp"),),
+                  out_specs=P(), check_vma=False)
+    return f(x)

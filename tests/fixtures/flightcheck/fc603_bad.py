@@ -1,0 +1,22 @@
+"""known-bad: with_sharding_constraint inside a FULLY-manual shard_map
+(FC603) — there are no auto axes to constrain, and jax 0.4.x hard-aborts
+lowering it on hybrid meshes (the trap PR 3 fixed twice)."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("pp", "mp"))
+
+
+def _stage(x):
+    h = x * 2.0
+    h = jax.lax.with_sharding_constraint(h, P(None, "mp"))  # dead hint
+    return jax.lax.psum(h, "pp")
+
+
+def run(x):
+    f = shard_map(_stage, mesh=MESH, in_specs=(P("pp"),),
+                  out_specs=P("pp"))                # fully manual
+    return f(x)

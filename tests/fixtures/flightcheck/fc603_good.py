@@ -1,0 +1,36 @@
+"""known-good twin of fc603_bad: the GSPMD hint is either gated on
+partial_manual_ok() (the pp_schedule/llama_pp idiom) or lives in a
+partial-manual shard_map where auto axes exist to constrain."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from paddle_tpu.distributed.fleet.pp_schedule import partial_manual_ok
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("pp", "mp"))
+
+
+def _stage_gated(x):
+    h = x * 2.0
+    if partial_manual_ok():                 # hint only when auto axes
+        h = jax.lax.with_sharding_constraint(h, P(None, "mp"))
+    return jax.lax.psum(h, "pp")
+
+
+def run(x):
+    f = shard_map(_stage_gated, mesh=MESH, in_specs=(P("pp"),),
+                  out_specs=P("pp"))
+    return f(x)
+
+
+def _stage_partial(x):
+    h = jax.lax.with_sharding_constraint(x * 2.0, P(None, "mp"))
+    return jax.lax.psum(h, "pp")
+
+
+def run_partial(x):
+    f = shard_map(_stage_partial, mesh=MESH, in_specs=(P("pp"),),
+                  out_specs=P("pp"), axis_names={"pp"})  # mp is auto
+    return f(x)

@@ -1,0 +1,19 @@
+"""known-bad: dimension sharded over a mesh axis that does not divide
+its size (FC604) — GSPMD pads the shards silently and every collective
+on the value moves (and every reduction sums) the padding."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("dp", "mp"))
+
+
+def place():
+    x = jnp.zeros((6, 16))                   # 6 % 4 != 0
+    return jax.device_put(x, NamedSharding(MESH, P("mp", None)))
+
+
+def place_inline():
+    return jax.device_put(jnp.ones((2, 10)),  # 10 % 4 != 0
+                          NamedSharding(MESH, P("dp", "mp")))

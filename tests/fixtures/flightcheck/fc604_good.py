@@ -1,0 +1,18 @@
+"""known-good twin of fc604_bad: every sharded dimension is an exact
+multiple of its mesh-axis (product) size."""
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+MESH = Mesh(np.arange(8).reshape(2, 4), ("dp", "mp"))
+
+
+def place():
+    x = jnp.zeros((8, 16))                   # 8 % 4 == 0
+    return jax.device_put(x, NamedSharding(MESH, P("mp", None)))
+
+
+def place_inline():
+    return jax.device_put(jnp.ones((2, 8)),   # 2 % 2, 8 % 4
+                          NamedSharding(MESH, P("dp", "mp")))

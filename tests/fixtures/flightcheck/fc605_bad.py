@@ -1,0 +1,14 @@
+"""known-bad: PartitionSpec drift (FC605) — the same parameter
+annotated with conflicting specs across call sites, and a spec that
+contradicts the canonical SpecLayout table
+(paddle_tpu/distributed/spec_layout.py)."""
+from jax.sharding import PartitionSpec as P
+
+# call site 1: column-parallel
+TRAIN_SPECS = {"wq": P(None, "tp")}
+
+# call site 2: the SAME weight, row-parallel — resharding all-gather
+SERVE_SPECS = {"wq": P("tp", None)}
+
+# contradicts the canonical table: wo is row-parallel (P('tp', None))
+EXPORT_SPECS = {"wo": P(None, "tp")}

@@ -1,0 +1,11 @@
+"""known-good twin of fc605_bad: one spec per parameter, agreeing with
+the canonical SpecLayout table — including the stacked-trunk form whose
+leading bookkeeping dims suffix-match the canonical entry."""
+from jax.sharding import PartitionSpec as P
+
+TRAIN_SPECS = {"wq": P(None, "tp"), "wo": P("tp", None)}
+
+SERVE_SPECS = {"wq": P(None, "tp"), "wo": P("tp", None)}
+
+# stacked [vpp, pp, layer, ...] trunk: suffix agrees with canonical
+STACKED_SPECS = {"wq": P(None, "pp", None, None, "tp")}

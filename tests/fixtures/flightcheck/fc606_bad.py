@@ -1,0 +1,15 @@
+"""known-bad: donated buffer whose sharding matches no output (FC606)
+— XLA cannot alias mismatched shardings, so the donation silently
+fails and the multi-GiB "in-place" update double-buffers."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _update(pool, x):
+    return pool.at[0].add(x)
+
+
+update_j = jax.jit(_update, donate_argnums=(0,),
+                   in_shardings=(P("dp"), P()),
+                   out_shardings=P(None, "mp"))     # pool can't alias

@@ -1,0 +1,14 @@
+"""known-good twin of fc606_bad: the donated input's sharding equals
+its output's, so the buffer aliases and the update is truly in place."""
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+def _update(pool, x):
+    return pool.at[0].add(x)
+
+
+update_j = jax.jit(_update, donate_argnums=(0,),
+                   in_shardings=(P("dp"), P()),
+                   out_shardings=P("dp"))

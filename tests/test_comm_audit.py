@@ -1,0 +1,182 @@
+"""Comm-audit gate: the jaxpr-level communication auditor.
+
+The audit abstract-traces every distributed entry point on the 8-device
+CPU mesh (no compile, no execution) and pins each program's collectives
+(kind / axis / per-shard payload bytes / count per dispatch) against the
+committed expectations file — the regression net under which multi-chip
+TP serving (ROADMAP item 1) ships: an accidental implicit all-gather or
+a doubled allreduce fails here, not in a profile three PRs later.
+"""
+import json
+import os
+
+import pytest
+
+from tools.flightcheck import comm_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def full_report():
+    return comm_audit.audit()
+
+
+class TestAuditMechanics:
+    def test_scan_multiplies_by_trip_count(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = comm_audit._mesh1d()
+
+        def body(x):
+            def step(c, _):
+                return jax.lax.ppermute(
+                    c, "rank",
+                    [(i, (i + 1) % 8) for i in range(8)]), None
+            out, _ = jax.lax.scan(step, x, None, length=5)
+            return out
+
+        f = shard_map(body, mesh=mesh, in_specs=(P("rank"),),
+                      out_specs=P("rank"), check_vma=False)
+        jx = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((8, 4), jnp.float32))
+        rows, flags = comm_audit.audit_jaxpr(jx)
+        assert rows == [{"kind": "ppermute", "axis": "rank",
+                         "bytes": 16, "count": 5}]
+        assert not flags
+
+    def test_doubled_collective_changes_the_audit(self):
+        """The hazard class this gate exists for: a refactor that
+        dispatches the same allreduce twice."""
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = comm_audit._mesh1d()
+
+        def once(x):
+            return jax.lax.psum(x, "rank")
+
+        def doubled(x):
+            return jax.lax.psum(jax.lax.psum(x, "rank") * 0.5, "rank")
+
+        def rows_of(body):
+            f = shard_map(body, mesh=mesh, in_specs=(P("rank"),),
+                          out_specs=P("rank"), check_vma=False)
+            jx = jax.make_jaxpr(f)(
+                jax.ShapeDtypeStruct((8, 4), jnp.float32))
+            return comm_audit.audit_jaxpr(jx)[0]
+
+        r1, r2 = rows_of(once), rows_of(doubled)
+        assert sum(r["count"] for r in r1) == 1
+        assert sum(r["count"] for r in r2) == 2
+        drift = comm_audit.compare(
+            {"collective.all_reduce": {"collectives": r2, "flags": []}},
+            {"collective.all_reduce": {"collectives": r1, "flags": []}})
+        assert drift and "drift" in drift[0]
+
+    def test_cond_branches_merge_by_max(self):
+        import jax
+        import jax.numpy as jnp
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+        mesh = comm_audit._mesh1d()
+
+        def body(x):
+            return jax.lax.cond(
+                x.sum() > 0,
+                lambda a: jax.lax.psum(a, "rank"),
+                lambda a: a * 2.0, x)
+
+        f = shard_map(body, mesh=mesh, in_specs=(P("rank"),),
+                      out_specs=P("rank"), check_vma=False)
+        jx = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((8, 4), jnp.float32))
+        rows, _ = comm_audit.audit_jaxpr(jx)
+        # worst-case branch: one psum (not zero, not double-counted)
+        assert sum(r["count"] for r in rows
+                   if r["kind"] == "psum") == 1
+
+
+class TestExpectationsRoundtrip:
+    def test_save_load_roundtrip(self, tmp_path):
+        report = {"prog.a": {"collectives": [
+            {"kind": "psum", "axis": "tp", "bytes": 1024, "count": 2}],
+            "flags": []}}
+        path = str(tmp_path / "exp.json")
+        comm_audit.save(report, path)
+        assert comm_audit.load(path) == report
+        # a second save of the loaded report is byte-identical
+        path2 = str(tmp_path / "exp2.json")
+        comm_audit.save(comm_audit.load(path), path2)
+        assert open(path).read() == open(path2).read()
+
+    def test_committed_file_parses_and_covers_all_programs(self):
+        exp = comm_audit.load()
+        assert set(exp) == set(comm_audit.program_names())
+        for name, entry in exp.items():
+            assert "error" not in entry, f"{name} committed as failing"
+            for row in entry["collectives"]:
+                assert set(row) == {"kind", "axis", "bytes", "count"}
+                assert row["count"] >= 1 and row["bytes"] > 0
+
+
+class TestAuditGate:
+    def test_all_programs_trace(self, full_report):
+        errors = {n: e["error"] for n, e in full_report.items()
+                  if "error" in e}
+        assert not errors, f"entry points failed to trace: {errors}"
+
+    def test_audit_matches_committed_expectations(self, full_report):
+        problems = comm_audit.compare(full_report, comm_audit.load())
+        assert not problems, "communication drift:\n" + \
+            "\n".join(problems)
+
+    def test_known_shapes_of_key_programs(self, full_report):
+        """Spot-check the structural facts the audit exists to pin."""
+        ring = full_report["ring_attention.zigzag_fwd"]["collectives"]
+        # the ring: k and v each hop n=8 times -> 16 ppermutes, nothing
+        # else (an implicit all-gather here would be the bug)
+        assert {r["kind"] for r in ring} == {"ppermute"}
+        assert sum(r["count"] for r in ring) == 16
+        ar = full_report["collective.all_reduce"]["collectives"]
+        assert len(ar) == 1 and ar[0]["kind"] == "psum" \
+            and ar[0]["axis"] == "rank"
+        pp = full_report["pp_schedule.1f1b"]["collectives"]
+        perm = [r for r in pp if r["kind"] == "ppermute"]
+        # 2 hops (fwd act + bwd grad) per tick, every tick
+        assert perm and all(r["count"] % 2 == 0 for r in perm)
+
+
+class TestSpecLayout:
+    def test_canonical_table_is_literal_and_complete(self):
+        from paddle_tpu.distributed.spec_layout import (CANONICAL_SPECS,
+                                                        SpecLayout)
+        for key in ("wq", "wk", "wv", "wo", "wg", "wu", "wd", "embed",
+                    "head", "norm", "cache_k", "cache_v"):
+            assert key in CANONICAL_SPECS
+        lay = SpecLayout()
+        assert tuple(lay.spec("wq")) == (None, "tp")
+        # axis renaming keeps the layout shape
+        assert tuple(SpecLayout(tp_axis="mp").spec("wo")) == ("mp", None)
+
+    def test_apply_places_weight_tree(self):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh
+        from paddle_tpu.distributed.spec_layout import SpecLayout
+        mesh = Mesh(np.asarray(jax.devices()[:8]), ("tp",))
+        w = {"embed": jnp.zeros((64, 32)),
+             "norm": jnp.zeros((32,)),
+             "head": jnp.zeros((32, 64)),
+             "layers": [{"wq": jnp.zeros((32, 32)),
+                         "wo": jnp.zeros((32, 32))}]}
+        placed = SpecLayout().apply(mesh, w)
+        head_spec = placed["head"].sharding.spec
+        assert tuple(head_spec) == (None, "tp")
+        wq = placed["layers"][0]["wq"]
+        # col-parallel: each tp shard holds 32/8 output features
+        assert wq.addressable_shards[0].data.shape == (32, 4)

@@ -25,7 +25,8 @@ FIXTURES = os.path.join(REPO, "tests", "fixtures", "flightcheck")
 PACKAGE = os.path.join(REPO, "paddle_tpu")
 
 RULES = ["FC101", "FC102", "FC103", "FC201", "FC202", "FC203",
-         "FC301", "FC401", "FC402", "FC501"]
+         "FC301", "FC401", "FC402", "FC501",
+         "FC601", "FC602", "FC603", "FC604", "FC605", "FC606"]
 
 
 def _scan(path):
@@ -128,6 +129,164 @@ class TestSuppressionsAndBaseline:
         docs = core.all_rules()
         for rule in RULES:
             assert rule in docs and docs[rule]
+
+
+class TestShardingRules:
+    """FC6xx-specific behavior beyond the generic fixture twins."""
+
+    def test_fc601_reports_bound_axes(self):
+        f = [x for x in _scan(os.path.join(FIXTURES, "fc601_bad.py"))
+             if x.rule == "FC601"][0]
+        assert "tp" in f.message and "shard_map" in f.message
+
+    def test_fc601_partial_manual_flags_auto_axis(self):
+        # the axis_names={'dp'} site: psum over the AUTO axis mp fires
+        fs = [x for x in _scan(os.path.join(FIXTURES, "fc601_bad.py"))
+              if x.rule == "FC601"]
+        assert any("'mp'" in f.message for f in fs)
+
+    def test_fc603_partial_manual_ok_gate_exempts(self):
+        src = (
+            "import jax\nfrom jax import shard_map\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "from x import partial_manual_ok\n"
+            "def body(x):\n"
+            "    if partial_manual_ok():\n"
+            "        x = jax.lax.with_sharding_constraint(x, P('mp'))\n"
+            "    return x\n"
+            "def run(x, mesh):\n"
+            "    return shard_map(body, mesh=mesh, in_specs=(P('pp'),),"
+            " out_specs=P('pp'))(x)\n")
+        assert not [f for f in core.check_source(src, "t.py")
+                    if f.rule == "FC603"]
+
+    def test_fc605_stacked_suffix_agrees(self):
+        # a stacked-trunk spec whose suffix matches canonical is clean
+        src = ("from jax.sharding import PartitionSpec as P\n"
+               "A = {'wq': P(None, 'pp', None, None, 'tp')}\n"
+               "B = {'wq': P(None, 'tp')}\n")
+        assert not core.check_source(src, "t.py")
+
+    def test_fc605_seeded_from_spec_layout_table(self):
+        # the canonical table is parsed out of the committed module
+        from tools.flightcheck.sharding import canonical_specs
+        canon = canonical_specs(REPO)
+        assert canon.get("wq") == (None, "tp")
+        assert canon.get("wo") == ("tp", None)
+
+    def test_variable_axis_names_are_skipped(self):
+        # non-literal axis -> no verdict (low-false-positive contract)
+        src = (
+            "import jax\nfrom jax import shard_map\n"
+            "from jax.sharding import PartitionSpec as P\n"
+            "def make(axis, mesh):\n"
+            "    def body(x):\n"
+            "        return jax.lax.psum(x, axis)\n"
+            "    return shard_map(body, mesh=mesh, "
+            "in_specs=(P(axis),), out_specs=P(axis))\n")
+        assert not core.check_source(src, "t.py")
+
+    def test_suppression_applies_to_fc6(self):
+        with open(os.path.join(FIXTURES, "fc602_bad.py"),
+                  encoding="utf-8") as fh:
+            src = fh.read()
+        suppressed = src.replace(
+            "out_specs=P(), check_vma=False)",
+            "out_specs=P(), check_vma=False)"
+            "  # flightcheck: disable=FC602")
+        assert not [f for f in core.check_source(suppressed, "t.py")
+                    if f.rule == "FC602"]
+
+
+class TestChangedAndCache:
+    def test_changed_files_parses_git_output(self, tmp_path):
+        from tools.flightcheck.__main__ import changed_files
+
+        class FakeProc:
+            def __init__(self, out):
+                self.stdout = out
+                self.returncode = 0
+
+        (tmp_path / "a.py").write_text("x = 1\n")
+        (tmp_path / "b.txt").write_text("not python\n")
+
+        def fake_run(cmd, **kw):
+            if "diff" in cmd:
+                return FakeProc("a.py\nb.txt\n")
+            return FakeProc("missing.py\n")
+
+        files = changed_files(str(tmp_path), run=fake_run)
+        # .py only, existing only
+        assert files == [str(tmp_path / "a.py")]
+
+    def test_changed_files_unreadable_git_falls_back(self, tmp_path):
+        from tools.flightcheck.__main__ import changed_files
+
+        def fake_run(cmd, **kw):
+            raise OSError("no git")
+
+        assert changed_files(str(tmp_path), run=fake_run) is None
+
+    def test_cache_roundtrip_and_content_keying(self, tmp_path):
+        from tools.flightcheck.cache import FindingsCache
+        src = ("import jax\n@jax.jit\ndef f(x):\n"
+               "    if x > 0:\n        return x\n    return -x\n")
+        findings = core.check_source(src, "t.py")
+        assert findings
+        cache = FindingsCache(str(tmp_path / "c.json"))
+        assert cache.lookup(src) is None
+        cache.store(src, None, findings)
+        cache.save()
+        reloaded = FindingsCache(str(tmp_path / "c.json"))
+        hit = reloaded.lookup(src)
+        assert hit is not None and \
+            [core.baseline_key(f) for f in hit] == \
+            [core.baseline_key(f) for f in findings]
+        # an edit (even a comment) changes the key -> miss
+        assert reloaded.lookup("# new\n" + src) is None
+        # a different rules filter keys separately
+        assert reloaded.lookup(src, ["FC101"]) is None
+
+    def test_check_path_serves_from_cache(self, tmp_path):
+        """Prove check_path consults the cache: poison the cached entry
+        for the file's (path, content) and observe it served verbatim."""
+        from tools.flightcheck.cache import FindingsCache
+        target = tmp_path / "mod.py"
+        target.write_text("x = 1\n")
+        src = target.read_text()
+        cache = FindingsCache(str(tmp_path / "c.json"))
+        planted = core.Finding("mod.py", 1, "FC999", "planted", "f")
+        cache.store(src, None, [planted], path=str(target))
+        got = core.check_path(str(target), cache=cache)
+        assert [f.rule for f in got] == ["FC999"]
+        # without the cache the file is clean
+        assert core.check_path(str(target)) == []
+
+    def test_cache_keys_include_path(self, tmp_path):
+        """Two files with IDENTICAL content cache separately — findings
+        (and baseline keys) are path-addressed, so a shared entry would
+        misattribute one file's findings to the other."""
+        from tools.flightcheck.cache import FindingsCache
+        src = ("import jax\n@jax.jit\ndef f(x):\n"
+               "    if x > 0:\n        return x\n    return -x\n")
+        for name in ("a.py", "b.py"):
+            (tmp_path / name).write_text(src)
+        cache = FindingsCache(str(tmp_path / "c.json"))
+        got = core.check_path(str(tmp_path), cache=cache)
+        paths = sorted({f.path for f in got if f.rule == "FC101"})
+        assert len(paths) == 2 and paths[0] != paths[1]
+        # and a second, fully-cached run reports the same attribution
+        again = core.check_path(str(tmp_path), cache=cache)
+        assert sorted({f.path for f in again
+                       if f.rule == "FC101"}) == paths
+
+    def test_explain_cli(self, capsys):
+        from tools.flightcheck.__main__ import main
+        assert main(["--explain", "FC601"]) == 0
+        out = capsys.readouterr().out
+        assert "FC601" in out and "fc601_bad.py" in out \
+            and "fc601_good.py" in out
+        assert main(["--explain", "FC000X"]) == 2
 
 
 class TestPackageGate:

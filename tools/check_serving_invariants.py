@@ -51,19 +51,37 @@ TEST_FILES = [
 
 
 def run_flightcheck() -> int:
-    """Static phase: flightcheck over the inference package."""
+    """Static phase: flightcheck over the WHOLE package (ISSUE 7 widened
+    the former inference/-only scope — the FC6xx sharding family gates
+    distributed/ and the models too), plus the comm audit: the
+    distributed entry points' collectives must match the committed
+    per-program expectations (kind/axis/bytes/count)."""
     from tools.flightcheck import DEFAULT_BASELINE, core
-    target = os.path.join(REPO, "paddle_tpu", "inference")
+    target = os.path.join(REPO, "paddle_tpu")
     new, old = core.run(target, DEFAULT_BASELINE)
     for f in new:
         print(core.format_finding(f))
+    rc = 0
     if new:
         print(f"FLIGHTCHECK GATE FAILED — {len(new)} new finding(s) in "
-              f"paddle_tpu/inference/")
-        return 1
-    print(f"FLIGHTCHECK OK — paddle_tpu/inference/ clean "
-          f"({len(old)} baselined)")
-    return 0
+              f"paddle_tpu/")
+        rc = 1
+    else:
+        print(f"FLIGHTCHECK OK — paddle_tpu/ clean "
+              f"({len(old)} baselined)")
+    if os.environ.get("FLIGHTCHECK_COMM_AUDIT_RAN") == "1":
+        # run_checks.sh already ran the audit as its own phase; don't
+        # trace all 14 distributed programs twice per gate run
+        print("COMM AUDIT skipped — already run by the caller")
+        return rc
+    import subprocess
+    comm_rc = subprocess.call(
+        [sys.executable, "-m", "tools.flightcheck.comm_audit"],
+        cwd=REPO)
+    print("COMM AUDIT OK — collectives match expectations"
+          if comm_rc == 0 else
+          f"COMM AUDIT GATE FAILED (exit {comm_rc})")
+    return rc or comm_rc
 
 
 def run_chaos() -> int:

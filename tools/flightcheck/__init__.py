@@ -4,16 +4,31 @@ A lint suite for the bug classes that make JAX code on TPUs fail
 *silently*: tracer leaks into Python control flow (FC101-FC103), jit
 recompilation storms (FC201-FC202), hidden host-device syncs on the
 serving hot path (FC301), PRNG key reuse and dead derivations
-(FC401-FC402), and use-after-donation (FC501). An optional jaxpr-backed
-mode (``--jaxpr``) traces the paged-decode/serving entry points and
-cross-checks the AST verdicts, keeping the static pass low-false-
-positive.
+(FC401-FC402), use-after-donation (FC501), and SPMD/sharding hazards at
+the shard_map/GSPMD layer (FC601-FC606: unbound collective axes, fake
+replication claims, in-body GSPMD constraints in fully-manual regions,
+mesh divisibility, PartitionSpec drift vs the canonical SpecLayout
+table, donation/sharding mismatch). Two dynamic cross-checks keep the
+static pass honest: ``--jaxpr`` traces the paged-decode/serving entry
+points and refutes/confirms AST verdicts, and the comm audit
+(``tools.flightcheck.comm_audit``) abstract-traces the distributed
+entry points on the 8-device mesh and pins every program's collectives
+(kind/axis/payload bytes/count per dispatch) against a committed
+expectations file.
 
 Usage::
 
     python -m tools.flightcheck paddle_tpu/            # lint the tree
     python -m tools.flightcheck --list-rules
+    python -m tools.flightcheck --explain FC603        # rule rationale
+    python -m tools.flightcheck --changed paddle_tpu/  # git-diff scoped
     python -m tools.flightcheck --jaxpr paddle_tpu/    # + jaxpr mode
+    python -m tools.flightcheck.comm_audit             # comm audit gate
+
+Findings cache: results are memoized on disk keyed by file content hash
+and a checker-source hash (``tools/flightcheck/.findings_cache.json``),
+so repeat runs over an unchanged tree skip re-parsing; ``--no-cache``
+bypasses it.
 
 Suppress a single intended finding inline::
 

@@ -6,7 +6,58 @@ Exit codes: 0 = clean (or only baselined findings), 1 = new findings,
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
+
+
+def changed_files(repo_root: str, run=subprocess.run):
+    """Tracked-modified + untracked .py files (git-diff scoped mode).
+    Returns None when git state is unreadable (caller falls back to a
+    full run — degrading to MORE coverage, never less)."""
+    try:
+        diff = run(["git", "-C", repo_root, "diff", "--name-only",
+                    "HEAD"], capture_output=True, text=True, timeout=30)
+        untracked = run(["git", "-C", repo_root, "ls-files", "--others",
+                         "--exclude-standard"], capture_output=True,
+                        text=True, timeout=30)
+        if diff.returncode or untracked.returncode:
+            return None
+    except (OSError, subprocess.SubprocessError):
+        return None
+    files = set(diff.stdout.splitlines()) | \
+        set(untracked.stdout.splitlines())
+    out = []
+    for f in sorted(files):
+        if f.endswith(".py"):
+            ap = os.path.join(repo_root, f)
+            if os.path.exists(ap):
+                out.append(ap)
+    return out
+
+
+def _explain(code: str) -> int:
+    from . import core
+    docs = core.all_rules()
+    code = code.upper()
+    if code not in docs:
+        print(f"unknown rule {code}; known: {', '.join(docs)}",
+              file=sys.stderr)
+        return 2
+    print(f"{code}: {docs[code]}\n")
+    rationale = core.RULE_EXPLAIN.get(code)
+    if rationale:
+        print(rationale + "\n")
+    repo = core._REPO_ROOT
+    fixtures = os.path.join(repo, "tests", "fixtures", "flightcheck")
+    for kind, title in (("bad", "known-bad example (fires)"),
+                        ("good", "corrected twin (clean)")):
+        path = os.path.join(fixtures, f"{code.lower()}_{kind}.py")
+        if os.path.exists(path):
+            with open(path, encoding="utf-8") as fh:
+                print(f"--- {title}: {os.path.relpath(path, repo)}")
+                print(fh.read())
+    return 0
 
 
 def main(argv=None) -> int:
@@ -24,11 +75,23 @@ def main(argv=None) -> int:
                     help="write current findings as the new baseline")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule codes to run (default "
-                         "all)")
+                         "all); a bare family prefix like FC6 selects "
+                         "the family")
     ap.add_argument("--list-rules", action="store_true")
+    ap.add_argument("--explain", metavar="FC###",
+                    help="print a rule's rationale plus its bad/good "
+                         "fixture pair, then exit")
     ap.add_argument("--jaxpr", action="store_true",
                     help="also trace the paged-decode/serving entry "
-                         "points and cross-check AST verdicts")
+                    "points and cross-check AST verdicts")
+    ap.add_argument("--comm-audit", action="store_true",
+                    help="also run the distributed communication audit "
+                         "against the committed expectations")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only files modified/untracked per git "
+                         "(scoped to the given paths when provided)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the on-disk findings cache")
     ap.add_argument("--show-baselined", action="store_true")
     args = ap.parse_args(argv)
 
@@ -36,15 +99,59 @@ def main(argv=None) -> int:
         for code, doc in core.all_rules().items():
             print(f"{code}  {doc}")
         return 0
-    if not args.paths:
+    if args.explain:
+        return _explain(args.explain)
+
+    repo_root = core._REPO_ROOT
+    if args.write_baseline and args.changed:
+        # a baseline written from a git-scoped subset would silently
+        # drop every entry living in unchanged files
+        print("--write-baseline needs a full run; drop --changed",
+              file=sys.stderr)
+        return 2
+    paths = args.paths
+    changed_empty = False
+    if args.changed:
+        files = changed_files(repo_root)
+        if files is None:
+            # fall back to MORE coverage, never less: lint the given
+            # paths in full — and with no paths there is no scope at
+            # all, which must not read as clean
+            print("flightcheck: git state unreadable; falling back to "
+                  "a full run of the given paths", file=sys.stderr)
+            if not paths:
+                print("flightcheck: --changed without readable git "
+                      "needs explicit paths", file=sys.stderr)
+                return 2
+        else:
+            if paths:
+                roots = [os.path.abspath(p) for p in paths]
+                files = [f for f in files
+                         if any(os.path.abspath(f) == r
+                                or os.path.abspath(f).startswith(
+                                    r.rstrip(os.sep) + os.sep)
+                                for r in roots)]
+            if not files:
+                print("flightcheck: no changed .py files in scope")
+                changed_empty = True
+            # an empty list still falls through: explicitly requested
+            # --jaxpr/--comm-audit gates must run regardless
+            paths = files
+    if not paths and not changed_empty:
         ap.print_usage()
         return 2
 
-    rules = [r.strip() for r in args.rules.split(",") if r.strip()] \
-        or None
+    # a family prefix (FC6) expands to every registered rule in it
+    rules = []
+    for tok in (r.strip() for r in args.rules.split(",") if r.strip()):
+        expanded = [c for c in core.all_rules() if c.startswith(tok)]
+        rules.extend(expanded or [tok])
+    rules = rules or None
+    cache_path = None if args.no_cache else "default"
     new, old = [], []
-    for path in args.paths:
-        n, o = core.run(path, args.baseline or None, rules)
+    for path in paths:
+        n, o = core.run(path, args.baseline or None, rules,
+                        cache_path=cache_path)
         new.extend(n)
         old.extend(o)
 
@@ -70,17 +177,27 @@ def main(argv=None) -> int:
         # hazard regardless of what the AST pass saw
         jaxpr_failed = bool(report.trace_failures or report.prng_notes)
 
+    comm_failed = False
+    if args.comm_audit:
+        # subprocess on purpose: this process's jax backend may already
+        # be initialized with one device (the --jaxpr phase does), and
+        # the audit needs the 8-device mesh from a clean start
+        import subprocess
+        comm_failed = subprocess.call(
+            [sys.executable, "-m", "tools.flightcheck.comm_audit"],
+            cwd=repo_root) != 0
+
     for f in new:
         print(core.format_finding(f))
     if args.show_baselined:
         for f in old:
             print("[baselined] " + core.format_finding(f))
-    if jaxpr_failed:
-        return 1
 
     if new:
         print(f"\nflightcheck: {len(new)} new finding(s) "
               f"({len(old)} baselined)")
+        return 1
+    if jaxpr_failed or comm_failed:
         return 1
     print(f"flightcheck: clean ({len(old)} baselined finding(s))")
     return 0

@@ -1,0 +1,132 @@
+"""On-disk findings cache so the tier-1 gate doesn't re-parse the whole
+tree every run.
+
+Safety model — the cache can never serve a stale verdict, only miss:
+- one entry per repo-relative PATH (and rules filter), carrying the
+  sha256 of the FILE CONTENT it was computed from: an edit — including
+  adding/removing a suppression comment — misses and supersedes the
+  entry in place, so the file stays bounded by tree size; two identical
+  files cache separately, since findings and baseline keys are
+  path-addressed;
+- the whole cache is versioned by a sha256 over the flightcheck package
+  sources AND the canonical SpecLayout table
+  (paddle_tpu/distributed/spec_layout.py, an FC605 input), so changing
+  any checker — or the table — invalidates everything;
+- the rules filter participates in the key (a ``--rules FC6`` run and a
+  full run cache separately).
+
+Findings are stored post-suppression (exactly what check_source
+returned). The file lives next to the package
+(``tools/flightcheck/.findings_cache.json``) and is git-ignored.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence
+
+from .core import Finding
+
+DEFAULT_CACHE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".findings_cache.json")
+
+_FIELDS = ("path", "line", "rule", "message", "func", "chain")
+
+_version: Optional[str] = None
+
+
+def checker_version() -> str:
+    """sha256 over the package's own .py sources plus every out-of-tree
+    checker INPUT (the canonical SpecLayout table FC605 parses) — any
+    rule or table change flushes the cache."""
+    global _version
+    if _version is None:
+        from .core import _REPO_ROOT
+        h = hashlib.sha256()
+        pkg = os.path.dirname(os.path.abspath(__file__))
+        extra = [os.path.join(_REPO_ROOT, "paddle_tpu", "distributed",
+                              "spec_layout.py")]
+        paths = [os.path.join(pkg, fn) for fn in sorted(os.listdir(pkg))
+                 if fn.endswith(".py")] + extra
+        for path in paths:
+            try:
+                with open(path, "rb") as fh:
+                    h.update(os.path.basename(path).encode())
+                    h.update(fh.read())
+            except OSError:
+                h.update(f"missing:{path}".encode())
+        _version = h.hexdigest()[:16]
+    return _version
+
+
+def _key(rules: Optional[Sequence[str]], path: str) -> str:
+    rk = ",".join(sorted(rules)) if rules else "*"
+    return path + "::" + rk
+
+
+def _sha(source: str) -> str:
+    return hashlib.sha256(source.encode()).hexdigest()[:32]
+
+
+class FindingsCache:
+    def __init__(self, path: str = DEFAULT_CACHE):
+        self.path = path
+        self._dirty = False
+        self._entries: Dict[str, List[dict]] = {}
+        try:
+            with open(path, encoding="utf-8") as fh:
+                data = json.load(fh)
+            if data.get("version") == checker_version():
+                self._entries = data.get("entries", {})
+        except (OSError, ValueError):
+            pass
+
+    def lookup(self, source: str,
+               rules: Optional[Sequence[str]] = None,
+               path: str = "") -> Optional[List[Finding]]:
+        # one entry per (path, rules); the content hash lives INSIDE the
+        # value, so edits supersede in place and the file stays bounded
+        # by the number of files, not the number of edits
+        entry = self._entries.get(_key(rules, path))
+        if not isinstance(entry, dict) or \
+                entry.get("sha") != _sha(source):
+            return None
+        try:
+            return [Finding(**{k: r[k] for k in _FIELDS})
+                    for r in entry.get("findings", [])]
+        except (KeyError, TypeError):
+            return None
+
+    def store(self, source: str, rules: Optional[Sequence[str]],
+              findings: List[Finding], path: str = ""):
+        self._entries[_key(rules, path)] = {
+            "sha": _sha(source),
+            "findings": [{k: getattr(f, k) for k in _FIELDS}
+                         for f in findings]}
+        self._dirty = True
+
+    def save(self):
+        if not self._dirty:
+            return
+        payload = {"version": checker_version(),
+                   "entries": self._entries}
+        tmp = None
+        try:
+            fd, tmp = tempfile.mkstemp(
+                dir=os.path.dirname(self.path) or ".",
+                prefix=".findings_cache.")
+            with os.fdopen(fd, "w", encoding="utf-8") as fh:
+                json.dump(payload, fh)
+            os.replace(tmp, self.path)
+            tmp = None
+            self._dirty = False
+        except OSError:
+            pass
+        finally:
+            if tmp is not None:     # failed write: no orphaned temp
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass

@@ -38,15 +38,22 @@ __all__ = [
 
 # rule code -> one-line description (filled in by checker modules)
 RULE_DOCS: Dict[str, str] = {}
+# rule code -> long-form rationale (surfaced by ``--explain FC###``)
+RULE_EXPLAIN: Dict[str, str] = {}
 
 _CHECKERS: List[Tuple[str, Callable]] = []
 
 
-def register(name: str, fn: Callable, docs: Dict[str, str]):
+def register(name: str, fn: Callable, docs: Dict[str, str],
+             explain: Optional[Dict[str, str]] = None):
     """Register a checker. ``docs`` maps each rule code the checker can
-    emit to its one-line description (surfaced by ``--list-rules``)."""
+    emit to its one-line description (surfaced by ``--list-rules``);
+    ``explain`` optionally maps codes to the long-form rationale behind
+    ``--explain``."""
     _CHECKERS.append((name, fn))
     RULE_DOCS.update(docs)
+    if explain:
+        RULE_EXPLAIN.update(explain)
 
 
 def all_rules() -> Dict[str, str]:
@@ -113,8 +120,10 @@ def _parse_suppressions(source: str) -> Dict[int, set]:
 def _load_checkers():
     if _CHECKERS:
         return
-    from . import tracer_safety, recompile, host_sync, prng, donation
-    for mod in (tracer_safety, recompile, host_sync, prng, donation):
+    from . import (tracer_safety, recompile, host_sync, prng, donation,
+                   sharding)
+    for mod in (tracer_safety, recompile, host_sync, prng, donation,
+                sharding):
         mod.setup(register)
 
 
@@ -189,12 +198,24 @@ def _repo_rel(path: str) -> str:
 
 
 def check_path(root: str,
-               rules: Optional[Sequence[str]] = None) -> List[Finding]:
+               rules: Optional[Sequence[str]] = None,
+               cache: Optional["FindingsCache"] = None) -> List[Finding]:
     findings: List[Finding] = []
     for path in _iter_py_files(root):
         with open(path, encoding="utf-8") as fh:
             src = fh.read()
-        findings.extend(check_source(src, _repo_rel(path), rules))
+        rel = _repo_rel(path)
+        if cache is not None:
+            hit = cache.lookup(src, rules, path=rel)
+            if hit is not None:
+                findings.extend(hit)
+                continue
+        file_findings = check_source(src, rel, rules)
+        if cache is not None:
+            cache.store(src, rules, file_findings, path=rel)
+        findings.extend(file_findings)
+    if cache is not None:
+        cache.save()
     return findings
 
 
@@ -237,10 +258,20 @@ def format_finding(f: Finding) -> str:
 
 
 def run(root: str, baseline_path: Optional[str] = None,
-        rules: Optional[Sequence[str]] = None
+        rules: Optional[Sequence[str]] = None,
+        cache_path: Optional[str] = "default"
         ) -> Tuple[List[Finding], List[Finding]]:
-    """Returns (new_findings, baselined_findings)."""
-    findings = check_path(root, rules)
+    """Returns (new_findings, baselined_findings).
+
+    ``cache_path="default"`` uses the on-disk findings cache (keyed by
+    file content hash + checker-source hash, so it can never serve
+    stale verdicts); ``None`` disables it."""
+    cache = None
+    if cache_path is not None:
+        from .cache import FindingsCache, DEFAULT_CACHE
+        cache = FindingsCache(
+            DEFAULT_CACHE if cache_path == "default" else cache_path)
+    findings = check_path(root, rules, cache=cache)
     baseline = load_baseline(baseline_path) if baseline_path else set()
     new, old = [], []
     for f in findings:

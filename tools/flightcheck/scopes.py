@@ -36,6 +36,10 @@ TRACING_HOF_TAILS = {
     "remat", "custom_vjp", "custom_jvp", "scan", "cond", "while_loop",
     "fori_loop", "switch", "associative_scan", "shard_map", "eval_shape",
     "make_jaxpr", "named_call", "map",
+    # Pallas kernel bodies run under the Pallas trace: Python control
+    # flow on Ref VALUES (vs static shapes/program_ids) is the same
+    # hazard class as under jit
+    "pallas_call",
 }
 
 JIT_TAILS = {"jit", "pjit"}
@@ -229,10 +233,31 @@ def find_traced_scopes(tree: ast.Module) -> List[TracedScope]:
             target = inner if inner is not None else sub
             nums, names = static_arg_info(target)
             for arg in target.args:
+                # partial(kernel, static...) hands the wrapped def to
+                # the HOF (the pallas_call / shard_map idiom): resolve
+                # through it, and mark every partial-BOUND parameter
+                # static — those are Python values baked at bind time
+                # (causal flags, block sizes), not traced operands
+                extra_static: Set[str] = set()
+                if isinstance(arg, ast.Call) and \
+                        tail_of(call_head(arg)) == "partial" and \
+                        arg.args:
+                    extra_static.update(
+                        kw.arg for kw in arg.keywords if kw.arg)
+                    npos = len(arg.args) - 1
+                    inner = arg.args[0]
+                    if npos and isinstance(inner, ast.Name) and \
+                            inner.id in defs:
+                        a = defs[inner.id].args
+                        params = [p.arg for p in
+                                  a.posonlyargs + a.args]
+                        extra_static.update(params[:npos])
+                    arg = inner
+                snames = names | extra_static if extra_static else names
                 if isinstance(arg, ast.Lambda):
-                    add(arg, f"{hof}-callee", nums, names)
+                    add(arg, f"{hof}-callee", nums, snames)
                 elif isinstance(arg, ast.Name) and arg.id in defs:
-                    add(defs[arg.id], f"{hof}-callee", nums, names)
+                    add(defs[arg.id], f"{hof}-callee", nums, snames)
                 elif isinstance(arg, ast.Attribute) and \
                         isinstance(arg.value, ast.Name) and \
                         arg.value.id == "self":
@@ -240,7 +265,7 @@ def find_traced_scopes(tree: ast.Module) -> List[TracedScope]:
                     meth = methods_of_class.get(cls, {}) \
                         .get(arg.attr) if cls is not None else None
                     if meth is not None:
-                        add(meth, f"{hof}-callee", nums, names)
+                        add(meth, f"{hof}-callee", nums, snames)
 
     # scan the module plus every function body (each is a def-owner)
     scan_owner(tree)
@@ -259,6 +284,50 @@ def find_traced_scopes(tree: ast.Module) -> List[TracedScope]:
                     add(sub, "nested-in-traced")
                     changed = True
     return list(scopes.values())
+
+
+# -- PartitionSpec parsing (shared with the sharding rule family) -----------
+
+def parse_pspec(node: ast.AST) -> Optional[Tuple]:
+    """``P(...)`` / ``PartitionSpec(...)`` literal -> tuple of entries
+    (each a str axis name, None, or a tuple of str for multi-axis dims).
+    Returns None when the node is not a spec call or any entry is not a
+    literal (a variable entry makes the spec statically unknowable —
+    callers must skip, never guess)."""
+    if not (isinstance(node, ast.Call)
+            and tail_of(dotted(node.func)) in ("P", "PartitionSpec")
+            and not node.keywords):
+        return None
+    entries: List = []
+    for a in node.args:
+        if isinstance(a, ast.Constant) and (
+                a.value is None or isinstance(a.value, str)):
+            entries.append(a.value)
+        elif isinstance(a, (ast.Tuple, ast.List)) and a.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in a.elts):
+            entries.append(tuple(e.value for e in a.elts))
+        else:
+            return None
+    return tuple(entries)
+
+
+def pspec_axes(spec: Tuple) -> Set[str]:
+    """All mesh axis names a parsed spec mentions."""
+    out: Set[str] = set()
+    for e in spec:
+        if isinstance(e, str):
+            out.add(e)
+        elif isinstance(e, tuple):
+            out.update(e)
+    return out
+
+
+def format_pspec(spec: Tuple) -> str:
+    return "P(" + ", ".join(
+        repr(e) if not isinstance(e, tuple)
+        else "(" + ", ".join(repr(x) for x in e) + ")"
+        for e in spec) + ")"
 
 
 # -- value-use walking ------------------------------------------------------

@@ -1,0 +1,719 @@
+"""SPMD/sharding rule family (FC601-FC606): the shard_map/GSPMD layer.
+
+This repo has been burned at exactly this layer twice (PR 3): jax 0.4.x
+cannot lower collectives — or in-body GSPMD constraints — inside a
+*partially*-manual shard_map (a fatal SPMD-partitioner CHECK, not a
+catchable error), and a shard_map that *claims* replicated outputs with
+the rep checker disabled silently returns per-shard garbage. Before the
+serving engine is sharded over a ``tp`` axis (ROADMAP item 1), these
+hazards need static eyes:
+
+- FC601 collective over an axis name the enclosing shard_map never
+  binds (unbound at trace time, or an auto axis under partial-manual —
+  the spmd_partitioner.cc:512 abort);
+- FC602 out_specs claim replication while check_vma/check_rep is OFF
+  and the body establishes replication nowhere (no psum/pmean/pmax/
+  pmin/all_gather/pvary) — each shard returns its own value and the
+  claim silently picks shard 0;
+- FC603 ``with_sharding_constraint`` inside a FULLY-manual shard_map —
+  there are no auto axes to constrain; on jax 0.4.x hybrid meshes this
+  is the hard-abort PR 3 fixed twice. The sanctioned pattern gates the
+  hint on ``partial_manual_ok()`` (pp_schedule) and is exempt;
+- FC604 a dimension sharded over mesh axes whose static size is not
+  divisible by the (statically known) mesh axis size — XLA pads
+  silently and collectives carry the padding;
+- FC605 PartitionSpec drift: the same parameter name annotated with
+  conflicting literal specs across call sites, or disagreeing with the
+  canonical ``SpecLayout`` table
+  (paddle_tpu/distributed/spec_layout.py, parsed syntactically);
+- FC606 a donated jit argument whose in_sharding differs from every
+  out_sharding — XLA cannot alias mismatched layouts, the donation
+  silently fails and the "in-place" update double-buffers.
+
+All rules resolve meshes/specs/callees statically and SKIP whenever a
+value is not a literal — low-false-positive by construction, like the
+rest of the suite.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import Finding, FileContext
+from .scopes import (FuncNode, dotted, format_pspec, func_of_map,
+                     parse_pspec, pspec_axes, tail_of, unwrap_partial)
+
+# collective tails -> index of the positional axis-name argument
+COLLECTIVE_AXIS_ARG = {
+    "psum": 1, "pmax": 1, "pmin": 1, "pmean": 1, "ppermute": 1,
+    "pshuffle": 1, "all_gather": 1, "all_to_all": 1, "psum_scatter": 1,
+    "pbroadcast": 1, "pvary": 1, "axis_index": 0,
+}
+AXIS_KWARGS = ("axis_name", "axes")
+
+# calls whose presence in a shard_map body can establish replication
+# over a manual axis (FC602's escape hatch)
+REPLICATING_TAILS = {"psum", "pmean", "pmax", "pmin", "all_gather",
+                     "pvary"}
+
+ARRAY_CTOR_TAILS = {"zeros", "ones", "empty", "full"}
+
+
+def _literal_axis_names(call: ast.Call) -> Optional[List[str]]:
+    """Axis-name string literals of a collective call, or None when the
+    axis argument is not a literal (variable axis names are common and
+    fine — we only judge what we can prove)."""
+    tail = tail_of(dotted(call.func))
+    pos = COLLECTIVE_AXIS_ARG.get(tail)
+    node = None
+    if pos is not None and len(call.args) > pos:
+        node = call.args[pos]
+    for kw in call.keywords:
+        if kw.arg in AXIS_KWARGS:
+            node = kw.value
+    if node is None:
+        return None
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return [e.value for e in node.elts]
+    return None
+
+
+# -- mesh resolution --------------------------------------------------------
+
+def _literal_str_tuple(node) -> Optional[Tuple[str, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, str)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return (node.value,)
+    return None
+
+
+def _literal_int_tuple(node) -> Optional[Tuple[int, ...]]:
+    if isinstance(node, (ast.Tuple, ast.List)) and node.elts and all(
+            isinstance(e, ast.Constant) and isinstance(e.value, int)
+            for e in node.elts):
+        return tuple(e.value for e in node.elts)
+    return None
+
+
+def _mesh_from_call(call: ast.Call) -> Optional[Dict[str, Optional[int]]]:
+    """``Mesh(devs, ("a","b"))`` / ``create_mesh((2,4), ("a","b"))`` ->
+    {axis: size-or-None}. Sizes resolve when the device grid is a
+    literal-shaped construction (create_mesh shape tuple, or
+    ``np.arange(n).reshape(a, b)``)."""
+    tail = tail_of(dotted(call.func))
+    names: Optional[Tuple[str, ...]] = None
+    sizes: Optional[Tuple[int, ...]] = None
+    if tail == "Mesh":
+        args = list(call.args)
+        kw = {k.arg: k.value for k in call.keywords}
+        names_node = args[1] if len(args) > 1 else kw.get("axis_names")
+        if names_node is None:
+            return None
+        names = _literal_str_tuple(names_node)
+        dev = args[0] if args else None
+        # np.arange(n).reshape(a, b) — the common literal grid (the
+        # chain's base is a Call, so match the .reshape attr directly)
+        if isinstance(dev, ast.Call) and \
+                isinstance(dev.func, ast.Attribute) and \
+                dev.func.attr == "reshape":
+            if len(dev.args) == 1:
+                sizes = _literal_int_tuple(dev.args[0])
+                if sizes is None and \
+                        isinstance(dev.args[0], ast.Constant) and \
+                        isinstance(dev.args[0].value, int):
+                    sizes = (dev.args[0].value,)
+            elif dev.args:
+                sizes = _literal_int_tuple(
+                    ast.Tuple(elts=list(dev.args), ctx=ast.Load()))
+    elif tail == "create_mesh":
+        args = list(call.args)
+        kw = {k.arg: k.value for k in call.keywords}
+        shape_node = args[0] if args else kw.get("shape")
+        names_node = args[1] if len(args) > 1 else kw.get("dim_names")
+        if names_node is None:
+            return None
+        names = _literal_str_tuple(names_node)
+        sizes = _literal_int_tuple(shape_node) if shape_node is not None \
+            else None
+    if not names:
+        return None
+    if sizes is not None and len(sizes) != len(names):
+        sizes = None
+    return {n: (sizes[i] if sizes is not None else None)
+            for i, n in enumerate(names)}
+
+
+def _mesh_table(tree: ast.Module) -> Dict[str, Dict[str, Optional[int]]]:
+    """Assigned name (full dotted AND attr tail) -> mesh axes. A name
+    bound to two DIFFERENT meshes is dropped (ambiguous)."""
+    out: Dict[str, Dict[str, Optional[int]]] = {}
+    dead: Set[str] = set()
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)):
+            continue
+        axes = _mesh_from_call(node.value)
+        if axes is None:
+            continue
+        keys: Set[str] = set()
+        for t in node.targets:
+            name = dotted(t)
+            if name:
+                keys.add(name)
+                keys.add(tail_of(name))
+        for k in keys:
+            if k in out and out[k] != axes:
+                dead.add(k)
+            out[k] = axes
+    for k in dead:
+        out.pop(k, None)
+    return out
+
+
+def _resolve_mesh(expr, mesh_table) -> Optional[Dict[str, Optional[int]]]:
+    name = dotted(expr)
+    if not name:
+        return None
+    return mesh_table.get(name) or mesh_table.get(tail_of(name))
+
+
+# -- shard_map call-site discovery ------------------------------------------
+
+@dataclass
+class SMSite:
+    call: ast.Call
+    lineno: int
+    callee: Optional[ast.AST] = None           # def/lambda node
+    mesh_axes: Optional[Dict[str, Optional[int]]] = None
+    manual_axes: Optional[Set[str]] = None     # None = fully manual
+    ambiguous: bool = False                    # **kwargs at the site
+    check_off: bool = False                    # check_vma/check_rep False
+    out_specs: List[Tuple] = field(default_factory=list)
+    out_specs_known: bool = False
+
+    def bound_axes(self) -> Optional[Set[str]]:
+        """Axis names the body may use collectives over, or None when
+        statically unknowable."""
+        if self.ambiguous:
+            return None
+        if self.manual_axes is not None:
+            return set(self.manual_axes)
+        if self.mesh_axes is not None:
+            return set(self.mesh_axes)
+        return None
+
+
+def _def_tables(tree: ast.Module):
+    """(name -> unique def node or None-if-ambiguous,
+    class methods map, node -> owner class)."""
+    by_name: Dict[str, Optional[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, FuncNode):
+            if node.name in by_name and by_name[node.name] is not node:
+                by_name[node.name] = None
+            else:
+                by_name.setdefault(node.name, node)
+    methods: Dict[ast.AST, Dict[str, ast.AST]] = {}
+    owner: Dict[ast.AST, ast.AST] = {}
+    for cls in ast.walk(tree):
+        if isinstance(cls, ast.ClassDef):
+            meths = {n.name: n for n in cls.body
+                     if isinstance(n, FuncNode)}
+            methods[cls] = meths
+            for n in meths.values():
+                for sub in ast.walk(n):
+                    owner[sub] = cls
+    return by_name, methods, owner
+
+
+def _resolve_callee(node: ast.AST, site_call: ast.Call, by_name, methods,
+                    owner) -> Optional[ast.AST]:
+    if isinstance(node, ast.Lambda):
+        return node
+    if isinstance(node, ast.Call) and \
+            tail_of(dotted(node.func)) == "partial" and node.args:
+        return _resolve_callee(node.args[0], site_call, by_name, methods,
+                               owner)
+    name = dotted(node)
+    if not name:
+        return None
+    if name.startswith("self."):
+        cls = owner.get(site_call)
+        if cls is not None:
+            return methods.get(cls, {}).get(name.split(".", 1)[1])
+        return None
+    return by_name.get(name)
+
+
+def _parse_out_specs(node) -> Tuple[List[Tuple], bool]:
+    """out_specs AST -> (list of parsed specs, fully-known?)."""
+    if node is None:
+        return [], False
+    single = parse_pspec(node)
+    if single is not None:
+        return [single], True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        specs, known = [], True
+        for e in node.elts:
+            s = parse_pspec(e)
+            if s is None:
+                known = False
+            else:
+                specs.append(s)
+        return specs, known
+    return [], False
+
+
+def _find_sites(tree: ast.Module, mesh_table) -> List[SMSite]:
+    by_name, methods, owner = _def_tables(tree)
+    sites: List[SMSite] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = node
+        if tail_of(dotted(node.func)) == "partial":
+            inner = unwrap_partial(node)
+            if inner is None:
+                continue
+            target = inner
+        if tail_of(dotted(target.func)) != "shard_map":
+            continue
+        site = SMSite(call=node, lineno=node.lineno)
+        kw = {k.arg: k.value for k in target.keywords}
+        site.ambiguous = any(k.arg is None for k in target.keywords)
+        if target.args:
+            site.callee = _resolve_callee(target.args[0], node, by_name,
+                                          methods, owner)
+        mesh_node = kw.get("mesh") or (
+            target.args[1] if len(target.args) > 1 else None)
+        if mesh_node is not None:
+            site.mesh_axes = _resolve_mesh(mesh_node, mesh_table)
+        an = kw.get("axis_names")
+        if an is not None:
+            names = _literal_str_tuple(an)
+            if names is None and isinstance(an, ast.Set) and all(
+                    isinstance(e, ast.Constant)
+                    and isinstance(e.value, str) for e in an.elts):
+                names = tuple(e.value for e in an.elts)
+            if names is not None:
+                site.manual_axes = set(names)
+            else:
+                site.ambiguous = True
+        for flag in ("check_vma", "check_rep"):
+            v = kw.get(flag)
+            if isinstance(v, ast.Constant) and v.value is False:
+                site.check_off = True
+        site.out_specs, site.out_specs_known = _parse_out_specs(
+            kw.get("out_specs"))
+        sites.append(site)
+    return sites
+
+
+def _body_nodes(callee: ast.AST, skip: Set[int]):
+    """Walk a callee body, skipping nested shard_map callees (their
+    collectives bind against THEIR site, not this one)."""
+    stack = [callee]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if id(child) in skip:
+                continue
+            yield child
+            stack.append(child)
+
+
+def _calls_partial_manual_ok(fn_node: ast.AST) -> bool:
+    for sub in ast.walk(fn_node):
+        if isinstance(sub, ast.Call) and \
+                tail_of(dotted(sub.func)) == "partial_manual_ok":
+            return True
+    return False
+
+
+# -- FC604/FC605 support ----------------------------------------------------
+
+def _shape_of_ctor(node) -> Optional[Tuple[int, ...]]:
+    """jnp.zeros((2, 3)) / np.ones((4,)) / jnp.full((2, 2), v) -> shape."""
+    if not (isinstance(node, ast.Call)
+            and tail_of(dotted(node.func)) in ARRAY_CTOR_TAILS
+            and node.args):
+        return None
+    shp = _literal_int_tuple(node.args[0])
+    if shp is None and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, int):
+        shp = (node.args[0].value,)
+    return shp
+
+
+def _local_shapes(tree: ast.Module) -> Dict[str, Tuple[int, ...]]:
+    """name (dotted) -> literal array shape, dropped on conflict."""
+    out: Dict[str, Tuple[int, ...]] = {}
+    dead: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        shp = _shape_of_ctor(node.value)
+        if shp is None:
+            continue
+        for t in node.targets:
+            name = dotted(t)
+            if not name:
+                continue
+            if name in out and out[name] != shp:
+                dead.add(name)
+            out[name] = shp
+    for k in dead:
+        out.pop(k, None)
+    return out
+
+
+_CANONICAL_CACHE: Dict[str, Dict[str, Tuple]] = {}
+
+
+def canonical_specs(repo_root: str) -> Dict[str, Tuple]:
+    """Parse CANONICAL_SPECS out of the committed SpecLayout table —
+    syntactically, so linting never imports the linted package."""
+    path = os.path.join(repo_root, "paddle_tpu", "distributed",
+                        "spec_layout.py")
+    if path in _CANONICAL_CACHE:
+        return _CANONICAL_CACHE[path]
+    table: Dict[str, Tuple] = {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        _CANONICAL_CACHE[path] = table
+        return table
+    for node in ast.walk(tree):
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets, value = [node.target], node.value
+        if not any(dotted(t) == "CANONICAL_SPECS" for t in targets):
+            continue
+        if isinstance(value, ast.Dict):
+            for k, v in zip(value.keys, value.values):
+                spec = parse_pspec(v)
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and spec is not None:
+                    table[k.value] = spec
+    _CANONICAL_CACHE[path] = table
+    return table
+
+
+def _spec_conflicts(a: Tuple, b: Tuple) -> bool:
+    """Suffix comparison: stacked layouts prepend bookkeeping dims, so
+    ('pp', None, 'tp') agrees with canonical (None, 'tp') but
+    ('tp', None) does not."""
+    n = min(len(a), len(b))
+    if n == 0:
+        return False
+    return a[-n:] != b[-n:]
+
+
+# -- the checker ------------------------------------------------------------
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    _annotate_parents(tree)     # FC604 climbs NamedSharding→device_put
+    findings: List[Finding] = []
+    owner_of = func_of_map(tree)
+    mesh_table = _mesh_table(tree)
+    sites = _find_sites(tree, mesh_table)
+    callee_ids = {id(s.callee) for s in sites if s.callee is not None}
+
+    def qual(node) -> str:
+        return owner_of.get(node, "")
+
+    # FC601 / FC602 / FC603 — per shard_map site
+    for site in sites:
+        if site.callee is None:
+            continue
+        skip = callee_ids - {id(site.callee)}
+        body = list(_body_nodes(site.callee, skip))
+
+        bound = site.bound_axes()
+        if bound is not None:
+            for node in body:
+                if not isinstance(node, ast.Call):
+                    continue
+                tail = tail_of(dotted(node.func))
+                if tail not in COLLECTIVE_AXIS_ARG:
+                    continue
+                axes = _literal_axis_names(node)
+                if axes is None:
+                    continue
+                for ax in axes:
+                    if ax not in bound:
+                        mode = ("manual axes {%s}" % ", ".join(
+                            sorted(site.manual_axes))
+                            if site.manual_axes is not None
+                            else "mesh axes {%s}" % ", ".join(
+                                sorted(bound)))
+                        findings.append(Finding(
+                            ctx.path, node.lineno, "FC601",
+                            f"collective '{tail}' over axis '{ax}' "
+                            f"which the enclosing shard_map (line "
+                            f"{site.lineno}) does not bind ({mode}); "
+                            f"unbound at trace time — or an auto axis, "
+                            f"which the SPMD partitioner hard-aborts "
+                            f"on", qual(node)))
+
+        if site.check_off and site.out_specs_known and any(
+                len(s) == 0 for s in site.out_specs):
+            has_escape = any(
+                isinstance(n, ast.Call)
+                and tail_of(dotted(n.func)) in REPLICATING_TAILS
+                for n in body)
+            if not has_escape:
+                findings.append(Finding(
+                    ctx.path, site.lineno, "FC602",
+                    "out_specs claims a fully-replicated output (P()) "
+                    "with check_vma/check_rep disabled, but the body "
+                    "never establishes replication (no psum/pmean/pmax/"
+                    "pmin/all_gather/pvary) — each shard returns its "
+                    "own value and the claim silently takes one "
+                    "shard's", qual(site.call)))
+
+        fully_manual = (site.manual_axes is None and not site.ambiguous)
+        if fully_manual:
+            for node in body:
+                if isinstance(node, ast.Call) and tail_of(dotted(
+                        node.func)) == "with_sharding_constraint":
+                    if _calls_partial_manual_ok(site.callee):
+                        continue
+                    findings.append(Finding(
+                        ctx.path, node.lineno, "FC603",
+                        f"with_sharding_constraint inside a FULLY-"
+                        f"manual shard_map (line {site.lineno}): no "
+                        f"auto axes exist to constrain, and jax 0.4.x "
+                        f"hard-aborts lowering it on hybrid meshes "
+                        f"(spmd_partitioner.cc:512) — gate the hint on "
+                        f"partial_manual_ok() or drop it",
+                        qual(node)))
+
+    # FC604 — divisibility at device_put/NamedSharding sites
+    shapes = _local_shapes(tree)
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call) and
+                tail_of(dotted(node.func)) == "NamedSharding"
+                and len(node.args) >= 2):
+            continue
+        mesh_axes = _resolve_mesh(node.args[0], mesh_table)
+        spec = parse_pspec(node.args[1])
+        if mesh_axes is None or spec is None:
+            continue
+        # the array being placed: device_put(x, NamedSharding(...))
+        parent = getattr(node, "_fc_parent", None)
+        shp = None
+        if parent is not None and isinstance(parent, ast.Call):
+            x = parent.args[0] if parent.args else None
+            shp = _shape_of_ctor(x) if x is not None else None
+            if shp is None and x is not None:
+                name = dotted(x)
+                shp = shapes.get(name) if name else None
+        if shp is None or len(spec) > len(shp):
+            continue
+        for dim, entry in enumerate(spec):
+            axes = [entry] if isinstance(entry, str) else (
+                list(entry) if isinstance(entry, tuple) else [])
+            total = 1
+            known = bool(axes)
+            for ax in axes:
+                size = mesh_axes.get(ax)
+                if size is None:
+                    known = False
+                    break
+                total *= size
+            if known and shp[dim] % total:
+                findings.append(Finding(
+                    ctx.path, node.lineno, "FC604",
+                    f"dim {dim} (size {shp[dim]}) sharded over mesh "
+                    f"axes {axes} of total size {total} — not "
+                    f"divisible; XLA pads silently and every "
+                    f"collective on this value moves the padding",
+                    qual(node)))
+
+    # FC605 — spec drift across call sites + canonical table
+    from .core import _REPO_ROOT
+    canon = canonical_specs(_REPO_ROOT)
+    seen: Dict[str, Tuple[Tuple, int]] = {}
+    for node in ast.walk(tree):
+        bindings: List[Tuple[str, Tuple, int]] = []
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                spec = parse_pspec(v)
+                if isinstance(k, ast.Constant) and \
+                        isinstance(k.value, str) and spec is not None:
+                    bindings.append((k.value, spec, v.lineno))
+        elif isinstance(node, ast.Call) and tail_of(dotted(
+                node.func)) in ("with_sharding_constraint",
+                                "device_put") and len(node.args) >= 2:
+            tgt = dotted(node.args[0])
+            sh = node.args[1]
+            spec = parse_pspec(sh)
+            if spec is None and isinstance(sh, ast.Call) and \
+                    tail_of(dotted(sh.func)) == "NamedSharding" and \
+                    len(sh.args) >= 2:
+                spec = parse_pspec(sh.args[1])
+            if tgt and spec is not None:
+                bindings.append((tail_of(tgt), spec, node.lineno))
+        for name, spec, lineno in bindings:
+            prev = seen.get(name)
+            # suffix comparison: a stacked-trunk spec ('pp', None, 'tp')
+            # agrees with its unstacked (None, 'tp') form
+            if prev is not None and _spec_conflicts(spec, prev[0]):
+                findings.append(Finding(
+                    ctx.path, lineno, "FC605",
+                    f"'{name}' annotated {format_pspec(spec)} here but "
+                    f"{format_pspec(prev[0])} at line {prev[1]} — "
+                    f"conflicting specs for the same value compose "
+                    f"into silent all-gathers; pick one (the "
+                    f"SpecLayout table) and reuse it",
+                    qual(node)))
+            else:
+                seen[name] = (spec, lineno)
+            cspec = canon.get(name)
+            if cspec is not None and (
+                    pspec_axes(spec) & pspec_axes(cspec)) and \
+                    _spec_conflicts(spec, cspec):
+                findings.append(Finding(
+                    ctx.path, lineno, "FC605",
+                    f"'{name}' annotated {format_pspec(spec)} but the "
+                    f"canonical SpecLayout table "
+                    f"(paddle_tpu/distributed/spec_layout.py) says "
+                    f"{format_pspec(cspec)} — drift from the "
+                    f"canonical layout", qual(node)))
+
+    # FC606 — donation/sharding mismatch on jit sites
+    findings.extend(_check_donation_specs(tree, ctx, owner_of))
+
+    findings = [f for f in findings if not ctx.suppressed(f.line, f.rule)]
+    return findings
+
+
+def _check_donation_specs(tree, ctx, owner_of) -> List[Finding]:
+    out: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        target = node
+        if tail_of(dotted(node.func)) == "partial":
+            inner = unwrap_partial(node)
+            if inner is None:
+                continue
+            target = inner
+        if tail_of(dotted(target.func)) not in ("jit", "pjit"):
+            continue
+        kw = {k.arg: k.value for k in target.keywords}
+        donate = kw.get("donate_argnums")
+        ins, outs = kw.get("in_shardings"), kw.get("out_shardings")
+        if donate is None or ins is None or outs is None:
+            continue
+        try:
+            donated = ast.literal_eval(donate)
+        except (ValueError, TypeError, SyntaxError):
+            continue
+        if isinstance(donated, int):
+            donated = (donated,)
+        in_specs, in_known = _parse_out_specs(ins)
+        out_specs, out_known = _parse_out_specs(outs)
+        if not (in_known and out_known and out_specs):
+            continue
+        for pos in donated:
+            if not isinstance(pos, int) or pos >= len(in_specs):
+                continue
+            spec = in_specs[pos]
+            if all(spec != o for o in out_specs):
+                out.append(Finding(
+                    ctx.path, target.lineno, "FC606",
+                    f"donated arg {pos} has in_sharding "
+                    f"{format_pspec(spec)} but no output shares it "
+                    f"(outs: "
+                    f"{', '.join(format_pspec(o) for o in out_specs)})"
+                    f" — XLA cannot alias mismatched shardings, the "
+                    f"donation silently fails and the buffer "
+                    f"double-allocates", owner_of.get(node, "")))
+    return out
+
+
+def _annotate_parents(tree: ast.Module):
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child._fc_parent = node  # type: ignore[attr-defined]
+
+
+EXPLAIN = {
+    "FC601": (
+        "A collective (psum/ppermute/all_gather/...) names a mesh axis "
+        "the enclosing shard_map never binds. Under a fully-manual "
+        "shard_map the bound axes are the mesh's; under partial-manual "
+        "(axis_names={...}) they are exactly that subset — a collective "
+        "over an auto axis is the jax 0.4.x SPMD-partitioner hard "
+        "abort (spmd_partitioner.cc:512) PR 3 worked around. Fix: bind "
+        "the axis (add it to axis_names / the mesh) or reduce over the "
+        "right name."),
+    "FC602": (
+        "shard_map's out_specs is a CLAIM. P() claims every shard "
+        "holds the same value; the rep/vma checker normally verifies "
+        "it, but this site disables the checker (check_vma=False) and "
+        "the body never runs a replication-establishing op (psum, "
+        "pmean, pmax, pmin, all_gather, pvary). One shard's value is "
+        "silently broadcast as 'the' answer. Fix: psum (or all_gather) "
+        "the output, or declare the honest per-shard spec."),
+    "FC603": (
+        "with_sharding_constraint steers GSPMD *auto* axes. Inside a "
+        "FULLY-manual shard_map there are none — the hint is dead at "
+        "best, and on jax 0.4.x hybrid meshes lowering it is a fatal "
+        "XLA CHECK (the exact trap PR 3 fixed twice). Fix: gate the "
+        "hint on partial_manual_ok() (see pp_schedule/llama_pp) or "
+        "drop it in manual regions."),
+    "FC604": (
+        "A dimension sharded over a mesh axis must divide by the axis "
+        "size; otherwise GSPMD pads the shards and every collective "
+        "moves (and every reduction sums) the padding — correct-ish "
+        "numerics at best, silent garbage at the edges at worst. Fix: "
+        "pad explicitly to a multiple, or reshape the sharded dim."),
+    "FC605": (
+        "The same parameter annotated with two different "
+        "PartitionSpecs (across call sites, or against the canonical "
+        "SpecLayout table in paddle_tpu/distributed/spec_layout.py) "
+        "makes XLA insert resharding all-gathers at the boundary — "
+        "the #1 silent perf leak when hand-threading specs. Fix: "
+        "import the spec from the one canonical table."),
+    "FC606": (
+        "donate_argnums promises an input buffer to an output, but "
+        "aliasing requires matching shardings. A donated input whose "
+        "in_sharding matches no out_sharding cannot be aliased: jax "
+        "warns once, the 'in-place' KV-pool-style update silently "
+        "double-buffers, and HBM headroom halves. Fix: make the "
+        "donated input's spec equal its output's (the multi-GiB "
+        "buffers this matters for are updated in place, not "
+        "resharded)."),
+}
+
+
+def setup(register):
+    register("sharding", check, {
+        "FC601": "collective over an axis the enclosing shard_map does "
+                 "not bind",
+        "FC602": "replicated out_specs claim with rep-check disabled "
+                 "and no psum/pvary in the body",
+        "FC603": "with_sharding_constraint inside a fully-manual "
+                 "shard_map (jax 0.4.x lowering trap)",
+        "FC604": "sharded dimension not divisible by the mesh axis "
+                 "size",
+        "FC605": "conflicting PartitionSpecs for the same value across "
+                 "call sites / vs the SpecLayout table",
+        "FC606": "donated buffer whose sharding matches no output (the "
+                 "donation silently fails)",
+    }, EXPLAIN)
