@@ -10,9 +10,12 @@ all-gathers (or wrong math under shard_map). The table is the single
 source of truth, in BOTH directions:
 
 - runtime: ``layout.sharding(mesh, name)`` / ``layout.apply(mesh,
-  weights)`` place a PagedLlamaDecoder-style weight tree (the
-  ``paged_decode._weights_from_model`` key vocabulary: wq/wk/wv/wo,
-  wg/wu/wd, embed/head/norm, cache_k/cache_v) onto a mesh;
+  weights)`` place a paged-decoder weight tree (the PagedLlamaDecoder
+  ``_extract_weights`` key vocabulary — wq/wk/wv/wo, wg/wu/wd,
+  embed/head/norm/ln1/ln2 — and the PagedGPTDecoder TP-split vocabulary
+  — wq/wk/wv/bq/bk/bv, wo/bo, wi/bi, wf/bf, pos/ln*_w/ln*_b/lnf_* —
+  plus the paged pool cache_k/cache_v) onto a mesh, quantized
+  ``(w_q, scale)`` pairs included;
 - static analysis: ``tools/flightcheck`` rule FC605 parses
   ``CANONICAL_SPECS`` out of this file (AST, no import) and flags any
   *literal* PartitionSpec in the tree that disagrees with the canonical
@@ -21,12 +24,19 @@ source of truth, in BOTH directions:
 Layout choices (Megatron-style 1-allreduce-per-block decode):
 - attention: wq/wk/wv column-parallel (heads split over tp), wo
   row-parallel — the block's only collective is the allreduce after wo;
-- mlp: wg/wu column-parallel, wd row-parallel — allreduce after wd;
-- embed/norm replicated (small), head column-parallel (per-shard logits
-  concatenate over vocab);
-- paged KV pool: [num_blocks, block_size, kv_heads, head_dim] sharded
-  over the kv-head dim, so a tp shard appends exactly the heads it
-  computed — no cross-chip traffic on the KV write path.
+- mlp: wg/wu (Llama) / wi (GPT) column-parallel, wd/wf row-parallel —
+  allreduce after wd/wf;
+- biases follow their weight's OUT dim: column-parallel biases shard
+  (bq/bk/bv/bi), row-parallel output biases replicate and are added
+  AFTER the allreduce (bo/bf) — adding them per shard before the psum
+  would multiply them by the tp degree;
+- embed/pos/norms replicated (small), head column-parallel (per-shard
+  vocab logits all-gather once before sampling);
+- paged KV pool: [num_blocks, kv_heads, block_size, head_dim]
+  (ops.paged_attention.PagedKVCache layout — one physical page is a
+  contiguous [kv_heads, block_size, head_dim] region) sharded over the
+  kv-head dim, so a tp shard appends exactly the heads it computed —
+  ZERO collectives on the KV-append path.
 """
 from __future__ import annotations
 
@@ -49,17 +59,38 @@ CANONICAL_SPECS: Dict[str, P] = {
     "wk": P(None, "tp"),
     "wv": P(None, "tp"),
     "wo": P("tp", None),
-    # mlp
+    # attention biases (GPT family): column biases shard with the out
+    # dim; the row-parallel output bias replicates (added post-psum)
+    "bq": P("tp"),
+    "bk": P("tp"),
+    "bv": P("tp"),
+    "bo": P(None),
+    # mlp (Llama gate/up/down)
     "wg": P(None, "tp"),
     "wu": P(None, "tp"),
     "wd": P("tp", None),
+    # mlp (GPT fc_in/fc_out + biases)
+    "wi": P(None, "tp"),
+    "bi": P("tp"),
+    "wf": P("tp", None),
+    "bf": P(None),
     # embedding / output
     "embed": P(None, None),
+    "pos": P(None, None),
     "norm": P(None),
+    "ln1": P(None),
+    "ln2": P(None),
+    "ln1_w": P(None),
+    "ln1_b": P(None),
+    "ln2_w": P(None),
+    "ln2_b": P(None),
+    "lnf_w": P(None),
+    "lnf_b": P(None),
     "head": P(None, "tp"),
-    # paged KV pool: [num_blocks, block_size, kv_heads, head_dim]
-    "cache_k": P(None, None, "tp", None),
-    "cache_v": P(None, None, "tp", None),
+    # paged KV pool: [num_blocks, kv_heads, block_size, head_dim]
+    # (kv-head dim sharded — each shard appends the heads it computed)
+    "cache_k": P(None, "tp", None, None),
+    "cache_v": P(None, "tp", None, None),
 }
 
 
@@ -69,33 +100,75 @@ class SpecLayout:
 
     tp_axis: str = TP_AXIS
 
-    def spec(self, name: str) -> P:
+    def spec(self, name: str, strict: bool = False) -> P:
         base = CANONICAL_SPECS.get(name)
         if base is None:
+            if strict:
+                raise KeyError(
+                    f"weight key {name!r} has no canonical PartitionSpec"
+                    f" in CANONICAL_SPECS (paddle_tpu/distributed/"
+                    f"spec_layout.py) — a silently-replicated unknown "
+                    f"key is how spec drift starts; add it to the table"
+                    f" (or place it explicitly)")
             # per-layer dicts nest under "layers"; unknown small tensors
-            # (norms, rope caches, scales) replicate
+            # (rope caches, scales) replicate — only in non-strict mode
             return P()
         if self.tp_axis == TP_AXIS:
             return base
         return P(*[self.tp_axis if e == TP_AXIS else e for e in base])
 
+    def scale_spec(self, name: str) -> P:
+        """Spec for the per-output-channel scale of a quantized
+        ``(w_q, scale)`` pair: the scale follows the OUT dim, so it
+        shards iff the weight is column-parallel (out dim sharded)."""
+        s = self.spec(name)
+        if len(s) >= 2 and s[-1] == self.tp_axis:
+            return P(self.tp_axis)
+        return P()
+
     def sharding(self, mesh, name: str) -> NamedSharding:
         return NamedSharding(mesh, self.spec(name))
 
-    def apply(self, mesh, weights):
-        """device_put a paged-decoder weight tree by key name. Leaves
-        under ``layers`` (a list of per-layer dicts) use their dict key;
-        anything without a canonical entry replicates."""
-        import jax
-
-        def put(name, leaf):
-            return jax.device_put(leaf, self.sharding(mesh, name))
-
+    def _map(self, weights, leaf_fn, strict: bool):
         out = {}
         for k, v in weights.items():
             if k == "layers":
-                out[k] = [{kk: put(kk, vv) for kk, vv in layer.items()}
-                          for layer in v]
+                out[k] = [{kk: leaf_fn(kk, vv, strict)
+                           for kk, vv in layer.items()} for layer in v]
             else:
-                out[k] = put(k, v)
+                out[k] = leaf_fn(k, v, strict)
         return out
+
+    def apply(self, mesh, weights, strict: bool = False):
+        """device_put a paged-decoder weight tree by key name. Leaves
+        under ``layers`` (a list of per-layer dicts) use their dict key;
+        quantized ``(w_q, scale)`` tuples place the packed array by the
+        weight's spec and the scale by ``scale_spec``. With
+        ``strict=True`` a key missing from CANONICAL_SPECS raises
+        instead of silently replicating."""
+        import jax
+
+        def put(name, leaf, strict_):
+            ns = NamedSharding(mesh, self.spec(name, strict=strict_))
+            if isinstance(leaf, tuple):
+                wq, sc = leaf
+                return (jax.device_put(wq, ns),
+                        jax.device_put(sc, NamedSharding(
+                            mesh, self.scale_spec(name))))
+            return jax.device_put(leaf, ns)
+
+        return self._map(weights, put, strict)
+
+    def spec_tree(self, weights, strict: bool = False):
+        """A PartitionSpec pytree matching ``weights`` leaf-for-leaf —
+        the ``in_specs`` entry a fully-manual shard_map needs for the
+        weight operand (quantized tuples get (weight_spec,
+        scale_spec))."""
+
+        def spec_of(name, leaf, strict_):
+            if isinstance(leaf, tuple):
+                return (self.spec(name, strict=strict_),
+                        self.scale_spec(name))
+            return self.spec(name, strict=strict_)
+
+        return self._map(weights, spec_of, strict)

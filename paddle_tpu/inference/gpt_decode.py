@@ -27,9 +27,9 @@ from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
                                    ragged_paged_attention,
                                    reshape_and_cache)
-from .paged_decode import (_gather_prefix_pages, _mm,
+from .paged_decode import (_TPDecoderMixin, _gather_prefix_pages, _mm,
                            _prefix_suffix_attention, _quantize_w,
-                           _quantize_w4_halves)
+                           _quantize_w4, _quantize_w4_halves)
 
 __all__ = ["PagedGPTDecoder"]
 
@@ -44,33 +44,56 @@ def _layer_norm(x, w, b, eps):
             + b.astype(jnp.float32)).astype(x.dtype)
 
 
-def _extract_gpt_weights(model, weight_dtype=None):
+def _extract_gpt_weights(model, weight_dtype=None, tp_split=False):
     """Raw arrays from a GPTForCausalLM. Matmul weights optionally
-    quantized; biases/norms/embeddings stay full precision."""
+    quantized; biases/norms/embeddings stay full precision.
+
+    tp_split: emit the TENSOR-PARALLEL layout — the fused qkv
+    projection split into per-projection wq/wk/wv (+ bq/bk/bv). The
+    fused [h, 3*nh*hd] out dim is ordered (q-block, k-block, v-block);
+    a naive column split of the FUSED weight would hand a shard a mix
+    of q/k/v features that no head grouping can use, so TP placement
+    needs the split form (each projection is then plain
+    column-parallel). int4 packs even/odd-interleaved (_quantize_w4),
+    the row-shardable layout — see paged_decode."""
     if weight_dtype not in (None, "int8", "int4"):
         raise ValueError(f"weight_dtype must be None, 'int8' or 'int4', "
                          f"got {weight_dtype!r}")
     # single-device family: halves int4 packing (matches the module
     # _mm default and the Pallas streaming kernel)
     q = {None: lambda w: w, "int8": _quantize_w,
-         "int4": _quantize_w4_halves}[weight_dtype]
+         "int4": _quantize_w4 if tp_split else _quantize_w4_halves
+         }[weight_dtype]
     m = model.gpt
+    nfeat = (m.layers[0].attn.qkv_proj.weight._value.shape[1] // 3
+             if tp_split else None)
     layers = []
     for lyr in m.layers:
-        layers.append({
+        w = {
             "ln1_w": lyr.ln_1.weight._value,
             "ln1_b": lyr.ln_1.bias._value,
             "ln2_w": lyr.ln_2.weight._value,
             "ln2_b": lyr.ln_2.bias._value,
-            "wqkv": q(lyr.attn.qkv_proj.weight._value),
-            "bqkv": lyr.attn.qkv_proj.bias._value,
             "wo": q(lyr.attn.out_proj.weight._value),
             "bo": lyr.attn.out_proj.bias._value,
             "wi": q(lyr.mlp.fc_in.weight._value),
             "bi": lyr.mlp.fc_in.bias._value,
             "wf": q(lyr.mlp.fc_out.weight._value),
             "bf": lyr.mlp.fc_out.bias._value,
-        })
+        }
+        wqkv = lyr.attn.qkv_proj.weight._value
+        bqkv = lyr.attn.qkv_proj.bias._value
+        if tp_split:
+            w["wq"] = q(wqkv[:, :nfeat])
+            w["wk"] = q(wqkv[:, nfeat:2 * nfeat])
+            w["wv"] = q(wqkv[:, 2 * nfeat:])
+            w["bq"] = bqkv[:nfeat]
+            w["bk"] = bqkv[nfeat:2 * nfeat]
+            w["bv"] = bqkv[2 * nfeat:]
+        else:
+            w["wqkv"] = q(wqkv)
+            w["bqkv"] = bqkv
+        layers.append(w)
     head = (model.lm_head.weight._value if model.lm_head is not None
             else m.embed_tokens.weight._value.T)
     return {"embed": m.embed_tokens.weight._value,
@@ -80,45 +103,109 @@ def _extract_gpt_weights(model, weight_dtype=None):
             "layers": layers, "head": q(head)}
 
 
-class PagedGPTDecoder:
+class PagedGPTDecoder(_TPDecoderMixin):
     """Batched paged-KV greedy generation for a GPTForCausalLM
-    (structure mirrors inference.paged_decode.PagedLlamaDecoder)."""
+    (structure mirrors inference.paged_decode.PagedLlamaDecoder,
+    including the fully-manual tensor-parallel mode: mesh + tp_shard_map
+    run every program under shard_map with SpecLayout-placed weights,
+    one allreduce per attention/MLP block and one logits gather —
+    tp_comm="int8" compresses the block reduces, see paged_decode)."""
 
     def __init__(self, model, num_blocks: int = 512,
                  block_size: int = 16,
                  max_pages_per_seq: Optional[int] = None,
-                 weight_dtype: Optional[str] = None):
+                 weight_dtype: Optional[str] = None, mesh=None,
+                 mp_axis: str = "tp", tp_shard_map: bool = False,
+                 tp_comm: str = "fp32"):
         cfg = model.cfg
         self.cfg = cfg
         self.block_size = block_size
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
-        self.weights = _extract_gpt_weights(model, weight_dtype)
+        self.mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") \
+            else mesh
+        if self.mesh is not None and not tp_shard_map:
+            raise ValueError(
+                "PagedGPTDecoder tensor parallelism is the manual "
+                "shard_map path only — pass tp_shard_map=True with the "
+                "mesh (no GSPMD fallback is implemented for the fused-"
+                "qkv layout)")
+        if tp_comm not in ("fp32", "int8"):
+            raise ValueError(f"tp_comm must be 'fp32' or 'int8', got "
+                             f"{tp_comm!r}")
+        if tp_shard_map and self.mesh is None:
+            raise ValueError("tp_shard_map=True needs a mesh (the tp "
+                             "request would otherwise be silently "
+                             "dropped)")
+        self.mp_axis = mp_axis
+        self.tp_comm = tp_comm
+        self.weight_dtype = weight_dtype
+        self._tp_manual = bool(tp_shard_map) and self.mesh is not None
+        if tp_comm != "fp32" and not self._tp_manual:
+            raise ValueError(
+                "tp_comm='int8' requires the manual shard_map path "
+                "(mesh + tp_shard_map=True); on any other path the "
+                "compressed collective would be silently dropped")
+        self._tp = (int(self.mesh.shape[self.mp_axis])
+                    if self._tp_manual else 1)
+        self._allow_kernel = self.mesh is None
+        self.weights = _extract_gpt_weights(model, weight_dtype,
+                                            tp_split=self._tp_manual)
+        if self._tp_manual:
+            self._check_tp_divisibility(self._tp)
+            self.weights = self._layout().apply(self.mesh, self.weights,
+                                                strict=True)
         self.cache = PagedKVCache(
             num_layers=cfg.num_hidden_layers, num_blocks=num_blocks,
             block_size=block_size, kv_heads=cfg.num_attention_heads,
             head_dim=self.head_dim,
-            dtype=self.weights["embed"].dtype)
-        self._prefill = jax.jit(self._prefill_impl,
-                                donate_argnums=(1, 2))
-        self._decode_scan = jax.jit(self._decode_scan_impl,
+            dtype=self.weights["embed"].dtype,
+            kv_sharding=self._kv_sharding())
+        if self._tp_manual:
+            self._prefill = jax.jit(self.tp_wrap(
+                lambda w, k, v, ids, slots:
+                    self._prefill_impl(w, k, v, ids, slots),
+                n_extra=2), donate_argnums=(1, 2))
+            self._decode_scan = jax.jit(
+                self.tp_wrap(self._decode_scan_impl, n_extra=4),
+                donate_argnums=(1, 2))
+        else:
+            self._prefill = jax.jit(self._prefill_impl,
                                     donate_argnums=(1, 2))
+            self._decode_scan = jax.jit(self._decode_scan_impl,
+                                        donate_argnums=(1, 2))
 
     def _qkv(self, w, hn, b, s):
-        nh = self.cfg.num_attention_heads
-        qkv = _mm(hn, w["wqkv"]) + w["bqkv"].astype(hn.dtype)
-        qkv = qkv.reshape(b, s, 3, nh, self.head_dim)
-        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        nh = self.cfg.num_attention_heads // self._tp
+        ak = self._allow_kernel
+        if "wqkv" in w:
+            qkv = _mm(hn, w["wqkv"], ak) + w["bqkv"].astype(hn.dtype)
+            qkv = qkv.reshape(b, s, 3, nh, self.head_dim)
+            return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # TP-split layout: per-projection column-parallel weights (the
+        # fused out dim cannot be sharded without mixing q/k/v features)
+        q = (_mm(hn, w["wq"], ak) + w["bq"].astype(hn.dtype)) \
+            .reshape(b, s, nh, self.head_dim)
+        k = (_mm(hn, w["wk"], ak) + w["bk"].astype(hn.dtype)) \
+            .reshape(b, s, nh, self.head_dim)
+        v = (_mm(hn, w["wv"], ak) + w["bv"].astype(hn.dtype)) \
+            .reshape(b, s, nh, self.head_dim)
+        return q, k, v
 
     def _block(self, w, h, attn_out):
         cfg = self.cfg
         eps = cfg.layer_norm_epsilon
-        h = h + (_mm(attn_out, w["wo"]) + w["bo"].astype(h.dtype))
+        ak = self._allow_kernel
+        # row-parallel output projections reduce BEFORE their bias is
+        # added (a per-shard bias would be summed tp times by the psum)
+        h = h + (self._block_reduce(_mm(attn_out, w["wo"], ak))
+                 + w["bo"].astype(h.dtype))
         hn = _layer_norm(h, w["ln2_w"], w["ln2_b"], eps)
-        mid = jax.nn.gelu(_mm(hn, w["wi"]) + w["bi"].astype(h.dtype),
+        mid = jax.nn.gelu(_mm(hn, w["wi"], ak) + w["bi"].astype(h.dtype),
                           approximate=False)
-        return h + (_mm(mid, w["wf"]) + w["bf"].astype(h.dtype))
+        return h + (self._block_reduce(_mm(mid, w["wf"], ak))
+                    + w["bf"].astype(h.dtype))
 
     def _prefill_impl(self, weights, k_pool, v_pool, ids, slots,
                       last_idx=None):
@@ -134,7 +221,7 @@ class PagedGPTDecoder:
                              cfg.layer_norm_epsilon)
             q, k, v = self._qkv(w, hn, b, s)
             attn = flash_attention(q, k, v, causal=True)
-            h = self._block(w, h, attn.reshape(b, s, cfg.hidden_size))
+            h = self._block(w, h, attn.reshape(b, s, self._attn_dim))
             nk, nv = reshape_and_cache(
                 k.reshape(b * s, -1, self.head_dim),
                 v.reshape(b * s, -1, self.head_dim),
@@ -146,8 +233,9 @@ class PagedGPTDecoder:
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
         hl = h[:, -1] if last_idx is None else h[jnp.arange(b), last_idx]
-        return _mm(hl, weights["head"]).astype(jnp.float32), \
-            k_pool, v_pool
+        return self._gather_logits(
+            _mm(hl, weights["head"], self._allow_kernel)
+            .astype(jnp.float32)), k_pool, v_pool
 
     def _prefill_prefix_impl(self, weights, k_pool, v_pool, ids, slots,
                              last_idx, n_cached, prefix_tables):
@@ -180,7 +268,7 @@ class PagedGPTDecoder:
             v_pre = _gather_prefix_pages(v_pool[li], prefix_tables)
             attn = _prefix_suffix_attention(q, k, v, k_pre, v_pre,
                                             n_cached)
-            h = self._block(w, h, attn.reshape(b, s, cfg.hidden_size))
+            h = self._block(w, h, attn.reshape(b, s, self._attn_dim))
             nk, nv = reshape_and_cache(
                 k.reshape(b * s, -1, self.head_dim),
                 v.reshape(b * s, -1, self.head_dim),
@@ -192,8 +280,9 @@ class PagedGPTDecoder:
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
         hl = h[jnp.arange(b), last_idx]
-        return _mm(hl, weights["head"]).astype(jnp.float32), \
-            k_pool, v_pool
+        return self._gather_logits(
+            _mm(hl, weights["head"], self._allow_kernel)
+            .astype(jnp.float32)), k_pool, v_pool
 
     def _prefill_chunk_impl(self, weights, k_pool, v_pool, ids, slots,
                             n_cached, prefix_tables):
@@ -229,10 +318,12 @@ class PagedGPTDecoder:
             v_pool[li] = vp
             attn = paged_attention_decode(q, kp, vp, tables,
                                           ctx_lens + 1)
-            h = self._block(w, h, attn.reshape(b, cfg.hidden_size))
+            h = self._block(w, h, attn.reshape(b, self._attn_dim))
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
-        logits = _mm(h, weights["head"]).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(h, weights["head"], self._allow_kernel)
+            .astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
@@ -262,10 +353,12 @@ class PagedGPTDecoder:
             v_pool[li] = vp
             attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
                                           row_ctx)
-            h = self._block(w, h, attn.reshape(r, cfg.hidden_size))
+            h = self._block(w, h, attn.reshape(r, self._attn_dim))
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
-        logits = _mm(h, weights["head"]).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(h, weights["head"], self._allow_kernel)
+            .astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,

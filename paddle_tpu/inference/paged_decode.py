@@ -259,13 +259,115 @@ def _weight_specs(cfg):
     return specs
 
 
-class PagedLlamaDecoder:
+class _TPDecoderMixin:
+    """Shared fully-manual tensor-parallel machinery for the paged
+    decoders (Llama and GPT expose the same mesh/mp_axis/tp_comm
+    surface): canonical SpecLayout placement, the shard_map wrapper,
+    the per-block reduce and the logits gather. Hosts expect
+    ``self.mesh / mp_axis / tp_comm / _tp / _tp_manual / cfg /
+    head_dim / weights`` to be set by their __init__."""
+
+    def _kv_sharding(self):
+        if self.mesh is None:
+            return None
+        # pool layout [num_blocks, kv_heads, block_size, head_dim]:
+        # shard the kv-head dim (the canonical cache_k/cache_v spec)
+        return self._layout().sharding(self.mesh, "cache_k")
+
+    def _layout(self):
+        from ..distributed.spec_layout import SpecLayout
+        return SpecLayout(tp_axis=self.mp_axis)
+
+    def _check_tp_divisibility(self, mp: int):
+        """Shared TP shardability validation (Llama + GPT): attention
+        heads, kv heads (where the config has them — MHA GPT configs
+        don't) and the intermediate size must divide the mesh degree.
+        The MANUAL shard_map path additionally needs the vocab
+        divisible (its tiled logits all_gather concatenates equal
+        shards); GSPMD placement tolerates uneven dims, so the legacy
+        mesh= path is not held to that. int4 row-sharding (wo/wd/wf)
+        shards the nibble-PACKED in-dim (in/2), which must also
+        divide or device_put fails with a raw sharding error."""
+        cfg = self.cfg
+        kvh = getattr(cfg, "num_key_value_heads",
+                      cfg.num_attention_heads)
+        if (cfg.num_attention_heads % mp or kvh % mp
+                or cfg.intermediate_size % mp):
+            raise ValueError(
+                f"TP serving needs heads ({cfg.num_attention_heads}"
+                f"/{kvh}) and intermediate size "
+                f"({cfg.intermediate_size}) divisible by the "
+                f"'{self.mp_axis}' degree {mp}")
+        if self._tp_manual and cfg.vocab_size % mp:
+            raise ValueError(
+                f"manual TP serving needs vocab ({cfg.vocab_size}) "
+                f"divisible by the '{self.mp_axis}' degree {mp} "
+                f"(the tiled logits all_gather concatenates equal "
+                f"per-shard slices)")
+        if self.weight_dtype == "int4" and (
+                (cfg.hidden_size // 2) % mp
+                or (cfg.intermediate_size // 2) % mp):
+            raise ValueError(
+                f"int4 TP serving needs hidden_size/2 "
+                f"({cfg.hidden_size // 2}) and intermediate_size/2 "
+                f"({cfg.intermediate_size // 2}) divisible by the "
+                f"'{self.mp_axis}' degree {mp} (nibble-packed in-dim)")
+
+    def tp_wrap(self, fn, n_extra: int, outs: str = "tkv"):
+        """shard_map-wrap a compiled-program body of the decoder-call
+        convention ``fn(weights, k_pool, v_pool, *replicated)`` for
+        fully-manual tp execution: weights enter per the SpecLayout
+        tree, pools sharded over the kv-head dim, everything else
+        replicated. ``outs``: "tkv" for (tokens/logits, k, v) bodies,
+        "kv" for no-sample chunk bodies. The engine uses this to wrap
+        its sampling programs; generate() wraps the decoder's own."""
+        from jax.sharding import PartitionSpec as P
+        lay = self._layout()
+        kv = lay.spec("cache_k")
+        in_specs = (lay.spec_tree(self.weights), kv, kv) \
+            + (P(),) * n_extra
+        out_specs = {"tkv": (P(), kv, kv), "kv": (kv, kv)}[outs]
+        return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+
+    def _block_reduce(self, x):
+        """The ONE collective per attention/MLP block under manual tp:
+        the partial row-parallel matmul output (after wo / wd) reduces
+        across shards — fp32 psum, or the EQuARX-style int8 collective
+        under tp_comm="int8". Identity off tp (and on the GSPMD path,
+        where the partitioner inserts the psum itself)."""
+        if not self._tp_manual:
+            return x
+        if self.tp_comm == "int8":
+            from ..distributed.collective import int8_all_reduce
+            return int8_all_reduce(x, self.mp_axis, self._tp)
+        return jax.lax.psum(x, self.mp_axis)
+
+    def _gather_logits(self, logits):
+        """Concatenate per-shard vocab logits (head is column-parallel)
+        — the single logits collective before sampling; exact (moves
+        disjoint shards) under both tp_comm modes."""
+        if not self._tp_manual:
+            return logits
+        return jax.lax.all_gather(logits, self.mp_axis,
+                                  axis=logits.ndim - 1, tiled=True)
+
+    @property
+    def _attn_dim(self) -> int:
+        """Attention output width as the program sees it: the full
+        hidden size, or this shard's head slice under manual tp."""
+        return (self.cfg.num_attention_heads // self._tp) \
+            * self.head_dim
+
+
+class PagedLlamaDecoder(_TPDecoderMixin):
     """Batched paged-KV generation for a LlamaForCausalLM."""
 
     def __init__(self, model, num_blocks: int = 512, block_size: int = 16,
                  max_pages_per_seq: Optional[int] = None,
                  weight_dtype: Optional[str] = None, mesh=None,
-                 mp_axis: str = "mp", _cfg=None, _weights=None):
+                 mp_axis: str = "mp", tp_shard_map: bool = False,
+                 tp_comm: str = "fp32", _cfg=None, _weights=None):
         cfg = model.cfg if model is not None else _cfg
         self.cfg = cfg
         self.block_size = block_size
@@ -279,6 +381,38 @@ class PagedLlamaDecoder:
         self.mesh = mesh.to_jax_mesh() if hasattr(mesh, "to_jax_mesh") \
             else mesh
         self.mp_axis = mp_axis
+        # tensor-parallel execution mode (ROADMAP 1): tp_shard_map runs
+        # every compiled program FULLY-MANUAL under shard_map — weights
+        # placed by the canonical SpecLayout table, per-shard head/
+        # intermediate slices, exactly ONE allreduce per attention/MLP
+        # block (after wo / wd) plus one all-gather over the per-shard
+        # vocab logits. jax 0.4.x cannot lower collectives in a
+        # partially-manual shard_map (the spmd_partitioner.cc:512 abort
+        # partial_manual_ok() gates elsewhere); the serving tp mesh is
+        # one-axis, so manual-over-every-axis is simply shard_map with
+        # full in/out specs. tp_comm="int8" swaps the block allreduce
+        # for the EQuARX-style quantized collective
+        # (distributed.collective.int8_all_reduce); the logits gather
+        # moves disjoint shards and stays exact either way.
+        if tp_comm not in ("fp32", "int8"):
+            raise ValueError(f"tp_comm must be 'fp32' or 'int8', got "
+                             f"{tp_comm!r}")
+        if tp_shard_map and self.mesh is None:
+            # fail loudly: silently dropping the TP request builds an
+            # unsharded decoder that OOMs one chip at 8B scale with no
+            # hint why
+            raise ValueError("tp_shard_map=True needs a mesh (the tp "
+                             "request would otherwise be silently "
+                             "dropped)")
+        self.tp_comm = tp_comm
+        self._tp_manual = bool(tp_shard_map) and self.mesh is not None
+        if tp_comm != "fp32" and not self._tp_manual:
+            raise ValueError(
+                "tp_comm='int8' requires the manual shard_map path "
+                "(mesh + tp_shard_map=True); on any other path the "
+                "compressed collective would be silently dropped")
+        self._tp = (int(self.mesh.shape[self.mp_axis])
+                    if self._tp_manual else 1)
         # the Pallas decode kernel cannot be GSPMD-partitioned: only
         # unsharded (single-device) weights may route to it
         self._allow_kernel = self.mesh is None
@@ -307,10 +441,22 @@ class PagedLlamaDecoder:
                                     jnp.float32)
         self._cos = cos[0, :, 0, :]   # [max_len, head_dim]
         self._sin = sin[0, :, 0, :]
-        self._prefill = jax.jit(self._prefill_impl,
-                                donate_argnums=(1, 2))
-        self._decode_scan = jax.jit(self._decode_scan_impl,
+        if self._tp_manual:
+            # generate()'s programs run fully-manual too (the engine
+            # wraps its own sampling programs through tp_wrap); the
+            # lambda pins the 5-arg call shape _paged_generate uses
+            self._prefill = jax.jit(self.tp_wrap(
+                lambda w, k, v, ids, slots:
+                    self._prefill_impl(w, k, v, ids, slots),
+                n_extra=2), donate_argnums=(1, 2))
+            self._decode_scan = jax.jit(
+                self.tp_wrap(self._decode_scan_impl, n_extra=4),
+                donate_argnums=(1, 2))
+        else:
+            self._prefill = jax.jit(self._prefill_impl,
                                     donate_argnums=(1, 2))
+            self._decode_scan = jax.jit(self._decode_scan_impl,
+                                        donate_argnums=(1, 2))
 
     # -- lazy construction (VERDICT r4 #2: serve 8B on one 16GB chip) --------
     @classmethod
@@ -318,7 +464,9 @@ class PagedLlamaDecoder:
                            block_size: int = 16,
                            max_pages_per_seq: Optional[int] = None,
                            weight_dtype: Optional[str] = None,
-                           mesh=None, mp_axis: str = "mp"):
+                           mesh=None, mp_axis: str = "mp",
+                           tp_shard_map: bool = False,
+                           tp_comm: str = "fp32"):
         """Build a decoder WITHOUT materializing the full-precision
         model: llama_3_8b bf16 is ~16 GB — the whole of a v5e's HBM —
         but its int4 weights are ~4 GB. `load(name, shape)` returns the
@@ -362,7 +510,8 @@ class PagedLlamaDecoder:
         return cls(None, num_blocks=num_blocks, block_size=block_size,
                    max_pages_per_seq=max_pages_per_seq,
                    weight_dtype=weight_dtype, mesh=mesh,
-                   mp_axis=mp_axis, _cfg=cfg, _weights=weights)
+                   mp_axis=mp_axis, tp_shard_map=tp_shard_map,
+                   tp_comm=tp_comm, _cfg=cfg, _weights=weights)
 
     @classmethod
     def from_config(cls, cfg, seed: int = 0, init_scale: float = 0.02,
@@ -390,74 +539,26 @@ class PagedLlamaDecoder:
     # fleet_executor.h:36). TPU-native: NamedShardings on weights + KV
     # pool; GSPMD partitions the jitted prefill/decode programs (heads
     # shard over the mp axis, o/down projections reduce via psum).
-    def _kv_sharding(self):
-        if self.mesh is None:
-            return None
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        # pool layout [num_blocks, kv_heads, block_size, head_dim]:
-        # shard the kv-head dim
-        return NamedSharding(self.mesh,
-                             P(None, self.mp_axis, None, None))
-
     def _shard_weights(self):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        mp = self.mesh.shape[self.mp_axis]
-        if (self.cfg.num_key_value_heads % mp
-                or self.cfg.num_attention_heads % mp
-                or self.cfg.intermediate_size % mp):
-            raise ValueError(
-                f"TP serving needs heads ({self.cfg.num_attention_heads}"
-                f"/{self.cfg.num_key_value_heads}) and intermediate size "
-                f"({self.cfg.intermediate_size}) divisible by the "
-                f"'{self.mp_axis}' degree {mp}")
-        if self.weight_dtype == "int4" and (
-                (self.cfg.hidden_size // 2) % mp
-                or (self.cfg.intermediate_size // 2) % mp):
-            # row-sharded int4 weights (wo, wd) shard the PACKED in-dim
-            # (in/2); it must still divide by mp or device_put fails
-            # with a raw sharding error
-            raise ValueError(
-                f"int4 TP serving needs hidden_size/2 "
-                f"({self.cfg.hidden_size // 2}) and intermediate_size/2 "
-                f"({self.cfg.intermediate_size // 2}) divisible by the "
-                f"'{self.mp_axis}' degree {mp} (nibble-packed in-dim)")
-
-        def put(w, spec):
-            ns = NamedSharding(self.mesh, spec)
-            if isinstance(w, tuple):
-                # quantized (w, scale): scale follows the OUT dim. The
-                # int4 packed array shards like the weight — packing is
-                # along in-dim pairs, so row-sharding stays aligned as
-                # long as in/2 divides by mp (guaranteed by the
-                # divisibility checks above for even hidden sizes)
-                wq, sc = w
-                sc_spec = P(spec[1]) if spec[1] is not None else P()
-                return (jax.device_put(wq, ns),
-                        jax.device_put(sc, NamedSharding(self.mesh,
-                                                         sc_spec)))
-            return jax.device_put(w, ns)
-
-        col = P(None, self.mp_axis)        # output-feature sharded
-        row = P(self.mp_axis, None)        # input-feature sharded
-        rep = P()
-        self.weights = {
-            "embed": put(self.weights["embed"], rep),
-            "norm": put(self.weights["norm"], rep),
-            "head": put(self.weights["head"], col),
-            "layers": [
-                {"ln1": put(w["ln1"], rep), "ln2": put(w["ln2"], rep),
-                 "wq": put(w["wq"], col), "wk": put(w["wk"], col),
-                 "wv": put(w["wv"], col), "wo": put(w["wo"], row),
-                 "wg": put(w["wg"], col), "wu": put(w["wu"], col),
-                 "wd": put(w["wd"], row)}
-                for w in self.weights["layers"]],
-        }
+        """Place the weight tree via the canonical SpecLayout table —
+        the SAME table flightcheck's FC605 parses, so placement cannot
+        drift from what static analysis pins. strict: every key of the
+        serving vocabulary must have a canonical spec (a silently
+        replicated weight is how an implicit all-gather starts)."""
+        self._check_tp_divisibility(int(self.mesh.shape[self.mp_axis]))
+        self.weights = self._layout().apply(self.mesh, self.weights,
+                                            strict=True)
 
     # -- attention building blocks -----------------------------------------
     def _proj_qkv(self, w, hn, b, s):
         cfg = self.cfg
-        nh, kvh, hd = (cfg.num_attention_heads,
-                       cfg.num_key_value_heads, self.head_dim)
+        # under manual tp the program runs on per-shard arrays: this
+        # shard's head slice (column-parallel wq/wk/wv). tp divides
+        # kvh, so every shard holds whole GQA groups and the q->kv
+        # head mapping is the global one restricted to the slice.
+        nh, kvh, hd = (cfg.num_attention_heads // self._tp,
+                       cfg.num_key_value_heads // self._tp,
+                       self.head_dim)
         if "wqkv" in w:
             qkv = _mm(hn, w["wqkv"], self._allow_kernel)
             q, k, v = jnp.split(
@@ -503,10 +604,11 @@ class PagedLlamaDecoder:
             q = self._rope(q, positions)
             k = self._rope(k, positions)
             attn = flash_attention(q, k, v, causal=True)
-            h = h + _mm(attn.reshape(b, s, cfg.hidden_size), w["wo"],
-                        self._allow_kernel)
+            h = h + self._block_reduce(
+                _mm(attn.reshape(b, s, self._attn_dim), w["wo"],
+                    self._allow_kernel))
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + self._mlp(w, hn)
+            h = h + self._block_reduce(self._mlp(w, hn))
             # scatter this layer's k/v into the pool pages (list swap —
             # no stacked-pool slice copies)
             from ..ops.paged_attention import reshape_and_cache
@@ -523,8 +625,9 @@ class PagedLlamaDecoder:
             hl = h[:, -1]
         else:
             hl = h[jnp.arange(b), last_idx]
-        logits = _mm(hl, weights["head"],
-                     self._allow_kernel).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(hl, weights["head"],
+                self._allow_kernel).astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _prefill_prefix_impl(self, weights, k_pool, v_pool, ids, slots,
@@ -558,10 +661,11 @@ class PagedLlamaDecoder:
             v_pre = _gather_prefix_pages(v_pool[li], prefix_tables)
             attn = _prefix_suffix_attention(q, k, v, k_pre, v_pre,
                                             n_cached)
-            h = h + _mm(attn.reshape(b, s, cfg.hidden_size), w["wo"],
-                        self._allow_kernel)
+            h = h + self._block_reduce(
+                _mm(attn.reshape(b, s, self._attn_dim), w["wo"],
+                    self._allow_kernel))
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + self._mlp(w, hn)
+            h = h + self._block_reduce(self._mlp(w, hn))
             from ..ops.paged_attention import reshape_and_cache
             nk, nv = reshape_and_cache(
                 k.reshape(b * s, -1, self.head_dim),
@@ -573,8 +677,9 @@ class PagedLlamaDecoder:
             v_pool[li] = nv
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
         hl = h[jnp.arange(b), last_idx]
-        logits = _mm(hl, weights["head"],
-                     self._allow_kernel).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(hl, weights["head"],
+                self._allow_kernel).astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _prefill_chunk_impl(self, weights, k_pool, v_pool, ids, slots,
@@ -622,13 +727,15 @@ class PagedLlamaDecoder:
             k_pool[li] = kp
             v_pool[li] = vp
             attn = paged_attention_decode(q, kp, vp, tables, ctx_lens + 1)
-            h = h + _mm(attn.reshape(b, cfg.hidden_size), w["wo"],
-                        self._allow_kernel)
+            h = h + self._block_reduce(
+                _mm(attn.reshape(b, self._attn_dim), w["wo"],
+                    self._allow_kernel))
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + self._mlp(w, hn)
+            h = h + self._block_reduce(self._mlp(w, hn))
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
-        logits = _mm(h, weights["head"],
-                     self._allow_kernel).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(h, weights["head"],
+                self._allow_kernel).astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
@@ -666,13 +773,15 @@ class PagedLlamaDecoder:
             v_pool[li] = vp
             attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
                                           row_ctx)
-            h = h + _mm(attn.reshape(r, cfg.hidden_size), w["wo"],
-                        self._allow_kernel)
+            h = h + self._block_reduce(
+                _mm(attn.reshape(r, self._attn_dim), w["wo"],
+                    self._allow_kernel))
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + self._mlp(w, hn)
+            h = h + self._block_reduce(self._mlp(w, hn))
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
-        logits = _mm(h, weights["head"],
-                     self._allow_kernel).astype(jnp.float32)
+        logits = self._gather_logits(
+            _mm(h, weights["head"],
+                self._allow_kernel).astype(jnp.float32))
         return logits, k_pool, v_pool
 
     def _decode_body(self, weights, k_pool, v_pool, last_ids, tables,
@@ -718,6 +827,10 @@ def _paged_generate(dec, input_ids, max_new_tokens, timings=None):
     allocation, ONE compiled prefill, host-precomputed decode schedule,
     ONE compiled scan, page free."""
     import time as _time
+    # under manual tp, schedule arrays go in as UNCOMMITTED host
+    # arrays: jnp.asarray would commit them to the default device,
+    # which conflicts with the tp mesh the program runs on
+    aj = np.asarray if getattr(dec, "_tp", 1) > 1 else jnp.asarray
     ids = input_ids._value if isinstance(input_ids, Tensor) \
         else jnp.asarray(input_ids)
     ids = np.asarray(ids).astype(np.int32)
@@ -728,10 +841,10 @@ def _paged_generate(dec, input_ids, max_new_tokens, timings=None):
     for i in seqs:
         cache.allocate(i, s + max_new_tokens)
         slot_rows.append([cache.extend(i) for _ in range(s)])
-    slots = jnp.asarray(np.asarray(slot_rows, np.int32))
+    slots = aj(np.asarray(slot_rows, np.int32))
     t0 = _time.perf_counter()
     logits, cache.k, cache.v = dec._prefill(
-        dec.weights, cache.k, cache.v, jnp.asarray(ids), slots)
+        dec.weights, cache.k, cache.v, aj(ids), slots)
     next_ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if timings is not None:
         next_ids.block_until_ready()
@@ -756,8 +869,7 @@ def _paged_generate(dec, input_ids, max_new_tokens, timings=None):
     if T > 0:
         toks, cache.k, cache.v = dec._decode_scan(
             dec.weights, cache.k, cache.v, next_ids,
-            jnp.asarray(tables_all), jnp.asarray(ctx_all),
-            jnp.asarray(slots_all))
+            aj(tables_all), aj(ctx_all), aj(slots_all))
         toks = np.asarray(toks)
     else:
         toks = np.zeros((b, 0), np.int32)

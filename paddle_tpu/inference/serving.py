@@ -286,19 +286,82 @@ class ServingEngine:
                  retry_backoff_s: float = 0.05,
                  admission: str = "worst_case",
                  max_queue_depth: Optional[int] = None,
-                 ragged: bool = False):
+                 ragged: bool = False, tp: int = 1,
+                 tp_comm: Optional[str] = None):
         from .gpt_decode import PagedGPTDecoder
+        # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
+        # tp=N builds a one-axis "tp" mesh over the first N devices and
+        # runs the WHOLE serving step — the ragged [T, W] program,
+        # in-program sampling, paged KV append — fully-manual under
+        # shard_map: decoder weights placed by the canonical SpecLayout
+        # table (wq/wk/wv/wg/wu/head column-parallel, wo/wd
+        # row-parallel, embed/norms replicated), the KV pool sharded
+        # over the kv-head dim (each shard appends exactly the heads it
+        # computed — zero collectives on the append path), exactly ONE
+        # allreduce per attention/MLP block plus one all-gather over
+        # the per-shard vocab logits before sampling. tp_comm="int8"
+        # swaps the block allreduces for the EQuARX-style quantized
+        # collective (distributed.collective.int8_all_reduce); the
+        # logits gather stays exact. tp>1 forces ragged=True — one
+        # sharded program per step IS the multi-chip serving step.
+        # tp_comm=None (the default) means "the decoder's mode" —
+        # fp32 when the engine builds the decoder itself; an EXPLICIT
+        # value that contradicts a prebuilt decoder raises (the comm
+        # mode is baked into the decoder's compiled programs, and a
+        # silently-substituted mode corrupts exactly the fp32-vs-int8
+        # A/B the flag exists for).
+        tp = int(tp)
+        if tp < 1:
+            raise ValueError(f"tp must be >= 1, got {tp}")
+        if tp_comm not in (None, "fp32", "int8"):
+            raise ValueError(f"tp_comm must be 'fp32' or 'int8', got "
+                             f"{tp_comm!r}")
+        if tp > 1 and mesh is not None:
+            raise ValueError("pass either tp=N (manual shard_map "
+                             "serving) or mesh= (GSPMD decoder "
+                             "placement), not both")
         if isinstance(model, (PagedLlamaDecoder, PagedGPTDecoder)):
             # a prebuilt paged decoder (e.g. PagedLlamaDecoder
             # .from_config for 8B-class weights that must be quantized
-            # at load); its pool/quantization choices stand — the
+            # at load); its pool/quantization/tp choices stand — the
             # num_blocks/block_size/weight_dtype args here are ignored
             self.dec = model
+            dec_tp = int(getattr(model, "_tp", 1))
+            if tp > 1 and dec_tp != tp:
+                raise ValueError(
+                    f"ServingEngine(tp={tp}) got a prebuilt decoder "
+                    f"with tp degree {dec_tp}; build the decoder with "
+                    f"the matching mesh (tp_shard_map=True) or drop "
+                    f"the engine tp argument")
+            dec_comm = getattr(model, "tp_comm", "fp32")
+            if tp_comm is not None and dec_comm != tp_comm:
+                # the comm mode is baked into the decoder's programs:
+                # silently substituting the decoder's would run the
+                # wrong leg of the fp32-vs-int8 A/B in EITHER direction
+                raise ValueError(
+                    f"ServingEngine(tp_comm={tp_comm!r}) got a "
+                    f"prebuilt decoder built with tp_comm="
+                    f"{dec_comm!r}; pass the desired tp_comm to the "
+                    f"decoder constructor instead")
+            self.tp = dec_tp
         else:
+            if tp > 1:
+                devs = jax.devices()
+                if len(devs) < tp:
+                    raise ValueError(
+                        f"tp={tp} needs {tp} devices, found "
+                        f"{len(devs)}")
+                from jax.sharding import Mesh
+                mesh = Mesh(np.asarray(devs[:tp]), ("tp",))
             self.dec = PagedLlamaDecoder(model, num_blocks=num_blocks,
                                          block_size=block_size,
                                          weight_dtype=weight_dtype,
-                                         mesh=mesh)
+                                         mesh=mesh, mp_axis="tp"
+                                         if tp > 1 else "mp",
+                                         tp_shard_map=tp > 1,
+                                         tp_comm=tp_comm or "fp32")
+            self.tp = tp
+        self.tp_comm = getattr(self.dec, "tp_comm", tp_comm or "fp32")
         self.max_b = int(max_batch_size)
         self.buckets = tuple(sorted(prompt_buckets))
         self.top_k = int(top_k)
@@ -423,6 +486,12 @@ class ServingEngine:
         self._recompute_chunk = self.prefill_chunk or 64
         self._debug_pool = os.environ.get(
             "PADDLE_TPU_POOL_DEBUG", "") not in ("", "0")
+        # schedule-array staging: under manual tp the per-chunk arrays
+        # must reach the program UNCOMMITTED (np) — jnp.asarray would
+        # commit them to the default device, which conflicts with the
+        # tp mesh; jit places uncommitted arrays per the shard_map
+        # in_specs (replicated) itself
+        self._aj = jnp.asarray if self.tp == 1 else np.asarray
 
         self._slots: List[Optional[Request]] = [None] * self.max_b
         self._last_tok = np.zeros(self.max_b, np.int32)
@@ -566,6 +635,14 @@ class ServingEngine:
         # Needs the decoder's _ragged_logits; the attention op falls
         # back to the masked jnp oracle off-TPU.
         self.ragged = bool(ragged) and hasattr(dec, "_ragged_logits")
+        if self.tp > 1:
+            if not hasattr(dec, "_ragged_logits"):
+                raise ValueError(
+                    "tensor-parallel serving needs a decoder with the "
+                    "ragged step program (_ragged_logits)")
+            # the tp serving step IS the sharded ragged program; the
+            # dense per-phase dispatch path is not built for shard_map
+            self.ragged = True
         # prefill tokens folded into one ragged dispatch (the ragged
         # path is always chunked-style — a long prompt spreads over
         # successive steps' programs under this per-step cap)
@@ -639,10 +716,23 @@ class ServingEngine:
                      top_ps_all, reps_all))
                 return toks, k, v          # [T, W]
 
-            self._ragged_j = jax.jit(ragged_chunk,
-                                     donate_argnums=(1, 2))
-            self._ragged_rich_j = jax.jit(ragged_chunk_rich,
-                                          donate_argnums=(1, 2))
+            if self.tp > 1:
+                # the WHOLE step program — decode scan, in-program
+                # sampling, KV append, prefill rows — runs fully-manual
+                # under shard_map on the tp mesh (jax 0.4.x cannot
+                # lower collectives in a partially-manual region; the
+                # one-axis serving mesh makes full specs natural)
+                self._ragged_j = jax.jit(
+                    dec.tp_wrap(ragged_chunk, n_extra=14),
+                    donate_argnums=(1, 2))
+                self._ragged_rich_j = jax.jit(
+                    dec.tp_wrap(ragged_chunk_rich, n_extra=19),
+                    donate_argnums=(1, 2))
+            else:
+                self._ragged_j = jax.jit(ragged_chunk,
+                                         donate_argnums=(1, 2))
+                self._ragged_rich_j = jax.jit(ragged_chunk_rich,
+                                              donate_argnums=(1, 2))
 
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
@@ -1511,9 +1601,19 @@ class ServingEngine:
         """Cached device-resident all-False seen mask (per row count)."""
         cached = self._zeros_seen_cache.get(rows)
         if cached is None:
-            cached = jnp.zeros((rows, vocab), bool)
+            cached = self._replicated(jnp.zeros((rows, vocab), bool))
             self._zeros_seen_cache[rows] = cached
         return cached
+
+    def _replicated(self, arr):
+        """Commit a cached device constant consistently with the
+        engine's mesh: replicated over the tp mesh under tensor
+        parallelism (a default-device-committed constant would clash
+        with the tp-mesh program), as-is otherwise."""
+        if self.tp == 1:
+            return arr
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        return jax.device_put(arr, NamedSharding(self.dec.mesh, P()))
 
     def _warmup_prompt(self, n: int) -> np.ndarray:
         """Throwaway warmup prompt with a per-call token fill: two
@@ -1799,7 +1899,7 @@ class ServingEngine:
         flush (every column takes its host override)."""
         cached = self._zeros_toks_cache.get((t, w))
         if cached is None:
-            cached = jnp.zeros((t, w), jnp.int32)
+            cached = self._replicated(jnp.zeros((t, w), jnp.int32))
             self._zeros_toks_cache[(t, w)] = cached
         return cached
 
@@ -2124,13 +2224,16 @@ class ServingEngine:
             or any(f[0].sampling.needs_rich_sampling for f in finals)
         prev_toks = prev["toks"] if prev is not None \
             else self._zeros_toks(T, W)
-        keys = jax.random.split(self._next_key(), T)
+        # under tp the split keys (committed to the default device)
+        # re-place replicated on the tp mesh — an async device_put,
+        # not a host sync; the key VALUES are identical to the tp=1
+        # stream, only the placement changes
+        keys = self._replicated(jax.random.split(self._next_key(), T))
+        aj = self._aj
         args = (self.dec.weights, cache.k, cache.v, prev_toks,
-                jnp.asarray(last_t), jnp.asarray(prev_col),
-                jnp.asarray(use_host), jnp.asarray(override),
-                jnp.asarray(ids), jnp.asarray(pos), jnp.asarray(slots),
-                jnp.asarray(rseq), jnp.asarray(rctx), jnp.asarray(ucar),
-                jnp.asarray(tables), jnp.asarray(temps), keys)
+                aj(last_t), aj(prev_col), aj(use_host), aj(override),
+                aj(ids), aj(pos), aj(slots), aj(rseq), aj(rctx),
+                aj(ucar), aj(tables), aj(temps), keys)
         try:
             if rich:
                 any_rep = any(r.sampling.repetition_penalty != 1.0
@@ -2149,13 +2252,13 @@ class ServingEngine:
                     for req, _, t, c in finals:
                         if req.sampling.repetition_penalty != 1.0:
                             seen[c, req.prompt] = True
-                    seen_dev = jnp.asarray(seen)
+                    seen_dev = aj(seen)
                 else:
                     seen_dev = self._zeros_seen(W, vocab)
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:ragged", self._ragged_rich_j, *args,
-                    jnp.asarray(top_ks), jnp.asarray(top_ps),
-                    jnp.asarray(reps), seen_dev, jnp.asarray(upd))
+                    aj(top_ks), aj(top_ps), aj(reps), seen_dev,
+                    aj(upd))
             else:
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:ragged", self._ragged_j, *args)

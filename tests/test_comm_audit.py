@@ -148,6 +148,20 @@ class TestAuditGate:
         perm = [r for r in pp if r["kind"] == "ppermute"]
         # 2 hops (fwd act + bwd grad) per tick, every tick
         assert perm and all(r["count"] % 2 == 0 for r in perm)
+        # the TP serving step (ISSUE 8): T=2 ministeps x 2 layers x
+        # 2 blocks = 8 psums + one logits all_gather per ministep,
+        # NOTHING else — zero collectives on the KV-append path
+        tp = full_report["serving.ragged_tp2_fp32"]["collectives"]
+        kinds = {}
+        for r in tp:
+            kinds[r["kind"]] = kinds.get(r["kind"], 0) + r["count"]
+        assert kinds == {"psum": 8, "all_gather": 2}, tp
+        # int8 comms: every block psum becomes the quantized
+        # collective (2 all_to_alls + 2 all_gathers); no psum remains
+        tpq = full_report["serving.ragged_tp2_int8"]["collectives"]
+        assert not any(r["kind"] == "psum" for r in tpq), tpq
+        assert sum(r["count"] for r in tpq
+                   if r["kind"] == "all_to_all") == 16
 
 
 class TestSpecLayout:

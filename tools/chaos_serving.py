@@ -48,7 +48,8 @@ def build_engine(model, args):
         admission="optimistic",
         max_dispatch_retries=args.retries,
         retry_backoff_s=0.0,
-        ragged=args.ragged)
+        ragged=args.ragged or args.tp > 1,
+        tp=args.tp)
 
 
 def gen_workload(args):
@@ -164,12 +165,24 @@ def main() -> int:
                     help="exercise the ragged unified prefill+decode "
                          "path (ISSUE 5): both the chaos and the "
                          "fault-free replay run with ragged=True")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree (ISSUE 8): both runs "
+                         "serve on the sharded shard_map engine — "
+                         "OOM-preemption, injected dispatch faults and "
+                         "cancellation must stay token-identical under "
+                         "sharding (implies the ragged path)")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
                          "actually happened")
     args = ap.parse_args()
     args.vocab = None
+
+    if args.tp > 1:
+        # the tp mesh needs the multi-device CPU backend before
+        # anything initializes jax (the conftest dance, standalone)
+        from tools.flightcheck.comm_audit import ensure_devices
+        ensure_devices(max(8, args.tp))
 
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_tiny
@@ -197,7 +210,8 @@ def main() -> int:
             faulted += 1
     st = eng.stats()
     summary = {
-        "ragged": args.ragged,
+        "ragged": args.ragged or args.tp > 1,
+        "tp": args.tp,
         "steps": steps_run,
         "requests": len(chaos_results),
         "done_identical": done - len(mismatches),

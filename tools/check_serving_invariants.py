@@ -47,6 +47,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_serving.py"),
     os.path.join(REPO, "tests", "test_fault_tolerance.py"),
     os.path.join(REPO, "tests", "test_ragged_batching.py"),
+    os.path.join(REPO, "tests", "test_tp_serving.py"),
 ]
 
 
@@ -95,16 +96,20 @@ def run_chaos() -> int:
     one cancellation. The schedule runs TWICE: once on the dense path
     and once with ragged=True, so preemption row-range neutralize,
     cancel-driven reader restarts and dispatch-fault recovery are
-    exercised on the unified one-program-per-step scheduler too."""
+    exercised on the unified one-program-per-step scheduler too.
+    ISSUE 8 added the --tp 2 leg: the same schedule on the
+    tensor-parallel shard_map engine — preemption neutralization,
+    epoch guards and retry must stay request-granular under
+    sharding."""
     import subprocess
     rc_all = 0
-    for leg in ((), ("--ragged",)):
+    for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
+                     ("tp2", ("--tp", "2"))):
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",
                *leg]
         rc = subprocess.call(cmd)
-        tag = "ragged" if leg else "dense"
         print(f"CHAOS GATE ({tag}) OK — fault schedule survived, "
               "outputs identical" if rc == 0
               else f"CHAOS GATE ({tag}) FAILED (exit {rc})")

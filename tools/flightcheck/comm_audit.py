@@ -16,10 +16,15 @@ counts exactly what the program will execute:
 - ``while`` bodies count ONCE and the program is marked approximate.
 
 Entry points: the eager collective bodies (collective.py — the SAME
-module-level body functions the public API jits), ring attention
-forward/backward (zigzag and the multi-axis fallback), the GPipe
-pipeline, the table-driven 1F1B schedule, and the full 4D-parallel
-pipelined-Llama train step.
+module-level body functions the public API jits; the EQuARX-style
+int8_all_reduce included), ring attention forward/backward (zigzag and
+the multi-axis fallback), the GPipe pipeline, the table-driven 1F1B
+schedule, the full 4D-parallel pipelined-Llama train step, and (ISSUE
+8) the TENSOR-PARALLEL SERVING STEP — the ServingEngine(tp=2) ragged
+[T, W] program, fp32 and int8 comms, whose expectations pin exactly
+one allreduce per attention/MLP block per layer per ministep, one
+logits all_gather per ministep, and ZERO collectives on the KV-append
+path (any implicit gather there would change the counts).
 
 The committed expectations file (tools/flightcheck/comm_expectations.json)
 pins every program's audit; ``python -m tools.flightcheck.comm_audit``
@@ -185,6 +190,14 @@ def _build_collectives():
             C.barrier_body(), P("rank"), (n,)),
         "collective.p2p_ring": lambda: _collective_program(
             C.ppermute_body(ring), P("rank"), (n, 64, 64)),
+        # the EQuARX-style quantized allreduce (ISSUE 8): its exact
+        # collective shape — TWO all_to_alls (int8 chunks + their
+        # per-row scales, the reduce-scatter phase) + TWO all_gathers
+        # (reduced int8 chunks + fresh scales) — is pinned here so a
+        # refactor that silently doubles a phase (or falls back to
+        # fp32 psum) fails the gate
+        "collective.int8_all_reduce": lambda: _collective_program(
+            C.int8_all_reduce_body(n), P("rank"), (n, 4, 64)),
     }
 
 
@@ -282,6 +295,62 @@ def _build_llama_pp():
     return {"llama_pp.train_step": step}
 
 
+def _build_tp_serving():
+    """The ISSUE-8 serving-step programs: the unified ragged [T, W]
+    chunk of a ServingEngine(tp=2) on a 2-device submesh, fp32 and
+    int8 comms. The pinned expectations ARE the TP contract:
+
+    - fp32: exactly ONE psum per attention/MLP block per layer per
+      ministep (T * layers * 2 in total) plus ONE logits all_gather
+      per ministep — and NOTHING else: the KV-append path
+      (reshape_and_cache into the kv-head-sharded pool) contributes
+      zero collectives, and a doubled/implicit collective from a
+      refactor changes the counts and fails this gate in ~4s, not in
+      a profile;
+    - int8: each block psum becomes the quantized collective
+      (2 all_to_alls + 2 all_gathers, chunks + per-row scales), the
+      logits gather stays exact.
+    """
+    def _mk(tp_comm):
+        def build():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from paddle_tpu.inference.paged_decode import \
+                PagedLlamaDecoder
+            from paddle_tpu.inference.serving import ServingEngine
+            from paddle_tpu.models.llama import LlamaConfig
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+            mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+            dec = PagedLlamaDecoder.from_config(
+                cfg, num_blocks=8, block_size=4, mesh=mesh,
+                mp_axis="tp", tp_shard_map=True, tp_comm=tp_comm)
+            eng = ServingEngine(dec, tp=2, tp_comm=tp_comm,
+                                max_batch_size=2,
+                                prompt_buckets=(8, 16), chunk_size=2,
+                                prefill_chunk=4)
+            T, W = 2, 4
+            S = jax.ShapeDtypeStruct
+            i32, f32 = jnp.int32, jnp.float32
+            args = (dec.weights, dec.cache.k, dec.cache.v,
+                    S((T, W), i32), S((W,), i32), S((W,), i32),
+                    S((W,), jnp.bool_), S((W,), i32),
+                    S((T, W), i32), S((T, W), i32), S((T, W), i32),
+                    S((T, W), i32), S((T, W), i32),
+                    S((T, W), jnp.bool_),
+                    S((eng.max_b + 1, dec.max_pages), i32),
+                    S((T, W), f32), S((T, 2), jnp.uint32))
+            return eng._ragged_j, args
+        return build
+
+    return {"serving.ragged_tp2_fp32": _mk("fp32"),
+            "serving.ragged_tp2_int8": _mk("int8")}
+
+
 def programs() -> Dict[str, callable]:
     """name -> lazy builder returning (traceable fn, example args).
     Builders import jax/paddle_tpu only when called."""
@@ -290,6 +359,7 @@ def programs() -> Dict[str, callable]:
     out.update(_build_ring_attention())
     out.update(_build_pipelines())
     out.update(_build_llama_pp())
+    out.update(_build_tp_serving())
     return out
 
 
