@@ -91,7 +91,7 @@ LKG = {
 AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "8b",
               "serving", "serving_tp", "pp", "moe", "dit", "profile")
 
-MODE_TIMEOUT_S = {"serving": 2700, "decode": 2100, "8b": 3600}
+MODE_TIMEOUT_S = {"serving": 3300, "decode": 2100, "8b": 3600}
 DEFAULT_TIMEOUT_S = 1800
 
 # calibration plausibility band: a big scanned bf16 matmul on an
@@ -1159,6 +1159,95 @@ def run_serving_ragged(weight_dtype=None):
     return out
 
 
+def run_serving_spec():
+    """Speculative decoding A/B (the ISSUE-9 acceptance scenario): 6
+    greedy decode streams, spec on vs off, on TWO workload regimes:
+
+    - "rep" (repetitive/templated — high n-gram hit rate): the
+      llama_small geometry with TIED embeddings, whose random-init
+      greedy decode locks onto a repeated continuation within a few
+      tokens — the honest stand-in for templated traffic (an untrained
+      model cannot re-walk meaningful text, but the drafter/verify
+      machinery sees exactly what a high-hit production stream gives
+      it: long accepted prefixes). Headline: >= 1.5x tok/s with the
+      acceptance rate reported.
+    - "adv" (adversarial low-hit): the same geometry UNTIED — greedy
+      output wanders, n-gram lookups mostly miss or mispredict, and
+      the row reports what spec COSTS when drafting doesn't pay
+      (flushed pipeline + verify rows that get rejected).
+
+    Greedy outputs must be token-identical spec-on vs spec-off in BOTH
+    regimes — asserted here in the bench, not just in the test suite
+    (serving_spec_tokens_identical gates the row)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import (ServingEngine, SamplingParams,
+                                      SpecConfig)
+
+    block_size = 32
+    n_short, short_len, short_new = 6, 64, 96
+    out = {}
+    for regime, tied in (("rep", True), ("adv", False)):
+        cfg = llama_small(dtype="bfloat16", tie_word_embeddings=tied)
+        rng = np.random.RandomState(0)
+        prompts = [rng.randint(0, cfg.vocab_size, short_len)
+                   .astype(np.int32) for _ in range(n_short)]
+        n_blocks = (n_short
+                    * -(-(short_len + short_new) // block_size) + 4)
+        toks = {}
+        for tag, spec in (("off", None),
+                          ("on", SpecConfig(draft_len=16))):
+            paddle.seed(0)
+            model = LlamaForCausalLM(cfg)
+            model.eval()
+            eng = ServingEngine(
+                model, max_batch_size=n_short, num_blocks=n_blocks,
+                block_size=block_size, prompt_buckets=(64, 128),
+                chunk_size=8, prefill_chunk=64, ragged=True,
+                spec_decode=spec)
+            eng.warmup()   # compile outside the clock, like every row
+            t0 = time.perf_counter()
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=short_new))
+                for p in prompts]
+            eng.run_to_completion()
+            wall = time.perf_counter() - t0
+            st = eng.stats()
+            toks[tag] = [eng.result(r).tolist() for r in rids]
+            pre = f"serving_spec_{regime}_{tag}"
+            out[f"{pre}_tok_per_sec"] = round(
+                st["generated_tokens"] / wall, 1)
+            out[f"{pre}_itl_p50_s"] = round(st["itl_p50_s"], 4)
+            out[f"{pre}_itl_p99_s"] = round(st["itl_p99_s"], 4)
+            out[f"{pre}_tokens_per_dispatch"] = round(
+                st["tokens_per_dispatch"], 2)
+            out[f"{pre}_wall_s"] = round(wall, 3)
+            if spec is not None:
+                out[f"{pre}_acceptance_rate"] = round(
+                    st["draft_acceptance_rate"], 3)
+                out[f"{pre}_drafted"] = st["drafted_tokens"]
+                out[f"{pre}_accepted"] = st["accepted_draft_tokens"]
+                out[f"{pre}_rollbacks"] = st["spec_rollbacks"]
+            del eng, model
+            _clear_device_memory()
+        out[f"serving_spec_{regime}_tokens_identical"] = \
+            toks["on"] == toks["off"]
+        out[f"serving_spec_{regime}_speedup_x"] = round(
+            out[f"serving_spec_{regime}_on_tok_per_sec"]
+            / max(out[f"serving_spec_{regime}_off_tok_per_sec"],
+                  1e-9), 2)
+        out[f"serving_spec_{regime}_dispatch_reduction_x"] = round(
+            out[f"serving_spec_{regime}_on_tokens_per_dispatch"]
+            / max(out[f"serving_spec_{regime}_off_tokens_per_dispatch"],
+                  1e-9), 2)
+    out["serving_spec_tokens_identical"] = (
+        out["serving_spec_rep_tokens_identical"]
+        and out["serving_spec_adv_tokens_identical"])
+    assert out["serving_spec_tokens_identical"], \
+        "speculative decoding changed greedy outputs"
+    return out
+
+
 def run_serving_tp():
     """Multi-chip tensor-parallel serving A/B (ISSUE 8 acceptance): the
     same mixed workload — 6 decode streams plus a mid-stream long
@@ -1536,6 +1625,11 @@ def run_serving_suite():
     # delivered token, one program per step vs the dense schedule
     out.update(run_serving_ragged())
     _suite_barrier("serving_ragged", out)
+    # speculative decoding A/B (ISSUE 9): repetitive vs adversarial
+    # workloads, spec on/off — tok/s, ITL, acceptance rate, token
+    # identity asserted inside the row
+    out.update(run_serving_spec())
+    _suite_barrier("serving_spec", out)
     # multi-chip TP A/B (ISSUE 8): the sharded ragged step at tp=1/2/4,
     # fp32 vs int8 comms — skipped cleanly when the process' backend
     # cannot provide the 8-device mesh (e.g. initialized single-chip)
@@ -1792,6 +1886,12 @@ def main(mode: str):
                   "unit": "x",
                   "value": r["serving_ragged_dispatch_reduction_x"],
                   "extra": r}
+    elif mode == "serving_spec":
+        r = run_serving_spec()
+        result = {"metric": "serving_spec_rep_speedup_x",
+                  "unit": "x",
+                  "value": r["serving_spec_rep_speedup_x"],
+                  "extra": r}
     elif mode == "serving_tp":
         r = run_serving_tp()
         result = {"metric": "serving_tp2_tok_per_sec",
@@ -1835,8 +1935,8 @@ def main(mode: str):
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
                 "resnet", "decode", "8b", "serving",
                 "serving_interleave", "serving_degradation",
-                "serving_ragged", "serving_tp", "pp", "moe", "dit",
-                "profile", "calibrate")
+                "serving_ragged", "serving_spec", "serving_tp", "pp",
+                "moe", "dit", "profile", "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

@@ -20,10 +20,12 @@ from .gpt_decode import PagedGPTDecoder  # noqa: F401
 from .paged_decode import PagedLlamaDecoder  # noqa: F401
 from .serving import (EngineOverloaded, Request, SamplingParams,  # noqa: F401
                       ServingEngine)
+from .spec_decode import Drafter, NGramDrafter, SpecConfig  # noqa: F401
 
 __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
            "PlaceType", "ServingEngine", "SamplingParams", "Request",
-           "EngineOverloaded", "PagedLlamaDecoder", "PagedGPTDecoder"]
+           "EngineOverloaded", "PagedLlamaDecoder", "PagedGPTDecoder",
+           "SpecConfig", "Drafter", "NGramDrafter"]
 
 
 class PrecisionType:

@@ -27,7 +27,8 @@ from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
                                    ragged_paged_attention,
                                    reshape_and_cache)
-from .paged_decode import (_TPDecoderMixin, _gather_prefix_pages, _mm,
+from .paged_decode import (_SpecDecodeMixin, _TPDecoderMixin,
+                           _gather_prefix_pages, _mm,
                            _prefix_suffix_attention, _quantize_w,
                            _quantize_w4, _quantize_w4_halves)
 
@@ -103,13 +104,14 @@ def _extract_gpt_weights(model, weight_dtype=None, tp_split=False):
             "layers": layers, "head": q(head)}
 
 
-class PagedGPTDecoder(_TPDecoderMixin):
+class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
     """Batched paged-KV greedy generation for a GPTForCausalLM
     (structure mirrors inference.paged_decode.PagedLlamaDecoder,
     including the fully-manual tensor-parallel mode: mesh + tp_shard_map
     run every program under shard_map with SpecLayout-placed weights,
     one allreduce per attention/MLP block and one logits gather —
-    tp_comm="int8" compresses the block reduces, see paged_decode)."""
+    tp_comm="int8" compresses the block reduces, see paged_decode —
+    and the speculative-decoding verification tail, _SpecDecodeMixin)."""
 
     def __init__(self, model, num_blocks: int = 512,
                  block_size: int = 16,

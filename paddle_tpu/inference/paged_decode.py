@@ -319,14 +319,17 @@ class _TPDecoderMixin:
         fully-manual tp execution: weights enter per the SpecLayout
         tree, pools sharded over the kv-head dim, everything else
         replicated. ``outs``: "tkv" for (tokens/logits, k, v) bodies,
-        "kv" for no-sample chunk bodies. The engine uses this to wrap
-        its sampling programs; generate() wraps the decoder's own."""
+        "takv" for the speculative verify body (tokens, accepted-mask,
+        k, v — both small outputs replicated), "kv" for no-sample
+        chunk bodies. The engine uses this to wrap its sampling
+        programs; generate() wraps the decoder's own."""
         from jax.sharding import PartitionSpec as P
         lay = self._layout()
         kv = lay.spec("cache_k")
         in_specs = (lay.spec_tree(self.weights), kv, kv) \
             + (P(),) * n_extra
-        out_specs = {"tkv": (P(), kv, kv), "kv": (kv, kv)}[outs]
+        out_specs = {"tkv": (P(), kv, kv), "takv": (P(), P(), kv, kv),
+                     "kv": (kv, kv)}[outs]
         return jax.shard_map(fn, mesh=self.mesh, in_specs=in_specs,
                              out_specs=out_specs, check_vma=False)
 
@@ -360,7 +363,71 @@ class _TPDecoderMixin:
             * self.head_dim
 
 
-class PagedLlamaDecoder(_TPDecoderMixin):
+class _SpecDecodeMixin:
+    """Speculative-decoding verification tail shared by the paged
+    decoders (ISSUE 9): the teacher logits at every draft position are
+    just the ordinary per-row outputs of ``_ragged_logits`` — a verify
+    window rides the ragged program as 1 + k extra rows of its column
+    (carried token at position ctx, drafts at ctx+1..ctx+k, each with
+    row_ctx = position + 1, so draft row i sees the context plus
+    drafts 0..i-1, exactly the visibility the prefill-chunk rows
+    already use). What the ragged program does NOT have is acceptance:
+    this mixin computes the longest-accepted-prefix IN-PROGRAM and
+    neutralizes the rejected tail's pool writes, so only [W] tokens and
+    a [W] accepted mask ever cross the host boundary."""
+
+    def _spec_accept(self, k_pool, v_pool, toks, draft_ids, slots,
+                     seg_start, is_draft, scratch_slot: int):
+        """In-program longest-accepted-prefix acceptance + rejected-
+        tail KV neutralization, appended to the verify forward.
+
+        toks [W]: this ministep's sampled per-row tokens (draft row
+        r's token is the teacher's verification output for the
+        position AFTER its draft). draft_ids [W]: each draft row's
+        proposed token (engine-provided schedule data; non-draft rows
+        hold don't-care). slots [W]: each row's flat pool slot.
+        seg_start [W]: the row index of the row's column BASE (the
+        carried-token row; a column's rows are contiguous, so the
+        accepted prefix is a cumulative AND over (seg_start, r]).
+        is_draft [W]: marks draft rows. scratch_slot: static.
+
+        Acceptance: draft row r is accepted iff every draft in its
+        column up to and including r matched the previous row's
+        teacher token. Exact for greedy — each accepted token IS the
+        teacher's argmax under a verified prefix.
+
+        Neutralization: rejected draft rows already wrote K/V into
+        their real slots during the forward (their keys must be
+        visible to LATER draft rows — that is what verification
+        conditions on). After acceptance, one zero-scatter per layer
+        re-targets every row at either its own slot (rejected — junk
+        zeroed) or the scratch slot (accepted / non-draft — the write
+        lands in the /dev/null page, the PR-4/5 preemption mechanism).
+        The host-side rollback (PagedKVCache.rollback) then rescinds
+        the rejected slots so future extends re-issue them; the pool
+        holds no trace of a rejected draft either way. Adds ZERO
+        collectives under tp: toks are post-gather (replicated), the
+        compare/cumsum is replicated, and each shard zero-scatters
+        only its own kv-head slice."""
+        from ..ops.paged_attention import reshape_and_cache
+        ok = jnp.where(is_draft, jnp.roll(toks, 1) == draft_ids, False)
+        bad = (is_draft & ~ok).astype(jnp.int32)
+        cb = jnp.cumsum(bad)
+        accepted = is_draft & ((cb - jnp.take(cb, seg_start)) == 0)
+        tgt = jnp.where(is_draft & ~accepted, slots,
+                        jnp.int32(scratch_slot))
+        w = toks.shape[0]
+        kvh, hd = k_pool[0].shape[1], k_pool[0].shape[3]
+        zeros = jnp.zeros((w, kvh, hd), k_pool[0].dtype)
+        k_pool = list(k_pool)
+        v_pool = list(v_pool)
+        for li in range(len(k_pool)):
+            k_pool[li], v_pool[li] = reshape_and_cache(
+                zeros, zeros, k_pool[li], v_pool[li], tgt)
+        return accepted, k_pool, v_pool
+
+
+class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
     """Batched paged-KV generation for a LlamaForCausalLM."""
 
     def __init__(self, model, num_blocks: int = 512, block_size: int = 16,

@@ -90,6 +90,26 @@ per-request fault and the pool invariant holds through every recovery.
 - chaos: utils/chaos.ChaosMonkey injects seeded allocator OOMs,
   dispatch/collect faults and latency spikes at the sanctioned hooks;
   tools/chaos_serving.py gates token-identity under fault schedules.
+
+Speculative decoding (ISSUE 9; spec_decode=SpecConfig(...)): a host
+drafter (n-gram/prompt-lookup by default; any Drafter plugs in)
+proposes k continuation tokens per greedy decode column, which ride as
+EXTRA ROWS of the ragged program — carried token at position ctx,
+drafts at ctx+1..ctx+k, each with row_ctx = position + 1, the exact
+visibility contract prefill-chunk rows already use. One forward gives
+the teacher's token at every position; the decoder's _spec_accept
+computes the longest-accepted-prefix IN-program and neutralizes
+rejected rows' pool writes via the scratch slot; the host delivers
+1..k+1 tokens per column per dispatch and rolls the allocator back
+past them (PagedKVCache.rollback). Greedy outputs are bit-identical to
+spec-off — every emitted token is the teacher's own argmax under a
+verified prefix. Verify chunks are synchronous (acceptance decides the
+next schedule); draft rows compete with prefill chunks under the
+per-step row budget; rich-sampling columns pause drafting. All PR-4
+invariants hold with drafts in flight: a mid-window preemption blanks
+the victim's rows through the staleness sweep, epoch guards drop a
+previous life's verify results, and dispatch/collect retries re-issue
+the same program.
 """
 from __future__ import annotations
 
@@ -107,9 +127,10 @@ import jax.numpy as jnp
 from ..framework.core import Tensor
 from ..ops.paged_attention import KVCacheExhausted
 from .paged_decode import PagedLlamaDecoder
+from .spec_decode import SpecConfig
 
 __all__ = ["EngineOverloaded", "SamplingParams", "Request",
-           "ServingEngine"]
+           "ServingEngine", "SpecConfig"]
 
 
 class EngineOverloaded(RuntimeError):
@@ -287,7 +308,8 @@ class ServingEngine:
                  admission: str = "worst_case",
                  max_queue_depth: Optional[int] = None,
                  ragged: bool = False, tp: int = 1,
-                 tp_comm: Optional[str] = None):
+                 tp_comm: Optional[str] = None,
+                 spec_decode: Optional[SpecConfig] = None):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -443,10 +465,20 @@ class ServingEngine:
         self.shed_requests = 0
         self.retries = 0
         # device-program launch count (every successful "dispatch:*"
-        # _device_call — prefill, decode, merge, ragged); with
+        # _device_call — prefill, decode, merge, ragged, spec); with
         # generated_tokens it yields tokens_per_dispatch, the headline
-        # the ragged path optimizes (reset by clear_finished)
+        # the ragged path optimizes and speculative decoding multiplies
+        # (accepted draft tokens are generated_tokens too, so the
+        # metric reflects the win; reset by clear_finished)
         self.device_dispatches = 0
+        # speculative-decoding counters (ISSUE 9; reset by
+        # clear_finished): drafted = draft rows dispatched for
+        # verification, accepted = drafts confirmed by the teacher,
+        # spec_rollbacks = verify steps that rejected >= 1 draft (each
+        # costs one PagedKVCache.rollback of the rejected tail)
+        self.drafted_tokens = 0
+        self.accepted_draft_tokens = 0
+        self.spec_rollbacks = 0
         # optional chaos monkey (utils/chaos.py ChaosMonkey.attach):
         # consulted by _device_call before every dispatch/fetch
         self.chaos = None
@@ -643,6 +675,33 @@ class ServingEngine:
             # the tp serving step IS the sharded ragged program; the
             # dense per-phase dispatch path is not built for shard_map
             self.ragged = True
+        # -- speculative decoding (ISSUE 9) ---------------------------------
+        # spec_decode=SpecConfig(...): each greedy decode column's k
+        # draft tokens ride as EXTRA ROWS of the ragged program (the
+        # mechanism prefill-chunk rows already use) and are verified
+        # in-program — teacher logits at every draft position in ONE
+        # forward, longest-accepted-prefix acceptance, rejected tails'
+        # pool writes neutralized via the scratch page and their slots
+        # rescinded by PagedKVCache.rollback. Up to draft_len + 1
+        # verified tokens per column per dispatch; greedy outputs are
+        # BIT-IDENTICAL to the spec-off path (each emitted token is
+        # the teacher's own argmax under a verified prefix). Forces
+        # the ragged path: the verify window IS a ragged row pattern.
+        self.spec = spec_decode
+        self._drafter = None
+        if self.spec is not None:
+            if not isinstance(self.spec, SpecConfig):
+                raise TypeError(
+                    f"spec_decode must be a SpecConfig, got "
+                    f"{type(self.spec).__name__}")
+            if not (hasattr(dec, "_ragged_logits")
+                    and hasattr(dec, "_spec_accept")):
+                raise ValueError(
+                    "speculative decoding needs a decoder with the "
+                    "ragged step program and the verification tail "
+                    "(_ragged_logits + _spec_accept)")
+            self.ragged = True
+            self._drafter = self.spec.make_drafter()
         # prefill tokens folded into one ragged dispatch (the ragged
         # path is always chunked-style — a long prompt spreads over
         # successive steps' programs under this per-step cap)
@@ -733,6 +792,52 @@ class ServingEngine:
                                          donate_argnums=(1, 2))
                 self._ragged_rich_j = jax.jit(ragged_chunk_rich,
                                               donate_argnums=(1, 2))
+
+            if self.spec is not None:
+                scratch = self._scratch_slot
+
+                def spec_chunk(weights, k, v, override, use_ov, ids,
+                               pos, slots, rseq, rctx, tables, temps,
+                               key, seg_start, is_draft):
+                    """ONE speculative verify+decode ministep over a
+                    ragged [W] row batch: each decode column's carried
+                    token plus its k draft rows at consecutive
+                    positions (drafts condition on each other through
+                    the pool — write-before-attend + row_ctx, the
+                    prefill-chunk mechanism), prefill rows riding
+                    along as usual. Per-row sampling gives the
+                    teacher's token at every position in one forward;
+                    the decoder's _spec_accept computes the
+                    longest-accepted-prefix mask in-program and
+                    neutralizes rejected rows' pool writes via the
+                    scratch slot. No scan: acceptance decides the next
+                    input token, so a verify chunk is one ministep and
+                    the host schedules the next from collected truth.
+                    """
+                    ids_in = jnp.where(use_ov, override, ids)
+                    logits, k, v = dec._ragged_logits(
+                        weights, k, v, ids_in, pos, slots, rseq, rctx,
+                        tables)
+                    toks = self._sample(logits, temps, key)
+                    acc, k, v = dec._spec_accept(
+                        k, v, toks, ids, slots, seg_start, is_draft,
+                        scratch)
+                    return toks, acc, k, v
+
+                if self.tp > 1:
+                    # verification must stay one-allreduce-per-block:
+                    # _spec_accept compares post-gather (replicated)
+                    # tokens and zero-scatters per-shard kv-head
+                    # slices, so the sharded verify program has
+                    # EXACTLY the T=1 ragged program's collectives
+                    # (pinned by comm_audit serving.ragged_spec_tp2)
+                    self._spec_j = jax.jit(
+                        dec.tp_wrap(spec_chunk, n_extra=12,
+                                    outs="takv"),
+                        donate_argnums=(1, 2))
+                else:
+                    self._spec_j = jax.jit(spec_chunk,
+                                           donate_argnums=(1, 2))
 
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
@@ -1949,19 +2054,338 @@ class ServingEngine:
         return T, dcols, takes
 
     def _dispatch_ragged(self) -> bool:
-        """Dispatch this step's ragged work: ONE unified chunk in the
-        steady mixed regime; a pure-prefill backlog (no running
-        decodes — cold start, burst admission) keeps issuing bounded
-        prefill-only chunks until nothing is ready, mirroring the
-        dense idle path's unbudgeted _dispatch_prefill (each program
-        is dispatched before the next is built, so a splice reader's
-        same-step chunks still follow its writer's in device order)."""
+        """Dispatch this step's ragged work: the speculative verify
+        chunk when drafting applies (ISSUE 9 — greedy decode columns
+        with draft hits), else ONE unified chunk in the steady mixed
+        regime; a pure-prefill backlog (no running decodes — cold
+        start, burst admission) keeps issuing bounded prefill-only
+        chunks until nothing is ready, mirroring the dense idle path's
+        unbudgeted _dispatch_prefill (each program is dispatched
+        before the next is built, so a splice reader's same-step
+        chunks still follow its writer's in device order)."""
+        if self._dispatch_spec_chunk():
+            return True
         if not self._dispatch_ragged_chunk():
             return False
         while (not any(r is not None and r.state == "running"
                        for r in self._slots)
                and self._dispatch_ragged_chunk()):
             pass
+        return True
+
+    # -- speculative decoding (ISSUE 9) --------------------------------------
+    def _spec_probe(self) -> bool:
+        """Would ANY running greedy column draft right now, judged on
+        the possibly-stale (in-flight-chunk-lagged) history? Pure host
+        work — used to decide whether a pipeline flush is worth
+        paying; windows are never built from this, only from flushed
+        truth in _dispatch_spec_chunk."""
+        for r in self._slots:
+            if (r is None or r.state != "running"
+                    or r.sampling.temperature > 0.0
+                    or r.sampling.needs_rich_sampling):
+                continue
+            left = r.sampling.max_new_tokens - r.planned
+            if left <= 1:
+                continue
+            hist = np.concatenate(
+                [r.prompt, np.asarray(r.out_tokens, np.int32)])
+            if np.asarray(self._drafter.propose(
+                    hist, min(self.spec.draft_len, left - 1))).size:
+                return True
+        return False
+
+    def _dispatch_spec_chunk(self) -> bool:
+        """Dispatch ONE speculative verify+decode chunk: every greedy
+        running column rides as 1 + k ragged rows (its carried token
+        plus the drafter's k proposals at consecutive positions), the
+        teacher verifies all positions in a single forward, and
+        acceptance/neutralization happen in-program (_spec_accept).
+        Prefill-chunk rows ride along under what is left of the
+        per-step row budget after the draft fan-out. Returns False —
+        the caller falls back to the plain ragged chunk — when spec is
+        off, any slotted request needs rich sampling (its per-column
+        seen-mask semantics don't compose with multi-row columns), no
+        column is running, or the drafter proposed nothing this step
+        (a 1-ministep chunk with no drafts is strictly worse than the
+        T-ministep ragged program).
+
+        The verify chunk is SYNCHRONOUS by construction (step()
+        collects it before returning): the accepted count decides the
+        next step's positions, slots and drafts, so there is nothing
+        correct to pipeline behind it. The flush below also makes the
+        drafter's history exact — an in-flight chunk's tokens are
+        device-side and drafting against stale history would verify
+        the wrong positions."""
+        if self.spec is None:
+            return False
+        if any(r is not None and r.sampling.needs_rich_sampling
+               for r in self._slots):
+            return False
+        if not any(r is not None and r.state == "running"
+                   for r in self._slots):
+            return False
+        # cheap probe on the CURRENT (at most one-chunk-stale) history
+        # BEFORE paying the pipeline flush: on a low-hit workload the
+        # drafter misses every step, and flushing first would disable
+        # the ragged path's overlap permanently. A probe hit flushes
+        # and re-proposes against exact history (a window is only ever
+        # BUILT from flushed truth); a probe miss that exact history
+        # would have hit merely delays spec by one step.
+        if self._inflight and not self._spec_probe():
+            return False
+        while self._inflight:
+            self._collect_oldest()
+        t0 = time.perf_counter()
+        cache = self.dec.cache
+        mp = self.dec.max_pages
+        dcols: List[Tuple[int, Request, np.ndarray]] = []
+        total_drafts = 0
+        for si in range(self.max_b):
+            req = self._slots[si]
+            if req is None or req.state != "running":
+                continue
+            left = req.sampling.max_new_tokens - req.planned
+            if left <= 0:
+                continue
+            drafts = np.zeros(0, np.int32)
+            if req.sampling.temperature <= 0.0 and left > 1:
+                # drafts clamp to the window AND the remaining budget
+                # (re-clipped after propose: a Drafter that ignores
+                # its k contract must not inflate the verify window or
+                # starve the prefill row budget): a draft past either
+                # bound could never be delivered — pure row waste
+                k = min(self.spec.draft_len, left - 1)
+                hist = np.concatenate(
+                    [req.prompt, np.asarray(req.out_tokens, np.int32)])
+                drafts = np.asarray(
+                    self._drafter.propose(hist, k),
+                    np.int32).reshape(-1)[:k]
+            dcols.append((si, req, drafts))
+            total_drafts += len(drafts)
+        if total_drafts == 0:
+            self.time_host_s += time.perf_counter() - t0
+            return False
+        # draft rows COMPETE with prefill chunks under the per-step
+        # row budget: both are extra rows of the same program, and the
+        # budget is the bound on the running streams' added ITL
+        budget = max(0, self._ragged_cap - total_drafts)
+        takes: List[Tuple[Request, int]] = []
+        pending = sorted((r for r in self._slots
+                          if r is not None and r.state == "prefilling"
+                          and r.prefill_sent < r.suffix_len),
+                         key=lambda r: r.req_id)
+        for r in pending:
+            if budget <= 0:
+                break
+            if not self._deps_ready(r):
+                continue
+            take = min(budget, r.suffix_len - r.prefill_sent)
+            takes.append((r, take))
+            budget -= take
+
+        rows = sum(1 + len(d) for _, _, d in dcols) \
+            + sum(t for _, t in takes)
+        W = self._ragged_width(rows)
+        scratch_row = self.max_b
+        ids = np.zeros(W, np.int32)
+        pos = np.zeros(W, np.int32)
+        slots = np.full(W, self._scratch_slot, np.int32)
+        rseq = np.full(W, scratch_row, np.int32)
+        rctx = np.zeros(W, np.int32)
+        use_ov = np.zeros(W, bool)
+        override = np.zeros(W, np.int32)
+        temps = np.zeros(W, np.float32)
+        seg_start = np.arange(W, dtype=np.int32)
+        is_draft = np.zeros(W, bool)
+        rows_of: Dict[int, List[int]] = {}       # req_id -> rows
+        sched: Dict[int, Tuple[Request, int]] = {}
+        spec_of: Dict[int, dict] = {}            # slot -> verify window
+        finals: List[Tuple[Request, int, int]] = []
+        take_of: Dict[int, int] = {}
+        col = 0
+        for si, req, drafts in dcols:
+            if self._slots[si] is not req or req.state != "running":
+                continue   # evicted by an earlier column's KV pressure
+            base, span = col, 1 + len(drafts)
+            col += span    # the run stays reserved even if preempted
+            cells = rows_of.setdefault(req.req_id, [])
+            # pre-register (like the ragged chunk): when req becomes
+            # its own victim mid-extend the staleness sweep must see
+            # it to blank its partial rows
+            sched[req.req_id] = (req, req.epoch)
+            ctx0 = cache.context_len(req.req_id)
+            # table length BEFORE the window's extends: rollback may
+            # drop only blocks the window itself appended — a
+            # worst-case admission reservation must survive intact
+            tbl0 = len(cache.seq_blocks(req.req_id))
+            done = 0
+            try:
+                for j in range(span):
+                    c = base + j
+                    p = ctx0 + j
+                    slot = self._extend_with_preempt(req)
+                    slots[c] = slot
+                    pos[c] = p
+                    rctx[c] = p + 1   # sees context + earlier drafts
+                    rseq[c] = si
+                    cells.append(c)
+                    if j == 0:
+                        # the carried token always comes from the host
+                        # here: the pipeline was flushed above, so the
+                        # last emitted token is host-known by def.
+                        use_ov[c] = True
+                        override[c] = self._last_tok[si]
+                    else:
+                        ids[c] = int(drafts[j - 1])
+                        is_draft[c] = True
+                        seg_start[c] = base
+                    done += 1
+            except KVCacheExhausted:
+                # no preemption victim left for the window's tail.
+                # With the BASE row scheduled, degrade gracefully:
+                # truncate the window to the rows the pool granted (a
+                # k=0 window is a plain decode row) — self-preempting
+                # here would replay the identical oversized window on
+                # resume and livelock under exactly the pressure that
+                # made the pool refuse. Only a base row that cannot
+                # extend at all preempts (or fails, on a
+                # recompute-incapable decoder), like the ragged path.
+                if done == 0:
+                    if self._can_recompute:
+                        self._preempt(req)
+                    else:
+                        self._fail_request(
+                            req, "KV pool exhausted and decoder does "
+                                 "not support "
+                                 "preemption-with-recompute")
+                    continue
+            # per-row temperature over the whole window: a draftable
+            # column is greedy (temp <= 0) by construction, but a
+            # plain-temperature stochastic column rides as a 1-row
+            # window and must keep SAMPLING (its stream is not pinned
+            # across spec on/off — the key consumption differs — but
+            # it must stay a sample, not silently turn greedy)
+            temps[base:base + done] = req.sampling.temperature
+            # collection needs only the window geometry: acceptance is
+            # read off the program's in-program mask (the draft values
+            # already live in the dispatched ids schedule)
+            spec_of[si] = {"req": req, "epoch": req.epoch,
+                           "base": base, "k": done - 1,
+                           "ctx0": ctx0, "tbl0": tbl0}
+        # prefill rows after the verify windows. Every row is its own
+        # column at T=1, so the ragged chunk's one-sampling-final-per-
+        # column constraint is satisfied for free; rich finals cannot
+        # appear (spec pauses while any slotted request is rich).
+        pi = col
+        for req, take in takes:
+            if req.state != "prefilling" or req.slot is None:
+                continue   # evicted by decode-side pressure mid-build
+            si = req.slot
+            toks_src = req.prefill_tokens
+            base_off = req.n_cached + req.prefill_sent
+            cells = rows_of.setdefault(req.req_id, [])
+            sched[req.req_id] = (req, req.epoch)
+            scheduled = 0
+            try:
+                for j in range(take):
+                    if pi >= W:
+                        break
+                    off = base_off + j
+                    c = pi
+                    slot = self._extend_with_preempt(req)
+                    ids[c] = int(toks_src[off])
+                    pos[c] = off
+                    rctx[c] = off + 1
+                    slots[c] = slot
+                    rseq[c] = si
+                    cells.append(c)
+                    scheduled += 1
+                    pi += 1
+                    if not req.resume and off + 1 == len(toks_src):
+                        temps[c] = req.sampling.temperature
+                        finals.append((req, req.epoch, c))
+            except KVCacheExhausted as e:
+                self._fail_request(
+                    req, f"KV pool exhausted mid-prefill with no "
+                         f"preemption victim: {e}")
+                continue
+            if scheduled:
+                take_of[req.req_id] = scheduled
+
+        # staleness sweep (the ragged chunk's, at one ministep): blank
+        # every row of every request that lost its life mid-build
+        def blank(cell_list):
+            for c in cell_list:
+                ids[c] = 0
+                pos[c] = 0
+                slots[c] = self._scratch_slot
+                rseq[c] = scratch_row
+                rctx[c] = 0
+                temps[c] = 0.0
+                use_ov[c] = False
+                override[c] = 0
+                is_draft[c] = False
+                seg_start[c] = c
+
+        for rid in list(sched):
+            req, epoch = sched[rid]
+            if (req.epoch == epoch and req.slot is not None
+                    and req.state in ("running", "prefilling")):
+                continue
+            blank(rows_of.get(rid, []))
+            for vsi in [s for s, ent in spec_of.items()
+                        if ent["req"] is req]:
+                del spec_of[vsi]
+            take_of.pop(rid, None)
+            finals[:] = [f for f in finals if f[0] is not req]
+            del sched[rid]
+        if not sched:
+            self.time_host_s += time.perf_counter() - t0
+            return False
+
+        tables = np.full((self.max_b + 1, mp), self._scratch_block,
+                         np.int32)
+        for rid, (req, epoch) in sched.items():
+            tables[req.slot] = cache.block_table(req.req_id, mp)
+        self._fresh_slots.clear()
+
+        key = self._replicated(self._next_key())
+        aj = self._aj
+        args = (self.dec.weights, cache.k, cache.v, aj(override),
+                aj(use_ov), aj(ids), aj(pos), aj(slots), aj(rseq),
+                aj(rctx), aj(tables), aj(temps), key, aj(seg_start),
+                aj(is_draft))
+        try:
+            toks, acc, cache.k, cache.v = self._device_call(
+                "dispatch:spec", self._spec_j, *args)
+        except _DispatchFailed as e:
+            # one program: every surviving request riding it fails
+            # together (the ragged chunk's failure contract)
+            for rid, (req, epoch) in sched.items():
+                if req.epoch == epoch and req.state in ("running",
+                                                        "prefilling"):
+                    self._fail_request(
+                        req, f"spec dispatch failed after retries: "
+                             f"{e}")
+            self.time_host_s += time.perf_counter() - t0
+            return False
+
+        for rid, (req, epoch) in sched.items():
+            take = take_of.get(rid, 0)
+            if take and req.state == "prefilling":
+                req.prefill_sent += take
+                if req.prefill_sent >= req.suffix_len:
+                    if req.resume:
+                        self._resume_complete(req)
+                    else:
+                        self._clear_pending_writes(req)
+        self._inflight.append({
+            "kind": "spec", "toks": toks, "acc": acc, "W": W,
+            "spec": spec_of, "finals": list(finals),
+            "real_rows": sum(take_of.values()),
+            "free_after": []})
+        self.time_host_s += time.perf_counter() - t0
         return True
 
     def _dispatch_ragged_chunk(self) -> bool:
@@ -2379,6 +2803,115 @@ class ServingEngine:
         for rid in ch["free_after"]:
             self.dec.cache.free(rid)
 
+    def _collect_spec(self, ch):
+        """Fetch and process one speculative verify chunk: per verify
+        window, count the accepted prefix off the in-program mask,
+        deliver accepted drafts + the bonus token (EOS / budget cut
+        mid-window like any decode chunk), and ROLL the allocator BACK
+        past the delivered tokens — the rejected tail's slots return
+        so the next extend re-issues and overwrites them. Final
+        prefill rows deliver their first token exactly like the ragged
+        chunk's."""
+        t0 = time.perf_counter()
+        cache = self.dec.cache
+        try:
+            # the spec pipeline's designed blocking point (sync by
+            # construction — acceptance decides the next schedule);
+            # one batched fetch for tokens + accepted mask
+            fetched = self._device_call(  # flightcheck: disable=FC301
+                "collect:spec", jax.device_get, [ch["toks"], ch["acc"]])
+        except _DispatchFailed as e:
+            self.time_stall_s += time.perf_counter() - t0
+            for si, ent in ch["spec"].items():
+                req = ent["req"]
+                if req.state == "running" \
+                        and req.epoch == ent["epoch"] \
+                        and self._slots[si] is req:
+                    self._fail_request(
+                        req, f"spec collection failed after retries: "
+                             f"{e}")
+            for req, epoch, _ in ch["finals"]:
+                if req.state == "prefilling" and req.epoch == epoch:
+                    self._fail_request(
+                        req, f"prefill collection failed after "
+                             f"retries: {e}")
+            for rid in ch["free_after"]:
+                cache.free(rid)
+            return
+        toks = np.asarray(fetched[0])
+        acc = np.asarray(fetched[1])
+        self.time_stall_s += time.perf_counter() - t0
+        now = time.perf_counter()
+        self.decode_steps += 1
+        self.decode_slot_steps += ch["W"]
+        self.decode_useful_tokens += ch["real_rows"]
+        for si, ent in ch["spec"].items():
+            req = ent["req"]
+            if req.state != "running" or req.epoch != ent["epoch"] \
+                    or self._slots[si] is not req:
+                continue   # retired/preempted while the chunk flew
+            base, k, ctx0 = ent["base"], ent["k"], ent["ctx0"]
+            m = 0
+            while m < k and acc[base + 1 + m]:
+                m += 1
+            self.drafted_tokens += k
+            self.accepted_draft_tokens += m
+            if m < k:
+                self.spec_rollbacks += 1
+            delivered = 0
+            for j in range(m + 1):
+                tok = int(toks[base + j])
+                req.out_tokens.append(tok)
+                delivered += 1
+                self.generated_tokens += 1
+                self._last_tok[si] = tok
+                if self._is_finished(req):
+                    break      # EOS cut mid-draft-window
+            self.decode_useful_tokens += delivered
+            # sync collection: with nothing in flight, dispatched ==
+            # delivered is the planned invariant (the window's
+            # rejected remainder was never "planned work")
+            req.planned = len(req.out_tokens)
+            if delivered:
+                if req.t_last_emit is not None:
+                    itl = (now - req.t_last_emit) / delivered
+                    req.itls.extend([itl] * delivered)
+                req.t_last_emit = now
+            if self._drafter is not None and k:
+                self._drafter.observe(
+                    np.concatenate(
+                        [req.prompt,
+                         np.asarray(req.out_tokens, np.int32)]),
+                    m, k)
+            if self._is_finished(req) and self._slots[si] is req:
+                self._retire(si)
+            else:
+                # position/KV rollback: context length snaps to
+                # exactly the KV the delivered prefix wrote (the
+                # bonus token's KV is NOT written — it is the next
+                # step's input like any freshly sampled token).
+                # min_blocks: only blocks the window's own extends
+                # appended may drop — never the admission reservation
+                cache.rollback(req.req_id, ctx0 + delivered,
+                               min_blocks=ent["tbl0"])
+        for req, epoch, c in ch["finals"]:
+            if req.state != "prefilling" or req.epoch != epoch:
+                continue
+            si = req.slot
+            tok = int(toks[c])
+            req.state = "running"
+            req.t_first_token = now
+            req.t_last_emit = now
+            req.out_tokens.append(tok)
+            req.planned = 1
+            self.generated_tokens += 1
+            self._last_tok[si] = tok
+            self._fresh_slots.add(si)
+            if self._is_finished(req):
+                self._retire(si)
+        for rid in ch["free_after"]:
+            cache.free(rid)
+
     def _collect_oldest(self):
         """Fetch and process the oldest in-flight chunk — prefill or
         decode (the only host-blocking points of the engine). Mid
@@ -2388,6 +2921,9 @@ class ServingEngine:
         accounting (the chunk's wall interval is attributed evenly
         over the tokens it delivered to each request)."""
         ch = self._inflight.popleft()
+        if ch["kind"] == "spec":
+            self._collect_spec(ch)
+            return
         if ch["kind"] == "ragged":
             self._collect_ragged(ch)
             return
@@ -2532,8 +3068,14 @@ class ServingEngine:
         else:
             self._dispatch_prefill()
             dispatched = self._dispatch_chunk()
+        # a speculative verify chunk is always collected THIS step
+        # (depth 0): its accepted count decides the next schedule —
+        # positions, slots and drafts — so there is nothing correct
+        # to pipeline behind it
         depth = 1 if (dispatched and self.overlap
-                      and not self._rep_active()) else 0
+                      and not self._rep_active()
+                      and not any(e["kind"] == "spec"
+                                  for e in self._inflight)) else 0
         while len(self._inflight) > depth:
             # a RUN of leading prefill entries is fetched with one
             # batched device_get (one tunnel RTT per burst, not per
@@ -2748,6 +3290,9 @@ class ServingEngine:
         self.shed_requests = 0
         self.retries = 0
         self.device_dispatches = 0
+        self.drafted_tokens = 0
+        self.accepted_draft_tokens = 0
+        self.spec_rollbacks = 0
         self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
@@ -2826,11 +3371,22 @@ class ServingEngine:
             "time_host_s": self.time_host_s,
             # device-program launches and delivered tokens per launch —
             # the ragged path's headline: one program per step instead
-            # of merge + decode + N prefill dispatches
+            # of merge + decode + N prefill dispatches. Accepted draft
+            # tokens are generated_tokens like any other delivered
+            # token, so speculative decoding's win shows up here
+            # directly (a verify dispatch delivers up to draft_len + 1
+            # tokens per column).
             "device_dispatches": self.device_dispatches,
             "tokens_per_dispatch": (
                 self.generated_tokens / self.device_dispatches
                 if self.device_dispatches else 0.0),
+            # -- speculative decoding (reset by clear_finished) -------
+            "drafted_tokens": self.drafted_tokens,
+            "accepted_draft_tokens": self.accepted_draft_tokens,
+            "draft_acceptance_rate": (
+                self.accepted_draft_tokens / self.drafted_tokens
+                if self.drafted_tokens else 0.0),
+            "spec_rollbacks": self.spec_rollbacks,
             "decode_slot_steps": self.decode_slot_steps,
             # ragged-aware: on the ragged path slot_steps counts the
             # [T, W] grid actually dispatched (W sized by real rows)

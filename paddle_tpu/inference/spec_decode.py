@@ -1,0 +1,140 @@
+"""Speculative decoding for the serving engine (ISSUE 9).
+
+Decode is dispatch- and latency-bound exactly where the ragged
+one-program-per-step path (PR 5) and the tp mesh (PR 8) left it: one
+verified token per decode column per ministep, T sequential model
+forwards per chunk. Speculative decoding breaks the one-token-per-
+forward bound: a cheap DRAFTER proposes k continuation tokens per
+column, and the teacher model verifies all k+1 positions in ONE
+forward by riding them as extra rows of the existing ragged [T, W]
+program — the same mechanism prefill-chunk rows already use. Teacher
+logits at each draft position fall out of the ordinary per-row head
+matmul; longest-accepted-prefix acceptance turns up to k+1 tokens per
+column per dispatch (accepted drafts are exact for greedy: each
+emitted token is the teacher's own argmax given a verified prefix, so
+spec-on output is bit-identical to spec-off).
+
+This module is the DRAFTING half — pure host-side numpy, no device
+code: the ``Drafter`` interface, the n-gram / prompt-lookup reference
+drafter, and the ``SpecConfig`` the engine consumes
+(``ServingEngine(spec_decode=SpecConfig(...))``). The verification /
+acceptance / KV-rollback half lives in the engine and the decoders
+(serving._dispatch_spec_chunk, paged_decode._SpecDecodeMixin,
+ops.paged_attention.PagedKVCache.rollback).
+
+Drafting contract: ``propose(history, k)`` sees the request's full
+token history (prompt ++ generated so far) and returns up to ``k``
+proposed continuation tokens. It runs on the host between device
+programs, so it must be cheap relative to a model forward; it must be
+DETERMINISTIC in its inputs (the chaos harness replays schedules and
+demands token identity — a stochastic drafter would still be *correct*,
+since acceptance only ever admits teacher-verified tokens, but the
+fault-free replay could then take different verify windows). A small
+draft MODEL can slot in by wrapping its own generate loop in a
+Drafter; the engine does not care where proposals come from.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["Drafter", "NGramDrafter", "SpecConfig"]
+
+
+class Drafter:
+    """Pluggable draft-token source for speculative decoding.
+
+    Subclass and implement :meth:`propose`. The engine calls it once
+    per draftable decode column per serving step, AFTER the pipeline
+    has been flushed, so ``history`` is exact (never stale by an
+    in-flight chunk)."""
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        """Up to ``k`` proposed continuation tokens for a request whose
+        prompt ++ generated tokens are ``history`` ([n] int32). May
+        return fewer (including zero — the engine then decodes that
+        column normally this step). Must not mutate ``history``."""
+        raise NotImplementedError
+
+    def observe(self, history: np.ndarray, accepted: int,
+                drafted: int) -> None:
+        """Optional feedback hook: called after each verify step with
+        the number of drafts accepted — adaptive drafters can tune
+        their window. The default drafter ignores it."""
+
+
+class NGramDrafter(Drafter):
+    """N-gram / prompt-lookup drafting (the PLD scheme): match the
+    history's trailing n-gram against an EARLIER occurrence in the
+    history itself and propose the tokens that followed it. Zero model
+    cost, and exactly the drafter that wins on repetitive / templated
+    traffic (summarization, code edit, retrieval-grounded generation —
+    anything whose output re-walks its own context).
+
+    Longest-match-first: n runs from ``max_ngram`` down to
+    ``min_ngram``; among equal-n matches the EARLIEST occurrence wins —
+    it has the longest continuation ahead of it (a recent match near
+    the end of a repeated run proposes only the run's last token),
+    and a deterministic tie-break keeps chaos replays identical."""
+
+    def __init__(self, max_ngram: int = 4, min_ngram: int = 1):
+        max_ngram = int(max_ngram)
+        min_ngram = int(min_ngram)
+        if not 1 <= min_ngram <= max_ngram:
+            raise ValueError(
+                f"need 1 <= min_ngram <= max_ngram, got "
+                f"min_ngram={min_ngram} max_ngram={max_ngram}")
+        self.max_ngram = max_ngram
+        self.min_ngram = min_ngram
+
+    def propose(self, history: np.ndarray, k: int) -> np.ndarray:
+        h = np.asarray(history, np.int32).reshape(-1)
+        empty = np.zeros(0, np.int32)
+        n_hi = min(self.max_ngram, h.size - 1)
+        if k <= 0 or n_hi < self.min_ngram:
+            return empty
+        for n in range(n_hi, self.min_ngram - 1, -1):
+            pat = h[h.size - n:]
+            # all length-n windows; the last window IS the pattern, so
+            # candidate starts are windows strictly before it
+            wins = np.lib.stride_tricks.sliding_window_view(h, n)
+            match = np.flatnonzero(
+                np.all(wins[:-1] == pat[None, :], axis=1))
+            if match.size:
+                i = int(match[0])      # earliest: longest continuation
+                cont = h[i + n:i + n + k]
+                if cont.size:
+                    return cont.astype(np.int32, copy=True)
+        return empty
+
+
+@dataclass
+class SpecConfig:
+    """Speculative-decoding knobs for ``ServingEngine(spec_decode=...)``.
+
+    draft_len: max draft tokens proposed per column per verify step —
+        the verify window. Each window costs 1 + draft_len ragged rows
+        in one forward and yields 1..draft_len+1 verified tokens, so
+        bigger windows pay off only at high acceptance (the engine
+        clamps to the request's remaining token budget either way).
+    max_ngram / min_ngram: the default NGramDrafter's match lengths
+        (ignored when ``drafter`` is supplied).
+    drafter: a custom Drafter instance; None builds an NGramDrafter.
+    """
+    draft_len: int = 8
+    max_ngram: int = 4
+    min_ngram: int = 1
+    drafter: Optional[Drafter] = None
+
+    def __post_init__(self):
+        self.draft_len = int(self.draft_len)
+        if self.draft_len < 1:
+            raise ValueError(
+                f"draft_len must be >= 1, got {self.draft_len}")
+
+    def make_drafter(self) -> Drafter:
+        if self.drafter is not None:
+            return self.drafter
+        return NGramDrafter(self.max_ngram, self.min_ngram)

@@ -111,7 +111,11 @@ def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
                   already in the pool, so a decode row passes ctx+1 and
                   chunk row j of a prefill at offset `off` passes
                   off+j+1 — that per-row bound IS the causal mask
-                  between same-sequence rows of one call)
+                  between same-sequence rows of one call; speculative
+                  DRAFT row i of a verify window rides the same
+                  contract at ctx+i+1, so it sees the context, the
+                  column's carried token, drafts 0..i-1 and itself —
+                  never a later draft)
     Rows with row_ctx <= 0 (grid padding) return exact zeros.
     Returns [total_rows, num_heads, head_dim].
     """
@@ -438,6 +442,53 @@ class PagedKVCache:
         self._lens[seq_id] = pos + 1
         block = blocks[pos // self.block_size]
         return block * self.block_size + pos % self.block_size
+
+    def rollback(self, seq_id: int, new_len: int,
+                 min_blocks: int = 0):
+        """Roll a live sequence's context length BACK to ``new_len`` —
+        the speculative-decoding unwind: slots handed out (via extend)
+        for draft tokens past the accepted prefix are rescinded, so the
+        next extend re-issues them and overwrites the rejected tail's
+        K/V. Slots between new_len and the old length are masked by
+        every reader until then (attention visibility is bounded by
+        context length), so the junk they hold is unreachable.
+
+        Blocks now WHOLLY past the new length leave the table (ref--;
+        at ref 0 they return straight to the free list, never the
+        cached-LRU — their content was never valid) and any hash
+        registration pointing at them is invalidated (a block that held
+        rejected drafts must not be spliceable). ``min_blocks`` FLOORS
+        the truncation: the caller passes the table length from before
+        its speculative extends, so only blocks those extends appended
+        are ever dropped — an up-front worst-case admission
+        reservation (whose tail the sequence has not reached yet) must
+        survive every rollback, or the "a running request can never
+        exhaust the pool" guarantee silently dies. Shared (ref > 1)
+        blocks cannot appear in the dropped tail in practice — splices
+        cover prompt prefixes, and speculative slots are past the whole
+        emitted history — but the ref discipline handles them anyway.
+        """
+        blocks = self._tables[seq_id]
+        cur = self._lens[seq_id]
+        new_len = int(new_len)
+        if not 0 <= new_len <= cur:
+            raise ValueError(
+                f"rollback(seq {seq_id}) to {new_len} outside "
+                f"[0, {cur}]")
+        keep = max(1, -(-new_len // self.block_size), int(min_blocks))
+        dropped = blocks[keep:]
+        del blocks[keep:]
+        self._lens[seq_id] = new_len
+        returned = []
+        for b in reversed(dropped):
+            self._ref[b] -= 1
+            if self._ref[b] == 0:
+                del self._ref[b]
+                h = self._hash_of.pop(b, None)
+                if h is not None:
+                    self._block_of.pop(h, None)
+                returned.append(b)
+        self._free.extend(returned)
 
     def free(self, seq_id: int):
         """Release a sequence: ref-- on each of its blocks; blocks

@@ -82,6 +82,12 @@ def _full_script(**overrides):
             "serving_bf16_c8_tok_per_sec", 289.0,
             {"serving_bf16_c8_tok_per_sec": 289.0,
              "serving_capacity_decode_tok_per_sec": 3398.0}), "")],
+        # serving_tp joined AUTO_MODES in the ISSUE-8 PR but was never
+        # scripted here, so every auto run "failed" the mode, burned a
+        # recalibration, and broke the two call-count assertions below
+        "serving_tp": [(_simple(
+            "serving_tp2_tok_per_sec", 119.0,
+            {"serving_tp2_tok_per_sec": 119.0}), "")],
         "pp": [(_simple("pp_remat_overhead_x", 0.991,
                         {"pp_remat_overhead_x": 0.991,
                          "pp_tick_fwd_ms": 0.086,

@@ -1,0 +1,518 @@
+"""Speculative decoding riding the ragged [T, W] program (ISSUE 9).
+
+Layers under test:
+- NGramDrafter (prompt-lookup): longest-match-first, earliest
+  occurrence, window clamps, empty-history/no-match behavior;
+- PagedKVCache.rollback: the rejected-tail unwind — context length
+  snaps back, wholly-dropped blocks return to the free list with
+  their hash registrations invalidated, pool invariant holds;
+- the ACCEPTANCE RULE against the dense path: spec-on (every verify
+  window, any drafter — perfect, adversarial, n-gram) must emit
+  greedy tokens BIT-IDENTICAL to the dense spec-off engine, because
+  every emitted token is the teacher's own argmax under a verified
+  prefix;
+- the greedy identity matrix: chunked prefill, prefix-cache splices,
+  EOS cut mid-draft-window, preemption-with-recompute mid-draft,
+  tp=2, and the GPT twin;
+- the dispatch win: >= 1.5x fewer device dispatches per delivered
+  token on a repetitive (high-acceptance) workload;
+- the stats surface: drafted_tokens / accepted_draft_tokens /
+  draft_acceptance_rate / spec_rollbacks, reset by clear_finished.
+
+PADDLE_TPU_POOL_DEBUG=1 (set by the invariant gate) makes every engine
+step here assert the pool invariant — including immediately after a
+speculative rollback.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference import (Drafter, NGramDrafter, SamplingParams,
+                                  ServingEngine, SpecConfig)
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+
+# ---------------------------------------------------------------------------
+# drafters
+# ---------------------------------------------------------------------------
+
+class OracleDrafter(Drafter):
+    """Proposes the TRUE continuation from a reference run — the
+    always-accepted upper bound, and the shape a small draft model
+    plugs into (the pluggable-interface satellite)."""
+
+    def __init__(self, refs):
+        # refs: list of (prompt array, full reference output list)
+        self.refs = [(np.asarray(p, np.int32), list(o)) for p, o in refs]
+
+    def propose(self, history, k):
+        h = np.asarray(history, np.int32)
+        for p, out in self.refs:
+            if h.size >= p.size and np.array_equal(h[:p.size], p):
+                done = h.size - p.size
+                return np.asarray(out[done:done + k], np.int32)
+        return np.zeros(0, np.int32)
+
+
+class WrongDrafter(Drafter):
+    """Adversarial: always proposes (token+1) mod vocab of a constant —
+    every draft is rejected, every verify step rolls back."""
+
+    def __init__(self, vocab, k=4):
+        self.vocab = vocab
+        self.k = k
+
+    def propose(self, history, k):
+        last = int(np.asarray(history)[-1])
+        return np.full(min(k, self.k), (last + 1) % self.vocab,
+                       np.int32)
+
+
+# ---------------------------------------------------------------------------
+# NGramDrafter unit tests
+# ---------------------------------------------------------------------------
+
+class TestNGramDrafter:
+    def test_repeated_motif_proposes_continuation(self):
+        d = NGramDrafter(max_ngram=3, min_ngram=1)
+        h = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+        # suffix [4, 1, 2] first occurs at index 3 -> continuation 3, 4, 1, 2
+        np.testing.assert_array_equal(d.propose(h, 4), [3, 4, 1, 2])
+
+    def test_earliest_match_gives_longest_continuation(self):
+        d = NGramDrafter(max_ngram=1, min_ngram=1)
+        # constant run: the EARLIEST 7 must win (a most-recent match
+        # would propose a single token)
+        h = [9, 7, 7, 7, 7, 7]
+        np.testing.assert_array_equal(d.propose(h, 8), [7, 7, 7, 7])
+
+    def test_longest_ngram_wins(self):
+        d = NGramDrafter(max_ngram=2, min_ngram=1)
+        # 2-gram [5, 6] matches at 0 -> continuation [8]; the 1-gram
+        # [6] would match index 1 too, but the longer match is tried
+        # first
+        h = [5, 6, 8, 5, 6]
+        np.testing.assert_array_equal(d.propose(h, 3), [8, 5, 6])
+
+    def test_no_match_and_short_history(self):
+        d = NGramDrafter(max_ngram=3, min_ngram=2)
+        assert d.propose([1, 2, 3, 4], 4).size == 0   # no repeat
+        assert d.propose([1], 4).size == 0            # too short
+        assert d.propose([1, 2, 1, 2], 0).size == 0   # k == 0
+
+    def test_k_clamp(self):
+        d = NGramDrafter(max_ngram=1, min_ngram=1)
+        h = [3, 1, 2, 3]
+        np.testing.assert_array_equal(d.propose(h, 2), [1, 2])
+
+    def test_config_validation(self):
+        with pytest.raises(ValueError):
+            SpecConfig(draft_len=0)
+        with pytest.raises(ValueError):
+            NGramDrafter(max_ngram=2, min_ngram=3)
+        assert isinstance(SpecConfig().make_drafter(), NGramDrafter)
+        custom = WrongDrafter(16)
+        assert SpecConfig(drafter=custom).make_drafter() is custom
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache.rollback unit tests
+# ---------------------------------------------------------------------------
+
+class TestRollback:
+    def _pool(self, num_blocks=8, bs=4):
+        from paddle_tpu.ops.paged_attention import PagedKVCache
+        return PagedKVCache(num_layers=1, num_blocks=num_blocks,
+                            block_size=bs, kv_heads=1, head_dim=4)
+
+    def test_rollback_frees_tail_blocks(self):
+        c = self._pool()
+        c.allocate(0, 4)
+        for _ in range(4):
+            c.extend(0)
+        free0 = c.free_blocks
+        for _ in range(9):          # spill into 3 more blocks
+            c.extend(0)
+        assert c.free_blocks == free0 - 3
+        c.rollback(0, 5)            # keep 2 blocks (ceil(5/4))
+        assert c.context_len(0) == 5
+        assert c.free_blocks == free0 - 1
+        c.debug_check()
+        # re-extend reuses the rescinded slot range
+        s = c.extend(0)
+        assert c.context_len(0) == 6
+        assert s == c.seq_blocks(0)[1] * c.block_size + 1
+        c.free(0)
+        c.debug_check()
+
+    def test_rollback_bounds_and_noop(self):
+        c = self._pool()
+        c.allocate(0, 4)
+        for _ in range(3):
+            c.extend(0)
+        with pytest.raises(ValueError):
+            c.rollback(0, 4)        # beyond current length
+        c.rollback(0, 3)            # no-op
+        assert c.context_len(0) == 3
+        c.debug_check()
+
+    def test_rollback_preserves_reservation_floor(self):
+        """Regression (review): a worst-case admission reserves the
+        whole prompt+max_new table up front — rollback with the
+        pre-window min_blocks floor must NEVER rescind that
+        reservation, only blocks the speculative extends appended."""
+        c = self._pool(num_blocks=8, bs=4)
+        c.allocate(0, 16)               # 4-block up-front reservation
+        free0 = c.free_blocks
+        for _ in range(6):
+            c.extend(0)
+        tbl0 = len(c.seq_blocks(0))
+        assert tbl0 == 4                # still inside the reservation
+        c.rollback(0, 5, min_blocks=tbl0)
+        assert len(c.seq_blocks(0)) == 4   # reservation intact
+        assert c.free_blocks == free0
+        c.debug_check()
+        # without the floor the same rollback WOULD truncate
+        c.rollback(0, 5)
+        assert len(c.seq_blocks(0)) == 2
+        c.debug_check()
+        c.free(0)
+
+    def test_rollback_unregisters_dropped_hashes(self):
+        c = self._pool(num_blocks=8, bs=4)
+        toks = np.arange(9, dtype=np.int32)     # 2 full blocks + 1
+        c.allocate_with_prefix(0, toks, 9)
+        for _ in range(9):
+            c.extend(0)
+        assert len(c._block_of) == 2
+        # roll back INTO the second hashed block: it leaves the table,
+        # so its registration (content no longer guaranteed once the
+        # slots are re-issued) must die with it
+        c.rollback(0, 2)
+        assert len(c._block_of) == 1
+        c.debug_check()
+        c.free(0)
+        c.debug_check()
+
+
+# ---------------------------------------------------------------------------
+# engine-level identity
+# ---------------------------------------------------------------------------
+
+def _engine(model, spec=None, *, ragged=True, blocks=96, bs=8,
+            max_b=4, chunk=4, **kw):
+    return ServingEngine(model, max_batch_size=max_b, num_blocks=blocks,
+                         block_size=bs, prompt_buckets=(16, 32, 64),
+                         chunk_size=chunk, ragged=ragged,
+                         spec_decode=spec, **kw)
+
+
+def _run(eng, prompts, max_new=40, sampling=None):
+    rids = [eng.add_request(
+        p, sampling[i] if sampling else
+        SamplingParams(max_new_tokens=max_new))
+        for i, p in enumerate(prompts)]
+    eng.run_to_completion()
+    return [eng.result(r).tolist() for r in rids]
+
+
+@pytest.fixture(scope="module")
+def tied_model():
+    cfg = llama_tiny(tie_word_embeddings=True)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return cfg, m
+
+
+@pytest.fixture(scope="module")
+def model():
+    cfg = llama_tiny()
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    return cfg, m
+
+
+def _prompts(cfg, lens, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, cfg.vocab_size, int(n)).astype(np.int32)
+            for n in lens]
+
+
+class TestAcceptanceRule:
+    """The acceptance rule against the DENSE path: whatever the
+    drafter proposes, spec-on greedy output must be bit-identical to
+    the dense (ragged=False, spec=off) engine — acceptance only ever
+    admits teacher-verified tokens."""
+
+    def test_oracle_drafter_identity(self, model):
+        cfg, m = model
+        prompts = _prompts(cfg, (12, 20, 30))
+        dense = _run(_engine(m, None, ragged=False), prompts)
+        oracle = OracleDrafter(list(zip(prompts, dense)))
+        eng = _engine(m, SpecConfig(draft_len=6, drafter=oracle))
+        assert _run(eng, prompts) == dense
+        st = eng.stats()
+        assert st["drafted_tokens"] > 0
+        assert st["accepted_draft_tokens"] == st["drafted_tokens"]
+        assert st["spec_rollbacks"] == 0
+
+    def test_adversarial_drafter_identity(self, model):
+        cfg, m = model
+        prompts = _prompts(cfg, (12, 20))
+        dense = _run(_engine(m, None, ragged=False), prompts)
+        eng = _engine(
+            m, SpecConfig(drafter=WrongDrafter(cfg.vocab_size)))
+        assert _run(eng, prompts) == dense
+        st = eng.stats()
+        assert st["drafted_tokens"] > 0
+        assert st["accepted_draft_tokens"] == 0
+        assert st["spec_rollbacks"] > 0       # every window rolled back
+
+    def test_ngram_drafter_identity(self, tied_model):
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (12, 20, 30))
+        dense = _run(_engine(m, None, ragged=False), prompts)
+        eng = _engine(m, SpecConfig(draft_len=8))
+        assert _run(eng, prompts) == dense
+        assert eng.stats()["accepted_draft_tokens"] > 0
+
+
+class TestSpecIdentityMatrix:
+    def test_chunked_prefill_mid_stream(self, tied_model):
+        """A long (chunked) prompt lands mid-stream while spec columns
+        run: prefill rows and draft rows share verify chunks."""
+        cfg, m = tied_model
+        shorts = _prompts(cfg, (12, 16))
+        longp = _prompts(cfg, (60,), seed=7)[0]
+
+        def run(spec):
+            eng = _engine(m, spec, prefill_chunk=16)
+            rids = [eng.add_request(p, SamplingParams(max_new_tokens=32))
+                    for p in shorts]
+            while eng.generated_tokens < 8:
+                eng.step()
+            rl = eng.add_request(longp,
+                                 SamplingParams(max_new_tokens=16))
+            eng.run_to_completion()
+            return [eng.result(r).tolist() for r in rids + [rl]]
+
+        assert run(SpecConfig(draft_len=6)) == run(None)
+
+    def test_prefix_splice(self, tied_model):
+        cfg, m = tied_model
+        rng = np.random.RandomState(3)
+        shared = rng.randint(0, cfg.vocab_size, 16).astype(np.int32)
+        prompts = [np.concatenate([shared, t]) for t in
+                   _prompts(cfg, (8, 12), seed=4)]
+
+        def run(spec):
+            eng = _engine(m, spec)
+            out = _run(eng, prompts, max_new=24)
+            assert eng.stats()["prefix_cache_hit_tokens"] > 0
+            return out
+
+        assert run(SpecConfig(draft_len=6)) == run(None)
+
+    def test_eos_cut_mid_draft_window(self, tied_model):
+        """EOS chosen to land INSIDE a verify window: the tail of the
+        window (accepted drafts included) must be discarded and the
+        pool rolled back consistently."""
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (12,))
+        ref = _run(_engine(m, None, ragged=False), prompts,
+                   max_new=24)[0]
+        eos = ref[10]          # mid-window for draft_len=8
+        sp = [SamplingParams(max_new_tokens=24, eos_token_id=eos)]
+        base = _run(_engine(m, None, ragged=False), prompts,
+                    sampling=sp)[0]
+        assert base[-1] == eos and len(base) < 24
+        eng = _engine(m, SpecConfig(draft_len=8))
+        assert _run(eng, prompts, sampling=sp)[0] == base
+
+    def test_preemption_recompute_mid_draft(self, tied_model):
+        """Tight optimistic pool: verify windows trigger preemption /
+        window truncation; greedy outputs must survive the
+        recompute-resume dance bit-identically."""
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (16, 16, 16))
+        base = _run(_engine(m, None, blocks=96), prompts, max_new=48)
+        eng = _engine(m, SpecConfig(draft_len=8), blocks=14,
+                      max_b=3, admission="optimistic",
+                      prefill_chunk=8)
+        assert _run(eng, prompts, max_new=48) == base
+        assert eng.preemptions > 0
+
+    def test_tp2_identity(self, tied_model):
+        cfg, m = tied_model
+        import jax
+        if len(jax.devices()) < 2:
+            pytest.skip("needs 2 devices")
+        prompts = _prompts(cfg, (12, 20))
+        base = _run(_engine(m, None), prompts)
+        eng = _engine(m, SpecConfig(draft_len=6), tp=2)
+        assert _run(eng, prompts) == base
+        st = eng.stats()
+        assert st["accepted_draft_tokens"] > 0
+
+    def test_gpt_twin(self):
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import PagedGPTDecoder
+        cfg = gpt_tiny()
+        paddle.seed(0)
+        m = GPTForCausalLM(cfg)
+        m.eval()
+        prompts = _prompts(cfg, (12, 20))
+
+        def run(spec):
+            dec = PagedGPTDecoder(m, num_blocks=96, block_size=8)
+            eng = ServingEngine(dec, max_batch_size=3,
+                                prompt_buckets=(16, 32), chunk_size=4,
+                                ragged=True, spec_decode=spec)
+            return _run(eng, prompts, max_new=32)
+
+        assert run(SpecConfig(draft_len=6)) == run(None)
+
+    def test_stochastic_column_keeps_sampling(self, model):
+        """A plain-temperature (non-rich) request sharing the batch
+        with a greedy spec column rides the verify program as a 1-row
+        window — and must keep SAMPLING at its own temperature, not
+        silently decode greedy."""
+        cfg, m = model
+        prompts = _prompts(cfg, (12, 12))
+        greedy_ref = _run(_engine(m, None, ragged=False), prompts,
+                          max_new=32)[1]
+        # drive the greedy column with an oracle so verify windows
+        # actually dispatch every step
+        dense = _run(_engine(m, None, ragged=False), prompts,
+                     max_new=32)
+        oracle = OracleDrafter([(prompts[0], dense[0])])
+        eng = _engine(m, SpecConfig(draft_len=6, drafter=oracle))
+        sp = [SamplingParams(max_new_tokens=32),
+              SamplingParams(max_new_tokens=32, temperature=1.0)]
+        out = _run(eng, prompts, sampling=sp)
+        assert eng.stats()["drafted_tokens"] > 0
+        assert out[0] == dense[0]           # greedy column identical
+        assert out[1] != greedy_ref         # stochastic stayed a sample
+
+    def test_mixed_rich_request_pauses_spec(self, tied_model):
+        """A rich-sampling request in the batch pauses drafting (its
+        seen-mask semantics don't compose with multi-row columns) but
+        everything still completes and the GREEDY streams stay
+        identical to the all-greedy spec-off run of the same mix."""
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (12, 16))
+        sp = [SamplingParams(max_new_tokens=24),
+              SamplingParams(max_new_tokens=24, temperature=1.0,
+                             top_k=1)]   # rich but deterministic
+
+        def run(spec):
+            eng = _engine(m, spec)
+            return _run(eng, prompts, sampling=sp), eng.stats()
+
+        off, _ = run(None)
+        on, st = run(SpecConfig(draft_len=6))
+        assert on == off
+        assert st["drafted_tokens"] == 0   # rich present -> spec paused
+
+
+class TestSchedulerContracts:
+    def test_worst_case_reservation_survives_rollback(self, tied_model):
+        """Regression (review): under worst_case admission, spec
+        rollbacks must not release reserved blocks — a queued third
+        request could otherwise admit into the reservation and force
+        the running request into preemption later."""
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (16, 16, 16))
+        # pool sized for exactly TWO worst-case requests (+1 scratch):
+        # 16 prompt + 48 new = 8 blocks each at bs=8
+        eng = _engine(m, SpecConfig(draft_len=8), blocks=17, bs=8,
+                      max_b=3)
+        out = _run(eng, prompts, max_new=48)
+        assert eng.preemptions == 0     # reservation never leaked
+        assert eng.stats()["accepted_draft_tokens"] > 0
+        base = _run(_engine(m, None, blocks=96), prompts, max_new=48)
+        assert out == base
+
+    def test_oversized_drafter_clipped_to_draft_len(self, model):
+        """Regression (review): a Drafter that ignores its k contract
+        must be clipped to draft_len — the verify window must not
+        inflate and starve the prefill row budget."""
+        cfg, m = model
+        prompts = _prompts(cfg, (12,))
+        dense = _run(_engine(m, None, ragged=False), prompts,
+                     max_new=30)
+        oracle = OracleDrafter(list(zip(prompts, dense)))
+
+        class Oversized(Drafter):
+            def propose(self, history, k):
+                return oracle.propose(history, 50)   # ignores k
+
+        eng = _engine(m, SpecConfig(draft_len=2, drafter=Oversized()))
+        n_spec = [0]
+        orig = eng._device_call
+
+        def spy(kind, fn, *a):
+            if kind == "dispatch:spec":
+                n_spec[0] += 1
+            return orig(kind, fn, *a)
+
+        eng._device_call = spy
+        assert _run(eng, prompts, max_new=30) == dense
+        # 30 tokens at <= 3 per verify window needs >= 9 windows; an
+        # unclipped drafter would deliver them in ~1-2 oversized ones
+        assert n_spec[0] >= 9
+        assert eng.stats()["drafted_tokens"] <= 2 * n_spec[0]
+
+
+class TestDispatchReduction:
+    def test_repetitive_workload_dispatch_win(self, tied_model):
+        """The acceptance bar: >= 1.5x fewer device dispatches per
+        delivered token on a repetitive (high n-gram acceptance)
+        workload."""
+        cfg, m = tied_model
+        prompts = _prompts(cfg, (16, 16, 16))
+
+        def run(spec):
+            eng = _engine(m, spec, blocks=128)
+            _run(eng, prompts, max_new=120)
+            st = eng.stats()
+            return (st["device_dispatches"]
+                    / max(st["generated_tokens"], 1), st)
+
+        dpt_off, _ = run(None)
+        dpt_on, st = run(SpecConfig(draft_len=8))
+        assert st["draft_acceptance_rate"] > 0.8
+        assert dpt_off / dpt_on >= 1.5, \
+            f"dispatches/token off={dpt_off:.4f} on={dpt_on:.4f}"
+
+
+class TestSpecStats:
+    def test_counters_and_reset(self, tied_model):
+        cfg, m = tied_model
+        eng = _engine(m, SpecConfig(draft_len=6))
+        _run(eng, _prompts(cfg, (12,)), max_new=32)
+        st = eng.stats()
+        assert st["drafted_tokens"] > 0
+        assert 0 < st["accepted_draft_tokens"] <= st["drafted_tokens"]
+        assert st["draft_acceptance_rate"] == pytest.approx(
+            st["accepted_draft_tokens"] / st["drafted_tokens"])
+        assert st["spec_rollbacks"] >= 0
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["drafted_tokens"] == 0
+        assert st["accepted_draft_tokens"] == 0
+        assert st["spec_rollbacks"] == 0
+        assert st["draft_acceptance_rate"] == 0.0
+
+    def test_spec_requires_ragged_capable_decoder(self, model):
+        cfg, m = model
+        eng = _engine(m, SpecConfig())
+        assert eng.ragged    # spec forces the ragged path
+        with pytest.raises(TypeError):
+            _engine(m, "not a config")

@@ -40,7 +40,10 @@ import numpy as np  # noqa: E402
 
 
 def build_engine(model, args):
-    from paddle_tpu.inference import ServingEngine
+    from paddle_tpu.inference import ServingEngine, SpecConfig
+    # getattr defaults: programmatic callers (the slow fault-tolerance
+    # test builds a bare Namespace) predate the --ragged/--tp/--spec
+    # flags and must keep running on the dense single-chip engine
     return ServingEngine(
         model, max_batch_size=3, num_blocks=args.num_blocks,
         block_size=8, prompt_buckets=(8, 16, 32), chunk_size=4,
@@ -48,8 +51,11 @@ def build_engine(model, args):
         admission="optimistic",
         max_dispatch_retries=args.retries,
         retry_backoff_s=0.0,
-        ragged=args.ragged or args.tp > 1,
-        tp=args.tp)
+        ragged=getattr(args, "ragged", False)
+        or getattr(args, "tp", 1) > 1,
+        tp=getattr(args, "tp", 1),
+        spec_decode=SpecConfig(draft_len=4)
+        if getattr(args, "spec", False) else None)
 
 
 def gen_workload(args):
@@ -171,10 +177,20 @@ def main() -> int:
                          "OOM-preemption, injected dispatch faults and "
                          "cancellation must stay token-identical under "
                          "sharding (implies the ragged path)")
+    ap.add_argument("--spec", action="store_true",
+                    help="exercise speculative decoding (ISSUE 9): "
+                         "both runs serve with "
+                         "spec_decode=SpecConfig(draft_len=4) — n-gram "
+                         "drafts ride the verify program through the "
+                         "whole fault schedule (OOM-preemption "
+                         "mid-window, injected dispatch/collect "
+                         "faults, cancellation) and surviving outputs "
+                         "must stay token-identical (implies ragged)")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
-                         "actually happened")
+                         "actually happened (with --spec, also >=1 "
+                         "draft rejection)")
     args = ap.parse_args()
     args.vocab = None
 
@@ -210,8 +226,12 @@ def main() -> int:
             faulted += 1
     st = eng.stats()
     summary = {
-        "ragged": args.ragged or args.tp > 1,
+        "ragged": args.ragged or args.tp > 1 or args.spec,
         "tp": args.tp,
+        "spec": bool(args.spec),
+        "drafted_tokens": st["drafted_tokens"],
+        "accepted_draft_tokens": st["accepted_draft_tokens"],
+        "spec_rollbacks": st["spec_rollbacks"],
         "steps": steps_run,
         "requests": len(chaos_results),
         "done_identical": done - len(mismatches),
@@ -233,6 +253,10 @@ def main() -> int:
             missing.append("dispatch_fault")
         if st["aborted"] < 1:
             missing.append("cancellation")
+        if args.spec and st["spec_rollbacks"] < 1:
+            # the spec leg must actually exercise the rejected-tail
+            # rollback path, not just ride accepted drafts
+            missing.append("draft_rejection")
         if missing:
             summary["missing_events"] = missing
             ok = False

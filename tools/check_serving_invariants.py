@@ -48,6 +48,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_fault_tolerance.py"),
     os.path.join(REPO, "tests", "test_ragged_batching.py"),
     os.path.join(REPO, "tests", "test_tp_serving.py"),
+    os.path.join(REPO, "tests", "test_spec_decode.py"),
 ]
 
 
@@ -100,11 +101,15 @@ def run_chaos() -> int:
     ISSUE 8 added the --tp 2 leg: the same schedule on the
     tensor-parallel shard_map engine — preemption neutralization,
     epoch guards and retry must stay request-granular under
-    sharding."""
+    sharding. ISSUE 9 added the --spec leg: n-gram drafts ride the
+    verify program through the whole fault schedule, and
+    --require-events demands >=1 draft rejection on top of the
+    preemption/fault/cancel events, so the rejected-tail
+    KV/position rollback is exercised with faults in flight."""
     import subprocess
     rc_all = 0
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
-                     ("tp2", ("--tp", "2"))):
+                     ("tp2", ("--tp", "2")), ("spec", ("--spec",))):
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",

@@ -309,7 +309,14 @@ def _build_tp_serving():
       a profile;
     - int8: each block psum becomes the quantized collective
       (2 all_to_alls + 2 all_gathers, chunks + per-row scales), the
-      logits gather stays exact.
+      logits gather stays exact;
+    - spec (ISSUE 9): the speculative VERIFY program
+      (serving.ragged_spec_tp2) must have exactly the T=1 ragged
+      program's collectives — one psum per block per layer plus one
+      logits all_gather. In-program acceptance compares post-gather
+      (replicated) tokens and the rejected-tail neutralization
+      zero-scatters each shard's own kv-head slice, so verification
+      adds ZERO collectives; any new collective here fails the gate.
     """
     def _mk(tp_comm):
         def build():
@@ -347,8 +354,45 @@ def _build_tp_serving():
             return eng._ragged_j, args
         return build
 
+    def _mk_spec():
+        def build():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from paddle_tpu.inference.paged_decode import \
+                PagedLlamaDecoder
+            from paddle_tpu.inference.serving import ServingEngine
+            from paddle_tpu.inference.spec_decode import SpecConfig
+            from paddle_tpu.models.llama import LlamaConfig
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+            mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+            dec = PagedLlamaDecoder.from_config(
+                cfg, num_blocks=8, block_size=4, mesh=mesh,
+                mp_axis="tp", tp_shard_map=True, tp_comm="fp32")
+            eng = ServingEngine(dec, tp=2, max_batch_size=2,
+                                prompt_buckets=(8, 16), chunk_size=2,
+                                prefill_chunk=4,
+                                spec_decode=SpecConfig(draft_len=3))
+            W = 8
+            S = jax.ShapeDtypeStruct
+            i32, f32 = jnp.int32, jnp.float32
+            args = (dec.weights, dec.cache.k, dec.cache.v,
+                    S((W,), i32), S((W,), jnp.bool_), S((W,), i32),
+                    S((W,), i32), S((W,), i32), S((W,), i32),
+                    S((W,), i32),
+                    S((eng.max_b + 1, dec.max_pages), i32),
+                    S((W,), f32), S((2,), jnp.uint32),
+                    S((W,), i32), S((W,), jnp.bool_))
+            return eng._spec_j, args
+        return build
+
     return {"serving.ragged_tp2_fp32": _mk("fp32"),
-            "serving.ragged_tp2_int8": _mk("int8")}
+            "serving.ragged_tp2_int8": _mk("int8"),
+            "serving.ragged_spec_tp2": _mk_spec()}
 
 
 def programs() -> Dict[str, callable]:

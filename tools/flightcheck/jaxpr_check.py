@@ -47,8 +47,14 @@ def _build_tiny():
                      num_key_value_heads=2, vocab_size=64,
                      max_position_embeddings=64)
     dec = PagedLlamaDecoder.from_config(cfg, num_blocks=16, block_size=4)
+    # spec_decode forces ragged=True on top of the dense programs, so
+    # one engine carries every compiled serving program — the dense
+    # per-phase set, the ragged [T, W] chunk, and the ISSUE-9
+    # speculative verify program
+    from paddle_tpu.inference.spec_decode import SpecConfig
     eng = ServingEngine(dec, max_batch_size=2, prompt_buckets=(8, 16),
-                        chunk_size=2, prefill_chunk=8)
+                        chunk_size=2, prefill_chunk=8,
+                        spec_decode=SpecConfig(draft_len=2))
     return dec, eng
 
 
@@ -138,6 +144,23 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
                        jnp.zeros((1, c), jnp.int32),
                        jnp.zeros((1,), jnp.int32),
                        jnp.zeros((1, 1), jnp.int32)))))
+    if eng.spec is not None:
+        w = 4
+        entries.append(
+            (serving, "spec_chunk",
+             lambda: (eng._spec_j,
+                      (dec.weights, cache.k, cache.v,
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((w,), bool),
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((w,), jnp.int32),
+                       jnp.zeros((eng.max_b + 1, mp_), jnp.int32),
+                       jnp.zeros((w,), jnp.float32), key,
+                       jnp.arange(w, dtype=jnp.int32),
+                       jnp.zeros((w,), bool)))))
 
     jaxprs = {}
     for file_sfx, name, build in entries:
