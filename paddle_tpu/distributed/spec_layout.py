@@ -91,6 +91,11 @@ CANONICAL_SPECS: Dict[str, P] = {
     # (kv-head dim sharded — each shard appends the heads it computed)
     "cache_k": P(None, "tp", None, None),
     "cache_v": P(None, "tp", None, None),
+    # LoRA adapter-page plane: [num_blocks, page_elems] REPLICATED —
+    # each shard slices its own A-rows/B-columns from the full
+    # factors in-program, which is what keeps the lora deltas at
+    # zero extra collectives (see inference/lora.py)
+    "lora_pool": P(None, None),
 }
 
 

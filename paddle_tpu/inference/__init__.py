@@ -17,6 +17,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from .gpt_decode import PagedGPTDecoder  # noqa: F401
+from .lora import AdapterRegistry, LoRALayout  # noqa: F401
 from .paged_decode import PagedLlamaDecoder  # noqa: F401
 from .serving import (EngineOverloaded, Request, SamplingParams,  # noqa: F401
                       ServingEngine)
@@ -25,7 +26,8 @@ from .spec_decode import Drafter, NGramDrafter, SpecConfig  # noqa: F401
 __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
            "PlaceType", "ServingEngine", "SamplingParams", "Request",
            "EngineOverloaded", "PagedLlamaDecoder", "PagedGPTDecoder",
-           "SpecConfig", "Drafter", "NGramDrafter"]
+           "SpecConfig", "Drafter", "NGramDrafter", "AdapterRegistry",
+           "LoRALayout"]
 
 
 class PrecisionType:

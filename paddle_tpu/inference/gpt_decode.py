@@ -27,8 +27,8 @@ from ..ops.flash_attention import flash_attention
 from ..ops.paged_attention import (PagedKVCache, paged_attention_decode,
                                    ragged_paged_attention,
                                    reshape_and_cache)
-from .paged_decode import (_SpecDecodeMixin, _TPDecoderMixin,
-                           _gather_prefix_pages, _mm,
+from .paged_decode import (_LoRAMixin, _SpecDecodeMixin,
+                           _TPDecoderMixin, _gather_prefix_pages, _mm,
                            _prefix_suffix_attention, _quantize_w,
                            _quantize_w4, _quantize_w4_halves)
 
@@ -104,7 +104,7 @@ def _extract_gpt_weights(model, weight_dtype=None, tp_split=False):
             "layers": layers, "head": q(head)}
 
 
-class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
+class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
     """Batched paged-KV greedy generation for a GPTForCausalLM
     (structure mirrors inference.paged_decode.PagedLlamaDecoder,
     including the fully-manual tensor-parallel mode: mesh + tp_shard_map
@@ -195,19 +195,37 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
             .reshape(b, s, nh, self.head_dim)
         return q, k, v
 
-    def _block(self, w, h, attn_out):
+    def lora_target_modules(self):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        it = cfg.intermediate_size
+        return (("wq", h, h, "col"), ("wk", h, h, "col"),
+                ("wv", h, h, "col"), ("wo", h, h, "row"),
+                ("wi", h, it, "col"), ("wf", it, h, "row"))
+
+    def _block(self, w, h, attn_out, lora=None, row_seq=None, li=0):
         cfg = self.cfg
         eps = cfg.layer_norm_epsilon
         ak = self._allow_kernel
         # row-parallel output projections reduce BEFORE their bias is
-        # added (a per-shard bias would be summed tp times by the psum)
-        h = h + (self._block_reduce(_mm(attn_out, w["wo"], ak))
-                 + w["bo"].astype(h.dtype))
+        # added (a per-shard bias would be summed tp times by the psum);
+        # LoRA deltas add to the pre-bias projection (W -> W + s*AB),
+        # row-parallel ones joining the partial product before the
+        # block's one allreduce (see paged_decode._LoRAMixin)
+        o = _mm(attn_out, w["wo"], ak)
+        if lora is not None:
+            o = o + self._lora_delta(lora, row_seq, attn_out, li, "wo")
+        h = h + (self._block_reduce(o) + w["bo"].astype(h.dtype))
         hn = _layer_norm(h, w["ln2_w"], w["ln2_b"], eps)
-        mid = jax.nn.gelu(_mm(hn, w["wi"], ak) + w["bi"].astype(h.dtype),
+        mi = _mm(hn, w["wi"], ak)
+        if lora is not None:
+            mi = mi + self._lora_delta(lora, row_seq, hn, li, "wi")
+        mid = jax.nn.gelu(mi + w["bi"].astype(h.dtype),
                           approximate=False)
-        return h + (self._block_reduce(_mm(mid, w["wf"], ak))
-                    + w["bf"].astype(h.dtype))
+        f = _mm(mid, w["wf"], ak)
+        if lora is not None:
+            f = f + self._lora_delta(lora, row_seq, mid, li, "wf")
+        return h + (self._block_reduce(f) + w["bf"].astype(h.dtype))
 
     def _prefill_impl(self, weights, k_pool, v_pool, ids, slots,
                       last_idx=None):
@@ -329,13 +347,15 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
         return logits, k_pool, v_pool
 
     def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
-                       slots, row_seq, row_ctx, tables):
+                       slots, row_seq, row_ctx, tables, lora=None):
         """One RAGGED ministep up to the logits (the GPT twin of
         PagedLlamaDecoder._ragged_logits — see its docstring): learned
         position embeddings are gathered at the per-row positions
         (clamped — pad rows may carry junk positions; their K/V aims at
         the scratch page and their outputs are discarded, so junk is
-        inert, same contract as _prefill_prefix_impl)."""
+        inert, same contract as _prefill_prefix_impl). ``lora``:
+        optional per-row adapter context, same contract as the Llama
+        twin's."""
         cfg = self.cfg
         r = ids.shape[0]
         pos = jnp.minimum(positions, cfg.max_position_embeddings - 1)
@@ -346,6 +366,13 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
             hn = _layer_norm(h, w["ln1_w"], w["ln1_b"],
                              cfg.layer_norm_epsilon)
             q, k, v = self._qkv(w, hn[:, None, :], r, 1)
+            if lora is not None:
+                q = q + self._lora_delta(lora, row_seq, hn, li,
+                                         "wq").reshape(q.shape)
+                k = k + self._lora_delta(lora, row_seq, hn, li,
+                                         "wk").reshape(k.shape)
+                v = v + self._lora_delta(lora, row_seq, hn, li,
+                                         "wv").reshape(v.shape)
             q, k, v = q[:, 0], k[:, 0], v[:, 0]
             kp, vp = reshape_and_cache(k, v, k_pool[li], v_pool[li],
                                        slots)
@@ -355,7 +382,8 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin):
             v_pool[li] = vp
             attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
                                           row_ctx)
-            h = self._block(w, h, attn.reshape(r, self._attn_dim))
+            h = self._block(w, h, attn.reshape(r, self._attn_dim),
+                            lora=lora, row_seq=row_seq, li=li)
         h = _layer_norm(h, weights["lnf_w"], weights["lnf_b"],
                         cfg.layer_norm_epsilon)
         logits = self._gather_logits(

@@ -1,0 +1,489 @@
+"""Multi-tenant many-LoRA serving (ISSUE 10): the adapter subsystem.
+
+The north-star scenario is "millions of users, each with their own
+fine-tune": one base model, thousands of registered LoRA adapters, a
+handful concurrently active per serving step. The S-LoRA design
+(PAPERS.md) maps onto this engine almost verbatim because the two hard
+problems are already solved elsewhere:
+
+- PAGING: adapter weights are paged through the SAME block-pool
+  allocator as the KV cache (``PagedKVCache``). An adapter's flattened
+  (A, B) factors occupy ``n_pages`` fixed-size pages of a device-side
+  ``lora_pool`` plane ([num_blocks, page_elems] f32) indexed by the
+  very block ids the KV pool hands out — adapter residency trades off
+  directly against KV capacity, in-use adapters are ref-counted
+  allocations (a pseudo-sequence per adapter, so ``debug_check``'s
+  pool invariant covers them for free), and COLD adapters park in the
+  allocator's cached-LRU under synthetic page hashes exactly like
+  prefix-cache blocks: any later allocation under pressure evicts
+  them page by page, and re-acquiring a partially-evicted adapter
+  faults the whole thing back in (host store → pool upload). The host
+  registry far exceeds device memory; the pool holds the working set.
+
+- BATCHING: per-request ``SamplingParams.adapter_id`` rides the ragged
+  [T, W] one-program-per-step path as a per-row adapter index (the
+  engine reuses ``row_seq`` — each engine slot maps to a row of a
+  per-dispatch ``lora_tables`` page table, the scratch row to the
+  all-zero null adapter), and the decoders' ``_LoRAMixin`` applies
+  batched gathered-matmul deltas ``y += (x @ A_row) @ B_row`` inside
+  ``_ragged_logits`` — so a mixed-tenant batch is still ONE device
+  program per step, and base-only rows pay a zero delta through the
+  scratch page's all-zero lora row.
+
+TP sharding (zero extra collectives, pinned by comm_audit
+``serving.ragged_lora_tp2``): the lora pool replicates across the mesh;
+for a COLUMN-parallel base weight (wq/wk/wv/wg/wu/wi) the A factor is
+applied whole (x is replicated) and B is sliced to this shard's
+out-columns, so the delta lands on the shard's own slice; for a
+ROW-parallel base weight (wo/wd/wf) A is sliced to this shard's
+in-rows (the input is the shard's partial activation) and the partial
+delta is added BEFORE the block's existing allreduce, which then
+reduces base + delta together. Either way the step program's
+collectives are exactly the base program's.
+
+This module is the host half: the packing layout (single source of
+truth for the static in-program slice offsets) and the
+``AdapterRegistry`` (host adapter store + pool paging + counters). The
+device half lives in ``paged_decode._LoRAMixin`` and the engine's
+``_ragged_lora_j`` program family.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = ["LoRALayout", "AdapterRegistry"]
+
+
+class LoRALayout:
+    """Static flat-packing layout for one registry's adapters.
+
+    Every adapter of a registry shares one layout: rank ``r`` (smaller
+    adapters zero-pad), one (A [din, r], B [r, dout]) pair per target
+    module per layer, flattened layer-major / module-minor with A
+    before B. The layout is consumed in two places that MUST agree —
+    the registry's host-side ``_flatten`` and the decoder mixin's
+    in-program static slices — which is why it is one object.
+
+    ``modules``: ordered ((name, din, dout, kind)) with kind "col"
+    (base weight column-parallel under tp: B sliced per shard) or
+    "row" (base row-parallel: A sliced per shard).
+    """
+
+    def __init__(self, modules: Sequence[Tuple[str, int, int, str]],
+                 num_layers: int, rank: int, page_elems: int):
+        self.modules = tuple((str(n), int(di), int(do), str(k))
+                             for n, di, do, k in modules)
+        if not self.modules:
+            raise ValueError("LoRA layout needs at least one target "
+                             "module")
+        for n, di, do, k in self.modules:
+            if k not in ("col", "row"):
+                raise ValueError(f"module {n}: kind must be 'col' or "
+                                 f"'row', got {k!r}")
+        self.num_layers = int(num_layers)
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        self.page_elems = int(page_elems)
+        if self.page_elems < 1:
+            raise ValueError("page_elems must be >= 1")
+        # offsets[(li, name)] = (offA, offB); A slab din*r, B slab r*do
+        self.offsets: Dict[Tuple[int, str], Tuple[int, int]] = {}
+        off = 0
+        for li in range(self.num_layers):
+            for name, din, dout, _ in self.modules:
+                offA = off
+                off += din * self.rank
+                offB = off
+                off += self.rank * dout
+                self.offsets[(li, name)] = (offA, offB)
+        self.total = off
+        self.n_pages = -(-self.total // self.page_elems)
+        self.capacity = self.n_pages * self.page_elems
+        self._dims = {n: (di, do, k) for n, di, do, k in self.modules}
+
+    def entry(self, li: int, name: str):
+        """(offA, offB, din, dout, kind) for one module instance —
+        the static slice coordinates the in-program delta uses."""
+        offA, offB = self.offsets[(li, name)]
+        din, dout, kind = self._dims[name]
+        return offA, offB, din, dout, kind
+
+    def module_names(self) -> Tuple[str, ...]:
+        return tuple(n for n, _, _, _ in self.modules)
+
+    def check_tp(self, tp: int):
+        """Shard-slicability: col modules slice B's out dim, row
+        modules slice A's in dim — both must divide the mesh degree
+        (the same dims the base weights already shard)."""
+        for n, di, do, k in self.modules:
+            dim = do if k == "col" else di
+            if dim % tp:
+                raise ValueError(
+                    f"LoRA module {n}: {'out' if k == 'col' else 'in'}"
+                    f" dim {dim} not divisible by tp={tp}")
+
+
+class AdapterRegistry:
+    """Host-side many-adapter store + S-LoRA paging through the KV
+    block pool.
+
+    Usage::
+
+        reg = AdapterRegistry(rank=8, alpha=16)
+        reg.register("alice", {"wq": (A, B), ...})   # all layers
+        reg.register_random("bob", seed=1)           # test/bench stub
+        eng = ServingEngine(model, ragged=True, lora=reg)
+        eng.add_request(ids, SamplingParams(adapter_id="alice"))
+
+    Registration is host-only (numpy) and unbounded — thousands of
+    adapters cost host RAM, not HBM. Residency is managed per adapter:
+
+    - ``acquire`` (engine admission): in-use adapters ref-bump; a cold
+      but still-parked adapter REVIVES its pages out of the
+      allocator's LRU (``adapter_cache_hits``); anything else FAULTS
+      IN — allocate ``n_pages`` blocks from the shared pool (evicting
+      whatever the LRU policy picks, prefix blocks and colder adapters
+      alike), upload the flattened factors into the ``lora_pool``
+      plane, and register synthetic page hashes so a later ``free``
+      parks instead of dropping (``adapter_cache_misses``; a refault
+      of a previously-resident adapter also counts
+      ``adapter_cache_evictions``).
+    - ``release`` (request leaves its slot): at zero users the
+      adapter's pseudo-sequence frees; its hashed pages park in the
+      LRU — still resident, instantly revivable, evictable by anyone.
+
+    Acquire raises ``KVCacheExhausted`` exactly like a KV allocation
+    would; the engine treats it as admission pressure (FIFO wait /
+    preemption), which is what "an adapter fault preempts like a KV
+    OOM" means in practice.
+    """
+
+    _OWNER_BASE = -1000   # pseudo-seq ids: -1000, -1001, ... (scratch
+    #                       is -1; request ids are >= 0)
+
+    def __init__(self, rank: int, alpha: Optional[float] = None,
+                 page_elems: Optional[int] = None):
+        self.rank = int(rank)
+        if self.rank < 1:
+            raise ValueError(f"rank must be >= 1, got {self.rank}")
+        self.alpha = float(alpha) if alpha is not None else float(rank)
+        self._page_elems_arg = page_elems
+        self.layout: Optional[LoRALayout] = None
+        self._cache = None
+        self._raw: Dict[object, Tuple[dict, float]] = {}
+        self._random: Dict[object, Tuple[int, float, float]] = {}
+        self._flat: Dict[object, np.ndarray] = {}     # padded to pages
+        self._owner: Dict[object, int] = {}
+        self._use: Dict[object, int] = {}
+        self._hashes: Dict[object, List[object]] = {}
+        self._was_resident: set = set()
+        # counters (engine stats(); reset by clear_finished)
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    # -- registration (host-only; no device state) --------------------------
+    def register(self, adapter_id, weights: Dict[str, tuple],
+                 alpha: Optional[float] = None):
+        """Register explicit factors. ``weights`` maps a module name
+        ("wq") — applied to EVERY layer — or a per-layer key
+        ("layers.3.wq") to an (A [din, ra], B [ra, dout]) pair with
+        ra <= the registry rank (smaller ranks zero-pad). Missing
+        modules contribute a zero delta. The alpha/rank scale folds
+        into B at flatten time, so the device program never sees a
+        per-adapter scale."""
+        if adapter_id is None:
+            raise ValueError("adapter_id None is the base model")
+        if adapter_id in self._raw or adapter_id in self._random:
+            raise ValueError(f"adapter {adapter_id!r} already "
+                             f"registered")
+        self._raw[adapter_id] = (dict(weights),
+                                 float(alpha) if alpha is not None
+                                 else self.alpha)
+        if self.layout is not None:
+            self._flat[adapter_id] = self._flatten(adapter_id)
+
+    def register_random(self, adapter_id, seed: int,
+                        scale: float = 0.02,
+                        alpha: Optional[float] = None):
+        """Seeded N(0, scale) factors for every target module — the
+        deterministic stub tests, bench and the chaos harness use
+        (generation is deferred to bind time, when shapes are
+        known)."""
+        if adapter_id is None:
+            raise ValueError("adapter_id None is the base model")
+        if adapter_id in self._raw or adapter_id in self._random:
+            raise ValueError(f"adapter {adapter_id!r} already "
+                             f"registered")
+        self._random[adapter_id] = (
+            int(seed), float(scale),
+            float(alpha) if alpha is not None else self.alpha)
+
+    def ids(self) -> List[object]:
+        return list(self._raw) + list(self._random)
+
+    def __contains__(self, adapter_id) -> bool:
+        return adapter_id in self._raw or adapter_id in self._random
+
+    # -- binding ------------------------------------------------------------
+    def bind(self, dec, sharding=None):
+        """Attach to a paged decoder's cache: compute the layout from
+        the decoder's declared target modules, enable the cache's
+        ``lora_pool`` plane (replicated over the tp mesh via
+        ``sharding``), and assign pseudo-sequence owner ids. A
+        registry binds to ONE decoder/cache at a time."""
+        if self._cache is not None:
+            if self._cache is dec.cache:
+                return
+            raise ValueError("AdapterRegistry is already bound to a "
+                             "different engine's cache")
+        cache = dec.cache
+        page_elems = self._page_elems_arg
+        if page_elems is None:
+            # KV-block-equivalent page: one adapter page displaces
+            # roughly one KV block of bytes (k + v, all layers)
+            nb, kvh, bs, hd = cache.k[0].shape
+            page_elems = 2 * len(cache.k) * kvh * bs * hd
+        self.layout = LoRALayout(dec.lora_target_modules(),
+                                 dec.cfg.num_hidden_layers, self.rank,
+                                 page_elems)
+        tp = int(getattr(dec, "_tp", 1))
+        if tp > 1:
+            self.layout.check_tp(tp)
+        cache.enable_lora_pool(self.layout.page_elems,
+                               sharding=sharding)
+        self._cache = cache
+        for i, aid in enumerate(self.ids()):
+            self._owner[aid] = self._OWNER_BASE - i
+            self._use.setdefault(aid, 0)
+        self._next_owner = self._OWNER_BASE - len(self._owner)
+
+    def _owner_of(self, adapter_id) -> int:
+        o = self._owner.get(adapter_id)
+        if o is None:
+            o = self._next_owner
+            self._next_owner -= 1
+            self._owner[adapter_id] = o
+            self._use.setdefault(adapter_id, 0)
+        return o
+
+    def _module_pair(self, weights, li, name, din, dout):
+        pair = weights.get(f"layers.{li}.{name}", weights.get(name))
+        if pair is None:
+            return None
+        a, b = pair
+        a = np.asarray(a, np.float32)
+        b = np.asarray(b, np.float32)
+        ra = a.shape[-1]
+        if a.shape != (din, ra) or b.shape != (ra, dout) \
+                or ra > self.rank:
+            raise ValueError(
+                f"adapter factors for {name} have shapes "
+                f"{a.shape}/{b.shape}; expected ({din}, r)/(r, {dout})"
+                f" with r <= {self.rank}")
+        return a, b
+
+    def _flatten(self, adapter_id) -> np.ndarray:
+        lay = self.layout
+        flat = np.zeros(lay.capacity, np.float32)
+        if adapter_id in self._random:
+            seed, scale, alpha = self._random[adapter_id]
+            rng = np.random.RandomState(seed)
+            s = alpha / self.rank
+            for li in range(lay.num_layers):
+                for name, din, dout, _ in lay.modules:
+                    offA, offB = lay.offsets[(li, name)]
+                    a = rng.randn(din, self.rank) * scale
+                    b = rng.randn(self.rank, dout) * scale * s
+                    flat[offA:offA + din * self.rank] = \
+                        a.astype(np.float32).ravel()
+                    flat[offB:offB + self.rank * dout] = \
+                        b.astype(np.float32).ravel()
+            return flat
+        weights, alpha = self._raw[adapter_id]
+        # every provided key must name a real target module (bare
+        # "wq" or per-layer "layers.{li}.wq") — a misspelled or
+        # HF-named key would otherwise be silently dropped and the
+        # adapter would serve as an all-zero (base-model) delta
+        valid = set(lay.module_names())
+        for key in weights:
+            name, li = key, None
+            if key.startswith("layers."):
+                try:
+                    _, li_s, name = key.split(".")
+                    li = int(li_s)
+                except ValueError:
+                    raise ValueError(
+                        f"adapter {adapter_id!r}: malformed weight "
+                        f"key {key!r} (expected 'layers.<i>.<module>'"
+                        f" or a bare module name)") from None
+            if name not in valid or (li is not None
+                                     and not 0 <= li
+                                     < lay.num_layers):
+                raise ValueError(
+                    f"adapter {adapter_id!r}: weight key {key!r} "
+                    f"matches no target module — valid modules are "
+                    f"{sorted(valid)} over {lay.num_layers} layers "
+                    f"(a dropped key would silently serve the base "
+                    f"model)")
+        s = alpha / self.rank
+        for li in range(lay.num_layers):
+            for name, din, dout, _ in lay.modules:
+                pair = self._module_pair(weights, li, name, din, dout)
+                if pair is None:
+                    continue
+                a, b = pair
+                ra = a.shape[-1]
+                offA, offB = lay.offsets[(li, name)]
+                ap = np.zeros((din, self.rank), np.float32)
+                ap[:, :ra] = a
+                bp = np.zeros((self.rank, dout), np.float32)
+                bp[:ra] = b * s
+                flat[offA:offA + din * self.rank] = ap.ravel()
+                flat[offB:offB + self.rank * dout] = bp.ravel()
+        return flat
+
+    def _page_hashes(self, adapter_id) -> List[object]:
+        hs = self._hashes.get(adapter_id)
+        if hs is None:
+            # synthetic chain-namespace hashes: structurally disjoint
+            # from prompt chain hashes (those are hash((parent, token
+            # tuple))); stable across lives so revival can find parked
+            # pages by content identity
+            hs = [hash(("__lora__", adapter_id, i))
+                  for i in range(self.layout.n_pages)]
+            self._hashes[adapter_id] = hs
+        return hs
+
+    # -- residency ----------------------------------------------------------
+    def is_registered(self, adapter_id) -> bool:
+        return adapter_id in self
+
+    def n_pages(self) -> int:
+        if self.layout is None:
+            raise RuntimeError("registry not bound")
+        return self.layout.n_pages
+
+    def in_use(self, adapter_id) -> int:
+        return self._use.get(adapter_id, 0)
+
+    def active_count(self) -> int:
+        return sum(1 for v in self._use.values() if v > 0)
+
+    def acquire(self, adapter_id):
+        """Pin the adapter resident for one more user. Raises
+        ``KeyError`` for an unregistered id and ``KVCacheExhausted``
+        when the pool cannot hold its pages (the caller's admission
+        pressure path)."""
+        if adapter_id not in self:
+            raise KeyError(f"unknown adapter {adapter_id!r}")
+        if self._cache is None:
+            raise RuntimeError("registry not bound to an engine")
+        cache = self._cache
+        owner = self._owner_of(adapter_id)
+        if self._use.get(adapter_id, 0) > 0:
+            self._use[adapter_id] += 1
+            self.hits += 1
+            return
+        hashes = self._page_hashes(adapter_id)
+        parked = [cache.lookup_hash(h) for h in hashes]
+        if all(b is not None for b in parked):
+            # cold but fully parked: revive in place, zero upload
+            cache.adopt_cached_blocks(owner, parked)
+            self._use[adapter_id] = 1
+            self.hits += 1
+            return
+        # partial or full miss: drop any surviving pages (their
+        # content is useless without the rest), fault the whole
+        # adapter back in
+        survivors = [b for b in parked if b is not None]
+        if survivors:
+            cache.unregister_block_hashes(survivors)
+        was_evicted = adapter_id in self._was_resident
+        flat = self._flat.get(adapter_id)
+        if flat is None:
+            flat = self._flatten(adapter_id)
+            self._flat[adapter_id] = flat
+        lay = self.layout
+        # allocate may raise KVCacheExhausted: count miss/eviction
+        # only AFTER the refault actually lands — a request waiting at
+        # the queue head retries acquire every step, and counting the
+        # failed attempts would report one eviction N times
+        blocks = cache.allocate(owner, lay.n_pages * cache.block_size)
+        cache.write_lora_pages(
+            list(blocks), flat.reshape(lay.n_pages, lay.page_elems))
+        cache.register_page_hashes(list(blocks), hashes)
+        self._use[adapter_id] = 1
+        self.misses += 1
+        if was_evicted:
+            self.evictions += 1
+        self._was_resident.add(adapter_id)
+
+    def release(self, adapter_id):
+        """One user done. At zero users the pseudo-sequence frees and
+        the hashed pages PARK in the allocator LRU (still resident,
+        revivable, evictable)."""
+        n = self._use.get(adapter_id, 0)
+        if n <= 0:
+            raise ValueError(f"adapter {adapter_id!r} released more "
+                             f"times than acquired")
+        self._use[adapter_id] = n - 1
+        if n == 1:
+            self._cache.free(self._owner[adapter_id])
+
+    def resident_blocks(self, adapter_id) -> List[int]:
+        """The IN-USE adapter's page table (the per-dispatch
+        ``lora_tables`` row)."""
+        return self._cache.seq_blocks(self._owner[adapter_id])
+
+    def reset_stats(self):
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def stats(self) -> dict:
+        return {"active_adapters": self.active_count(),
+                "adapter_cache_hits": self.hits,
+                "adapter_cache_misses": self.misses,
+                "adapter_cache_evictions": self.evictions}
+
+    def debug_check(self, expected_use: Optional[Dict[object, int]]
+                    = None):
+        """Adapter-page invariants, the registry-level analogue of
+        ``PagedKVCache.debug_check`` (which already covers the shared
+        pool's global accounting):
+
+        - every in-use adapter owns exactly ``n_pages`` referenced
+          blocks, each carrying its synthetic page hash;
+        - no zero-use adapter still owns an allocation (a leak would
+          silently pin pool capacity);
+        - with ``expected_use`` (the engine's slot-derived counts),
+          the use counts match reality exactly.
+        """
+        cache = self._cache
+        assert cache is not None, "registry not bound"
+        for aid, n in self._use.items():
+            owner = self._owner[aid]
+            if n > 0:
+                blocks = cache._tables.get(owner)
+                assert blocks is not None and \
+                    len(blocks) == self.layout.n_pages, \
+                    f"adapter {aid!r}: in use but not fully resident"
+                hs = self._page_hashes(aid)
+                for b, h in zip(blocks, hs):
+                    assert cache._ref.get(b, 0) >= 1, \
+                        f"adapter {aid!r}: page {b} unreferenced"
+                    assert cache._hash_of.get(b) == h, \
+                        f"adapter {aid!r}: page {b} lost its hash"
+            else:
+                assert self._owner[aid] not in cache._tables, \
+                    f"adapter {aid!r}: zero users but still allocated"
+        if expected_use is not None:
+            actual = {a: n for a, n in self._use.items() if n > 0}
+            assert actual == {a: n for a, n in expected_use.items()
+                              if n > 0}, (
+                f"adapter use counts {actual} != engine-derived "
+                f"{expected_use}")

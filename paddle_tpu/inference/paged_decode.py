@@ -313,7 +313,8 @@ class _TPDecoderMixin:
                 f"({cfg.intermediate_size // 2}) divisible by the "
                 f"'{self.mp_axis}' degree {mp} (nibble-packed in-dim)")
 
-    def tp_wrap(self, fn, n_extra: int, outs: str = "tkv"):
+    def tp_wrap(self, fn, n_extra: int, outs: str = "tkv",
+                lora_pool: bool = False):
         """shard_map-wrap a compiled-program body of the decoder-call
         convention ``fn(weights, k_pool, v_pool, *replicated)`` for
         fully-manual tp execution: weights enter per the SpecLayout
@@ -321,12 +322,20 @@ class _TPDecoderMixin:
         replicated. ``outs``: "tkv" for (tokens/logits, k, v) bodies,
         "takv" for the speculative verify body (tokens, accepted-mask,
         k, v — both small outputs replicated), "kv" for no-sample
-        chunk bodies. The engine uses this to wrap its sampling
-        programs; generate() wraps the decoder's own."""
+        chunk bodies. ``lora_pool``: the body's convention is
+        ``fn(weights, k, v, lora_pool, shard_ids, *replicated)`` —
+        the adapter-page plane enters REPLICATED (every shard slices
+        its own A-rows/B-columns from the full factors, so the lora
+        math adds zero collectives) and ``shard_ids`` is the
+        P(tp)-sharded arange whose per-shard element is the shard
+        index (the repo's axis_index idiom — see pp_schedule). The
+        engine uses this to wrap its sampling programs; generate()
+        wraps the decoder's own."""
         from jax.sharding import PartitionSpec as P
         lay = self._layout()
         kv = lay.spec("cache_k")
-        in_specs = (lay.spec_tree(self.weights), kv, kv) \
+        pre = (P(None, None), P(self.mp_axis)) if lora_pool else ()
+        in_specs = (lay.spec_tree(self.weights), kv, kv) + pre \
             + (P(),) * n_extra
         out_specs = {"tkv": (P(), kv, kv), "takv": (P(), P(), kv, kv),
                      "kv": (kv, kv)}[outs]
@@ -427,7 +436,64 @@ class _SpecDecodeMixin:
         return accepted, k_pool, v_pool
 
 
-class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
+class _LoRAMixin:
+    """Per-row LoRA deltas for the ragged serving step (ISSUE 10; the
+    device half of inference/lora.py — see its module docstring for
+    the paging/TP design). A decoder exposes ``lora_target_modules()``
+    (ordered (name, din, dout, kind) over FULL unsharded dims; kind
+    "col"/"row" mirrors the base weight's SpecLayout placement) and
+    its ``_ragged_logits`` threads an optional ``lora`` context
+    ``(layout, lora_flat, shard_id)`` into ``_lora_delta`` at every
+    target module:
+
+    - ``lora_flat`` [S, n_pages * page_elems]: the per-dispatch gather
+      of each engine slot's adapter pages out of the shared pool
+      plane (slot S-1 is the scratch row — the all-zero null adapter
+      base-only and padding rows read);
+    - the per-module (A [din, r], B [r, dout]) factors are STATIC
+      slices of that flat vector (layout.entry — one compiled program
+      serves every adapter);
+    - the delta is the batched gathered matmul (S-LoRA's BGMV shape):
+      rows gather their own factors by ``row_seq`` and compute
+      ``(x @ A_row) @ B_row`` in f32 — zero for null rows, so mixed
+      batches need no masking.
+
+    Under manual tp, "col" modules slice B to this shard's
+    out-columns (x is replicated; the delta lands on the shard's own
+    output slice) and "row" modules slice A to this shard's in-rows
+    (the partial delta joins the base partial product BEFORE the
+    block's one allreduce) — zero extra collectives either way,
+    pinned by comm_audit ``serving.ragged_lora_tp2``."""
+
+    def lora_target_modules(self):
+        raise NotImplementedError
+
+    def _lora_delta(self, lora, row_seq, x, li: int, name: str):
+        """[rows, dout_local] delta for module (li, name); x is the
+        module's input activation [rows, din_local]."""
+        layout, lflat, sid = lora
+        offA, offB, din, dout, kind = layout.entry(li, name)
+        r = layout.rank
+        s = lflat.shape[0]
+        A = lflat[:, offA:offA + din * r].reshape(s, din, r)
+        B = lflat[:, offB:offB + r * dout].reshape(s, r, dout)
+        tp = self._tp
+        if tp > 1:
+            if kind == "col":
+                dl = dout // tp
+                B = jax.lax.dynamic_slice_in_dim(B, sid * dl, dl,
+                                                 axis=2)
+            else:
+                dl = din // tp
+                A = jax.lax.dynamic_slice_in_dim(A, sid * dl, dl,
+                                                 axis=1)
+        Ar = jnp.take(A, row_seq, axis=0)       # [rows, din_l, r]
+        Br = jnp.take(B, row_seq, axis=0)       # [rows, r, dout_l]
+        xa = jnp.einsum("wd,wdr->wr", x.astype(jnp.float32), Ar)
+        return jnp.einsum("wr,wro->wo", xa, Br).astype(x.dtype)
+
+
+class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
     """Batched paged-KV generation for a LlamaForCausalLM."""
 
     def __init__(self, model, num_blocks: int = 512, block_size: int = 16,
@@ -647,6 +713,37 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
         return _mm(jax.nn.silu(_mm(hn, w["wg"], ak))
                    * _mm(hn, w["wu"], ak), w["wd"], ak)
 
+    def lora_target_modules(self):
+        cfg = self.cfg
+        h = cfg.hidden_size
+        ad = cfg.num_attention_heads * self.head_dim
+        kvd = cfg.num_key_value_heads * self.head_dim
+        it = cfg.intermediate_size
+        return (("wq", h, ad, "col"), ("wk", h, kvd, "col"),
+                ("wv", h, kvd, "col"), ("wo", ad, h, "row"),
+                ("wg", h, it, "col"), ("wu", h, it, "col"),
+                ("wd", it, h, "row"))
+
+    def _lora_mlp(self, w, hn, lora, row_seq, li):
+        """The _mlp body with per-row LoRA deltas on gate/up/down —
+        kept separate so the base path's fused program is untouched.
+        Deltas add to the PRE-activation projections (W -> W + s*AB);
+        the wd delta joins the partial product before the block's
+        allreduce (see _LoRAMixin)."""
+        ak = self._allow_kernel
+        if "wgu" in w:
+            gu = _mm(hn, w["wgu"], ak)
+            g_, u_ = jnp.split(gu, [self.cfg.intermediate_size],
+                               axis=-1)
+        else:
+            g_ = _mm(hn, w["wg"], ak)
+            u_ = _mm(hn, w["wu"], ak)
+        g_ = g_ + self._lora_delta(lora, row_seq, hn, li, "wg")
+        u_ = u_ + self._lora_delta(lora, row_seq, hn, li, "wu")
+        mid = jax.nn.silu(g_) * u_
+        return _mm(mid, w["wd"], ak) \
+            + self._lora_delta(lora, row_seq, mid, li, "wd")
+
     def _rope(self, x, positions):
         # x [b, s, h, d]; positions [b, s]
         cos = self._cos[positions][:, :, None, :].astype(x.dtype)
@@ -806,7 +903,7 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
         return logits, k_pool, v_pool
 
     def _ragged_logits(self, weights, k_pool, v_pool, ids, positions,
-                       slots, row_seq, row_ctx, tables):
+                       slots, row_seq, row_ctx, tables, lora=None):
         """One RAGGED ministep up to the logits: a flattened token
         batch mixing decode rows (one token of a running sequence) and
         no-sample prefill-chunk rows (consecutive prompt positions),
@@ -817,6 +914,10 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
         at its flat slot BEFORE attention, so intra-call causality is
         pure data: row_ctx bounds what each row sees (see
         ops.paged_attention.ragged_paged_attention_reference).
+        ``lora``: optional (layout, lora_flat, shard_id) multi-tenant
+        context — per-row adapter deltas at every target module, null
+        rows reading the scratch slot's zero page (_LoRAMixin); the
+        base path's program is byte-identical when None.
         Returns (logits [rows, vocab], k_pool, v_pool)."""
         cfg = self.cfg
         r = ids.shape[0]
@@ -828,6 +929,13 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
         for li, w in enumerate(weights["layers"]):
             hn = rms_norm(h, w["ln1"], cfg.rms_norm_eps)
             q, k, v = self._proj_qkv(w, hn[:, None, :], r, 1)
+            if lora is not None:
+                q = q + self._lora_delta(lora, row_seq, hn, li,
+                                         "wq").reshape(q.shape)
+                k = k + self._lora_delta(lora, row_seq, hn, li,
+                                         "wk").reshape(k.shape)
+                v = v + self._lora_delta(lora, row_seq, hn, li,
+                                         "wv").reshape(v.shape)
             q = self._rope(q, pos)[:, 0]                   # [r, nh, d]
             k = self._rope(k, pos)[:, 0]                   # [r, kvh, d]
             v = v[:, 0]
@@ -840,11 +948,15 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin):
             v_pool[li] = vp
             attn = ragged_paged_attention(q, kp, vp, tables, row_seq,
                                           row_ctx)
-            h = h + self._block_reduce(
-                _mm(attn.reshape(r, self._attn_dim), w["wo"],
-                    self._allow_kernel))
+            af = attn.reshape(r, self._attn_dim)
+            o = _mm(af, w["wo"], self._allow_kernel)
+            if lora is not None:
+                o = o + self._lora_delta(lora, row_seq, af, li, "wo")
+            h = h + self._block_reduce(o)
             hn = rms_norm(h, w["ln2"], cfg.rms_norm_eps)
-            h = h + self._block_reduce(self._mlp(w, hn))
+            mlp = self._mlp(w, hn) if lora is None \
+                else self._lora_mlp(w, hn, lora, row_seq, li)
+            h = h + self._block_reduce(mlp)
         h = rms_norm(h, weights["norm"], cfg.rms_norm_eps)
         logits = self._gather_logits(
             _mm(h, weights["head"],

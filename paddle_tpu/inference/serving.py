@@ -178,13 +178,29 @@ class SamplingParams:
     # priority: higher survives longer under KV pressure (preemption
     # victims are picked lowest-priority-first, newest-first on ties)
     priority: int = 0
+    # -- multi-tenant surface (ISSUE 10) ----------------------------------
+    # adapter_id: serve this request through a LoRA adapter registered
+    # in the engine's AdapterRegistry (None = the base model). The
+    # adapter is faulted into the shared block pool at admission and
+    # its per-row deltas ride the ragged step program; prefix-cache
+    # hashes are salted with the id so splices never cross tenants.
+    adapter_id: Optional[object] = None
+    # allowed_tokens: vocab restriction applied IN-PROGRAM to this
+    # request's decode columns before sampling (the minimal structured
+    # decoding hook — "own output schema"; grammar FSMs are future
+    # work). Either a boolean mask of length vocab_size or a sequence
+    # of allowed token ids; greedy becomes constrained greedy (argmax
+    # over the masked logits) and sampling renormalizes over the mask.
+    allowed_tokens: Optional[object] = None
 
     @property
     def needs_rich_sampling(self) -> bool:
         # an EXPLICIT top_k (including 0, which must be able to override
-        # an engine-level default) routes through the per-request path
+        # an engine-level default) routes through the per-request path;
+        # a vocab mask rides the same mask-based program family
         return (self.top_k is not None or self.top_p < 1.0
-                or self.repetition_penalty != 1.0)
+                or self.repetition_penalty != 1.0
+                or self.allowed_tokens is not None)
 
 
 @dataclass
@@ -229,6 +245,13 @@ class Request:
     deps: List[Tuple["Request", int]] = field(default_factory=list)
     pending_blocks: List[int] = field(default_factory=list)
     slot: Optional[int] = None
+    # -- multi-tenant bookkeeping (ISSUE 10) ------------------------------
+    # lora_held: this request currently holds one acquire() on its
+    # adapter (set at admission, dropped whenever the slot is lost)
+    lora_held: bool = False
+    # allowed_mask: sampling.allowed_tokens normalized to a [vocab]
+    # bool mask at add_request (None = unrestricted)
+    allowed_mask: Optional[np.ndarray] = None
     # inter-token latency samples (seconds/token, chunk time split
     # evenly over the chunk's delivered tokens — see _collect_oldest)
     itls: List[float] = field(default_factory=list)
@@ -309,7 +332,8 @@ class ServingEngine:
                  max_queue_depth: Optional[int] = None,
                  ragged: bool = False, tp: int = 1,
                  tp_comm: Optional[str] = None,
-                 spec_decode: Optional[SpecConfig] = None):
+                 spec_decode: Optional[SpecConfig] = None,
+                 lora=None):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -561,21 +585,21 @@ class ServingEngine:
         dec = self.dec
 
         def prefill(weights, k, v, ids, slots, last_idx, temp, key,
-                    top_ks, top_ps, rep, seen):
+                    top_ks, top_ps, rep, seen, allowed):
             logits, k, v = dec._prefill_impl(weights, k, v, ids, slots,
                                              last_idx)
             tok = self._sample_rich(logits, temp, key, top_ks, top_ps,
-                                    rep, seen)
+                                    rep, seen, allowed)
             return tok, k, v
 
         def prefill_prefix(weights, k, v, ids, slots, last_idx,
                            n_cached, prefix_tables, temp, key, top_ks,
-                           top_ps, rep, seen):
+                           top_ps, rep, seen, allowed):
             logits, k, v = dec._prefill_prefix_impl(
                 weights, k, v, ids, slots, last_idx, n_cached,
                 prefix_tables)
             tok = self._sample_rich(logits, temp, key, top_ks, top_ps,
-                                    rep, seen)
+                                    rep, seen, allowed)
             return tok, k, v
 
         def decode_chunk(weights, k, v, first_ids, tables_all, ctx_all,
@@ -595,18 +619,19 @@ class ServingEngine:
 
         def decode_chunk_rich(weights, k, v, first_ids, tables_all,
                               ctx_all, slots_all, temp, keys_all,
-                              top_ks, top_ps, rep, seen):
+                              top_ks, top_ps, rep, seen, allowed):
             """Per-request-sampling variant: the scan additionally
             carries the token-presence mask (repetition penalty) and
-            applies per-slot top_k/top_p masks. Compiled only when a
-            request actually asks for them."""
+            applies per-slot top_k/top_p masks plus the per-slot
+            allowed-vocab mask (structured decoding). Compiled only
+            when a request actually asks for them."""
             def step(carry, xs):
                 last_ids, kp, vp, seen_c = carry
                 tables, ctx, slots, key = xs
                 logits, kp, vp = dec._decode_logits(
                     weights, kp, vp, last_ids, tables, ctx, slots)
                 nxt = self._sample_rich(logits, temp, key, top_ks,
-                                        top_ps, rep, seen_c)
+                                        top_ps, rep, seen_c, allowed)
                 seen_c = seen_c.at[
                     jnp.arange(seen_c.shape[0]), nxt].set(True)
                 return (nxt, kp, vp, seen_c), nxt
@@ -687,6 +712,62 @@ class ServingEngine:
         # BIT-IDENTICAL to the spec-off path (each emitted token is
         # the teacher's own argmax under a verified prefix). Forces
         # the ragged path: the verify window IS a ragged row pattern.
+        # -- multi-tenant many-LoRA serving (ISSUE 10) ----------------------
+        # lora=AdapterRegistry(...): per-request adapters ride the
+        # ragged [T, W] program as per-row (A, B) deltas gathered from
+        # adapter pages paged through the SAME block pool as the KV
+        # cache (S-LoRA style — see inference/lora.py). Forces the
+        # ragged path: the per-row adapter index IS a ragged-row
+        # attribute. Dispatches whose scheduled requests are all
+        # base-model use the UNCHANGED base programs, so adapter_id=
+        # None traffic is bit-identical to a lora-less engine.
+        self.lora = lora
+        if lora is not None:
+            from .lora import AdapterRegistry
+            if not isinstance(lora, AdapterRegistry):
+                raise TypeError(
+                    f"lora must be an AdapterRegistry, got "
+                    f"{type(lora).__name__}")
+            if not hasattr(dec, "_ragged_logits") \
+                    or not hasattr(dec, "lora_target_modules"):
+                raise ValueError(
+                    "many-LoRA serving needs a decoder with the ragged "
+                    "step program and LoRA targets (_ragged_logits + "
+                    "lora_target_modules)")
+            self.ragged = True
+            if self.tp > 1:
+                # the plane's placement comes from the canonical
+                # SpecLayout table (replicated), like every other
+                # sharded serving array
+                lora.bind(dec, sharding=dec._layout().sharding(
+                    dec.mesh, "lora_pool"))
+            else:
+                lora.bind(dec)
+        # per-shard index operand for the lora programs: a tp-sharded
+        # arange whose in-program element is the shard id (the repo's
+        # axis_index idiom — jax 0.4.x-safe); a plain [0] off tp
+        if self.tp > 1:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+            self._shard_ids = jax.device_put(
+                np.arange(self.tp, dtype=np.int32),
+                NamedSharding(self.dec.mesh, P("tp")))
+        else:
+            self._shard_ids = np.zeros(1, np.int32)
+        # multi-tenant / structured-decoding counters (stats(); reset
+        # by clear_finished): lora_dispatches / lora_rows feed
+        # lora_rows_per_dispatch; masked_decode_columns counts
+        # scheduled decode columns carrying an allowed_tokens mask
+        self.lora_dispatches = 0
+        self.lora_rows = 0
+        self.masked_decode_columns = 0
+        self._ones_allowed_cache: Dict[int, jax.Array] = {}
+        # composed allowed-mask operands, memoized per (rows, row ->
+        # mask-identity) layout: a request's mask is immutable, so a
+        # steady-state masked stream re-ships nothing (cleared by
+        # clear_finished — mask ids are only stable while their
+        # requests are retained)
+        self._allowed_memo: Dict[tuple, jax.Array] = {}
         self.spec = spec_decode
         self._drafter = None
         if self.spec is not None:
@@ -743,12 +824,18 @@ class ServingEngine:
                                   pos_all, slots_all, rseq_all,
                                   rctx_all, use_carry, tables,
                                   temps_all, keys, top_ks_all,
-                                  top_ps_all, reps_all, seen, upd):
+                                  top_ps_all, reps_all, seen, upd,
+                                  allowed):
                 """Per-request-sampling twin: carries the seen mask.
                 Only columns flagged in `upd` (decode columns)
                 accumulate their own samples — a final-prefill row's
                 seen mask is its prompt, seeded host-side, and other
-                ministeps sharing its column must not pollute it."""
+                ministeps sharing its column must not pollute it.
+                ``allowed`` [W, vocab] is per COLUMN (ministep-
+                invariant): only the column's consumed cells — its
+                decode samples or its one sampling final — ever reach
+                a request, so masking the discarded cells too is
+                harmless."""
                 first = jnp.where(use_host, override,
                                   prev_toks[last_t, prev_col])
                 w = use_host.shape[0]
@@ -762,7 +849,7 @@ class ServingEngine:
                         weights, kp, vp, ids, pos, slots, rseq, rctx,
                         tables)
                     nxt = self._sample_rich(logits, temp, key, tks,
-                                            tps, rp, seen_c)
+                                            tps, rp, seen_c, allowed)
                     rows = jnp.arange(w)
                     seen_c = seen_c.at[rows, nxt].set(
                         seen_c[rows, nxt] | upd)
@@ -785,13 +872,115 @@ class ServingEngine:
                     dec.tp_wrap(ragged_chunk, n_extra=14),
                     donate_argnums=(1, 2))
                 self._ragged_rich_j = jax.jit(
-                    dec.tp_wrap(ragged_chunk_rich, n_extra=19),
+                    dec.tp_wrap(ragged_chunk_rich, n_extra=20),
                     donate_argnums=(1, 2))
             else:
                 self._ragged_j = jax.jit(ragged_chunk,
                                          donate_argnums=(1, 2))
                 self._ragged_rich_j = jax.jit(ragged_chunk_rich,
                                               donate_argnums=(1, 2))
+
+            if self.lora is not None:
+                layout = self.lora.layout
+
+                def _lora_ctx(lora_pool, shard_ids, lora_tables):
+                    """Gather each engine slot's adapter pages out of
+                    the shared pool plane ONCE per dispatch (scan-
+                    invariant): [S, n_pages * page_elems] flat factors
+                    the decoder's static layout slices — S = max_b + 1
+                    rows addressed by row_seq, the scratch row reading
+                    the scratch block's all-zero page (the null
+                    adapter every base-only row costs)."""
+                    flat = jnp.take(lora_pool, lora_tables.reshape(-1),
+                                    axis=0)
+                    flat = flat.reshape(lora_tables.shape[0], -1)
+                    return (layout, flat, shard_ids[0])
+
+                def ragged_lora_chunk(weights, k, v, lora_pool,
+                                      shard_ids, lora_tables,
+                                      prev_toks, last_t, prev_col,
+                                      use_host, override, ids_all,
+                                      pos_all, slots_all, rseq_all,
+                                      rctx_all, use_carry, tables,
+                                      temps_all, keys):
+                    """ragged_chunk with per-row LoRA deltas: the
+                    multi-tenant twin — same schedule contract, one
+                    program per step, adapters applied inside
+                    _ragged_logits via the gathered page factors."""
+                    lctx = _lora_ctx(lora_pool, shard_ids, lora_tables)
+                    first = jnp.where(use_host, override,
+                                      prev_toks[last_t, prev_col])
+
+                    def step(carry, xs):
+                        cur, kp, vp = carry
+                        ids_d, pos, slots, rseq, rctx, uc, temp, key \
+                            = xs
+                        ids = jnp.where(uc, cur, ids_d)
+                        logits, kp, vp = dec._ragged_logits(
+                            weights, kp, vp, ids, pos, slots, rseq,
+                            rctx, tables, lora=lctx)
+                        nxt = self._sample(logits, temp, key)
+                        return (nxt, kp, vp), nxt
+
+                    (_, k, v), toks = jax.lax.scan(
+                        step, (first, k, v),
+                        (ids_all, pos_all, slots_all, rseq_all,
+                         rctx_all, use_carry, temps_all, keys))
+                    return toks, k, v          # [T, W]
+
+                def ragged_lora_chunk_rich(weights, k, v, lora_pool,
+                                           shard_ids, lora_tables,
+                                           prev_toks, last_t, prev_col,
+                                           use_host, override, ids_all,
+                                           pos_all, slots_all,
+                                           rseq_all, rctx_all,
+                                           use_carry, tables,
+                                           temps_all, keys, top_ks_all,
+                                           top_ps_all, reps_all, seen,
+                                           upd, allowed):
+                    """ragged_chunk_rich with per-row LoRA deltas."""
+                    lctx = _lora_ctx(lora_pool, shard_ids, lora_tables)
+                    first = jnp.where(use_host, override,
+                                      prev_toks[last_t, prev_col])
+                    w = use_host.shape[0]
+
+                    def step(carry, xs):
+                        cur, kp, vp, seen_c = carry
+                        (ids_d, pos, slots, rseq, rctx, uc, temp, key,
+                         tks, tps, rp) = xs
+                        ids = jnp.where(uc, cur, ids_d)
+                        logits, kp, vp = dec._ragged_logits(
+                            weights, kp, vp, ids, pos, slots, rseq,
+                            rctx, tables, lora=lctx)
+                        nxt = self._sample_rich(logits, temp, key, tks,
+                                                tps, rp, seen_c,
+                                                allowed)
+                        rows = jnp.arange(w)
+                        seen_c = seen_c.at[rows, nxt].set(
+                            seen_c[rows, nxt] | upd)
+                        return (nxt, kp, vp, seen_c), nxt
+
+                    (_, k, v, _), toks = jax.lax.scan(
+                        step, (first, k, v, seen),
+                        (ids_all, pos_all, slots_all, rseq_all,
+                         rctx_all, use_carry, temps_all, keys,
+                         top_ks_all, top_ps_all, reps_all))
+                    return toks, k, v          # [T, W]
+
+                if self.tp > 1:
+                    self._ragged_lora_j = jax.jit(
+                        dec.tp_wrap(ragged_lora_chunk, n_extra=15,
+                                    lora_pool=True),
+                        donate_argnums=(1, 2))
+                    self._ragged_lora_rich_j = jax.jit(
+                        dec.tp_wrap(ragged_lora_chunk_rich, n_extra=21,
+                                    lora_pool=True),
+                        donate_argnums=(1, 2))
+                else:
+                    self._ragged_lora_j = jax.jit(
+                        ragged_lora_chunk, donate_argnums=(1, 2))
+                    self._ragged_lora_rich_j = jax.jit(
+                        ragged_lora_chunk_rich, donate_argnums=(1, 2))
 
             if self.spec is not None:
                 scratch = self._scratch_slot
@@ -839,6 +1028,39 @@ class ServingEngine:
                     self._spec_j = jax.jit(spec_chunk,
                                            donate_argnums=(1, 2))
 
+                if self.lora is not None:
+                    def spec_lora_chunk(weights, k, v, lora_pool,
+                                        shard_ids, lora_tables,
+                                        override, use_ov, ids, pos,
+                                        slots, rseq, rctx, tables,
+                                        temps, key, seg_start,
+                                        is_draft):
+                        """spec_chunk with per-row LoRA deltas: draft
+                        rows verify against the ROW's adapter model
+                        (base + its tenant's delta), so acceptance is
+                        exact per tenant; the acceptance tail is
+                        adapter-agnostic."""
+                        lctx = _lora_ctx(lora_pool, shard_ids,
+                                         lora_tables)
+                        ids_in = jnp.where(use_ov, override, ids)
+                        logits, k, v = dec._ragged_logits(
+                            weights, k, v, ids_in, pos, slots, rseq,
+                            rctx, tables, lora=lctx)
+                        toks = self._sample(logits, temps, key)
+                        acc, k, v = dec._spec_accept(
+                            k, v, toks, ids, slots, seg_start,
+                            is_draft, scratch)
+                        return toks, acc, k, v
+
+                    if self.tp > 1:
+                        self._spec_lora_j = jax.jit(
+                            dec.tp_wrap(spec_lora_chunk, n_extra=13,
+                                        outs="takv", lora_pool=True),
+                            donate_argnums=(1, 2))
+                    else:
+                        self._spec_lora_j = jax.jit(
+                            spec_lora_chunk, donate_argnums=(1, 2))
+
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
         engine-static top_k."""
@@ -852,14 +1074,18 @@ class ServingEngine:
         return jnp.where(temp > 0.0, sampled, greedy)
 
     def _sample_rich(self, logits, temp, key, top_ks, top_ps, rep,
-                     seen):
+                     seen, allowed=None):
         """Per-request sampling, all mask-based so one compiled program
         serves every parameter combination (models/generation.py:26-46
         semantics): repetition penalty over the seen mask, per-slot
         top_k via the k-th order statistic of the sorted logits,
         per-slot top_p nucleus over the tempered distribution.
         logits [b, V] f32; temp/top_ps/rep [b] f32; top_ks [b] i32;
-        seen [b, V] bool."""
+        seen [b, V] bool; allowed [b, V] bool (the structured-decoding
+        vocab restriction — applied BEFORE the greedy argmax and the
+        filters, so constrained greedy is the argmax over the masked
+        logits and sampling renormalizes inside the mask; an all-True
+        row is the bitwise identity)."""
         v = logits.shape[-1]
         logits = logits.astype(jnp.float32)
         # repetition penalty (HF semantics: shrink positive logits,
@@ -867,6 +1093,8 @@ class ServingEngine:
         pen = jnp.where(logits > 0, logits / rep[:, None],
                         logits * rep[:, None])
         logits = jnp.where(seen & (rep != 1.0)[:, None], pen, logits)
+        if allowed is not None:
+            logits = jnp.where(allowed, logits, -1e30)
         greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         lt = logits / jnp.maximum(temp, 1e-6)[:, None]
         # ONE descending sort serves both filters
@@ -1048,6 +1276,10 @@ class ServingEngine:
             self._slots[si] = None
             self._fresh_slots.discard(si)
         req.slot = None
+        # adapter pin travels with the slot: the evicted life's pages
+        # park (evictable — "an adapter eviction preempts like a KV
+        # OOM"); re-admission re-acquires, reviving or refaulting
+        self._lora_release(req)
         if req.state == "prefilling":
             self._unwind_alloc(req, immediate=True)
         else:
@@ -1183,6 +1415,7 @@ class ServingEngine:
                 self._fresh_slots.discard(si)
             req.slot = None
             req.epoch += 1     # in-flight chunks must drop its tokens
+            self._lora_release(req)
             if req.state == "prefilling":
                 self._unwind_alloc(req)
             elif req.req_id in self.dec.cache._tables:
@@ -1243,11 +1476,31 @@ class ServingEngine:
         cache = self.dec.cache
         need = -(-(int(prompt.size) + sp.max_new_tokens)
                  // cache.block_size)
-        if need > cache.num_blocks - 1:  # -1: scratch page
+        # a tenant request must fit its KV *plus* its adapter's pages
+        # (both come out of the same pool) — reject impossible
+        # geometry at the door, like oversized prompts
+        lora_pages = 0
+        if sp.adapter_id is not None:
+            if self.lora is None:
+                raise ValueError(
+                    f"adapter_id={sp.adapter_id!r} but the engine has "
+                    f"no AdapterRegistry (pass lora= to ServingEngine)")
+            if not self.lora.is_registered(sp.adapter_id):
+                raise KeyError(
+                    f"unknown adapter {sp.adapter_id!r} — register it "
+                    f"before submitting requests")
+            lora_pages = self.lora.n_pages()
+        if need + lora_pages > cache.num_blocks - 1:  # -1: scratch page
             raise ValueError(
-                f"request needs {need} KV pages but the pool only has "
-                f"{cache.num_blocks - 1}; shrink max_new_tokens/prompt "
-                "or grow num_blocks")
+                f"request needs {need} KV pages"
+                + (f" + {lora_pages} adapter pages" if lora_pages
+                   else "")
+                + f" but the pool only has {cache.num_blocks - 1}; "
+                "shrink max_new_tokens/prompt or grow num_blocks")
+        allowed_mask = None
+        if sp.allowed_tokens is not None:
+            allowed_mask = self._normalize_allowed(
+                sp.allowed_tokens, self.dec.cfg.vocab_size)
         # overload shedding: reject at the door what cannot be served —
         # a hard queue-depth cap, and (for deadline'd requests, once the
         # engine has a measured token rate) a backlog/deadline estimate
@@ -1267,6 +1520,7 @@ class ServingEngine:
                     f"(backlog {len(self._queue)} queued)")
         rid = next(self._ids)
         req = Request(rid, prompt, sp, t_submit=time.perf_counter())
+        req.allowed_mask = allowed_mask
         self._queue.append(req)
         return rid
 
@@ -1332,15 +1586,35 @@ class ServingEngine:
                 total = int(len(toks)) + 1
             else:
                 total = int(req.prompt.size) + req.sampling.max_new_tokens
+            # adapter fault-in FIRST (ISSUE 10): its pages come out of
+            # the same pool the KV allocation below draws from, so the
+            # two claims must be ordered and individually unwound — an
+            # adapter that cannot fault in waits at the queue head
+            # exactly like a KV refusal (preemptions and frees
+            # downstream relieve both)
+            if req.sampling.adapter_id is not None:
+                try:
+                    self._lora_acquire(req)
+                except KVCacheExhausted:
+                    break  # head-of-line: keep FIFO, wait for frees
             if self.prefix_caching:
                 try:
                     # one hash walk: the capacity check happens inside
                     # allocate_with_prefix BEFORE any mutation, so a
-                    # refusal leaves the pool untouched
+                    # refusal leaves the pool untouched. The chain is
+                    # SALTED with the adapter id: a tenant's blocks
+                    # hold its adapter's K/V and must never splice
+                    # into another tenant's (or the base model's)
+                    # table
                     reused, n_cached = cache.allocate_with_prefix(
-                        req.req_id, toks, total)
+                        req.req_id, toks, total,
+                        salt=req.sampling.adapter_id)
                 except RuntimeError:
-                    break  # head-of-line: keep FIFO, wait for frees
+                    # keep FIFO; drop the adapter pin taken above (the
+                    # adapter stays parked-resident, so the retry next
+                    # step is a cheap revive)
+                    self._lora_release(req)
+                    break
                 req.deps = [self._pending_writes[b] for b in reused
                             if b in self._pending_writes]
                 # register OUR fresh full prefill blocks as splice-
@@ -1354,10 +1628,12 @@ class ServingEngine:
                     req.pending_blocks.append(table[j])
             else:
                 if cache.free_blocks < -(-total // cache.block_size):
+                    self._lora_release(req)
                     break
                 try:
                     cache.allocate(req.req_id, total)
                 except RuntimeError:
+                    self._lora_release(req)
                     break
                 n_cached = 0
             self._queue.popleft()
@@ -1618,6 +1894,9 @@ class ServingEngine:
             return
         seen_dev = jnp.asarray(seen) if any_rep \
             else self._zeros_seen(gp, vocab)
+        allowed_dev = self._allowed_operand(
+            gp, [(row, req.allowed_mask)
+                 for row, (_si, req, _off) in enumerate(group)])
         # the suffix-prefix program pays a per-layer page gather plus
         # dense attention over the (possibly all-masked) prefix columns:
         # only groups with at least one covered prefix take it —
@@ -1632,7 +1911,8 @@ class ServingEngine:
                     jnp.asarray(last_idx), jnp.asarray(ncv),
                     jnp.asarray(ptab), jnp.asarray(temps),
                     self._next_key(), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
+                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev,
+                    allowed_dev)
             else:
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:prefill", self._prefill_j,
@@ -1640,7 +1920,8 @@ class ServingEngine:
                     jnp.asarray(ids), jnp.asarray(slots),
                     jnp.asarray(last_idx), jnp.asarray(temps),
                     self._next_key(), jnp.asarray(top_ks),
-                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev)
+                    jnp.asarray(top_ps), jnp.asarray(reps), seen_dev,
+                    allowed_dev)
         except _DispatchFailed as e:
             # request mutations happen only after a SUCCESSFUL
             # dispatch, so coverage bookkeeping is still truthful here:
@@ -1694,6 +1975,7 @@ class ServingEngine:
         req.t_done = time.perf_counter()
         self._done[req.req_id] = req
         self._slots[si] = None
+        self._lora_release(req)
         if self._inflight:
             # an in-flight chunk still reads/writes this request's pages
             # (it was dispatched assuming continuation): free them only
@@ -1709,6 +1991,122 @@ class ServingEngine:
             cached = self._replicated(jnp.zeros((rows, vocab), bool))
             self._zeros_seen_cache[rows] = cached
         return cached
+
+    def _ones_allowed(self, rows: int, vocab: int):
+        """Cached device-resident all-True allowed mask: the identity
+        operand every rich dispatch without structured-decoding
+        requests ships (no [rows, vocab] host->device traffic)."""
+        cached = self._ones_allowed_cache.get(rows)
+        if cached is None:
+            cached = self._replicated(jnp.ones((rows, vocab), bool))
+            self._ones_allowed_cache[rows] = cached
+        return cached
+
+    def _allowed_operand(self, rows: int, entries):
+        """The allowed-vocab operand for one rich dispatch: ``entries``
+        is [(row, mask)] for the requests that restrict their vocab —
+        empty reuses the cached all-True identity, and a repeated
+        (rows, row->mask) layout reuses the memoized device operand
+        (masks are per-request immutable, so a long-running masked
+        stream uploads its [rows, vocab] operand once per layout, not
+        once per dispatch)."""
+        vocab = self.dec.cfg.vocab_size
+        entries = [(r, m) for r, m in entries if m is not None]
+        if not entries:
+            return self._ones_allowed(rows, vocab)
+        key = (rows, tuple(sorted((r, id(m)) for r, m in entries)))
+        cached = self._allowed_memo.get(key)
+        if cached is None:
+            if len(self._allowed_memo) >= 256:
+                # churn guard: an engine that never clear_finished()es
+                # must not accumulate one [rows, vocab] device array
+                # per dead layout forever
+                self._allowed_memo.clear()
+            allowed = np.ones((rows, vocab), bool)
+            for r, m in entries:
+                allowed[r] = m
+            cached = self._replicated(jnp.asarray(allowed)) \
+                if self.tp > 1 else jnp.asarray(allowed)
+            self._allowed_memo[key] = cached
+        return cached
+
+    @staticmethod
+    def _normalize_allowed(allowed_tokens, vocab: int) -> np.ndarray:
+        """allowed_tokens (bool mask of length vocab, or a sequence of
+        allowed token ids) -> [vocab] bool mask; rejects empty masks
+        and out-of-range ids at add_request time."""
+        arr = np.asarray(allowed_tokens)
+        if arr.dtype == bool:
+            if arr.shape != (vocab,):
+                raise ValueError(
+                    f"allowed_tokens bool mask must have shape "
+                    f"({vocab},), got {arr.shape}")
+            mask = arr.copy()
+        else:
+            if (arr.ndim == 1 and arr.size == vocab and vocab > 2
+                    and np.isin(arr, (0, 1)).all()):
+                # an INTEGER 0/1 vector of exactly vocab length is
+                # almost certainly a mask built with the wrong dtype —
+                # interpreting it as token IDS would silently constrain
+                # decoding to tokens {0, 1}
+                raise ValueError(
+                    f"allowed_tokens is a length-{vocab} integer 0/1 "
+                    f"vector — ambiguous between a mask and an id "
+                    f"list; pass a bool mask (astype(bool)) or a list "
+                    f"of allowed token ids")
+            ids = arr.astype(np.int64).reshape(-1)
+            if ids.size and (ids.min() < 0 or ids.max() >= vocab):
+                raise ValueError(
+                    f"allowed_tokens ids out of range [0, {vocab})")
+            mask = np.zeros(vocab, bool)
+            mask[ids] = True
+        if not mask.any():
+            raise ValueError("allowed_tokens permits no token — "
+                             "nothing could ever be sampled")
+        return mask
+
+    # -- multi-tenant adapter bookkeeping (ISSUE 10) -------------------------
+    def _lora_acquire(self, req: Request):
+        """Fault/pin the request's adapter at admission. Raises
+        KVCacheExhausted when its pages cannot be faulted in — the
+        caller treats it exactly like a KV allocation refusal."""
+        if req.sampling.adapter_id is None or req.lora_held:
+            return
+        self.lora.acquire(req.sampling.adapter_id)
+        req.lora_held = True
+
+    def _lora_release(self, req: Request):
+        """Drop the request's pin whenever it loses its slot (retire,
+        abort/fail, preemption/restart). At zero users the adapter's
+        pages park in the pool LRU — still resident, evictable."""
+        if req.lora_held:
+            self.lora.release(req.sampling.adapter_id)
+            req.lora_held = False
+
+    def _lora_tables_operand(self, sched) -> np.ndarray:
+        """[max_b + 1, n_pages] page table for this dispatch's lora
+        gather: engine slot -> its request's resident adapter pages
+        (scratch block — the all-zero null-adapter page — for
+        base-model slots and the scratch row)."""
+        width = self.lora.n_pages()
+        tables = np.full((self.max_b + 1, width), self._scratch_block,
+                         np.int32)
+        for rid, (req, _epoch) in sched.items():
+            aid = req.sampling.adapter_id
+            if aid is not None and req.slot is not None:
+                tables[req.slot] = self.lora.resident_blocks(aid)
+        return tables
+
+    def _debug_lora_check(self):
+        """Cross-check registry use counts against the scheduler's
+        slot truth, then the registry's own page invariants (the
+        ISSUE-10 half of the per-step debug sweep)."""
+        expected: Dict[object, int] = {}
+        for r in self._slots:
+            if r is not None and r.lora_held:
+                aid = r.sampling.adapter_id
+                expected[aid] = expected.get(aid, 0) + 1
+        self.lora.debug_check(expected_use=expected)
 
     def _replicated(self, arr):
         """Commit a cached device constant consistently with the
@@ -1942,13 +2340,20 @@ class ServingEngine:
                     # device-resident zeros mask instead of shipping
                     # [mb, vocab] bools through the tunnel every chunk
                     seen_dev = self._zeros_seen(mb, vocab)
+                allowed_dev = self._allowed_operand(
+                    mb, [(si, r.allowed_mask)
+                         for si, r in reqs_of.items()])
+                self.masked_decode_columns += sum(
+                    1 for si, r in reqs_of.items()
+                    if r.allowed_mask is not None
+                    and steps_of.get(si, 0) > 0)
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:decode", self._decode_rich_j,
                     self.dec.weights, cache.k, cache.v, first_ids,
                     jnp.asarray(tables), jnp.asarray(ctx),
                     jnp.asarray(slots), jnp.asarray(temps), keys,
                     jnp.asarray(top_ks), jnp.asarray(top_ps),
-                    jnp.asarray(reps), seen_dev)
+                    jnp.asarray(reps), seen_dev, allowed_dev)
             else:
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:decode", self._decode_j,
@@ -2352,13 +2757,28 @@ class ServingEngine:
 
         key = self._replicated(self._next_key())
         aj = self._aj
-        args = (self.dec.weights, cache.k, cache.v, aj(override),
-                aj(use_ov), aj(ids), aj(pos), aj(slots), aj(rseq),
-                aj(rctx), aj(tables), aj(temps), key, aj(seg_start),
-                aj(is_draft))
+        use_lora = self.lora is not None and any(
+            req.sampling.adapter_id is not None
+            for req, _e in sched.values())
+        pre = ()
+        prog = self._spec_j
+        if use_lora:
+            pre = (cache.lora_pool, self._shard_ids,
+                   aj(self._lora_tables_operand(sched)))
+            prog = self._spec_lora_j
+            self.lora_dispatches += 1
+            self.lora_rows += sum(
+                len(rows_of.get(rid, []))
+                for rid, (req, _e) in sched.items()
+                if req.sampling.adapter_id is not None)
+        args = (self.dec.weights, cache.k, cache.v) + pre + (
+            aj(override),
+            aj(use_ov), aj(ids), aj(pos), aj(slots), aj(rseq),
+            aj(rctx), aj(tables), aj(temps), key, aj(seg_start),
+            aj(is_draft))
         try:
             toks, acc, cache.k, cache.v = self._device_call(
-                "dispatch:spec", self._spec_j, *args)
+                "dispatch:spec", prog, *args)
         except _DispatchFailed as e:
             # one program: every surviving request riding it fails
             # together (the ragged chunk's failure contract)
@@ -2646,6 +3066,15 @@ class ServingEngine:
         rich = any(r.sampling.needs_rich_sampling
                    for r in reqs_of.values()) \
             or any(f[0].sampling.needs_rich_sampling for f in finals)
+        # multi-tenant routing (ISSUE 10): any surviving scheduled
+        # request with an adapter routes the whole chunk through the
+        # lora program family (base rows read the null page — zero
+        # delta); an all-base chunk keeps the UNCHANGED base program,
+        # so adapter_id=None traffic is bit-identical to a lora-less
+        # engine
+        use_lora = self.lora is not None and any(
+            req.sampling.adapter_id is not None
+            for req, _e in sched.values())
         prev_toks = prev["toks"] if prev is not None \
             else self._zeros_toks(T, W)
         # under tp the split keys (committed to the default device)
@@ -2654,10 +3083,20 @@ class ServingEngine:
         # stream, only the placement changes
         keys = self._replicated(jax.random.split(self._next_key(), T))
         aj = self._aj
-        args = (self.dec.weights, cache.k, cache.v, prev_toks,
-                aj(last_t), aj(prev_col), aj(use_host), aj(override),
-                aj(ids), aj(pos), aj(slots), aj(rseq), aj(rctx),
-                aj(ucar), aj(tables), aj(temps), keys)
+        pre = ()
+        if use_lora:
+            pre = (cache.lora_pool, self._shard_ids,
+                   aj(self._lora_tables_operand(sched)))
+            self.lora_dispatches += 1
+            self.lora_rows += sum(
+                len(rows_of.get(rid, []))
+                for rid, (req, _e) in sched.items()
+                if req.sampling.adapter_id is not None)
+        args = (self.dec.weights, cache.k, cache.v) + pre + (
+            prev_toks,
+            aj(last_t), aj(prev_col), aj(use_host), aj(override),
+            aj(ids), aj(pos), aj(slots), aj(rseq), aj(rctx),
+            aj(ucar), aj(tables), aj(temps), keys)
         try:
             if rich:
                 any_rep = any(r.sampling.repetition_penalty != 1.0
@@ -2679,13 +3118,28 @@ class ServingEngine:
                     seen_dev = aj(seen)
                 else:
                     seen_dev = self._zeros_seen(W, vocab)
+                # structured decoding: per-COLUMN allowed-vocab masks
+                # (decode columns and sampling finals; discarded cells
+                # of a shared column are masked harmlessly)
+                entries = [(c, reqs_of[si].allowed_mask)
+                           for si, c in col_of.items()]
+                entries += [(c, req.allowed_mask)
+                            for req, _, _t, c in finals]
+                allowed_dev = self._allowed_operand(W, entries)
+                self.masked_decode_columns += sum(
+                    1 for si, _c in col_of.items()
+                    if reqs_of[si].allowed_mask is not None)
+                prog = self._ragged_lora_rich_j if use_lora \
+                    else self._ragged_rich_j
                 toks, cache.k, cache.v = self._device_call(
-                    "dispatch:ragged", self._ragged_rich_j, *args,
+                    "dispatch:ragged", prog, *args,
                     aj(top_ks), aj(top_ps), aj(reps), seen_dev,
-                    aj(upd))
+                    aj(upd), allowed_dev)
             else:
+                prog = self._ragged_lora_j if use_lora \
+                    else self._ragged_j
                 toks, cache.k, cache.v = self._device_call(
-                    "dispatch:ragged", self._ragged_j, *args)
+                    "dispatch:ragged", prog, *args)
         except _DispatchFailed as e:
             # the unified chunk is ONE program: every surviving request
             # riding it fails together, with a structured error — the
@@ -3093,8 +3547,12 @@ class ServingEngine:
             # (free + cached + referenced == num_blocks, refs == table
             # contents, partial-prefill length bounds) after every
             # scheduler step — including between the chunks of a
-            # multi-step prefill
+            # multi-step prefill. With a lora registry, the adapter-
+            # page invariants (use counts vs slots, page refs/hashes,
+            # no zero-use allocations) ride the same sweep.
             self.dec.cache.debug_check()
+            if self.lora is not None:
+                self._debug_lora_check()
         return self.has_work
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
@@ -3260,9 +3718,29 @@ class ServingEngine:
                 finally:
                     self._force_chunk = None
                 self._chunk_cost[c] = max(delta / n_chunks, 1e-6)
+        # multi-tenant warmup (ISSUE 10): one short adapter-carrying
+        # request compiles the lora ragged program family so the first
+        # real tenant request pays no compile (base-only programs were
+        # warmed above; an all-base dispatch never runs the lora
+        # variant)
+        if self.lora is not None and self.lora.ids():
+            aid = self.lora.ids()[0]
+            need = self.lora.n_pages() \
+                + -(-(plens[0] + 2) // cache.block_size)
+            if cache.available_blocks < need:
+                _warnings.warn(
+                    "warmup: pool too small to warm the lora serving "
+                    "program; the first tenant request will pay that "
+                    "compile")
+            else:
+                self.add_request(
+                    self._warmup_prompt(plens[0]),
+                    SamplingParams(max_new_tokens=2, adapter_id=aid))
+                self.run_to_completion()
         # warmup traffic must leave no trace: parked throwaway blocks
         # would otherwise occupy LRU slots (and could in principle be
-        # spliced by a real request with the same fill pattern)
+        # spliced by a real request with the same fill pattern) —
+        # clear_prefix_cache also evicts warmup's parked adapter pages
         cache.clear_prefix_cache()
         self.clear_finished()
 
@@ -3293,6 +3771,16 @@ class ServingEngine:
         self.drafted_tokens = 0
         self.accepted_draft_tokens = 0
         self.spec_rollbacks = 0
+        # multi-tenant counters reset alongside everything else
+        self.lora_dispatches = 0
+        self.lora_rows = 0
+        self.masked_decode_columns = 0
+        # the memo keys masks by object identity; retained requests
+        # (and their masks) are dropped here, so the memo must go too
+        # (a recycled id must never alias a dead request's operand)
+        self._allowed_memo.clear()
+        if self.lora is not None:
+            self.lora.reset_stats()
         self.dec.cache.reset_prefix_stats()
 
     def stats(self) -> dict:
@@ -3387,6 +3875,27 @@ class ServingEngine:
                 self.accepted_draft_tokens / self.drafted_tokens
                 if self.drafted_tokens else 0.0),
             "spec_rollbacks": self.spec_rollbacks,
+            # -- multi-tenant LoRA serving (reset by clear_finished) --
+            # active_adapters: adapters pinned by >= 1 slotted request
+            # right now; hits/misses/evictions: registry residency
+            # traffic (hit = ref-bump or LRU revive, miss = fault-in
+            # upload, eviction = a previously-resident adapter found
+            # evicted at re-acquire); lora_rows_per_dispatch: ragged
+            # rows that carried a real adapter per lora dispatch — the
+            # mixed-tenant batching density; masked_decode_columns:
+            # scheduled decode columns under an allowed_tokens mask
+            "active_adapters": (self.lora.active_count()
+                                if self.lora is not None else 0),
+            "adapter_cache_hits": (self.lora.hits
+                                   if self.lora is not None else 0),
+            "adapter_cache_misses": (self.lora.misses
+                                     if self.lora is not None else 0),
+            "adapter_cache_evictions": (
+                self.lora.evictions if self.lora is not None else 0),
+            "lora_rows_per_dispatch": (
+                self.lora_rows / self.lora_dispatches
+                if self.lora_dispatches else 0.0),
+            "masked_decode_columns": self.masked_decode_columns,
             "decode_slot_steps": self.decode_slot_steps,
             # ragged-aware: on the ragged path slot_steps counts the
             # [T, W] grid actually dispatched (W sized by real rows)

@@ -240,6 +240,13 @@ class PagedKVCache:
         # top of every _take_block, BEFORE any mutation, so an injected
         # KVCacheExhausted leaves the pool untouched
         self.fault_hook = None
+        # optional LoRA adapter plane (ISSUE 10): a [num_blocks,
+        # page_elems] f32 device array sharing THIS allocator's block
+        # ids — a block either holds KV (rows of self.k/self.v) or an
+        # adapter page (its row here); ownership is whatever the
+        # ref-count says. None until enable_lora_pool.
+        self.lora_pool = None
+        self.lora_page_elems = 0
 
     # -- allocation ---------------------------------------------------------
     def _take_block(self) -> int:
@@ -290,15 +297,21 @@ class PagedKVCache:
         return self._tables[seq_id]
 
     # -- prefix caching ------------------------------------------------------
-    def _chain_hashes(self, tokens) -> List[int]:
+    def _chain_hashes(self, tokens, salt=None) -> List[int]:
         """Chain hash per FULL block of `tokens`:
         h_i = hash(h_{i-1}, tokens[i*bs:(i+1)*bs]); the chain makes a
         block's identity cover its whole prefix, so equal hashes mean
-        equal content AND equal position history."""
+        equal content AND equal position history. ``salt`` seeds the
+        chain root (multi-tenant serving passes the request's adapter
+        id): equal prompts under different salts hash to disjoint
+        chains, so prefix splices can never cross tenants — a block
+        prefilled through adapter X holds X's K/V, which is junk to
+        any other adapter's attention. salt=None (the default) keeps
+        the original chain values bit-for-bit."""
         bs = self.block_size
         toks = [int(t) for t in tokens]
         out: List[int] = []
-        h = None
+        h = None if salt is None else ("#tenant", salt)
         for i in range(len(toks) // bs):
             h = hash((h, tuple(toks[i * bs:(i + 1) * bs])))
             out.append(h)
@@ -316,12 +329,14 @@ class PagedKVCache:
             matched.pop()
         return matched
 
-    def match_prefix(self, tokens) -> List[Tuple[int, int]]:
+    def match_prefix(self, tokens, salt=None) -> List[Tuple[int, int]]:
         """Longest chain of already-cached full blocks covering a
         prefix of `tokens` — [(hash, block)], non-mutating. Capped so at
         least one token is left uncovered: the caller always prefills a
-        non-empty suffix (the last position's logits must be computed)."""
-        return self._match(self._chain_hashes(tokens), len(tokens))
+        non-empty suffix (the last position's logits must be computed).
+        ``salt`` namespaces the chain (see _chain_hashes)."""
+        return self._match(self._chain_hashes(tokens, salt),
+                           len(tokens))
 
     def _prefix_capacity(self, matched, num_tokens: int):
         """(fresh blocks needed, blocks claimable) for an allocation
@@ -332,14 +347,16 @@ class PagedKVCache:
                                          if b in self._lru)
         return needed, len(self._free) + evictable
 
-    def can_allocate_with_prefix(self, tokens, num_tokens: int) -> bool:
+    def can_allocate_with_prefix(self, tokens, num_tokens: int,
+                                 salt=None) -> bool:
         """Worst-case admission check that credits reusable blocks."""
-        needed, avail = self._prefix_capacity(self.match_prefix(tokens),
-                                              num_tokens)
+        needed, avail = self._prefix_capacity(
+            self.match_prefix(tokens, salt), num_tokens)
         return avail >= needed
 
     def allocate_with_prefix(self, seq_id: int, tokens,
-                             num_tokens: Optional[int] = None):
+                             num_tokens: Optional[int] = None,
+                             salt=None):
         """Reserve blocks for a prompt of `tokens` (worst-case capacity
         `num_tokens` ≥ len(tokens)), splicing in every cached block of
         the longest matching block-aligned prefix (ref++, no copy).
@@ -354,7 +371,7 @@ class PagedKVCache:
         if seq_id in self._tables:
             raise ValueError(f"seq {seq_id} already allocated")
         n_tok = len(tokens) if num_tokens is None else int(num_tokens)
-        hashes = self._chain_hashes(tokens)
+        hashes = self._chain_hashes(tokens, salt)
         matched = self._match(hashes, len(tokens))
         needed_new, avail = self._prefix_capacity(matched, n_tok)
         if avail < needed_new:
@@ -428,6 +445,71 @@ class PagedKVCache:
                     # blocks must all be hash-registered)
                     del self._lru[b]
                     self._free.append(b)
+
+    # -- LoRA adapter paging (ISSUE 10; see inference/lora.py) --------------
+    def enable_lora_pool(self, page_elems: int, sharding=None):
+        """Attach the adapter-page plane: [num_blocks, page_elems]
+        f32, zero-initialized (the scratch block's row stays zero
+        forever — it IS the null adapter every base-only row reads).
+        ``sharding`` replicates the plane over a tp mesh. Idempotent
+        for a matching page size; a mismatch raises (two registries
+        with different layouts cannot share one pool)."""
+        if self.lora_pool is not None:
+            if self.lora_page_elems != int(page_elems):
+                raise ValueError(
+                    f"lora pool already enabled with page_elems="
+                    f"{self.lora_page_elems}, got {page_elems}")
+            return
+        self.lora_page_elems = int(page_elems)
+        pool = jnp.zeros((self.num_blocks, self.lora_page_elems),
+                         jnp.float32)
+        if sharding is not None:
+            import jax
+            pool = jax.device_put(pool, sharding)
+        self.lora_pool = pool
+
+    def write_lora_pages(self, blocks: List[int], pages):
+        """Upload host page data ([n, page_elems]) into the plane rows
+        of ``blocks`` — the adapter fault-in path. Functional scatter:
+        the plane is never donated, so a retried upload is safe."""
+        idx = jnp.asarray(np.asarray(blocks, np.int32))
+        self.lora_pool = self.lora_pool.at[idx].set(
+            jnp.asarray(np.asarray(pages, np.float32)))
+
+    def lookup_hash(self, h) -> Optional[int]:
+        """The block currently registered under chain hash ``h`` (KV
+        prefix or synthetic adapter-page hash), else None."""
+        return self._block_of.get(h)
+
+    def register_page_hashes(self, blocks: List[int], hashes):
+        """Register synthetic hashes onto referenced blocks (adapter
+        fault-in): when the owning pseudo-sequence later frees, the
+        pages PARK in the cached-LRU instead of dropping to the free
+        list — resident-but-cold, revivable via adopt_cached_blocks,
+        evictable by anyone. Skips hashes/blocks already taken (same
+        contract as the prompt-suffix registration path)."""
+        for b, h in zip(blocks, hashes):
+            if h not in self._block_of and b not in self._hash_of:
+                self._block_of[h] = b
+                self._hash_of[b] = h
+
+    def adopt_cached_blocks(self, seq_id: int, blocks: List[int]):
+        """Claim PARKED (cached, ref-0) blocks as ``seq_id``'s table —
+        the adapter-revival fast path (a cold adapter's pages come
+        straight back out of the LRU; no upload, no allocation).
+        All-or-nothing: every block must currently be parked."""
+        if seq_id in self._tables:
+            raise ValueError(f"seq {seq_id} already allocated")
+        for b in blocks:
+            if b not in self._lru:
+                raise KeyError(f"block {b} is not parked in the "
+                               f"cached-LRU")
+        for b in blocks:
+            del self._lru[b]
+            self._ref[b] = 1
+        self._tables[seq_id] = list(blocks)
+        self._lens[seq_id] = 0
+        return self._tables[seq_id]
 
     def extend(self, seq_id: int):
         """Ensure room for one more token; returns the flat slot id."""

@@ -88,6 +88,12 @@ def _full_script(**overrides):
         "serving_tp": [(_simple(
             "serving_tp2_tok_per_sec", 119.0,
             {"serving_tp2_tok_per_sec": 119.0}), "")],
+        # serving_lora joined AUTO_MODES in the ISSUE-10 PR — scripted
+        # from day one (the PR-9 lesson)
+        "serving_lora": [(_simple(
+            "serving_lora_lora_tok_per_sec", 95.0,
+            {"serving_lora_lora_tok_per_sec": 95.0,
+             "serving_lora_adapter_hit_rate": 0.6}), "")],
         "pp": [(_simple("pp_remat_overhead_x", 0.991,
                         {"pp_remat_overhead_x": 0.991,
                          "pp_tick_fwd_ms": 0.086,

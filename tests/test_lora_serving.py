@@ -1,0 +1,398 @@
+"""Multi-tenant many-LoRA serving (ISSUE 10): registry paging through
+the shared block pool, per-row adapter deltas riding the ragged step,
+cross-tenant prefix isolation, the structured-decoding mask hook, and
+composition with preemption / speculative decoding / tensor
+parallelism. Runs in the invariant gate (check_serving_invariants.py)
+with PADDLE_TPU_POOL_DEBUG=1, so every engine step also asserts the
+pool AND adapter-page invariants."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.inference import (AdapterRegistry, PagedGPTDecoder,
+                                  SamplingParams, ServingEngine,
+                                  SpecConfig)
+from paddle_tpu.inference.lora import LoRALayout
+from paddle_tpu.ops.paged_attention import KVCacheExhausted
+
+
+CFG = llama_tiny(hidden_size=64, num_attention_heads=4,
+                 num_key_value_heads=2, intermediate_size=96,
+                 num_hidden_layers=2, vocab_size=256,
+                 max_position_embeddings=256)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    return [rng.randint(0, CFG.vocab_size, ln).astype(np.int32)
+            for ln in (12, 9, 17, 21, 7, 14)[:n]]
+
+
+def _registry(rank=2, scale=0.2, n=2):
+    reg = AdapterRegistry(rank=rank)
+    for i in range(n):
+        reg.register_random(f"a{i}", seed=100 + i, scale=scale)
+    return reg
+
+
+def _engine(model, lora=None, **kw):
+    kw.setdefault("max_batch_size", 3)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", (16, 32))
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("ragged", True)
+    return ServingEngine(model, lora=lora, **kw)
+
+
+def _serve(eng, prompts, aids=None, masks=None, max_new=8, temps=None):
+    aids = aids or [None] * len(prompts)
+    masks = masks or [None] * len(prompts)
+    temps = temps or [0.0] * len(prompts)
+    rids = [eng.add_request(
+        p, SamplingParams(max_new_tokens=max_new, adapter_id=a,
+                          allowed_tokens=m, temperature=t))
+        for p, a, m, t in zip(prompts, aids, masks, temps)]
+    eng.run_to_completion()
+    return [eng.result(r).tolist() for r in rids]
+
+
+# -- layout / registry units ----------------------------------------------
+
+class TestLayoutAndRegistry:
+    def test_layout_offsets_disjoint_and_total(self):
+        lay = LoRALayout(
+            (("wq", 8, 8, "col"), ("wo", 8, 8, "row")), num_layers=2,
+            rank=2, page_elems=32)
+        spans = []
+        for li in range(2):
+            for name, din, dout, _ in lay.modules:
+                offA, offB, di, do, _k = lay.entry(li, name)
+                spans.append((offA, offA + di * lay.rank))
+                spans.append((offB, offB + lay.rank * do))
+        spans.sort()
+        assert spans[0][0] == 0 and spans[-1][1] == lay.total
+        for (a0, a1), (b0, b1) in zip(spans, spans[1:]):
+            assert a1 == b0, "layout slabs must tile [0, total)"
+        assert lay.n_pages == -(-lay.total // 32)
+
+    def test_layout_tp_divisibility(self):
+        lay = LoRALayout((("wq", 8, 6, "col"),), 1, 2, 16)
+        with pytest.raises(ValueError, match="not divisible"):
+            lay.check_tp(4)
+
+    def test_register_validation(self):
+        reg = AdapterRegistry(rank=2)
+        reg.register_random("a", seed=0)
+        with pytest.raises(ValueError, match="already registered"):
+            reg.register_random("a", seed=1)
+        with pytest.raises(ValueError, match="base model"):
+            reg.register(None, {})
+        # rank above the registry's is rejected at flatten time
+        reg2 = AdapterRegistry(rank=1)
+        A = np.zeros((CFG.hidden_size, 3), np.float32)
+        B = np.zeros((3, CFG.hidden_size), np.float32)
+        reg2.register("big", {"wq": (A, B)})
+        dec = ServingEngine(LlamaForCausalLM(CFG), ragged=True,
+                            num_blocks=32, block_size=8,
+                            prompt_buckets=(16,), lora=reg2).lora
+        with pytest.raises(ValueError, match="r <= 1"):
+            dec.acquire("big")
+
+    def test_paging_lifecycle_hit_miss_evict(self, model):
+        reg = _registry(n=2)
+        eng = _engine(model, lora=reg)
+        cache = eng.dec.cache
+        reg.acquire("a0")                       # fault-in
+        assert reg.misses == 1 and reg.in_use("a0") == 1
+        n_pages = reg.n_pages()
+        reg.acquire("a0")                       # ref bump
+        assert reg.hits == 1
+        reg.release("a0")
+        reg.release("a0")                       # parks in the LRU
+        assert cache.cached_blocks >= n_pages
+        reg.debug_check()
+        cache.debug_check()
+        reg.acquire("a0")                       # revive from the LRU
+        assert reg.hits == 2 and reg.misses == 1
+        reg.release("a0")
+        # pool pressure evicts the parked pages (the big allocation
+        # drains the free list INTO the LRU) -> once it frees, the
+        # next acquire detects the eviction and refaults
+        cache.allocate(999, (cache.free_blocks + cache.cached_blocks)
+                       * cache.block_size)
+        cache.free(999)
+        reg.acquire("a0")
+        assert reg.evictions == 1 and reg.misses == 2
+        reg.release("a0")
+        cache.debug_check()
+        with pytest.raises(ValueError, match="released more"):
+            reg.release("a0")
+
+    def test_acquire_exhaustion_raises(self, model):
+        reg = _registry(n=1)
+        eng = _engine(model, lora=reg, num_blocks=8)
+        cache = eng.dec.cache
+        cache.allocate(999, (cache.free_blocks + cache.cached_blocks)
+                       * cache.block_size)
+        with pytest.raises(KVCacheExhausted):
+            reg.acquire("a0")
+        cache.free(999)
+
+
+# -- engine behavior ------------------------------------------------------
+
+class TestLoRAServing:
+    def test_base_traffic_bit_identical_with_registry(self, model):
+        prompts = _prompts(3)
+        base = _serve(_engine(model), prompts,
+                      temps=[0.0, 1.0, 0.0])
+        with_reg = _serve(_engine(model, lora=_registry()), prompts,
+                          temps=[0.0, 1.0, 0.0])
+        assert base == with_reg
+
+    def test_mixed_batch_base_rows_identical(self, model):
+        prompts = _prompts(3)
+        base = _serve(_engine(model), prompts)
+        mixed = _serve(_engine(model, lora=_registry()), prompts,
+                       aids=["a0", None, "a1"])
+        assert mixed[1] == base[1]
+        assert mixed[0] != base[0]      # scale 0.2 flips argmaxes
+        assert mixed[2] != base[2]
+
+    def test_same_adapter_same_output_across_requests(self, model):
+        prompts = [_prompts(1)[0]] * 2
+        eng = _engine(model, lora=_registry())
+        outs = _serve(eng, prompts, aids=["a0", "a0"])
+        assert outs[0] == outs[1]
+        st = eng.stats()
+        assert st["adapter_cache_hits"] >= 1
+        assert st["lora_rows_per_dispatch"] > 0
+
+    def test_merged_weights_equivalence(self):
+        """Serving through (A, B) must equal serving the model whose
+        weights were merged W + (alpha/r) A @ B — the end-to-end pin
+        on packing order, slice offsets and delta orientation."""
+        import jax.numpy as jnp
+        rng = np.random.RandomState(7)
+        p = rng.randint(0, CFG.vocab_size, 11).astype(np.int32)
+        h, it = CFG.hidden_size, CFG.intermediate_size
+        kvd = CFG.num_key_value_heads * (h // CFG.num_attention_heads)
+        ab = {}
+        for name, din, dout in (("wq", h, h), ("wk", h, kvd),
+                                ("wv", h, kvd), ("wo", h, h),
+                                ("wg", h, it), ("wu", h, it),
+                                ("wd", it, h)):
+            ab[name] = (rng.randn(din, 2).astype(np.float32) * 0.1,
+                        rng.randn(2, dout).astype(np.float32) * 0.1)
+        paddle.seed(0)
+        m1 = LlamaForCausalLM(CFG)
+        m1.eval()
+        reg = AdapterRegistry(rank=2, alpha=2)    # scale exactly 1.0
+        reg.register("t", ab)
+        out_lora = _serve(_engine(m1, lora=reg), [p], aids=["t"],
+                          max_new=10)[0]
+        paddle.seed(0)
+        m2 = LlamaForCausalLM(CFG)
+        m2.eval()
+        for lyr in m2.model.layers:
+            at, mlp = lyr.self_attn, lyr.mlp
+            for name, mod in (("wq", at.q_proj), ("wk", at.k_proj),
+                              ("wv", at.v_proj), ("wo", at.o_proj),
+                              ("wg", mlp.gate_proj),
+                              ("wu", mlp.up_proj),
+                              ("wd", mlp.down_proj)):
+                A, B = ab[name]
+                mod.weight._value = mod.weight._value \
+                    + jnp.asarray(A @ B)
+        out_merged = _serve(_engine(m2), [p], max_new=10)[0]
+        assert out_lora == out_merged
+
+    def test_cross_tenant_prefix_isolation(self, model):
+        """Identical prompts under different adapter ids must NOT
+        splice each other's blocks (the chain hash is salted with the
+        adapter id); the same tenant resubmitting DOES splice."""
+        p = _prompts(1)[0]
+        long_p = np.tile(p, 3)[:24]     # 3 full blocks at bs=8
+        eng = _engine(model, lora=_registry())
+        _serve(eng, [long_p], aids=["a0"])
+        hit0 = eng.dec.cache.prefix_hit_tokens
+        _serve(eng, [long_p], aids=["a1"])      # other tenant: no hit
+        assert eng.dec.cache.prefix_hit_tokens == hit0
+        _serve(eng, [long_p], aids=[None])      # base model: no hit
+        assert eng.dec.cache.prefix_hit_tokens == hit0
+        _serve(eng, [long_p], aids=["a0"])      # same tenant: splices
+        assert eng.dec.cache.prefix_hit_tokens > hit0
+
+    def test_preemption_resume_with_adapter_identity(self, model):
+        """Adapter requests preempted under KV pressure (optimistic
+        admission, tight pool) resume token-identically — the adapter
+        refaults on re-admission like a KV OOM recompute."""
+        prompts = _prompts(3, seed=3)
+        loose = _serve(_engine(model, lora=_registry(),
+                               num_blocks=64), prompts,
+                       aids=["a0", "a1", "a0"], max_new=12)
+        eng = _engine(model, lora=_registry(), num_blocks=26,
+                      admission="optimistic")
+        tight = _serve(eng, prompts, aids=["a0", "a1", "a0"],
+                       max_new=12)
+        assert tight == loose
+
+    def test_add_request_validation(self, model):
+        eng = _engine(model)                     # no registry
+        with pytest.raises(ValueError, match="no AdapterRegistry"):
+            eng.add_request(_prompts(1)[0],
+                            SamplingParams(adapter_id="a0"))
+        eng2 = _engine(model, lora=_registry())
+        with pytest.raises(KeyError, match="unknown adapter"):
+            eng2.add_request(_prompts(1)[0],
+                             SamplingParams(adapter_id="nope"))
+
+    def test_stats_plumbing_and_reset(self, model):
+        eng = _engine(model, lora=_registry())
+        mask = np.zeros(CFG.vocab_size, bool)
+        mask[::2] = True
+        _serve(eng, _prompts(2), aids=["a0", None],
+               masks=[mask, None])
+        st = eng.stats()
+        assert st["adapter_cache_misses"] >= 1
+        assert st["lora_rows_per_dispatch"] > 0
+        assert st["masked_decode_columns"] >= 1
+        assert st["active_adapters"] == 0        # all retired
+        eng.clear_finished()
+        st = eng.stats()
+        for k in ("adapter_cache_hits", "adapter_cache_misses",
+                  "adapter_cache_evictions", "lora_rows_per_dispatch",
+                  "masked_decode_columns"):
+            assert st[k] == 0
+
+
+# -- structured decoding --------------------------------------------------
+
+class TestAllowedTokens:
+    def test_all_ones_mask_changes_nothing(self, model):
+        prompts = _prompts(2)
+        ones = np.ones(CFG.vocab_size, bool)
+        plain = _serve(_engine(model), prompts,
+                       temps=[0.0, 1.0])
+        masked = _serve(_engine(model), prompts,
+                        masks=[ones, ones], temps=[0.0, 1.0])
+        assert plain == masked
+
+    def test_constrained_greedy_stays_inside_mask(self, model):
+        rng = np.random.RandomState(5)
+        mask = rng.random_sample(CFG.vocab_size) < 0.25
+        mask[7] = True
+        eng = _engine(model, lora=_registry())
+        outs = _serve(eng, _prompts(2), aids=["a0", None],
+                      masks=[mask, mask], max_new=12)
+        for out in outs:
+            assert mask[np.asarray(out)].all()
+
+    def test_constrained_sampling_stays_inside_mask(self, model):
+        mask = np.zeros(CFG.vocab_size, bool)
+        mask[10:20] = True
+        outs = _serve(_engine(model), _prompts(1), masks=[mask],
+                      temps=[1.0], max_new=16)
+        assert mask[np.asarray(outs[0])].all()
+
+    def test_token_id_list_form_and_validation(self, model):
+        eng = _engine(model)
+        outs = _serve(eng, _prompts(1),
+                      masks=[np.arange(0, CFG.vocab_size, 2)],
+                      max_new=8)
+        assert all(t % 2 == 0 for t in outs[0])
+        with pytest.raises(ValueError, match="permits no token"):
+            eng.add_request(_prompts(1)[0], SamplingParams(
+                allowed_tokens=np.zeros(CFG.vocab_size, bool)))
+        with pytest.raises(ValueError, match="out of range"):
+            eng.add_request(_prompts(1)[0], SamplingParams(
+                allowed_tokens=[CFG.vocab_size + 5]))
+
+
+# -- composition ----------------------------------------------------------
+
+class TestComposition:
+    def test_spec_decode_composes(self, model):
+        prompts = _prompts(3, seed=4)
+        aids = ["a0", None, "a1"]
+        off = _serve(_engine(model, lora=_registry()), prompts,
+                     aids=aids, max_new=12)
+        on = _serve(_engine(model, lora=_registry(),
+                            spec_decode=SpecConfig(draft_len=3)),
+                    prompts, aids=aids, max_new=12)
+        assert on == off
+
+    def test_tp2_identity(self, model):
+        if len(__import__("jax").devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        prompts = _prompts(3, seed=2)
+        aids = ["a0", None, "a1"]
+        t1 = _serve(_engine(model, lora=_registry()), prompts,
+                    aids=aids)
+        t2 = _serve(_engine(model, lora=_registry(), tp=2), prompts,
+                    aids=aids)
+        assert t1 == t2
+
+    def test_gpt_twin(self):
+        cfg = GPTConfig(vocab_size=128, hidden_size=32,
+                        intermediate_size=64, num_hidden_layers=2,
+                        num_attention_heads=4,
+                        max_position_embeddings=64)
+        paddle.seed(0)
+        gm = GPTForCausalLM(cfg)
+        gm.eval()
+        dec = PagedGPTDecoder(gm, num_blocks=48, block_size=8)
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, cfg.vocab_size, n).astype(np.int32)
+                   for n in (9, 13)]
+        reg = AdapterRegistry(rank=2)
+        reg.register_random("g0", seed=9, scale=0.3)
+
+        def run(lora, aids):
+            d = PagedGPTDecoder(gm, num_blocks=48, block_size=8)
+            eng = ServingEngine(d, max_batch_size=2,
+                                prompt_buckets=(16,), chunk_size=4,
+                                prefill_chunk=8, ragged=True,
+                                lora=lora)
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=8, adapter_id=a))
+                for p, a in zip(prompts, aids)]
+            eng.run_to_completion()
+            return [eng.result(r).tolist() for r in rids]
+
+        base = run(None, [None, None])
+        reg2 = AdapterRegistry(rank=2)
+        reg2.register_random("g0", seed=9, scale=0.3)
+        mixed = run(reg2, ["g0", None])
+        assert mixed[1] == base[1]
+        assert mixed[0] != base[0]
+
+    def test_debug_invariants_under_mixed_load(self, model,
+                                               monkeypatch):
+        monkeypatch.setenv("PADDLE_TPU_POOL_DEBUG", "1")
+        eng = _engine(model, lora=_registry(), num_blocks=30,
+                      admission="optimistic")
+        assert eng._debug_pool
+        prompts = _prompts(5, seed=6)
+        rids = [eng.add_request(
+            p, SamplingParams(max_new_tokens=10,
+                              adapter_id=["a0", None, "a1", "a0",
+                                          "a1"][i]))
+            for i, p in enumerate(prompts)]
+        while eng.step():        # debug_check + lora check every step
+            pass
+        assert all(eng.request(r).state == "done" for r in rids)

@@ -42,8 +42,25 @@ import numpy as np  # noqa: E402
 def build_engine(model, args):
     from paddle_tpu.inference import ServingEngine, SpecConfig
     # getattr defaults: programmatic callers (the slow fault-tolerance
-    # test builds a bare Namespace) predate the --ragged/--tp/--spec
-    # flags and must keep running on the dense single-chip engine
+    # test builds a bare Namespace) predate the
+    # --ragged/--tp/--spec/--lora flags and must keep running on the
+    # dense single-chip engine
+    lora = None
+    if getattr(args, "lora", False):
+        from paddle_tpu.inference import AdapterRegistry
+        # rank-1 adapters keep the page footprint small enough that a
+        # tight pool constantly evicts cold adapters — exactly the
+        # S-LoRA pressure path this leg exists to exercise. Both the
+        # chaos run and the fault-free replay build IDENTICAL
+        # registries (seeded), so token identity is well-defined.
+        lora = AdapterRegistry(rank=1)
+        lora.register_random("a0", seed=101, scale=0.1)
+        lora.register_random("a1", seed=102, scale=0.1)
+        # a2 is deliberately RARE traffic: it spends long stretches
+        # cold/parked, so pool pressure actually evicts it and its
+        # next request exercises the refault path (the
+        # adapter_eviction event --require-events demands)
+        lora.register_random("a2", seed=103, scale=0.1)
     return ServingEngine(
         model, max_batch_size=3, num_blocks=args.num_blocks,
         block_size=8, prompt_buckets=(8, 16, 32), chunk_size=4,
@@ -55,7 +72,8 @@ def build_engine(model, args):
         or getattr(args, "tp", 1) > 1,
         tp=getattr(args, "tp", 1),
         spec_decode=SpecConfig(draft_len=4)
-        if getattr(args, "spec", False) else None)
+        if getattr(args, "spec", False) else None,
+        lora=lora)
 
 
 def gen_workload(args):
@@ -68,7 +86,8 @@ def gen_workload(args):
     # writers with dependent readers — the riskiest recovery paths
     templates = [rng.randint(0, args.vocab, (24,)).astype(np.int32)
                  for _ in range(2)]
-    arrivals = []   # (step, prompt, max_new)
+    arrivals = []   # (step, prompt, max_new, adapter_id, allowed)
+    lora = getattr(args, "lora", False)
     step = 0
     while len(arrivals) < args.requests:
         step += int(rng.randint(1, max(2, args.steps // args.requests)))
@@ -82,7 +101,22 @@ def gen_workload(args):
         # prefill's pages, so long decodes are what actually
         # oversubscribe the pool and exercise preemption
         max_new = int(rng.randint(8, 33))
-        arrivals.append((step % max(1, args.steps - 5), prompt, max_new))
+        adapter = None
+        allowed = None
+        if lora:
+            # extra draws ONLY on the lora leg (keyed off args.lora),
+            # so the other legs' seeded schedules are unchanged:
+            # ~2/3 of traffic is tenant traffic over 2 adapters, and
+            # ~1/4 additionally carries a structured-decoding vocab
+            # mask (half-vocab; greedy stays deterministic, so the
+            # fault-free replay is still well-defined)
+            adapter = [None, "a0", "a0", "a1", "a1",
+                       "a2"][int(rng.randint(6))]
+            if rng.random_sample() < 0.25:
+                allowed = rng.random_sample(args.vocab) < 0.5
+                allowed[int(rng.randint(args.vocab))] = True  # nonempty
+        arrivals.append((step % max(1, args.steps - 5), prompt,
+                         max_new, adapter, allowed))
     arrivals.sort(key=lambda a: a[0])
     # cancel ~10% of arrivals a few steps after they land; small
     # schedules can draw zero, so force one mid-window cancel — the
@@ -121,9 +155,12 @@ def run_schedule(model, args, chaotic: bool):
         nonlocal next_arrival
         while next_arrival < len(arrivals) \
                 and arrivals[next_arrival][0] <= step:
-            _, prompt, max_new = arrivals[next_arrival]
+            _, prompt, max_new, adapter, allowed = \
+                arrivals[next_arrival]
             rid_of[next_arrival] = eng.add_request(
-                prompt, SamplingParams(max_new_tokens=max_new))
+                prompt, SamplingParams(max_new_tokens=max_new,
+                                       adapter_id=adapter,
+                                       allowed_tokens=allowed))
             next_arrival += 1
         if chaotic:
             for ordinal in cancels.get(step, ()):
@@ -161,7 +198,14 @@ def main() -> int:
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--requests", type=int, default=16)
     ap.add_argument("--seed", type=int, default=0)
-    ap.add_argument("--num-blocks", type=int, default=14)
+    # default pool: 14 blocks; the lora leg defaults to 24 — the two
+    # 3-page adapters permanently displace KV capacity (that is the
+    # unified-pool design), and at 14 the displaced pool tips the
+    # oldest-runner self-preemption cycle into a genuine no-progress
+    # regime (nothing to do with faults: the fault-free replay wedges
+    # too). 24 keeps real eviction/refault pressure without starving
+    # the oldest request of the headroom it needs to ever finish.
+    ap.add_argument("--num-blocks", type=int, default=None)
     ap.add_argument("--retries", type=int, default=1)
     ap.add_argument("--p-oom", type=float, default=0.05)
     ap.add_argument("--p-dispatch", type=float, default=0.04)
@@ -177,6 +221,17 @@ def main() -> int:
                          "OOM-preemption, injected dispatch faults and "
                          "cancellation must stay token-identical under "
                          "sharding (implies the ragged path)")
+    ap.add_argument("--lora", action="store_true",
+                    help="exercise multi-tenant many-LoRA serving "
+                         "(ISSUE 10): both runs attach a seeded "
+                         "3-adapter registry, ~2/3 of arrivals carry "
+                         "an adapter id (some with allowed_tokens "
+                         "masks), and the whole fault schedule — "
+                         "adapter eviction under pool pressure, "
+                         "OOM-preemption with adapter refault on "
+                         "resume, cancellation — must stay "
+                         "token-identical vs the fault-free replay "
+                         "(implies ragged)")
     ap.add_argument("--spec", action="store_true",
                     help="exercise speculative decoding (ISSUE 9): "
                          "both runs serve with "
@@ -192,6 +247,8 @@ def main() -> int:
                          "actually happened (with --spec, also >=1 "
                          "draft rejection)")
     args = ap.parse_args()
+    if args.num_blocks is None:
+        args.num_blocks = 24 if args.lora else 14
     args.vocab = None
 
     if args.tp > 1:
@@ -226,9 +283,15 @@ def main() -> int:
             faulted += 1
     st = eng.stats()
     summary = {
-        "ragged": args.ragged or args.tp > 1 or args.spec,
+        "ragged": args.ragged or args.tp > 1 or args.spec or args.lora,
         "tp": args.tp,
         "spec": bool(args.spec),
+        "lora": bool(args.lora),
+        "active_adapters": st["active_adapters"],
+        "adapter_cache_hits": st["adapter_cache_hits"],
+        "adapter_cache_misses": st["adapter_cache_misses"],
+        "adapter_cache_evictions": st["adapter_cache_evictions"],
+        "masked_decode_columns": st["masked_decode_columns"],
         "drafted_tokens": st["drafted_tokens"],
         "accepted_draft_tokens": st["accepted_draft_tokens"],
         "spec_rollbacks": st["spec_rollbacks"],
@@ -257,6 +320,16 @@ def main() -> int:
             # the spec leg must actually exercise the rejected-tail
             # rollback path, not just ride accepted drafts
             missing.append("draft_rejection")
+        if args.lora:
+            # the lora leg must actually exercise adapter paging, not
+            # just ride two permanently-resident adapters: at least
+            # one previously-resident adapter must have been found
+            # EVICTED at re-acquire (refaulted from host) under the
+            # pool pressure the tight num_blocks creates
+            if st["adapter_cache_evictions"] < 1:
+                missing.append("adapter_eviction")
+            if st["masked_decode_columns"] < 1:
+                missing.append("masked_decode")
         if missing:
             summary["missing_events"] = missing
             ok = False

@@ -49,6 +49,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_ragged_batching.py"),
     os.path.join(REPO, "tests", "test_tp_serving.py"),
     os.path.join(REPO, "tests", "test_spec_decode.py"),
+    os.path.join(REPO, "tests", "test_lora_serving.py"),
 ]
 
 
@@ -105,11 +106,23 @@ def run_chaos() -> int:
     verify program through the whole fault schedule, and
     --require-events demands >=1 draft rejection on top of the
     preemption/fault/cancel events, so the rejected-tail
-    KV/position rollback is exercised with faults in flight."""
+    KV/position rollback is exercised with faults in flight.
+    ISSUE 10 added the --lora leg: multi-tenant traffic over a
+    3-adapter registry (some requests masked via allowed_tokens) —
+    --require-events additionally demands >=1 adapter eviction-
+    and-refault and >=1 masked decode column, so S-LoRA paging
+    churns under the same faults."""
     import subprocess
     rc_all = 0
+    # the lora leg (ISSUE 10) runs more requests on a 20-block pool:
+    # the two knobs that make a previously-resident adapter actually
+    # get EVICTED and refaulted mid-schedule (--require-events demands
+    # it) without tipping the oldest-runner preemption cycle into the
+    # no-progress regime a 14-block pool + 9 adapter pages produces
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
-                     ("tp2", ("--tp", "2")), ("spec", ("--spec",))):
+                     ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
+                     ("lora", ("--lora", "--num-blocks", "20",
+                               "--requests", "12"))):
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",

@@ -390,9 +390,59 @@ def _build_tp_serving():
             return eng._spec_j, args
         return build
 
+    def _mk_lora():
+        def build():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from paddle_tpu.inference.lora import AdapterRegistry
+            from paddle_tpu.inference.paged_decode import \
+                PagedLlamaDecoder
+            from paddle_tpu.inference.serving import ServingEngine
+            from paddle_tpu.models.llama import LlamaConfig
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+            mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+            dec = PagedLlamaDecoder.from_config(
+                cfg, num_blocks=8, block_size=4, mesh=mesh,
+                mp_axis="tp", tp_shard_map=True, tp_comm="fp32")
+            reg = AdapterRegistry(rank=2)
+            reg.register_random("tenant0", seed=0)
+            eng = ServingEngine(dec, tp=2, max_batch_size=2,
+                                prompt_buckets=(8, 16), chunk_size=2,
+                                prefill_chunk=4, lora=reg)
+            T, W = 2, 4
+            lay = reg.layout
+            S = jax.ShapeDtypeStruct
+            i32, f32 = jnp.int32, jnp.float32
+            args = (dec.weights, dec.cache.k, dec.cache.v,
+                    S((dec.cache.num_blocks, lay.page_elems), f32),
+                    S((2,), i32),
+                    S((eng.max_b + 1, lay.n_pages), i32),
+                    S((T, W), i32), S((W,), i32), S((W,), i32),
+                    S((W,), jnp.bool_), S((W,), i32),
+                    S((T, W), i32), S((T, W), i32), S((T, W), i32),
+                    S((T, W), i32), S((T, W), i32),
+                    S((T, W), jnp.bool_),
+                    S((eng.max_b + 1, dec.max_pages), i32),
+                    S((T, W), f32), S((T, 2), jnp.uint32))
+            return eng._ragged_lora_j, args
+        return build
+
     return {"serving.ragged_tp2_fp32": _mk("fp32"),
             "serving.ragged_tp2_int8": _mk("int8"),
-            "serving.ragged_spec_tp2": _mk_spec()}
+            "serving.ragged_spec_tp2": _mk_spec(),
+            # ISSUE 10: the multi-tenant lora twin of the fp32 ragged
+            # step MUST pin exactly the base program's collectives —
+            # the per-row adapter deltas (replicated pool gather,
+            # per-shard A-row/B-column slices, row-parallel deltas
+            # joining the partial product before the block psum) add
+            # ZERO collectives; any new psum/all_gather here fails
+            # the gate
+            "serving.ragged_lora_tp2": _mk_lora()}
 
 
 def programs() -> Dict[str, callable]:

@@ -47,14 +47,18 @@ def _build_tiny():
                      num_key_value_heads=2, vocab_size=64,
                      max_position_embeddings=64)
     dec = PagedLlamaDecoder.from_config(cfg, num_blocks=16, block_size=4)
-    # spec_decode forces ragged=True on top of the dense programs, so
-    # one engine carries every compiled serving program — the dense
-    # per-phase set, the ragged [T, W] chunk, and the ISSUE-9
-    # speculative verify program
+    # spec_decode forces ragged=True on top of the dense programs, and
+    # the lora registry (ISSUE 10) adds the multi-tenant program
+    # family, so one engine carries every compiled serving program —
+    # the dense per-phase set, the ragged [T, W] chunk, the ISSUE-9
+    # speculative verify program and the lora twins
+    from paddle_tpu.inference.lora import AdapterRegistry
     from paddle_tpu.inference.spec_decode import SpecConfig
+    reg = AdapterRegistry(rank=2)
+    reg.register_random("tenant0", seed=0)
     eng = ServingEngine(dec, max_batch_size=2, prompt_buckets=(8, 16),
                         chunk_size=2, prefill_chunk=8,
-                        spec_decode=SpecConfig(draft_len=2))
+                        spec_decode=SpecConfig(draft_len=2), lora=reg)
     return dec, eng
 
 
@@ -82,6 +86,7 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
     top_ps = jnp.ones((b,), jnp.float32)
     reps = jnp.ones((b,), jnp.float32)
     seen = jnp.zeros((b, vocab), bool)
+    allowed = jnp.ones((b, vocab), bool)
     key = jax.random.PRNGKey(0)
     T = eng.chunk
     tables_all = jnp.zeros((T, eng.max_b, mp_), jnp.int32)
@@ -91,6 +96,7 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
     temps_mb = jnp.zeros((eng.max_b,), jnp.float32)
     keys_all = jax.random.split(key, T)
     seen_mb = jnp.zeros((eng.max_b, vocab), bool)
+    allowed_mb = jnp.ones((eng.max_b, vocab), bool)
 
     entries = [
         (paged, "_prefill_impl",
@@ -111,11 +117,12 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
         (serving, "prefill",
          lambda: (eng._prefill_j, (dec.weights, cache.k, cache.v, ids,
                                    slots, last_idx, temps, key, top_ks,
-                                   top_ps, reps, seen))),
+                                   top_ps, reps, seen, allowed))),
         (serving, "prefill_prefix",
          lambda: (eng._prefill_prefix_j,
                   (dec.weights, cache.k, cache.v, ids, slots, last_idx,
-                   ncv, ptab, temps, key, top_ks, top_ps, reps, seen))),
+                   ncv, ptab, temps, key, top_ks, top_ps, reps, seen,
+                   allowed))),
         (serving, "decode_chunk",
          lambda: (eng._decode_j, (dec.weights, cache.k, cache.v,
                                   first_ids, tables_all, ctx_all,
@@ -126,7 +133,8 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
                    ctx_all, slots_all, temps_mb, keys_all,
                    jnp.zeros((eng.max_b,), jnp.int32),
                    jnp.ones((eng.max_b,), jnp.float32),
-                   jnp.ones((eng.max_b,), jnp.float32), seen_mb))),
+                   jnp.ones((eng.max_b,), jnp.float32), seen_mb,
+                   allowed_mb))),
         (serving, "merge_first",
          lambda: (eng._merge_first_j,
                   (jnp.zeros((eng.max_b, T), jnp.int32),
@@ -161,6 +169,30 @@ def trace_entry_points() -> Dict[Tuple[str, str], str]:
                        jnp.zeros((w,), jnp.float32), key,
                        jnp.arange(w, dtype=jnp.int32),
                        jnp.zeros((w,), bool)))))
+    if eng.lora is not None:
+        # the ISSUE-10 multi-tenant ragged program: lora-pool gather +
+        # per-row adapter deltas wrapped around the same [T, W] scan
+        wl = 4
+        n_pages = eng.lora.layout.n_pages
+        entries.append(
+            (serving, "ragged_lora_chunk",
+             lambda: (eng._ragged_lora_j,
+                      (dec.weights, cache.k, cache.v, cache.lora_pool,
+                       jnp.zeros((1,), jnp.int32),
+                       jnp.zeros((eng.max_b + 1, n_pages), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((wl,), jnp.int32),
+                       jnp.zeros((wl,), jnp.int32),
+                       jnp.zeros((wl,), bool),
+                       jnp.zeros((wl,), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((T, wl), jnp.int32),
+                       jnp.zeros((T, wl), bool),
+                       jnp.zeros((eng.max_b + 1, mp_), jnp.int32),
+                       jnp.zeros((T, wl), jnp.float32), keys_all))))
 
     jaxprs = {}
     for file_sfx, name, build in entries:
