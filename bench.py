@@ -89,8 +89,8 @@ LKG = {
 # rows, so ensure_devices(8) can only skip — a fresh subprocess lets it
 # force the 8-CPU-device mesh before anything touches jax
 AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "8b",
-              "serving", "serving_tp", "serving_lora", "pp", "moe",
-              "dit", "profile")
+              "serving", "serving_tp", "serving_lora", "serving_dp",
+              "pp", "moe", "dit", "profile")
 
 MODE_TIMEOUT_S = {"serving": 3300, "decode": 2100, "8b": 3600}
 DEFAULT_TIMEOUT_S = 1800
@@ -1445,6 +1445,119 @@ def run_serving_lora():
     return out
 
 
+def run_serving_dp():
+    """Fleet serving A/B (ISSUE 11 acceptance): a SHARED-PREFIX mixed
+    workload — 16 greedy requests, 4 per each of 4 block-aligned
+    64-token system prefixes, arriving in a seeded SHUFFLED order with
+    jittered serving-step gaps between arrivals — served three
+    ways: one equal-capacity single engine, an R=2 fleet with
+    prefix-affinity routing ON, and the same fleet with affinity OFF
+    (pure least-loaded). Reports tok/s, fleet ITL p50/p99, the
+    prefix-cache hit rate and the router counters per leg, and ASSERTS
+    greedy token identity of every fleet leg against the single engine
+    (outputs are replica-independent — the cross-replica identity
+    contract). The affinity win is the hit-rate delta: affinity keeps a
+    prefix group on the replica whose pool already holds its blocks,
+    while least-loaded routing splits groups across replicas and
+    re-prefills the shared prefix on both. On CPU one process steps
+    both replicas serially, so fleet tok/s carries that host tax —
+    the mechanism (routing + hit rate), not chip-count scaling, is
+    what this row tracks."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.inference import SamplingParams, ServingEngine
+    from paddle_tpu.inference.fleet import Router
+
+    cfg = llama_tiny(hidden_size=256, num_attention_heads=8,
+                     num_key_value_heads=4, intermediate_size=704,
+                     num_hidden_layers=4)
+    n_groups, per_group, pre_len, tail_len, n_new = 4, 4, 64, 16, 16
+    rng = np.random.RandomState(0)
+    prefixes = [rng.randint(0, cfg.vocab_size, pre_len).astype(np.int32)
+                for _ in range(n_groups)]
+    # SHUFFLED arrival order with jittered spacing (seeded): group
+    # membership decorrelates from instantaneous load, which is the
+    # traffic shape affinity exists for — least-loaded routing
+    # scatters a group across replicas (each pays its own prefix
+    # prefill), affinity keeps it where the blocks are
+    order = rng.permutation([g for g in range(n_groups)
+                             for _ in range(per_group)])
+    prompts = [np.concatenate(
+        [prefixes[g], rng.randint(0, cfg.vocab_size,
+                                  tail_len).astype(np.int32)])
+        for g in order]
+    gaps = [int(rng.randint(1, 5)) for _ in prompts]
+    geom = dict(num_blocks=48, block_size=16, prompt_buckets=(96,),
+                chunk_size=8, prefill_chunk=32, ragged=True)
+    out = {}
+    toks = {}
+    for tag in ("single", "dp2_affinity", "dp2_noaffinity"):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        if tag == "single":
+            srv = ServingEngine(model, max_batch_size=4,
+                                **{**geom, "num_blocks": 96})
+            engines = [srv]
+        else:
+            srv = Router(model, dp=2, max_batch_size=2,
+                         affinity=(tag == "dp2_affinity"), **geom)
+            engines = [rep.engine for rep in srv.replicas]
+        srv.warmup()
+
+        def _run():
+            rids = []
+            for p, gap in zip(prompts, gaps):
+                rids.append(srv.add_request(
+                    p, SamplingParams(max_new_tokens=n_new)))
+                for _ in range(gap):
+                    srv.step()
+            srv.run_to_completion()
+            return rids
+        # dry run compiles the production (T, W) variants outside the
+        # clock; prefix caches cleared after so the timed run pays
+        # real prefills and the hit rate measures ROUTING, not leftovers
+        _run()
+        for e in engines:
+            e.dec.cache.clear_prefix_cache()
+        srv.clear_finished()
+        t0 = time.perf_counter()
+        rids = _run()
+        wall = time.perf_counter() - t0
+        toks[tag] = [srv.result(r).tolist() for r in rids]
+        pre = f"serving_dp_{tag}"
+        if tag == "single":
+            st = srv.stats()
+            gen, hit = st["generated_tokens"], st["prefix_cache_hit_rate"]
+            itl50, itl99 = st["itl_p50_s"], st["itl_p99_s"]
+        else:
+            st = srv.stats()["fleet"]
+            gen, hit = st["generated_tokens"], st["prefix_cache_hit_rate"]
+            itl50, itl99 = st["itl_p50_s"], st["itl_p99_s"]
+            out[f"{pre}_affinity_hits"] = st["affinity_hits"]
+            out[f"{pre}_spills"] = st["spills"]
+            out[f"{pre}_affinity_hit_rate"] = round(
+                st["affinity_hit_rate"], 3)
+        out[f"{pre}_tok_per_sec"] = round(gen / wall, 1)
+        out[f"{pre}_itl_p50_s"] = round(itl50, 4)
+        out[f"{pre}_itl_p99_s"] = round(itl99, 4)
+        out[f"{pre}_prefix_hit_rate"] = round(hit, 3)
+        out[f"{pre}_wall_s"] = round(wall, 3)
+        del srv, engines
+        _clear_device_memory()
+    ok = (toks["dp2_affinity"] == toks["single"]
+          and toks["dp2_noaffinity"] == toks["single"])
+    out["serving_dp_tokens_identical"] = ok
+    assert ok, "fleet greedy outputs diverged from the single engine"
+    out["serving_dp2_tok_per_sec"] = \
+        out["serving_dp_dp2_affinity_tok_per_sec"]
+    # the affinity win: cached-prefix coverage routed-to vs scattered
+    out["serving_dp_affinity_hit_gain"] = round(
+        out["serving_dp_dp2_affinity_prefix_hit_rate"]
+        - out["serving_dp_dp2_noaffinity_prefix_hit_rate"], 3)
+    return out
+
+
 def run_pp():
     """Pipeline-schedule efficiency microbench (VERDICT r3 #3): wall
     time per step, remat vs store-activations, on a 1-stage mesh on the
@@ -2005,6 +2118,12 @@ def main(mode: str):
                   "unit": "tokens/s",
                   "value": r.get("serving_lora_lora_tok_per_sec", 0.0),
                   "extra": r}
+    elif mode == "serving_dp":
+        r = run_serving_dp()
+        result = {"metric": "serving_dp2_tok_per_sec",
+                  "unit": "tokens/s",
+                  "value": r.get("serving_dp2_tok_per_sec", 0.0),
+                  "extra": r}
     elif mode == "pp":
         r = run_pp()
         result = {"metric": "pp_remat_overhead_x", "unit": "x",
@@ -2043,8 +2162,8 @@ _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
                 "resnet", "decode", "8b", "serving",
                 "serving_interleave", "serving_degradation",
                 "serving_ragged", "serving_spec", "serving_tp",
-                "serving_lora", "pp", "moe", "dit", "profile",
-                "calibrate")
+                "serving_lora", "serving_dp", "pp", "moe", "dit",
+                "profile", "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

@@ -37,17 +37,32 @@ Layout choices (Megatron-style 1-allreduce-per-block decode):
   contiguous [kv_heads, block_size, head_dim] region) sharded over the
   kv-head dim, so a tp shard appends exactly the heads it computed —
   ZERO collectives on the KV-append path.
+
+Fleet serving (ISSUE 11) adds the DATA axis: the dp x tp serving mesh
+is a [dp, tp] device grid with axes ("data", "tp") where each row is
+one replica's tp mesh. The canonical placement over the data axis is
+PURE REPLICATION — no CANONICAL_SPECS entry ever names it: every
+replica holds full weights and its own full KV pool, which is exactly
+what keeps dp at ZERO step-path collectives (replicas never talk
+during a step; the comm audit pins serving.ragged_dp2_tp2 identical to
+serving.ragged_tp2_fp32). ``fleet_device_slices`` hands the Router
+(inference/fleet.py) the disjoint per-replica device rows this table
+implies.
 """
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict
+from typing import Dict, List, Optional, Sequence
 
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-__all__ = ["SpecLayout", "CANONICAL_SPECS", "TP_AXIS"]
+__all__ = ["SpecLayout", "CANONICAL_SPECS", "TP_AXIS", "DATA_AXIS"]
 
 TP_AXIS = "tp"
+# the replica axis of the dp x tp serving mesh: weights and KV pools
+# REPLICATE over it (each replica is an independent engine), so no
+# canonical spec below may name it — spec() enforces that invariant
+DATA_AXIS = "data"
 
 # parameter name -> canonical PartitionSpec over the tp axis. The specs
 # describe the TRAILING dims of the parameter (stacked trunks prepend
@@ -101,9 +116,16 @@ CANONICAL_SPECS: Dict[str, P] = {
 
 @dataclass(frozen=True)
 class SpecLayout:
-    """Resolved canonical layout over a concrete tp axis name."""
+    """Resolved canonical layout over concrete tp/data axis names.
+
+    The ``data_axis`` is the replica dimension of the dp x tp serving
+    mesh (ISSUE 11): it never appears in a weight spec — replicas
+    replicate — so its only resolved artifacts are the device GRID
+    (``fleet_mesh``) and the disjoint per-replica rows
+    (``fleet_device_slices``) the fleet Router places engines on."""
 
     tp_axis: str = TP_AXIS
+    data_axis: str = DATA_AXIS
 
     def spec(self, name: str, strict: bool = False) -> P:
         base = CANONICAL_SPECS.get(name)
@@ -177,3 +199,40 @@ class SpecLayout:
             return self.spec(name, strict=strict_)
 
         return self._map(weights, spec_of, strict)
+
+    # -- dp x tp fleet placement (ISSUE 11) -------------------------------
+    def _fleet_grid(self, dp: int, tp: int,
+                    devices: Optional[Sequence] = None):
+        import jax
+        import numpy as np
+        dp, tp = int(dp), int(tp)
+        if dp < 1 or tp < 1:
+            raise ValueError(f"dp and tp must be >= 1, got dp={dp} "
+                             f"tp={tp}")
+        devs = list(devices) if devices is not None else jax.devices()
+        if len(devs) < dp * tp:
+            raise ValueError(
+                f"dp={dp} x tp={tp} needs {dp * tp} devices, found "
+                f"{len(devs)}")
+        return np.asarray(devs[:dp * tp], dtype=object).reshape(dp, tp)
+
+    def fleet_mesh(self, dp: int, tp: int,
+                   devices: Optional[Sequence] = None):
+        """The canonical [dp, tp] serving mesh: axes (data_axis,
+        tp_axis) over the first dp*tp devices (or an explicit list).
+        Row r IS replica r's tp mesh — the 2D mesh exists so placement
+        (and the FC6xx analyses) can reason about both axes from one
+        table; each replica's engine runs fully-manual shard_map over
+        its OWN one-axis row, never over the data axis."""
+        from jax.sharding import Mesh
+        return Mesh(self._fleet_grid(dp, tp, devices),
+                    (self.data_axis, self.tp_axis))
+
+    def fleet_device_slices(self, dp: int, tp: int,
+                            devices: Optional[Sequence] = None
+                            ) -> List[list]:
+        """The disjoint per-replica device rows of the dp x tp grid —
+        what the fleet Router passes to each ServingEngine(devices=...)
+        so R tp-sharded replicas never share a chip."""
+        grid = self._fleet_grid(dp, tp, devices)
+        return [list(grid[r]) for r in range(grid.shape[0])]

@@ -16,6 +16,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .fleet import Router  # noqa: F401
 from .gpt_decode import PagedGPTDecoder  # noqa: F401
 from .lora import AdapterRegistry, LoRALayout  # noqa: F401
 from .paged_decode import PagedLlamaDecoder  # noqa: F401
@@ -27,7 +28,7 @@ __all__ = ["Config", "create_predictor", "Predictor", "PrecisionType",
            "PlaceType", "ServingEngine", "SamplingParams", "Request",
            "EngineOverloaded", "PagedLlamaDecoder", "PagedGPTDecoder",
            "SpecConfig", "Drafter", "NGramDrafter", "AdapterRegistry",
-           "LoRALayout"]
+           "LoRALayout", "Router"]
 
 
 class PrecisionType:

@@ -1,0 +1,606 @@
+"""Fleet-grade serving: a dp x tp replica mesh behind a health-checked
+Router (ISSUE 11 — ROADMAP item 1).
+
+One ServingEngine is one failure domain: PR 4 made it absorb every
+fault at REQUEST granularity, but nothing above it could notice a whole
+replica wedging — a flaky interconnect, a poisoned device, a runaway
+compile. The Router owns R independent replicas (each a full
+ServingEngine, tp-sharded over a DISJOINT device slice — row r of the
+SpecLayout dp x tp grid) and adds the replica-level half of the story:
+
+- prefix-affinity load balancing: each admission consults every
+  eligible replica's PR-1 chain-hash index (PagedKVCache.match_prefix —
+  a pure host-side hash walk, no device traffic) and routes to the
+  replica whose cached coverage of the prompt is LONGEST; ties (and the
+  no-coverage common case) break by least-loaded-then-lowest-index, so
+  routing is deterministic. A saturated winner (EngineOverloaded from
+  its queue cap or deadline math) SPILLS to the next candidate; when
+  every replica refuses, the fleet sheds — the PR-4 machinery, one
+  level up.
+- per-replica health tracking with a circuit breaker: after every
+  replica step the Router reads three signals — new _device_call retry
+  EXHAUSTIONS (the engine's dispatch_exhaustions counter), a step
+  wall-clock past stall_timeout_s (the watchdog-stall signal,
+  synchronous form), and a step() exception (defensive; step() never
+  raises by contract). Every retry exhaustion is one strike (a stall
+  or exception floors at one); a clean step WITH device activity
+  resets the count — consecutive semantics, so transient faults that
+  the engine's own bounded retry absorbs never accumulate, while an
+  idle step proves nothing in either direction; at breaker_threshold
+  accumulated strikes the replica is WEDGED.
+- drain-and-migrate failover: a wedged replica's live requests (and
+  the requests its fault burst just failed) are harvested — prompt,
+  sampling, generated history — cancelled locally (host-side unwind
+  only; the wedged device is never touched), and re-enqueued on
+  healthy replicas through ServingEngine.adopt_request: the history
+  re-prefills via the PR-4 all-mid-chunk NO-SAMPLE path (zero PRNG
+  keys drawn) and decode resumes from the last generated token, so
+  greedy outputs are TOKEN-IDENTICAL across the migration (the chaos
+  --dp leg gates this against a fault-free replay).
+- optional probation: cooldown_steps after wedging, the replica
+  re-enters routing on PROBATION — one strike re-wedges it instantly;
+  probation_steps consecutive clean steps promote it back to healthy.
+
+dp adds ZERO step-path collectives: replicas never talk during a step
+(affinity is a host-side hash lookup, migration is a host-side
+re-enqueue), and every replica's step program is byte-for-byte the
+single-engine tp program — pinned by the comm-audit entry
+serving.ragged_dp2_tp2, whose expectations equal
+serving.ragged_tp2_fp32's exactly.
+
+Usage::
+
+    from paddle_tpu.inference.fleet import Router
+    router = Router(model, dp=2, tp=2, max_batch_size=8)
+    fid = router.add_request(prompt_ids, SamplingParams(...))
+    while router.step():
+        pass
+    tokens = router.result(fid)
+
+Token-identity contract: GREEDY requests produce identical tokens no
+matter which replica serves them and across any number of migrations
+(every replica holds the same weights and migration re-prefills
+without sampling). Stochastic requests stay request-granular-correct
+but are NOT bit-reproducible across replicas — each engine owns an
+independent PRNG stream, exactly like preemption's contract in PR 4.
+"""
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..distributed.spec_layout import SpecLayout
+from .serving import (EngineOverloaded, SamplingParams, ServingEngine,
+                      _normalize_prompt)
+
+__all__ = ["Router", "Replica"]
+
+
+@dataclass
+class Replica:
+    """One engine plus its health record (Router-internal, exposed via
+    ``router.replicas`` for tests/telemetry)."""
+    idx: int
+    engine: ServingEngine
+    state: str = "healthy"          # healthy | probation | wedged
+    strikes: int = 0                # consecutive faulty steps
+    wedges: int = 0                 # times this replica tripped
+    wedged_at: Optional[int] = None  # router step of the last wedge
+    probation_clean: int = 0        # clean steps since probation began
+    # engine.dispatch_exhaustions watermark (delta per step = faults)
+    exh_mark: int = 0
+    # engine.device_dispatches watermark: a step with NO device
+    # activity is evidence of nothing — it neither strikes nor resets
+    disp_mark: int = 0
+    # engine req_ids already failed BEFORE the current strike burst:
+    # at drain time only requests failed DURING the burst migrate (a
+    # request that failed long ago was already observed as failed by
+    # the caller — resurrecting it would change a delivered answer).
+    # Rebuilt lazily: valid while engine.failed == snap_failed_cnt,
+    # so the steady state (no failures) never rescans _done
+    burst_failed_mark: frozenset = frozenset()
+    snap_failed_cnt: int = 0
+
+
+@dataclass
+class _FleetRequest:
+    """Fleet-level request record: which replica currently owns it."""
+    fid: int
+    prompt: np.ndarray
+    sampling: SamplingParams
+    replica: int
+    rid: int                        # engine-local req_id on `replica`
+    t_submit: float = 0.0
+    migrations: int = 0
+
+
+class Router:
+    """R ServingEngine replicas behind prefix-affinity routing, health
+    tracking with a circuit breaker, and drain-and-migrate failover.
+
+    Parameters
+    ----------
+    model : the LlamaForCausalLM (or GPT) every replica serves. Ignored
+        when ``engine_factory`` is given.
+    dp : replica count R.
+    tp : per-replica tensor-parallel degree; tp > 1 places replica r on
+        row r of ``SpecLayout.fleet_device_slices(dp, tp)`` — disjoint
+        device slices, dp x tp chips total.
+    affinity : route by longest cached chain-hash coverage (True, the
+        default) or purely by load (False — the bench A/B's off leg).
+    breaker_threshold : consecutive faulty steps before a replica is
+        declared wedged and drained.
+    stall_timeout_s : a single engine step taking longer than this
+        counts as a watchdog-stall strike (None disables — CPU test
+        meshes stall for compile reasons, not health reasons).
+    cooldown_steps : router steps after a wedge before the replica
+        re-enters routing on probation (None = stay wedged forever).
+    probation_steps : consecutive clean steps that promote a probation
+        replica back to healthy.
+    engine_factory : optional ``f(replica_idx, devices) ->
+        ServingEngine`` overriding default construction — prebuilt
+        decoders, GPT twins, per-replica AdapterRegistry instances (a
+        registry binds to one engine's pool and must NOT be shared
+        across replicas).
+    **engine_kwargs : forwarded to every ServingEngine (max_batch_size,
+        num_blocks, prefill_chunk, ragged, spec_decode, ...).
+    """
+
+    def __init__(self, model, dp: int = 2, tp: int = 1, *,
+                 affinity: bool = True,
+                 breaker_threshold: int = 3,
+                 stall_timeout_s: Optional[float] = None,
+                 cooldown_steps: Optional[int] = None,
+                 probation_steps: int = 8,
+                 engine_factory: Optional[Callable] = None,
+                 **engine_kwargs):
+        dp = int(dp)
+        if dp < 1:
+            raise ValueError(f"dp must be >= 1, got {dp}")
+        self.dp = dp
+        self.tp = int(tp)
+        self.affinity = bool(affinity)
+        self.breaker_threshold = max(1, int(breaker_threshold))
+        self.stall_timeout_s = stall_timeout_s
+        self.cooldown_steps = (int(cooldown_steps)
+                               if cooldown_steps is not None else None)
+        self.probation_steps = max(1, int(probation_steps))
+        # per-replica device rows from the canonical dp x tp grid
+        # (tp=1 replicas share the default device — placement only
+        # matters once a replica actually spans chips)
+        layout = SpecLayout()
+        slices = (layout.fleet_device_slices(dp, tp) if self.tp > 1
+                  else [None] * dp)
+        self.replicas: List[Replica] = []
+        for r in range(dp):
+            if engine_factory is not None:
+                eng = engine_factory(r, slices[r])
+            else:
+                eng = ServingEngine(model, tp=tp, devices=slices[r],
+                                    **engine_kwargs)
+            self.replicas.append(Replica(r, eng))
+        self._requests: Dict[int, _FleetRequest] = {}
+        self._fids = itertools.count()
+        self._step_no = 0
+        # routing / robustness counters (reset by clear_finished)
+        self.routed_requests = 0
+        self.affinity_hits = 0
+        self.spills = 0
+        self.failovers = 0
+        self.migrated_requests = 0
+        self.failed_migrations = 0
+        self.shed_requests = 0
+
+    # -- routing policy ------------------------------------------------------
+    def _eligible(self) -> List[Replica]:
+        return [rep for rep in self.replicas if rep.state != "wedged"]
+
+    @staticmethod
+    def _load(eng: ServingEngine) -> int:
+        """Host-side load proxy: live requests (queued + slotted)."""
+        return len(eng._queue) + sum(1 for s in eng._slots
+                                     if s is not None)
+
+    @staticmethod
+    def _coverage(eng: ServingEngine, prompt, salt) -> int:
+        """Cached chain-hash coverage of `prompt` on this replica, in
+        tokens — the PR-1 index walk, pure host-side."""
+        if not eng.prefix_caching:
+            return 0
+        cache = eng.dec.cache
+        return len(cache.match_prefix(prompt, salt)) * cache.block_size
+
+    def _ranked(self, prompt, sp: SamplingParams,
+                exclude: Sequence[int] = ()
+                ) -> Tuple[List[Replica], Dict[int, int]]:
+        """Admission order: longest coverage first (affinity), ties —
+        and the affinity=False mode — by (load, replica idx). Fully
+        deterministic: equal fleets route equal traffic equally."""
+        cands = [rep for rep in self._eligible()
+                 if rep.idx not in exclude]
+        cov = {rep.idx: (self._coverage(rep.engine, prompt,
+                                        sp.adapter_id)
+                         if self.affinity else 0)
+               for rep in cands}
+        return sorted(cands, key=lambda rep: (-cov[rep.idx],
+                                              self._load(rep.engine),
+                                              rep.idx)), cov
+
+    def add_request(self, prompt, sampling: Optional[SamplingParams]
+                    = None) -> int:
+        """Route one admission through the fleet. Returns a FLEET
+        request id (stable across migrations). Raises EngineOverloaded
+        only when EVERY eligible replica sheds it (per-replica queue
+        caps and deadline estimates are the PR-4 machinery, consulted
+        replica by replica — a saturated affinity winner spills to the
+        next candidate instead of shedding)."""
+        sp = sampling or SamplingParams()
+        prompt = _normalize_prompt(prompt)
+        order, cov = self._ranked(prompt, sp)
+        if not order:
+            self.shed_requests += 1
+            raise EngineOverloaded("fleet has no eligible replica "
+                                   "(all wedged)")
+        last_exc = invalid = None
+        for pos, rep in enumerate(order):
+            try:
+                rid = rep.engine.add_request(prompt, sp)
+            except EngineOverloaded as e:
+                last_exc = e
+                continue
+            except (KeyError, ValueError) as e:
+                # per-replica validation refusal (engine_factory fleets
+                # may be heterogeneous: an adapter registered on only
+                # some replicas, differing pool geometry) — try the
+                # next candidate; if EVERY replica refuses this way the
+                # request is genuinely invalid and the first error is
+                # the honest one to surface
+                invalid = invalid or e
+                continue
+            fid = next(self._fids)
+            self._requests[fid] = _FleetRequest(
+                fid, prompt, sp, rep.idx, rid,
+                t_submit=time.perf_counter())
+            self.routed_requests += 1
+            if cov.get(rep.idx, 0) > 0:
+                self.affinity_hits += 1
+            if pos > 0:
+                self.spills += 1
+            return fid
+        if invalid is not None and last_exc is None:
+            raise invalid          # rejected everywhere: caller error
+        self.shed_requests += 1
+        raise EngineOverloaded(
+            f"fleet saturated: all {len(order)} eligible replica(s) "
+            f"shed the request (last: {last_exc or invalid})")
+
+    # -- request surface -----------------------------------------------------
+    def _record(self, fid: int) -> _FleetRequest:
+        rec = self._requests.get(fid)
+        if rec is None:
+            raise KeyError(f"unknown fleet request {fid}")
+        return rec
+
+    def _owner(self, fid: int) -> Replica:
+        return self.replicas[self._record(fid).replica]
+
+    def request(self, fid: int):
+        """The current owner's Request record (live or terminal)."""
+        rec = self._record(fid)
+        eng = self.replicas[rec.replica].engine
+        req = eng._find_request(rec.rid)
+        if req is None:
+            raise KeyError(f"fleet request {fid}: engine record "
+                           f"{rec.rid} gone (cleared?)")
+        return req
+
+    def result(self, fid: int) -> np.ndarray:
+        rec = self._record(fid)
+        return self.replicas[rec.replica].engine.result(rec.rid)
+
+    def migrations(self, fid: int) -> int:
+        return self._record(fid).migrations
+
+    def cancel(self, fid: int) -> bool:
+        rec = self._record(fid)
+        return self.replicas[rec.replica].engine.cancel(rec.rid)
+
+    @property
+    def has_work(self) -> bool:
+        """Work remains on some replica the Router will still step —
+        non-wedged ones always; wedged ones only if probation can
+        revive them (their live queue was drained at wedge time, so
+        this is almost always the non-wedged term)."""
+        return any(rep.engine.has_work for rep in self.replicas
+                   if rep.state != "wedged"
+                   or self.cooldown_steps is not None)
+
+    # -- health / failover ---------------------------------------------------
+    def _failed_rids(self, eng: ServingEngine) -> frozenset:
+        return frozenset(rid for rid, r in eng._done.items()
+                         if r.state == "failed")
+
+    def _strike(self, rep: Replica, amount: int,
+                prestep_mark: frozenset):
+        """Accumulate fault evidence. `amount` is the step's retry-
+        exhaustion count (each exhaustion is one consecutive
+        _device_call failure — a step that exhausts three dispatches is
+        three strikes, not one), floored at 1 for stall/exception
+        strikes. Strikes reset only on a CLEAN step with device
+        activity, so a replica that killed its whole queue and went
+        idle keeps its evidence until the breaker decides."""
+        if rep.strikes == 0:
+            # burst starts: the PRE-step snapshot of what had already
+            # failed — taken before this step's own casualties, so the
+            # drain migrates everything THIS burst killed and nothing
+            # a caller already observed as failed
+            rep.burst_failed_mark = prestep_mark
+        rep.strikes += max(1, int(amount))
+        limit = 1 if rep.state == "probation" else self.breaker_threshold
+        if rep.strikes >= limit:
+            self._wedge(rep)
+
+    def _wedge(self, rep: Replica):
+        rep.state = "wedged"
+        rep.wedges += 1
+        rep.wedged_at = self._step_no
+        rep.strikes = 0
+        self.failovers += 1
+        self._drain(rep)
+
+    def _drain(self, rep: Replica):
+        """Harvest every fleet request the wedged replica still owes an
+        answer for — live (queued/prefilling/running) plus the ones its
+        fault burst just failed — cancel them locally (host-side pool
+        unwind only; nothing is dispatched to the wedged device) and
+        re-enqueue them on healthy replicas as prompt+generated-history
+        recomputes. Migration order is fid order: deterministic, FIFO-
+        fair. With no healthy replica left the requests stay terminal
+        on the wedged engine (the fleet is down; results of already-
+        finished requests remain readable)."""
+        eng = rep.engine
+        victims = []            # (record, out_tokens harvested)
+        for fid in sorted(self._requests):
+            rec = self._requests[fid]
+            if rec.replica != rep.idx:
+                continue
+            req = eng._find_request(rec.rid)
+            if req is None:
+                continue
+            if req.state in ("queued", "prefilling", "running"):
+                victims.append((rec, list(req.out_tokens)))
+                try:
+                    eng.cancel(rec.rid)
+                except Exception:       # noqa: BLE001 — wedged engine:
+                    pass                # best-effort local unwind
+            elif (req.state == "failed"
+                  and rec.rid not in rep.burst_failed_mark):
+                victims.append((rec, list(req.out_tokens)))
+        for rec, toks in victims:
+            self._migrate(rec, toks)
+
+    def _migrate(self, rec: _FleetRequest, out_tokens: List[int]):
+        """Re-enqueue one drained request on the best healthy replica
+        (affinity order over prompt ++ history — the history's blocks
+        may be cache-hot somewhere). adopt_request bypasses overload
+        shedding, so the first candidate accepts; greedy continuation
+        is token-identical by the no-sample recompute contract."""
+        ctx = (np.concatenate([rec.prompt,
+                               np.asarray(out_tokens, np.int32)])
+               if out_tokens else rec.prompt)
+        order, _ = self._ranked(ctx, rec.sampling,
+                                exclude=(rec.replica,))
+        for target in order:
+            try:
+                rid = target.engine.adopt_request(
+                    rec.prompt, rec.sampling, out_tokens=out_tokens,
+                    t_submit=rec.t_submit)
+            except Exception:   # noqa: BLE001 — a refusing candidate
+                # (heterogeneous fleet: adapter not registered there,
+                # tighter pool geometry) must not abort the drain: the
+                # remaining victims still need their migration, and
+                # step()'s never-raises contract covers drains too
+                continue
+            rec.rid = rid
+            rec.replica = target.idx
+            rec.migrations += 1
+            self.migrated_requests += 1
+            return
+        # no candidate accepted (fleet down / nowhere fits): the
+        # request stays terminal on the wedged engine — its record
+        # still resolves (result() returns the partial tokens, the
+        # state reads aborted/failed) and the refusal is COUNTED so
+        # a failovers-vs-victims delta is visible in stats
+        self.failed_migrations += 1
+
+    def _maybe_probation(self, rep: Replica):
+        if (self.cooldown_steps is not None
+                and self._step_no - rep.wedged_at
+                >= self.cooldown_steps):
+            rep.state = "probation"
+            rep.strikes = 0
+            rep.probation_clean = 0
+
+    # -- stepping ------------------------------------------------------------
+    def step(self) -> bool:
+        """One fleet iteration: step every non-wedged replica, read its
+        health signals, trip the breaker and drain where needed, and
+        revive cooled-down replicas onto probation. Returns True while
+        any steppable replica has work. Like ServingEngine.step(), this
+        never raises on a replica fault — a dying replica becomes a
+        drain, not an exception."""
+        self._step_no += 1
+        for rep in self.replicas:
+            if rep.state == "wedged":
+                self._maybe_probation(rep)
+                continue
+            eng = rep.engine
+            # pre-step failed-set snapshot: only consulted if THIS
+            # step opens a strike burst (see _strike). The frozenset
+            # is rebuilt only when engine.failed moved since the last
+            # build — an O(1) check per step instead of an O(finished)
+            # scan of _done; mid-burst (strikes > 0) the burst-start
+            # snapshot must stand, so no refresh
+            if rep.strikes == 0 and eng.failed != rep.snap_failed_cnt:
+                rep.burst_failed_mark = self._failed_rids(eng)
+                rep.snap_failed_cnt = eng.failed
+            prestep_mark = rep.burst_failed_mark
+            t0 = time.perf_counter()
+            raised = False
+            try:
+                eng.step()
+            except Exception:           # noqa: BLE001 — contract says
+                raised = True           # never, but a wedge IS the
+            wall = time.perf_counter() - t0   # never-happens case
+            exh = eng.dispatch_exhaustions - rep.exh_mark
+            rep.exh_mark = eng.dispatch_exhaustions
+            disp = eng.device_dispatches - rep.disp_mark
+            rep.disp_mark = eng.device_dispatches
+            stalled = (self.stall_timeout_s is not None
+                       and wall > self.stall_timeout_s)
+            if raised or exh > 0 or stalled:
+                self._strike(rep, exh, prestep_mark)
+            elif disp > 0:
+                # clean step WITH device activity: real evidence of
+                # health. Idle steps prove nothing — they neither
+                # strike nor forgive (a replica that failed its whole
+                # queue and went quiet must not launder its record)
+                rep.strikes = 0
+                if rep.state == "probation":
+                    rep.probation_clean += 1
+                    if rep.probation_clean >= self.probation_steps:
+                        rep.state = "healthy"
+        return self.has_work
+
+    def run_to_completion(self) -> Dict[int, np.ndarray]:
+        while self.step():
+            pass
+        out = {}
+        for fid in list(self._requests):
+            try:
+                out[fid] = self.result(fid)
+            except KeyError:
+                pass
+        return out
+
+    def warmup(self, prompt_len: Optional[int] = None):
+        """Warm every replica's compiled programs, then reset stats so
+        warmup traffic never pollutes the fleet numbers."""
+        for rep in self.replicas:
+            if rep.state != "wedged":
+                rep.engine.warmup(prompt_len)
+        self.clear_finished()
+
+    # -- stats ---------------------------------------------------------------
+    @staticmethod
+    def _raw_itls(eng: ServingEngine) -> List[float]:
+        ok = (r for r in eng._done.values() if r.state == "done")
+        live = (r for r in eng._slots if r is not None)
+        return [x for r in itertools.chain(ok, live) for x in r.itls]
+
+    def _goodput_tokens(self, eng: ServingEngine) -> int:
+        return sum(len(r.out_tokens) for r in eng._done.values()
+                   if r.state == "done")
+
+    def stats(self) -> dict:
+        """Fleet rollup + per-replica breakdown.
+
+        ``fleet`` carries the routing counters (affinity_hits / spills
+        / failovers / migrated_requests / shed_requests — all reset by
+        clear_finished), goodput (tokens delivered by successfully
+        finished requests — the PR-4 degradation metric, fleet-wide)
+        and TRUE fleet ITL percentiles computed over the union of every
+        replica's raw inter-token samples (percentiles don't average;
+        the per-replica stats() percentiles are reported alongside).
+        ``replicas`` is each engine's own stats() plus its health
+        record."""
+        engines = [rep.engine for rep in self.replicas]
+        itls = [x for e in engines for x in self._raw_itls(e)]
+        hit = sum(e.dec.cache.prefix_hit_tokens for e in engines)
+        query = sum(e.dec.cache.prefix_query_tokens for e in engines)
+        migrated_done = 0
+        for rec in self._requests.values():
+            if rec.migrations > 0:
+                req = self.replicas[rec.replica].engine._find_request(
+                    rec.rid)
+                if req is not None and req.state == "done":
+                    migrated_done += 1
+        fleet = {
+            "replicas": self.dp,
+            "healthy_replicas": sum(1 for rep in self.replicas
+                                    if rep.state == "healthy"),
+            "wedged_replicas": sum(1 for rep in self.replicas
+                                   if rep.state == "wedged"),
+            "routed_requests": self.routed_requests,
+            "affinity_hits": self.affinity_hits,
+            "affinity_hit_rate": (self.affinity_hits
+                                  / self.routed_requests
+                                  if self.routed_requests else 0.0),
+            "spills": self.spills,
+            "failovers": self.failovers,
+            "migrated_requests": self.migrated_requests,
+            "migrated_done": migrated_done,
+            "failed_migrations": self.failed_migrations,
+            # FLEET-level refusals only: a per-replica shed that spilled
+            # to another replica was served, not shed (the per-replica
+            # counts stay visible in the replicas list)
+            "shed_requests": self.shed_requests,
+            "finished": sum(
+                1 for e in engines for r in e._done.values()
+                if r.state == "done"),
+            "generated_tokens": sum(e.generated_tokens
+                                    for e in engines),
+            "goodput_tokens": sum(self._goodput_tokens(e)
+                                  for e in engines),
+            "itl_p50_s": (float(np.quantile(itls, 0.50))
+                          if itls else None),
+            "itl_p99_s": (float(np.quantile(itls, 0.99))
+                          if itls else None),
+            "preemptions": sum(e.preemptions for e in engines),
+            "aborted": sum(e.aborted for e in engines),
+            "failed": sum(e.failed for e in engines),
+            "retries": sum(e.retries for e in engines),
+            "dispatch_exhaustions": sum(e.dispatch_exhaustions
+                                        for e in engines),
+            "device_dispatches": sum(e.device_dispatches
+                                     for e in engines),
+            "prefix_cache_hit_rate": hit / query if query else 0.0,
+        }
+        per = []
+        for rep in self.replicas:
+            st = rep.engine.stats()
+            st["replica"] = rep.idx
+            st["state"] = rep.state
+            st["wedges"] = rep.wedges
+            st["load"] = self._load(rep.engine)
+            per.append(st)
+        return {"fleet": fleet, "replicas": per}
+
+    def clear_finished(self):
+        """Fleet-wide counter reset (the clear_finished contract every
+        counter family honors): every replica's clear_finished plus the
+        routing/failover counters; terminal fleet records are dropped
+        with their engine records (live requests keep their mapping)."""
+        for rep in self.replicas:
+            rep.engine.clear_finished()
+            rep.exh_mark = rep.engine.dispatch_exhaustions
+            rep.disp_mark = rep.engine.device_dispatches
+            rep.burst_failed_mark = frozenset()
+            rep.snap_failed_cnt = rep.engine.failed
+        self.routed_requests = 0
+        self.affinity_hits = 0
+        self.spills = 0
+        self.failovers = 0
+        self.migrated_requests = 0
+        self.failed_migrations = 0
+        self.shed_requests = 0
+        live = {}
+        for fid, rec in self._requests.items():
+            eng = self.replicas[rec.replica].engine
+            if eng._find_request(rec.rid) is not None:
+                live[fid] = rec
+        self._requests = live

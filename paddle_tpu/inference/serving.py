@@ -110,6 +110,17 @@ invariants hold with drafts in flight: a mid-window preemption blanks
 the victim's rows through the staleness sweep, epoch guards drop a
 previous life's verify results, and dispatch/collect retries re-issue
 the same program.
+
+Fleet serving (ISSUE 11): ONE engine is ONE failure domain. R engines
+compose into a dp x tp fleet behind inference/fleet.py::Router —
+prefix-affinity routing over each replica's chain-hash index, a
+per-replica circuit breaker fed by this engine's dispatch_exhaustions
+counter, and drain-and-migrate failover riding adopt_request (the
+preemption-recompute machinery pointed across engines: history
+re-prefills through the no-sample chunk programs, so greedy outputs
+are token-identical across the migration). The engine itself stays
+fleet-agnostic; devices= is the only constructor surface the Router
+needs (a disjoint device slice per tp-sharded replica).
 """
 from __future__ import annotations
 
@@ -293,6 +304,18 @@ class Request:
         return self.t_done - self.t_submit
 
 
+def _normalize_prompt(prompt) -> np.ndarray:
+    """Prompt intake shared by engine admission and the fleet Router:
+    Tensor unwrap, int32 flatten, empty rejection — ONE definition so
+    the two surfaces cannot drift."""
+    if isinstance(prompt, Tensor):
+        prompt = np.asarray(prompt._value)
+    prompt = np.asarray(prompt, np.int32).reshape(-1)
+    if prompt.size == 0:
+        raise ValueError("empty prompt")
+    return prompt
+
+
 def _bucket_for(n: int, buckets: Sequence[int]) -> int:
     for b in buckets:
         if n <= b:
@@ -332,6 +355,7 @@ class ServingEngine:
                  max_queue_depth: Optional[int] = None,
                  ragged: bool = False, tp: int = 1,
                  tp_comm: Optional[str] = None,
+                 devices: Optional[Sequence] = None,
                  spec_decode: Optional[SpecConfig] = None,
                  lora=None):
         from .gpt_decode import PagedGPTDecoder
@@ -371,6 +395,11 @@ class ServingEngine:
             # .from_config for 8B-class weights that must be quantized
             # at load); its pool/quantization/tp choices stand — the
             # num_blocks/block_size/weight_dtype args here are ignored
+            if devices is not None:
+                raise ValueError(
+                    "devices= only applies when the engine builds the "
+                    "decoder itself; a prebuilt decoder's mesh already "
+                    "fixed its device placement")
             self.dec = model
             dec_tp = int(getattr(model, "_tp", 1))
             if tp > 1 and dec_tp != tp:
@@ -391,8 +420,23 @@ class ServingEngine:
                     f"decoder constructor instead")
             self.tp = dec_tp
         else:
+            if devices is not None and tp == 1:
+                # fail loudly, like the PR-8 tp-flag checks: a tp=1
+                # engine always builds on the default device, and a
+                # silently-dropped placement request would put every
+                # "placed" fleet replica on one chip with no hint why
+                raise ValueError(
+                    "devices= requires tp > 1: a single-chip engine "
+                    "builds on the default device (the fleet Router "
+                    "passes devices only for tp-sharded replicas)")
             if tp > 1:
-                devs = jax.devices()
+                # devices=: an explicit device slice for the tp mesh —
+                # the fleet Router (inference/fleet.py) places each
+                # dp replica's tp mesh on a DISJOINT row of the
+                # SpecLayout dp x tp device grid; the default remains
+                # the first tp devices of the process
+                devs = (list(devices) if devices is not None
+                        else jax.devices())
                 if len(devs) < tp:
                     raise ValueError(
                         f"tp={tp} needs {tp} devices, found "
@@ -488,6 +532,12 @@ class ServingEngine:
         self.deadline_misses = 0
         self.shed_requests = 0
         self.retries = 0
+        # dispatch/fetch calls that exhausted their whole retry budget
+        # (each one failed the involved requests). This is the fleet
+        # Router's primary per-replica health signal: a replica whose
+        # engine keeps exhausting _device_call retries is wedged, not
+        # merely flaky (reset by clear_finished)
+        self.dispatch_exhaustions = 0
         # device-program launch count (every successful "dispatch:*"
         # _device_call — prefill, decode, merge, ragged, spec); with
         # generated_tokens it yields tokens_per_dispatch, the headline
@@ -1158,6 +1208,7 @@ class ServingEngine:
                 raise
             except Exception as e:          # noqa: BLE001 — fault wall
                 if attempt >= self.max_dispatch_retries:
+                    self.dispatch_exhaustions += 1
                     raise _DispatchFailed(kind, e) from e
                 attempt += 1
                 self.retries += 1
@@ -1463,15 +1514,15 @@ class ServingEngine:
         return "\n".join(lines) + "\n"
 
     # -- public API ----------------------------------------------------------
-    def add_request(self, prompt, sampling: Optional[SamplingParams] = None
-                    ) -> int:
-        """Queue a prompt ([len] ids; list/np/Tensor). Returns req_id."""
-        if isinstance(prompt, Tensor):
-            prompt = np.asarray(prompt._value)
-        prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if prompt.size == 0:
-            raise ValueError("empty prompt")
-        sp = sampling or SamplingParams()
+    def _validate_new_request(self, prompt, sp: SamplingParams):
+        """Shared admission validation (add_request and the fleet
+        migration path adopt_request): prompt normalization, bucket and
+        pool-geometry checks, adapter registration, allowed-tokens mask
+        normalization. Returns (prompt, allowed_mask). Raises on
+        impossible geometry — validation, NOT shedding (the overload
+        checks live in add_request only: a migrated request was already
+        admitted to the fleet once and must not be shed at drain)."""
+        prompt = _normalize_prompt(prompt)
         _bucket_for(int(prompt.size), self.buckets)  # validates length
         cache = self.dec.cache
         need = -(-(int(prompt.size) + sp.max_new_tokens)
@@ -1501,6 +1552,13 @@ class ServingEngine:
         if sp.allowed_tokens is not None:
             allowed_mask = self._normalize_allowed(
                 sp.allowed_tokens, self.dec.cfg.vocab_size)
+        return prompt, allowed_mask
+
+    def add_request(self, prompt, sampling: Optional[SamplingParams] = None
+                    ) -> int:
+        """Queue a prompt ([len] ids; list/np/Tensor). Returns req_id."""
+        sp = sampling or SamplingParams()
+        prompt, allowed_mask = self._validate_new_request(prompt, sp)
         # overload shedding: reject at the door what cannot be served —
         # a hard queue-depth cap, and (for deadline'd requests, once the
         # engine has a measured token rate) a backlog/deadline estimate
@@ -1521,6 +1579,54 @@ class ServingEngine:
         rid = next(self._ids)
         req = Request(rid, prompt, sp, t_submit=time.perf_counter())
         req.allowed_mask = allowed_mask
+        self._queue.append(req)
+        return rid
+
+    def adopt_request(self, prompt, sampling: Optional[SamplingParams]
+                      = None, out_tokens: Sequence[int] = (),
+                      t_submit: Optional[float] = None) -> int:
+        """Admit a request that already ran (partially) on ANOTHER
+        engine — the fleet Router's replica-failover migration path
+        (inference/fleet.py). The generated history re-enters this
+        engine's pool through the preemption-recompute machinery
+        (resume=True): the prefill reads prompt ++ out_tokens[:-1]
+        through the NO-SAMPLE chunk programs — no PRNG key is drawn,
+        the engine's key stream is untouched — and decode resumes from
+        out_tokens[-1], so greedy outputs are token-identical across
+        the migration. Overload shedding is BYPASSED (the fleet already
+        admitted this request once; shedding a drain would drop it) —
+        pool-geometry validation still applies. ``t_submit`` preserves
+        the original submit time so deadlines keep their meaning on the
+        new engine. A history that already satisfies the stop condition
+        (budget spent / trailing EOS) completes immediately; an engine
+        without the chunk programs drops the history and re-runs from
+        the prompt (still greedy-identical, just more recompute)."""
+        sp = sampling or SamplingParams()
+        prompt, allowed_mask = self._validate_new_request(prompt, sp)
+        rid = next(self._ids)
+        req = Request(rid, prompt, sp,
+                      t_submit=(time.perf_counter() if t_submit is None
+                                else float(t_submit)))
+        req.allowed_mask = allowed_mask
+        toks = [int(t) for t in out_tokens]
+        if toks and not self._can_recompute:
+            # no no-sample chunk programs: the history cannot re-enter
+            # the pool without drawing keys — from-scratch re-prefill
+            toks = []
+        req.out_tokens = toks
+        if toks and (len(toks) >= sp.max_new_tokens
+                     or (sp.eos_token_id is not None
+                         and toks[-1] == sp.eos_token_id)):
+            # the migrated history already finished the request — a
+            # resume admission would schedule one decode row past the
+            # budget before retiring; complete it here instead
+            req.out_tokens = toks[:sp.max_new_tokens]
+            req.state = "done"
+            req.t_done = time.perf_counter()
+            self._done[rid] = req
+            return rid
+        req.resume = bool(toks)
+        req.planned = len(toks)
         self._queue.append(req)
         return rid
 
@@ -3767,6 +3873,7 @@ class ServingEngine:
         self.deadline_misses = 0
         self.shed_requests = 0
         self.retries = 0
+        self.dispatch_exhaustions = 0
         self.device_dispatches = 0
         self.drafted_tokens = 0
         self.accepted_draft_tokens = 0
@@ -3845,6 +3952,7 @@ class ServingEngine:
             "deadline_misses": self.deadline_misses,
             "shed_requests": self.shed_requests,
             "retries": self.retries,
+            "dispatch_exhaustions": self.dispatch_exhaustions,
             "decode_steps": self.decode_steps,
             "generated_tokens": self.generated_tokens,
             "latency_p50_s": pct(lats, 0.50),

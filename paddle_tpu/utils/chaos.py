@@ -106,6 +106,24 @@ class ChaosMonkey:
         if engine.dec.cache.fault_hook == self._alloc_hook:
             engine.dec.cache.fault_hook = None
 
+    def wedge(self):
+        """Turn this monkey into a PERSISTENT replica wedge (ISSUE 11):
+        from now on EVERY dispatch and every fetch raises — the model
+        of a replica whose device/link died outright, as opposed to
+        the transient faults the probabilities above inject. The
+        attached engine's bounded retry exhausts on every call and
+        fails the riding requests; above it, the fleet Router reads
+        the exhaustion stream as consecutive strikes, trips its
+        circuit breaker, and drains the replica (tools/chaos_serving
+        --dp leg). Latency/OOM injection keeps its configured rates —
+        a wedged device still answers allocator bookkeeping, which is
+        host-side anyway."""
+        self.p_dispatch = 1.0
+        self.p_collect = 1.0
+        self.counts["wedged"] += 1
+        self.log.append((self._calls, "wedge"))
+        return self
+
     # -- injection sites ----------------------------------------------------
     def _alloc_hook(self):
         self._calls += 1

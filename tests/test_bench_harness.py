@@ -94,6 +94,13 @@ def _full_script(**overrides):
             "serving_lora_lora_tok_per_sec", 95.0,
             {"serving_lora_lora_tok_per_sec": 95.0,
              "serving_lora_adapter_hit_rate": 0.6}), "")],
+        # serving_dp joined AUTO_MODES in the ISSUE-11 PR — scripted
+        # same-PR (the PR-9 lesson, twice applied)
+        "serving_dp": [(_simple(
+            "serving_dp2_tok_per_sec", 88.0,
+            {"serving_dp2_tok_per_sec": 88.0,
+             "serving_dp_affinity_hit_gain": 0.3,
+             "serving_dp_tokens_identical": True}), "")],
         "pp": [(_simple("pp_remat_overhead_x", 0.991,
                         {"pp_remat_overhead_x": 0.991,
                          "pp_tick_fwd_ms": 0.086,

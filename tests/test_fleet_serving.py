@@ -1,0 +1,559 @@
+"""Fleet serving (ISSUE 11): the dp x tp replica mesh behind the
+prefix-affinity Router — routing policy edge cases (affinity, tie-break
+determinism, spill on saturation, fleet-level shedding), cross-replica
+greedy token identity, replica failover mid-prefill and mid-decode with
+token-identical migration, probation re-admission, the SpecLayout data
+axis, the adopt_request migration primitive, fleet stats plumbing +
+reset, and the GPT twin. Runs in the invariant gate
+(check_serving_invariants.py) with PADDLE_TPU_POOL_DEBUG=1 so every
+replica step also asserts the pool invariant."""
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.distributed.spec_layout import (CANONICAL_SPECS,
+                                                DATA_AXIS, SpecLayout)
+from paddle_tpu.inference import (EngineOverloaded, PagedGPTDecoder,
+                                  Router, SamplingParams, ServingEngine)
+from paddle_tpu.utils.chaos import ChaosMonkey
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+CFG = llama_tiny(hidden_size=64, num_attention_heads=4,
+                 num_key_value_heads=2, intermediate_size=96,
+                 num_hidden_layers=2, vocab_size=256,
+                 max_position_embeddings=256)
+
+KW = dict(max_batch_size=3, num_blocks=24, block_size=8,
+          prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(n=4, seed=0, shared_prefix=True):
+    """n prompts; with shared_prefix they open with one block-aligned
+    16-token template (splice-able at block_size=8)."""
+    rng = np.random.RandomState(seed)
+    pre = rng.randint(0, CFG.vocab_size, 16).astype(np.int32)
+    out = []
+    for _ in range(n):
+        tail = rng.randint(0, CFG.vocab_size, 8).astype(np.int32)
+        out.append(np.concatenate([pre, tail]) if shared_prefix
+                   else tail)
+    return out
+
+
+def _oracle(model, prompts, max_new=12):
+    """Single-engine greedy outputs — the replica-independent truth."""
+    eng = ServingEngine(model, **KW)
+    outs = []
+    for p in prompts:
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=max_new))
+        eng.run_to_completion()
+        outs.append(eng.result(rid).tolist())
+    return outs
+
+
+# -- SpecLayout data axis ----------------------------------------------------
+
+class TestSpecLayoutDataAxis:
+    def test_fleet_mesh_axes_and_grid(self):
+        mesh = SpecLayout().fleet_mesh(2, 2)
+        assert mesh.axis_names == (DATA_AXIS, "tp")
+        assert mesh.devices.shape == (2, 2)
+
+    def test_replica_slices_disjoint_and_row_aligned(self):
+        layout = SpecLayout()
+        mesh = layout.fleet_mesh(2, 2)
+        slices = layout.fleet_device_slices(2, 2)
+        assert len(slices) == 2
+        seen = set()
+        for r, row in enumerate(slices):
+            assert row == list(mesh.devices[r])
+            for d in row:
+                assert d not in seen
+                seen.add(d)
+        assert len(seen) == 4
+
+    def test_data_axis_never_shards_a_weight(self):
+        # the canonical dp placement IS replication: any data-axis
+        # entry in a weight spec would make replicas talk in-step
+        for name, spec in CANONICAL_SPECS.items():
+            assert DATA_AXIS not in tuple(spec), name
+
+    def test_oversized_grid_rejected(self):
+        with pytest.raises(ValueError, match="needs"):
+            SpecLayout().fleet_device_slices(4, 4)
+        with pytest.raises(ValueError, match=">= 1"):
+            SpecLayout().fleet_mesh(0, 2)
+
+
+# -- adopt_request: the migration primitive ----------------------------------
+
+class TestAdoptRequest:
+    def test_mid_history_adoption_token_identical(self, model):
+        prompts = _prompts(1)
+        full = _oracle(model, prompts, max_new=14)[0]
+        for cut in (1, 7, 13):
+            eng = ServingEngine(model, **KW)
+            rid = eng.adopt_request(
+                prompts[0], SamplingParams(max_new_tokens=14),
+                out_tokens=full[:cut])
+            eng.run_to_completion()
+            assert eng.result(rid).tolist() == full, f"cut={cut}"
+
+    def test_finished_history_completes_immediately(self, model):
+        prompts = _prompts(1)
+        full = _oracle(model, prompts, max_new=10)[0]
+        eng = ServingEngine(model, **KW)
+        rid = eng.adopt_request(
+            prompts[0], SamplingParams(max_new_tokens=10),
+            out_tokens=full)
+        req = eng.request(rid)
+        assert req.state == "done"
+        assert eng.result(rid).tolist() == full
+        # trailing EOS finishes too, without a decode dispatch
+        rid2 = eng.adopt_request(
+            prompts[0], SamplingParams(max_new_tokens=10,
+                                       eos_token_id=full[3]),
+            out_tokens=full[:4])
+        assert eng.request(rid2).state == "done"
+
+    def test_adopt_bypasses_queue_cap(self, model):
+        eng = ServingEngine(model, max_queue_depth=0, **KW)
+        with pytest.raises(EngineOverloaded):
+            eng.add_request(_prompts(1)[0],
+                            SamplingParams(max_new_tokens=4))
+        rid = eng.adopt_request(_prompts(1)[0],
+                                SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        assert eng.request(rid).state == "done"
+
+    def test_preserves_submit_time(self, model):
+        eng = ServingEngine(model, **KW)
+        t0 = time.perf_counter() - 100.0
+        rid = eng.adopt_request(_prompts(1)[0],
+                                SamplingParams(max_new_tokens=4),
+                                t_submit=t0)
+        assert eng._find_request(rid).t_submit == t0
+
+
+# -- routing policy ----------------------------------------------------------
+
+class TestRouting:
+    def test_tie_break_determinism(self, model):
+        """Equal fleets route equal traffic identically; the zero-
+        coverage tie lands on the lowest index, then spreads by load."""
+        prompts = _prompts(4, shared_prefix=False)
+        placements = []
+        for _ in range(2):
+            router = Router(model, dp=2, **KW)
+            fids = [router.add_request(
+                p, SamplingParams(max_new_tokens=4)) for p in prompts]
+            placements.append(
+                [router._record(f).replica for f in fids])
+            router.run_to_completion()
+        assert placements[0] == placements[1]
+        assert placements[0][0] == 0          # first: lowest index
+        assert set(placements[0]) == {0, 1}   # load then spreads
+
+    def test_affinity_routes_to_cached_replica(self, model):
+        prompts = _prompts(4)
+        router = Router(model, dp=2, **KW)
+        fids = [router.add_request(prompts[0],
+                                   SamplingParams(max_new_tokens=6))]
+        router.run_to_completion()
+        home = router._record(fids[0]).replica
+        # later shared-prefix admissions follow the cached blocks even
+        # though pure load-balancing would alternate replicas
+        for p in prompts[1:]:
+            fids.append(router.add_request(
+                p, SamplingParams(max_new_tokens=6)))
+            router.run_to_completion()
+        assert [router._record(f).replica for f in fids] == [home] * 4
+        st = router.stats()["fleet"]
+        assert st["affinity_hits"] >= 3
+        assert st["routed_requests"] == 4
+
+    def test_affinity_off_routes_by_load(self, model):
+        prompts = _prompts(4)
+        router = Router(model, dp=2, affinity=False, **KW)
+        f0 = router.add_request(prompts[0],
+                                SamplingParams(max_new_tokens=6))
+        router.run_to_completion()
+        # replica 0 now holds the prefix blocks, but load is equal
+        # (0, 0) again — the affinity=False leg must NOT consult the
+        # hash index, so the next request lands on index order, and
+        # with replica 0 loaded the one after goes to replica 1
+        f1 = router.add_request(prompts[1],
+                                SamplingParams(max_new_tokens=6))
+        f2 = router.add_request(prompts[2],
+                                SamplingParams(max_new_tokens=6))
+        assert router._record(f1).replica == 0
+        assert router._record(f2).replica == 1
+        router.run_to_completion()
+        assert router.stats()["fleet"]["affinity_hits"] == 0
+
+    def test_spill_on_saturation(self, model):
+        prompts = _prompts(3)
+        router = Router(model, dp=2, max_queue_depth=1, **KW)
+        fid = router.add_request(prompts[0],
+                                 SamplingParams(max_new_tokens=6))
+        router.run_to_completion()
+        home = router._record(fid).replica
+        # saturate the affinity winner's queue directly (engine-level:
+        # deterministic, no routing side effects on the other replica)
+        rep = router.replicas[home]
+        rep.engine.add_request(_prompts(1, seed=7, shared_prefix=False
+                                        )[0],
+                               SamplingParams(max_new_tokens=4))
+        f2 = router.add_request(prompts[1],
+                                SamplingParams(max_new_tokens=6))
+        assert router._record(f2).replica != home
+        assert router.stats()["fleet"]["spills"] == 1
+        router.run_to_completion()
+
+    def test_fleet_saturated_sheds(self, model):
+        router = Router(model, dp=2, max_queue_depth=0, **KW)
+        with pytest.raises(EngineOverloaded, match="saturated"):
+            router.add_request(_prompts(1)[0],
+                               SamplingParams(max_new_tokens=4))
+        assert router.stats()["fleet"]["shed_requests"] >= 1
+
+    def test_invalid_requests_rejected_at_the_door(self, model):
+        router = Router(model, dp=2, **KW)
+        with pytest.raises(ValueError, match="empty prompt"):
+            router.add_request([], SamplingParams(max_new_tokens=4))
+        with pytest.raises(ValueError, match="bucket"):
+            router.add_request(
+                np.zeros(99, np.int32), SamplingParams(max_new_tokens=4))
+        # the normalization is the ENGINE's (one definition): Tensor
+        # prompts route like arrays
+        from paddle_tpu import to_tensor
+        prompt = _prompts(1, shared_prefix=False)[0]
+        fid = router.add_request(to_tensor(prompt),
+                                 SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        assert router.request(fid).state == "done"
+
+    def test_devices_with_tp1_fails_loudly(self, model):
+        import jax
+        with pytest.raises(ValueError, match="devices= requires"):
+            ServingEngine(model, devices=[jax.devices()[0]], **KW)
+
+    def test_cross_replica_greedy_identity(self, model):
+        """The same request yields identical tokens no matter which
+        replica serves it — the property every other fleet guarantee
+        (affinity indifference, migration identity) rests on."""
+        prompts = _prompts(2)
+        oracle = _oracle(model, prompts, max_new=12)
+        router = Router(model, dp=2, **KW)
+        for i, p in enumerate(prompts):
+            outs = []
+            for rep in router.replicas:
+                rid = rep.engine.add_request(
+                    p, SamplingParams(max_new_tokens=12))
+                rep.engine.run_to_completion()
+                outs.append(rep.engine.result(rid).tolist())
+            assert outs[0] == outs[1] == oracle[i]
+
+
+# -- failover ----------------------------------------------------------------
+
+def _wedge(router, idx):
+    m = ChaosMonkey(seed=0).attach(router.replicas[idx].engine)
+    return m.wedge()
+
+
+class TestFailover:
+    def test_failover_mid_decode_token_identical(self, model):
+        prompts = _prompts(4)
+        oracle = _oracle(model, prompts, max_new=12)
+        router = Router(model, dp=2, breaker_threshold=1,
+                        max_dispatch_retries=1, retry_backoff_s=0.0,
+                        **KW)
+        fids = [router.add_request(p, SamplingParams(max_new_tokens=12))
+                for p in prompts]
+        for _ in range(4):
+            router.step()
+        victim = router._record(fids[0]).replica
+        assert len(router.request(fids[0]).out_tokens) > 0  # mid-decode
+        _wedge(router, victim)
+        router.run_to_completion()
+        st = router.stats()["fleet"]
+        assert st["failovers"] >= 1
+        assert st["migrated_requests"] >= 1
+        assert st["migrated_done"] >= 1
+        assert router.replicas[victim].state == "wedged"
+        for f, want in zip(fids, oracle):
+            assert router.request(f).state == "done"
+            assert router.result(f).tolist() == want
+
+    def test_failover_mid_prefill_token_identical(self, model):
+        rng = np.random.RandomState(3)
+        shorts = _prompts(2, shared_prefix=False)
+        # 64-token prompt: 8 chunks at prefill_chunk=8 — with a decode
+        # running on its replica the per-step prefill budget throttles
+        # it to ~one chunk per step, so a wedge catches it MID-prefill
+        long_p = rng.randint(0, CFG.vocab_size, 64).astype(np.int32)
+        kw = {**KW, "prompt_buckets": (8, 16, 32, 64),
+              "num_blocks": 32}
+        eng = ServingEngine(model, **kw)
+        rid = eng.add_request(long_p, SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        oracle = [eng.result(rid).tolist()]
+        router = Router(model, dp=2, breaker_threshold=1,
+                        max_dispatch_retries=1, retry_backoff_s=0.0,
+                        **kw)
+        # one decode stream per replica keeps both busy
+        fs = [router.add_request(s, SamplingParams(max_new_tokens=20))
+              for s in shorts]
+        for _ in range(2):
+            router.step()
+        fid = router.add_request(long_p,
+                                 SamplingParams(max_new_tokens=8))
+        router.step()          # admit + first budgeted chunk only
+        req = router.request(fid)
+        victim = router._record(fid).replica
+        assert req.state == "prefilling"
+        _wedge(router, victim)
+        router.run_to_completion()
+        assert router.migrations(fid) == 1
+        assert router.request(fid).state == "done"
+        assert router.result(fid).tolist() == oracle[0]
+        assert router.stats()["fleet"]["failovers"] == 1
+
+    def test_stall_strike_trips_breaker(self, model):
+        """The watchdog-stall signal: one replica's steps go slow (the
+        engine itself reports no errors) — the breaker still trips and
+        the router migrates its traffic."""
+        router = Router(model, dp=2, breaker_threshold=2,
+                        stall_timeout_s=0.05, **KW)
+        fid = router.add_request(_prompts(1)[0],
+                                 SamplingParams(max_new_tokens=10))
+        router.step()
+        rep = router.replicas[router._record(fid).replica]
+        orig = rep.engine.step
+
+        def slow_step():
+            time.sleep(0.08)
+            return orig()
+        rep.engine.step = slow_step
+        router.run_to_completion()
+        assert rep.state == "wedged"
+        assert router.stats()["fleet"]["failovers"] == 1
+        assert router.request(fid).state == "done"
+
+    def test_probation_readmission(self, model):
+        prompts = _prompts(4)
+        router = Router(model, dp=2, breaker_threshold=1,
+                        max_dispatch_retries=1, retry_backoff_s=0.0,
+                        cooldown_steps=2, probation_steps=2, **KW)
+        fid = router.add_request(prompts[0],
+                                 SamplingParams(max_new_tokens=8))
+        for _ in range(2):
+            router.step()
+        victim = router._record(fid).replica
+        monkey = _wedge(router, victim)
+        router.run_to_completion()
+        rep = router.replicas[victim]
+        # cooldown may already have revived it onto probation during
+        # the drain loop; the wedge itself is pinned by the counter
+        assert rep.wedges == 1
+        assert rep.state in ("wedged", "probation")
+        # the fault heals: detach the monkey, cool down, re-admit
+        monkey.detach(rep.engine)
+        rep.engine.chaos = None
+        for _ in range(3):
+            router.step()
+        assert rep.state == "probation"
+        # probation replicas serve again; clean ACTIVE steps promote
+        # back to healthy (traffic pinned to the probation engine so
+        # promotion doesn't depend on routing draws)
+        rids = [rep.engine.add_request(
+            p, SamplingParams(max_new_tokens=6)) for p in prompts]
+        while router.step():
+            pass
+        assert rep.state == "healthy"
+        assert all(rep.engine.request(r).state == "done"
+                   for r in rids)
+        assert router.request(fid).state == "done"
+
+    def test_rewedge_on_probation_is_immediate(self, model):
+        """A probation replica gets NO breaker budget: its first
+        faulty step re-wedges it (threshold 1, not breaker_threshold)
+        — a persistent fault cannot flap a replica back into full
+        rotation."""
+        router = Router(model, dp=2, breaker_threshold=2,
+                        max_dispatch_retries=0, retry_backoff_s=0.0,
+                        cooldown_steps=1, probation_steps=4, **KW)
+        fid = router.add_request(_prompts(1)[0],
+                                 SamplingParams(max_new_tokens=8))
+        for _ in range(2):
+            router.step()
+        victim = router._record(fid).replica
+        rep = router.replicas[victim]
+        _wedge(router, victim)     # persistent: stays faulty
+        router.run_to_completion()
+        assert rep.wedges == 1
+        assert router.request(fid).state == "done"   # migrated
+        # cooldown revives it onto probation; pin fresh work to it —
+        # the persistent fault re-wedges on the FIRST faulty step even
+        # though a healthy replica would get breaker_threshold strikes
+        for _ in range(3):
+            router.step()
+        assert rep.state == "probation"
+        rep.engine.add_request(_prompts(1, seed=9)[0],
+                               SamplingParams(max_new_tokens=8))
+        strikes_before = rep.strikes
+        router.step()
+        # exactly one faulty step sufficed — no second strike needed
+        assert strikes_before == 0
+        assert rep.wedges == 2
+        assert rep.state == "wedged"
+
+    def test_gpt_twin_failover(self):
+        paddle.seed(0)
+        gcfg = GPTConfig(vocab_size=256, hidden_size=64,
+                         num_hidden_layers=2, num_attention_heads=4,
+                         max_position_embeddings=128)
+        gmodel = GPTForCausalLM(gcfg)
+        gmodel.eval()
+        ekw = {k: v for k, v in KW.items()
+               if k not in ("num_blocks", "block_size")}
+
+        def factory(idx, devs):
+            dec = PagedGPTDecoder(gmodel, num_blocks=24, block_size=8)
+            return ServingEngine(dec, max_dispatch_retries=1,
+                                 retry_backoff_s=0.0, **ekw)
+
+        prompts = _prompts(3)
+        single = factory(0, None)
+        oracle = []
+        for p in prompts:
+            rid = single.add_request(p,
+                                     SamplingParams(max_new_tokens=10))
+            single.run_to_completion()
+            oracle.append(single.result(rid).tolist())
+        router = Router(None, dp=2, breaker_threshold=1,
+                        engine_factory=factory)
+        fids = [router.add_request(p, SamplingParams(max_new_tokens=10))
+                for p in prompts]
+        for _ in range(3):
+            router.step()
+        _wedge(router, router._record(fids[0]).replica)
+        router.run_to_completion()
+        assert router.stats()["fleet"]["failovers"] >= 1
+        for f, want in zip(fids, oracle):
+            assert router.request(f).state == "done"
+            assert router.result(f).tolist() == want
+
+
+# -- dp x tp composition -----------------------------------------------------
+
+class TestDpTp:
+    def test_dp2_tp2_greedy_identity(self, model):
+        """Two tp=2 replicas on DISJOINT device rows serve greedy
+        traffic token-identical to the single-chip engine."""
+        prompts = _prompts(3)
+        oracle = _oracle(model, prompts, max_new=10)
+        router = Router(model, dp=2, tp=2, **KW)
+        # replica meshes sit on the canonical grid rows
+        slices = SpecLayout().fleet_device_slices(2, 2)
+        for r, rep in enumerate(router.replicas):
+            assert list(rep.engine.dec.mesh.devices.ravel()) \
+                == slices[r]
+        fids = []
+        for p in prompts:
+            fids.append(router.add_request(
+                p, SamplingParams(max_new_tokens=10)))
+            router.step()
+        router.run_to_completion()
+        for f, want in zip(fids, oracle):
+            assert router.result(f).tolist() == want
+
+    def test_dp_comm_expectations_pinned_identical(self):
+        """The committed comm-audit expectations for the fleet
+        replica's step program must be EXACTLY the single-engine tp
+        program's — dp contributes zero step-path collectives."""
+        path = os.path.join(REPO, "tools", "flightcheck",
+                            "comm_expectations.json")
+        with open(path, encoding="utf-8") as fh:
+            exp = json.load(fh)
+        assert "serving.ragged_dp2_tp2" in exp
+        assert exp["serving.ragged_dp2_tp2"] \
+            == exp["serving.ragged_tp2_fp32"]
+
+
+# -- stats -------------------------------------------------------------------
+
+class TestFleetStats:
+    def test_rollup_plumbing(self, model):
+        prompts = _prompts(4)
+        router = Router(model, dp=2, **KW)
+        fids = []
+        for p in prompts:
+            fids.append(router.add_request(
+                p, SamplingParams(max_new_tokens=6)))
+            router.step()
+        router.run_to_completion()
+        st = router.stats()
+        fleet, per = st["fleet"], st["replicas"]
+        assert len(per) == 2
+        assert fleet["replicas"] == 2
+        assert fleet["healthy_replicas"] == 2
+        assert fleet["routed_requests"] == 4
+        assert fleet["finished"] == 4
+        assert fleet["generated_tokens"] == \
+            sum(p["generated_tokens"] for p in per) == 4 * 6
+        assert fleet["goodput_tokens"] == 4 * 6
+        assert fleet["itl_p50_s"] is not None
+        assert fleet["itl_p99_s"] >= fleet["itl_p50_s"]
+        assert fleet["failovers"] == 0
+        assert fleet["migrated_requests"] == 0
+        for p in per:
+            assert p["state"] == "healthy"
+            assert p["wedges"] == 0
+            assert "load" in p
+
+    def test_clear_finished_resets_everything(self, model):
+        prompts = _prompts(4)
+        router = Router(model, dp=2, breaker_threshold=1,
+                        max_dispatch_retries=1, retry_backoff_s=0.0,
+                        **KW)
+        fids = [router.add_request(p, SamplingParams(max_new_tokens=8))
+                for p in prompts]
+        for _ in range(3):
+            router.step()
+        _wedge(router, router._record(fids[0]).replica)
+        router.run_to_completion()
+        before = router.stats()["fleet"]
+        assert before["failovers"] >= 1
+        assert before["migrated_requests"] >= 1
+        assert before["affinity_hits"] + before["spills"] \
+            + before["routed_requests"] > 0
+        router.clear_finished()
+        st = router.stats()["fleet"]
+        for key in ("routed_requests", "affinity_hits", "spills",
+                    "failovers", "migrated_requests", "migrated_done",
+                    "failed_migrations",
+                    "shed_requests", "finished", "generated_tokens",
+                    "goodput_tokens", "preemptions", "aborted",
+                    "failed", "retries", "dispatch_exhaustions"):
+            assert st[key] == 0, key
+        assert st["itl_p50_s"] is None
+        # terminal fleet records dropped with their engine records
+        with pytest.raises(KeyError):
+            router.result(fids[0])

@@ -23,6 +23,17 @@ token mismatch, or (with --require-events) a schedule that failed to
 exercise at least one OOM-driven preemption, one injected dispatch
 fault AND one cancellation/abort. Prints one JSON summary line
 (BENCH-style extra dict).
+
+--dp R (ISSUE 11) swaps the single engine for an R-replica
+prefix-affinity fleet Router: every replica gets its own seeded
+background monkey AND replica 0 is WEDGED at a seeded mid-run step
+(ChaosMonkey.wedge — persistent dispatch+collect failure). The Router
+must trip its circuit breaker, drain the wedged replica and
+redistribute its queue as prompt+generated-history recomputes;
+--require-events then demands >=1 replica failover and >=1
+migrated-request COMPLETION on top of the dispatch-fault/cancellation
+events, and token identity covers surviving and migrated requests
+alike vs a fault-free fleet replay.
 """
 from __future__ import annotations
 
@@ -74,6 +85,23 @@ def build_engine(model, args):
         spec_decode=SpecConfig(draft_len=4)
         if getattr(args, "spec", False) else None,
         lora=lora)
+
+
+def build_fleet(model, args):
+    """The --dp leg's fleet (ISSUE 11): R single-chip replicas behind
+    the prefix-affinity Router, each with the same tight-geometry
+    engine the single-engine legs use. Both the chaos run and the
+    fault-free replay build IDENTICAL fleets, so token identity of
+    surviving AND migrated requests is well-defined (all-greedy
+    workload; routing may differ between the runs — greedy outputs are
+    replica-independent by the cross-replica identity contract)."""
+    from paddle_tpu.inference.fleet import Router
+    return Router(
+        model, dp=args.dp,
+        max_batch_size=3, num_blocks=args.num_blocks, block_size=8,
+        prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8,
+        admission="optimistic", max_dispatch_retries=args.retries,
+        retry_backoff_s=0.0, ragged=getattr(args, "ragged", False))
 
 
 def gen_workload(args):
@@ -135,21 +163,49 @@ def gen_workload(args):
 
 
 def run_schedule(model, args, chaotic: bool):
-    """One full run; returns (results-by-ordinal, engine, monkey)."""
+    """One full run; returns (results-by-ordinal, engine-or-router,
+    monkey-or-monkeys, steps_run). With --dp R > 1 the engine is a
+    fleet Router: every replica gets its own seeded background monkey,
+    and at a SEEDED mid-run step replica 0's monkey turns into a
+    persistent wedge (ChaosMonkey.wedge — every dispatch/fetch fails
+    from then on); the Router must trip its breaker, drain the replica
+    and redistribute, with migrated requests finishing token-identical
+    to the fault-free fleet replay."""
     from paddle_tpu.inference import SamplingParams
     from paddle_tpu.utils.chaos import ChaosMonkey
 
-    eng = build_engine(model, args)
-    monkey = None
-    if chaotic:
-        monkey = ChaosMonkey(
-            seed=args.seed + 1, p_alloc_oom=args.p_oom,
+    dp = getattr(args, "dp", 1)
+    if dp > 1:
+        eng = build_fleet(model, args)
+        monkey = [ChaosMonkey(
+            seed=args.seed + 1 + r, p_alloc_oom=args.p_oom,
             p_dispatch=args.p_dispatch, p_collect=args.p_collect,
-            p_latency=args.p_latency).attach(eng)
+            p_latency=args.p_latency).attach(rep.engine)
+            for r, rep in enumerate(eng.replicas)] if chaotic else None
+        wedge_step = args.steps // 3
+    else:
+        eng = build_engine(model, args)
+        monkey = None
+        if chaotic:
+            monkey = ChaosMonkey(
+                seed=args.seed + 1, p_alloc_oom=args.p_oom,
+                p_dispatch=args.p_dispatch, p_collect=args.p_collect,
+                p_latency=args.p_latency).attach(eng)
     arrivals, cancels = gen_workload(args)
     rid_of = {}
     next_arrival = 0
     steps_run = 0
+    user_cancels = 0   # cancels that actually landed on a live request
+    #                    (distinct from drain-migration aborts: the dp
+    #                    wedge drain aborts victims too, so the
+    #                    cancellation event must count USER cancels)
+
+    def debug_check():
+        if dp > 1:
+            for rep in eng.replicas:
+                rep.engine.dec.cache.debug_check()
+        else:
+            eng.dec.cache.debug_check()
 
     def inject_step_events(step):
         nonlocal next_arrival
@@ -163,15 +219,24 @@ def run_schedule(model, args, chaotic: bool):
                                        allowed_tokens=allowed))
             next_arrival += 1
         if chaotic:
+            nonlocal user_cancels
+            if dp > 1 and step == wedge_step:
+                monkey[0].wedge()
             for ordinal in cancels.get(step, ()):
                 rid = rid_of.get(ordinal)
-                if rid is not None and rid not in eng._done:
-                    eng.cancel(rid)
+                if rid is None:
+                    continue
+                if dp > 1:
+                    if eng.cancel(rid):   # False on terminal — no-op
+                        user_cancels += 1
+                elif rid not in eng._done:
+                    if eng.cancel(rid):
+                        user_cancels += 1
 
     for step in range(args.steps):
         inject_step_events(step)
         eng.step()
-        eng.dec.cache.debug_check()
+        debug_check()
         steps_run += 1
     # drain (chaos stays attached: the tail is chaotic too; schedule
     # events keep firing so nothing lands silently past the window)
@@ -180,7 +245,7 @@ def run_schedule(model, args, chaotic: bool):
     while eng.has_work and drain_cap > 0:
         inject_step_events(step)
         eng.step()
-        eng.dec.cache.debug_check()
+        debug_check()
         steps_run += 1
         step += 1
         drain_cap -= 1
@@ -190,7 +255,7 @@ def run_schedule(model, args, chaotic: bool):
     for ordinal, rid in rid_of.items():
         req = eng.request(rid)
         results[ordinal] = (req.state, list(req.out_tokens), req.error)
-    return results, eng, monkey, steps_run
+    return results, eng, monkey, steps_run, user_cancels
 
 
 def main() -> int:
@@ -241,11 +306,25 @@ def main() -> int:
                          "mid-window, injected dispatch/collect "
                          "faults, cancellation) and surviving outputs "
                          "must stay token-identical (implies ragged)")
+    ap.add_argument("--dp", type=int, default=1,
+                    help="fleet replica count (ISSUE 11): both runs "
+                         "serve through a dp-replica prefix-affinity "
+                         "Router; the chaos run additionally WEDGES "
+                         "replica 0 at a seeded mid-run step "
+                         "(persistent dispatch+collect faults) — the "
+                         "router must trip its circuit breaker, drain "
+                         "the replica and redistribute its queue, and "
+                         "every surviving AND migrated request must "
+                         "stay token-identical vs the fault-free "
+                         "fleet replay")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
                          "actually happened (with --spec, also >=1 "
-                         "draft rejection)")
+                         "draft rejection; with --dp, the preemption "
+                         "requirement is replaced by >=1 replica "
+                         "failover and >=1 migrated-request "
+                         "completion)")
     args = ap.parse_args()
     if args.num_blocks is None:
         args.num_blocks = 24 if args.lora else 14
@@ -265,10 +344,10 @@ def main() -> int:
     model = LlamaForCausalLM(cfg)
     model.eval()
 
-    base_results, base_eng, _, _ = run_schedule(model, args,
-                                                chaotic=False)
-    chaos_results, eng, monkey, steps_run = run_schedule(model, args,
-                                                         chaotic=True)
+    base_results, base_eng, _, _, _ = run_schedule(model, args,
+                                                   chaotic=False)
+    chaos_results, eng, monkey, steps_run, user_cancels = \
+        run_schedule(model, args, chaotic=True)
 
     mismatches = []
     done = faulted = 0
@@ -281,6 +360,59 @@ def main() -> int:
                     {"ordinal": ordinal, "chaos": toks, "base": btoks})
         else:
             faulted += 1
+    if args.dp > 1:
+        from collections import Counter
+        fleet = eng.stats()["fleet"]
+        injected = Counter()
+        for m in monkey:
+            injected.update(m.counts)
+        summary = {
+            "dp": args.dp,
+            "ragged": bool(args.ragged),
+            "steps": steps_run,
+            "requests": len(chaos_results),
+            "failovers": fleet["failovers"],
+            "migrated_requests": fleet["migrated_requests"],
+            "migrated_done": fleet["migrated_done"],
+            "affinity_hits": fleet["affinity_hits"],
+            "spills": fleet["spills"],
+            "preemptions": fleet["preemptions"],
+            "aborted": fleet["aborted"],
+            "failed": fleet["failed"],
+            "retries": fleet["retries"],
+            "dispatch_exhaustions": fleet["dispatch_exhaustions"],
+            "wedged_replicas": fleet["wedged_replicas"],
+            "user_cancels": user_cancels,
+            "injected": dict(injected),
+        }
+        summary["done_identical"] = done - len(mismatches)
+        summary["mismatches"] = len(mismatches)
+        summary["faulted"] = faulted
+        ok = not mismatches
+        if args.require_events:
+            missing = []
+            if fleet["failovers"] < 1:
+                missing.append("replica_failover")
+            if fleet["migrated_done"] < 1:
+                missing.append("migrated_request_completion")
+            if injected.get("dispatch_faults", 0) < 1:
+                missing.append("dispatch_fault")
+            # USER cancels specifically: the wedge drain aborts its
+            # victims too, so fleet["aborted"] >= 1 is guaranteed by
+            # failover alone and would mask a dead cancel path
+            if user_cancels < 1:
+                missing.append("cancellation")
+            if missing:
+                summary["missing_events"] = missing
+                ok = False
+        summary["ok"] = ok
+        print(json.dumps(summary))
+        for m in mismatches[:4]:
+            print(f"MISMATCH ordinal {m['ordinal']}: "
+                  f"chaos={m['chaos']} base={m['base']}",
+                  file=sys.stderr)
+        return 0 if ok else 1
+
     st = eng.stats()
     summary = {
         "ragged": args.ragged or args.tp > 1 or args.spec or args.lora,

@@ -50,6 +50,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_tp_serving.py"),
     os.path.join(REPO, "tests", "test_spec_decode.py"),
     os.path.join(REPO, "tests", "test_lora_serving.py"),
+    os.path.join(REPO, "tests", "test_fleet_serving.py"),
 ]
 
 
@@ -111,7 +112,14 @@ def run_chaos() -> int:
     3-adapter registry (some requests masked via allowed_tokens) —
     --require-events additionally demands >=1 adapter eviction-
     and-refault and >=1 masked decode column, so S-LoRA paging
-    churns under the same faults."""
+    churns under the same faults. ISSUE 11 added the --dp 2 leg:
+    the same schedule through a 2-replica prefix-affinity fleet
+    Router with replica 0 WEDGED at a seeded mid-run step —
+    --require-events demands >=1 replica failover and >=1
+    migrated-request completion, and token identity covers
+    surviving AND migrated requests vs a fault-free fleet replay
+    (the router drains the wedged replica and redistributes its
+    queue as no-sample prompt+history recomputes)."""
     import subprocess
     rc_all = 0
     # the lora leg (ISSUE 10) runs more requests on a 20-block pool:
@@ -122,7 +130,8 @@ def run_chaos() -> int:
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
                      ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
                      ("lora", ("--lora", "--num-blocks", "20",
-                               "--requests", "12"))):
+                               "--requests", "12")),
+                     ("dp2", ("--dp", "2"))):
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",

@@ -432,9 +432,65 @@ def _build_tp_serving():
             return eng._ragged_lora_j, args
         return build
 
+    def _mk_dp():
+        def build():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from paddle_tpu.distributed.spec_layout import SpecLayout
+            from paddle_tpu.inference.fleet import Router
+            from paddle_tpu.inference.paged_decode import \
+                PagedLlamaDecoder
+            from paddle_tpu.inference.serving import ServingEngine
+            from paddle_tpu.models.llama import LlamaConfig
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+
+            def factory(idx, devs):
+                mesh = Mesh(np.asarray(devs), ("tp",))
+                dec = PagedLlamaDecoder.from_config(
+                    cfg, num_blocks=8, block_size=4, mesh=mesh,
+                    mp_axis="tp", tp_shard_map=True, tp_comm="fp32")
+                return ServingEngine(dec, tp=2, max_batch_size=2,
+                                     prompt_buckets=(8, 16),
+                                     chunk_size=2, prefill_chunk=4)
+
+            router = Router(None, dp=2, tp=2, engine_factory=factory)
+            # replica 1 — the row OFF the default device slice: its
+            # placement comes from SpecLayout.fleet_device_slices and
+            # proves a non-zero dp row's step program is byte-for-byte
+            # the single-engine tp program
+            eng = router.replicas[1].engine
+            grid = SpecLayout().fleet_device_slices(2, 2)
+            assert list(eng.dec.mesh.devices.ravel()) == grid[1]
+            T, W = 2, 4
+            S = jax.ShapeDtypeStruct
+            i32, f32 = jnp.int32, jnp.float32
+            args = (eng.dec.weights, eng.dec.cache.k, eng.dec.cache.v,
+                    S((T, W), i32), S((W,), i32), S((W,), i32),
+                    S((W,), jnp.bool_), S((W,), i32),
+                    S((T, W), i32), S((T, W), i32), S((T, W), i32),
+                    S((T, W), i32), S((T, W), i32),
+                    S((T, W), jnp.bool_),
+                    S((eng.max_b + 1, eng.dec.max_pages), i32),
+                    S((T, W), f32), S((T, 2), jnp.uint32))
+            return eng._ragged_j, args
+        return build
+
     return {"serving.ragged_tp2_fp32": _mk("fp32"),
             "serving.ragged_tp2_int8": _mk("int8"),
             "serving.ragged_spec_tp2": _mk_spec(),
+            # ISSUE 11: a dp x tp FLEET replica's ragged step — built
+            # through the Router on row 1 of the SpecLayout 2x2 device
+            # grid — must pin EXACTLY the collectives of the
+            # single-engine tp=2 program (serving.ragged_tp2_fp32):
+            # data parallelism contributes ZERO step-path collectives
+            # because replicas never talk during a step (affinity is a
+            # host-side hash lookup, failover a host-side re-enqueue)
+            "serving.ragged_dp2_tp2": _mk_dp(),
             # ISSUE 10: the multi-tenant lora twin of the fp32 ragged
             # step MUST pin exactly the base program's collectives —
             # the per-row adapter deltas (replicated pool gather,
