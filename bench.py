@@ -1160,6 +1160,93 @@ def run_serving_ragged(weight_dtype=None):
     return out
 
 
+def run_serving_trace():
+    """Serving telemetry overhead A/B (ISSUE 12): the ragged-row
+    workload (6 steady decode streams + a 512-token prompt landing
+    mid-stream) run twice on the SAME engine config — tracer off vs a
+    full Tracer (per-request spans, per-dispatch events, metrics
+    registry). The pinned-overhead contract: tracing costs < 5% tok/s
+    in-row (asserted, not just reported) and tokens are bit-identical
+    (tracing never touches scheduling, sampling or the PRNG stream).
+    Each leg is measured twice and scored on its best wall (one-box
+    CPU walls jitter a few percent; the mechanism under test is a few
+    host-side dict appends per step). The traced leg's flight recorder
+    is exported as the bench artifact (serving_trace.perfetto.json,
+    summarizable via tools/trace_report.py)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_small
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+    from paddle_tpu.utils.telemetry import Tracer
+
+    cfg = llama_small(dtype="bfloat16")
+    block_size = 32
+    n_short, short_len, short_new = 6, 96, 96
+    long_len, long_new = 512, 32
+    rng = np.random.RandomState(0)
+    shorts = [rng.randint(0, cfg.vocab_size, short_len).astype(np.int32)
+              for _ in range(n_short)]
+    longp = rng.randint(0, cfg.vocab_size, long_len).astype(np.int32)
+    n_blocks = (n_short * -(-(short_len + short_new) // block_size)
+                + -(-(long_len + long_new) // block_size) + 2)
+    out = {}
+    toks = {}
+    tracer = None
+    for tag in ("off", "on"):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        tracer = Tracer() if tag == "on" else None
+        eng = ServingEngine(
+            model, max_batch_size=n_short + 1, num_blocks=n_blocks,
+            block_size=block_size, prompt_buckets=(128, long_len),
+            chunk_size=8, prefill_chunk=32, ragged=True,
+            tracer=tracer)
+        eng.warmup()
+        best = None
+        for _rep in range(2):
+            eng.clear_finished()
+            t0 = time.perf_counter()
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=short_new))
+                for p in shorts]
+            while eng.generated_tokens < n_short * short_new // 4:
+                eng.step()
+            rl = eng.add_request(
+                longp, SamplingParams(max_new_tokens=long_new))
+            eng.run_to_completion()
+            wall = time.perf_counter() - t0
+            gen = eng.stats()["generated_tokens"]
+            leg = {"wall": wall, "rate": gen / wall,
+                   "toks": [eng.result(r).tolist()
+                            for r in rids + [rl]]}
+            if best is None or leg["rate"] > best["rate"]:
+                best = leg
+        toks[tag] = best["toks"]
+        out[f"serving_trace_{tag}_tok_per_sec"] = round(best["rate"], 1)
+        out[f"serving_trace_{tag}_wall_s"] = round(best["wall"], 3)
+        if tracer is not None:
+            path = os.path.join(os.path.dirname(
+                os.path.abspath(__file__)),
+                "serving_trace.perfetto.json")
+            tracer.export(path)
+            out["serving_trace_artifact"] = path
+            out["serving_trace_records"] = tracer.appended
+            out["serving_trace_dropped"] = tracer.dropped
+        del eng, model
+        _clear_device_memory()
+    overhead = 1.0 - (out["serving_trace_on_tok_per_sec"]
+                      / max(out["serving_trace_off_tok_per_sec"], 1e-9))
+    out["serving_trace_overhead_frac"] = round(overhead, 4)
+    out["serving_trace_tokens_identical"] = toks["on"] == toks["off"]
+    # the acceptance bar, enforced in-row: tracer-off outputs
+    # bit-identical, tracer-on within the pinned overhead budget
+    assert toks["on"] == toks["off"], \
+        "tracing changed serving outputs — it must be schedule-neutral"
+    assert overhead < 0.05, \
+        f"tracer overhead {overhead:.1%} exceeds the 5% contract"
+    return out
+
+
 def run_serving_spec():
     """Speculative decoding A/B (the ISSUE-9 acceptance scenario): 6
     greedy decode streams, spec on vs off, on TWO workload regimes:
@@ -1834,6 +1921,11 @@ def run_serving_suite():
     # delivered token, one program per step vs the dense schedule
     out.update(run_serving_ragged())
     _suite_barrier("serving_ragged", out)
+    # telemetry overhead A/B (ISSUE 12): tracer on/off on the ragged
+    # row — < 5% tok/s overhead asserted in-row, tokens bit-identical,
+    # flight recorder exported as the bench artifact
+    out.update(run_serving_trace())
+    _suite_barrier("serving_trace", out)
     # speculative decoding A/B (ISSUE 9): repetitive vs adversarial
     # workloads, spec on/off — tok/s, ITL, acceptance rate, token
     # identity asserted inside the row
@@ -2100,6 +2192,12 @@ def main(mode: str):
                   "unit": "x",
                   "value": r["serving_ragged_dispatch_reduction_x"],
                   "extra": r}
+    elif mode == "serving_trace":
+        r = run_serving_trace()
+        result = {"metric": "serving_trace_overhead_frac",
+                  "unit": "frac",
+                  "value": r["serving_trace_overhead_frac"],
+                  "extra": r}
     elif mode == "serving_spec":
         r = run_serving_spec()
         result = {"metric": "serving_spec_rep_speedup_x",
@@ -2161,9 +2259,9 @@ def main(mode: str):
 _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
                 "resnet", "decode", "8b", "serving",
                 "serving_interleave", "serving_degradation",
-                "serving_ragged", "serving_spec", "serving_tp",
-                "serving_lora", "serving_dp", "pp", "moe", "dit",
-                "profile", "calibrate")
+                "serving_ragged", "serving_trace", "serving_spec",
+                "serving_tp", "serving_lora", "serving_dp", "pp",
+                "moe", "dit", "profile", "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

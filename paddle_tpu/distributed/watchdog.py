@@ -255,6 +255,21 @@ def watch_engine(engine, timeout: Optional[float] = None,
     def _dump(buf: io.StringIO):
         # debug_dump() opens with its own "serving engine state:" header
         buf.write(engine.debug_dump())
+        # flight recorder (ISSUE 12): the hang report carries the tail
+        # of the telemetry ring — what dispatched, retried, preempted
+        # or faulted right before the wedge — and, when the report is
+        # going to a file, the FULL Perfetto export lands next to it
+        # so every hang ships its own post-mortem timeline
+        tracer = getattr(engine, "tracer", None)
+        if tracer is not None:
+            buf.write(tracer.summary())
+            if dump_path:
+                try:
+                    p = tracer.export(dump_path + ".trace.json")
+                    buf.write(f"flight recorder exported: {p}\n")
+                except Exception as e:     # noqa: BLE001 — the hang
+                    # report must survive any export failure
+                    buf.write(f"(flight recorder export failed: {e})\n")
 
     wd = StepWatchdog(timeout=timeout, poll_interval=poll_interval,
                       on_hang=on_hang, dump_path=dump_path,

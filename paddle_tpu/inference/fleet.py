@@ -74,6 +74,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..distributed.spec_layout import SpecLayout
+from ..utils.telemetry import FLEET_PID, Reservoir
 from .serving import (EngineOverloaded, SamplingParams, ServingEngine,
                       _normalize_prompt)
 
@@ -116,6 +117,10 @@ class _FleetRequest:
     rid: int                        # engine-local req_id on `replica`
     t_submit: float = 0.0
     migrations: int = 0
+    # telemetry span id (ISSUE 12): opened by the owning engine's
+    # add_request, carried through adopt_request at migration so the
+    # whole lifecycle is ONE continuous span across replicas
+    trace_id: Optional[int] = None
 
 
 class Router:
@@ -157,6 +162,7 @@ class Router:
                  cooldown_steps: Optional[int] = None,
                  probation_steps: int = 8,
                  engine_factory: Optional[Callable] = None,
+                 tracer=None,
                  **engine_kwargs):
         dp = int(dp)
         if dp < 1:
@@ -175,6 +181,12 @@ class Router:
         layout = SpecLayout()
         slices = (layout.fleet_device_slices(dp, tp) if self.tp > 1
                   else [None] * dp)
+        # telemetry (ISSUE 12): ONE shared Tracer across the Router and
+        # every replica engine — per-request spans carry replica pids
+        # and routing/breaker/migration events land on the fleet track,
+        # so a migrated request renders as a single continuous span
+        # crossing two replica tracks. tracer=None is a bitwise no-op.
+        self.tracer = tracer
         self.replicas: List[Replica] = []
         for r in range(dp):
             if engine_factory is not None:
@@ -182,6 +194,8 @@ class Router:
             else:
                 eng = ServingEngine(model, tp=tp, devices=slices[r],
                                     **engine_kwargs)
+            if tracer is not None:
+                eng.set_telemetry(tracer, replica_id=r)
             self.replicas.append(Replica(r, eng))
         self._requests: Dict[int, _FleetRequest] = {}
         self._fids = itertools.count()
@@ -243,6 +257,9 @@ class Router:
         order, cov = self._ranked(prompt, sp)
         if not order:
             self.shed_requests += 1
+            if self.tracer is not None:
+                self.tracer.event("fleet_shed", pid=FLEET_PID,
+                                  reason="all_wedged")
             raise EngineOverloaded("fleet has no eligible replica "
                                    "(all wedged)")
         last_exc = invalid = None
@@ -262,18 +279,29 @@ class Router:
                 invalid = invalid or e
                 continue
             fid = next(self._fids)
-            self._requests[fid] = _FleetRequest(
-                fid, prompt, sp, rep.idx, rid,
-                t_submit=time.perf_counter())
+            rec = _FleetRequest(fid, prompt, sp, rep.idx, rid,
+                                t_submit=time.perf_counter())
+            self._requests[fid] = rec
             self.routed_requests += 1
             if cov.get(rep.idx, 0) > 0:
                 self.affinity_hits += 1
             if pos > 0:
                 self.spills += 1
+            if self.tracer is not None:
+                req = rep.engine._find_request(rid)
+                rec.trace_id = (req.trace_id if req is not None
+                                else None)
+                self.tracer.event(
+                    "route", trace=rec.trace_id, pid=FLEET_PID,
+                    fid=fid, replica=rep.idx,
+                    coverage=int(cov.get(rep.idx, 0)), spill=pos)
             return fid
         if invalid is not None and last_exc is None:
             raise invalid          # rejected everywhere: caller error
         self.shed_requests += 1
+        if self.tracer is not None:
+            self.tracer.event("fleet_shed", pid=FLEET_PID,
+                              reason="saturated")
         raise EngineOverloaded(
             f"fleet saturated: all {len(order)} eligible replica(s) "
             f"shed the request (last: {last_exc or invalid})")
@@ -340,6 +368,10 @@ class Router:
             # a caller already observed as failed
             rep.burst_failed_mark = prestep_mark
         rep.strikes += max(1, int(amount))
+        if self.tracer is not None:
+            self.tracer.event("breaker_strike", pid=FLEET_PID,
+                              replica=rep.idx, strikes=rep.strikes,
+                              amount=int(amount), state=rep.state)
         limit = 1 if rep.state == "probation" else self.breaker_threshold
         if rep.strikes >= limit:
             self._wedge(rep)
@@ -350,6 +382,10 @@ class Router:
         rep.wedged_at = self._step_no
         rep.strikes = 0
         self.failovers += 1
+        if self.tracer is not None:
+            self.tracer.event("breaker_wedge", pid=FLEET_PID,
+                              replica=rep.idx, wedges=rep.wedges,
+                              step=self._step_no)
         self._drain(rep)
 
     def _drain(self, rep: Replica):
@@ -373,6 +409,10 @@ class Router:
                 continue
             if req.state in ("queued", "prefilling", "running"):
                 victims.append((rec, list(req.out_tokens)))
+                # the local abort is a MIGRATION, not a terminal end:
+                # keep the lifetime span open so the adopted
+                # continuation on the new replica stays one span
+                req.trace_keep_open = True
                 try:
                     eng.cancel(rec.rid)
                 except Exception:       # noqa: BLE001 — wedged engine:
@@ -380,6 +420,14 @@ class Router:
             elif (req.state == "failed"
                   and rec.rid not in rep.burst_failed_mark):
                 victims.append((rec, list(req.out_tokens)))
+                if self.tracer is not None:
+                    # the burst failure already closed this span; the
+                    # migration supersedes it — rescind the end so the
+                    # adopted continuation keeps ONE continuous span
+                    self.tracer.reopen_request(rec.trace_id)
+        if self.tracer is not None:
+            self.tracer.event("failover", pid=FLEET_PID,
+                              replica=rep.idx, victims=len(victims))
         for rec, toks in victims:
             self._migrate(rec, toks)
 
@@ -398,13 +446,18 @@ class Router:
             try:
                 rid = target.engine.adopt_request(
                     rec.prompt, rec.sampling, out_tokens=out_tokens,
-                    t_submit=rec.t_submit)
+                    t_submit=rec.t_submit, trace_id=rec.trace_id)
             except Exception:   # noqa: BLE001 — a refusing candidate
                 # (heterogeneous fleet: adapter not registered there,
                 # tighter pool geometry) must not abort the drain: the
                 # remaining victims still need their migration, and
                 # step()'s never-raises contract covers drains too
                 continue
+            if self.tracer is not None:
+                self.tracer.event(
+                    "migrate", trace=rec.trace_id, pid=FLEET_PID,
+                    fid=rec.fid, src=rec.replica, dst=target.idx,
+                    history=len(out_tokens))
             rec.rid = rid
             rec.replica = target.idx
             rec.migrations += 1
@@ -416,6 +469,15 @@ class Router:
         # state reads aborted/failed) and the refusal is COUNTED so
         # a failovers-vs-victims delta is visible in stats
         self.failed_migrations += 1
+        if self.tracer is not None:
+            self.tracer.event("migration_failed", trace=rec.trace_id,
+                              pid=FLEET_PID, fid=rec.fid,
+                              src=rec.replica)
+            # the drain suppressed the local abort's span end expecting
+            # a continuation that never came — close it here
+            self.tracer.end_request(rec.trace_id, "failed",
+                                    replica=rec.replica,
+                                    error="migration failed")
 
     def _maybe_probation(self, rep: Replica):
         if (self.cooldown_steps is not None
@@ -424,6 +486,9 @@ class Router:
             rep.state = "probation"
             rep.strikes = 0
             rep.probation_clean = 0
+            if self.tracer is not None:
+                self.tracer.event("breaker_probation", pid=FLEET_PID,
+                                  replica=rep.idx, step=self._step_no)
 
     # -- stepping ------------------------------------------------------------
     def step(self) -> bool:
@@ -474,6 +539,10 @@ class Router:
                     rep.probation_clean += 1
                     if rep.probation_clean >= self.probation_steps:
                         rep.state = "healthy"
+                        if self.tracer is not None:
+                            self.tracer.event(
+                                "breaker_promote", pid=FLEET_PID,
+                                replica=rep.idx, step=self._step_no)
         return self.has_work
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
@@ -497,10 +566,15 @@ class Router:
 
     # -- stats ---------------------------------------------------------------
     @staticmethod
-    def _raw_itls(eng: ServingEngine) -> List[float]:
-        ok = (r for r in eng._done.values() if r.state == "done")
-        live = (r for r in eng._slots if r is not None)
-        return [x for r in itertools.chain(ok, live) for x in r.itls]
+    def _itl_parts(eng: ServingEngine) -> List[tuple]:
+        """(samples, n_seen) parts for the bounded fleet ITL union:
+        each engine's finished-request reservoir plus its live slots'
+        exact samples (ISSUE 12 satellite — the raw flattened union
+        grew without limit on long runs; Reservoir.merge keeps the
+        combined sample proportional to each stream's true size)."""
+        live = [x for r in eng._slots if r is not None for x in r.itls]
+        return [(eng._itl_res.samples, eng._itl_res.n),
+                (live, len(live))]
 
     def _goodput_tokens(self, eng: ServingEngine) -> int:
         return sum(len(r.out_tokens) for r in eng._done.values()
@@ -519,7 +593,9 @@ class Router:
         ``replicas`` is each engine's own stats() plus its health
         record."""
         engines = [rep.engine for rep in self.replicas]
-        itls = [x for e in engines for x in self._raw_itls(e)]
+        itls = Reservoir.merge(
+            [p for e in engines for p in self._itl_parts(e)],
+            k=ServingEngine.ITL_RESERVOIR_K)
         hit = sum(e.dec.cache.prefix_hit_tokens for e in engines)
         query = sum(e.dec.cache.prefix_query_tokens for e in engines)
         migrated_done = 0
@@ -578,6 +654,12 @@ class Router:
             st["wedges"] = rep.wedges
             st["load"] = self._load(rep.engine)
             per.append(st)
+        if self.tracer is not None:
+            # the unified registry mirrors the fleet rollup under
+            # "fleet.*"; each engine's stats() call above published its
+            # own view under its per-replica namespace ("engine" for
+            # replica 0, "engine1"... beyond — no overwriting)
+            self.tracer.metrics.publish("fleet", fleet)
         return {"fleet": fleet, "replicas": per}
 
     def clear_finished(self):

@@ -184,6 +184,11 @@ class AdapterRegistry:
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+        # optional telemetry tracer (utils/telemetry.py; ISSUE 12):
+        # adapter refaults/evictions land as flight-recorder events.
+        # Attached by ServingEngine.set_telemetry; None = no-op.
+        self.tracer = None
+        self.trace_pid = 0
 
     # -- registration (host-only; no device state) --------------------------
     def register(self, adapter_id, weights: Dict[str, tuple],
@@ -420,6 +425,11 @@ class AdapterRegistry:
         self.misses += 1
         if was_evicted:
             self.evictions += 1
+        if self.tracer is not None:
+            self.tracer.event(
+                "adapter_refault", pid=self.trace_pid,
+                adapter=str(adapter_id), pages=lay.n_pages,
+                evicted=bool(was_evicted))
         self._was_resident.add(adapter_id)
 
     def release(self, adapter_id):

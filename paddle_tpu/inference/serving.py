@@ -137,6 +137,7 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..ops.paged_attention import KVCacheExhausted
+from ..utils.telemetry import Reservoir
 from .paged_decode import PagedLlamaDecoder
 from .spec_decode import SpecConfig
 
@@ -267,6 +268,22 @@ class Request:
     # evenly over the chunk's delivered tokens — see _collect_oldest)
     itls: List[float] = field(default_factory=list)
     t_last_emit: Optional[float] = None
+    # -- telemetry (ISSUE 12; all None/0 while tracing is off) ------------
+    # trace_id: the request's lifetime async-span id on the engine's
+    # Tracer — stable across preemption lives AND cross-replica
+    # migration (adopt_request continues it), so the whole lifecycle
+    # renders as ONE span in Perfetto
+    trace_id: Optional[int] = None
+    t_queued: float = 0.0         # current queued-life start
+    t_life: float = 0.0           # current life's slot-admission time
+    t_run: Optional[float] = None   # current life's running transition
+    t_wait: Optional[float] = None  # splice-wait start (deps unmet)
+    # trace_keep_open: the fleet Router sets this before its drain
+    # cancels a request it is about to MIGRATE — the local abort must
+    # not close the lifetime span (the adopted continuation on the new
+    # replica ends it), or the migrated request would render as two
+    # disjoint spans instead of one continuous one
+    trace_keep_open: bool = False
 
     @property
     def prefill_tokens(self) -> np.ndarray:
@@ -357,7 +374,7 @@ class ServingEngine:
                  tp_comm: Optional[str] = None,
                  devices: Optional[Sequence] = None,
                  spec_decode: Optional[SpecConfig] = None,
-                 lora=None):
+                 lora=None, tracer=None):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -818,6 +835,21 @@ class ServingEngine:
         # clear_finished — mask ids are only stable while their
         # requests are retained)
         self._allowed_memo: Dict[tuple, jax.Array] = {}
+        # -- telemetry (ISSUE 12) -------------------------------------------
+        # tracer=None (the default) is a bitwise no-op: every hook is
+        # behind an `if self.tracer is not None` guard, no PRNG key is
+        # drawn and no schedule array changes. set_telemetry also
+        # threads the tracer into the KV pool and the adapter registry
+        # so kv alloc/evict/splice/rollback and adapter refaults land
+        # in the same flight recorder; the fleet Router re-calls it
+        # with the replica index so every record carries its replica.
+        self.set_telemetry(tracer)
+        # bounded ITL aggregation (ISSUE 12 satellite): finished
+        # requests' per-token samples fold into a seeded reservoir at
+        # retire time, so stats() percentiles stay O(k) on unbounded
+        # runs (exact below capacity; sampling-tolerance above it).
+        # Live requests' samples are still read exactly from the slot.
+        self._itl_res = Reservoir(self.ITL_RESERVOIR_K)
         self.spec = spec_decode
         self._drafter = None
         if self.spec is not None:
@@ -1175,6 +1207,65 @@ class ServingEngine:
         self._key, k = jax.random.split(self._key)
         return k
 
+    # -- telemetry (ISSUE 12) ------------------------------------------------
+    # reservoir capacity for the finished-request ITL aggregation: big
+    # enough that every existing test/bench workload stays EXACT (they
+    # emit far fewer samples), small enough to bound unbounded runs
+    ITL_RESERVOIR_K = 4096
+
+    def set_telemetry(self, tracer, replica_id: int = 0):
+        """Attach (tracer) or detach (None) serving telemetry. The
+        tracer is shared down into the KV pool and the adapter registry
+        so cache and adapter events ride the same flight recorder;
+        ``replica_id`` becomes the pid every record of this engine
+        carries (the fleet Router sets it to the replica index)."""
+        self.tracer = tracer
+        self.replica_id = int(replica_id)
+        cache = self.dec.cache
+        cache.tracer = tracer
+        cache.trace_pid = self.replica_id
+        if self.lora is not None:
+            self.lora.tracer = tracer
+            self.lora.trace_pid = self.replica_id
+
+    def _trace_running(self, req: Request, now: float):
+        """Close the current life's prefill span at the prefilling →
+        running transition (call sites guard on self.tracer)."""
+        if req.trace_id is None:
+            return
+        t0 = req.t_life or req.t_admit or now
+        self.tracer.span(
+            "prefill", req.trace_id, t0, now, pid=self.replica_id,
+            epoch=req.epoch, n_cached=int(req.n_cached),
+            recompute=bool(req.resume))
+        req.t_run = now
+
+    def _trace_life_end(self, req: Request, reason: str, now: float):
+        """Close whatever phase span the current life was in — decode
+        for a running request, prefill (interrupted) for a prefilling
+        one, queued for one that never got a slot — and reset the
+        per-life markers (call sites guard on self.tracer)."""
+        if req.trace_id is None:
+            return
+        tr = self.tracer
+        if req.t_run is not None:
+            tr.span("decode", req.trace_id, req.t_run, now,
+                    pid=self.replica_id, epoch=req.epoch, reason=reason,
+                    tokens=len(req.out_tokens))
+        elif req.t_life:
+            tr.span("prefill", req.trace_id, req.t_life, now,
+                    pid=self.replica_id, epoch=req.epoch, reason=reason,
+                    interrupted=True)
+        elif req.t_queued:
+            tr.span("queued", req.trace_id, req.t_queued, now,
+                    pid=self.replica_id, reason=reason)
+        if req.t_wait is not None:
+            tr.span("splice_wait", req.trace_id, req.t_wait, now,
+                    pid=self.replica_id, reason=reason)
+        req.t_run = None
+        req.t_life = 0.0
+        req.t_wait = None
+
     # -- fault tolerance -----------------------------------------------------
     def _device_call(self, kind: str, fn, *args):
         """Every device dispatch/fetch routes through here: the chaos
@@ -1209,9 +1300,16 @@ class ServingEngine:
             except Exception as e:          # noqa: BLE001 — fault wall
                 if attempt >= self.max_dispatch_retries:
                     self.dispatch_exhaustions += 1
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            "dispatch_exhausted", pid=self.replica_id,
+                            kind=kind, error=type(e).__name__)
                     raise _DispatchFailed(kind, e) from e
                 attempt += 1
                 self.retries += 1
+                if self.tracer is not None:
+                    self.tracer.event("retry", pid=self.replica_id,
+                                      kind=kind, attempt=attempt)
                 if self.retry_backoff_s > 0:
                     time.sleep(self.retry_backoff_s
                                * (2 ** (attempt - 1)))
@@ -1304,6 +1402,11 @@ class ServingEngine:
         so recompute cost is near zero on hits. A PREFILLING victim
         restarts its prefill from scratch."""
         self.preemptions += 1
+        if self.tracer is not None and victim.trace_id is not None:
+            self.tracer.event(
+                "preempt", trace=victim.trace_id, pid=self.replica_id,
+                state=victim.state, tokens=len(victim.out_tokens),
+                priority=victim.sampling.priority)
         self._evict_to_queue(victim)
         self._requeue_front([victim])
 
@@ -1321,6 +1424,10 @@ class ServingEngine:
         while the request re-enters the queue would let the next
         _admit re-allocate its seq before the free lands and raise out
         of step(). The caller requeues."""
+        if self.tracer is not None and req.trace_id is not None:
+            now = time.perf_counter()
+            self._trace_life_end(req, "evict", now)
+            req.t_queued = now      # the requeued life's queued span
         req.epoch += 1
         si = req.slot
         if si is not None:
@@ -1480,6 +1587,12 @@ class ServingEngine:
         req.state = state
         req.error = msg
         req.t_done = time.perf_counter()
+        if self.tracer is not None and req.trace_id is not None:
+            self._trace_life_end(req, state, req.t_done)
+            if not req.trace_keep_open:
+                self.tracer.end_request(
+                    req.trace_id, state, replica=self.replica_id,
+                    error=msg)
         self._done[req.req_id] = req
 
     def debug_dump(self) -> str:
@@ -1565,6 +1678,9 @@ class ServingEngine:
         if self.max_queue_depth is not None and \
                 len(self._queue) >= self.max_queue_depth:
             self.shed_requests += 1
+            if self.tracer is not None:
+                self.tracer.event("shed", pid=self.replica_id,
+                                  reason="queue_depth")
             raise EngineOverloaded(
                 f"queue depth {len(self._queue)} at the "
                 f"max_queue_depth={self.max_queue_depth} cap")
@@ -1572,6 +1688,9 @@ class ServingEngine:
             est = self._estimate_completion_s(sp)
             if est is not None and est > sp.deadline_s:
                 self.shed_requests += 1
+                if self.tracer is not None:
+                    self.tracer.event("shed", pid=self.replica_id,
+                                      reason="deadline_estimate")
                 raise EngineOverloaded(
                     f"estimated completion {est:.3f}s exceeds the "
                     f"{sp.deadline_s:.3f}s deadline "
@@ -1579,12 +1698,19 @@ class ServingEngine:
         rid = next(self._ids)
         req = Request(rid, prompt, sp, t_submit=time.perf_counter())
         req.allowed_mask = allowed_mask
+        req.t_queued = req.t_submit
+        if self.tracer is not None:
+            req.trace_id = self.tracer.begin_request(
+                rid, tenant=sp.adapter_id, replica=self.replica_id,
+                prompt_len=int(prompt.size),
+                max_new_tokens=sp.max_new_tokens)
         self._queue.append(req)
         return rid
 
     def adopt_request(self, prompt, sampling: Optional[SamplingParams]
                       = None, out_tokens: Sequence[int] = (),
-                      t_submit: Optional[float] = None) -> int:
+                      t_submit: Optional[float] = None,
+                      trace_id: Optional[int] = None) -> int:
         """Admit a request that already ran (partially) on ANOTHER
         engine — the fleet Router's replica-failover migration path
         (inference/fleet.py). The generated history re-enters this
@@ -1600,7 +1726,11 @@ class ServingEngine:
         new engine. A history that already satisfies the stop condition
         (budget spent / trailing EOS) completes immediately; an engine
         without the chunk programs drops the history and re-runs from
-        the prompt (still greedy-identical, just more recompute)."""
+        the prompt (still greedy-identical, just more recompute).
+        ``trace_id`` continues an existing telemetry span (the Router
+        passes the migrating request's id, so the whole lifecycle stays
+        ONE continuous span across replicas; None opens a fresh one
+        when a tracer is attached)."""
         sp = sampling or SamplingParams()
         prompt, allowed_mask = self._validate_new_request(prompt, sp)
         rid = next(self._ids)
@@ -1608,6 +1738,16 @@ class ServingEngine:
                       t_submit=(time.perf_counter() if t_submit is None
                                 else float(t_submit)))
         req.allowed_mask = allowed_mask
+        req.t_queued = time.perf_counter()
+        if self.tracer is not None:
+            req.trace_id = (int(trace_id) if trace_id is not None
+                            else self.tracer.begin_request(
+                                rid, tenant=sp.adapter_id,
+                                replica=self.replica_id,
+                                prompt_len=int(prompt.size)))
+            self.tracer.event(
+                "adopt", trace=req.trace_id, pid=self.replica_id,
+                history=len(out_tokens), req_id=rid)
         toks = [int(t) for t in out_tokens]
         if toks and not self._can_recompute:
             # no no-sample chunk programs: the history cannot re-enter
@@ -1623,6 +1763,10 @@ class ServingEngine:
             req.out_tokens = toks[:sp.max_new_tokens]
             req.state = "done"
             req.t_done = time.perf_counter()
+            if self.tracer is not None:
+                self.tracer.end_request(
+                    req.trace_id, "done", replica=self.replica_id,
+                    tokens=len(req.out_tokens))
             self._done[rid] = req
             return rid
         req.resume = bool(toks)
@@ -1747,8 +1891,19 @@ class ServingEngine:
             req.n_cached = n_cached
             req.state = "prefilling"
             req.slot = si
+            now = time.perf_counter()
             if req.t_admit is None:
-                req.t_admit = time.perf_counter()
+                req.t_admit = now
+            if self.tracer is not None and req.trace_id is not None:
+                self.tracer.span(
+                    "queued", req.trace_id, req.t_queued or now, now,
+                    pid=self.replica_id, epoch=req.epoch,
+                    resume=bool(req.resume))
+                self.tracer.event(
+                    "admitted", trace=req.trace_id,
+                    pid=self.replica_id, slot=si,
+                    n_cached=int(n_cached), resume=bool(req.resume))
+                req.t_life = now
             if req.resume:
                 # tokens that must genuinely recompute (past the splice)
                 self.recompute_tokens += req.suffix_len
@@ -1765,7 +1920,19 @@ class ServingEngine:
         if req.deps:
             req.deps = [(w, need) for w, need in req.deps
                         if w.prefill_sent < need]
-        return not req.deps
+        if not req.deps:
+            if req.t_wait is not None:
+                # splice-wait over: the reader held its chunks back
+                # for this long waiting on the writer's dispatches
+                if self.tracer is not None and req.trace_id is not None:
+                    self.tracer.span(
+                        "splice_wait", req.trace_id, req.t_wait,
+                        time.perf_counter(), pid=self.replica_id)
+                req.t_wait = None
+            return True
+        if self.tracer is not None and req.t_wait is None:
+            req.t_wait = time.perf_counter()
+        return False
 
     def _clear_pending_writes(self, req: Request):
         for b in req.pending_blocks:
@@ -1918,6 +2085,11 @@ class ServingEngine:
                                     f"retries: {e}")
             return 0
         req.prefill_sent += take
+        if self.tracer is not None:
+            self.tracer.event(
+                "dispatch", trace=req.trace_id, pid=self.replica_id,
+                kind="prefill_mid", rows=1, tokens=int(take),
+                offset=int(off))
         self._inflight.append({"kind": "prefill", "toks": None,
                                "group": [], "free_after": []})
         if req.resume and req.prefill_sent >= req.suffix_len:
@@ -1931,6 +2103,8 @@ class ServingEngine:
         already-emitted out_tokens[-1], supplied from the host exactly
         like a fresh prefill's first token."""
         req.state = "running"
+        if self.tracer is not None:
+            self._trace_running(req, time.perf_counter())
         self._clear_pending_writes(req)
         si = req.slot
         self._last_tok[si] = req.out_tokens[-1]
@@ -2041,6 +2215,10 @@ class ServingEngine:
         for si, req, off in group:
             req.prefill_sent = req.suffix_len
             self._clear_pending_writes(req)
+        if self.tracer is not None:
+            self.tracer.event("dispatch", pid=self.replica_id,
+                              kind="prefill", rows=int(gp),
+                              bucket=int(bucket))
         self._inflight.append({"kind": "prefill", "toks": toks,
                                "group": [(si, req, req.epoch)
                                          for si, req, _ in group],
@@ -2059,6 +2237,8 @@ class ServingEngine:
                 continue
             tok = int(toks[row])
             req.state = "running"
+            if self.tracer is not None:
+                self._trace_running(req, now)
             req.t_first_token = now
             req.t_last_emit = now
             req.out_tokens.append(tok)
@@ -2079,6 +2259,21 @@ class ServingEngine:
         req = self._slots[si]
         req.state = "done"
         req.t_done = time.perf_counter()
+        # finished-request ITL samples fold into the bounded reservoir
+        # here (aborted/failed lifetimes never reach _retire, so the
+        # successful-traffic-only percentile contract is preserved)
+        self._itl_res.extend(req.itls)
+        if self.tracer is not None:
+            if req.trace_id is not None:
+                self._trace_life_end(req, "done", req.t_done)
+                self.tracer.end_request(
+                    req.trace_id, "done", replica=self.replica_id,
+                    tokens=len(req.out_tokens))
+            m = self.tracer.metrics
+            if req.latency_s is not None:
+                m.histogram("engine.latency_s").observe(req.latency_s)
+            if req.ttft_s is not None:
+                m.histogram("engine.ttft_s").observe(req.ttft_s)
         self._done[req.req_id] = req
         self._slots[si] = None
         self._lora_release(req)
@@ -2480,6 +2675,12 @@ class ServingEngine:
                              f"{e}")
             self.time_host_s += time.perf_counter() - t0
             return False
+        if self.tracer is not None:
+            self.tracer.event(
+                "dispatch", pid=self.replica_id, kind="decode",
+                T=int(T), width=self.max_b,
+                rows=sum(1 for s in steps_of.values() if s > 0),
+                tokens=int(sum(steps_of.values())))
         self._inflight.append({"kind": "decode", "toks": toks,
                                "steps": steps_of, "reqs": reqs_of,
                                "epochs": epochs_of,
@@ -2906,6 +3107,12 @@ class ServingEngine:
                         self._resume_complete(req)
                     else:
                         self._clear_pending_writes(req)
+        if self.tracer is not None:
+            self.tracer.event(
+                "dispatch", pid=self.replica_id, kind="spec",
+                W=int(W), drafts=int(total_drafts),
+                decode_cols=len(spec_of),
+                prefill_rows=int(sum(take_of.values())))
         self._inflight.append({
             "kind": "spec", "toks": toks, "acc": acc, "W": W,
             "spec": spec_of, "finals": list(finals),
@@ -3273,6 +3480,12 @@ class ServingEngine:
                         self._resume_complete(req)
                     else:
                         self._clear_pending_writes(req)
+        if self.tracer is not None:
+            self.tracer.event(
+                "dispatch", pid=self.replica_id, kind="ragged",
+                T=int(T), W=int(W), decode_cols=len(col_of),
+                prefill_rows=int(sum(take_of.values())),
+                finals=len(finals))
         self._inflight.append({
             "kind": "ragged", "toks": toks, "T": T, "W": W,
             "cols": dict(col_of), "steps": dict(steps_of),
@@ -3338,11 +3551,7 @@ class ServingEngine:
                 if self._is_finished(req):
                     break      # mid-chunk EOS: discard the tail
             self.decode_useful_tokens += delivered
-            if delivered:
-                if req.t_last_emit is not None:
-                    itl = (now - req.t_last_emit) / delivered
-                    req.itls.extend([itl] * delivered)
-                req.t_last_emit = now
+            self._note_itl(req, now, delivered)
             if self._is_finished(req) and self._slots[si] is req:
                 self._retire(si)
         for req, epoch, t, c in ch["finals"]:
@@ -3351,6 +3560,8 @@ class ServingEngine:
             si = req.slot
             tok = int(toks[t, c])
             req.state = "running"
+            if self.tracer is not None:
+                self._trace_running(req, now)
             req.t_first_token = now
             req.t_last_emit = now
             req.out_tokens.append(tok)
@@ -3418,6 +3629,11 @@ class ServingEngine:
             self.accepted_draft_tokens += m
             if m < k:
                 self.spec_rollbacks += 1
+            if self.tracer is not None and k:
+                self.tracer.event(
+                    "spec_window", trace=req.trace_id,
+                    pid=self.replica_id, drafted=int(k),
+                    accepted=int(m))
             delivered = 0
             for j in range(m + 1):
                 tok = int(toks[base + j])
@@ -3432,11 +3648,7 @@ class ServingEngine:
             # delivered is the planned invariant (the window's
             # rejected remainder was never "planned work")
             req.planned = len(req.out_tokens)
-            if delivered:
-                if req.t_last_emit is not None:
-                    itl = (now - req.t_last_emit) / delivered
-                    req.itls.extend([itl] * delivered)
-                req.t_last_emit = now
+            self._note_itl(req, now, delivered)
             if self._drafter is not None and k:
                 self._drafter.observe(
                     np.concatenate(
@@ -3460,6 +3672,8 @@ class ServingEngine:
             si = req.slot
             tok = int(toks[c])
             req.state = "running"
+            if self.tracer is not None:
+                self._trace_running(req, now)
             req.t_first_token = now
             req.t_last_emit = now
             req.out_tokens.append(tok)
@@ -3471,6 +3685,22 @@ class ServingEngine:
                 self._retire(si)
         for rid in ch["free_after"]:
             cache.free(rid)
+
+    def _note_itl(self, req: Request, now: float, delivered: int):
+        """Per-token ITL attribution at collection, shared by the
+        decode/ragged/spec collect paths: the chunk's wall interval
+        split evenly over the tokens it delivered to this request
+        (recorded on the request; mirrored into the engine.itl_s
+        fixed-bucket histogram when tracing is on)."""
+        if not delivered:
+            return
+        if req.t_last_emit is not None:
+            itl = (now - req.t_last_emit) / delivered
+            req.itls.extend([itl] * delivered)
+            if self.tracer is not None:
+                self.tracer.metrics.histogram(
+                    "engine.itl_s").observe(itl, n=delivered)
+        req.t_last_emit = now
 
     def _collect_oldest(self):
         """Fetch and process the oldest in-flight chunk — prefill or
@@ -3549,11 +3779,7 @@ class ServingEngine:
                 if self._is_finished(req):
                     break      # mid-chunk EOS: discard the tail
             self.decode_useful_tokens += delivered
-            if delivered:
-                if req.t_last_emit is not None:
-                    itl = (now - req.t_last_emit) / delivered
-                    req.itls.extend([itl] * delivered)
-                req.t_last_emit = now
+            self._note_itl(req, now, delivered)
             if self._is_finished(req) and self._slots[si] is req:
                 self._retire(si)
         for rid in ch["free_after"]:
@@ -3886,6 +4112,9 @@ class ServingEngine:
         # (and their masks) are dropped here, so the memo must go too
         # (a recycled id must never alias a dead request's operand)
         self._allowed_memo.clear()
+        # finished-request ITL reservoir resets with the requests it
+        # sampled (same seed: identical runs keep identical stats)
+        self._itl_res = Reservoir(self.ITL_RESERVOIR_K)
         if self.lora is not None:
             self.lora.reset_stats()
         self.dec.cache.reset_prefix_stats()
@@ -3928,17 +4157,21 @@ class ServingEngine:
                  if r.queue_wait_s is not None]
         # terminal side filtered to state=="done" like lats/ttfts/waits
         # above: an aborted/failed request's stall-inflated gaps must
-        # not bleed into the successful-traffic ITL percentiles
-        itls = [x for r in itertools.chain(
-            ok, (r for r in self._slots if r is not None))
-            for x in r.itls]
+        # not bleed into the successful-traffic ITL percentiles.
+        # Finished requests' samples come from the bounded reservoir
+        # (fed at _retire — done-state lifetimes only); live slotted
+        # requests' samples are read exactly. Exact below the reservoir
+        # capacity, sampling-tolerance beyond it (ISSUE 12 satellite:
+        # the raw union list grew without limit on long runs).
+        itls = list(self._itl_res) + [
+            x for r in self._slots if r is not None for x in r.itls]
 
         def pct(xs, p):
             # Interpolated (the truncating index form overstated
             # p50/p99 on small samples).
             return float(np.quantile(xs, p)) if xs else None
 
-        return {
+        out = {
             # finished = completed successfully; aborted/failed/shed
             # are accounted separately below (latency/TTFT percentiles
             # cover successful requests only — a deadline abort's
@@ -4027,3 +4260,18 @@ class ServingEngine:
             "free_blocks": cache.free_blocks,
             "cached_blocks": cache.cached_blocks,
         }
+        if self.tracer is not None:
+            # the unified metrics registry mirrors this dict (ints ->
+            # counters, floats -> gauges), so the stats() view and the
+            # registry agree bit-for-bit — the cross-subsystem rollup
+            # tests pin the parity. In a fleet the tracer is SHARED:
+            # each replica publishes under its own namespace ("engine"
+            # for replica 0 / a single engine, "engine1"... beyond),
+            # so one replica's counters never masquerade as another's;
+            # fleet-wide totals live under "fleet.*" and the shared
+            # engine.itl_s/ttft_s/latency_s histograms ACCUMULATE
+            # across replicas (a fleet-wide distribution by design).
+            prefix = ("engine" if self.replica_id == 0
+                      else f"engine{self.replica_id}")
+            self.tracer.metrics.publish(prefix, out)
+        return out

@@ -240,6 +240,12 @@ class PagedKVCache:
         # top of every _take_block, BEFORE any mutation, so an injected
         # KVCacheExhausted leaves the pool untouched
         self.fault_hook = None
+        # optional telemetry tracer (utils/telemetry.py; ISSUE 12):
+        # alloc/evict/splice/rollback land as flight-recorder events.
+        # Attached by ServingEngine.set_telemetry; trace_pid is the
+        # owning engine's replica id. None = zero-overhead no-op.
+        self.tracer = None
+        self.trace_pid = 0
         # optional LoRA adapter plane (ISSUE 10): a [num_blocks,
         # page_elems] f32 device array sharing THIS allocator's block
         # ids — a block either holds KV (rows of self.k/self.v) or an
@@ -262,6 +268,9 @@ class PagedKVCache:
             h = self._hash_of.pop(blk)
             self._block_of.pop(h, None)
             self.prefix_evictions += 1
+            if self.tracer is not None:
+                self.tracer.event("kv_evict", pid=self.trace_pid,
+                                  block=int(blk))
             return blk
         raise KVCacheExhausted("KV cache exhausted")
 
@@ -294,6 +303,9 @@ class PagedKVCache:
             self._ref[b] = 1
         self._tables[seq_id] = blocks
         self._lens[seq_id] = 0
+        if self.tracer is not None:
+            self.tracer.event("kv_alloc", pid=self.trace_pid,
+                              seq=int(seq_id), blocks=int(needed))
         return self._tables[seq_id]
 
     # -- prefix caching ------------------------------------------------------
@@ -403,6 +415,14 @@ class PagedKVCache:
         self._lens[seq_id] = n_cached
         self.prefix_query_tokens += len(tokens)
         self.prefix_hit_tokens += n_cached
+        if self.tracer is not None:
+            self.tracer.event("kv_alloc", pid=self.trace_pid,
+                              seq=int(seq_id), blocks=int(needed_new),
+                              spliced=len(reused))
+            if reused:
+                self.tracer.event(
+                    "kv_splice", pid=self.trace_pid, seq=int(seq_id),
+                    blocks=len(reused), tokens=int(n_cached))
         # register the suffix's full prompt blocks for future reuse
         for i in range(len(reused), len(hashes)):
             h, b = hashes[i], table[i]
@@ -571,6 +591,10 @@ class PagedKVCache:
                     self._block_of.pop(h, None)
                 returned.append(b)
         self._free.extend(returned)
+        if self.tracer is not None:
+            self.tracer.event(
+                "kv_rollback", pid=self.trace_pid, seq=int(seq_id),
+                new_len=new_len, dropped=len(dropped))
 
     def free(self, seq_id: int):
         """Release a sequence: ref-- on each of its blocks; blocks

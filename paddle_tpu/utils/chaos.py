@@ -92,12 +92,18 @@ class ChaosMonkey:
         # (call index, site) of every injection, for post-mortems
         self.log: List[Tuple[int, str]] = []
         self._calls = 0
+        # the attached engine (telemetry: injections are emitted into
+        # its flight recorder when a tracer is enabled, so every red
+        # gate run's export shows exactly which faults were injected
+        # when, next to the spans they hit)
+        self._engine = None
 
     # -- wiring -------------------------------------------------------------
     def attach(self, engine) -> "ChaosMonkey":
         """Hook this monkey into `engine` (and its KV pool)."""
         engine.chaos = self
         engine.dec.cache.fault_hook = self._alloc_hook
+        self._engine = engine
         return self
 
     def detach(self, engine):
@@ -105,6 +111,17 @@ class ChaosMonkey:
             engine.chaos = None
         if engine.dec.cache.fault_hook == self._alloc_hook:
             engine.dec.cache.fault_hook = None
+        if self._engine is engine:
+            self._engine = None
+
+    def _trace_event(self, site: str, **attrs):
+        eng = self._engine
+        tracer = getattr(eng, "tracer", None) if eng is not None \
+            else None
+        if tracer is not None:
+            tracer.event("injected_fault",
+                         pid=getattr(eng, "replica_id", 0),
+                         site=site, **attrs)
 
     def wedge(self):
         """Turn this monkey into a PERSISTENT replica wedge (ISSUE 11):
@@ -122,6 +139,7 @@ class ChaosMonkey:
         self.p_collect = 1.0
         self.counts["wedged"] += 1
         self.log.append((self._calls, "wedge"))
+        self._trace_event("wedge")
         return self
 
     # -- injection sites ----------------------------------------------------
@@ -132,6 +150,7 @@ class ChaosMonkey:
                 self.rng.random_sample() < self.p_alloc_oom:
             self.counts["alloc_oom"] += 1
             self.log.append((self._calls, "alloc_oom"))
+            self._trace_event("alloc_oom")
             raise KVCacheExhausted("chaos: injected allocator OOM")
 
     def before_call(self, engine, kind: str):
@@ -151,6 +170,7 @@ class ChaosMonkey:
                     self.rng.random_sample() < self.p_collect:
                 self.counts["collect_faults"] += 1
                 self.log.append((self._calls, kind))
+                self._trace_event("collect_fault", kind=kind)
                 raise InjectedCollectError(
                     f"chaos: injected collection fault at {kind}")
         else:
@@ -158,5 +178,6 @@ class ChaosMonkey:
                     self.rng.random_sample() < self.p_dispatch:
                 self.counts["dispatch_faults"] += 1
                 self.log.append((self._calls, kind))
+                self._trace_event("dispatch_fault", kind=kind)
                 raise InjectedDispatchError(
                     f"chaos: injected dispatch fault at {kind}")

@@ -1,0 +1,426 @@
+"""Serving telemetry: span tracing, a flight-recorder ring buffer with
+Perfetto export, and a unified metrics registry (ISSUE 12).
+
+The engine composes six subsystems inside ONE device program per step
+(PRs 5-11), so host-side visibility is the scarce resource: everything
+interesting happens between two dispatches. This module is the
+host-side answer — three small, allocation-light primitives every
+serving subsystem shares:
+
+- ``Tracer``: per-request SPANS (queued → admitted → prefill chunk i →
+  splice-wait → decode → preempt/recompute → migrate →
+  done/aborted/failed, each carrying req_id/tenant/replica attributes)
+  and per-step EVENTS (dispatch width bucket / rows / tokens, retry,
+  injected fault, breaker strike), held in a bounded FLIGHT-RECORDER
+  ring buffer (old records fall off; ``dropped`` counts them) with
+  Chrome-trace/Perfetto JSON export (``Tracer.export(path)``). A
+  request is ONE async span for its whole life — the trace id
+  propagates through preemption-recompute and cross-replica migration
+  (``ServingEngine.adopt_request(trace_id=...)``), so a migrated
+  request renders as a single continuous span crossing two replica
+  process tracks in Perfetto.
+- ``MetricsRegistry``: counters / gauges / fixed-bucket histograms.
+  The engine/fleet/cache/chaos ``stats()`` dicts publish into it under
+  namespaced keys ("engine.preemptions", "fleet.failovers", ...), so
+  the registry is the unified cross-subsystem view and the per-call
+  dicts are views over the same numbers (parity is pinned by
+  tests/test_telemetry.py); span durations and ITL/TTFT/latency
+  samples additionally feed fixed-bucket histograms live.
+- ``Reservoir``: seeded Algorithm-R uniform sampling — the bound on
+  the raw per-token ITL sample aggregation in ServingEngine.stats() /
+  Router.stats() (exact below capacity, p50/p99-within-tolerance
+  above it).
+
+Overhead contract: ``tracer=None`` (the default everywhere) is a
+BITWISE no-op — every hook is behind an ``if tracer is not None``
+guard, no PRNG key is drawn, no device call is made, no schedule array
+changes. Enabled, the hot path appends small dicts to a deque and
+never touches a traced array or forces a host sync (the tracer reads
+only host-side scheduler state — flightcheck's FC301 family stays at
+zero findings over this module and its call sites); the serving bench
+pins the enabled overhead < 5% tok/s on the ragged row
+(bench.py serving_trace).
+
+Export format: Chrome Trace Event JSON (the ``traceEvents`` array
+form), loadable by Perfetto (ui.perfetto.dev) and chrome://tracing.
+Request lifecycles are nestable async events (``ph: "b"/"e"``, matched
+on ``cat + id`` across process tracks); per-phase slices are complete
+events (``ph: "X"`` with ``ts``/``dur``); per-step events are instants
+(``ph: "i"``). Engine events land on ``pid = replica_id``; fleet-level
+records (routing, breaker, migration, the request async spans) land on
+the dedicated ``FLEET_PID`` track.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import threading
+import time
+from bisect import bisect_right
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["Tracer", "MetricsRegistry", "Reservoir", "FLEET_PID",
+           "DEFAULT_TIME_BUCKETS_S"]
+
+# the pid Chrome-trace track fleet-level records render on (routing,
+# breaker transitions, migration, request async spans); engine records
+# use pid = replica_id (0 for a single engine), so the two can never
+# collide for any plausible fleet size
+FLEET_PID = 1000
+
+# fixed histogram buckets for second-valued observations (ITL, TTFT,
+# latency, span durations): roughly log-spaced 0.5 ms .. 60 s
+DEFAULT_TIME_BUCKETS_S = (
+    0.0005, 0.001, 0.002, 0.005, 0.01, 0.02, 0.05, 0.1, 0.2, 0.5,
+    1.0, 2.0, 5.0, 10.0, 30.0, 60.0)
+
+
+class Reservoir:
+    """Seeded Algorithm-R reservoir: a bounded uniform sample of an
+    unbounded stream. Exact (every sample retained, in order) while the
+    stream is <= k items; beyond that each seen item has equal
+    probability k/n of being retained, so quantiles stay within
+    sampling tolerance while memory is O(k). Deterministic: the same
+    seed + the same stream reproduces the same sample (the RNG is
+    private — engine PRNG streams are untouched)."""
+
+    def __init__(self, k: int = 4096, seed: int = 0):
+        self.k = int(k)
+        self._rng = np.random.RandomState(seed)
+        self.samples: List[float] = []
+        self.n = 0                      # items seen (>= len(samples))
+
+    def append(self, x: float):
+        if self.n < self.k:
+            self.samples.append(float(x))
+        else:
+            j = int(self._rng.randint(0, self.n + 1))
+            if j < self.k:
+                self.samples[j] = float(x)
+        self.n += 1
+
+    def extend(self, xs: Sequence[float]):
+        for x in xs:
+            self.append(x)
+
+    def __len__(self) -> int:
+        return len(self.samples)
+
+    def __iter__(self):
+        return iter(self.samples)
+
+    @staticmethod
+    def merge(parts, k: int = 4096, seed: int = 0) -> List[float]:
+        """Combine several (samples, n_seen) parts — Reservoir objects
+        or (list, n) tuples — into ONE bounded sample whose composition
+        is proportional to each part's true stream size (concatenating
+        raw reservoirs would over-weight small streams). Exact
+        concatenation when everything fits in k."""
+        norm = []
+        for p in parts:
+            if isinstance(p, Reservoir):
+                norm.append((p.samples, p.n))
+            else:
+                s, n = p
+                norm.append((list(s), int(n)))
+        norm = [(s, n) for s, n in norm if s]
+        total = sum(n for _, n in norm)
+        if total <= k:
+            return [x for s, _ in norm for x in s]
+        rng = np.random.RandomState(seed)
+        out: List[float] = []
+        for s, n in norm:
+            want = max(1, int(round(k * n / total)))
+            if want >= len(s):
+                out.extend(s)
+            else:
+                idx = rng.choice(len(s), size=want, replace=False)
+                out.extend(s[i] for i in idx)
+        return out
+
+
+class _Histogram:
+    """Fixed-bucket histogram: counts[i] = observations <= buckets[i]
+    boundary (last slot is the overflow), plus n/sum for means."""
+
+    def __init__(self, buckets: Sequence[float]):
+        self.buckets = tuple(float(b) for b in buckets)
+        self.counts = [0] * (len(self.buckets) + 1)
+        self.n = 0
+        self.sum = 0.0
+
+    def observe(self, v: float, n: int = 1):
+        self.counts[bisect_right(self.buckets, float(v))] += int(n)
+        self.n += int(n)
+        self.sum += float(v) * int(n)
+
+    def snapshot(self) -> dict:
+        return {"buckets": list(self.buckets),
+                "counts": list(self.counts),
+                "n": self.n, "sum": self.sum,
+                "mean": (self.sum / self.n) if self.n else None}
+
+
+class MetricsRegistry:
+    """Unified counters/gauges/histograms across engine, fleet, cache
+    and chaos. Two feeding paths:
+
+    - live: ``inc(name)`` from the tracer's event/span hooks (event
+      counts, span-duration histograms) — cheap dict ops;
+    - published: ``publish(prefix, stats_dict)`` mirrors a subsystem's
+      ``stats()`` dict under namespaced keys (ints -> counters, floats
+      -> gauges; None/bool/nested values skipped), making the stats
+      dicts views over the registry — ``registry.value("engine.X") ==
+      engine.stats()["X"]`` for every numeric key (tested).
+
+    Thread-safety: all dict membership mutations and ``snapshot()``
+    take one lock, so a watchdog-thread export can never hit a
+    dictionary-changed-during-iteration crash while the engine thread
+    records a first-seen event/histogram name. Individual histogram
+    ``observe`` calls stay lockless (they mutate an existing object in
+    place); a concurrent snapshot may read a histogram mid-update,
+    which is tolerable for a post-mortem."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.histograms: Dict[str, _Histogram] = {}
+
+    def inc(self, name: str, n: float = 1):
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + n
+
+    def set_gauge(self, name: str, v: float):
+        with self._lock:
+            self.gauges[name] = float(v)
+
+    def histogram(self, name: str,
+                  buckets: Sequence[float] = DEFAULT_TIME_BUCKETS_S
+                  ) -> _Histogram:
+        h = self.histograms.get(name)
+        if h is None:
+            with self._lock:
+                h = self.histograms.get(name)
+                if h is None:
+                    h = self.histograms[name] = _Histogram(buckets)
+        return h
+
+    def publish(self, prefix: str, stats: dict):
+        with self._lock:
+            for key, v in stats.items():
+                name = f"{prefix}.{key}"
+                if v is None:
+                    # a stat that went back to None (e.g. percentiles
+                    # after clear_finished) must not leave its stale
+                    # pre-reset value in the registry/export
+                    self.counters.pop(name, None)
+                    self.gauges.pop(name, None)
+                    continue
+                if isinstance(v, bool):
+                    continue
+                if isinstance(v, (int, np.integer)):
+                    self.counters[name] = int(v)
+                elif isinstance(v, (float, np.floating)):
+                    self.gauges[name] = float(v)
+
+    def value(self, name: str):
+        with self._lock:
+            if name in self.counters:
+                return self.counters[name]
+            return self.gauges.get(name)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"counters": dict(self.counters),
+                    "gauges": dict(self.gauges),
+                    "histograms": {k: h.snapshot()
+                                   for k, h in self.histograms.items()}}
+
+
+class Tracer:
+    """Flight recorder + span tracer. See the module docstring for the
+    taxonomy; the record stream is a bounded deque of small dicts:
+
+    - ``{"kind": "begin"/"end", "name": "request", "trace": id, ...}``
+      — request lifecycle (async span endpoints);
+    - ``{"kind": "span", "name": phase, "trace": id, "ts": t0,
+      "dur": seconds, ...}`` — one completed per-life phase;
+    - ``{"kind": "event", "name": ..., ...}`` — per-step instants.
+
+    Timestamps are ``time.perf_counter()`` values (the engine's own
+    clock); export rebases them to microseconds from the tracer's
+    construction. Thread-safe (the watchdog thread reads ``summary()``
+    while the engine appends)."""
+
+    DEFAULT_CAPACITY = 1 << 16
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY,
+                 metrics: Optional[MetricsRegistry] = None):
+        self.capacity = int(capacity)
+        self._ring: deque = deque(maxlen=self.capacity)
+        self.appended = 0
+        self.metrics = metrics or MetricsRegistry()
+        self._ids = itertools.count(1)
+        self._t0 = time.perf_counter()
+        self._lock = threading.Lock()
+
+    # -- recording -----------------------------------------------------------
+    def _record(self, rec: dict):
+        with self._lock:
+            self._ring.append(rec)
+            self.appended += 1
+
+    @property
+    def dropped(self) -> int:
+        """Records that fell off the ring (flight-recorder semantics:
+        the newest ``capacity`` records always survive)."""
+        return self.appended - len(self._ring)
+
+    def begin_request(self, req_id: int, tenant=None, replica: int = 0,
+                      **attrs) -> int:
+        """Open one request-lifetime async span; returns its trace id
+        (propagate it through adopt_request so a migrated request stays
+        ONE span)."""
+        tid = next(self._ids)
+        args = {"req_id": int(req_id), "replica": int(replica)}
+        if tenant is not None:
+            args["tenant"] = str(tenant)
+        args.update(attrs)
+        self._record({"kind": "begin", "name": "request", "trace": tid,
+                      "pid": FLEET_PID, "ts": time.perf_counter(),
+                      "args": args})
+        self.metrics.inc("trace.requests")
+        return tid
+
+    def end_request(self, trace_id: Optional[int], state: str,
+                    replica: int = 0, **attrs):
+        if trace_id is None:
+            return
+        args = {"state": state, "replica": int(replica)}
+        args.update(attrs)
+        self._record({"kind": "end", "name": "request",
+                      "trace": int(trace_id), "pid": FLEET_PID,
+                      "ts": time.perf_counter(), "args": args})
+        self.metrics.inc(f"trace.requests_{state}")
+
+    def reopen_request(self, trace_id: Optional[int]) -> bool:
+        """Rescind the most recent end record of ``trace_id`` — the
+        fleet Router calls this when it migrates a request whose
+        fault-burst FAILURE already closed the span (the engine failed
+        it before the breaker tripped): the migration supersedes the
+        terminal state, so the span must stay open until the adopted
+        continuation ends it (one continuous span across replicas).
+        Returns False when no end record is in the ring (it either
+        never existed or already fell off)."""
+        if trace_id is None:
+            return False
+        with self._lock:
+            for r in reversed(self._ring):
+                if r["kind"] == "end" and r["trace"] == trace_id:
+                    self._ring.remove(r)
+                    self.appended -= 1
+                    state = r["args"].get("state")
+                    if state:
+                        self.metrics.inc(f"trace.requests_{state}", -1)
+                    return True
+        return False
+
+    def span(self, name: str, trace_id: Optional[int], t0: float,
+             t1: float, pid: int = 0, **attrs):
+        """One completed per-life phase slice [t0, t1] (perf_counter
+        seconds) on the replica track ``pid``."""
+        self._record({"kind": "span", "name": name,
+                      "trace": (int(trace_id) if trace_id is not None
+                                else None),
+                      "pid": int(pid), "ts": float(t0),
+                      "dur": max(0.0, float(t1) - float(t0)),
+                      "args": attrs})
+        self.metrics.inc(f"spans.{name}")
+        self.metrics.histogram(f"span.{name}_s").observe(
+            max(0.0, float(t1) - float(t0)))
+
+    def event(self, name: str, trace: Optional[int] = None,
+              pid: int = 0, **attrs):
+        """One per-step instant (dispatch, retry, injected fault,
+        breaker strike, kv alloc/evict/splice/rollback, ...)."""
+        self._record({"kind": "event", "name": name,
+                      "trace": (int(trace) if trace is not None
+                                else None),
+                      "pid": int(pid), "ts": time.perf_counter(),
+                      "args": attrs})
+        self.metrics.inc(f"events.{name}")
+
+    # -- reading -------------------------------------------------------------
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def summary(self, last: int = 25) -> str:
+        """Human-readable tail of the flight recorder (the watchdog
+        appends this to its hang report)."""
+        recs = self.records()
+        lines = [f"flight recorder: {self.appended} records "
+                 f"({self.dropped} dropped, capacity {self.capacity}); "
+                 f"last {min(last, len(recs))}:"]
+        for r in recs[-last:]:
+            t = r["ts"] - self._t0
+            extra = f" dur={r['dur'] * 1e3:.2f}ms" if "dur" in r else ""
+            tidp = f" trace={r['trace']}" if r.get("trace") else ""
+            lines.append(f"  +{t:9.3f}s [{r['kind']}] {r['name']}"
+                         f"{tidp} pid={r['pid']}{extra} {r['args']}")
+        return "\n".join(lines) + "\n"
+
+    # -- export --------------------------------------------------------------
+    def _us(self, t: float) -> float:
+        return max(0.0, (t - self._t0) * 1e6)
+
+    def export(self, path: str) -> str:
+        """Write the flight recorder as Chrome-trace / Perfetto JSON
+        (plus the metrics-registry snapshot under ``"metrics"``).
+        Returns ``path``."""
+        recs = self.records()
+        evts: List[dict] = []
+        pids = sorted({r["pid"] for r in recs})
+        for pid in pids:
+            name = ("fleet" if pid == FLEET_PID
+                    else f"replica{pid}")
+            evts.append({"ph": "M", "name": "process_name", "pid": pid,
+                         "tid": 0, "ts": 0,
+                         "args": {"name": name}})
+        for r in recs:
+            tid = r["trace"] if r.get("trace") is not None else 0
+            if r["kind"] == "begin":
+                evts.append({"ph": "b", "cat": "request",
+                             "id": str(r["trace"]),
+                             "name": f"req{r['args'].get('req_id', '')}",
+                             "pid": r["pid"], "tid": tid,
+                             "ts": self._us(r["ts"]),
+                             "args": r["args"]})
+            elif r["kind"] == "end":
+                evts.append({"ph": "e", "cat": "request",
+                             "id": str(r["trace"]), "name": "request",
+                             "pid": r["pid"], "tid": tid,
+                             "ts": self._us(r["ts"]),
+                             "args": r["args"]})
+            elif r["kind"] == "span":
+                evts.append({"ph": "X", "cat": "phase",
+                             "name": r["name"], "pid": r["pid"],
+                             "tid": tid, "ts": self._us(r["ts"]),
+                             "dur": r["dur"] * 1e6,
+                             "args": r["args"]})
+            else:
+                evts.append({"ph": "i", "cat": "step",
+                             "name": r["name"], "pid": r["pid"],
+                             "tid": tid, "ts": self._us(r["ts"]),
+                             "s": "t", "args": r["args"]})
+        doc = {"traceEvents": evts, "displayTimeUnit": "ms",
+               "otherData": {"dropped_records": self.dropped,
+                             "appended_records": self.appended},
+               "metrics": self.metrics.snapshot()}
+        with open(path, "w") as f:
+            json.dump(doc, f)
+        return path

@@ -1,0 +1,473 @@
+"""Serving telemetry (ISSUE 12): span lifecycle for every terminal
+state, flight-recorder ring wraparound, Perfetto export schema,
+migration span continuity across replicas, stats()-vs-registry parity,
+the tracer-off bitwise no-op, and the bounded (reservoir) ITL
+aggregation regression. Runs in the invariant gate
+(check_serving_invariants.py) with PADDLE_TPU_POOL_DEBUG=1."""
+import json
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference import Router, SamplingParams, ServingEngine
+from paddle_tpu.utils.chaos import ChaosMonkey
+from paddle_tpu.utils.telemetry import (FLEET_PID, MetricsRegistry,
+                                        Reservoir, Tracer)
+
+CFG = llama_tiny(hidden_size=64, num_attention_heads=4,
+                 num_key_value_heads=2, intermediate_size=96,
+                 num_hidden_layers=2, vocab_size=256,
+                 max_position_embeddings=256)
+
+KW = dict(max_batch_size=3, num_blocks=24, block_size=8,
+          prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompt(n=12, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, CFG.vocab_size, n).astype(np.int32)
+
+
+def _names(tracer, kind=None, trace=None):
+    out = []
+    for r in tracer.records():
+        if kind is not None and r["kind"] != kind:
+            continue
+        if trace is not None and r.get("trace") != trace:
+            continue
+        out.append(r["name"])
+    return out
+
+
+# -- ring buffer -------------------------------------------------------------
+
+class TestFlightRecorderRing:
+    def test_wraparound_keeps_newest(self):
+        tr = Tracer(capacity=8)
+        for i in range(20):
+            tr.event("tick", i=i)
+        recs = tr.records()
+        assert len(recs) == 8
+        assert tr.appended == 20
+        assert tr.dropped == 12
+        # flight-recorder semantics: the NEWEST capacity records live
+        assert [r["args"]["i"] for r in recs] == list(range(12, 20))
+        # the live event counter keeps counting past the ring
+        assert tr.metrics.value("events.tick") == 20
+
+    def test_summary_mentions_drops(self):
+        tr = Tracer(capacity=4)
+        for i in range(6):
+            tr.event("tick", i=i)
+        s = tr.summary()
+        assert "2 dropped" in s and "tick" in s
+
+
+# -- span lifecycle ----------------------------------------------------------
+
+class TestSpanLifecycle:
+    def test_done_lifecycle(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        rid = eng.add_request(_prompt(),
+                              SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        tid = eng.request(rid).trace_id
+        assert tid is not None
+        names = _names(tr, trace=tid)
+        # one begin, phases in order, one end
+        assert names[0] == "request" and names[-1] == "request"
+        spans = _names(tr, kind="span", trace=tid)
+        assert spans == ["queued", "prefill", "decode"]
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == tid]
+        assert len(ends) == 1 and ends[0]["args"]["state"] == "done"
+
+    def test_aborted_lifecycle(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        rid = eng.add_request(_prompt(),
+                              SamplingParams(max_new_tokens=64))
+        for _ in range(4):
+            eng.step()
+        assert eng.cancel(rid)
+        eng.run_to_completion()
+        tid = eng.request(rid).trace_id
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == tid]
+        assert len(ends) == 1 and ends[0]["args"]["state"] == "aborted"
+        # the life the cancel interrupted still closed its phase span
+        assert _names(tr, kind="span", trace=tid)
+
+    def test_failed_lifecycle(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, max_dispatch_retries=0,
+                            retry_backoff_s=0.0, tracer=tr, **KW)
+        monkey = ChaosMonkey(seed=0, p_dispatch=1.0).attach(eng)
+        rid = eng.add_request(_prompt(),
+                              SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        monkey.detach(eng)
+        assert eng.request(rid).state == "failed"
+        tid = eng.request(rid).trace_id
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == tid]
+        assert len(ends) == 1 and ends[0]["args"]["state"] == "failed"
+        evts = _names(tr, kind="event")
+        assert "injected_fault" in evts
+        assert "dispatch_exhausted" in evts
+
+    def test_preempt_event_and_per_life_spans(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, admission="optimistic",
+                            num_blocks=12, tracer=tr,
+                            **{k: v for k, v in KW.items()
+                               if k != "num_blocks"})
+        rids = [eng.add_request(_prompt(seed=s),
+                                SamplingParams(max_new_tokens=24))
+                for s in range(3)]
+        eng.run_to_completion()
+        assert eng.preemptions > 0
+        pre = [r for r in tr.records()
+               if r["kind"] == "event" and r["name"] == "preempt"]
+        assert pre
+        victim = pre[0]["trace"]
+        # the preempted request has > 1 queued span (one per life) and
+        # still exactly one terminal end
+        queued = [n for n in _names(tr, kind="span", trace=victim)
+                  if n == "queued"]
+        assert len(queued) > 1
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == victim]
+        assert len(ends) == 1
+        assert all(eng.request(r).state == "done" for r in rids)
+
+
+# -- Perfetto export schema --------------------------------------------------
+
+class TestPerfettoExport:
+    def test_schema_fields(self, model, tmp_path):
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        path = tr.export(str(tmp_path / "t.json"))
+        doc = json.load(open(path))
+        evts = doc["traceEvents"]
+        assert evts
+        for e in evts:
+            for field in ("ph", "ts", "pid", "tid"):
+                assert field in e, e
+            if e["ph"] == "X":
+                assert "dur" in e and e["dur"] >= 0
+            if e["ph"] in ("b", "e"):
+                assert e["cat"] == "request" and isinstance(e["id"],
+                                                            str)
+        # process-name metadata for every pid in the trace
+        meta_pids = {e["pid"] for e in evts if e["ph"] == "M"}
+        assert {e["pid"] for e in evts} <= meta_pids
+        assert {e["name"] for e in evts if e["ph"] == "X"} >= \
+            {"queued", "prefill", "decode"}
+        # the metrics snapshot rides the export
+        assert doc["metrics"]["counters"]["trace.requests"] == 1
+
+    def test_trace_report_summarizes(self, model, tmp_path):
+        from tools.trace_report import analyze
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        for s in range(2):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        path = tr.export(str(tmp_path / "t.json"))
+        rep = analyze(json.load(open(path)))
+        assert rep["requests"]["begun"] == 2
+        assert rep["requests"]["states"] == {"done": 2}
+        assert set(rep["phases"]) >= {"queued", "prefill", "decode"}
+        assert "replica0" in rep["replicas"]
+        assert rep["replicas"]["replica0"]["dispatches"]
+
+
+# -- migration continuity ----------------------------------------------------
+
+class TestMigrationContinuity:
+    def test_single_continuous_span_across_replicas(self, model):
+        tr = Tracer()
+        router = Router(model, dp=2, breaker_threshold=1, tracer=tr,
+                        **KW)
+        fid = router.add_request(_prompt(),
+                                 SamplingParams(max_new_tokens=24))
+        for _ in range(4):
+            router.step()
+        rec = router._requests[fid]
+        src = rec.replica
+        router._wedge(router.replicas[src])
+        router.run_to_completion()
+        rec = router._requests[fid]
+        assert rec.migrations == 1 and rec.replica != src
+        tid = rec.trace_id
+        # exactly one begin/end pair — ONE continuous async span
+        begins = [r for r in tr.records()
+                  if r["kind"] == "begin" and r["trace"] == tid]
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == tid]
+        assert len(begins) == 1 and len(ends) == 1
+        assert ends[0]["args"]["state"] == "done"
+        # phase slices on BOTH replica tracks
+        pids = {r["pid"] for r in tr.records()
+                if r["kind"] == "span" and r["trace"] == tid}
+        assert {src, rec.replica} <= pids
+        # fleet-track events narrate the failover
+        evts = _names(tr, kind="event")
+        for name in ("route", "breaker_wedge", "failover", "migrate",
+                     "adopt"):
+            assert name in evts, name
+
+    def test_continuity_when_burst_failure_precedes_drain(self, model):
+        """The harder continuity case: the replica's fault burst FAILS
+        the request (its span end fires) before the breaker trips —
+        the drain's migration must rescind that end so the trace still
+        shows exactly one continuous span."""
+        tr = Tracer()
+        router = Router(model, dp=2, breaker_threshold=1,
+                        max_dispatch_retries=0, retry_backoff_s=0.0,
+                        tracer=tr, **KW)
+        fid = router.add_request(_prompt(),
+                                 SamplingParams(max_new_tokens=24))
+        for _ in range(4):
+            router.step()
+        rec = router._requests[fid]
+        src = rec.replica
+        monkey = ChaosMonkey(seed=0).attach(
+            router.replicas[src].engine)
+        monkey.wedge()
+        router.run_to_completion()
+        rec = router._requests[fid]
+        assert rec.migrations == 1 and rec.replica != src
+        begins = [r for r in tr.records()
+                  if r["kind"] == "begin" and r["trace"] == rec.trace_id]
+        ends = [r for r in tr.records()
+                if r["kind"] == "end" and r["trace"] == rec.trace_id]
+        assert len(begins) == 1 and len(ends) == 1
+        assert ends[0]["args"]["state"] == "done"
+        # the rescinded failure also reverses its registry tally
+        assert (tr.metrics.value("trace.requests_failed") or 0) == 0
+
+    def test_fleet_events_carry_fleet_pid(self, model):
+        tr = Tracer()
+        router = Router(model, dp=2, tracer=tr, **KW)
+        router.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        route = [r for r in tr.records() if r["name"] == "route"]
+        assert route and all(r["pid"] == FLEET_PID for r in route)
+
+
+# -- watchdog hang report carries the flight recorder ------------------------
+
+class TestWatchdogFlightRecorder:
+    def test_hang_report_dumps_recorder_and_exports(self, model,
+                                                    tmp_path):
+        import time
+        from paddle_tpu.distributed.watchdog import watch_engine
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        dump = str(tmp_path / "hang.txt")
+        reports = []
+        wd = watch_engine(eng, timeout=0.25, poll_interval=0.05,
+                          on_hang=reports.append, dump_path=dump)
+        try:
+            deadline = time.monotonic() + 4.0
+            while not reports and time.monotonic() < deadline:
+                time.sleep(0.05)
+        finally:
+            wd.stop()
+        assert reports, "watchdog never reported the stall"
+        text = reports[0]
+        assert "flight recorder:" in text
+        assert "request" in text        # the begin record in the tail
+        # the full Perfetto export landed next to the dump file
+        doc = json.load(open(dump + ".trace.json"))
+        assert doc["traceEvents"]
+
+
+# -- stats() vs registry parity ----------------------------------------------
+
+class TestRegistryParity:
+    def test_engine_stats_mirrored(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        st = eng.stats()
+        reg = tr.metrics
+        checked = 0
+        for k, v in st.items():
+            if v is None or isinstance(v, bool) \
+                    or not isinstance(v, (int, float, np.integer,
+                                          np.floating)):
+                continue
+            assert reg.value(f"engine.{k}") == pytest.approx(v), k
+            checked += 1
+        assert checked > 10
+        # live histograms carry real observations
+        assert reg.histograms["engine.itl_s"].n > 0
+        assert reg.histograms["engine.latency_s"].n == 3
+
+    def test_fleet_stats_mirrored(self, model):
+        tr = Tracer()
+        router = Router(model, dp=2, tracer=tr, **KW)
+        for s in range(3):
+            router.add_request(_prompt(seed=s),
+                               SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        fleet = router.stats()["fleet"]
+        for k in ("routed_requests", "failovers", "migrated_requests",
+                  "finished", "generated_tokens"):
+            assert tr.metrics.value(f"fleet.{k}") == fleet[k], k
+        # per-replica namespaces: replica 1's engine counters must not
+        # overwrite replica 0's in the shared registry
+        eng0 = tr.metrics.value("engine.finished")
+        eng1 = tr.metrics.value("engine1.finished")
+        assert eng0 is not None and eng1 is not None
+        assert eng0 + eng1 == fleet["finished"]
+
+    def test_publish_type_mapping(self):
+        reg = MetricsRegistry()
+        reg.publish("x", {"c": 3, "g": 0.5, "skip_b": True,
+                          "skip_n": None, "skip_s": "str"})
+        assert reg.counters["x.c"] == 3
+        assert reg.gauges["x.g"] == 0.5
+        assert "x.skip_b" not in reg.counters
+        assert "x.skip_n" not in reg.gauges
+        assert "x.skip_s" not in reg.gauges
+        # a value that resets to None clears its stale published entry
+        reg.publish("x", {"g": None})
+        assert reg.value("x.g") is None
+        h = reg.histogram("h", buckets=(1.0, 2.0))
+        h.observe(0.5)
+        h.observe(1.5)
+        h.observe(9.0, n=2)
+        snap = h.snapshot()
+        assert snap["counts"] == [1, 1, 2] and snap["n"] == 4
+
+
+# -- tracer-off bitwise no-op ------------------------------------------------
+
+class TestTracerNoOp:
+    def test_outputs_identical_on_off(self, model):
+        outs = {}
+        for tag in ("off", "on"):
+            tr = Tracer() if tag == "on" else None
+            eng = ServingEngine(model, seed=7, tracer=tr, **KW)
+            rids = []
+            for s in range(3):
+                # stochastic sampling too: a tracer that touched the
+                # key stream would shift these, not just greedy
+                rids.append(eng.add_request(
+                    _prompt(seed=s),
+                    SamplingParams(max_new_tokens=8,
+                                   temperature=1.0 if s == 1 else 0.0,
+                                   top_k=5 if s == 1 else None)))
+            eng.run_to_completion()
+            outs[tag] = [eng.result(r).tolist() for r in rids]
+        assert outs["on"] == outs["off"]
+
+    def test_off_leaves_no_trace_state(self, model):
+        eng = ServingEngine(model, **KW)
+        rid = eng.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        req = eng.request(rid)
+        assert eng.tracer is None and req.trace_id is None
+        assert eng.dec.cache.tracer is None
+
+
+# -- bounded ITL aggregation (reservoir satellite) ---------------------------
+
+class TestReservoir:
+    def test_exact_below_capacity(self):
+        r = Reservoir(k=100)
+        xs = list(np.random.RandomState(0).rand(50))
+        r.extend(xs)
+        assert list(r) == [float(x) for x in xs] and r.n == 50
+
+    def test_bounded_and_tolerant_above_capacity(self):
+        rng = np.random.RandomState(1)
+        xs = rng.lognormal(mean=-3.0, sigma=0.7, size=50_000)
+        r = Reservoir(k=2048)
+        r.extend(xs)
+        assert len(r) == 2048 and r.n == 50_000
+        for q in (0.50, 0.99):
+            exact = float(np.quantile(xs, q))
+            approx = float(np.quantile(r.samples, q))
+            assert abs(approx - exact) / exact < 0.10, (q, exact,
+                                                        approx)
+
+    def test_merge_proportional(self):
+        # stream A: 10k small values; stream B: 100 large ones — the
+        # merged sample must not over-weight B's tiny reservoir
+        a = Reservoir(k=256)
+        a.extend([0.001] * 10_000)
+        b = Reservoir(k=256)
+        b.extend([1.0] * 100)
+        merged = Reservoir.merge([a, b], k=256)
+        assert len(merged) <= 256 + 1
+        frac_large = sum(1 for x in merged if x == 1.0) / len(merged)
+        assert frac_large < 0.05     # true fraction is ~1%
+
+    def test_engine_stats_exact_below_capacity(self, model):
+        """Regression (ISSUE 12 satellite): the reservoir-backed
+        stats() ITL percentiles equal the old exact flattened-union
+        values while under capacity — including with a mix of retained
+        finished requests and live slots."""
+        eng = ServingEngine(model, **KW)
+        for s in range(4):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        st = eng.stats()
+        exact = [x for r in eng._done.values() if r.state == "done"
+                 for x in r.itls]
+        assert st["itl_p50_s"] == pytest.approx(
+            float(np.quantile(exact, 0.50)))
+        assert st["itl_p99_s"] == pytest.approx(
+            float(np.quantile(exact, 0.99)))
+        # the aggregation is bounded by construction
+        assert len(eng._itl_res) <= eng.ITL_RESERVOIR_K
+
+    def test_engine_aggregation_bounded(self, model, monkeypatch):
+        monkeypatch.setattr(ServingEngine, "ITL_RESERVOIR_K", 8)
+        eng = ServingEngine(model, **KW)
+        for s in range(4):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=10))
+        eng.run_to_completion()
+        # far more samples were emitted than the cap retains
+        assert eng._itl_res.n > 8
+        assert len(eng._itl_res) == 8
+        assert eng.stats()["itl_p50_s"] is not None
+        eng.clear_finished()
+        assert eng._itl_res.n == 0
+
+    def test_fleet_itl_merged_and_bounded(self, model, monkeypatch):
+        monkeypatch.setattr(ServingEngine, "ITL_RESERVOIR_K", 8)
+        router = Router(model, dp=2, **KW)
+        for s in range(4):
+            router.add_request(_prompt(seed=s),
+                               SamplingParams(max_new_tokens=10))
+        router.run_to_completion()
+        fleet = router.stats()["fleet"]
+        assert fleet["itl_p50_s"] is not None
+        assert fleet["itl_p99_s"] >= fleet["itl_p50_s"]

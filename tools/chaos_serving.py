@@ -34,6 +34,15 @@ redistribute its queue as prompt+generated-history recomputes;
 migrated-request COMPLETION on top of the dispatch-fault/cancellation
 events, and token identity covers surviving and migrated requests
 alike vs a fault-free fleet replay.
+
+--trace-out PATH (ISSUE 12) runs the CHAOS leg with serving telemetry
+on (one shared Tracer across the engine/fleet — per-request spans,
+per-step dispatch events, injected faults) and writes the
+flight-recorder Perfetto export to PATH whether the run passes or
+crashes, so every red gate run ships its own post-mortem timeline
+(tools/trace_report.py summarizes it). The fault-free replay stays
+untraced — its token identity against the traced chaos run doubles as
+proof that tracing never changes scheduling or sampling.
 """
 from __future__ import annotations
 
@@ -50,7 +59,7 @@ sys.path.insert(0, REPO)
 import numpy as np  # noqa: E402
 
 
-def build_engine(model, args):
+def build_engine(model, args, tracer=None):
     from paddle_tpu.inference import ServingEngine, SpecConfig
     # getattr defaults: programmatic callers (the slow fault-tolerance
     # test builds a bare Namespace) predate the
@@ -84,10 +93,10 @@ def build_engine(model, args):
         tp=getattr(args, "tp", 1),
         spec_decode=SpecConfig(draft_len=4)
         if getattr(args, "spec", False) else None,
-        lora=lora)
+        lora=lora, tracer=tracer)
 
 
-def build_fleet(model, args):
+def build_fleet(model, args, tracer=None):
     """The --dp leg's fleet (ISSUE 11): R single-chip replicas behind
     the prefix-affinity Router, each with the same tight-geometry
     engine the single-engine legs use. Both the chaos run and the
@@ -101,7 +110,8 @@ def build_fleet(model, args):
         max_batch_size=3, num_blocks=args.num_blocks, block_size=8,
         prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8,
         admission="optimistic", max_dispatch_retries=args.retries,
-        retry_backoff_s=0.0, ragged=getattr(args, "ragged", False))
+        retry_backoff_s=0.0, ragged=getattr(args, "ragged", False),
+        tracer=tracer)
 
 
 def gen_workload(args):
@@ -162,7 +172,7 @@ def gen_workload(args):
     return arrivals, cancels
 
 
-def run_schedule(model, args, chaotic: bool):
+def run_schedule(model, args, chaotic: bool, tracer=None):
     """One full run; returns (results-by-ordinal, engine-or-router,
     monkey-or-monkeys, steps_run). With --dp R > 1 the engine is a
     fleet Router: every replica gets its own seeded background monkey,
@@ -176,7 +186,7 @@ def run_schedule(model, args, chaotic: bool):
 
     dp = getattr(args, "dp", 1)
     if dp > 1:
-        eng = build_fleet(model, args)
+        eng = build_fleet(model, args, tracer=tracer)
         monkey = [ChaosMonkey(
             seed=args.seed + 1 + r, p_alloc_oom=args.p_oom,
             p_dispatch=args.p_dispatch, p_collect=args.p_collect,
@@ -184,7 +194,7 @@ def run_schedule(model, args, chaotic: bool):
             for r, rep in enumerate(eng.replicas)] if chaotic else None
         wedge_step = args.steps // 3
     else:
-        eng = build_engine(model, args)
+        eng = build_engine(model, args, tracer=tracer)
         monkey = None
         if chaotic:
             monkey = ChaosMonkey(
@@ -317,6 +327,13 @@ def main() -> int:
                          "every surviving AND migrated request must "
                          "stay token-identical vs the fault-free "
                          "fleet replay")
+    ap.add_argument("--trace-out", default=None,
+                    help="run the chaos leg with serving telemetry ON "
+                         "(ISSUE 12) and write the flight-recorder "
+                         "Perfetto export here — on success, mismatch "
+                         "OR crash (the replay stays untraced, so "
+                         "token identity also proves tracing is "
+                         "schedule-neutral)")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
@@ -346,8 +363,18 @@ def main() -> int:
 
     base_results, base_eng, _, _, _ = run_schedule(model, args,
                                                    chaotic=False)
-    chaos_results, eng, monkey, steps_run, user_cancels = \
-        run_schedule(model, args, chaotic=True)
+    tracer = None
+    if args.trace_out:
+        from paddle_tpu.utils.telemetry import Tracer
+        tracer = Tracer()
+    try:
+        chaos_results, eng, monkey, steps_run, user_cancels = \
+            run_schedule(model, args, chaotic=True, tracer=tracer)
+    finally:
+        # the flight recorder is the post-mortem: it must land next to
+        # the log even (especially) when the chaos run crashed
+        if tracer is not None:
+            tracer.export(args.trace_out)
 
     mismatches = []
     done = faulted = 0
@@ -406,6 +433,8 @@ def main() -> int:
                 summary["missing_events"] = missing
                 ok = False
         summary["ok"] = ok
+        if args.trace_out:
+            summary["trace"] = args.trace_out
         print(json.dumps(summary))
         for m in mismatches[:4]:
             print(f"MISMATCH ordinal {m['ordinal']}: "
@@ -466,6 +495,8 @@ def main() -> int:
             summary["missing_events"] = missing
             ok = False
     summary["ok"] = ok
+    if args.trace_out:
+        summary["trace"] = args.trace_out
     print(json.dumps(summary))
     if mismatches:
         for m in mismatches[:4]:

@@ -51,6 +51,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_spec_decode.py"),
     os.path.join(REPO, "tests", "test_lora_serving.py"),
     os.path.join(REPO, "tests", "test_fleet_serving.py"),
+    os.path.join(REPO, "tests", "test_telemetry.py"),
 ]
 
 
@@ -120,28 +121,106 @@ def run_chaos() -> int:
     surviving AND migrated requests vs a fault-free fleet replay
     (the router drains the wedged replica and redistributes its
     queue as no-sample prompt+history recomputes)."""
+    import shutil
     import subprocess
+    import tempfile
     rc_all = 0
+    trace_dir = tempfile.mkdtemp(prefix="paddle_tpu_chaos_trace_")
+    print(f"CHAOS flight-recorder exports: {trace_dir}/chaos_<leg>"
+          f".trace.json (kept on failure, removed on a green run)")
     # the lora leg (ISSUE 10) runs more requests on a 20-block pool:
     # the two knobs that make a previously-resident adapter actually
     # get EVICTED and refaulted mid-schedule (--require-events demands
     # it) without tipping the oldest-runner preemption cycle into the
-    # no-progress regime a 14-block pool + 9 adapter pages produces
+    # no-progress regime a 14-block pool + 9 adapter pages produces.
+    # ISSUE 12: every leg runs with serving telemetry ON and writes
+    # its flight-recorder export next to the log; the dp2 leg's trace
+    # is then VALIDATED (parses, carries >= 1 span per lifecycle
+    # phase, and shows a migrated request as ONE continuous span
+    # crossing two replica tracks).
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
                      ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
                      ("lora", ("--lora", "--num-blocks", "20",
                                "--requests", "12")),
                      ("dp2", ("--dp", "2"))):
+        trace_path = os.path.join(trace_dir, f"chaos_{tag}.trace.json")
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",
-               *leg]
+               "--trace-out", trace_path, *leg]
         rc = subprocess.call(cmd)
         print(f"CHAOS GATE ({tag}) OK — fault schedule survived, "
               "outputs identical" if rc == 0
-              else f"CHAOS GATE ({tag}) FAILED (exit {rc})")
+              else f"CHAOS GATE ({tag}) FAILED (exit {rc}; "
+                   f"flight recorder: {trace_path})")
         rc_all = rc_all or rc
+    trc = validate_trace(os.path.join(trace_dir, "chaos_dp2.trace.json"))
+    rc_all = rc_all or trc
+    if rc_all == 0:
+        # a fully green run needs no post-mortems — don't let repeated
+        # gate runs accumulate orphaned trace directories in /tmp
+        shutil.rmtree(trace_dir, ignore_errors=True)
     return rc_all
+
+
+def validate_trace(path: str) -> int:
+    """Telemetry gate (ISSUE 12): the dp2 chaos leg's exported trace
+    must parse as Chrome-trace JSON, carry at least one span for every
+    lifecycle phase the leg exercises (queued / prefill / decode), a
+    migrate event, and at least one trace id whose phase slices land on
+    TWO OR MORE replica pids with exactly one begin/end pair — the
+    migrated request rendering as a single continuous span crossing
+    replicas in Perfetto."""
+    import json
+    from collections import defaultdict
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"TRACE GATE FAILED — cannot parse {path}: {e}")
+        return 1
+    evts = doc.get("traceEvents", [])
+    problems = []
+    for e in evts:
+        for field in ("ph", "ts", "pid", "tid"):
+            if field not in e:
+                problems.append(f"event missing {field}: {e}")
+                break
+        if e.get("ph") == "X" and "dur" not in e:
+            problems.append(f"X event missing dur: {e}")
+    span_names = {e["name"] for e in evts if e.get("ph") == "X"}
+    for phase in ("queued", "prefill", "decode"):
+        if phase not in span_names:
+            problems.append(f"no '{phase}' span in the trace")
+    if not any(e.get("ph") == "i" and e["name"] == "migrate"
+               for e in evts):
+        problems.append("no migrate event in the dp2 trace")
+    span_pids = defaultdict(set)
+    for e in evts:
+        if e.get("ph") == "X" and e.get("tid"):
+            span_pids[e["tid"]].add(e["pid"])
+    crossing = [t for t, pids in span_pids.items() if len(pids) >= 2]
+    if not crossing:
+        problems.append("no request span crosses two replica pids")
+    for t in crossing:
+        b = sum(1 for e in evts if e.get("ph") == "b"
+                and e.get("id") == str(t))
+        en = sum(1 for e in evts if e.get("ph") == "e"
+                 and e.get("id") == str(t))
+        if (b, en) != (1, 1):
+            problems.append(
+                f"migrated trace {t} has {b} begin / {en} end events "
+                f"(must be exactly one pair — one continuous span)")
+    if problems:
+        for p in problems[:8]:
+            print(f"  trace problem: {p}")
+        print(f"TRACE GATE FAILED — {len(problems)} problem(s) in "
+              f"{path}")
+        return 1
+    print(f"TRACE GATE OK — dp2 flight recorder valid "
+          f"({len(evts)} events, {len(crossing)} migrated span(s) "
+          f"crossing replicas): {path}")
+    return 0
 
 
 def main() -> int:

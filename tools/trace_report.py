@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Summarize a serving telemetry trace (ISSUE 12).
+
+Reads the Chrome-trace/Perfetto JSON written by
+``paddle_tpu.utils.telemetry.Tracer.export`` and prints the post-mortem
+a red gate run (or a bench artifact) needs without opening the UI:
+
+- per-phase latency breakdown: count / total / mean / p50 / p99 of
+  every span name (queued, prefill, splice_wait, decode, ...);
+- per-replica occupancy: span-busy seconds per replica track over the
+  trace wall clock (an approximation — overlapping spans of different
+  requests double-count busy time, so >100% means real concurrency);
+- dispatch mix per replica (ragged/decode/prefill/spec counts);
+- top preempted / migrated requests, with req ids and tenant
+  attributes off the request-begin records;
+- terminal-state counts and the event tally (retries, injected
+  faults, breaker strikes, kv churn).
+
+Pure host tool: no jax, no paddle_tpu import — runs anywhere the JSON
+does.
+
+    python tools/trace_report.py trace.json
+    python tools/trace_report.py trace.json --json   # machine-readable
+    python tools/trace_report.py trace.json --top 10
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from collections import Counter, defaultdict
+
+
+def _pct(xs, p):
+    if not xs:
+        return None
+    xs = sorted(xs)
+    i = (len(xs) - 1) * p
+    lo, hi = int(i), min(int(i) + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (i - lo)
+
+
+def _pid_name(pid):
+    # keep in sync with telemetry.FLEET_PID (no import: pure host tool)
+    return "fleet" if pid == 1000 else f"replica{pid}"
+
+
+def analyze(doc: dict, top: int = 5) -> dict:
+    evts = doc.get("traceEvents", [])
+    spans = [e for e in evts if e.get("ph") == "X"]
+    insts = [e for e in evts if e.get("ph") == "i"]
+    begins = {e.get("id"): e for e in evts if e.get("ph") == "b"}
+    ends = {e.get("id"): e for e in evts if e.get("ph") == "e"}
+
+    # -- per-phase latency breakdown ------------------------------------
+    by_phase: dict = defaultdict(list)
+    for s in spans:
+        by_phase[s["name"]].append(s.get("dur", 0.0) / 1e6)
+    phases = {}
+    for name, durs in sorted(by_phase.items()):
+        phases[name] = {
+            "count": len(durs),
+            "total_s": round(sum(durs), 4),
+            "mean_s": round(sum(durs) / len(durs), 5),
+            "p50_s": round(_pct(durs, 0.50), 5),
+            "p99_s": round(_pct(durs, 0.99), 5),
+        }
+
+    # -- per-replica occupancy + dispatch mix ---------------------------
+    ts_all = [e["ts"] for e in evts if e.get("ph") in ("X", "i", "b", "e")]
+    wall_s = ((max(ts_all) - min(ts_all)) / 1e6) if ts_all else 0.0
+    busy: Counter = Counter()
+    for s in spans:
+        # waiting phases are not device work: a queue-backed-up idle
+        # replica must not read as saturated
+        if s["name"] in ("queued", "splice_wait"):
+            continue
+        busy[s["pid"]] += s.get("dur", 0.0) / 1e6
+    dispatch_mix: dict = defaultdict(Counter)
+    for e in insts:
+        if e["name"] == "dispatch":
+            dispatch_mix[e["pid"]][e.get("args", {}).get("kind", "?")] \
+                += 1
+    replicas = {}
+    for pid in sorted(set(busy) | set(dispatch_mix)):
+        replicas[_pid_name(pid)] = {
+            "busy_s": round(busy.get(pid, 0.0), 4),
+            "occupancy": (round(busy.get(pid, 0.0) / wall_s, 4)
+                          if wall_s else None),
+            "dispatches": dict(dispatch_mix.get(pid, {})),
+        }
+
+    # -- per-request robustness: preempt / migrate counts ---------------
+    preempts: Counter = Counter()
+    migrations: Counter = Counter()
+    for e in insts:
+        tid = e.get("tid")
+        if e["name"] == "preempt" and tid:
+            preempts[tid] += 1
+        elif e["name"] == "migrate" and tid:
+            migrations[tid] += 1
+
+    def _req_label(tid):
+        b = begins.get(str(tid)) or begins.get(tid)
+        if b is None:
+            return {"trace": tid}
+        a = b.get("args", {})
+        out = {"trace": tid, "req_id": a.get("req_id")}
+        if "tenant" in a:
+            out["tenant"] = a["tenant"]
+        return out
+
+    top_preempted = [dict(_req_label(t), preemptions=n)
+                     for t, n in preempts.most_common(top)]
+    top_migrated = [dict(_req_label(t), migrations=n)
+                    for t, n in migrations.most_common(top)]
+
+    # -- terminal states + event tally ----------------------------------
+    states: Counter = Counter()
+    for e in ends.values():
+        states[e.get("args", {}).get("state", "?")] += 1
+    events: Counter = Counter(e["name"] for e in insts)
+
+    return {
+        "wall_s": round(wall_s, 4),
+        "records": len(evts),
+        "dropped_records": doc.get("otherData", {}).get(
+            "dropped_records", 0),
+        "requests": {"begun": len(begins), "ended": len(ends),
+                     "states": dict(states)},
+        "phases": phases,
+        "replicas": replicas,
+        "top_preempted": top_preempted,
+        "top_migrated": top_migrated,
+        "events": dict(events),
+    }
+
+
+def format_report(rep: dict) -> str:
+    lines = [f"trace: {rep['records']} records over {rep['wall_s']}s "
+             f"wall ({rep['dropped_records']} dropped from the ring)"]
+    rq = rep["requests"]
+    lines.append(f"requests: {rq['begun']} begun, {rq['ended']} ended "
+                 f"{rq['states']}")
+    lines.append("per-phase latency:")
+    for name, p in rep["phases"].items():
+        lines.append(
+            f"  {name:12s} n={p['count']:<5d} total={p['total_s']:<9g} "
+            f"mean={p['mean_s']:<9g} p50={p['p50_s']:<9g} "
+            f"p99={p['p99_s']:g}")
+    lines.append("per-replica occupancy:")
+    for name, r in rep["replicas"].items():
+        occ = (f"{r['occupancy'] * 100:.1f}%"
+               if r["occupancy"] is not None else "n/a")
+        lines.append(f"  {name:10s} busy={r['busy_s']}s ({occ}) "
+                     f"dispatches={r['dispatches']}")
+    if rep["top_preempted"]:
+        lines.append(f"top preempted: {rep['top_preempted']}")
+    if rep["top_migrated"]:
+        lines.append(f"top migrated: {rep['top_migrated']}")
+    lines.append(f"events: {rep['events']}")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("trace", help="path to a Tracer.export JSON file")
+    ap.add_argument("--json", action="store_true",
+                    help="print the machine-readable summary dict")
+    ap.add_argument("--top", type=int, default=5,
+                    help="top-N preempted/migrated requests to list")
+    args = ap.parse_args()
+    with open(args.trace) as f:
+        doc = json.load(f)
+    rep = analyze(doc, top=args.top)
+    try:
+        print(json.dumps(rep) if args.json else format_report(rep))
+    except BrokenPipeError:      # head/less closed the pipe — fine
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
