@@ -106,6 +106,14 @@ CANONICAL_SPECS: Dict[str, P] = {
     # (kv-head dim sharded — each shard appends the heads it computed)
     "cache_k": P(None, "tp", None, None),
     "cache_v": P(None, "tp", None, None),
+    # quantized-pool sidecar scales (ISSUE 13): [num_blocks, kv_heads,
+    # block_size] — the kv-head dim shards EXACTLY like the values'
+    # (dim-aligned with their heads), so each tp shard quantizes and
+    # dequantizes its own head slice with its own scales and the int8
+    # pool adds ZERO collectives (pinned by comm-audit entry
+    # serving.ragged_kv8_tp2 == serving.ragged_tp2_fp32)
+    "cache_k_scale": P(None, "tp", None),
+    "cache_v_scale": P(None, "tp", None),
     # LoRA adapter-page plane: [num_blocks, page_elems] REPLICATED —
     # each shard slices its own A-rows/B-columns from the full
     # factors in-program, which is what keeps the lora deltas at

@@ -118,9 +118,17 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
                  max_pages_per_seq: Optional[int] = None,
                  weight_dtype: Optional[str] = None, mesh=None,
                  mp_axis: str = "tp", tp_shard_map: bool = False,
-                 tp_comm: str = "fp32"):
+                 tp_comm: str = "fp32",
+                 kv_quant: Optional[str] = None):
         cfg = model.cfg
         self.cfg = cfg
+        # quantized KV pool (ISSUE 13) — same contract as the Llama
+        # twin: (int8, scales) planes, quantize at append, dequant at
+        # every gather; None keeps the dense pool bitwise unchanged
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', got "
+                             f"{kv_quant!r}")
+        self.kv_quant = kv_quant
         self.block_size = block_size
         self.head_dim = cfg.hidden_size // cfg.num_attention_heads
         self.max_pages = max_pages_per_seq or \
@@ -163,7 +171,8 @@ class PagedGPTDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
             block_size=block_size, kv_heads=cfg.num_attention_heads,
             head_dim=self.head_dim,
             dtype=self.weights["embed"].dtype,
-            kv_sharding=self._kv_sharding())
+            kv_sharding=self._kv_sharding(), kv_quant=kv_quant,
+            kv_scale_sharding=self._kv_scale_sharding())
         if self._tp_manual:
             self._prefill = jax.jit(self.tp_wrap(
                 lambda w, k, v, ids, slots:

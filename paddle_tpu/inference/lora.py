@@ -249,8 +249,11 @@ class AdapterRegistry:
         page_elems = self._page_elems_arg
         if page_elems is None:
             # KV-block-equivalent page: one adapter page displaces
-            # roughly one KV block of bytes (k + v, all layers)
-            nb, kvh, bs, hd = cache.k[0].shape
+            # roughly one KV block of bytes (k + v, all layers) —
+            # sized off the pool's GEOMETRY (quantized (int8, scales)
+            # planes have the same dims as dense ones)
+            from ..ops.paged_attention import _plane_values
+            nb, kvh, bs, hd = _plane_values(cache.k[0]).shape
             page_elems = 2 * len(cache.k) * kvh * bs * hd
         self.layout = LoRALayout(dec.lora_target_modules(),
                                  dec.cfg.num_hidden_layers, self.rank,

@@ -191,8 +191,11 @@ def _prefix_suffix_attention(q, k_suf, v_suf, k_pre, v_pre, n_cached,
 
 def _gather_prefix_pages(pool, prefix_tables):
     """[num_blocks, kvh, bs, d] pool + [b, P] page ids →
-    [b, kvh, P*bs, d] per-row contiguous prefix K/V."""
-    g = jnp.take(pool, prefix_tables, axis=0)   # [b, P, kvh, bs, d]
+    [b, kvh, P*bs, d] per-row contiguous prefix K/V. Quantized pools
+    ((int8, scales) tuples — ISSUE 13) dequantize at the gather, the
+    same fused read every other pool consumer uses."""
+    from ..ops.paged_attention import _dequantize_gather
+    g = _dequantize_gather(pool, prefix_tables)  # [b, P, kvh, bs, d]
     b, p, kvh, bs, d = g.shape
     return g.transpose(0, 2, 1, 3, 4).reshape(b, kvh, p * bs, d)
 
@@ -274,6 +277,27 @@ class _TPDecoderMixin:
         # shard the kv-head dim (the canonical cache_k/cache_v spec)
         return self._layout().sharding(self.mesh, "cache_k")
 
+    def _kv_scale_sharding(self):
+        """Placement for the int8 pool's sidecar scales (ISSUE 13):
+        [num_blocks, kv_heads, block_size] sharded over the kv-head
+        dim — dim-aligned with the values' heads, so a tp shard owns
+        its own scales end to end (zero collectives)."""
+        if self.mesh is None:
+            return None
+        return self._layout().sharding(self.mesh, "cache_k_scale")
+
+    def _kv_spec(self):
+        """The shard_map spec tree for ONE pool operand: a bare
+        kv-head-sharded P for dense planes, or (for kv_quant="int8")
+        a per-layer list of (values spec, scales spec) tuples matching
+        the (int8, scales) plane pytree leaf-for-leaf."""
+        lay = self._layout()
+        kv = lay.spec("cache_k")
+        if getattr(self, "kv_quant", None) == "int8":
+            return [(kv, lay.spec("cache_k_scale"))] \
+                * self.cfg.num_hidden_layers
+        return kv
+
     def _layout(self):
         from ..distributed.spec_layout import SpecLayout
         return SpecLayout(tp_axis=self.mp_axis)
@@ -333,7 +357,7 @@ class _TPDecoderMixin:
         wraps the decoder's own."""
         from jax.sharding import PartitionSpec as P
         lay = self._layout()
-        kv = lay.spec("cache_k")
+        kv = self._kv_spec()
         pre = (P(None, None), P(self.mp_axis)) if lora_pool else ()
         in_specs = (lay.spec_tree(self.weights), kv, kv) + pre \
             + (P(),) * n_extra
@@ -418,7 +442,8 @@ class _SpecDecodeMixin:
         collectives under tp: toks are post-gather (replicated), the
         compare/cumsum is replicated, and each shard zero-scatters
         only its own kv-head slice."""
-        from ..ops.paged_attention import reshape_and_cache
+        from ..ops.paged_attention import (_plane_values,
+                                           reshape_and_cache)
         ok = jnp.where(is_draft, jnp.roll(toks, 1) == draft_ids, False)
         bad = (is_draft & ~ok).astype(jnp.int32)
         cb = jnp.cumsum(bad)
@@ -426,8 +451,14 @@ class _SpecDecodeMixin:
         tgt = jnp.where(is_draft & ~accepted, slots,
                         jnp.int32(scratch_slot))
         w = toks.shape[0]
-        kvh, hd = k_pool[0].shape[1], k_pool[0].shape[3]
-        zeros = jnp.zeros((w, kvh, hd), k_pool[0].dtype)
+        # tuple-aware (quantized pools): the zero-scatter goes through
+        # reshape_and_cache, which quantizes zeros to exact int8 zeros
+        # with unit scales — the neutralization stays bit-exact
+        kp0 = _plane_values(k_pool[0])
+        kvh, hd = kp0.shape[1], kp0.shape[3]
+        zeros = jnp.zeros(
+            (w, kvh, hd),
+            jnp.float32 if isinstance(k_pool[0], tuple) else kp0.dtype)
         k_pool = list(k_pool)
         v_pool = list(v_pool)
         for li in range(len(k_pool)):
@@ -500,7 +531,8 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
                  max_pages_per_seq: Optional[int] = None,
                  weight_dtype: Optional[str] = None, mesh=None,
                  mp_axis: str = "mp", tp_shard_map: bool = False,
-                 tp_comm: str = "fp32", _cfg=None, _weights=None):
+                 tp_comm: str = "fp32", kv_quant: Optional[str] = None,
+                 _cfg=None, _weights=None):
         cfg = model.cfg if model is not None else _cfg
         self.cfg = cfg
         self.block_size = block_size
@@ -508,6 +540,16 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
         self.max_pages = max_pages_per_seq or \
             -(-cfg.max_position_embeddings // block_size)
         self.weight_dtype = weight_dtype
+        # quantized KV pool (ISSUE 13): kv_quant="int8" stores the
+        # k/v planes as (int8, per-slot-per-kv-head absmax scale)
+        # tuples — quantize fused into every reshape_and_cache append,
+        # dequant into every pool read (attention gathers + the Pallas
+        # ragged kernel's page DMA). None (the default) keeps the
+        # dense planes bitwise unchanged.
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', got "
+                             f"{kv_quant!r}")
+        self.kv_quant = kv_quant
         self.weights = (_extract_weights(model, weight_dtype,
                                          int4_halves=mesh is None)
                         if model is not None else _weights)
@@ -568,7 +610,8 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
             block_size=block_size, kv_heads=cfg.num_key_value_heads,
             head_dim=self.head_dim,
             dtype=self.weights["embed"].dtype,
-            kv_sharding=self._kv_sharding())
+            kv_sharding=self._kv_sharding(), kv_quant=kv_quant,
+            kv_scale_sharding=self._kv_scale_sharding())
         cos, sin = build_rope_cache(cfg.max_position_embeddings,
                                     self.head_dim, cfg.rope_theta,
                                     jnp.float32)
@@ -599,7 +642,8 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
                            weight_dtype: Optional[str] = None,
                            mesh=None, mp_axis: str = "mp",
                            tp_shard_map: bool = False,
-                           tp_comm: str = "fp32"):
+                           tp_comm: str = "fp32",
+                           kv_quant: Optional[str] = None):
         """Build a decoder WITHOUT materializing the full-precision
         model: llama_3_8b bf16 is ~16 GB — the whole of a v5e's HBM —
         but its int4 weights are ~4 GB. `load(name, shape)` returns the
@@ -644,7 +688,8 @@ class PagedLlamaDecoder(_TPDecoderMixin, _SpecDecodeMixin, _LoRAMixin):
                    max_pages_per_seq=max_pages_per_seq,
                    weight_dtype=weight_dtype, mesh=mesh,
                    mp_axis=mp_axis, tp_shard_map=tp_shard_map,
-                   tp_comm=tp_comm, _cfg=cfg, _weights=weights)
+                   tp_comm=tp_comm, kv_quant=kv_quant, _cfg=cfg,
+                   _weights=weights)
 
     @classmethod
     def from_config(cls, cfg, seed: int = 0, init_scale: float = 0.02,

@@ -374,7 +374,8 @@ class ServingEngine:
                  tp_comm: Optional[str] = None,
                  devices: Optional[Sequence] = None,
                  spec_decode: Optional[SpecConfig] = None,
-                 lora=None, tracer=None):
+                 lora=None, tracer=None,
+                 kv_quant: Optional[str] = None):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -403,6 +404,27 @@ class ServingEngine:
         if tp_comm not in (None, "fp32", "int8"):
             raise ValueError(f"tp_comm must be 'fp32' or 'int8', got "
                              f"{tp_comm!r}")
+        # -- quantized KV cache (ISSUE 13) ----------------------------------
+        # kv_quant="int8" stores the paged pool's k/v planes as int8
+        # with per-slot-per-kv-head absmax scales in a sidecar plane:
+        # quantize is fused into every append (reshape_and_cache),
+        # dequant into every pool read (the ragged Pallas kernel's
+        # per-page DMA and the jnp oracle's page walk alike). Roughly
+        # halves KV bytes per token (bf16 pools; ~3.6x on f32), so the
+        # same HBM holds ~2x the concurrent sequences / resident
+        # adapters. None (the default) is the dense pool, bitwise
+        # unchanged. ACCURACY CONTRACT: greedy outputs match the fp32
+        # pool on the pinned workloads (quantization noise is well
+        # below typical logit gaps; a sub-quantization-step near-tie
+        # may legitimately flip — that is the flag's contract, same as
+        # tp_comm="int8"); note the dense and ragged SCHEDULERS are
+        # each deterministic under kv_quant but not bit-identical to
+        # each other (dense prefill attends the chunk's fresh
+        # full-precision K/V, the ragged path reads its own rows back
+        # quantized).
+        if kv_quant not in (None, "int8"):
+            raise ValueError(f"kv_quant must be None or 'int8', got "
+                             f"{kv_quant!r}")
         if tp > 1 and mesh is not None:
             raise ValueError("pass either tp=N (manual shard_map "
                              "serving) or mesh= (GSPMD decoder "
@@ -435,6 +457,17 @@ class ServingEngine:
                     f"prebuilt decoder built with tp_comm="
                     f"{dec_comm!r}; pass the desired tp_comm to the "
                     f"decoder constructor instead")
+            dec_kvq = getattr(model.cache, "kv_quant", None)
+            if kv_quant is not None and dec_kvq != kv_quant:
+                # same contract as tp_comm: the pool layout is baked
+                # into the decoder's cache and compiled programs — a
+                # silently-substituted mode would run the wrong leg of
+                # the fp32-vs-int8 capacity/accuracy A/B
+                raise ValueError(
+                    f"ServingEngine(kv_quant={kv_quant!r}) got a "
+                    f"prebuilt decoder whose pool was built with "
+                    f"kv_quant={dec_kvq!r}; pass the desired kv_quant "
+                    f"to the decoder constructor instead")
             self.tp = dec_tp
         else:
             if devices is not None and tp == 1:
@@ -466,9 +499,13 @@ class ServingEngine:
                                          mesh=mesh, mp_axis="tp"
                                          if tp > 1 else "mp",
                                          tp_shard_map=tp > 1,
-                                         tp_comm=tp_comm or "fp32")
+                                         tp_comm=tp_comm or "fp32",
+                                         kv_quant=kv_quant)
             self.tp = tp
         self.tp_comm = getattr(self.dec, "tp_comm", tp_comm or "fp32")
+        # the pool's actual quantization mode (prebuilt decoders carry
+        # their own; None = dense fp planes) — surfaced by stats()
+        self.kv_quant = getattr(self.dec.cache, "kv_quant", None)
         self.max_b = int(max_batch_size)
         self.buckets = tuple(sorted(prompt_buckets))
         self.top_k = int(top_k)
@@ -4259,6 +4296,17 @@ class ServingEngine:
             "prefix_cache_evictions": cache.prefix_evictions,
             "free_blocks": cache.free_blocks,
             "cached_blocks": cache.cached_blocks,
+            # -- quantized KV cache (ISSUE 13) ------------------------
+            # kv_quant: the pool's storage mode ("fp32"-family dtype
+            # name or "int8"); kv_pool_bytes / kv_bytes_per_token: the
+            # pool's logical device footprint (sidecar scales
+            # included) — the capacity headline the int8 pool roughly
+            # halves. Pool-geometry gauges: clear_finished leaves them
+            # at the same recomputed values (pinned by the reset test)
+            # while every counter around them drops to zero.
+            "kv_quant": self.kv_quant or cache.pool_dtype,
+            "kv_pool_bytes": cache.pool_bytes(),
+            "kv_bytes_per_token": cache.bytes_per_token(),
         }
         if self.tracer is not None:
             # the unified metrics registry mirrors this dict (ints ->

@@ -26,8 +26,54 @@ import jax.numpy as jnp
 import numpy as np
 
 __all__ = ["KVCacheExhausted", "PagedKVCache", "paged_attention_decode",
-           "paged_attention_decode_reference", "ragged_paged_attention",
-           "ragged_paged_attention_reference", "reshape_and_cache"]
+           "paged_attention_decode_reference", "quantize_kv_rows",
+           "ragged_paged_attention", "ragged_paged_attention_reference",
+           "reshape_and_cache"]
+
+
+# ---------------------------------------------------------------------------
+# Quantized KV pool (ISSUE 13): a pool plane is either a dense array
+# [num_blocks, kv_heads, block_size, head_dim] (fp32/bf16 — the
+# original layout, bitwise unchanged) or an (int8 values, f32 scales)
+# TUPLE with the scales in a per-slot-per-kv-head sidecar plane
+# [num_blocks, kv_heads, block_size] — one absmax scale per written
+# K/V row per head, living inside the page so the Pallas kernel's
+# per-physical-page DMA fetches values + scales together. The tuple
+# rides every existing pytree path (jit args, donation, shard_map
+# specs, lax.scan carries) without new plumbing: quantize is fused
+# into reshape_and_cache (the only pool write), dequant into the
+# attention gathers (the only pool reads).
+# ---------------------------------------------------------------------------
+
+def _plane_values(plane):
+    """The value array of a pool plane (tuple-aware)."""
+    return plane[0] if isinstance(plane, tuple) else plane
+
+
+def quantize_kv_rows(x):
+    """Per-row-per-kv-head symmetric absmax int8 for a K/V append
+    batch ``x`` [n, kv_heads, head_dim] (same math as the weight
+    quantizer _quantize_w, but over the head_dim axis — each written
+    slot carries its own scale, so appending never re-scales already
+    written tokens and a page mixes tokens of any magnitude).
+    Returns (int8 [n, kv_heads, head_dim], f32 scales [n, kv_heads])."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.max(jnp.abs(xf), axis=-1) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127) \
+        .astype(jnp.int8)
+    return q, scale
+
+
+def _dequantize_gather(plane, idx):
+    """jnp.take over a pool plane's leading (page) axis with dequant
+    fused at the gather: tuple planes come back as f32
+    values * per-slot scales, dense planes gather as-is."""
+    if isinstance(plane, tuple):
+        vals, scales = plane
+        return jnp.take(vals, idx, axis=0).astype(jnp.float32) \
+            * jnp.take(scales, idx, axis=0)[..., None]
+    return jnp.take(plane, idx, axis=0)
 
 
 class KVCacheExhausted(RuntimeError):
@@ -45,7 +91,29 @@ def reshape_and_cache(k, v, k_cache, v_cache, slot_mapping):
     Returns updated caches. Cache layout: [num_blocks, kv_heads,
     block_size, head_dim] — a physical page is one contiguous
     [kv_heads, block_size, head_dim] region, so the Pallas decode kernel
-    fetches a whole page (all kv heads) with a single DMA."""
+    fetches a whole page (all kv heads) with a single DMA.
+
+    Quantized pools (kv_quant="int8"): a cache passed as an
+    (int8 values, f32 scales) tuple gets the QUANTIZE FUSED INTO THE
+    APPEND — per-row-per-kv-head absmax int8 plus a scale scatter into
+    the sidecar plane, one functional update each, no fp32 staging
+    copy of the pool. Under tp the per-shard kv-head slice quantizes
+    its own heads with its own scales, so the append path stays at
+    zero collectives on the quantized layout too."""
+    if isinstance(k_cache, tuple):
+        kc, kcs = k_cache
+        vc, vcs = v_cache
+        nb, h, bs, d = kc.shape
+        blocks = slot_mapping // bs
+        offs = slot_mapping % bs
+        heads = jnp.arange(h)[None, :]
+        kq, ks = quantize_kv_rows(k)
+        vq, vs = quantize_kv_rows(v)
+        kc = kc.at[blocks[:, None], heads, offs[:, None]].set(kq)
+        kcs = kcs.at[blocks[:, None], heads, offs[:, None]].set(ks)
+        vc = vc.at[blocks[:, None], heads, offs[:, None]].set(vq)
+        vcs = vcs.at[blocks[:, None], heads, offs[:, None]].set(vs)
+        return (kc, kcs), (vc, vcs)
     nb, h, bs, d = k_cache.shape
     blocks = slot_mapping // bs
     offs = slot_mapping % bs
@@ -62,20 +130,21 @@ def paged_attention_decode_reference(q, k_cache, v_cache, block_tables,
 
     q:            [batch, num_heads, head_dim]  (this step's query)
     k_cache/v_cache: [num_blocks, kv_heads, block_size, head_dim]
+                  (or (int8, scales) tuples — dequant at the gather)
     block_tables: [batch, max_blocks] int32 physical block ids
     context_lens: [batch] int32 — valid tokens per sequence (incl. this)
     Returns [batch, num_heads, head_dim].
     """
     b, nh, d = q.shape
-    nb, kvh, bs, _ = k_cache.shape
+    nb, kvh, bs, _ = _plane_values(k_cache).shape
     max_blocks = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
     group = nh // kvh  # GQA: queries per kv head
 
     # gather each sequence's blocks: [b, max_blocks, kvh, bs, d]
-    k = jnp.take(k_cache, block_tables, axis=0)
-    v = jnp.take(v_cache, block_tables, axis=0)
+    k = _dequantize_gather(k_cache, block_tables)
+    v = _dequantize_gather(v_cache, block_tables)
     k = k.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
     v = v.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
 
@@ -117,10 +186,15 @@ def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
                   column's carried token, drafts 0..i-1 and itself —
                   never a later draft)
     Rows with row_ctx <= 0 (grid padding) return exact zeros.
+    Quantized pools ((int8, scales) tuples) dequantize INSIDE the page
+    walk — the per-page gather fetches values + sidecar scales and
+    multiplies before the score matmul, exactly the Pallas kernel's
+    fused per-page-DMA dequant, so the oracle stays the kernel's
+    ground truth on the int8 layout too.
     Returns [total_rows, num_heads, head_dim].
     """
     r, nh, d = q.shape
-    nb, kvh, bs, _ = k_cache.shape
+    nb, kvh, bs, _ = _plane_values(k_cache).shape
     max_pages = block_tables.shape[1]
     if scale is None:
         scale = 1.0 / np.sqrt(d)
@@ -145,8 +219,8 @@ def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,
     def page_step(p, carry):
         m_prev, l_prev, acc = carry
         pids = jnp.take(tables_r, p, axis=1)             # [r]
-        k = jnp.take(k_cache, pids, axis=0).astype(jnp.float32)
-        v = jnp.take(v_cache, pids, axis=0)              # [r, kvh, bs, d]
+        k = _dequantize_gather(k_cache, pids).astype(jnp.float32)
+        v = _dequantize_gather(v_cache, pids)            # [r, kvh, bs, d]
         sc = jnp.einsum("rkgd,rksd->rkgs", qg, k) * scale
         pos = p * bs + jnp.arange(bs)[None, None, None, :]
         mask = pos < ctx
@@ -174,7 +248,10 @@ def ragged_paged_attention(q, k_cache, v_cache, block_tables, row_seq,
     """Ragged mixed prefill+decode attention; Pallas scalar-prefetch
     kernel on TPU, jnp oracle elsewhere (CPU, or
     FLAGS.use_pallas_kernels=False). Kernel eligibility is the decode
-    kernel's policy — same pool layout, same tiling constraints."""
+    kernel's policy — same pool layout, same tiling constraints.
+    Quantized pools ((int8, scales) tuples) route to the kernel too:
+    the sidecar scales ride each page's DMA and dequant happens in
+    VMEM (see pallas/ragged_paged_attention.py)."""
     if _pallas_decode_ok(q, k_cache):
         from .pallas.ragged_paged_attention import \
             ragged_paged_attention_pallas
@@ -208,21 +285,55 @@ class PagedKVCache:
 
     def __init__(self, num_layers: int, num_blocks: int, block_size: int,
                  kv_heads: int, head_dim: int, dtype=jnp.float32,
-                 kv_sharding=None):
+                 kv_sharding=None, kv_quant=None,
+                 kv_scale_sharding=None):
+        if kv_quant not in (None, "int8"):
+            raise ValueError(
+                f"kv_quant must be None or 'int8', got {kv_quant!r}")
         self.num_layers = num_layers
         self.num_blocks = num_blocks
         self.block_size = block_size
+        self.kv_quant = kv_quant
         # per-layer pools as a LIST pytree: updating one layer swaps a
         # list element — no [L, ...] slice/update copies in the compiled
         # decode step. kv_sharding (a NamedSharding over the kv-head
         # dim) places the pool for tensor-parallel serving.
-        self.k = [jnp.zeros((num_blocks, kv_heads, block_size, head_dim),
-                            dtype) for _ in range(num_layers)]
-        self.v = [jnp.zeros_like(self.k[0]) for _ in range(num_layers)]
+        # kv_quant="int8" (ISSUE 13): each plane becomes an
+        # (int8 values, f32 scales) tuple — values keep the page
+        # layout, scales live in a per-slot-per-kv-head sidecar
+        # [num_blocks, kv_heads, block_size] whose kv-head dim shards
+        # EXACTLY like the values' (kv_scale_sharding; the canonical
+        # cache_k_scale spec), so tp adds zero collectives. All-zero
+        # init matches the dense pools' zeros bit-for-bit (0 * 0 = 0).
+        if kv_quant == "int8":
+            def _plane():
+                return (jnp.zeros((num_blocks, kv_heads, block_size,
+                                   head_dim), jnp.int8),
+                        jnp.zeros((num_blocks, kv_heads, block_size),
+                                  jnp.float32))
+        else:
+            def _plane():
+                return jnp.zeros((num_blocks, kv_heads, block_size,
+                                  head_dim), dtype)
+        self.k = [_plane() for _ in range(num_layers)]
+        self.v = [_plane() for _ in range(num_layers)]
         if kv_sharding is not None:
             import jax
-            self.k = [jax.device_put(a, kv_sharding) for a in self.k]
-            self.v = [jax.device_put(a, kv_sharding) for a in self.v]
+            if kv_quant == "int8":
+                if kv_scale_sharding is None:
+                    raise ValueError(
+                        "a sharded int8 pool needs kv_scale_sharding "
+                        "(the sidecar scales must shard with their kv "
+                        "heads, or every read pays an implicit gather)")
+
+                def _put(plane):
+                    return (jax.device_put(plane[0], kv_sharding),
+                            jax.device_put(plane[1], kv_scale_sharding))
+            else:
+                def _put(plane):
+                    return jax.device_put(plane, kv_sharding)
+            self.k = [_put(a) for a in self.k]
+            self.v = [_put(a) for a in self.v]
         self._free = list(range(num_blocks - 1, -1, -1))
         self._tables: dict = {}   # seq_id → [block ids]
         self._lens: dict = {}     # seq_id → context length
@@ -305,7 +416,8 @@ class PagedKVCache:
         self._lens[seq_id] = 0
         if self.tracer is not None:
             self.tracer.event("kv_alloc", pid=self.trace_pid,
-                              seq=int(seq_id), blocks=int(needed))
+                              seq=int(seq_id), blocks=int(needed),
+                              dtype=self.pool_dtype)
         return self._tables[seq_id]
 
     # -- prefix caching ------------------------------------------------------
@@ -418,7 +530,8 @@ class PagedKVCache:
         if self.tracer is not None:
             self.tracer.event("kv_alloc", pid=self.trace_pid,
                               seq=int(seq_id), blocks=int(needed_new),
-                              spliced=len(reused))
+                              spliced=len(reused),
+                              dtype=self.pool_dtype)
             if reused:
                 self.tracer.event(
                     "kv_splice", pid=self.trace_pid, seq=int(seq_id),
@@ -633,6 +746,33 @@ class PagedKVCache:
         """The sequence's physical block list (read-only view)."""
         return list(self._tables[seq_id])
 
+    # -- pool-footprint introspection (ISSUE 13) ----------------------------
+    @property
+    def pool_dtype(self) -> str:
+        """The pool's storage dtype as stats()/telemetry report it:
+        'int8' for the quantized layout, else the plane dtype name."""
+        if self.kv_quant == "int8":
+            return "int8"
+        return str(np.dtype(_plane_values(self.k[0]).dtype))
+
+    def pool_bytes(self) -> int:
+        """Total device bytes of the K/V planes (sidecar scales
+        included) — the logical (global, unsharded) footprint."""
+        total = 0
+        for plane in list(self.k) + list(self.v):
+            leaves = plane if isinstance(plane, tuple) else (plane,)
+            for a in leaves:
+                total += int(np.prod(a.shape, dtype=np.int64)
+                             * np.dtype(a.dtype).itemsize)
+        return total
+
+    def bytes_per_token(self) -> float:
+        """KV bytes one token slot costs across all layers (k + v,
+        scales included) — pool_bytes over the pool's slot count; the
+        capacity headline kv_quant halves."""
+        return self.pool_bytes() / float(self.num_blocks
+                                         * self.block_size)
+
     @property
     def free_blocks(self) -> int:
         return len(self._free)
@@ -706,7 +846,8 @@ def _pallas_decode_ok(q, k_cache):
     if not getattr(FLAGS, "use_pallas_kernels", True):
         return False
     d = q.shape[-1]
-    bs = k_cache.shape[2]   # layout [num_blocks, kv_heads, block_size, d]
+    # layout [num_blocks, kv_heads, block_size, d] (tuple-aware)
+    bs = _plane_values(k_cache).shape[2]
     return d in (64, 128, 256) and bs % 8 == 0
 
 
@@ -714,8 +855,12 @@ def paged_attention_decode(q, k_cache, v_cache, block_tables, context_lens,
                            scale: Optional[float] = None):
     """One-token decode attention over the paged cache; Pallas
     scalar-prefetch kernel on TPU, jnp reference elsewhere. See
-    paged_attention_decode_reference for the signature."""
-    if _pallas_decode_ok(q, k_cache):
+    paged_attention_decode_reference for the signature. Quantized
+    pools run the reference path everywhere: the DENSE decode kernel
+    predates the sidecar-scale layout, and serving's TPU hot path is
+    the ragged program (whose kernel fuses the dequant) — the dense
+    per-phase scheduler is the CPU/debug fallback there."""
+    if not isinstance(k_cache, tuple) and _pallas_decode_ok(q, k_cache):
         from .pallas.paged_attention import paged_attention_decode_pallas
         return paged_attention_decode_pallas(q, k_cache, v_cache,
                                              block_tables, context_lens,

@@ -51,10 +51,21 @@ def _on_tpu() -> bool:
         return False
 
 
-def _ragged_kernel(rowseq_ref, rowctx_ref, tables_ref, q_ref, k_hbm,
-                   v_hbm, o_ref, k_buf, v_buf, sem_k, sem_v, *,
+def _ragged_kernel(rowseq_ref, rowctx_ref, tables_ref, q_ref, *refs,
                    block_size, scale, pages_per_iter, max_pages, tq,
-                   group):
+                   group, quantized):
+    # ref unpacking is static on `quantized` (ISSUE 13): the int8 pool
+    # carries two extra HBM operands (the per-slot-per-kv-head scale
+    # sidecars), two extra VMEM scale buffers and their DMA semaphores
+    # — each physical page's [kvh, bs] scale row rides the SAME
+    # double-buffered pipeline as its values, and dequant happens in
+    # VMEM right before the score/value matmuls (quantize-the-pool,
+    # dequant-at-the-DMA: the EQuARX wire idea applied to storage).
+    if quantized:
+        (k_hbm, v_hbm, ks_hbm, vs_hbm, o_ref, k_buf, v_buf, ks_buf,
+         vs_buf, sem_k, sem_v, sem_ks, sem_vs) = refs
+    else:
+        k_hbm, v_hbm, o_ref, k_buf, v_buf, sem_k, sem_v = refs
     g = pl.program_id(0)
     base = g * tq
     P = pages_per_iter
@@ -72,32 +83,41 @@ def _ragged_kernel(rowseq_ref, rowctx_ref, tables_ref, q_ref, k_hbm,
         seq_map = jnp.where(lane_row == j, rowseq_ref[base + j], seq_map)
         ctx_map = jnp.where(lane_row == j, rowctx_ref[base + j], ctx_map)
 
-    def copy_in(s, it, slot):
-        """Issue P page DMAs of sequence `s`'s iteration group `it`
-        into buffer `slot` (tail groups read a clamped table entry —
-        masked in compute)."""
-        for pj in range(P):
-            page = tables_ref[s, jnp.minimum(it * P + pj, max_pages - 1)]
+    def _page_copies(s, it, slot, pj):
+        page = tables_ref[s, jnp.minimum(it * P + pj, max_pages - 1)]
+        copies = [
             pltpu.make_async_copy(
                 k_hbm.at[page],
                 k_buf.at[slot, :, pl.ds(pj * bs, bs), :],
-                sem_k.at[slot, pj]).start()
+                sem_k.at[slot, pj]),
             pltpu.make_async_copy(
                 v_hbm.at[page],
                 v_buf.at[slot, :, pl.ds(pj * bs, bs), :],
-                sem_v.at[slot, pj]).start()
+                sem_v.at[slot, pj]),
+        ]
+        if quantized:
+            copies.append(pltpu.make_async_copy(
+                ks_hbm.at[page],
+                ks_buf.at[slot, :, pl.ds(pj * bs, bs)],
+                sem_ks.at[slot, pj]))
+            copies.append(pltpu.make_async_copy(
+                vs_hbm.at[page],
+                vs_buf.at[slot, :, pl.ds(pj * bs, bs)],
+                sem_vs.at[slot, pj]))
+        return copies
+
+    def copy_in(s, it, slot):
+        """Issue the page DMAs of sequence `s`'s iteration group `it`
+        into buffer `slot` (tail groups read a clamped table entry —
+        masked in compute); values + sidecar scales together."""
+        for pj in range(P):
+            for c in _page_copies(s, it, slot, pj):
+                c.start()
 
     def wait_group(s, it, slot):
         for pj in range(P):
-            page = tables_ref[s, jnp.minimum(it * P + pj, max_pages - 1)]
-            pltpu.make_async_copy(
-                k_hbm.at[page],
-                k_buf.at[slot, :, pl.ds(pj * bs, bs), :],
-                sem_k.at[slot, pj]).wait()
-            pltpu.make_async_copy(
-                v_hbm.at[page],
-                v_buf.at[slot, :, pl.ds(pj * bs, bs), :],
-                sem_v.at[slot, pj]).wait()
+            for c in _page_copies(s, it, slot, pj):
+                c.wait()
 
     def seq_body(j, carry):
         """Process the block's j-th row's sequence IF row j is its
@@ -140,6 +160,13 @@ def _ragged_kernel(rowseq_ref, rowctx_ref, tables_ref, q_ref, k_hbm,
             wait_group(s, it, slot)
             k = k_buf[slot].astype(jnp.float32)        # [kvh, P*bs, d]
             v = v_buf[slot].astype(jnp.float32)
+            if quantized:
+                # dequant in VMEM, per element, exactly the oracle's
+                # gather-time math (value * its slot's scale) so
+                # kernel-vs-oracle parity holds bit-tight on the int8
+                # layout; the scale buffers are [kvh, P*bs]
+                k = k * ks_buf[slot][..., None]
+                v = v * vs_buf[slot][..., None]
             sc = jax.lax.dot_general(
                 q, k, (((2,), (2,)), ((0,), (0,))),
                 preferred_element_type=jnp.float32)    # [kvh, rows, P*bs]
@@ -178,10 +205,17 @@ def ragged_paged_attention_pallas(q, k_cache, v_cache, block_tables,
     """Ragged mixed prefill+decode attention over the paged pool.
 
     q [total_rows, num_heads, head_dim]; caches [num_blocks, kv_heads,
-    block_size, head_dim]; block_tables [num_seqs, max_pages] int32;
+    block_size, head_dim] — or (int8 values, f32 scales [num_blocks,
+    kv_heads, block_size]) tuples for the quantized pool (ISSUE 13),
+    whose sidecar scales ride each page's DMA and dequantize in VMEM;
+    block_tables [num_seqs, max_pages] int32;
     row_seq/row_ctx [total_rows] int32 (see
     ops.paged_attention.ragged_paged_attention_reference).
     Returns [total_rows, num_heads, head_dim]."""
+    quantized = isinstance(k_cache, tuple)
+    if quantized:
+        k_cache, k_scales = k_cache
+        v_cache, v_scales = v_cache
     r, nh, d = q.shape
     nb, kvh, bs, _ = k_cache.shape
     max_pages = block_tables.shape[1]
@@ -214,34 +248,47 @@ def ragged_paged_attention_pallas(q, k_cache, v_cache, block_tables,
     tpi = int(os.environ.get("PT_PAGED_TOKENS_PER_ITER", "128"))
     P = max(1, min(max_pages, tpi // bs))
 
+    in_specs = [
+        pl.BlockSpec((kvh, 1, tq * group, d),
+                     lambda gi, rs_, rc_, tb_: (0, gi, 0, 0)),
+        pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
+        pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((2, kvh, P * bs, d), k_cache.dtype),
+        pltpu.VMEM((2, kvh, P * bs, d), v_cache.dtype),
+    ]
+    sems = [pltpu.SemaphoreType.DMA((2, P)),
+            pltpu.SemaphoreType.DMA((2, P))]
+    operands = [k_cache, v_cache]
+    if quantized:
+        # scale sidecars: HBM-resident like the pools, double-buffered
+        # [kvh, P*bs] f32 VMEM slices, one DMA semaphore pair more
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY),
+                     pl.BlockSpec(memory_space=pltpu.ANY)]
+        scratch_shapes += [pltpu.VMEM((2, kvh, P * bs), jnp.float32),
+                           pltpu.VMEM((2, kvh, P * bs), jnp.float32)]
+        sems += [pltpu.SemaphoreType.DMA((2, P)),
+                 pltpu.SemaphoreType.DMA((2, P))]
+        operands += [k_scales, v_scales]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(g,),
-        in_specs=[
-            pl.BlockSpec((kvh, 1, tq * group, d),
-                         lambda gi, rs_, rc_, tb_: (0, gi, 0, 0)),
-            pl.BlockSpec(memory_space=pltpu.ANY),   # K pool stays in HBM
-            pl.BlockSpec(memory_space=pltpu.ANY),   # V pool stays in HBM
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((kvh, 1, tq * group, d),
                                lambda gi, rs_, rc_, tb_: (0, gi, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((2, kvh, P * bs, d), k_cache.dtype),
-            pltpu.VMEM((2, kvh, P * bs, d), v_cache.dtype),
-            pltpu.SemaphoreType.DMA((2, P)),
-            pltpu.SemaphoreType.DMA((2, P)),
-        ],
+        scratch_shapes=scratch_shapes + sems,
     )
     out = pl.pallas_call(
         functools.partial(_ragged_kernel, block_size=bs, scale=scale,
                           pages_per_iter=P, max_pages=max_pages, tq=tq,
-                          group=group),
+                          group=group, quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((kvh, g, tq * group, d), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel",)),
         interpret=_interpret(),
-    )(rs, rc, block_tables.astype(jnp.int32), q4, k_cache, v_cache)
+    )(rs, rc, block_tables.astype(jnp.int32), q4, *operands)
     out = out.reshape(kvh, r_pad, group, d).transpose(1, 0, 2, 3) \
         .reshape(r_pad, nh, d)
     return out[:r]

@@ -101,6 +101,14 @@ def _full_script(**overrides):
             {"serving_dp2_tok_per_sec": 88.0,
              "serving_dp_affinity_hit_gain": 0.3,
              "serving_dp_tokens_identical": True}), "")],
+        # serving_kv8 joined AUTO_MODES in the ISSUE-13 PR — scripted
+        # same-PR (the PR-9 lesson, three times applied)
+        "serving_kv8": [(_simple(
+            "serving_kv8_bytes_per_token_reduction_x", 3.56,
+            {"serving_kv8_bytes_per_token_reduction_x": 3.56,
+             "serving_kv8_tokens_identical": True,
+             "serving_kv8_cap_fp32_oom_preemptions": 6,
+             "serving_kv8_cap_int8_oom_preemptions": 1}), "")],
         "pp": [(_simple("pp_remat_overhead_x", 0.991,
                         {"pp_remat_overhead_x": 0.991,
                          "pp_tick_fwd_ms": 0.086,

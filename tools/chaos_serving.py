@@ -93,7 +93,8 @@ def build_engine(model, args, tracer=None):
         tp=getattr(args, "tp", 1),
         spec_decode=SpecConfig(draft_len=4)
         if getattr(args, "spec", False) else None,
-        lora=lora, tracer=tracer)
+        lora=lora, tracer=tracer,
+        kv_quant=getattr(args, "kv_quant", None))
 
 
 def build_fleet(model, args, tracer=None):
@@ -111,7 +112,7 @@ def build_fleet(model, args, tracer=None):
         prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8,
         admission="optimistic", max_dispatch_retries=args.retries,
         retry_backoff_s=0.0, ragged=getattr(args, "ragged", False),
-        tracer=tracer)
+        kv_quant=getattr(args, "kv_quant", None), tracer=tracer)
 
 
 def gen_workload(args):
@@ -290,6 +291,15 @@ def main() -> int:
                     help="exercise the ragged unified prefill+decode "
                          "path (ISSUE 5): both the chaos and the "
                          "fault-free replay run with ragged=True")
+    ap.add_argument("--kv-quant", choices=("int8",), default=None,
+                    help="run BOTH legs on the quantized KV pool "
+                         "(ISSUE 13): int8 planes + sidecar scales — "
+                         "the whole fault schedule (OOM-preemption, "
+                         "rollback, eviction, cancellation) must hold "
+                         "debug_check on the int8 layout and stay "
+                         "token-identical vs the fault-free replay "
+                         "(both replays quantized, so identity is "
+                         "well-defined)")
     ap.add_argument("--tp", type=int, default=1,
                     help="tensor-parallel degree (ISSUE 8): both runs "
                          "serve on the sharded shard_map engine — "
@@ -396,6 +406,7 @@ def main() -> int:
         summary = {
             "dp": args.dp,
             "ragged": bool(args.ragged),
+            "kv_quant": eng.replicas[0].engine.stats()["kv_quant"],
             "steps": steps_run,
             "requests": len(chaos_results),
             "failovers": fleet["failovers"],
@@ -448,6 +459,8 @@ def main() -> int:
         "tp": args.tp,
         "spec": bool(args.spec),
         "lora": bool(args.lora),
+        "kv_quant": st["kv_quant"],
+        "kv_bytes_per_token": st["kv_bytes_per_token"],
         "active_adapters": st["active_adapters"],
         "adapter_cache_hits": st["adapter_cache_hits"],
         "adapter_cache_misses": st["adapter_cache_misses"],

@@ -52,6 +52,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_lora_serving.py"),
     os.path.join(REPO, "tests", "test_fleet_serving.py"),
     os.path.join(REPO, "tests", "test_telemetry.py"),
+    os.path.join(REPO, "tests", "test_kv_quant.py"),
 ]
 
 
@@ -138,7 +139,12 @@ def run_chaos() -> int:
     # is then VALIDATED (parses, carries >= 1 span per lifecycle
     # phase, and shows a migrated request as ONE continuous span
     # crossing two replica tracks).
+    # ISSUE 13: the ragged leg RE-RUNS on the quantized KV pool
+    # (ragged_kv8) — same seeded schedule, int8 planes + sidecar
+    # scales, debug_check through every rollback/eviction, token
+    # identity vs a fault-free replay on the SAME quantized pool
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
+                     ("ragged_kv8", ("--ragged", "--kv-quant", "int8")),
                      ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
                      ("lora", ("--lora", "--num-blocks", "20",
                                "--requests", "12")),

@@ -318,7 +318,7 @@ def _build_tp_serving():
       zero-scatters each shard's own kv-head slice, so verification
       adds ZERO collectives; any new collective here fails the gate.
     """
-    def _mk(tp_comm):
+    def _mk(tp_comm, kv_quant=None):
         def build():
             import jax
             import jax.numpy as jnp
@@ -335,7 +335,8 @@ def _build_tp_serving():
             mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
             dec = PagedLlamaDecoder.from_config(
                 cfg, num_blocks=8, block_size=4, mesh=mesh,
-                mp_axis="tp", tp_shard_map=True, tp_comm=tp_comm)
+                mp_axis="tp", tp_shard_map=True, tp_comm=tp_comm,
+                kv_quant=kv_quant)
             eng = ServingEngine(dec, tp=2, tp_comm=tp_comm,
                                 max_batch_size=2,
                                 prompt_buckets=(8, 16), chunk_size=2,
@@ -482,6 +483,15 @@ def _build_tp_serving():
 
     return {"serving.ragged_tp2_fp32": _mk("fp32"),
             "serving.ragged_tp2_int8": _mk("int8"),
+            # ISSUE 13: the QUANTIZED-POOL ragged step must pin
+            # byte-identical collectives to the fp32-pool program —
+            # the int8 planes' sidecar scales shard dim-aligned with
+            # their kv heads (canonical cache_k_scale spec), so the
+            # quantize-at-append scatter and dequant-at-read gather
+            # are both shard-local; ANY implicit gather over the
+            # scales (a mis-sharded sidecar) changes these counts and
+            # fails the 4s gate
+            "serving.ragged_kv8_tp2": _mk("fp32", kv_quant="int8"),
             "serving.ragged_spec_tp2": _mk_spec(),
             # ISSUE 11: a dp x tp FLEET replica's ragged step — built
             # through the Router on row 1 of the SpecLayout 2x2 device
