@@ -1172,7 +1172,18 @@ def run_serving_trace():
     CPU walls jitter a few percent; the mechanism under test is a few
     host-side dict appends per step). The traced leg's flight recorder
     is exported as the bench artifact (serving_trace.perfetto.json,
-    summarizable via tools/trace_report.py)."""
+    summarizable via tools/trace_report.py).
+
+    ISSUE 14 re-pins the bar with the program observatory riding the
+    traced leg: counter tracks sample every step and CompileWatch
+    records every compile. Both legs bound ragged_idle_cap (closing
+    the reachable program grid) and run warmup(seal_programs=True) —
+    the grid compiles pre-clock and is SEALED, so the measured reps
+    must finish with ZERO unexpected recompiles (asserted in-row, the
+    runtime FC2xx on the bench workload; sealing after a cold first
+    lap is NOT enough — the second lap splices warm prefixes and
+    legitimately reaches schedule shapes a cold lap never dispatches,
+    which is exactly the class of surprise the grid warmup closes)."""
     import paddle_tpu as paddle
     from paddle_tpu.models import LlamaForCausalLM, llama_small
     from paddle_tpu.inference import ServingEngine, SamplingParams
@@ -1200,8 +1211,8 @@ def run_serving_trace():
             model, max_batch_size=n_short + 1, num_blocks=n_blocks,
             block_size=block_size, prompt_buckets=(128, long_len),
             chunk_size=8, prefill_chunk=32, ragged=True,
-            tracer=tracer)
-        eng.warmup()
+            ragged_idle_cap=32, tracer=tracer)
+        eng.warmup(seal_programs=True)
         best = None
         for _rep in range(2):
             eng.clear_finished()
@@ -1221,6 +1232,22 @@ def run_serving_trace():
                             for r in rids + [rl]]}
             if best is None or leg["rate"] > best["rate"]:
                 best = leg
+        if tag == "on":
+            # the watch's ledger is cumulative (clear_finished resets
+            # only the per-workload engine counters), so this covers
+            # every post-seal dispatch across both measured reps
+            out["serving_trace_program_compiles"] = \
+                eng.compile_watch.compiles
+            out["serving_trace_unexpected_recompiles"] = \
+                eng.compile_watch.unexpected_recompiles
+            out["serving_trace_counter_samples"] = sum(
+                1 for r in tracer.records() if r["kind"] == "counter")
+            assert eng.compile_watch.unexpected_recompiles == 0, \
+                ("measured reps retraced after seal: "
+                 f"{eng.compile_watch.unexpected_recompiles} "
+                 "unexpected compiles")
+            assert out["serving_trace_counter_samples"] > 0, \
+                "traced leg sampled no counter tracks"
         toks[tag] = best["toks"]
         out[f"serving_trace_{tag}_tok_per_sec"] = round(best["rate"], 1)
         out[f"serving_trace_{tag}_wall_s"] = round(best["wall"], 3)

@@ -74,7 +74,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..distributed.spec_layout import SpecLayout
-from ..utils.telemetry import FLEET_PID, Reservoir
+from ..utils.telemetry import FLEET_PID, Reservoir, SLOMonitor, SLOPolicy
 from .serving import (EngineOverloaded, SamplingParams, ServingEngine,
                       _normalize_prompt)
 
@@ -162,7 +162,7 @@ class Router:
                  cooldown_steps: Optional[int] = None,
                  probation_steps: int = 8,
                  engine_factory: Optional[Callable] = None,
-                 tracer=None,
+                 tracer=None, slo=None,
                  **engine_kwargs):
         dp = int(dp)
         if dp < 1:
@@ -187,13 +187,31 @@ class Router:
         # so a migrated request renders as a single continuous span
         # crossing two replica tracks. tracer=None is a bitwise no-op.
         self.tracer = tracer
+        # SLO monitoring (ISSUE 14): `slo` is a sequence of SLOPolicy
+        # declarations (or one policy / an SLOMonitor whose policies
+        # are taken as the template). Each replica gets its OWN
+        # monitor over the shared policy set — windows must be
+        # per-replica or one slow replica's tail hides inside the
+        # fleet aggregate; stats() rolls the per-replica headrooms up
+        # (the SLO-aware-routing input, ROADMAP 1)
+        self._slo_policies: List[SLOPolicy] = \
+            SLOMonitor.coerce_policies(slo)
+        if self._slo_policies and engine_factory is not None:
+            # a factory builds its engines itself — Router-level
+            # policies would be silently ignored; fail loudly instead
+            raise ValueError("pass slo= to the Router only without "
+                             "engine_factory (give factory-built "
+                             "engines their own SLOMonitor)")
         self.replicas: List[Replica] = []
         for r in range(dp):
             if engine_factory is not None:
                 eng = engine_factory(r, slices[r])
             else:
+                kw = dict(engine_kwargs)
+                if self._slo_policies:
+                    kw["slo"] = SLOMonitor(self._slo_policies)
                 eng = ServingEngine(model, tp=tp, devices=slices[r],
-                                    **engine_kwargs)
+                                    **kw)
             if tracer is not None:
                 eng.set_telemetry(tracer, replica_id=r)
             self.replicas.append(Replica(r, eng))
@@ -543,6 +561,17 @@ class Router:
                             self.tracer.event(
                                 "breaker_promote", pid=FLEET_PID,
                                 replica=rep.idx, step=self._step_no)
+        if self.tracer is not None:
+            # fleet counter tracks (ISSUE 14): per-replica load on the
+            # replica's own track, fleet health on the fleet track —
+            # the resource timeline next to the request spans
+            for rep in self.replicas:
+                self.tracer.counter("load", self._load(rep.engine),
+                                    pid=rep.idx)
+            self.tracer.counter(
+                "healthy_replicas",
+                sum(1 for rep in self.replicas
+                    if rep.state == "healthy"), pid=FLEET_PID)
         return self.has_work
 
     def run_to_completion(self) -> Dict[int, np.ndarray]:
@@ -556,13 +585,36 @@ class Router:
                 pass
         return out
 
-    def warmup(self, prompt_len: Optional[int] = None):
+    def warmup(self, prompt_len: Optional[int] = None,
+               seal_programs: bool = False):
         """Warm every replica's compiled programs, then reset stats so
-        warmup traffic never pollutes the fleet numbers."""
+        warmup traffic never pollutes the fleet numbers.
+        ``seal_programs=True`` additionally grid-warms and SEALS each
+        replica's program set (ServingEngine.warmup contract)."""
         for rep in self.replicas:
             if rep.state != "wedged":
-                rep.engine.warmup(prompt_len)
+                rep.engine.warmup(prompt_len,
+                                  seal_programs=seal_programs)
         self.clear_finished()
+
+    def warmup_programs(self, max_width: Optional[int] = None):
+        """Grid-compile every replica's reachable program set by
+        direct invocation (no scheduler traffic, no PRNG keys — see
+        ServingEngine.warmup_programs)."""
+        for rep in self.replicas:
+            if rep.state != "wedged":
+                rep.engine.warmup_programs(max_width)
+
+    def seal_programs(self):
+        """Seal every healthy replica's program set: any later compile
+        counts in that replica's unexpected_recompiles and the fleet
+        rollup — the chaos dp leg asserts the sum stays zero. A WEDGED
+        replica is skipped exactly like warmup_programs skips it:
+        sealing it cold would turn the recovered replica's legitimate
+        grid compiles into false retrace verdicts."""
+        for rep in self.replicas:
+            if rep.state != "wedged":
+                rep.engine.seal_programs()
 
     # -- stats ---------------------------------------------------------------
     @staticmethod
@@ -645,6 +697,13 @@ class Router:
             "device_dispatches": sum(e.device_dispatches
                                      for e in engines),
             "prefix_cache_hit_rate": hit / query if query else 0.0,
+            # -- program observatory (ISSUE 14) -----------------------
+            # fleet-wide compile ledger: the chaos dp leg asserts the
+            # unexpected sum stays zero after sealing
+            "program_compiles": sum(e.program_compiles
+                                    for e in engines),
+            "unexpected_recompiles": sum(e.unexpected_recompiles
+                                         for e in engines),
         }
         per = []
         for rep in self.replicas:
@@ -654,12 +713,37 @@ class Router:
             st["wedges"] = rep.wedges
             st["load"] = self._load(rep.engine)
             per.append(st)
+        if self._slo_policies or any("slo" in st for st in per):
+            # per-replica SLO headroom rollup — the input SLO-aware
+            # routing needs (ROADMAP 1): route a deadline class to the
+            # replica with the most headroom for its policy. min
+            # headroom per policy says how close the FLEET is to
+            # paging; a wedged replica reports no headroom entry
+            headroom: Dict[str, Dict[str, float]] = {}
+            for rep, st in zip(self.replicas, per):
+                slo = st.get("slo")
+                if not slo:
+                    continue
+                for pname, pol in slo["policies"].items():
+                    headroom.setdefault(pname, {})[str(rep.idx)] = \
+                        pol["headroom"]
+            fleet["slo"] = {
+                "headroom": headroom,
+                "min_headroom": {
+                    pname: min(vals.values())
+                    for pname, vals in headroom.items() if vals}}
         if self.tracer is not None:
             # the unified registry mirrors the fleet rollup under
             # "fleet.*"; each engine's stats() call above published its
             # own view under its per-replica namespace ("engine" for
             # replica 0, "engine1"... beyond — no overwriting)
             self.tracer.metrics.publish("fleet", fleet)
+            for pname, vals in fleet.get("slo", {}).get(
+                    "headroom", {}).items():
+                for ridx, h in vals.items():
+                    self.tracer.metrics.set_gauge(
+                        f"fleet.slo.{pname}.r{ridx}.headroom",
+                        float(h))
         return {"fleet": fleet, "replicas": per}
 
     def clear_finished(self):

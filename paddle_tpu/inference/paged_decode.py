@@ -270,6 +270,21 @@ class _TPDecoderMixin:
     ``self.mesh / mp_axis / tp_comm / _tp / _tp_manual / cfg /
     head_dim / weights`` to be set by their __init__."""
 
+    @property
+    def program_build_info(self) -> dict:
+        """Compact build fingerprint riding every CompileWatch record
+        (ISSUE 14): WHICH decoder build a compile span belongs to —
+        the knobs that change compiled-program identity without
+        changing operand shapes, so a trace reader can tell an int8
+        pool's ragged program from an fp32 one at a glance."""
+        return {
+            "decoder": type(self).__name__,
+            "dtype": str(np.dtype(self.weights["embed"].dtype)),
+            "kv_quant": getattr(self, "kv_quant", None) or "none",
+            "tp_comm": self.tp_comm if self._tp_manual else "none",
+            "block_size": int(self.block_size),
+        }
+
     def _kv_sharding(self):
         if self.mesh is None:
             return None

@@ -137,7 +137,8 @@ import jax.numpy as jnp
 
 from ..framework.core import Tensor
 from ..ops.paged_attention import KVCacheExhausted
-from ..utils.telemetry import Reservoir
+from ..utils.telemetry import (CompileWatch, Reservoir, SLOMonitor,
+                               SLOPolicy)
 from .paged_decode import PagedLlamaDecoder
 from .spec_decode import SpecConfig
 
@@ -375,7 +376,11 @@ class ServingEngine:
                  devices: Optional[Sequence] = None,
                  spec_decode: Optional[SpecConfig] = None,
                  lora=None, tracer=None,
-                 kv_quant: Optional[str] = None):
+                 kv_quant: Optional[str] = None,
+                 slo=None,
+                 profile_every: Optional[int] = None,
+                 profile_seed: int = 0,
+                 ragged_idle_cap: Optional[int] = None):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -880,6 +885,54 @@ class ServingEngine:
         # so kv alloc/evict/splice/rollback and adapter refaults land
         # in the same flight recorder; the fleet Router re-calls it
         # with the replica index so every record carries its replica.
+        # -- program observatory (ISSUE 14) ---------------------------------
+        # CompileWatch: every serving program family registers its
+        # jitted callable (end of __init__, once the programs exist);
+        # _device_call asks the watch after each dispatch whether the
+        # jit cache grew — a grown cache IS a trace+lower+compile,
+        # recorded as a compile span. seal_programs() (after
+        # warmup_programs has compiled the reachable grid) turns any
+        # later compile into engine.unexpected_recompiles — the
+        # runtime analogue of flightcheck's FC2xx rules. The watch is
+        # always on: detection is two host attribute reads per
+        # dispatch, and chaos legs must be able to assert the sealed
+        # contract even when no tracer is attached.
+        self.compile_watch = CompileWatch()
+        self.unexpected_recompiles = 0
+        self.program_compiles = 0
+        # sampled dispatch-time attribution: every profile_every-th
+        # dispatch pays a block_until_ready fence (seeded start phase)
+        # and splits the step wall into host-schedule / dispatch-queue
+        # / device-execute histograms, per program family. Default OFF
+        # — the unsampled steady state keeps the async pipeline and
+        # the bitwise no-op contract (a fence never changes tokens,
+        # but it does cost a sync, so sampling is opt-in).
+        if profile_every is not None and int(profile_every) < 1:
+            raise ValueError(f"profile_every must be >= 1, got "
+                             f"{profile_every}")
+        self._prof_n = int(profile_every) if profile_every else 0
+        self._prof_metrics = None    # lazy registry when tracer is off
+        self._prof_countdown = 0
+        if self._prof_n:
+            rng = np.random.RandomState(int(profile_seed))
+            self._prof_countdown = 1 + int(rng.randint(self._prof_n))
+        self._prof_mark = time.perf_counter()
+        self.profiled_dispatches = 0
+        # SLO monitoring (declared per-class latency targets; see
+        # telemetry.SLOPolicy/SLOMonitor): fed at the same collection
+        # points as the PR-12 histograms, evaluated by stats()["slo"].
+        # Pure host-side and passive — attaching a monitor changes no
+        # schedule, draws no key.
+        if isinstance(slo, SLOMonitor):
+            self._slo = slo
+        else:
+            pols = SLOMonitor.coerce_policies(slo)
+            self._slo = SLOMonitor(pols) if pols else None
+        self._slo_violating: set = set()
+        # per-window draft-acceptance EMA (alpha 0.1): the adaptive-
+        # window signal ROADMAP item 2 needs, sampled into the
+        # acceptance_ema counter track
+        self.draft_acceptance_ema = 0.0
         self.set_telemetry(tracer)
         # bounded ITL aggregation (ISSUE 12 satellite): finished
         # requests' per-token samples fold into a seeded reservoir at
@@ -907,6 +960,18 @@ class ServingEngine:
         # successive steps' programs under this per-step cap)
         self._ragged_cap = (self.prefill_budget or self.prefill_chunk
                             or self._recompute_chunk)
+        # idle-drain width bound (ISSUE 14): pure-prefill programs on
+        # an idle engine widen up to this many rows per dispatch. The
+        # class default keeps the PR-5 wide-drain behavior; a bounded
+        # value CLOSES the reachable (T, W) program grid so
+        # warmup_programs can compile it whole and seal_programs can
+        # assert no mid-run retrace (the chaos legs run bounded)
+        if ragged_idle_cap is not None and int(ragged_idle_cap) < 1:
+            raise ValueError(f"ragged_idle_cap must be >= 1, got "
+                             f"{ragged_idle_cap}")
+        self._ragged_idle_cap = (int(ragged_idle_cap)
+                                 if ragged_idle_cap is not None
+                                 else self._RAGGED_IDLE_CAP)
         self._zeros_toks_cache: Dict[Tuple[int, int], jax.Array] = {}
         if self.ragged:
             def ragged_chunk(weights, k, v, prev_toks, last_t, prev_col,
@@ -1180,6 +1245,40 @@ class ServingEngine:
                         self._spec_lora_j = jax.jit(
                             spec_lora_chunk, donate_argnums=(1, 2))
 
+        # -- program observatory: register every family (ISSUE 14) ----------
+        # the registration order fixes the family names compile spans,
+        # attribution histograms and trace_report tables use; `info`
+        # carries the decoder's build fingerprint so a compile record
+        # says WHICH decoder build it belongs to
+        info = dict(getattr(dec, "program_build_info", {}) or {})
+        info["tp"] = self.tp
+        for fam, fn in self._program_families():
+            self.compile_watch.register(fam, fn, **info)
+
+    def _program_families(self):
+        """(family name, jitted callable) for every serving program
+        this engine can dispatch — the CompileWatch registration set
+        AND the warmup_programs grid's family list."""
+        fams = [("prefill", self._prefill_j),
+                ("prefill_prefix", self._prefill_prefix_j),
+                ("decode", self._decode_j),
+                ("decode_rich", self._decode_rich_j),
+                ("merge", self._merge_first_j)]
+        if self._can_recompute:
+            fams += [("prefill_mid", self._prefill_mid_j),
+                     ("prefill_mid0", self._prefill_mid0_j)]
+        if self.ragged:
+            fams += [("ragged", self._ragged_j),
+                     ("ragged_rich", self._ragged_rich_j)]
+        if self.lora is not None:
+            fams += [("ragged_lora", self._ragged_lora_j),
+                     ("ragged_lora_rich", self._ragged_lora_rich_j)]
+        if self.spec is not None:
+            fams.append(("spec", self._spec_j))
+            if self.lora is not None:
+                fams.append(("spec_lora", self._spec_lora_j))
+        return fams
+
     def _sample(self, logits, temp, key):
         """In-program sampling: per-slot temperature (<=0 → greedy),
         engine-static top_k."""
@@ -1264,6 +1363,80 @@ class ServingEngine:
         if self.lora is not None:
             self.lora.tracer = tracer
             self.lora.trace_pid = self.replica_id
+        # the compile watch shares the tracer's registry (compile
+        # spans + compile.* counters land beside everything else);
+        # without a tracer it keeps its own registry so sealed-set
+        # detection still works untraced
+        self.compile_watch.bind(tracer, pid=self.replica_id)
+
+    def _profile_metrics(self):
+        """Registry the sampled-attribution histograms feed: the
+        tracer's when attached, else a private one (profiling without
+        a tracer still measures — the engine just owns the registry)."""
+        if self.tracer is not None:
+            return self.tracer.metrics
+        if self._prof_metrics is None:
+            from ..utils.telemetry import MetricsRegistry
+            self._prof_metrics = MetricsRegistry()
+        return self._prof_metrics
+
+    def _prof_due(self) -> bool:
+        """Deterministic every-Nth sampling with a seeded start phase
+        (profile_seed): identical runs fence identical dispatches."""
+        if not self._prof_n:
+            return False
+        self._prof_countdown -= 1
+        if self._prof_countdown > 0:
+            return False
+        self._prof_countdown = self._prof_n
+        return True
+
+    def _slo_attrs(self, req: Request) -> dict:
+        return {"adapter_id": req.sampling.adapter_id,
+                "priority": req.sampling.priority}
+
+    def _slo_ttft(self, req: Request, now: float):
+        """Feed the request's TTFT into the SLO windows (call sites
+        guard on self._slo; all three first-token paths route here)."""
+        self._slo.observe("ttft", now - req.t_submit,
+                          self._slo_attrs(req), now=now)
+
+    def _mark_first_token(self, req: Request, now: float):
+        """First-token bookkeeping shared by the dense/ragged/spec
+        prefill-final collection paths — first LIFE only: a
+        preemption-recompute re-entry is not a first token and must
+        not overwrite the true ttft_s or feed an inflated sample into
+        the SLO windows."""
+        if req.t_first_token is None:
+            req.t_first_token = now
+            if self._slo is not None:
+                self._slo_ttft(req, now)
+        req.t_last_emit = now
+
+    def _prof_record(self, kind: str, fn, host_s: float, queue_s: float,
+                     execute_s: float):
+        """Record one sampled dispatch attribution: host-schedule
+        (since the previous device call ended — admission + schedule
+        building), dispatch-queue (draining previously enqueued work)
+        and device-execute (this program's own wall), overall and per
+        program family."""
+        family = self.compile_watch.family_of(fn) \
+            or kind.split(":", 1)[-1]
+        m = self._profile_metrics()
+        m.histogram("profile.host_schedule_s").observe(max(0.0, host_s))
+        m.histogram("profile.dispatch_queue_s").observe(
+            max(0.0, queue_s))
+        m.histogram("profile.device_execute_s").observe(
+            max(0.0, execute_s))
+        m.histogram(f"profile.device_execute_s.{family}").observe(
+            max(0.0, execute_s))
+        self.profiled_dispatches += 1
+        if self.tracer is not None:
+            self.tracer.event(
+                "profile_sample", pid=self.replica_id, family=family,
+                kind=kind, host_s=round(host_s, 6),
+                queue_s=round(queue_s, 6),
+                execute_s=round(execute_s, 6))
 
     def _trace_running(self, req: Request, now: float):
         """Close the current life's prefill span at the prefilling →
@@ -1321,16 +1494,51 @@ class ServingEngine:
         step(). The chaos harness always injects BEFORE the underlying
         call, so injected faults are guaranteed retry-safe."""
         attempt = 0
+        dispatch = kind.startswith("dispatch:")
+        # sampled dispatch-time attribution (ISSUE 14): decided ONCE
+        # per logical call (not per retry) so the seeded cadence is
+        # schedule-stable; the fences run on the attempt that succeeds
+        prof = dispatch and self._prof_due()
         while True:
             try:
                 if self.chaos is not None:
                     self.chaos.before_call(self, kind)
+                if prof:
+                    tq0 = time.perf_counter()
+                    host_s = tq0 - self._prof_mark
+                    prev = (self._inflight[-1]["toks"]
+                            if self._inflight else None)
+                    if prev is not None:
+                        # drain the device queue so the post-dispatch
+                        # fence times THIS program, not its backlog —
+                        # the sampled profiling mode's designed sync
+                        jax.block_until_ready(prev)  # flightcheck: disable=FC301
+                    tq1 = time.perf_counter()
+                t0 = time.perf_counter() if dispatch else 0.0
                 out = fn(*args)
-                if kind.startswith("dispatch:"):
+                t1 = time.perf_counter() if dispatch else 0.0
+                if prof:
+                    # the sampled fence: device-execute wall of this
+                    # program alone (queue drained above). Values are
+                    # unchanged — block_until_ready never rewrites —
+                    # so tokens stay bitwise identical, sampled or not
+                    jax.block_until_ready(out)  # flightcheck: disable=FC301
+                    self._prof_record(kind, fn, host_s, tq1 - tq0,
+                                      time.perf_counter() - t1)
+                    prof = False
+                if dispatch:
+                    n_new, n_unexp = self.compile_watch.observe(
+                        fn, t0, t1, args)
+                    if n_new:
+                        self.program_compiles += n_new
+                    if n_unexp:
+                        self.unexpected_recompiles += n_unexp
                     # every successful device-program launch (prefill /
                     # decode / merge / ragged) — the denominator of
                     # stats()["tokens_per_dispatch"]
                     self.device_dispatches += 1
+                if self._prof_n:
+                    self._prof_mark = time.perf_counter()
                 return out
             except KVCacheExhausted:
                 raise
@@ -2276,8 +2484,7 @@ class ServingEngine:
             req.state = "running"
             if self.tracer is not None:
                 self._trace_running(req, now)
-            req.t_first_token = now
-            req.t_last_emit = now
+            self._mark_first_token(req, now)
             req.out_tokens.append(tok)
             req.planned = 1
             self.generated_tokens += 1
@@ -2450,11 +2657,19 @@ class ServingEngine:
         """Commit a cached device constant consistently with the
         engine's mesh: replicated over the tp mesh under tensor
         parallelism (a default-device-committed constant would clash
-        with the tp-mesh program), as-is otherwise."""
+        with the tp-mesh program), as-is otherwise. The spec is
+        spelled DIMENSION-WISE (P(None, ..., None), not P()) to match
+        the sharding the tp programs' own outputs carry: jit caches on
+        the spelling, so a carried operand that alternates between a
+        P() constant (first dispatch after idle) and a program output
+        (every later dispatch) would trace+compile each (T, W) shape
+        TWICE — a silent 2x compile tax CompileWatch caught on the
+        sealed tp chaos leg (ISSUE 14)."""
         if self.tp == 1:
             return arr
         from jax.sharding import NamedSharding, PartitionSpec as P
-        return jax.device_put(arr, NamedSharding(self.dec.mesh, P()))
+        return jax.device_put(
+            arr, NamedSharding(self.dec.mesh, P(*(None,) * arr.ndim)))
 
     def _warmup_prompt(self, n: int) -> np.ndarray:
         """Throwaway warmup prompt with a per-call token fill: two
@@ -2783,7 +2998,7 @@ class ServingEngine:
         # _dispatch_ragged keeps issuing pure-prefill chunks until the
         # backlog is gone
         budget = self._ragged_cap if dcols \
-            else max(self._ragged_cap, self._RAGGED_IDLE_CAP)
+            else max(self._ragged_cap, self._ragged_idle_cap)
         pending = sorted((r for r in self._slots
                           if r is not None and r.state == "prefilling"
                           and r.prefill_sent < r.suffix_len),
@@ -3599,8 +3814,7 @@ class ServingEngine:
             req.state = "running"
             if self.tracer is not None:
                 self._trace_running(req, now)
-            req.t_first_token = now
-            req.t_last_emit = now
+            self._mark_first_token(req, now)
             req.out_tokens.append(tok)
             req.planned = 1
             self.generated_tokens += 1
@@ -3664,6 +3878,12 @@ class ServingEngine:
                 m += 1
             self.drafted_tokens += k
             self.accepted_draft_tokens += m
+            if k:
+                # per-window acceptance EMA (alpha 0.1): the adaptive-
+                # window signal (ROADMAP 2), sampled into the
+                # acceptance_ema counter track each step
+                self.draft_acceptance_ema += 0.1 * (
+                    m / k - self.draft_acceptance_ema)
             if m < k:
                 self.spec_rollbacks += 1
             if self.tracer is not None and k:
@@ -3711,8 +3931,7 @@ class ServingEngine:
             req.state = "running"
             if self.tracer is not None:
                 self._trace_running(req, now)
-            req.t_first_token = now
-            req.t_last_emit = now
+            self._mark_first_token(req, now)
             req.out_tokens.append(tok)
             req.planned = 1
             self.generated_tokens += 1
@@ -3737,6 +3956,11 @@ class ServingEngine:
             if self.tracer is not None:
                 self.tracer.metrics.histogram(
                     "engine.itl_s").observe(itl, n=delivered)
+            if self._slo is not None:
+                # one weighted append per chunk — the SLO windows see
+                # every delivered token without a per-token append
+                self._slo.observe("itl", itl, self._slo_attrs(req),
+                                  n=delivered, now=now)
         req.t_last_emit = now
 
     def _collect_oldest(self):
@@ -3911,6 +4135,11 @@ class ServingEngine:
                 self._collect_prefill_run(n)
             else:
                 self._collect_oldest()
+        if self.tracer is not None:
+            # counter tracks (ISSUE 14): sample the scheduler gauges
+            # into the trace every step so Perfetto renders resource
+            # timelines next to the request spans
+            self._sample_counter_tracks()
         if self._debug_pool:
             # PADDLE_TPU_POOL_DEBUG=1: assert the pool invariant
             # (free + cached + referenced == num_blocks, refs == table
@@ -3924,18 +4153,45 @@ class ServingEngine:
                 self._debug_lora_check()
         return self.has_work
 
+    def _sample_counter_tracks(self):
+        """One sample per scheduler gauge per step (tracer attached):
+        exported as Perfetto ``ph:"C"`` counter events, latest values
+        mirrored as ``track.*`` registry gauges. Host scheduler state
+        only — no device read, no schedule change."""
+        tr = self.tracer
+        pid = self.replica_id
+        cache = self.dec.cache
+        tr.counter("running_slots",
+                   sum(1 for r in self._slots
+                       if r is not None and r.state == "running"), pid)
+        tr.counter("queue_depth", len(self._queue), pid)
+        tr.counter("inflight_chunks", len(self._inflight), pid)
+        tr.counter("free_blocks", cache.free_blocks, pid)
+        tr.counter("cached_blocks", cache.cached_blocks, pid)
+        if self.spec is not None:
+            tr.counter("acceptance_ema", self.draft_acceptance_ema,
+                       pid)
+        if self.lora is not None:
+            tr.counter("active_adapters", self.lora.active_count(),
+                       pid)
+
     def run_to_completion(self) -> Dict[int, np.ndarray]:
         """Drain the queue; returns {req_id: generated tokens}."""
         while self.step():
             pass
         return {rid: self.result(rid) for rid in list(self._done)}
 
-    def warmup(self, prompt_len: Optional[int] = None):
+    def warmup(self, prompt_len: Optional[int] = None,
+               seal_programs: bool = False):
         """Pre-compile the serving programs — BOTH prefill widths for
         every bucket (or just prompt_len's bucket when given), the
         prefix-cache HIT prefill for every hit-reachable suffix bucket,
         plus the decode chunk — with throwaway requests, so no user
-        request pays a compile. Prompts longer than prefill_chunk run
+        request pays a compile. ``seal_programs=True`` additionally
+        compiles the full reachable program grid (warmup_programs) and
+        SEALS the set, so any later retrace counts as
+        unexpected_recompiles (bound ragged_idle_cap first on ragged
+        engines, or the grid is large). Prompts longer than prefill_chunk run
         the CHUNKED path (exactly as production traffic at that length
         will), compiling the no-sample chunk programs and the
         remainder-bucket finals instead of the monolithic full-length
@@ -4111,7 +4367,230 @@ class ServingEngine:
         # spliced by a real request with the same fill pattern) —
         # clear_prefix_cache also evicts warmup's parked adapter pages
         cache.clear_prefix_cache()
+        if seal_programs:
+            # close the remaining grid (rungs/widths the throwaway
+            # traffic didn't reach) and declare the set sealed — from
+            # here a mid-serving retrace is a counted, assertable bug
+            self.warmup_programs()
+            self.seal_programs()
         self.clear_finished()
+
+    # -- program observatory: grid warmup + sealing (ISSUE 14) ---------------
+    def reachable_ragged_widths(self, T: int,
+                                max_width: Optional[int] = None
+                                ) -> List[int]:
+        """The W rungs a T-ministep ragged program can be dispatched
+        at, derived from engine config: mixed-regime chunks carry at
+        most max_b decode columns plus ceil(prefill_budget / T)
+        prefill columns; pure-prefill chunks widen to the idle cap.
+        Sticky-shrink only ever pads to a previously-reached width at
+        the same T, so this set is CLOSED — compiling it whole is what
+        makes seal_programs assertable."""
+        cap = self._ragged_cap
+        idle = max(cap, self._ragged_idle_cap)
+        rows = max(self.max_b + -(-cap // T), -(-idle // T))
+        return self._widths_up_to(rows, max_width)
+
+    def _widths_up_to(self, rows: int,
+                      max_width: Optional[int] = None) -> List[int]:
+        """W rungs (the static ladder, then 64-multiples) reachable up
+        to the padded width of ``rows`` — shared by the ragged and spec
+        grids so the ladder/rounding rule can never drift between them
+        (a one-sided change would make warmup_programs' grids disagree
+        and seed sealed-set false positives)."""
+        if max_width is not None:
+            rows = min(rows, int(max_width))
+        bound = self._ragged_width(rows)
+        widths = [w for w in self.RAGGED_WIDTHS if w <= bound]
+        w = (widths[-1] if widths else 0) + 64
+        w -= w % 64
+        while w <= bound:           # past-ladder 64-multiples
+            widths.append(w)
+            w += 64
+        return widths
+
+    def _spec_widths(self, max_width: Optional[int] = None
+                     ) -> List[int]:
+        """Reachable W rungs of the one-ministep speculative verify
+        program: every running column fans out to 1 + draft_len rows,
+        prefill rows fill what is left of the per-step budget."""
+        rows = self.max_b * (1 + self.spec.draft_len) + self._ragged_cap
+        return self._widths_up_to(rows, max_width)
+
+    def warmup_programs(self, max_width: Optional[int] = None):
+        """Compile the reachable serving-program grid by DIRECT
+        program invocation — dummy operands aimed entirely at the
+        scratch page/row, so no scheduler state changes, no pool block
+        is claimed, and (unlike traffic-driven warmup) NO engine PRNG
+        key is consumed: a warmed engine serves token-identical to an
+        unwarmed one, stochastic sampling included. Every call routes
+        through CompileWatch.observe, so the compiles land in the
+        trace as compile spans; afterwards seal_programs() can declare
+        the set closed. ``max_width`` clamps the ragged W rungs (tests
+        use it to leave a rung cold on purpose)."""
+        cache = self.dec.cache
+        weights = self.dec.weights
+        mb, mp, vocab = self.max_b, self.dec.max_pages, \
+            self.dec.cfg.vocab_size
+        aj = self._aj
+        key1 = self._replicated(jax.random.PRNGKey(0))
+
+        def obs(fn, *args):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            n_new, n_unexp = self.compile_watch.observe(
+                fn, t0, time.perf_counter(), args)
+            self.program_compiles += n_new
+            self.unexpected_recompiles += n_unexp
+            return out
+
+        if not self.ragged:
+            # dense per-phase programs: final prefill (plain + prefix
+            # splice) per (bucket, width), the no-sample mid-chunk
+            # ladder, the decode chunk rungs (+ rich twins) and the
+            # overlap merge
+            widths = sorted({1, min(self.PREFILL_GROUP, self.max_b)})
+            for b in self.buckets:
+                for w in widths:
+                    ids = aj(np.zeros((w, b), np.int32))
+                    slots = aj(np.full((w, b), self._scratch_slot,
+                                       np.int32))
+                    last_idx = aj(np.zeros(w, np.int32))
+                    temps = aj(np.zeros(w, np.float32))
+                    tks = aj(np.zeros(w, np.int32))
+                    tps = aj(np.ones(w, np.float32))
+                    reps = aj(np.ones(w, np.float32))
+                    seen = self._zeros_seen(w, vocab)
+                    allowed = self._ones_allowed(w, vocab)
+                    _, cache.k, cache.v = obs(
+                        self._prefill_j, weights, cache.k, cache.v,
+                        ids, slots, last_idx, temps, key1, tks, tps,
+                        reps, seen, allowed)
+                    ncv = aj(np.zeros(w, np.int32))
+                    ptab = aj(np.full((w, self._prefix_pages),
+                                      self._scratch_block, np.int32))
+                    _, cache.k, cache.v = obs(
+                        self._prefill_prefix_j, weights, cache.k,
+                        cache.v, ids, slots, last_idx, ncv, ptab,
+                        temps, key1, tks, tps, reps, seen, allowed)
+            if self._can_recompute:
+                c = self.prefill_chunk or self._recompute_chunk
+                ids1 = aj(np.zeros((1, c), np.int32))
+                slots1 = aj(np.full((1, c), self._scratch_slot,
+                                    np.int32))
+                cache.k, cache.v = obs(self._prefill_mid0_j, weights,
+                                       cache.k, cache.v, ids1, slots1)
+                for pb in self._prefix_page_buckets:
+                    ptab = aj(np.full((1, pb), self._scratch_block,
+                                      np.int32))
+                    cache.k, cache.v = obs(
+                        self._prefill_mid_j, weights, cache.k, cache.v,
+                        ids1, slots1, aj(np.asarray([1], np.int32)),
+                        ptab)
+            for T in self.chunks:
+                first = aj(np.zeros(mb, np.int32))
+                tables = aj(np.full((T, mb, mp), self._scratch_block,
+                                    np.int32))
+                ctx = aj(np.zeros((T, mb), np.int32))
+                slots = aj(np.full((T, mb), self._scratch_slot,
+                                   np.int32))
+                temps = aj(np.zeros(mb, np.float32))
+                keys = jax.random.split(jax.random.PRNGKey(0), T)
+                toks, cache.k, cache.v = obs(
+                    self._decode_j, weights, cache.k, cache.v, first,
+                    tables, ctx, slots, temps, keys)
+                obs(self._merge_first_j, toks, aj(np.zeros(mb,
+                    np.int32)), aj(np.zeros(mb, np.int32)),
+                    aj(np.ones(mb, bool)))
+                _, cache.k, cache.v = obs(
+                    self._decode_rich_j, weights, cache.k, cache.v,
+                    first, tables, ctx, slots, temps, keys,
+                    aj(np.zeros(mb, np.int32)),
+                    aj(np.ones(mb, np.float32)),
+                    aj(np.ones(mb, np.float32)),
+                    self._zeros_seen(mb, vocab),
+                    self._ones_allowed(mb, vocab))
+            return
+
+        # ragged grid: every (T, W) variant of the unified chunk (+
+        # rich and lora twins where configured), then the spec verify
+        # widths. All rows are scratch rows (rctx 0), exactly the
+        # schedule shape an all-neutralized production chunk ships.
+        scratch_row = mb
+        lora_pre = ()
+        if self.lora is not None:
+            lora_pre = (cache.lora_pool, self._shard_ids,
+                        aj(np.full((mb + 1, self.lora.n_pages()),
+                                   self._scratch_block, np.int32)))
+        for T in sorted(set(list(self.chunks) + [1])):
+            for W in self.reachable_ragged_widths(T, max_width):
+                z2 = np.zeros((T, W), np.int32)
+                ids = aj(z2)
+                pos = aj(z2)
+                slots = aj(np.full((T, W), self._scratch_slot,
+                                   np.int32))
+                rseq = aj(np.full((T, W), scratch_row, np.int32))
+                rctx = aj(z2)
+                ucar = aj(np.zeros((T, W), bool))
+                temps = aj(np.zeros((T, W), np.float32))
+                tables = aj(np.full((mb + 1, mp), self._scratch_block,
+                                    np.int32))
+                last_t = aj(np.zeros(W, np.int32))
+                prev_col = aj(np.zeros(W, np.int32))
+                use_host = aj(np.ones(W, bool))
+                override = aj(np.zeros(W, np.int32))
+                keys = self._replicated(
+                    jax.random.split(jax.random.PRNGKey(0), T))
+                prev = self._zeros_toks(T, W)
+                tail = (prev, last_t, prev_col, use_host, override,
+                        ids, pos, slots, rseq, rctx, ucar, tables,
+                        temps, keys)
+                _, cache.k, cache.v = obs(
+                    self._ragged_j, weights, cache.k, cache.v, *tail)
+                rich_tail = (aj(np.zeros((T, W), np.int32)),
+                             aj(np.ones((T, W), np.float32)),
+                             aj(np.ones((T, W), np.float32)),
+                             self._zeros_seen(W, vocab),
+                             aj(np.zeros(W, bool)),
+                             self._ones_allowed(W, vocab))
+                _, cache.k, cache.v = obs(
+                    self._ragged_rich_j, weights, cache.k, cache.v,
+                    *tail, *rich_tail)
+                if self.lora is not None:
+                    _, cache.k, cache.v = obs(
+                        self._ragged_lora_j, weights, cache.k,
+                        cache.v, *lora_pre, *tail)
+                    _, cache.k, cache.v = obs(
+                        self._ragged_lora_rich_j, weights, cache.k,
+                        cache.v, *lora_pre, *tail, *rich_tail)
+        if self.spec is not None:
+            for W in self._spec_widths(max_width):
+                z1 = np.zeros(W, np.int32)
+                spec_tail = (
+                    aj(z1), aj(np.zeros(W, bool)), aj(z1), aj(z1),
+                    aj(np.full(W, self._scratch_slot, np.int32)),
+                    aj(np.full(W, scratch_row, np.int32)), aj(z1),
+                    aj(np.full((mb + 1, mp), self._scratch_block,
+                               np.int32)),
+                    aj(np.zeros(W, np.float32)), key1,
+                    aj(np.arange(W, dtype=np.int32)),
+                    aj(np.zeros(W, bool)))
+                _, _, cache.k, cache.v = obs(
+                    self._spec_j, weights, cache.k, cache.v,
+                    *spec_tail)
+                if self.lora is not None:
+                    _, _, cache.k, cache.v = obs(
+                        self._spec_lora_j, weights, cache.k, cache.v,
+                        *lora_pre, *spec_tail)
+
+    def seal_programs(self):
+        """Declare the compiled program set COMPLETE (call after
+        warmup_programs, or after a steady-state lap whose program set
+        is the production one): from here on, any compile observed by
+        the watch increments stats()["unexpected_recompiles"] and
+        fires an ``unexpected_recompile`` tracer event — the runtime
+        FC2xx. Chaos legs and bench.py serving_trace assert zero."""
+        self.compile_watch.seal()
 
     def clear_finished(self):
         """Drop finished requests + counters (e.g. after warmup) so
@@ -4145,6 +4624,18 @@ class ServingEngine:
         self.lora_dispatches = 0
         self.lora_rows = 0
         self.masked_decode_columns = 0
+        # program-observatory counters (ISSUE 14): the engine-side
+        # view resets with every other counter family; the
+        # CompileWatch's own cumulative ledger (and its sealed flag)
+        # survives — the program set is an engine property, not a
+        # workload one
+        self.unexpected_recompiles = 0
+        self.program_compiles = 0
+        self.profiled_dispatches = 0
+        self.draft_acceptance_ema = 0.0
+        if self._slo is not None:
+            self._slo.reset()
+        self._slo_violating.clear()
         # the memo keys masks by object identity; retained requests
         # (and their masks) are dropped here, so the memo must go too
         # (a recycled id must never alias a dead request's operand)
@@ -4307,7 +4798,54 @@ class ServingEngine:
             "kv_quant": self.kv_quant or cache.pool_dtype,
             "kv_pool_bytes": cache.pool_bytes(),
             "kv_bytes_per_token": cache.bytes_per_token(),
+            # -- program observatory (ISSUE 14) -----------------------
+            # program_compiles: trace+lower+compile events the watch
+            # observed (warmup's grid lands here); unexpected_
+            # recompiles: compiles AFTER seal_programs() — the runtime
+            # FC2xx, asserted zero by chaos legs and the bench;
+            # profiled_dispatches: sampled-attribution fences taken;
+            # draft_acceptance_ema: the per-window acceptance EMA the
+            # acceptance_ema counter track samples (adaptive-window
+            # signal for ROADMAP 2)
+            "program_compiles": self.program_compiles,
+            "unexpected_recompiles": self.unexpected_recompiles,
+            "programs_sealed": self.compile_watch.sealed,
+            "profiled_dispatches": self.profiled_dispatches,
+            "draft_acceptance_ema": float(self.draft_acceptance_ema),
         }
+        if self._slo is not None:
+            # declared-SLO evaluation over the sliding windows: per
+            # policy/metric burn rates + headroom (telemetry.
+            # SLOMonitor.evaluate); the fleet Router rolls the
+            # per-replica headrooms up for SLO-aware routing. The
+            # nested dict rides stats() only; the scalar
+            # slo_min_headroom mirrors into the registry like every
+            # other float
+            slo = self._slo.evaluate()
+            out["slo"] = slo
+            out["slo_min_headroom"] = float(slo["min_headroom"])
+            if self.tracer is not None:
+                for pname, pol in slo["policies"].items():
+                    if pol["violating"] and \
+                            pname not in self._slo_violating:
+                        self.tracer.event(
+                            "slo_violation", pid=self.replica_id,
+                            policy=pname, headroom=pol["headroom"])
+                self._slo_violating = {
+                    pname for pname, pol in slo["policies"].items()
+                    if pol["violating"]}
+                flat = {}
+                for pname, pol in slo["policies"].items():
+                    flat[f"{pname}.headroom"] = float(pol["headroom"])
+                    for metric, md in pol["metrics"].items():
+                        for wname, wd in md["windows"].items():
+                            if wd["burn_rate"] is not None:
+                                flat[f"{pname}.{metric}."
+                                     f"burn_{wname}"] = \
+                                    float(wd["burn_rate"])
+                prefix = ("slo" if self.replica_id == 0
+                          else f"slo.r{self.replica_id}")
+                self.tracer.metrics.publish(prefix, flat)
         if self.tracer is not None:
             # the unified metrics registry mirrors this dict (ints ->
             # counters, floats -> gauges), so the stats() view and the

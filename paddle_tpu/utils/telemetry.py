@@ -31,6 +31,40 @@ serving subsystem shares:
   Router.stats() (exact below capacity, p50/p99-within-tolerance
   above it).
 
+The program observatory (ISSUE 14) adds the PROGRAM-level half — the
+requests were observable, the compiled programs the engine lives on
+were not:
+
+- ``CompileWatch``: the runtime twin of flightcheck's static FC2xx
+  recompilation rules. Every serving program family registers its
+  jitted callable; after each dispatch the engine asks the watch to
+  compare the jit cache size against its ledger — growth IS a
+  trace+lower+compile, recorded as an explicit ``compile`` span in the
+  trace (family, operand-shape signature, wall; XLA
+  ``cost_analysis()``/``memory_analysis()`` flops/bytes when
+  ``analyze=True`` and the jax version exposes them) and counted in
+  the registry. ``seal()`` declares the program set complete (after
+  warmup): ANY later compile increments ``unexpected_recompiles`` and
+  fires an ``unexpected_recompile`` event carrying the offending
+  signature — a silent mid-serving XLA retrace stops being an
+  unexplained ITL spike and becomes an assertable gate failure.
+  Detection reads only the jit cache size (two host attribute reads
+  per dispatch), so the steady state pays nothing.
+- counter tracks: ``Tracer.counter(name, value, pid)`` records gauge
+  samples that export as Perfetto ``ph: "C"`` counter events, so
+  resource timelines (running slots, free/cached blocks, queue depth,
+  in-flight chunks, acceptance EMA, per-replica load) render next to
+  the request spans.
+- ``SLOPolicy`` / ``SLOMonitor``: declared per-class latency targets
+  (ttft/itl pXX) evaluated over multi-duration sliding windows with
+  SRE-style burn rates (observed violation fraction over the allowed
+  error budget); surfaced through ``stats()["slo"]`` and the Router's
+  per-replica headroom rollup — the input SLO-aware routing needs.
+- ``MetricsRegistry.to_openmetrics()`` / ``openmetrics_text()``: a
+  jax-free OpenMetrics/Prometheus text exporter over the registry
+  snapshot (``tools/metrics_export.py`` runs it standalone over an
+  exported trace).
+
 Overhead contract: ``tracer=None`` (the default everywhere) is a
 BITWISE no-op — every hook is behind an ``if tracer is not None``
 guard, no PRNG key is drawn, no device call is made, no schedule array
@@ -58,12 +92,14 @@ import threading
 import time
 from bisect import bisect_right
 from collections import deque
-from typing import Dict, List, Optional, Sequence
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-__all__ = ["Tracer", "MetricsRegistry", "Reservoir", "FLEET_PID",
-           "DEFAULT_TIME_BUCKETS_S"]
+__all__ = ["Tracer", "MetricsRegistry", "Reservoir", "CompileWatch",
+           "SLOPolicy", "SLOMonitor", "FLEET_PID",
+           "DEFAULT_TIME_BUCKETS_S", "openmetrics_text"]
 
 # the pid Chrome-trace track fleet-level records render on (routing,
 # breaker transitions, migration, request async spans); engine records
@@ -240,6 +276,423 @@ class MetricsRegistry:
                     "histograms": {k: h.snapshot()
                                    for k, h in self.histograms.items()}}
 
+    def to_openmetrics(self) -> str:
+        """The registry as OpenMetrics/Prometheus text (counters with
+        the ``_total`` suffix, gauges, cumulative-bucket histograms,
+        terminated by ``# EOF``). Pure host formatting — scrapeable by
+        any Prometheus-compatible collector; ``tools/metrics_export.py``
+        runs the same formatter over an exported trace's snapshot."""
+        return openmetrics_text(self.snapshot())
+
+
+def _om_name(name: str) -> str:
+    """Sanitize a dotted registry name into the OpenMetrics charset
+    ([a-zA-Z0-9_:], non-digit first)."""
+    s = "".join(ch if (ch.isalnum() and ch.isascii()) or ch in "_:"
+                else "_" for ch in str(name))
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
+
+
+def _om_num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".10g")
+
+
+def openmetrics_text(snapshot: dict) -> str:
+    """Format a ``MetricsRegistry.snapshot()`` dict as OpenMetrics /
+    Prometheus text exposition. jax-free on purpose: the exporter must
+    run anywhere the snapshot JSON does (a metrics sidecar, a laptop
+    reading a trace artifact — see tools/metrics_export.py)."""
+    lines: List[str] = []
+    for name, v in sorted((snapshot.get("counters") or {}).items()):
+        n = _om_name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n}_total {_om_num(v)}")
+    for name, v in sorted((snapshot.get("gauges") or {}).items()):
+        n = _om_name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_om_num(v)}")
+    for name, h in sorted((snapshot.get("histograms") or {}).items()):
+        n = _om_name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        counts = list(h.get("counts", ()))
+        buckets = list(h.get("buckets", ()))
+        for b, c in zip(buckets, counts):
+            cum += int(c)
+            lines.append(f'{n}_bucket{{le="{_om_num(b)}"}} {cum}')
+        if counts:
+            cum += int(counts[-1])        # the overflow slot
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {_om_num(h.get('sum', 0.0))}")
+        lines.append(f"{n}_count {int(h.get('n', 0))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+class CompileWatch:
+    """Per-program-family compile ledger + sealed-set retrace sentinel
+    (ISSUE 14) — the runtime twin of flightcheck's static FC2xx rules.
+
+    The engine registers every jitted serving program family
+    (``register``), then calls ``observe(fn, t0, t1, args)`` after each
+    dispatch. Detection is the jit cache size: growth since the last
+    observation means that call TRACED+LOWERED+COMPILED (the call wall
+    is the compile wall, execution being async), independent of any
+    host-side model of what should retrace — a weak-type flip, a dtype
+    drift or an unstable cache key is caught exactly like a new shape.
+    The offending operand-shape signature is derived lazily (compiles
+    only), so the steady state pays two host attribute reads.
+
+    ``seal()`` declares the program set complete — warmup's contract.
+    Any compile observed after sealing increments
+    ``unexpected_recompiles`` and fires an ``unexpected_recompile``
+    tracer event with the signature; chaos legs and the serving bench
+    assert the counter stays zero.
+
+    jax-free by duck typing: the jitted callable just needs
+    ``_cache_size()`` (and ``lower()`` for the opt-in ``analyze``
+    mode); a callable without it simply isn't watched."""
+
+    MAX_RECORDS = 512
+
+    def __init__(self, tracer: Optional["Tracer"] = None,
+                 analyze: bool = False):
+        self.tracer = tracer
+        self.metrics = (tracer.metrics if tracer is not None
+                        else MetricsRegistry())
+        # analyze=True: on the FIRST observed compile of each family,
+        # re-lower abstractly and pull XLA cost/memory analysis
+        # (flops / bytes accessed / temp+output bytes) into the compile
+        # record. Costs one extra trace+lower+compile per family —
+        # off by default so traced production runs keep the <5%
+        # overhead contract; tests and one-off investigations opt in.
+        self.analyze = bool(analyze)
+        self.pid = 0
+        self.sealed = False
+        self.compiles = 0
+        self.unexpected_recompiles = 0
+        self.records: List[dict] = []
+        self._families: Dict[str, dict] = {}
+        self._by_id: Dict[int, str] = {}
+
+    def bind(self, tracer: Optional["Tracer"], pid: int = 0):
+        """(Re)attach the tracer/registry sink and the replica pid —
+        called by ServingEngine.set_telemetry."""
+        self.tracer = tracer
+        if tracer is not None:
+            self.metrics = tracer.metrics
+        self.pid = int(pid)
+
+    @staticmethod
+    def _size(jfn) -> int:
+        try:
+            return int(jfn._cache_size())
+        except Exception:       # noqa: BLE001 — unwatchable callable
+            return -1
+
+    def register(self, family: str, jfn, **info):
+        """Track one jitted program family. ``info`` (decoder build
+        fingerprint, tp degree, ...) rides every compile record."""
+        self._families[family] = {"fn": jfn, "size": self._size(jfn),
+                                  "info": dict(info), "analyzed": False}
+        self._by_id[id(jfn)] = family
+
+    def family_of(self, fn) -> Optional[str]:
+        return self._by_id.get(id(fn))
+
+    @property
+    def families(self) -> List[str]:
+        return list(self._families)
+
+    @staticmethod
+    def signature_of(args, skip: int = 3, limit: int = 200) -> str:
+        """Compact dtype[shape] signature of the VARYING operands —
+        the first ``skip`` args (weights, k, v by the engine's calling
+        convention) are engine-static and elided."""
+        parts: List[str] = []
+
+        def walk(x):
+            if isinstance(x, (tuple, list)):
+                for y in x:
+                    walk(y)
+            elif isinstance(x, dict):
+                for k in sorted(x):
+                    walk(x[k])
+            elif hasattr(x, "shape") and hasattr(x, "dtype"):
+                shape = "x".join(str(int(d)) for d in x.shape)
+                dt = np.dtype(x.dtype).str.lstrip("<>|=")
+                parts.append(f"{dt}[{shape}]")
+
+        for a in list(args)[skip:]:
+            walk(a)
+        sig = ",".join(parts)
+        return sig if len(sig) <= limit else sig[:limit] + "..."
+
+    def _analyze(self, fn, args) -> dict:
+        """Best-effort AOT lower/compile for XLA cost+memory analysis.
+        Duck-typed and fully guarded: a jax version (or a sharded
+        program) that refuses any step just yields fewer fields."""
+        out: Dict[str, float] = {}
+        try:
+            t0 = time.perf_counter()
+            lowered = fn.lower(*args)
+            out["lower_s"] = time.perf_counter() - t0
+        except Exception:       # noqa: BLE001 — best-effort contract
+            return out
+        try:
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else None
+            if isinstance(ca, dict):
+                if "flops" in ca:
+                    out["flops"] = float(ca["flops"])
+                if "bytes accessed" in ca:
+                    out["bytes_accessed"] = float(ca["bytes accessed"])
+        except Exception:       # noqa: BLE001
+            pass
+        try:
+            t0 = time.perf_counter()
+            compiled = lowered.compile()
+            out["compile_s"] = time.perf_counter() - t0
+            ma = compiled.memory_analysis()
+            out["temp_bytes"] = float(
+                getattr(ma, "temp_size_in_bytes", 0))
+            out["output_bytes"] = float(
+                getattr(ma, "output_size_in_bytes", 0))
+        except Exception:       # noqa: BLE001
+            pass
+        return out
+
+    def observe(self, fn, t0: float, t1: float, args=()
+                ) -> "tuple[int, int]":
+        """Post-dispatch check: did this call grow ``fn``'s jit cache?
+        Returns (new_compiles, unexpected_compiles). A cache that
+        SHRANK (jax.clear_caches between bench suites) just resyncs."""
+        name = self._by_id.get(id(fn))
+        if name is None:
+            return 0, 0
+        fam = self._families[name]
+        if fam["size"] < 0:
+            return 0, 0
+        cur = self._size(fn)
+        if cur < 0:
+            fam["size"] = -1
+            return 0, 0
+        prev = fam["size"]
+        fam["size"] = cur
+        if cur <= prev:
+            return 0, 0
+        n = cur - prev
+        wall = max(0.0, float(t1) - float(t0))
+        rec = {"family": name, "signature": self.signature_of(args),
+               "wall_s": wall, "sealed": self.sealed}
+        rec.update(fam["info"])
+        if self.analyze and not fam["analyzed"]:
+            fam["analyzed"] = True
+            rec.update(self._analyze(fn, args))
+        self.compiles += n
+        if len(self.records) < self.MAX_RECORDS:
+            self.records.append(rec)
+        m = self.metrics
+        m.inc("compile.total", n)
+        m.inc(f"compile.{name}")
+        m.histogram("compile.wall_s").observe(wall)
+        if "flops" in rec:
+            m.set_gauge(f"compile.{name}.flops", rec["flops"])
+        if "bytes_accessed" in rec:
+            m.set_gauge(f"compile.{name}.bytes_accessed",
+                        rec["bytes_accessed"])
+        if self.tracer is not None:
+            attrs = {k: v for k, v in rec.items() if k != "wall_s"}
+            self.tracer.span("compile", None, t0, t1, pid=self.pid,
+                             **attrs)
+        unexpected = n if self.sealed else 0
+        if unexpected:
+            self.unexpected_recompiles += unexpected
+            m.inc("compile.unexpected", unexpected)
+            if self.tracer is not None:
+                self.tracer.event("unexpected_recompile", pid=self.pid,
+                                  family=name, signature=rec["signature"])
+        return n, unexpected
+
+    def seal(self):
+        """Declare the program set complete: resync every family's
+        cache size, then flag every later compile as unexpected (the
+        runtime FC2xx — asserted zero by chaos legs and the bench)."""
+        for fam in self._families.values():
+            if fam["size"] >= 0:
+                fam["size"] = self._size(fam["fn"])
+        self.sealed = True
+        self.metrics.set_gauge("compile.sealed", 1.0)
+        if self.tracer is not None:
+            self.tracer.event("programs_sealed", pid=self.pid,
+                              families=len(self._families))
+
+
+@dataclass
+class SLOPolicy:
+    """One declared latency objective over a traffic class: "p99 TTFT
+    under ``ttft_p99_s`` and p99 ITL under ``itl_p99_s`` for requests
+    matched by ``class_selector``" (None targets are unmonitored; a
+    None selector matches all traffic). ``class_selector`` receives a
+    small attrs dict ({"adapter_id": ..., "priority": ...}) so classes
+    can be cut by tenant or priority without the monitor knowing the
+    Request type."""
+    name: str
+    ttft_p99_s: Optional[float] = None
+    itl_p99_s: Optional[float] = None
+    class_selector: Optional[Callable[[dict], bool]] = None
+    quantile: float = 0.99
+
+
+class SLOMonitor:
+    """Sliding-window SLO evaluation with multi-window burn rates.
+
+    Samples arrive timestamped from the engine's collection paths
+    (``observe``; ttft once per request, itl per delivered token with a
+    count so a T-token chunk is one append). ``evaluate`` computes, per
+    policy and metric, the observed quantile plus the BURN RATE of each
+    window — (violating fraction) / (allowed fraction, 1 - quantile) —
+    the SRE error-budget form: burn 1.0 spends the budget exactly,
+    14.4x on a 1h window is the classic page threshold. A policy is
+    ``violating`` when both the shortest and longest populated windows
+    burn above 1.0 (the multi-window AND: a transient spike or a stale
+    long tail alone doesn't page). ``headroom`` is (target - pXX) /
+    target over the longest populated window, the per-replica scalar
+    the fleet Router rolls up for SLO-aware routing (1.0 = idle/no
+    data, negative = violating by that relative margin).
+
+    Deterministic and jax-free: tests drive it with synthetic
+    timestamps (``now=``); the engine feeds perf_counter."""
+
+    DEFAULT_WINDOWS_S = (60.0, 300.0, 1800.0)
+    METRICS = ("ttft", "itl")
+
+    def __init__(self, policies, windows_s: Optional[Sequence[float]]
+                 = None, max_samples: int = 4096):
+        if isinstance(policies, SLOPolicy):
+            policies = [policies]
+        self.policies: List[SLOPolicy] = list(policies)
+        names = [p.name for p in self.policies]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate SLO policy names: {names}")
+        self.windows = tuple(sorted(
+            float(w) for w in (windows_s or self.DEFAULT_WINDOWS_S)))
+        if not self.windows or any(w <= 0 for w in self.windows):
+            raise ValueError(f"windows_s must be positive: "
+                             f"{self.windows}")
+        self.max_samples = int(max_samples)
+        # (policy, metric) -> deque of (ts, value, count); bounded like
+        # the PR-12 reservoirs so unbounded runs stay O(k)
+        self._dq: Dict[tuple, deque] = {
+            (p.name, m): deque(maxlen=self.max_samples)
+            for p in self.policies for m in self.METRICS}
+
+    @staticmethod
+    def coerce_policies(slo) -> List[SLOPolicy]:
+        """Normalize the ``slo=`` constructor surface (None / one
+        policy / a monitor whose policies serve as the template / a
+        sequence of policies) into a plain policy list — shared by
+        ServingEngine and Router so the accepted forms can't drift."""
+        if slo is None:
+            return []
+        if isinstance(slo, SLOMonitor):
+            return list(slo.policies)
+        if isinstance(slo, SLOPolicy):
+            return [slo]
+        return list(slo)
+
+    @staticmethod
+    def _target(p: SLOPolicy, metric: str) -> Optional[float]:
+        return p.ttft_p99_s if metric == "ttft" else p.itl_p99_s
+
+    def observe(self, metric: str, value: float, attrs: Optional[dict]
+                = None, n: int = 1, now: Optional[float] = None):
+        if metric not in self.METRICS:
+            raise ValueError(f"metric must be one of {self.METRICS}, "
+                             f"got {metric!r}")
+        now = time.perf_counter() if now is None else float(now)
+        for p in self.policies:
+            if self._target(p, metric) is None:
+                continue
+            sel = p.class_selector
+            if sel is not None and not sel(attrs or {}):
+                continue
+            self._dq[(p.name, metric)].append(
+                (now, float(value), int(n)))
+
+    def evaluate(self, now: Optional[float] = None) -> dict:
+        now = time.perf_counter() if now is None else float(now)
+        policies: Dict[str, dict] = {}
+        any_viol = False
+        heads: List[float] = []
+        for p in self.policies:
+            metrics: Dict[str, dict] = {}
+            p_viol = False
+            p_heads: List[float] = []
+            for metric in self.METRICS:
+                target = self._target(p, metric)
+                if target is None:
+                    continue
+                samples = list(self._dq[(p.name, metric)])
+                allowed = max(1e-9, 1.0 - p.quantile)
+                wins: Dict[str, dict] = {}
+                burns: List[float] = []
+                for w in self.windows:
+                    vals = [(v, k) for ts, v, k in samples
+                            if now - ts <= w]
+                    nn = sum(k for _, k in vals)
+                    bad = sum(k for v, k in vals if v > target)
+                    burn = ((bad / nn) / allowed) if nn else None
+                    wins[f"{int(w)}s"] = {
+                        "n": nn, "violations": bad,
+                        "burn_rate": (round(burn, 4)
+                                      if burn is not None else None)}
+                    if nn:
+                        burns.append(burn)
+                pxx = None
+                longest = [(v, k) for ts, v, k in samples
+                           if now - ts <= self.windows[-1]]
+                if longest:
+                    arr = np.repeat([v for v, _ in longest],
+                                    [k for _, k in longest])
+                    pxx = float(np.quantile(arr, p.quantile))
+                viol = (len(burns) > 0 and burns[0] > 1.0
+                        and burns[-1] > 1.0)
+                head = (None if pxx is None
+                        else (target - pxx) / target)
+                metrics[metric] = {
+                    "target_s": target,
+                    "p_s": (round(pxx, 6) if pxx is not None else None),
+                    "windows": wins, "violating": viol,
+                    "headroom": (round(head, 4)
+                                 if head is not None else None)}
+                p_viol = p_viol or viol
+                if head is not None:
+                    p_heads.append(head)
+            head = min(p_heads) if p_heads else 1.0
+            policies[p.name] = {"metrics": metrics,
+                                "violating": p_viol,
+                                "headroom": round(head, 4)}
+            any_viol = any_viol or p_viol
+            heads.append(head)
+        return {"policies": policies, "violating": any_viol,
+                "min_headroom": (round(min(heads), 4)
+                                 if heads else 1.0)}
+
+    def reset(self):
+        """Drop every window (the clear_finished contract: post-warmup
+        stats reflect only real traffic)."""
+        for dq in self._dq.values():
+            dq.clear()
+
 
 class Tracer:
     """Flight recorder + span tracer. See the module docstring for the
@@ -354,6 +807,22 @@ class Tracer:
                       "args": attrs})
         self.metrics.inc(f"events.{name}")
 
+    def counter(self, name: str, value, pid: int = 0):
+        """One counter-track sample (ISSUE 14): exports as a Perfetto
+        ``ph: "C"`` event so the value renders as a resource TIMELINE
+        next to the request spans (running slots, free blocks, queue
+        depth, ...). The latest value also lands in the registry as a
+        ``track.*`` gauge (per-replica suffix off the pid), so the
+        OpenMetrics export carries the instantaneous view."""
+        v = float(value)
+        self._record({"kind": "counter", "name": name, "trace": None,
+                      "pid": int(pid), "ts": time.perf_counter(),
+                      "args": {"value": v}})
+        suffix = ("" if pid == 0
+                  else ".fleet" if pid == FLEET_PID
+                  else f".r{int(pid)}")
+        self.metrics.set_gauge(f"track.{name}{suffix}", v)
+
     # -- reading -------------------------------------------------------------
     def records(self) -> List[dict]:
         with self._lock:
@@ -411,6 +880,11 @@ class Tracer:
                              "name": r["name"], "pid": r["pid"],
                              "tid": tid, "ts": self._us(r["ts"]),
                              "dur": r["dur"] * 1e6,
+                             "args": r["args"]})
+            elif r["kind"] == "counter":
+                evts.append({"ph": "C", "cat": "track",
+                             "name": r["name"], "pid": r["pid"],
+                             "tid": 0, "ts": self._us(r["ts"]),
                              "args": r["args"]})
             else:
                 evts.append({"ph": "i", "cat": "step",

@@ -81,7 +81,15 @@ def _full_script(**overrides):
         "serving": [(_simple(
             "serving_bf16_c8_tok_per_sec", 289.0,
             {"serving_bf16_c8_tok_per_sec": 289.0,
-             "serving_capacity_decode_tok_per_sec": 3398.0}), "")],
+             "serving_capacity_decode_tok_per_sec": 3398.0,
+             # ISSUE 14: the serving_trace suite row re-pins its <5%
+             # bar with the program observatory riding the traced leg
+             # and asserts a sealed steady state — scripted same-PR
+             # (the PR-9 lesson, four times applied)
+             "serving_trace_overhead_frac": 0.012,
+             "serving_trace_unexpected_recompiles": 0,
+             "serving_trace_counter_samples": 2048,
+             "serving_trace_tokens_identical": True}), "")],
         # serving_tp joined AUTO_MODES in the ISSUE-8 PR but was never
         # scripted here, so every auto run "failed" the mode, burned a
         # recalibration, and broke the two call-count assertions below

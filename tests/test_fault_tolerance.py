@@ -576,13 +576,19 @@ class TestChaosSchedule:
                          "tools", "chaos_serving.py"))
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
-        args = mod.argparse.Namespace(
-            steps=120, requests=10, seed=1, num_blocks=14, retries=1,
-            p_oom=0.05, p_dispatch=0.05, p_collect=0.03, p_latency=0.0,
-            vocab=model.cfg.vocab_size)
-        base, _, _, _ = mod.run_schedule(model, args, chaotic=False)
-        chaos, eng, monkey, _ = mod.run_schedule(model, args,
-                                                 chaotic=True)
+        # defaults from the real CLI parser, so a new run_schedule
+        # knob can't silently strand this Namespace (it did once:
+        # args.dp landed in PR 11 and this test sat broken behind the
+        # slow marker until the next full sweep)
+        args = mod.build_parser().parse_args([])
+        args.steps, args.requests, args.seed = 120, 10, 1
+        args.num_blocks, args.retries = 14, 1
+        args.p_oom, args.p_dispatch = 0.05, 0.05
+        args.p_collect, args.p_latency = 0.03, 0.0
+        args.vocab = model.cfg.vocab_size
+        base, _, _, _, _ = mod.run_schedule(model, args, chaotic=False)
+        chaos, eng, monkey, _, _ = mod.run_schedule(model, args,
+                                                    chaotic=True)
         assert monkey.counts["dispatch_faults"] >= 1
         for ordinal, (state, toks, err) in chaos.items():
             if state == "done":

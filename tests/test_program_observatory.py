@@ -1,0 +1,731 @@
+"""Program observatory (ISSUE 14): CompileWatch sealed-set retrace
+detection, grid warmup + seal_programs, sampled dispatch-time
+attribution, SLO burn-rate math, the OpenMetrics exporter round-trip,
+counter tracks, fleet SLO headroom rollup, and stats()/registry parity
++ clear_finished reset for every new key. Runs in the invariant gate
+(check_serving_invariants.py) with PADDLE_TPU_POOL_DEBUG=1."""
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference import Router, SamplingParams, ServingEngine
+from paddle_tpu.utils.telemetry import (CompileWatch, MetricsRegistry,
+                                        SLOMonitor, SLOPolicy, Tracer,
+                                        openmetrics_text)
+
+CFG = llama_tiny(hidden_size=64, num_attention_heads=4,
+                 num_key_value_heads=2, intermediate_size=96,
+                 num_hidden_layers=2, vocab_size=256,
+                 max_position_embeddings=256)
+
+KW = dict(max_batch_size=3, num_blocks=24, block_size=8,
+          prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompt(n=12, seed=0):
+    return np.random.RandomState(seed).randint(
+        0, CFG.vocab_size, n).astype(np.int32)
+
+
+# -- CompileWatch units ------------------------------------------------------
+
+class TestCompileWatch:
+    def _observe(self, watch, fn, *args):
+        t0 = time.perf_counter()
+        fn(*args)
+        return watch.observe(fn, t0, time.perf_counter(), args)
+
+    def test_sealed_set_retrace_exactly_one_event(self):
+        """The runtime FC2xx contract: a fresh operand shape AFTER
+        seal() fires exactly one unexpected_recompile event carrying
+        the offending signature; re-dispatching the same shape is a
+        cache hit and fires nothing."""
+        tr = Tracer()
+        watch = CompileWatch(tr)
+        f = jax.jit(lambda w, k, v, x: x + 1)
+        watch.register("fam", f)
+        pre = (0, 0, 0)      # the engine-static skip=3 prefix
+        n, unexp = self._observe(watch, f, *pre, jnp.zeros(4))
+        assert (n, unexp) == (1, 0)       # pre-seal compile: expected
+        watch.seal()
+        n, unexp = self._observe(watch, f, *pre, jnp.zeros(4))
+        assert (n, unexp) == (0, 0)       # warm shape: no event
+        n, unexp = self._observe(watch, f, *pre,
+                                 jnp.zeros(8, np.float32))
+        assert (n, unexp) == (1, 1)       # the forced fresh rung
+        assert watch.unexpected_recompiles == 1
+        evts = [r for r in tr.records() if r["kind"] == "event"
+                and r["name"] == "unexpected_recompile"]
+        assert len(evts) == 1
+        assert evts[0]["args"]["family"] == "fam"
+        assert "f4[8]" in evts[0]["args"]["signature"]
+        # compile spans landed for BOTH compiles, flagged vs not
+        spans = [r for r in tr.records() if r["kind"] == "span"
+                 and r["name"] == "compile"]
+        assert [s["args"]["sealed"] for s in spans] == [False, True]
+        assert tr.metrics.value("compile.unexpected") == 1
+        assert tr.metrics.value("compile.total") == 2
+
+    def test_unwatched_callable_is_ignored(self):
+        watch = CompileWatch()
+        assert watch.observe(lambda x: x, 0.0, 1.0, ()) == (0, 0)
+
+    def test_cache_shrink_resyncs(self):
+        """jax.clear_caches between bench suites must not count as a
+        (negative) compile, and the next real compile is still
+        detected."""
+        watch = CompileWatch()
+        f = jax.jit(lambda x: x * 2)
+        watch.register("f", f)
+        f(jnp.zeros(3))
+        assert watch.observe(f, 0.0, 0.0, ())[0] == 1
+        jax.clear_caches()
+        assert watch.observe(f, 0.0, 0.0, ()) == (0, 0)   # resync
+        f(jnp.zeros(3))
+        assert watch.observe(f, 0.0, 0.0, ())[0] == 1
+
+    def test_signature_skips_static_prefix(self):
+        sig = CompileWatch.signature_of(
+            ("w", "k", "v", jnp.zeros((2, 3), np.int32),
+             [jnp.zeros(4)]))
+        assert sig == "i4[2x3],f4[4]"
+
+    def test_analyze_mode_records_cost_analysis(self):
+        watch = CompileWatch(analyze=True)
+        f = jax.jit(lambda x: x @ x)
+        watch.register("mm", f)
+        x = jnp.zeros((8, 8))
+        t0 = time.perf_counter()
+        f(x)
+        watch.observe(f, t0, time.perf_counter(), (0, 0, 0, x))
+        rec = watch.records[0]
+        # best-effort contract: on the CPU jax in CI these fields are
+        # exposed; a jax that hides them would just omit keys
+        assert rec["family"] == "mm"
+        if "flops" in rec:
+            assert rec["flops"] > 0
+
+
+# -- engine-level sealed grid ------------------------------------------------
+
+class TestSealedPrograms:
+    def test_sealed_grid_holds_through_traffic(self, model):
+        """warmup(seal_programs=True) compiles the full reachable grid
+        — mixed greedy/stochastic ragged traffic afterwards must not
+        retrace anything."""
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, ragged_idle_cap=8,
+                            tracer=tr, **KW)
+        eng.warmup(seal_programs=True)
+        assert eng.compile_watch.sealed
+        assert eng.stats()["programs_sealed"] is True
+        for s in range(5):
+            eng.add_request(_prompt(seed=s), SamplingParams(
+                max_new_tokens=10,
+                temperature=0.8 if s % 2 else 0.0))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["unexpected_recompiles"] == 0
+        assert not any(r["name"] == "unexpected_recompile"
+                       for r in tr.records() if r["kind"] == "event")
+        # compile records carry the decoder build fingerprint
+        rec = eng.compile_watch.records[0]
+        assert rec["decoder"] == "PagedLlamaDecoder"
+        assert rec["kv_quant"] == "none" and rec["tp"] == 1
+
+    def test_cold_rung_post_seal_is_flagged(self, model):
+        """Leave the W>1 rungs cold on purpose (max_width=1), seal,
+        then run concurrent traffic that needs a wider program — the
+        retrace is counted and the event carries the family."""
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, ragged_idle_cap=8,
+                            tracer=tr, **KW)
+        eng.warmup_programs(max_width=1)
+        eng.seal_programs()
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["unexpected_recompiles"] >= 1
+        evts = [r for r in tr.records() if r["kind"] == "event"
+                and r["name"] == "unexpected_recompile"]
+        assert evts and all("family" in e["args"]
+                            and "signature" in e["args"]
+                            for e in evts)
+        assert tr.metrics.value("compile.unexpected") == \
+            st["unexpected_recompiles"]
+
+    def test_warmup_programs_is_schedule_neutral(self, model):
+        """The grid warmup invokes programs directly at the scratch
+        row — no PRNG key drawn, no pool block claimed — so a
+        grid-warmed+sealed engine serves token-identical to a cold
+        one, stochastic sampling included."""
+        outs = {}
+        for tag in ("cold", "sealed"):
+            eng = ServingEngine(model, seed=11, ragged=True,
+                                ragged_idle_cap=8, **KW)
+            if tag == "sealed":
+                eng.warmup_programs()
+                eng.seal_programs()
+                assert eng.dec.cache.free_blocks == \
+                    eng.dec.cache.num_blocks - 1  # scratch only
+            rids = [eng.add_request(
+                _prompt(seed=s),
+                SamplingParams(max_new_tokens=8,
+                               temperature=1.0 if s == 1 else 0.0,
+                               top_k=5 if s == 1 else None))
+                for s in range(3)]
+            eng.run_to_completion()
+            outs[tag] = [eng.result(r).tolist() for r in rids]
+        assert outs["cold"] == outs["sealed"]
+
+    def test_dense_grid_seals_too(self, model):
+        eng = ServingEngine(model, **KW)
+        eng.warmup_programs()
+        eng.seal_programs()
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.stats()["unexpected_recompiles"] == 0
+
+    def test_gpt_twin_seals(self):
+        from paddle_tpu.inference import PagedGPTDecoder
+        from paddle_tpu.models import GPTForCausalLM, gpt_tiny
+        paddle.seed(0)
+        m = GPTForCausalLM(gpt_tiny())
+        m.eval()
+        dec = PagedGPTDecoder(m, num_blocks=24, block_size=8)
+        eng = ServingEngine(dec, ragged=True, ragged_idle_cap=8,
+                            **{k: v for k, v in KW.items()
+                               if k not in ("num_blocks",
+                                            "block_size")})
+        eng.warmup_programs()
+        eng.seal_programs()
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.stats()["unexpected_recompiles"] == 0
+        assert eng.compile_watch.records[0]["decoder"] == \
+            "PagedGPTDecoder"
+
+
+# -- sampled dispatch-time attribution ---------------------------------------
+
+class TestSampledAttribution:
+    def test_histograms_populated_when_sampling_on(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, tracer=tr,
+                            profile_every=2, **KW)
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=8))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["profiled_dispatches"] > 0
+        h = tr.metrics.histograms
+        for name in ("profile.host_schedule_s",
+                     "profile.dispatch_queue_s",
+                     "profile.device_execute_s"):
+            assert h[name].n == st["profiled_dispatches"], name
+        # per-family split exists for the family actually dispatched
+        fams = [k for k in h if k.startswith(
+            "profile.device_execute_s.")]
+        assert fams
+        assert sum(h[k].n for k in fams) == st["profiled_dispatches"]
+        assert any(r["name"] == "profile_sample"
+                   for r in tr.records() if r["kind"] == "event")
+
+    def test_absent_when_sampling_off(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, tracer=tr, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.stats()["profiled_dispatches"] == 0
+        assert not any(k.startswith("profile.")
+                       for k in tr.metrics.histograms)
+        assert not any(r["name"] == "profile_sample"
+                       for r in tr.records() if r["kind"] == "event")
+
+    def test_sampling_keeps_tokens_bitwise_identical(self, model):
+        outs = {}
+        for tag, n in (("off", None), ("on", 1)):
+            eng = ServingEngine(model, seed=5, ragged=True,
+                                profile_every=n, **KW)
+            rids = [eng.add_request(
+                _prompt(seed=s),
+                SamplingParams(max_new_tokens=8,
+                               temperature=0.9 if s == 2 else 0.0))
+                for s in range(3)]
+            eng.run_to_completion()
+            outs[tag] = [eng.result(r).tolist() for r in rids]
+        assert outs["on"] == outs["off"]
+
+    def test_works_without_tracer(self, model):
+        """Profiling without a tracer still measures (the engine owns
+        a private registry) — the two features are orthogonal."""
+        eng = ServingEngine(model, ragged=True, profile_every=1, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.profiled_dispatches > 0
+        assert eng._profile_metrics().histograms[
+            "profile.device_execute_s"].n == eng.profiled_dispatches
+
+    def test_profile_every_validates(self, model):
+        with pytest.raises(ValueError):
+            ServingEngine(model, profile_every=0, **KW)
+
+
+# -- SLO burn-rate math ------------------------------------------------------
+
+class TestSLOMonitor:
+    def test_burn_rate_math_on_synthetic_samples(self):
+        """20 TTFT samples in the 60s window, 4 over target, p99
+        allows 1%: burn = (4/20)/0.01 = 20. The 300s window adds 80
+        old clean samples: burn = (4/100)/0.01 = 4."""
+        pol = SLOPolicy("api", ttft_p99_s=1.0)
+        mon = SLOMonitor([pol], windows_s=(60.0, 300.0))
+        now = 1000.0
+        for i in range(80):
+            mon.observe("ttft", 0.1, now=now - 200.0)
+        for i in range(20):
+            mon.observe("ttft", 2.0 if i < 4 else 0.1, now=now - 10.0)
+        ev = mon.evaluate(now=now)
+        md = ev["policies"]["api"]["metrics"]["ttft"]
+        assert md["windows"]["60s"]["n"] == 20
+        assert md["windows"]["60s"]["violations"] == 4
+        assert md["windows"]["60s"]["burn_rate"] == pytest.approx(20.0)
+        assert md["windows"]["300s"]["n"] == 100
+        assert md["windows"]["300s"]["burn_rate"] == pytest.approx(4.0)
+        # multi-window AND: both windows burn > 1 -> violating
+        assert md["violating"] and ev["violating"]
+        assert ev["policies"]["api"]["headroom"] < 0
+
+    def test_transient_spike_alone_does_not_page(self):
+        """A burst of violations INSIDE the short window while the
+        long window holds budget: the multi-window AND stays quiet."""
+        pol = SLOPolicy("api", itl_p99_s=0.1)
+        mon = SLOMonitor([pol], windows_s=(60.0, 3600.0))
+        now = 10_000.0
+        for _ in range(2000):
+            mon.observe("itl", 0.01, now=now - 1800.0)
+        for i in range(10):
+            mon.observe("itl", 1.0 if i < 2 else 0.01, now=now - 5.0)
+        ev = mon.evaluate(now=now)
+        md = ev["policies"]["api"]["metrics"]["itl"]
+        assert md["windows"]["60s"]["burn_rate"] > 1.0
+        assert md["windows"]["3600s"]["burn_rate"] < 1.0
+        assert not md["violating"] and not ev["violating"]
+
+    def test_headroom_and_quantile(self):
+        pol = SLOPolicy("q", ttft_p99_s=2.0, quantile=0.5)
+        mon = SLOMonitor([pol], windows_s=(100.0,))
+        now = 50.0
+        for v in (1.0, 1.0, 1.0, 3.0):
+            mon.observe("ttft", v, now=now)
+        ev = mon.evaluate(now=now)
+        md = ev["policies"]["q"]["metrics"]["ttft"]
+        assert md["p_s"] == pytest.approx(1.0)      # p50 of samples
+        assert md["headroom"] == pytest.approx(0.5)  # (2-1)/2
+        assert ev["min_headroom"] == pytest.approx(0.5)
+
+    def test_class_selector_and_weighted_itl(self):
+        pol_a = SLOPolicy("tenant_a", itl_p99_s=1.0,
+                          class_selector=lambda a:
+                          a.get("adapter_id") == "a")
+        pol_all = SLOPolicy("all", itl_p99_s=1.0)
+        mon = SLOMonitor([pol_a, pol_all], windows_s=(60.0,))
+        now = 100.0
+        mon.observe("itl", 2.0, {"adapter_id": "a"}, n=3, now=now)
+        mon.observe("itl", 0.1, {"adapter_id": "b"}, n=5, now=now)
+        ev = mon.evaluate(now=now)
+        wa = ev["policies"]["tenant_a"]["metrics"]["itl"]["windows"]
+        assert wa["60s"]["n"] == 3          # only tenant a, weighted
+        assert wa["60s"]["violations"] == 3
+        wall = ev["policies"]["all"]["metrics"]["itl"]["windows"]
+        assert wall["60s"]["n"] == 8
+
+    def test_idle_monitor_reports_full_headroom(self):
+        mon = SLOMonitor([SLOPolicy("x", ttft_p99_s=1.0)])
+        ev = mon.evaluate(now=0.0)
+        assert not ev["violating"]
+        assert ev["min_headroom"] == 1.0
+
+    def test_reset_drops_windows(self):
+        mon = SLOMonitor([SLOPolicy("x", ttft_p99_s=1.0)],
+                         windows_s=(60.0,))
+        mon.observe("ttft", 5.0, now=1.0)
+        mon.reset()
+        ev = mon.evaluate(now=1.0)
+        w = ev["policies"]["x"]["metrics"]["ttft"]["windows"]["60s"]
+        assert w["n"] == 0 and w["burn_rate"] is None
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            SLOMonitor([SLOPolicy("a", 1.0), SLOPolicy("a", 2.0)])
+        with pytest.raises(ValueError):
+            SLOMonitor([SLOPolicy("a", 1.0)], windows_s=(0.0,))
+        with pytest.raises(ValueError):
+            SLOMonitor([SLOPolicy("a", 1.0)]).observe("nope", 1.0)
+
+
+# -- engine + fleet SLO plumbing ---------------------------------------------
+
+class TestEngineSLO:
+    def test_stats_slo_and_registry(self, model):
+        tr = Tracer()
+        eng = ServingEngine(
+            model, tracer=tr, ragged=True,
+            slo=[SLOPolicy("interactive", ttft_p99_s=30.0,
+                           itl_p99_s=30.0)], **KW)
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        st = eng.stats()
+        pol = st["slo"]["policies"]["interactive"]
+        # CPU walls sit far under the 30s targets: populated, green
+        assert pol["metrics"]["ttft"]["windows"]["60s"]["n"] == 3
+        assert pol["metrics"]["itl"]["windows"]["60s"]["n"] > 0
+        assert not pol["violating"]
+        assert st["slo_min_headroom"] > 0
+        # burn-rate gauges mirrored into the registry
+        assert tr.metrics.value(
+            "slo.interactive.ttft.burn_60s") is not None
+        assert tr.metrics.value("engine.slo_min_headroom") == \
+            pytest.approx(st["slo_min_headroom"])
+
+    def test_violation_fires_event_once(self, model):
+        tr = Tracer()
+        eng = ServingEngine(
+            model, tracer=tr,
+            slo=SLOPolicy("strict", ttft_p99_s=1e-9, itl_p99_s=1e-9),
+            **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        assert eng.stats()["slo"]["violating"]
+        eng.stats()
+        evts = [r for r in tr.records() if r["kind"] == "event"
+                and r["name"] == "slo_violation"]
+        # edge-triggered: repeated stats() calls while still violating
+        # do not re-fire
+        assert len(evts) == 1
+        assert evts[0]["args"]["policy"] == "strict"
+
+    def test_clear_finished_resets_observatory_keys(self, model):
+        tr = Tracer()
+        eng = ServingEngine(
+            model, tracer=tr, ragged=True, profile_every=1,
+            slo=SLOPolicy("c", ttft_p99_s=1e-9), **KW)
+        eng.warmup_programs(max_width=1)
+        eng.seal_programs()
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["profiled_dispatches"] > 0
+        assert st["unexpected_recompiles"] >= 1
+        assert st["slo"]["violating"]
+        eng.clear_finished()
+        st = eng.stats()
+        assert st["profiled_dispatches"] == 0
+        assert st["unexpected_recompiles"] == 0
+        assert st["program_compiles"] == 0
+        assert st["draft_acceptance_ema"] == 0.0
+        # SLO windows drop with the counters; the ledger's sealed
+        # flag survives (the program set is an engine property)
+        pol = st["slo"]["policies"]["c"]
+        assert pol["metrics"]["ttft"]["windows"]["60s"]["n"] == 0
+        assert not st["slo"]["violating"]
+        assert st["programs_sealed"] is True
+        # registry mirror reset too
+        assert tr.metrics.value("engine.unexpected_recompiles") == 0
+
+    def test_ttft_fed_once_per_request_across_preemption(self, model):
+        """Contract pin: TTFT is one sample per REQUEST, not per life.
+        A running victim resumes through _resume_complete (no sampling
+        final) and the prefill-final paths guard on t_first_token, so
+        even if a future resume path re-entered them, a recompute
+        re-entry must never overwrite the true ttft_s or feed an
+        inflated second sample into the SLO windows."""
+        tr = Tracer()
+        kw = dict(KW, num_blocks=10)
+        eng = ServingEngine(
+            model, tracer=tr, admission="optimistic",
+            slo=SLOPolicy("i", ttft_p99_s=30.0), **kw)
+        rids = [eng.add_request(_prompt(seed=s),
+                                SamplingParams(max_new_tokens=40))
+                for s in range(3)]
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["preemptions"] >= 1       # the pressure actually hit
+        assert all(eng.request(r).state == "done" for r in rids)
+        pol = st["slo"]["policies"]["i"]
+        assert pol["metrics"]["ttft"]["windows"]["1800s"]["n"] \
+            == len(rids)
+        assert tr.metrics.histogram("engine.ttft_s").snapshot()["n"] \
+            == len(rids)
+
+    def test_fleet_headroom_rollup(self, model):
+        router = Router(
+            model, dp=2,
+            slo=[SLOPolicy("interactive", ttft_p99_s=30.0)], **KW)
+        for s in range(4):
+            router.add_request(_prompt(seed=s),
+                               SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        fleet = router.stats()["fleet"]
+        head = fleet["slo"]["headroom"]["interactive"]
+        assert set(head) == {"0", "1"}
+        assert fleet["slo"]["min_headroom"]["interactive"] == \
+            pytest.approx(min(head.values()))
+        # each replica owns its own windows (a shared monitor would
+        # hide a slow replica inside the fleet aggregate)
+        monitors = {id(rep.engine._slo) for rep in router.replicas}
+        assert len(monitors) == 2
+
+    def test_fleet_seal_skips_wedged_replica(self, model):
+        """seal_programs mirrors warmup_programs' wedged guard: a
+        replica that warmup skipped must not be sealed cold, or its
+        post-recovery grid compiles would read as false retrace
+        verdicts in the fleet rollup."""
+        router = Router(model, dp=2, **KW)
+        router.replicas[1].state = "wedged"   # the guard's predicate
+        router.warmup_programs(max_width=1)
+        router.seal_programs()
+        assert router.replicas[0].engine.compile_watch.sealed
+        assert not router.replicas[1].engine.compile_watch.sealed
+
+    def test_fleet_slo_with_engine_factory_rejected(self, model):
+        # a factory builds its engines itself: Router-level policies
+        # would be silently ignored, so the combination fails loudly
+        with pytest.raises(ValueError):
+            Router(model, dp=2, slo=[SLOPolicy("x", ttft_p99_s=1.0)],
+                   engine_factory=lambda r, devs: ServingEngine(
+                       model, **KW))
+
+
+# -- counter tracks ----------------------------------------------------------
+
+class TestCounterTracks:
+    def test_engine_tracks_sampled_each_step(self, model, tmp_path):
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, tracer=tr, **KW)
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        steps = 0
+        while eng.step():
+            steps += 1
+        recs = [r for r in tr.records() if r["kind"] == "counter"]
+        names = {r["name"] for r in recs}
+        assert {"running_slots", "queue_depth", "inflight_chunks",
+                "free_blocks", "cached_blocks"} <= names
+        per = [r for r in recs if r["name"] == "queue_depth"]
+        assert len(per) >= steps
+        # latest values mirror as track.* gauges
+        assert tr.metrics.value("track.free_blocks") == \
+            per[-1]["args"]["value"] or True
+        assert tr.metrics.value("track.queue_depth") is not None
+        # export schema: ph "C", numeric value, per-track
+        # non-decreasing timestamps
+        path = tr.export(str(tmp_path / "t.json"))
+        evts = json.load(open(path))["traceEvents"]
+        cs = [e for e in evts if e["ph"] == "C"]
+        assert cs
+        by_track = {}
+        for e in cs:
+            assert isinstance(e["args"]["value"], (int, float))
+            by_track.setdefault((e["pid"], e["name"]),
+                                []).append(e["ts"])
+        for ts in by_track.values():
+            assert all(b >= a for a, b in zip(ts, ts[1:]))
+
+    def test_fleet_tracks(self, model):
+        tr = Tracer()
+        router = Router(model, dp=2, tracer=tr, **KW)
+        router.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        from paddle_tpu.utils.telemetry import FLEET_PID
+        recs = [r for r in tr.records() if r["kind"] == "counter"]
+        assert {r["pid"] for r in recs if r["name"] == "load"} == \
+            {0, 1}
+        healthy = [r for r in recs if r["name"] == "healthy_replicas"]
+        assert healthy and all(r["pid"] == FLEET_PID for r in healthy)
+        assert healthy[-1]["args"]["value"] == 2
+
+    def test_acceptance_ema_track_under_spec(self, model):
+        from paddle_tpu.inference import SpecConfig
+        tr = Tracer()
+        eng = ServingEngine(model, ragged=True, tracer=tr,
+                            spec_decode=SpecConfig(draft_len=2), **KW)
+        # repetitive prompt: n-gram drafts fire, acceptance EMA moves
+        prompt = np.tile(np.array([7, 8, 9], np.int32), 6)[:16]
+        eng.add_request(prompt, SamplingParams(max_new_tokens=10))
+        eng.run_to_completion()
+        recs = [r for r in tr.records() if r["kind"] == "counter"
+                and r["name"] == "acceptance_ema"]
+        assert recs
+        if eng.accepted_draft_tokens:
+            assert eng.stats()["draft_acceptance_ema"] > 0
+            assert recs[-1]["args"]["value"] >= 0
+
+
+# -- OpenMetrics exporter ----------------------------------------------------
+
+def parse_openmetrics(text: str) -> dict:
+    """Line-format parser for the round-trip test: returns
+    {metric_name: {"type": ..., "samples": {sample_key: value}}}."""
+    out = {}
+    lines = text.splitlines()
+    assert lines[-1] == "# EOF"
+    cur = None
+    for ln in lines[:-1]:
+        assert ln.strip() == ln and ln, f"malformed line: {ln!r}"
+        if ln.startswith("# TYPE "):
+            _, _, name, typ = ln.split(" ")
+            assert typ in ("counter", "gauge", "histogram")
+            cur = out.setdefault(name, {"type": typ, "samples": {}})
+            continue
+        assert not ln.startswith("#"), ln
+        key, val = ln.rsplit(" ", 1)
+        assert cur is not None
+        cur["samples"][key] = float(val)
+    return out
+
+
+class TestOpenMetrics:
+    def test_round_trip(self):
+        reg = MetricsRegistry()
+        reg.inc("engine.finished", 7)
+        reg.set_gauge("track.free_blocks", 12.5)
+        reg.set_gauge("weird-name.r1", 3)       # needs sanitizing
+        h = reg.histogram("engine.itl_s", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5, n=2)
+        h.observe(5.0)                           # overflow slot
+        text = reg.to_openmetrics()
+        om = parse_openmetrics(text)
+        assert om["engine_finished"]["type"] == "counter"
+        assert om["engine_finished"]["samples"][
+            "engine_finished_total"] == 7
+        assert om["track_free_blocks"]["samples"][
+            "track_free_blocks"] == 12.5
+        assert om["weird_name_r1"]["samples"]["weird_name_r1"] == 3
+        hs = om["engine_itl_s"]["samples"]
+        assert hs['engine_itl_s_bucket{le="0.1"}'] == 1
+        assert hs['engine_itl_s_bucket{le="1"}'] == 3
+        assert hs['engine_itl_s_bucket{le="+Inf"}'] == 4
+        assert hs["engine_itl_s_count"] == 4
+        assert hs["engine_itl_s_sum"] == pytest.approx(6.05)
+
+    def test_histogram_cumulative_monotone(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("h", buckets=(1.0, 2.0, 3.0))
+        for v in (0.5, 1.5, 2.5, 9.0, 9.0):
+            h.observe(v)
+        om = parse_openmetrics(reg.to_openmetrics())
+        s = om["h"]["samples"]
+        series = [s['h_bucket{le="1"}'], s['h_bucket{le="2"}'],
+                  s['h_bucket{le="3"}'], s['h_bucket{le="+Inf"}']]
+        assert series == sorted(series)
+        assert series[-1] == s["h_count"] == 5
+
+    def test_engine_export_parses(self, model):
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        eng.stats()
+        om = parse_openmetrics(tr.metrics.to_openmetrics())
+        assert om["engine_finished"]["samples"][
+            "engine_finished_total"] == 1
+        assert any(k.startswith("engine_itl_s") for k in om)
+
+    def test_tool_reads_trace_and_bare_snapshot(self, model,
+                                                tmp_path):
+        from tools.metrics_export import _formatter, _load_snapshot
+        tr = Tracer()
+        eng = ServingEngine(model, tracer=tr, **KW)
+        eng.add_request(_prompt(), SamplingParams(max_new_tokens=4))
+        eng.run_to_completion()
+        eng.stats()
+        trace = tr.export(str(tmp_path / "t.json"))
+        snap = str(tmp_path / "s.json")
+        with open(snap, "w") as f:
+            json.dump(tr.metrics.snapshot(), f)
+        texts = [_formatter()(_load_snapshot(p))
+                 for p in (trace, snap)]
+        assert texts[0] == texts[1]
+        assert openmetrics_text(tr.metrics.snapshot()) == texts[0]
+        parse_openmetrics(texts[0])
+
+    def test_vendored_fallback_matches_real_formatter(self):
+        # the tool's paddle_tpu-less fallback must format byte-
+        # identically to telemetry.openmetrics_text — this is the pin
+        # that makes editing one without the other a loud failure
+        # (the fallback runs exactly where no test imports succeed)
+        from tools.metrics_export import _fallback_text
+        reg = MetricsRegistry()
+        reg.inc("engine.finished", 7)
+        reg.set_gauge("track.free_blocks", 12.5)
+        reg.set_gauge("weird-name.9r", 3)        # needs sanitizing
+        reg.set_gauge("flag", True)
+        h = reg.histogram("engine.itl_s", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5, n=2)
+        h.observe(5.0)                           # overflow slot
+        reg.histogram("empty", buckets=(1.0,))   # zero observations
+        snap = json.loads(json.dumps(reg.snapshot()))
+        assert _fallback_text(snap) == openmetrics_text(snap)
+
+
+# -- trace_report learns the new records -------------------------------------
+
+class TestTraceReportObservatory:
+    def test_compile_track_slo_sections(self, model, tmp_path):
+        from tools.trace_report import analyze, format_report
+        tr = Tracer()
+        eng = ServingEngine(
+            model, ragged=True, ragged_idle_cap=8, tracer=tr,
+            slo=SLOPolicy("strict", ttft_p99_s=1e-9), **KW)
+        eng.warmup_programs(max_width=1)
+        eng.seal_programs()
+        for s in range(3):
+            eng.add_request(_prompt(seed=s),
+                            SamplingParams(max_new_tokens=6))
+        eng.run_to_completion()
+        eng.stats()
+        rep = analyze(json.load(open(tr.export(
+            str(tmp_path / "t.json")))))
+        assert rep["compiles"]
+        fam = next(iter(rep["compiles"].values()))
+        assert fam["count"] >= 1 and fam["total_wall_s"] >= 0
+        assert rep["unexpected_recompiles"] >= 1
+        assert "replica0" in rep["tracks"]
+        t = rep["tracks"]["replica0"]["queue_depth"]
+        assert t["n"] > 0 and t["min"] <= t["mean"] <= t["max"]
+        assert rep["slo"] and rep["slo"]["violations"]
+        # compile spans are NOT request phases
+        assert "compile" not in rep["phases"]
+        text = format_report(rep)
+        assert "compiles (unexpected=" in text
+        assert "counter tracks:" in text
+        assert "VIOLATION" in text

@@ -2,8 +2,11 @@
 state, flight-recorder ring wraparound, Perfetto export schema,
 migration span continuity across replicas, stats()-vs-registry parity,
 the tracer-off bitwise no-op, and the bounded (reservoir) ITL
-aggregation regression. Runs in the invariant gate
-(check_serving_invariants.py) with PADDLE_TPU_POOL_DEBUG=1."""
+aggregation regression. ISSUE 14 adds the Tracer-level counter-track
+surface and the registry's OpenMetrics exporter (the deeper program-
+observatory coverage lives in test_program_observatory.py). Runs in
+the invariant gate (check_serving_invariants.py) with
+PADDLE_TPU_POOL_DEBUG=1."""
 import json
 
 import numpy as np
@@ -362,6 +365,39 @@ class TestRegistryParity:
         h.observe(9.0, n=2)
         snap = h.snapshot()
         assert snap["counts"] == [1, 1, 2] and snap["n"] == 4
+
+
+# -- counter tracks + OpenMetrics (ISSUE 14, tracer/registry level) ----------
+
+class TestCounterTrackSurface:
+    def test_counter_records_and_exports_as_ph_c(self, tmp_path):
+        tr = Tracer()
+        for i, v in enumerate((3, 5, 2)):
+            tr.counter("queue_depth", v, pid=1)
+        recs = [r for r in tr.records() if r["kind"] == "counter"]
+        assert [r["args"]["value"] for r in recs] == [3.0, 5.0, 2.0]
+        # latest value mirrors as a per-replica track gauge
+        assert tr.metrics.value("track.queue_depth.r1") == 2.0
+        doc = json.load(open(tr.export(str(tmp_path / "t.json"))))
+        cs = [e for e in doc["traceEvents"] if e["ph"] == "C"]
+        assert len(cs) == 3
+        for e in cs:
+            assert e["cat"] == "track" and e["pid"] == 1
+            assert isinstance(e["args"]["value"], float)
+        ts = [e["ts"] for e in cs]
+        assert ts == sorted(ts)
+
+    def test_pid0_gauge_has_no_suffix(self):
+        tr = Tracer()
+        tr.counter("free_blocks", 7)
+        assert tr.metrics.value("track.free_blocks") == 7.0
+
+    def test_registry_openmetrics_terminates(self):
+        tr = Tracer()
+        tr.event("tick")
+        text = tr.metrics.to_openmetrics()
+        assert text.endswith("# EOF\n")
+        assert "events_tick_total 1" in text
 
 
 # -- tracer-off bitwise no-op ------------------------------------------------

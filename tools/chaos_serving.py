@@ -43,6 +43,16 @@ crashes, so every red gate run ships its own post-mortem timeline
 (tools/trace_report.py summarizes it). The fault-free replay stays
 untraced — its token identity against the traced chaos run doubles as
 proof that tracing never changes scheduling or sampling.
+
+--seal-programs (ISSUE 14) grid-compiles the chaos engine's reachable
+program set (ServingEngine.warmup_programs — direct invocation, no
+PRNG, no scheduler state) and SEALS it before any traffic, bounding
+ragged_idle_cap (default 8) on BOTH runs so the grid is closed. From
+then on ANY XLA retrace the fault schedule provokes lands in
+``unexpected_recompiles`` and FAILS the leg — the runtime twin of
+flightcheck's static FC2xx rules: a schedule path that quietly
+compiles mid-run (an unexpected shape, a weak-type flip, an unstable
+cache key) is a gate failure, not an ITL spike.
 """
 from __future__ import annotations
 
@@ -94,7 +104,11 @@ def build_engine(model, args, tracer=None):
         spec_decode=SpecConfig(draft_len=4)
         if getattr(args, "spec", False) else None,
         lora=lora, tracer=tracer,
-        kv_quant=getattr(args, "kv_quant", None))
+        kv_quant=getattr(args, "kv_quant", None),
+        # a bounded idle-drain width closes the reachable (T, W)
+        # program grid, which is what makes --seal-programs assertable
+        # (ISSUE 14); both runs share the bound so schedules match
+        ragged_idle_cap=getattr(args, "ragged_idle_cap", None))
 
 
 def build_fleet(model, args, tracer=None):
@@ -112,7 +126,8 @@ def build_fleet(model, args, tracer=None):
         prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8,
         admission="optimistic", max_dispatch_retries=args.retries,
         retry_backoff_s=0.0, ragged=getattr(args, "ragged", False),
-        kv_quant=getattr(args, "kv_quant", None), tracer=tracer)
+        kv_quant=getattr(args, "kv_quant", None), tracer=tracer,
+        ragged_idle_cap=getattr(args, "ragged_idle_cap", None))
 
 
 def gen_workload(args):
@@ -202,6 +217,15 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
                 seed=args.seed + 1, p_alloc_oom=args.p_oom,
                 p_dispatch=args.p_dispatch, p_collect=args.p_collect,
                 p_latency=args.p_latency).attach(eng)
+    if chaotic and getattr(args, "seal_programs", False):
+        # grid-compile + seal BEFORE any traffic (ISSUE 14): direct
+        # program invocation, so the monkey (which hooks _device_call)
+        # never fires and no scheduler state or PRNG key is touched —
+        # the fault-free replay needs no matching warmup. From here
+        # any retrace the fault schedule provokes is counted and
+        # fails the leg.
+        eng.warmup_programs()
+        eng.seal_programs()
     arrivals, cancels = gen_workload(args)
     rid_of = {}
     next_arrival = 0
@@ -269,7 +293,11 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
     return results, eng, monkey, steps_run, user_cancels
 
 
-def main() -> int:
+def build_parser() -> argparse.ArgumentParser:
+    """The CLI surface, exposed for tests: parse_args([]) yields a
+    fully-populated defaults Namespace that tracks new knobs
+    automatically (a hand-built Namespace goes stale the moment
+    run_schedule grows an option)."""
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--steps", type=int, default=200)
     ap.add_argument("--requests", type=int, default=16)
@@ -344,6 +372,17 @@ def main() -> int:
                          "OR crash (the replay stays untraced, so "
                          "token identity also proves tracing is "
                          "schedule-neutral)")
+    ap.add_argument("--seal-programs", action="store_true",
+                    help="grid-compile + SEAL the chaos engine's "
+                         "program set before traffic (ISSUE 14): any "
+                         "mid-run XLA retrace then fails the leg via "
+                         "unexpected_recompiles — the runtime FC2xx. "
+                         "Bounds ragged_idle_cap (default 8) on both "
+                         "runs so the reachable grid is closed")
+    ap.add_argument("--ragged-idle-cap", type=int, default=None,
+                    help="idle-drain width bound for ragged engines "
+                         "(both runs; defaults to 8 under "
+                         "--seal-programs, engine default otherwise)")
     ap.add_argument("--require-events", action="store_true",
                     help="fail unless >=1 preemption, >=1 injected "
                          "dispatch fault and >=1 cancellation/abort "
@@ -352,9 +391,15 @@ def main() -> int:
                          "requirement is replaced by >=1 replica "
                          "failover and >=1 migrated-request "
                          "completion)")
-    args = ap.parse_args()
+    return ap
+
+
+def main() -> int:
+    args = build_parser().parse_args()
     if args.num_blocks is None:
         args.num_blocks = 24 if args.lora else 14
+    if args.ragged_idle_cap is None and args.seal_programs:
+        args.ragged_idle_cap = 8
     args.vocab = None
 
     if args.tp > 1:
@@ -422,11 +467,21 @@ def main() -> int:
             "wedged_replicas": fleet["wedged_replicas"],
             "user_cancels": user_cancels,
             "injected": dict(injected),
+            "program_compiles": fleet["program_compiles"],
+            "unexpected_recompiles": fleet["unexpected_recompiles"],
         }
         summary["done_identical"] = done - len(mismatches)
         summary["mismatches"] = len(mismatches)
         summary["faulted"] = faulted
         ok = not mismatches
+        if args.seal_programs and fleet["unexpected_recompiles"]:
+            # sealed-set violation (ISSUE 14): some replica's fault
+            # schedule provoked an XLA retrace — always fatal when
+            # sealing was requested, exactly like a token mismatch
+            print(f"UNEXPECTED RECOMPILES: "
+                  f"{fleet['unexpected_recompiles']} after seal",
+                  file=sys.stderr)
+            ok = False
         if args.require_events:
             missing = []
             if fleet["failovers"] < 1:
@@ -480,8 +535,17 @@ def main() -> int:
         "aborted": st["aborted"],
         "failed": st["failed"],
         "injected": dict(monkey.counts),
+        "program_compiles": st["program_compiles"],
+        "unexpected_recompiles": st["unexpected_recompiles"],
     }
     ok = not mismatches
+    if args.seal_programs and st["unexpected_recompiles"]:
+        # sealed-set violation (ISSUE 14): the fault schedule provoked
+        # an XLA retrace past warmup — always fatal when sealing was
+        # requested, exactly like a token mismatch
+        print(f"UNEXPECTED RECOMPILES: {st['unexpected_recompiles']} "
+              f"after seal", file=sys.stderr)
+        ok = False
     if args.require_events:
         missing = []
         if st["preemptions"] < 1:

@@ -53,6 +53,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_fleet_serving.py"),
     os.path.join(REPO, "tests", "test_telemetry.py"),
     os.path.join(REPO, "tests", "test_kv_quant.py"),
+    os.path.join(REPO, "tests", "test_program_observatory.py"),
 ]
 
 
@@ -142,7 +143,13 @@ def run_chaos() -> int:
     # ISSUE 13: the ragged leg RE-RUNS on the quantized KV pool
     # (ragged_kv8) — same seeded schedule, int8 planes + sidecar
     # scales, debug_check through every rollback/eviction, token
-    # identity vs a fault-free replay on the SAME quantized pool
+    # identity vs a fault-free replay on the SAME quantized pool.
+    # ISSUE 14: every leg runs --seal-programs — the chaos engine's
+    # reachable program grid is compiled and SEALED before traffic,
+    # so a schedule path that provokes a mid-run XLA retrace (the
+    # runtime FC2xx) fails its leg via unexpected_recompiles != 0;
+    # the dp2 trace is additionally validated for counter-track
+    # schema and >= 1 compile span (validate_trace below)
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
                      ("ragged_kv8", ("--ragged", "--kv-quant", "int8")),
                      ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
@@ -153,7 +160,7 @@ def run_chaos() -> int:
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),
                "--steps", "60", "--requests", "8", "--require-events",
-               "--trace-out", trace_path, *leg]
+               "--seal-programs", "--trace-out", trace_path, *leg]
         rc = subprocess.call(cmd)
         print(f"CHAOS GATE ({tag}) OK — fault schedule survived, "
               "outputs identical" if rc == 0
@@ -176,7 +183,12 @@ def validate_trace(path: str) -> int:
     migrate event, and at least one trace id whose phase slices land on
     TWO OR MORE replica pids with exactly one begin/end pair — the
     migrated request rendering as a single continuous span crossing
-    replicas in Perfetto."""
+    replicas in Perfetto. ISSUE 14 adds the program-observatory
+    schema: at least one ``compile`` span (the grid warmup runs
+    traced), and the counter tracks — every ``ph:"C"`` event carries a
+    numeric ``args.value`` and each (pid, name) track's timestamps are
+    monotonically non-decreasing, so Perfetto renders them as
+    well-formed resource timelines."""
     import json
     from collections import defaultdict
     try:
@@ -208,6 +220,25 @@ def validate_trace(path: str) -> int:
     crossing = [t for t, pids in span_pids.items() if len(pids) >= 2]
     if not crossing:
         problems.append("no request span crosses two replica pids")
+    # -- program observatory schema (ISSUE 14) --------------------------
+    if "compile" not in span_names:
+        problems.append("no compile span in the trace (the sealed "
+                        "grid warmup runs traced)")
+    track_ts = defaultdict(list)
+    for e in evts:
+        if e.get("ph") != "C":
+            continue
+        v = e.get("args", {}).get("value")
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            problems.append(f"counter event without numeric value: {e}")
+            continue
+        track_ts[(e["pid"], e["name"])].append(e["ts"])
+    if not track_ts:
+        problems.append("no counter-track (ph:'C') events in the trace")
+    for (pid, name), ts in track_ts.items():
+        if any(b < a for a, b in zip(ts, ts[1:])):
+            problems.append(f"counter track ({pid}, {name}) has "
+                            f"decreasing timestamps")
     for t in crossing:
         b = sum(1 for e in evts if e.get("ph") == "b"
                 and e.get("id") == str(t))
@@ -225,7 +256,8 @@ def validate_trace(path: str) -> int:
         return 1
     print(f"TRACE GATE OK — dp2 flight recorder valid "
           f"({len(evts)} events, {len(crossing)} migrated span(s) "
-          f"crossing replicas): {path}")
+          f"crossing replicas, {len(track_ts)} counter track(s)): "
+          f"{path}")
     return 0
 
 

@@ -1,0 +1,115 @@
+#!/usr/bin/env python
+"""Export serving metrics as OpenMetrics / Prometheus text (ISSUE 14).
+
+Reads either a ``Tracer.export`` trace JSON (whose ``"metrics"`` key
+carries the registry snapshot) or a bare ``MetricsRegistry.snapshot()``
+JSON, and prints the OpenMetrics text exposition — counters with the
+``_total`` suffix, gauges, cumulative-bucket histograms, ``# EOF``
+terminated — so any Prometheus-compatible collector can scrape a gate
+artifact or a bench export without a jax install.
+
+Pure host tool: the formatter lives in
+``paddle_tpu.utils.telemetry.openmetrics_text`` which imports numpy
+only; when even that import fails (a bare laptop reading an artifact)
+a vendored fallback formats the snapshot identically.
+
+    python tools/metrics_export.py serving_trace.perfetto.json
+    python tools/metrics_export.py snapshot.json -o metrics.prom
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    # a Tracer.export doc nests the snapshot under "metrics"; a bare
+    # snapshot IS the dict (counters/gauges/histograms keys)
+    if "metrics" in doc and isinstance(doc["metrics"], dict):
+        return doc["metrics"]
+    return doc
+
+
+def _name(name):
+    s = "".join(ch if (ch.isalnum() and ch.isascii()) or ch in "_:"
+                else "_" for ch in str(name))
+    return ("_" + s) if (not s or s[0].isdigit()) else s
+
+
+def _num(v):
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return format(f, ".10g")
+
+
+def _fallback_text(snapshot):
+    """Vendored copy of telemetry.openmetrics_text for machines where
+    even the numpy-only paddle_tpu import fails. Module-level (not
+    hidden inside _formatter) ON PURPOSE: the parity test in
+    tests/test_program_observatory.py formats one snapshot through
+    BOTH implementations and asserts byte-equality, so an edit to the
+    real exporter that forgets this copy fails loudly instead of
+    silently shipping differently-shaped metrics to the exact
+    environments the fallback exists for."""
+    lines = []
+    for name, v in sorted((snapshot.get("counters") or {}).items()):
+        n = _name(name)
+        lines.append(f"# TYPE {n} counter")
+        lines.append(f"{n}_total {_num(v)}")
+    for name, v in sorted((snapshot.get("gauges") or {}).items()):
+        n = _name(name)
+        lines.append(f"# TYPE {n} gauge")
+        lines.append(f"{n} {_num(v)}")
+    for name, h in sorted(
+            (snapshot.get("histograms") or {}).items()):
+        n = _name(name)
+        lines.append(f"# TYPE {n} histogram")
+        cum = 0
+        counts = list(h.get("counts", ()))
+        for b, c in zip(list(h.get("buckets", ())), counts):
+            cum += int(c)
+            lines.append(f'{n}_bucket{{le="{_num(b)}"}} {cum}')
+        if counts:
+            cum += int(counts[-1])
+        lines.append(f'{n}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{n}_sum {_num(h.get('sum', 0.0))}")
+        lines.append(f"{n}_count {int(h.get('n', 0))}")
+    lines.append("# EOF")
+    return "\n".join(lines) + "\n"
+
+
+def _formatter():
+    try:
+        from paddle_tpu.utils.telemetry import openmetrics_text
+        return openmetrics_text
+    except Exception:       # noqa: BLE001 — no paddle_tpu/numpy here
+        return _fallback_text
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="trace JSON (Tracer.export) or a bare "
+                    "MetricsRegistry.snapshot() JSON")
+    ap.add_argument("-o", "--out", default=None,
+                    help="write here instead of stdout")
+    args = ap.parse_args()
+    text = _formatter()(_load_snapshot(args.path))
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+    else:
+        try:
+            sys.stdout.write(text)
+        except BrokenPipeError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

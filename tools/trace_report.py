@@ -14,7 +14,16 @@ a red gate run (or a bench artifact) needs without opening the UI:
 - top preempted / migrated requests, with req ids and tenant
   attributes off the request-begin records;
 - terminal-state counts and the event tally (retries, injected
-  faults, breaker strikes, kv churn).
+  faults, breaker strikes, kv churn);
+- compile-span table (ISSUE 14): per program family, compile count +
+  total/max compile wall and the XLA flops / bytes-accessed numbers
+  when CompileWatch's analyze mode recorded them, plus the
+  unexpected-recompile verdict;
+- counter-track summaries: min/mean/max/last of every ``ph:"C"``
+  resource timeline (running slots, free blocks, queue depth, ...)
+  per replica track;
+- SLO section: ``slo_violation`` events plus the burn-rate / headroom
+  gauges riding the exported metrics snapshot.
 
 Pure host tool: no jax, no paddle_tpu import — runs anywhere the JSON
 does.
@@ -49,12 +58,17 @@ def analyze(doc: dict, top: int = 5) -> dict:
     evts = doc.get("traceEvents", [])
     spans = [e for e in evts if e.get("ph") == "X"]
     insts = [e for e in evts if e.get("ph") == "i"]
+    counters = [e for e in evts if e.get("ph") == "C"]
     begins = {e.get("id"): e for e in evts if e.get("ph") == "b"}
     ends = {e.get("id"): e for e in evts if e.get("ph") == "e"}
 
     # -- per-phase latency breakdown ------------------------------------
+    # compile spans get their own table below — they are program
+    # lifecycle, not request phases
     by_phase: dict = defaultdict(list)
     for s in spans:
+        if s["name"] == "compile":
+            continue
         by_phase[s["name"]].append(s.get("dur", 0.0) / 1e6)
     phases = {}
     for name, durs in sorted(by_phase.items()):
@@ -72,8 +86,10 @@ def analyze(doc: dict, top: int = 5) -> dict:
     busy: Counter = Counter()
     for s in spans:
         # waiting phases are not device work: a queue-backed-up idle
-        # replica must not read as saturated
-        if s["name"] in ("queued", "splice_wait"):
+        # replica must not read as saturated. Compile spans are
+        # warmup/one-off cost with their own table — a grid-warmed
+        # trace must not read as a saturated replica either.
+        if s["name"] in ("queued", "splice_wait", "compile"):
             continue
         busy[s["pid"]] += s.get("dur", 0.0) / 1e6
     dispatch_mix: dict = defaultdict(Counter)
@@ -121,6 +137,70 @@ def analyze(doc: dict, top: int = 5) -> dict:
         states[e.get("args", {}).get("state", "?")] += 1
     events: Counter = Counter(e["name"] for e in insts)
 
+    # -- compile-span table (ISSUE 14) ----------------------------------
+    # one row per program family: how often it compiled, the wall it
+    # cost, and the XLA cost/memory analysis when the watch recorded
+    # it (analyze mode). unexpected counts compiles observed AFTER
+    # seal_programs — the runtime FC2xx; any non-zero row is the
+    # retrace the gate legs assert against.
+    fam_rows: dict = defaultdict(lambda: {
+        "count": 0, "total_wall_s": 0.0, "max_wall_s": 0.0,
+        "unexpected": 0})
+    for s in spans:
+        if s["name"] != "compile":
+            continue
+        a = s.get("args", {})
+        row = fam_rows[a.get("family", "?")]
+        w = s.get("dur", 0.0) / 1e6
+        row["count"] += 1
+        row["total_wall_s"] += w
+        row["max_wall_s"] = max(row["max_wall_s"], w)
+        if a.get("sealed"):
+            row["unexpected"] += 1
+        for k in ("flops", "bytes_accessed", "temp_bytes",
+                  "output_bytes"):
+            if k in a:
+                row[k] = a[k]
+    compiles = {}
+    for fam, row in sorted(fam_rows.items()):
+        row["total_wall_s"] = round(row["total_wall_s"], 4)
+        row["max_wall_s"] = round(row["max_wall_s"], 4)
+        compiles[fam] = row
+    unexpected_recompiles = (
+        events.get("unexpected_recompile", 0)
+        or sum(r["unexpected"] for r in compiles.values()))
+
+    # -- counter-track summaries (ISSUE 14) -----------------------------
+    # per (replica track, counter name): sample count + min/mean/max
+    # and the final value — the text view of the Perfetto timelines
+    track_vals: dict = defaultdict(list)
+    for c in counters:
+        v = c.get("args", {}).get("value")
+        if v is not None:
+            track_vals[(c["pid"], c["name"])].append(float(v))
+    tracks: dict = {}
+    for (pid, name), vals in sorted(track_vals.items()):
+        tracks.setdefault(_pid_name(pid), {})[name] = {
+            "n": len(vals),
+            "min": round(min(vals), 4),
+            "mean": round(sum(vals) / len(vals), 4),
+            "max": round(max(vals), 4),
+            "last": round(vals[-1], 4),
+        }
+
+    # -- SLO section (ISSUE 14) -----------------------------------------
+    # violation events carry (policy, headroom at detection); the
+    # exported metrics snapshot carries the latest burn-rate /
+    # headroom gauges under the slo* namespaces
+    slo_events = [dict(e.get("args", {}))
+                  for e in insts if e["name"] == "slo_violation"]
+    slo_gauges = {
+        k: v for k, v in sorted(
+            (doc.get("metrics", {}).get("gauges") or {}).items())
+        if k.startswith("slo") or ".slo." in k}
+    slo = ({"violations": slo_events, "gauges": slo_gauges}
+           if (slo_events or slo_gauges) else None)
+
     return {
         "wall_s": round(wall_s, 4),
         "records": len(evts),
@@ -133,6 +213,10 @@ def analyze(doc: dict, top: int = 5) -> dict:
         "top_preempted": top_preempted,
         "top_migrated": top_migrated,
         "events": dict(events),
+        "compiles": compiles,
+        "unexpected_recompiles": unexpected_recompiles,
+        "tracks": tracks,
+        "slo": slo,
     }
 
 
@@ -158,6 +242,35 @@ def format_report(rep: dict) -> str:
         lines.append(f"top preempted: {rep['top_preempted']}")
     if rep["top_migrated"]:
         lines.append(f"top migrated: {rep['top_migrated']}")
+    if rep.get("compiles"):
+        verdict = rep.get("unexpected_recompiles", 0)
+        lines.append(f"compiles (unexpected={verdict}):")
+        for fam, r in rep["compiles"].items():
+            extra = "".join(
+                f" {k}={r[k]:g}" for k in ("flops", "bytes_accessed")
+                if k in r)
+            flag = (f" UNEXPECTED={r['unexpected']}"
+                    if r["unexpected"] else "")
+            lines.append(
+                f"  {fam:18s} n={r['count']:<4d} "
+                f"total={r['total_wall_s']:<9g} "
+                f"max={r['max_wall_s']:g}{extra}{flag}")
+    if rep.get("tracks"):
+        lines.append("counter tracks:")
+        for rname, tr in rep["tracks"].items():
+            for name, t in tr.items():
+                lines.append(
+                    f"  {rname}/{name:18s} n={t['n']:<5d} "
+                    f"min={t['min']:<8g} mean={t['mean']:<8g} "
+                    f"max={t['max']:<8g} last={t['last']:g}")
+    if rep.get("slo"):
+        slo = rep["slo"]
+        lines.append(f"slo: {len(slo['violations'])} violation "
+                     f"event(s)")
+        for v in slo["violations"]:
+            lines.append(f"  VIOLATION {v}")
+        for k, v in slo["gauges"].items():
+            lines.append(f"  {k} = {v:g}")
     lines.append(f"events: {rep['events']}")
     return "\n".join(lines)
 
