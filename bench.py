@@ -90,7 +90,8 @@ LKG = {
 # force the 8-CPU-device mesh before anything touches jax
 AUTO_MODES = ("mid4k", "mid8k", "1b", "resnet", "decode", "8b",
               "serving", "serving_tp", "serving_lora", "serving_dp",
-              "serving_kv8", "pp", "moe", "dit", "profile")
+              "serving_kv8", "serving_msteps", "pp", "moe", "dit",
+              "profile")
 
 MODE_TIMEOUT_S = {"serving": 3300, "decode": 2100, "8b": 3600}
 DEFAULT_TIMEOUT_S = 1800
@@ -1459,6 +1460,101 @@ def _kv8_logits_probe(model, block_size):
                  / max(float(np.max(np.abs(outs["fp"]))), 1e-9))
 
 
+def run_serving_msteps():
+    """Multi-step fused decode A/B (ISSUE 16 acceptance): the pinned
+    6-stream greedy workload served with multi_step=1 vs multi_step=4
+    on otherwise-identical ragged engines. One fused window runs
+    k * chunk_size decode iterations inside ONE device program
+    (lax.scan with in-program KV append, EOS bookkeeping and sampling
+    carried across iterations), so the k=4 leg must deliver >= 3x
+    fewer device dispatches per delivered token (asserted) at
+    equal-or-better tok/s, with greedy outputs TOKEN-IDENTICAL
+    (asserted in-row). Both legs run with profile_every=1 so every
+    dispatch feeds the sampled attribution histograms; the
+    host_schedule + dispatch_queue attribution — the ITL floor PR
+    14's observatory measured — is reported PER DELIVERED TOKEN and
+    must shrink on the fused leg (each fused window pays the
+    host-schedule + dispatch-queue floor once for k * chunk_size
+    tokens instead of once per chunk; measured ~2x on CPU)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+    from paddle_tpu.inference import ServingEngine, SamplingParams
+
+    cfg = llama_tiny()
+    n_str, plen, n_new = 6, 16, 128
+    block_size = 16
+    n_blocks = n_str * (-(-(plen + n_new) // block_size) + 1) + 2
+    rng = np.random.RandomState(0)
+    prompts = [rng.randint(0, cfg.vocab_size, plen).astype(np.int32)
+               for _ in range(n_str)]
+    out = {}
+    toks = {}
+    dpt = {}
+    tps = {}
+    for k in (1, 4):
+        paddle.seed(0)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        eng = ServingEngine(
+            model, max_batch_size=n_str, num_blocks=n_blocks,
+            block_size=block_size, prompt_buckets=(plen,),
+            chunk_size=4, prefill_chunk=plen, ragged=True,
+            multi_step=k, profile_every=1)
+        eng.warmup()
+        t0 = time.perf_counter()
+        rids = [eng.add_request(p,
+                                SamplingParams(max_new_tokens=n_new))
+                for p in prompts]
+        eng.run_to_completion()
+        wall = time.perf_counter() - t0
+        st = eng.stats()
+        toks[k] = [eng.result(r).tolist() for r in rids]
+        dpt[k] = st["device_dispatches"] / max(st["generated_tokens"],
+                                               1)
+        tps[k] = st["generated_tokens"] / wall
+        hg = eng._profile_metrics().snapshot()["histograms"]
+        host = hg["profile.host_schedule_s"]["sum"]
+        queue = hg["profile.dispatch_queue_s"]["sum"]
+        hq_us = 1e6 * (host + queue) / max(st["generated_tokens"], 1)
+        pre = f"serving_msteps_k{k}"
+        out[f"{pre}_tok_per_sec"] = round(tps[k], 1)
+        out[f"{pre}_itl_p50_s"] = round(st["itl_p50_s"], 4)
+        out[f"{pre}_itl_p99_s"] = round(st["itl_p99_s"], 4)
+        out[f"{pre}_device_dispatches"] = st["device_dispatches"]
+        out[f"{pre}_dispatches_per_token"] = round(dpt[k], 4)
+        out[f"{pre}_tokens_per_dispatch"] = round(
+            st["tokens_per_dispatch"], 2)
+        out[f"{pre}_fused_windows"] = st["multi_step_windows"]
+        out[f"{pre}_host_overhead_us_per_token"] = round(hq_us, 1)
+        out[f"{pre}_wall_s"] = round(wall, 3)
+        del eng, model
+        _clear_device_memory()
+    out["serving_msteps_tokens_identical"] = toks[4] == toks[1]
+    out["serving_msteps_dispatch_reduction_x"] = round(
+        dpt[1] / max(dpt[4], 1e-9), 2)
+    out["serving_msteps_tok_per_sec_ratio"] = round(
+        tps[4] / max(tps[1], 1e-9), 3)
+    out["serving_msteps_host_overhead_shrink_x"] = round(
+        out["serving_msteps_k1_host_overhead_us_per_token"]
+        / max(out["serving_msteps_k4_host_overhead_us_per_token"],
+              1e-9), 2)
+    assert out["serving_msteps_tokens_identical"], \
+        "multi_step=4 changed greedy outputs on the pinned workload"
+    assert out["serving_msteps_dispatch_reduction_x"] >= 3.0, \
+        (f"dispatch reduction "
+         f"{out['serving_msteps_dispatch_reduction_x']}x below the 3x "
+         f"acceptance bar")
+    assert out["serving_msteps_tok_per_sec_ratio"] >= 1.0, \
+        (f"fused decode must not cost throughput: k=4 at "
+         f"{out['serving_msteps_k4_tok_per_sec']} tok/s vs k=1 at "
+         f"{out['serving_msteps_k1_tok_per_sec']}")
+    assert out["serving_msteps_host_overhead_shrink_x"] > 1.0, \
+        (f"fused windows must amortize the host-schedule/dispatch-"
+         f"queue floor per token "
+         f"({out['serving_msteps_host_overhead_shrink_x']}x)")
+    return out
+
+
 def run_serving_spec():
     """Speculative decoding A/B (the ISSUE-9 acceptance scenario): 6
     greedy decode streams, spec on vs off, on TWO workload regimes:
@@ -2144,6 +2240,12 @@ def run_serving_suite():
     # OOM-preemptions on the oversubscribed burst)
     out.update(run_serving_kv8())
     _suite_barrier("serving_kv8", out)
+    # multi-step fused decode A/B (ISSUE 16): k=1 vs k=4 on the pinned
+    # greedy workload — >= 3x fewer dispatches per delivered token at
+    # equal-or-better tok/s, token identity asserted in-row, sampled
+    # host_schedule+dispatch_queue share reported per leg
+    out.update(run_serving_msteps())
+    _suite_barrier("serving_msteps", out)
     # speculative decoding A/B (ISSUE 9): repetitive vs adversarial
     # workloads, spec on/off — tok/s, ITL, acceptance rate, token
     # identity asserted inside the row
@@ -2422,6 +2524,12 @@ def main(mode: str):
                   "unit": "x",
                   "value": r["serving_kv8_bytes_per_token_reduction_x"],
                   "extra": r}
+    elif mode == "serving_msteps":
+        r = run_serving_msteps()
+        result = {"metric": "serving_msteps_dispatch_reduction_x",
+                  "unit": "x",
+                  "value": r["serving_msteps_dispatch_reduction_x"],
+                  "extra": r}
     elif mode == "serving_spec":
         r = run_serving_spec()
         result = {"metric": "serving_spec_rep_speedup_x",
@@ -2484,9 +2592,9 @@ _VALID_MODES = ("auto", "mid", "mid4k", "mid8k", "1b", "small", "tiny",
                 "resnet", "decode", "8b", "serving",
                 "serving_interleave", "serving_degradation",
                 "serving_ragged", "serving_trace", "serving_spec",
-                "serving_kv8", "serving_tp", "serving_lora",
-                "serving_dp", "pp", "moe", "dit", "profile",
-                "calibrate")
+                "serving_kv8", "serving_msteps", "serving_tp",
+                "serving_lora", "serving_dp", "pp", "moe", "dit",
+                "profile", "calibrate")
 
 if __name__ == "__main__":
     mode = sys.argv[1] if len(sys.argv) > 1 else "auto"

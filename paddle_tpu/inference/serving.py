@@ -380,7 +380,8 @@ class ServingEngine:
                  slo=None,
                  profile_every: Optional[int] = None,
                  profile_seed: int = 0,
-                 ragged_idle_cap: Optional[int] = None):
+                 ragged_idle_cap: Optional[int] = None,
+                 multi_step: int = 1):
         from .gpt_decode import PagedGPTDecoder
         # -- multi-chip tensor-parallel serving (ROADMAP 1) -----------------
         # tp=N builds a one-axis "tp" mesh over the first N devices and
@@ -409,6 +410,39 @@ class ServingEngine:
         if tp_comm not in (None, "fp32", "int8"):
             raise ValueError(f"tp_comm must be 'fp32' or 'int8', got "
                              f"{tp_comm!r}")
+        # -- multi-step fused decode (ISSUE 16) -----------------------------
+        # multi_step=k fuses k consecutive pure-decode serving steps
+        # into ONE device program: a lax.scan over k*T ragged decode
+        # ministeps with in-program KV append, in-program sampling
+        # carried across iterations, and on-device EOS bookkeeping (a
+        # per-column live mask freezes finished columns to the scratch
+        # slot, so late iterations are no-ops for them). The host
+        # collects k*T tokens per column per dispatch, amortizing the
+        # host-schedule + dispatch-queue floor the observatory
+        # measures. Scheduler invariants (admission, deadlines, epoch
+        # guards, preemption, debug_check) move to k-step boundaries:
+        # step() dispatches one whole window, so a mid-window cancel
+        # or deadline takes effect at the NEXT boundary. Fused windows
+        # only dispatch in the pure-decode regime — any prefilling
+        # slot drops the engine back to single-step chunks until the
+        # prefill drains, so chunked-prefill/splice semantics are
+        # untouched. Greedy outputs are token-identical to
+        # multi_step=1 (greedy sampling depends only on context, and
+        # a window never writes KV a single-step schedule would not).
+        multi_step = int(multi_step)
+        if multi_step < 1:
+            raise ValueError(f"multi_step must be >= 1, got "
+                             f"{multi_step}")
+        if multi_step > 1 and spec_decode is not None:
+            # both features re-schedule the decode token stream on
+            # device; composing them (draft windows inside a fused
+            # window) is ROADMAP work, not a silent interaction
+            raise ValueError(
+                "multi_step > 1 and spec_decode are mutually "
+                "exclusive: speculative verify windows re-plan every "
+                "step from collected acceptance truth, which a fused "
+                "k-step program cannot observe mid-window")
+        self.multi_step = multi_step
         # -- quantized KV cache (ISSUE 13) ----------------------------------
         # kv_quant="int8" stores the paged pool's k/v planes as int8
         # with per-slot-per-kv-head absmax scales in a sidecar plane:
@@ -809,6 +843,13 @@ class ServingEngine:
             # the tp serving step IS the sharded ragged program; the
             # dense per-phase dispatch path is not built for shard_map
             self.ragged = True
+        if self.multi_step > 1:
+            if not hasattr(dec, "_ragged_logits"):
+                raise ValueError(
+                    "multi-step fused decode needs a decoder with the "
+                    "ragged step program (_ragged_logits)")
+            # the fused window IS a ragged [k*T, W] program
+            self.ragged = True
         # -- speculative decoding (ISSUE 9) ---------------------------------
         # spec_decode=SpecConfig(...): each greedy decode column's k
         # draft tokens ride as EXTRA ROWS of the ragged program (the
@@ -870,6 +911,12 @@ class ServingEngine:
         self.lora_dispatches = 0
         self.lora_rows = 0
         self.masked_decode_columns = 0
+        # multi-step fused decode counters (stats(); reset by
+        # clear_finished): windows dispatched, and slot-steps a fused
+        # window scheduled but froze after an in-window EOS (the
+        # honest frozen-column share of padded_token_waste)
+        self.ms_windows = 0
+        self.ms_frozen_token_waste = 0
         self._ones_allowed_cache: Dict[int, jax.Array] = {}
         # composed allowed-mask operands, memoized per (rows, row ->
         # mask-identity) layout: a request's mask is immutable, so a
@@ -1245,6 +1292,219 @@ class ServingEngine:
                         self._spec_lora_j = jax.jit(
                             spec_lora_chunk, donate_argnums=(1, 2))
 
+            if self.multi_step > 1:
+                ms_scratch = self._scratch_slot
+
+                def ragged_ms_chunk(weights, k, v, prev_toks, last_t,
+                                    prev_col, use_host, override,
+                                    ids_all, pos_all, slots_all,
+                                    rseq_all, rctx_all, use_carry,
+                                    tables, temps_all, keys, eos_ids):
+                    """The fused k-step window (ISSUE 16): ragged_chunk
+                    over k*T decode ministeps with ON-DEVICE EOS
+                    bookkeeping. ``eos_ids`` [W] carries each column's
+                    EOS token id (-1 = none); a per-column ``live``
+                    mask rides the scan carry — once a column samples
+                    its EOS, later iterations redirect its KV append
+                    to the scratch slot (the write-neutralization
+                    mechanism preemption already uses) and freeze its
+                    carried token, so a finished column's remaining
+                    ministeps are no-ops whose outputs the host
+                    discards at the mid-chunk-EOS cut. The EOS token
+                    itself IS delivered (the freeze applies from the
+                    NEXT iteration), and its own KV never lands in
+                    real pages — exactly the single-step schedule, so
+                    greedy outputs are token-identical to
+                    multi_step=1."""
+                    first = jnp.where(use_host, override,
+                                      prev_toks[last_t, prev_col])
+                    live0 = jnp.ones(use_host.shape, bool)
+
+                    def step(carry, xs):
+                        cur, live, kp, vp = carry
+                        ids_d, pos, slots, rseq, rctx, uc, temp, key \
+                            = xs
+                        ids = jnp.where(uc, cur, ids_d)
+                        slots = jnp.where(live, slots, ms_scratch)
+                        logits, kp, vp = dec._ragged_logits(
+                            weights, kp, vp, ids, pos, slots, rseq,
+                            rctx, tables)
+                        nxt = self._sample(logits, temp, key)
+                        nxt = jnp.where(live, nxt, cur)
+                        live = live & (nxt != eos_ids)
+                        return (nxt, live, kp, vp), nxt
+
+                    (_, _, k, v), toks = jax.lax.scan(
+                        step, (first, live0, k, v),
+                        (ids_all, pos_all, slots_all, rseq_all,
+                         rctx_all, use_carry, temps_all, keys))
+                    return toks, k, v          # [k*T, W]
+
+                def ragged_ms_chunk_rich(weights, k, v, prev_toks,
+                                         last_t, prev_col, use_host,
+                                         override, ids_all, pos_all,
+                                         slots_all, rseq_all, rctx_all,
+                                         use_carry, tables, temps_all,
+                                         keys, eos_ids, top_ks_all,
+                                         top_ps_all, reps_all, seen,
+                                         upd, allowed):
+                    """Per-request-sampling twin of the fused window:
+                    the seen mask accumulates only while the column is
+                    live (a frozen column's repeated carried token
+                    must not re-mark itself — under multi_step=1 the
+                    request retires before any such iteration runs)."""
+                    first = jnp.where(use_host, override,
+                                      prev_toks[last_t, prev_col])
+                    live0 = jnp.ones(use_host.shape, bool)
+                    w = use_host.shape[0]
+
+                    def step(carry, xs):
+                        cur, live, kp, vp, seen_c = carry
+                        (ids_d, pos, slots, rseq, rctx, uc, temp, key,
+                         tks, tps, rp) = xs
+                        ids = jnp.where(uc, cur, ids_d)
+                        slots = jnp.where(live, slots, ms_scratch)
+                        logits, kp, vp = dec._ragged_logits(
+                            weights, kp, vp, ids, pos, slots, rseq,
+                            rctx, tables)
+                        nxt = self._sample_rich(logits, temp, key, tks,
+                                                tps, rp, seen_c,
+                                                allowed)
+                        nxt = jnp.where(live, nxt, cur)
+                        rows = jnp.arange(w)
+                        seen_c = seen_c.at[rows, nxt].set(
+                            seen_c[rows, nxt] | (upd & live))
+                        live = live & (nxt != eos_ids)
+                        return (nxt, live, kp, vp, seen_c), nxt
+
+                    (_, _, k, v, _), toks = jax.lax.scan(
+                        step, (first, live0, k, v, seen),
+                        (ids_all, pos_all, slots_all, rseq_all,
+                         rctx_all, use_carry, temps_all, keys,
+                         top_ks_all, top_ps_all, reps_all))
+                    return toks, k, v          # [k*T, W]
+
+                if self.tp > 1:
+                    # tp_wrap'd like the base families: every operand
+                    # past weights/k/v replicated, so tp=N multiplies
+                    # the per-block collectives by EXACTLY k — pinned
+                    # by comm_audit serving.ragged_k4_tp2
+                    self._ragged_ms_j = jax.jit(
+                        dec.tp_wrap(ragged_ms_chunk, n_extra=15),
+                        donate_argnums=(1, 2))
+                    self._ragged_ms_rich_j = jax.jit(
+                        dec.tp_wrap(ragged_ms_chunk_rich, n_extra=21),
+                        donate_argnums=(1, 2))
+                else:
+                    self._ragged_ms_j = jax.jit(
+                        ragged_ms_chunk, donate_argnums=(1, 2))
+                    self._ragged_ms_rich_j = jax.jit(
+                        ragged_ms_chunk_rich, donate_argnums=(1, 2))
+
+                if self.lora is not None:
+                    def ragged_ms_lora_chunk(weights, k, v, lora_pool,
+                                             shard_ids, lora_tables,
+                                             prev_toks, last_t,
+                                             prev_col, use_host,
+                                             override, ids_all,
+                                             pos_all, slots_all,
+                                             rseq_all, rctx_all,
+                                             use_carry, tables,
+                                             temps_all, keys, eos_ids):
+                        """ragged_ms_chunk with per-row LoRA deltas:
+                        the adapter-page factors are gathered ONCE per
+                        window (scan-invariant, PR 10's per-dispatch
+                        state riding the fused scan)."""
+                        lctx = _lora_ctx(lora_pool, shard_ids,
+                                         lora_tables)
+                        first = jnp.where(use_host, override,
+                                          prev_toks[last_t, prev_col])
+                        live0 = jnp.ones(use_host.shape, bool)
+
+                        def step(carry, xs):
+                            cur, live, kp, vp = carry
+                            (ids_d, pos, slots, rseq, rctx, uc, temp,
+                             key) = xs
+                            ids = jnp.where(uc, cur, ids_d)
+                            slots = jnp.where(live, slots, ms_scratch)
+                            logits, kp, vp = dec._ragged_logits(
+                                weights, kp, vp, ids, pos, slots,
+                                rseq, rctx, tables, lora=lctx)
+                            nxt = self._sample(logits, temp, key)
+                            nxt = jnp.where(live, nxt, cur)
+                            live = live & (nxt != eos_ids)
+                            return (nxt, live, kp, vp), nxt
+
+                        (_, _, k, v), toks = jax.lax.scan(
+                            step, (first, live0, k, v),
+                            (ids_all, pos_all, slots_all, rseq_all,
+                             rctx_all, use_carry, temps_all, keys))
+                        return toks, k, v          # [k*T, W]
+
+                    def ragged_ms_lora_chunk_rich(weights, k, v,
+                                                  lora_pool, shard_ids,
+                                                  lora_tables,
+                                                  prev_toks, last_t,
+                                                  prev_col, use_host,
+                                                  override, ids_all,
+                                                  pos_all, slots_all,
+                                                  rseq_all, rctx_all,
+                                                  use_carry, tables,
+                                                  temps_all, keys,
+                                                  eos_ids, top_ks_all,
+                                                  top_ps_all, reps_all,
+                                                  seen, upd, allowed):
+                        """ragged_ms_chunk_rich with per-row LoRA
+                        deltas."""
+                        lctx = _lora_ctx(lora_pool, shard_ids,
+                                         lora_tables)
+                        first = jnp.where(use_host, override,
+                                          prev_toks[last_t, prev_col])
+                        live0 = jnp.ones(use_host.shape, bool)
+                        w = use_host.shape[0]
+
+                        def step(carry, xs):
+                            cur, live, kp, vp, seen_c = carry
+                            (ids_d, pos, slots, rseq, rctx, uc, temp,
+                             key, tks, tps, rp) = xs
+                            ids = jnp.where(uc, cur, ids_d)
+                            slots = jnp.where(live, slots, ms_scratch)
+                            logits, kp, vp = dec._ragged_logits(
+                                weights, kp, vp, ids, pos, slots,
+                                rseq, rctx, tables, lora=lctx)
+                            nxt = self._sample_rich(logits, temp, key,
+                                                    tks, tps, rp,
+                                                    seen_c, allowed)
+                            nxt = jnp.where(live, nxt, cur)
+                            rows = jnp.arange(w)
+                            seen_c = seen_c.at[rows, nxt].set(
+                                seen_c[rows, nxt] | (upd & live))
+                            live = live & (nxt != eos_ids)
+                            return (nxt, live, kp, vp, seen_c), nxt
+
+                        (_, _, k, v, _), toks = jax.lax.scan(
+                            step, (first, live0, k, v, seen),
+                            (ids_all, pos_all, slots_all, rseq_all,
+                             rctx_all, use_carry, temps_all, keys,
+                             top_ks_all, top_ps_all, reps_all))
+                        return toks, k, v          # [k*T, W]
+
+                    if self.tp > 1:
+                        self._ragged_ms_lora_j = jax.jit(
+                            dec.tp_wrap(ragged_ms_lora_chunk,
+                                        n_extra=16, lora_pool=True),
+                            donate_argnums=(1, 2))
+                        self._ragged_ms_lora_rich_j = jax.jit(
+                            dec.tp_wrap(ragged_ms_lora_chunk_rich,
+                                        n_extra=22, lora_pool=True),
+                            donate_argnums=(1, 2))
+                    else:
+                        self._ragged_ms_lora_j = jax.jit(
+                            ragged_ms_lora_chunk, donate_argnums=(1, 2))
+                        self._ragged_ms_lora_rich_j = jax.jit(
+                            ragged_ms_lora_chunk_rich,
+                            donate_argnums=(1, 2))
+
         # -- program observatory: register every family (ISSUE 14) ----------
         # the registration order fixes the family names compile spans,
         # attribution histograms and trace_report tables use; `info`
@@ -1273,6 +1533,13 @@ class ServingEngine:
         if self.lora is not None:
             fams += [("ragged_lora", self._ragged_lora_j),
                      ("ragged_lora_rich", self._ragged_lora_rich_j)]
+        if self.multi_step > 1:
+            fams += [("ragged_ms", self._ragged_ms_j),
+                     ("ragged_ms_rich", self._ragged_ms_rich_j)]
+            if self.lora is not None:
+                fams += [("ragged_ms_lora", self._ragged_ms_lora_j),
+                         ("ragged_ms_lora_rich",
+                          self._ragged_ms_lora_rich_j)]
         if self.spec is not None:
             fams.append(("spec", self._spec_j))
             if self.lora is not None:
@@ -2973,16 +3240,28 @@ class ServingEngine:
         return cached
 
     def _ragged_plan(self):
-        """(T, dcols, takes): this step's decode columns and prefill
-        token takes, computed WITHOUT touching the allocator — the
-        shape pre-pass that fixes the (T, W) program variant before any
-        page is claimed (so a variant mismatch with the in-flight chunk
-        can flush the pipeline BEFORE the schedule is built)."""
+        """(T, dcols, takes, fused): this step's decode columns and
+        prefill token takes, computed WITHOUT touching the allocator —
+        the shape pre-pass that fixes the (T, W) program variant before
+        any page is claimed (so a variant mismatch with the in-flight
+        chunk can flush the pipeline BEFORE the schedule is built).
+        ``fused`` marks a multi-step window (ISSUE 16): in the
+        pure-decode regime — running slots, NO prefilling slot — the
+        plan scales the chunk rung to k*T ministeps, fusing k serving
+        steps into one program; any prefilling slot (mid-prefill,
+        splice-pending, fresh admission) drops back to single-step
+        chunks so chunked-prefill ITL bounds and splice watermarks
+        keep their per-step granularity."""
         running = [si for si in range(self.max_b)
                    if self._slots[si] is not None
                    and self._slots[si].state == "running"]
         T = self._force_chunk or (self._pick_chunk(running) if running
                                   else 1)
+        fused = (self.multi_step > 1 and bool(running)
+                 and not any(r is not None and r.state == "prefilling"
+                             for r in self._slots))
+        if fused:
+            T = T * self.multi_step
         dcols = []
         for si in running:
             req = self._slots[si]
@@ -3015,7 +3294,7 @@ class ServingEngine:
             take = min(budget, r.suffix_len - r.prefill_sent)
             takes.append((r, take))
             budget -= take
-        return T, dcols, takes
+        return T, dcols, takes, fused
 
     def _dispatch_ragged(self) -> bool:
         """Dispatch this step's ragged work: the speculative verify
@@ -3392,7 +3671,7 @@ class ServingEngine:
         t0 = time.perf_counter()
         cache = self.dec.cache
         mp = self.dec.max_pages
-        T, dcols, takes = self._ragged_plan()
+        T, dcols, takes, fused = self._ragged_plan()
         if not dcols and not takes:
             self.time_host_s += time.perf_counter() - t0
             return False
@@ -3414,7 +3693,7 @@ class ServingEngine:
                 self._collect_oldest()
             # collection may retire slots / deliver first tokens:
             # re-plan against the post-flush scheduler state
-            T, dcols, takes = self._ragged_plan()
+            T, dcols, takes, fused = self._ragged_plan()
             if not dcols and not takes:
                 self.time_host_s += time.perf_counter() - t0
                 return False
@@ -3642,6 +3921,19 @@ class ServingEngine:
             for req, _e in sched.values())
         prev_toks = prev["toks"] if prev is not None \
             else self._zeros_toks(T, W)
+        eos = None
+        if fused:
+            # on-device EOS bookkeeping operand: each surviving decode
+            # column's EOS id (-1 = no EOS configured — the column
+            # never freezes; the host still cuts at max_new via the
+            # steps clamp). Built AFTER the staleness sweep so a
+            # blanked column keeps -1 like any other scratch column.
+            eos = np.full(W, -1, np.int32)
+            for si, c in col_of.items():
+                e = reqs_of[si].sampling.eos_token_id
+                if e is not None:
+                    eos[c] = e
+            self.ms_windows += 1
         # under tp the split keys (committed to the default device)
         # re-place replicated on the tp mesh — an async device_put,
         # not a host sync; the key VALUES are identical to the tp=1
@@ -3662,6 +3954,8 @@ class ServingEngine:
             aj(last_t), aj(prev_col), aj(use_host), aj(override),
             aj(ids), aj(pos), aj(slots), aj(rseq), aj(rctx),
             aj(ucar), aj(tables), aj(temps), keys)
+        if fused:
+            args = args + (aj(eos),)
         try:
             if rich:
                 any_rep = any(r.sampling.repetition_penalty != 1.0
@@ -3694,15 +3988,23 @@ class ServingEngine:
                 self.masked_decode_columns += sum(
                     1 for si, _c in col_of.items()
                     if reqs_of[si].allowed_mask is not None)
-                prog = self._ragged_lora_rich_j if use_lora \
-                    else self._ragged_rich_j
+                if fused:
+                    prog = self._ragged_ms_lora_rich_j if use_lora \
+                        else self._ragged_ms_rich_j
+                else:
+                    prog = self._ragged_lora_rich_j if use_lora \
+                        else self._ragged_rich_j
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:ragged", prog, *args,
                     aj(top_ks), aj(top_ps), aj(reps), seen_dev,
                     aj(upd), allowed_dev)
             else:
-                prog = self._ragged_lora_j if use_lora \
-                    else self._ragged_j
+                if fused:
+                    prog = self._ragged_ms_lora_j if use_lora \
+                        else self._ragged_ms_j
+                else:
+                    prog = self._ragged_lora_j if use_lora \
+                        else self._ragged_j
                 toks, cache.k, cache.v = self._device_call(
                     "dispatch:ragged", prog, *args)
         except _DispatchFailed as e:
@@ -3733,17 +4035,23 @@ class ServingEngine:
                     else:
                         self._clear_pending_writes(req)
         if self.tracer is not None:
+            # k + decode_toks feed trace_report's dispatch-
+            # amortization table (tokens scheduled per program launch,
+            # split by fused-window depth)
             self.tracer.event(
                 "dispatch", pid=self.replica_id, kind="ragged",
                 T=int(T), W=int(W), decode_cols=len(col_of),
                 prefill_rows=int(sum(take_of.values())),
-                finals=len(finals))
+                finals=len(finals),
+                k=int(self.multi_step if fused else 1),
+                decode_toks=int(sum(steps_of.values())))
         self._inflight.append({
             "kind": "ragged", "toks": toks, "T": T, "W": W,
             "cols": dict(col_of), "steps": dict(steps_of),
             "reqs": dict(reqs_of), "epochs": dict(epochs_of),
             "finals": list(finals),
             "real_rows": sum(take_of.values()),
+            "k": self.multi_step if fused else 1,
             "free_after": []})
         self.time_host_s += time.perf_counter() - t0
         return True
@@ -3781,8 +4089,11 @@ class ServingEngine:
         self.time_stall_s += time.perf_counter() - t0
         now = time.perf_counter()
         self.decode_steps += ch["T"]
-        # ragged utilization accounting: the program ran T x W cells;
-        # useful work = delivered decode tokens + real prefill rows, so
+        # ragged utilization accounting: the program ran T x W cells
+        # (T is the WINDOW length k*T under multi_step — entry "T"
+        # carries the per-iteration row count, so tokens_per_dispatch
+        # and padded_token_waste stay honest per ministep); useful
+        # work = delivered decode tokens + real prefill rows, so
         # padded_token_waste is the true pad-to-grid remainder (plus
         # genuine post-EOS discards) — no scratch-slot steady waste
         self.decode_slot_steps += ch["T"] * ch["W"]
@@ -3802,9 +4113,15 @@ class ServingEngine:
                 self._last_tok[si] = tok
                 if self._is_finished(req):
                     break      # mid-chunk EOS: discard the tail
+            fin = self._is_finished(req)
+            if fin and delivered < steps and ch.get("k", 1) > 1:
+                # the in-window EOS froze this column: the remaining
+                # scheduled ministeps ran as scratch-aimed no-ops —
+                # count them so the fused path's waste is honest
+                self.ms_frozen_token_waste += steps - delivered
             self.decode_useful_tokens += delivered
             self._note_itl(req, now, delivered)
-            if self._is_finished(req) and self._slots[si] is req:
+            if fin and self._slots[si] is req:
                 self._retire(si)
         for req, epoch, t, c in ch["finals"]:
             if req.state != "prefilling" or req.epoch != epoch:
@@ -4522,37 +4839,38 @@ class ServingEngine:
             lora_pre = (cache.lora_pool, self._shard_ids,
                         aj(np.full((mb + 1, self.lora.n_pages()),
                                    self._scratch_block, np.int32)))
+        def ragged_tail(T, W):
+            z2 = np.zeros((T, W), np.int32)
+            return (self._zeros_toks(T, W),
+                    aj(np.zeros(W, np.int32)),
+                    aj(np.zeros(W, np.int32)),
+                    aj(np.ones(W, bool)),
+                    aj(np.zeros(W, np.int32)),
+                    aj(z2), aj(z2),
+                    aj(np.full((T, W), self._scratch_slot, np.int32)),
+                    aj(np.full((T, W), scratch_row, np.int32)),
+                    aj(z2),
+                    aj(np.zeros((T, W), bool)),
+                    aj(np.full((mb + 1, mp), self._scratch_block,
+                               np.int32)),
+                    aj(np.zeros((T, W), np.float32)),
+                    self._replicated(
+                        jax.random.split(jax.random.PRNGKey(0), T)))
+
+        def ragged_rich_tail(T, W):
+            return (aj(np.zeros((T, W), np.int32)),
+                    aj(np.ones((T, W), np.float32)),
+                    aj(np.ones((T, W), np.float32)),
+                    self._zeros_seen(W, vocab),
+                    aj(np.zeros(W, bool)),
+                    self._ones_allowed(W, vocab))
+
         for T in sorted(set(list(self.chunks) + [1])):
             for W in self.reachable_ragged_widths(T, max_width):
-                z2 = np.zeros((T, W), np.int32)
-                ids = aj(z2)
-                pos = aj(z2)
-                slots = aj(np.full((T, W), self._scratch_slot,
-                                   np.int32))
-                rseq = aj(np.full((T, W), scratch_row, np.int32))
-                rctx = aj(z2)
-                ucar = aj(np.zeros((T, W), bool))
-                temps = aj(np.zeros((T, W), np.float32))
-                tables = aj(np.full((mb + 1, mp), self._scratch_block,
-                                    np.int32))
-                last_t = aj(np.zeros(W, np.int32))
-                prev_col = aj(np.zeros(W, np.int32))
-                use_host = aj(np.ones(W, bool))
-                override = aj(np.zeros(W, np.int32))
-                keys = self._replicated(
-                    jax.random.split(jax.random.PRNGKey(0), T))
-                prev = self._zeros_toks(T, W)
-                tail = (prev, last_t, prev_col, use_host, override,
-                        ids, pos, slots, rseq, rctx, ucar, tables,
-                        temps, keys)
+                tail = ragged_tail(T, W)
                 _, cache.k, cache.v = obs(
                     self._ragged_j, weights, cache.k, cache.v, *tail)
-                rich_tail = (aj(np.zeros((T, W), np.int32)),
-                             aj(np.ones((T, W), np.float32)),
-                             aj(np.ones((T, W), np.float32)),
-                             self._zeros_seen(W, vocab),
-                             aj(np.zeros(W, bool)),
-                             self._ones_allowed(W, vocab))
+                rich_tail = ragged_rich_tail(T, W)
                 _, cache.k, cache.v = obs(
                     self._ragged_rich_j, weights, cache.k, cache.v,
                     *tail, *rich_tail)
@@ -4563,6 +4881,35 @@ class ServingEngine:
                     _, cache.k, cache.v = obs(
                         self._ragged_lora_rich_j, weights, cache.k,
                         cache.v, *lora_pre, *tail, *rich_tail)
+        if self.multi_step > 1:
+            # the (T, W, k) grid (ISSUE 16): fused windows dispatch at
+            # k x the chunk rung picked over running slots, and only
+            # in the pure-decode regime — but sticky-shrink can pad a
+            # window up to ANY width the same window length reached
+            # (including a prefill-widened single-step chunk when
+            # k*chunk collides with a chunk rung), so the fused
+            # families compile the full reachable width set per rung.
+            # Scratch-aimed operands like the base grid; eos -1 = the
+            # no-EOS schedule every all-neutralized window ships.
+            for T in sorted({self.multi_step * c for c in self.chunks}):
+                for W in self.reachable_ragged_widths(T, max_width):
+                    tail = ragged_tail(T, W)
+                    eos = aj(np.full(W, -1, np.int32))
+                    _, cache.k, cache.v = obs(
+                        self._ragged_ms_j, weights, cache.k, cache.v,
+                        *tail, eos)
+                    rich_tail = ragged_rich_tail(T, W)
+                    _, cache.k, cache.v = obs(
+                        self._ragged_ms_rich_j, weights, cache.k,
+                        cache.v, *tail, eos, *rich_tail)
+                    if self.lora is not None:
+                        _, cache.k, cache.v = obs(
+                            self._ragged_ms_lora_j, weights, cache.k,
+                            cache.v, *lora_pre, *tail, eos)
+                        _, cache.k, cache.v = obs(
+                            self._ragged_ms_lora_rich_j, weights,
+                            cache.k, cache.v, *lora_pre, *tail, eos,
+                            *rich_tail)
         if self.spec is not None:
             for W in self._spec_widths(max_width):
                 z1 = np.zeros(W, np.int32)
@@ -4624,6 +4971,10 @@ class ServingEngine:
         self.lora_dispatches = 0
         self.lora_rows = 0
         self.masked_decode_columns = 0
+        # multi-step fused-decode counters (ISSUE 16); the multi_step
+        # gauge itself is engine config and survives, like kv_quant
+        self.ms_windows = 0
+        self.ms_frozen_token_waste = 0
         # program-observatory counters (ISSUE 14): the engine-side
         # view resets with every other counter family; the
         # CompileWatch's own cumulative ledger (and its sealed flag)
@@ -4732,7 +5083,11 @@ class ServingEngine:
             # tokens are generated_tokens like any other delivered
             # token, so speculative decoding's win shows up here
             # directly (a verify dispatch delivers up to draft_len + 1
-            # tokens per column).
+            # tokens per column). Under multi_step=k a fused window is
+            # ONE launch delivering up to k*T tokens per column —
+            # decode_steps/slot_steps count its per-iteration rows
+            # (entry "T" carries the window length), so this ratio and
+            # the waste terms below stay per-ministep honest.
             "device_dispatches": self.device_dispatches,
             "tokens_per_dispatch": (
                 self.generated_tokens / self.device_dispatches
@@ -4765,6 +5120,17 @@ class ServingEngine:
                 self.lora_rows / self.lora_dispatches
                 if self.lora_dispatches else 0.0),
             "masked_decode_columns": self.masked_decode_columns,
+            # -- multi-step fused decode (ISSUE 16) -------------------
+            # multi_step_k: the engine's configured window depth (a
+            # config gauge, like kv_quant — clear_finished leaves it);
+            # multi_step_windows: fused windows dispatched;
+            # ms_frozen_token_waste: slot-steps scheduled into fused
+            # windows but frozen by an in-window EOS (a subset of
+            # padded_token_waste — the honest cost of running EOS
+            # bookkeeping on device instead of re-planning every step)
+            "multi_step_k": float(self.multi_step),
+            "multi_step_windows": self.ms_windows,
+            "ms_frozen_token_waste": self.ms_frozen_token_waste,
             "decode_slot_steps": self.decode_slot_steps,
             # ragged-aware: on the ragged path slot_steps counts the
             # [T, W] grid actually dispatched (W sized by real rows)

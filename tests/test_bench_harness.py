@@ -117,6 +117,15 @@ def _full_script(**overrides):
              "serving_kv8_tokens_identical": True,
              "serving_kv8_cap_fp32_oom_preemptions": 6,
              "serving_kv8_cap_int8_oom_preemptions": 1}), "")],
+        # serving_msteps joined AUTO_MODES in the ISSUE-16 PR — scripted
+        # same-PR (the PR-9 lesson, four times applied)
+        "serving_msteps": [(_simple(
+            "serving_msteps_dispatch_reduction_x", 3.4,
+            {"serving_msteps_dispatch_reduction_x": 3.4,
+             "serving_msteps_tokens_identical": True,
+             "serving_msteps_tok_per_sec_ratio": 1.2,
+             "serving_msteps_host_overhead_shrink_x": 1.9,
+             "serving_msteps_k4_fused_windows": 8}), "")],
         "pp": [(_simple("pp_remat_overhead_x", 0.991,
                         {"pp_remat_overhead_x": 0.991,
                          "pp_tick_fwd_ms": 0.086,

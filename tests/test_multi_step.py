@@ -1,0 +1,384 @@
+"""Multi-step fused decode (ISSUE 16): k serving steps in ONE device
+program.
+
+Layers under test:
+- token identity: greedy outputs under multi_step=k (k in {2, 4}) must
+  be token-identical to multi_step=1 across the serving matrix —
+  chunked prefill, prefix splices, preemption-with-recompute at
+  k-boundaries, kv_quant="int8", LoRA tenants, tp=2, the GPT twin;
+- on-device EOS bookkeeping: a column finishing mid-window freezes to
+  the scratch slot (late iterations are no-ops), the EOS token itself
+  is delivered, and ms_frozen_token_waste counts the frozen tail;
+- k-boundary semantics: mid-window cancellation and deadlines take
+  effect at the next boundary with partial tokens kept, survivors
+  unperturbed;
+- the sealed (T, W, k) program grid: warmup_programs + seal_programs
+  hold cold-free over fused traffic (unexpected_recompiles == 0);
+- stats plumbing: multi_step_k gauge, multi_step_windows and
+  ms_frozen_token_waste counters, tokens_per_dispatch counting
+  per-iteration rows, clear_finished reset behavior;
+- flag validation: multi_step >= 1, mutual exclusion with spec_decode.
+
+Runs in the invariant gate (check_serving_invariants.py) with
+PADDLE_TPU_POOL_DEBUG=1, so every k-boundary also asserts the pool
+invariant.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.inference import (AdapterRegistry, SamplingParams,
+                                  ServingEngine, SpecConfig)
+
+os.environ.setdefault("PADDLE_TPU_POOL_DEBUG", "1")
+
+CFG = llama_tiny()
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(n=4, seed=0, vocab=None, lens=(12, 9, 17, 21, 7, 14)):
+    rng = np.random.RandomState(seed)
+    v = vocab or CFG.vocab_size
+    return [rng.randint(1, v, ln).astype(np.int32) for ln in lens[:n]]
+
+
+def _engine(model, k, **kw):
+    kw.setdefault("max_batch_size", 3)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("prompt_buckets", (16, 32))
+    kw.setdefault("chunk_size", 4)
+    kw.setdefault("prefill_chunk", 8)
+    kw.setdefault("ragged", True)
+    return ServingEngine(model, multi_step=k, **kw)
+
+
+def _serve(eng, prompts, max_new=14, eos=None, aids=None):
+    aids = aids or [None] * len(prompts)
+    rids = [eng.add_request(
+        p, SamplingParams(max_new_tokens=max_new, temperature=0.0,
+                          eos_token_id=eos, adapter_id=a))
+        for p, a in zip(prompts, aids)]
+    eng.run_to_completion()
+    return [eng.result(r).tolist() for r in rids]
+
+
+# ---------------------------------------------------------------------------
+# flag surface
+# ---------------------------------------------------------------------------
+
+class TestFlagValidation:
+    def test_multi_step_below_one_raises(self, model):
+        with pytest.raises(ValueError, match="multi_step"):
+            _engine(model, 0)
+
+    def test_spec_decode_mutually_exclusive(self, model):
+        with pytest.raises(ValueError, match="mutually "
+                                             "exclusive"):
+            _engine(model, 4, spec_decode=SpecConfig(draft_len=2))
+
+    def test_multi_step_forces_ragged(self, model):
+        eng = _engine(model, 4, ragged=False)
+        assert eng.ragged is True
+        assert eng.multi_step == 4
+
+    def test_program_families_registered(self, model):
+        fams = dict(_engine(model, 2)._program_families())
+        assert "ragged_ms" in fams and "ragged_ms_rich" in fams
+        fams1 = dict(_engine(model, 1)._program_families())
+        assert "ragged_ms" not in fams1
+
+
+# ---------------------------------------------------------------------------
+# token identity matrix: k in {2, 4} vs k=1
+# ---------------------------------------------------------------------------
+
+class TestIdentityMatrix:
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_plain_and_mid_stream_arrivals(self, model, k):
+        """Mixed prompt lengths, chunked prefill, one mid-stream
+        arrival (drops the engine back to single-step until the
+        prefill drains, then re-fuses) — token identical to k=1."""
+        def leg(kk):
+            eng = _engine(model, kk)
+            prompts = _prompts(3)
+            rids = [eng.add_request(
+                p, SamplingParams(max_new_tokens=12, temperature=0.0))
+                for p in prompts]
+            for _ in range(3):
+                eng.step()
+            late = eng.add_request(
+                _prompts(4, seed=5)[3],
+                SamplingParams(max_new_tokens=9, temperature=0.0))
+            eng.run_to_completion()
+            st = eng.stats()
+            return ([eng.result(r).tolist() for r in rids + [late]],
+                    st)
+
+        t1, s1 = leg(1)
+        tk, sk = leg(k)
+        assert tk == t1
+        assert sk["multi_step_windows"] >= 1
+        assert sk["device_dispatches"] < s1["device_dispatches"]
+
+    @pytest.mark.parametrize("k", [2, 4])
+    def test_kv_quant_int8(self, model, k):
+        """kv_quant A/B runs BOTH legs quantized: int8 is its own
+        accuracy contract vs fp32, but k vs 1 on the SAME pool must
+        stay token-identical (tuple-aware in-program KV append)."""
+        t1 = _serve(_engine(model, 1, kv_quant="int8"), _prompts(3))
+        tk = _serve(_engine(model, k, kv_quant="int8"), _prompts(3))
+        assert tk == t1
+
+    def test_lora_routing(self, model):
+        """Adapter table routing rides the fused scan (gathered once
+        per window): mixed base/tenant columns, k=4 vs k=1."""
+        def leg(kk):
+            reg = AdapterRegistry(rank=2)
+            reg.register_random("t0", seed=5, scale=0.2)
+            return _serve(_engine(model, kk, lora=reg), _prompts(3),
+                          aids=["t0", None, "t0"])
+        assert leg(4) == leg(1)
+
+    def test_prefix_splice(self, model):
+        """Shared-prefix admissions splice cached blocks; decode then
+        fuses — k=4 vs k=1 across a splice-heavy workload."""
+        base = _prompts(1, seed=3, lens=(24,))[0]
+        prompts = [base, np.concatenate([base, [5, 7]]).astype(np.int32),
+                   np.concatenate([base, [11]]).astype(np.int32)]
+
+        def leg(kk):
+            eng = _engine(model, kk, prompt_buckets=(16, 32, 64))
+            out = _serve(eng, prompts, max_new=10)
+            return out, eng.stats()["prefix_cache_hit_tokens"]
+
+        (t1, h1), (t4, h4) = leg(1), leg(4)
+        assert t4 == t1
+        assert h1 > 0 and h4 == h1
+
+    def test_preemption_recompute_at_k_boundary(self, model):
+        """A pool sized to force OOM preemption mid-run: the victim's
+        whole fused window is neutralized (scratch-aimed), it resumes
+        by recompute, and outputs still match k=1."""
+        def leg(kk):
+            eng = _engine(model, kk, num_blocks=14, block_size=4,
+                          max_batch_size=3, admission="optimistic")
+            out = _serve(eng, _prompts(3, lens=(9, 11, 8)), max_new=16)
+            return out, eng.stats()["preemptions"]
+
+        (t1, p1), (t4, p4) = leg(1), leg(4)
+        assert t4 == t1
+        assert p4 >= 1, "workload must actually exercise preemption"
+
+    def test_tp2(self, model):
+        """tp=2 fused windows: the shared TP mixin wraps the ms family
+        like the base one — outputs match the tp=2 k=1 engine."""
+        import jax
+        from jax.sharding import Mesh
+        from paddle_tpu.inference.paged_decode import PagedLlamaDecoder
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+
+        def leg(kk):
+            mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+            dec = PagedLlamaDecoder(model, num_blocks=32, block_size=8,
+                                    mesh=mesh, mp_axis="tp",
+                                    tp_shard_map=True)
+            eng = ServingEngine(dec, tp=2, multi_step=kk,
+                                max_batch_size=3,
+                                prompt_buckets=(16, 32), chunk_size=4,
+                                prefill_chunk=8)
+            return _serve(eng, _prompts(3), max_new=10)
+
+        assert leg(4) == leg(1)
+
+    def test_gpt_twin(self):
+        from paddle_tpu.models.gpt import GPTForCausalLM, gpt_tiny
+        from paddle_tpu.inference import PagedGPTDecoder
+        paddle.seed(0)
+        gm = GPTForCausalLM(gpt_tiny())
+        gm.eval()
+
+        def leg(kk):
+            dec = PagedGPTDecoder(gm, num_blocks=32, block_size=8)
+            eng = ServingEngine(dec, multi_step=kk, max_batch_size=3,
+                                prompt_buckets=(16, 32), chunk_size=4,
+                                prefill_chunk=8, ragged=True)
+            return _serve(eng, _prompts(3, vocab=gm.cfg.vocab_size),
+                          max_new=10)
+
+        assert leg(4) == leg(1)
+
+
+# ---------------------------------------------------------------------------
+# on-device EOS bookkeeping
+# ---------------------------------------------------------------------------
+
+class TestEOSMidWindow:
+    def _eos_from_probe(self, model, prompts, max_new=16):
+        """Pick an EOS id that provably fires mid-stream: probe the
+        greedy continuation without EOS and take a token the first
+        stream emits in its middle third."""
+        probe = _serve(_engine(model, 1), prompts, max_new=max_new)
+        return int(probe[0][max_new // 2])
+
+    def test_eos_mid_window_identity_and_frozen_waste(self, model):
+        prompts = _prompts(3)
+        eos = self._eos_from_probe(model, prompts)
+
+        def leg(kk):
+            eng = _engine(model, kk)
+            out = _serve(eng, prompts, max_new=16, eos=eos)
+            return out, eng.stats()
+
+        (t1, s1), (t4, s4) = leg(1), leg(4)
+        assert t4 == t1
+        # at least the probed stream cut on EOS mid-run
+        assert any(o[-1] == eos and len(o) < 16 for o in t4)
+        # the frozen tail of the EOS column is counted honestly, and
+        # it is a subset of the overall padded waste
+        assert s4["ms_frozen_token_waste"] >= 1
+        assert s4["ms_frozen_token_waste"] <= s4["padded_token_waste"]
+        assert s1["ms_frozen_token_waste"] == 0
+
+    def test_eos_on_window_boundary_no_waste(self, model):
+        """max_new an exact multiple of the window length and no EOS:
+        every scheduled ministep delivers — zero frozen waste."""
+        eng = _engine(model, 4, chunk_size=2)
+        _serve(eng, _prompts(2, lens=(9, 11)), max_new=16)
+        st = eng.stats()
+        assert st["multi_step_windows"] >= 1
+        assert st["ms_frozen_token_waste"] == 0
+
+
+# ---------------------------------------------------------------------------
+# k-boundary semantics: cancel / deadline
+# ---------------------------------------------------------------------------
+
+class TestKBoundary:
+    def test_cancel_mid_window_takes_effect_next_boundary(self, model):
+        """cancel() between k-boundaries: the victim lands ABORTED
+        with its partial tokens kept, survivors finish with outputs
+        identical to an undisturbed k=1 run of the same survivors."""
+        prompts = _prompts(3)
+        eng = _engine(model, 4)
+        # budget of 40 > the 16-token fused window, so the victim is
+        # still mid-flight after its first window lands
+        rids = [eng.add_request(
+            p, SamplingParams(max_new_tokens=40, temperature=0.0))
+            for p in prompts]
+        # run up to a point where decode windows are in flight
+        for _ in range(4):
+            eng.step()
+        assert eng.cancel(rids[1]) is True
+        eng.run_to_completion()
+        victim = eng.result(rids[1])
+        assert eng._find_request(rids[1]).state == "aborted"
+        assert len(victim) < 40          # cut before its budget
+        # survivors: same tokens as a clean k=1 run (cancellation of a
+        # neighbor never perturbs the epoch-guarded columns)
+        clean = _serve(_engine(model, 1), [prompts[0], prompts[2]],
+                       max_new=40)
+        assert eng.result(rids[0]).tolist() == clean[0]
+        assert eng.result(rids[2]).tolist() == clean[1]
+        assert eng.stats()["aborted"] == 1
+
+    def test_deadline_enforced_at_boundary(self, model):
+        """A 0-second deadline aborts at the NEXT k-boundary (the
+        enforcement sweep runs once per step), not mid-window."""
+        eng = _engine(model, 4)
+        rid = eng.add_request(
+            _prompts(1)[0],
+            SamplingParams(max_new_tokens=30, temperature=0.0,
+                           deadline_s=1e-9))
+        eng.run_to_completion()
+        assert eng.stats()["deadline_misses"] == 1
+        assert len(eng.result(rid)) < 30
+
+
+# ---------------------------------------------------------------------------
+# sealed (T, W, k) grid
+# ---------------------------------------------------------------------------
+
+class TestSealedGrid:
+    def test_fused_traffic_holds_cold_free(self, model):
+        """warmup_programs + seal_programs, then a fused workload with
+        mid-stream arrivals and EOS cuts: zero unexpected recompiles —
+        the (T, W, k) grid is closed."""
+        eng = _engine(model, 4, ragged_idle_cap=8)
+        eng.warmup_programs()
+        eng.seal_programs()
+        eng.clear_finished()
+        prompts = _prompts(3)
+        rids = [eng.add_request(
+            p, SamplingParams(max_new_tokens=12, temperature=0.0,
+                              eos_token_id=3))
+            for p in prompts]
+        for _ in range(3):
+            eng.step()
+        eng.add_request(_prompts(4, seed=9)[3],
+                        SamplingParams(max_new_tokens=7,
+                                       temperature=0.0))
+        eng.run_to_completion()
+        st = eng.stats()
+        assert st["programs_sealed"] is True
+        assert st["unexpected_recompiles"] == 0
+        assert st["multi_step_windows"] >= 1
+
+    def test_rich_sampling_window_in_grid(self, model):
+        """A temperature>0 / top-p request routes the fused window
+        through the rich twin — also in the sealed grid."""
+        eng = _engine(model, 2, ragged_idle_cap=8)
+        eng.warmup_programs()
+        eng.seal_programs()
+        eng.clear_finished()
+        eng.add_request(_prompts(1)[0],
+                        SamplingParams(max_new_tokens=8,
+                                       temperature=0.8, top_p=0.9))
+        eng.run_to_completion()
+        assert eng.stats()["unexpected_recompiles"] == 0
+
+
+# ---------------------------------------------------------------------------
+# stats plumbing
+# ---------------------------------------------------------------------------
+
+class TestStats:
+    def test_gauge_counters_and_per_iteration_rows(self, model):
+        eng = _engine(model, 4, chunk_size=2)
+        _serve(eng, _prompts(2, lens=(9, 11)), max_new=16)
+        st = eng.stats()
+        assert st["multi_step_k"] == 4.0
+        assert st["multi_step_windows"] >= 1
+        # decode accounting counts per-iteration rows: a fused window
+        # of L ministeps adds L to decode_steps — with 16-token
+        # budgets fully delivered, useful decode tokens dominate the
+        # the slot-step grid and tokens_per_dispatch beats the k=1 run
+        eng1 = _engine(model, 1, chunk_size=2)
+        _serve(eng1, _prompts(2, lens=(9, 11)), max_new=16)
+        s1 = eng1.stats()
+        assert st["generated_tokens"] == s1["generated_tokens"]
+        assert st["device_dispatches"] < s1["device_dispatches"]
+        assert st["tokens_per_dispatch"] > s1["tokens_per_dispatch"]
+        assert st["decode_steps"] >= 16
+
+    def test_clear_finished_resets_counters_keeps_gauge(self, model):
+        eng = _engine(model, 4)
+        _serve(eng, _prompts(2), max_new=8)
+        st = eng.stats()
+        assert st["multi_step_windows"] >= 1
+        eng.clear_finished()
+        st2 = eng.stats()
+        assert st2["multi_step_windows"] == 0
+        assert st2["ms_frozen_token_waste"] == 0
+        assert st2["multi_step_k"] == 4.0      # config gauge survives

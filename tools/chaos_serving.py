@@ -53,6 +53,13 @@ then on ANY XLA retrace the fault schedule provokes lands in
 flightcheck's static FC2xx rules: a schedule path that quietly
 compiles mid-run (an unexpected shape, a weak-type flip, an unstable
 cache key) is a gate failure, not an ITL spike.
+
+--multi-step k (ISSUE 16) runs the schedule with fused k-step decode
+windows (implies ragged): every OOM preemption neutralizes a whole
+fused window, cancellations land at the next k-boundary, debug_check
+runs per boundary, and --require-events additionally demands >=1
+fused window actually dispatched (multi_step_windows >= 1) so the leg
+cannot silently spend the whole schedule in the prefill regime.
 """
 from __future__ import annotations
 
@@ -108,7 +115,8 @@ def build_engine(model, args, tracer=None):
         # a bounded idle-drain width closes the reachable (T, W)
         # program grid, which is what makes --seal-programs assertable
         # (ISSUE 14); both runs share the bound so schedules match
-        ragged_idle_cap=getattr(args, "ragged_idle_cap", None))
+        ragged_idle_cap=getattr(args, "ragged_idle_cap", None),
+        multi_step=getattr(args, "multi_step", 1))
 
 
 def build_fleet(model, args, tracer=None):
@@ -345,6 +353,18 @@ def build_parser() -> argparse.ArgumentParser:
                          "resume, cancellation — must stay "
                          "token-identical vs the fault-free replay "
                          "(implies ragged)")
+    ap.add_argument("--multi-step", type=int, default=1,
+                    dest="multi_step",
+                    help="multi-step fused decode depth (ISSUE 16): "
+                         "both runs fuse k serving steps into one "
+                         "device program in the pure-decode regime — "
+                         "the whole fault schedule (OOM-preemption "
+                         "neutralizing whole windows, injected "
+                         "dispatch faults, mid-window cancellation "
+                         "taking effect at the next k-boundary, "
+                         "debug_check after every boundary) must stay "
+                         "token-identical vs the fault-free replay "
+                         "(implies ragged)")
     ap.add_argument("--spec", action="store_true",
                     help="exercise speculative decoding (ISSUE 9): "
                          "both runs serve with "
@@ -510,10 +530,14 @@ def main() -> int:
 
     st = eng.stats()
     summary = {
-        "ragged": args.ragged or args.tp > 1 or args.spec or args.lora,
+        "ragged": args.ragged or args.tp > 1 or args.spec or args.lora
+        or args.multi_step > 1,
         "tp": args.tp,
         "spec": bool(args.spec),
         "lora": bool(args.lora),
+        "multi_step": args.multi_step,
+        "multi_step_windows": st["multi_step_windows"],
+        "ms_frozen_token_waste": st["ms_frozen_token_waste"],
         "kv_quant": st["kv_quant"],
         "kv_bytes_per_token": st["kv_bytes_per_token"],
         "active_adapters": st["active_adapters"],
@@ -558,6 +582,10 @@ def main() -> int:
             # the spec leg must actually exercise the rejected-tail
             # rollback path, not just ride accepted drafts
             missing.append("draft_rejection")
+        if args.multi_step > 1 and st["multi_step_windows"] < 1:
+            # the multi-step leg must actually dispatch fused windows,
+            # not spend the whole schedule in the prefill regime
+            missing.append("fused_window")
         if args.lora:
             # the lora leg must actually exercise adapter paging, not
             # just ride two permanently-resident adapters: at least

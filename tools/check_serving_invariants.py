@@ -54,6 +54,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_telemetry.py"),
     os.path.join(REPO, "tests", "test_kv_quant.py"),
     os.path.join(REPO, "tests", "test_program_observatory.py"),
+    os.path.join(REPO, "tests", "test_multi_step.py"),
 ]
 
 
@@ -150,12 +151,19 @@ def run_chaos() -> int:
     # runtime FC2xx) fails its leg via unexpected_recompiles != 0;
     # the dp2 trace is additionally validated for counter-track
     # schema and >= 1 compile span (validate_trace below)
+    # ISSUE 16: the ragged_ms4 leg re-runs the schedule with
+    # multi_step=4 — k serving steps fused into ONE device program.
+    # Every OOM preemption neutralizes a whole fused window, every
+    # cancellation lands at a k-boundary, debug_check runs per
+    # boundary, and --require-events additionally demands >= 1 fused
+    # window actually dispatched (multi_step_windows >= 1).
     for tag, leg in (("dense", ()), ("ragged", ("--ragged",)),
                      ("ragged_kv8", ("--ragged", "--kv-quant", "int8")),
                      ("tp2", ("--tp", "2")), ("spec", ("--spec",)),
                      ("lora", ("--lora", "--num-blocks", "20",
                                "--requests", "12")),
-                     ("dp2", ("--dp", "2"))):
+                     ("dp2", ("--dp", "2")),
+                     ("ragged_ms4", ("--ragged", "--multi-step", "4"))):
         trace_path = os.path.join(trace_dir, f"chaos_{tag}.trace.json")
         cmd = [sys.executable,
                os.path.join(REPO, "tools", "chaos_serving.py"),

@@ -433,6 +433,47 @@ def _build_tp_serving():
             return eng._ragged_lora_j, args
         return build
 
+    def _mk_ms():
+        def build():
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
+            from jax.sharding import Mesh
+            from paddle_tpu.inference.paged_decode import \
+                PagedLlamaDecoder
+            from paddle_tpu.inference.serving import ServingEngine
+            from paddle_tpu.models.llama import LlamaConfig
+            cfg = LlamaConfig(
+                vocab_size=64, hidden_size=32, intermediate_size=64,
+                num_hidden_layers=2, num_attention_heads=4,
+                num_key_value_heads=2, max_position_embeddings=64)
+            mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+            dec = PagedLlamaDecoder.from_config(
+                cfg, num_blocks=8, block_size=4, mesh=mesh,
+                mp_axis="tp", tp_shard_map=True, tp_comm="fp32")
+            eng = ServingEngine(dec, tp=2, tp_comm="fp32",
+                                multi_step=4, max_batch_size=2,
+                                prompt_buckets=(8, 16), chunk_size=2,
+                                prefill_chunk=4)
+            # the fused window: k * chunk_size ministeps in ONE
+            # program (the shapes the scheduler dispatches when every
+            # running slot is decoding), plus the per-column eos ids
+            # the on-device finish bookkeeping consumes
+            T, W = 4 * 2, 4
+            S = jax.ShapeDtypeStruct
+            i32, f32 = jnp.int32, jnp.float32
+            args = (dec.weights, dec.cache.k, dec.cache.v,
+                    S((T, W), i32), S((W,), i32), S((W,), i32),
+                    S((W,), jnp.bool_), S((W,), i32),
+                    S((T, W), i32), S((T, W), i32), S((T, W), i32),
+                    S((T, W), i32), S((T, W), i32),
+                    S((T, W), jnp.bool_),
+                    S((eng.max_b + 1, dec.max_pages), i32),
+                    S((T, W), f32), S((T, 2), jnp.uint32),
+                    S((W,), i32))
+            return eng._ragged_ms_j, args
+        return build
+
     def _mk_dp():
         def build():
             import jax
@@ -492,6 +533,17 @@ def _build_tp_serving():
             # scales (a mis-sharded sidecar) changes these counts and
             # fails the 4s gate
             "serving.ragged_kv8_tp2": _mk("fp32", kv_quant="int8"),
+            # ISSUE 16: the multi-step fused window at k=4 must pin
+            # EXACTLY k x the per-ministep collectives of the T=2
+            # baseline above (4x the T, 4x the psums and logits
+            # gathers, nothing else): the scan carry (sampled tokens,
+            # live mask, KV pool planes) is shard-local, the
+            # on-device EOS bookkeeping compares post-gather
+            # replicated tokens, and the per-iteration KV append
+            # stays collective-free — a refactor that syncs the
+            # carry or double-gathers logits changes these counts
+            # and fails the 4s gate
+            "serving.ragged_k4_tp2": _mk_ms(),
             "serving.ragged_spec_tp2": _mk_spec(),
             # ISSUE 11: a dp x tp FLEET replica's ragged step — built
             # through the Router on row 1 of the SpecLayout 2x2 device

@@ -23,7 +23,11 @@ a red gate run (or a bench artifact) needs without opening the UI:
   resource timeline (running slots, free blocks, queue depth, ...)
   per replica track;
 - SLO section: ``slo_violation`` events plus the burn-rate / headroom
-  gauges riding the exported metrics snapshot.
+  gauges riding the exported metrics snapshot;
+- dispatch amortization (ISSUE 16): tokens per dispatch grouped by
+  (kind, fused-window depth k) off the dispatch events' ``k`` /
+  ``decode_toks`` args, plus sampled device-execute totals per
+  program family (the ragged_ms* families are the k>1 windows).
 
 Pure host tool: no jax, no paddle_tpu import — runs anywhere the JSON
 does.
@@ -188,6 +192,44 @@ def analyze(doc: dict, top: int = 5) -> dict:
             "last": round(vals[-1], 4),
         }
 
+    # -- dispatch amortization (ISSUE 16) -------------------------------
+    # ragged dispatch events carry k (fused-window depth) and
+    # decode_toks (decode tokens the window delivers); grouping by
+    # (kind, k) shows the tokens-per-dispatch amortization the
+    # multi-step refactor buys, and the per-family execute totals from
+    # the sampled attribution events split the device wall by program
+    # family (the ragged_ms* families are the k>1 windows)
+    amort_rows: dict = defaultdict(
+        lambda: {"dispatches": 0, "decode_toks": 0})
+    for e in insts:
+        if e["name"] != "dispatch":
+            continue
+        a = e.get("args", {})
+        row = amort_rows[(a.get("kind", "?"), int(a.get("k", 1)))]
+        row["dispatches"] += 1
+        row["decode_toks"] += int(a.get("decode_toks", 0))
+    amort: dict = {}
+    for (kind, kk), row in sorted(amort_rows.items()):
+        amort[f"{kind} k={kk}"] = {
+            "dispatches": row["dispatches"],
+            "decode_toks": row["decode_toks"],
+            "toks_per_dispatch": round(
+                row["decode_toks"] / row["dispatches"], 2),
+        }
+    exec_by_family: dict = defaultdict(
+        lambda: {"samples": 0, "execute_s": 0.0})
+    for e in insts:
+        if e["name"] == "profile_sample":
+            a = e.get("args", {})
+            r = exec_by_family[a.get("family", "?")]
+            r["samples"] += 1
+            r["execute_s"] += float(a.get("execute_s", 0.0))
+    execute = {fam: {"samples": r["samples"],
+                     "execute_s": round(r["execute_s"], 4)}
+               for fam, r in sorted(exec_by_family.items())}
+    amortization = ({"dispatch": amort, "execute_by_family": execute}
+                    if amort or execute else None)
+
     # -- SLO section (ISSUE 14) -----------------------------------------
     # violation events carry (policy, headroom at detection); the
     # exported metrics snapshot carries the latest burn-rate /
@@ -216,6 +258,7 @@ def analyze(doc: dict, top: int = 5) -> dict:
         "compiles": compiles,
         "unexpected_recompiles": unexpected_recompiles,
         "tracks": tracks,
+        "amortization": amortization,
         "slo": slo,
     }
 
@@ -263,6 +306,21 @@ def format_report(rep: dict) -> str:
                     f"  {rname}/{name:18s} n={t['n']:<5d} "
                     f"min={t['min']:<8g} mean={t['mean']:<8g} "
                     f"max={t['max']:<8g} last={t['last']:g}")
+    if rep.get("amortization"):
+        am = rep["amortization"]
+        if am["dispatch"]:
+            lines.append("dispatch amortization:")
+            for key, r in am["dispatch"].items():
+                lines.append(
+                    f"  {key:22s} dispatches={r['dispatches']:<5d} "
+                    f"decode_toks={r['decode_toks']:<7d} "
+                    f"toks/dispatch={r['toks_per_dispatch']:g}")
+        if am["execute_by_family"]:
+            lines.append("device execute by family (sampled):")
+            for fam, r in am["execute_by_family"].items():
+                lines.append(
+                    f"  {fam:18s} samples={r['samples']:<5d} "
+                    f"execute={r['execute_s']:g}s")
     if rep.get("slo"):
         slo = rep["slo"]
         lines.append(f"slo: {len(slo['violations'])} violation "
