@@ -195,7 +195,11 @@ def _gather_prefix_pages(pool, prefix_tables):
     ((int8, scales) tuples — ISSUE 13) dequantize at the gather, the
     same fused read every other pool consumer uses."""
     from ..ops.paged_attention import _dequantize_gather
-    g = _dequantize_gather(pool, prefix_tables)  # [b, P, kvh, bs, d]
+    # bounded, deliberate materialization: prefix_tables holds only
+    # each row's OWN prefix pages (b * P_prefix, not the pool), and
+    # _prefix_suffix_attention's einsum program shape needs the
+    # contiguous [b, kvh, P*bs, d] block
+    g = _dequantize_gather(pool, prefix_tables)  # flightcheck: disable=FC701
     b, p, kvh, bs, d = g.shape
     return g.transpose(0, 2, 1, 3, 4).reshape(b, kvh, p * bs, d)
 

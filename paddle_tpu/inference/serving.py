@@ -1122,8 +1122,12 @@ class ServingEngine:
                     rows addressed by row_seq, the scratch row reading
                     the scratch block's all-zero page (the null
                     adapter every base-only row costs)."""
-                    flat = jnp.take(lora_pool, lora_tables.reshape(-1),
-                                    axis=0)
+                    # bounded, deliberate: S * n_pages adapter pages
+                    # (the slots' own tables, not the pool), gathered
+                    # once per dispatch outside the decode scan
+                    flat = jnp.take(  # flightcheck: disable=FC701
+                        lora_pool, lora_tables.reshape(-1),
+                        axis=0, mode="clip")
                     flat = flat.reshape(lora_tables.shape[0], -1)
                     return (layout, flat, shard_ids[0])
 

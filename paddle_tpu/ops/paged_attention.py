@@ -68,12 +68,18 @@ def quantize_kv_rows(x):
 def _dequantize_gather(plane, idx):
     """jnp.take over a pool plane's leading (page) axis with dequant
     fused at the gather: tuple planes come back as f32
-    values * per-slot scales, dense planes gather as-is."""
+    values * per-slot scales, dense planes gather as-is.
+
+    mode="clip": unused table slots hold sentinel page ids; the
+    default out-of-bounds mode fills float gathers with NaN, which a
+    downstream mask multiplies to NaN, not zero. Clipped reads land on
+    a real page and the per-position mask discards them."""
     if isinstance(plane, tuple):
         vals, scales = plane
-        return jnp.take(vals, idx, axis=0).astype(jnp.float32) \
-            * jnp.take(scales, idx, axis=0)[..., None]
-    return jnp.take(plane, idx, axis=0)
+        return jnp.take(vals, idx, axis=0, mode="clip") \
+            .astype(jnp.float32) \
+            * jnp.take(scales, idx, axis=0, mode="clip")[..., None]
+    return jnp.take(plane, idx, axis=0, mode="clip")
 
 
 class KVCacheExhausted(RuntimeError):
@@ -135,29 +141,17 @@ def paged_attention_decode_reference(q, k_cache, v_cache, block_tables,
     context_lens: [batch] int32 — valid tokens per sequence (incl. this)
     Returns [batch, num_heads, head_dim].
     """
-    b, nh, d = q.shape
-    nb, kvh, bs, _ = _plane_values(k_cache).shape
-    max_blocks = block_tables.shape[1]
-    if scale is None:
-        scale = 1.0 / np.sqrt(d)
-    group = nh // kvh  # GQA: queries per kv head
-
-    # gather each sequence's blocks: [b, max_blocks, kvh, bs, d]
-    k = _dequantize_gather(k_cache, block_tables)
-    v = _dequantize_gather(v_cache, block_tables)
-    k = k.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
-    v = v.transpose(0, 2, 1, 3, 4).reshape(b, kvh, max_blocks * bs, d)
-
-    qg = q.reshape(b, kvh, group, d)
-    # scores: [b, kvh, group, S]
-    scores = jnp.einsum("bkgd,bksd->bkgs", qg.astype(jnp.float32),
-                        k.astype(jnp.float32)) * scale
-    pos = jnp.arange(max_blocks * bs)[None, None, None, :]
-    mask = pos < context_lens[:, None, None, None]
-    scores = jnp.where(mask, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1)
-    out = jnp.einsum("bkgs,bksd->bkgd", probs, v.astype(jnp.float32))
-    return out.reshape(b, nh, d).astype(q.dtype)
+    # A pure decode batch is the ragged program with one row per
+    # sequence and the identity row->table mapping; delegating reuses
+    # the online-softmax page walk, so the dense oracle no longer
+    # materializes every row's whole [max_blocks * bs] K/V (the flat
+    # _dequantize_gather this function used to do — FC701's
+    # pool-traffic class; a decode row always has context_lens >= 1,
+    # so the refs agree everywhere the dense path is defined).
+    b = q.shape[0]
+    return ragged_paged_attention_reference(
+        q, k_cache, v_cache, block_tables,
+        jnp.arange(b, dtype=jnp.int32), context_lens, scale)
 
 
 def ragged_paged_attention_reference(q, k_cache, v_cache, block_tables,

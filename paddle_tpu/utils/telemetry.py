@@ -466,6 +466,8 @@ class CompileWatch:
                 getattr(ma, "temp_size_in_bytes", 0))
             out["output_bytes"] = float(
                 getattr(ma, "output_size_in_bytes", 0))
+            out["argument_bytes"] = float(
+                getattr(ma, "argument_size_in_bytes", 0))
         except Exception:       # noqa: BLE001
             pass
         return out

@@ -1,0 +1,22 @@
+"""known-bad: flat whole-table gathers over pool planes — jnp.take
+with the ENTIRE block table materializes a [rows, max_pages, ...]
+copy of the pool (the bug that once made ragged slower than dense), a
+pool take relying on the default out-of-bounds mode (NaN fill for
+floats), and an outer-product broadcast of a pool-scale operand."""
+import jax.numpy as jnp
+
+
+def flat_gather(cache_k, block_tables):
+    # every row's every page at once: [rows, max_pages, kvh, bs, d]
+    k = jnp.take(cache_k, block_tables, axis=0)
+    return k.sum()
+
+
+def default_oob(lora_pool, idx):
+    # no mode=: out-of-range page ids fill the gather with NaN
+    return jnp.take(lora_pool, idx, axis=0)
+
+
+def outer_broadcast(cache_k_scale, w):
+    s = cache_k_scale
+    return s[:, None] * w[None, :]

@@ -1,0 +1,22 @@
+"""known-good twin of fc701_bad: the page walk gathers ONE table
+column per iteration (online-softmax structure — peak memory is one
+page per row, not the pool), pool takes pass mode= explicitly, and
+the outer product is contracted instead of materialized."""
+import jax
+import jax.numpy as jnp
+
+
+def page_walk(cache_k, block_tables, n_pages):
+    def step(p, acc):
+        pids = jnp.take(block_tables, p, axis=1)   # one column: [rows]
+        page = jnp.take(cache_k, pids, axis=0, mode="clip")
+        return acc + page.sum()
+    return jax.lax.fori_loop(0, n_pages, step, 0.0)
+
+
+def explicit_mode(lora_pool, idx):
+    return jnp.take(lora_pool, idx, axis=0, mode="clip")
+
+
+def contracted(cache_k_scale, w):
+    return cache_k_scale @ w

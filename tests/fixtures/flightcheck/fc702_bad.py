@@ -1,0 +1,23 @@
+"""known-bad: dtype-footprint leaks — f32 constant arithmetic on a
+quantized pool plane, a whole-plane astype, a dtype-less fill
+scattered into a plane, and a quantized (values, scales) unpack whose
+scales half is silently dropped (raw int8 codes flow downstream)."""
+import jax.numpy as jnp
+
+
+def const_upcast(cache_k):
+    return cache_k * 0.5
+
+
+def whole_plane_astype(cache_v):
+    return cache_v.astype(jnp.float32).sum()
+
+
+def dtypeless_scatter(cache_k, slots):
+    z = jnp.zeros((4, 8))
+    return cache_k.at[slots].set(z)
+
+
+def dropped_scales(k_pool):
+    vals, scales = k_pool
+    return vals.sum()

@@ -1,0 +1,26 @@
+"""known-good twin of fc702_bad: constants cast to the plane dtype,
+dequant happens per gathered page (never on the whole plane), fills
+carry the plane dtype, and both tuple halves are threaded."""
+import jax.numpy as jnp
+
+
+def const_in_plane_dtype(cache_k):
+    half = jnp.asarray(0.5, cache_k.dtype)
+    return cache_k * half
+
+
+def per_page_dequant(cache_v, pids):
+    page = jnp.take(cache_v, pids, axis=0, mode="clip")
+    return page.astype(jnp.float32).sum()
+
+
+def typed_scatter(cache_k, slots):
+    z = jnp.zeros((4, 8), cache_k.dtype)
+    return cache_k.at[slots].set(z)
+
+
+def threaded_scales(k_pool, pids):
+    vals, scales = k_pool
+    v = jnp.take(vals, pids, axis=0, mode="clip")
+    s = jnp.take(scales, pids, axis=0, mode="clip")
+    return (v.astype(jnp.float32) * s[..., None]).sum()

@@ -1,0 +1,22 @@
+"""known-bad: donation effectiveness — a jit whose target updates and
+RETURNS a pool plane parameter without donating it (the functional
+in-place update then double-buffers the pool every dispatch), and a
+donated plane returned with a changed shape, which XLA cannot alias
+(the donation is accepted and silently ignored)."""
+import jax
+
+
+def update_pool(weights, k_pool, slots):
+    k_pool = k_pool.at[slots].add(weights.sum())
+    return k_pool
+
+
+update_j = jax.jit(update_pool)          # no donate_argnums
+
+
+def reshape_pool(weights, v_pool):
+    v_pool = v_pool.reshape(-1)          # donated, but cannot alias
+    return weights.sum() + v_pool
+
+
+reshape_j = jax.jit(reshape_pool, donate_argnums=(1,))

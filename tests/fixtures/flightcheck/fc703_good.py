@@ -1,0 +1,20 @@
+"""known-good twin of fc703_bad: every returned pool plane is donated
+and comes back with its dtype and shape unchanged, so XLA aliases the
+buffers and the update is genuinely in place."""
+import jax
+
+
+def update_pool(weights, k_pool, slots):
+    k_pool = k_pool.at[slots].add(weights.sum())
+    return k_pool
+
+
+update_j = jax.jit(update_pool, donate_argnums=(1,))
+
+
+def same_shape(weights, v_pool, slots):
+    v_pool = v_pool.at[slots].add(weights.sum())
+    return v_pool
+
+
+same_j = jax.jit(same_shape, donate_argnums=(1,))

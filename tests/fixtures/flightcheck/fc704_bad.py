@@ -1,0 +1,27 @@
+"""known-bad: scan-carry residency — a carry that grows every
+iteration (scan carries must be fixed-shape; the growth pattern
+multiplies bytes by the trip count), and pool planes carried through
+a scan whose enclosing jit never donates them (each of the k fused
+steps then double-buffers the plane)."""
+import jax
+import jax.numpy as jnp
+
+
+def growing(xs):
+    def step(toks, x):
+        toks = jnp.concatenate([toks, x[None]])
+        return toks, x
+    out, _ = jax.lax.scan(step, jnp.zeros((1,)), xs)
+    return out
+
+
+def fused_window(weights, k_pool, v_pool, toks):
+    def step(carry, t):
+        kp, vp = carry
+        kp = kp.at[t].add(weights.sum())
+        return (kp, vp), kp.sum()
+    _, ys = jax.lax.scan(step, (k_pool, v_pool), toks)
+    return ys
+
+
+fused_j = jax.jit(fused_window)          # pool carried, not donated

@@ -1,0 +1,26 @@
+"""known-good twin of fc704_bad: the accumulator is preallocated and
+written in place (fixed carry shape), and the fused multi-step window
+carries pool planes that the enclosing jit DONATES — the carry then
+aliases the pool instead of double-buffering it."""
+import jax
+import jax.numpy as jnp
+
+
+def accumulate(xs):
+    def step(toks, x):
+        toks = toks.at[0].add(x)
+        return toks, x
+    out, _ = jax.lax.scan(step, jnp.zeros((4,)), xs)
+    return out
+
+
+def fused_window(weights, k_pool, v_pool, toks):
+    def step(carry, t):
+        kp, vp = carry
+        kp = kp.at[t].add(weights.sum())
+        return (kp, vp), kp.sum()
+    (k_pool, v_pool), ys = jax.lax.scan(step, (k_pool, v_pool), toks)
+    return k_pool, v_pool, ys
+
+
+fused_j = jax.jit(fused_window, donate_argnums=(1, 2))

@@ -26,7 +26,8 @@ PACKAGE = os.path.join(REPO, "paddle_tpu")
 
 RULES = ["FC101", "FC102", "FC103", "FC201", "FC202", "FC203",
          "FC301", "FC401", "FC402", "FC501",
-         "FC601", "FC602", "FC603", "FC604", "FC605", "FC606"]
+         "FC601", "FC602", "FC603", "FC604", "FC605", "FC606",
+         "FC701", "FC702", "FC703", "FC704"]
 
 
 def _scan(path):
@@ -196,6 +197,96 @@ class TestShardingRules:
             "  # flightcheck: disable=FC602")
         assert not [f for f in core.check_source(suppressed, "t.py")
                     if f.rule == "FC602"]
+
+
+class TestMemoryRules:
+    """FC7xx-specific behavior beyond the generic fixture twins."""
+
+    def test_pool_vocabulary_seeded_from_spec_layout(self):
+        # pool plane names come from the committed SpecLayout table,
+        # not a hand-maintained list
+        from tools.flightcheck.memory import _canonical_pool_names
+        canon = _canonical_pool_names()
+        assert {"cache_k", "cache_v", "lora_pool"} <= canon
+
+    def test_fc701_distinguishes_flat_gather_from_oob_mode(self):
+        fs = [x for x in _scan(os.path.join(FIXTURES, "fc701_bad.py"))
+              if x.rule == "FC701"]
+        msgs = " | ".join(f.message for f in fs)
+        assert "whole block table" in msgs
+        assert "out-of-bounds mode" in msgs
+
+    def test_fc701_per_column_page_walk_is_clean(self):
+        # the engine's real access pattern: walk pages one column at a
+        # time, gathering bounded [rows, ...] slices with explicit mode
+        src = (
+            "import jax\nimport jax.numpy as jnp\n"
+            "def walk(cache_k, block_tables):\n"
+            "    def body(p, acc):\n"
+            "        cols = jax.lax.dynamic_index_in_dim(\n"
+            "            block_tables, p, axis=1, keepdims=False)\n"
+            "        page = jnp.take(cache_k, cols, axis=0,"
+            " mode='clip')\n"
+            "        return acc + page.sum()\n"
+            "    return jax.lax.fori_loop(0, 8, body, 0.0)\n")
+        assert not [f for f in core.check_source(src, "t.py")
+                    if f.rule == "FC701"]
+
+    def test_fc703_sees_through_tp_wrap(self):
+        src = (
+            "import jax\n"
+            "class Engine:\n"
+            "    def _impl(self, weights, k_pool, v_pool):\n"
+            "        k_pool = k_pool.at[0].add(weights.sum())\n"
+            "        return k_pool, v_pool\n"
+            "    def tp_wrap(self, fn, n_extra=0):\n"
+            "        return fn\n"
+            "    def build(self):\n"
+            "        self.step = jax.jit("
+            "self.tp_wrap(self._impl, n_extra=4))\n")
+        assert [f for f in core.check_source(src, "t.py")
+                if f.rule == "FC703"]
+        donated = src.replace(
+            "self.tp_wrap(self._impl, n_extra=4))",
+            "self.tp_wrap(self._impl, n_extra=4), "
+            "donate_argnums=(1, 2))")
+        assert not [f for f in core.check_source(donated, "t.py")
+                    if f.rule == "FC703"]
+
+    def test_suppression_applies_to_fc7(self):
+        with open(os.path.join(FIXTURES, "fc701_bad.py"),
+                  encoding="utf-8") as fh:
+            src = fh.read()
+        suppressed = "\n".join(
+            line + "  # flightcheck: disable=FC701"
+            if not line.startswith(("#", '"')) and line else line
+            for line in src.splitlines()) + "\n"
+        assert not [f for f in core.check_source(suppressed, "t.py")
+                    if f.rule == "FC701"]
+
+    def test_memory_checker_participates_in_cache_version(self):
+        # recompute the digest by hand: memory.py must be in the hash
+        # input, and the function must agree with the recomputation
+        import hashlib
+        from tools.flightcheck import cache as fc_cache
+        pkg = os.path.dirname(os.path.abspath(fc_cache.__file__))
+        names = sorted(fn for fn in os.listdir(pkg)
+                       if fn.endswith(".py"))
+        assert "memory.py" in names and "mem_audit.py" in names
+        h = hashlib.sha256()
+        paths = [os.path.join(pkg, fn) for fn in names] + [
+            os.path.join(REPO, "paddle_tpu", "distributed",
+                         "spec_layout.py")]
+        for path in paths:
+            with open(path, "rb") as fh:
+                h.update(os.path.basename(path).encode())
+                h.update(fh.read())
+        old = fc_cache._version
+        try:
+            fc_cache._version = None
+            assert fc_cache.checker_version() == h.hexdigest()[:16]
+        finally:
+            fc_cache._version = old
 
 
 class TestChangedAndCache:

@@ -1,0 +1,220 @@
+"""Mem-audit gate: the jaxpr-level HBM auditor.
+
+The audit abstract-traces every registered entry point (no compile, no
+execution) and pins each program's memory shape — argument/output/
+peak-temp bytes, donated bytes actually aliased, scan-carry residency —
+against the committed expectations file, plus the cross-program
+relations that encode the engine's paper-level memory claims (int8
+pool < fp32 pool, multi-step carry flat in k, dp adds zero bytes).
+The mutation tests prove the two headline regressions — a doubled pool
+copy and a dropped/ineffective donation — each FAIL the gate.
+"""
+import json
+import os
+
+import pytest
+
+from tools.flightcheck import mem_audit
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# a registered program name, so compare() on a synthetic entry is not
+# polluted by the "expected but no longer registered" guard
+PROG = "serving.ragged_tp2_fp32"
+
+
+@pytest.fixture(scope="module")
+def full_report():
+    return mem_audit.audit()
+
+
+def _trace(fn, *args):
+    import jax
+    return mem_audit.audit_jaxpr(jax.make_jaxpr(fn)(*args))
+
+
+class TestAuditMechanics:
+    def test_byte_accounting_of_a_known_program(self):
+        import jax.numpy as jnp
+
+        def f(a, b):
+            c = a @ b
+            return c + 1.0
+
+        e = _trace(f, jnp.zeros((8, 8)), jnp.zeros((8, 8)))
+        assert e["method"] == "jaxpr"
+        assert e["arg_bytes"] == 512 and e["out_bytes"] == 256
+        # the matmul intermediate is live before the add
+        assert e["peak_temp_bytes"] >= 256
+
+    def test_donation_measured_and_aliased(self):
+        import jax
+        import jax.numpy as jnp
+
+        def upd(w, pool):
+            return pool.at[0].add(w.sum())
+
+        e = _trace(jax.jit(upd, donate_argnums=(1,)),
+                   jnp.zeros(4), jnp.zeros((16, 8)))
+        assert e["donated_bytes"] == 512
+        assert e["aliased_bytes"] == 512
+
+    def test_changed_dtype_defeats_aliasing(self):
+        """The FC703 failure mode, measured: a donated plane returned
+        upcast counts as donated but NOT aliased."""
+        import jax
+        import jax.numpy as jnp
+
+        def upcast(w, pool):
+            return pool.astype(jnp.float32) + w.sum()
+
+        e = _trace(jax.jit(upcast, donate_argnums=(1,)),
+                   jnp.zeros(4), jnp.zeros((16, 8), jnp.int8))
+        assert e["donated_bytes"] == 128
+        assert e["aliased_bytes"] == 0
+
+    def test_scan_carry_bytes(self):
+        import jax
+        import jax.numpy as jnp
+
+        def f(pool, xs):
+            def step(c, x):
+                return c.at[0].add(x), x
+            c, _ = jax.lax.scan(step, pool, xs)
+            return c
+
+        e = _trace(jax.jit(f), jnp.zeros((16, 8)), jnp.zeros(4))
+        assert e["scan_carry_bytes"] == 512
+
+
+class TestMutations:
+    """The two regressions this gate exists for, seeded deliberately:
+    each must produce drift against the clean program's entry."""
+
+    def _args(self):
+        import jax.numpy as jnp
+        return jnp.zeros((64, 8)), jnp.zeros((8,))
+
+    def test_doubled_pool_copy_fails_the_audit(self):
+        import jax
+
+        def clean(pool, w):
+            return pool.at[0].add(w.sum())
+
+        def doubled(pool, w):
+            staging = pool * 1.0          # a second full plane
+            return staging.at[0].add(w.sum())
+
+        e_clean = _trace(jax.jit(clean, donate_argnums=(0,)),
+                         *self._args())
+        e_doubled = _trace(jax.jit(doubled, donate_argnums=(0,)),
+                           *self._args())
+        # sanity: identical entries do NOT drift
+        assert not mem_audit.compare({PROG: e_clean}, {PROG: e_clean})
+        drift = mem_audit.compare({PROG: e_doubled}, {PROG: e_clean})
+        assert drift and any("peak_temp_bytes" in d for d in drift), \
+            drift
+
+    def test_dropped_donation_fails_the_audit(self):
+        import jax
+
+        def clean(pool, w):
+            return pool.at[0].add(w.sum())
+
+        e_with = _trace(jax.jit(clean, donate_argnums=(0,)),
+                        *self._args())
+        e_without = _trace(jax.jit(clean), *self._args())
+        assert e_with["donated_bytes"] == 64 * 8 * 4
+        assert e_without["donated_bytes"] == 0
+        drift = mem_audit.compare({PROG: e_without}, {PROG: e_with})
+        assert any("donated_bytes" in d for d in drift), drift
+        assert any("aliased_bytes" in d for d in drift), drift
+
+
+class TestExpectationsRoundtrip:
+    def test_save_load_roundtrip(self, tmp_path):
+        report = {"prog.a": {
+            "method": "jaxpr", "arg_bytes": 1024, "out_bytes": 512,
+            "peak_temp_bytes": 256, "donated_bytes": 512,
+            "aliased_bytes": 512, "scan_carry_bytes": 0, "flags": []}}
+        path = str(tmp_path / "exp.json")
+        mem_audit.save(report, path)
+        assert mem_audit.load(path) == report
+        # a second save of the loaded report is byte-identical
+        path2 = str(tmp_path / "exp2.json")
+        mem_audit.save(mem_audit.load(path), path2)
+        assert open(path).read() == open(path2).read()
+
+    def test_committed_file_parses_and_covers_all_programs(self):
+        exp = mem_audit.load()
+        assert set(exp) == set(mem_audit.program_names())
+        for name, entry in exp.items():
+            assert "error" not in entry, f"{name} committed as failing"
+            for field in mem_audit._EXACT_FIELDS:
+                assert isinstance(entry[field], int) and \
+                    entry[field] >= 0, (name, field)
+            assert entry["aliased_bytes"] <= entry["donated_bytes"]
+            assert entry["arg_bytes"] > 0
+
+
+class TestAuditGate:
+    def test_all_programs_trace(self, full_report):
+        errors = {n: e["error"] for n, e in full_report.items()
+                  if "error" in e}
+        assert not errors, f"entry points failed to trace: {errors}"
+
+    def test_audit_matches_committed_expectations(self, full_report):
+        problems = mem_audit.compare(full_report, mem_audit.load())
+        assert not problems, "memory drift:\n" + "\n".join(problems)
+
+    def test_relations_hold(self, full_report):
+        assert not mem_audit.relations(full_report)
+
+    def test_kv8_pool_bytes_well_under_fp32(self, full_report):
+        """ISSUE 13's residency claim, pinned: int8 values + f32
+        sidecar scales vs f32 planes at identical geometry."""
+        f = full_report["serving.ragged_tp2_fp32"]["donated_bytes"]
+        q = full_report["serving.ragged_kv8_tp2"]["donated_bytes"]
+        assert q > 0 and q * 1.5 < f
+        # exact geometry: 2 planes x (1024 int8 + 512 scale bytes) vs
+        # 2 planes x 4096 f32 bytes
+        assert f / q == pytest.approx(8 / 3)
+
+    def test_k4_carry_flat_in_k(self, full_report):
+        """ISSUE 16's carry claim: the fused k=4 window carries the
+        pool planes ONCE — its carry tracks the single-step program's
+        carry, not k x anything."""
+        k4 = full_report["serving.ragged_k4_tp2"]
+        base = full_report["serving.ragged_tp2_fp32"]
+        assert k4["scan_carry_bytes"] > 0
+        assert k4["scan_carry_bytes"] <= \
+            base["scan_carry_bytes"] * 1.25 + 4096
+
+    def test_dp_replica_adds_zero_bytes(self, full_report):
+        """ISSUE 11: a dp x tp fleet replica's step program is
+        byte-identical to the single-engine tp program."""
+        base = full_report["serving.ragged_tp2_fp32"]
+        dp = full_report["serving.ragged_dp2_tp2"]
+        for field in mem_audit._EXACT_FIELDS + ("peak_temp_bytes",):
+            assert dp[field] == base[field], field
+
+    def test_serving_donations_fully_alias(self, full_report):
+        """Donation effectiveness on the REAL engine programs: every
+        donated byte of every serving program must actually alias (a
+        dtype/shape change on a returned plane would drop out here)."""
+        for name, e in full_report.items():
+            if not name.startswith("serving.") or "error" in e:
+                continue
+            assert e["aliased_bytes"] == e["donated_bytes"], name
+
+    def test_seeded_relation_violations_are_detected(self, full_report):
+        mutated = {k: dict(v) for k, v in full_report.items()}
+        mutated["serving.ragged_kv8_tp2"]["donated_bytes"] = \
+            mutated["serving.ragged_tp2_fp32"]["donated_bytes"]
+        assert any("kv8" in p for p in mem_audit.relations(mutated))
+        mutated = {k: dict(v) for k, v in full_report.items()}
+        mutated["serving.ragged_k4_tp2"]["scan_carry_bytes"] *= 4
+        assert any("k4" in p for p in mem_audit.relations(mutated))
+        mutated = {k: dict(v) for k, v in full_report.items()}
+        mutated["serving.ragged_dp2_tp2"]["peak_temp_bytes"] += 4096
+        assert any("dp2" in p for p in mem_audit.relations(mutated))

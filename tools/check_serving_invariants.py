@@ -55,15 +55,19 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_kv_quant.py"),
     os.path.join(REPO, "tests", "test_program_observatory.py"),
     os.path.join(REPO, "tests", "test_multi_step.py"),
+    os.path.join(REPO, "tests", "test_flightcheck.py"),
+    os.path.join(REPO, "tests", "test_mem_audit.py"),
 ]
 
 
 def run_flightcheck() -> int:
     """Static phase: flightcheck over the WHOLE package (ISSUE 7 widened
     the former inference/-only scope — the FC6xx sharding family gates
-    distributed/ and the models too), plus the comm audit: the
-    distributed entry points' collectives must match the committed
-    per-program expectations (kind/axis/bytes/count)."""
+    distributed/ and the models too; ISSUE 18 added the FC7xx memory
+    family), plus the comm audit (distributed entry points' collectives
+    vs committed per-program expectations) and the mem audit (the same
+    entry points' argument/output/peak-temp/donated bytes vs
+    tools/flightcheck/mem_expectations.json)."""
     from tools.flightcheck import DEFAULT_BASELINE, core
     target = os.path.join(REPO, "paddle_tpu")
     new, old = core.run(target, DEFAULT_BASELINE)
@@ -77,19 +81,30 @@ def run_flightcheck() -> int:
     else:
         print(f"FLIGHTCHECK OK — paddle_tpu/ clean "
               f"({len(old)} baselined)")
+    import subprocess
     if os.environ.get("FLIGHTCHECK_COMM_AUDIT_RAN") == "1":
         # run_checks.sh already ran the audit as its own phase; don't
         # trace all 14 distributed programs twice per gate run
         print("COMM AUDIT skipped — already run by the caller")
-        return rc
-    import subprocess
-    comm_rc = subprocess.call(
-        [sys.executable, "-m", "tools.flightcheck.comm_audit"],
-        cwd=REPO)
-    print("COMM AUDIT OK — collectives match expectations"
-          if comm_rc == 0 else
-          f"COMM AUDIT GATE FAILED (exit {comm_rc})")
-    return rc or comm_rc
+        comm_rc = 0
+    else:
+        comm_rc = subprocess.call(
+            [sys.executable, "-m", "tools.flightcheck.comm_audit"],
+            cwd=REPO)
+        print("COMM AUDIT OK — collectives match expectations"
+              if comm_rc == 0 else
+              f"COMM AUDIT GATE FAILED (exit {comm_rc})")
+    if os.environ.get("FLIGHTCHECK_MEM_AUDIT_RAN") == "1":
+        print("MEM AUDIT skipped — already run by the caller")
+        mem_rc = 0
+    else:
+        mem_rc = subprocess.call(
+            [sys.executable, "-m", "tools.flightcheck.mem_audit"],
+            cwd=REPO)
+        print("MEM AUDIT OK — per-program bytes match expectations"
+              if mem_rc == 0 else
+              f"MEM AUDIT GATE FAILED (exit {mem_rc})")
+    return rc or comm_rc or mem_rc
 
 
 def run_chaos() -> int:

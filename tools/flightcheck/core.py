@@ -121,9 +121,9 @@ def _load_checkers():
     if _CHECKERS:
         return
     from . import (tracer_safety, recompile, host_sync, prng, donation,
-                   sharding)
+                   sharding, memory)
     for mod in (tracer_safety, recompile, host_sync, prng, donation,
-                sharding):
+                sharding, memory):
         mod.setup(register)
 
 

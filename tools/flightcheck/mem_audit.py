@@ -1,0 +1,452 @@
+"""Per-program HBM audit: abstract-trace every registered entry point
+and pin its memory shape — argument/output/peak-temp bytes, donated
+bytes actually aliased, and scan-carry residency — against a committed
+expectations file.
+
+The FC7xx rules (tools/flightcheck/memory.py) catch the memory hazards
+visible in SOURCE; this audit pins the ones visible only in the traced
+PROGRAM: the engine's headline memory claims — int8 KV pages at a
+fraction of f32 bytes (ISSUE 13), donation keeping the multi-GiB pool
+single-buffered across every dispatch, the multi_step=k fused window
+carrying pool planes at FLAT cost in k (ISSUE 16), data-parallel rows
+adding zero per-replica bytes (ISSUE 11) — all regress silently: the
+program still computes the right numbers, it just holds more HBM while
+doing so, and no numeric test notices until an OOM on real hardware.
+
+Accounting is jaxpr-level — deterministic, backend-free, and the same
+on the CPU gate as anywhere else (XLA's ``memory_analysis()`` is
+backend-specific and unavailable or host-shaped on the CPU gate, so it
+is surfaced informationally via ``--xla`` but never pinned):
+
+- ``arg_bytes`` / ``out_bytes``: summed over the traced avals;
+- ``peak_temp_bytes``: a liveness scan over the program's equations
+  (allocate at the defining equation, free after the last use), with
+  control-flow bodies (scan/while/cond/pjit) contributing their own
+  recursive peak while they execute — an upper-bound shape, not an XLA
+  buffer assignment, which is exactly what makes it stable enough to
+  commit;
+- ``donated_bytes``: invars marked donated on the pjit equation;
+- ``aliased_bytes``: the donated bytes XLA can actually alias — a
+  donated invar only aliases an output of identical shape AND dtype,
+  so a plane returned upcast/reshaped silently drops out of this
+  number (the FC703 failure mode, measured);
+- ``scan_carry_bytes``: the widest scan carry in the program (the
+  multi_step hot spot: the carry holds whole pool planes).
+
+Every numeric field is pinned exactly except ``peak_temp_bytes``
+(a relative tolerance band absorbs jax-version jitter in equation
+order). On top of per-program pins, cross-program RELATIONS encode the
+paper-level claims directly:
+
+- ``serving.ragged_kv8_tp2`` pool (donated) bytes strictly below
+  ``serving.ragged_tp2_fp32`` at equal geometry (int8 + f32 sidecar
+  scales vs f32 planes: > 1.5x smaller);
+- ``serving.ragged_k4_tp2`` scan-carry bytes FLAT in k — bounded by
+  its own donated pool planes plus slack, never k x;
+- ``serving.ragged_dp2_tp2`` byte-identical to the single-engine tp
+  program: dp adds zero per-replica step bytes.
+
+``python -m tools.flightcheck.mem_audit`` fails on ANY drift;
+regenerate deliberately with ``--write`` after a reviewed change.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .comm_audit import ensure_devices, program_names, programs
+
+EXPECTATIONS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "mem_expectations.json")
+
+# fields pinned exactly vs within a relative band
+_EXACT_FIELDS = ("arg_bytes", "out_bytes", "donated_bytes",
+                 "aliased_bytes", "scan_carry_bytes")
+_BAND_FIELDS = {"peak_temp_bytes": 0.10}
+
+
+# -- jaxpr byte accounting --------------------------------------------------
+
+def _aval_bytes(aval) -> int:
+    import numpy as np
+    shape = getattr(aval, "shape", None)
+    if shape is None:
+        return 0
+    try:
+        item = np.dtype(aval.dtype).itemsize
+    except TypeError:
+        return 0
+    return int(np.prod(shape, dtype=np.int64)) * item
+
+
+def _vars_bytes(vs) -> int:
+    total = 0
+    for v in vs:
+        if hasattr(v, "val"):        # literal
+            continue
+        total += _aval_bytes(getattr(v, "aval", None))
+    return total
+
+
+def _sub_jaxprs(eqn):
+    """Inner jaxprs of a control-flow/pjit equation."""
+    out = []
+    for key in ("jaxpr", "body_jaxpr", "cond_jaxpr", "call_jaxpr"):
+        v = eqn.params.get(key)
+        if v is None:
+            continue
+        core = getattr(v, "jaxpr", v)
+        if hasattr(core, "eqns"):
+            out.append(core)
+    for br in eqn.params.get("branches", ()) or ():
+        core = getattr(br, "jaxpr", br)
+        if hasattr(core, "eqns"):
+            out.append(core)
+    return out
+
+
+def _peak_temp(jaxpr, flags: set, depth: int = 0) -> int:
+    """Liveness-scan peak of intermediate bytes: each equation's
+    outputs allocate when it runs and free after their last use;
+    control-flow bodies contribute their own recursive peak while
+    their equation executes. Inputs and outputs of ``jaxpr`` itself
+    are excluded (they are argument/output bytes, counted separately).
+    """
+    if depth > 6:                    # pathological nesting guard
+        flags.add("depth-capped")
+        return 0
+    eqns = jaxpr.eqns
+    if not eqns:
+        return 0
+    out_set = {id(v) for v in jaxpr.outvars}
+    last_use: Dict[int, int] = {}
+    for i, eqn in enumerate(eqns):
+        if eqn.primitive.name == "while":
+            flags.add("while-approx")
+        for v in eqn.invars:
+            if not hasattr(v, "val"):
+                last_use[id(v)] = i
+    live = 0
+    peak = 0
+    freed_at: Dict[int, List] = {}
+    for i, eqn in enumerate(eqns):
+        inner = 0
+        for sub in _sub_jaxprs(eqn):
+            inner = max(inner, _peak_temp(sub, flags, depth + 1))
+        alloc = sum(_aval_bytes(v.aval) for v in eqn.outvars
+                    if id(v) not in out_set)
+        peak = max(peak, live + alloc + inner)
+        live += alloc
+        # free temps whose last use was THIS equation
+        for v in eqn.invars:
+            if hasattr(v, "val") or id(v) in out_set:
+                continue
+            if last_use.get(id(v)) == i and id(v) not in freed_at:
+                freed_at[id(v)] = True
+                live -= _aval_bytes(v.aval)
+        live = max(live, 0)
+    return peak
+
+
+def _walk_eqns(jaxpr, depth: int = 0):
+    if depth > 6:
+        return
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for sub in _sub_jaxprs(eqn):
+            yield from _walk_eqns(sub, depth + 1)
+
+
+def _donation(jaxpr) -> Tuple[int, int]:
+    """(donated_bytes, aliased_bytes) summed over pjit equations.
+    Aliased = donated invars greedily matched to same-(shape, dtype)
+    outputs — the match XLA's donation aliasing actually requires, so
+    a donated plane returned with a changed dtype/shape counts as
+    donated but NOT aliased (FC703's failure mode, measured)."""
+    donated = 0
+    aliased = 0
+    for eqn in _walk_eqns(jaxpr):
+        marks = eqn.params.get("donated_invars")
+        if not marks or not any(marks):
+            continue
+        outs = {}
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            key = (tuple(aval.shape), str(aval.dtype))
+            outs[key] = outs.get(key, 0) + 1
+        for v, is_don in zip(eqn.invars, marks):
+            if not is_don or hasattr(v, "val"):
+                continue
+            aval = getattr(v, "aval", None)
+            if aval is None or not hasattr(aval, "shape"):
+                continue
+            nb = _aval_bytes(aval)
+            donated += nb
+            key = (tuple(aval.shape), str(aval.dtype))
+            if outs.get(key, 0) > 0:
+                outs[key] -= 1
+                aliased += nb
+    return donated, aliased
+
+
+def _scan_carry(jaxpr) -> int:
+    """Widest scan carry (bytes) anywhere in the program."""
+    widest = 0
+    for eqn in _walk_eqns(jaxpr):
+        if eqn.primitive.name != "scan":
+            continue
+        n = int(eqn.params.get("num_carry", 0))
+        widest = max(widest, sum(_aval_bytes(v.aval)
+                                 for v in eqn.outvars[:n]))
+    return widest
+
+
+def audit_jaxpr(closed_jaxpr) -> dict:
+    jx = closed_jaxpr.jaxpr
+    flags: set = set()
+    entry = {
+        "method": "jaxpr",
+        "arg_bytes": _vars_bytes(jx.invars),
+        "out_bytes": _vars_bytes(jx.outvars),
+        "peak_temp_bytes": _peak_temp(jx, flags),
+        "scan_carry_bytes": _scan_carry(jx),
+    }
+    donated, aliased = _donation(jx)
+    entry["donated_bytes"] = donated
+    entry["aliased_bytes"] = aliased
+    entry["flags"] = sorted(flags)
+    return entry
+
+
+# -- audit / expectations ---------------------------------------------------
+
+def audit(only: Optional[str] = None) -> Dict[str, dict]:
+    """Trace and byte-account every registered program (or the
+    ``only`` name-prefix subset). A program that cannot trace IS a
+    bug: it becomes an {"error": ...} entry and fails the compare."""
+    ensure_devices()
+    import jax
+    report: Dict[str, dict] = {}
+    for name, build in sorted(programs().items()):
+        if only and not name.startswith(only):
+            continue
+        try:
+            fn, args = build()
+            jx = jax.make_jaxpr(fn)(*args)
+            report[name] = audit_jaxpr(jx)
+        except Exception as e:
+            report[name] = {"error": f"{type(e).__name__}: {e}"}
+    return report
+
+
+def xla_memory(only: Optional[str] = None) -> Dict[str, dict]:
+    """Informational XLA-side numbers (``memory_analysis()``) where the
+    installed backend provides them — never pinned: the committed
+    expectations must be identical on the CPU gate and a TPU host."""
+    ensure_devices()
+    import jax
+    out: Dict[str, dict] = {}
+    for name, build in sorted(programs().items()):
+        if only and not name.startswith(only):
+            continue
+        try:
+            fn, args = build()
+            compiled = jax.jit(fn).lower(*args).compile()
+            ma = compiled.memory_analysis()
+            out[name] = {
+                "argument_bytes": int(
+                    getattr(ma, "argument_size_in_bytes", 0)),
+                "output_bytes": int(
+                    getattr(ma, "output_size_in_bytes", 0)),
+                "temp_bytes": int(
+                    getattr(ma, "temp_size_in_bytes", 0)),
+            }
+        except Exception as e:
+            out[name] = {"error": f"{type(e).__name__}: {e}"}
+    return out
+
+
+def relations(report: Dict[str, dict]) -> List[str]:
+    """Cross-program memory relations (empty list = all hold). Each
+    encodes a paper-level claim; checked only when both endpoints are
+    in ``report`` (scoped --only runs skip them)."""
+    problems: List[str] = []
+
+    def get(name):
+        e = report.get(name)
+        return e if e is not None and "error" not in e else None
+
+    fp32 = get("serving.ragged_tp2_fp32")
+    kv8 = get("serving.ragged_kv8_tp2")
+    k4 = get("serving.ragged_k4_tp2")
+    dp2 = get("serving.ragged_dp2_tp2")
+
+    if fp32 and kv8:
+        # quantized pool planes (int8 values + f32 sidecar scales) must
+        # be well under the f32 planes at the same geometry
+        f, q = fp32["donated_bytes"], kv8["donated_bytes"]
+        if not q or q * 1.5 >= f:
+            problems.append(
+                f"relation kv8<fp32: quantized pool donated bytes {q} "
+                f"not < fp32 {f} by >1.5x — the int8 layout stopped "
+                f"paying for itself")
+    if k4 and fp32:
+        # the fused multi-step carry holds the pool planes ONCE — flat
+        # in k: its bytes track the single-step program's carry (plus
+        # per-step token/position slack), NOT k x anything
+        carry, base = k4["scan_carry_bytes"], fp32["scan_carry_bytes"]
+        if carry <= 0:
+            problems.append(
+                "relation k4-carry: multi-step program has no scan "
+                "carry — the fused window lost its scan")
+        elif carry > base * 1.25 + 4096:
+            problems.append(
+                f"relation k4-carry-flat: carry bytes {carry} exceed "
+                f"the single-step program's carry {base} + slack — "
+                f"the carry is no longer flat in k")
+    if dp2 and fp32:
+        diff = [f for f in _EXACT_FIELDS + tuple(_BAND_FIELDS)
+                if dp2.get(f) != fp32.get(f)]
+        if diff:
+            problems.append(
+                f"relation dp2==fp32: replica program differs from the "
+                f"single-engine tp program on {', '.join(diff)} — data "
+                f"parallelism must add zero per-replica step bytes")
+    return problems
+
+
+def save(report: Dict[str, dict], path: str = EXPECTATIONS):
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(report, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+
+
+def load(path: str = EXPECTATIONS) -> Dict[str, dict]:
+    with open(path, encoding="utf-8") as fh:
+        return json.load(fh)
+
+
+def compare(actual: Dict[str, dict],
+            expected: Dict[str, dict]) -> List[str]:
+    """Human-readable drift list (empty = match): exact on every field
+    except the tolerance-banded ones. Only programs present in
+    ``actual`` are compared (supports scoped runs), but a program
+    expected and no longer REGISTERED is drift."""
+    problems: List[str] = []
+    names = set(programs())
+    for name in sorted(set(expected) - names):
+        problems.append(f"{name}: expected but no longer registered")
+    for name, got in sorted(actual.items()):
+        want = expected.get(name)
+        if want is None:
+            problems.append(f"{name}: not in expectations file "
+                            f"(regenerate with --write)")
+            continue
+        if "error" in got:
+            problems.append(f"{name}: TRACE FAILURE {got['error']}")
+            continue
+        for f in _EXACT_FIELDS:
+            if got.get(f) != want.get(f):
+                problems.append(
+                    f"{name}: {f} drifted — expected {want.get(f)}, "
+                    f"got {got.get(f)}")
+        for f, band in _BAND_FIELDS.items():
+            w, g = want.get(f, 0), got.get(f, 0)
+            if abs(g - w) > band * max(abs(w), 1):
+                problems.append(
+                    f"{name}: {f} outside the ±{int(band * 100)}% "
+                    f"band — expected {w}, got {g}")
+        if got.get("flags") != want.get("flags"):
+            problems.append(
+                f"{name}: flags drifted — expected {want.get('flags')}"
+                f", got {got.get('flags')}")
+    return problems
+
+
+def format_report(report: Dict[str, dict]) -> str:
+    lines = []
+    for name, entry in sorted(report.items()):
+        if "error" in entry:
+            lines.append(f"{name}: TRACE FAILURE {entry['error']}")
+            continue
+        flag = (" [" + ",".join(entry["flags"]) + "]"
+                if entry.get("flags") else "")
+        lines.append(f"{name}:{flag}")
+        lines.append(
+            f"    args {entry['arg_bytes']:>12} B   "
+            f"out {entry['out_bytes']:>12} B   "
+            f"peak-temp {entry['peak_temp_bytes']:>12} B")
+        lines.append(
+            f"    donated {entry['donated_bytes']:>9} B   "
+            f"aliased {entry['aliased_bytes']:>8} B   "
+            f"scan-carry {entry['scan_carry_bytes']:>11} B")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.flightcheck.mem_audit",
+        description="jaxpr-level HBM audit of the serving/distributed "
+                    "entry points")
+    ap.add_argument("--write", action="store_true",
+                    help="regenerate the committed expectations file")
+    ap.add_argument("--only", default=None,
+                    help="audit only programs with this name prefix")
+    ap.add_argument("--xla", action="store_true",
+                    help="also print XLA memory_analysis numbers "
+                         "(informational; never pinned)")
+    args = ap.parse_args(argv)
+
+    report = audit(only=args.only)
+    if args.only and not report:
+        print(f"mem audit: --only {args.only!r} matches no registered "
+              f"program; known: {', '.join(program_names())}",
+              file=sys.stderr)
+        return 2
+    print(format_report(report))
+    if args.xla:
+        print("\nXLA memory_analysis (informational):")
+        for name, e in sorted(xla_memory(only=args.only).items()):
+            print(f"  {name}: {json.dumps(e)}")
+    errors = [n for n, e in report.items() if "error" in e]
+    rel_problems = relations(report)
+    if args.write:
+        if errors:
+            print(f"mem audit: NOT writing expectations — "
+                  f"{len(errors)} trace failure(s)")
+            return 1
+        if rel_problems:
+            print("mem audit: NOT writing expectations — relation "
+                  "violation(s):")
+            for p in rel_problems:
+                print("  " + p)
+            return 1
+        if args.only:
+            merged = load() if os.path.exists(EXPECTATIONS) else {}
+            merged.update(report)
+            report = merged
+        save(report)
+        print(f"mem audit: expectations written -> {EXPECTATIONS}")
+        return 0
+    if not os.path.exists(EXPECTATIONS):
+        print("mem audit: no expectations file committed — run with "
+              "--write")
+        return 1
+    problems = compare(report, load()) + rel_problems
+    if problems:
+        print("\nmem audit: DRIFT detected")
+        for p in problems:
+            print("  " + p)
+        return 1
+    print(f"\nmem audit: {len(report)} program(s) match the committed "
+          f"expectations; relations hold")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

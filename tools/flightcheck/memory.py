@@ -1,0 +1,690 @@
+"""Memory-hazard rules (FC7xx): pool-scale residency and footprint.
+
+The serving engine's HBM budget is dominated by a handful of pool
+planes — the paged KV cache (``cache_k``/``cache_v`` and their int8
+scale planes) and the S-LoRA adapter pool — whose residency claims
+(int8 pages at a fraction of f32 bytes, in-place donation on every
+dispatch, flat carry bytes across multi-step scans) are exactly the
+kind of thing that regresses silently: the program still computes the
+right numbers, it just holds two copies of a multi-GiB buffer while
+doing so. These rules flag the four statically-visible ways that
+happens:
+
+- FC701 — a *flat whole-table gather* (``jnp.take(pool, tables)`` /
+  ``pool[tables]`` / the ``_dequantize_gather`` helper fed a full
+  block table) materializes a ``[rows, max_pages, ...]`` copy of the
+  pool, and outer-product broadcasts of pool-scale operands do the
+  same through shape expansion. Also enumerates pool gathers that rely
+  on the default out-of-bounds mode (NaN fill for floats).
+- FC702 — dtype-footprint leaks: an f32 constant or whole-plane
+  ``astype`` forcing a bf16/int8 plane to upcast, a dtype-less
+  ``jnp.zeros`` scattered into a pool plane, or a quantized
+  ``(values, scales)`` unpack whose scales half is silently dropped.
+- FC703 — donation *effectiveness* (FC501 covers use-after-donate):
+  a jit whose target returns a pool-plane parameter that is not in
+  ``donate_argnums``, or a donated plane returned with a changed
+  dtype/shape so XLA cannot alias the buffers.
+- FC704 — ``lax.scan`` carries that grow per iteration (self-concat
+  in the step body) or carry pool planes bound to non-donated jit
+  arguments (the multi_step=k hot spot: every step then
+  double-buffers the plane).
+
+Pool vocabulary is seeded from the committed SpecLayout table
+(``canonical_specs``) — the same source of truth the FC6xx sharding
+rules lint against — plus the conventional local aliases
+(``k_pool``/``v_pool``/``plane``/...). Bare ``k``/``v`` are
+deliberately excluded from the gather/dtype rules (they are ubiquitous
+attention operands); they count only where position corroborates them
+(jit parameters and scan-carry elements).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Finding, FileContext, _REPO_ROOT
+from .donation import _donate_nums, _jit_target
+from .scopes import FuncNode, dotted, func_of_map, tail_of
+from .sharding import canonical_specs
+
+# -- pool-plane vocabulary --------------------------------------------------
+
+_POOL_FALLBACK = frozenset({
+    "cache_k", "cache_v", "cache_k_scale", "cache_v_scale", "lora_pool"})
+
+_POOL_ALIASES = frozenset({
+    "pool", "plane", "k_cache", "v_cache", "kv_cache", "k_pool",
+    "v_pool", "kv_pool", "lora_pool"})
+
+_POOL_SUFFIXES = ("_pool", "_plane")
+
+# weak names: accepted only where position corroborates them (jit
+# params / scan carries), never for the gather/dtype rules
+_POOL_WEAK = frozenset({"k", "v", "kp", "vp", "kv"})
+
+_FLOAT_DTYPES = {"float32", "float64", "f32", "f64"}
+
+
+def _canonical_pool_names() -> frozenset:
+    table = canonical_specs(_REPO_ROOT)
+    names = {n for n in table
+             if n.startswith("cache_") or n.endswith("_pool")}
+    return frozenset(names) if names else _POOL_FALLBACK
+
+
+def _pool_name(name: Optional[str], canon: frozenset) -> bool:
+    if not name:
+        return False
+    return (name in canon or name in _POOL_ALIASES
+            or name.endswith(_POOL_SUFFIXES))
+
+
+def _pool_operand(node: ast.AST, pool: Set[str],
+                  canon: frozenset) -> Optional[str]:
+    """Dotted name of the pool plane an expression denotes, seeing
+    through per-layer subscripts (``k_pool[li]``), or None."""
+    if isinstance(node, ast.Subscript):
+        return _pool_operand(node.value, pool, canon)
+    name = dotted(node)
+    if name is None:
+        return None
+    t = tail_of(name)
+    if t in pool or _pool_name(t, canon):
+        return name
+    return None
+
+
+def _own_nodes(owner):
+    """Every AST node in ``owner``'s body, excluding nested def/lambda
+    subtrees (those get their own scope pass)."""
+    stack = list(ast.iter_child_nodes(owner))
+    while stack:
+        n = stack.pop()
+        if isinstance(n, FuncNode + (ast.Lambda,)):
+            continue
+        yield n
+        stack.extend(ast.iter_child_nodes(n))
+
+
+def _params_of(fn) -> List[str]:
+    a = fn.args
+    return [p.arg for p in a.posonlyargs + a.args]
+
+
+def _pool_locals(fn, canon: frozenset) -> Set[str]:
+    """Names in this scope that denote a pool plane: pool-named params,
+    direct aliases, per-layer subscripts of a pool, and tuple-unpack
+    halves of a quantized plane. Deliberately NOT full value taint —
+    a matmul result derived from the pool is an activation, not a
+    plane."""
+    pool: Set[str] = set()
+    if isinstance(fn, (ast.Lambda,) + FuncNode):
+        for p in _params_of(fn):
+            if _pool_name(p, canon):
+                pool.add(p)
+    if isinstance(fn, ast.Lambda):
+        return pool
+    changed = True
+    while changed:
+        changed = False
+        for st in _own_nodes(fn):
+            if not isinstance(st, ast.Assign) or len(st.targets) != 1:
+                continue
+            val = st.value
+            if isinstance(val, ast.Subscript):
+                src = tail_of(dotted(val.value))
+            else:
+                src = tail_of(dotted(val))
+            if src is None or not (src in pool or _pool_name(src, canon)):
+                continue
+            tgt = st.targets[0]
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for e in elts:
+                if isinstance(e, ast.Name) and e.id not in pool:
+                    pool.add(e.id)
+                    changed = True
+    return pool
+
+
+# -- FC701: flat whole-table gathers / pool-scale broadcasts ----------------
+
+_FLAT_HELPERS = {"_dequantize_gather", "dequantize_gather"}
+
+
+def _strip_flatten(node: ast.AST) -> ast.AST:
+    """idx.reshape(-1) / .ravel() / .flatten() -> idx"""
+    while isinstance(node, ast.Call) and \
+            isinstance(node.func, ast.Attribute) and \
+            node.func.attr in ("reshape", "ravel", "flatten"):
+        node = node.func.value
+    return node
+
+
+def _table_like(node: ast.AST) -> Optional[str]:
+    """A WHOLE block-table operand (not a per-step column of one)."""
+    node = _strip_flatten(node)
+    name = dotted(node)          # Subscript (tables[:, i]) -> None
+    if name is None:
+        return None
+    t = (tail_of(name) or "").lower()
+    if "table" in t or "pages" in t:
+        return name
+    return None
+
+
+def _has_none_expand(sub: ast.Subscript) -> bool:
+    """P[:, None] style rank expansion."""
+    sl = sub.slice
+    elts = sl.elts if isinstance(sl, ast.Tuple) else [sl]
+    return any(isinstance(e, ast.Constant) and e.value is None
+               for e in elts)
+
+
+def _check_fc701(fn, pool, canon, owner_of, ctx, out):
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Call):
+            head = tail_of(dotted(n.func))
+            if head == "take" and n.args:
+                # jnp.take(P, idx, ...) or P.take(idx, ...)
+                if isinstance(n.func, ast.Attribute) and \
+                        _pool_operand(n.func.value, pool, canon):
+                    plane = _pool_operand(n.func.value, pool, canon)
+                    idx = n.args[0] if n.args else None
+                else:
+                    plane = _pool_operand(n.args[0], pool, canon)
+                    idx = n.args[1] if len(n.args) > 1 else None
+                if plane is None:
+                    continue
+                tbl = _table_like(idx) if idx is not None else None
+                if tbl is not None:
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC701",
+                        f"flat gather of pool plane '{plane}' over the "
+                        f"whole block table '{tbl}' materializes a "
+                        f"[rows, max_pages, ...] copy of the pool — "
+                        f"walk pages online (fori_loop) or gather one "
+                        f"column per step",
+                        owner_of.get(n, "")))
+                elif not any(kw.arg == "mode" for kw in n.keywords):
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC701",
+                        f"jnp.take on pool plane '{plane}' relies on "
+                        f"the default out-of-bounds mode (NaN fill for "
+                        f"floats) — pass mode= explicitly "
+                        f"(mode='clip' matches the page allocator's "
+                        f"sentinel convention)",
+                        owner_of.get(n, "")))
+            elif head in _FLAT_HELPERS and len(n.args) >= 2:
+                tbl = _table_like(n.args[1])
+                if tbl is not None:
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC701",
+                        f"'{head}' fed the whole block table '{tbl}' "
+                        f"materializes every page of the pool plane at "
+                        f"once — restrict to the rows' own pages or "
+                        f"walk pages online",
+                        owner_of.get(n, "")))
+        elif isinstance(n, ast.Subscript) and \
+                isinstance(n.ctx, ast.Load):
+            plane = _pool_operand(n.value, pool, canon)
+            if plane is not None and plane != dotted(n.value):
+                continue    # per-layer subscript of a pool, fine
+            if plane is not None:
+                tbl = _table_like(n.slice)
+                if tbl is not None:
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC701",
+                        f"fancy-index '{plane}[{tbl}]' is a flat "
+                        f"whole-table gather — materializes "
+                        f"[rows, max_pages, ...] of the pool",
+                        owner_of.get(n, "")))
+        elif isinstance(n, ast.BinOp) and isinstance(n.op, ast.Mult):
+            sides = [n.left, n.right]
+            expanded = [s for s in sides
+                        if isinstance(s, ast.Subscript)
+                        and _has_none_expand(s)]
+            if len(expanded) == 2:
+                for s in expanded:
+                    plane = _pool_operand(s.value, pool, canon)
+                    if plane is not None:
+                        out.append(Finding(
+                            ctx.path, n.lineno, "FC701",
+                            f"outer-product broadcast of pool-scale "
+                            f"operand '{plane}' materializes a "
+                            f"rank-expanded intermediate of the whole "
+                            f"pool — contract inside a kernel or per "
+                            f"page instead",
+                            owner_of.get(n, "")))
+                        break
+
+
+# -- FC702: dtype-footprint leaks -------------------------------------------
+
+def _is_f32_dtype(node: ast.AST) -> bool:
+    name = tail_of(dotted(node))
+    if name in _FLOAT_DTYPES:
+        return True
+    return isinstance(node, ast.Constant) and node.value in _FLOAT_DTYPES
+
+
+def _float_const(node: ast.AST) -> bool:
+    if isinstance(node, ast.Constant):
+        return isinstance(node.value, float)
+    if isinstance(node, ast.UnaryOp):
+        return _float_const(node.operand)
+    return False
+
+
+def _check_fc702(fn, pool, canon, owner_of, ctx, out):
+    # dtype-less fills (jnp.zeros(shape) with no dtype=) by local name
+    fills: Set[str] = set()
+    loads: Dict[str, int] = {}
+    for n in _own_nodes(fn):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            loads[n.id] = loads.get(n.id, 0) + 1
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Name) and \
+                isinstance(n.value, ast.Call):
+            h = tail_of(dotted(n.value.func))
+            if h in ("zeros", "ones", "full") and \
+                    not any(kw.arg == "dtype" for kw in n.value.keywords):
+                nargs = 2 if h == "full" else 1
+                if len(n.value.args) <= nargs:
+                    fills.add(n.targets[0].id)
+
+    for n in _own_nodes(fn):
+        # f32 constant arithmetic on a bare plane
+        if isinstance(n, ast.BinOp):
+            for a, b in ((n.left, n.right), (n.right, n.left)):
+                plane = _pool_operand(a, pool, canon)
+                if plane is not None and _float_const(b):
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC702",
+                        f"f32 constant arithmetic on pool plane "
+                        f"'{plane}' upcasts the whole plane inside the "
+                        f"traced body — fold the constant into the "
+                        f"dequant scale or cast it to the plane dtype",
+                        owner_of.get(n, "")))
+                    break
+        # whole-plane astype to f32
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr == "astype" and n.args:
+            plane = _pool_operand(n.func.value, pool, canon)
+            if plane is not None and _is_f32_dtype(n.args[0]):
+                out.append(Finding(
+                    ctx.path, n.lineno, "FC702",
+                    f"whole-plane astype of pool plane '{plane}' to "
+                    f"float32 multiplies resident bytes by 2-4x — "
+                    f"dequantize per-page inside the attention kernel "
+                    f"instead",
+                    owner_of.get(n, "")))
+        # jnp.where/minimum/maximum/clip mixing a plane with f32 consts
+        elif isinstance(n, ast.Call) and \
+                tail_of(dotted(n.func)) in ("where", "minimum",
+                                            "maximum", "clip"):
+            planes = [_pool_operand(a, pool, canon) for a in n.args]
+            if any(planes) and any(_float_const(a) for a in n.args):
+                plane = next(p for p in planes if p)
+                out.append(Finding(
+                    ctx.path, n.lineno, "FC702",
+                    f"'{tail_of(dotted(n.func))}' mixes pool plane "
+                    f"'{plane}' with a float constant — promotion "
+                    f"upcasts the whole plane; cast the constant to "
+                    f"the plane dtype",
+                    owner_of.get(n, "")))
+        # dtype-less fill scattered into a plane: P.at[...].set(z)
+        elif isinstance(n, ast.Call) and \
+                isinstance(n.func, ast.Attribute) and \
+                n.func.attr in ("set", "add") and n.args and \
+                isinstance(n.args[0], ast.Name) and \
+                n.args[0].id in fills:
+            recv = n.func.value          # P.at[idx]
+            if isinstance(recv, ast.Subscript) and \
+                    isinstance(recv.value, ast.Attribute) and \
+                    recv.value.attr == "at":
+                plane = _pool_operand(recv.value.value, pool, canon)
+                if plane is not None:
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC702",
+                        f"dtype-less fill '{n.args[0].id}' (defaults "
+                        f"to float32) scattered into pool plane "
+                        f"'{plane}' upcasts the plane — pass the "
+                        f"plane's dtype to the zeros/ones call",
+                        owner_of.get(n, "")))
+
+        # quantized (values, scales) unpack dropping the scales half
+        if isinstance(n, ast.Assign) and len(n.targets) == 1 and \
+                isinstance(n.targets[0], ast.Tuple) and \
+                len(n.targets[0].elts) == 2 and \
+                all(isinstance(e, ast.Name) for e in n.targets[0].elts):
+            plane = _pool_operand(n.value, pool, canon)
+            if plane is None:
+                continue
+            vals, scales = (e.id for e in n.targets[0].elts)
+            if loads.get(vals, 0) > 0 and loads.get(scales, 0) == 0:
+                out.append(Finding(
+                    ctx.path, n.lineno, "FC702",
+                    f"quantized plane '{plane}' unpacked to "
+                    f"({vals}, {scales}) but the scales half "
+                    f"'{scales}' is never used — downstream math "
+                    f"silently consumes raw int8 codes",
+                    owner_of.get(n, "")))
+
+
+# -- FC703/FC704 shared: jit-target registry --------------------------------
+
+def _resolve_fn(arg: ast.AST, defs: Dict[str, ast.AST]):
+    """Resolve a jit/scan function operand to its def or lambda node,
+    seeing through wrapper calls (``tp_wrap(f, ...)``, ``partial(f,
+    ...)``) by their first positional argument, and through
+    ``self.method`` by name."""
+    hops = 0
+    while isinstance(arg, ast.Call) and arg.args and hops < 3:
+        arg = arg.args[0]
+        hops += 1
+    if isinstance(arg, ast.Lambda):
+        return arg
+    name = dotted(arg)
+    return defs.get(tail_of(name) or "") if name else None
+
+
+def _defs_by_name(tree: ast.Module) -> Dict[str, ast.AST]:
+    return {n.name: n for n in ast.walk(tree)
+            if isinstance(n, FuncNode)}
+
+
+def _jit_registry(tree: ast.Module, defs: Dict[str, ast.AST]):
+    """target def/lambda node -> {"donate": union of donated positions,
+    "sites": [(lineno, donate_set)]} over every resolvable jit site."""
+    reg: Dict[ast.AST, Dict] = {}
+
+    def note(node, donate: Set[int], lineno: int):
+        ent = reg.setdefault(node, {"donate": set(), "sites": []})
+        ent["donate"] |= donate
+        ent["sites"].append((lineno, set(donate)))
+
+    for n in ast.walk(tree):
+        if isinstance(n, FuncNode):
+            for dec in n.decorator_list:
+                if isinstance(dec, ast.Call):
+                    jit = _jit_target(dec)
+                    if jit is not None:
+                        note(n, _donate_nums(jit), dec.lineno)
+                elif tail_of(dotted(dec)) in ("jit", "pjit"):
+                    note(n, set(), dec.lineno)
+        if not isinstance(n, ast.Call):
+            continue
+        jit = _jit_target(n)
+        if jit is None or not jit.args:
+            continue
+        target = _resolve_fn(jit.args[0], defs)
+        if target is not None:
+            note(target, _donate_nums(jit), n.lineno)
+    return reg
+
+
+def _donatable_params(fn) -> List[Tuple[int, str]]:
+    """(donate-position, name) pairs, counting from the first non-self
+    parameter the way a bound-method jit does."""
+    params = _params_of(fn)
+    off = 1 if params and params[0] in ("self", "cls") else 0
+    return [(i - off, p) for i, p in enumerate(params)
+            if p not in ("self", "cls")]
+
+
+def _returned_names(fn) -> Set[str]:
+    if isinstance(fn, ast.Lambda):
+        exprs = [fn.body]
+    else:
+        exprs = [r.value for r in _own_nodes(fn)
+                 if isinstance(r, ast.Return) and r.value is not None]
+    # only names that ARE the returned value (recursing through
+    # tuple/list structure) count — a name consumed inside a call or
+    # arithmetic in the return expression is not the plane coming back
+    names: Set[str] = set()
+
+    def collect(e):
+        if isinstance(e, ast.Name) and isinstance(e.ctx, ast.Load):
+            names.add(e.id)
+        elif isinstance(e, (ast.Tuple, ast.List)):
+            for el in e.elts:
+                collect(el)
+
+    for e in exprs:
+        collect(e)
+    return names
+
+
+def _pool_param(name: str, canon: frozenset) -> bool:
+    return _pool_name(name, canon) or name in _POOL_WEAK
+
+
+def _check_fc703(tree, reg, canon, owner_of, ctx, out):
+    for target, ent in reg.items():
+        pairs = _donatable_params(target)
+        returned = _returned_names(target)
+        qual = owner_of.get(target, getattr(target, "name", "<lambda>"))
+        tname = getattr(target, "name", "<lambda>")
+        # (a) a site with no donation, while the target returns a
+        # pool-plane parameter: the in-place update double-buffers
+        pool_returned = [(i, p) for i, p in pairs
+                         if _pool_param(p, canon) and p in returned]
+        if pool_returned:
+            for lineno, donate in ent["sites"]:
+                missing = [(i, p) for i, p in pool_returned
+                           if i not in donate]
+                if missing:
+                    pos = ", ".join(str(i) for i, _ in missing)
+                    names = ", ".join(f"'{p}'" for _, p in missing)
+                    out.append(Finding(
+                        ctx.path, lineno, "FC703",
+                        f"jit of '{tname}' returns pool plane "
+                        f"parameter(s) {names} without donating them "
+                        f"— the in-place update double-buffers the "
+                        f"pool (add donate_argnums position(s) {pos})",
+                        qual))
+        # (b) donated plane returned with changed dtype/shape: the
+        # donation cannot alias
+        donated_names = {p for i, p in pairs if i in ent["donate"]}
+        if not donated_names:
+            continue
+        for n in _own_nodes(target) if not isinstance(
+                target, ast.Lambda) else ():
+            rebind = None
+            if isinstance(n, ast.Assign) and len(n.targets) == 1:
+                rebind = n
+            if rebind is None:
+                continue
+            val = rebind.value
+            if not (isinstance(val, ast.Call) and
+                    isinstance(val.func, ast.Attribute) and
+                    val.func.attr in ("astype", "reshape")):
+                continue
+            base = dotted(val.func.value)
+            tgts = rebind.targets[0]
+            tgt_names = [e.id for e in (
+                tgts.elts if isinstance(tgts, (ast.Tuple, ast.List))
+                else [tgts]) if isinstance(e, ast.Name)]
+            if base in donated_names and base in tgt_names and \
+                    base in returned:
+                what = ("dtype" if val.func.attr == "astype"
+                        else "shape")
+                out.append(Finding(
+                    ctx.path, n.lineno, "FC703",
+                    f"donated plane '{base}' is returned with a "
+                    f"changed {what} ('{val.func.attr}') — XLA cannot "
+                    f"alias the buffers, so the donation silently "
+                    f"double-buffers; convert outside the jit boundary "
+                    f"or donate a buffer of the output {what}",
+                    qual))
+
+
+# -- FC704: scan-carry residency --------------------------------------------
+
+_GROW_CALLS = {"concatenate", "concat", "append", "hstack", "vstack",
+               "column_stack", "pad"}
+
+
+def _check_fc704(tree, reg, defs, canon, owner_of, ctx, out):
+    for fn in [n for n in ast.walk(tree) if isinstance(n, FuncNode)]:
+        for n in _own_nodes(fn):
+            if not (isinstance(n, ast.Call) and
+                    tail_of(dotted(n.func)) == "scan" and
+                    len(n.args) >= 2):
+                continue
+            local = {c.name: c for c in ast.iter_child_nodes(fn)
+                     if isinstance(c, FuncNode)}
+            step = _resolve_fn(n.args[0], {**defs, **local})
+            qual = owner_of.get(n, fn.name)
+            # (a) growing carry: step rebinds a returned name by
+            # concatenating it with itself
+            if step is not None and not isinstance(step, ast.Lambda):
+                ret = _returned_names(step)
+                for st in _own_nodes(step):
+                    if not (isinstance(st, ast.Assign) and
+                            len(st.targets) == 1 and
+                            isinstance(st.targets[0], ast.Name) and
+                            isinstance(st.value, ast.Call)):
+                        continue
+                    name = st.targets[0].id
+                    if tail_of(dotted(st.value.func)) not in _GROW_CALLS:
+                        continue
+                    self_ref = any(
+                        isinstance(s, ast.Name) and s.id == name and
+                        isinstance(s.ctx, ast.Load)
+                        for s in ast.walk(st.value))
+                    if self_ref and name in ret:
+                        out.append(Finding(
+                            ctx.path, st.lineno, "FC704",
+                            f"scan carry '{name}' grows every "
+                            f"iteration ('{tail_of(dotted(st.value.func))}' "
+                            f"with itself) — carries must be "
+                            f"fixed-shape; preallocate and write with "
+                            f".at[i].set, or emit via the ys output",
+                            owner_of.get(st, qual)))
+            # (b) pool planes carried through a non-donated jit arg
+            ent = reg.get(fn)
+            if ent is None:
+                continue
+            donated = {p for i, p in _donatable_params(fn)
+                       if i in ent["donate"]}
+            param_names = {p for _, p in _donatable_params(fn)}
+            init = n.args[1]
+            elts = init.elts if isinstance(init, (ast.Tuple, ast.List)) \
+                else [init]
+            for e in elts:
+                name = tail_of(dotted(e))
+                if not name or not _pool_param(name, canon):
+                    continue
+                if name in param_names and name not in donated:
+                    out.append(Finding(
+                        ctx.path, n.lineno, "FC704",
+                        f"scan carries pool plane '{name}', a "
+                        f"NON-donated argument of jitted '{fn.name}' — "
+                        f"every step double-buffers the plane; add its "
+                        f"position to donate_argnums",
+                        qual))
+
+
+# -- the checker ------------------------------------------------------------
+
+def check(tree: ast.Module, ctx: FileContext) -> List[Finding]:
+    canon = _canonical_pool_names()
+    owner_of = func_of_map(tree)
+    defs = _defs_by_name(tree)
+    reg = _jit_registry(tree, defs)
+    findings: List[Finding] = []
+
+    scopes = [tree] + [n for n in ast.walk(tree)
+                       if isinstance(n, FuncNode)]
+    for fn in scopes:
+        pool = _pool_locals(fn, canon) if isinstance(fn, FuncNode) \
+            else set()
+        # module level: only explicitly pool-named globals count
+        _check_fc701(fn, pool, canon, owner_of, ctx, findings)
+        _check_fc702(fn, pool, canon, owner_of, ctx, findings)
+
+    _check_fc703(tree, reg, canon, owner_of, ctx, findings)
+    _check_fc704(tree, reg, defs, canon, owner_of, ctx, findings)
+
+    # dedup (a node can be visited from nested scope iterations)
+    seen = set()
+    out = []
+    for f in sorted(findings, key=lambda f: f.sort_key()):
+        key = (f.line, f.rule, f.message)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    return out
+
+
+EXPLAIN = {
+    "FC701": (
+        "A paged pool is only cheap while it is addressed one page at "
+        "a time. `jnp.take(pool, block_tables)` (or "
+        "`pool[block_tables]`, or feeding `_dequantize_gather` a whole "
+        "table) gathers EVERY row's EVERY page into a dense "
+        "[rows, max_pages, block, heads, d] intermediate — the exact "
+        "bug that once made ragged serving slower than dense: HBM "
+        "traffic scales with the pool, not the tokens. Outer-product "
+        "broadcasts of pool-scale operands (`a[:, None] * b[None, :]`) "
+        "materialize the same way through shape expansion. Fix: walk "
+        "pages online (fori_loop over a per-step table column, "
+        "online-softmax style) or gather only the rows' own pages. "
+        "The rule also enumerates pool gathers that rely on jnp.take's "
+        "default out-of-bounds mode — unused page slots hold sentinel "
+        "ids, and the default fills float gathers with NaN; pass "
+        "mode= explicitly."),
+    "FC702": (
+        "Quantized and bf16 planes earn their bytes only if nothing "
+        "silently promotes them. An f32 literal in plane arithmetic, "
+        "a whole-plane `.astype(jnp.float32)`, or a dtype-less "
+        "`jnp.zeros(...)` scattered into a plane each force XLA to "
+        "materialize an f32 copy of the pool (2-4x bytes) inside the "
+        "traced body. The quantized-tuple variant is worse than a "
+        "footprint leak: unpacking `(values, scales)` and dropping "
+        "the scales half feeds raw int8 codes to downstream math — "
+        "numerically wrong, not just big. Fix: fold constants into "
+        "the dequant scale, dequantize per-page inside the kernel, "
+        "pass the plane dtype to fills, and thread both tuple halves."),
+    "FC703": (
+        "donate_argnums is a promise, not a guarantee. Two ways it "
+        "silently fails to save memory: (a) the jit never donates a "
+        "pool plane its target updates and returns — functional "
+        "in-place updates (`pool.at[...].set`) then allocate a second "
+        "full plane per dispatch; (b) the plane IS donated but comes "
+        "back with a different dtype or shape, which XLA cannot alias "
+        "(input and output buffers must match byte-for-byte), so the "
+        "donation is accepted and ignored. FC501 catches reading a "
+        "donated buffer after the call; FC703 catches donations that "
+        "never took effect at all. Fix: donate every returned plane, "
+        "and keep dtype/shape fixed across the jit boundary."),
+    "FC704": (
+        "A lax.scan carry is resident for the whole scan. Two hazard "
+        "shapes: (a) a carry that grows per iteration "
+        "(concatenating itself) — scan requires fixed carry shapes, "
+        "and the workaround people reach for (padding, re-tracing) "
+        "multiplies bytes by the trip count; preallocate and write "
+        "with .at[i].set, or emit per-step values through the ys "
+        "output. (b) the multi_step=k hot spot: the carry holds whole "
+        "pool planes, which is exactly right for fused decode — but "
+        "only if the enclosing jit donates them. A non-donated plane "
+        "carried through k steps double-buffers the pool for the "
+        "duration of every dispatch."),
+}
+
+
+def setup(register):
+    register("memory", check, {
+        "FC701": "flat whole-table gather / broadcast materializes a "
+                 "pool-scale intermediate (or pool take without "
+                 "explicit OOB mode)",
+        "FC702": "dtype-footprint leak: f32 op upcasts a quantized "
+                 "plane, or a (values, scales) path drops the scales",
+        "FC703": "pool-plane jit argument whose donation is missing "
+                 "or cannot alias (dtype/shape change)",
+        "FC704": "lax.scan carry grows per iteration or carries a "
+                 "non-donated pool plane",
+    }, EXPLAIN)

@@ -6,8 +6,8 @@
 #   tools/run_checks.sh --fast     # skip the tier-1 pytest sweep
 #
 # Phases:
-#   1. flightcheck over paddle_tpu/ (AST rules FC1xx-FC6xx incl. the
-#      SPMD/sharding family, committed baseline, on-disk findings
+#   1. flightcheck over paddle_tpu/ (AST rules FC1xx-FC7xx incl. the
+#      SPMD/sharding and memory-hazard families, committed baseline, on-disk findings
 #      cache; see tools/flightcheck/ and README "Static analysis").
 #      Tip: `python -m tools.flightcheck --changed paddle_tpu/` scopes
 #      a local run to git-modified files.
@@ -16,7 +16,13 @@
 #   3. comm audit: abstract-trace the distributed entry points on the
 #      8-device mesh and pin each program's collectives (kind/axis/
 #      bytes/count) against tools/flightcheck/comm_expectations.json
-#   4. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
+#   4. mem audit: abstract-trace the SAME entry points and pin each
+#      program's memory shape (argument/output/peak-temp bytes,
+#      donated bytes actually aliased, scan-carry residency) against
+#      tools/flightcheck/mem_expectations.json, plus the cross-program
+#      relations (int8 pool < fp32, multi-step carry flat in k, dp2
+#      byte-identical to fp32)
+#   5. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
 #      serving-path tests incl. test_fault_tolerance.py and
 #      test_ragged_batching.py; includes its own paddle_tpu/ flightcheck
 #      AND the deterministic chaos schedule across all eight legs —
@@ -27,33 +33,37 @@
 #      ragged_ms4 leg additionally demands >=1 multi-step fused
 #      window dispatched), with token-identity vs a fault-free
 #      replay)
-#   5. tier-1 pytest (tests/, -m 'not slow')
+#   6. tier-1 pytest (tests/, -m 'not slow')
 set -u -o pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}"
 rc=0
 
-echo "== [1/5] flightcheck: static analysis over paddle_tpu/ =="
+echo "== [1/6] flightcheck: static analysis over paddle_tpu/ =="
 python -m tools.flightcheck paddle_tpu/ || rc=1
 
-echo "== [2/5] flightcheck --jaxpr: entry-point cross-check =="
+echo "== [2/6] flightcheck --jaxpr: entry-point cross-check =="
 python -m tools.flightcheck --jaxpr paddle_tpu/inference/ || rc=1
 
-echo "== [3/5] comm audit: distributed collectives vs expectations =="
+echo "== [3/6] comm audit: distributed collectives vs expectations =="
 python -m tools.flightcheck.comm_audit || rc=1
 
-echo "== [4/5] serving invariants (runtime debug_check + chaos gate) =="
-# the invariants gate skips its own comm-audit leg — phase 3 just ran it
-FLIGHTCHECK_COMM_AUDIT_RAN=1 python tools/check_serving_invariants.py || rc=1
+echo "== [4/6] mem audit: per-program HBM bytes vs expectations =="
+python -m tools.flightcheck.mem_audit || rc=1
+
+echo "== [5/6] serving invariants (runtime debug_check + chaos gate) =="
+# the invariants gate skips its own audit legs — phases 3 and 4 just ran
+FLIGHTCHECK_COMM_AUDIT_RAN=1 FLIGHTCHECK_MEM_AUDIT_RAN=1 \
+    python tools/check_serving_invariants.py || rc=1
 
 if [ "${1:-}" != "--fast" ]; then
-    echo "== [5/5] tier-1 pytest =="
+    echo "== [6/6] tier-1 pytest =="
     python -m pytest tests/ -q -m 'not slow' \
         --continue-on-collection-errors -p no:cacheprovider \
         -p no:randomly || rc=1
 else
-    echo "== [5/5] tier-1 pytest skipped (--fast) =="
+    echo "== [6/6] tier-1 pytest skipped (--fast) =="
 fi
 
 if [ "$rc" -ne 0 ]; then

@@ -162,7 +162,7 @@ def analyze(doc: dict, top: int = 5) -> dict:
         if a.get("sealed"):
             row["unexpected"] += 1
         for k in ("flops", "bytes_accessed", "temp_bytes",
-                  "output_bytes"):
+                  "output_bytes", "argument_bytes"):
             if k in a:
                 row[k] = a[k]
     compiles = {}
@@ -298,6 +298,21 @@ def format_report(rep: dict) -> str:
                 f"  {fam:18s} n={r['count']:<4d} "
                 f"total={r['total_wall_s']:<9g} "
                 f"max={r['max_wall_s']:g}{extra}{flag}")
+        # XLA memory_analysis per family (CompileWatch analyze=True):
+        # argument/peak-temp/output bytes of the last compile observed
+        mem_fams = {fam: r for fam, r in rep["compiles"].items()
+                    if any(k in r for k in (
+                        "argument_bytes", "temp_bytes", "output_bytes"))}
+        if mem_fams:
+            lines.append("memory by family (XLA memory_analysis):")
+            for fam, r in mem_fams.items():
+                parts = "".join(
+                    f" {label}={r[k]:g}B"
+                    for k, label in (("argument_bytes", "args"),
+                                     ("temp_bytes", "peak-temp"),
+                                     ("output_bytes", "out"))
+                    if k in r)
+                lines.append(f"  {fam:18s}{parts}")
     if rep.get("tracks"):
         lines.append("counter tracks:")
         for rname, tr in rep["tracks"].items():
