@@ -40,6 +40,19 @@ SpecLayout dp x tp grid) and adds the replica-level half of the story:
 - optional probation: cooldown_steps after wedging, the replica
   re-enters routing on PROBATION — one strike re-wedges it instantly;
   probation_steps consecutive clean steps promote it back to healthy.
+- replica transports (ISSUE 19): every engine access goes through a
+  ReplicaTransport (inference/transport.py). ``transport="inproc"``
+  (default) is the in-process engine, bitwise-identical to PR 11;
+  ``transport="process"`` runs each engine in a SPAWNED worker process
+  behind an RPC pipe — two extra health signals (missed heartbeat,
+  process exit) feed the same breaker, and because a dead worker's
+  memory is gone the Router keeps an authoritative per-request JOURNAL
+  (prompt, sampling, delivered-token watermark, trace id) updated at
+  collection with exactly-once semantics: failover reconstructs every
+  in-flight request host-side and re-enqueues it via adopt_request —
+  greedy outputs token-identical across a hard SIGKILL. A supervisor
+  respawns dead workers (fresh engine, replayed warmup + seal, then
+  the PR-11 probation re-admission).
 
 dp adds ZERO step-path collectives: replicas never talk during a step
 (affinity is a host-side hash lookup, migration is a host-side
@@ -77,8 +90,14 @@ from ..distributed.spec_layout import SpecLayout
 from ..utils.telemetry import FLEET_PID, Reservoir, SLOMonitor, SLOPolicy
 from .serving import (EngineOverloaded, SamplingParams, ServingEngine,
                       _normalize_prompt)
+from .transport import (InProcTransport, ProcTransport, RequestView,
+                        TransportError, WorkerDied, WorkerSpec)
 
 __all__ = ["Router", "Replica"]
+
+# journal states considered live (the engine's non-terminal states);
+# terminal entries stop being acked and are pruned by clear_finished
+_LIVE_STATES = ("queued", "prefilling", "running")
 
 
 @dataclass
@@ -102,9 +121,38 @@ class Replica:
     # request that failed long ago was already observed as failed by
     # the caller — resurrecting it would change a delivered answer).
     # Rebuilt lazily: valid while engine.failed == snap_failed_cnt,
-    # so the steady state (no failures) never rescans _done
+    # so the steady state (no failures) never rescans _done.
+    # Remote replicas store journal FIDs here instead of engine rids
+    # (the journal is the Router's only authoritative view of a
+    # worker it cannot trust to answer)
     burst_failed_mark: frozenset = frozenset()
     snap_failed_cnt: int = 0
+    # the transport driving this replica (ISSUE 19): InProcTransport
+    # wraps `engine` (kept live for tests/harnesses); ProcTransport
+    # owns a worker process and `engine` is None
+    transport: object = None
+    # last step's worker-reported load (remote replicas: the counter
+    # track must not cost an RPC; in-proc replicas read the engine)
+    last_load: int = 0
+
+
+@dataclass
+class _JournalEntry:
+    """Router-side delivery journal for ONE fleet request (ISSUE 19):
+    the delivered-token watermark plus last observed state. Together
+    with _FleetRequest (prompt, sampling, trace_id) this is everything
+    failover needs to reconstruct an in-flight request after a worker
+    dies with its memory. ``delivered`` only ever EXTENDS past its
+    current length against the reply's ack base — exactly-once no
+    matter how many times a step reply crosses the pipe."""
+    fid: int
+    state: str = "queued"
+    delivered: List[int] = None     # type: ignore[assignment]
+    error: Optional[str] = None
+
+    def __post_init__(self):
+        if self.delivered is None:
+            self.delivered = []
 
 
 @dataclass
@@ -150,7 +198,20 @@ class Router:
         ServingEngine`` overriding default construction — prebuilt
         decoders, GPT twins, per-replica AdapterRegistry instances (a
         registry binds to one engine's pool and must NOT be shared
-        across replicas).
+        across replicas). With ``transport="process"`` the factory is
+        pickled to the worker, so it must be a module-level callable.
+    transport : ``"inproc"`` (default — engines live in this process,
+        bitwise-identical to the pre-transport Router) or
+        ``"process"`` — each engine in a SPAWNED worker behind an RPC
+        pipe (crash isolation; see inference/transport.py).
+    heartbeat_timeout_s : (process transport) heartbeat silence beyond
+        this is a breaker strike per step — the liveness signal for a
+        hung-but-not-dead worker. None disables.
+    rpc_timeout_s / rpc_retries : (process transport) per-RPC deadline
+        and bounded retry budget for transient transport faults
+        (exactly-once by the worker's reply cache).
+    respawn : (process transport) supervisor restart of dead workers —
+        fresh engine, replayed warmup/seal, probation re-admission.
     **engine_kwargs : forwarded to every ServingEngine (max_batch_size,
         num_blocks, prefill_chunk, ragged, spec_decode, ...).
     """
@@ -162,11 +223,19 @@ class Router:
                  cooldown_steps: Optional[int] = None,
                  probation_steps: int = 8,
                  engine_factory: Optional[Callable] = None,
+                 transport: str = "inproc",
+                 heartbeat_timeout_s: Optional[float] = 10.0,
+                 rpc_timeout_s: float = 120.0,
+                 rpc_retries: int = 2,
+                 respawn: bool = True,
                  tracer=None, slo=None,
                  **engine_kwargs):
         dp = int(dp)
         if dp < 1:
             raise ValueError(f"dp must be >= 1, got {dp}")
+        if transport not in ("inproc", "process"):
+            raise ValueError(f"transport must be 'inproc' or "
+                             f"'process', got {transport!r}")
         self.dp = dp
         self.tp = int(tp)
         self.affinity = bool(affinity)
@@ -175,6 +244,11 @@ class Router:
         self.cooldown_steps = (int(cooldown_steps)
                                if cooldown_steps is not None else None)
         self.probation_steps = max(1, int(probation_steps))
+        self.transport = transport
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.rpc_timeout_s = float(rpc_timeout_s)
+        self.rpc_retries = int(rpc_retries)
+        self.respawn = bool(respawn)
         # per-replica device rows from the canonical dp x tp grid
         # (tp=1 replicas share the default device — placement only
         # matters once a replica actually spans chips)
@@ -204,6 +278,20 @@ class Router:
                              "engines their own SLOMonitor)")
         self.replicas: List[Replica] = []
         for r in range(dp):
+            if transport == "process":
+                spec = WorkerSpec(
+                    model=(None if engine_factory is not None
+                           else model),
+                    factory=engine_factory, dp=dp, tp=self.tp,
+                    engine_kwargs=dict(engine_kwargs),
+                    slo_policies=tuple(self._slo_policies),
+                    traced=tracer is not None)
+                tr = ProcTransport(
+                    spec, replica_id=r, tracer=tracer,
+                    rpc_timeout_s=self.rpc_timeout_s,
+                    rpc_retries=self.rpc_retries)
+                self.replicas.append(Replica(r, None, transport=tr))
+                continue
             if engine_factory is not None:
                 eng = engine_factory(r, slices[r])
             else:
@@ -214,10 +302,17 @@ class Router:
                                     **kw)
             if tracer is not None:
                 eng.set_telemetry(tracer, replica_id=r)
-            self.replicas.append(Replica(r, eng))
+            self.replicas.append(Replica(r, eng,
+                                         transport=InProcTransport(
+                                             eng)))
         self._requests: Dict[int, _FleetRequest] = {}
+        # per-request delivery journal (ISSUE 19): authoritative for
+        # BOTH transports (uniform gauges + acks); only the process
+        # transport depends on it for correctness
+        self._journal: Dict[int, _JournalEntry] = {}
         self._fids = itertools.count()
         self._step_no = 0
+        self._closed = False
         # routing / robustness counters (reset by clear_finished)
         self.routed_requests = 0
         self.affinity_hits = 0
@@ -226,6 +321,10 @@ class Router:
         self.migrated_requests = 0
         self.failed_migrations = 0
         self.shed_requests = 0
+        # fleet-process counters (ISSUE 19, reset by clear_finished)
+        self.worker_exits = 0
+        self.worker_restarts = 0
+        self.heartbeat_misses = 0
 
     # -- routing policy ------------------------------------------------------
     def _eligible(self) -> List[Replica]:
@@ -246,20 +345,36 @@ class Router:
         cache = eng.dec.cache
         return len(cache.match_prefix(prompt, salt)) * cache.block_size
 
+    def _cov_of(self, rep: Replica, prompt, salt) -> int:
+        """Transport coverage probe, fault-tolerant: a dying remote
+        replica answers 0 (it will be wedged by the next step; routing
+        must not crash on it)."""
+        try:
+            return rep.transport.match_coverage(prompt, salt)
+        except TransportError:
+            return 0
+
+    def _load_of(self, rep: Replica) -> int:
+        try:
+            return rep.transport.load()
+        except TransportError:
+            return 1 << 30      # dying remote: route anywhere else
+
     def _ranked(self, prompt, sp: SamplingParams,
                 exclude: Sequence[int] = ()
                 ) -> Tuple[List[Replica], Dict[int, int]]:
         """Admission order: longest coverage first (affinity), ties —
         and the affinity=False mode — by (load, replica idx). Fully
-        deterministic: equal fleets route equal traffic equally."""
+        deterministic: equal fleets route equal traffic equally (the
+        process transport's coverage/load probes are exact RPCs, so an
+        inproc fleet and a process fleet route identically)."""
         cands = [rep for rep in self._eligible()
                  if rep.idx not in exclude]
-        cov = {rep.idx: (self._coverage(rep.engine, prompt,
-                                        sp.adapter_id)
+        cov = {rep.idx: (self._cov_of(rep, prompt, sp.adapter_id)
                          if self.affinity else 0)
                for rep in cands}
         return sorted(cands, key=lambda rep: (-cov[rep.idx],
-                                              self._load(rep.engine),
+                                              self._load_of(rep),
                                               rep.idx)), cov
 
     def add_request(self, prompt, sampling: Optional[SamplingParams]
@@ -283,7 +398,7 @@ class Router:
         last_exc = invalid = None
         for pos, rep in enumerate(order):
             try:
-                rid = rep.engine.add_request(prompt, sp)
+                rid, tid = rep.transport.add_request(prompt, sp)
             except EngineOverloaded as e:
                 last_exc = e
                 continue
@@ -296,19 +411,24 @@ class Router:
                 # the honest one to surface
                 invalid = invalid or e
                 continue
+            except TransportError as e:
+                # a dying remote replica refuses like a saturated one:
+                # spill to the next candidate (the breaker wedges it
+                # on its own evidence at the next step)
+                last_exc = e
+                continue
             fid = next(self._fids)
             rec = _FleetRequest(fid, prompt, sp, rep.idx, rid,
                                 t_submit=time.perf_counter())
+            rec.trace_id = tid
             self._requests[fid] = rec
+            self._journal[fid] = _JournalEntry(fid)
             self.routed_requests += 1
             if cov.get(rep.idx, 0) > 0:
                 self.affinity_hits += 1
             if pos > 0:
                 self.spills += 1
             if self.tracer is not None:
-                req = rep.engine._find_request(rid)
-                rec.trace_id = (req.trace_id if req is not None
-                                else None)
                 self.tracer.event(
                     "route", trace=rec.trace_id, pid=FLEET_PID,
                     fid=fid, replica=rep.idx,
@@ -334,26 +454,82 @@ class Router:
     def _owner(self, fid: int) -> Replica:
         return self.replicas[self._record(fid).replica]
 
+    def _journal_view(self, fid: int) -> Optional[RequestView]:
+        """Reconstruct a request view from the journal — the fallback
+        when the owning WORKER's memory is gone (died, or respawned
+        fresh). Exact for terminal requests: the terminal delivery
+        carried every remaining token before the state flipped."""
+        rec = self._requests.get(fid)
+        je = self._journal.get(fid)
+        if rec is None or je is None:
+            return None
+        return RequestView(req_id=rec.rid, state=je.state,
+                           out_tokens=list(je.delivered),
+                           error=je.error, trace_id=rec.trace_id)
+
     def request(self, fid: int):
-        """The current owner's Request record (live or terminal)."""
+        """The current owner's Request record (live or terminal). For
+        a process-transport replica this is a RequestView (same duck
+        type); if the owning worker died or was respawned, the view
+        is reconstructed from the Router's journal."""
         rec = self._record(fid)
-        eng = self.replicas[rec.replica].engine
-        req = eng._find_request(rec.rid)
-        if req is None:
-            raise KeyError(f"fleet request {fid}: engine record "
-                           f"{rec.rid} gone (cleared?)")
-        return req
+        rep = self.replicas[rec.replica]
+        if not rep.transport.remote:
+            req = rep.engine._find_request(rec.rid)
+            if req is None:
+                raise KeyError(f"fleet request {fid}: engine record "
+                               f"{rec.rid} gone (cleared?)")
+            return req
+        # TERMINAL entries answer from the journal, never the worker:
+        # the terminal delivery carried every remaining token, and a
+        # RESPAWNED worker's fresh engine restarts its req_id counter
+        # at 0 — the stale rec.rid may now name a DIFFERENT request
+        je = self._journal.get(fid)
+        if je is not None and je.state not in _LIVE_STATES:
+            return self._journal_view(fid)
+        try:
+            view = rep.transport.view(rec.rid)
+        except TransportError:
+            view = None
+        if view is not None:
+            return view
+        view = self._journal_view(fid)
+        if view is not None:
+            return view
+        raise KeyError(f"fleet request {fid}: engine record "
+                       f"{rec.rid} gone (cleared?)")
 
     def result(self, fid: int) -> np.ndarray:
         rec = self._record(fid)
-        return self.replicas[rec.replica].engine.result(rec.rid)
+        rep = self.replicas[rec.replica]
+        if not rep.transport.remote:
+            return rep.engine.result(rec.rid)
+        # journal-first for terminal states: the delivered watermark
+        # IS the full output, and it cannot alias a respawned
+        # worker's recycled req_id the way rec.rid can
+        je = self._journal.get(fid)
+        if je is not None and je.state not in _LIVE_STATES:
+            return np.asarray(je.delivered, np.int32)
+        try:
+            return rep.transport.result(rec.rid)
+        except (KeyError, TransportError):
+            raise KeyError(f"fleet request {fid}: result not "
+                           f"available (rid {rec.rid})")
 
     def migrations(self, fid: int) -> int:
         return self._record(fid).migrations
 
     def cancel(self, fid: int) -> bool:
         rec = self._record(fid)
-        return self.replicas[rec.replica].engine.cancel(rec.rid)
+        rep = self.replicas[rec.replica]
+        if rep.transport.remote:
+            je = self._journal.get(fid)
+            if je is not None and je.state not in _LIVE_STATES:
+                # terminal per the journal: the inproc False-on-
+                # terminal contract, without risking a stale rec.rid
+                # cancelling a respawned worker's recycled req_id
+                return False
+        return rep.transport.cancel(rec.rid)
 
     @property
     def has_work(self) -> bool:
@@ -361,7 +537,7 @@ class Router:
         non-wedged ones always; wedged ones only if probation can
         revive them (their live queue was drained at wedge time, so
         this is almost always the non-wedged term)."""
-        return any(rep.engine.has_work for rep in self.replicas
+        return any(rep.transport.has_work() for rep in self.replicas
                    if rep.state != "wedged"
                    or self.cooldown_steps is not None)
 
@@ -369,6 +545,53 @@ class Router:
     def _failed_rids(self, eng: ServingEngine) -> frozenset:
         return frozenset(rid for rid, r in eng._done.items()
                          if r.state == "failed")
+
+    def _failed_fids(self, rep: Replica) -> frozenset:
+        """Journal fids already failed on `rep` — the remote replica's
+        burst snapshot (its engine cannot be trusted to answer when
+        the burst is a dying process)."""
+        return frozenset(
+            fid for fid, je in self._journal.items()
+            if je.state == "failed"
+            and (rec := self._requests.get(fid)) is not None
+            and rec.replica == rep.idx)
+
+    def _acks_for(self, rep: Replica
+                  ) -> Tuple[Dict[int, int], Dict[int, int]]:
+        """(acks, rid->fid) for one replica's step RPC: every live
+        journal entry it owns, acked at its delivered watermark."""
+        acks: Dict[int, int] = {}
+        ridmap: Dict[int, int] = {}
+        for fid, je in self._journal.items():
+            if je.state not in _LIVE_STATES:
+                continue
+            rec = self._requests.get(fid)
+            if rec is None or rec.replica != rep.idx:
+                continue
+            acks[rec.rid] = len(je.delivered)
+            ridmap[rec.rid] = fid
+        return acks, ridmap
+
+    def _apply_deliveries(self, deliveries, ridmap: Dict[int, int]):
+        """Extend the journal exactly once per token: a delivery's
+        tokens start at its echoed ack base, so extension happens only
+        past the CURRENT watermark — idempotent under RPC retry (the
+        same reply applied twice extends nothing the second time)."""
+        for d in deliveries:
+            fid = ridmap.get(d["rid"])
+            if fid is None:
+                continue
+            je = self._journal.get(fid)
+            if je is None:
+                continue
+            have = len(je.delivered)
+            base = d["base"]
+            toks = d["tokens"]
+            if base <= have < base + len(toks):
+                je.delivered.extend(toks[have - base:])
+            if d["state"] != "gone":
+                je.state = d["state"]
+                je.error = d["error"]
 
     def _strike(self, rep: Replica, amount: int,
                 prestep_mark: frozenset):
@@ -416,6 +639,9 @@ class Router:
         fair. With no healthy replica left the requests stay terminal
         on the wedged engine (the fleet is down; results of already-
         finished requests remain readable)."""
+        if rep.transport.remote:
+            self._drain_remote(rep)
+            return
         eng = rep.engine
         victims = []            # (record, out_tokens harvested)
         for fid in sorted(self._requests):
@@ -449,6 +675,49 @@ class Router:
         for rec, toks in victims:
             self._migrate(rec, toks)
 
+    def _drain_remote(self, rep: Replica):
+        """The journal-backed drain (ISSUE 19): a remote replica's
+        memory may be GONE (SIGKILL) or unreachable (hang), so the
+        harvest reads the Router's own journal — delivered-token
+        watermarks updated at collection with exactly-once semantics —
+        instead of the engine. Live entries migrate with their
+        delivered history (token-identical greedy resume); entries the
+        fault burst failed migrate like the in-proc path. Cancels are
+        best-effort RPCs, skipped entirely for a dead worker."""
+        alive = rep.transport.alive()
+        victims = []
+        for fid in sorted(self._requests):
+            rec = self._requests[fid]
+            if rec.replica != rep.idx:
+                continue
+            je = self._journal.get(fid)
+            if je is None:
+                continue
+            if je.state in _LIVE_STATES:
+                victims.append((rec, list(je.delivered)))
+                if alive:
+                    # migration, not a terminal end: the worker keeps
+                    # the span open (migrate_cancel sets
+                    # trace_keep_open before the local unwind)
+                    try:
+                        rep.transport.migrate_cancel(rec.rid)
+                    except Exception:   # noqa: BLE001 — best effort
+                        pass
+            elif (je.state == "failed"
+                  and fid not in rep.burst_failed_mark):
+                victims.append((rec, list(je.delivered)))
+                if self.tracer is not None:
+                    # the forwarded burst-failure end already closed
+                    # this span; the migration supersedes it (if the
+                    # end record never made it over the pipe before
+                    # the death, reopen is a harmless no-op)
+                    self.tracer.reopen_request(rec.trace_id)
+        if self.tracer is not None:
+            self.tracer.event("failover", pid=FLEET_PID,
+                              replica=rep.idx, victims=len(victims))
+        for rec, toks in victims:
+            self._migrate(rec, toks)
+
     def _migrate(self, rec: _FleetRequest, out_tokens: List[int]):
         """Re-enqueue one drained request on the best healthy replica
         (affinity order over prompt ++ history — the history's blocks
@@ -462,7 +731,7 @@ class Router:
                                 exclude=(rec.replica,))
         for target in order:
             try:
-                rid = target.engine.adopt_request(
+                rid = target.transport.adopt_request(
                     rec.prompt, rec.sampling, out_tokens=out_tokens,
                     t_submit=rec.t_submit, trace_id=rec.trace_id)
             except Exception:   # noqa: BLE001 — a refusing candidate
@@ -480,6 +749,14 @@ class Router:
             rec.replica = target.idx
             rec.migrations += 1
             self.migrated_requests += 1
+            je = self._journal.get(rec.fid)
+            if je is not None:
+                # the adopted request's history IS the harvested
+                # tokens: re-anchor the watermark so the new owner's
+                # deliveries extend from exactly here
+                je.delivered = [int(t) for t in out_tokens]
+                je.state = "queued"
+                je.error = None
             return
         # no candidate accepted (fleet down / nowhere fits): the
         # request stays terminal on the wedged engine — its record
@@ -487,6 +764,13 @@ class Router:
         # state reads aborted/failed) and the refusal is COUNTED so
         # a failovers-vs-victims delta is visible in stats
         self.failed_migrations += 1
+        je = self._journal.get(rec.fid)
+        if je is not None and je.state in _LIVE_STATES:
+            # remote owner: record the terminal verdict in the journal
+            # so request()/result() answer from it — the dead/respawned
+            # worker can no longer speak for this fid
+            je.state = "failed"
+            je.error = "migration failed"
         if self.tracer is not None:
             self.tracer.event("migration_failed", trace=rec.trace_id,
                               pid=FLEET_PID, fid=rec.fid,
@@ -508,6 +792,52 @@ class Router:
                 self.tracer.event("breaker_probation", pid=FLEET_PID,
                                   replica=rep.idx, step=self._step_no)
 
+    # -- supervisor (ISSUE 19) -----------------------------------------------
+    def _worker_death(self, rep: Replica, reason: str):
+        """A remote replica's PROCESS is gone (pipe EOF / waitpid) or
+        beyond trust (heartbeat-silent past the wedge): count the
+        exit, wedge + journal-drain it, then respawn if supervised."""
+        self.worker_exits += 1
+        if self.tracer is not None:
+            self.tracer.event("worker_exit", pid=FLEET_PID,
+                              replica=rep.idx, reason=reason,
+                              step=self._step_no)
+        if rep.state != "wedged":
+            self._wedge(rep)
+        if self.respawn:
+            self._respawn(rep)
+
+    def _respawn(self, rep: Replica):
+        """Supervisor restart: fresh worker + engine, replayed warmup
+        / warmup_programs / seal_programs (the respawned replica must
+        serve with a SEALED program set or every dispatch would count
+        as an unexpected recompile), then straight onto PROBATION —
+        the PR-11 re-admission ladder, no cooldown (the old process is
+        gone; there is nothing to cool down)."""
+        t0 = time.perf_counter()
+        try:
+            rep.transport.respawn()
+        except Exception as e:  # noqa: BLE001 — a failed respawn
+            # leaves the replica wedged; the supervisor does not loop
+            if self.tracer is not None:
+                self.tracer.event("worker_respawn_failed",
+                                  pid=FLEET_PID, replica=rep.idx,
+                                  error=type(e).__name__)
+            return
+        wall = time.perf_counter() - t0
+        self.worker_restarts += 1
+        rep.state = "probation"
+        rep.strikes = 0
+        rep.probation_clean = 0
+        rep.exh_mark = 0        # fresh engine: counters restart at 0
+        rep.disp_mark = 0
+        rep.snap_failed_cnt = 0
+        rep.burst_failed_mark = frozenset()
+        if self.tracer is not None:
+            self.tracer.event("worker_respawn", pid=FLEET_PID,
+                              replica=rep.idx, step=self._step_no,
+                              wall_s=wall)
+
     # -- stepping ------------------------------------------------------------
     def step(self) -> bool:
         """One fleet iteration: step every non-wedged replica, read its
@@ -515,37 +845,85 @@ class Router:
         revive cooled-down replicas onto probation. Returns True while
         any steppable replica has work. Like ServingEngine.step(), this
         never raises on a replica fault — a dying replica becomes a
-        drain, not an exception."""
+        drain, not an exception. Process-transport replicas add two
+        pre-step liveness gates (process exit, heartbeat silence) and
+        a post-step journal update; the in-proc path is the PR-11 loop
+        verbatim behind the transport interface."""
         self._step_no += 1
         for rep in self.replicas:
             if rep.state == "wedged":
                 self._maybe_probation(rep)
                 continue
-            eng = rep.engine
-            # pre-step failed-set snapshot: only consulted if THIS
-            # step opens a strike burst (see _strike). The frozenset
-            # is rebuilt only when engine.failed moved since the last
-            # build — an O(1) check per step instead of an O(finished)
-            # scan of _done; mid-burst (strikes > 0) the burst-start
-            # snapshot must stand, so no refresh
-            if rep.strikes == 0 and eng.failed != rep.snap_failed_cnt:
-                rep.burst_failed_mark = self._failed_rids(eng)
-                rep.snap_failed_cnt = eng.failed
+            tr = rep.transport
+            if tr.remote:
+                if not tr.alive():
+                    # process exit (waitpid): immediate wedge + drain
+                    # + respawn — no strike accumulation; a dead
+                    # process yields no more evidence
+                    self._worker_death(rep, "process_exit")
+                    continue
+                if rep.strikes == 0:
+                    rep.burst_failed_mark = self._failed_fids(rep)
+                age = tr.heartbeat_age()
+                if (self.heartbeat_timeout_s is not None
+                        and age is not None
+                        and age > self.heartbeat_timeout_s):
+                    # heartbeat-silent: strike (not instant wedge —
+                    # one missed beat on a loaded host is evidence,
+                    # not proof). The step RPC is SKIPPED: a hung
+                    # worker would cost the full RPC deadline
+                    self.heartbeat_misses += 1
+                    if self.tracer is not None:
+                        self.tracer.event(
+                            "heartbeat_miss", pid=FLEET_PID,
+                            replica=rep.idx, age_s=age)
+                    self._strike(rep, 1, rep.burst_failed_mark)
+                    if rep.state == "wedged" and self.respawn:
+                        # wedged on silence: the process is beyond
+                        # trust — kill it and respawn fresh
+                        try:
+                            tr.kill_worker()
+                        except Exception:   # noqa: BLE001
+                            pass
+                        self._worker_death(rep, "heartbeat")
+                    continue
+            else:
+                eng = rep.engine
+                # pre-step failed-set snapshot: only consulted if THIS
+                # step opens a strike burst (see _strike). The
+                # frozenset is rebuilt only when engine.failed moved
+                # since the last build — an O(1) check per step
+                # instead of an O(finished) scan of _done; mid-burst
+                # (strikes > 0) the burst-start snapshot must stand,
+                # so no refresh
+                if rep.strikes == 0 \
+                        and eng.failed != rep.snap_failed_cnt:
+                    rep.burst_failed_mark = self._failed_rids(eng)
+                    rep.snap_failed_cnt = eng.failed
             prestep_mark = rep.burst_failed_mark
-            t0 = time.perf_counter()
-            raised = False
+            acks, ridmap = self._acks_for(rep)
             try:
-                eng.step()
-            except Exception:           # noqa: BLE001 — contract says
-                raised = True           # never, but a wedge IS the
-            wall = time.perf_counter() - t0   # never-happens case
-            exh = eng.dispatch_exhaustions - rep.exh_mark
-            rep.exh_mark = eng.dispatch_exhaustions
-            disp = eng.device_dispatches - rep.disp_mark
-            rep.disp_mark = eng.device_dispatches
+                res = tr.step(acks)
+            except WorkerDied:
+                self._worker_death(rep, "process_exit")
+                continue
+            except TransportError:
+                # retries exhausted but the process is alive: fault
+                # evidence, same ladder as a dispatch exhaustion
+                self._strike(rep, 1, prestep_mark)
+                continue
+            # journal first, health second: the drain a strike may
+            # trigger reads the journal, which must reflect THIS
+            # step's deliveries (exactly-once by the ack-base check)
+            self._apply_deliveries(res.deliveries, ridmap)
+            rep.last_load = res.load
+            exh = res.dispatch_exhaustions - rep.exh_mark
+            rep.exh_mark = res.dispatch_exhaustions
+            disp = res.device_dispatches - rep.disp_mark
+            rep.disp_mark = res.device_dispatches
             stalled = (self.stall_timeout_s is not None
-                       and wall > self.stall_timeout_s)
-            if raised or exh > 0 or stalled:
+                       and res.wall > self.stall_timeout_s)
+            if res.raised or exh > 0 or stalled:
                 self._strike(rep, exh, prestep_mark)
             elif disp > 0:
                 # clean step WITH device activity: real evidence of
@@ -566,8 +944,11 @@ class Router:
             # replica's own track, fleet health on the fleet track —
             # the resource timeline next to the request spans
             for rep in self.replicas:
-                self.tracer.counter("load", self._load(rep.engine),
-                                    pid=rep.idx)
+                self.tracer.counter(
+                    "load",
+                    (rep.last_load if rep.transport.remote
+                     else self._load(rep.engine)),
+                    pid=rep.idx)
             self.tracer.counter(
                 "healthy_replicas",
                 sum(1 for rep in self.replicas
@@ -593,17 +974,20 @@ class Router:
         replica's program set (ServingEngine.warmup contract)."""
         for rep in self.replicas:
             if rep.state != "wedged":
-                rep.engine.warmup(prompt_len,
-                                  seal_programs=seal_programs)
+                rep.transport.warmup(prompt_len,
+                                     seal_programs=seal_programs)
         self.clear_finished()
 
     def warmup_programs(self, max_width: Optional[int] = None):
         """Grid-compile every replica's reachable program set by
         direct invocation (no scheduler traffic, no PRNG keys — see
-        ServingEngine.warmup_programs)."""
+        ServingEngine.warmup_programs). On the process transport this
+        call (like warmup/seal) is recorded by the transport and
+        REPLAYED into a respawned worker, so a supervisor restart
+        comes back with the same compiled+sealed program set."""
         for rep in self.replicas:
             if rep.state != "wedged":
-                rep.engine.warmup_programs(max_width)
+                rep.transport.warmup_programs(max_width)
 
     def seal_programs(self):
         """Seal every healthy replica's program set: any later compile
@@ -614,23 +998,22 @@ class Router:
         grid compiles into false retrace verdicts."""
         for rep in self.replicas:
             if rep.state != "wedged":
-                rep.engine.seal_programs()
+                rep.transport.seal_programs()
 
     # -- stats ---------------------------------------------------------------
-    @staticmethod
-    def _itl_parts(eng: ServingEngine) -> List[tuple]:
-        """(samples, n_seen) parts for the bounded fleet ITL union:
-        each engine's finished-request reservoir plus its live slots'
-        exact samples (ISSUE 12 satellite — the raw flattened union
-        grew without limit on long runs; Reservoir.merge keeps the
-        combined sample proportional to each stream's true size)."""
-        live = [x for r in eng._slots if r is not None for x in r.itls]
-        return [(eng._itl_res.samples, eng._itl_res.n),
-                (live, len(live))]
-
-    def _goodput_tokens(self, eng: ServingEngine) -> int:
-        return sum(len(r.out_tokens) for r in eng._done.values()
-                   if r.state == "done")
+    def _journal_bytes(self) -> int:
+        """Approximate resident size of the failover journal — the
+        cost of exactly-once delivery, surfaced so capacity planning
+        can see it (ISSUE 19). Prompt array + 4B/delivered token +
+        a fixed per-entry overhead for the dataclass + dict slot."""
+        total = 0
+        for fid, je in self._journal.items():
+            rec = self._requests.get(fid)
+            if rec is not None and rec.prompt is not None:
+                total += int(getattr(rec.prompt, "nbytes",
+                                     4 * len(rec.prompt)))
+            total += 4 * len(je.delivered) + 96
+        return total
 
     def stats(self) -> dict:
         """Fleet rollup + per-replica breakdown.
@@ -644,17 +1027,24 @@ class Router:
         the per-replica stats() percentiles are reported alongside).
         ``replicas`` is each engine's own stats() plus its health
         record."""
-        engines = [rep.engine for rep in self.replicas]
+        bundles = [rep.transport.stats_bundle()
+                   for rep in self.replicas]
+        snaps = [b["snapshot"] for b in bundles]
         itls = Reservoir.merge(
-            [p for e in engines for p in self._itl_parts(e)],
+            [(p[0], p[1]) for s in snaps for p in s["itl_parts"]],
             k=ServingEngine.ITL_RESERVOIR_K)
-        hit = sum(e.dec.cache.prefix_hit_tokens for e in engines)
-        query = sum(e.dec.cache.prefix_query_tokens for e in engines)
+        hit = sum(s["prefix_hit_tokens"] for s in snaps)
+        query = sum(s["prefix_query_tokens"] for s in snaps)
         migrated_done = 0
-        for rec in self._requests.values():
+        for fid, rec in self._requests.items():
             if rec.migrations > 0:
-                req = self.replicas[rec.replica].engine._find_request(
-                    rec.rid)
+                rep = self.replicas[rec.replica]
+                if rep.transport.remote:
+                    je = self._journal.get(fid)
+                    if je is not None and je.state == "done":
+                        migrated_done += 1
+                    continue
+                req = rep.engine._find_request(rec.rid)
                 if req is not None and req.state == "done":
                     migrated_done += 1
         fleet = {
@@ -677,42 +1067,60 @@ class Router:
             # to another replica was served, not shed (the per-replica
             # counts stay visible in the replicas list)
             "shed_requests": self.shed_requests,
-            "finished": sum(
-                1 for e in engines for r in e._done.values()
-                if r.state == "done"),
-            "generated_tokens": sum(e.generated_tokens
-                                    for e in engines),
-            "goodput_tokens": sum(self._goodput_tokens(e)
-                                  for e in engines),
+            "finished": sum(s["finished"] for s in snaps),
+            "generated_tokens": sum(s["generated_tokens"]
+                                    for s in snaps),
+            "goodput_tokens": sum(s["goodput_tokens"]
+                                  for s in snaps),
             "itl_p50_s": (float(np.quantile(itls, 0.50))
                           if itls else None),
             "itl_p99_s": (float(np.quantile(itls, 0.99))
                           if itls else None),
-            "preemptions": sum(e.preemptions for e in engines),
-            "aborted": sum(e.aborted for e in engines),
-            "failed": sum(e.failed for e in engines),
-            "retries": sum(e.retries for e in engines),
-            "dispatch_exhaustions": sum(e.dispatch_exhaustions
-                                        for e in engines),
-            "device_dispatches": sum(e.device_dispatches
-                                     for e in engines),
+            "preemptions": sum(s["preemptions"] for s in snaps),
+            "aborted": sum(s["aborted"] for s in snaps),
+            "failed": sum(s["failed"] for s in snaps),
+            "retries": sum(s["retries"] for s in snaps),
+            "dispatch_exhaustions": sum(s["dispatch_exhaustions"]
+                                        for s in snaps),
+            "device_dispatches": sum(s["device_dispatches"]
+                                     for s in snaps),
             "prefix_cache_hit_rate": hit / query if query else 0.0,
             # -- program observatory (ISSUE 14) -----------------------
             # fleet-wide compile ledger: the chaos dp leg asserts the
             # unexpected sum stays zero after sealing
-            "program_compiles": sum(e.program_compiles
-                                    for e in engines),
-            "unexpected_recompiles": sum(e.unexpected_recompiles
-                                         for e in engines),
+            "program_compiles": sum(s["program_compiles"]
+                                    for s in snaps),
+            "unexpected_recompiles": sum(s["unexpected_recompiles"]
+                                         for s in snaps),
+            # -- process fleet (ISSUE 19) -----------------------------
+            # supervisor + transport health, all reset by
+            # clear_finished like every counter family above
+            "worker_exits": self.worker_exits,
+            "worker_restarts": self.worker_restarts,
+            "heartbeat_misses": self.heartbeat_misses,
+            "rpc_retries": sum(rep.transport.rpc_retries
+                               for rep in self.replicas),
+            "journal_requests": len(self._journal),
+            "journal_bytes": self._journal_bytes(),
         }
         per = []
-        for rep in self.replicas:
-            st = rep.engine.stats()
+        for rep, bundle in zip(self.replicas, bundles):
+            st = dict(bundle["stats"])
             st["replica"] = rep.idx
             st["state"] = rep.state
             st["wedges"] = rep.wedges
-            st["load"] = self._load(rep.engine)
+            st["load"] = (bundle["snapshot"]["load"]
+                          if rep.transport.remote
+                          else self._load(rep.engine))
             per.append(st)
+            if self.tracer is not None and rep.transport.remote \
+                    and bundle["stats"]:
+                # a worker's engine.stats() published into ITS OWN
+                # registry; mirror the numeric view into the parent so
+                # trace_report and the gate read one registry
+                self.tracer.metrics.publish(
+                    "engine" if rep.idx == 0 else f"engine{rep.idx}",
+                    bundle["stats"])
         if self._slo_policies or any("slo" in st for st in per):
             # per-replica SLO headroom rollup — the input SLO-aware
             # routing needs (ROADMAP 1): route a deadline class to the
@@ -752,11 +1160,24 @@ class Router:
         routing/failover counters; terminal fleet records are dropped
         with their engine records (live requests keep their mapping)."""
         for rep in self.replicas:
-            rep.engine.clear_finished()
-            rep.exh_mark = rep.engine.dispatch_exhaustions
-            rep.disp_mark = rep.engine.device_dispatches
+            if rep.transport.remote:
+                try:
+                    rep.transport.clear_finished()
+                except TransportError:
+                    pass            # dead worker: nothing to clear
+                # the worker's clear_finished zeroed the engine
+                # counters the watermarks track, so the parent-side
+                # watermarks follow to zero
+                rep.exh_mark = 0
+                rep.disp_mark = 0
+                rep.snap_failed_cnt = 0
+                rep.transport.rpc_retries = 0
+            else:
+                rep.engine.clear_finished()
+                rep.exh_mark = rep.engine.dispatch_exhaustions
+                rep.disp_mark = rep.engine.device_dispatches
+                rep.snap_failed_cnt = rep.engine.failed
             rep.burst_failed_mark = frozenset()
-            rep.snap_failed_cnt = rep.engine.failed
         self.routed_requests = 0
         self.affinity_hits = 0
         self.spills = 0
@@ -764,9 +1185,43 @@ class Router:
         self.migrated_requests = 0
         self.failed_migrations = 0
         self.shed_requests = 0
+        self.worker_exits = 0
+        self.worker_restarts = 0
+        self.heartbeat_misses = 0
         live = {}
         for fid, rec in self._requests.items():
-            eng = self.replicas[rec.replica].engine
-            if eng._find_request(rec.rid) is not None:
+            rep = self.replicas[rec.replica]
+            if rep.transport.remote:
+                je = self._journal.get(fid)
+                if je is not None and je.state in _LIVE_STATES:
+                    live[fid] = rec
+            elif rep.engine._find_request(rec.rid) is not None:
                 live[fid] = rec
         self._requests = live
+        # terminal journal entries go with their fleet records: the
+        # journal is a FAILOVER ledger, not an archive — exactly-once
+        # needs it only while the request can still produce tokens
+        self._journal = {fid: je for fid, je in self._journal.items()
+                         if fid in live}
+
+    # -- shutdown (ISSUE 19) -------------------------------------------------
+    def close(self):
+        """Tear the fleet down: close every transport (in-proc engines
+        settle their in-flight requests; process workers get a close
+        RPC then join, escalating to kill on a hung worker). Safe to
+        call twice, safe to call on a half-dead fleet — shutdown is
+        the one path that must never raise."""
+        if self._closed:
+            return
+        self._closed = True
+        for rep in self.replicas:
+            try:
+                rep.transport.close()
+            except Exception:       # noqa: BLE001 — best-effort
+                pass
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -5231,3 +5231,30 @@ class ServingEngine:
                       else f"engine{self.replica_id}")
             self.tracer.metrics.publish(prefix, out)
         return out
+
+    # -- shutdown (ISSUE 19) -------------------------------------------------
+    def close(self):
+        """Graceful shutdown: collect every in-flight device chunk so
+        dispatched buffers retire deterministically (nothing is left
+        referencing pool pages), then mark the engine closed.
+        Idempotent — a second close is a no-op; step()/add_request
+        after close are not supported. The fleet transports call this
+        from Router.close(), and a worker process calls it on its way
+        out of the command loop."""
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
+        try:
+            while self._inflight:
+                self._collect_oldest()
+        except Exception:       # noqa: BLE001 — shutdown path: a torn
+            # collection must not keep the process alive; drop the
+            # remaining entries (their requests stay non-terminal)
+            self._inflight.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()
+        return False

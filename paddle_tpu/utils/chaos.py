@@ -44,6 +44,8 @@ surviving request against a fault-free run.
 """
 from __future__ import annotations
 
+import os
+import signal
 import time
 from collections import Counter
 from typing import List, Tuple
@@ -53,7 +55,7 @@ import numpy as np
 from ..ops.paged_attention import KVCacheExhausted
 
 __all__ = ["ChaosMonkey", "InjectedFault", "InjectedDispatchError",
-           "InjectedCollectError"]
+           "InjectedCollectError", "InjectedTransportError"]
 
 
 class InjectedFault(RuntimeError):
@@ -70,6 +72,16 @@ class InjectedCollectError(InjectedFault):
     """Injected ahead of a result fetch (torn/corrupt collection)."""
 
 
+class InjectedTransportError(InjectedFault):
+    """Injected at a ProcTransport RPC boundary (ISSUE 19): raised by
+    ``transport_fault`` before a send (dropped request) or after a
+    receive (dropped response). The transport's bounded retry treats
+    it exactly like a real torn pipe — and because retries re-use the
+    message id against the worker's reply cache, a dropped RESPONSE is
+    the deterministic exactly-once test: the reply crosses twice, the
+    step ran once, the journal extends once."""
+
+
 class ChaosMonkey:
     """Seeded, deterministic fault injector for one ServingEngine.
 
@@ -77,17 +89,23 @@ class ChaosMonkey:
     p_dispatch:   probability a dispatch raises InjectedDispatchError
     p_collect:    probability a fetch raises InjectedCollectError
     p_latency:    probability a call is delayed by latency_s first
+    p_rpc_drop:   probability a transport RPC stage (send/recv) raises
+                  InjectedTransportError (ISSUE 19 — parent-side hook)
+    p_rpc_delay:  probability an RPC stage sleeps latency_s first
     """
 
     def __init__(self, seed: int = 0, p_alloc_oom: float = 0.0,
                  p_dispatch: float = 0.0, p_collect: float = 0.0,
-                 p_latency: float = 0.0, latency_s: float = 0.002):
+                 p_latency: float = 0.0, latency_s: float = 0.002,
+                 p_rpc_drop: float = 0.0, p_rpc_delay: float = 0.0):
         self.rng = np.random.RandomState(seed)
         self.p_alloc_oom = float(p_alloc_oom)
         self.p_dispatch = float(p_dispatch)
         self.p_collect = float(p_collect)
         self.p_latency = float(p_latency)
         self.latency_s = float(latency_s)
+        self.p_rpc_drop = float(p_rpc_drop)
+        self.p_rpc_delay = float(p_rpc_delay)
         self.counts: Counter = Counter()
         # (call index, site) of every injection, for post-mortems
         self.log: List[Tuple[int, str]] = []
@@ -141,6 +159,43 @@ class ChaosMonkey:
         self.log.append((self._calls, "wedge"))
         self._trace_event("wedge")
         return self
+
+    def kill(self):
+        """SIGKILL the CURRENT process — the hard-death analogue of
+        wedge() (ISSUE 19): wedge models a device/link that died while
+        the host survives; kill models the host process itself dying
+        (OOM killer, segfault). Meant to run INSIDE a ProcTransport
+        worker (the transport's ``chaos_kill`` verb / ``inject_kill``)
+        — the Router observes pipe EOF + waitpid, wedges the replica,
+        drains its journal and respawns. Counts/log/trace are emitted
+        best-effort first, but a SIGKILL'd process flushes nothing:
+        the parent-side counters are the ones that survive."""
+        self.counts["kills"] += 1
+        self.log.append((self._calls, "kill"))
+        self._trace_event("kill")
+        os.kill(os.getpid(), signal.SIGKILL)
+
+    def transport_fault(self, stage: str, verb: str):
+        """ProcTransport consults this ahead of every RPC send and
+        after every receive (``stage`` is 'send' or 'recv'). Raising
+        InjectedTransportError models a dropped request / dropped
+        response; the transport's bounded retry + the worker's reply
+        cache make recovery exactly-once by construction. A seeded
+        delay models a slow pipe without failing anything."""
+        self._calls += 1
+        self.counts["rpc_stages"] += 1
+        if self.p_rpc_delay and \
+                self.rng.random_sample() < self.p_rpc_delay:
+            self.counts["rpc_delays"] += 1
+            self.log.append((self._calls, f"rpc_delay:{stage}:{verb}"))
+            time.sleep(self.latency_s)
+        if self.p_rpc_drop and \
+                self.rng.random_sample() < self.p_rpc_drop:
+            self.counts["rpc_drops"] += 1
+            self.log.append((self._calls, f"rpc_drop:{stage}:{verb}"))
+            self._trace_event("rpc_drop", stage=stage, verb=verb)
+            raise InjectedTransportError(
+                f"chaos: injected rpc {stage} drop at {verb}")
 
     # -- injection sites ----------------------------------------------------
     def _alloc_hook(self):

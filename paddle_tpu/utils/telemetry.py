@@ -714,12 +714,18 @@ class Tracer:
     DEFAULT_CAPACITY = 1 << 16
 
     def __init__(self, capacity: int = DEFAULT_CAPACITY,
-                 metrics: Optional[MetricsRegistry] = None):
+                 metrics: Optional[MetricsRegistry] = None,
+                 id_base: int = 1):
         self.capacity = int(capacity)
         self._ring: deque = deque(maxlen=self.capacity)
         self.appended = 0
         self.metrics = metrics or MetricsRegistry()
-        self._ids = itertools.count(1)
+        # id_base (ISSUE 19): a worker-process Tracer starts its trace
+        # ids at a per-(replica, generation) disjoint base, so records
+        # forwarded over the transport and ingested into the parent
+        # ring can never collide with the parent's own ids (default 1:
+        # single-process behavior unchanged)
+        self._ids = itertools.count(int(id_base))
         self._t0 = time.perf_counter()
         self._lock = threading.Lock()
 
@@ -824,6 +830,56 @@ class Tracer:
                   else ".fleet" if pid == FLEET_PID
                   else f".r{int(pid)}")
         self.metrics.set_gauge(f"track.{name}{suffix}", v)
+
+    # -- cross-process forwarding (ISSUE 19) ---------------------------------
+    def drain_since(self, mark: int) -> tuple:
+        """``(records appended since `mark`, new mark)`` — the worker
+        side of transport telemetry forwarding: each step/stats reply
+        piggybacks only the NEW records (reconstructed from the ring
+        tail via the ``appended`` counter; records that already fell
+        off the ring are lost exactly like flight-recorder semantics
+        lose them locally)."""
+        with self._lock:
+            new = self.appended - int(mark)
+            if new <= 0:
+                return [], self.appended
+            recs = list(self._ring)
+            return (recs[-new:] if new < len(recs) else recs,
+                    self.appended)
+
+    def ingest(self, records: List[dict], ts_offset: float = 0.0):
+        """Append records forwarded from ANOTHER process's Tracer into
+        this ring, mirroring each kind's registry side-effects (the
+        merged registry / validate_trace / trace_report views must
+        agree with a single-process run). ``ts_offset`` shifts worker
+        timestamps onto the parent clock — 0.0 on Linux, where
+        perf_counter is CLOCK_MONOTONIC and shared across processes."""
+        for r in records:
+            rec = dict(r)
+            if ts_offset:
+                rec["ts"] = float(rec["ts"]) + ts_offset
+            self._record(rec)
+            kind, name = rec.get("kind"), rec.get("name")
+            if kind == "begin":
+                self.metrics.inc("trace.requests")
+            elif kind == "end":
+                state = rec.get("args", {}).get("state")
+                if state:
+                    self.metrics.inc(f"trace.requests_{state}")
+            elif kind == "span":
+                self.metrics.inc(f"spans.{name}")
+                self.metrics.histogram(f"span.{name}_s").observe(
+                    max(0.0, float(rec.get("dur", 0.0))))
+            elif kind == "event":
+                self.metrics.inc(f"events.{name}")
+            elif kind == "counter":
+                pid = int(rec.get("pid", 0))
+                suffix = ("" if pid == 0
+                          else ".fleet" if pid == FLEET_PID
+                          else f".r{pid}")
+                self.metrics.set_gauge(
+                    f"track.{name}{suffix}",
+                    float(rec["args"]["value"]))
 
     # -- reading -------------------------------------------------------------
     def records(self) -> List[dict]:

@@ -109,6 +109,14 @@ def _full_script(**overrides):
             {"serving_dp2_tok_per_sec": 88.0,
              "serving_dp_affinity_hit_gain": 0.3,
              "serving_dp_tokens_identical": True}), "")],
+        # serving_proc joined AUTO_MODES in the ISSUE-19 PR — scripted
+        # same-PR (the PR-9 lesson, five times applied)
+        "serving_proc": [(_simple(
+            "serving_proc_process_tok_per_sec", 83.0,
+            {"serving_proc_process_tok_per_sec": 83.0,
+             "serving_proc_overhead_pct": 4.1,
+             "serving_proc_respawn_wall_s": 9.5,
+             "serving_proc_worker_exits": 1}), "")],
         # serving_kv8 joined AUTO_MODES in the ISSUE-13 PR — scripted
         # same-PR (the PR-9 lesson, three times applied)
         "serving_kv8": [(_simple(

@@ -1,0 +1,285 @@
+"""Process-per-replica fleet (ISSUE 19): the ReplicaTransport seam —
+inproc default bitwise-unchanged, process workers greedy
+token-identical to the single engine, SIGKILL failover mid-decode AND
+mid-prefill resuming token-identical from the Router's journal,
+supervisor respawn with probation re-admission, heartbeat-miss
+detection of a hung-but-answering worker, exactly-once delivery across
+a dropped-and-retried step RPC, journal gauges + clear_finished reset,
+and the GPT twin through a picklable engine_factory. Runs in the
+invariant gate (check_serving_invariants.py) with
+PADDLE_TPU_POOL_DEBUG=1.
+
+Everything the spawned workers unpickle (the GPT factory below) must
+be MODULE-LEVEL: spawn re-imports this module by qualified name in the
+child, so closures and locals would not cross."""
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models import LlamaForCausalLM, llama_tiny
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.inference import (PagedGPTDecoder, Router,
+                                  SamplingParams, ServingEngine)
+from paddle_tpu.inference.transport import (InProcTransport,
+                                            ProcTransport)
+from paddle_tpu.utils.chaos import InjectedTransportError
+
+CFG = llama_tiny(hidden_size=64, num_attention_heads=4,
+                 num_key_value_heads=2, intermediate_size=96,
+                 num_hidden_layers=2, vocab_size=256,
+                 max_position_embeddings=256)
+
+KW = dict(max_batch_size=3, num_blocks=24, block_size=8,
+          prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8)
+
+# process fleets in tests: generous RPC deadline (CPU jit compiles ride
+# the first step), no backoff wait
+PROC = dict(transport="process", rpc_timeout_s=90.0)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = LlamaForCausalLM(CFG)
+    m.eval()
+    return m
+
+
+def _prompts(n=3, seed=0):
+    rng = np.random.RandomState(seed)
+    pre = rng.randint(0, CFG.vocab_size, 16).astype(np.int32)
+    return [np.concatenate([pre,
+                            rng.randint(0, CFG.vocab_size,
+                                        8).astype(np.int32)])
+            for _ in range(n)]
+
+
+def _oracle(model, prompts, max_new=10):
+    eng = ServingEngine(model, **KW)
+    outs = []
+    for p in prompts:
+        rid = eng.add_request(p, SamplingParams(max_new_tokens=max_new))
+        eng.run_to_completion()
+        outs.append(eng.result(rid).tolist())
+    return outs
+
+
+def _drain(router, budget_s=120.0):
+    t0 = time.perf_counter()
+    while router.has_work:
+        router.step()
+        assert time.perf_counter() - t0 < budget_s, "fleet wedged"
+
+
+# -- the transport seam ------------------------------------------------------
+
+class TestTransportSeam:
+    def test_inproc_is_the_default_and_keeps_the_engine(self, model):
+        """transport='inproc' (the default) must keep the PR-11
+        surface intact: a live engine on every replica, InProcTransport
+        wrapping it, nothing remote — the bitwise-unchanged leg."""
+        router = Router(model, dp=2, **KW)
+        for rep in router.replicas:
+            assert isinstance(rep.transport, InProcTransport)
+            assert rep.transport.remote is False
+            assert rep.engine is not None
+            assert rep.transport.engine is rep.engine
+        fid = router.add_request(_prompts(1)[0],
+                                 SamplingParams(max_new_tokens=4))
+        router.run_to_completion()
+        assert router.request(fid).state == "done"
+        # inproc close is idempotent and settles nothing violently
+        router.close()
+        router.close()
+
+    def test_process_identity_journal_retry_and_reset(self, model):
+        """One process fleet session, three contracts: (a) greedy
+        token identity vs the single engine WITH the reply of each
+        replica's first step RPC dropped — the reply crosses twice
+        (bounded retry, same message id), the worker's reply cache
+        guarantees the step ran ONCE, and the ack-base journal
+        extension delivers every token exactly once; (b) journal
+        gauges while in flight and after; (c) the clear_finished
+        reset contract."""
+        dropped = set()
+
+        def drop_first_step_reply(replica):
+            def hook(stage, verb):
+                if (stage == "recv" and verb == "step"
+                        and replica not in dropped):
+                    dropped.add(replica)
+                    raise InjectedTransportError("test: dropped reply")
+            return hook
+
+        prompts = _prompts(4)
+        oracle = _oracle(model, prompts)
+        with Router(model, dp=2, **PROC, **KW) as router:
+            for r, rep in enumerate(router.replicas):
+                assert isinstance(rep.transport, ProcTransport)
+                assert rep.engine is None
+                rep.transport.fault_hook = drop_first_step_reply(r)
+            fids = [router.add_request(
+                p, SamplingParams(max_new_tokens=10)) for p in prompts]
+            fleet = router.stats()["fleet"]
+            assert fleet["journal_requests"] == 4
+            assert fleet["journal_bytes"] > 0
+            _drain(router)
+            assert dropped, "fault hook never fired"
+            for f, want in zip(fids, oracle):
+                assert router.request(f).state == "done"
+                assert router.result(f).tolist() == want
+            fleet = router.stats()["fleet"]
+            assert fleet["finished"] == 4
+            assert fleet["rpc_retries"] >= len(dropped)
+            assert fleet["worker_exits"] == 0
+            assert fleet["worker_restarts"] == 0
+            assert fleet["heartbeat_misses"] == 0
+            # reset contract: terminal journal entries drop with their
+            # fleet records; every ISSUE-19 counter goes back to zero
+            router.clear_finished()
+            fleet = router.stats()["fleet"]
+            assert fleet["journal_requests"] == 0
+            assert fleet["journal_bytes"] == 0
+            assert fleet["rpc_retries"] == 0
+            assert fleet["finished"] == 0
+        # context-manager exit closed the workers
+        for rep in router.replicas:
+            assert not rep.transport.alive()
+
+
+# -- SIGKILL failover --------------------------------------------------------
+
+class TestSigkillFailover:
+    def test_sigkill_mid_prefill_and_mid_decode_token_identical(
+            self, model):
+        """One fleet, two hard kills. Round 1: SIGKILL replica 0
+        while its requests are still PREFILLING — the journal holds
+        zero delivered tokens, so failover is a clean re-enqueue and
+        identity must hold from token zero; the supervisor respawns
+        the worker onto probation. Round 2: on the SAME fleet (the
+        respawned worker now serving), SIGKILL again mid-DECODE — the
+        Router sees pipe EOF (no RPC-deadline wait), drains the
+        replica from its JOURNAL, migrates with the delivered-token
+        history — and every request still finishes token-identical to
+        the single-engine oracle. Probation promotion closes it out."""
+        p1 = _prompts(3, seed=2)
+        p2 = _prompts(4, seed=1)
+        want1 = _oracle(model, p1, max_new=8)
+        want2 = _oracle(model, p2, max_new=12)
+        with Router(model, dp=2, breaker_threshold=1,
+                    probation_steps=2, **PROC, **KW) as router:
+            victim = router.replicas[0]
+            # round 1: mid-prefill
+            fids1 = [router.add_request(
+                p, SamplingParams(max_new_tokens=8)) for p in p1]
+            router.step()           # chunked prefill: still in flight
+            gen = victim.transport.generation
+            victim.transport.kill_worker()
+            _drain(router, budget_s=180.0)
+            fleet = router.stats()["fleet"]
+            assert fleet["worker_exits"] >= 1
+            assert fleet["worker_restarts"] >= 1
+            assert victim.transport.generation == gen + 1
+            assert victim.transport.alive()
+            for f, want in zip(fids1, want1):
+                assert router.result(f).tolist() == want
+            # round 2: mid-decode on the respawned fleet
+            fids2 = [router.add_request(
+                p, SamplingParams(max_new_tokens=12)) for p in p2]
+            for _ in range(4):      # well into decode
+                router.step()
+            owned = [f for f, rec in router._requests.items()
+                     if rec.replica == 0
+                     and router.request(f).state not in
+                     ("done", "failed", "aborted")]
+            assert owned, "routing sent nothing live to replica 0"
+            victim.transport.kill_worker()
+            _drain(router, budget_s=180.0)
+            fleet = router.stats()["fleet"]
+            assert fleet["worker_exits"] >= 2
+            assert fleet["worker_restarts"] >= 2
+            assert fleet["migrated_done"] >= 1
+            assert victim.transport.generation == gen + 2
+            assert victim.state in ("probation", "healthy")
+            for f, want in zip(fids2, want2):
+                assert router.request(f).state == "done"
+                assert router.result(f).tolist() == want
+            # probation promotion: route fresh work at the respawned
+            # replica (it has the lowest load) — clean steps WITH
+            # device activity promote it back to healthy
+            f2 = router.add_request(_prompts(1, seed=7)[0],
+                                    SamplingParams(max_new_tokens=6))
+            _drain(router, budget_s=180.0)
+            assert router.request(f2).state == "done"
+            assert victim.state == "healthy"
+
+
+# -- heartbeat liveness ------------------------------------------------------
+
+class TestHeartbeat:
+    def test_heartbeat_silence_wedges_hung_worker(self, model):
+        """A worker whose COMMAND LOOP still answers but whose
+        heartbeat thread has gone silent (the model of a process wedged
+        in a non-cooperative section) must be detected by the
+        heartbeat clock alone: the Router strikes WITHOUT issuing the
+        step RPC, wedges (threshold 1), migrates the queue and — with
+        respawn disabled — leaves the replica wedged."""
+        prompts = _prompts(2, seed=5)
+        oracle = _oracle(model, prompts, max_new=6)
+        with Router(model, dp=2, breaker_threshold=1, respawn=False,
+                    heartbeat_timeout_s=0.4, **PROC, **KW) as router:
+            fids = [router.add_request(
+                p, SamplingParams(max_new_tokens=6)) for p in prompts]
+            router.step()
+            victim = router.replicas[0]
+            victim.transport.hb_pause(30.0)
+            time.sleep(0.6)         # let the silence exceed the budget
+            router.step()
+            assert router.heartbeat_misses >= 1
+            assert victim.state == "wedged"
+            fleet = router.stats()["fleet"]
+            assert fleet["heartbeat_misses"] >= 1
+            assert fleet["worker_restarts"] == 0
+            _drain(router, budget_s=180.0)
+            for f, want in zip(fids, oracle):
+                assert router.result(f).tolist() == want
+
+
+# -- heterogeneous fleet over the wire ---------------------------------------
+
+GPT_CFG = GPTConfig(vocab_size=256, hidden_size=64,
+                    num_hidden_layers=2, num_attention_heads=4,
+                    max_position_embeddings=128)
+
+
+def _gpt_engine(idx, devs):
+    """Module-level so spawn can unpickle it by qualified name; builds
+    the model INSIDE the worker (seeded — every replica identical)."""
+    paddle.seed(0)
+    m = GPTForCausalLM(GPT_CFG)
+    m.eval()
+    dec = PagedGPTDecoder(m, num_blocks=24, block_size=8)
+    return ServingEngine(dec, max_batch_size=3,
+                         prompt_buckets=(8, 16, 32), chunk_size=4,
+                         prefill_chunk=8)
+
+
+class TestProcessFactory:
+    def test_gpt_twin_process_fleet_identity(self):
+        prompts = _prompts(2, seed=4)
+        single = _gpt_engine(0, None)
+        oracle = []
+        for p in prompts:
+            rid = single.add_request(p,
+                                     SamplingParams(max_new_tokens=8))
+            single.run_to_completion()
+            oracle.append(single.result(rid).tolist())
+        with Router(None, dp=2, engine_factory=_gpt_engine,
+                    **PROC) as router:
+            fids = [router.add_request(
+                p, SamplingParams(max_new_tokens=8)) for p in prompts]
+            _drain(router, budget_s=180.0)
+            for f, want in zip(fids, oracle):
+                assert router.result(f).tolist() == want

@@ -119,17 +119,19 @@ def build_engine(model, args, tracer=None):
         multi_step=getattr(args, "multi_step", 1))
 
 
-def build_fleet(model, args, tracer=None):
+def build_fleet(model, args, tracer=None, transport="inproc"):
     """The --dp leg's fleet (ISSUE 11): R single-chip replicas behind
     the prefix-affinity Router, each with the same tight-geometry
     engine the single-engine legs use. Both the chaos run and the
     fault-free replay build IDENTICAL fleets, so token identity of
     surviving AND migrated requests is well-defined (all-greedy
     workload; routing may differ between the runs — greedy outputs are
-    replica-independent by the cross-replica identity contract)."""
+    replica-independent by the cross-replica identity contract).
+    ``transport="process"`` (ISSUE 19) puts each replica's engine in a
+    spawned worker process — the dp_proc leg's crash-isolated fleet."""
     from paddle_tpu.inference.fleet import Router
     return Router(
-        model, dp=args.dp,
+        model, dp=args.dp, transport=transport,
         max_batch_size=3, num_blocks=args.num_blocks, block_size=8,
         prompt_buckets=(8, 16, 32), chunk_size=4, prefill_chunk=8,
         admission="optimistic", max_dispatch_retries=args.retries,
@@ -209,13 +211,42 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
     from paddle_tpu.utils.chaos import ChaosMonkey
 
     dp = getattr(args, "dp", 1)
+    # the dp_proc leg (ISSUE 19): only the CHAOS run is a process
+    # fleet — the fault-free replay runs inproc, so token identity
+    # also proves the process transport changes no tokens
+    proc = (dp > 1 and chaotic
+            and getattr(args, "dp_transport", "inproc") == "process")
     if dp > 1:
-        eng = build_fleet(model, args, tracer=tracer)
-        monkey = [ChaosMonkey(
-            seed=args.seed + 1 + r, p_alloc_oom=args.p_oom,
-            p_dispatch=args.p_dispatch, p_collect=args.p_collect,
-            p_latency=args.p_latency).attach(rep.engine)
-            for r, rep in enumerate(eng.replicas)] if chaotic else None
+        eng = build_fleet(model, args, tracer=tracer,
+                          transport="process" if proc else "inproc")
+        monkey = None
+        if chaotic and proc:
+            # worker-side monkeys are BUILT INSIDE each worker over
+            # the chaos_attach verb (same seeds/probabilities as the
+            # inproc leg; the config is replayed into a respawned
+            # worker); parent-side monkeys drop/delay RPCs at the
+            # transport boundary — the retry/backoff + reply-cache
+            # exactly-once path, exercised deterministically
+            for r, rep in enumerate(eng.replicas):
+                rep.transport.chaos_attach(
+                    seed=args.seed + 1 + r, p_alloc_oom=args.p_oom,
+                    p_dispatch=args.p_dispatch,
+                    p_collect=args.p_collect,
+                    p_latency=args.p_latency)
+            monkey = []
+            for r, rep in enumerate(eng.replicas):
+                pm = ChaosMonkey(
+                    seed=args.seed + 101 + r,
+                    p_rpc_drop=getattr(args, "p_rpc_drop", 0.0),
+                    p_rpc_delay=getattr(args, "p_rpc_delay", 0.0))
+                rep.transport.fault_hook = pm.transport_fault
+                monkey.append(pm)
+        elif chaotic:
+            monkey = [ChaosMonkey(
+                seed=args.seed + 1 + r, p_alloc_oom=args.p_oom,
+                p_dispatch=args.p_dispatch, p_collect=args.p_collect,
+                p_latency=args.p_latency).attach(rep.engine)
+                for r, rep in enumerate(eng.replicas)]
         wedge_step = args.steps // 3
     else:
         eng = build_engine(model, args, tracer=tracer)
@@ -246,7 +277,22 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
     def debug_check():
         if dp > 1:
             for rep in eng.replicas:
-                rep.engine.dec.cache.debug_check()
+                if rep.transport.remote:
+                    # the pool invariant holds INSIDE the worker; a
+                    # dead/wedged worker has no pool left to check
+                    if rep.state != "wedged" and rep.transport.alive():
+                        try:
+                            rep.transport.debug_check()
+                        except Exception as e:  # noqa: BLE001
+                            # a REAL pool violation is an ASSERTION
+                            # inside the worker and must fail the leg;
+                            # a worker dying/timing out mid-check is
+                            # the supervisor's event, not a violation
+                            if "AssertionError" in str(e):
+                                raise
+
+                else:
+                    rep.engine.dec.cache.debug_check()
         else:
             eng.dec.cache.debug_check()
 
@@ -264,7 +310,14 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
         if chaotic:
             nonlocal user_cancels
             if dp > 1 and step == wedge_step:
-                monkey[0].wedge()
+                if proc:
+                    # hard death instead of a wedge: the worker
+                    # SIGKILLs itself mid-run — the Router must see
+                    # pipe EOF, drain replica 0 from its JOURNAL,
+                    # migrate token-identically and RESPAWN
+                    eng.replicas[0].transport.inject_kill()
+                else:
+                    monkey[0].wedge()
             for ordinal in cancels.get(step, ()):
                 rid = rid_of.get(ordinal)
                 if rid is None:
@@ -276,28 +329,41 @@ def run_schedule(model, args, chaotic: bool, tracer=None):
                     if eng.cancel(rid):
                         user_cancels += 1
 
-    for step in range(args.steps):
-        inject_step_events(step)
-        eng.step()
-        debug_check()
-        steps_run += 1
-    # drain (chaos stays attached: the tail is chaotic too; schedule
-    # events keep firing so nothing lands silently past the window)
-    drain_cap = 50 * args.steps
-    step = args.steps
-    while eng.has_work and drain_cap > 0:
-        inject_step_events(step)
-        eng.step()
-        debug_check()
-        steps_run += 1
-        step += 1
-        drain_cap -= 1
-    if eng.has_work:
-        raise RuntimeError("engine failed to drain (livelock?)")
-    results = {}
-    for ordinal, rid in rid_of.items():
-        req = eng.request(rid)
-        results[ordinal] = (req.state, list(req.out_tokens), req.error)
+    try:
+        for step in range(args.steps):
+            inject_step_events(step)
+            eng.step()
+            debug_check()
+            steps_run += 1
+        # drain (chaos stays attached: the tail is chaotic too;
+        # schedule events keep firing so nothing lands silently past
+        # the window)
+        drain_cap = 50 * args.steps
+        step = args.steps
+        while eng.has_work and drain_cap > 0:
+            inject_step_events(step)
+            eng.step()
+            debug_check()
+            steps_run += 1
+            step += 1
+            drain_cap -= 1
+        if eng.has_work:
+            raise RuntimeError("engine failed to drain (livelock?)")
+        results = {}
+        for ordinal, rid in rid_of.items():
+            req = eng.request(rid)
+            results[ordinal] = (req.state, list(req.out_tokens),
+                                req.error)
+    except BaseException:
+        # a crashing fleet run must not leak worker processes: the
+        # harness exits red either way, but orphaned spawn children
+        # would outlive it (ISSUE 19 shutdown contract)
+        if dp > 1:
+            try:
+                eng.close()
+            except Exception:       # noqa: BLE001 — best-effort
+                pass
+        raise
     return results, eng, monkey, steps_run, user_cancels
 
 
@@ -385,6 +451,31 @@ def build_parser() -> argparse.ArgumentParser:
                          "every surviving AND migrated request must "
                          "stay token-identical vs the fault-free "
                          "fleet replay")
+    ap.add_argument("--dp-transport", choices=("inproc", "process"),
+                    default="inproc", dest="dp_transport",
+                    help="fleet transport for the CHAOS run (ISSUE "
+                         "19): 'process' spawns each replica's engine "
+                         "in its own worker process and replaces the "
+                         "wedge with a mid-run SIGKILL of replica 0's "
+                         "worker — the Router must fail fast on pipe "
+                         "EOF, drain from its journal, migrate "
+                         "token-identically, RESPAWN the worker "
+                         "(warmup+seal replayed) and re-admit it via "
+                         "probation; parent-side monkeys additionally "
+                         "drop/delay RPCs to exercise bounded retry "
+                         "with exactly-once replies. The fault-free "
+                         "replay always runs inproc, so token "
+                         "identity also proves the transport is "
+                         "token-neutral")
+    ap.add_argument("--p-rpc-drop", type=float, default=None,
+                    dest="p_rpc_drop",
+                    help="per-RPC-stage drop probability for the "
+                         "process-fleet parent monkeys (default 0.03 "
+                         "with --dp-transport process, else 0)")
+    ap.add_argument("--p-rpc-delay", type=float, default=0.02,
+                    dest="p_rpc_delay",
+                    help="per-RPC-stage seeded delay probability for "
+                         "the process-fleet parent monkeys")
     ap.add_argument("--trace-out", default=None,
                     help="run the chaos leg with serving telemetry ON "
                          "(ISSUE 12) and write the flight-recorder "
@@ -420,6 +511,9 @@ def main() -> int:
         args.num_blocks = 24 if args.lora else 14
     if args.ragged_idle_cap is None and args.seal_programs:
         args.ragged_idle_cap = 8
+    if args.p_rpc_drop is None:
+        args.p_rpc_drop = 0.03 if args.dp_transport == "process" \
+            else 0.0
     args.vocab = None
 
     if args.tp > 1:
@@ -464,14 +558,33 @@ def main() -> int:
             faulted += 1
     if args.dp > 1:
         from collections import Counter
-        fleet = eng.stats()["fleet"]
+        proc = args.dp_transport == "process"
+        full = eng.stats()
+        fleet = full["fleet"]
         injected = Counter()
-        for m in monkey:
-            injected.update(m.counts)
+        if proc:
+            # worker-side injections live in the WORKERS' monkeys:
+            # harvest over the chaos_counts verb from every replica
+            # still answering (a SIGKILL'd generation's counts died
+            # with it — the parent-side supervisor counters below are
+            # the record of the death itself); the parent monkeys
+            # contribute the RPC drop/delay counts
+            for rep in eng.replicas:
+                if rep.transport.alive() and rep.state != "wedged":
+                    try:
+                        injected.update(rep.transport.chaos_counts())
+                    except Exception:   # noqa: BLE001 — best-effort
+                        pass
+            for m in monkey:
+                injected.update(m.counts)
+        else:
+            for m in monkey:
+                injected.update(m.counts)
         summary = {
             "dp": args.dp,
+            "transport": args.dp_transport,
             "ragged": bool(args.ragged),
-            "kv_quant": eng.replicas[0].engine.stats()["kv_quant"],
+            "kv_quant": full["replicas"][0].get("kv_quant"),
             "steps": steps_run,
             "requests": len(chaos_results),
             "failovers": fleet["failovers"],
@@ -489,6 +602,12 @@ def main() -> int:
             "injected": dict(injected),
             "program_compiles": fleet["program_compiles"],
             "unexpected_recompiles": fleet["unexpected_recompiles"],
+            # -- process fleet (ISSUE 19) -----------------------------
+            "worker_exits": fleet["worker_exits"],
+            "worker_restarts": fleet["worker_restarts"],
+            "heartbeat_misses": fleet["heartbeat_misses"],
+            "rpc_retries": fleet["rpc_retries"],
+            "journal_requests": fleet["journal_requests"],
         }
         summary["done_identical"] = done - len(mismatches)
         summary["mismatches"] = len(mismatches)
@@ -515,6 +634,15 @@ def main() -> int:
             # failover alone and would mask a dead cancel path
             if user_cancels < 1:
                 missing.append("cancellation")
+            if proc:
+                # the dp_proc leg must actually exercise the death +
+                # supervisor + retry machinery, not just route RPCs
+                if fleet["worker_exits"] < 1:
+                    missing.append("worker_exit")
+                if fleet["worker_restarts"] < 1:
+                    missing.append("worker_respawn")
+                if fleet["rpc_retries"] < 1:
+                    missing.append("rpc_retry")
             if missing:
                 summary["missing_events"] = missing
                 ok = False
@@ -526,6 +654,10 @@ def main() -> int:
             print(f"MISMATCH ordinal {m['ordinal']}: "
                   f"chaos={m['chaos']} base={m['base']}",
                   file=sys.stderr)
+        # shutdown contract (ISSUE 19): no leaked worker processes —
+        # idempotent, and a no-op for the inproc legs
+        eng.close()
+        base_eng.close()
         return 0 if ok else 1
 
     st = eng.stats()

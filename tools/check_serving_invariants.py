@@ -51,6 +51,7 @@ TEST_FILES = [
     os.path.join(REPO, "tests", "test_spec_decode.py"),
     os.path.join(REPO, "tests", "test_lora_serving.py"),
     os.path.join(REPO, "tests", "test_fleet_serving.py"),
+    os.path.join(REPO, "tests", "test_transport_fleet.py"),
     os.path.join(REPO, "tests", "test_telemetry.py"),
     os.path.join(REPO, "tests", "test_kv_quant.py"),
     os.path.join(REPO, "tests", "test_program_observatory.py"),
@@ -138,7 +139,16 @@ def run_chaos() -> int:
     migrated-request completion, and token identity covers
     surviving AND migrated requests vs a fault-free fleet replay
     (the router drains the wedged replica and redistributes its
-    queue as no-sample prompt+history recomputes)."""
+    queue as no-sample prompt+history recomputes). ISSUE 19 added
+    the --dp-transport process leg (dp_proc): the same schedule
+    through a PROCESS-PER-REPLICA fleet whose replica-0 worker
+    SIGKILLs itself at a seeded mid-run step while parent-side
+    monkeys drop/delay RPCs — --require-events additionally demands
+    >=1 worker exit, >=1 supervisor respawn and >=1 retried RPC, the
+    fault-free replay runs INPROC so token identity also proves the
+    transport is token-neutral, and the sealed-programs assertion
+    covers the respawned worker's replayed warmup+seal (0 unexpected
+    recompiles after re-seal)."""
     import shutil
     import subprocess
     import tempfile
@@ -178,6 +188,8 @@ def run_chaos() -> int:
                      ("lora", ("--lora", "--num-blocks", "20",
                                "--requests", "12")),
                      ("dp2", ("--dp", "2")),
+                     ("dp_proc", ("--dp", "2", "--dp-transport",
+                                  "process")),
                      ("ragged_ms4", ("--ragged", "--multi-step", "4"))):
         trace_path = os.path.join(trace_dir, f"chaos_{tag}.trace.json")
         cmd = [sys.executable,

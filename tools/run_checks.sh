@@ -25,14 +25,16 @@
 #   5. serving invariant gate (PADDLE_TPU_POOL_DEBUG=1 over the
 #      serving-path tests incl. test_fault_tolerance.py and
 #      test_ragged_batching.py; includes its own paddle_tpu/ flightcheck
-#      AND the deterministic chaos schedule across all eight legs —
-#      dense/ragged/ragged_kv8/tp2/spec/lora/dp2/ragged_ms4 — every
-#      gate run exercises >=1 OOM-preemption, >=1 injected dispatch
-#      failure and >=1 cancellation (the dp2 leg instead demands >=1
-#      replica failover and >=1 migrated-request completion; the
-#      ragged_ms4 leg additionally demands >=1 multi-step fused
-#      window dispatched), with token-identity vs a fault-free
-#      replay)
+#      AND the deterministic chaos schedule across all nine legs —
+#      dense/ragged/ragged_kv8/tp2/spec/lora/dp2/ragged_ms4/dp_proc —
+#      every gate run exercises >=1 OOM-preemption, >=1 injected
+#      dispatch failure and >=1 cancellation (the dp2 leg instead
+#      demands >=1 replica failover and >=1 migrated-request
+#      completion; the ragged_ms4 leg additionally demands >=1
+#      multi-step fused window dispatched; the dp_proc leg SIGKILLs a
+#      worker process mid-run and demands >=1 worker exit, >=1
+#      respawn and >=1 migrated completion), with token-identity vs
+#      a fault-free replay)
 #   6. tier-1 pytest (tests/, -m 'not slow')
 set -u -o pipefail
 cd "$(dirname "$0")/.."

@@ -27,7 +27,10 @@ a red gate run (or a bench artifact) needs without opening the UI:
 - dispatch amortization (ISSUE 16): tokens per dispatch grouped by
   (kind, fused-window depth k) off the dispatch events' ``k`` /
   ``decode_toks`` args, plus sampled device-execute totals per
-  program family (the ragged_ms* families are the k>1 windows).
+  program family (the ragged_ms* families are the k>1 windows);
+- worker lifecycle (ISSUE 19): process-fleet supervision off the
+  fleet track — worker exits grouped by reason, respawn count and
+  wall-clock, heartbeat misses, and migrations.
 
 Pure host tool: no jax, no paddle_tpu import — runs anywhere the JSON
 does.
@@ -243,6 +246,37 @@ def analyze(doc: dict, top: int = 5) -> dict:
     slo = ({"violations": slo_events, "gauges": slo_gauges}
            if (slo_events or slo_gauges) else None)
 
+    # -- worker lifecycle (ISSUE 19) ------------------------------------
+    # process-fleet supervision events off the fleet track: worker
+    # exits grouped by reason (process_exit / heartbeat / ...),
+    # respawn count + wall-clock each respawn paid (spawn + warmup
+    # replay + re-seal), heartbeat misses, and migrations — the
+    # crash-isolation story of a run at a glance
+    w_exits = [dict(e.get("args", {}))
+               for e in insts if e["name"] == "worker_exit"]
+    w_spawns = [dict(e.get("args", {}))
+                for e in insts if e["name"] == "worker_respawn"]
+    hb_misses = sum(1 for e in insts if e["name"] == "heartbeat_miss")
+    workers = None
+    if w_exits or w_spawns or hb_misses:
+        walls = [float(r.get("wall_s", 0.0)) for r in w_spawns]
+        workers = {
+            "exits": len(w_exits),
+            "exits_by_reason": dict(Counter(
+                x.get("reason", "?") for x in w_exits)),
+            "respawns": len(w_spawns),
+            "respawn_failed": sum(
+                1 for e in insts
+                if e["name"] == "worker_respawn_failed"),
+            "respawn_wall_s": {
+                "max": round(max(walls), 3),
+                "total": round(sum(walls), 3),
+            } if walls else None,
+            "heartbeat_misses": hb_misses,
+            "migrations": sum(
+                1 for e in insts if e["name"] == "migrate"),
+        }
+
     return {
         "wall_s": round(wall_s, 4),
         "records": len(evts),
@@ -260,6 +294,7 @@ def analyze(doc: dict, top: int = 5) -> dict:
         "tracks": tracks,
         "amortization": amortization,
         "slo": slo,
+        "workers": workers,
     }
 
 
@@ -344,6 +379,19 @@ def format_report(rep: dict) -> str:
             lines.append(f"  VIOLATION {v}")
         for k, v in slo["gauges"].items():
             lines.append(f"  {k} = {v:g}")
+    if rep.get("workers"):
+        w = rep["workers"]
+        wall = w["respawn_wall_s"]
+        wall_txt = (f" wall max={wall['max']:g}s total={wall['total']:g}s"
+                    if wall else "")
+        failed = (f" ({w['respawn_failed']} failed)"
+                  if w["respawn_failed"] else "")
+        lines.append(
+            f"worker lifecycle: {w['exits']} exit(s) "
+            f"{w['exits_by_reason']}, {w['respawns']} "
+            f"respawn(s){failed}{wall_txt}, "
+            f"{w['heartbeat_misses']} heartbeat miss(es), "
+            f"{w['migrations']} migration(s)")
     lines.append(f"events: {rep['events']}")
     return "\n".join(lines)
 
